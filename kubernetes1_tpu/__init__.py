@@ -1,0 +1,25 @@
+"""kubernetes1_tpu — a TPU-native container-orchestration framework.
+
+A from-scratch re-design of the capabilities of the reference system (an
+NVIDIA fork of Kubernetes v1.9 that makes GPUs first-class schedulable
+devices; see SURVEY.md) with Cloud TPU as the only accelerator:
+
+- declarative API server over a consistent, watchable MVCC store
+  (ref: staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go)
+- level-triggered controllers (Job/ReplicaSet/Deployment/DaemonSet,
+  node lifecycle, namespace GC; ref: pkg/controller/)
+- device-aware scheduler allocating specific TPU chip IDs with attribute
+  affinity and ICI-topology gang scheduling
+  (ref: plugin/pkg/scheduler/core/extended_resources.go)
+- per-node agent (kubelet) with a device-manager plugin layer
+  (ref: pkg/kubelet/cm/devicemanager/)
+- a libtpu device plugin advertising google.com/tpu with topology
+  attributes and injecting /dev/accel* + TPU env into containers
+- a JAX workload layer (models/ops/parallel) providing the training
+  workloads the framework schedules: MNIST, ResNet-50, Llama-class
+  transformers with dp/tp/sp/pp shardings over a jax.sharding.Mesh.
+"""
+
+__version__ = "0.1.0"
+
+TPU_RESOURCE = "google.com/tpu"

@@ -1,0 +1,28 @@
+"""API types + scheme registration (ref: pkg/apis/core/install)."""
+
+from ..machinery.scheme import global_scheme
+from . import types as t  # noqa: F401
+from .types import *  # noqa: F401,F403
+
+_REGISTRY = [
+    # (class, plural, namespaced)
+    (t.Pod, "pods", True),
+    (t.Node, "nodes", False),
+    (t.Binding, "bindings", True),
+    (t.Namespace, "namespaces", False),
+    (t.Event, "events", True),
+    (t.Lease, "leases", True),
+    (t.Job, "jobs", True),
+    (t.ReplicaSet, "replicasets", True),
+    (t.Deployment, "deployments", True),
+    (t.DaemonSet, "daemonsets", True),
+    (t.Service, "services", True),
+    (t.Endpoints, "endpoints", True),
+    (t.ConfigMap, "configmaps", True),
+    (t.PriorityClass, "priorityclasses", False),
+]
+
+for cls, plural, namespaced in _REGISTRY:
+    global_scheme.register(cls, plural, namespaced)
+
+scheme = global_scheme

@@ -1,0 +1,673 @@
+"""Core API types — the L3 equivalent of the reference's pkg/apis/core +
+staging/src/k8s.io/api (Pod/Node/Binding/workloads), including the fork's
+ExtendedResources v2 device model re-pointed at TPU.
+
+Reference anchors (for parity checking, NOT copied):
+- Pod/Container/Node: staging/src/k8s.io/api/core/v1/types.go
+- fork ExtendedResources: types.go:2633-2637 (ResourceSelector/Affinity),
+  :2885 (PodSpec.ExtendedResources), :3848-3850 (NodeStatus.ExtendedResources),
+  :4018-4060 (Binding/ExtendedResourceMap/Domain/Device), :2202-2204
+  (Container.ExtendedResourceRequests)
+- Job: pkg/apis/batch/types.go — extended here with completionMode=Indexed and
+  gang scheduling policy, the two capabilities SURVEY.md flags as reference
+  gaps that multi-host TPU slices require.
+
+Differences from the reference, by design (TPU-first):
+- Devices carry free-form string attributes with the `google.com/tpu/` prefix
+  (topology, slice id, host index, chip coords) instead of NVIDIA attrs.
+- PodSpec.scheduling_gang names a gang; all pods of a gang bind atomically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..machinery.meta import KObject, ListMeta, ObjectMeta
+
+# ----------------------------------------------------------------- constants
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+DEVICE_HEALTHY = "Healthy"
+DEVICE_UNHEALTHY = "Unhealthy"
+
+NODE_READY = "Ready"
+
+# Well-known TPU attribute keys (vendor-prefixed like the reference's
+# nvidia.com/gpu/memory convention).
+ATTR_TPU_TYPE = "google.com/tpu/type"            # e.g. v5e, v5p
+ATTR_TPU_TOPOLOGY = "google.com/tpu/topology"    # e.g. 2x2x1, 4x4x8
+ATTR_TPU_SLICE = "google.com/tpu/slice"          # slice/ICI-domain id
+ATTR_TPU_HOST_INDEX = "google.com/tpu/host-index"
+ATTR_TPU_CHIP_COORDS = "google.com/tpu/coords"   # x,y,z within slice
+ATTR_TPU_CORES_PER_CHIP = "google.com/tpu/cores-per-chip"
+
+# Annotation carrying the scheduler's nominated node during preemption
+# (ref: scheduler.go NominatedNodeAnnotationKey).
+NOMINATED_NODE_ANNOTATION = "scheduler.ktpu.io/nominated-node"
+# Job completion index annotation+env (reference gap; needed for TPU worker id)
+COMPLETION_INDEX_ANNOTATION = "batch.ktpu.io/completion-index"
+JOB_NAME_LABEL = "batch.ktpu.io/job-name"
+
+# --------------------------------------------------------------- shared bits
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = "In"  # In | NotIn | Exists | DoesNotExist
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+
+# ----------------------------------------------------- extended resources v2
+
+
+@dataclass
+class ResourceSelectorRequirement:
+    """One attribute-affinity term (ref: types.go ResourceSelector)."""
+
+    key: str = ""  # e.g. google.com/tpu/type
+    operator: str = "In"  # In | NotIn | Exists | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ResourceAffinity:
+    required: List[ResourceSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class PodExtendedResource:
+    """A pod-level device request (ref: types.go PodExtendedResource).
+
+    `assigned` is filled by the scheduler at bind time and is the durable
+    record of which chip IDs the pod owns — the fork's restart-safe
+    "checkpoint in the API object" design (storage.go:186).
+    """
+
+    name: str = ""  # unique within pod; containers reference it
+    resource: str = ""  # e.g. google.com/tpu
+    quantity: int = 0
+    affinity: Optional[ResourceAffinity] = None
+    assigned: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ExtendedResourceDevice:
+    id: str = ""
+    health: str = DEVICE_HEALTHY
+    attributes: Dict[str, str] = field(default_factory=dict)
+
+
+# NodeStatus.extended_resources: {resource name: [devices]}
+ExtendedResourceMap = Dict[str, List[ExtendedResourceDevice]]
+
+
+# ------------------------------------------------------------------ pod spec
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class HostPathVolumeSource:
+    path: str = ""
+
+
+@dataclass
+class EmptyDirVolumeSource:
+    medium: str = ""
+
+
+@dataclass
+class ConfigMapVolumeSource:
+    name: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    host_path: Optional[HostPathVolumeSource] = None
+    empty_dir: Optional[EmptyDirVolumeSource] = None
+    config_map: Optional[ConfigMapVolumeSource] = None
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Dict[str, Any] = field(default_factory=dict)
+    requests: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ExecAction:
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = "/"
+    port: int = 0
+    host: str = ""
+
+
+@dataclass
+class TCPSocketAction:
+    port: int = 0
+    host: str = ""
+
+
+@dataclass
+class Probe:
+    exec_action: Optional[ExecAction] = None
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    initial_delay_seconds: int = 0
+    period_seconds: int = 10
+    timeout_seconds: int = 1
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    working_dir: str = ""
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    # Names of PodSpec.extended_resources entries this container consumes
+    # (ref: types.go:2202-2204).
+    extended_resource_requests: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeAffinityTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    # required node affinity terms are ORed; expressions within a term ANDed
+    node_affinity_required: List[NodeAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    restart_policy: str = "Always"  # Always | OnFailure | Never
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    priority: int = 0
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    termination_grace_period_seconds: int = 30
+    active_deadline_seconds: Optional[int] = None
+    host_network: bool = False
+    # fork v2: pod-level device requests with attribute affinity
+    extended_resources: List[PodExtendedResource] = field(default_factory=list)
+    # gang scheduling (TPU multi-host slices): pods sharing
+    # (namespace, scheduling_gang) bind all-or-nothing over gang_size pods.
+    scheduling_gang: str = ""
+    gang_size: int = 0
+
+
+@dataclass
+class ContainerStateRunning:
+    started_at: str = ""
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+    started_at: str = ""
+    finished_at: str = ""
+
+
+@dataclass
+class ContainerStateWaiting:
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class ContainerState:
+    waiting: Optional[ContainerStateWaiting] = None
+    running: Optional[ContainerStateRunning] = None
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: ContainerState = field(default_factory=ContainerState)
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    container_id: str = ""
+
+
+@dataclass
+class PodCondition:
+    type: str = ""  # PodScheduled | Ready | Initialized | ContainersReady
+    status: str = ""  # True | False | Unknown
+    reason: str = ""
+    message: str = ""
+    last_transition_time: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    message: str = ""
+    reason: str = ""
+    host_ip: str = ""
+    pod_ip: str = ""
+    start_time: str = ""
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod(KObject):
+    KIND = "Pod"
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+# --------------------------------------------------------------------- node
+
+
+@dataclass
+class NodeSystemInfo:
+    machine_id: str = ""
+    kernel_version: str = ""
+    os_image: str = ""
+    container_runtime_version: str = ""
+    kubelet_version: str = ""
+    architecture: str = ""
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""  # Ready | MemoryPressure | DiskPressure | TPUUnhealthy
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_heartbeat_time: str = ""
+    last_transition_time: str = ""
+
+
+@dataclass
+class NodeAddress:
+    type: str = ""  # InternalIP | Hostname
+    address: str = ""
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+    pod_cidr: str = ""
+    provider_id: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, Any] = field(default_factory=dict)
+    allocatable: Dict[str, Any] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    addresses: List[NodeAddress] = field(default_factory=list)
+    node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
+    # fork: per-device inventory with attributes (types.go:3848-3850),
+    # published by kubelet from the device manager's store
+    # (kubelet_node_status.go:552-621)
+    extended_resources: Dict[str, List[ExtendedResourceDevice]] = field(default_factory=dict)
+    images: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Node(KObject):
+    KIND = "Node"
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+# ------------------------------------------------------------------- binding
+
+
+@dataclass
+class Binding(KObject):
+    """Scheduler -> apiserver: bind pod to node + carry assigned device IDs
+    (ref: types.go:4493-4495, registry/core/pod/storage/storage.go:138-195).
+
+    `extended_resource_assignments` maps PodExtendedResource.name -> chip IDs.
+    """
+
+    KIND = "Binding"
+    target_node: str = ""
+    extended_resource_assignments: Dict[str, List[str]] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------- namespaces
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"  # Active | Terminating
+
+
+@dataclass
+class Namespace(KObject):
+    KIND = "Namespace"
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+
+# ------------------------------------------------------------------- events
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event(KObject):
+    KIND = "Event"
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    source_component: str = ""
+    first_timestamp: str = ""
+    last_timestamp: str = ""
+
+
+# -------------------------------------------------------------------- lease
+
+
+@dataclass
+class Lease(KObject):
+    """Leader-election resource lock (ref: client-go tools/leaderelection)."""
+
+    KIND = "Lease"
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: str = ""
+    renew_time: str = ""
+    lease_transitions: int = 0
+
+
+# ---------------------------------------------------------------- workloads
+
+
+@dataclass
+class JobSpec:
+    parallelism: Optional[int] = None
+    completions: Optional[int] = None
+    backoff_limit: int = 6
+    active_deadline_seconds: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # Indexed completion mode (reference gap — SURVEY.md §2.1 job row):
+    # each pod gets a stable completion index 0..completions-1, exposed as
+    # annotation + TPU_WORKER_ID env; required for multi-host TPU workers.
+    completion_mode: str = "NonIndexed"  # NonIndexed | Indexed
+    # Gang scheduling: all pods of the job bind atomically (TPU slices).
+    gang_scheduling: bool = False
+
+
+@dataclass
+class JobCondition:
+    type: str = ""  # Complete | Failed
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+    last_transition_time: str = ""
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: str = ""
+    completion_time: str = ""
+    conditions: List[JobCondition] = field(default_factory=list)
+    # Indexed mode: which indexes have succeeded, as a compact string "0-3,7"
+    completed_indexes: str = ""
+
+
+@dataclass
+class Job(KObject):
+    KIND = "Job"
+    API_VERSION = "batch/v1"
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    min_ready_seconds: int = 0
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    fully_labeled_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet(KObject):
+    KIND = "ReplicaSet"
+    API_VERSION = "apps/v1"
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+
+@dataclass
+class RollingUpdateDeployment:
+    max_unavailable: Any = 1  # int or "25%"
+    max_surge: Any = 1
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = "RollingUpdate"  # RollingUpdate | Recreate
+    rolling_update: RollingUpdateDeployment = field(default_factory=RollingUpdateDeployment)
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    revision_history_limit: int = 10
+    paused: bool = False
+
+
+@dataclass
+class DeploymentStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
+
+
+@dataclass
+class Deployment(KObject):
+    KIND = "Deployment"
+    API_VERSION = "apps/v1"
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    desired_number_scheduled: int = 0
+    number_ready: int = 0
+    number_misscheduled: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class DaemonSet(KObject):
+    KIND = "DaemonSet"
+    API_VERSION = "apps/v1"
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+# ----------------------------------------------------------------- services
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+    node_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"  # ClusterIP | NodePort
+
+
+@dataclass
+class Service(KObject):
+    KIND = "Service"
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints(KObject):
+    KIND = "Endpoints"
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+
+@dataclass
+class ConfigMap(KObject):
+    KIND = "ConfigMap"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PriorityClass(KObject):
+    KIND = "PriorityClass"
+    API_VERSION = "scheduling/v1"
+    value: int = 0
+    global_default: bool = False
+    description: str = ""

@@ -1,0 +1,3 @@
+from .server import Master
+from .registry import Registry
+from .admission import AdmissionChain, ResourceV2

@@ -1,0 +1,34 @@
+"""Standalone apiserver entrypoint (ref: cmd/kube-apiserver).
+
+    python -m kubernetes1_tpu.apiserver --port 8001 [--wal /var/lib/ktpu/store.wal]
+"""
+
+import argparse
+import signal
+import threading
+
+from .server import Master
+
+
+def main():
+    ap = argparse.ArgumentParser(description="ktpu apiserver")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8001)
+    ap.add_argument("--wal", default="", help="write-ahead log path for durability")
+    ap.add_argument("--token", default="", help="bearer token required from clients")
+    args = ap.parse_args()
+
+    master = Master(
+        host=args.host, port=args.port, wal_path=args.wal or None, token=args.token
+    )
+    master.start()
+    print(f"ktpu-apiserver listening on {master.url}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    master.stop()
+
+
+if __name__ == "__main__":
+    main()

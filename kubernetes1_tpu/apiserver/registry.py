@@ -1,0 +1,409 @@
+"""REST registry: per-resource storage strategies over the MVCC store.
+
+Ref: pkg/registry/ — each resource has a strategy (defaulting + validation +
+key layout) and shares generic Create/Update/Delete/List/Watch plumbing; the
+pod Binding subresource applies the scheduler's device assignment through a
+single GuaranteedUpdate transaction (registry/core/pod/storage/storage.go:
+138-195), which is what makes device assignment restart-safe without any
+kubelet-local checkpoint file.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..machinery import (
+    BadRequest,
+    Conflict,
+    Invalid,
+    NotFound,
+    labels as labelutil,
+    now_iso,
+)
+from ..machinery.errors import Forbidden
+from ..machinery.scheme import Scheme, from_dict, to_dict
+from ..storage import Store, StopUpdate
+
+_NAME_SUFFIX_ALPHABET = string.ascii_lowercase + string.digits
+
+
+def _rand_suffix(n=5):
+    return "".join(random.choice(_NAME_SUFFIX_ALPHABET) for _ in range(n))
+
+
+def field_get(obj_dict: Dict[str, Any], dotted: str) -> Any:
+    cur: Any = obj_dict
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return "" if cur is None else cur
+
+
+def parse_field_selector(s: str) -> List[Tuple[str, str, str]]:
+    """'spec.nodeName=x,status.phase!=Failed' -> [(path, op, value)]."""
+    out = []
+    for part in (p for p in s.split(",") if p.strip()):
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            out.append((k.strip(), "!=", v.strip()))
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            out.append((k.strip(), "=", v.strip()))
+        else:
+            raise BadRequest(f"invalid field selector {part!r}")
+    return out
+
+
+def field_selector_matches(reqs, obj_dict) -> bool:
+    for path, op, val in reqs:
+        have = str(field_get(obj_dict, path))
+        if op == "=" and have != val:
+            return False
+        if op == "!=" and have == val:
+            return False
+    return True
+
+
+class Strategy:
+    """Per-resource defaulting + validation hooks."""
+
+    def prepare_for_create(self, obj):
+        pass
+
+    def validate(self, obj):
+        if not obj.metadata.name:
+            raise Invalid("metadata.name is required")
+
+    def prepare_for_update(self, new, old):
+        # Immutable system metadata survives client writes.
+        new.metadata.uid = old.metadata.uid
+        new.metadata.creation_timestamp = old.metadata.creation_timestamp
+
+
+class PodStrategy(Strategy):
+    def prepare_for_create(self, obj):
+        if not obj.spec.restart_policy:
+            obj.spec.restart_policy = "Always"
+        for c in obj.spec.containers:
+            if not c.name:
+                raise Invalid("container name required")
+
+    def validate(self, obj):
+        super().validate(obj)
+        if not obj.spec.containers:
+            raise Invalid("spec.containers must not be empty")
+        names = [c.name for c in obj.spec.containers]
+        if len(set(names)) != len(names):
+            raise Invalid("duplicate container names")
+        seen = set()
+        for per in obj.spec.extended_resources:
+            if per.name in seen:
+                raise Invalid(f"duplicate extended resource {per.name!r}")
+            seen.add(per.name)
+            if per.quantity <= 0:
+                raise Invalid("extended resource quantity must be > 0")
+        valid = {per.name for per in obj.spec.extended_resources}
+        for c in obj.spec.containers:
+            for ref in c.extended_resource_requests:
+                if ref not in valid:
+                    raise Invalid(f"container {c.name} references unknown extended resource {ref!r}")
+
+    def prepare_for_update(self, new, old):
+        super().prepare_for_update(new, old)
+        # NodeName is write-once outside the binding subresource.
+        if old.spec.node_name and new.spec.node_name != old.spec.node_name:
+            raise Forbidden("pod.spec.nodeName is immutable once set; use the binding subresource")
+
+
+class NodeStrategy(Strategy):
+    pass
+
+
+class JobStrategy(Strategy):
+    def prepare_for_create(self, obj):
+        if obj.spec.parallelism is None:
+            obj.spec.parallelism = 1
+        if obj.spec.completion_mode not in ("NonIndexed", "Indexed"):
+            raise Invalid("completionMode must be NonIndexed or Indexed")
+        if obj.spec.selector is None:
+            obj.spec.selector = t.LabelSelector(
+                match_labels={t.JOB_NAME_LABEL: obj.metadata.name}
+            )
+            obj.spec.template.metadata.labels.setdefault(
+                t.JOB_NAME_LABEL, obj.metadata.name
+            )
+
+
+class ReplicaSetStrategy(Strategy):
+    def prepare_for_create(self, obj):
+        if obj.spec.replicas is None:
+            obj.spec.replicas = 1
+
+    def validate(self, obj):
+        super().validate(obj)
+        if obj.spec.selector is None or (
+            not obj.spec.selector.match_labels and not obj.spec.selector.match_expressions
+        ):
+            raise Invalid("spec.selector is required")
+        if not labelutil.label_selector_matches(
+            obj.spec.selector, obj.spec.template.metadata.labels
+        ):
+            raise Invalid("selector does not match template labels")
+
+
+class DeploymentStrategy_(ReplicaSetStrategy):
+    pass
+
+
+_STRATEGIES: Dict[str, Strategy] = {}
+
+
+def strategy_for(resource: str) -> Strategy:
+    if resource not in _STRATEGIES:
+        _STRATEGIES[resource] = {
+            "pods": PodStrategy,
+            "nodes": NodeStrategy,
+            "jobs": JobStrategy,
+            "replicasets": ReplicaSetStrategy,
+            "deployments": DeploymentStrategy_,
+        }.get(resource, Strategy)()
+    return _STRATEGIES[resource]
+
+
+class Registry:
+    """All-resource REST storage facade used by the HTTP server and by
+    in-process tests (the master_utils.RunAMaster analogue)."""
+
+    def __init__(self, store: Store, scheme: Scheme):
+        self.store = store
+        self.scheme = scheme
+        self._ns_lock = threading.Lock()
+
+    # ------------------------------------------------------------------ keys
+
+    def key(self, resource: str, namespace: str, name: str) -> str:
+        if self.scheme.namespaced.get(resource, True):
+            if not namespace:
+                raise BadRequest(f"{resource} is namespaced; namespace required")
+            return f"/registry/{resource}/{namespace}/{name}"
+        return f"/registry/{resource}/{name}"
+
+    def prefix(self, resource: str, namespace: str = "") -> str:
+        if namespace and self.scheme.namespaced.get(resource, True):
+            return f"/registry/{resource}/{namespace}/"
+        return f"/registry/{resource}/"
+
+    # ------------------------------------------------------------- namespace
+
+    def ensure_namespace(self, name: str):
+        with self._ns_lock:
+            key = self.key("namespaces", "", name)
+            if self.store.get_or_none(key) is None:
+                ns = t.Namespace()
+                ns.metadata.name = name
+                self.store.create(key, ns)
+
+    def check_namespace_active(self, name: str):
+        ns = self.store.get_or_none(self.key("namespaces", "", name))
+        if ns is not None and ns.status.phase == "Terminating":
+            raise Forbidden(f"namespace {name} is terminating")
+
+    # ------------------------------------------------------------ operations
+
+    def create(self, resource: str, namespace: str, obj):
+        if self.scheme.namespaced.get(resource, True):
+            obj.metadata.namespace = namespace or obj.metadata.namespace or "default"
+        else:
+            obj.metadata.namespace = ""
+        if not obj.metadata.name and obj.metadata.generate_name:
+            obj.metadata.name = obj.metadata.generate_name + _rand_suffix()
+        strat = strategy_for(resource)
+        strat.prepare_for_create(obj)
+        strat.validate(obj)
+        if self.scheme.namespaced.get(resource, True):
+            self.check_namespace_active(obj.metadata.namespace)
+        key = self.key(resource, obj.metadata.namespace, obj.metadata.name)
+        return self.store.create(key, obj)
+
+    def get(self, resource: str, namespace: str, name: str):
+        try:
+            return self.store.get(self.key(resource, namespace, name))
+        except NotFound:
+            raise NotFound(f'{resource} "{name}" not found') from None
+
+    def update(self, resource: str, namespace: str, name: str, obj):
+        strat = strategy_for(resource)
+        key = self.key(resource, namespace, name)
+        old = self.store.get(key)
+        strat.prepare_for_update(obj, old)
+        strat.validate(obj)
+        if obj.metadata.generation or old.metadata.generation:
+            if to_dict(getattr(obj, "spec", None)) != to_dict(getattr(old, "spec", None)):
+                obj.metadata.generation = old.metadata.generation + 1
+            else:
+                obj.metadata.generation = old.metadata.generation
+        return self.store.update_cas(key, obj)
+
+    def update_status(self, resource: str, namespace: str, name: str, obj):
+        """Status subresource: only .status (and labels/annotations) land."""
+        key = self.key(resource, namespace, name)
+
+        def apply(cur):
+            if obj.metadata.resource_version and (
+                obj.metadata.resource_version != cur.metadata.resource_version
+            ):
+                raise Conflict(f"{name}: resourceVersion mismatch on status update")
+            if hasattr(cur, "status"):
+                cur.status = obj.status
+            return cur
+
+        return self.store.guaranteed_update(key, apply)
+
+    def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]):
+        """RFC 7386 JSON merge patch via GuaranteedUpdate."""
+        key = self.key(resource, namespace, name)
+        cls = self.scheme.by_resource[resource]
+
+        def apply(cur):
+            merged = _merge_patch(self.scheme.encode(cur), patch)
+            obj = from_dict(cls, merged)
+            obj.metadata.resource_version = cur.metadata.resource_version
+            strategy_for(resource).prepare_for_update(obj, cur)
+            return obj
+
+        return self.store.guaranteed_update(key, apply)
+
+    def delete(self, resource: str, namespace: str, name: str, grace_seconds: Optional[int] = None):
+        key = self.key(resource, namespace, name)
+        obj = self.store.get(key)
+        if resource == "pods":
+            return self._delete_pod(key, obj, grace_seconds)
+        if resource == "namespaces":
+            return self._delete_namespace(obj)
+        return self.store.delete(key)
+
+    def _delete_pod(self, key, pod, grace_seconds):
+        """Graceful pod deletion (ref: registry pod strategy + kubelet):
+        scheduled, running pods get deletionTimestamp and the kubelet
+        finalizes with grace 0; unscheduled or finished pods go immediately."""
+        if grace_seconds is None:
+            grace_seconds = pod.spec.termination_grace_period_seconds
+        finished = pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+        if grace_seconds == 0 or not pod.spec.node_name or finished:
+            return self.store.delete(key)
+
+        def mark(cur):
+            if cur.metadata.deletion_timestamp:
+                raise StopUpdate()
+            cur.metadata.deletion_timestamp = now_iso()
+            return cur
+
+        try:
+            return self.store.guaranteed_update(key, mark)
+        except StopUpdate:
+            return pod
+
+    def _delete_namespace(self, ns):
+        """Namespace deletion: mark Terminating; the namespace controller
+        empties it and then finalizes with force=True."""
+        def mark(cur):
+            cur.status.phase = "Terminating"
+            if not cur.metadata.deletion_timestamp:
+                cur.metadata.deletion_timestamp = now_iso()
+            return cur
+
+        return self.store.guaranteed_update(self.key("namespaces", "", ns.metadata.name), mark)
+
+    def finalize_namespace(self, name: str):
+        return self.store.delete(self.key("namespaces", "", name))
+
+    def list(
+        self,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ):
+        items, rev = self.store.list(self.prefix(resource, namespace))
+        if label_selector:
+            reqs = labelutil.parse_selector(label_selector)
+            items = [
+                o for o in items if labelutil.selector_matches(reqs, o.metadata.labels)
+            ]
+        if field_selector:
+            freqs = parse_field_selector(field_selector)
+            items = [
+                o for o in items if field_selector_matches(freqs, self.scheme.encode(o))
+            ]
+        return items, rev
+
+    def watch(
+        self,
+        resource: str,
+        namespace: str = "",
+        since_rev: int = 0,
+        label_selector: str = "",
+        field_selector: str = "",
+    ):
+        w = self.store.watch(self.prefix(resource, namespace), since_rev)
+        lreqs = labelutil.parse_selector(label_selector) if label_selector else None
+        freqs = parse_field_selector(field_selector) if field_selector else None
+
+        def event_matches(obj_dict) -> bool:
+            if lreqs is not None and not labelutil.selector_matches(
+                lreqs, (obj_dict.get("metadata") or {}).get("labels") or {}
+            ):
+                return False
+            if freqs is not None and not field_selector_matches(freqs, obj_dict):
+                return False
+            return True
+
+        w.event_matches = event_matches  # attached for the server loop
+        return w
+
+    # ---------------------------------------------------------- binding
+
+    def bind(self, namespace: str, pod_name: str, binding: t.Binding):
+        """Apply the scheduler's placement transactionally
+        (ref: storage.go:147,181-186)."""
+        key = self.key("pods", namespace, pod_name)
+
+        def apply(pod):
+            if pod.spec.node_name and pod.spec.node_name != binding.target_node:
+                raise Conflict(
+                    f"pod {pod_name} already bound to {pod.spec.node_name}"
+                )
+            pod.spec.node_name = binding.target_node
+            by_name = {per.name: per for per in pod.spec.extended_resources}
+            for req_name, ids in binding.extended_resource_assignments.items():
+                per = by_name.get(req_name)
+                if per is None:
+                    raise Invalid(f"unknown extended resource {req_name!r} in binding")
+                if len(ids) != per.quantity:
+                    raise Invalid(
+                        f"binding assigns {len(ids)} devices to {req_name}, want {per.quantity}"
+                    )
+                per.assigned = list(ids)
+            pod.metadata.annotations.pop(t.NOMINATED_NODE_ANNOTATION, None)
+            return pod
+
+        return self.store.guaranteed_update(key, apply)
+
+
+def _merge_patch(target: Any, patch: Any) -> Any:
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out

@@ -1,0 +1,402 @@
+"""HTTP API server: REST + streaming watch over the registry.
+
+Ref: cmd/kube-apiserver + staging/src/k8s.io/apiserver/pkg/server — the
+filter chain (authn -> audit -> authz -> admission) collapses here to a
+bearer-token check hook, an audit log hook, and the admission chain; the
+wire protocol is the reference's: JSON objects, list kinds with a
+resourceVersion for watch resume, and watch streams as line-delimited
+{"type","object"} frames over chunked HTTP (exactly what client-go's
+reflector consumes).
+
+The in-process `Master` is the master_utils.RunAMaster equivalent
+(test/integration/framework/master_utils.go:193): tests and the local
+cluster boot embed a full apiserver over the MVCC store with zero setup.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api import types as t
+from ..machinery import ApiError, BadRequest, NotFound
+from ..machinery.scheme import Scheme, global_scheme
+from ..storage import Store
+from .admission import (
+    CREATE,
+    UPDATE,
+    AdmissionChain,
+    GangDefaulter,
+    NamespaceAutoProvision,
+    PriorityResolver,
+    ResourceV2,
+)
+from .registry import Registry
+
+WATCH_HEARTBEAT_SECONDS = 5.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ktpu-apiserver/0.1"
+
+    # quiet request logging; audit hook covers observability
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def master(self) -> "Master":
+        return self.server.master  # type: ignore[attr-defined]
+
+    def _send_json(self, code: int, payload: Dict[str, Any]):
+        raw = json.dumps(payload, separators=(",", ":")).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _send_error(self, err: ApiError):
+        self._send_json(err.code, err.to_status())
+
+    def _read_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            raise BadRequest("request body required")
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            raise BadRequest(f"invalid JSON body: {e}") from e
+
+    def _authn(self) -> bool:
+        token = self.master.token
+        if not token:
+            return True
+        auth = self.headers.get("Authorization", "")
+        return auth == f"Bearer {token}"
+
+    # ------------------------------------------------------------- dispatch
+
+    def _route(self):
+        parsed = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        parts = [p for p in parsed.path.split("/") if p]
+        return parts, q
+
+    def _parse_resource_path(self, parts):
+        """Return (resource, namespace, name, subresource).
+
+        Accepted forms (group prefixes /api/v1 and /apis/<g>/<v> both map to
+        the single flat registry):
+          <prefix>/<resource>
+          <prefix>/<resource>/<name>[/<sub>]
+          <prefix>/namespaces/<ns>/<resource>[/<name>[/<sub>]]
+        """
+        if not parts or parts[0] not in ("api", "apis"):
+            raise NotFound(f"unknown path {self.path}")
+        rest = parts[2:] if parts[0] == "api" else parts[3:]
+        if not rest:
+            raise NotFound("missing resource")
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns, resource = rest[1], rest[2]
+            name = rest[3] if len(rest) > 3 else ""
+            sub = rest[4] if len(rest) > 4 else ""
+            return resource, ns, name, sub
+        resource = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        sub = rest[2] if len(rest) > 2 else ""
+        return resource, "", name, sub
+
+    def _handle(self, method: str):
+        start = time.monotonic()
+        try:
+            if not self._authn():
+                self.send_response(401)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            parts, q = self._route()
+            if parts and parts[0] in ("healthz", "readyz", "livez"):
+                self._send_json(200, {"status": "ok"})
+                return
+            if parts and parts[0] == "version":
+                self._send_json(200, {"gitVersion": "v0.1.0-ktpu", "platform": "tpu"})
+                return
+            if parts and parts[0] == "metrics":
+                self._serve_metrics()
+                return
+            resource, ns, name, sub = self._parse_resource_path(parts)
+            if resource not in self.master.scheme.by_resource:
+                raise NotFound(f"resource {resource!r} not registered")
+            handler = getattr(self, f"_do_{method.lower()}")
+            handler(resource, ns, name, sub, q)
+            self.master.metrics.observe(method, resource, time.monotonic() - start)
+        except ApiError as e:
+            try:
+                self._send_error(e)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            traceback.print_exc()
+            try:
+                err = ApiError(str(e))
+                self._send_error(err)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_PUT(self):
+        self._handle("PUT")
+
+    def do_PATCH(self):
+        self._handle("PATCH")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+    # ------------------------------------------------------------------ GET
+
+    def _do_get(self, resource, ns, name, sub, q):
+        reg = self.master.registry
+        if name and not sub:
+            obj = reg.get(resource, ns, name)
+            self._send_json(200, self.master.scheme.encode(obj))
+            return
+        if name and sub:
+            raise NotFound(f"subresource {sub!r} not readable")
+        if q.get("watch") in ("1", "true"):
+            self._serve_watch(resource, ns, q)
+            return
+        items, rev = reg.list(
+            resource,
+            ns,
+            label_selector=q.get("labelSelector", ""),
+            field_selector=q.get("fieldSelector", ""),
+        )
+        kind = self.master.scheme.by_resource[resource].KIND + "List"
+        self._send_json(
+            200,
+            {
+                "kind": kind,
+                "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(rev)},
+                "items": [self.master.scheme.encode(o) for o in items],
+            },
+        )
+
+    def _serve_watch(self, resource, ns, q):
+        since = int(q.get("resourceVersion") or 0)
+        timeout = float(q.get("timeoutSeconds") or 0)
+        w = self.master.registry.watch(
+            resource,
+            ns,
+            since_rev=since,
+            label_selector=q.get("labelSelector", ""),
+            field_selector=q.get("fieldSelector", ""),
+        )
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        deadline = time.monotonic() + timeout if timeout else None
+        try:
+            while True:
+                if deadline and time.monotonic() >= deadline:
+                    break
+                ev = w.next_timeout(WATCH_HEARTBEAT_SECONDS)
+                if self.master.stopping.is_set():
+                    break
+                if ev is None:
+                    # heartbeat chunk keeps half-open connections detectable
+                    self._write_chunk(b"")
+                    continue
+                if not w.event_matches(ev.object):
+                    continue
+                frame = json.dumps(
+                    {"type": ev.type, "object": ev.object}, separators=(",", ":")
+                ).encode() + b"\n"
+                self._write_chunk(frame)
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            pass
+        finally:
+            w.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:  # noqa: BLE001
+                pass
+            self.close_connection = True
+
+    def _write_chunk(self, data: bytes):
+        if not data:
+            # zero-length would terminate chunked encoding; send a newline
+            data = b"\n"
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _serve_metrics(self):
+        body = self.master.metrics.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ----------------------------------------------------------------- POST
+
+    def _do_post(self, resource, ns, name, sub, q):
+        reg = self.master.registry
+        body = self._read_body()
+        if resource == "pods" and sub == "binding":
+            binding = self.master.scheme.decode(body)
+            pod = reg.bind(ns, name, binding)
+            self.master.audit("bind", resource, ns, name)
+            self._send_json(201, self.master.scheme.encode(pod))
+            return
+        if sub:
+            raise NotFound(f"subresource {sub!r} not writable")
+        obj = self.master.scheme.decode(body)
+        obj = self.master.admission.admit(CREATE, resource, obj)
+        created = reg.create(resource, ns, obj)
+        self.master.audit("create", resource, ns, created.metadata.name)
+        self._send_json(201, self.master.scheme.encode(created))
+
+    # ------------------------------------------------------------------ PUT
+
+    def _do_put(self, resource, ns, name, sub, q):
+        reg = self.master.registry
+        body = self._read_body()
+        obj = self.master.scheme.decode(body)
+        if sub == "status":
+            updated = reg.update_status(resource, ns, name, obj)
+        elif sub:
+            raise NotFound(f"subresource {sub!r} not writable")
+        else:
+            old = reg.get(resource, ns, name)
+            obj = self.master.admission.admit(UPDATE, resource, obj, old)
+            updated = reg.update(resource, ns, name, obj)
+        self.master.audit("update", resource, ns, name)
+        self._send_json(200, self.master.scheme.encode(updated))
+
+    # ---------------------------------------------------------------- PATCH
+
+    def _do_patch(self, resource, ns, name, sub, q):
+        patch = self._read_body()
+        if sub == "status":
+            patch = {"status": patch.get("status", patch)}
+        updated = self.master.registry.patch(resource, ns, name, patch)
+        self.master.audit("patch", resource, ns, name)
+        self._send_json(200, self.master.scheme.encode(updated))
+
+    # --------------------------------------------------------------- DELETE
+
+    def _do_delete(self, resource, ns, name, sub, q):
+        if not name:
+            raise BadRequest("collection delete not supported; delete by name")
+        grace = q.get("gracePeriodSeconds")
+        obj = self.master.registry.delete(
+            resource, ns, name, None if grace is None else int(grace)
+        )
+        self.master.audit("delete", resource, ns, name)
+        self._send_json(200, self.master.scheme.encode(obj))
+
+
+class Metrics:
+    """Minimal Prometheus-style counters/histogram sums (ref: apiserver
+    request metrics; full component metrics live in utils/metrics.py)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._sums: Dict[str, float] = {}
+
+    def observe(self, method: str, resource: str, seconds: float):
+        key = f'method="{method}",resource="{resource}"'
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._sums[key] = self._sums.get(key, 0.0) + seconds
+
+    def render(self) -> str:
+        lines = [
+            "# TYPE apiserver_request_total counter",
+        ]
+        with self._lock:
+            for key, n in sorted(self._counts.items()):
+                lines.append(f"apiserver_request_total{{{key}}} {n}")
+            lines.append("# TYPE apiserver_request_duration_seconds_sum counter")
+            for key, s in sorted(self._sums.items()):
+                lines.append(f"apiserver_request_duration_seconds_sum{{{key}}} {s:.6f}")
+        return "\n".join(lines) + "\n"
+
+
+class Master:
+    """In-process apiserver: store + registry + admission + HTTP frontend."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        scheme: Optional[Scheme] = None,
+        wal_path: Optional[str] = None,
+        token: str = "",
+        audit_log: Optional[list] = None,
+    ):
+        self.scheme = scheme or global_scheme
+        self.store = Store(self.scheme, wal_path=wal_path)
+        self.registry = Registry(self.store, self.scheme)
+        self.token = token
+        self.metrics = Metrics()
+        self.stopping = threading.Event()
+        self._audit_log = audit_log
+        self.admission = AdmissionChain(
+            [
+                NamespaceAutoProvision(self.registry.ensure_namespace),
+                PriorityResolver(self._get_priority_class),
+                ResourceV2(),
+                GangDefaulter(),
+            ]
+        )
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.master = self  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def _get_priority_class(self, name: str):
+        return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
+
+    def audit(self, verb: str, resource: str, ns: str, name: str):
+        if self._audit_log is not None:
+            self._audit_log.append(
+                {"ts": time.time(), "verb": verb, "resource": resource, "ns": ns, "name": name}
+            )
+
+    def start(self) -> "Master":
+        self.registry.ensure_namespace("default")
+        self.registry.ensure_namespace("kube-system")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.stopping.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.store.close()

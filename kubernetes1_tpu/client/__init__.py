@@ -1,0 +1,5 @@
+from .rest import ApiClient
+from .clientset import Clientset, ResourceClient
+from .informer import SharedInformer, InformerFactory
+from .leaderelection import LeaderElector
+from .events import EventRecorder

@@ -1,0 +1,188 @@
+"""Typed clientset over ApiClient (ref: client-go kubernetes.Clientset).
+
+Each ResourceClient handles one resource's full verb set including the
+status and binding subresources; objects cross the wire as scheme-encoded
+JSON and come back as typed dataclasses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..machinery.scheme import Scheme, global_scheme
+from .rest import ApiClient, WatchStream
+
+_GROUP_PATH = {
+    "jobs": "/apis/batch/v1",
+    "replicasets": "/apis/apps/v1",
+    "deployments": "/apis/apps/v1",
+    "daemonsets": "/apis/apps/v1",
+    "priorityclasses": "/apis/scheduling/v1",
+}
+
+
+class ResourceClient:
+    def __init__(self, api: ApiClient, resource: str, scheme: Scheme):
+        self.api = api
+        self.resource = resource
+        self.scheme = scheme
+        self.namespaced = scheme.namespaced.get(resource, True)
+        self._base = _GROUP_PATH.get(resource, "/api/v1")
+
+    def _path(self, namespace: str = "", name: str = "", sub: str = "") -> str:
+        parts = [self._base]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.resource)
+        if name:
+            parts.append(name)
+        if sub:
+            parts.append(sub)
+        return "/".join(parts)
+
+    # ---------------------------------------------------------------- verbs
+
+    def create(self, obj, namespace: str = ""):
+        ns = namespace or obj.metadata.namespace or ("default" if self.namespaced else "")
+        data = self.api.request("POST", self._path(ns), body=self.scheme.encode(obj))
+        return self.scheme.decode(data)
+
+    def get(self, name: str, namespace: str = "default"):
+        data = self.api.request("GET", self._path(namespace, name))
+        return self.scheme.decode(data)
+
+    def list(
+        self,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> Tuple[List[Any], str]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        data = self.api.request("GET", self._path(namespace), params=params)
+        items = [self.scheme.decode(d) for d in data.get("items", [])]
+        rv = (data.get("metadata") or {}).get("resourceVersion", "0")
+        return items, rv
+
+    def update(self, obj):
+        ns = obj.metadata.namespace
+        data = self.api.request(
+            "PUT", self._path(ns, obj.metadata.name), body=self.scheme.encode(obj)
+        )
+        return self.scheme.decode(data)
+
+    def update_status(self, obj):
+        ns = obj.metadata.namespace
+        data = self.api.request(
+            "PUT",
+            self._path(ns, obj.metadata.name, "status"),
+            body=self.scheme.encode(obj),
+        )
+        return self.scheme.decode(data)
+
+    def patch(self, name: str, patch: Dict[str, Any], namespace: str = "default"):
+        data = self.api.request("PATCH", self._path(namespace, name), body=patch)
+        return self.scheme.decode(data)
+
+    def delete(self, name: str, namespace: str = "default", grace_seconds: Optional[int] = None):
+        params = {}
+        if grace_seconds is not None:
+            params["gracePeriodSeconds"] = str(grace_seconds)
+        data = self.api.request("DELETE", self._path(namespace, name), params=params)
+        return self.scheme.decode(data)
+
+    def watch(
+        self,
+        namespace: str = "",
+        resource_version: str = "0",
+        label_selector: str = "",
+        field_selector: str = "",
+        timeout_seconds: float = 0,
+    ) -> WatchStream:
+        params = {"resourceVersion": resource_version}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if field_selector:
+            params["fieldSelector"] = field_selector
+        if timeout_seconds:
+            params["timeoutSeconds"] = str(timeout_seconds)
+        return self.api.watch(self._path(namespace), params)
+
+
+class Clientset:
+    def __init__(self, url: str, token: str = "", scheme: Optional[Scheme] = None):
+        self.api = ApiClient(url, token=token)
+        self.scheme = scheme or global_scheme
+        self._clients: Dict[str, ResourceClient] = {}
+
+    def resource(self, plural: str) -> ResourceClient:
+        if plural not in self._clients:
+            self._clients[plural] = ResourceClient(self.api, plural, self.scheme)
+        return self._clients[plural]
+
+    @property
+    def pods(self) -> ResourceClient:
+        return self.resource("pods")
+
+    @property
+    def nodes(self) -> ResourceClient:
+        return self.resource("nodes")
+
+    @property
+    def namespaces(self) -> ResourceClient:
+        return self.resource("namespaces")
+
+    @property
+    def events(self) -> ResourceClient:
+        return self.resource("events")
+
+    @property
+    def jobs(self) -> ResourceClient:
+        return self.resource("jobs")
+
+    @property
+    def replicasets(self) -> ResourceClient:
+        return self.resource("replicasets")
+
+    @property
+    def deployments(self) -> ResourceClient:
+        return self.resource("deployments")
+
+    @property
+    def daemonsets(self) -> ResourceClient:
+        return self.resource("daemonsets")
+
+    @property
+    def services(self) -> ResourceClient:
+        return self.resource("services")
+
+    @property
+    def endpoints(self) -> ResourceClient:
+        return self.resource("endpoints")
+
+    @property
+    def leases(self) -> ResourceClient:
+        return self.resource("leases")
+
+    @property
+    def configmaps(self) -> ResourceClient:
+        return self.resource("configmaps")
+
+    @property
+    def priorityclasses(self) -> ResourceClient:
+        return self.resource("priorityclasses")
+
+    def bind(self, namespace: str, pod_name: str, binding: t.Binding):
+        data = self.api.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{pod_name}/binding",
+            body=self.scheme.encode(binding),
+        )
+        return self.scheme.decode(data)
+
+    def close(self):
+        self.api.close()

@@ -1,0 +1,61 @@
+"""Event recorder (ref: client-go tools/record) — best-effort, rate-bounded
+event creation with count aggregation for repeats."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ..api import types as t
+from ..machinery import now_iso
+from .clientset import Clientset
+
+
+class EventRecorder:
+    def __init__(self, clientset: Clientset, component: str, max_cached: int = 4096):
+        self.cs = clientset
+        self.component = component
+        self._lock = threading.Lock()
+        self._seen: Dict[tuple, str] = {}  # aggregation key -> event name
+        self._max = max_cached
+
+    def event(self, obj, event_type: str, reason: str, message: str):
+        """Record an event about obj; repeats bump count instead of piling up."""
+        ref = t.ObjectReference(
+            kind=type(obj).KIND,
+            namespace=obj.metadata.namespace,
+            name=obj.metadata.name,
+            uid=obj.metadata.uid,
+        )
+        key = (ref.kind, ref.namespace, ref.name, reason, message[:64])
+        now = now_iso()
+        with self._lock:
+            existing = self._seen.get(key)
+        ns = ref.namespace or "default"
+        try:
+            if existing:
+                self._bump(existing, ns, now)
+                return
+            ev = t.Event()
+            ev.metadata.generate_name = f"{ref.name}."
+            ev.metadata.namespace = ns
+            ev.involved_object = ref
+            ev.type = event_type
+            ev.reason = reason
+            ev.message = message
+            ev.source_component = self.component
+            ev.first_timestamp = now
+            ev.last_timestamp = now
+            created = self.cs.events.create(ev, ns)
+            with self._lock:
+                if len(self._seen) > self._max:
+                    self._seen.clear()
+                self._seen[key] = created.metadata.name
+        except Exception:  # noqa: BLE001 — events are best-effort
+            pass
+
+    def _bump(self, name: str, ns: str, now: str):
+        ev = self.cs.events.get(name, ns)
+        ev.count += 1
+        ev.last_timestamp = now
+        self.cs.events.update(ev)

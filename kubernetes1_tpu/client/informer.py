@@ -1,0 +1,222 @@
+"""Reflector + SharedInformer: the LIST+WATCH cache every control loop uses.
+
+Ref: client-go tools/cache/{reflector.go:239,shared_informer.go,delta_fifo.go}.
+Semantics preserved:
+- initial LIST seeds the cache and records the collection resourceVersion;
+- WATCH resumes from that version so no event is missed (exactly-once
+  delivery into the local cache);
+- a 410 Expired (compacted revision) triggers full relist — handlers see a
+  resync as adds/updates/deletes computed against the existing cache;
+- handlers run on a single dispatch thread per informer (ordering guarantee),
+  and has_synced() gates controllers until the first LIST is delivered.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from ..machinery import ApiError, TooOldResourceVersion
+from .clientset import Clientset, ResourceClient
+
+
+class SharedInformer:
+    def __init__(
+        self,
+        client: ResourceClient,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+        resync_period: float = 0.0,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.resync_period = resync_period
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+        self._handlers: List[Dict[str, Callable]] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watch_stream = None
+
+    # ----------------------------------------------------------------- api
+
+    def add_handler(
+        self,
+        on_add: Optional[Callable[[Any], None]] = None,
+        on_update: Optional[Callable[[Any, Any], None]] = None,
+        on_delete: Optional[Callable[[Any], None]] = None,
+    ):
+        self._handlers.append({"add": on_add, "update": on_update, "delete": on_delete})
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        ws = self._watch_stream
+        if ws is not None:
+            ws.close()
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # ------------------------------------------------------------- store api
+
+    @staticmethod
+    def _key(obj) -> str:
+        m = obj.metadata
+        return f"{m.namespace}/{m.name}" if m.namespace else m.name
+
+    def get(self, key: str):
+        with self._lock:
+            return self._cache.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._cache.values())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._cache.keys())
+
+    # ---------------------------------------------------------------- loops
+
+    def _dispatch(self, kind: str, *args):
+        for h in self._handlers:
+            fn = h.get(kind)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — handler bugs must not kill the informer
+                traceback.print_exc()
+
+    def _relist(self) -> str:
+        items, rv = self.client.list(
+            namespace=self.namespace,
+            label_selector=self.label_selector,
+            field_selector=self.field_selector,
+        )
+        fresh = {self._key(o): o for o in items}
+        with self._lock:
+            old = self._cache
+            self._cache = fresh
+        for key, obj in fresh.items():
+            if key in old:
+                self._dispatch("update", old[key], obj)
+            else:
+                self._dispatch("add", obj)
+        for key, obj in old.items():
+            if key not in fresh:
+                self._dispatch("delete", obj)
+        self._synced.set()
+        return rv
+
+    def _run(self):
+        rv = "0"
+        while not self._stop.is_set():
+            try:
+                rv = self._relist()
+                self._watch_loop(rv)
+            except ApiError:
+                self._stop.wait(0.5)
+            except Exception:  # noqa: BLE001
+                if not self._stop.is_set():
+                    traceback.print_exc()
+                    self._stop.wait(1.0)
+
+    def _watch_loop(self, rv: str):
+        while not self._stop.is_set():
+            try:
+                stream = self.client.watch(
+                    namespace=self.namespace,
+                    resource_version=rv,
+                    label_selector=self.label_selector,
+                    field_selector=self.field_selector,
+                )
+            except TooOldResourceVersion:
+                return  # relist
+            self._watch_stream = stream
+            try:
+                for ev_type, obj_dict in stream:
+                    if self._stop.is_set():
+                        return
+                    obj = self.client.scheme.decode(obj_dict)
+                    rv = obj.metadata.resource_version or rv
+                    key = self._key(obj)
+                    if ev_type == "DELETED":
+                        with self._lock:
+                            old = self._cache.pop(key, None)
+                        self._dispatch("delete", obj if old is None else old)
+                    elif ev_type in ("ADDED", "MODIFIED"):
+                        with self._lock:
+                            old = self._cache.get(key)
+                            self._cache[key] = obj
+                        if old is None:
+                            self._dispatch("add", obj)
+                        else:
+                            self._dispatch("update", old, obj)
+                    elif ev_type == "ERROR":
+                        status = obj_dict
+                        if status.get("code") == 410:
+                            return  # relist
+            finally:
+                self._watch_stream = None
+                stream.close()
+            # stream ended (server timeout / restart): re-watch from last rv;
+            # outer loop relists if that rv is compacted.
+
+
+class InformerFactory:
+    """Shared informers per resource (ref: informers.SharedInformerFactory)."""
+
+    def __init__(self, clientset: Clientset):
+        self.clientset = clientset
+        self._informers: Dict[tuple, SharedInformer] = {}
+        self._lock = threading.Lock()
+
+    def informer(
+        self,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ) -> SharedInformer:
+        key = (resource, namespace, label_selector, field_selector)
+        with self._lock:
+            if key not in self._informers:
+                self._informers[key] = SharedInformer(
+                    self.clientset.resource(resource),
+                    namespace=namespace,
+                    label_selector=label_selector,
+                    field_selector=field_selector,
+                )
+            return self._informers[key]
+
+    def start_all(self):
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        with self._lock:
+            informers = list(self._informers.values())
+        return all(inf.wait_for_sync(timeout) for inf in informers)
+
+    def stop_all(self):
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()

@@ -1,0 +1,136 @@
+"""Leader election via CAS on a Lease object.
+
+Ref: client-go tools/leaderelection/leaderelection.go:138-274 — the same
+acquire/renew loop over a resource lock: candidates try to create/update the
+Lease; the holder renews every retry_period; takers steal only after
+lease_duration since the last observed renewal.  Non-leaders hot-standby.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from ..api import types as t
+from ..machinery import AlreadyExists, Conflict, NotFound, now_iso
+from .clientset import Clientset
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        clientset: Clientset,
+        name: str,
+        identity: str,
+        namespace: str = "kube-system",
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.cs = clientset
+        self.name = name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._is_leader = threading.Event()
+        self._observed_renew: dict = {}
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def wait_for_leadership(self, timeout: float = 10.0) -> bool:
+        return self._is_leader.wait(timeout)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._is_leader.is_set():
+            self._release()
+
+    # ----------------------------------------------------------------- loop
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if self._try_acquire_or_renew():
+                    if not self._is_leader.is_set():
+                        self._is_leader.set()
+                        if self.on_started_leading:
+                            self.on_started_leading()
+                else:
+                    if self._is_leader.is_set():
+                        self._is_leader.clear()
+                        if self.on_stopped_leading:
+                            self.on_stopped_leading()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            self._stop.wait(self.retry_period)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = now_iso()
+        try:
+            lease = self.cs.leases.get(self.name, self.namespace)
+        except NotFound:
+            lease = t.Lease()
+            lease.metadata.name = self.name
+            lease.metadata.namespace = self.namespace
+            lease.holder_identity = self.identity
+            lease.lease_duration_seconds = int(self.lease_duration)
+            lease.acquire_time = now
+            lease.renew_time = now
+            try:
+                self.cs.leases.create(lease, self.namespace)
+                return True
+            except AlreadyExists:
+                return False
+
+        if lease.holder_identity == self.identity:
+            lease.renew_time = now
+            try:
+                self.cs.leases.update(lease)
+                return True
+            except Conflict:
+                return False
+
+        # Another holder: steal only if its renewal is stale.  Renew times are
+        # wall-clock ISO strings; with second resolution a fresh lease parses
+        # equal to "now", which is fine at these timescales.
+        if lease.renew_time and not self._expired(lease):
+            return False
+        lease.holder_identity = self.identity
+        lease.acquire_time = now
+        lease.renew_time = now
+        lease.lease_transitions += 1
+        try:
+            self.cs.leases.update(lease)
+            return True
+        except Conflict:
+            return False
+
+    def _expired(self, lease: t.Lease) -> bool:
+        renew = time.mktime(time.strptime(lease.renew_time, "%Y-%m-%dT%H:%M:%SZ"))
+        return (time.time() - renew) > max(
+            float(lease.lease_duration_seconds), self.lease_duration
+        )
+
+    def _release(self):
+        try:
+            lease = self.cs.leases.get(self.name, self.namespace)
+            if lease.holder_identity == self.identity:
+                lease.holder_identity = ""
+                self.cs.leases.update(lease)
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,142 @@
+"""HTTP REST client with streaming watch (ref: client-go rest + dynamic).
+
+Connections are pooled per thread for request/response calls; every watch
+gets a dedicated connection whose chunked body is consumed line by line —
+each non-empty line is one {"type","object"} frame (heartbeat lines are
+blank).  Errors arrive as Status objects and are re-raised as the typed
+ApiError hierarchy so callers can distinguish Conflict/NotFound/Expired.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+from urllib.parse import urlencode, urlparse
+
+from ..machinery import ApiError
+
+
+class WatchStream:
+    """Iterator over (event_type, obj_dict); close() to abort."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp: http.client.HTTPResponse):
+        self._conn = conn
+        self._resp = resp
+        self._closed = False
+
+    def __iter__(self) -> Iterator[Tuple[str, Dict[str, Any]]]:
+        try:
+            while not self._closed:
+                line = self._resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                frame = json.loads(line)
+                yield frame["type"], frame["object"]
+        except (
+            http.client.IncompleteRead,
+            ConnectionResetError,
+            OSError,
+            ValueError,
+            AttributeError,  # fp=None race when close() lands mid-readline
+        ):
+            return
+
+    def close(self):
+        self._closed = True
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ApiClient:
+    def __init__(self, url: str, token: str = "", timeout: float = 30.0):
+        self.url = url.rstrip("/")
+        parsed = urlparse(self.url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 80
+        self.token = token
+        self.timeout = timeout
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json", "Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _reset_conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._local.conn = None
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        if params:
+            path = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
+        payload = json.dumps(body).encode() if body is not None else None
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=payload, headers=self._headers())
+                resp = conn.getresponse()
+                raw = resp.read()
+                break
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._reset_conn()
+                if attempt == 1:
+                    raise
+        data = json.loads(raw) if raw else {}
+        if resp.status >= 400:
+            if data.get("kind") == "Status":
+                raise ApiError.from_status(data)
+            err = ApiError(f"{method} {path}: HTTP {resp.status}")
+            err.code = resp.status
+            raise err
+        return data
+
+    def watch(
+        self, path: str, params: Optional[Dict[str, str]] = None
+    ) -> WatchStream:
+        params = dict(params or {})
+        params["watch"] = "true"
+        full = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=None)
+        conn.request("GET", full, headers=self._headers())
+        resp = conn.getresponse()
+        if resp.status >= 400:
+            raw = resp.read()
+            conn.close()
+            data = json.loads(raw) if raw else {}
+            if data.get("kind") == "Status":
+                raise ApiError.from_status(data)
+            err = ApiError(f"watch {path}: HTTP {resp.status}")
+            err.code = resp.status
+            raise err
+        return WatchStream(conn, resp)
+
+    def close(self):
+        self._reset_conn()

@@ -1,0 +1,22 @@
+"""API machinery: typed object model, scheme/serialization, selectors, watch.
+
+TPU-native re-design of the reference's staging/src/k8s.io/apimachinery/:
+runtime.Object/Scheme become a dataclass-based object model with automatic
+camelCase JSON round-tripping; watch.Interface becomes an iterator of
+WatchEvent; label selectors keep the same matching semantics.
+"""
+
+from .meta import ObjectMeta, OwnerReference, KObject, ListMeta, now_iso, new_uid
+from .scheme import Scheme, encode, decode_into, to_dict, from_dict, global_scheme
+from .errors import (
+    ApiError,
+    NotFound,
+    AlreadyExists,
+    Conflict,
+    Invalid,
+    TooOldResourceVersion,
+    BadRequest,
+    Forbidden,
+)
+from .labels import match_labels, parse_selector, selector_matches, format_selector
+from .watch import WatchEvent, ADDED, MODIFIED, DELETED, BOOKMARK, ERROR

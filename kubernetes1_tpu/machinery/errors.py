@@ -1,0 +1,92 @@
+"""Structured API errors, mirroring the reference's apimachinery Status errors.
+
+Ref: staging/src/k8s.io/apimachinery/pkg/api/errors/errors.go — every error
+carries an HTTP code and a machine-readable reason so clients (reflectors,
+controllers) can react: Conflict -> retry CAS, Gone -> relist, NotFound ->
+treat as deleted.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ApiError",
+    "NotFound",
+    "AlreadyExists",
+    "Conflict",
+    "Invalid",
+    "BadRequest",
+    "Forbidden",
+    "TooOldResourceVersion",
+]
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+    def to_status(self):
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "code": self.code,
+            "reason": self.reason,
+            "message": self.message,
+        }
+
+    @staticmethod
+    def from_status(status: dict) -> "ApiError":
+        reason = status.get("reason", "")
+        cls = _BY_REASON.get(reason, ApiError)
+        err = cls(status.get("message", ""))
+        err.code = status.get("code", cls.code)
+        return err
+
+
+class NotFound(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExists(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class Conflict(ApiError):
+    """resourceVersion mismatch on a CAS write; caller should re-get + retry."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class Invalid(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class BadRequest(ApiError):
+    code = 400
+    reason = "BadRequest"
+
+
+class Forbidden(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+class TooOldResourceVersion(ApiError):
+    """Watch/list from a compacted revision; client must relist (HTTP 410)."""
+
+    code = 410
+    reason = "Expired"
+
+
+_BY_REASON = {
+    c.reason: c
+    for c in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden, TooOldResourceVersion)
+}

@@ -1,0 +1,82 @@
+"""Object metadata — the equivalent of the reference's meta/v1 types.
+
+Ref: staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go (ObjectMeta,
+ListMeta, OwnerReference).  Every persisted object carries ObjectMeta; the
+store stamps uid/resourceVersion/creationTimestamp on create and bumps
+resourceVersion on every write (ref: etcd3/store.go GuaranteedUpdate).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+    # generateName: server appends a random suffix on create when name == "".
+    generate_name: str = ""
+
+
+@dataclass
+class ListMeta:
+    resource_version: str = ""
+    continue_token: str = ""
+
+
+@dataclass
+class KObject:
+    """Base for all API objects (the runtime.Object equivalent).
+
+    Subclasses set class attrs KIND / API_VERSION and are registered with the
+    Scheme.  `metadata` is present on every object.
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    KIND = ""
+    API_VERSION = "v1"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        if self.metadata.namespace:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name

@@ -1,0 +1,159 @@
+"""Scheme: kind registry + dataclass <-> JSON-dict round-tripping.
+
+The reference's runtime.Scheme (staging/src/k8s.io/apimachinery/pkg/runtime/
+scheme.go) does type registration, conversion, defaulting and serialization
+through generated code.  Here the object model is Python dataclasses and the
+(de)serializer is derived from type hints at import time, so there is no
+generated code: snake_case attrs round-trip to the camelCase wire form the
+reference uses, unknown wire fields are ignored (forward compatibility), and
+values equal to the field default are omitted (the `omitempty` convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import typing
+from typing import Any, Dict, Optional, Type
+
+# Fields whose wire name is not the mechanical snake->camel conversion.
+_SPECIAL_WIRE_NAMES = {
+    "continue_token": "continue",
+    "api_version": "apiVersion",
+}
+
+
+def _camel(name: str) -> str:
+    if name in _SPECIAL_WIRE_NAMES:
+        return _SPECIAL_WIRE_NAMES[name]
+    parts = name.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+@functools.lru_cache(maxsize=None)
+def _field_info(cls):
+    """Resolved (name, wire_name, type, default) per dataclass field."""
+    hints = typing.get_type_hints(cls)
+    info = []
+    for f in dataclasses.fields(cls):
+        if f.default is not dataclasses.MISSING:
+            default = f.default
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+            default = f.default_factory()  # type: ignore
+        else:
+            default = dataclasses.MISSING
+        info.append((f.name, _camel(f.name), hints[f.name], default))
+    return info
+
+
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union or str(origin) == "types.UnionType":
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def to_dict(obj: Any) -> Any:
+    """Encode a dataclass (or primitive/list/dict) to plain JSON-able data."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for name, wire, _tp, default in _field_info(type(obj)):
+            v = getattr(obj, name)
+            if v is None:
+                continue
+            if default is not dataclasses.MISSING and v == default:
+                continue
+            out[wire] = to_dict(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    return obj
+
+
+def from_dict(cls: Type, data: Any) -> Any:
+    """Decode plain data into `cls` using its type hints."""
+    cls = _unwrap_optional(cls)
+    if data is None:
+        return None
+    origin = typing.get_origin(cls)
+    if origin in (list, tuple):
+        (item_tp,) = typing.get_args(cls) or (Any,)
+        return [from_dict(item_tp, v) for v in data]
+    if origin is dict:
+        args = typing.get_args(cls)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: from_dict(val_tp, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(cls):
+        kwargs = {}
+        if not isinstance(data, dict):
+            raise TypeError(f"cannot decode {data!r} into {cls.__name__}")
+        for name, wire, tp, default in _field_info(cls):
+            if wire in data:
+                kwargs[name] = from_dict(tp, data[wire])
+        return cls(**kwargs)
+    if cls is Any or isinstance(cls, typing.TypeVar):
+        return data
+    if cls in (int, float, str, bool):
+        return cls(data) if data is not None else data
+    return data
+
+
+class Scheme:
+    """Kind registry: maps (kind) <-> dataclass and resource plural names.
+
+    Ref: runtime.Scheme + the RESTMapper.  Resources are lowercase plurals
+    ("pods"), kinds are CamelCase ("Pod").
+    """
+
+    def __init__(self):
+        self.by_kind: Dict[str, Type] = {}
+        self.by_resource: Dict[str, Type] = {}
+        self.resource_of: Dict[str, str] = {}  # kind -> plural
+        self.namespaced: Dict[str, bool] = {}  # plural -> bool
+
+    def register(self, cls: Type, plural: Optional[str] = None, namespaced: bool = True):
+        kind = cls.KIND or cls.__name__
+        plural = plural or (kind.lower() + "s")
+        self.by_kind[kind] = cls
+        self.by_resource[plural] = cls
+        self.resource_of[kind] = plural
+        self.namespaced[plural] = namespaced
+        return cls
+
+    def encode(self, obj: Any) -> Dict[str, Any]:
+        d = to_dict(obj)
+        d["kind"] = type(obj).KIND or type(obj).__name__
+        d["apiVersion"] = type(obj).API_VERSION
+        return d
+
+    def encode_json(self, obj: Any) -> str:
+        return json.dumps(self.encode(obj), separators=(",", ":"))
+
+    def decode(self, data: Dict[str, Any]) -> Any:
+        kind = data.get("kind", "")
+        cls = self.by_kind.get(kind)
+        if cls is None:
+            raise KeyError(f"kind {kind!r} not registered")
+        return from_dict(cls, data)
+
+    def decode_json(self, raw: str) -> Any:
+        return self.decode(json.loads(raw))
+
+    def deepcopy(self, obj: Any) -> Any:
+        return from_dict(type(obj), to_dict(obj))
+
+
+global_scheme = Scheme()
+
+
+def encode(obj: Any) -> Dict[str, Any]:
+    return global_scheme.encode(obj)
+
+
+def decode_into(cls: Type, data: Dict[str, Any]) -> Any:
+    return from_dict(cls, data)

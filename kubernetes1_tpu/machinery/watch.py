@@ -1,0 +1,23 @@
+"""Watch primitives — the watch.Interface equivalent.
+
+Ref: staging/src/k8s.io/apimachinery/pkg/watch/watch.go.  A watch is an
+iterator of WatchEvent; event types match the reference's wire protocol so
+the REST watch stream is line-delimited JSON {"type": ..., "object": ...}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+ERROR = "ERROR"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    object: Any  # decoded KObject, or a Status dict for ERROR

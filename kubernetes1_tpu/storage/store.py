@@ -1,0 +1,288 @@
+"""MVCC object store with CAS updates and resumable watch.
+
+This is the L0 storage layer: the TPU-native stand-in for the reference's
+etcd3 + watch-cache stack (staging/src/k8s.io/apiserver/pkg/storage/etcd3/
+store.go:152 Create, :263 GuaranteedUpdate, :661 Watch; storage/cacher.go).
+Design choices relative to the reference:
+
+- One in-process MVCC store *is* the watch cache: every watcher gets its own
+  queue fed from a shared, revision-ordered history ring, so N watchers cost
+  one event fan-out, exactly what Cacher buys the reference.
+- resourceVersion is a global monotonically increasing int64 revision (same
+  contract as etcd's mod_revision): lists return the store revision, watches
+  resume from any uncompacted revision, resuming below the compaction floor
+  raises TooOldResourceVersion (HTTP 410) which forces clients to relist —
+  the exact reflector contract (client-go tools/cache/reflector.go:239).
+- GuaranteedUpdate is the system's only transaction primitive: read, apply a
+  user function, compare-and-swap on resourceVersion, retry on conflict —
+  mirroring etcd3 store.go:263's txn loop.
+- Optional write-ahead log (JSON lines) gives durability/restart; the control
+  plane is otherwise stateless and resumes from LIST+WATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..machinery import (
+    ADDED,
+    AlreadyExists,
+    Conflict,
+    DELETED,
+    MODIFIED,
+    NotFound,
+    TooOldResourceVersion,
+    WatchEvent,
+    new_uid,
+    now_iso,
+)
+from ..machinery.scheme import Scheme
+
+# Keep this many events for watch resume before compaction kicks in.
+DEFAULT_HISTORY_LIMIT = 100_000
+
+
+class StopUpdate(Exception):
+    """Raised by a GuaranteedUpdate callback to abort without error."""
+
+
+class Watcher:
+    """A single watch stream; iterate to receive WatchEvents; stop() to end."""
+
+    def __init__(self, store: "Store", prefix: str):
+        self._store = store
+        self.prefix = prefix
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+
+    def _push(self, ev: WatchEvent):
+        if not self._stopped.is_set():
+            self._q.put(ev)
+
+    def stop(self):
+        if not self._stopped.is_set():
+            self._stopped.set()
+            self._q.put(None)
+            self._store._remove_watcher(self)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> WatchEvent:
+        ev = self._q.get()
+        if ev is None:
+            raise StopIteration
+        return ev
+
+    def next_timeout(self, timeout: float) -> Optional[WatchEvent]:
+        """Non-raising get with timeout; returns None on timeout/stop."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+
+class Store:
+    def __init__(
+        self,
+        scheme: Scheme,
+        wal_path: Optional[str] = None,
+        history_limit: int = DEFAULT_HISTORY_LIMIT,
+    ):
+        self._scheme = scheme
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[int, Dict[str, Any]]] = {}  # key -> (rev, encoded obj)
+        self._rev = 0
+        # History ring for watch resume: list of (rev, type, key, encoded obj)
+        self._history: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        self._history_limit = history_limit
+        self._compacted_rev = 0  # watches must start > this
+        self._watchers: List[Watcher] = []
+        self._wal_path = wal_path
+        self._wal = None
+        if wal_path:
+            self._replay_wal(wal_path)
+            self._wal = open(wal_path, "a", buffering=1)
+
+    # ---------------------------------------------------------------- helpers
+
+    def current_revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def _replay_wal(self, path: str):
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                rev, typ, key, obj = rec["rev"], rec["type"], rec["key"], rec["obj"]
+                self._rev = max(self._rev, rev)
+                if typ == DELETED:
+                    self._data.pop(key, None)
+                else:
+                    self._data[key] = (rev, obj)
+        # Watches cannot resume across restart below the replayed revision.
+        self._compacted_rev = self._rev
+
+    def _commit(self, typ: str, key: str, obj: Dict[str, Any]):
+        """Must hold lock. Assigns the next revision and fans out."""
+        self._rev += 1
+        rev = self._rev
+        obj = dict(obj)
+        obj.setdefault("metadata", {})
+        obj["metadata"]["resourceVersion"] = str(rev)
+        if typ == DELETED:
+            self._data.pop(key, None)
+        else:
+            self._data[key] = (rev, obj)
+        self._history.append((rev, typ, key, obj))
+        if len(self._history) > self._history_limit:
+            drop = len(self._history) - self._history_limit
+            self._compacted_rev = self._history[drop - 1][0]
+            del self._history[:drop]
+        if self._wal:
+            self._wal.write(
+                json.dumps({"rev": rev, "type": typ, "key": key, "obj": obj}) + "\n"
+            )
+        event = WatchEvent(typ, obj)
+        for w in self._watchers:
+            if key.startswith(w.prefix):
+                w._push(event)
+        return rev, obj
+
+    def _decode(self, obj: Dict[str, Any]):
+        return self._scheme.decode(obj)
+
+    # ------------------------------------------------------------- operations
+
+    def create(self, key: str, obj) -> Any:
+        """Create; fails with AlreadyExists. Stamps uid/creationTimestamp."""
+        meta = obj.metadata
+        if not meta.uid:
+            meta.uid = new_uid()
+        if not meta.creation_timestamp:
+            meta.creation_timestamp = now_iso()
+        encoded = self._scheme.encode(obj)
+        with self._lock:
+            if key in self._data:
+                raise AlreadyExists(f"{key} already exists")
+            _, stored = self._commit(ADDED, key, encoded)
+            return self._decode(stored)
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                raise NotFound(f"{key} not found")
+            return self._decode(ent[1])
+
+    def get_or_none(self, key: str):
+        try:
+            return self.get(key)
+        except NotFound:
+            return None
+
+    def list(self, prefix: str) -> Tuple[List[Any], int]:
+        """All objects under prefix + the store revision for watch resume."""
+        with self._lock:
+            items = [
+                self._decode(obj)
+                for key, (_rev, obj) in sorted(self._data.items())
+                if key.startswith(prefix)
+            ]
+            return items, self._rev
+
+    def update_cas(self, key: str, obj) -> Any:
+        """Single compare-and-swap using obj.metadata.resource_version."""
+        encoded = self._scheme.encode(obj)
+        expect = obj.metadata.resource_version
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                raise NotFound(f"{key} not found")
+            cur_rev, _ = ent
+            if expect and str(cur_rev) != expect:
+                raise Conflict(
+                    f"{key}: resourceVersion mismatch (have {cur_rev}, want {expect})"
+                )
+            _, stored = self._commit(MODIFIED, key, encoded)
+            return self._decode(stored)
+
+    def guaranteed_update(self, key: str, update_fn: Callable[[Any], Any]) -> Any:
+        """Read-modify-CAS retry loop (ref: etcd3 store.go:263).
+
+        update_fn receives a fresh decoded copy and returns the new object
+        (mutating in place is fine).  Raise StopUpdate to abort cleanly.
+        """
+        while True:
+            cur = self.get(key)
+            updated = update_fn(cur)
+            if updated is None:
+                updated = cur
+            try:
+                return self.update_cas(key, updated)
+            except Conflict:
+                continue
+
+    def delete(self, key: str, expect_rv: str = "") -> Any:
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                raise NotFound(f"{key} not found")
+            cur_rev, obj = ent
+            if expect_rv and str(cur_rev) != expect_rv:
+                raise Conflict(f"{key}: resourceVersion mismatch")
+            _, stored = self._commit(DELETED, key, obj)
+            return self._decode(stored)
+
+    # ------------------------------------------------------------------ watch
+
+    def watch(self, prefix: str, since_rev: int = 0) -> Watcher:
+        """Watch events for keys under prefix with rev > since_rev.
+
+        since_rev==0 means "from now".  Resuming below the compaction floor
+        raises TooOldResourceVersion — the client must relist.
+        """
+        with self._lock:
+            if since_rev and since_rev < self._compacted_rev:
+                raise TooOldResourceVersion(
+                    f"revision {since_rev} compacted (floor {self._compacted_rev})"
+                )
+            w = Watcher(self, prefix)
+            if since_rev:
+                for rev, typ, key, obj in self._history:
+                    if rev > since_rev and key.startswith(prefix):
+                        w._push(WatchEvent(typ, obj))
+            self._watchers.append(w)
+            return w
+
+    def _remove_watcher(self, w: Watcher):
+        with self._lock:
+            try:
+                self._watchers.remove(w)
+            except ValueError:
+                pass
+
+    def compact(self, keep_last: int = 1000):
+        with self._lock:
+            if len(self._history) > keep_last:
+                drop = len(self._history) - keep_last
+                self._compacted_rev = self._history[drop - 1][0]
+                del self._history[:drop]
+
+    def close(self):
+        with self._lock:
+            for w in list(self._watchers):
+                w.stop()
+            if self._wal:
+                self._wal.close()
+                self._wal = None

@@ -1,0 +1,44 @@
+"""Polling/retry helpers (ref: apimachinery util/wait/wait.go)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+def poll_until(
+    condition: Callable[[], bool],
+    interval: float = 0.05,
+    timeout: float = 10.0,
+    desc: str = "condition",
+) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if condition():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def must_poll_until(condition, interval=0.05, timeout=10.0, desc="condition"):
+    if not poll_until(condition, interval, timeout, desc):
+        raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def until(fn: Callable[[], None], period: float, stop: threading.Event):
+    """Run fn every `period` seconds until stop is set (wait.Until)."""
+    while not stop.is_set():
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — control loops must not die
+            import traceback
+
+            traceback.print_exc()
+        stop.wait(period)
+
+
+def run_until(fn: Callable[[], None], period: float, stop: threading.Event, name: str = "") -> threading.Thread:
+    t = threading.Thread(target=until, args=(fn, period, stop), daemon=True, name=name)
+    t.start()
+    return t

@@ -1,0 +1,12 @@
+import os
+import sys
+
+# Force a deterministic 8-device virtual CPU mesh for all JAX-touching tests:
+# multi-chip sharding is validated on virtual devices (the driver separately
+# dry-runs the multichip path), single-real-chip runs happen only in bench.py.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,258 @@
+"""Integration tests: real in-process apiserver + real store, no nodes.
+
+Mirrors the reference's test/integration pattern (framework.RunAMaster,
+master_utils.go:193): every test gets an embedded master over the MVCC
+store and talks to it through the real HTTP client stack.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, LeaderElector, SharedInformer
+from kubernetes1_tpu.machinery import Conflict, Invalid, NotFound
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+from tests.test_machinery import make_pod
+
+
+@pytest.fixture(scope="module")
+def master():
+    m = Master().start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def cs(master):
+    c = Clientset(master.url)
+    yield c
+    c.close()
+
+
+class TestRest:
+    def test_create_get_list_delete(self, cs):
+        pod = make_pod("rest-a")
+        created = cs.pods.create(pod)
+        assert created.metadata.uid
+        got = cs.pods.get("rest-a")
+        assert got.spec.containers[0].image == "busybox"
+        items, rv = cs.pods.list(namespace="default")
+        assert any(p.metadata.name == "rest-a" for p in items)
+        assert int(rv) > 0
+        cs.pods.delete("rest-a", grace_seconds=0)
+        with pytest.raises(NotFound):
+            cs.pods.get("rest-a")
+
+    def test_generate_name(self, cs):
+        pod = make_pod()
+        pod.metadata.name = ""
+        pod.metadata.generate_name = "gen-"
+        created = cs.pods.create(pod)
+        assert created.metadata.name.startswith("gen-")
+        assert len(created.metadata.name) > len("gen-")
+        cs.pods.delete(created.metadata.name, grace_seconds=0)
+
+    def test_validation_rejected(self, cs):
+        pod = t.Pod()
+        pod.metadata.name = "noname"
+        with pytest.raises(Invalid):
+            cs.pods.create(pod)
+
+    def test_conflict_on_stale_update(self, cs):
+        created = cs.pods.create(make_pod("rest-conflict"))
+        fresh = cs.pods.get("rest-conflict")
+        fresh.metadata.labels["x"] = "1"
+        cs.pods.update(fresh)
+        created.metadata.labels["y"] = "2"
+        with pytest.raises(Conflict):
+            cs.pods.update(created)
+        cs.pods.delete("rest-conflict", grace_seconds=0)
+
+    def test_merge_patch(self, cs):
+        cs.pods.create(make_pod("rest-patch"))
+        out = cs.pods.patch(
+            "rest-patch", {"metadata": {"labels": {"patched": "yes"}}}
+        )
+        assert out.metadata.labels["patched"] == "yes"
+        assert out.metadata.labels["app"] == "test"  # merge, not replace
+        cs.pods.delete("rest-patch", grace_seconds=0)
+
+    def test_status_subresource(self, cs):
+        cs.pods.create(make_pod("rest-status"))
+        pod = cs.pods.get("rest-status")
+        pod.status.phase = t.POD_RUNNING
+        pod.spec.node_name = ""  # spec changes via status endpoint must not land
+        updated = cs.pods.update_status(pod)
+        assert updated.status.phase == t.POD_RUNNING
+        cs.pods.delete("rest-status", grace_seconds=0)
+
+    def test_field_selector(self, cs):
+        a = make_pod("fs-a")
+        a.spec.node_name = "node-1"
+        cs.pods.create(a)
+        cs.pods.create(make_pod("fs-b"))
+        bound, _ = cs.pods.list(
+            namespace="default", field_selector="spec.nodeName=node-1"
+        )
+        assert [p.metadata.name for p in bound] == ["fs-a"]
+        unbound, _ = cs.pods.list(
+            namespace="default", field_selector="spec.nodeName="
+        )
+        assert any(p.metadata.name == "fs-b" for p in unbound)
+        assert all(p.metadata.name != "fs-a" for p in unbound)
+        cs.pods.delete("fs-a", grace_seconds=0)
+        cs.pods.delete("fs-b", grace_seconds=0)
+
+
+class TestResourceV2Admission:
+    def test_tpu_limit_rewritten_to_pod_level(self, cs):
+        """The fork's signature behavior (resourcev2/admission.go:62-92),
+        TPU-flavored: container google.com/tpu limits become pod-level
+        extended resources."""
+        pod = make_pod("adm-tpu", tpus=4)
+        created = cs.pods.create(pod)
+        assert "google.com/tpu" not in created.spec.containers[0].resources.limits
+        assert len(created.spec.extended_resources) == 1
+        per = created.spec.extended_resources[0]
+        assert per.resource == "google.com/tpu"
+        assert per.quantity == 4
+        assert created.spec.containers[0].extended_resource_requests == [per.name]
+        cs.pods.delete("adm-tpu", grace_seconds=0)
+
+    def test_nvidia_resource_rejected_with_pointer(self, cs):
+        pod = make_pod("adm-gpu")
+        pod.spec.containers[0].resources.limits["nvidia.com/gpu"] = 1
+        with pytest.raises(Invalid, match="google.com/tpu"):
+            cs.pods.create(pod)
+
+
+class TestBindingSubresource:
+    def test_bind_applies_node_and_devices(self, cs):
+        pod = make_pod("bind-a", tpus=2)
+        created = cs.pods.create(pod)
+        per_name = created.spec.extended_resources[0].name
+        binding = t.Binding(
+            target_node="node-1",
+            extended_resource_assignments={per_name: ["tpu-0", "tpu-1"]},
+        )
+        binding.metadata.name = "bind-a"
+        bound = cs.bind("default", "bind-a", binding)
+        assert bound.spec.node_name == "node-1"
+        assert bound.spec.extended_resources[0].assigned == ["tpu-0", "tpu-1"]
+        # double-bind to another node must conflict
+        b2 = t.Binding(target_node="node-2")
+        with pytest.raises(Conflict):
+            cs.bind("default", "bind-a", b2)
+        cs.pods.delete("bind-a", grace_seconds=0)
+
+    def test_bind_quantity_mismatch(self, cs):
+        created = cs.pods.create(make_pod("bind-q", tpus=2))
+        per_name = created.spec.extended_resources[0].name
+        binding = t.Binding(
+            target_node="node-1",
+            extended_resource_assignments={per_name: ["tpu-0"]},
+        )
+        with pytest.raises(Invalid):
+            cs.bind("default", "bind-q", binding)
+        cs.pods.delete("bind-q", grace_seconds=0)
+
+
+class TestGracefulDelete:
+    def test_scheduled_pod_marked_then_removed(self, cs):
+        pod = make_pod("gd-a")
+        cs.pods.create(pod)
+        fresh = cs.pods.get("gd-a")
+        fresh.spec.node_name = ""  # not bound: immediate delete
+        out = cs.pods.delete("gd-a")
+        with pytest.raises(NotFound):
+            cs.pods.get("gd-a")
+
+        pod = make_pod("gd-b", tpus=0)
+        created = cs.pods.create(pod)
+        cs.bind("default", "gd-b", t.Binding(target_node="n1"))
+        out = cs.pods.delete("gd-b")
+        assert out.metadata.deletion_timestamp  # graceful: marked, not gone
+        got = cs.pods.get("gd-b")
+        assert got.metadata.deletion_timestamp
+        cs.pods.delete("gd-b", grace_seconds=0)
+        with pytest.raises(NotFound):
+            cs.pods.get("gd-b")
+
+
+class TestWatchStream:
+    def test_watch_sees_create_update_delete(self, cs):
+        stream = cs.pods.watch(namespace="default")
+        events = []
+        th = threading.Thread(
+            target=lambda: [events.append(e) for e in stream], daemon=True
+        )
+        th.start()
+        time.sleep(0.2)
+        cs.pods.create(make_pod("w-a"))
+        pod = cs.pods.get("w-a")
+        pod.metadata.labels["w"] = "1"
+        cs.pods.update(pod)
+        cs.pods.delete("w-a", grace_seconds=0)
+        must_poll_until(lambda: len(events) >= 3, desc="3 watch events")
+        stream.close()
+        types = [e[0] for e in events[:3]]
+        assert types == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_watch_resume_from_rv(self, cs):
+        cs.pods.create(make_pod("w-r1"))
+        _, rv = cs.pods.list(namespace="default")
+        cs.pods.create(make_pod("w-r2"))
+        stream = cs.pods.watch(namespace="default", resource_version=rv)
+        it = iter(stream)
+        ev_type, obj = next(it)
+        assert ev_type == "ADDED"
+        assert obj["metadata"]["name"] == "w-r2"
+        stream.close()
+        cs.pods.delete("w-r1", grace_seconds=0)
+        cs.pods.delete("w-r2", grace_seconds=0)
+
+
+class TestInformer:
+    def test_informer_sync_and_events(self, cs, master):
+        cs.pods.create(make_pod("inf-pre"))
+        inf = SharedInformer(cs.pods, namespace="default")
+        adds, updates, deletes = [], [], []
+        inf.add_handler(
+            on_add=lambda o: adds.append(o.metadata.name),
+            on_update=lambda o, n: updates.append(n.metadata.name),
+            on_delete=lambda o: deletes.append(o.metadata.name),
+        )
+        inf.start()
+        assert inf.wait_for_sync()
+        must_poll_until(lambda: "inf-pre" in adds, desc="initial add")
+        cs.pods.create(make_pod("inf-live"))
+        must_poll_until(lambda: "inf-live" in adds, desc="live add")
+        pod = cs.pods.get("inf-live")
+        pod.metadata.labels["u"] = "1"
+        cs.pods.update(pod)
+        must_poll_until(lambda: "inf-live" in updates, desc="live update")
+        cs.pods.delete("inf-live", grace_seconds=0)
+        must_poll_until(lambda: "inf-live" in deletes, desc="live delete")
+        assert inf.get("default/inf-pre") is not None
+        inf.stop()
+        cs.pods.delete("inf-pre", grace_seconds=0)
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self, master):
+        cs1, cs2 = Clientset(master.url), Clientset(master.url)
+        e1 = LeaderElector(cs1, "test-lock", "id-1", lease_duration=1.0, retry_period=0.1)
+        e1.start()
+        assert e1.wait_for_leadership(5)
+        e2 = LeaderElector(cs2, "test-lock", "id-2", lease_duration=1.0, retry_period=0.1)
+        e2.start()
+        time.sleep(0.5)
+        assert not e2.is_leader
+        e1.stop()  # releases the lease
+        assert e2.wait_for_leadership(5)
+        e2.stop()
+        cs1.close()
+        cs2.close()

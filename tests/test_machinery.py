@@ -1,0 +1,137 @@
+"""Machinery kernel tests: serialization round-trip, selectors, errors."""
+
+from kubernetes1_tpu import api
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.machinery import (
+    ApiError,
+    Conflict,
+    labels,
+    scheme as scheme_mod,
+)
+from kubernetes1_tpu.machinery.scheme import from_dict, global_scheme, to_dict
+from kubernetes1_tpu.utils.quantity import parse_milli, parse_quantity
+
+
+def make_pod(name="p1", ns="default", tpus=0):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    pod.metadata.labels = {"app": "test"}
+    c = t.Container(name="main", image="busybox", command=["sleep", "1"])
+    c.resources.limits = {"cpu": "500m", "memory": "128Mi"}
+    if tpus:
+        c.resources.limits["google.com/tpu"] = tpus
+    pod.spec.containers = [c]
+    return pod
+
+
+class TestScheme:
+    def test_roundtrip_pod(self):
+        pod = make_pod(tpus=4)
+        pod.spec.extended_resources = [
+            t.PodExtendedResource(
+                name="tpu-0",
+                resource="google.com/tpu",
+                quantity=4,
+                affinity=t.ResourceAffinity(
+                    required=[
+                        t.ResourceSelectorRequirement(
+                            key=t.ATTR_TPU_TYPE, operator="In", values=["v5e"]
+                        )
+                    ]
+                ),
+            )
+        ]
+        d = global_scheme.encode(pod)
+        assert d["kind"] == "Pod"
+        assert d["apiVersion"] == "v1"
+        assert d["spec"]["containers"][0]["resources"]["limits"]["cpu"] == "500m"
+        pod2 = global_scheme.decode(d)
+        assert pod2.metadata.name == "p1"
+        assert pod2.spec.extended_resources[0].affinity.required[0].values == ["v5e"]
+        assert global_scheme.encode(pod2) == d
+
+    def test_camel_case_wire_names(self):
+        pod = make_pod()
+        pod.spec.node_name = "node-1"
+        pod.spec.termination_grace_period_seconds = 5
+        d = to_dict(pod)
+        assert d["spec"]["nodeName"] == "node-1"
+        assert d["spec"]["terminationGracePeriodSeconds"] == 5
+        assert "node_name" not in d["spec"]
+
+    def test_omitempty(self):
+        pod = t.Pod()
+        d = to_dict(pod)
+        # defaults are omitted entirely
+        assert d == {}
+
+    def test_unknown_fields_ignored(self):
+        d = global_scheme.encode(make_pod())
+        d["spec"]["someFutureField"] = {"x": 1}
+        pod = global_scheme.decode(d)
+        assert pod.metadata.name == "p1"
+
+    def test_deepcopy_isolation(self):
+        pod = make_pod()
+        cp = global_scheme.deepcopy(pod)
+        cp.spec.containers[0].image = "other"
+        assert pod.spec.containers[0].image == "busybox"
+
+    def test_job_indexed(self):
+        job = t.Job()
+        job.metadata.name = "train"
+        job.spec.completions = 8
+        job.spec.completion_mode = "Indexed"
+        job.spec.gang_scheduling = True
+        d = global_scheme.encode(job)
+        assert d["apiVersion"] == "batch/v1"
+        assert d["spec"]["completionMode"] == "Indexed"
+        job2 = global_scheme.decode(d)
+        assert job2.spec.gang_scheduling is True
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        assert labels.match_labels({"a": "b"}, {"a": "b", "c": "d"})
+        assert not labels.match_labels({"a": "x"}, {"a": "b"})
+        assert labels.match_labels(None, {})
+
+    def test_parse_and_match(self):
+        reqs = labels.parse_selector("app=web,tier!=db,env in (prod,stage),!legacy")
+        assert labels.selector_matches(reqs, {"app": "web", "env": "prod"})
+        assert not labels.selector_matches(reqs, {"app": "web", "env": "dev"})
+        assert not labels.selector_matches(
+            reqs, {"app": "web", "env": "prod", "legacy": "1"}
+        )
+
+    def test_structured_selector(self):
+        sel = t.LabelSelector(
+            match_labels={"app": "web"},
+            match_expressions=[
+                t.LabelSelectorRequirement(key="tier", operator="NotIn", values=["db"])
+            ],
+        )
+        assert labels.label_selector_matches(sel, {"app": "web", "tier": "fe"})
+        assert not labels.label_selector_matches(sel, {"app": "web", "tier": "db"})
+        assert not labels.label_selector_matches(None, {"app": "web"})
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert parse_quantity("500m") == 0.5
+        assert parse_quantity("2") == 2
+        assert parse_quantity("1Gi") == 2**30
+        assert parse_quantity("1G") == 10**9
+        assert parse_milli("250m") == 250
+        assert parse_milli(2) == 2000
+
+
+class TestErrors:
+    def test_status_roundtrip(self):
+        err = Conflict("rv mismatch")
+        st = err.to_status()
+        assert st["code"] == 409
+        back = ApiError.from_status(st)
+        assert isinstance(back, Conflict)
+        assert back.message == "rv mismatch"
