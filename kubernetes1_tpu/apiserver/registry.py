@@ -273,7 +273,9 @@ class Registry:
             merged = _merge_patch(self.scheme.encode(cur), patch)
             obj = from_dict(cls, merged)
             obj.metadata.resource_version = cur.metadata.resource_version
-            strategy_for(resource).prepare_for_update(obj, cur)
+            strat = strategy_for(resource)
+            strat.prepare_for_update(obj, cur)
+            strat.validate(obj)  # a patch must not persist an invalid object
             return obj
 
         return self.store.guaranteed_update(key, apply)
@@ -284,6 +286,10 @@ class Registry:
         if resource == "pods":
             return self._delete_pod(key, obj, grace_seconds)
         if resource == "namespaces":
+            # grace 0 = finalize (namespace controller's last step after
+            # emptying the namespace); otherwise mark Terminating
+            if grace_seconds == 0:
+                return self.store.delete(key)
             return self._delete_namespace(obj)
         return self.store.delete(key)
 

@@ -105,7 +105,15 @@ class _Handler(BaseHTTPRequestHandler):
         rest = parts[2:] if parts[0] == "api" else parts[3:]
         if not rest:
             raise NotFound("missing resource")
-        if rest[0] == "namespaces" and len(rest) >= 3:
+        # /namespaces/<ns>/<resource>... is the namespaced form only when
+        # <resource> is actually a registered resource — otherwise it's the
+        # cluster-scoped namespaces object's own subresource
+        # (/namespaces/<name>/status).
+        if (
+            rest[0] == "namespaces"
+            and len(rest) >= 3
+            and rest[2] in self.master.scheme.by_resource
+        ):
             ns, resource = rest[1], rest[2]
             name = rest[3] if len(rest) > 3 else ""
             sub = rest[4] if len(rest) > 4 else ""
@@ -270,6 +278,10 @@ class _Handler(BaseHTTPRequestHandler):
         if sub:
             raise NotFound(f"subresource {sub!r} not writable")
         obj = self.master.scheme.decode(body)
+        # default namespace from the URL before admission so plugins
+        # (NamespaceAutoProvision) see the effective namespace
+        if ns and not obj.metadata.namespace:
+            obj.metadata.namespace = ns
         obj = self.master.admission.admit(CREATE, resource, obj)
         created = reg.create(resource, ns, obj)
         self.master.audit("create", resource, ns, created.metadata.name)

@@ -8,6 +8,7 @@ lease_duration since the last observed renewal.  Non-leaders hot-standby.
 
 from __future__ import annotations
 
+import calendar
 import threading
 import time
 import traceback
@@ -121,7 +122,8 @@ class LeaderElector:
             return False
 
     def _expired(self, lease: t.Lease) -> bool:
-        renew = time.mktime(time.strptime(lease.renew_time, "%Y-%m-%dT%H:%M:%SZ"))
+        # renew_time is UTC — timegm, not mktime (which assumes local time)
+        renew = calendar.timegm(time.strptime(lease.renew_time, "%Y-%m-%dT%H:%M:%SZ"))
         return (time.time() - renew) > max(
             float(lease.lease_duration_seconds), self.lease_duration
         )

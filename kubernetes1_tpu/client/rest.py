@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import socket
 import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlparse
@@ -48,6 +49,14 @@ class WatchStream:
 
     def close(self):
         self._closed = True
+        try:
+            # wake a reader blocked in recv(); bare close() leaves it blocked
+            # until the server's next heartbeat
+            sock = self._conn.sock  # snapshot: concurrent close() may null it
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass
         try:
             self._conn.close()
         except Exception:  # noqa: BLE001
@@ -98,16 +107,23 @@ class ApiClient:
         if params:
             path = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
         payload = json.dumps(body).encode() if body is not None else None
+        # Retry rules: GET retries on any connection error; mutations retry
+        # only when the failure happened while *sending* (stale keep-alive
+        # connection — the server never saw the request).  A mutation whose
+        # response was lost may have been applied, so re-sending it could
+        # duplicate the action.
         for attempt in (0, 1):
             conn = self._conn()
+            sent = False
             try:
                 conn.request(method, path, body=payload, headers=self._headers())
+                sent = True
                 resp = conn.getresponse()
                 raw = resp.read()
                 break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._reset_conn()
-                if attempt == 1:
+                if attempt == 1 or (sent and method != "GET"):
                     raise
         data = json.loads(raw) if raw else {}
         if resp.status >= 400:

@@ -1,0 +1,2 @@
+from .cache import NodeInfo, SchedulerCache
+from .scheduler import Scheduler

@@ -1,0 +1,48 @@
+"""Standalone scheduler entrypoint (ref: plugin/cmd/kube-scheduler).
+
+    python -m kubernetes1_tpu.scheduler --server http://127.0.0.1:8001 [--leader-elect]
+"""
+
+import argparse
+import signal
+import threading
+
+from ..client import Clientset, LeaderElector
+from .scheduler import Scheduler
+
+
+def main():
+    ap = argparse.ArgumentParser(description="ktpu scheduler")
+    ap.add_argument("--server", default="http://127.0.0.1:8001")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--scheduler-name", default="default-scheduler")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--identity", default="scheduler-0")
+    args = ap.parse_args()
+
+    cs = Clientset(args.server, token=args.token)
+    sched = Scheduler(cs, scheduler_name=args.scheduler_name)
+    stop = threading.Event()
+
+    if args.leader_elect:
+        elector = LeaderElector(
+            cs,
+            "ktpu-scheduler",
+            args.identity,
+            on_started_leading=lambda: sched.start(),
+            on_stopped_leading=lambda: stop.set(),  # hot-standby lost lease: exit
+        )
+        elector.start()
+        print(f"scheduler {args.identity}: campaigning for leadership", flush=True)
+    else:
+        sched.start()
+        print("scheduler running", flush=True)
+
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    sched.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Device allocation: pick specific TPU chip IDs matching attribute affinity.
+
+Ref: plugin/pkg/scheduler/core/extended_resources.go:42-150 — for each
+PodExtendedResource, filter the node's available devices by the request's
+ResourceAffinity (selector ops In/NotIn/Exists/Gt/Lt over vendor-prefixed
+attributes), then pick `quantity` device IDs.  TPU-first addition: when a
+pod needs multiple chips, prefer chips from the same ICI slice and with
+contiguous coordinates so intra-pod collectives ride ICI, and keep slices
+unfragmented for future gang placements (pick from the slice with the
+least leftover capacity — best-fit).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+
+
+def device_matches(dev: t.ExtendedResourceDevice, affinity: Optional[t.ResourceAffinity]) -> bool:
+    if affinity is None:
+        return True
+    attrs = dev.attributes or {}
+    for req in affinity.required:
+        val = attrs.get(req.key)
+        if req.operator == "In":
+            if val not in req.values:
+                return False
+        elif req.operator == "NotIn":
+            if val is not None and val in req.values:
+                return False
+        elif req.operator == "Exists":
+            if val is None:
+                return False
+        elif req.operator == "DoesNotExist":
+            if val is not None:
+                return False
+        elif req.operator in ("Gt", "Lt"):
+            if val is None or not req.values:
+                return False
+            try:
+                have, want = float(val), float(req.values[0])
+            except ValueError:
+                return False
+            if req.operator == "Gt" and not have > want:
+                return False
+            if req.operator == "Lt" and not have < want:
+                return False
+        else:
+            return False
+    return True
+
+
+def _coord_key(dev: t.ExtendedResourceDevice) -> Tuple:
+    raw = (dev.attributes or {}).get(t.ATTR_TPU_CHIP_COORDS, "")
+    try:
+        return tuple(int(x) for x in raw.split(",")) if raw else ()
+    except ValueError:
+        return ()
+
+
+def pick_devices(
+    candidates: List[t.ExtendedResourceDevice], quantity: int
+) -> Optional[List[str]]:
+    """Choose `quantity` chips, slice-aware best-fit + coordinate-contiguous."""
+    if len(candidates) < quantity:
+        return None
+    by_slice: Dict[str, List[t.ExtendedResourceDevice]] = defaultdict(list)
+    for d in candidates:
+        by_slice[(d.attributes or {}).get(t.ATTR_TPU_SLICE, "")].append(d)
+    # best-fit: smallest slice that still satisfies the request
+    fitting = [devs for devs in by_slice.values() if len(devs) >= quantity]
+    if fitting:
+        pool = min(fitting, key=len)
+    else:
+        # spill across slices deterministically (largest first to bound the
+        # number of slices touched)
+        pool = []
+        for devs in sorted(by_slice.values(), key=len, reverse=True):
+            pool.extend(devs)
+    pool = sorted(pool, key=lambda d: (_coord_key(d), d.id))
+    return [d.id for d in pool[:quantity]]
+
+
+def allocate_for_pod(
+    pod: t.Pod, node_info
+) -> Tuple[Optional[Dict[str, List[str]]], str]:
+    """Try to satisfy every PodExtendedResource from node_info's available
+    devices.  Returns ({request name: [device ids]}, "") on success or
+    (None, reason).  Multiple requests for the same resource are satisfied
+    disjointly."""
+    if not pod.spec.extended_resources:
+        return {}, ""
+    assignments: Dict[str, List[str]] = {}
+    taken: Dict[str, set] = defaultdict(set)
+    for per in pod.spec.extended_resources:
+        avail = [
+            d
+            for d in node_info.available_devices(per.resource)
+            if d.id not in taken[per.resource] and device_matches(d, per.affinity)
+        ]
+        ids = pick_devices(avail, per.quantity)
+        if ids is None:
+            return None, (
+                f"insufficient {per.resource} matching affinity "
+                f"(want {per.quantity}, matched {len(avail)})"
+            )
+        assignments[per.name] = ids
+        taken[per.resource].update(ids)
+    return assignments, ""
+
+
+def has_extended_resources(pod: t.Pod) -> bool:
+    return bool(pod.spec.extended_resources)

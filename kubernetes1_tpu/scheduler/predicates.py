@@ -1,0 +1,153 @@
+"""Fit predicates (ref: plugin/pkg/scheduler/algorithm/predicates/
+predicates.go — PodFitsResources:630, GeneralPredicates:965, node selector,
+taints, host ports; defaults registered in algorithmprovider/defaults).
+
+Each predicate returns (fits: bool, reason: str).  Device fit is separate
+(devices.allocate_for_pod) because it also produces the assignment.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..api import types as t
+from ..machinery import labels as labelutil
+from .cache import NodeInfo, pod_request_memory, pod_request_milli_cpu
+
+
+def pod_fits_resources(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
+    if len(ni.pods) + 1 > ni.allocatable_pods:
+        return False, f"too many pods ({len(ni.pods)}/{ni.allocatable_pods})"
+    cpu = pod_request_milli_cpu(pod)
+    if cpu and ni.requested_milli_cpu + cpu > ni.allocatable_milli_cpu:
+        return False, (
+            f"insufficient cpu (requested {ni.requested_milli_cpu}m + {cpu}m > "
+            f"allocatable {ni.allocatable_milli_cpu}m)"
+        )
+    mem = pod_request_memory(pod)
+    if mem and ni.requested_memory + mem > ni.allocatable_memory:
+        return False, "insufficient memory"
+    return True, ""
+
+
+def pod_matches_node_selector(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
+    node = ni.node
+    if node is None:
+        return False, "node unknown"
+    if pod.spec.node_selector and not labelutil.match_labels(
+        pod.spec.node_selector, node.metadata.labels
+    ):
+        return False, "node selector mismatch"
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity_required:
+        # terms are ORed; expressions within a term ANDed
+        for term in aff.node_affinity_required:
+            if _term_matches(term, node.metadata.labels):
+                break
+        else:
+            return False, "node affinity mismatch"
+    return True, ""
+
+
+def _term_matches(term: t.NodeAffinityTerm, node_labels) -> bool:
+    for expr in term.match_expressions:
+        val = node_labels.get(expr.key)
+        if expr.operator == "In":
+            if val not in expr.values:
+                return False
+        elif expr.operator == "NotIn":
+            if val is not None and val in expr.values:
+                return False
+        elif expr.operator == "Exists":
+            if val is None:
+                return False
+        elif expr.operator == "DoesNotExist":
+            if val is not None:
+                return False
+        elif expr.operator in ("Gt", "Lt"):
+            if val is None:
+                return False
+            try:
+                have, want = float(val), float(expr.values[0])
+            except (ValueError, IndexError):
+                return False
+            if expr.operator == "Gt" and not have > want:
+                return False
+            if expr.operator == "Lt" and not have < want:
+                return False
+        else:
+            return False
+    return True
+
+
+def pod_tolerates_node_taints(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
+    node = ni.node
+    if node is None:
+        return False, "node unknown"
+    for taint in node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule is a priority concern
+        if not any(_tolerates(tol, taint) for tol in pod.spec.tolerations):
+            return False, f"untolerated taint {taint.key}={taint.value}:{taint.effect}"
+    return True, ""
+
+
+def _tolerates(tol: t.Toleration, taint: t.Taint) -> bool:
+    if tol.effect and tol.effect != taint.effect:
+        return False
+    if tol.operator == "Exists":
+        return tol.key == "" or tol.key == taint.key
+    return tol.key == taint.key and tol.value == taint.value
+
+
+def pod_fits_host_ports(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
+    wanted = {
+        (p.host_port, p.protocol)
+        for c in pod.spec.containers
+        for p in c.ports
+        if p.host_port
+    }
+    if not wanted:
+        return True, ""
+    used = {
+        (p.host_port, p.protocol)
+        for existing in ni.pods.values()
+        for c in existing.spec.containers
+        for p in c.ports
+        if p.host_port
+    }
+    clash = wanted & used
+    if clash:
+        return False, f"host port(s) in use: {sorted(clash)}"
+    return True, ""
+
+
+def node_schedulable(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
+    node = ni.node
+    if node is None:
+        return False, "node unknown"
+    if node.spec.unschedulable:
+        return False, "node unschedulable (cordoned)"
+    for cond in node.status.conditions:
+        if cond.type == t.NODE_READY and cond.status != "True":
+            return False, "node not ready"
+    return True, ""
+
+
+DEFAULT_PREDICATES = [
+    ("NodeSchedulable", node_schedulable),
+    ("MatchNodeSelector", pod_matches_node_selector),
+    ("PodToleratesNodeTaints", pod_tolerates_node_taints),
+    ("PodFitsHostPorts", pod_fits_host_ports),
+    ("PodFitsResources", pod_fits_resources),
+]
+
+
+def run_predicates(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, List[str]]:
+    reasons = []
+    for _name, pred in DEFAULT_PREDICATES:
+        ok, reason = pred(pod, ni)
+        if not ok:
+            reasons.append(reason)
+            return False, reasons
+    return True, reasons

@@ -1,0 +1,102 @@
+"""Scoring priorities (ref: plugin/pkg/scheduler/algorithm/priorities/ —
+LeastRequested, BalancedAllocation, TaintToleration, NodeAffinity; defaults
+at algorithmprovider/defaults/defaults.go:220-255).
+
+TPU-first addition: `slice_packing` scores nodes by how well the pod's
+device request packs into ICI slices — preferring nodes whose free chips
+complete a slice rather than fragmenting a fresh one.  This is the
+single-pod analogue of gang slice-affinity.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, List, Tuple
+
+from ..api import types as t
+from .cache import NodeInfo, pod_request_memory, pod_request_milli_cpu
+from .devices import device_matches
+
+MAX_SCORE = 10.0
+
+
+def least_requested(pod: t.Pod, ni: NodeInfo) -> float:
+    """Favor nodes with more free cpu+memory fraction."""
+    score = 0.0
+    if ni.allocatable_milli_cpu > 0:
+        free = max(0.0, 1 - (ni.requested_milli_cpu + pod_request_milli_cpu(pod)) / ni.allocatable_milli_cpu)
+        score += free * MAX_SCORE
+    if ni.allocatable_memory > 0:
+        free = max(0.0, 1 - (ni.requested_memory + pod_request_memory(pod)) / ni.allocatable_memory)
+        score += free * MAX_SCORE
+    return score / 2
+
+
+def balanced_allocation(pod: t.Pod, ni: NodeInfo) -> float:
+    """Favor nodes where cpu and memory utilization stay close."""
+    if ni.allocatable_milli_cpu <= 0 or ni.allocatable_memory <= 0:
+        return 0.0
+    cpu_frac = min(1.0, (ni.requested_milli_cpu + pod_request_milli_cpu(pod)) / ni.allocatable_milli_cpu)
+    mem_frac = min(1.0, (ni.requested_memory + pod_request_memory(pod)) / ni.allocatable_memory)
+    return (1 - abs(cpu_frac - mem_frac)) * MAX_SCORE
+
+
+def taint_toleration(pod: t.Pod, ni: NodeInfo) -> float:
+    """Penalize PreferNoSchedule taints the pod doesn't tolerate."""
+    if ni.node is None:
+        return 0.0
+    from .predicates import _tolerates
+
+    bad = 0
+    for taint in ni.node.spec.taints:
+        if taint.effect == "PreferNoSchedule" and not any(
+            _tolerates(tol, taint) for tol in pod.spec.tolerations
+        ):
+            bad += 1
+    return max(0.0, MAX_SCORE - 2.0 * bad)
+
+
+def slice_packing(pod: t.Pod, ni: NodeInfo) -> float:
+    """Best-fit over ICI slices: for each device request, score high when a
+    slice can satisfy it exactly or with little leftover, low when the
+    request must fragment a large slice or span slices."""
+    if not pod.spec.extended_resources:
+        return MAX_SCORE / 2  # neutral
+    total = 0.0
+    for per in pod.spec.extended_resources:
+        avail = [
+            d
+            for d in ni.available_devices(per.resource)
+            if device_matches(d, per.affinity)
+        ]
+        if len(avail) < per.quantity:
+            continue  # predicate will have filtered; defensive
+        by_slice: Dict[str, int] = defaultdict(int)
+        for d in avail:
+            by_slice[(d.attributes or {}).get(t.ATTR_TPU_SLICE, "")] += 1
+        fitting = [n for n in by_slice.values() if n >= per.quantity]
+        if not fitting:
+            total += 1.0  # must span slices: worst
+            continue
+        best = min(fitting)
+        leftover = best - per.quantity
+        total += MAX_SCORE * (1.0 / (1.0 + leftover))
+    return total / max(1, len(pod.spec.extended_resources))
+
+
+DEFAULT_PRIORITIES: List[Tuple[str, Callable[[t.Pod, NodeInfo], float], float]] = [
+    ("LeastRequested", least_requested, 1.0),
+    ("BalancedAllocation", balanced_allocation, 1.0),
+    ("TaintToleration", taint_toleration, 1.0),
+    ("SlicePacking", slice_packing, 2.0),  # device placement dominates on TPU
+]
+
+
+def prioritize(pod: t.Pod, nodes: List[NodeInfo]) -> Dict[str, float]:
+    scores: Dict[str, float] = {}
+    for ni in nodes:
+        s = 0.0
+        for _name, fn, weight in DEFAULT_PRIORITIES:
+            s += weight * fn(pod, ni)
+        scores[ni.node.metadata.name] = s
+    return scores

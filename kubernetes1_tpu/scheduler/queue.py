@@ -1,0 +1,103 @@
+"""Scheduling queue: priority-ordered with backoff for unschedulable pods.
+
+Ref: plugin/pkg/scheduler/core/scheduling_queue.go (FIFO + priority queue)
+— higher spec.priority pops first, FIFO within a priority band; pods that
+failed to schedule re-enter after exponential backoff so a full queue of
+unschedulable pods doesn't hot-loop the scheduler.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class SchedulingQueue:
+    def __init__(self, base_backoff: float = 0.1, max_backoff: float = 10.0):
+        self._cond = threading.Condition()
+        self._heap: list = []  # (-priority, seq, key)
+        self._entries: set = set()
+        self._seq = 0
+        self._shutdown = False
+        self._base = base_backoff
+        self._max = max_backoff
+        self._attempts: Dict[str, int] = {}
+        self._timers: Dict[str, threading.Timer] = {}
+
+    def add(self, key: str, priority: int = 0):
+        with self._cond:
+            if self._shutdown or key in self._entries:
+                return
+            self._entries.add(key)
+            heapq.heappush(self._heap, (-priority, self._seq, key))
+            self._seq += 1
+            self._cond.notify()
+
+    def add_backoff(self, key: str, priority: int = 0):
+        """Re-add after exponential backoff (unschedulable path)."""
+        with self._cond:
+            if self._shutdown:
+                return
+            n = self._attempts.get(key, 0)
+            self._attempts[key] = n + 1
+            delay = min(self._base * (2**n), self._max)
+            if key in self._timers:
+                return
+            timer = threading.Timer(delay, self._timer_fire, args=(key, priority))
+            timer.daemon = True
+            self._timers[key] = timer
+            timer.start()
+
+    def _timer_fire(self, key: str, priority: int):
+        with self._cond:
+            self._timers.pop(key, None)
+        self.add(key, priority)
+
+    def flush_backoffs(self):
+        """Move every backing-off pod to the active queue now — called on
+        cluster-state changes that may make pods schedulable (node add,
+        device health change, pod deletion), the reference's
+        moveAllToActiveOrBackoffQueue."""
+        with self._cond:
+            fired = []
+            for key, timer in list(self._timers.items()):
+                timer.cancel()
+                self._timers.pop(key, None)
+                fired.append(key)
+        for key in fired:
+            self.add(key)
+
+    def forget(self, key: str):
+        """Successful schedule resets the backoff counter."""
+        with self._cond:
+            self._attempts.pop(key, None)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[str]:
+        with self._cond:
+            deadline = time.monotonic() + timeout if timeout is not None else None
+            while not self._heap and not self._shutdown:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining)
+            if self._shutdown and not self._heap:
+                return None
+            _, _, key = heapq.heappop(self._heap)
+            self._entries.discard(key)
+            return key
+
+    def __len__(self):
+        with self._cond:
+            return len(self._heap)
+
+    def shut_down(self):
+        with self._cond:
+            self._shutdown = True
+            for timer in self._timers.values():
+                timer.cancel()
+            self._timers.clear()
+            self._cond.notify_all()

@@ -137,8 +137,9 @@ class Store:
         """Must hold lock. Assigns the next revision and fans out."""
         self._rev += 1
         rev = self._rev
-        obj = dict(obj)
-        obj.setdefault("metadata", {})
+        # two-level copy: never re-stamp a dict already committed to history
+        # or handed to a watcher (delete passes the stored dict back in here)
+        obj = {**obj, "metadata": dict(obj.get("metadata") or {})}
         obj["metadata"]["resourceVersion"] = str(rev)
         if typ == DELETED:
             self._data.pop(key, None)

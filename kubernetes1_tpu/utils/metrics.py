@@ -1,0 +1,131 @@
+"""Component metrics: counters/gauges/histograms with Prometheus text output.
+
+Ref: the reference's prometheus client usage (scheduler metrics/, kubelet
+metrics/ — incl. the fork's DevicePluginAllocationLatency observed at
+devicemanager/manager.go:231).  Histograms keep a bounded sample reservoir
+so p50/p90/p99 are queryable in-process (bench.py reads them directly).
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import threading
+from typing import Dict, List, Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+    def render(self) -> str:
+        return f"# TYPE {self.name} counter\n{self.name} {self.value}\n"
+
+
+class Gauge(Counter):
+    def set(self, v: float):
+        with self._lock:
+            self._v = v
+
+    def render(self) -> str:
+        return f"# TYPE {self.name} gauge\n{self.name} {self.value}\n"
+
+
+class Histogram:
+    """Reservoir-sampled histogram with exact quantiles over the reservoir."""
+
+    def __init__(self, name: str, help_: str = "", reservoir: int = 10000):
+        self.name = name
+        self.help = help_
+        self._samples: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._max_reservoir = reservoir
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if len(self._samples) < self._max_reservoir:
+                bisect.insort(self._samples, v)
+            else:
+                idx = random.randrange(self._count)
+                if idx < self._max_reservoir:
+                    del self._samples[random.randrange(len(self._samples))]
+                    bisect.insort(self._samples, v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            idx = min(len(self._samples) - 1, int(q * len(self._samples)))
+            return self._samples[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> str:
+        lines = [f"# TYPE {self.name} summary"]
+        for q in (0.5, 0.9, 0.99):
+            v = self.quantile(q)
+            if v is not None:
+                lines.append(f'{self.name}{{quantile="{q}"}} {v:.6f}')
+        lines.append(f"{self.name}_sum {self.sum:.6f}")
+        lines.append(f"{self.name}_count {self.count}")
+        return "\n".join(lines) + "\n"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Counter(name, help_)
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Gauge(name, help_)
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Histogram(name, help_)
+            return self._metrics[name]  # type: ignore[return-value]
+
+    def render(self) -> str:
+        with self._lock:
+            return "".join(m.render() for m in self._metrics.values())  # type: ignore[attr-defined]
+
+
+global_registry = Registry()

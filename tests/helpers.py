@@ -1,0 +1,72 @@
+"""Shared test fixtures: nodes with synthetic TPU inventories, TPU pods."""
+
+from kubernetes1_tpu.api import types as t
+
+
+def make_tpu_devices(count, slice_id="slice-0", tpu_type="v5e", host_index=0, prefix=None):
+    prefix = prefix if prefix is not None else f"{slice_id}-h{host_index}"
+    devices = []
+    for i in range(count):
+        devices.append(
+            t.ExtendedResourceDevice(
+                id=f"{prefix}-tpu{i}",
+                health=t.DEVICE_HEALTHY,
+                attributes={
+                    t.ATTR_TPU_TYPE: tpu_type,
+                    t.ATTR_TPU_SLICE: slice_id,
+                    t.ATTR_TPU_HOST_INDEX: str(host_index),
+                    t.ATTR_TPU_CHIP_COORDS: f"{i % 2},{i // 2},0",
+                    t.ATTR_TPU_TOPOLOGY: "2x2x1",
+                },
+            )
+        )
+    return devices
+
+
+def make_node(
+    name,
+    cpu="8",
+    memory="32Gi",
+    tpus=0,
+    slice_id="slice-0",
+    tpu_type="v5e",
+    host_index=0,
+    labels=None,
+    ready=True,
+):
+    node = t.Node()
+    node.metadata.name = name
+    node.metadata.labels = labels or {}
+    node.status.capacity = {"cpu": cpu, "memory": memory, "pods": "110"}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [
+        t.NodeCondition(type=t.NODE_READY, status="True" if ready else "False")
+    ]
+    if tpus:
+        node.status.extended_resources = {
+            "google.com/tpu": make_tpu_devices(
+                tpus, slice_id=slice_id, tpu_type=tpu_type, host_index=host_index
+            )
+        }
+    return node
+
+
+def make_tpu_pod(name, tpus=1, ns="default", cpu="100m", affinity=None, priority=0,
+                 gang="", gang_size=0):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    c = t.Container(name="main", image="jax-workload")
+    c.resources.requests = {"cpu": cpu}
+    pod.spec.containers = [c]
+    pod.spec.priority = priority
+    pod.spec.scheduling_gang = gang
+    pod.spec.gang_size = gang_size
+    if tpus:
+        per = t.PodExtendedResource(
+            name=f"{name}-tpu", resource="google.com/tpu", quantity=tpus,
+            affinity=affinity,
+        )
+        pod.spec.extended_resources = [per]
+        c.extended_resource_requests = [per.name]
+    return pod

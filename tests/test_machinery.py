@@ -135,3 +135,27 @@ class TestErrors:
         back = ApiError.from_status(st)
         assert isinstance(back, Conflict)
         assert back.message == "rv mismatch"
+
+
+class TestLeaseExpiry:
+    def test_expired_uses_utc(self):
+        """Regression: renew_time is UTC; expiry math must use timegm."""
+        import time as _time
+
+        from kubernetes1_tpu.api import types as t
+        from kubernetes1_tpu.client.leaderelection import LeaderElector
+
+        elector = LeaderElector.__new__(LeaderElector)
+        elector.lease_duration = 10.0
+        fresh = t.Lease(
+            renew_time=_time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+            lease_duration_seconds=10,
+        )
+        assert not elector._expired(fresh)
+        stale = t.Lease(
+            renew_time=_time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(_time.time() - 60)
+            ),
+            lease_duration_seconds=10,
+        )
+        assert elector._expired(stale)

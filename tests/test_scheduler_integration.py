@@ -1,0 +1,168 @@
+"""Scheduler integration: real apiserver + real scheduler, fake nodes.
+
+Mirrors the reference's test/integration/scheduler suite: nodes are API
+objects with synthetic TPU inventories (no kubelet), pods flow through the
+real watch -> queue -> schedule -> bind path.
+"""
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_node, make_tpu_pod
+
+
+@pytest.fixture()
+def cluster():
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=5.0)
+    sched.start()
+    yield master, cs, sched
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def wait_bound(cs, name, ns="default", timeout=10.0):
+    def check():
+        pod = cs.pods.get(name, ns)
+        return bool(pod.spec.node_name)
+
+    must_poll_until(check, timeout=timeout, desc=f"pod {name} bound")
+    return cs.pods.get(name, ns)
+
+
+class TestScheduling:
+    def test_cpu_pod_binds(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("n1"))
+        cs.pods.create(make_tpu_pod("cpu-pod", tpus=0))
+        pod = wait_bound(cs, "cpu-pod")
+        assert pod.spec.node_name == "n1"
+
+    def test_tpu_pod_gets_device_ids(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("n1", tpus=4))
+        cs.pods.create(make_tpu_pod("tpu-pod", tpus=2))
+        pod = wait_bound(cs, "tpu-pod")
+        assert len(pod.spec.extended_resources[0].assigned) == 2
+        assert all("tpu" in i for i in pod.spec.extended_resources[0].assigned)
+
+    def test_devices_not_double_allocated(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("n1", tpus=4))
+        for i in range(2):
+            cs.pods.create(make_tpu_pod(f"half-{i}", tpus=2))
+        pods = [wait_bound(cs, f"half-{i}") for i in range(2)]
+        ids = [i for p in pods for i in p.spec.extended_resources[0].assigned]
+        assert len(ids) == 4
+        assert len(set(ids)) == 4  # disjoint
+        # a fifth chip doesn't exist: next pod stays pending
+        cs.pods.create(make_tpu_pod("overflow", tpus=1))
+        import time
+
+        time.sleep(1.0)
+        assert cs.pods.get("overflow").spec.node_name == ""
+
+    def test_affinity_routes_to_matching_type(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("n-v5e", tpus=4, tpu_type="v5e"))
+        cs.nodes.create(make_node("n-v5p", tpus=4, tpu_type="v5p", slice_id="slice-p"))
+        aff = t.ResourceAffinity(
+            required=[
+                t.ResourceSelectorRequirement(
+                    key=t.ATTR_TPU_TYPE, operator="In", values=["v5p"]
+                )
+            ]
+        )
+        cs.pods.create(make_tpu_pod("want-v5p", tpus=2, affinity=aff))
+        pod = wait_bound(cs, "want-v5p")
+        assert pod.spec.node_name == "n-v5p"
+
+    def test_unschedulable_pod_schedules_after_capacity_arrives(self, cluster):
+        _, cs, _ = cluster
+        cs.pods.create(make_tpu_pod("waiting", tpus=4))
+        import time
+
+        time.sleep(0.5)
+        assert cs.pods.get("waiting").spec.node_name == ""
+        cs.nodes.create(make_node("late-node", tpus=4))
+        pod = wait_bound(cs, "waiting")
+        assert pod.spec.node_name == "late-node"
+
+
+class TestGangScheduling:
+    def test_gang_binds_all_or_nothing(self, cluster):
+        _, cs, _ = cluster
+        # two hosts, same ICI slice, 4 chips each
+        cs.nodes.create(make_node("h0", tpus=4, slice_id="v5p-32", host_index=0))
+        cs.nodes.create(make_node("h1", tpus=4, slice_id="v5p-32", host_index=1))
+        for i in range(2):
+            cs.pods.create(
+                make_tpu_pod(f"worker-{i}", tpus=4, gang="bert", gang_size=2)
+            )
+        pods = [wait_bound(cs, f"worker-{i}") for i in range(2)]
+        assert {p.spec.node_name for p in pods} == {"h0", "h1"}
+        for p in pods:
+            assert len(p.spec.extended_resources[0].assigned) == 4
+
+    def test_gang_waits_for_all_members(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("h0", tpus=4, slice_id="s", host_index=0))
+        cs.nodes.create(make_node("h1", tpus=4, slice_id="s", host_index=1))
+        cs.pods.create(make_tpu_pod("lone-0", tpus=4, gang="solo", gang_size=2))
+        import time
+
+        time.sleep(1.0)
+        assert cs.pods.get("lone-0").spec.node_name == ""  # incomplete gang holds
+        cs.pods.create(make_tpu_pod("lone-1", tpus=4, gang="solo", gang_size=2))
+        wait_bound(cs, "lone-0")
+        wait_bound(cs, "lone-1")
+
+    def test_gang_prefers_single_slice(self, cluster):
+        _, cs, _ = cluster
+        # slice A: two hosts with 4 free chips each; slice B: two hosts likewise
+        # but one host is half-occupied -> only slice A can hold the gang whole
+        cs.nodes.create(make_node("a0", tpus=4, slice_id="sliceA", host_index=0))
+        cs.nodes.create(make_node("a1", tpus=4, slice_id="sliceA", host_index=1))
+        cs.nodes.create(make_node("b0", tpus=4, slice_id="sliceB", host_index=0))
+        cs.nodes.create(make_node("b1", tpus=2, slice_id="sliceB", host_index=1))
+        for i in range(2):
+            cs.pods.create(
+                make_tpu_pod(f"g-{i}", tpus=4, gang="affine", gang_size=2)
+            )
+        pods = [wait_bound(cs, f"g-{i}") for i in range(2)]
+        assert {p.spec.node_name for p in pods} == {"a0", "a1"}
+
+
+class TestPreemption:
+    def test_high_priority_preempts(self, cluster):
+        _, cs, _ = cluster
+        cs.nodes.create(make_node("n1", tpus=4))
+        cs.pods.create(make_tpu_pod("victim", tpus=4, priority=0))
+        wait_bound(cs, "victim")
+        cs.pods.create(make_tpu_pod("vip", tpus=4, priority=100))
+        # scheduler preempts: victim gets a graceful deletionTimestamp
+        must_poll_until(
+            lambda: cs.pods.get("victim").metadata.deletion_timestamp,
+            timeout=10.0,
+            desc="victim marked for deletion",
+        )
+        # nominated node recorded on the preemptor
+        must_poll_until(
+            lambda: cs.pods.get("vip").metadata.annotations.get(
+                t.NOMINATED_NODE_ANNOTATION
+            )
+            == "n1",
+            timeout=10.0,
+            desc="nominated node annotation",
+        )
+        # no kubelet in this test: simulate its finalization of the victim
+        cs.pods.delete("victim", grace_seconds=0)
+        pod = wait_bound(cs, "vip", timeout=15.0)
+        assert pod.spec.node_name == "n1"

@@ -1,0 +1,173 @@
+"""Scheduler unit tests against directly-constructed NodeInfos
+(the reference's core/extended_resources_test.go + generic_scheduler_test.go
+pattern: no apiserver, pure placement logic)."""
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.scheduler.cache import NodeInfo, SchedulerCache
+from kubernetes1_tpu.scheduler.devices import allocate_for_pod, device_matches, pick_devices
+from kubernetes1_tpu.scheduler.predicates import run_predicates
+from kubernetes1_tpu.scheduler.priorities import prioritize, slice_packing
+
+from tests.helpers import make_node, make_tpu_devices, make_tpu_pod
+
+
+def ni(node):
+    return NodeInfo(node)
+
+
+class TestDeviceMatching:
+    def test_affinity_in(self):
+        dev = make_tpu_devices(1, tpu_type="v5p")[0]
+        aff = t.ResourceAffinity(
+            required=[t.ResourceSelectorRequirement(key=t.ATTR_TPU_TYPE, operator="In", values=["v5p"])]
+        )
+        assert device_matches(dev, aff)
+        aff.required[0].values = ["v5e"]
+        assert not device_matches(dev, aff)
+
+    def test_affinity_gt_exists(self):
+        dev = t.ExtendedResourceDevice(id="d0", attributes={"google.com/tpu/memory-gb": "16"})
+        gt = t.ResourceAffinity(
+            required=[t.ResourceSelectorRequirement(key="google.com/tpu/memory-gb", operator="Gt", values=["8"])]
+        )
+        assert device_matches(dev, gt)
+        gt.required[0].values = ["16"]
+        assert not device_matches(dev, gt)
+        ex = t.ResourceAffinity(
+            required=[t.ResourceSelectorRequirement(key=t.ATTR_TPU_SLICE, operator="Exists")]
+        )
+        assert not device_matches(dev, ex)
+
+    def test_unhealthy_not_allocatable(self):
+        node = make_node("n1", tpus=4)
+        node.status.extended_resources["google.com/tpu"][0].health = t.DEVICE_UNHEALTHY
+        info = ni(node)
+        pod = make_tpu_pod("p", tpus=4)
+        assignments, reason = allocate_for_pod(pod, info)
+        assert assignments is None
+        assert "insufficient" in reason
+        pod3 = make_tpu_pod("p3", tpus=3)
+        assignments, _ = allocate_for_pod(pod3, info)
+        assert assignments is not None
+
+    def test_slice_best_fit(self):
+        # 2 free in slice-a, 4 free in slice-b: a 2-chip ask takes slice-a
+        devices = make_tpu_devices(2, slice_id="slice-a") + make_tpu_devices(
+            4, slice_id="slice-b"
+        )
+        ids = pick_devices(devices, 2)
+        assert all("slice-a" in i for i in ids)
+        # 3-chip ask doesn't fit slice-a; takes slice-b without spanning
+        ids = pick_devices(devices, 3)
+        assert all("slice-b" in i for i in ids)
+        # 5-chip ask must span
+        ids = pick_devices(devices, 5)
+        assert len(ids) == 5
+
+    def test_disjoint_multi_request(self):
+        node = make_node("n1", tpus=4)
+        pod = make_tpu_pod("p", tpus=2)
+        per2 = t.PodExtendedResource(name="second", resource="google.com/tpu", quantity=2)
+        pod.spec.extended_resources.append(per2)
+        assignments, _ = allocate_for_pod(pod, ni(node))
+        all_ids = assignments[pod.spec.extended_resources[0].name] + assignments["second"]
+        assert len(set(all_ids)) == 4
+
+
+class TestPredicates:
+    def test_fits_resources(self):
+        node = make_node("n1", cpu="1")
+        info = ni(node)
+        small = make_tpu_pod("s", tpus=0, cpu="500m")
+        ok, _ = run_predicates(small, info)
+        assert ok
+        info.add_pod(small)
+        big = make_tpu_pod("b", tpus=0, cpu="600m")
+        ok, reasons = run_predicates(big, info)
+        assert not ok and "insufficient cpu" in reasons[0]
+
+    def test_node_selector_and_ready(self):
+        node = make_node("n1", labels={"pool": "tpu"})
+        pod = make_tpu_pod("p", tpus=0)
+        pod.spec.node_selector = {"pool": "tpu"}
+        assert run_predicates(pod, ni(node))[0]
+        pod.spec.node_selector = {"pool": "gpu"}
+        assert not run_predicates(pod, ni(node))[0]
+        notready = make_node("n2", ready=False)
+        pod.spec.node_selector = {}
+        ok, reasons = run_predicates(pod, ni(notready))
+        assert not ok and "not ready" in reasons[0]
+
+    def test_taints_tolerations(self):
+        node = make_node("n1")
+        node.spec.taints = [t.Taint(key="tpu-maint", value="true", effect="NoSchedule")]
+        pod = make_tpu_pod("p", tpus=0)
+        assert not run_predicates(pod, ni(node))[0]
+        pod.spec.tolerations = [t.Toleration(key="tpu-maint", operator="Exists")]
+        assert run_predicates(pod, ni(node))[0]
+
+    def test_host_ports(self):
+        node = make_node("n1")
+        info = ni(node)
+        p1 = make_tpu_pod("p1", tpus=0)
+        p1.spec.containers[0].ports = [t.ContainerPort(container_port=80, host_port=8080)]
+        info.add_pod(p1)
+        p2 = make_tpu_pod("p2", tpus=0)
+        p2.spec.containers[0].ports = [t.ContainerPort(container_port=80, host_port=8080)]
+        ok, reasons = run_predicates(p2, info)
+        assert not ok and "host port" in reasons[0]
+
+
+class TestPriorities:
+    def test_least_requested_prefers_idle(self):
+        idle, busy = ni(make_node("idle")), ni(make_node("busy"))
+        filler = make_tpu_pod("f", tpus=0, cpu="6")
+        busy.add_pod(filler)
+        pod = make_tpu_pod("p", tpus=0)
+        scores = prioritize(pod, [idle, busy])
+        assert scores["idle"] > scores["busy"]
+
+    def test_slice_packing_prefers_tight_fit(self):
+        # node-a has exactly 4 free chips in one slice; node-b has 8
+        a = ni(make_node("a", tpus=4, slice_id="sa"))
+        b = ni(make_node("b", tpus=8, slice_id="sb"))
+        pod = make_tpu_pod("p", tpus=4)
+        assert slice_packing(pod, a) > slice_packing(pod, b)
+
+
+class TestCacheAccounting:
+    def test_assume_confirm_lifecycle(self):
+        cache = SchedulerCache()
+        cache.update_node(make_node("n1", tpus=4))
+        pod = make_tpu_pod("p", tpus=2)
+        pod.spec.extended_resources[0].assigned = ["slice-0-h0-tpu0", "slice-0-h0-tpu1"]
+        pod.spec.node_name = "n1"
+        cache.assume_pod(pod, "n1")
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 2
+        # confirm via add_pod (watch event) keeps the deduction exactly once
+        cache.add_pod(pod)
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 2
+        cache.remove_pod(pod)
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 4
+
+    def test_forget_releases(self):
+        cache = SchedulerCache()
+        cache.update_node(make_node("n1", tpus=4))
+        pod = make_tpu_pod("p", tpus=4)
+        pod.spec.extended_resources[0].assigned = [
+            f"slice-0-h0-tpu{i}" for i in range(4)
+        ]
+        cache.assume_pod(pod, "n1")
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 0
+        cache.forget_pod(pod)
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 4
+
+    def test_expired_assume_cleanup(self):
+        cache = SchedulerCache()
+        cache.ASSUME_EXPIRY_SECONDS = 0.0
+        cache.update_node(make_node("n1", tpus=2))
+        pod = make_tpu_pod("p", tpus=2)
+        pod.spec.extended_resources[0].assigned = ["slice-0-h0-tpu0", "slice-0-h0-tpu1"]
+        cache.assume_pod(pod, "n1")
+        cache.cleanup_expired_assumes()
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 2

@@ -179,3 +179,21 @@ class TestWAL:
         s2.create(key(make_pod("c")), make_pod("c"))
         assert s2.get(key(make_pod("c"))).metadata.resource_version == "4"
         s2.close()
+
+
+class TestHistoryImmutability:
+    def test_delete_does_not_restamp_history(self, store):
+        """Regression: _commit must not mutate dicts already in history —
+        a replayed ADDED event keeps its own revision, not the delete's."""
+        store.create(key(make_pod("a")), make_pod("a"))
+        _, rev_after_a = store.list("/registry/pods/")
+        store.create(key(make_pod("b")), make_pod("b"))
+        store.delete(key(make_pod("b")))
+        w = store.watch("/registry/pods/", since_rev=rev_after_a)
+        added = w.next_timeout(1)
+        deleted = w.next_timeout(1)
+        assert added.type == ADDED
+        assert added.object["metadata"]["resourceVersion"] == str(rev_after_a + 1)
+        assert deleted.type == DELETED
+        assert deleted.object["metadata"]["resourceVersion"] == str(rev_after_a + 2)
+        w.stop()
