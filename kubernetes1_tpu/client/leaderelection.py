@@ -8,14 +8,14 @@ lease_duration since the last observed renewal.  Non-leaders hot-standby.
 
 from __future__ import annotations
 
-import calendar
 import threading
 import time
 import traceback
 from typing import Callable, Optional
 
 from ..api import types as t
-from ..machinery import AlreadyExists, Conflict, NotFound, now_iso
+from ..machinery.errors import AlreadyExists, Conflict, NotFound
+from ..machinery.meta import now_iso_micro, parse_iso
 from .clientset import Clientset
 
 
@@ -81,7 +81,7 @@ class LeaderElector:
             self._stop.wait(self.retry_period)
 
     def _try_acquire_or_renew(self) -> bool:
-        now = now_iso()
+        now = now_iso_micro()
         try:
             lease = self.cs.leases.get(self.name, self.namespace)
         except NotFound:
@@ -122,8 +122,7 @@ class LeaderElector:
             return False
 
     def _expired(self, lease: t.Lease) -> bool:
-        # renew_time is UTC — timegm, not mktime (which assumes local time)
-        renew = calendar.timegm(time.strptime(lease.renew_time, "%Y-%m-%dT%H:%M:%SZ"))
+        renew = parse_iso(lease.renew_time)  # UTC, microsecond resolution
         return (time.time() - renew) > max(
             float(lease.lease_duration_seconds), self.lease_duration
         )

@@ -1,0 +1,9 @@
+from .api import (
+    PluginClient,
+    PluginServer,
+    ContainerSpec,
+    DeviceSpec,
+    Mount,
+    plugin_socket_path,
+)
+from .tpu_plugin import TPUDevicePlugin, discover_tpu_devices

@@ -1,0 +1,265 @@
+"""libtpu device plugin: advertises google.com/tpu chips with topology
+attributes and injects /dev/accel* + TPU bootstrap env into containers.
+
+This replaces the reference's out-of-tree NVIDIA plugin + nvidia-container-
+runtime hook pair (SURVEY.md §2.2 docker hook service): instead of swapping
+the OCI runtime, everything a TPU container needs rides the InitContainer
+ContainerSpec — device nodes, libtpu env, and the multi-host (megascale)
+bootstrap variables that the reference-era GPU stack had no equivalent for:
+
+  TPU_VISIBLE_CHIPS        chip indices this container owns ("0,1")
+  TPU_WORKER_ID            completion index of the pod in its Job
+  TPU_WORKER_HOSTNAMES     comma-separated peer hostnames (from Job svc)
+  TPU_ACCELERATOR_TYPE     e.g. v5e-4, v5p-32
+  TPU_CHIPS_PER_HOST_BOUNDS / TPU_TOPOLOGY  slice geometry
+  JAX_COORDINATOR_ADDRESS  jax.distributed bootstrap address
+
+Discovery modes:
+- real: walk /dev/accel[0-9]* on a TPU VM; geometry from TPU_* env or
+  the metadata attributes file when present.
+- fake: KTPU_FAKE_TPUS="<type>:<count>:<slice>:<host_index>" synthesizes
+  an inventory — the kubemark-style path that lets a 256-host v5e cluster
+  be tested with zero TPUs (SURVEY.md §4.5).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import TPU_RESOURCE
+from ..api import types as t
+from .api import ContainerSpec, DeviceSpec, PluginServer, plugin_socket_path
+
+# Pod annotations the plugin consumes (set by the Job controller / user).
+ANN_WORKER_ID = "tpu.ktpu.io/worker-id"
+ANN_COORDINATOR = "tpu.ktpu.io/coordinator-address"
+ANN_WORKER_HOSTNAMES = "tpu.ktpu.io/worker-hostnames"
+
+
+def discover_tpu_devices() -> List[dict]:
+    """Return the node's TPU inventory as encoded ExtendedResourceDevice
+    dicts.  Fake mode wins if configured; else real /dev/accel* discovery."""
+    fake = os.environ.get("KTPU_FAKE_TPUS", "")
+    if fake:
+        return _fake_devices(fake)
+    return _real_devices()
+
+
+def _fake_devices(spec: str) -> List[dict]:
+    parts = spec.split(":")
+    tpu_type = parts[0] if len(parts) > 0 and parts[0] else "v5e"
+    count = int(parts[1]) if len(parts) > 1 and parts[1] else 4
+    slice_id = parts[2] if len(parts) > 2 and parts[2] else "slice-0"
+    host_index = parts[3] if len(parts) > 3 and parts[3] else "0"
+    devices = []
+    for i in range(count):
+        devices.append(
+            {
+                "id": f"{slice_id}-h{host_index}-chip{i}",
+                "health": t.DEVICE_HEALTHY,
+                "attributes": {
+                    t.ATTR_TPU_TYPE: tpu_type,
+                    t.ATTR_TPU_SLICE: slice_id,
+                    t.ATTR_TPU_HOST_INDEX: str(host_index),
+                    t.ATTR_TPU_CHIP_COORDS: f"{i % 2},{i // 2},0",
+                    t.ATTR_TPU_TOPOLOGY: _topology_for(count),
+                    "ktpu.io/device-index": str(i),
+                },
+            }
+        )
+    return devices
+
+
+def _topology_for(count: int) -> str:
+    # minimal sensible geometry for common host chip counts
+    return {1: "1x1x1", 2: "2x1x1", 4: "2x2x1", 8: "2x2x2"}.get(count, f"{count}x1x1")
+
+
+def _real_devices() -> List[dict]:
+    """Walk /dev/accel* (TPU VM device nodes; the analogue of the legacy GPU
+    manager's /dev/nvidia[0-9]* walk, ref pkg/kubelet/gpu/nvidia/
+    nvidia_gpu_manager.go:40-46)."""
+    paths = sorted(glob.glob("/dev/accel[0-9]*"))
+    tpu_type = os.environ.get("TPU_ACCELERATOR_TYPE", "v5e")
+    slice_id = os.environ.get("TPU_SLICE_ID", os.environ.get("TPU_NAME", "slice-0"))
+    host_index = os.environ.get("TPU_WORKER_ID", "0")
+    hostname = os.uname().nodename
+    devices = []
+    for i, path in enumerate(paths):
+        devices.append(
+            {
+                "id": f"{hostname}-accel{i}",
+                "health": t.DEVICE_HEALTHY,
+                "attributes": {
+                    t.ATTR_TPU_TYPE: tpu_type.split("-")[0],
+                    t.ATTR_TPU_SLICE: slice_id,
+                    t.ATTR_TPU_HOST_INDEX: str(host_index),
+                    t.ATTR_TPU_CHIP_COORDS: f"{i % 2},{i // 2},0",
+                    t.ATTR_TPU_TOPOLOGY: _topology_for(len(paths)),
+                    "ktpu.io/device-index": str(i),
+                    "ktpu.io/device-path": path,
+                },
+            }
+        )
+    return devices
+
+
+class TPUDevicePlugin:
+    """Plugin implementation served over PluginServer."""
+
+    def __init__(
+        self,
+        devices: Optional[List[dict]] = None,
+        health_check_interval: float = 10.0,
+    ):
+        self.devices = devices if devices is not None else discover_tpu_devices()
+        self._by_id = {d["id"]: d for d in self.devices}
+        self._admitted_pods: Dict[str, dict] = {}
+        self.health_check_interval = health_check_interval
+        self._lock = threading.Lock()
+        self._dirty = threading.Event()
+
+    # --------------------------------------------------------------- 4 RPCs
+
+    def get_plugin_info(self) -> dict:
+        return {
+            "name": TPU_RESOURCE,
+            "version": "v1",
+            "device_count": len(self.devices),
+        }
+
+    def list_devices(self) -> List[dict]:
+        with self._lock:
+            return [dict(d) for d in self.devices]
+
+    def watch_devices(self, send, stop: threading.Event):
+        """Push updated inventory whenever health flips (ListAndWatch
+        stream semantics, ref endpoint.go:99-105)."""
+        while not stop.is_set():
+            self._dirty.wait(self.health_check_interval)
+            if stop.is_set():
+                return
+            if self._dirty.is_set():
+                self._dirty.clear()
+                send(self.list_devices())
+            else:
+                self._check_health(send)
+
+    def _check_health(self, send):
+        """Real mode: a vanished /dev/accel node marks its chip unhealthy."""
+        changed = False
+        with self._lock:
+            for d in self.devices:
+                path = d["attributes"].get("ktpu.io/device-path")
+                if not path:
+                    continue
+                healthy = os.path.exists(path)
+                want = t.DEVICE_HEALTHY if healthy else t.DEVICE_UNHEALTHY
+                if d["health"] != want:
+                    d["health"] = want
+                    changed = True
+        if changed:
+            send(self.list_devices())
+
+    def set_health(self, device_id: str, health: str):
+        """Test/ops hook: flip a chip's health and push the update."""
+        with self._lock:
+            if device_id in self._by_id:
+                self._by_id[device_id]["health"] = health
+        self._dirty.set()
+
+    def admit_pod(self, params: dict) -> dict:
+        """Verify the scheduler's assignment against local inventory
+        (ref: devicemanager manager.go:152-236 calling plugin AdmitPod)."""
+        pod_uid = params.get("pod_uid", "")
+        assignments = params.get("assignments") or {}
+        with self._lock:
+            for _req_name, ids in assignments.items():
+                for dev_id in ids:
+                    dev = self._by_id.get(dev_id)
+                    if dev is None:
+                        return {"allowed": False, "reason": f"device {dev_id} not on this node"}
+                    if dev["health"] != t.DEVICE_HEALTHY:
+                        return {"allowed": False, "reason": f"device {dev_id} unhealthy"}
+            self._admitted_pods[pod_uid] = assignments
+            # bounded debug record, not a source of truth (assignment truth
+            # lives in the pod spec) — drop oldest beyond the cap
+            if len(self._admitted_pods) > 1024:
+                for key in list(self._admitted_pods)[:256]:
+                    del self._admitted_pods[key]
+        return {"allowed": True}
+
+    def init_container(self, params: dict) -> ContainerSpec:
+        """Build the injection spec for one container (ref: manager.go:245-291
+        -> device_run_container_options.go)."""
+        device_ids: List[str] = params.get("device_ids") or []
+        annotations: Dict[str, str] = params.get("pod_annotations") or {}
+        spec = ContainerSpec()
+        indices, dev_specs = [], []
+        with self._lock:
+            for dev_id in device_ids:
+                dev = self._by_id.get(dev_id)
+                if dev is None:
+                    continue
+                attrs = dev["attributes"]
+                indices.append(attrs.get("ktpu.io/device-index", "0"))
+                path = attrs.get("ktpu.io/device-path")
+                if path:
+                    dev_specs.append(
+                        DeviceSpec(host_path=path, container_path=path, permissions="rw")
+                    )
+            sample = self._by_id.get(device_ids[0]) if device_ids else None
+        spec.envs["TPU_VISIBLE_CHIPS"] = ",".join(indices)
+        spec.envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{len(indices)},1,1"
+        if sample:
+            attrs = sample["attributes"]
+            spec.envs["TPU_ACCELERATOR_TYPE"] = attrs.get(t.ATTR_TPU_TYPE, "")
+            spec.envs["TPU_TOPOLOGY"] = attrs.get(t.ATTR_TPU_TOPOLOGY, "")
+            spec.envs["TPU_SLICE_ID"] = attrs.get(t.ATTR_TPU_SLICE, "")
+            spec.envs["TPU_HOST_INDEX"] = attrs.get(t.ATTR_TPU_HOST_INDEX, "0")
+        # multi-host bootstrap: worker identity + coordinator from annotations
+        if ANN_WORKER_ID in annotations:
+            spec.envs["TPU_WORKER_ID"] = annotations[ANN_WORKER_ID]
+        if ANN_COORDINATOR in annotations:
+            spec.envs["JAX_COORDINATOR_ADDRESS"] = annotations[ANN_COORDINATOR]
+        if ANN_WORKER_HOSTNAMES in annotations:
+            spec.envs["TPU_WORKER_HOSTNAMES"] = annotations[ANN_WORKER_HOSTNAMES]
+        spec.devices = dev_specs
+        spec.annotations["tpu.ktpu.io/injected"] = "true"
+        return spec
+
+
+def run_plugin(
+    plugin_dir: str,
+    devices: Optional[List[dict]] = None,
+    resource: str = TPU_RESOURCE,
+) -> PluginServer:
+    impl = TPUDevicePlugin(devices=devices)
+    server = PluginServer(impl, plugin_socket_path(plugin_dir, resource))
+    server.impl = impl
+    server.start()
+    return server
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description="ktpu TPU device plugin")
+    ap.add_argument("--plugin-dir", default=os.environ.get("KTPU_PLUGIN_DIR", "/var/lib/ktpu/device-plugins"))
+    args = ap.parse_args()
+    server = run_plugin(args.plugin_dir)
+    n = len(server.impl.devices)
+    print(f"tpu device plugin: advertising {n} chip(s) at {server.socket_path}", flush=True)
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .kubelet import Kubelet
+from .runtime import FakeRuntime, ProcessRuntime, RuntimeService, ContainerConfig
+from .devicemanager import DeviceManager

@@ -1,0 +1,53 @@
+"""Standalone kubelet entrypoint (ref: cmd/kubelet).
+
+    python -m kubernetes1_tpu.kubelet --server http://127.0.0.1:8001 \
+        --node-name $(hostname) --runtime process --plugin-dir /var/lib/ktpu/device-plugins
+"""
+
+import argparse
+import signal
+import threading
+
+from ..client import Clientset
+from .kubelet import Kubelet
+from .runtime import FakeRuntime, ProcessRuntime
+
+
+def main():
+    ap = argparse.ArgumentParser(description="ktpu kubelet")
+    ap.add_argument("--server", default="http://127.0.0.1:8001")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--node-name", default="node-0")
+    ap.add_argument("--runtime", choices=["process", "fake"], default="process")
+    ap.add_argument("--plugin-dir", default="/var/lib/ktpu/device-plugins")
+    ap.add_argument("--static-pod-dir", default="")
+    ap.add_argument("--root-dir", default="/tmp/ktpu")
+    ap.add_argument("--label", action="append", default=[], help="k=v node label")
+    args = ap.parse_args()
+
+    cs = Clientset(args.server, token=args.token)
+    runtime = (
+        ProcessRuntime(root_dir=args.root_dir)
+        if args.runtime == "process"
+        else FakeRuntime()
+    )
+    labels = dict(kv.split("=", 1) for kv in args.label)
+    kubelet = Kubelet(
+        cs,
+        node_name=args.node_name,
+        runtime=runtime,
+        plugin_dir=args.plugin_dir,
+        static_pod_dir=args.static_pod_dir or None,
+        node_labels=labels,
+    )
+    kubelet.start()
+    print(f"kubelet {args.node_name} running ({args.runtime} runtime)", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    kubelet.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,628 @@
+"""Kubelet: the per-node agent realizing bound pods into running containers.
+
+Ref: pkg/kubelet/kubelet.go — Run (:1361) starts the status/heartbeat loops,
+PLEG and syncLoop (:1772/:1839); per-pod workers (pod_workers.go); syncPod
+(:1441) = admission -> sandbox -> containers -> status.  The TPU path
+threads through the device manager exactly where the fork put it:
+AdmitPod at pod admission (container_manager_linux.go:619-621) and
+InitContainer before each container start (kubelet_pods.go:468 ->
+GenerateRunContainerOptions).
+
+Structure here:
+- pod source = apiserver informer filtered to spec.nodeName==<me> plus an
+  optional static-manifest directory (ref: config/apiserver.go, file source);
+- a work queue of pod keys drives N sync workers; PLEG (1s relist) and a
+  periodic ticker both enqueue;
+- status truth flows one way: runtime state -> computed PodStatus -> status
+  subresource PUT when changed (status_manager.go:131,399).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..client import Clientset, EventRecorder, SharedInformer
+from ..machinery import ApiError, Conflict, NotFound, now_iso
+from ..machinery.scheme import from_dict, global_scheme
+from ..utils.workqueue import WorkQueue
+from .devicemanager import DeviceManager
+from .runtime import (
+    CONTAINER_EXITED,
+    CONTAINER_RUNNING,
+    ContainerConfig,
+    RuntimeService,
+)
+
+DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
+
+
+class Kubelet:
+    def __init__(
+        self,
+        clientset: Clientset,
+        node_name: str,
+        runtime: RuntimeService,
+        plugin_dir: str = DEFAULT_PLUGIN_DIR,
+        static_pod_dir: Optional[str] = None,
+        node_labels: Optional[Dict[str, str]] = None,
+        capacity: Optional[Dict[str, str]] = None,
+        heartbeat_interval: float = 5.0,
+        sync_interval: float = 1.0,
+        pleg_interval: float = 1.0,
+        restart_backoff_base: float = 1.0,
+        sync_workers: int = 4,
+    ):
+        self.cs = clientset
+        self.node_name = node_name
+        self.runtime = runtime
+        self.device_manager = DeviceManager(plugin_dir)
+        self.device_manager.on_capacity_change = self._heartbeat_now
+        self.static_pod_dir = static_pod_dir
+        self.node_labels = node_labels or {}
+        self.capacity = capacity or self._default_capacity()
+        self.heartbeat_interval = heartbeat_interval
+        self.sync_interval = sync_interval
+        self.pleg_interval = pleg_interval
+        self.restart_backoff_base = restart_backoff_base
+        self.sync_workers = sync_workers
+        self.recorder = EventRecorder(clientset, f"kubelet/{node_name}")
+
+        self.pods = SharedInformer(
+            clientset.pods, field_selector=f"spec.nodeName={node_name}"
+        )
+        self._queue = WorkQueue()
+        self._sandboxes: Dict[str, str] = {}  # pod uid -> sandbox id
+        self._containers: Dict[Tuple[str, str], str] = {}  # (uid, cname) -> cid
+        self._restart_at: Dict[Tuple[str, str], float] = {}
+        self._restarts: Dict[Tuple[str, str], int] = {}
+        self._admitted: Dict[str, Tuple[str, str]] = {}
+        self._admit_first_seen: Dict[str, float] = {}
+        self._last_status: Dict[str, dict] = {}  # uid -> last PUT status dict
+        self._pleg_state: Dict[str, str] = {}
+        self._heartbeat_event = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.RLock()
+
+    # ---------------------------------------------------------------- start
+
+    @staticmethod
+    def _default_capacity() -> Dict[str, str]:
+        cpus = os.cpu_count() or 4
+        mem_kb = 8 * 1024 * 1024
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        mem_kb = int(line.split()[1])
+                        break
+        except OSError:
+            pass
+        return {"cpu": str(cpus), "memory": f"{mem_kb}Ki", "pods": "110"}
+
+    def start(self):
+        self.device_manager.start()
+        self._reconcile_runtime()
+        self._register_node()
+        self.pods.add_handler(
+            on_add=lambda p: self._enqueue(p),
+            on_update=lambda _o, p: self._enqueue(p),
+            on_delete=lambda p: self._enqueue(p, deleted=True),
+        )
+        self.pods.start()
+        self.pods.wait_for_sync()
+        if self.static_pod_dir:
+            self._load_static_pods()
+        for i in range(self.sync_workers):
+            th = threading.Thread(target=self._sync_worker, daemon=True, name=f"sync-{i}")
+            th.start()
+            self._threads.append(th)
+        for fn, period, name in (
+            (self._heartbeat, self.heartbeat_interval, "heartbeat"),
+            (self._pleg_relist, self.pleg_interval, "pleg"),
+            (self._tick_all, self.sync_interval, "sync-ticker"),
+        ):
+            th = threading.Thread(
+                target=self._loop, args=(fn, period), daemon=True, name=name
+            )
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._queue.shut_down()
+        self.pods.stop()
+        self.device_manager.stop()
+
+    def _loop(self, fn, period: float):
+        while not self._stop.is_set():
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            if fn is self._heartbeat:
+                # wake immediately on capacity change
+                self._heartbeat_event.wait(period)
+                self._heartbeat_event.clear()
+            else:
+                self._stop.wait(period)
+
+    def _heartbeat_now(self):
+        self._heartbeat_event.set()
+
+    def _reconcile_runtime(self):
+        """Adopt pre-existing runtime state after a kubelet restart: rebuild
+        the sandbox/container maps from the runtime's own records so running
+        workloads are NOT duplicated (the reference kubelet rebuilds from the
+        CRI the same way; restart-safety e2e relies on this)."""
+        sandbox_by_uid: Dict[str, str] = {}
+        for sb in self.runtime.list_pod_sandboxes():
+            uid = sb.labels.get("pod-uid") or sb.pod_uid
+            if uid:
+                sandbox_by_uid[uid] = sb.id
+        sandbox_to_uid = {sid: uid for uid, sid in sandbox_by_uid.items()}
+        containers: Dict[Tuple[str, str], str] = {}
+        for c in self.runtime.list_containers():
+            uid = sandbox_to_uid.get(c.sandbox_id)
+            if uid is None:
+                continue
+            ckey = (uid, c.name)
+            prev = containers.get(ckey)
+            if prev is None:
+                containers[ckey] = c.id
+            else:
+                # prefer the running record over exited leftovers
+                prev_rec = self.runtime.container_status(prev)
+                if prev_rec is None or prev_rec.state != CONTAINER_RUNNING:
+                    containers[ckey] = c.id
+        with self._lock:
+            self._sandboxes.update(sandbox_by_uid)
+            self._containers.update(containers)
+
+    # ----------------------------------------------------------- node status
+
+    def _node_object(self) -> t.Node:
+        node = t.Node()
+        node.metadata.name = self.node_name
+        node.metadata.labels = {
+            "kubernetes.io/hostname": self.node_name,
+            **self.node_labels,
+        }
+        self._fill_status(node)
+        return node
+
+    def _fill_status(self, node: t.Node):
+        node.status.capacity = dict(self.capacity)
+        node.status.allocatable = dict(self.capacity)
+        now = now_iso()
+        node.status.conditions = [
+            t.NodeCondition(
+                type=t.NODE_READY,
+                status="True",
+                reason="KubeletReady",
+                last_heartbeat_time=now,
+            )
+        ]
+        node.status.addresses = [t.NodeAddress(type="Hostname", address=self.node_name)]
+        node.status.node_info = t.NodeSystemInfo(
+            kubelet_version="ktpu-0.1",
+            container_runtime_version=self.runtime.version(),
+            architecture=os.uname().machine,
+            os_image="linux",
+        )
+        node.status.extended_resources = self.device_manager.get_capacity()
+
+    def _register_node(self):
+        node = self._node_object()
+        try:
+            self.cs.nodes.create(node)
+        except ApiError:
+            pass  # exists: heartbeat will refresh status
+
+    def _heartbeat(self):
+        """10s-class syncNodeStatus (ref: kubelet_node_status.go:545-621)."""
+        try:
+            node = self.cs.nodes.get(self.node_name, "")
+        except NotFound:
+            self._register_node()
+            return
+        self._fill_status(node)
+        try:
+            self.cs.nodes.update_status(node)
+        except Conflict:
+            pass  # next beat wins
+
+    # ------------------------------------------------------------ pod source
+
+    def _enqueue(self, pod: t.Pod, deleted: bool = False):
+        self._queue.add(pod.key())
+
+    def _load_static_pods(self):
+        """File source (ref: kubelet.go:277-321): manifests in a directory
+        become pods bound to this node — how control-plane self-hosting runs."""
+        import yaml
+
+        for fname in sorted(os.listdir(self.static_pod_dir)):
+            if not fname.endswith((".json", ".yaml", ".yml")):
+                continue
+            path = os.path.join(self.static_pod_dir, fname)
+            try:
+                with open(path) as f:
+                    data = yaml.safe_load(f) if fname.endswith((".yaml", ".yml")) else json.load(f)
+                pod = global_scheme.decode(data)
+                pod.spec.node_name = self.node_name
+                pod.metadata.annotations["kubelet.ktpu.io/static"] = "true"
+                try:
+                    self.cs.pods.create(pod)
+                except ApiError:
+                    pass  # already mirrored
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _tick_all(self):
+        for pod in self.pods.list():
+            self._queue.add(pod.key())
+
+    # ----------------------------------------------------------------- PLEG
+
+    def _pleg_relist(self):
+        """1s relist-and-diff (ref: pleg/generic.go:182): container state
+        changes enqueue their pod for sync."""
+        current: Dict[str, str] = {}
+        sandbox_pod: Dict[str, str] = {}
+        for sb in self.runtime.list_pod_sandboxes():
+            sandbox_pod[sb.id] = f"{sb.pod_namespace}/{sb.pod_name}"
+        for c in self.runtime.list_containers():
+            current[c.id] = c.state
+            old = self._pleg_state.get(c.id)
+            if old != c.state:
+                pod_key = sandbox_pod.get(c.sandbox_id)
+                if pod_key:
+                    self._queue.add(pod_key)
+        self._pleg_state = current
+
+    # --------------------------------------------------------- sync workers
+
+    def _sync_worker(self):
+        while not self._stop.is_set():
+            key = self._queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                pod = self.pods.get(key)
+                if pod is None:
+                    self._cleanup_missing(key)
+                else:
+                    self.sync_pod(pod)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            finally:
+                self._queue.done(key)
+
+    def _cleanup_missing(self, key: str):
+        """Pod vanished from the API: tear down any leftover runtime state."""
+        ns, name = key.split("/", 1)
+        for sb in self.runtime.list_pod_sandboxes():
+            if sb.pod_namespace == ns and sb.pod_name == name:
+                self.runtime.remove_pod_sandbox(sb.id)
+                with self._lock:
+                    self._sandboxes.pop(sb.pod_uid, None)
+                    for k in [k for k in self._containers if k[0] == sb.pod_uid]:
+                        self._containers.pop(k, None)
+                self.device_manager.forget_pod(sb.pod_uid)
+                self._prune_pod_state(sb.pod_uid)
+
+    # -------------------------------------------------------------- syncPod
+
+    def sync_pod(self, pod: t.Pod):
+        """ref: kubelet.go:1441 syncPod."""
+        uid = pod.metadata.uid
+        if pod.metadata.deletion_timestamp:
+            self._terminate_pod(pod)
+            return
+        if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+            self._ensure_stopped(pod)
+            return
+
+        verdict, reason = self._admit(pod)
+        if verdict == "fail":
+            self._set_failed(pod, "AdmissionError", reason)
+            return
+        if verdict == "wait":
+            return  # infrastructure warming up; sync ticker retries
+
+        sandbox_id = self._ensure_sandbox(pod)
+        self._sync_containers(pod, sandbox_id)
+        self._sync_status(pod)
+
+    ADMISSION_GRACE_SECONDS = 30.0
+
+    def _admit(self, pod: t.Pod) -> Tuple[str, str]:
+        """Returns ('ok'|'wait'|'fail', reason).  Retriable denials (device
+        manager warming up after kubelet/plugin restart) wait up to
+        ADMISSION_GRACE_SECONDS before failing the pod."""
+        uid = pod.metadata.uid
+        with self._lock:
+            cached = self._admitted.get(uid)
+        if cached is not None:
+            return cached
+        result = self.device_manager.admit_pod(pod)
+        if result.allowed:
+            with self._lock:
+                self._admitted[uid] = ("ok", "")
+            return "ok", ""
+        if result.retriable:
+            with self._lock:
+                first = self._admit_first_seen.setdefault(uid, time.monotonic())
+            if time.monotonic() - first < self.ADMISSION_GRACE_SECONDS:
+                return "wait", result.reason
+        self.recorder.event(pod, "Warning", "AdmissionError", result.reason)
+        with self._lock:
+            self._admitted[uid] = ("fail", result.reason)
+        return "fail", result.reason
+
+    def _ensure_sandbox(self, pod: t.Pod) -> str:
+        uid = pod.metadata.uid
+        with self._lock:
+            sid = self._sandboxes.get(uid)
+        if sid is not None:
+            return sid
+        sid = self.runtime.run_pod_sandbox(
+            pod.metadata.name, pod.metadata.namespace, uid,
+            labels={"pod-uid": uid},
+        )
+        with self._lock:
+            self._sandboxes[uid] = sid
+        return sid
+
+    def _container_config(self, pod: t.Pod, container: t.Container) -> ContainerConfig:
+        """GenerateRunContainerOptions (ref kubelet_pods.go:468): pod env +
+        device-plugin injection merged into the CRI config."""
+        env = {e.name: e.value for e in container.env}
+        devices, mounts, annotations = [], [], {}
+        spec = self.device_manager.init_container(pod, container)
+        env.update(spec.envs)
+        devices = [vars(d) for d in spec.devices]
+        mounts = [vars(m) for m in spec.mounts]
+        annotations = dict(spec.annotations)
+        return ContainerConfig(
+            name=container.name,
+            image=container.image,
+            command=list(container.command),
+            args=list(container.args),
+            env=env,
+            working_dir=container.working_dir,
+            devices=devices,
+            mounts=mounts,
+            annotations=annotations,
+        )
+
+    def _sync_containers(self, pod: t.Pod, sandbox_id: str):
+        uid = pod.metadata.uid
+        for container in pod.spec.containers:
+            ckey = (uid, container.name)
+            with self._lock:
+                cid = self._containers.get(ckey)
+            record = self.runtime.container_status(cid) if cid else None
+            if record is not None and record.state == CONTAINER_RUNNING:
+                continue
+            if record is not None and record.state == CONTAINER_EXITED:
+                if not self._should_restart(pod, record.exit_code):
+                    continue
+                now = time.monotonic()
+                with self._lock:
+                    n = self._restarts.get(ckey, 0)
+                    next_at = self._restart_at.get(ckey, 0.0)
+                if now < next_at:
+                    continue  # backoff; ticker retries
+                with self._lock:
+                    self._restarts[ckey] = n + 1
+                    self._restart_at[ckey] = now + min(
+                        self.restart_backoff_base * (2**n), 300.0
+                    )
+                self.runtime.remove_container(record.id)
+                self.recorder.event(
+                    pod, "Normal", "Restarting",
+                    f"container {container.name} exited {record.exit_code}; restarting",
+                )
+            # create + start (start failures back off like crash restarts and
+            # must not leak the half-created container record)
+            with self._lock:
+                if time.monotonic() < self._restart_at.get(ckey, 0.0):
+                    continue
+            cid = None
+            try:
+                config = self._container_config(pod, container)
+                if hasattr(self.runtime, "images"):
+                    self.runtime.images.pull_image(container.image)
+                cid = self.runtime.create_container(sandbox_id, config)
+                self.runtime.start_container(cid)
+                with self._lock:
+                    self._containers[ckey] = cid
+                self.recorder.event(
+                    pod, "Normal", "Started", f"container {container.name} started"
+                )
+            except Exception as e:  # noqa: BLE001
+                if cid is not None:
+                    try:
+                        self.runtime.remove_container(cid)
+                    except Exception:  # noqa: BLE001
+                        pass
+                with self._lock:
+                    n = self._restarts.get(ckey, 0)
+                    self._restarts[ckey] = n + 1
+                    self._restart_at[ckey] = time.monotonic() + min(
+                        self.restart_backoff_base * (2**n), 300.0
+                    )
+                self.recorder.event(
+                    pod, "Warning", "FailedStart",
+                    f"container {container.name}: {e}",
+                )
+
+    @staticmethod
+    def _should_restart(pod: t.Pod, exit_code: Optional[int]) -> bool:
+        policy = pod.spec.restart_policy
+        if policy == "Always":
+            return True
+        if policy == "OnFailure":
+            return exit_code not in (0, None)
+        return False
+
+    # ------------------------------------------------------------- teardown
+
+    def _terminate_pod(self, pod: t.Pod):
+        """Graceful deletion: stop containers, remove sandbox, then force
+        delete so the API object goes away (the reference's kubelet sends
+        the final grace-0 delete)."""
+        uid = pod.metadata.uid
+        with self._lock:
+            sid = self._sandboxes.get(uid)
+        if sid is not None:
+            self.runtime.stop_pod_sandbox(sid)
+            self.runtime.remove_pod_sandbox(sid)
+            with self._lock:
+                self._sandboxes.pop(uid, None)
+                for k in [k for k in self._containers if k[0] == uid]:
+                    self._containers.pop(k, None)
+        self.device_manager.forget_pod(uid)
+        self._prune_pod_state(uid)
+        try:
+            self.cs.pods.delete(
+                pod.metadata.name, pod.metadata.namespace, grace_seconds=0
+            )
+        except ApiError:
+            pass
+
+    def _prune_pod_state(self, uid: str):
+        """Drop every per-pod bookkeeping entry (unbounded growth otherwise
+        under Job-style pod churn)."""
+        with self._lock:
+            self._admitted.pop(uid, None)
+            self._admit_first_seen.pop(uid, None)
+            self._last_status.pop(uid, None)
+            for k in [k for k in self._restarts if k[0] == uid]:
+                self._restarts.pop(k, None)
+            for k in [k for k in self._restart_at if k[0] == uid]:
+                self._restart_at.pop(k, None)
+
+    def _ensure_stopped(self, pod: t.Pod):
+        uid = pod.metadata.uid
+        with self._lock:
+            sid = self._sandboxes.get(uid)
+        if sid is not None:
+            self.runtime.stop_pod_sandbox(sid)
+
+    def _set_failed(self, pod: t.Pod, reason: str, message: str):
+        fresh = global_scheme.deepcopy(pod)
+        fresh.status.phase = t.POD_FAILED
+        fresh.status.reason = reason
+        fresh.status.message = message
+        try:
+            self.cs.pods.update_status(fresh)
+        except ApiError:
+            pass
+
+    # --------------------------------------------------------------- status
+
+    def _compute_status(self, pod: t.Pod) -> t.PodStatus:
+        uid = pod.metadata.uid
+        status = t.PodStatus()
+        status.host_ip = self.node_name
+        status.pod_ip = "127.0.0.1"
+        status.start_time = pod.status.start_time or now_iso()
+        statuses: List[t.ContainerStatus] = []
+        running = exited_ok = exited_bad = waiting = 0
+        for container in pod.spec.containers:
+            ckey = (uid, container.name)
+            with self._lock:
+                cid = self._containers.get(ckey)
+                restarts = self._restarts.get(ckey, 0)
+            record = self.runtime.container_status(cid) if cid else None
+            cs = t.ContainerStatus(
+                name=container.name, image=container.image, restart_count=restarts
+            )
+            if record is None:
+                waiting += 1
+                cs.state.waiting = t.ContainerStateWaiting(reason="ContainerCreating")
+            elif record.state == CONTAINER_RUNNING:
+                running += 1
+                cs.ready = True
+                cs.container_id = record.id
+                cs.state.running = t.ContainerStateRunning(
+                    started_at=_iso(record.started_at)
+                )
+            elif record.state == CONTAINER_EXITED:
+                cs.container_id = record.id
+                cs.state.terminated = t.ContainerStateTerminated(
+                    exit_code=record.exit_code or 0,
+                    reason="Completed" if record.exit_code == 0 else "Error",
+                    started_at=_iso(record.started_at),
+                    finished_at=_iso(record.finished_at),
+                )
+                if record.exit_code == 0:
+                    exited_ok += 1
+                else:
+                    exited_bad += 1
+            else:
+                waiting += 1
+                cs.state.waiting = t.ContainerStateWaiting(reason="Created")
+            statuses.append(cs)
+        status.container_statuses = statuses
+        total = len(pod.spec.containers)
+        policy = pod.spec.restart_policy
+        if running == total and total > 0:
+            status.phase = t.POD_RUNNING
+        elif exited_ok == total and policy != "Always":
+            status.phase = t.POD_SUCCEEDED
+        elif exited_bad > 0 and policy == "Never":
+            status.phase = t.POD_FAILED
+        elif running > 0:
+            status.phase = t.POD_RUNNING
+        else:
+            status.phase = t.POD_PENDING
+        ready = all(c.ready for c in statuses) and status.phase == t.POD_RUNNING
+        status.conditions = [
+            t.PodCondition(
+                type="Ready",
+                status="True" if ready else "False",
+                last_transition_time=now_iso(),
+            ),
+            t.PodCondition(type="PodScheduled", status="True"),
+        ]
+        return status
+
+    def _sync_status(self, pod: t.Pod):
+        """statusManager syncBatch (ref status_manager.go:399): PUT only on
+        change (conditions' timestamps excluded from the comparison)."""
+        status = self._compute_status(pod)
+        from ..machinery.scheme import to_dict
+
+        desired = to_dict(status)
+        comparable = json.dumps(
+            {k: v for k, v in desired.items() if k != "conditions"}, sort_keys=True
+        )
+        uid = pod.metadata.uid
+        with self._lock:
+            if self._last_status.get(uid) == comparable:
+                return
+        fresh = global_scheme.deepcopy(pod)
+        fresh.status = status
+        try:
+            self.cs.pods.update_status(fresh)
+            with self._lock:
+                self._last_status[uid] = comparable
+        except NotFound:
+            pass
+        except ApiError:
+            traceback.print_exc()
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)) if ts else ""

@@ -1,0 +1,409 @@
+"""Container runtime: the CRI seam (ref: pkg/kubelet/apis/cri/v1alpha1/
+runtime/api.proto RuntimeService/ImageService, dockershim server,
+pkg/kubelet/remote client).
+
+Two implementations, both behind the same interface the kubelet consumes:
+
+- ProcessRuntime — containers are host subprocesses.  This is the
+  TPU-native answer for this environment (no dockerd in the image): the
+  "image" is advisory, the command runs directly with the ContainerSpec's
+  injected env (TPU_VISIBLE_CHIPS etc.), logs stream to per-container
+  files.  A real JAX training process on the real TPU chip runs this way.
+- FakeRuntime — the kubemark hollow runtime (ref: pkg/kubemark/
+  hollow_kubelet.go + libdocker/fake_client.go): containers are in-memory
+  records with scriptable exit behavior, enabling 1000-node scale tests
+  with zero real processes.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+CONTAINER_CREATED = "CREATED"
+CONTAINER_RUNNING = "RUNNING"
+CONTAINER_EXITED = "EXITED"
+
+
+@dataclass
+class SandboxRecord:
+    id: str
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    state: str = SANDBOX_READY
+    created_at: float = field(default_factory=time.time)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerConfig:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    working_dir: str = ""
+    devices: List[dict] = field(default_factory=list)
+    mounts: List[dict] = field(default_factory=list)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerRecord:
+    id: str
+    sandbox_id: str
+    name: str
+    image: str
+    state: str = CONTAINER_CREATED
+    exit_code: Optional[int] = None
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    restart_count: int = 0
+    log_path: str = ""
+
+
+class RuntimeService:
+    """The interface the kubelet drives (20-RPC RuntimeService condensed to
+    the calls the sync loop actually needs)."""
+
+    def version(self) -> str:
+        raise NotImplementedError
+
+    def run_pod_sandbox(self, pod_name, pod_namespace, pod_uid, labels=None) -> str:
+        raise NotImplementedError
+
+    def stop_pod_sandbox(self, sandbox_id: str):
+        raise NotImplementedError
+
+    def remove_pod_sandbox(self, sandbox_id: str):
+        raise NotImplementedError
+
+    def list_pod_sandboxes(self) -> List[SandboxRecord]:
+        raise NotImplementedError
+
+    def create_container(self, sandbox_id: str, config: ContainerConfig) -> str:
+        raise NotImplementedError
+
+    def start_container(self, container_id: str):
+        raise NotImplementedError
+
+    def stop_container(self, container_id: str, timeout: float = 10.0):
+        raise NotImplementedError
+
+    def remove_container(self, container_id: str):
+        raise NotImplementedError
+
+    def list_containers(self) -> List[ContainerRecord]:
+        raise NotImplementedError
+
+    def container_status(self, container_id: str) -> Optional[ContainerRecord]:
+        raise NotImplementedError
+
+    def read_log(self, container_id: str, tail: int = 0) -> str:
+        return ""
+
+
+class ImageService:
+    """ref: api.proto ImageService (5 RPCs) — advisory here."""
+
+    def __init__(self):
+        self._images: set = set()
+
+    def pull_image(self, image: str) -> str:
+        self._images.add(image)
+        return image
+
+    def list_images(self) -> List[str]:
+        return sorted(self._images)
+
+    def image_present(self, image: str) -> bool:
+        return image in self._images
+
+
+# ------------------------------------------------------------ fake runtime
+
+
+class FakeRuntime(RuntimeService):
+    """Hollow runtime.  Containers run forever unless the config's command
+    is ["sleep", "N"]-shaped or env KTPU_FAKE_EXIT_AFTER/_CODE is set, in
+    which case they exit after N seconds with the given code."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sandboxes: Dict[str, SandboxRecord] = {}
+        self._containers: Dict[str, ContainerRecord] = {}
+        self._exit_plans: Dict[str, tuple] = {}  # cid -> (deadline, code)
+        self.images = ImageService()
+
+    def version(self) -> str:
+        return "fake://0.1"
+
+    def run_pod_sandbox(self, pod_name, pod_namespace, pod_uid, labels=None) -> str:
+        sid = f"sbx-{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._sandboxes[sid] = SandboxRecord(
+                id=sid, pod_name=pod_name, pod_namespace=pod_namespace,
+                pod_uid=pod_uid, labels=labels or {},
+            )
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str):
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            if sb:
+                sb.state = SANDBOX_NOTREADY
+            for c in self._containers.values():
+                if c.sandbox_id == sandbox_id and c.state == CONTAINER_RUNNING:
+                    self._finish(c, 137)
+
+    def remove_pod_sandbox(self, sandbox_id: str):
+        with self._lock:
+            self._sandboxes.pop(sandbox_id, None)
+            for cid in [c.id for c in self._containers.values() if c.sandbox_id == sandbox_id]:
+                self._containers.pop(cid, None)
+
+    def list_pod_sandboxes(self) -> List[SandboxRecord]:
+        with self._lock:
+            return list(self._sandboxes.values())
+
+    def create_container(self, sandbox_id: str, config: ContainerConfig) -> str:
+        cid = f"ctr-{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            if sandbox_id not in self._sandboxes:
+                raise KeyError(f"sandbox {sandbox_id} not found")
+            self._containers[cid] = ContainerRecord(
+                id=cid, sandbox_id=sandbox_id, name=config.name, image=config.image
+            )
+            plan = self._plan_exit(config)
+            if plan:
+                self._exit_plans[cid] = plan
+        return cid
+
+    @staticmethod
+    def _plan_exit(config: ContainerConfig):
+        if "KTPU_FAKE_EXIT_AFTER" in config.env:
+            return (
+                float(config.env["KTPU_FAKE_EXIT_AFTER"]),
+                int(config.env.get("KTPU_FAKE_EXIT_CODE", "0")),
+            )
+        cmd = (config.command or []) + (config.args or [])
+        if len(cmd) == 2 and cmd[0] == "sleep":
+            try:
+                return (float(cmd[1]), 0)
+            except ValueError:
+                return None
+        return None
+
+    def start_container(self, container_id: str):
+        with self._lock:
+            c = self._containers[container_id]
+            c.state = CONTAINER_RUNNING
+            c.started_at = time.time()
+            plan = self._exit_plans.get(container_id)
+        if plan:
+            delay, code = plan
+            timer = threading.Timer(delay, self._timed_exit, args=(container_id, code))
+            timer.daemon = True
+            timer.start()
+
+    def _timed_exit(self, container_id: str, code: int):
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c and c.state == CONTAINER_RUNNING:
+                self._finish(c, code)
+
+    def _finish(self, c: ContainerRecord, code: int):
+        c.state = CONTAINER_EXITED
+        c.exit_code = code
+        c.finished_at = time.time()
+
+    def stop_container(self, container_id: str, timeout: float = 10.0):
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c and c.state == CONTAINER_RUNNING:
+                self._finish(c, 137)
+
+    def remove_container(self, container_id: str):
+        with self._lock:
+            self._containers.pop(container_id, None)
+            self._exit_plans.pop(container_id, None)
+
+    def list_containers(self) -> List[ContainerRecord]:
+        with self._lock:
+            return list(self._containers.values())
+
+    def container_status(self, container_id: str) -> Optional[ContainerRecord]:
+        with self._lock:
+            return self._containers.get(container_id)
+
+
+# --------------------------------------------------------- process runtime
+
+
+class ProcessRuntime(RuntimeService):
+    """Containers as host subprocesses (TPU-native local runtime).
+
+    Sandbox = a log/working directory; container = a subprocess whose env is
+    the merged pod env + device-plugin injection.  SIGTERM then SIGKILL on
+    stop, honoring the grace timeout.
+    """
+
+    def __init__(self, root_dir: str = "/tmp/ktpu"):
+        self.root = root_dir
+        os.makedirs(os.path.join(self.root, "logs"), exist_ok=True)
+        self._lock = threading.RLock()
+        self._sandboxes: Dict[str, SandboxRecord] = {}
+        self._containers: Dict[str, ContainerRecord] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._configs: Dict[str, ContainerConfig] = {}
+        self.images = ImageService()
+
+    def version(self) -> str:
+        return "process://0.1"
+
+    def run_pod_sandbox(self, pod_name, pod_namespace, pod_uid, labels=None) -> str:
+        sid = f"sbx-{uuid.uuid4().hex[:12]}"
+        os.makedirs(os.path.join(self.root, "logs", sid), exist_ok=True)
+        with self._lock:
+            self._sandboxes[sid] = SandboxRecord(
+                id=sid, pod_name=pod_name, pod_namespace=pod_namespace,
+                pod_uid=pod_uid, labels=labels or {},
+            )
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str):
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            if sb:
+                sb.state = SANDBOX_NOTREADY
+            cids = [c.id for c in self._containers.values() if c.sandbox_id == sandbox_id]
+        for cid in cids:
+            self.stop_container(cid, timeout=2.0)
+
+    def remove_pod_sandbox(self, sandbox_id: str):
+        self.stop_pod_sandbox(sandbox_id)
+        with self._lock:
+            self._sandboxes.pop(sandbox_id, None)
+            for cid in [c.id for c in self._containers.values() if c.sandbox_id == sandbox_id]:
+                self._containers.pop(cid, None)
+                self._procs.pop(cid, None)
+                self._configs.pop(cid, None)
+
+    def list_pod_sandboxes(self) -> List[SandboxRecord]:
+        with self._lock:
+            return list(self._sandboxes.values())
+
+    def create_container(self, sandbox_id: str, config: ContainerConfig) -> str:
+        cid = f"ctr-{uuid.uuid4().hex[:12]}"
+        log_path = os.path.join(self.root, "logs", sandbox_id, f"{config.name}-{cid}.log")
+        with self._lock:
+            if sandbox_id not in self._sandboxes:
+                raise KeyError(f"sandbox {sandbox_id} not found")
+            self._containers[cid] = ContainerRecord(
+                id=cid, sandbox_id=sandbox_id, name=config.name,
+                image=config.image, log_path=log_path,
+            )
+            self._configs[cid] = config
+        return cid
+
+    def start_container(self, container_id: str):
+        with self._lock:
+            c = self._containers[container_id]
+            config = self._configs[container_id]
+        cmd = list(config.command or [])
+        if not cmd:
+            raise ValueError(f"container {config.name}: command required for process runtime")
+        cmd += list(config.args or [])
+        env = dict(os.environ)
+        env.update(config.env)
+        logf = open(c.log_path, "ab")
+        proc = subprocess.Popen(
+            cmd,
+            env=env,
+            cwd=config.working_dir or None,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,  # isolate signals from the kubelet
+        )
+        with self._lock:
+            self._procs[container_id] = proc
+            c.state = CONTAINER_RUNNING
+            c.started_at = time.time()
+
+    def _reap(self, c: ContainerRecord):
+        proc = self._procs.get(c.id)
+        if proc is None:
+            return
+        code = proc.poll()
+        if code is not None and c.state == CONTAINER_RUNNING:
+            c.state = CONTAINER_EXITED
+            c.exit_code = code
+            c.finished_at = time.time()
+
+    def stop_container(self, container_id: str, timeout: float = 10.0):
+        with self._lock:
+            c = self._containers.get(container_id)
+            proc = self._procs.get(container_id)
+        if c is None or proc is None:
+            return
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+        with self._lock:
+            self._reap(c)
+            if c.state == CONTAINER_RUNNING:  # defensive
+                c.state = CONTAINER_EXITED
+                c.exit_code = proc.returncode
+                c.finished_at = time.time()
+
+    def remove_container(self, container_id: str):
+        self.stop_container(container_id, timeout=2.0)
+        with self._lock:
+            self._containers.pop(container_id, None)
+            self._procs.pop(container_id, None)
+            self._configs.pop(container_id, None)
+
+    def list_containers(self) -> List[ContainerRecord]:
+        with self._lock:
+            for c in self._containers.values():
+                self._reap(c)
+            return list(self._containers.values())
+
+    def container_status(self, container_id: str) -> Optional[ContainerRecord]:
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c:
+                self._reap(c)
+            return c
+
+    def read_log(self, container_id: str, tail: int = 0) -> str:
+        with self._lock:
+            c = self._containers.get(container_id)
+        if c is None or not os.path.exists(c.log_path):
+            return ""
+        with open(c.log_path, "r", errors="replace") as f:
+            lines = f.readlines()
+        if tail:
+            lines = lines[-tail:]
+        return "".join(lines)

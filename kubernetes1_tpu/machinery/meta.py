@@ -18,6 +18,26 @@ def now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def now_iso_micro() -> str:
+    """MicroTime (ref: meta/v1 MicroTime) — leases need sub-second
+    resolution or short lease durations fall below timestamp granularity."""
+    now = time.time()
+    frac = int((now % 1) * 1_000_000)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now)) + f".{frac:06d}Z"
+
+
+def parse_iso(ts: str) -> float:
+    """Parse either second- or microsecond-resolution UTC timestamps."""
+    import calendar
+
+    if "." in ts:
+        base, frac = ts.rstrip("Z").split(".", 1)
+        return calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S")) + float(
+            "0." + frac
+        )
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+
+
 def new_uid() -> str:
     return str(uuid.uuid4())
 

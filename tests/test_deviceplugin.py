@@ -1,0 +1,222 @@
+"""Device-plugin protocol + manager tests against an in-process plugin
+served over a real unix socket (the reference's device_plugin_stub.go
+pattern: real sockets, real streams, scriptable behavior)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.deviceplugin.api import (
+    ContainerSpec,
+    PluginClient,
+    PluginServer,
+    plugin_socket_path,
+    resource_from_socket,
+)
+from kubernetes1_tpu.deviceplugin.tpu_plugin import (
+    ANN_COORDINATOR,
+    ANN_WORKER_ID,
+    TPUDevicePlugin,
+    _fake_devices,
+)
+from kubernetes1_tpu.kubelet.devicemanager import DeviceManager
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+
+
+@pytest.fixture()
+def plugin_dir(tmp_path):
+    return str(tmp_path / "plugins")
+
+
+@pytest.fixture()
+def served_plugin(plugin_dir):
+    impl = TPUDevicePlugin(devices=_fake_devices("v5e:4:s0:0"))
+    server = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+    server.start()
+    yield impl, server, plugin_dir
+    server.stop()
+
+
+class TestProtocol:
+    def test_socket_path_layout(self, plugin_dir):
+        p = plugin_socket_path(plugin_dir, "google.com/tpu")
+        assert p.endswith("google.com/tpu.sock")
+        assert resource_from_socket(plugin_dir, p) == "google.com/tpu"
+        assert resource_from_socket(plugin_dir, plugin_dir + "/junk") is None
+
+    def test_get_plugin_info(self, served_plugin):
+        impl, server, _ = served_plugin
+        client = PluginClient(server.socket_path)
+        info = client.call("GetPluginInfo")
+        assert info["name"] == "google.com/tpu"
+        assert info["device_count"] == 4
+        client.close()
+
+    def test_list_and_watch_streams_updates(self, served_plugin):
+        impl, server, _ = served_plugin
+        client = PluginClient(server.socket_path)
+        frames = []
+        stream = client.list_and_watch()
+
+        def consume():
+            for devices in stream:
+                frames.append(devices)
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        must_poll_until(lambda: len(frames) >= 1, desc="initial frame")
+        assert len(frames[0]) == 4
+        impl.set_health("s0-h0-chip0", t.DEVICE_UNHEALTHY)
+        must_poll_until(lambda: len(frames) >= 2, desc="health update frame")
+        sick = [d for d in frames[-1] if d["id"] == "s0-h0-chip0"][0]
+        assert sick["health"] == t.DEVICE_UNHEALTHY
+        client.close()
+
+    def test_admit_and_init(self, served_plugin):
+        impl, server, _ = served_plugin
+        client = PluginClient(server.socket_path)
+        resp = client.call(
+            "AdmitPod",
+            {"pod_uid": "u1", "assignments": {"r0": ["s0-h0-chip0", "s0-h0-chip1"]}},
+        )
+        assert resp["allowed"] is True
+        resp = client.call(
+            "AdmitPod", {"pod_uid": "u2", "assignments": {"r0": ["nope"]}}
+        )
+        assert resp["allowed"] is False
+        result = client.call(
+            "InitContainer",
+            {
+                "pod_uid": "u1",
+                "container_name": "main",
+                "device_ids": ["s0-h0-chip0", "s0-h0-chip1"],
+                "pod_annotations": {
+                    ANN_WORKER_ID: "3",
+                    ANN_COORDINATOR: "trainer-0.trainer:8476",
+                },
+            },
+        )
+        spec = ContainerSpec.from_dict(result)
+        assert spec.envs["TPU_VISIBLE_CHIPS"] == "0,1"
+        assert spec.envs["TPU_WORKER_ID"] == "3"
+        assert spec.envs["JAX_COORDINATOR_ADDRESS"] == "trainer-0.trainer:8476"
+        assert spec.envs["TPU_ACCELERATOR_TYPE"] == "v5e"
+        client.close()
+
+
+class TestDeviceManager:
+    def test_discovery_and_capacity(self, served_plugin):
+        _, _, plugin_dir = served_plugin
+        dm = DeviceManager(plugin_dir, poll_interval=0.1).start()
+        try:
+            must_poll_until(
+                lambda: "google.com/tpu" in dm.get_capacity(), desc="plugin discovered"
+            )
+            devices = dm.get_capacity()["google.com/tpu"]
+            assert len(devices) == 4
+            assert devices[0].attributes[t.ATTR_TPU_SLICE] == "s0"
+        finally:
+            dm.stop()
+
+    def test_admit_pod_paths(self, served_plugin):
+        impl, _, plugin_dir = served_plugin
+        dm = DeviceManager(plugin_dir, poll_interval=0.1).start()
+        try:
+            must_poll_until(lambda: dm.has_plugin("google.com/tpu"), desc="plugin up")
+            must_poll_until(
+                lambda: dm.get_capacity().get("google.com/tpu"), desc="devices known"
+            )
+            pod = make_tpu_pod("p", tpus=2)
+            pod.metadata.uid = "uid-1"
+            # no assignment -> permanent reject
+            res = dm.admit_pod(pod)
+            assert not res.allowed and "no assignment" in res.reason
+            assert not res.retriable
+            # good assignment
+            pod.spec.extended_resources[0].assigned = ["s0-h0-chip2", "s0-h0-chip3"]
+            res = dm.admit_pod(pod)
+            assert res.allowed, res.reason
+            # unknown device
+            pod2 = make_tpu_pod("p2", tpus=1)
+            pod2.metadata.uid = "uid-2"
+            pod2.spec.extended_resources[0].assigned = ["bogus"]
+            res = dm.admit_pod(pod2)
+            assert not res.allowed and "not in local inventory" in res.reason
+            # unhealthy device
+            impl.set_health("s0-h0-chip1", t.DEVICE_UNHEALTHY)
+            must_poll_until(
+                lambda: any(
+                    d.health == t.DEVICE_UNHEALTHY
+                    for d in dm.get_capacity()["google.com/tpu"]
+                ),
+                desc="unhealthy propagated",
+            )
+            pod3 = make_tpu_pod("p3", tpus=1)
+            pod3.metadata.uid = "uid-3"
+            pod3.spec.extended_resources[0].assigned = ["s0-h0-chip1"]
+            res = dm.admit_pod(pod3)
+            assert not res.allowed and "unhealthy" in res.reason
+            assert dm.allocation_latency.count >= 1
+        finally:
+            dm.stop()
+
+    def test_plugin_removal_marks_unhealthy(self, served_plugin):
+        _, server, plugin_dir = served_plugin
+        dm = DeviceManager(plugin_dir, poll_interval=0.1).start()
+        try:
+            must_poll_until(
+                lambda: dm.get_capacity().get("google.com/tpu"), desc="devices known"
+            )
+            server.stop()  # socket unlinked
+            must_poll_until(
+                lambda: all(
+                    d.health == t.DEVICE_UNHEALTHY
+                    for d in dm.get_capacity()["google.com/tpu"]
+                ),
+                timeout=5.0,
+                desc="all devices unhealthy after plugin death",
+            )
+        finally:
+            dm.stop()
+
+    def test_killed_plugin_stale_socket_marks_unhealthy(self, plugin_dir):
+        """A SIGKILLed plugin process leaves its socket file behind; the
+        endpoint's refused reconnects must mark the inventory unhealthy
+        (probe-found bug)."""
+        import signal
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes1_tpu.deviceplugin.tpu_plugin",
+             "--plugin-dir", plugin_dir],
+            env={**os.environ, "KTPU_FAKE_TPUS": "v5e:4:s0:0"},
+        )
+        dm = DeviceManager(plugin_dir, poll_interval=0.1).start()
+        try:
+            must_poll_until(
+                lambda: dm.get_capacity().get("google.com/tpu"),
+                timeout=10.0,
+                desc="devices known",
+            )
+            sock = plugin_socket_path(plugin_dir, "google.com/tpu")
+            proc.kill()  # SIGKILL: no cleanup, socket file stays
+            proc.wait()
+            assert os.path.exists(sock)  # file really is stale
+            must_poll_until(
+                lambda: all(
+                    d.health == t.DEVICE_UNHEALTHY
+                    for d in dm.get_capacity()["google.com/tpu"]
+                ),
+                timeout=8.0,
+                desc="stale-socket plugin marked unhealthy",
+            )
+        finally:
+            dm.stop()
+            if proc.poll() is None:
+                proc.kill()

@@ -1,0 +1,238 @@
+"""Node e2e (SURVEY §4 tier 3): real kubelet + real (process) runtime +
+real device plugin + in-process apiserver/scheduler on one machine —
+the reference's test/e2e_node pattern with everything statically linked
+into the test process (services.go:61).
+
+Covers the fork's signature e2e (gpu_device_plugin.go:36-120), TPU-style:
+device assignment survives kubelet restart; a second pod gets different
+chips; injected TPU_* env reaches the workload process.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet, ProcessRuntime
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+
+
+@pytest.fixture()
+def node_env(tmp_path):
+    """master + scheduler + tpu plugin + kubelet with ProcessRuntime."""
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+    plugin_dir = str(tmp_path / "plugins")
+    impl = TPUDevicePlugin(devices=_fake_devices("v5e:4:s0:0"))
+    plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+    plugin.start()
+    runtime = ProcessRuntime(root_dir=str(tmp_path / "ktpu"))
+    kubelet = Kubelet(
+        cs,
+        node_name="tpu-node-0",
+        runtime=runtime,
+        plugin_dir=plugin_dir,
+        heartbeat_interval=0.5,
+        sync_interval=0.3,
+        pleg_interval=0.3,
+    )
+    kubelet.start()
+    env = {
+        "master": master, "cs": cs, "sched": sched, "plugin": plugin,
+        "impl": impl, "runtime": runtime, "kubelet": kubelet,
+        "plugin_dir": plugin_dir, "tmp": tmp_path,
+    }
+    yield env
+    env["kubelet"].stop()
+    sched.stop()
+    plugin.stop()
+    cs.close()
+    master.stop()
+
+
+def wait_phase(cs, name, phase, timeout=15.0, ns="default"):
+    must_poll_until(
+        lambda: cs.pods.get(name, ns).status.phase == phase,
+        timeout=timeout,
+        desc=f"pod {name} -> {phase}",
+    )
+    return cs.pods.get(name, ns)
+
+
+def py_pod(name, code, tpus=0, restart="Never"):
+    """Pod running a real python subprocess."""
+    pod = make_tpu_pod(name, tpus=tpus)
+    pod.spec.restart_policy = restart
+    pod.spec.containers[0].command = [sys.executable, "-c", code]
+    return pod
+
+
+class TestNorthStarPath:
+    def test_tpu_pod_runs_with_injected_env(self, node_env):
+        """SURVEY §3.1: kubectl-create -> admission -> schedule -> bind ->
+        kubelet admit -> InitContainer injection -> running process."""
+        cs = node_env["cs"]
+        tmp = node_env["tmp"]
+        out = str(tmp / "envdump.txt")
+        code = (
+            "import os,json;"
+            f"open({out!r},'w').write(json.dumps("
+            "{k:v for k,v in os.environ.items() if k.startswith('TPU')}))"
+        )
+        pod = py_pod("mnist", code, tpus=2)
+        cs.pods.create(pod)
+        bound = wait_phase(cs, "mnist", t.POD_SUCCEEDED)
+        assert bound.spec.node_name == "tpu-node-0"
+        assigned = bound.spec.extended_resources[0].assigned
+        assert len(assigned) == 2
+        import json
+
+        envs = json.loads(open(out).read())
+        # visible chip indices correspond 1:1 to the assigned device IDs
+        indices = envs["TPU_VISIBLE_CHIPS"].split(",")
+        assert len(indices) == 2 and len(set(indices)) == 2
+        assert sorted(indices) == sorted(i.rsplit("chip", 1)[1] for i in assigned)
+        # NOTE: TPU_ACCELERATOR_TYPE/TPU_TOPOLOGY are asserted in the plugin
+        # unit tests instead — this machine's TPU access hook (axon
+        # sitecustomize) force-overwrites them in every child interpreter.
+        assert envs["TPU_SLICE_ID"] == "s0"
+        assert envs["TPU_HOST_INDEX"] == "0"
+        assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+
+    def test_node_advertises_device_inventory(self, node_env):
+        cs = node_env["cs"]
+        must_poll_until(
+            lambda: len(
+                (cs.nodes.get("tpu-node-0", "").status.extended_resources or {}).get(
+                    "google.com/tpu", []
+                )
+            )
+            == 4,
+            desc="node advertises 4 chips",
+        )
+        node = cs.nodes.get("tpu-node-0", "")
+        dev = node.status.extended_resources["google.com/tpu"][0]
+        assert dev.attributes[t.ATTR_TPU_TYPE] == "v5e"
+
+    def test_failing_container_restart_policy(self, node_env):
+        cs = node_env["cs"]
+        pod = py_pod("crasher", "import sys; sys.exit(3)", restart="Never")
+        cs.pods.create(pod)
+        final = wait_phase(cs, "crasher", t.POD_FAILED)
+        term = final.status.container_statuses[0].state.terminated
+        assert term.exit_code == 3
+
+    def test_graceful_delete_kills_process(self, node_env):
+        cs = node_env["cs"]
+        pod = py_pod("longrun", "import time; time.sleep(300)")
+        cs.pods.create(pod)
+        wait_phase(cs, "longrun", t.POD_RUNNING)
+        cs.pods.delete("longrun", grace_seconds=None)  # graceful
+        from kubernetes1_tpu.machinery import NotFound
+
+        def gone():
+            try:
+                cs.pods.get("longrun")
+                return False
+            except NotFound:
+                return True
+
+        must_poll_until(gone, timeout=15.0, desc="pod fully deleted")
+        # no leaked sandboxes
+        assert not node_env["runtime"].list_pod_sandboxes() or all(
+            sb.pod_name != "longrun" for sb in node_env["runtime"].list_pod_sandboxes()
+        )
+
+    def test_unhealthy_chip_blocks_future_scheduling(self, node_env):
+        cs, impl = node_env["cs"], node_env["impl"]
+        impl.set_health("s0-h0-chip0", t.DEVICE_UNHEALTHY)
+        must_poll_until(
+            lambda: any(
+                d.health == t.DEVICE_UNHEALTHY
+                for d in (
+                    cs.nodes.get("tpu-node-0", "").status.extended_resources or {}
+                ).get("google.com/tpu", [])
+            ),
+            timeout=10.0,
+            desc="unhealthy chip visible in node status",
+        )
+        # only 3 healthy chips remain: a 4-chip ask must pend
+        cs.pods.create(py_pod("wants4", "print('hi')", tpus=4))
+        time.sleep(1.0)
+        assert cs.pods.get("wants4").spec.node_name == ""
+
+
+class TestRestartSafety:
+    def test_assignment_survives_kubelet_restart(self, node_env, tmp_path):
+        """The fork's signature behavior: no local checkpoint file — the
+        assignment in pod.spec survives kubelet restart, and a second pod
+        gets different chips (ref: e2e_node/gpu_device_plugin.go:95-120)."""
+        cs, runtime = node_env["cs"], node_env["runtime"]
+        pod = py_pod("persist", "import time; time.sleep(300)", tpus=2, restart="Always")
+        cs.pods.create(pod)
+        wait_phase(cs, "persist", t.POD_RUNNING)
+        first = cs.pods.get("persist").spec.extended_resources[0].assigned
+        assert len(first) == 2
+
+        node_env["kubelet"].stop()
+        kubelet2 = Kubelet(
+            cs,
+            node_name="tpu-node-0",
+            runtime=runtime,  # containers kept running across restart
+            plugin_dir=node_env["plugin_dir"],
+            heartbeat_interval=0.5,
+            sync_interval=0.3,
+            pleg_interval=0.3,
+        )
+        kubelet2.start()
+        node_env["kubelet"] = kubelet2
+        time.sleep(1.0)
+        after = cs.pods.get("persist").spec.extended_resources[0].assigned
+        assert after == first  # assignment unchanged (lives in the API object)
+        # second pod gets the other chips
+        cs.pods.create(py_pod("second", "import time; time.sleep(300)", tpus=2, restart="Always"))
+        wait_phase(cs, "second", t.POD_RUNNING)
+        second = cs.pods.get("second").spec.extended_resources[0].assigned
+        assert not (set(first) & set(second))
+
+    def test_restart_does_not_duplicate_processes(self, node_env):
+        """Regression (review-found): kubelet restart must adopt existing
+        sandboxes/containers, not spawn duplicates."""
+        cs, runtime = node_env["cs"], node_env["runtime"]
+        pod = py_pod("adopt", "import time; time.sleep(300)", restart="Always")
+        cs.pods.create(pod)
+        wait_phase(cs, "adopt", t.POD_RUNNING)
+        before = [
+            c.id for c in runtime.list_containers()
+            if c.state == "RUNNING" and c.name == "main"
+        ]
+        node_env["kubelet"].stop()
+        kubelet2 = Kubelet(
+            cs, node_name="tpu-node-0", runtime=runtime,
+            plugin_dir=node_env["plugin_dir"],
+            heartbeat_interval=0.5, sync_interval=0.3, pleg_interval=0.3,
+        )
+        kubelet2.start()
+        node_env["kubelet"] = kubelet2
+        time.sleep(1.5)
+        sandboxes = [
+            sb for sb in runtime.list_pod_sandboxes() if sb.pod_name == "adopt"
+        ]
+        running = [
+            c.id for c in runtime.list_containers()
+            if c.state == "RUNNING"
+            and c.sandbox_id in [sb.id for sb in sandboxes]
+        ]
+        assert len(sandboxes) == 1
+        assert running == before  # same single process, adopted not respawned
