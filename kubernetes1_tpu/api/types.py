@@ -23,7 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from ..machinery.meta import KObject, ListMeta, ObjectMeta
+from ..machinery.meta import KObject, ListMeta, ObjectMeta, OwnerReference
 
 # ----------------------------------------------------------------- constants
 

@@ -1,0 +1,8 @@
+from .manager import ControllerManager
+from .job import JobController
+from .replicaset import ReplicaSetController
+from .deployment import DeploymentController
+from .daemonset import DaemonSetController
+from .nodelifecycle import NodeLifecycleController
+from .namespace import NamespaceController, GarbageCollector
+from .endpoints import EndpointsController

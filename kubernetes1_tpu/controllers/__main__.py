@@ -1,0 +1,42 @@
+"""Standalone controller-manager entrypoint (ref: cmd/kube-controller-manager).
+
+    python -m kubernetes1_tpu.controllers --server http://127.0.0.1:8001 [--leader-elect]
+"""
+
+import argparse
+import signal
+import threading
+
+from ..client import Clientset
+from .manager import ControllerManager
+
+
+def main():
+    ap = argparse.ArgumentParser(description="ktpu controller manager")
+    ap.add_argument("--server", default="http://127.0.0.1:8001")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--identity", default="kcm-0")
+    ap.add_argument("--node-monitor-grace", type=float, default=40.0)
+    ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    args = ap.parse_args()
+
+    cs = Clientset(args.server, token=args.token)
+    cm = ControllerManager(
+        cs,
+        leader_elect=args.leader_elect,
+        identity=args.identity,
+        monitor_grace=args.node_monitor_grace,
+        eviction_timeout=args.pod_eviction_timeout,
+    )
+    cm.start()
+    print("controller manager running", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    cm.stop()
+
+
+if __name__ == "__main__":
+    main()

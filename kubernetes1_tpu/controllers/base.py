@@ -1,0 +1,74 @@
+"""Controller base: informer-fed, workqueue-driven reconcilers.
+
+Ref: the universal controller shape in pkg/controller/ — informer events
+enqueue keys into a rate-limited workqueue; N workers pop and call a
+level-triggered sync that compares desired vs actual and writes the
+difference through the API (never acting on the event payload itself).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import List, Optional
+
+from ..client import Clientset, EventRecorder, InformerFactory
+from ..utils.workqueue import RateLimitingQueue
+
+
+class Controller:
+    name = "controller"
+
+    def __init__(self, clientset: Clientset, factory: InformerFactory, workers: int = 2):
+        self.cs = clientset
+        self.factory = factory
+        self.queue = RateLimitingQueue()
+        self.workers = workers
+        self.recorder = EventRecorder(clientset, self.name)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # subclasses wire informer handlers in setup() and implement sync(key)
+
+    def setup(self):
+        raise NotImplementedError
+
+    def sync(self, key: str):
+        raise NotImplementedError
+
+    def enqueue(self, obj):
+        self.queue.add(obj.key())
+
+    def enqueue_after(self, key: str, delay: float):
+        self.queue.add_after(key, delay)
+
+    def start_workers(self):
+        for i in range(self.workers):
+            th = threading.Thread(
+                target=self._worker, daemon=True, name=f"{self.name}-{i}"
+            )
+            th.start()
+            self._threads.append(th)
+        return self
+
+    def start(self):
+        self.setup()
+        return self.start_workers()
+
+    def stop(self):
+        self._stop.set()
+        self.queue.shut_down()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                self.sync(key)
+                self.queue.forget(key)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)

@@ -1,0 +1,128 @@
+"""DaemonSet controller (ref: pkg/controller/daemon/): one pod per eligible
+node — how the TPU device plugin and metrics exporter roll out to hosts."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..machinery import ApiError
+from ..machinery.labels import label_selector_matches, match_labels
+from ..machinery.scheme import from_dict, to_dict
+from .base import Controller
+
+
+class DaemonSetController(Controller):
+    name = "daemonset-controller"
+
+    def setup(self):
+        self.daemonsets = self.factory.informer("daemonsets")
+        self.pods = self.factory.informer("pods")
+        self.nodes = self.factory.informer("nodes")
+        self.daemonsets.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self.enqueue,
+        )
+        self.nodes.add_handler(
+            on_add=lambda n: self._all(),
+            on_update=lambda _o, n: self._all(),
+            on_delete=lambda n: self._all(),
+        )
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _all(self):
+        for ds in self.daemonsets.list():
+            self.enqueue(ds)
+
+    def _pod_event(self, pod: t.Pod):
+        for ref in pod.metadata.owner_references:
+            if ref.kind == "DaemonSet" and ref.controller:
+                self.queue.add(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _node_eligible(self, ds: t.DaemonSet, node: t.Node) -> bool:
+        if node.spec.unschedulable:
+            return False
+        sel = ds.spec.template.spec.node_selector
+        if sel and not match_labels(sel, node.metadata.labels):
+            return False
+        return True
+
+    def sync(self, key: str):
+        ds = self.daemonsets.get(key)
+        if ds is None:
+            return
+        ns = ds.metadata.namespace
+        owned = [
+            p
+            for p in self.pods.list()
+            if p.metadata.namespace == ns
+            and not p.metadata.deletion_timestamp
+            and any(
+                r.kind == "DaemonSet" and r.uid == ds.metadata.uid
+                for r in p.metadata.owner_references
+            )
+        ]
+        by_node = {}
+        for p in owned:
+            by_node.setdefault(p.spec.node_name, []).append(p)
+        eligible = [
+            n for n in self.nodes.list() if self._node_eligible(ds, n)
+        ]
+        eligible_names = {n.metadata.name for n in eligible}
+        for node in eligible:
+            if node.metadata.name not in by_node:
+                self._create_pod(ds, node.metadata.name)
+        # remove pods on nodes no longer eligible + extra duplicates
+        for node_name, pods in by_node.items():
+            doomed = pods[1:] if node_name in eligible_names else pods
+            for p in doomed:
+                try:
+                    self.cs.pods.delete(p.metadata.name, ns)
+                except ApiError:
+                    pass
+        self._update_status(ds, owned, eligible)
+
+    def _create_pod(self, ds: t.DaemonSet, node_name: str):
+        pod = t.Pod()
+        pod.metadata.namespace = ds.metadata.namespace
+        pod.metadata.generate_name = f"{ds.metadata.name}-"
+        pod.metadata.labels = dict(ds.spec.template.metadata.labels)
+        pod.metadata.owner_references = [
+            t.OwnerReference(
+                api_version=ds.API_VERSION, kind="DaemonSet",
+                name=ds.metadata.name, uid=ds.metadata.uid, controller=True,
+            )
+        ]
+        pod.spec = from_dict(t.PodSpec, to_dict(ds.spec.template.spec))
+        # daemon pods bypass the scheduler: direct placement + tolerate all
+        pod.spec.node_name = node_name
+        pod.spec.tolerations.append(t.Toleration(operator="Exists"))
+        try:
+            self.cs.pods.create(pod)
+        except ApiError:
+            pass
+
+    def _update_status(self, ds, owned, eligible):
+        try:
+            fresh = self.cs.daemonsets.get(ds.metadata.name, ds.metadata.namespace)
+        except ApiError:
+            return
+        eligible_names = {n.metadata.name for n in eligible}
+        fresh.status.desired_number_scheduled = len(eligible)
+        fresh.status.current_number_scheduled = len(
+            {p.spec.node_name for p in owned if p.spec.node_name in eligible_names}
+        )
+        fresh.status.number_misscheduled = len(
+            [p for p in owned if p.spec.node_name not in eligible_names]
+        )
+        fresh.status.number_ready = len(
+            [p for p in owned if p.status.phase == t.POD_RUNNING]
+        )
+        fresh.status.observed_generation = fresh.metadata.generation
+        try:
+            self.cs.daemonsets.update_status(fresh)
+        except ApiError:
+            pass

@@ -1,0 +1,81 @@
+"""Endpoints controller (ref: pkg/controller/endpoint/): services select
+ready pods into Endpoints objects — the discovery substrate kube-proxy and
+the TPU coordinator bootstrap resolve against."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..machinery import AlreadyExists, ApiError, NotFound
+from ..machinery.labels import match_labels
+from .base import Controller
+
+
+class EndpointsController(Controller):
+    name = "endpoints-controller"
+
+    def setup(self):
+        self.services = self.factory.informer("services")
+        self.pods = self.factory.informer("pods")
+        self.services.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self._service_deleted,
+        )
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _service_deleted(self, svc: t.Service):
+        try:
+            self.cs.endpoints.delete(svc.metadata.name, svc.metadata.namespace)
+        except ApiError:
+            pass
+
+    def _pod_event(self, pod: t.Pod):
+        for svc in self.services.list():
+            if svc.metadata.namespace == pod.metadata.namespace and match_labels(
+                svc.spec.selector, pod.metadata.labels
+            ):
+                self.enqueue(svc)
+
+    def sync(self, key: str):
+        svc = self.services.get(key)
+        if svc is None:
+            return
+        ready_pods = [
+            p
+            for p in self.pods.list()
+            if p.metadata.namespace == svc.metadata.namespace
+            and not p.metadata.deletion_timestamp
+            and match_labels(svc.spec.selector, p.metadata.labels)
+            and p.status.phase == t.POD_RUNNING
+            and any(
+                c.type == "Ready" and c.status == "True" for c in p.status.conditions
+            )
+        ]
+        subset = t.EndpointSubset(
+            addresses=[
+                t.EndpointAddress(ip=p.status.pod_ip or p.status.host_ip, node_name=p.spec.node_name)
+                for p in sorted(ready_pods, key=lambda p: p.metadata.name)
+            ],
+            ports=[
+                t.EndpointPort(name=sp.name, port=sp.target_port or sp.port, protocol=sp.protocol)
+                for sp in svc.spec.ports
+            ],
+        )
+        eps = t.Endpoints(subsets=[subset] if subset.addresses else [])
+        eps.metadata.name = svc.metadata.name
+        eps.metadata.namespace = svc.metadata.namespace
+        try:
+            existing = self.cs.endpoints.get(svc.metadata.name, svc.metadata.namespace)
+            eps.metadata.resource_version = existing.metadata.resource_version
+            eps.metadata.uid = existing.metadata.uid
+            eps.metadata.creation_timestamp = existing.metadata.creation_timestamp
+            self.cs.endpoints.update(eps)
+        except NotFound:
+            try:
+                self.cs.endpoints.create(eps, svc.metadata.namespace)
+            except AlreadyExists:
+                pass

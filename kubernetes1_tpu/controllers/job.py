@@ -1,0 +1,282 @@
+"""Job controller: run-to-completion workloads — the TPU training primitive.
+
+Ref: pkg/controller/job/job_controller.go (syncJob :425, manageJob :633-700,
+completion counting :523-545), extended with the two capabilities SURVEY.md
+§2.1 identifies as reference gaps that multi-host TPU training requires:
+
+1. **Indexed completion mode** — each pod carries a stable completion index
+   0..completions-1 (annotation batch.ktpu.io/completion-index and pod name
+   suffix "<job>-<index>"), which the TPU device plugin turns into
+   TPU_WORKER_ID.  A v5p-32 slice Job runs as 8 indexed workers whose JAX
+   processes learn their coordinates from the index.
+2. **Gang scheduling** — spec.gang_scheduling=True stamps every pod with
+   (scheduling_gang=<job uid>, gang_size=parallelism) so the scheduler binds
+   the whole worker set atomically on one ICI slice.
+
+The controller also injects the multi-host bootstrap annotations the plugin
+consumes: worker id, coordinator address (index-0 worker), and the full
+worker hostname list.
+
+Elastic restart (the preemptible v5e-256 config): failed/deleted worker
+pods are recreated with the SAME completion index until backoff_limit, so a
+preempted slice re-forms and training resumes from the job's own
+checkpoints.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Set
+
+from ..api import types as t
+from ..client import Clientset, InformerFactory
+from ..deviceplugin.tpu_plugin import (
+    ANN_COORDINATOR,
+    ANN_WORKER_ID,
+    ANN_WORKER_HOSTNAMES,
+)
+from ..machinery import AlreadyExists, ApiError, NotFound, now_iso
+from ..machinery.labels import label_selector_matches
+from ..machinery.scheme import from_dict, to_dict
+from .base import Controller
+
+COORDINATOR_PORT = 8476
+
+
+def format_indexes(indexes: Set[int]) -> str:
+    """{0,1,2,5} -> '0-2,5' (compact completedIndexes form)."""
+    if not indexes:
+        return ""
+    xs = sorted(indexes)
+    parts, start, prev = [], xs[0], xs[0]
+    for x in xs[1:]:
+        if x == prev + 1:
+            prev = x
+            continue
+        parts.append(f"{start}-{prev}" if prev > start else str(start))
+        start = prev = x
+    parts.append(f"{start}-{prev}" if prev > start else str(start))
+    return ",".join(parts)
+
+
+class JobController(Controller):
+    name = "job-controller"
+
+    def setup(self):
+        self.jobs = self.factory.informer("jobs")
+        self.pods = self.factory.informer("pods")
+        self.jobs.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self.enqueue,
+        )
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _pod_event(self, pod: t.Pod):
+        job_name = pod.metadata.labels.get(t.JOB_NAME_LABEL)
+        if job_name:
+            self.queue.add(f"{pod.metadata.namespace}/{job_name}")
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, key: str):
+        job = self.jobs.get(key)
+        if job is None:
+            return
+        if self._finished(job):
+            return
+        ns = job.metadata.namespace
+        pods = [
+            p
+            for p in self.pods.list()
+            if p.metadata.namespace == ns
+            and label_selector_matches(job.spec.selector, p.metadata.labels)
+        ]
+        active = [p for p in pods if not self._pod_finished(p) and not p.metadata.deletion_timestamp]
+        succeeded = [p for p in pods if p.status.phase == t.POD_SUCCEEDED]
+        failed = [p for p in pods if p.status.phase == t.POD_FAILED]
+
+        indexed = job.spec.completion_mode == "Indexed"
+        completions = job.spec.completions
+        parallelism = job.spec.parallelism or 1
+
+        if indexed:
+            self._manage_indexed(job, active, succeeded, failed)
+        else:
+            self._manage_nonindexed(job, active, succeeded, failed)
+        self._update_status(job, active, succeeded, failed)
+
+    @staticmethod
+    def _pod_finished(pod: t.Pod) -> bool:
+        return pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+
+    @staticmethod
+    def _finished(job: t.Job) -> bool:
+        return any(
+            c.type in ("Complete", "Failed") and c.status == "True"
+            for c in job.status.conditions
+        )
+
+    # ------------------------------------------------------------- indexed
+
+    def _pod_index(self, pod: t.Pod) -> Optional[int]:
+        raw = pod.metadata.annotations.get(t.COMPLETION_INDEX_ANNOTATION)
+        try:
+            return int(raw) if raw is not None else None
+        except ValueError:
+            return None
+
+    def _manage_indexed(self, job: t.Job, active, succeeded, failed):
+        completions = job.spec.completions or job.spec.parallelism or 1
+        have: Set[int] = set()
+        for p in active:
+            idx = self._pod_index(p)
+            if idx is not None:
+                have.add(idx)
+        done: Set[int] = set()
+        for p in succeeded:
+            idx = self._pod_index(p)
+            if idx is not None:
+                done.add(idx)
+        if len(failed) > job.spec.backoff_limit:
+            return  # status update will mark Failed
+        missing = [
+            i for i in range(completions) if i not in have and i not in done
+        ]
+        # cap concurrency at parallelism
+        budget = (job.spec.parallelism or completions) - len(active)
+        for idx in missing[: max(0, budget)]:
+            self._create_indexed_pod(job, idx, completions)
+
+    def _create_indexed_pod(self, job: t.Job, index: int, completions: int):
+        pod = self._pod_from_template(job)
+        pod.metadata.name = f"{job.metadata.name}-{index}"
+        pod.metadata.generate_name = ""
+        pod.metadata.annotations[t.COMPLETION_INDEX_ANNOTATION] = str(index)
+        # TPU multi-host bootstrap (consumed by the device plugin)
+        pod.metadata.annotations[ANN_WORKER_ID] = str(index)
+        coordinator = f"{job.metadata.name}-0.{job.metadata.namespace}"
+        pod.metadata.annotations[ANN_COORDINATOR] = f"{coordinator}:{COORDINATOR_PORT}"
+        pod.metadata.annotations[ANN_WORKER_HOSTNAMES] = ",".join(
+            f"{job.metadata.name}-{i}.{job.metadata.namespace}"
+            for i in range(completions)
+        )
+        if job.spec.gang_scheduling:
+            pod.spec.scheduling_gang = f"job-{job.metadata.uid}"
+            pod.spec.gang_size = completions
+        try:
+            self.cs.pods.create(pod)
+            self.recorder.event(
+                job, "Normal", "SuccessfulCreate", f"created pod {pod.metadata.name}"
+            )
+        except AlreadyExists:
+            pass
+
+    # ---------------------------------------------------------- nonindexed
+
+    def _manage_nonindexed(self, job: t.Job, active, succeeded, failed):
+        parallelism = job.spec.parallelism or 1
+        completions = job.spec.completions
+        if len(failed) > job.spec.backoff_limit:
+            return
+        if completions is not None:
+            want_active = min(parallelism, max(0, completions - len(succeeded)))
+        else:
+            want_active = parallelism
+        need = want_active - len(active)
+        for _ in range(max(0, need)):
+            pod = self._pod_from_template(job)
+            pod.metadata.generate_name = f"{job.metadata.name}-"
+            if job.spec.gang_scheduling:
+                pod.spec.scheduling_gang = f"job-{job.metadata.uid}"
+                pod.spec.gang_size = parallelism
+            try:
+                self.cs.pods.create(pod)
+            except ApiError:
+                break
+        for pod in active[: max(0, -need)]:
+            try:
+                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+            except ApiError:
+                pass
+
+    def _pod_from_template(self, job: t.Job) -> t.Pod:
+        tmpl = job.spec.template
+        pod = t.Pod()
+        pod.metadata.namespace = job.metadata.namespace
+        pod.metadata.labels = dict(tmpl.metadata.labels)
+        pod.metadata.labels.setdefault(t.JOB_NAME_LABEL, job.metadata.name)
+        pod.metadata.annotations = dict(tmpl.metadata.annotations)
+        pod.metadata.owner_references = [
+            t.OwnerReference(
+                api_version=job.API_VERSION,
+                kind="Job",
+                name=job.metadata.name,
+                uid=job.metadata.uid,
+                controller=True,
+            )
+        ]
+        pod.spec = from_dict(t.PodSpec, to_dict(tmpl.spec))  # deep copy
+        if not pod.spec.restart_policy or pod.spec.restart_policy == "Always":
+            pod.spec.restart_policy = "Never"  # job pods must terminate
+        return pod
+
+    # --------------------------------------------------------------- status
+
+    def _update_status(self, job: t.Job, active, succeeded, failed):
+        completions = job.spec.completions
+        indexed = job.spec.completion_mode == "Indexed"
+        done_indexes: Set[int] = set()
+        if indexed:
+            for p in succeeded:
+                idx = self._pod_index(p)
+                if idx is not None:
+                    done_indexes.add(idx)
+
+        fresh = self.cs.jobs.get(job.metadata.name, job.metadata.namespace)
+        fresh.status.active = len(active)
+        fresh.status.succeeded = len(succeeded)
+        fresh.status.failed = len(failed)
+        if not fresh.status.start_time:
+            fresh.status.start_time = now_iso()
+        if indexed:
+            fresh.status.completed_indexes = format_indexes(done_indexes)
+
+        complete = False
+        if indexed:
+            want = completions or job.spec.parallelism or 1
+            complete = len(done_indexes) >= want
+        elif completions is not None:
+            complete = len(succeeded) >= completions
+        else:
+            complete = len(succeeded) > 0 and len(active) == 0
+
+        if complete and not self._finished(fresh):
+            fresh.status.completion_time = now_iso()
+            fresh.status.conditions.append(
+                t.JobCondition(
+                    type="Complete", status="True", last_transition_time=now_iso()
+                )
+            )
+            self.recorder.event(job, "Normal", "Completed", "job completed")
+        elif len(failed) > job.spec.backoff_limit and not self._finished(fresh):
+            fresh.status.conditions.append(
+                t.JobCondition(
+                    type="Failed",
+                    status="True",
+                    reason="BackoffLimitExceeded",
+                    last_transition_time=now_iso(),
+                )
+            )
+            self.recorder.event(
+                job, "Warning", "BackoffLimitExceeded",
+                f"{len(failed)} failed pods exceed backoffLimit={job.spec.backoff_limit}",
+            )
+        try:
+            self.cs.jobs.update_status(fresh)
+        except NotFound:
+            pass

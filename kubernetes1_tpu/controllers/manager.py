@@ -1,0 +1,82 @@
+"""Controller manager (ref: cmd/kube-controller-manager/app/
+controllermanager.go:334-363): runs every control loop over one shared
+informer factory, optionally under leader election."""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..client import Clientset, InformerFactory, LeaderElector
+from .daemonset import DaemonSetController
+from .deployment import DeploymentController
+from .endpoints import EndpointsController
+from .job import JobController
+from .namespace import GarbageCollector, NamespaceController
+from .nodelifecycle import NodeLifecycleController
+from .replicaset import ReplicaSetController
+
+
+class ControllerManager:
+    def __init__(
+        self,
+        clientset: Clientset,
+        leader_elect: bool = False,
+        identity: str = "kcm-0",
+        monitor_grace: float = 40.0,
+        eviction_timeout: float = 300.0,
+    ):
+        self.cs = clientset
+        self.factory = InformerFactory(clientset)
+        self.controllers = [
+            JobController(clientset, self.factory),
+            ReplicaSetController(clientset, self.factory),
+            DeploymentController(clientset, self.factory),
+            DaemonSetController(clientset, self.factory),
+            NamespaceController(clientset, self.factory),
+            GarbageCollector(clientset, self.factory),
+            EndpointsController(clientset, self.factory),
+        ]
+        self.node_lifecycle = NodeLifecycleController(
+            clientset,
+            self.factory,
+            monitor_grace=monitor_grace,
+            eviction_timeout=eviction_timeout,
+        )
+        self.leader_elect = leader_elect
+        self.identity = identity
+        self._elector: Optional[LeaderElector] = None
+        self._started = threading.Event()
+
+    def _run(self):
+        if self._started.is_set():
+            return
+        self._started.set()
+        for c in self.controllers:
+            c.setup()
+        self.factory.start_all()
+        self.factory.wait_for_sync()
+        for c in self.controllers:
+            c.start_workers()
+        self.node_lifecycle.start()
+
+    def start(self):
+        if self.leader_elect:
+            self._elector = LeaderElector(
+                self.cs,
+                "ktpu-controller-manager",
+                self.identity,
+                on_started_leading=self._run,
+            )
+            self._elector.start()
+        else:
+            self._run()
+        return self
+
+    def stop(self):
+        if self._elector:
+            self._elector.stop()
+        for c in self.controllers:
+            c.stop()
+        self.node_lifecycle.stop()
+        self.factory.stop_all()

@@ -1,0 +1,120 @@
+"""Namespace + garbage controllers.
+
+NamespaceController (ref: pkg/controller/namespace/): Terminating
+namespaces get emptied of every namespaced resource, then finalized.
+
+GarbageCollector (ref: pkg/controller/garbagecollector/): objects whose
+controller owner reference no longer resolves are deleted — how pods die
+when their Job/ReplicaSet is removed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List
+
+from ..api import types as t
+from ..client import Clientset, InformerFactory
+from ..machinery import ApiError, NotFound
+from .base import Controller
+
+NAMESPACED_RESOURCES = (
+    "pods", "jobs", "replicasets", "deployments", "daemonsets",
+    "services", "endpoints", "configmaps", "events", "leases",
+)
+
+
+class NamespaceController(Controller):
+    name = "namespace-controller"
+
+    def setup(self):
+        self.namespaces = self.factory.informer("namespaces")
+        self.namespaces.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+        )
+
+    def sync(self, key: str):
+        ns = self.namespaces.get(key)
+        if ns is None or ns.status.phase != "Terminating":
+            return
+        remaining = 0
+        for resource in NAMESPACED_RESOURCES:
+            items, _ = self.cs.resource(resource).list(namespace=ns.metadata.name)
+            for obj in items:
+                remaining += 1
+                try:
+                    self.cs.resource(resource).delete(
+                        obj.metadata.name, ns.metadata.name,
+                        grace_seconds=0 if resource == "pods" else None,
+                    )
+                except ApiError:
+                    pass
+        if remaining == 0:
+            try:
+                self.cs.namespaces.delete(ns.metadata.name, "", grace_seconds=0)
+            except ApiError:
+                pass
+        else:
+            self.enqueue_after(key, 0.5)
+
+
+OWNED_RESOURCES = ("pods", "replicasets")
+
+
+class GarbageCollector(Controller):
+    name = "garbage-collector"
+
+    OWNER_RESOURCE = {
+        "Job": "jobs",
+        "ReplicaSet": "replicasets",
+        "Deployment": "deployments",
+        "DaemonSet": "daemonsets",
+    }
+
+    def setup(self):
+        self.informers: Dict[str, object] = {}
+        for resource in OWNED_RESOURCES + ("jobs", "deployments", "daemonsets"):
+            self.informers[resource] = self.factory.informer(resource)
+        for resource in OWNED_RESOURCES:
+            inf = self.informers[resource]
+            inf.add_handler(
+                on_add=lambda o, r=resource: self.queue.add(f"{r}|{o.key()}")
+            )
+        # owner deletions re-scan owned kinds
+        for owner in ("jobs", "replicasets", "deployments", "daemonsets"):
+            self.informers[owner].add_handler(
+                on_delete=lambda o: self._rescan()
+            )
+
+    def _rescan(self):
+        for resource in OWNED_RESOURCES:
+            for obj in self.informers[resource].list():
+                self.queue.add(f"{resource}|{obj.key()}")
+
+    def sync(self, key: str):
+        resource, obj_key = key.split("|", 1)
+        obj = self.informers[resource].get(obj_key)
+        if obj is None or obj.metadata.deletion_timestamp:
+            return
+        for ref in obj.metadata.owner_references:
+            owner_resource = self.OWNER_RESOURCE.get(ref.kind)
+            if owner_resource is None:
+                continue
+            try:
+                owner = self.cs.resource(owner_resource).get(
+                    ref.name, obj.metadata.namespace
+                )
+                if owner.metadata.uid != ref.uid:
+                    raise NotFound("uid changed")
+            except NotFound:
+                try:
+                    self.cs.resource(resource).delete(
+                        obj.metadata.name, obj.metadata.namespace,
+                        grace_seconds=0 if resource == "pods" else None,
+                    )
+                except ApiError:
+                    pass
+                return

@@ -1,0 +1,131 @@
+"""Node lifecycle controller: heartbeat-based failure detection + eviction.
+
+Ref: pkg/controller/node/node_controller.go with the reference's defaults
+(options.go:96-97): a node whose Ready heartbeat is older than
+monitor_grace goes NotReady; after eviction_timeout its pods are deleted so
+their controllers recreate them elsewhere — the elastic-restart primitive
+for preemptible TPU slices (a reclaimed v5e host's workers re-form on new
+hosts via the Job controller's index-preserving recreate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from ..api import types as t
+from ..client import Clientset, EventRecorder, InformerFactory
+from ..machinery import ApiError, now_iso
+from ..machinery.meta import parse_iso
+
+
+class NodeLifecycleController:
+    name = "node-lifecycle-controller"
+
+    def __init__(
+        self,
+        clientset: Clientset,
+        factory: InformerFactory,
+        monitor_grace: float = 40.0,
+        eviction_timeout: float = 300.0,
+        monitor_interval: float = 5.0,
+    ):
+        self.cs = clientset
+        self.factory = factory
+        self.nodes = factory.informer("nodes")
+        self.pods = factory.informer("pods")
+        self.recorder = EventRecorder(clientset, self.name)
+        self.monitor_grace = monitor_grace
+        self.eviction_timeout = eviction_timeout
+        self.monitor_interval = monitor_interval
+        self._not_ready_since: dict = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self.monitor_interval):
+            try:
+                self._monitor()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _ready_condition(self, node: t.Node):
+        for cond in node.status.conditions:
+            if cond.type == t.NODE_READY:
+                return cond
+        return None
+
+    def _monitor(self):
+        now = time.time()
+        for node in self.nodes.list():
+            name = node.metadata.name
+            cond = self._ready_condition(node)
+            stale = True
+            if cond and cond.last_heartbeat_time:
+                try:
+                    stale = (now - parse_iso(cond.last_heartbeat_time)) > self.monitor_grace
+                except ValueError:
+                    stale = True
+            if not stale and cond and cond.status == "True":
+                self._not_ready_since.pop(name, None)
+                continue
+            # node is failing: mark NotReady (if kubelet isn't doing it) and
+            # start the eviction clock
+            since = self._not_ready_since.setdefault(name, now)
+            if stale and cond and cond.status == "True":
+                self._mark_not_ready(node)
+            if now - since > self.eviction_timeout:
+                self._evict_pods(node)
+
+    def _mark_not_ready(self, node: t.Node):
+        try:
+            fresh = self.cs.nodes.get(node.metadata.name, "")
+            cond = self._ready_condition(fresh)
+            if cond is None:
+                cond = t.NodeCondition(type=t.NODE_READY)
+                fresh.status.conditions.append(cond)
+            if cond.status != "Unknown":
+                cond.status = "Unknown"
+                cond.reason = "NodeStatusUnknown"
+                cond.message = "kubelet stopped posting node status"
+                cond.last_transition_time = now_iso()
+                self.cs.nodes.update_status(fresh)
+                self.recorder.event(
+                    fresh, "Warning", "NodeNotReady",
+                    f"node {node.metadata.name} heartbeat stale",
+                )
+        except ApiError:
+            pass
+
+    def _evict_pods(self, node: t.Node):
+        for pod in self.pods.list():
+            if pod.spec.node_name != node.metadata.name:
+                continue
+            if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+                continue  # finished pods hold no resources; leave the record
+            if pod.metadata.deletion_timestamp:
+                # kubelet is gone and can't finalize: force delete so the
+                # controller can replace the pod
+                try:
+                    self.cs.pods.delete(
+                        pod.metadata.name, pod.metadata.namespace, grace_seconds=0
+                    )
+                except ApiError:
+                    pass
+                continue
+            try:
+                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+                self.recorder.event(
+                    pod, "Warning", "NodeEviction",
+                    f"evicted: node {node.metadata.name} unreachable",
+                )
+            except ApiError:
+                pass

@@ -1,0 +1,114 @@
+"""ReplicaSet controller (ref: pkg/controller/replicaset/replica_set.go):
+level-triggered replica reconciliation with owner-reference adoption."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api import types as t
+from ..machinery import ApiError, NotFound
+from ..machinery.labels import label_selector_matches
+from ..machinery.scheme import from_dict, to_dict
+from .base import Controller
+
+
+def owned_by(pod: t.Pod, kind: str, uid: str) -> bool:
+    return any(
+        ref.kind == kind and ref.uid == uid and ref.controller
+        for ref in pod.metadata.owner_references
+    )
+
+
+class ReplicaSetController(Controller):
+    name = "replicaset-controller"
+
+    def setup(self):
+        self.rsets = self.factory.informer("replicasets")
+        self.pods = self.factory.informer("pods")
+        self.rsets.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self.enqueue,
+        )
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _pod_event(self, pod: t.Pod):
+        for ref in pod.metadata.owner_references:
+            if ref.kind == "ReplicaSet" and ref.controller:
+                self.queue.add(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _select_pods(self, rs: t.ReplicaSet) -> List[t.Pod]:
+        return [
+            p
+            for p in self.pods.list()
+            if p.metadata.namespace == rs.metadata.namespace
+            and not p.metadata.deletion_timestamp
+            and label_selector_matches(rs.spec.selector, p.metadata.labels)
+            and (
+                owned_by(p, "ReplicaSet", rs.metadata.uid)
+                or not p.metadata.owner_references  # adoptable orphan
+            )
+        ]
+
+    def sync(self, key: str):
+        rs = self.rsets.get(key)
+        if rs is None:
+            return
+        pods = self._select_pods(rs)
+        alive = [p for p in pods if p.status.phase not in (t.POD_FAILED, t.POD_SUCCEEDED)]
+        want = rs.spec.replicas if rs.spec.replicas is not None else 1
+        diff = want - len(alive)
+        if diff > 0:
+            for _ in range(min(diff, 50)):  # burst cap like the reference
+                pod = t.Pod()
+                pod.metadata.namespace = rs.metadata.namespace
+                pod.metadata.generate_name = f"{rs.metadata.name}-"
+                pod.metadata.labels = dict(rs.spec.template.metadata.labels)
+                pod.metadata.annotations = dict(rs.spec.template.metadata.annotations)
+                pod.metadata.owner_references = [
+                    t.OwnerReference(
+                        api_version=rs.API_VERSION, kind="ReplicaSet",
+                        name=rs.metadata.name, uid=rs.metadata.uid, controller=True,
+                    )
+                ]
+                pod.spec = from_dict(t.PodSpec, to_dict(rs.spec.template.spec))
+                try:
+                    self.cs.pods.create(pod)
+                except ApiError:
+                    break
+        elif diff < 0:
+            # prefer deleting unscheduled, then newest
+            doomed = sorted(
+                alive,
+                key=lambda p: (bool(p.spec.node_name), p.metadata.creation_timestamp),
+            )[: -diff]
+            for pod in doomed:
+                try:
+                    self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+                except ApiError:
+                    pass
+        self._update_status(rs, alive)
+
+    def _update_status(self, rs: t.ReplicaSet, alive: List[t.Pod]):
+        try:
+            fresh = self.cs.replicasets.get(rs.metadata.name, rs.metadata.namespace)
+        except NotFound:
+            return
+        ready = [
+            p
+            for p in alive
+            if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
+        ]
+        fresh.status.replicas = len(alive)
+        fresh.status.ready_replicas = len(ready)
+        fresh.status.available_replicas = len(ready)
+        fresh.status.fully_labeled_replicas = len(alive)
+        fresh.status.observed_generation = fresh.metadata.generation
+        try:
+            self.cs.replicasets.update_status(fresh)
+        except ApiError:
+            pass

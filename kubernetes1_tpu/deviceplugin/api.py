@@ -22,11 +22,10 @@ from __future__ import annotations
 import json
 import os
 import socket
-import socketserver
 import threading
 import traceback
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
 

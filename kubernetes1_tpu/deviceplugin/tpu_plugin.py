@@ -32,7 +32,13 @@ from typing import Dict, List, Optional
 
 from .. import TPU_RESOURCE
 from ..api import types as t
-from .api import ContainerSpec, DeviceSpec, PluginServer, plugin_socket_path
+from .api import (
+    DEFAULT_PLUGIN_DIR,
+    ContainerSpec,
+    DeviceSpec,
+    PluginServer,
+    plugin_socket_path,
+)
 
 # Pod annotations the plugin consumes (set by the Job controller / user).
 ANN_WORKER_ID = "tpu.ktpu.io/worker-id"
@@ -121,7 +127,10 @@ class TPUDevicePlugin:
         self._admitted_pods: Dict[str, dict] = {}
         self.health_check_interval = health_check_interval
         self._lock = threading.Lock()
-        self._dirty = threading.Event()
+        # one wakeup Event per live ListAndWatch stream: a shared event could
+        # be consumed (and cleared) by a dead stream, losing the update for
+        # the live one
+        self._subscribers: List[threading.Event] = []
 
     # --------------------------------------------------------------- 4 RPCs
 
@@ -139,15 +148,25 @@ class TPUDevicePlugin:
     def watch_devices(self, send, stop: threading.Event):
         """Push updated inventory whenever health flips (ListAndWatch
         stream semantics, ref endpoint.go:99-105)."""
-        while not stop.is_set():
-            self._dirty.wait(self.health_check_interval)
-            if stop.is_set():
-                return
-            if self._dirty.is_set():
-                self._dirty.clear()
-                send(self.list_devices())
-            else:
-                self._check_health(send)
+        dirty = threading.Event()
+        with self._lock:
+            self._subscribers.append(dirty)
+        try:
+            while not stop.is_set():
+                dirty.wait(self.health_check_interval)
+                if stop.is_set():
+                    return
+                if dirty.is_set():
+                    dirty.clear()
+                    send(self.list_devices())
+                else:
+                    self._check_health(send)
+        finally:
+            with self._lock:
+                try:
+                    self._subscribers.remove(dirty)
+                except ValueError:
+                    pass
 
     def _check_health(self, send):
         """Real mode: a vanished /dev/accel node marks its chip unhealthy."""
@@ -170,7 +189,9 @@ class TPUDevicePlugin:
         with self._lock:
             if device_id in self._by_id:
                 self._by_id[device_id]["health"] = health
-        self._dirty.set()
+            subscribers = list(self._subscribers)
+        for ev in subscribers:
+            ev.set()
 
     def admit_pod(self, params: dict) -> dict:
         """Verify the scheduler's assignment against local inventory
@@ -240,7 +261,6 @@ def run_plugin(
 ) -> PluginServer:
     impl = TPUDevicePlugin(devices=devices)
     server = PluginServer(impl, plugin_socket_path(plugin_dir, resource))
-    server.impl = impl
     server.start()
     return server
 
@@ -249,7 +269,7 @@ def main():
     import argparse
 
     ap = argparse.ArgumentParser(description="ktpu TPU device plugin")
-    ap.add_argument("--plugin-dir", default=os.environ.get("KTPU_PLUGIN_DIR", "/var/lib/ktpu/device-plugins"))
+    ap.add_argument("--plugin-dir", default=os.environ.get("KTPU_PLUGIN_DIR", DEFAULT_PLUGIN_DIR))
     args = ap.parse_args()
     server = run_plugin(args.plugin_dir)
     n = len(server.impl.devices)

@@ -9,6 +9,7 @@ import signal
 import threading
 
 from ..client import Clientset
+from ..deviceplugin.api import DEFAULT_PLUGIN_DIR
 from .kubelet import Kubelet
 from .runtime import FakeRuntime, ProcessRuntime
 
@@ -19,7 +20,7 @@ def main():
     ap.add_argument("--token", default="")
     ap.add_argument("--node-name", default="node-0")
     ap.add_argument("--runtime", choices=["process", "fake"], default="process")
-    ap.add_argument("--plugin-dir", default="/var/lib/ktpu/device-plugins")
+    ap.add_argument("--plugin-dir", default=DEFAULT_PLUGIN_DIR)
     ap.add_argument("--static-pod-dir", default="")
     ap.add_argument("--root-dir", default="/tmp/ktpu")
     ap.add_argument("--label", action="append", default=[], help="k=v node label")

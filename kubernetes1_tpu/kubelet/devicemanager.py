@@ -29,15 +29,15 @@ import traceback
 from typing import Dict, List, NamedTuple, Optional
 
 
-class AdmitResult(NamedTuple):
-    allowed: bool
-    reason: str
-    retriable: bool
-
 from ..api import types as t
 from ..deviceplugin.api import ContainerSpec, PluginClient, resource_from_socket
 from ..machinery.scheme import from_dict
 from ..utils.metrics import Histogram
+
+class AdmitResult(NamedTuple):
+    allowed: bool
+    reason: str
+    retriable: bool
 
 
 class Endpoint:

@@ -29,8 +29,9 @@ from typing import Dict, List, Optional, Tuple
 from ..api import types as t
 from ..client import Clientset, EventRecorder, SharedInformer
 from ..machinery import ApiError, Conflict, NotFound, now_iso
-from ..machinery.scheme import from_dict, global_scheme
+from ..machinery.scheme import global_scheme
 from ..utils.workqueue import WorkQueue
+from ..deviceplugin.api import DEFAULT_PLUGIN_DIR
 from .devicemanager import DeviceManager
 from .runtime import (
     CONTAINER_EXITED,
@@ -39,7 +40,6 @@ from .runtime import (
     RuntimeService,
 )
 
-DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
 
 
 class Kubelet:
@@ -113,7 +113,7 @@ class Kubelet:
         self.pods.add_handler(
             on_add=lambda p: self._enqueue(p),
             on_update=lambda _o, p: self._enqueue(p),
-            on_delete=lambda p: self._enqueue(p, deleted=True),
+            on_delete=self._enqueue,
         )
         self.pods.start()
         self.pods.wait_for_sync()
@@ -241,7 +241,7 @@ class Kubelet:
 
     # ------------------------------------------------------------ pod source
 
-    def _enqueue(self, pod: t.Pod, deleted: bool = False):
+    def _enqueue(self, pod: t.Pod):
         self._queue.add(pod.key())
 
     def _load_static_pods(self):
@@ -386,7 +386,6 @@ class Kubelet:
         """GenerateRunContainerOptions (ref kubelet_pods.go:468): pod env +
         device-plugin injection merged into the CRI config."""
         env = {e.name: e.value for e in container.env}
-        devices, mounts, annotations = [], [], {}
         spec = self.device_manager.init_container(pod, container)
         env.update(spec.envs)
         devices = [vars(d) for d in spec.devices]

@@ -18,7 +18,6 @@ Two implementations, both behind the same interface the kubelet consumes:
 from __future__ import annotations
 
 import os
-import shlex
 import signal
 import subprocess
 import threading
