@@ -1,0 +1,143 @@
+"""End-to-end density benchmark (reference analog: test/e2e/scalability/
+density.go + test/integration/scheduler_perf).
+
+Boots the full framework in-process — HTTP apiserver over the MVCC store,
+device-aware scheduler, and N hollow kubelets (FakeRuntime) each backed by
+a fake 4-chip TPU device plugin over real unix sockets — then creates M
+pods requesting google.com/tpu and measures create->Running latency.
+
+Primary metric: pod startup p99 vs the reference's enforced 5s SLO
+(test/e2e/framework/metrics_util.go:46).  vs_baseline = 5.0 / p99, so
+>1 means beating the SLO by that factor.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NODES = int(os.environ.get("BENCH_NODES", "20"))
+CHIPS_PER_NODE = 4
+# default exactly at chip capacity so every pod can run
+PODS = int(os.environ.get("BENCH_PODS", str(NODES * CHIPS_PER_NODE)))
+
+
+def main():
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+    from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+    from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+    from kubernetes1_tpu.scheduler import Scheduler
+    from tests.helpers import make_tpu_pod
+
+    tmp = tempfile.mkdtemp(prefix="ktpu-bench-")
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+
+    kubelets, plugins, clients = [], [], []
+    for i in range(NODES):
+        plugin_dir = os.path.join(tmp, f"node-{i}")
+        impl = TPUDevicePlugin(devices=_fake_devices(f"v5e:{CHIPS_PER_NODE}:s{i}:0"))
+        plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+        plugin.start()
+        plugins.append(plugin)
+        kcs = Clientset(master.url)
+        clients.append(kcs)
+        kl = Kubelet(kcs, node_name=f"hollow-{i}", runtime=FakeRuntime(),
+                     plugin_dir=plugin_dir, heartbeat_interval=2.0,
+                     sync_interval=0.2, pleg_interval=0.2)
+        kl.start()
+        kubelets.append(kl)
+
+    # wait for all nodes Ready with chips advertised
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        nodes, _ = cs.nodes.list()
+        ready = [n for n in nodes
+                 if n.status.extended_resources.get("google.com/tpu")]
+        if len(ready) == NODES:
+            break
+        time.sleep(0.2)
+    else:
+        raise RuntimeError("nodes never became ready")
+
+    created = {}
+    t0 = time.perf_counter()
+    for i in range(PODS):
+        pod = make_tpu_pod(f"bench-{i}", tpus=1)
+        pod.spec.containers[0].command = ["sleep", "3600"]
+        cs.pods.create(pod)
+        created[pod.metadata.name] = time.perf_counter()
+
+    running_at = {}
+    sched_at = {}
+    deadline = time.time() + 120
+    while len(running_at) < PODS and time.time() < deadline:
+        for p in cs.pods.list(namespace="default")[0]:
+            nm = p.metadata.name
+            if nm not in created:
+                continue
+            now = time.perf_counter()
+            if nm not in sched_at and p.spec.node_name:
+                sched_at[nm] = now
+            if nm not in running_at and p.status.phase == t.POD_RUNNING:
+                running_at[nm] = now
+        time.sleep(0.05)
+
+    n_ok = len(running_at)
+    lat = sorted(running_at[nm] - created[nm] for nm in running_at)
+    total_wall = max(running_at.values()) - t0 if running_at else float("inf")
+
+    def pct(xs, q):
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("inf")
+
+    p50, p90, p99 = pct(lat, 0.50), pct(lat, 0.90), pct(lat, 0.99)
+    sched_lat = sorted(sched_at[nm] - created[nm] for nm in sched_at)
+    sched_p50 = pct(sched_lat, 0.50)
+
+    # verify every running pod actually got a distinct chip assignment
+    assigned = []
+    for p in cs.pods.list(namespace="default")[0]:
+        for er in p.spec.extended_resources:
+            assigned.extend(er.assigned)
+    distinct = len(set(assigned))
+
+    for kl in kubelets:
+        kl.stop()
+    for pl in plugins:
+        pl.stop()
+    sched.stop()
+    for c in clients:
+        c.close()
+    cs.close()
+    master.stop()
+
+    result = {
+        "metric": "pod_startup_p99_s",
+        "value": round(p99, 4),
+        "unit": "s",
+        "vs_baseline": round(5.0 / p99, 2) if p99 > 0 else None,
+        "extra": {
+            "pods": PODS, "nodes": NODES, "running": n_ok,
+            "pod_startup_p50_s": round(p50, 4),
+            "pod_startup_p90_s": round(p90, 4),
+            "chip_alloc_p50_s": round(sched_p50, 4),
+            "pods_per_sec": round(n_ok / total_wall, 1) if total_wall else 0,
+            "distinct_chips_assigned": distinct,
+            "baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46)",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

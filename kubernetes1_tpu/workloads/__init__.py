@@ -1,0 +1,20 @@
+"""JAX training workloads the framework schedules onto TPU.
+
+The reference is an orchestrator: its "workloads" are CUDA containers
+(test/e2e/scheduling/nvidia-gpus.go runs a CUDA add; the README's headline
+is scheduling GPU ML jobs).  The TPU-native equivalents live here — real
+jax/pjit programs covering every BASELINE.json config:
+
+- mnist     — single-chip JAX MNIST (config 2)
+- resnet    — ResNet-50, data-parallel over a single-host mesh (config 3)
+- llama     — Llama-3-style transformer with dp/fsdp/tp sharding, scanned
+              layers, remat, bf16 (configs 4 and 5; flagship model)
+- ringattention — sequence-parallel blockwise attention over an `sp` mesh
+              axis (long-context path; ppermute ring over ICI)
+
+These run *inside* scheduled pods (ProcessRuntime containers) with the
+TPU env injected by the device plugin; they are also imported directly by
+bench.py and __graft_entry__.py.
+"""
+
+from . import mnist, llama, resnet, ringattention, sharding  # noqa: F401

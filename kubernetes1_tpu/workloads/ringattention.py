@@ -1,0 +1,117 @@
+"""Ring attention: sequence/context parallelism over an `sp` mesh axis.
+
+Long-context is first-class: a sequence too big for one chip's HBM is
+sharded along its length; each device holds one Q/K/V block and K/V blocks
+rotate around the ring with lax.ppermute (neighbor hops ride ICI), while a
+running online-softmax accumulator (m, l, o) folds in each block — so the
+full S x S attention is computed with S/n-sized tiles and no all-gather.
+
+Causal masking is handled per (q_block, kv_block) pair from the blocks'
+global offsets: kv block strictly behind -> dense, same block -> lower
+triangle, ahead -> skipped (contributes nothing).
+
+Written with shard_map so the collective schedule is explicit; everything
+inside is jit-compatible (static shapes, fori_loop over ring steps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, kv_off, causal):
+    """One tile: q (B,Sq,H,hd) x k/v (B,Sk,H,hd) -> (o, m, l) partials.
+    Returns unnormalised o with row max m and row sum l (f32)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        qi = q_off + jnp.arange(sq)[:, None]
+        ki = kv_off + jnp.arange(sk)[None, :]
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                      # (B,H,Sq)
+    # guard fully-masked rows (m == NEG_INF) against NaN in exp
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)                           # (B,H,Sq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+    return o.astype(jnp.float32), m_safe, l
+
+
+def _merge(acc, new):
+    """Fold a new (o, m, l) partial into the running accumulator."""
+    o_a, m_a, l_a = acc
+    o_n, m_n, l_n = new
+    m = jnp.maximum(m_a, m_n)
+    a = jnp.exp(m_a - m)
+    b = jnp.exp(m_n - m)
+    o = o_a * a[..., None].swapaxes(1, 2) + o_n * b[..., None].swapaxes(1, 2)
+    l = l_a * a + l_n * b
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "sp", causal: bool = True) -> jax.Array:
+    """q/k/v: (B, S, H, hd) with S sharded over `axis`. GQA allowed
+    (H_kv divides H). Returns attention output sharded like q."""
+    groups = q.shape[2] // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+
+    def local(q, k, v):
+        n = jax.lax.axis_size(axis)
+        idx = jax.lax.axis_index(axis)
+        sq = q.shape[1]
+        q_off = idx * sq
+        # mark accumulators as device-varying over the ring axis so the
+        # fori carry types match the body outputs (shard_map VMA rules)
+        o0 = jax.lax.pvary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), axis)
+        m0 = jax.lax.pvary(jnp.full((q.shape[0], q.shape[2], sq), NEG_INF, jnp.float32), axis)
+        l0 = jax.lax.pvary(jnp.zeros((q.shape[0], q.shape[2], sq), jnp.float32), axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def body(step, carry):
+            acc, kc, vc = carry
+            # kv block currently held came from device (idx - step) mod n
+            src = jax.lax.rem(idx - step + n, n)
+            kv_off = src * kc.shape[1]
+            new = _block_attn(q, kc, vc, q_off, kv_off, causal)
+            acc = _merge(acc, new)
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return acc, kc, vc
+
+        (o, m, l), _, _ = jax.lax.fori_loop(0, n, body, ((o0, m0, l0), k, v))
+        l = jnp.maximum(l, 1e-20)
+        return (o / l.swapaxes(1, 2)[..., None]).astype(q.dtype)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Dense single-device attention for correctness checks."""
+    groups = q.shape[2] // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)

@@ -1,0 +1,74 @@
+"""Mesh + sharding helpers shared by the workloads.
+
+The scaling-book recipe: pick a mesh, annotate shardings on params and
+activations, let XLA insert the collectives.  Axes:
+
+- dp    pure data parallelism (params replicated)
+- fsdp  data parallelism with params sharded over the axis (ZeRO-3 style;
+        XLA turns the annotations into all-gather/reduce-scatter)
+- tp    megatron tensor parallelism (attention heads / ffn hidden)
+- sp    sequence/context parallelism (ring attention, ringattention.py)
+
+The framework's job (scheduler + device plugin) is to place each worker
+process on the right host of a slice; inside the process these meshes map
+onto ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * fsdp * tp
+    if n > len(devices):
+        raise ValueError(f"mesh {dp}x{fsdp}x{tp} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, fsdp, tp)
+    return Mesh(arr, ("dp", "fsdp", "tp"))
+
+
+def auto_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Sensible mesh for however many chips are visible: all-fsdp up to a
+    host (<=8 chips), then dp across hosts."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fsdp = min(n, 8)
+    dp = n // fsdp
+    return make_mesh(dp=dp, fsdp=fsdp, tp=1, devices=devices[: dp * fsdp])
+
+
+def named(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def batch_spec() -> P:
+    """Batch dim sharded over both data axes (dp, fsdp) — standard FSDP."""
+    return P(("dp", "fsdp"))
+
+
+def constrain(x, spec: P):
+    """with_sharding_constraint that no-ops when no mesh is active (so the
+    same model code jits single-chip without a mesh context)."""
+    m = jax.sharding.get_abstract_mesh()
+    if m is None or not m.axis_names:
+        return x
+    # drop axes the active mesh doesn't have (e.g. a pure-dp mesh)
+    def filter_axes(entry):
+        if entry is None:
+            return None
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a in m.axis_names)
+        return kept if kept else None
+
+    spec = P(*(filter_axes(e) for e in spec))
+    return jax.lax.with_sharding_constraint(x, spec)

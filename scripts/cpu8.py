@@ -1,0 +1,26 @@
+"""Run a python snippet/module under an 8-device virtual CPU mesh.
+
+Usage: python scripts/cpu8.py -c "code" | python scripts/cpu8.py path.py
+Needed because the image's sitecustomize force-registers the real-TPU
+platform regardless of JAX_PLATFORMS.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+if sys.argv[1] == "-c":
+    exec(compile(sys.argv[2], "<cpu8>", "exec"), {"__name__": "__main__"})
+else:
+    path = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    exec(compile(open(path).read(), path, "exec"), {"__name__": "__main__"})

@@ -4,9 +4,18 @@ import sys
 # Force a deterministic 8-device virtual CPU mesh for all JAX-touching tests:
 # multi-chip sharding is validated on virtual devices (the driver separately
 # dry-runs the multichip path), single-real-chip runs happen only in bench.py.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+#
+# Note: this image registers the real-TPU "axon" platform from a
+# sitecustomize hook that overrides the JAX_PLATFORMS env var, so the env
+# var alone is not enough — we must also flip jax.config after import
+# (config wins over the boot-time registration).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
