@@ -14,7 +14,16 @@ jax/pjit programs covering every BASELINE.json config:
 
 These run *inside* scheduled pods (ProcessRuntime containers) with the
 TPU env injected by the device plugin; they are also imported directly by
-bench.py and __graft_entry__.py.
+bench.py and __graft_entry__.py.  Submodules import lazily so a container
+running only mnist doesn't pay for llama/resnet at startup.
 """
 
-from . import mnist, llama, resnet, ringattention, sharding  # noqa: F401
+import importlib
+
+_SUBMODULES = ("mnist", "llama", "resnet", "ringattention", "sharding")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

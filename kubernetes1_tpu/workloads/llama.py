@@ -86,7 +86,7 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
-    k = jax.random.split(key, 8)
+    k = jax.random.split(key, 9)
     d, hd = cfg.d_model, cfg.head_dim
     L = cfg.n_layers
 
@@ -110,7 +110,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             "w_down": w(k[7], (L, cfg.d_ff, d), cfg.d_ff),
         },
         "final_norm": norm_init((d,)),
-        "unembed": w(k[0], (d, cfg.vocab), d),
+        "unembed": w(k[8], (d, cfg.vocab), d),
     }
 
 
@@ -133,19 +133,9 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Causal GQA. q: (B,S,H,hd), k/v: (B,S,Hkv,hd)."""
-    groups = q.shape[2] // k.shape[2]
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
-    scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    logits = logits * scale
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    """Causal GQA via jax.nn.dot_product_attention (fused TPU lowering;
+    handles grouped KV heads natively). q: (B,S,H,hd), k/v: (B,S,Hkv,hd)."""
+    return jax.nn.dot_product_attention(q, k, v, is_causal=True)
 
 
 def layer_fn(cfg: LlamaConfig, x: jax.Array, lp: Dict[str, jax.Array],

@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def make_mesh(
@@ -45,15 +45,6 @@ def auto_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     fsdp = min(n, 8)
     dp = n // fsdp
     return make_mesh(dp=dp, fsdp=fsdp, tp=1, devices=devices[: dp * fsdp])
-
-
-def named(mesh: Mesh, *axes) -> NamedSharding:
-    return NamedSharding(mesh, P(*axes))
-
-
-def batch_spec() -> P:
-    """Batch dim sharded over both data axes (dp, fsdp) — standard FSDP."""
-    return P(("dp", "fsdp"))
 
 
 def constrain(x, spec: P):
