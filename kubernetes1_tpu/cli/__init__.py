@@ -1,0 +1,429 @@
+"""ktpu — the kubectl-equivalent CLI (ref: pkg/kubectl/cmd, 60 commands;
+the subset that covers daily driving of the cluster).
+
+Usage: python -m kubernetes1_tpu.cli [--server URL] <command> ...
+
+Commands: get, describe, apply, create, delete, scale, cordon, uncordon,
+drain, top, rollout, logs, wait, api-resources, version, cluster-up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import Any, List, Optional
+
+import yaml
+
+from ..api import types as t
+from ..client import Clientset
+from ..machinery import ApiError, NotFound
+from ..machinery.scheme import global_scheme
+from . import printers
+
+DEFAULT_SERVER = "http://127.0.0.1:8001"
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "ns": "namespaces", "namespace": "namespaces",
+    "deploy": "deployments", "deployment": "deployments",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "svc": "services", "service": "services",
+    "ep": "endpoints",
+    "ev": "events", "event": "events",
+    "job": "jobs",
+    "cm": "configmaps", "configmap": "configmaps",
+    "pc": "priorityclasses", "priorityclass": "priorityclasses",
+}
+
+
+def resolve_resource(name: str) -> str:
+    name = name.lower()
+    plural = ALIASES.get(name, name)
+    if plural not in global_scheme.by_resource:
+        known = ", ".join(sorted(global_scheme.by_resource))
+        raise SystemExit(f"error: unknown resource {name!r} (known: {known})")
+    return plural
+
+
+def split_target(args: List[str]):
+    """Accept both `kind name` and `kind/name` forms."""
+    if len(args) == 1 and "/" in args[0]:
+        kind, name = args[0].split("/", 1)
+        return resolve_resource(kind), name
+    kind = resolve_resource(args[0])
+    return kind, (args[1] if len(args) > 1 else "")
+
+
+def load_manifests(path: str) -> List[dict]:
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    if raw.lstrip().startswith("{"):
+        doc = json.loads(raw)
+        return doc.get("items", [doc]) if isinstance(doc, dict) else doc
+    return [d for d in yaml.safe_load_all(raw) if d]
+
+
+class CLI:
+    def __init__(self, server: str, namespace: str, out=None):
+        self.cs = Clientset(server)
+        self.ns = namespace
+        self.out = out or sys.stdout
+        self.scheme = global_scheme
+
+    # ------------------------------------------------------------------ get
+
+    def get(self, args):
+        plural = resolve_resource(args.resource)
+        client = self.cs.resource(plural)
+        show_ns = args.all_namespaces
+        if args.name:
+            objs = [client.get(args.name, self.ns)]
+        else:
+            ns = "" if args.all_namespaces or not self.scheme.namespaced[plural] else self.ns
+            objs, rv = client.list(namespace=ns, label_selector=args.selector or "")
+            if args.watch:
+                printers.print_objs(objs, args.output, self.scheme, self.out, show_ns)
+                with client.watch(namespace=ns, resource_version=rv) as stream:
+                    for etype, obj in stream:
+                        o = self.scheme.decode(obj)
+                        print(f"{etype}\t{o.metadata.namespace}/{o.metadata.name}",
+                              file=self.out)
+                return
+        printers.print_objs(objs, args.output, self.scheme, self.out, show_ns)
+
+    def describe(self, args):
+        plural, name = split_target([args.resource] + ([args.name] if args.name else []))
+        if not name:
+            raise SystemExit("error: describe needs a name")
+        obj = self.cs.resource(plural).get(name, self.ns)
+        events, _ = self.cs.events.list(namespace=self.ns)
+        related = [e for e in events
+                   if e.involved_object.name == name
+                   and e.involved_object.kind == obj.KIND]
+        printers.describe(obj, related, self.scheme, self.out)
+
+    # ---------------------------------------------------------- apply/create
+
+    def _apply_one(self, doc: dict, create_only: bool = False):
+        obj = self.scheme.decode(doc)
+        plural = self.scheme.resource_of[obj.KIND]
+        client = self.cs.resource(plural)
+        ns = obj.metadata.namespace or self.ns
+        if self.scheme.namespaced[plural]:
+            obj.metadata.namespace = ns
+        try:
+            existing = client.get(obj.metadata.name, ns)
+        except NotFound:
+            created = client.create(obj)
+            print(f"{plural}/{created.metadata.name} created", file=self.out)
+            return
+        if create_only:
+            raise SystemExit(f"error: {plural}/{obj.metadata.name} already exists")
+        # apply = merge patch of the manifest over the live object, so
+        # server-owned fields (nodeName, assigned devices, status) survive
+        updated = client.patch(obj.metadata.name, doc, ns)
+        print(f"{plural}/{updated.metadata.name} configured", file=self.out)
+
+    def apply(self, args):
+        for doc in load_manifests(args.filename):
+            self._apply_one(doc)
+
+    def create(self, args):
+        for doc in load_manifests(args.filename):
+            self._apply_one(doc, create_only=True)
+
+    def delete(self, args):
+        if args.filename:
+            for doc in load_manifests(args.filename):
+                obj = self.scheme.decode(doc)
+                plural = self.scheme.resource_of[obj.KIND]
+                ns = obj.metadata.namespace or self.ns
+                self.cs.resource(plural).delete(obj.metadata.name, ns,
+                                                grace_seconds=args.grace_period)
+                print(f"{plural}/{obj.metadata.name} deleted", file=self.out)
+            return
+        plural, name = split_target([args.resource] + ([args.name] if args.name else []))
+        if not name:
+            raise SystemExit("error: delete needs a name or -f file")
+        self.cs.resource(plural).delete(name, self.ns,
+                                        grace_seconds=args.grace_period)
+        print(f"{plural}/{name} deleted", file=self.out)
+
+    # ---------------------------------------------------------------- scale
+
+    def scale(self, args):
+        plural, name = split_target([args.target])
+        client = self.cs.resource(plural)
+        # patch, not get+update: controllers write these objects concurrently
+        if plural in ("deployments", "replicasets"):
+            client.patch(name, {"spec": {"replicas": args.replicas}}, self.ns)
+        elif plural == "jobs":
+            client.patch(name, {"spec": {"parallelism": args.replicas}}, self.ns)
+        else:
+            raise SystemExit(f"error: cannot scale {plural}")
+        print(f"{plural}/{name} scaled to {args.replicas}", file=self.out)
+
+    # ----------------------------------------------------------- node admin
+
+    def _set_unschedulable(self, name: str, value: bool):
+        # patch, not get+update: the kubelet heartbeat updates the node
+        # concurrently and a full replace would 409
+        self.cs.nodes.patch(name, {"spec": {"unschedulable": value}}, "")
+
+    def cordon(self, args):
+        self._set_unschedulable(args.node, True)
+        print(f"node/{args.node} cordoned", file=self.out)
+
+    def uncordon(self, args):
+        self._set_unschedulable(args.node, False)
+        print(f"node/{args.node} uncordoned", file=self.out)
+
+    def drain(self, args):
+        self._set_unschedulable(args.node, True)
+        pods, _ = self.cs.pods.list(field_selector=f"spec.nodeName={args.node}")
+        for p in pods:
+            owners = {o.kind for o in p.metadata.owner_references}
+            if "DaemonSet" in owners and not args.force:
+                continue
+            try:
+                self.cs.pods.delete(p.metadata.name, p.metadata.namespace, grace_seconds=0)
+            except NotFound:
+                continue  # already gone (e.g. its controller was deleted)
+            print(f"pod/{p.metadata.name} evicted", file=self.out)
+        print(f"node/{args.node} drained", file=self.out)
+
+    # ------------------------------------------------------------------ top
+
+    def top(self, args):
+        if args.what == "nodes":
+            nodes, _ = self.cs.nodes.list()
+            pods, _ = self.cs.pods.list()
+            used: dict = {}
+            for p in pods:
+                if p.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+                    continue
+                n = used.setdefault(p.spec.node_name, 0)
+                used[p.spec.node_name] = n + sum(
+                    len(er.assigned) or er.quantity for er in p.spec.extended_resources)
+            rows = []
+            for n in nodes:
+                devs = n.status.extended_resources.get("google.com/tpu", [])
+                rows.append((n.metadata.name, used.get(n.metadata.name, 0), len(devs)))
+            print("NODE            TPU-USED  TPU-TOTAL  UTIL%", file=self.out)
+            for name, u, total in rows:
+                pct = f"{100 * u / total:.0f}" if total else "-"
+                print(f"{name:<15} {u:<9} {total:<10} {pct}", file=self.out)
+        else:
+            pods, _ = self.cs.pods.list(namespace=self.ns)
+            print("POD             PHASE      TPUS", file=self.out)
+            for p in pods:
+                chips = sum(len(er.assigned) or er.quantity
+                            for er in p.spec.extended_resources)
+                print(f"{p.metadata.name:<15} {p.status.phase:<10} {chips}", file=self.out)
+
+    # -------------------------------------------------------------- rollout
+
+    def rollout(self, args):
+        plural, name = split_target([args.target])
+        if plural != "deployments":
+            raise SystemExit("error: rollout supports deployments")
+        if args.action == "status":
+            deadline = time.time() + args.timeout
+            while time.time() < deadline:
+                d = self.cs.deployments.get(name, self.ns)
+                want = d.spec.replicas or 0
+                if (d.status.observed_generation >= d.metadata.generation
+                        and d.status.updated_replicas == want
+                        and d.status.available_replicas == want
+                        and d.status.replicas == want):  # old-RS pods gone too
+                    print(f'deployment "{name}" successfully rolled out', file=self.out)
+                    return
+                time.sleep(0.3)
+            raise SystemExit(f'error: deployment "{name}" rollout timed out')
+        if args.action == "restart":
+            stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            self.cs.deployments.patch(name, {"spec": {"template": {"metadata": {
+                "annotations": {"ktpu.io/restartedAt": stamp}}}}}, self.ns)
+            print(f"deployment/{name} restarted", file=self.out)
+            return
+        raise SystemExit(f"error: unknown rollout action {args.action!r}")
+
+    # ----------------------------------------------------------------- logs
+
+    def logs(self, args):
+        pod = self.cs.pods.get(args.pod, self.ns)
+        if not pod.spec.node_name:
+            raise SystemExit("error: pod not scheduled yet")
+        node = self.cs.nodes.get(pod.spec.node_name, "")
+        base = node.metadata.annotations.get("kubelet.ktpu.io/server")
+        if not base:
+            raise SystemExit(
+                "error: node does not advertise a kubelet server endpoint")
+        import urllib.request
+
+        url = (f"{base}/containerLogs/{pod.metadata.namespace}/{pod.metadata.name}"
+               f"/{args.container or pod.spec.containers[0].name}")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            self.out.write(resp.read().decode(errors="replace"))
+
+    # ----------------------------------------------------------------- wait
+
+    def wait(self, args):
+        plural, name = split_target([args.target])
+        cond = args.condition.removeprefix("condition=").lower()
+        deadline = time.time() + args.timeout
+        client = self.cs.resource(plural)
+        while time.time() < deadline:
+            try:
+                obj = client.get(name, self.ns)
+            except NotFound:
+                if cond == "delete":
+                    print(f"{plural}/{name} condition met", file=self.out)
+                    return
+                time.sleep(0.3)
+                continue
+            ok = False
+            if cond == "ready" and obj.KIND == "Pod":
+                ok = any(c.type == "Ready" and c.status == "True"
+                         for c in obj.status.conditions)
+            elif cond == "complete" and obj.KIND == "Job":
+                ok = any(c.type == "Complete" and c.status == "True"
+                         for c in obj.status.conditions)
+            elif cond.startswith("phase="):
+                ok = obj.status.phase.lower() == cond.split("=", 1)[1]
+            if ok:
+                print(f"{plural}/{name} condition met", file=self.out)
+                return
+            time.sleep(0.3)
+        raise SystemExit(f"error: timed out waiting for {args.condition} on {plural}/{name}")
+
+    # ------------------------------------------------------------- misc
+
+    def api_resources(self, args):
+        print("NAME                 NAMESPACED  KIND", file=self.out)
+        for plural, cls in sorted(global_scheme.by_resource.items()):
+            print(f"{plural:<20} {str(global_scheme.namespaced[plural]):<11} {cls.KIND}",
+                  file=self.out)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ktpu", description=__doc__)
+    p.add_argument("--server", "-s", default=None,
+                   help=f"apiserver URL (default $KTPU_SERVER or {DEFAULT_SERVER})")
+    p.add_argument("--namespace", "-n", default="default")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?", default="")
+    g.add_argument("-o", "--output", default="table",
+                   choices=["table", "json", "yaml", "name", "wide"])
+    g.add_argument("-l", "--selector", default="")
+    g.add_argument("-A", "--all-namespaces", action="store_true")
+    g.add_argument("-w", "--watch", action="store_true")
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name", nargs="?", default="")
+
+    for verb in ("apply", "create"):
+        a = sub.add_parser(verb)
+        a.add_argument("-f", "--filename", required=True)
+
+    de = sub.add_parser("delete")
+    de.add_argument("resource", nargs="?", default="")
+    de.add_argument("name", nargs="?", default="")
+    de.add_argument("-f", "--filename", default="")
+    de.add_argument("--grace-period", type=int, default=None)
+
+    sc = sub.add_parser("scale")
+    sc.add_argument("target")
+    sc.add_argument("--replicas", type=int, required=True)
+
+    for verb in ("cordon", "uncordon", "drain"):
+        c = sub.add_parser(verb)
+        c.add_argument("node")
+        if verb == "drain":
+            c.add_argument("--force", action="store_true")
+
+    tp = sub.add_parser("top")
+    tp.add_argument("what", choices=["nodes", "pods"])
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "restart"])
+    ro.add_argument("target")
+    ro.add_argument("--timeout", type=float, default=60)
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("pod")
+    lg.add_argument("-c", "--container", default="")
+
+    w = sub.add_parser("wait")
+    w.add_argument("target")
+    w.add_argument("--for", dest="condition", required=True)
+    w.add_argument("--timeout", type=float, default=60)
+
+    sub.add_parser("api-resources")
+    sub.add_parser("version")
+
+    cu = sub.add_parser("cluster-up")
+    cu.add_argument("--nodes", type=int, default=1)
+    cu.add_argument("--tpus-per-node", type=int, default=4)
+    cu.add_argument("--port", type=int, default=8001)
+    cu.add_argument("--hollow", action="store_true",
+                    help="FakeRuntime nodes (default: real process runtime)")
+    cu.add_argument("--real-tpu", action="store_true",
+                    help="node 0 advertises the host's real /dev/accel* chips")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import os
+
+    args = build_parser().parse_args(argv)
+    if args.cmd == "version":
+        print("ktpu v0.1 (kubernetes1_tpu)")
+        return 0
+    if args.cmd == "cluster-up":
+        from ..localcluster import LocalCluster
+
+        cluster = LocalCluster(nodes=args.nodes, tpus_per_node=args.tpus_per_node,
+                               hollow=args.hollow, real_tpu=args.real_tpu,
+                               port=args.port)
+        cluster.start()
+        print(f"cluster up: apiserver {cluster.url}")
+        print(f"  ktpu --server {cluster.url} get nodes")
+        stop = []
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        while not stop:
+            time.sleep(0.5)
+        cluster.stop()
+        return 0
+
+    server = args.server or os.environ.get("KTPU_SERVER", DEFAULT_SERVER)
+    cli = CLI(server, args.namespace)
+    try:
+        dispatch(cli, args)
+        return 0
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        cli.cs.close()
+
+
+def dispatch(cli: CLI, args) -> None:
+    handler = {
+        "get": cli.get, "describe": cli.describe, "apply": cli.apply,
+        "create": cli.create, "delete": cli.delete, "scale": cli.scale,
+        "cordon": cli.cordon, "uncordon": cli.uncordon, "drain": cli.drain,
+        "top": cli.top, "rollout": cli.rollout, "logs": cli.logs,
+        "wait": cli.wait, "api-resources": cli.api_resources,
+    }[args.cmd]
+    handler(args)

@@ -47,6 +47,13 @@ class WatchStream:
         ):
             return
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def close(self):
         self._closed = True
         try:

@@ -1,0 +1,122 @@
+"""CLI tests (ref: pkg/kubectl/cmd tests): drive ktpu commands against a
+hollow LocalCluster through the real HTTP apiserver."""
+
+import io
+import json
+
+import pytest
+import yaml
+
+from kubernetes1_tpu.cli import CLI, build_parser, dispatch
+from kubernetes1_tpu.localcluster import LocalCluster
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(nodes=2, tpus_per_node=4, hollow=True).start().wait_ready()
+    yield c
+    c.stop()
+
+
+def run_cli(cluster, *argv):
+    out = io.StringIO()
+    cli = CLI(cluster.url, "default", out=out)
+    args = build_parser().parse_args(["--server", cluster.url] + list(argv))
+    try:
+        dispatch(cli, args)
+    finally:
+        cli.cs.close()
+    return out.getvalue()
+
+
+def test_get_nodes_table(cluster):
+    out = run_cli(cluster, "get", "nodes")
+    assert "node-0" in out and "node-1" in out
+    assert "Ready" in out
+    assert "4/4" in out  # healthy/total chips
+
+
+def test_apply_get_delete_roundtrip(cluster, tmp_path):
+    manifest = {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "cli-pod"},
+        "spec": {"containers": [{"name": "c", "image": "busybox",
+                                 "command": ["sleep", "60"]}]},
+    }
+    f = tmp_path / "pod.yaml"
+    f.write_text(yaml.safe_dump(manifest))
+    out = run_cli(cluster, "apply", "-f", str(f))
+    assert "pods/cli-pod created" in out
+
+    out = run_cli(cluster, "get", "pods", "cli-pod", "-o", "json")
+    assert json.loads(out)["metadata"]["name"] == "cli-pod"
+
+    out = run_cli(cluster, "apply", "-f", str(f))  # idempotent re-apply
+    assert "pods/cli-pod configured" in out
+
+    out = run_cli(cluster, "describe", "pod", "cli-pod")
+    assert "Name:         cli-pod" in out
+
+    out = run_cli(cluster, "delete", "pod", "cli-pod")
+    assert "deleted" in out
+
+
+def test_deployment_scale_and_rollout(cluster, tmp_path):
+    manifest = {
+        "kind": "Deployment", "apiVersion": "apps/v1",
+        "metadata": {"name": "web"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "web"}},
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {"containers": [{"name": "c", "image": "busybox",
+                                         "command": ["sleep", "300"]}]},
+            },
+        },
+    }
+    f = tmp_path / "deploy.yaml"
+    f.write_text(yaml.safe_dump(manifest))
+    run_cli(cluster, "apply", "-f", str(f))
+    out = run_cli(cluster, "rollout", "status", "deployment/web", "--timeout", "30")
+    assert "successfully rolled out" in out
+
+    out = run_cli(cluster, "scale", "deployment/web", "--replicas", "3")
+    assert "scaled to 3" in out
+    must_poll_until(
+        lambda: "3/3" in run_cli(cluster, "get", "deploy", "web"),
+        timeout=30, desc="deployment scales to 3")
+    run_cli(cluster, "delete", "deployment", "web")
+
+
+def test_cordon_drain_uncordon(cluster):
+    out = run_cli(cluster, "cordon", "node-1")
+    assert "cordoned" in out
+    out = run_cli(cluster, "get", "nodes")
+    assert "SchedulingDisabled" in out
+    out = run_cli(cluster, "drain", "node-1")
+    assert "drained" in out
+    run_cli(cluster, "uncordon", "node-1")
+    assert "SchedulingDisabled" not in run_cli(cluster, "get", "nodes")
+
+
+def test_top_nodes(cluster):
+    out = run_cli(cluster, "top", "nodes")
+    assert "TPU-USED" in out and "node-0" in out
+
+
+def test_api_resources(cluster):
+    out = run_cli(cluster, "api-resources")
+    assert "pods" in out and "Pod" in out
+
+
+def test_wait_for_delete(cluster):
+    from tests.helpers import make_tpu_pod
+
+    cli = CLI(cluster.url, "default", out=io.StringIO())
+    cli.cs.pods.create(make_tpu_pod("wait-pod", tpus=0))
+    cli.cs.pods.delete("wait-pod", "default", grace_seconds=0)
+    out = run_cli(cluster, "wait", "pods/wait-pod", "--for", "delete", "--timeout", "20")
+    assert "condition met" in out
+    cli.cs.close()
