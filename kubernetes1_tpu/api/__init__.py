@@ -16,6 +16,8 @@ _REGISTRY = [
     (t.ReplicaSet, "replicasets", True),
     (t.Deployment, "deployments", True),
     (t.DaemonSet, "daemonsets", True),
+    (t.StatefulSet, "statefulsets", True),
+    (t.CronJob, "cronjobs", True),
     (t.Service, "services", True),
     (t.Endpoints, "endpoints", True),
     (t.ConfigMap, "configmaps", True),

@@ -611,6 +611,86 @@ class DaemonSet(KObject):
 
 
 @dataclass
+class RollingUpdateStatefulSetStrategy:
+    partition: int = 0
+
+
+@dataclass
+class StatefulSetUpdateStrategy:
+    type: str = "RollingUpdate"  # RollingUpdate | OnDelete
+    rolling_update: Optional[RollingUpdateStatefulSetStrategy] = None
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: Optional[int] = None
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    service_name: str = ""
+    # OrderedReady: create/delete one ordinal at a time; Parallel: all at once.
+    pod_management_policy: str = "OrderedReady"  # OrderedReady | Parallel
+    update_strategy: StatefulSetUpdateStrategy = field(
+        default_factory=StatefulSetUpdateStrategy
+    )
+
+
+@dataclass
+class StatefulSetStatus:
+    observed_generation: int = 0
+    replicas: int = 0
+    ready_replicas: int = 0
+    current_replicas: int = 0
+    updated_replicas: int = 0
+    current_revision: str = ""
+    update_revision: str = ""
+
+
+@dataclass
+class StatefulSet(KObject):
+    """Stable-identity workload (ref: pkg/apis/apps/types.go StatefulSet;
+    controller at pkg/controller/statefulset/stateful_set.go)."""
+
+    KIND = "StatefulSet"
+    API_VERSION = "apps/v1"
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+
+
+@dataclass
+class JobTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+
+
+@dataclass
+class CronJobSpec:
+    schedule: str = ""  # 5-field cron, local time
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    starting_deadline_seconds: Optional[int] = None
+    job_template: JobTemplateSpec = field(default_factory=JobTemplateSpec)
+    successful_jobs_history_limit: int = 3
+    failed_jobs_history_limit: int = 1
+
+
+@dataclass
+class CronJobStatus:
+    active: List["ObjectReference"] = field(default_factory=list)
+    last_schedule_time: str = ""
+
+
+@dataclass
+class CronJob(KObject):
+    """Scheduled Jobs (ref: pkg/apis/batch/types.go CronJob; controller at
+    pkg/controller/cronjob/cronjob_controller.go)."""
+
+    KIND = "CronJob"
+    API_VERSION = "batch/v1"
+    spec: CronJobSpec = field(default_factory=CronJobSpec)
+    status: CronJobStatus = field(default_factory=CronJobStatus)
+
+
+@dataclass
 class ServicePort:
     name: str = ""
     port: int = 0

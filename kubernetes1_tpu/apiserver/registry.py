@@ -160,6 +160,28 @@ class DeploymentStrategy_(ReplicaSetStrategy):
     pass
 
 
+class StatefulSetStrategy(ReplicaSetStrategy):
+    def validate(self, obj):
+        super().validate(obj)
+        if obj.spec.pod_management_policy not in ("OrderedReady", "Parallel"):
+            raise Invalid("podManagementPolicy must be OrderedReady or Parallel")
+        if obj.spec.update_strategy.type not in ("RollingUpdate", "OnDelete"):
+            raise Invalid("updateStrategy.type must be RollingUpdate or OnDelete")
+
+
+class CronJobStrategy(Strategy):
+    def validate(self, obj):
+        super().validate(obj)
+        from ..utils.cron import parse_cron
+
+        try:
+            parse_cron(obj.spec.schedule)
+        except ValueError as e:
+            raise Invalid(f"spec.schedule: {e}")
+        if obj.spec.concurrency_policy not in ("Allow", "Forbid", "Replace"):
+            raise Invalid("concurrencyPolicy must be Allow, Forbid or Replace")
+
+
 _STRATEGIES: Dict[str, Strategy] = {}
 
 
@@ -171,6 +193,8 @@ def strategy_for(resource: str) -> Strategy:
             "jobs": JobStrategy,
             "replicasets": ReplicaSetStrategy,
             "deployments": DeploymentStrategy_,
+            "statefulsets": StatefulSetStrategy,
+            "cronjobs": CronJobStrategy,
         }.get(resource, Strategy)()
     return _STRATEGIES[resource]
 

@@ -37,6 +37,8 @@ ALIASES = {
     "ep": "endpoints",
     "ev": "events", "event": "events",
     "job": "jobs",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "cj": "cronjobs", "cronjob": "cronjobs",
     "cm": "configmaps", "configmap": "configmaps",
     "pc": "priorityclasses", "priorityclass": "priorityclasses",
 }
@@ -160,7 +162,7 @@ class CLI:
         plural, name = split_target([args.target])
         client = self.cs.resource(plural)
         # patch, not get+update: controllers write these objects concurrently
-        if plural in ("deployments", "replicasets"):
+        if plural in ("deployments", "replicasets", "statefulsets"):
             client.patch(name, {"spec": {"replicas": args.replicas}}, self.ns)
         elif plural == "jobs":
             client.patch(name, {"spec": {"parallelism": args.replicas}}, self.ns)

@@ -15,9 +15,11 @@ from .rest import ApiClient, WatchStream
 
 _GROUP_PATH = {
     "jobs": "/apis/batch/v1",
+    "cronjobs": "/apis/batch/v1",
     "replicasets": "/apis/apps/v1",
     "deployments": "/apis/apps/v1",
     "daemonsets": "/apis/apps/v1",
+    "statefulsets": "/apis/apps/v1",
     "priorityclasses": "/apis/scheduling/v1",
 }
 
@@ -155,6 +157,14 @@ class Clientset:
     @property
     def daemonsets(self) -> ResourceClient:
         return self.resource("daemonsets")
+
+    @property
+    def statefulsets(self) -> ResourceClient:
+        return self.resource("statefulsets")
+
+    @property
+    def cronjobs(self) -> ResourceClient:
+        return self.resource("cronjobs")
 
     @property
     def services(self) -> ResourceClient:

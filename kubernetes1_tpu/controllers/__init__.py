@@ -6,3 +6,5 @@ from .daemonset import DaemonSetController
 from .nodelifecycle import NodeLifecycleController
 from .namespace import NamespaceController, GarbageCollector
 from .endpoints import EndpointsController
+from .statefulset import StatefulSetController
+from .cronjob import CronJobController

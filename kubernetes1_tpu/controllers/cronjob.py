@@ -1,0 +1,217 @@
+"""CronJob controller (ref: pkg/controller/cronjob/cronjob_controller.go):
+creates Jobs on a cron schedule with concurrency policy and history limits.
+
+Unlike most controllers this one is clock-driven: each sync computes the
+next fire time and re-arms itself via the delaying workqueue (the
+reference polls syncAll every 10s; the workqueue re-arm is the
+level-triggered equivalent without the global poll).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional
+
+from ..api import types as t
+from ..machinery import AlreadyExists, ApiError, NotFound
+from ..machinery.meta import parse_iso
+from ..machinery.scheme import from_dict, to_dict
+from ..utils.cron import next_fire, unmet_times
+from .base import Controller
+
+
+def _utc(ts: float) -> datetime.datetime:
+    return datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+
+
+class CronJobController(Controller):
+    name = "cronjob-controller"
+
+    def __init__(self, *args, clock=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        import time as _time
+
+        self.clock = clock or _time.time
+
+    def setup(self):
+        self.cronjobs = self.factory.informer("cronjobs")
+        self.jobs = self.factory.informer("jobs")
+        self.cronjobs.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self.enqueue,
+        )
+        self.jobs.add_handler(
+            on_add=self._job_event,
+            on_update=lambda _o, n: self._job_event(n),
+            on_delete=self._job_event,
+        )
+
+    def _job_event(self, job: t.Job):
+        for ref in job.metadata.owner_references:
+            if ref.kind == "CronJob" and ref.controller:
+                self.queue.add(f"{job.metadata.namespace}/{ref.name}")
+
+    def _owned_jobs(self, cj: t.CronJob) -> List[t.Job]:
+        return [
+            j
+            for j in self.jobs.list()
+            if j.metadata.namespace == cj.metadata.namespace
+            and any(
+                r.kind == "CronJob" and r.uid == cj.metadata.uid and r.controller
+                for r in j.metadata.owner_references
+            )
+        ]
+
+    @staticmethod
+    def _finished(job: t.Job) -> str:
+        for c in job.status.conditions:
+            if c.type in ("Complete", "Failed") and c.status == "True":
+                return c.type
+        return ""
+
+    def _new_job(self, cj: t.CronJob, fire: datetime.datetime) -> t.Job:
+        job = t.Job()
+        # name encodes the scheduled minute so a missed double-create is an
+        # AlreadyExists no-op (ref: getJobName, scheduledTimeHash)
+        job.metadata.name = f"{cj.metadata.name}-{int(fire.timestamp()) // 60}"
+        job.metadata.namespace = cj.metadata.namespace
+        job.metadata.labels = dict(cj.spec.job_template.metadata.labels)
+        job.metadata.annotations = dict(cj.spec.job_template.metadata.annotations)
+        job.metadata.owner_references = [
+            t.OwnerReference(
+                api_version=cj.API_VERSION, kind="CronJob",
+                name=cj.metadata.name, uid=cj.metadata.uid, controller=True,
+            )
+        ]
+        job.spec = from_dict(t.JobSpec, to_dict(cj.spec.job_template.spec))
+        return job
+
+    def sync(self, key: str):
+        cj = self.cronjobs.get(key)
+        if cj is None or cj.metadata.deletion_timestamp:
+            return
+        now = _utc(self.clock())
+        jobs = self._owned_jobs(cj)
+        active = [j for j in jobs if not self._finished(j)]
+        self._prune_history(cj, jobs)
+        self._reconcile_active(cj, active)
+
+        if not cj.spec.suspend:
+            earliest = (
+                _utc(parse_iso(cj.status.last_schedule_time))
+                if cj.status.last_schedule_time
+                else _utc(parse_iso(cj.metadata.creation_timestamp))
+            )
+            times, truncated = unmet_times(cj.spec.schedule, earliest, now)
+            if truncated:
+                # Too many missed starts (controller down for a long time):
+                # start nothing for the stale backlog — firing times[-1]
+                # would trigger a catch-up storm — and advance
+                # lastScheduleTime to now so the controller recovers.
+                self.recorder.event(
+                    cj, "Warning", "TooManyMissedTimes",
+                    f"too many missed start times since {earliest}; "
+                    "skipping backlog",
+                )
+                self._record_schedule_time(cj, now, None, active)
+            elif times:
+                fire = times[-1]  # only the most recent unmet time is acted on
+                deadline_ok = (
+                    cj.spec.starting_deadline_seconds is None
+                    or (now - fire).total_seconds()
+                    <= cj.spec.starting_deadline_seconds
+                )
+                if deadline_ok and self._concurrency_allows(cj, active):
+                    if cj.spec.concurrency_policy == "Replace":
+                        active = []  # the previous jobs were just deleted
+                    self._start_job(cj, fire, active)
+
+        # re-arm for the next scheduled minute
+        try:
+            nxt = next_fire(cj.spec.schedule, now)
+            self.enqueue_after(key, max(1.0, (nxt - now).total_seconds()))
+        except ValueError:
+            pass
+
+    def _concurrency_allows(self, cj: t.CronJob, active: List[t.Job]) -> bool:
+        if not active or cj.spec.concurrency_policy == "Allow":
+            return True
+        if cj.spec.concurrency_policy == "Forbid":
+            self.recorder.event(
+                cj, "Normal", "JobAlreadyActive",
+                "skipping schedule: previous job still active",
+            )
+            return False
+        # Replace: kill the running jobs, then start fresh
+        for j in active:
+            try:
+                self.cs.jobs.delete(j.metadata.name, j.metadata.namespace)
+            except ApiError:
+                pass
+        return True
+
+    @staticmethod
+    def _job_ref(job: t.Job) -> t.ObjectReference:
+        return t.ObjectReference(kind="Job", namespace=job.metadata.namespace,
+                                 name=job.metadata.name, uid=job.metadata.uid)
+
+    def _reconcile_active(self, cj: t.CronJob, active: List[t.Job]):
+        """Drop finished/deleted jobs from status.active (the reference
+        prunes active each sync; without this, completed jobs linger)."""
+        want = sorted((r.uid for r in map(self._job_ref, active)))
+        have = sorted(r.uid for r in cj.status.active)
+        if want == have:
+            return
+        self._record_schedule_time(cj, None, None, active)
+
+    def _record_schedule_time(
+        self,
+        cj: t.CronJob,
+        schedule_time: Optional[datetime.datetime],
+        new_job: Optional[t.Job],
+        active: List[t.Job],
+    ):
+        try:
+            fresh = self.cs.cronjobs.get(cj.metadata.name, cj.metadata.namespace)
+        except NotFound:
+            return
+        if schedule_time is not None:
+            fresh.status.last_schedule_time = (
+                schedule_time.strftime("%Y-%m-%dT%H:%M:%S") + "Z"
+            )
+        refs = [self._job_ref(j) for j in active]
+        if new_job is not None:
+            refs.insert(0, self._job_ref(new_job))
+        fresh.status.active = refs
+        try:
+            self.cs.cronjobs.update_status(fresh)
+        except ApiError:
+            pass
+
+    def _start_job(self, cj: t.CronJob, fire: datetime.datetime, active: List[t.Job]):
+        job = self._new_job(cj, fire)
+        try:
+            created = self.cs.jobs.create(job)
+        except AlreadyExists:
+            return
+        except ApiError:
+            return
+        self.recorder.event(cj, "Normal", "SuccessfulCreate",
+                            f"created job {created.metadata.name}")
+        self._record_schedule_time(cj, fire, created, active)
+
+    def _prune_history(self, cj: t.CronJob, jobs: List[t.Job]):
+        for kind, limit in (
+            ("Complete", cj.spec.successful_jobs_history_limit),
+            ("Failed", cj.spec.failed_jobs_history_limit),
+        ):
+            done = sorted(
+                (j for j in jobs if self._finished(j) == kind),
+                key=lambda j: j.metadata.creation_timestamp,
+            )
+            for j in done[: max(0, len(done) - limit)]:
+                try:
+                    self.cs.jobs.delete(j.metadata.name, j.metadata.namespace)
+                except ApiError:
+                    pass

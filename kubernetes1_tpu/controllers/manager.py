@@ -8,6 +8,7 @@ import threading
 from typing import List, Optional
 
 from ..client import Clientset, InformerFactory, LeaderElector
+from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .endpoints import EndpointsController
@@ -15,6 +16,7 @@ from .job import JobController
 from .namespace import GarbageCollector, NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 
 
 class ControllerManager:
@@ -33,6 +35,8 @@ class ControllerManager:
             ReplicaSetController(clientset, self.factory),
             DeploymentController(clientset, self.factory),
             DaemonSetController(clientset, self.factory),
+            StatefulSetController(clientset, self.factory),
+            CronJobController(clientset, self.factory),
             NamespaceController(clientset, self.factory),
             GarbageCollector(clientset, self.factory),
             EndpointsController(clientset, self.factory),

@@ -21,8 +21,8 @@ from ..machinery import ApiError, NotFound
 from .base import Controller
 
 NAMESPACED_RESOURCES = (
-    "pods", "jobs", "replicasets", "deployments", "daemonsets",
-    "services", "endpoints", "configmaps", "events", "leases",
+    "pods", "jobs", "cronjobs", "replicasets", "deployments", "daemonsets",
+    "statefulsets", "services", "endpoints", "configmaps", "events", "leases",
 )
 
 
@@ -61,7 +61,9 @@ class NamespaceController(Controller):
             self.enqueue_after(key, 0.5)
 
 
-OWNED_RESOURCES = ("pods", "replicasets")
+OWNED_RESOURCES = ("pods", "replicasets", "jobs")
+OWNER_RESOURCES = ("jobs", "replicasets", "deployments", "daemonsets",
+                   "statefulsets", "cronjobs")
 
 
 class GarbageCollector(Controller):
@@ -72,11 +74,13 @@ class GarbageCollector(Controller):
         "ReplicaSet": "replicasets",
         "Deployment": "deployments",
         "DaemonSet": "daemonsets",
+        "StatefulSet": "statefulsets",
+        "CronJob": "cronjobs",
     }
 
     def setup(self):
         self.informers: Dict[str, object] = {}
-        for resource in OWNED_RESOURCES + ("jobs", "deployments", "daemonsets"):
+        for resource in set(OWNED_RESOURCES + OWNER_RESOURCES):
             self.informers[resource] = self.factory.informer(resource)
         for resource in OWNED_RESOURCES:
             inf = self.informers[resource]
@@ -84,7 +88,7 @@ class GarbageCollector(Controller):
                 on_add=lambda o, r=resource: self.queue.add(f"{r}|{o.key()}")
             )
         # owner deletions re-scan owned kinds
-        for owner in ("jobs", "replicasets", "deployments", "daemonsets"):
+        for owner in OWNER_RESOURCES:
             self.informers[owner].add_handler(
                 on_delete=lambda o: self._rescan()
             )

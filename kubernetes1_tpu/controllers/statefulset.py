@@ -1,0 +1,190 @@
+"""StatefulSet controller (ref: pkg/controller/statefulset/
+stateful_set.go + stateful_set_control.go): stable pod identity
+`<name>-<ordinal>`, ordered scale-up/down, partitioned rolling updates.
+
+TPU relevance: stable ordinals give multi-host workers persistent
+identities across restarts (same role as Indexed Jobs, but for
+long-running serving/parameter-server shapes).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from ..api import types as t
+from ..machinery import ApiError, NotFound
+from ..machinery.scheme import from_dict, to_dict
+from .base import Controller
+from .deployment import template_hash
+
+POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"
+REVISION_LABEL = "controller-revision-hash"
+
+_ORDINAL_RE = re.compile(r"^(.*)-(\d+)$")
+
+
+def ordinal_of(pod_name: str, parent: str) -> Optional[int]:
+    m = _ORDINAL_RE.match(pod_name)
+    if m and m.group(1) == parent:
+        return int(m.group(2))
+    return None
+
+
+def is_ready(pod: t.Pod) -> bool:
+    return pod.status.phase == t.POD_RUNNING and any(
+        c.type == "Ready" and c.status == "True" for c in pod.status.conditions
+    )
+
+
+class StatefulSetController(Controller):
+    name = "statefulset-controller"
+
+    def setup(self):
+        self.ssets = self.factory.informer("statefulsets")
+        self.pods = self.factory.informer("pods")
+        self.ssets.add_handler(
+            on_add=self.enqueue,
+            on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self.enqueue,
+        )
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _pod_event(self, pod: t.Pod):
+        for ref in pod.metadata.owner_references:
+            if ref.kind == "StatefulSet" and ref.controller:
+                self.queue.add(f"{pod.metadata.namespace}/{ref.name}")
+
+    def _owned_pods(self, ss: t.StatefulSet) -> Dict[int, t.Pod]:
+        out: Dict[int, t.Pod] = {}
+        for p in self.pods.list():
+            if p.metadata.namespace != ss.metadata.namespace:
+                continue
+            if not any(
+                r.kind == "StatefulSet" and r.uid == ss.metadata.uid and r.controller
+                for r in p.metadata.owner_references
+            ):
+                continue
+            o = ordinal_of(p.metadata.name, ss.metadata.name)
+            if o is not None:
+                out[o] = p
+        return out
+
+    def _new_pod(self, ss: t.StatefulSet, ordinal: int, revision: str) -> t.Pod:
+        pod = t.Pod()
+        pod.metadata.name = f"{ss.metadata.name}-{ordinal}"
+        pod.metadata.namespace = ss.metadata.namespace
+        pod.metadata.labels = dict(ss.spec.template.metadata.labels)
+        pod.metadata.labels[POD_NAME_LABEL] = pod.metadata.name
+        pod.metadata.labels[REVISION_LABEL] = revision
+        pod.metadata.annotations = dict(ss.spec.template.metadata.annotations)
+        pod.metadata.owner_references = [
+            t.OwnerReference(
+                api_version=ss.API_VERSION, kind="StatefulSet",
+                name=ss.metadata.name, uid=ss.metadata.uid, controller=True,
+            )
+        ]
+        pod.spec = from_dict(t.PodSpec, to_dict(ss.spec.template.spec))
+        return pod
+
+    def sync(self, key: str):
+        ss = self.ssets.get(key)
+        if ss is None or ss.metadata.deletion_timestamp:
+            return
+        want = ss.spec.replicas if ss.spec.replicas is not None else 1
+        update_rev = template_hash(ss.spec.template)
+        pods = self._owned_pods(ss)
+        ordered = ss.spec.pod_management_policy == "OrderedReady"
+
+        # Replace failed/succeeded pods first (the controller always
+        # recreates a dead stateful pod under the same identity).
+        for o, p in sorted(pods.items()):
+            if o < want and p.status.phase in (t.POD_FAILED, t.POD_SUCCEEDED):
+                self._delete(p)
+                return  # re-sync after the delete is observed
+
+        # Scale up: fill missing ordinals ascending.
+        for o in range(want):
+            p = pods.get(o)
+            if p is None or p.metadata.deletion_timestamp:
+                if p is None:
+                    try:
+                        self.cs.pods.create(self._new_pod(ss, o, update_rev))
+                    except ApiError:
+                        pass
+                if ordered:
+                    self._update_status(ss, pods, want, update_rev)
+                    return
+                continue
+            if ordered and not is_ready(p):
+                # OrderedReady: wait for this ordinal before touching higher ones
+                self._update_status(ss, pods, want, update_rev)
+                return
+
+        # Scale down: remove highest ordinals first, one at a time if ordered.
+        excess = sorted((o for o in pods if o >= want), reverse=True)
+        for o in excess:
+            if not pods[o].metadata.deletion_timestamp:
+                self._delete(pods[o])
+                if ordered:
+                    self._update_status(ss, pods, want, update_rev)
+                    return
+
+        # Rolling update: delete out-of-date pods with ordinal >= partition,
+        # highest first, one at a time. Readiness-gated regardless of
+        # podManagementPolicy (the policy only governs scaling): the next
+        # delete waits until every current pod is back Running+Ready.
+        if ss.spec.update_strategy.type == "RollingUpdate" and not excess:
+            current = [p for o, p in pods.items() if o < want]
+            all_ready = len(current) == want and all(
+                is_ready(p) and not p.metadata.deletion_timestamp for p in current
+            )
+            ru = ss.spec.update_strategy.rolling_update
+            partition = ru.partition if ru else 0
+            for o in sorted((o for o in pods if o < want), reverse=True):
+                p = pods[o]
+                if o < partition or p.metadata.deletion_timestamp:
+                    continue
+                if p.metadata.labels.get(REVISION_LABEL) != update_rev:
+                    if all_ready:
+                        self._delete(p)
+                    break  # one at a time
+
+        self._update_status(ss, pods, want, update_rev)
+
+    def _delete(self, pod: t.Pod):
+        try:
+            self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+        except ApiError:
+            pass
+
+    def _update_status(
+        self, ss: t.StatefulSet, pods: Dict[int, t.Pod], want: int, update_rev: str
+    ):
+        try:
+            fresh = self.cs.statefulsets.get(ss.metadata.name, ss.metadata.namespace)
+        except NotFound:
+            return
+        alive = [
+            p for o, p in pods.items()
+            if o < want and not p.metadata.deletion_timestamp
+            and p.status.phase not in (t.POD_FAILED, t.POD_SUCCEEDED)
+        ]
+        fresh.status.replicas = len(alive)
+        fresh.status.ready_replicas = sum(1 for p in alive if is_ready(p))
+        fresh.status.updated_replicas = sum(
+            1 for p in alive if p.metadata.labels.get(REVISION_LABEL) == update_rev
+        )
+        fresh.status.current_replicas = fresh.status.updated_replicas
+        fresh.status.update_revision = update_rev
+        if fresh.status.updated_replicas == len(alive):
+            fresh.status.current_revision = update_rev
+        fresh.status.observed_generation = fresh.metadata.generation
+        try:
+            self.cs.statefulsets.update_status(fresh)
+        except ApiError:
+            pass

@@ -1,0 +1,105 @@
+"""Minimal 5-field cron schedule parser + next-fire computation.
+
+The reference's CronJob controller delegates to robfig/cron
+(pkg/controller/cronjob/utils.go getRecentUnmetScheduleTimes); this is a
+self-contained equivalent supporting the standard syntax subset the
+controller needs: "*", numbers, ranges (a-b), steps (*/n, a-b/n) and
+comma lists, over minute hour day-of-month month day-of-week.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Set, Tuple
+
+_FIELDS = [
+    ("minute", 0, 59),
+    ("hour", 0, 23),
+    ("dom", 1, 31),
+    ("month", 1, 12),
+    ("dow", 0, 6),  # 0 = Sunday; 7 accepted as Sunday too
+]
+
+
+def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
+    out: Set[int] = set()
+    for part in expr.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            if not step_s.isdigit() or int(step_s) < 1:
+                raise ValueError(f"bad step in {name} field")
+            step = int(step_s)
+        if part == "*":
+            start, end = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            if not (a.isdigit() and b.isdigit()):
+                raise ValueError(f"bad range in {name} field")
+            start, end = int(a), int(b)
+        elif part.isdigit():
+            start = end = int(part)
+        else:
+            raise ValueError(f"bad value {part!r} in {name} field")
+        if name == "dow":
+            start, end = start % 7, end % 7
+        if start < lo or end > hi or start > end:
+            raise ValueError(f"{name} value out of range {lo}-{hi}")
+        out.update(range(start, end + 1, step))
+    return out
+
+
+def parse_cron(schedule: str) -> List[Set[int]]:
+    parts = schedule.split()
+    if len(parts) != 5:
+        raise ValueError("schedule must have 5 fields (min hour dom month dow)")
+    return [
+        _parse_field(p, lo, hi, name)
+        for p, (name, lo, hi) in zip(parts, _FIELDS)
+    ]
+
+
+def _matches(fields: List[Set[int]], dt: datetime.datetime) -> bool:
+    minute, hour, dom, month, dow = fields
+    # cron semantics: if both dom and dow are restricted, either may match
+    dom_star = dom == set(range(1, 32))
+    dow_star = dow == set(range(0, 7))
+    day_ok = (
+        (dt.day in dom) or (dt.isoweekday() % 7 in dow)
+        if not dom_star and not dow_star
+        else dt.day in dom and dt.isoweekday() % 7 in dow
+    )
+    return (
+        dt.minute in minute and dt.hour in hour and dt.month in month and day_ok
+    )
+
+
+def next_fire(schedule: str, after: datetime.datetime) -> datetime.datetime:
+    """First matching minute strictly after `after` (minute granularity)."""
+    fields = parse_cron(schedule)
+    dt = after.replace(second=0, microsecond=0) + datetime.timedelta(minutes=1)
+    # bounded scan: 4 years covers any 5-field schedule incl. Feb 29
+    for _ in range(4 * 366 * 24 * 60):
+        if _matches(fields, dt):
+            return dt
+        dt += datetime.timedelta(minutes=1)
+    raise ValueError(f"schedule {schedule!r} never fires")
+
+
+def unmet_times(
+    schedule: str,
+    earliest: datetime.datetime,
+    now: datetime.datetime,
+    limit: int = 100,
+) -> Tuple[List[datetime.datetime], bool]:
+    """Scheduled times in (earliest, now]; (times, truncated). Mirrors
+    getRecentUnmetScheduleTimes' too-many-missed-starts guard."""
+    times: List[datetime.datetime] = []
+    cur = earliest
+    while True:
+        cur = next_fire(schedule, cur)
+        if cur > now:
+            return times, False
+        times.append(cur)
+        if len(times) > limit:
+            return times, True

@@ -1,0 +1,254 @@
+"""StatefulSet + CronJob controller tests (ref: test/integration +
+pkg/controller/{statefulset,cronjob} unit suites)."""
+
+import datetime
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, InformerFactory
+from kubernetes1_tpu.controllers import ControllerManager
+from kubernetes1_tpu.controllers.cronjob import CronJobController
+from kubernetes1_tpu.controllers.statefulset import POD_NAME_LABEL, REVISION_LABEL
+from kubernetes1_tpu.machinery import Conflict, Invalid
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.cron import next_fire, parse_cron, unmet_times
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.test_controllers import start_hollow_node
+
+
+UTC = datetime.timezone.utc
+
+
+class TestCronParser:
+    def test_every_minute(self):
+        nxt = next_fire("* * * * *", datetime.datetime(2026, 7, 29, 12, 0, 30, tzinfo=UTC))
+        assert nxt == datetime.datetime(2026, 7, 29, 12, 1, tzinfo=UTC)
+
+    def test_steps_and_ranges(self):
+        fields = parse_cron("*/15 9-17 * * 1-5")
+        assert fields[0] == {0, 15, 30, 45}
+        assert fields[1] == set(range(9, 18))
+        assert fields[4] == {1, 2, 3, 4, 5}
+
+    def test_specific_time(self):
+        nxt = next_fire("30 3 * * *", datetime.datetime(2026, 7, 29, 4, 0, tzinfo=UTC))
+        assert nxt == datetime.datetime(2026, 7, 30, 3, 30, tzinfo=UTC)
+
+    def test_dow_sunday_as_7(self):
+        fields = parse_cron("0 0 * * 7")
+        assert fields[4] == {0}
+
+    def test_bad_schedules(self):
+        for bad in ("* * * *", "61 * * * *", "* * * * mon", "a b c d e"):
+            with pytest.raises(ValueError):
+                parse_cron(bad)
+
+    def test_unmet_times(self):
+        earliest = datetime.datetime(2026, 7, 29, 12, 0, tzinfo=UTC)
+        now = datetime.datetime(2026, 7, 29, 12, 5, 30, tzinfo=UTC)
+        times, truncated = unmet_times("* * * * *", earliest, now)
+        assert len(times) == 5 and not truncated
+        assert times[-1] == datetime.datetime(2026, 7, 29, 12, 5, tzinfo=UTC)
+
+    def test_unmet_truncation(self):
+        earliest = datetime.datetime(2026, 7, 1, tzinfo=UTC)
+        now = datetime.datetime(2026, 7, 29, tzinfo=UTC)
+        _, truncated = unmet_times("* * * * *", earliest, now)
+        assert truncated
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=5.0, eviction_timeout=5.0)
+    cm.start()
+    nodes = [
+        start_hollow_node(cs, f"ss-host-{i}", str(tmp_path), tpus=4, host_index=i)
+        for i in range(2)
+    ]
+    env = {"master": master, "cs": cs}
+    yield env
+    for kubelet, plugin, _ in nodes:
+        kubelet.stop()
+        plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def sset(name, replicas=2, image="v1", policy="OrderedReady"):
+    ss = t.StatefulSet()
+    ss.metadata.name = name
+    ss.spec.replicas = replicas
+    ss.spec.pod_management_policy = policy
+    ss.spec.service_name = name
+    ss.spec.selector = t.LabelSelector(match_labels={"app": name})
+    ss.spec.template.metadata.labels = {"app": name}
+    ss.spec.template.spec.containers = [
+        t.Container(name="c", image=image, command=["serve"])
+    ]
+    return ss
+
+
+class TestStatefulSet:
+    def test_ordered_creation_and_identity(self, cluster):
+        cs = cluster["cs"]
+        cs.statefulsets.create(sset("db", replicas=3))
+
+        def names():
+            pods, _ = cs.pods.list(namespace="default", label_selector="app=db")
+            return sorted(
+                p.metadata.name for p in pods if not p.metadata.deletion_timestamp
+            )
+
+        must_poll_until(lambda: names() == ["db-0", "db-1", "db-2"],
+                        timeout=20.0, desc="3 ordinal pods")
+        pods, _ = cs.pods.list(namespace="default", label_selector="app=db")
+        for p in pods:
+            assert p.metadata.labels[POD_NAME_LABEL] == p.metadata.name
+        must_poll_until(
+            lambda: cs.statefulsets.get("db").status.ready_replicas == 3,
+            timeout=20.0, desc="status ready",
+        )
+
+    def test_scale_down_removes_highest_ordinal(self, cluster):
+        cs = cluster["cs"]
+        cs.statefulsets.create(sset("cache", replicas=3, policy="Parallel"))
+
+        def names():
+            pods, _ = cs.pods.list(namespace="default", label_selector="app=cache")
+            return sorted(
+                p.metadata.name for p in pods if not p.metadata.deletion_timestamp
+            )
+
+        must_poll_until(lambda: names() == ["cache-0", "cache-1", "cache-2"],
+                        timeout=20.0, desc="3 pods")
+        ss = cs.statefulsets.get("cache")
+        ss.spec.replicas = 1
+        cs.statefulsets.update(ss)
+        must_poll_until(lambda: names() == ["cache-0"], timeout=20.0,
+                        desc="scaled to ordinal 0")
+
+    def test_rolling_update_recreates_at_new_revision(self, cluster):
+        cs = cluster["cs"]
+        cs.statefulsets.create(sset("web", replicas=2))
+        must_poll_until(
+            lambda: cs.statefulsets.get("web").status.ready_replicas == 2,
+            timeout=20.0, desc="2 ready",
+        )
+        old_rev = cs.statefulsets.get("web").status.current_revision
+        for _ in range(10):  # retry: status writes race this update
+            ss = cs.statefulsets.get("web")
+            ss.spec.template.spec.containers[0].image = "v2"
+            try:
+                cs.statefulsets.update(ss)
+                break
+            except Conflict:
+                time.sleep(0.05)
+
+        def updated():
+            s = cs.statefulsets.get("web").status
+            return s.current_revision != old_rev and s.ready_replicas == 2
+
+        must_poll_until(updated, timeout=30.0, desc="rolled to new revision")
+        pods, _ = cs.pods.list(namespace="default", label_selector="app=web")
+        live = [p for p in pods if not p.metadata.deletion_timestamp]
+        assert all(p.spec.containers[0].image == "v2" for p in live)
+        assert sorted(p.metadata.name for p in live) == ["web-0", "web-1"]
+
+    def test_validation(self, cluster):
+        cs = cluster["cs"]
+        ss = sset("bad")
+        ss.spec.pod_management_policy = "Chaotic"
+        with pytest.raises(Invalid):
+            cs.statefulsets.create(ss)
+
+
+class TestCronJob:
+    def make(self, name, schedule="* * * * *", policy="Allow"):
+        cj = t.CronJob()
+        cj.metadata.name = name
+        cj.spec.schedule = schedule
+        cj.spec.concurrency_policy = policy
+        cj.spec.job_template.spec.template.spec.containers = [
+            t.Container(name="c", image="task", command=["sleep", "0.1"])
+        ]
+        cj.spec.job_template.spec.completions = 1
+        return cj
+
+    def test_schedule_validation(self, cluster):
+        cs = cluster["cs"]
+        cj = self.make("bad", schedule="nope")
+        with pytest.raises(Invalid):
+            cs.cronjobs.create(cj)
+
+    def test_fires_on_schedule_with_fake_clock(self, tmp_path):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            fake_now = [time.time()]
+            factory = InformerFactory(cs)
+            ctl = CronJobController(cs, factory, clock=lambda: fake_now[0])
+            ctl.setup()
+            factory.start_all()
+            factory.wait_for_sync()
+
+            cs.cronjobs.create(self.make("tick"))
+            key = "default/tick"
+            # first sync: nothing unmet yet (created just now)
+            ctl.sync(key)
+            jobs, _ = cs.jobs.list(namespace="default")
+            assert len(jobs) == 0
+
+            fake_now[0] += 61  # cross a minute boundary
+            ctl.sync(key)
+            must_poll_until(
+                lambda: len(cs.jobs.list(namespace="default")[0]) == 1,
+                timeout=5.0, desc="job created",
+            )
+            cj = cs.cronjobs.get("tick")
+            assert cj.status.last_schedule_time
+            assert len(cj.status.active) == 1
+
+            # same minute again: name collision → no duplicate
+            ctl.sync(key)
+            assert len(cs.jobs.list(namespace="default")[0]) == 1
+
+            # long outage: backlog is skipped, not replayed as a storm
+            cs.cronjobs.create(self.make("stale"))
+            fake_now[0] += 3 * 86400
+            ctl.sync("default/stale")
+            stale_jobs = [
+                j for j in cs.jobs.list(namespace="default")[0]
+                if j.metadata.name.startswith("stale-")
+            ]
+            assert stale_jobs == []
+            must_poll_until(
+                lambda: cs.cronjobs.get("stale").status.last_schedule_time != "",
+                timeout=5.0, desc="lastScheduleTime advanced past backlog",
+            )
+
+            # Forbid policy blocks while active
+            fresh = cs.cronjobs.get("tick")
+            fresh.spec.concurrency_policy = "Forbid"
+            cs.cronjobs.update(fresh)
+            factory.wait_for_sync()
+            fake_now[0] += 60
+            must_poll_until(
+                lambda: (ctl.cronjobs.get(key) or fresh).spec.concurrency_policy
+                == "Forbid",
+                timeout=5.0, desc="informer saw Forbid",
+            )
+            ctl.sync(key)
+            assert len(cs.jobs.list(namespace="default")[0]) == 1
+        finally:
+            cs.close()
+            master.stop()
