@@ -703,8 +703,9 @@ class ServicePort:
 class ServiceSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
-    cluster_ip: str = ""
+    cluster_ip: str = ""  # allocated from 10.96/16; "None" = headless
     type: str = "ClusterIP"  # ClusterIP | NodePort
+    session_affinity: str = ""  # "" | ClientIP
 
 
 @dataclass

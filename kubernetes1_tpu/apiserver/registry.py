@@ -169,6 +169,26 @@ class StatefulSetStrategy(ReplicaSetStrategy):
             raise Invalid("updateStrategy.type must be RollingUpdate or OnDelete")
 
 
+class ServiceStrategy(Strategy):
+    def validate(self, obj):
+        super().validate(obj)
+        if obj.spec.type not in ("ClusterIP", "NodePort"):
+            raise Invalid("service type must be ClusterIP or NodePort")
+        if not obj.spec.ports and obj.spec.cluster_ip != "None":
+            raise Invalid("spec.ports is required")
+        names = [p.name for p in obj.spec.ports]
+        if len(obj.spec.ports) > 1 and len(set(names)) != len(names):
+            raise Invalid("port names must be unique")
+        for p in obj.spec.ports:
+            if not (0 < p.port < 65536):
+                raise Invalid(f"invalid port {p.port}")
+
+    def prepare_for_update(self, new, old):
+        super().prepare_for_update(new, old)
+        if old.spec.cluster_ip and new.spec.cluster_ip != old.spec.cluster_ip:
+            raise Forbidden("spec.clusterIP is immutable")
+
+
 class CronJobStrategy(Strategy):
     def validate(self, obj):
         super().validate(obj)
@@ -195,6 +215,7 @@ def strategy_for(resource: str) -> Strategy:
             "deployments": DeploymentStrategy_,
             "statefulsets": StatefulSetStrategy,
             "cronjobs": CronJobStrategy,
+            "services": ServiceStrategy,
         }.get(resource, Strategy)()
     return _STRATEGIES[resource]
 
@@ -207,6 +228,7 @@ class Registry:
         self.store = store
         self.scheme = scheme
         self._ns_lock = threading.Lock()
+        self._svc_lock = threading.Lock()
 
     # ------------------------------------------------------------------ keys
 
@@ -252,7 +274,72 @@ class Registry:
         if self.scheme.namespaced.get(resource, True):
             self.check_namespace_active(obj.metadata.namespace)
         key = self.key(resource, obj.metadata.namespace, obj.metadata.name)
+        if resource == "services":
+            # allocation and commit are one critical section — otherwise two
+            # concurrent creates can both scan, pick the same IP, and both land
+            with self._svc_lock:
+                self._allocate_service_fields(obj)
+                return self.store.create(key, obj)
         return self.store.create(key, obj)
+
+    # Service VIP / NodePort allocation (ref: pkg/registry/core/service/
+    # ipallocator + portallocator — there a bitmap in etcd; here a scan of
+    # the authoritative service list under _svc_lock, which also covers the
+    # store write).
+    SERVICE_CIDR_PREFIX = "10.96."  # /16
+    NODE_PORT_RANGE = (30000, 32767)
+
+    def _allocate_service_fields(self, obj, old=None):
+        """Allocate/validate clusterIP and nodePorts. Caller holds _svc_lock.
+        With `old` set (update path) the object's own allocations are free."""
+        items, _ = self.store.list(self.prefix("services"))
+        items = [
+            s for s in items
+            if not (
+                s.metadata.namespace == obj.metadata.namespace
+                and s.metadata.name == obj.metadata.name
+            )
+        ]
+        used_ips = {s.spec.cluster_ip for s in items}
+        used_ports = {
+            p.node_port for s in items for p in s.spec.ports if p.node_port
+        }
+        if not obj.spec.cluster_ip:  # "None" = headless, user-set kept
+            for i in range(1, 255 * 255):
+                ip = f"{self.SERVICE_CIDR_PREFIX}{i // 255}.{i % 255 + 1}"
+                if ip not in used_ips:
+                    obj.spec.cluster_ip = ip
+                    break
+            else:
+                raise Invalid("service IP range exhausted")
+        elif obj.spec.cluster_ip != "None":
+            if obj.spec.cluster_ip in used_ips:
+                raise Invalid(f"clusterIP {obj.spec.cluster_ip} already allocated")
+            if not obj.spec.cluster_ip.startswith(self.SERVICE_CIDR_PREFIX):
+                raise Invalid(
+                    f"clusterIP must be in {self.SERVICE_CIDR_PREFIX}0.0/16"
+                )
+        if obj.spec.type == "NodePort":
+            lo, hi = self.NODE_PORT_RANGE
+            nxt = lo
+            seen_here = set()
+            for p in obj.spec.ports:
+                if p.node_port:
+                    if (p.node_port in used_ports or p.node_port in seen_here
+                            or not lo <= p.node_port <= hi):
+                        raise Invalid(f"nodePort {p.node_port} unavailable")
+                    seen_here.add(p.node_port)
+            for p in obj.spec.ports:
+                if not p.node_port:
+                    while nxt in used_ports or nxt in seen_here:
+                        nxt += 1
+                    if nxt > hi:
+                        raise Invalid("nodePort range exhausted")
+                    p.node_port = nxt
+                    seen_here.add(nxt)
+        else:
+            for p in obj.spec.ports:
+                p.node_port = 0
 
     def get(self, resource: str, namespace: str, name: str):
         try:
@@ -265,12 +352,18 @@ class Registry:
         key = self.key(resource, namespace, name)
         old = self.store.get(key)
         strat.prepare_for_update(obj, old)
-        strat.validate(obj)
         if obj.metadata.generation or old.metadata.generation:
             if to_dict(getattr(obj, "spec", None)) != to_dict(getattr(old, "spec", None)):
                 obj.metadata.generation = old.metadata.generation + 1
             else:
                 obj.metadata.generation = old.metadata.generation
+        if resource == "services":
+            # updates can add ports / flip type — (re)allocate under the lock
+            with self._svc_lock:
+                self._allocate_service_fields(obj, old=old)
+                strat.validate(obj)
+                return self.store.update_cas(key, obj)
+        strat.validate(obj)
         return self.store.update_cas(key, obj)
 
     def update_status(self, resource: str, namespace: str, name: str, obj):
@@ -299,9 +392,14 @@ class Registry:
             obj.metadata.resource_version = cur.metadata.resource_version
             strat = strategy_for(resource)
             strat.prepare_for_update(obj, cur)
+            if resource == "services":
+                self._allocate_service_fields(obj, old=cur)
             strat.validate(obj)  # a patch must not persist an invalid object
             return obj
 
+        if resource == "services":
+            with self._svc_lock:
+                return self.store.guaranteed_update(key, apply)
         return self.store.guaranteed_update(key, apply)
 
     def delete(self, resource: str, namespace: str, name: str, grace_seconds: Optional[int] = None):

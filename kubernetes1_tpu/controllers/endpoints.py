@@ -44,6 +44,10 @@ class EndpointsController(Controller):
         svc = self.services.get(key)
         if svc is None:
             return
+        if not svc.spec.selector:
+            # selector-less service: endpoints are managed manually
+            # (ref: endpoints_controller.go skips services w/o selector)
+            return
         ready_pods = [
             p
             for p in self.pods.list()

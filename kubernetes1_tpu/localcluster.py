@@ -21,6 +21,7 @@ from .controllers import ControllerManager
 from .deviceplugin.api import PluginServer, plugin_socket_path
 from .deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices, discover_tpu_devices
 from .kubelet import FakeRuntime, Kubelet, ProcessRuntime
+from .proxy import Proxier
 from .scheduler import Scheduler
 
 
@@ -60,6 +61,7 @@ class LocalCluster:
         self.cs: Optional[Clientset] = None
         self.scheduler: Optional[Scheduler] = None
         self.kcm: Optional[ControllerManager] = None
+        self.proxier: Optional[Proxier] = None
         self.nodes: List[NodeHandle] = []
 
     @property
@@ -73,6 +75,8 @@ class LocalCluster:
         self.scheduler.start()
         self.kcm = ControllerManager(Clientset(self.master.url))
         self.kcm.start()
+        self._proxier_cs = Clientset(self.master.url)
+        self.proxier = Proxier(self._proxier_cs).start()
         for i in range(self.n_nodes):
             self._add_node(i)
         return self
@@ -128,6 +132,9 @@ class LocalCluster:
             if h.plugin:
                 h.plugin.stop()
             h.clientset.close()
+        if self.proxier:
+            self.proxier.stop()
+            self._proxier_cs.close()
         if self.kcm:
             self.kcm.stop()
         if self.scheduler:

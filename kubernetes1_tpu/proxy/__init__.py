@@ -1,0 +1,3 @@
+from .proxier import Proxier
+
+__all__ = ["Proxier"]

@@ -22,6 +22,9 @@ _FIELDS = [
 
 
 def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
+    # dow accepts 7 as Sunday, including as a range endpoint ("5-7" = Fri-Sun)
+    if name == "dow":
+        hi = 7
     out: Set[int] = set()
     for part in expr.split(","):
         step = 1
@@ -41,22 +44,33 @@ def _parse_field(expr: str, lo: int, hi: int, name: str) -> Set[int]:
             start = end = int(part)
         else:
             raise ValueError(f"bad value {part!r} in {name} field")
-        if name == "dow":
-            start, end = start % 7, end % 7
         if start < lo or end > hi or start > end:
             raise ValueError(f"{name} value out of range {lo}-{hi}")
-        out.update(range(start, end + 1, step))
+        values = range(start, end + 1, step)
+        out.update(v % 7 for v in values) if name == "dow" else out.update(values)
     return out
+
+
+_MONTH_MAX_DAY = {2: 29, 4: 30, 6: 30, 9: 30, 11: 30}
 
 
 def parse_cron(schedule: str) -> List[Set[int]]:
     parts = schedule.split()
     if len(parts) != 5:
         raise ValueError("schedule must have 5 fields (min hour dom month dow)")
-    return [
+    fields = [
         _parse_field(p, lo, hi, name)
         for p, (name, lo, hi) in zip(parts, _FIELDS)
     ]
+    minute, hour, dom, month, dow = fields
+    # reject schedules that can never fire (e.g. "0 0 31 2 *"): next_fire
+    # would otherwise scan 4 years of minutes before erroring on every sync
+    dom_star = dom == set(range(1, 32))
+    dow_star = dow == set(range(0, 7))
+    if not dom_star and dow_star:
+        if all(min(dom) > _MONTH_MAX_DAY.get(m, 31) for m in month):
+            raise ValueError("schedule never fires (day-of-month vs month)")
+    return fields
 
 
 def _matches(fields: List[Set[int]], dt: datetime.datetime) -> bool:

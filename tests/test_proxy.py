@@ -1,0 +1,229 @@
+"""Service networking tests: ClusterIP/NodePort allocation in the
+registry and the userspace proxier data plane (ref: pkg/proxy/userspace
+proxier tests + pkg/registry/core/service allocator tests)."""
+
+import socket
+import socketserver
+import threading
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import Forbidden, Invalid
+from kubernetes1_tpu.proxy import Proxier
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+class _Echo(socketserver.BaseRequestHandler):
+    def handle(self):
+        data = self.request.recv(1024)
+        self.request.sendall(self.server.tag + b":" + data)
+
+
+def start_backend(tag: bytes):
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Echo)
+    srv.tag = tag
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+@pytest.fixture()
+def master():
+    m = Master().start()
+    cs = Clientset(m.url)
+    yield m, cs
+    cs.close()
+    m.stop()
+
+
+def make_service(name, port=80, typ="ClusterIP", cluster_ip="", node_port=0,
+                 selector=None):
+    svc = t.Service()
+    svc.metadata.name = name
+    svc.spec.type = typ
+    svc.spec.cluster_ip = cluster_ip
+    svc.spec.selector = selector or {"app": name}
+    svc.spec.ports = [t.ServicePort(port=port, target_port=port, node_port=node_port)]
+    return svc
+
+
+class TestAllocation:
+    def test_cluster_ip_allocated_and_unique(self, master):
+        _, cs = master
+        a = cs.services.create(make_service("a"))
+        b = cs.services.create(make_service("b"))
+        assert a.spec.cluster_ip.startswith("10.96.")
+        assert b.spec.cluster_ip.startswith("10.96.")
+        assert a.spec.cluster_ip != b.spec.cluster_ip
+
+    def test_explicit_ip_collision_rejected(self, master):
+        _, cs = master
+        a = cs.services.create(make_service("a"))
+        with pytest.raises(Invalid):
+            cs.services.create(make_service("b", cluster_ip=a.spec.cluster_ip))
+
+    def test_cluster_ip_immutable(self, master):
+        _, cs = master
+        a = cs.services.create(make_service("a"))
+        a.spec.cluster_ip = "10.96.9.9"
+        with pytest.raises(Forbidden):
+            cs.services.update(a)
+
+    def test_headless_service(self, master):
+        _, cs = master
+        h = cs.services.create(make_service("h", cluster_ip="None"))
+        assert h.spec.cluster_ip == "None"
+
+    def test_node_port_allocated(self, master):
+        _, cs = master
+        a = cs.services.create(make_service("a", typ="NodePort"))
+        assert 30000 <= a.spec.ports[0].node_port <= 32767
+        b = cs.services.create(make_service("b", typ="NodePort"))
+        assert b.spec.ports[0].node_port != a.spec.ports[0].node_port
+
+    def test_node_port_collision_rejected(self, master):
+        _, cs = master
+        cs.services.create(make_service("a", typ="NodePort", node_port=30123))
+        with pytest.raises(Invalid):
+            cs.services.create(make_service("b", typ="NodePort", node_port=30123))
+
+    def test_bad_type_rejected(self, master):
+        _, cs = master
+        with pytest.raises(Invalid):
+            cs.services.create(make_service("x", typ="LoadBalancer"))
+
+    def test_concurrent_creates_get_unique_ips(self, master):
+        _, cs = master
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            svcs = list(ex.map(
+                lambda i: cs.services.create(make_service(f"s{i}", typ="NodePort")),
+                range(16),
+            ))
+        ips = [s.spec.cluster_ip for s in svcs]
+        ports = [s.spec.ports[0].node_port for s in svcs]
+        assert len(set(ips)) == 16, f"duplicate clusterIPs: {ips}"
+        assert len(set(ports)) == 16, f"duplicate nodePorts: {ports}"
+
+    def test_update_allocates_new_node_port(self, master):
+        _, cs = master
+        svc = cs.services.create(make_service("a", typ="NodePort"))
+        svc.spec.ports.append(t.ServicePort(name="extra", port=81, target_port=81))
+        svc.spec.ports[0].name = "main"
+        updated = cs.services.update(svc)
+        np = [p.node_port for p in updated.spec.ports]
+        assert all(30000 <= p <= 32767 for p in np) and len(set(np)) == 2
+
+
+def put_endpoints(cs, name, backends, port_name=""):
+    eps = t.Endpoints()
+    eps.metadata.name = name
+    eps.subsets = [
+        t.EndpointSubset(
+            addresses=[t.EndpointAddress(ip=ip) for ip, _ in backends],
+            ports=[t.EndpointPort(name=port_name, port=backends[0][1])],
+        )
+    ]
+    try:
+        return cs.endpoints.create(eps)
+    except Exception:
+        cur = cs.endpoints.get(name)
+        cur.subsets = eps.subsets
+        return cs.endpoints.update(cur)
+
+
+class TestProxier:
+    def test_round_robin_and_vip_resolution(self, master):
+        _, cs = master
+        s1, p1 = start_backend(b"be1")
+        s2, p2 = start_backend(b"be2")
+        try:
+            svc = cs.services.create(make_service("echo", port=7000))
+            # both backends listen on distinct ports; use per-subset ports
+            eps = t.Endpoints()
+            eps.metadata.name = "echo"
+            eps.subsets = [
+                t.EndpointSubset(addresses=[t.EndpointAddress(ip="127.0.0.1")],
+                                 ports=[t.EndpointPort(port=p1)]),
+                t.EndpointSubset(addresses=[t.EndpointAddress(ip="127.0.0.1")],
+                                 ports=[t.EndpointPort(port=p2)]),
+            ]
+            cs.endpoints.create(eps)
+            proxier = Proxier(cs).start()
+            try:
+                must_poll_until(
+                    lambda: proxier.resolve(svc.spec.cluster_ip, 7000) is not None,
+                    timeout=10.0, desc="vip programmed",
+                )
+                seen = set()
+                for _ in range(6):
+                    with proxier.connect(svc.spec.cluster_ip, 7000) as sock:
+                        sock.sendall(b"hi")
+                        seen.add(sock.recv(1024))
+                assert seen == {b"be1:hi", b"be2:hi"}
+                assert proxier.stats()["connections"] >= 6
+            finally:
+                proxier.stop()
+        finally:
+            s1.shutdown()
+            s2.shutdown()
+
+    def test_node_port_listens(self, master):
+        _, cs = master
+        srv, bp = start_backend(b"np")
+        try:
+            svc = cs.services.create(make_service("web", port=80, typ="NodePort"))
+            put_endpoints(cs, "web", [("127.0.0.1", bp)])
+            proxier = Proxier(cs).start()
+            try:
+                node_port = svc.spec.ports[0].node_port
+                must_poll_until(
+                    lambda: proxier.node_port_for("default", "web") == node_port,
+                    timeout=10.0, desc="nodePort bound",
+                )
+                with socket.create_connection(("127.0.0.1", node_port), 5) as sock:
+                    sock.sendall(b"x")
+                    assert sock.recv(1024) == b"np:x"
+            finally:
+                proxier.stop()
+        finally:
+            srv.shutdown()
+
+    def test_endpoint_update_and_service_delete(self, master):
+        _, cs = master
+        s1, p1 = start_backend(b"old")
+        s2, p2 = start_backend(b"new")
+        try:
+            svc = cs.services.create(make_service("flip", port=9000))
+            put_endpoints(cs, "flip", [("127.0.0.1", p1)])
+            proxier = Proxier(cs).start()
+            try:
+                must_poll_until(
+                    lambda: proxier.resolve(svc.spec.cluster_ip, 9000) is not None,
+                    timeout=10.0, desc="vip programmed",
+                )
+                with proxier.connect(svc.spec.cluster_ip, 9000) as sock:
+                    sock.sendall(b"a")
+                    assert sock.recv(1024) == b"old:a"
+                put_endpoints(cs, "flip", [("127.0.0.1", p2)])
+
+                def flipped():
+                    with proxier.connect(svc.spec.cluster_ip, 9000) as sock:
+                        sock.sendall(b"b")
+                        return sock.recv(1024) == b"new:b"
+
+                must_poll_until(flipped, timeout=10.0, desc="backends flipped")
+                cs.services.delete("flip")
+                must_poll_until(
+                    lambda: proxier.resolve(svc.spec.cluster_ip, 9000) is None,
+                    timeout=10.0, desc="vip removed",
+                )
+            finally:
+                proxier.stop()
+        finally:
+            s1.shutdown()
+            s2.shutdown()

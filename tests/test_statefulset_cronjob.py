@@ -42,6 +42,17 @@ class TestCronParser:
         fields = parse_cron("0 0 * * 7")
         assert fields[4] == {0}
 
+    def test_dow_ranges_with_7(self):
+        assert parse_cron("0 0 * * 5-7")[4] == {5, 6, 0}
+        assert parse_cron("0 0 * * 0-7")[4] == {0, 1, 2, 3, 4, 5, 6}
+
+    def test_never_firing_schedule_rejected(self):
+        with pytest.raises(ValueError):
+            parse_cron("0 0 31 2 *")
+        with pytest.raises(ValueError):
+            parse_cron("0 0 30 2 *")
+        parse_cron("0 0 29 2 *")  # leap years: valid
+
     def test_bad_schedules(self):
         for bad in ("* * * *", "61 * * * *", "* * * * mon", "a b c d e"):
             with pytest.raises(ValueError):
