@@ -22,6 +22,19 @@ _REGISTRY = [
     (t.Endpoints, "endpoints", True),
     (t.ConfigMap, "configmaps", True),
     (t.PriorityClass, "priorityclasses", False),
+    (t.Secret, "secrets", True),
+    (t.ServiceAccount, "serviceaccounts", True),
+    (t.ResourceQuota, "resourcequotas", True),
+    (t.LimitRange, "limitranges", True),
+    (t.HorizontalPodAutoscaler, "horizontalpodautoscalers", True),
+    (t.PodDisruptionBudget, "poddisruptionbudgets", True),
+    (t.PersistentVolume, "persistentvolumes", False),
+    (t.PersistentVolumeClaim, "persistentvolumeclaims", True),
+    (t.CertificateSigningRequest, "certificatesigningrequests", False),
+    (t.CustomResourceDefinition, "customresourcedefinitions", False),
+    (t.APIService, "apiservices", False),
+    (t.PodMetrics, "podmetrics", True),
+    (t.NodeMetrics, "nodemetrics", False),
 ]
 
 for cls, plural, namespaced in _REGISTRY:

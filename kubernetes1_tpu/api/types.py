@@ -170,11 +170,23 @@ class ConfigMapVolumeSource:
 
 
 @dataclass
+class SecretVolumeSource:
+    secret_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str = ""
+
+
+@dataclass
 class Volume:
     name: str = ""
     host_path: Optional[HostPathVolumeSource] = None
     empty_dir: Optional[EmptyDirVolumeSource] = None
     config_map: Optional[ConfigMapVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
 
 
 @dataclass
@@ -265,6 +277,7 @@ class PodSpec:
     termination_grace_period_seconds: int = 30
     active_deadline_seconds: Optional[int] = None
     host_network: bool = False
+    service_account_name: str = ""
     # fork v2: pod-level device requests with attribute affinity
     extended_resources: List[PodExtendedResource] = field(default_factory=list)
     # gang scheduling (TPU multi-host slices): pods sharing
@@ -488,6 +501,9 @@ class JobSpec:
     completion_mode: str = "NonIndexed"  # NonIndexed | Indexed
     # Gang scheduling: all pods of the job bind atomically (TPU slices).
     gang_scheduling: bool = False
+    # Cleanup of finished jobs (upstream ttlafterfinished design; absent in
+    # the 1.9 reference where finished Jobs accumulate forever).
+    ttl_seconds_after_finished: Optional[int] = None
 
 
 @dataclass
@@ -752,3 +768,331 @@ class PriorityClass(KObject):
     value: int = 0
     global_default: bool = False
     description: str = ""
+
+
+# ----------------------------------------------------- secrets / identities
+
+
+@dataclass
+class Secret(KObject):
+    """Ref: core/v1 Secret (types.go). Values are stored as plain strings
+    (`stringData` semantics) — there is no base64 layer to shed."""
+
+    KIND = "Secret"
+    type: str = "Opaque"  # Opaque | kubernetes.io/service-account-token | bootstrap.kubernetes.io/token
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServiceAccount(KObject):
+    """Ref: core/v1 ServiceAccount; token secrets minted by the token
+    controller (pkg/controller/serviceaccount)."""
+
+    KIND = "ServiceAccount"
+    secrets: List[ObjectReference] = field(default_factory=list)
+    automount_service_account_token: bool = True
+
+
+# -------------------------------------------------------- quota and limits
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Dict[str, str] = field(default_factory=dict)  # "pods", "requests.cpu", "google.com/tpu", ...
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, str] = field(default_factory=dict)
+    used: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota(KObject):
+    """Ref: core/v1 ResourceQuota; enforced by admission, recalculated by the
+    resourcequota controller (pkg/controller/resourcequota)."""
+
+    KIND = "ResourceQuota"
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class LimitRangeItem:
+    type: str = "Container"  # Container | Pod
+    max: Dict[str, str] = field(default_factory=dict)
+    min: Dict[str, str] = field(default_factory=dict)
+    default: Dict[str, str] = field(default_factory=dict)          # default limits
+    default_request: Dict[str, str] = field(default_factory=dict)  # default requests
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange(KObject):
+    """Ref: core/v1 LimitRange, applied by the LimitRanger admission plugin
+    (plugin/pkg/admission/limitranger)."""
+
+    KIND = "LimitRange"
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+# ---------------------------------------------------------------- autoscaling
+
+
+@dataclass
+class CrossVersionObjectReference:
+    kind: str = ""  # Deployment | ReplicaSet | StatefulSet
+    name: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_target_ref: CrossVersionObjectReference = field(
+        default_factory=CrossVersionObjectReference
+    )
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    observed_generation: int = 0
+    last_scale_time: str = ""
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler(KObject):
+    """Ref: autoscaling/v1 HPA; reconciled by pkg/controller/podautoscaler
+    against the resource-metrics pipeline (Summary API here)."""
+
+    KIND = "HorizontalPodAutoscaler"
+    API_VERSION = "autoscaling/v1"
+    spec: HorizontalPodAutoscalerSpec = field(default_factory=HorizontalPodAutoscalerSpec)
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus
+    )
+
+
+# -------------------------------------------------------------- disruption
+
+
+@dataclass
+class PodDisruptionBudgetSpec:
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+
+@dataclass
+class PodDisruptionBudgetStatus:
+    disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class PodDisruptionBudget(KObject):
+    """Ref: policy/v1beta1 PDB + pkg/controller/disruption; consulted by the
+    eviction subresource and `ktpu drain`."""
+
+    KIND = "PodDisruptionBudget"
+    API_VERSION = "policy/v1"
+    spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
+    status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+
+# ------------------------------------------------------------------ volumes
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: Dict[str, str] = field(default_factory=dict)  # {"storage": "10Gi"}
+    access_modes: List[str] = field(default_factory=list)  # ReadWriteOnce | ReadOnlyMany | ReadWriteMany
+    host_path: Optional[HostPathVolumeSource] = None
+    storage_class_name: str = ""
+    persistent_volume_reclaim_policy: str = "Retain"  # Retain | Delete | Recycle
+    claim_ref: Optional[ObjectReference] = None
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = "Available"  # Available | Bound | Released | Failed
+
+
+@dataclass
+class PersistentVolume(KObject):
+    """Ref: core/v1 PV + pkg/controller/volume/persistentvolume binder."""
+
+    KIND = "PersistentVolume"
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(default_factory=PersistentVolumeStatus)
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_name: str = ""
+    storage_class_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = "Pending"  # Pending | Bound | Lost
+    capacity: Dict[str, str] = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaim(KObject):
+    KIND = "PersistentVolumeClaim"
+    spec: PersistentVolumeClaimSpec = field(default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus
+    )
+
+
+# -------------------------------------------------------------- certificates
+
+
+@dataclass
+class CertificateSigningRequestSpec:
+    request: str = ""  # CSR payload (PEM in the reference; opaque string here)
+    usages: List[str] = field(default_factory=list)
+    username: str = ""
+    groups: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CSRCondition:
+    type: str = ""  # Approved | Denied
+    reason: str = ""
+    message: str = ""
+    last_update_time: str = ""
+
+
+@dataclass
+class CertificateSigningRequestStatus:
+    conditions: List[CSRCondition] = field(default_factory=list)
+    certificate: str = ""
+
+
+@dataclass
+class CertificateSigningRequest(KObject):
+    """Ref: certificates/v1beta1 CSR + pkg/controller/certificates (signer
+    issues on Approved condition; kubelet TLS bootstrap client flow)."""
+
+    KIND = "CertificateSigningRequest"
+    API_VERSION = "certificates/v1"
+    spec: CertificateSigningRequestSpec = field(
+        default_factory=CertificateSigningRequestSpec
+    )
+    status: CertificateSigningRequestStatus = field(
+        default_factory=CertificateSigningRequestStatus
+    )
+
+
+# ------------------------------------------------------------ extensibility
+
+
+@dataclass
+class CRDNames:
+    plural: str = ""
+    singular: str = ""
+    kind: str = ""
+
+
+@dataclass
+class CustomResourceDefinitionSpec:
+    group: str = ""
+    version: str = "v1"
+    names: CRDNames = field(default_factory=CRDNames)
+    scope: str = "Namespaced"  # Namespaced | Cluster
+
+
+@dataclass
+class CustomResourceDefinitionStatus:
+    accepted_names: CRDNames = field(default_factory=CRDNames)
+    conditions: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CustomResourceDefinition(KObject):
+    """Ref: apiextensions-apiserver CustomResourceDefinition — registers a
+    dynamic REST resource served straight from the store."""
+
+    KIND = "CustomResourceDefinition"
+    API_VERSION = "apiextensions/v1"
+    spec: CustomResourceDefinitionSpec = field(
+        default_factory=CustomResourceDefinitionSpec
+    )
+    status: CustomResourceDefinitionStatus = field(
+        default_factory=CustomResourceDefinitionStatus
+    )
+
+
+@dataclass
+class APIServiceSpec:
+    group: str = ""
+    version: str = ""
+    service_namespace: str = ""  # backing Service for delegation
+    service_name: str = ""
+    service_port: int = 443
+    group_priority_minimum: int = 1000
+
+
+@dataclass
+class APIServiceStatus:
+    available: bool = False
+    message: str = ""
+
+
+# ------------------------------------------------------------------ metrics
+
+
+@dataclass
+class ContainerMetrics:
+    name: str = ""
+    usage: Dict[str, str] = field(default_factory=dict)  # {"cpu": "250m", "memory": "64Mi"}
+
+
+@dataclass
+class PodMetrics(KObject):
+    """Ref: staging/src/k8s.io/metrics pod metrics, fed here by each kubelet
+    directly (the cadvisor → Summary API → metrics-server pipeline collapsed
+    into one hop; HPA reads these)."""
+
+    KIND = "PodMetrics"
+    API_VERSION = "metrics.k8s.io/v1"
+    timestamp: str = ""
+    containers: List[ContainerMetrics] = field(default_factory=list)
+
+
+@dataclass
+class NodeMetrics(KObject):
+    KIND = "NodeMetrics"
+    API_VERSION = "metrics.k8s.io/v1"
+    timestamp: str = ""
+    usage: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class APIService(KObject):
+    """Ref: kube-aggregator APIService — requests under /apis/<group>/<ver>
+    proxy to the backing service's endpoints."""
+
+    KIND = "APIService"
+    API_VERSION = "apiregistration/v1"
+    spec: APIServiceSpec = field(default_factory=APIServiceSpec)
+    status: APIServiceStatus = field(default_factory=APIServiceStatus)

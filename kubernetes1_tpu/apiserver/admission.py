@@ -120,6 +120,155 @@ class GangDefaulter(AdmissionPlugin):
             raise Invalid("scheduling_gang requires gang_size > 0")
 
 
+class LimitRanger(AdmissionPlugin):
+    """Applies LimitRange defaults and enforces min/max per container
+    (ref: plugin/pkg/admission/limitranger/admission.go)."""
+
+    name = "LimitRanger"
+
+    def __init__(self, list_limit_ranges):
+        self._list = list_limit_ranges  # (namespace) -> [LimitRange]
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        from ..utils.quantity import parse_quantity
+
+        for lr in self._list(obj.metadata.namespace):
+            for item in lr.spec.limits:
+                if item.type != "Container":
+                    continue
+                for c in obj.spec.containers:
+                    for res, val in item.default.items():
+                        c.resources.limits.setdefault(res, val)
+                    for res, val in item.default_request.items():
+                        c.resources.requests.setdefault(res, val)
+                    for res, val in item.max.items():
+                        have = c.resources.limits.get(res)
+                        if have is not None and parse_quantity(have) > parse_quantity(val):
+                            raise Forbidden(
+                                f"container {c.name}: {res} limit {have} exceeds LimitRange max {val}"
+                            )
+                    for res, val in item.min.items():
+                        have = c.resources.requests.get(res)
+                        if have is not None and parse_quantity(have) < parse_quantity(val):
+                            raise Forbidden(
+                                f"container {c.name}: {res} request {have} below LimitRange min {val}"
+                            )
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """Rejects creates that would push namespace usage over any ResourceQuota
+    hard limit (ref: plugin/pkg/admission/resourcequota). Usage is computed
+    live from the authoritative object lists; the resourcequota controller
+    keeps status.used current for observers."""
+
+    name = "ResourceQuota"
+
+    COUNTED = {"pods", "services", "configmaps", "secrets", "replicasets",
+               "persistentvolumeclaims"}
+
+    def __init__(self, list_quotas, usage_fn):
+        self._list = list_quotas       # (namespace) -> [ResourceQuota]
+        self._usage = usage_fn         # (namespace) -> {resource: float}
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if operation != CREATE or resource not in self.COUNTED:
+            return
+        ns = obj.metadata.namespace
+        quotas = self._list(ns)
+        if not quotas:
+            return
+        from ..utils.quantity import parse_quantity
+
+        delta = compute_object_usage(resource, obj)
+        used = self._usage(ns)
+        for q in quotas:
+            for res, hard in q.spec.hard.items():
+                inc = delta.get(res, 0.0)
+                if not inc:
+                    continue
+                if used.get(res, 0.0) + inc > parse_quantity(hard):
+                    raise Forbidden(
+                        f"exceeded quota {q.metadata.name}: {res} "
+                        f"used {used.get(res, 0.0):g} + requested {inc:g} > hard {hard}"
+                    )
+
+
+def compute_object_usage(resource: str, obj) -> dict:
+    """Quota usage contributed by one object (ref: pkg/quota/evaluator/core)."""
+    from ..utils.quantity import parse_quantity
+
+    usage = {resource: 1.0, f"count/{resource}": 1.0}
+    if resource == "pods":
+        for c in obj.spec.containers:
+            for res, val in (c.resources.requests or {}).items():
+                usage[f"requests.{res}"] = usage.get(f"requests.{res}", 0.0) + parse_quantity(val)
+            for res, val in (c.resources.limits or {}).items():
+                usage[f"limits.{res}"] = usage.get(f"limits.{res}", 0.0) + parse_quantity(val)
+        for per in obj.spec.extended_resources:
+            usage[per.resource] = usage.get(per.resource, 0.0) + per.quantity
+    return usage
+
+
+def compute_namespace_usage(lister, namespace: str) -> dict:
+    """Fold usage over every counted object in a namespace. `lister` is
+    (resource, namespace) -> list of objects (or raises/returns []). Shared
+    by admission enforcement and the resourcequota controller so the two
+    can't drift."""
+    from ..api import types as t
+
+    usage: dict = {}
+    for resource in ResourceQuotaAdmission.COUNTED:
+        for obj in lister(resource, namespace) or []:
+            if resource == "pods" and obj.status.phase in (
+                t.POD_SUCCEEDED, t.POD_FAILED
+            ):
+                continue
+            for res, val in compute_object_usage(resource, obj).items():
+                usage[res] = usage.get(res, 0.0) + val
+    return usage
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """Defaults pod.spec.serviceAccountName to 'default'
+    (ref: plugin/pkg/admission/serviceaccount/admission.go)."""
+
+    name = "ServiceAccount"
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+
+
+class EventRateLimit(AdmissionPlugin):
+    """Token-bucket cap on event creation per source component
+    (ref: plugin/pkg/admission/eventratelimit)."""
+
+    name = "EventRateLimit"
+
+    def __init__(self, qps: float = 50.0, burst: int = 100, clock=None):
+        import time as _time
+
+        self.qps = qps
+        self.burst = burst
+        self._clock = clock or _time.monotonic
+        self._buckets = {}  # source -> (tokens, last_ts)
+
+    def admit(self, operation: str, resource: str, obj, old=None):
+        if resource != "events" or operation != CREATE:
+            return
+        src = obj.source_component or "unknown"
+        now = self._clock()
+        tokens, last = self._buckets.get(src, (float(self.burst), now))
+        tokens = min(float(self.burst), tokens + (now - last) * self.qps)
+        if tokens < 1.0:
+            raise Forbidden(f"event rate limit exceeded for {src!r}")
+        self._buckets[src] = (tokens - 1.0, now)
+
+
 class AdmissionChain:
     def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
         self.plugins = plugins or []

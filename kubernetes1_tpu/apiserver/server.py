@@ -32,10 +32,15 @@ from .admission import (
     CREATE,
     UPDATE,
     AdmissionChain,
+    EventRateLimit,
     GangDefaulter,
+    LimitRanger,
     NamespaceAutoProvision,
     PriorityResolver,
+    ResourceQuotaAdmission,
     ResourceV2,
+    ServiceAccountAdmission,
+    compute_namespace_usage,
 )
 from .registry import Registry
 
@@ -282,8 +287,19 @@ class _Handler(BaseHTTPRequestHandler):
         # (NamespaceAutoProvision) see the effective namespace
         if ns and not obj.metadata.namespace:
             obj.metadata.namespace = ns
-        obj = self.master.admission.admit(CREATE, resource, obj)
-        created = reg.create(resource, ns, obj)
+        # Quota-counted resources serialize admission-check + commit so two
+        # concurrent creates cannot both pass a nearly-exhausted quota
+        # (admission computes usage from the store; unserialized it's TOCTOU).
+        effective_ns = ns or obj.metadata.namespace or "default"
+        if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
+            effective_ns
+        ):
+            with self.master.quota_lock:
+                obj = self.master.admission.admit(CREATE, resource, obj)
+                created = reg.create(resource, ns, obj)
+        else:
+            obj = self.master.admission.admit(CREATE, resource, obj)
+            created = reg.create(resource, ns, obj)
         self.master.audit("create", resource, ns, created.metadata.name)
         self._send_json(201, self.master.scheme.encode(created))
 
@@ -372,6 +388,7 @@ class Master:
         self.registry = Registry(self.store, self.scheme)
         self.token = token
         self.metrics = Metrics()
+        self.quota_lock = threading.Lock()
         self.stopping = threading.Event()
         self._audit_log = audit_log
         self.admission = AdmissionChain(
@@ -380,6 +397,10 @@ class Master:
                 PriorityResolver(self._get_priority_class),
                 ResourceV2(),
                 GangDefaulter(),
+                ServiceAccountAdmission(),
+                LimitRanger(self._list_limit_ranges),
+                ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
+                EventRateLimit(),
             ]
         )
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -391,6 +412,20 @@ class Master:
 
     def _get_priority_class(self, name: str):
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
+
+    def _list_limit_ranges(self, namespace: str):
+        items, _ = self.store.list(self.registry.prefix("limitranges", namespace))
+        return items
+
+    def _list_quotas(self, namespace: str):
+        items, _ = self.store.list(self.registry.prefix("resourcequotas", namespace))
+        return items
+
+    def _quota_usage(self, namespace: str):
+        return compute_namespace_usage(
+            lambda resource, ns: self.store.list(self.registry.prefix(resource, ns))[0],
+            namespace,
+        )
 
     def audit(self, verb: str, resource: str, ns: str, name: str):
         if self._audit_log is not None:

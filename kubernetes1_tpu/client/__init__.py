@@ -3,3 +3,4 @@ from .clientset import Clientset, ResourceClient
 from .informer import SharedInformer, InformerFactory
 from .leaderelection import LeaderElector
 from .events import EventRecorder
+from .retry import retry_on_conflict

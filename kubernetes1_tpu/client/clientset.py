@@ -21,6 +21,13 @@ _GROUP_PATH = {
     "daemonsets": "/apis/apps/v1",
     "statefulsets": "/apis/apps/v1",
     "priorityclasses": "/apis/scheduling/v1",
+    "horizontalpodautoscalers": "/apis/autoscaling/v1",
+    "poddisruptionbudgets": "/apis/policy/v1",
+    "certificatesigningrequests": "/apis/certificates/v1",
+    "customresourcedefinitions": "/apis/apiextensions/v1",
+    "apiservices": "/apis/apiregistration/v1",
+    "podmetrics": "/apis/metrics.k8s.io/v1",
+    "nodemetrics": "/apis/metrics.k8s.io/v1",
 }
 
 
@@ -185,6 +192,58 @@ class Clientset:
     @property
     def priorityclasses(self) -> ResourceClient:
         return self.resource("priorityclasses")
+
+    @property
+    def secrets(self) -> ResourceClient:
+        return self.resource("secrets")
+
+    @property
+    def serviceaccounts(self) -> ResourceClient:
+        return self.resource("serviceaccounts")
+
+    @property
+    def resourcequotas(self) -> ResourceClient:
+        return self.resource("resourcequotas")
+
+    @property
+    def limitranges(self) -> ResourceClient:
+        return self.resource("limitranges")
+
+    @property
+    def horizontalpodautoscalers(self) -> ResourceClient:
+        return self.resource("horizontalpodautoscalers")
+
+    @property
+    def poddisruptionbudgets(self) -> ResourceClient:
+        return self.resource("poddisruptionbudgets")
+
+    @property
+    def persistentvolumes(self) -> ResourceClient:
+        return self.resource("persistentvolumes")
+
+    @property
+    def persistentvolumeclaims(self) -> ResourceClient:
+        return self.resource("persistentvolumeclaims")
+
+    @property
+    def certificatesigningrequests(self) -> ResourceClient:
+        return self.resource("certificatesigningrequests")
+
+    @property
+    def customresourcedefinitions(self) -> ResourceClient:
+        return self.resource("customresourcedefinitions")
+
+    @property
+    def apiservices(self) -> ResourceClient:
+        return self.resource("apiservices")
+
+    @property
+    def podmetrics(self) -> ResourceClient:
+        return self.resource("podmetrics")
+
+    @property
+    def nodemetrics(self) -> ResourceClient:
+        return self.resource("nodemetrics")
 
     def bind(self, namespace: str, pod_name: str, binding: t.Binding):
         data = self.api.request(

@@ -1,0 +1,40 @@
+"""Conflict-retry helper for read-modify-write loops.
+
+Ref: client-go staging/src/k8s.io/client-go/util/retry/util.go (RetryOnConflict,
+DefaultRetry backoff). Any client that does get → mutate → update races with
+controllers updating the same object's status; the idiomatic answer is to retry
+the whole read-modify-write on a 409 with a short backoff.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+from ..machinery.errors import Conflict
+
+T = TypeVar("T")
+
+# Mirrors client-go's DefaultRetry: 5 steps, 10ms base, factor 1.0 + jitter.
+DEFAULT_STEPS = 5
+DEFAULT_SLEEP = 0.01
+
+
+def retry_on_conflict(
+    fn: Callable[[], T],
+    steps: int = DEFAULT_STEPS,
+    sleep: float = DEFAULT_SLEEP,
+) -> T:
+    """Run fn (a full read-modify-write closure) retrying on Conflict.
+
+    fn must re-GET the object on each attempt; retrying a stale in-memory
+    object would conflict forever.
+    """
+    last: Conflict
+    for i in range(steps):
+        try:
+            return fn()
+        except Conflict as e:
+            last = e
+            time.sleep(sleep * (i + 1))
+    raise last

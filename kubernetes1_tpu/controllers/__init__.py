@@ -8,3 +8,11 @@ from .namespace import NamespaceController, GarbageCollector
 from .endpoints import EndpointsController
 from .statefulset import StatefulSetController
 from .cronjob import CronJobController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
+from .podautoscaler import HorizontalPodAutoscalerController
+from .disruption import DisruptionController
+from .podgc import PodGCController
+from .ttl import TTLAfterFinishedController
+from .certificates import CertificateController
+from .volumebinder import PersistentVolumeBinder

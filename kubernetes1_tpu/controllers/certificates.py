@@ -1,0 +1,86 @@
+"""CSR signer/approver (ref: pkg/controller/certificates/{certificate_controller,
+approver, signer}.go): the kubelet TLS bootstrap seam. A node submits a
+CertificateSigningRequest; auto-approval covers node client certs
+(`system:node:*` usernames, mirroring the reference's sarApprover policy);
+the signer then issues the credential into status.certificate.
+
+Issued "certificates" are HMAC-bound attestations over (username, request)
+rather than x509 — the trust chain (approve → sign → verify at authn) is the
+same shape without an ASN.1 stack."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+
+from ..machinery import ApiError, NotFound, now_iso
+from .base import Controller
+
+
+def issue_certificate(ca_key: str, username: str, request: str) -> str:
+    mac = hmac.new(
+        ca_key.encode(), f"{username}\n{request}".encode(), hashlib.sha256
+    ).digest()
+    return "KTPU-CERT." + base64.urlsafe_b64encode(mac).rstrip(b"=").decode()
+
+
+def verify_certificate(ca_key: str, username: str, request: str, cert: str) -> bool:
+    return hmac.compare_digest(issue_certificate(ca_key, username, request), cert)
+
+
+class CertificateController(Controller):
+    name = "certificate-controller"
+
+    def __init__(self, clientset, factory, ca_key: str = "ktpu-ca-key", workers: int = 1):
+        super().__init__(clientset, factory, workers)
+        self.ca_key = ca_key
+
+    def setup(self):
+        self.csrs = self.factory.informer("certificatesigningrequests")
+        self.csrs.add_handler(
+            on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n)
+        )
+
+    @staticmethod
+    def _condition(csr, ctype: str) -> bool:
+        return any(c.type == ctype for c in csr.status.conditions)
+
+    def sync(self, key: str):
+        cached = self.csrs.get(key)
+        if cached is None or self._condition(cached, "Denied"):
+            return
+        from ..api import types as t
+
+        # Work on a fresh server copy — mutating the informer-cached object
+        # would make later syncs see state the server never accepted.
+        try:
+            csr = self.cs.certificatesigningrequests.get(cached.metadata.name, "")
+        except NotFound:
+            return
+        changed = False
+        if not self._condition(csr, "Approved"):
+            # Auto-approve node client certs only; anything else waits for a
+            # human `ktpu certificate approve`.
+            if csr.spec.username.startswith("system:node:"):
+                csr.status.conditions.append(
+                    t.CSRCondition(
+                        type="Approved", reason="AutoApproved",
+                        message="node client certificate",
+                        last_update_time=now_iso(),
+                    )
+                )
+                changed = True
+            else:
+                return
+        if self._condition(csr, "Approved") and not csr.status.certificate:
+            csr.status.certificate = issue_certificate(
+                self.ca_key, csr.spec.username, csr.spec.request
+            )
+            changed = True
+        if not changed:
+            return
+        try:
+            self.cs.certificatesigningrequests.update_status(csr)
+        except ApiError:
+            self.enqueue_after(key, 0.5)  # conflicting write landed; retry

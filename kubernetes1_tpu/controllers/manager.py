@@ -8,15 +8,23 @@ import threading
 from typing import List, Optional
 
 from ..client import Clientset, InformerFactory, LeaderElector
+from .certificates import CertificateController
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .job import JobController
 from .namespace import GarbageCollector, NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podautoscaler import HorizontalPodAutoscalerController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .ttl import TTLAfterFinishedController
+from .volumebinder import PersistentVolumeBinder
 
 
 class ControllerManager:
@@ -40,6 +48,14 @@ class ControllerManager:
             NamespaceController(clientset, self.factory),
             GarbageCollector(clientset, self.factory),
             EndpointsController(clientset, self.factory),
+            ResourceQuotaController(clientset, self.factory),
+            ServiceAccountController(clientset, self.factory),
+            HorizontalPodAutoscalerController(clientset, self.factory),
+            DisruptionController(clientset, self.factory),
+            PodGCController(clientset, self.factory),
+            TTLAfterFinishedController(clientset, self.factory),
+            CertificateController(clientset, self.factory),
+            PersistentVolumeBinder(clientset, self.factory),
         ]
         self.node_lifecycle = NodeLifecycleController(
             clientset,

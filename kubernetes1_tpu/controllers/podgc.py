@@ -1,0 +1,90 @@
+"""Pod garbage collector (ref: pkg/controller/podgc/gc_controller.go):
+(1) deletes pods bound to nodes that no longer exist (orphaned pods — the
+elastic-recovery path after a TPU host is replaced), (2) caps the number of
+terminated pods kept around for inspection."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..machinery import ApiError
+from .base import Controller
+
+RESYNC = 5.0  # the reference's gcCheckPeriod is 20s
+
+
+class PodGCController(Controller):
+    name = "podgc-controller"
+
+    def __init__(
+        self,
+        clientset,
+        factory,
+        terminated_pod_threshold: int = 100,
+        quarantine: float = 2 * RESYNC,
+        workers: int = 1,
+    ):
+        super().__init__(clientset, factory, workers)
+        self.terminated_pod_threshold = terminated_pod_threshold
+        # A node must be missing this long before its pods are deleted — the
+        # pods and nodes informers are independent watch streams, so a
+        # just-registered node can briefly be absent from our cache while its
+        # first bound pod is already present (upstream quarantines likewise).
+        self.quarantine = quarantine
+        self._missing_since: dict = {}  # node_name -> monotonic first-seen-missing
+        self._tick_key = "podgc/tick"
+
+    def setup(self):
+        self.pods = self.factory.informer("pods")
+        self.nodes = self.factory.informer("nodes")
+        self.queue.add(self._tick_key)
+
+    def sync(self, key: str):
+        try:
+            self._gc_orphaned()
+            self._gc_terminated()
+        finally:
+            self.enqueue_after(self._tick_key, RESYNC)
+
+    def _gc_orphaned(self):
+        import time
+
+        if not self.nodes.has_synced():
+            return
+        node_names = {n.metadata.name for n in self.nodes.list()}
+        now = time.monotonic()
+        for known in [n for n in self._missing_since if n in node_names]:
+            del self._missing_since[known]
+        for p in self.pods.list():
+            node = p.spec.node_name
+            if not node or node in node_names or p.metadata.deletion_timestamp:
+                continue
+            first = self._missing_since.setdefault(node, now)
+            if now - first < self.quarantine:
+                continue
+            try:
+                self.cs.pods.delete(
+                    p.metadata.name, p.metadata.namespace, grace_seconds=0
+                )
+                self.recorder.event(
+                    p, "Normal", "PodGC",
+                    f"deleted orphaned pod bound to missing node {node}",
+                )
+            except ApiError:
+                pass
+
+    def _gc_terminated(self):
+        terminated = [
+            p for p in self.pods.list()
+            if p.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+            and not p.metadata.deletion_timestamp
+            and not p.metadata.owner_references  # keep controller-owned history
+        ]
+        excess = len(terminated) - self.terminated_pod_threshold
+        if excess <= 0:
+            return
+        terminated.sort(key=lambda p: p.metadata.creation_timestamp)
+        for p in terminated[:excess]:
+            try:
+                self.cs.pods.delete(p.metadata.name, p.metadata.namespace, grace_seconds=0)
+            except ApiError:
+                pass

@@ -1,0 +1,67 @@
+"""ResourceQuota controller (ref: pkg/controller/resourcequota/
+resource_quota_controller.go): recalculates each quota's status.used from the
+authoritative object lists so observers (CLI, admission failure messages)
+see current consumption. Enforcement itself happens in the apiserver's
+ResourceQuota admission plugin."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..apiserver.admission import compute_namespace_usage
+from ..machinery import ApiError, Conflict, NotFound
+from .base import Controller
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else f"{v:g}"
+
+
+class ResourceQuotaController(Controller):
+    name = "resourcequota-controller"
+
+    resync_period = 10.0
+
+    def setup(self):
+        self.quotas = self.factory.informer("resourcequotas")
+        self.pods = self.factory.informer("pods")
+        self.quotas.add_handler(
+            on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n)
+        )
+        # pod churn is what moves usage; requeue the namespace's quotas
+        self.pods.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_event,
+        )
+
+    def _pod_event(self, pod: t.Pod):
+        for q in self.quotas.list():
+            if q.metadata.namespace == pod.metadata.namespace:
+                self.enqueue(q)
+
+    def _usage(self, namespace: str) -> dict:
+        def lister(resource, ns):
+            try:
+                return self.cs.resource(resource).list(namespace=ns)[0]
+            except ApiError:
+                return []
+
+        return compute_namespace_usage(lister, namespace)
+
+    def sync(self, key: str):
+        quota = self.quotas.get(key)
+        if quota is None:
+            return
+        usage = self._usage(quota.metadata.namespace)
+        used = {res: _fmt(usage.get(res, 0.0)) for res in quota.spec.hard}
+        if quota.status.used == used and quota.status.hard == quota.spec.hard:
+            return
+        try:
+            fresh = self.cs.resourcequotas.get(
+                quota.metadata.name, quota.metadata.namespace
+            )
+            fresh.status.hard = dict(quota.spec.hard)
+            fresh.status.used = used
+            self.cs.resourcequotas.update_status(fresh)
+        except (NotFound, Conflict):
+            pass  # requeued by the next event / resync

@@ -1,0 +1,128 @@
+"""PersistentVolume binder (ref: pkg/controller/volume/persistentvolume/
+pv_controller.go): matches Pending claims to Available volumes (capacity ≥
+request, access modes ⊆ volume's, storage class equal), binds both sides,
+and releases volumes whose claim is gone (Retain → Released, Delete →
+deleted). JAX checkpoint/dataset volumes ride this path."""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..machinery import ApiError, NotFound
+from ..utils.quantity import parse_quantity
+from .base import Controller
+
+
+class PersistentVolumeBinder(Controller):
+    name = "persistentvolume-binder"
+
+    def setup(self):
+        self.pvs = self.factory.informer("persistentvolumes")
+        self.pvcs = self.factory.informer("persistentvolumeclaims")
+        self.pvcs.add_handler(
+            on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self._claim_deleted,
+        )
+        self.pvs.add_handler(
+            on_add=self._pv_event, on_update=lambda _o, n: self._pv_event(n)
+        )
+
+    def _pv_event(self, pv: t.PersistentVolume):
+        # a new/updated volume may satisfy a pending claim; also reconcile
+        # release of bound volumes whose claim vanished
+        for pvc in self.pvcs.list():
+            if pvc.status.phase == "Pending":
+                self.enqueue(pvc)
+        self._maybe_release(pv)
+
+    def _claim_deleted(self, pvc: t.PersistentVolumeClaim):
+        for pv in self.pvs.list():
+            self._maybe_release(pv)
+
+    def _maybe_release(self, pv: t.PersistentVolume):
+        ref = pv.spec.claim_ref
+        if ref is None or pv.status.phase != "Bound":
+            return
+        if self.pvcs.get(f"{ref.namespace}/{ref.name}") is not None:
+            return
+        try:
+            if pv.spec.persistent_volume_reclaim_policy == "Delete":
+                self.cs.persistentvolumes.delete(pv.metadata.name, "")
+                return
+            fresh = self.cs.persistentvolumes.get(pv.metadata.name, "")
+            fresh.status.phase = "Released"
+            self.cs.persistentvolumes.update_status(fresh)
+        except (NotFound, ApiError):
+            pass
+
+    @staticmethod
+    def _matches(pv: t.PersistentVolume, pvc: t.PersistentVolumeClaim) -> bool:
+        if pv.spec.claim_ref is not None or pv.status.phase != "Available":
+            return False
+        if pv.spec.storage_class_name != pvc.spec.storage_class_name:
+            return False
+        if not set(pvc.spec.access_modes) <= set(pv.spec.access_modes):
+            return False
+        want = parse_quantity(pvc.spec.resources.requests.get("storage"))
+        have = parse_quantity(pv.spec.capacity.get("storage"))
+        return have >= want
+
+    def sync(self, key: str):
+        pvc = self.pvcs.get(key)
+        if pvc is None or pvc.status.phase == "Bound":
+            return
+        if pvc.spec.volume_name:
+            self._finish_bind(pvc, pvc.spec.volume_name)
+            return
+        # a previous pass may have claimed a PV but crashed before finishing —
+        # resume that bind instead of claiming a second volume
+        for pv in self.pvs.list():
+            ref = pv.spec.claim_ref
+            if (
+                ref is not None
+                and ref.namespace == pvc.metadata.namespace
+                and ref.name == pvc.metadata.name
+            ):
+                self._finish_bind(pvc, pv.metadata.name)
+                return
+        # smallest satisfying volume wins (reference's findBestMatchForClaim)
+        candidates = [pv for pv in self.pvs.list() if self._matches(pv, pvc)]
+        if not candidates:
+            return  # requeued when a PV appears
+        best = min(candidates, key=lambda pv: parse_quantity(pv.spec.capacity.get("storage")))
+        try:
+            fresh_pv = self.cs.persistentvolumes.get(best.metadata.name, "")
+            if fresh_pv.spec.claim_ref is not None:
+                self.enqueue_after(key, 0.5)  # raced with another binder pass
+                return
+            fresh_pv.spec.claim_ref = t.ObjectReference(
+                kind="PersistentVolumeClaim",
+                namespace=pvc.metadata.namespace,
+                name=pvc.metadata.name,
+                uid=pvc.metadata.uid,
+            )
+            fresh_pv = self.cs.persistentvolumes.update(fresh_pv)
+            fresh_pv.status.phase = "Bound"
+            self.cs.persistentvolumes.update_status(fresh_pv)
+        except ApiError:
+            self.enqueue_after(key, 0.5)
+            return
+        self._finish_bind(pvc, best.metadata.name)
+
+    def _finish_bind(self, pvc: t.PersistentVolumeClaim, pv_name: str):
+        try:
+            pv = self.cs.persistentvolumes.get(pv_name, "")
+            fresh = self.cs.persistentvolumeclaims.get(
+                pvc.metadata.name, pvc.metadata.namespace
+            )
+            if not fresh.spec.volume_name:
+                fresh.spec.volume_name = pv_name
+                fresh = self.cs.persistentvolumeclaims.update(fresh)
+            fresh.status.phase = "Bound"
+            fresh.status.capacity = dict(pv.spec.capacity)
+            fresh.status.access_modes = list(pv.spec.access_modes)
+            self.cs.persistentvolumeclaims.update_status(fresh)
+            if pv.status.phase != "Bound":
+                pv.status.phase = "Bound"
+                self.cs.persistentvolumes.update_status(pv)
+        except ApiError:
+            self.enqueue_after(pvc.key(), 0.5)

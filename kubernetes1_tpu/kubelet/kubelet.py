@@ -89,6 +89,7 @@ class Kubelet:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.RLock()
+        self._metrics_rv: Dict[Tuple[str, str], str] = {}  # (kind, key) -> rv
 
     # ---------------------------------------------------------------- start
 
@@ -127,6 +128,7 @@ class Kubelet:
             (self._heartbeat, self.heartbeat_interval, "heartbeat"),
             (self._pleg_relist, self.pleg_interval, "pleg"),
             (self._tick_all, self.sync_interval, "sync-ticker"),
+            (self._publish_metrics, self.heartbeat_interval, "stats"),
         ):
             th = threading.Thread(
                 target=self._loop, args=(fn, period), daemon=True, name=name
@@ -238,6 +240,73 @@ class Kubelet:
             self.cs.nodes.update_status(node)
         except Conflict:
             pass  # next beat wins
+
+    # -------------------------------------------------------- stats pipeline
+
+    @staticmethod
+    def _fmt_usage(stats: Dict[str, float]) -> Dict[str, str]:
+        return {
+            "cpu": f"{int(round(stats.get('cpu', 0.0) * 1000))}m",
+            "memory": str(int(stats.get("memory", 0.0))),
+        }
+
+    def _upsert_metrics(self, client, obj, namespace: str = ""):
+        # Steady state is update (the object exists after the first cycle);
+        # create only on the first publish or after a GC.
+        cached = self._metrics_rv.get((type(obj).KIND, obj.key()))
+        try:
+            if cached is not None:
+                obj.metadata.resource_version = cached
+                updated = client.update(obj)
+            else:
+                updated = client.create(obj, namespace)
+        except NotFound:
+            try:
+                updated = client.create(obj, namespace)
+            except ApiError:
+                return
+        except ApiError:  # Conflict/AlreadyExists: refresh rv, next cycle wins
+            try:
+                cur = client.get(obj.metadata.name, obj.metadata.namespace)
+                self._metrics_rv[(type(obj).KIND, obj.key())] = cur.metadata.resource_version
+            except ApiError:
+                self._metrics_rv.pop((type(obj).KIND, obj.key()), None)
+            return
+        self._metrics_rv[(type(obj).KIND, obj.key())] = updated.metadata.resource_version
+
+    def _publish_metrics(self):
+        """Resource-metrics pipeline, one hop: runtime stats → PodMetrics /
+        NodeMetrics objects (ref: cadvisor → /stats/summary
+        (server/stats/summary.go) → metrics-server → metrics.k8s.io)."""
+        now = now_iso()
+        node_cpu, node_mem = 0.0, 0.0
+        for pod in self.pods.list():
+            if pod.spec.node_name != self.node_name:
+                continue
+            with self._lock:
+                cids = {
+                    name: cid
+                    for (uid, name), cid in self._containers.items()
+                    if uid == pod.metadata.uid
+                }
+            if not cids:
+                continue
+            pm = t.PodMetrics(timestamp=now)
+            pm.metadata.name = pod.metadata.name
+            pm.metadata.namespace = pod.metadata.namespace
+            for cname, cid in sorted(cids.items()):
+                stats = self.runtime.container_stats(cid)
+                node_cpu += stats.get("cpu", 0.0)
+                node_mem += stats.get("memory", 0.0)
+                pm.containers.append(
+                    t.ContainerMetrics(name=cname, usage=self._fmt_usage(stats))
+                )
+            self._upsert_metrics(self.cs.podmetrics, pm, pod.metadata.namespace)
+        nm = t.NodeMetrics(
+            timestamp=now, usage=self._fmt_usage({"cpu": node_cpu, "memory": node_mem})
+        )
+        nm.metadata.name = self.node_name
+        self._upsert_metrics(self.cs.nodemetrics, nm)
 
     # ------------------------------------------------------------ pod source
 

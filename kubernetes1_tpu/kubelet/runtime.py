@@ -112,6 +112,11 @@ class RuntimeService:
     def read_log(self, container_id: str, tail: int = 0) -> str:
         return ""
 
+    def container_stats(self, container_id: str) -> Dict[str, float]:
+        """Point-in-time usage {"cpu": cores, "memory": bytes} for the stats
+        pipeline (ref: cadvisor ContainerStats → kubelet Summary API)."""
+        return {"cpu": 0.0, "memory": 0.0}
+
 
 class ImageService:
     """ref: api.proto ImageService (5 RPCs) — advisory here."""
@@ -144,6 +149,20 @@ class FakeRuntime(RuntimeService):
         self._containers: Dict[str, ContainerRecord] = {}
         self._exit_plans: Dict[str, tuple] = {}  # cid -> (deadline, code)
         self.images = ImageService()
+        # Synthetic usage for the stats pipeline: per-container-name override,
+        # else the default. Tests drive HPA behavior through set_usage().
+        self.default_usage: Dict[str, float] = {"cpu": 0.001, "memory": 1 << 20}
+        self._usage_by_name: Dict[str, Dict[str, float]] = {}
+
+    def set_usage(self, container_name: str, cpu: float, memory: float = 1 << 20):
+        self._usage_by_name[container_name] = {"cpu": cpu, "memory": memory}
+
+    def container_stats(self, container_id: str) -> Dict[str, float]:
+        with self._lock:
+            c = self._containers.get(container_id)
+        if c is None or c.state != CONTAINER_RUNNING:
+            return {"cpu": 0.0, "memory": 0.0}
+        return dict(self._usage_by_name.get(c.name, self.default_usage))
 
     def version(self) -> str:
         return "fake://0.1"
@@ -266,6 +285,7 @@ class ProcessRuntime(RuntimeService):
         self._containers: Dict[str, ContainerRecord] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._configs: Dict[str, ContainerConfig] = {}
+        self._stat_samples: Dict[str, tuple] = {}  # cid -> (cpu_ticks, mono_ts)
         self.images = ImageService()
 
     def version(self) -> str:
@@ -406,3 +426,29 @@ class ProcessRuntime(RuntimeService):
         if tail:
             lines = lines[-tail:]
         return "".join(lines)
+
+    def container_stats(self, container_id: str) -> Dict[str, float]:
+        """CPU from /proc/<pid>/stat utime+stime deltas between calls, RSS
+        from statm — per-process cadvisor-lite."""
+        with self._lock:
+            proc = self._procs.get(container_id)
+        if proc is None or proc.poll() is not None:
+            return {"cpu": 0.0, "memory": 0.0}
+        try:
+            with open(f"/proc/{proc.pid}/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            ticks = int(parts[11]) + int(parts[12])  # utime, stime after comm
+            with open(f"/proc/{proc.pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+        except (OSError, IndexError, ValueError):
+            return {"cpu": 0.0, "memory": 0.0}
+        now = time.monotonic()
+        hz = os.sysconf("SC_CLK_TCK")
+        mem = float(rss_pages * os.sysconf("SC_PAGE_SIZE"))
+        with self._lock:
+            last = self._stat_samples.get(container_id)
+            self._stat_samples[container_id] = (ticks, now)
+        if last is None or now <= last[1]:
+            return {"cpu": 0.0, "memory": mem}
+        cpu = (ticks - last[0]) / hz / (now - last[1])
+        return {"cpu": max(0.0, cpu), "memory": mem}

@@ -1,6 +1,22 @@
 """Shared test fixtures: nodes with synthetic TPU inventories, TPU pods."""
 
 from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.client import retry_on_conflict
+
+
+def mutate_with_retry(rc, name, mutate, namespace="default"):
+    """get → mutate(obj) → update under retry_on_conflict.
+
+    Controllers writing status bump resourceVersion between our get and
+    update, so every test-side read-modify-write goes through this.
+    """
+
+    def attempt():
+        obj = rc.get(name, namespace=namespace)
+        mutate(obj)
+        return rc.update(obj)
+
+    return retry_on_conflict(attempt)
 
 
 def make_tpu_devices(count, slice_id="slice-0", tpu_type="v5e", host_index=0, prefix=None):

@@ -22,7 +22,7 @@ from kubernetes1_tpu.machinery import NotFound
 from kubernetes1_tpu.scheduler import Scheduler
 from kubernetes1_tpu.utils.waitutil import must_poll_until
 
-from tests.helpers import make_tpu_pod
+from tests.helpers import make_tpu_pod, mutate_with_retry
 
 
 def start_hollow_node(cs, name, plugin_root, tpus=4, slice_id="s0", host_index=0):
@@ -240,9 +240,7 @@ class TestReplicaSetAndDeployment:
             return len([p for p in pods if not p.metadata.deletion_timestamp])
 
         must_poll_until(lambda: count() == 3, timeout=15.0, desc="3 replicas")
-        rs = cs.replicasets.get("web")
-        rs.spec.replicas = 1
-        cs.replicasets.update(rs)
+        mutate_with_retry(cs.replicasets, "web", lambda rs: setattr(rs.spec, "replicas", 1))
         must_poll_until(lambda: count() == 1, timeout=15.0, desc="scaled to 1")
         cs.replicasets.delete("web")
 
@@ -263,9 +261,10 @@ class TestReplicaSetAndDeployment:
             desc="deployment ready",
         )
         # rollout: change image
-        fresh = cs.deployments.get("app")
-        fresh.spec.template.spec.containers[0].image = "v2"
-        cs.deployments.update(fresh)
+        def set_v2(dep):
+            dep.spec.template.spec.containers[0].image = "v2"
+
+        mutate_with_retry(cs.deployments, "app", set_v2)
 
         def rolled():
             pods, _ = cs.pods.list(namespace="default", label_selector="app=app")

@@ -1,0 +1,343 @@
+"""Policy-layer tests: quota/limits admission, service accounts + tokens,
+HPA over the metrics pipeline, PDB status, pod GC, job TTL, CSR signing, and
+PV/PVC binding — the reference's test/integration/{quota,serviceaccount,
+evictions,garbagecollector} areas plus autoscaling."""
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.controllers import ControllerManager
+from kubernetes1_tpu.controllers.certificates import verify_certificate
+from kubernetes1_tpu.controllers.serviceaccount import verify_token
+from kubernetes1_tpu.machinery import Forbidden, NotFound
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import mutate_with_retry
+from tests.test_controllers import start_hollow_node
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=2.0, eviction_timeout=2.0)
+    cm.start()
+    kubelet, plugin, impl = start_hollow_node(cs, "node-0", str(tmp_path), tpus=4)
+    env = {"master": master, "cs": cs, "kubelet": kubelet}
+    yield env
+    kubelet.stop()
+    plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def simple_pod(name, cpu_request="100m", labels=None, command=None):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.labels = labels or {}
+    pod.spec.containers = [
+        t.Container(
+            name="c",
+            image="busybox",
+            command=command or ["serve"],
+            resources=t.ResourceRequirements(requests={"cpu": cpu_request}),
+        )
+    ]
+    return pod
+
+
+class TestQuotaAndLimits:
+    def test_quota_blocks_over_limit_and_tracks_usage(self, cluster):
+        cs = cluster["cs"]
+        quota = t.ResourceQuota()
+        quota.metadata.name = "q"
+        quota.spec.hard = {"pods": "2", "google.com/tpu": "2"}
+        cs.resourcequotas.create(quota)
+
+        cs.pods.create(simple_pod("p1"))
+        cs.pods.create(simple_pod("p2"))
+        with pytest.raises(Forbidden, match="exceeded quota"):
+            cs.pods.create(simple_pod("p3"))
+
+        must_poll_until(
+            lambda: cs.resourcequotas.get("q").status.used.get("pods") == "2",
+            timeout=10.0, desc="quota status.used",
+        )
+
+    def test_quota_enforces_tpu_chips(self, cluster):
+        cs = cluster["cs"]
+        quota = t.ResourceQuota()
+        quota.metadata.name = "tpuq"
+        quota.spec.hard = {"google.com/tpu": "2"}
+        cs.resourcequotas.create(quota)
+
+        pod = simple_pod("tpu-pod")
+        pod.spec.containers[0].resources.limits = {"google.com/tpu": 4}
+        with pytest.raises(Forbidden, match="exceeded quota"):
+            cs.pods.create(pod)
+
+    def test_limitranger_defaults_and_max(self, cluster):
+        cs = cluster["cs"]
+        lr = t.LimitRange()
+        lr.metadata.name = "limits"
+        lr.spec.limits = [
+            t.LimitRangeItem(
+                type="Container",
+                default={"cpu": "500m"},
+                default_request={"cpu": "250m"},
+                max={"cpu": "1"},
+            )
+        ]
+        cs.limitranges.create(lr)
+
+        pod = t.Pod()
+        pod.metadata.name = "defaulted"
+        pod.spec.containers = [t.Container(name="c", image="busybox", command=["serve"])]
+        created = cs.pods.create(pod)
+        assert created.spec.containers[0].resources.limits["cpu"] == "500m"
+        assert created.spec.containers[0].resources.requests["cpu"] == "250m"
+
+        big = simple_pod("big")
+        big.spec.containers[0].resources.limits = {"cpu": "4"}
+        with pytest.raises(Forbidden, match="LimitRange max"):
+            cs.pods.create(big)
+
+
+class TestServiceAccounts:
+    def test_default_sa_created_with_signed_token(self, cluster):
+        cs = cluster["cs"]
+        must_poll_until(
+            lambda: _sa_with_secret(cs, "default"), timeout=10.0,
+            desc="default SA + token",
+        )
+        sa = cs.serviceaccounts.get("default", "default")
+        secret = cs.secrets.get(sa.secrets[0].name, "default")
+        claims = verify_token("ktpu-sa-key", secret.data["token"])
+        assert claims["sub"] == "system:serviceaccount:default:default"
+
+    def test_pod_gets_default_service_account(self, cluster):
+        cs = cluster["cs"]
+        created = cs.pods.create(simple_pod("sa-pod"))
+        assert created.spec.service_account_name == "default"
+
+
+def _sa_with_secret(cs, ns):
+    try:
+        return bool(cs.serviceaccounts.get("default", ns).secrets)
+    except NotFound:
+        return False
+
+
+class TestAutoscaling:
+    def test_hpa_scales_up_on_cpu(self, cluster):
+        cs = cluster["cs"]
+        kubelet = cluster["kubelet"]
+        rs = t.ReplicaSet()
+        rs.metadata.name = "workers"
+        rs.spec.replicas = 1
+        rs.spec.selector = t.LabelSelector(match_labels={"app": "w"})
+        rs.spec.template.metadata.labels = {"app": "w"}
+        rs.spec.template.spec.containers = [
+            t.Container(
+                name="c", image="busybox", command=["serve"],
+                resources=t.ResourceRequirements(requests={"cpu": "100m"}),
+            )
+        ]
+        cs.replicasets.create(rs)
+        must_poll_until(
+            lambda: _running_count(cs, "app=w") == 1, timeout=15.0, desc="1 replica up"
+        )
+        # drive observed usage to 4x the request → HPA must scale up
+        kubelet.runtime.set_usage("c", cpu=0.4)
+
+        hpa = t.HorizontalPodAutoscaler()
+        hpa.metadata.name = "workers-hpa"
+        hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
+            kind="ReplicaSet", name="workers"
+        )
+        hpa.spec.min_replicas = 1
+        hpa.spec.max_replicas = 3
+        hpa.spec.target_cpu_utilization_percentage = 100
+        cs.horizontalpodautoscalers.create(hpa)
+
+        must_poll_until(
+            lambda: (cs.replicasets.get("workers").spec.replicas or 0) >= 3,
+            timeout=30.0, desc="HPA scaled to max",
+        )
+        must_poll_until(
+            lambda: cs.horizontalpodautoscalers.get("workers-hpa").status.desired_replicas >= 3,
+            timeout=10.0, desc="HPA status",
+        )
+
+
+def _running_count(cs, selector):
+    pods, _ = cs.pods.list(namespace="default", label_selector=selector)
+    return len([p for p in pods if p.status.phase == t.POD_RUNNING])
+
+
+class TestMetricsPipeline:
+    def test_kubelet_publishes_node_and_pod_metrics(self, cluster):
+        cs = cluster["cs"]
+        cs.pods.create(simple_pod("metered", labels={"app": "m"}))
+        must_poll_until(
+            lambda: _running_count(cs, "app=m") == 1, timeout=15.0, desc="pod running"
+        )
+
+        def has_metrics():
+            try:
+                pm = cs.podmetrics.get("metered", "default")
+                nm = cs.nodemetrics.get("node-0", "")
+            except NotFound:
+                return False
+            return bool(pm.containers) and "cpu" in nm.usage
+
+        must_poll_until(has_metrics, timeout=15.0, desc="metrics published")
+
+
+class TestDisruption:
+    def test_pdb_status_reflects_healthy_pods(self, cluster):
+        cs = cluster["cs"]
+        for i in range(3):
+            cs.pods.create(simple_pod(f"web-{i}", labels={"app": "web"}))
+        must_poll_until(
+            lambda: _running_count(cs, "app=web") == 3, timeout=15.0, desc="3 running"
+        )
+        pdb = t.PodDisruptionBudget()
+        pdb.metadata.name = "web-pdb"
+        pdb.spec.selector = t.LabelSelector(match_labels={"app": "web"})
+        pdb.spec.min_available = 2
+        cs.poddisruptionbudgets.create(pdb)
+
+        def settled():
+            st = cs.poddisruptionbudgets.get("web-pdb").status
+            return st.current_healthy == 3 and st.disruptions_allowed == 1
+        must_poll_until(settled, timeout=15.0, desc="PDB status")
+
+
+class TestGCAndTTL:
+    def test_orphaned_pod_deleted_when_node_gone(self, cluster):
+        cs = cluster["cs"]
+        pod = simple_pod("orphan")
+        pod.spec.node_name = "ghost-node"  # pre-bound to a node that never existed
+        cs.pods.create(pod)
+        must_poll_until(
+            lambda: _gone(cs, "orphan"), timeout=30.0, desc="orphan GCed"
+        )
+
+    def test_finished_job_deleted_after_ttl(self, cluster):
+        cs = cluster["cs"]
+        job = t.Job()
+        job.metadata.name = "quick"
+        job.spec.completions = 1
+        job.spec.ttl_seconds_after_finished = 1
+        job.spec.template.spec.containers = [
+            t.Container(name="c", image="busybox", command=["sleep", "0.1"])
+        ]
+        cs.jobs.create(job)
+        must_poll_until(
+            lambda: _job_gone(cs, "quick"), timeout=30.0, desc="job TTL-deleted"
+        )
+
+
+def _gone(cs, name):
+    try:
+        cs.pods.get(name, "default")
+        return False
+    except NotFound:
+        return True
+
+
+def _job_gone(cs, name):
+    try:
+        cs.jobs.get(name, "default")
+        return False
+    except NotFound:
+        return True
+
+
+class TestCertificates:
+    def test_node_csr_auto_approved_and_signed(self, cluster):
+        cs = cluster["cs"]
+        csr = t.CertificateSigningRequest()
+        csr.metadata.name = "node-1-client"
+        csr.spec.request = "CSR-PAYLOAD"
+        csr.spec.username = "system:node:node-1"
+        cs.certificatesigningrequests.create(csr)
+
+        must_poll_until(
+            lambda: bool(cs.certificatesigningrequests.get("node-1-client", "").status.certificate),
+            timeout=15.0, desc="CSR signed",
+        )
+        signed = cs.certificatesigningrequests.get("node-1-client", "")
+        assert any(c.type == "Approved" for c in signed.status.conditions)
+        assert verify_certificate(
+            "ktpu-ca-key", "system:node:node-1", "CSR-PAYLOAD",
+            signed.status.certificate,
+        )
+
+    def test_user_csr_waits_for_manual_approval(self, cluster):
+        cs = cluster["cs"]
+        csr = t.CertificateSigningRequest()
+        csr.metadata.name = "alice"
+        csr.spec.request = "REQ"
+        csr.spec.username = "alice"
+        cs.certificatesigningrequests.create(csr)
+        import time
+        time.sleep(1.0)
+        assert not cs.certificatesigningrequests.get("alice", "").status.certificate
+
+
+class TestVolumes:
+    def test_pvc_binds_smallest_satisfying_pv(self, cluster):
+        cs = cluster["cs"]
+        for name, size in (("pv-big", "100Gi"), ("pv-small", "10Gi")):
+            pv = t.PersistentVolume()
+            pv.metadata.name = name
+            pv.spec.capacity = {"storage": size}
+            pv.spec.access_modes = ["ReadWriteOnce"]
+            cs.persistentvolumes.create(pv)
+
+        pvc = t.PersistentVolumeClaim()
+        pvc.metadata.name = "ckpt"
+        pvc.spec.access_modes = ["ReadWriteOnce"]
+        pvc.spec.resources = t.ResourceRequirements(requests={"storage": "5Gi"})
+        cs.persistentvolumeclaims.create(pvc)
+
+        must_poll_until(
+            lambda: cs.persistentvolumeclaims.get("ckpt").status.phase == "Bound",
+            timeout=15.0, desc="claim bound",
+        )
+        bound = cs.persistentvolumeclaims.get("ckpt")
+        assert bound.spec.volume_name == "pv-small"
+        pv = cs.persistentvolumes.get("pv-small", "")
+        assert pv.status.phase == "Bound"
+        assert pv.spec.claim_ref.name == "ckpt"
+
+    def test_pv_released_when_claim_deleted(self, cluster):
+        cs = cluster["cs"]
+        pv = t.PersistentVolume()
+        pv.metadata.name = "pv-r"
+        pv.spec.capacity = {"storage": "1Gi"}
+        pv.spec.access_modes = ["ReadWriteOnce"]
+        cs.persistentvolumes.create(pv)
+        pvc = t.PersistentVolumeClaim()
+        pvc.metadata.name = "tmp-claim"
+        pvc.spec.access_modes = ["ReadWriteOnce"]
+        pvc.spec.resources = t.ResourceRequirements(requests={"storage": "1Gi"})
+        cs.persistentvolumeclaims.create(pvc)
+        must_poll_until(
+            lambda: cs.persistentvolumeclaims.get("tmp-claim").status.phase == "Bound",
+            timeout=15.0, desc="bound",
+        )
+        cs.persistentvolumeclaims.delete("tmp-claim")
+        must_poll_until(
+            lambda: cs.persistentvolumes.get("pv-r", "").status.phase == "Released",
+            timeout=15.0, desc="released",
+        )

@@ -12,11 +12,12 @@ from kubernetes1_tpu.client import Clientset, InformerFactory
 from kubernetes1_tpu.controllers import ControllerManager
 from kubernetes1_tpu.controllers.cronjob import CronJobController
 from kubernetes1_tpu.controllers.statefulset import POD_NAME_LABEL, REVISION_LABEL
-from kubernetes1_tpu.machinery import Conflict, Invalid
+from kubernetes1_tpu.machinery import Invalid
 from kubernetes1_tpu.scheduler import Scheduler
 from kubernetes1_tpu.utils.cron import next_fire, parse_cron, unmet_times
 from kubernetes1_tpu.utils.waitutil import must_poll_until
 
+from tests.helpers import mutate_with_retry
 from tests.test_controllers import start_hollow_node
 
 
@@ -142,9 +143,7 @@ class TestStatefulSet:
 
         must_poll_until(lambda: names() == ["cache-0", "cache-1", "cache-2"],
                         timeout=20.0, desc="3 pods")
-        ss = cs.statefulsets.get("cache")
-        ss.spec.replicas = 1
-        cs.statefulsets.update(ss)
+        mutate_with_retry(cs.statefulsets, "cache", lambda ss: setattr(ss.spec, "replicas", 1))
         must_poll_until(lambda: names() == ["cache-0"], timeout=20.0,
                         desc="scaled to ordinal 0")
 
@@ -156,14 +155,10 @@ class TestStatefulSet:
             timeout=20.0, desc="2 ready",
         )
         old_rev = cs.statefulsets.get("web").status.current_revision
-        for _ in range(10):  # retry: status writes race this update
-            ss = cs.statefulsets.get("web")
+        def set_v2(ss):
             ss.spec.template.spec.containers[0].image = "v2"
-            try:
-                cs.statefulsets.update(ss)
-                break
-            except Conflict:
-                time.sleep(0.05)
+
+        mutate_with_retry(cs.statefulsets, "web", set_v2)
 
         def updated():
             s = cs.statefulsets.get("web").status
@@ -248,9 +243,10 @@ class TestCronJob:
             )
 
             # Forbid policy blocks while active
-            fresh = cs.cronjobs.get("tick")
-            fresh.spec.concurrency_policy = "Forbid"
-            cs.cronjobs.update(fresh)
+            fresh = mutate_with_retry(
+                cs.cronjobs, "tick",
+                lambda cj: setattr(cj.spec, "concurrency_policy", "Forbid"),
+            )
             factory.wait_for_sync()
             fake_now[0] += 60
             must_poll_until(
