@@ -35,6 +35,10 @@ _REGISTRY = [
     (t.APIService, "apiservices", False),
     (t.PodMetrics, "podmetrics", True),
     (t.NodeMetrics, "nodemetrics", False),
+    (t.Role, "roles", True),
+    (t.ClusterRole, "clusterroles", False),
+    (t.RoleBinding, "rolebindings", True),
+    (t.ClusterRoleBinding, "clusterrolebindings", False),
 ]
 
 for cls, plural, namespaced in _REGISTRY:

@@ -1058,6 +1058,62 @@ class APIServiceStatus:
     message: str = ""
 
 
+# --------------------------------------------------------------------- rbac
+
+
+@dataclass
+class PolicyRule:
+    """Ref: rbac/v1 PolicyRule (staging/src/k8s.io/api/rbac/v1/types.go).
+    api_groups are omitted — the flat registry has no group dimension."""
+
+    verbs: List[str] = field(default_factory=list)       # get|list|watch|create|update|patch|delete|*
+    resources: List[str] = field(default_factory=list)   # plural names or *
+    resource_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Subject:
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""
+
+
+@dataclass
+class RoleRef:
+    kind: str = "Role"  # Role | ClusterRole
+    name: str = ""
+
+
+@dataclass
+class Role(KObject):
+    KIND = "Role"
+    API_VERSION = "rbac/v1"
+    rules: List[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class ClusterRole(KObject):
+    KIND = "ClusterRole"
+    API_VERSION = "rbac/v1"
+    rules: List[PolicyRule] = field(default_factory=list)
+
+
+@dataclass
+class RoleBinding(KObject):
+    KIND = "RoleBinding"
+    API_VERSION = "rbac/v1"
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+@dataclass
+class ClusterRoleBinding(KObject):
+    KIND = "ClusterRoleBinding"
+    API_VERSION = "rbac/v1"
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+
 # ------------------------------------------------------------------ metrics
 
 

@@ -1,0 +1,261 @@
+"""Authentication + authorization (ref: staging/src/k8s.io/apiserver/pkg/
+authentication + pkg/registry/rbac + plugin/pkg/auth/authorizer/node).
+
+The filter-chain position mirrors config.go:530-551: authn resolves the
+request's UserInfo, then the authorizer chain (union semantics — first
+authorizer to allow wins) gates the verb/resource before admission runs.
+
+Authenticators (bearer-token forms):
+- static tokens        → users/groups from a table (--token-auth-file)
+- service account HMAC → system:serviceaccount:<ns>:<name> (JWT analog)
+- KTPU-CERT creds      → subject embedded in the signed payload (x509 analog,
+                         minted by the CSR signer in controllers/certificates)
+
+Authorizers:
+- system:masters group is always allowed (bootstrap superuser, as upstream)
+- RBACAuthorizer over Role/ClusterRole/(Cluster)RoleBinding objects
+- NodeAuthorizer scoping each kubelet to its own node's objects
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import types as t
+
+GROUP_MASTERS = "system:masters"
+GROUP_NODES = "system:nodes"
+GROUP_AUTHENTICATED = "system:authenticated"
+GROUP_UNAUTHENTICATED = "system:unauthenticated"
+USER_ANONYMOUS = "system:anonymous"
+
+
+@dataclass
+class UserInfo:
+    name: str = USER_ANONYMOUS
+    groups: List[str] = field(default_factory=list)
+
+    def in_group(self, g: str) -> bool:
+        return g in self.groups
+
+
+ANONYMOUS = UserInfo(name=USER_ANONYMOUS, groups=[GROUP_UNAUTHENTICATED])
+
+
+# ------------------------------------------------------------------- authn
+
+
+class StaticTokenAuthenticator:
+    """token -> (username, groups) table."""
+
+    def __init__(self, tokens: Dict[str, Tuple[str, List[str]]]):
+        self.tokens = tokens
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        entry = self.tokens.get(token)
+        if entry is None:
+            return None
+        name, groups = entry
+        return UserInfo(name=name, groups=list(groups) + [GROUP_AUTHENTICATED])
+
+
+class ServiceAccountAuthenticator:
+    """Verifies HMAC SA tokens minted by the token controller."""
+
+    def __init__(self, signing_key: str):
+        self.signing_key = signing_key
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        from ..controllers.serviceaccount import verify_token
+
+        claims = verify_token(self.signing_key, token)
+        if not claims:
+            return None
+        sub = claims.get("sub", "")
+        if not sub.startswith("system:serviceaccount:"):
+            return None
+        _, _, ns, _name = sub.split(":", 3)
+        return UserInfo(
+            name=sub,
+            groups=[
+                "system:serviceaccounts",
+                f"system:serviceaccounts:{ns}",
+                GROUP_AUTHENTICATED,
+            ],
+        )
+
+
+class CertificateAuthenticator:
+    """Verifies KTPU-CERT credentials issued by the CSR signer."""
+
+    def __init__(self, ca_key: str):
+        self.ca_key = ca_key
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        from ..controllers.certificates import parse_certificate
+
+        info = parse_certificate(self.ca_key, token)
+        if info is None:
+            return None
+        return UserInfo(
+            name=info.get("user", ""),
+            groups=list(info.get("groups", [])) + [GROUP_AUTHENTICATED],
+        )
+
+
+class AuthenticatorChain:
+    def __init__(self, authenticators: List):
+        self.authenticators = authenticators
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        """None = bad credential; ANONYMOUS is returned only for NO credential
+        (decided by the caller)."""
+        for a in self.authenticators:
+            user = a.authenticate(token)
+            if user is not None:
+                return user
+        return None
+
+
+# ------------------------------------------------------------------- authz
+
+
+def _match(values: List[str], want: str) -> bool:
+    return "*" in values or want in values
+
+
+class RBACAuthorizer:
+    """Evaluates RBAC objects live from the store (the reference resolves
+    through informer-backed rule caches; the in-memory store makes direct
+    reads cheap enough)."""
+
+    def __init__(self, lister: Callable[[str, str], list]):
+        self._list = lister  # (resource, namespace) -> [objects]
+
+    def _subject_matches(self, subj: t.Subject, user: UserInfo) -> bool:
+        if subj.kind == "User":
+            return subj.name == user.name
+        if subj.kind == "Group":
+            return user.in_group(subj.name)
+        if subj.kind == "ServiceAccount":
+            return user.name == f"system:serviceaccount:{subj.namespace}:{subj.name}"
+        return False
+
+    def _rules_for(self, user: UserInfo, namespace: str) -> List[t.PolicyRule]:
+        rules: List[t.PolicyRule] = []
+        for crb in self._list("clusterrolebindings", ""):
+            if any(self._subject_matches(s, user) for s in crb.subjects):
+                role = self._get_cluster_role(crb.role_ref.name)
+                if role:
+                    rules.extend(role.rules)
+        if namespace:
+            for rb in self._list("rolebindings", namespace):
+                if not any(self._subject_matches(s, user) for s in rb.subjects):
+                    continue
+                if rb.role_ref.kind == "ClusterRole":
+                    role = self._get_cluster_role(rb.role_ref.name)
+                else:
+                    role = next(
+                        (r for r in self._list("roles", namespace)
+                         if r.metadata.name == rb.role_ref.name),
+                        None,
+                    )
+                if role:
+                    rules.extend(role.rules)
+        return rules
+
+    def _get_cluster_role(self, name: str):
+        return next(
+            (r for r in self._list("clusterroles", "") if r.metadata.name == name),
+            None,
+        )
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str, name: str) -> bool:
+        for rule in self._rules_for(user, namespace):
+            if not _match(rule.verbs, verb):
+                continue
+            if not _match(rule.resources, resource):
+                continue
+            if rule.resource_names and name and name not in rule.resource_names:
+                continue
+            return True
+        return False
+
+
+class NodeAuthorizer:
+    """Scopes kubelets (system:node:<name>, group system:nodes) to their own
+    node's objects (ref: plugin/pkg/auth/authorizer/node/node_authorizer.go —
+    there a graph; here direct pod-binding lookups)."""
+
+    READ_RESOURCES = {
+        "pods", "services", "endpoints", "configmaps", "secrets",
+        "persistentvolumeclaims", "persistentvolumes", "nodes",
+    }
+
+    def __init__(self, get_pod: Callable[[str, str], Optional[t.Pod]]):
+        self._get_pod = get_pod
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str, name: str) -> bool:
+        if not user.in_group(GROUP_NODES) or not user.name.startswith("system:node:"):
+            return False
+        node_name = user.name[len("system:node:"):]
+        if verb in ("get", "list", "watch") and resource in self.READ_RESOURCES:
+            return True
+        if resource == "nodes":
+            # register itself + keep its own status current
+            return (verb == "create") or (
+                verb in ("update", "patch", "delete") and name == node_name
+            )
+        if resource == "nodemetrics":
+            return verb in ("create", "update", "patch") and (
+                not name or name == node_name
+            )
+        if resource == "events":
+            return verb in ("create", "update", "patch")
+        if resource == "leases":
+            return verb in ("get", "create", "update", "patch")
+        if resource == "certificatesigningrequests":
+            return verb in ("get", "create")
+        if resource in ("pods", "podmetrics"):
+            if verb not in ("update", "patch", "create", "delete"):
+                return False
+            if verb == "create" and resource == "podmetrics":
+                return True
+            pod = self._get_pod(namespace, name)
+            # mirror pods (static manifests) are created by the node itself
+            if pod is None:
+                return verb in ("create", "update", "patch")
+            return pod.spec.node_name == node_name
+        return False
+
+
+class AlwaysAllowAuthorizer:
+    def authorize(self, *args) -> bool:
+        return True
+
+
+class AuthorizerChain:
+    def __init__(self, authorizers: List):
+        self.authorizers = authorizers
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str, name: str) -> bool:
+        if user.in_group(GROUP_MASTERS):
+            return True
+        return any(
+            a.authorize(user, verb, resource, namespace, name)
+            for a in self.authorizers
+        )
+
+
+def verb_for(method: str, name: str, is_watch: bool) -> str:
+    if method == "GET":
+        if is_watch:
+            return "watch"
+        return "get" if name else "list"
+    return {
+        "POST": "create", "PUT": "update", "PATCH": "patch", "DELETE": "delete",
+    }.get(method, method.lower())

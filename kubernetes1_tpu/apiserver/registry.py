@@ -262,6 +262,20 @@ class Registry:
     # ------------------------------------------------------------ operations
 
     def create(self, resource: str, namespace: str, obj):
+        if resource == "customresourcedefinitions":
+            names = obj.spec.names
+            if not (obj.spec.group and names.plural and names.kind):
+                raise Invalid("CRD requires spec.group, spec.names.plural, spec.names.kind")
+            if (
+                names.plural in self.scheme.by_resource
+                and names.plural not in self.scheme.dynamic_resources
+            ):
+                raise Invalid(f"plural {names.plural!r} shadows a built-in resource")
+            if (
+                names.kind in self.scheme.by_kind
+                and names.kind not in self.scheme.dynamic_kinds
+            ):
+                raise Invalid(f"kind {names.kind!r} shadows a built-in kind")
         if self.scheme.namespaced.get(resource, True):
             obj.metadata.namespace = namespace or obj.metadata.namespace or "default"
         else:

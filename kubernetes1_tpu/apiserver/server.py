@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
-from ..machinery import ApiError, BadRequest, NotFound
+from ..machinery import ApiError, BadRequest, Forbidden, NotFound, Unauthorized
 from ..machinery.scheme import Scheme, global_scheme
 from ..storage import Store
 from .admission import (
@@ -41,6 +41,20 @@ from .admission import (
     ResourceV2,
     ServiceAccountAdmission,
     compute_namespace_usage,
+)
+from .auth import (
+    ANONYMOUS,
+    GROUP_MASTERS,
+    AlwaysAllowAuthorizer,
+    AuthenticatorChain,
+    AuthorizerChain,
+    CertificateAuthenticator,
+    NodeAuthorizer,
+    RBACAuthorizer,
+    ServiceAccountAuthenticator,
+    StaticTokenAuthenticator,
+    UserInfo,
+    verb_for,
 )
 from .registry import Registry
 
@@ -81,12 +95,84 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise BadRequest(f"invalid JSON body: {e}") from e
 
-    def _authn(self) -> bool:
-        token = self.master.token
-        if not token:
-            return True
-        auth = self.headers.get("Authorization", "")
-        return auth == f"Bearer {token}"
+    def _authn(self) -> UserInfo:
+        """Resolve the request's user (ref: authn filter, config.go:530).
+        Raises Unauthorized for a presented-but-invalid credential."""
+        header = self.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            if self.master.token or self.master.authorization_mode != "AlwaysAllow":
+                return ANONYMOUS
+            return UserInfo(name="system:admin", groups=[GROUP_MASTERS])
+        token = header[len("Bearer "):]
+        user = self.master.authenticators.authenticate(token)
+        if user is None:
+            raise Unauthorized("invalid bearer token")
+        return user
+
+    def _check_kind(self, resource: str, obj):
+        """The body's kind must be the resource's registered kind — the
+        Unstructured decode fallback (for dynamic clients) must not let a
+        typo'd kind land in a typed registry."""
+        scheme = self.master.scheme
+        if resource in scheme.dynamic_resources:
+            want_kind = scheme.dynamic_resources[resource]
+        else:
+            want_kind = scheme.by_resource[resource].KIND
+        from ..machinery.scheme import Unstructured as _U
+
+        have_kind = obj.kind if isinstance(obj, _U) else type(obj).KIND
+        if want_kind and have_kind != want_kind:
+            raise BadRequest(
+                f"body kind {have_kind!r} does not match resource {resource!r} "
+                f"(want {want_kind!r})"
+            )
+        if isinstance(obj, _U) and resource not in scheme.dynamic_resources:
+            raise BadRequest(f"resource {resource!r} requires a typed {want_kind!r} body")
+
+    def _authz(self, user: UserInfo, verb: str, resource: str, ns: str, name: str):
+        if not self.master.authorizer.authorize(user, verb, resource, ns, name):
+            raise Forbidden(
+                f'user "{user.name}" cannot {verb} {resource}'
+                + (f' "{name}"' if name else "")
+                + (f' in namespace "{ns}"' if ns else "")
+            )
+
+    def _proxy_to_apiservice(self, svc_ref, method: str):
+        """Forward the request verbatim to the aggregated API server's
+        endpoint (ref: kube-aggregator proxy handler)."""
+        import http.client
+
+        addr = self.master.resolve_service_endpoint(
+            svc_ref.spec.service_namespace, svc_ref.spec.service_name,
+            svc_ref.spec.service_port,
+        )
+        if addr is None:
+            raise ApiError(
+                f"no endpoints for aggregated API service "
+                f"{svc_ref.metadata.name}"
+            )
+        host, port = addr
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            # identity forwarded the way the reference's front-proxy does
+            conn.request(method, self.path, body=body,
+                         headers={
+                             "Content-Type": "application/json",
+                             "X-Remote-User": self._user.name,
+                             "X-Remote-Group": ",".join(self._user.groups),
+                         })
+            resp = conn.getresponse()
+            raw = resp.read()
+            self.send_response(resp.status)
+            self.send_header("Content-Type",
+                             resp.getheader("Content-Type", "application/json"))
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+        finally:
+            conn.close()
 
     # ------------------------------------------------------------- dispatch
 
@@ -131,11 +217,6 @@ class _Handler(BaseHTTPRequestHandler):
     def _handle(self, method: str):
         start = time.monotonic()
         try:
-            if not self._authn():
-                self.send_response(401)
-                self.send_header("Content-Length", "0")
-                self.end_headers()
-                return
             parts, q = self._route()
             if parts and parts[0] in ("healthz", "readyz", "livez"):
                 self._send_json(200, {"status": "ok"})
@@ -143,12 +224,40 @@ class _Handler(BaseHTTPRequestHandler):
             if parts and parts[0] == "version":
                 self._send_json(200, {"gitVersion": "v0.1.0-ktpu", "platform": "tpu"})
                 return
+            user = self._authn()
+            # legacy single-token mode: the shared secret IS the cluster
+            if self.master.token and self.master.authorization_mode == "AlwaysAllow":
+                if self.headers.get("Authorization", "") != f"Bearer {self.master.token}":
+                    raise Unauthorized("invalid bearer token")
+                user = UserInfo(name="system:admin", groups=[GROUP_MASTERS])
+            self._user = user
+            # aggregation: /apis/<group>/<version> claimed by an APIService
+            # with a backing service proxies to that server (kube-aggregator).
+            # Authorize against the parsed resource path BEFORE proxying —
+            # upstream's aggregator likewise authorizes, then forwards
+            # identity via front-proxy headers.
+            apisvc = (
+                self.master.find_apiservice(parts[1], parts[2])
+                if len(parts) >= 3 and parts[0] == "apis"
+                else None
+            )
+            if apisvc is not None:
+                a_resource, a_ns, a_name, _ = self._parse_resource_path(parts)
+                self._authz(
+                    user,
+                    verb_for(method, a_name, q.get("watch") in ("1", "true")),
+                    a_resource, a_ns, a_name,
+                )
+                self._proxy_to_apiservice(apisvc, method)
+                return
             if parts and parts[0] == "metrics":
                 self._serve_metrics()
                 return
             resource, ns, name, sub = self._parse_resource_path(parts)
             if resource not in self.master.scheme.by_resource:
                 raise NotFound(f"resource {resource!r} not registered")
+            verb = verb_for(method, name, q.get("watch") in ("1", "true"))
+            self._authz(user, verb, resource, ns, name)
             handler = getattr(self, f"_do_{method.lower()}")
             handler(resource, ns, name, sub, q)
             self.master.metrics.observe(method, resource, time.monotonic() - start)
@@ -277,12 +386,13 @@ class _Handler(BaseHTTPRequestHandler):
         if resource == "pods" and sub == "binding":
             binding = self.master.scheme.decode(body)
             pod = reg.bind(ns, name, binding)
-            self.master.audit("bind", resource, ns, name)
+            self.master.audit("bind", resource, ns, name, self._user.name)
             self._send_json(201, self.master.scheme.encode(pod))
             return
         if sub:
             raise NotFound(f"subresource {sub!r} not writable")
         obj = self.master.scheme.decode(body)
+        self._check_kind(resource, obj)
         # default namespace from the URL before admission so plugins
         # (NamespaceAutoProvision) see the effective namespace
         if ns and not obj.metadata.namespace:
@@ -300,7 +410,11 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             obj = self.master.admission.admit(CREATE, resource, obj)
             created = reg.create(resource, ns, obj)
-        self.master.audit("create", resource, ns, created.metadata.name)
+        self.master.audit("create", resource, ns, created.metadata.name, self._user.name)
+        if resource == "customresourcedefinitions":
+            self.master.apply_crd(created)
+        elif resource == "apiservices":
+            self.master.apply_apiservice(created)
         self._send_json(201, self.master.scheme.encode(created))
 
     # ------------------------------------------------------------------ PUT
@@ -309,6 +423,7 @@ class _Handler(BaseHTTPRequestHandler):
         reg = self.master.registry
         body = self._read_body()
         obj = self.master.scheme.decode(body)
+        self._check_kind(resource, obj)
         if sub == "status":
             updated = reg.update_status(resource, ns, name, obj)
         elif sub:
@@ -317,7 +432,13 @@ class _Handler(BaseHTTPRequestHandler):
             old = reg.get(resource, ns, name)
             obj = self.master.admission.admit(UPDATE, resource, obj, old)
             updated = reg.update(resource, ns, name, obj)
-        self.master.audit("update", resource, ns, name)
+            if resource == "customresourcedefinitions":
+                self.master.remove_crd(old)
+                self.master.apply_crd(updated)
+            elif resource == "apiservices":
+                self.master.remove_apiservice(old)
+                self.master.apply_apiservice(updated)
+        self.master.audit("update", resource, ns, name, self._user.name)
         self._send_json(200, self.master.scheme.encode(updated))
 
     # ---------------------------------------------------------------- PATCH
@@ -327,7 +448,7 @@ class _Handler(BaseHTTPRequestHandler):
         if sub == "status":
             patch = {"status": patch.get("status", patch)}
         updated = self.master.registry.patch(resource, ns, name, patch)
-        self.master.audit("patch", resource, ns, name)
+        self.master.audit("patch", resource, ns, name, self._user.name)
         self._send_json(200, self.master.scheme.encode(updated))
 
     # --------------------------------------------------------------- DELETE
@@ -339,7 +460,11 @@ class _Handler(BaseHTTPRequestHandler):
         obj = self.master.registry.delete(
             resource, ns, name, None if grace is None else int(grace)
         )
-        self.master.audit("delete", resource, ns, name)
+        self.master.audit("delete", resource, ns, name, self._user.name)
+        if resource == "customresourcedefinitions":
+            self.master.remove_crd(obj)
+        elif resource == "apiservices":
+            self.master.remove_apiservice(obj)
         self._send_json(200, self.master.scheme.encode(obj))
 
 
@@ -382,6 +507,11 @@ class Master:
         wal_path: Optional[str] = None,
         token: str = "",
         audit_log: Optional[list] = None,
+        audit_path: Optional[str] = None,
+        authorization_mode: str = "AlwaysAllow",  # AlwaysAllow | "Node,RBAC"
+        static_tokens: Optional[Dict[str, tuple]] = None,
+        sa_signing_key: str = "ktpu-sa-key",
+        ca_key: str = "ktpu-ca-key",
     ):
         self.scheme = scheme or global_scheme
         self.store = Store(self.scheme, wal_path=wal_path)
@@ -391,6 +521,33 @@ class Master:
         self.quota_lock = threading.Lock()
         self.stopping = threading.Event()
         self._audit_log = audit_log
+        self._audit_path = audit_path
+        self._audit_lock = threading.Lock()
+        self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
+        self.authorization_mode = authorization_mode
+        tokens = dict(static_tokens or {})
+        if token:
+            tokens[token] = ("system:admin", [GROUP_MASTERS])
+        self.authenticators = AuthenticatorChain(
+            [
+                StaticTokenAuthenticator(tokens),
+                ServiceAccountAuthenticator(sa_signing_key),
+                CertificateAuthenticator(ca_key),
+            ]
+        )
+        if authorization_mode == "AlwaysAllow":
+            self.authorizer = AuthorizerChain([AlwaysAllowAuthorizer()])
+        else:
+            chain = []
+            for mode in authorization_mode.split(","):
+                mode = mode.strip()
+                if mode == "Node":
+                    chain.append(NodeAuthorizer(self._get_pod_or_none))
+                elif mode == "RBAC":
+                    chain.append(RBACAuthorizer(self._list_for_auth))
+                elif mode == "AlwaysAllow":
+                    chain.append(AlwaysAllowAuthorizer())
+            self.authorizer = AuthorizerChain(chain)
         self.admission = AdmissionChain(
             [
                 NamespaceAutoProvision(self.registry.ensure_namespace),
@@ -427,15 +584,97 @@ class Master:
             namespace,
         )
 
-    def audit(self, verb: str, resource: str, ns: str, name: str):
-        if self._audit_log is not None:
-            self._audit_log.append(
-                {"ts": time.time(), "verb": verb, "resource": resource, "ns": ns, "name": name}
+    def _get_pod_or_none(self, namespace: str, name: str):
+        if not namespace or not name:
+            return None
+        return self.store.get_or_none(self.registry.key("pods", namespace, name))
+
+    def _list_for_auth(self, resource: str, namespace: str):
+        items, _ = self.store.list(self.registry.prefix(resource, namespace))
+        return items
+
+    # -------------------------------------------------- CRDs and aggregation
+
+    def apply_crd(self, crd: t.CustomResourceDefinition):
+        """Serve the custom resource immediately (ref: apiextensions-apiserver
+        customresource_handler)."""
+        self.scheme.register_dynamic(
+            kind=crd.spec.names.kind,
+            plural=crd.spec.names.plural,
+            api_version=f"{crd.spec.group}/{crd.spec.version}",
+            namespaced=crd.spec.scope == "Namespaced",
+        )
+
+    def remove_crd(self, crd: t.CustomResourceDefinition):
+        self.scheme.deregister_dynamic(crd.spec.names.kind)
+
+    def _restore_crds(self):
+        """Re-register dynamic kinds + the APIService index after a WAL
+        restart."""
+        items, _ = self.store.list(self.registry.prefix("customresourcedefinitions"))
+        for crd in items:
+            self.apply_crd(crd)
+        items, _ = self.store.list(self.registry.prefix("apiservices"))
+        for svc in items:
+            self.apply_apiservice(svc)
+
+    def apply_apiservice(self, svc: t.APIService):
+        if svc.spec.service_name:
+            self._apiservice_index[(svc.spec.group, svc.spec.version)] = (
+                svc.metadata.name
             )
+
+    def remove_apiservice(self, svc: t.APIService):
+        self._apiservice_index.pop((svc.spec.group, svc.spec.version), None)
+
+    def find_apiservice(self, group: str, version: str):
+        """O(1) on the hot dispatch path — every /apis/* request asks."""
+        name = self._apiservice_index.get((group, version))
+        if name is None:
+            return None
+        svc = self.store.get_or_none(self.registry.key("apiservices", "", name))
+        if svc is None or not svc.spec.service_name:
+            return None
+        return svc
+
+    def resolve_service_endpoint(self, namespace: str, name: str, port: int):
+        """First ready endpoint address of a service (host, port). The
+        APIService's requested port wins when the subset advertises it; a
+        single advertised port is taken as the translated target port."""
+        eps = self.store.get_or_none(
+            self.registry.key("endpoints", namespace or "default", name)
+        )
+        if eps is None:
+            return None
+        for subset in eps.subsets:
+            for addr in subset.addresses:
+                advertised = [p.port for p in subset.ports if p.port]
+                if port in advertised:
+                    return addr.ip, port
+                if len(advertised) == 1:
+                    return addr.ip, advertised[0]
+                return addr.ip, port
+        return None
+
+    def audit(self, verb: str, resource: str, ns: str, name: str, user: str = ""):
+        """Audit backend (ref: apiserver/pkg/audit — Metadata level): one
+        entry per mutating request, to the in-memory sink and/or a JSONL
+        file."""
+        if self._audit_log is None and self._audit_path is None:
+            return
+        entry = {"ts": time.time(), "user": user, "verb": verb,
+                 "resource": resource, "ns": ns, "name": name}
+        if self._audit_log is not None:
+            self._audit_log.append(entry)
+        if self._audit_path is not None:
+            with self._audit_lock:
+                with open(self._audit_path, "a") as f:
+                    f.write(json.dumps(entry, separators=(",", ":")) + "\n")
 
     def start(self) -> "Master":
         self.registry.ensure_namespace("default")
         self.registry.ensure_namespace("kube-system")
+        self._restore_crds()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
         )

@@ -28,6 +28,10 @@ _GROUP_PATH = {
     "apiservices": "/apis/apiregistration/v1",
     "podmetrics": "/apis/metrics.k8s.io/v1",
     "nodemetrics": "/apis/metrics.k8s.io/v1",
+    "roles": "/apis/rbac/v1",
+    "clusterroles": "/apis/rbac/v1",
+    "rolebindings": "/apis/rbac/v1",
+    "clusterrolebindings": "/apis/rbac/v1",
 }
 
 
@@ -236,6 +240,22 @@ class Clientset:
     @property
     def apiservices(self) -> ResourceClient:
         return self.resource("apiservices")
+
+    @property
+    def roles(self) -> ResourceClient:
+        return self.resource("roles")
+
+    @property
+    def clusterroles(self) -> ResourceClient:
+        return self.resource("clusterroles")
+
+    @property
+    def rolebindings(self) -> ResourceClient:
+        return self.resource("rolebindings")
+
+    @property
+    def clusterrolebindings(self) -> ResourceClient:
+        return self.resource("clusterrolebindings")
 
     @property
     def podmetrics(self) -> ResourceClient:

@@ -13,20 +13,49 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
+import json
 
 from ..machinery import ApiError, NotFound, now_iso
 from .base import Controller
 
 
-def issue_certificate(ca_key: str, username: str, request: str) -> str:
-    mac = hmac.new(
-        ca_key.encode(), f"{username}\n{request}".encode(), hashlib.sha256
-    ).digest()
-    return "KTPU-CERT." + base64.urlsafe_b64encode(mac).rstrip(b"=").decode()
+def issue_certificate(ca_key: str, username: str, request: str, groups=None) -> str:
+    """Self-describing credential: KTPU-CERT.b64(payload).b64(hmac).
+    Carrying the subject in the payload lets the apiserver's cert
+    authenticator recover identity from the bearer credential alone (the
+    x509 CN/O convention, minus the ASN.1)."""
+    payload = json.dumps(
+        {"user": username, "groups": sorted(groups or []), "req": request},
+        sort_keys=True, separators=(",", ":"),
+    ).encode()
+    mac = hmac.new(ca_key.encode(), payload, hashlib.sha256).digest()
+    b64 = lambda b: base64.urlsafe_b64encode(b).rstrip(b"=").decode()  # noqa: E731
+    return f"KTPU-CERT.{b64(payload)}.{b64(mac)}"
+
+
+def parse_certificate(ca_key: str, cert: str):
+    """Verify signature and return the payload dict, or None."""
+    if not cert.startswith("KTPU-CERT."):
+        return None
+    try:
+        _, p64, m64 = cert.split(".", 2)
+        pad = lambda s: s + "=" * (-len(s) % 4)  # noqa: E731
+        payload = base64.urlsafe_b64decode(pad(p64))
+        mac = base64.urlsafe_b64decode(pad(m64))
+    except (ValueError, TypeError):
+        return None
+    want = hmac.new(ca_key.encode(), payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        return None
+    try:
+        return json.loads(payload)
+    except json.JSONDecodeError:
+        return None
 
 
 def verify_certificate(ca_key: str, username: str, request: str, cert: str) -> bool:
-    return hmac.compare_digest(issue_certificate(ca_key, username, request), cert)
+    info = parse_certificate(ca_key, cert)
+    return bool(info and info.get("user") == username and info.get("req") == request)
 
 
 class CertificateController(Controller):
@@ -61,8 +90,12 @@ class CertificateController(Controller):
         changed = False
         if not self._condition(csr, "Approved"):
             # Auto-approve node client certs only; anything else waits for a
-            # human `ktpu certificate approve`.
-            if csr.spec.username.startswith("system:node:"):
+            # human `ktpu certificate approve`. Groups are part of the signed
+            # identity, so a node CSR must not smuggle extra groups
+            # (system:masters would be a one-step privilege escalation).
+            if csr.spec.username.startswith("system:node:") and set(
+                csr.spec.groups
+            ) <= {"system:nodes"}:
                 csr.status.conditions.append(
                     t.CSRCondition(
                         type="Approved", reason="AutoApproved",
@@ -75,7 +108,8 @@ class CertificateController(Controller):
                 return
         if self._condition(csr, "Approved") and not csr.status.certificate:
             csr.status.certificate = issue_certificate(
-                self.ca_key, csr.spec.username, csr.spec.request
+                self.ca_key, csr.spec.username, csr.spec.request,
+                groups=csr.spec.groups,
             )
             changed = True
         if not changed:

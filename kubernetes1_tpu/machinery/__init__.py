@@ -17,6 +17,7 @@ from .errors import (
     TooOldResourceVersion,
     BadRequest,
     Forbidden,
+    Unauthorized,
 )
 from .labels import match_labels, parse_selector, selector_matches, format_selector
 from .watch import WatchEvent, ADDED, MODIFIED, DELETED, BOOKMARK, ERROR

@@ -74,6 +74,11 @@ class BadRequest(ApiError):
     reason = "BadRequest"
 
 
+class Unauthorized(ApiError):
+    code = 401
+    reason = "Unauthorized"
+
+
 class Forbidden(ApiError):
     code = 403
     reason = "Forbidden"
@@ -88,5 +93,6 @@ class TooOldResourceVersion(ApiError):
 
 _BY_REASON = {
     c.reason: c
-    for c in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden, TooOldResourceVersion)
+    for c in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden,
+              Unauthorized, TooOldResourceVersion)
 }

@@ -103,11 +103,52 @@ def from_dict(cls: Type, data: Any) -> Any:
     return data
 
 
+class Unstructured:
+    """Schema-less API object (ref: apimachinery unstructured.Unstructured) —
+    the representation for custom resources and for clients decoding kinds
+    they have no compiled type for. All non-meta fields live in `content`."""
+
+    KIND = ""
+    API_VERSION = "v1"
+
+    def __init__(self, kind: str = "", api_version: str = "v1", metadata=None,
+                 content: Optional[Dict[str, Any]] = None):
+        from .meta import ObjectMeta
+
+        self.kind = kind
+        self.api_version = api_version
+        self.metadata = metadata if metadata is not None else ObjectMeta()
+        self.content = content or {}
+
+    # registry strategies poke .status on objects that have one
+    @property
+    def status(self):
+        return self.content.get("status", {})
+
+    @status.setter
+    def status(self, v):
+        self.content["status"] = v
+
+    @property
+    def spec(self):
+        return self.content.get("spec", {})
+
+    @spec.setter
+    def spec(self, v):
+        self.content["spec"] = v
+
+    def key(self) -> str:
+        if self.metadata.namespace:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name
+
+
 class Scheme:
     """Kind registry: maps (kind) <-> dataclass and resource plural names.
 
     Ref: runtime.Scheme + the RESTMapper.  Resources are lowercase plurals
-    ("pods"), kinds are CamelCase ("Pod").
+    ("pods"), kinds are CamelCase ("Pod").  Dynamic kinds (CRDs) round-trip
+    as Unstructured.
     """
 
     def __init__(self):
@@ -115,6 +156,8 @@ class Scheme:
         self.by_resource: Dict[str, Type] = {}
         self.resource_of: Dict[str, str] = {}  # kind -> plural
         self.namespaced: Dict[str, bool] = {}  # plural -> bool
+        self.dynamic_kinds: Dict[str, str] = {}  # kind -> apiVersion
+        self.dynamic_resources: Dict[str, str] = {}  # plural -> kind
 
     def register(self, cls: Type, plural: Optional[str] = None, namespaced: bool = True):
         kind = cls.KIND or cls.__name__
@@ -125,7 +168,31 @@ class Scheme:
         self.namespaced[plural] = namespaced
         return cls
 
+    def register_dynamic(self, kind: str, plural: str, api_version: str,
+                         namespaced: bool = True):
+        """Register a CRD-backed kind served as Unstructured."""
+        self.dynamic_kinds[kind] = api_version
+        self.dynamic_resources[plural] = kind
+        self.by_kind[kind] = Unstructured
+        self.by_resource[plural] = Unstructured
+        self.resource_of[kind] = plural
+        self.namespaced[plural] = namespaced
+
+    def deregister_dynamic(self, kind: str):
+        plural = self.resource_of.pop(kind, "")
+        self.dynamic_kinds.pop(kind, None)
+        self.dynamic_resources.pop(plural, None)
+        self.by_kind.pop(kind, None)
+        self.by_resource.pop(plural, None)
+        self.namespaced.pop(plural, None)
+
     def encode(self, obj: Any) -> Dict[str, Any]:
+        if isinstance(obj, Unstructured):
+            d = dict(obj.content)
+            d["metadata"] = to_dict(obj.metadata)
+            d["kind"] = obj.kind
+            d["apiVersion"] = obj.api_version
+            return d
         d = to_dict(obj)
         d["kind"] = type(obj).KIND or type(obj).__name__
         d["apiVersion"] = type(obj).API_VERSION
@@ -135,16 +202,31 @@ class Scheme:
         return json.dumps(self.encode(obj), separators=(",", ":"))
 
     def decode(self, data: Dict[str, Any]) -> Any:
+        from .meta import ObjectMeta
+
         kind = data.get("kind", "")
         cls = self.by_kind.get(kind)
-        if cls is None:
-            raise KeyError(f"kind {kind!r} not registered")
+        if cls is None or cls is Unstructured:
+            # unknown or dynamic kind -> Unstructured passthrough (the
+            # client-go dynamic-client behavior)
+            content = {
+                k: v for k, v in data.items()
+                if k not in ("kind", "apiVersion", "metadata")
+            }
+            return Unstructured(
+                kind=kind,
+                api_version=data.get("apiVersion", "v1"),
+                metadata=from_dict(ObjectMeta, data.get("metadata") or {}),
+                content=content,
+            )
         return from_dict(cls, data)
 
     def decode_json(self, raw: str) -> Any:
         return self.decode(json.loads(raw))
 
     def deepcopy(self, obj: Any) -> Any:
+        if isinstance(obj, Unstructured):
+            return self.decode(self.encode(obj))
         return from_dict(type(obj), to_dict(obj))
 
 

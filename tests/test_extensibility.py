@@ -1,0 +1,201 @@
+"""Extensibility tests: CRDs served as dynamic resources (apiextensions-
+apiserver analog) and APIService aggregation proxying (kube-aggregator
+analog)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import ApiError, Invalid, NotFound
+from kubernetes1_tpu.machinery.scheme import Unstructured
+
+
+@pytest.fixture()
+def master():
+    m = Master().start()
+    yield m
+    m.stop()
+
+
+def make_crd(kind="TPUJobProfile", plural="tpujobprofiles", group="example.ktpu.io",
+             scope="Namespaced"):
+    crd = t.CustomResourceDefinition()
+    crd.metadata.name = f"{plural}.{group}"
+    crd.spec.group = group
+    crd.spec.version = "v1"
+    crd.spec.names = t.CRDNames(plural=plural, singular=kind.lower(), kind=kind)
+    crd.spec.scope = scope
+    return crd
+
+
+class TestCRDs:
+    def test_crd_lifecycle_create_use_delete(self, master):
+        cs = Clientset(master.url)
+        cs.customresourcedefinitions.create(make_crd())
+
+        obj = Unstructured(kind="TPUJobProfile", api_version="example.ktpu.io/v1")
+        obj.metadata.name = "bert-profile"
+        obj.metadata.namespace = "default"
+        obj.content["spec"] = {"topology": "4x4x8", "chips": 128}
+        created = cs.resource("tpujobprofiles").create(obj)
+        assert created.content["spec"]["chips"] == 128
+        assert created.metadata.uid
+
+        got = cs.resource("tpujobprofiles").get("bert-profile")
+        assert got.content["spec"]["topology"] == "4x4x8"
+
+        items, _ = cs.resource("tpujobprofiles").list(namespace="default")
+        assert [o.metadata.name for o in items] == ["bert-profile"]
+
+        # update round-trips free-form content
+        got.content["spec"]["chips"] = 256
+        updated = cs.resource("tpujobprofiles").update(got)
+        assert updated.content["spec"]["chips"] == 256
+
+        cs.resource("tpujobprofiles").delete("bert-profile")
+        with pytest.raises(NotFound):
+            cs.resource("tpujobprofiles").get("bert-profile")
+
+        # deleting the CRD unregisters the resource
+        cs.customresourcedefinitions.delete("tpujobprofiles.example.ktpu.io", "")
+        with pytest.raises(ApiError):
+            cs.resource("tpujobprofiles").list(namespace="default")
+        cs.close()
+
+    def test_crd_watch_stream(self, master):
+        cs = Clientset(master.url)
+        cs.customresourcedefinitions.create(make_crd(kind="Widget", plural="widgets"))
+        _, rv = cs.resource("widgets").list(namespace="default")
+        w = cs.resource("widgets").watch(namespace="default", resource_version=rv,
+                                         timeout_seconds=5)
+        obj = Unstructured(kind="Widget", api_version="example.ktpu.io/v1")
+        obj.metadata.name = "w1"
+        obj.metadata.namespace = "default"
+        cs.resource("widgets").create(obj)
+        etype, obj_dict = next(iter(w))
+        assert etype == "ADDED" and obj_dict["metadata"]["name"] == "w1"
+        w.close()
+        cs.close()
+
+    def test_crd_cannot_shadow_builtin(self, master):
+        cs = Clientset(master.url)
+        with pytest.raises(Invalid, match="shadows"):
+            cs.customresourcedefinitions.create(
+                make_crd(kind="FakePod", plural="pods")
+            )
+        # kind collision hijacks decoding of the built-in — also rejected
+        with pytest.raises(Invalid, match="shadows"):
+            cs.customresourcedefinitions.create(
+                make_crd(kind="Pod", plural="foopods")
+            )
+        cs.close()
+
+    def test_mismatched_kind_body_rejected(self, master):
+        """A typo'd kind must 400 at create, not silently persist as
+        Unstructured into a typed registry."""
+        from kubernetes1_tpu.machinery import BadRequest
+
+        cs = Clientset(master.url)
+        with pytest.raises(BadRequest, match="does not match resource"):
+            cs.api.request(
+                "POST", "/api/v1/namespaces/default/configmaps",
+                body={"kind": "Configmap", "apiVersion": "v1",
+                      "metadata": {"name": "oops"}, "data": {}},
+            )
+        cs.close()
+
+    def test_crd_update_reregisters_names(self, master):
+        cs = Clientset(master.url)
+        cs.customresourcedefinitions.create(make_crd(kind="Thing", plural="things"))
+        crd = cs.customresourcedefinitions.get("things.example.ktpu.io", "")
+        crd.spec.names = t.CRDNames(plural="stuffs", singular="stuff", kind="Stuff")
+        cs.customresourcedefinitions.update(crd)
+        # old plural gone, new plural served
+        with pytest.raises(ApiError):
+            cs.resource("things").list(namespace="default")
+        items, _ = cs.resource("stuffs").list(namespace="default")
+        assert items == []
+        cs.close()
+
+    def test_crd_survives_wal_restart(self, tmp_path):
+        wal = str(tmp_path / "wal.jsonl")
+        m1 = Master(wal_path=wal).start()
+        cs1 = Clientset(m1.url)
+        cs1.customresourcedefinitions.create(make_crd(kind="Gadget", plural="gadgets"))
+        obj = Unstructured(kind="Gadget", api_version="example.ktpu.io/v1")
+        obj.metadata.name = "g1"
+        obj.metadata.namespace = "default"
+        obj.content["spec"] = {"size": 3}
+        cs1.resource("gadgets").create(obj)
+        cs1.close()
+        m1.stop()
+
+        m2 = Master(wal_path=wal).start()
+        cs2 = Clientset(m2.url)
+        got = cs2.resource("gadgets").get("g1")
+        assert got.metadata.name == "g1"
+        assert got.content["spec"] == {"size": 3}
+        cs2.close()
+        m2.stop()
+
+
+class _EchoAPIHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        payload = json.dumps(
+            {"kind": "EchoList", "path": self.path, "served_by": "aggregated"}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class TestAggregation:
+    def test_apiservice_proxies_to_backing_endpoints(self, master):
+        cs = Clientset(master.url)
+        backend = ThreadingHTTPServer(("127.0.0.1", 0), _EchoAPIHandler)
+        th = threading.Thread(target=backend.serve_forever, daemon=True)
+        th.start()
+        port = backend.server_address[1]
+        try:
+            svc = t.Service()
+            svc.metadata.name = "echo-api"
+            svc.metadata.namespace = "kube-system"
+            svc.spec.ports = [t.ServicePort(port=443)]
+            cs.services.create(svc, "kube-system")
+            eps = t.Endpoints(
+                subsets=[
+                    t.EndpointSubset(
+                        addresses=[t.EndpointAddress(ip="127.0.0.1")],
+                        ports=[t.EndpointPort(port=port)],
+                    )
+                ]
+            )
+            eps.metadata.name = "echo-api"
+            eps.metadata.namespace = "kube-system"
+            cs.endpoints.create(eps, "kube-system")
+
+            apisvc = t.APIService()
+            apisvc.metadata.name = "v1.echo.ktpu.io"
+            apisvc.spec.group = "echo.ktpu.io"
+            apisvc.spec.version = "v1"
+            apisvc.spec.service_namespace = "kube-system"
+            apisvc.spec.service_name = "echo-api"
+            cs.apiservices.create(apisvc)
+
+            data = cs.api.request("GET", "/apis/echo.ktpu.io/v1/echoes")
+            assert data["served_by"] == "aggregated"
+            assert data["path"] == "/apis/echo.ktpu.io/v1/echoes"
+        finally:
+            backend.shutdown()
+            backend.server_close()
+            cs.close()
