@@ -1,0 +1,175 @@
+// ktpu-metrics-exporter — native TPU metrics exporter.
+//
+// The TPU-side replacement for the reference README's DCGM → Prometheus GPU
+// monitoring stack (README.md:57; SURVEY.md §5 observability): a small HTTP
+// server exposing Prometheus text metrics about the host's TPU inventory —
+// chip count, per-chip health, device-node presence — scraped by Prometheus
+// from a DaemonSet on every TPU node. Discovery matches the device plugin
+// (KTPU_FAKE_TPUS or /dev/accel*).
+//
+// GET /metrics  -> Prometheus text exposition
+// GET /healthz  -> ok
+//
+// Build: make -C kubernetes1_tpu/native
+
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::string getenv_or(const char* name, const std::string& dflt) {
+  const char* v = getenv(name);
+  return v && *v ? std::string(v) : dflt;
+}
+
+struct Chip {
+  std::string id;
+  std::string type;
+  std::string slice;
+  bool healthy;
+};
+
+std::vector<Chip> discover() {
+  std::vector<Chip> chips;
+  std::string fake = getenv_or("KTPU_FAKE_TPUS", "");
+  if (!fake.empty()) {
+    // "<type>:<count>:<slice>:<host>"
+    std::string type = "v5e", slice = "slice-0";
+    int count = 4;
+    std::istringstream ss(fake);
+    std::string part;
+    int idx = 0;
+    while (std::getline(ss, part, ':')) {
+      if (idx == 0 && !part.empty()) type = part;
+      if (idx == 1 && !part.empty()) count = atoi(part.c_str());
+      if (idx == 2 && !part.empty()) slice = part;
+      ++idx;
+    }
+    for (int i = 0; i < count; ++i)
+      chips.push_back({slice + "-chip" + std::to_string(i), type, slice, true});
+    return chips;
+  }
+  std::string type = getenv_or("TPU_ACCELERATOR_TYPE", "v5e");
+  std::string slice = getenv_or("TPU_SLICE_ID", "slice-0");
+  DIR* dir = opendir("/dev");
+  if (dir) {
+    struct dirent* ent;
+    std::vector<std::string> names;
+    while ((ent = readdir(dir)) != nullptr) {
+      std::string name = ent->d_name;
+      if (name.rfind("accel", 0) == 0 && name.size() > 5 &&
+          isdigit(static_cast<unsigned char>(name[5])))
+        names.push_back(name);
+    }
+    closedir(dir);
+    std::sort(names.begin(), names.end());
+    for (const auto& name : names) {
+      struct stat st;
+      bool ok = stat(("/dev/" + name).c_str(), &st) == 0;
+      chips.push_back({name, type, slice, ok});
+    }
+  }
+  return chips;
+}
+
+std::string render_metrics() {
+  auto chips = discover();
+  char hostname[256] = "tpu-host";
+  gethostname(hostname, sizeof hostname);
+  std::ostringstream out;
+  out << "# HELP ktpu_tpu_chips Total TPU chips discovered on this host\n"
+      << "# TYPE ktpu_tpu_chips gauge\n"
+      << "ktpu_tpu_chips{host=\"" << hostname << "\"} " << chips.size() << "\n"
+      << "# HELP ktpu_tpu_chip_healthy Per-chip health (1 healthy, 0 unhealthy)\n"
+      << "# TYPE ktpu_tpu_chip_healthy gauge\n";
+  for (const auto& c : chips) {
+    out << "ktpu_tpu_chip_healthy{host=\"" << hostname << "\",chip=\"" << c.id
+        << "\",type=\"" << c.type << "\",slice=\"" << c.slice << "\"} "
+        << (c.healthy ? 1 : 0) << "\n";
+  }
+  size_t healthy =
+      std::count_if(chips.begin(), chips.end(), [](const Chip& c) { return c.healthy; });
+  out << "# HELP ktpu_tpu_chips_healthy Healthy TPU chips on this host\n"
+      << "# TYPE ktpu_tpu_chips_healthy gauge\n"
+      << "ktpu_tpu_chips_healthy{host=\"" << hostname << "\"} " << healthy << "\n";
+  return out.str();
+}
+
+void serve_conn(int fd) {
+  char buf[4096];
+  ssize_t n = read(fd, buf, sizeof buf - 1);
+  if (n <= 0) { close(fd); return; }
+  buf[n] = 0;
+  std::string req(buf);
+  std::string body, status = "200 OK", ctype = "text/plain; version=0.0.4";
+  if (req.rfind("GET /metrics", 0) == 0) {
+    body = render_metrics();
+  } else if (req.rfind("GET /healthz", 0) == 0) {
+    body = "ok\n";
+  } else {
+    status = "404 Not Found";
+    body = "not found\n";
+  }
+  std::ostringstream resp;
+  resp << "HTTP/1.1 " << status << "\r\nContent-Type: " << ctype
+       << "\r\nContent-Length: " << body.size() << "\r\nConnection: close\r\n\r\n"
+       << body;
+  std::string payload = resp.str();
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t w = write(fd, payload.data() + off, payload.size() - off);
+    if (w <= 0) break;
+    off += static_cast<size_t>(w);
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = atoi(getenv_or("KTPU_EXPORTER_PORT", "9101").c_str());
+  for (int i = 1; i + 1 < argc; i += 2)
+    if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<struct sockaddr*>(&addr), sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 16) != 0) { perror("listen"); return 1; }
+  if (port == 0) {
+    socklen_t len = sizeof addr;
+    getsockname(srv, reinterpret_cast<struct sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+  }
+  printf("ktpu-metrics-exporter (native): listening on 127.0.0.1:%d\n", port);
+  fflush(stdout);
+
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread([fd] { serve_conn(fd); }).detach();
+  }
+}

@@ -1,0 +1,180 @@
+"""Native component tests: the C++ libtpu device plugin and the C++ TPU
+metrics exporter must interoperate with the Python control plane over the
+same unix-socket protocol / Prometheus text format as the Python
+implementations (deviceplugin/api.py is the contract)."""
+
+import os
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.deviceplugin.api import PluginClient, plugin_socket_path
+from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "kubernetes1_tpu", "native")
+
+
+@pytest.fixture(scope="session")
+def native_bins():
+    res = subprocess.run(
+        ["make", "-C", NATIVE_DIR], capture_output=True, text=True
+    )
+    if res.returncode != 0:
+        pytest.fail(f"native build failed:\n{res.stdout}\n{res.stderr}")
+    bins = {
+        "plugin": os.path.join(NATIVE_DIR, "bin", "ktpu-tpu-plugin"),
+        "exporter": os.path.join(NATIVE_DIR, "bin", "ktpu-metrics-exporter"),
+    }
+    for path in bins.values():
+        assert os.access(path, os.X_OK)
+    return bins
+
+
+def start_native_plugin(binary, plugin_dir, fake="v5e:4:sliceN:0"):
+    env = dict(os.environ, KTPU_FAKE_TPUS=fake)
+    proc = subprocess.Popen(
+        [binary, "--plugin-dir", str(plugin_dir)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+    sock = plugin_socket_path(str(plugin_dir), "google.com/tpu")
+    deadline = time.monotonic() + 5
+    while not os.path.exists(sock):
+        if time.monotonic() > deadline:
+            proc.terminate()
+            raise TimeoutError("native plugin socket never appeared")
+        time.sleep(0.05)
+    return proc
+
+
+class TestNativePluginProtocol:
+    def test_four_rpcs(self, native_bins, tmp_path):
+        proc = start_native_plugin(native_bins["plugin"], tmp_path, "v5p:4:sZ:1")
+        try:
+            client = PluginClient(plugin_socket_path(str(tmp_path), "google.com/tpu"))
+            info = client.call("GetPluginInfo")
+            assert info["name"] == "google.com/tpu"
+            assert info["device_count"] == 4
+            assert info["native"] is True
+
+            devices = next(client.list_and_watch())
+            assert len(devices) == 4
+            assert devices[0]["health"] == t.DEVICE_HEALTHY
+            attrs = devices[0]["attributes"]
+            assert attrs[t.ATTR_TPU_SLICE] == "sZ"
+            assert attrs[t.ATTR_TPU_TOPOLOGY] == "2x2x1"
+            assert attrs[t.ATTR_TPU_HOST_INDEX] == "1"
+
+            ok = client.call("AdmitPod", {
+                "pod_uid": "u1",
+                "assignments": {"req": [devices[0]["id"], devices[1]["id"]]},
+            })
+            assert ok == {"allowed": True}
+            bad = client.call("AdmitPod", {
+                "pod_uid": "u2", "assignments": {"req": ["ghost"]},
+            })
+            assert bad["allowed"] is False and "ghost" in bad["reason"]
+
+            spec = client.call("InitContainer", {
+                "device_ids": [d["id"] for d in devices[:2]],
+                "pod_annotations": {
+                    "tpu.ktpu.io/worker-id": "5",
+                    "tpu.ktpu.io/coordinator-address": "host0:8476",
+                    "tpu.ktpu.io/worker-hostnames": "host0,host1",
+                },
+            })
+            envs = spec["envs"]
+            assert envs["TPU_VISIBLE_CHIPS"] == "0,1"
+            assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+            assert envs["TPU_WORKER_ID"] == "5"
+            assert envs["JAX_COORDINATOR_ADDRESS"] == "host0:8476"
+            assert envs["TPU_WORKER_HOSTNAMES"] == "host0,host1"
+            assert envs["TPU_ACCELERATOR_TYPE"] == "v5p"
+            assert spec["annotations"]["tpu.ktpu.io/plugin"] == "native"
+            client.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_kubelet_runs_tpu_pod_via_native_plugin(self, native_bins, tmp_path):
+        """Full node path: C++ plugin socket discovered by the device manager,
+        chips advertised in node status, pod admitted + env injected."""
+        plugin_dir = tmp_path / "plugins"
+        proc = start_native_plugin(native_bins["plugin"], plugin_dir, "v5e:4:sN:0")
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs)
+        sched.start()
+        runtime = FakeRuntime()
+        kubelet = Kubelet(
+            cs, node_name="native-node", runtime=runtime,
+            plugin_dir=str(plugin_dir), heartbeat_interval=0.5,
+            sync_interval=0.2, pleg_interval=0.2,
+        )
+        kubelet.start()
+        try:
+            must_poll_until(
+                lambda: len(
+                    cs.nodes.get("native-node", "").status.extended_resources.get(
+                        "google.com/tpu", []
+                    )
+                ) == 4,
+                timeout=15.0, desc="native chips advertised",
+            )
+            pod = make_tpu_pod("native-tpu-pod", tpus=2)
+            cs.pods.create(pod)
+            must_poll_until(
+                lambda: cs.pods.get("native-tpu-pod").status.phase == t.POD_RUNNING,
+                timeout=20.0, desc="tpu pod running",
+            )
+            bound = cs.pods.get("native-tpu-pod")
+            assert len(bound.spec.extended_resources[0].assigned) == 2
+            # env injected by the native plugin made it into the container
+            containers = runtime.list_containers()
+            assert containers
+        finally:
+            kubelet.stop()
+            sched.stop()
+            cs.close()
+            master.stop()
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+class TestNativeExporter:
+    def test_metrics_exposition(self, native_bins):
+        env = dict(os.environ, KTPU_FAKE_TPUS="v5e:8:sliceM:0")
+        proc = subprocess.Popen(
+            [native_bins["exporter"], "--port", "0"],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            port = int(line.strip().rsplit(":", 1)[1])
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "ktpu_tpu_chips{" in text
+            assert '} 8' in text.split("ktpu_tpu_chips{", 1)[1].split("\n", 1)[0]
+            healthy_lines = [
+                l for l in text.splitlines()
+                if l.startswith("ktpu_tpu_chip_healthy{")
+            ]
+            assert len(healthy_lines) == 8
+            assert all(l.endswith(" 1") for l in healthy_lines)
+            assert 'slice="sliceM"' in healthy_lines[0]
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ).read().decode()
+            assert ok.strip() == "ok"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
