@@ -35,7 +35,7 @@ REJECTED_RESOURCES = ("nvidia.com/gpu",)
 class AdmissionPlugin:
     name = "base"
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         """Mutate obj in place or raise ApiError to reject."""
 
 
@@ -44,7 +44,7 @@ class ResourceV2(AdmissionPlugin):
 
     name = "ResourceV2"
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "pods" or operation != CREATE:
             return
         for container in list(obj.spec.containers) + list(obj.spec.init_containers):
@@ -80,7 +80,7 @@ class NamespaceAutoProvision(AdmissionPlugin):
     def __init__(self, ensure_namespace):
         self._ensure = ensure_namespace
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if operation != CREATE or resource == "namespaces":
             return
         ns = getattr(obj.metadata, "namespace", "")
@@ -96,7 +96,7 @@ class PriorityResolver(AdmissionPlugin):
     def __init__(self, get_priority_class):
         self._get = get_priority_class
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "pods" or operation != CREATE:
             return
         name = obj.spec.priority_class_name
@@ -113,7 +113,7 @@ class GangDefaulter(AdmissionPlugin):
 
     name = "GangDefaulter"
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "pods" or operation != CREATE:
             return
         if obj.spec.scheduling_gang and obj.spec.gang_size <= 0:
@@ -129,7 +129,7 @@ class LimitRanger(AdmissionPlugin):
     def __init__(self, list_limit_ranges):
         self._list = list_limit_ranges  # (namespace) -> [LimitRange]
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "pods" or operation != CREATE:
             return
         from ..utils.quantity import parse_quantity
@@ -172,7 +172,7 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         self._list = list_quotas       # (namespace) -> [ResourceQuota]
         self._usage = usage_fn         # (namespace) -> {resource: float}
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if operation != CREATE or resource not in self.COUNTED:
             return
         ns = obj.metadata.namespace
@@ -236,7 +236,7 @@ class ServiceAccountAdmission(AdmissionPlugin):
 
     name = "ServiceAccount"
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "pods" or operation != CREATE:
             return
         if not obj.spec.service_account_name:
@@ -257,7 +257,7 @@ class EventRateLimit(AdmissionPlugin):
         self._clock = clock or _time.monotonic
         self._buckets = {}  # source -> (tokens, last_ts)
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         if resource != "events" or operation != CREATE:
             return
         src = obj.source_component or "unknown"
@@ -269,11 +269,37 @@ class EventRateLimit(AdmissionPlugin):
         self._buckets[src] = (tokens - 1.0, now)
 
 
+CREATED_BY_ANNOTATION = "ktpu.io/created-by"
+CREATED_BY_GROUPS_ANNOTATION = "ktpu.io/created-by-groups"
+
+
+class IdentityStamp(AdmissionPlugin):
+    """Records the authenticated creator on CSRs (server-set, client-supplied
+    values are stripped). The CSR approver trusts only this annotation when
+    deciding node auto-approval — spec.username alone is client-controlled
+    and would allow minting credentials for arbitrary node identities."""
+
+    name = "IdentityStamp"
+
+    STAMPED = {"certificatesigningrequests"}
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource not in self.STAMPED or operation != CREATE:
+            return
+        obj.metadata.annotations.pop(CREATED_BY_ANNOTATION, None)
+        obj.metadata.annotations.pop(CREATED_BY_GROUPS_ANNOTATION, None)
+        if user is not None:
+            obj.metadata.annotations[CREATED_BY_ANNOTATION] = user.name
+            obj.metadata.annotations[CREATED_BY_GROUPS_ANNOTATION] = ",".join(
+                sorted(user.groups)
+            )
+
+
 class AdmissionChain:
     def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
         self.plugins = plugins or []
 
-    def admit(self, operation: str, resource: str, obj, old=None):
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
         for p in self.plugins:
-            p.admit(operation, resource, obj, old)
+            p.admit(operation, resource, obj, old, user=user)
         return obj

@@ -187,21 +187,49 @@ class RBACAuthorizer:
 class NodeAuthorizer:
     """Scopes kubelets (system:node:<name>, group system:nodes) to their own
     node's objects (ref: plugin/pkg/auth/authorizer/node/node_authorizer.go —
-    there a graph; here direct pod-binding lookups)."""
+    there a graph; here direct pod lookups). Secrets/configmaps/PVCs are the
+    sensitive class: a node may only GET ones referenced by a pod bound to it,
+    never list/watch them cluster-wide."""
 
     READ_RESOURCES = {
-        "pods", "services", "endpoints", "configmaps", "secrets",
-        "persistentvolumeclaims", "persistentvolumes", "nodes",
+        "pods", "services", "endpoints", "persistentvolumes", "nodes",
     }
+    REFERENCED_READ_RESOURCES = {"secrets", "configmaps", "persistentvolumeclaims"}
 
-    def __init__(self, get_pod: Callable[[str, str], Optional[t.Pod]]):
+    def __init__(self, get_pod: Callable[[str, str], Optional[t.Pod]],
+                 list_pods: Optional[Callable[[], list]] = None):
         self._get_pod = get_pod
+        self._list_pods = list_pods
+
+    def _pod_references(self, node_name: str, resource: str,
+                        namespace: str, name: str) -> bool:
+        if self._list_pods is None:
+            return False
+        for pod in self._list_pods():
+            if pod.spec.node_name != node_name or pod.metadata.namespace != namespace:
+                continue
+            for vol in pod.spec.volumes:
+                if resource == "secrets" and vol.secret is not None \
+                        and vol.secret.secret_name == name:
+                    return True
+                if resource == "configmaps" and vol.config_map is not None \
+                        and vol.config_map.name == name:
+                    return True
+                if resource == "persistentvolumeclaims" \
+                        and vol.persistent_volume_claim is not None \
+                        and vol.persistent_volume_claim.claim_name == name:
+                    return True
+        return False
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
                   namespace: str, name: str) -> bool:
         if not user.in_group(GROUP_NODES) or not user.name.startswith("system:node:"):
             return False
         node_name = user.name[len("system:node:"):]
+        if resource in self.REFERENCED_READ_RESOURCES:
+            return verb == "get" and bool(name) and self._pod_references(
+                node_name, resource, namespace, name
+            )
         if verb in ("get", "list", "watch") and resource in self.READ_RESOURCES:
             return True
         if resource == "nodes":

@@ -261,21 +261,24 @@ class Registry:
 
     # ------------------------------------------------------------ operations
 
+    def _validate_crd_names(self, obj):
+        names = obj.spec.names
+        if not (obj.spec.group and names.plural and names.kind):
+            raise Invalid("CRD requires spec.group, spec.names.plural, spec.names.kind")
+        if (
+            names.plural in self.scheme.by_resource
+            and names.plural not in self.scheme.dynamic_resources
+        ):
+            raise Invalid(f"plural {names.plural!r} shadows a built-in resource")
+        if (
+            names.kind in self.scheme.by_kind
+            and names.kind not in self.scheme.dynamic_kinds
+        ):
+            raise Invalid(f"kind {names.kind!r} shadows a built-in kind")
+
     def create(self, resource: str, namespace: str, obj):
         if resource == "customresourcedefinitions":
-            names = obj.spec.names
-            if not (obj.spec.group and names.plural and names.kind):
-                raise Invalid("CRD requires spec.group, spec.names.plural, spec.names.kind")
-            if (
-                names.plural in self.scheme.by_resource
-                and names.plural not in self.scheme.dynamic_resources
-            ):
-                raise Invalid(f"plural {names.plural!r} shadows a built-in resource")
-            if (
-                names.kind in self.scheme.by_kind
-                and names.kind not in self.scheme.dynamic_kinds
-            ):
-                raise Invalid(f"kind {names.kind!r} shadows a built-in kind")
+            self._validate_crd_names(obj)
         if self.scheme.namespaced.get(resource, True):
             obj.metadata.namespace = namespace or obj.metadata.namespace or "default"
         else:
@@ -365,6 +368,11 @@ class Registry:
         strat = strategy_for(resource)
         key = self.key(resource, namespace, name)
         old = self.store.get(key)
+        if resource == "customresourcedefinitions":
+            # shadow checks on the NEW names — an update renaming to a
+            # built-in plural/kind would brick that resource; the old CRD's
+            # own names are dynamic, so they don't false-positive here
+            self._validate_crd_names(obj)
         strat.prepare_for_update(obj, old)
         if obj.metadata.generation or old.metadata.generation:
             if to_dict(getattr(obj, "spec", None)) != to_dict(getattr(old, "spec", None)):
@@ -398,16 +406,19 @@ class Registry:
     def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]):
         """RFC 7386 JSON merge patch via GuaranteedUpdate."""
         key = self.key(resource, namespace, name)
-        cls = self.scheme.by_resource[resource]
 
         def apply(cur):
             merged = _merge_patch(self.scheme.encode(cur), patch)
-            obj = from_dict(cls, merged)
+            # decode via the scheme (not from_dict(cls)): dynamic resources
+            # map to Unstructured, which only scheme.decode reconstructs
+            obj = self.scheme.decode(merged)
             obj.metadata.resource_version = cur.metadata.resource_version
             strat = strategy_for(resource)
             strat.prepare_for_update(obj, cur)
             if resource == "services":
                 self._allocate_service_fields(obj, old=cur)
+            if resource == "customresourcedefinitions":
+                self._validate_crd_names(obj)
             strat.validate(obj)  # a patch must not persist an invalid object
             return obj
 

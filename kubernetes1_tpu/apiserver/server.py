@@ -34,6 +34,7 @@ from .admission import (
     AdmissionChain,
     EventRateLimit,
     GangDefaulter,
+    IdentityStamp,
     LimitRanger,
     NamespaceAutoProvision,
     PriorityResolver,
@@ -405,10 +406,10 @@ class _Handler(BaseHTTPRequestHandler):
             effective_ns
         ):
             with self.master.quota_lock:
-                obj = self.master.admission.admit(CREATE, resource, obj)
+                obj = self.master.admission.admit(CREATE, resource, obj, user=self._user)
                 created = reg.create(resource, ns, obj)
         else:
-            obj = self.master.admission.admit(CREATE, resource, obj)
+            obj = self.master.admission.admit(CREATE, resource, obj, user=self._user)
             created = reg.create(resource, ns, obj)
         self.master.audit("create", resource, ns, created.metadata.name, self._user.name)
         if resource == "customresourcedefinitions":
@@ -430,7 +431,7 @@ class _Handler(BaseHTTPRequestHandler):
             raise NotFound(f"subresource {sub!r} not writable")
         else:
             old = reg.get(resource, ns, name)
-            obj = self.master.admission.admit(UPDATE, resource, obj, old)
+            obj = self.master.admission.admit(UPDATE, resource, obj, old, user=self._user)
             updated = reg.update(resource, ns, name, obj)
             if resource == "customresourcedefinitions":
                 self.master.remove_crd(old)
@@ -447,7 +448,16 @@ class _Handler(BaseHTTPRequestHandler):
         patch = self._read_body()
         if sub == "status":
             patch = {"status": patch.get("status", patch)}
+        old = None
+        if resource in ("customresourcedefinitions", "apiservices"):
+            old = self.master.registry.get(resource, ns, name)
         updated = self.master.registry.patch(resource, ns, name, patch)
+        if resource == "customresourcedefinitions":
+            self.master.remove_crd(old)
+            self.master.apply_crd(updated)
+        elif resource == "apiservices":
+            self.master.remove_apiservice(old)
+            self.master.apply_apiservice(updated)
         self.master.audit("patch", resource, ns, name, self._user.name)
         self._send_json(200, self.master.scheme.encode(updated))
 
@@ -513,7 +523,9 @@ class Master:
         sa_signing_key: str = "ktpu-sa-key",
         ca_key: str = "ktpu-ca-key",
     ):
-        self.scheme = scheme or global_scheme
+        # own copy: CRD registrations must not leak into the process-global
+        # scheme shared by every other Master/client in this process
+        self.scheme = scheme or global_scheme.copy()
         self.store = Store(self.scheme, wal_path=wal_path)
         self.registry = Registry(self.store, self.scheme)
         self.token = token
@@ -542,7 +554,9 @@ class Master:
             for mode in authorization_mode.split(","):
                 mode = mode.strip()
                 if mode == "Node":
-                    chain.append(NodeAuthorizer(self._get_pod_or_none))
+                    chain.append(
+                        NodeAuthorizer(self._get_pod_or_none, self._list_all_pods)
+                    )
                 elif mode == "RBAC":
                     chain.append(RBACAuthorizer(self._list_for_auth))
                 elif mode == "AlwaysAllow":
@@ -555,6 +569,7 @@ class Master:
                 ResourceV2(),
                 GangDefaulter(),
                 ServiceAccountAdmission(),
+                IdentityStamp(),
                 LimitRanger(self._list_limit_ranges),
                 ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
                 EventRateLimit(),
@@ -588,6 +603,10 @@ class Master:
         if not namespace or not name:
             return None
         return self.store.get_or_none(self.registry.key("pods", namespace, name))
+
+    def _list_all_pods(self):
+        items, _ = self.store.list(self.registry.prefix("pods"))
+        return items
 
     def _list_for_auth(self, resource: str, namespace: str):
         items, _ = self.store.list(self.registry.prefix(resource, namespace))

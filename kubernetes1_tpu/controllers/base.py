@@ -13,7 +13,22 @@ import traceback
 from typing import List, Optional
 
 from ..client import Clientset, EventRecorder, InformerFactory
+from ..machinery.scheme import to_dict
 from ..utils.workqueue import RateLimitingQueue
+
+
+def write_status_if_changed(client, obj, mutate) -> bool:
+    """Apply mutate(obj.status) and PUT the status subresource only when it
+    actually changed. A no-op status write still bumps resourceVersion and
+    fires a MODIFIED event, which re-triggers the writing controller's own
+    informer (an infinite write storm) and conflicts every other writer out
+    of its get→update window — the replicaset/deployment livelock."""
+    before = to_dict(obj.status)
+    mutate(obj.status)
+    if to_dict(obj.status) == before:
+        return False
+    client.update_status(obj)
+    return True
 
 
 class Controller:

@@ -75,6 +75,31 @@ class CertificateController(Controller):
     def _condition(csr, ctype: str) -> bool:
         return any(c.type == ctype for c in csr.status.conditions)
 
+    @staticmethod
+    def _creator_may_request(csr) -> bool:
+        """The authenticated creator (IdentityStamp annotation) may request
+        spec.username if it IS that identity (renewal), holds a bootstrap
+        identity, or is a cluster admin (ref: the sarApprover's
+        selfnodeclient/nodeclient posture)."""
+        from ..apiserver.admission import (
+            CREATED_BY_ANNOTATION,
+            CREATED_BY_GROUPS_ANNOTATION,
+        )
+
+        creator = csr.metadata.annotations.get(CREATED_BY_ANNOTATION, "")
+        groups = set(
+            csr.metadata.annotations.get(CREATED_BY_GROUPS_ANNOTATION, "").split(",")
+        )
+        if not creator:
+            # no identity recorded (AlwaysAllow mode) — keep legacy behavior
+            return True
+        return (
+            creator == csr.spec.username
+            or creator.startswith("system:bootstrap:")
+            or "system:bootstrappers" in groups
+            or "system:masters" in groups
+        )
+
     def sync(self, key: str):
         cached = self.csrs.get(key)
         if cached is None or self._condition(cached, "Denied"):
@@ -90,12 +115,18 @@ class CertificateController(Controller):
         changed = False
         if not self._condition(csr, "Approved"):
             # Auto-approve node client certs only; anything else waits for a
-            # human `ktpu certificate approve`. Groups are part of the signed
-            # identity, so a node CSR must not smuggle extra groups
-            # (system:masters would be a one-step privilege escalation).
-            if csr.spec.username.startswith("system:node:") and set(
-                csr.spec.groups
-            ) <= {"system:nodes"}:
+            # human `ktpu certificate approve`. Two spoofing vectors guarded:
+            # groups are part of the signed identity (smuggling system:masters
+            # would be one-step privilege escalation), and spec.username is
+            # client-controlled — the authenticated creator recorded by the
+            # IdentityStamp admission plugin must be the node itself renewing,
+            # or a bootstrapper, to stop any CSR-creator minting other nodes'
+            # identities.
+            if (
+                csr.spec.username.startswith("system:node:")
+                and set(csr.spec.groups) <= {"system:nodes"}
+                and self._creator_may_request(csr)
+            ):
                 csr.status.conditions.append(
                     t.CSRCondition(
                         type="Approved", reason="AutoApproved",

@@ -17,7 +17,7 @@ from ..machinery import AlreadyExists, ApiError, NotFound
 from ..machinery.meta import parse_iso
 from ..machinery.scheme import from_dict, to_dict
 from ..utils.cron import next_fire, unmet_times
-from .base import Controller
+from .base import Controller, write_status_if_changed
 
 
 def _utc(ts: float) -> datetime.datetime:
@@ -176,16 +176,19 @@ class CronJobController(Controller):
             fresh = self.cs.cronjobs.get(cj.metadata.name, cj.metadata.namespace)
         except NotFound:
             return
-        if schedule_time is not None:
-            fresh.status.last_schedule_time = (
-                schedule_time.strftime("%Y-%m-%dT%H:%M:%S") + "Z"
-            )
         refs = [self._job_ref(j) for j in active]
         if new_job is not None:
             refs.insert(0, self._job_ref(new_job))
-        fresh.status.active = refs
+
+        def apply(st):
+            if schedule_time is not None:
+                st.last_schedule_time = (
+                    schedule_time.strftime("%Y-%m-%dT%H:%M:%S") + "Z"
+                )
+            st.active = refs
+
         try:
-            self.cs.cronjobs.update_status(fresh)
+            write_status_if_changed(self.cs.cronjobs, fresh, apply)
         except ApiError:
             pass
 

@@ -7,7 +7,7 @@ from ..api import types as t
 from ..machinery import ApiError
 from ..machinery.labels import label_selector_matches, match_labels
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller
+from .base import Controller, write_status_if_changed
 
 
 class DaemonSetController(Controller):
@@ -111,18 +111,21 @@ class DaemonSetController(Controller):
         except ApiError:
             return
         eligible_names = {n.metadata.name for n in eligible}
-        fresh.status.desired_number_scheduled = len(eligible)
-        fresh.status.current_number_scheduled = len(
-            {p.spec.node_name for p in owned if p.spec.node_name in eligible_names}
-        )
-        fresh.status.number_misscheduled = len(
-            [p for p in owned if p.spec.node_name not in eligible_names]
-        )
-        fresh.status.number_ready = len(
-            [p for p in owned if p.status.phase == t.POD_RUNNING]
-        )
-        fresh.status.observed_generation = fresh.metadata.generation
+
+        def apply(st):
+            st.desired_number_scheduled = len(eligible)
+            st.current_number_scheduled = len(
+                {p.spec.node_name for p in owned if p.spec.node_name in eligible_names}
+            )
+            st.number_misscheduled = len(
+                [p for p in owned if p.spec.node_name not in eligible_names]
+            )
+            st.number_ready = len(
+                [p for p in owned if p.status.phase == t.POD_RUNNING]
+            )
+            st.observed_generation = fresh.metadata.generation
+
         try:
-            self.cs.daemonsets.update_status(fresh)
+            write_status_if_changed(self.cs.daemonsets, fresh, apply)
         except ApiError:
             pass

@@ -11,7 +11,7 @@ from typing import List, Optional
 from ..api import types as t
 from ..machinery import AlreadyExists, ApiError, NotFound
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller
+from .base import Controller, write_status_if_changed
 
 HASH_LABEL = "pod-template-hash"
 
@@ -120,12 +120,17 @@ class DeploymentController(Controller):
     def _scale(self, rs: t.ReplicaSet, replicas: int):
         if (rs.spec.replicas or 0) == replicas:
             return
-        try:
+        from ..client.retry import retry_on_conflict
+
+        def attempt():
             fresh = self.cs.replicasets.get(rs.metadata.name, rs.metadata.namespace)
             fresh.spec.replicas = replicas
-            self.cs.replicasets.update(fresh)
+            return self.cs.replicasets.update(fresh)
+
+        try:
+            retry_on_conflict(attempt)
         except ApiError:
-            pass
+            pass  # re-enqueued by the next RS event
 
     def _rolling(self, dep, new_rs, old: List[t.ReplicaSet], replicas: int):
         ru = dep.spec.strategy.rolling_update
@@ -175,16 +180,19 @@ class DeploymentController(Controller):
         except NotFound:
             return
         live = [self.rsets.get(rs.key()) or rs for rs in owned]
-        fresh.status.replicas = sum(rs.status.replicas for rs in live)
-        fresh.status.ready_replicas = sum(rs.status.ready_replicas for rs in live)
-        fresh.status.available_replicas = fresh.status.ready_replicas
         new_live = self.rsets.get(new_rs.key()) or new_rs
-        fresh.status.updated_replicas = new_live.status.replicas
-        fresh.status.unavailable_replicas = max(
-            0, (fresh.spec.replicas or 1) - fresh.status.ready_replicas
-        )
-        fresh.status.observed_generation = fresh.metadata.generation
+
+        def apply(st):
+            st.replicas = sum(rs.status.replicas for rs in live)
+            st.ready_replicas = sum(rs.status.ready_replicas for rs in live)
+            st.available_replicas = st.ready_replicas
+            st.updated_replicas = new_live.status.replicas
+            st.unavailable_replicas = max(
+                0, (fresh.spec.replicas or 1) - st.ready_replicas
+            )
+            st.observed_generation = fresh.metadata.generation
+
         try:
-            self.cs.deployments.update_status(fresh)
+            write_status_if_changed(self.cs.deployments, fresh, apply)
         except ApiError:
             pass

@@ -38,7 +38,7 @@ from ..deviceplugin.tpu_plugin import (
 from ..machinery import AlreadyExists, ApiError, NotFound, now_iso
 from ..machinery.labels import label_selector_matches
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller
+from .base import Controller, write_status_if_changed
 
 COORDINATOR_PORT = 8476
 
@@ -238,13 +238,6 @@ class JobController(Controller):
                     done_indexes.add(idx)
 
         fresh = self.cs.jobs.get(job.metadata.name, job.metadata.namespace)
-        fresh.status.active = len(active)
-        fresh.status.succeeded = len(succeeded)
-        fresh.status.failed = len(failed)
-        if not fresh.status.start_time:
-            fresh.status.start_time = now_iso()
-        if indexed:
-            fresh.status.completed_indexes = format_indexes(done_indexes)
 
         complete = False
         if indexed:
@@ -254,29 +247,46 @@ class JobController(Controller):
             complete = len(succeeded) >= completions
         else:
             complete = len(succeeded) > 0 and len(active) == 0
+        newly_complete = complete and not self._finished(fresh)
+        newly_failed = (
+            not newly_complete
+            and len(failed) > job.spec.backoff_limit
+            and not self._finished(fresh)
+        )
 
-        if complete and not self._finished(fresh):
-            fresh.status.completion_time = now_iso()
-            fresh.status.conditions.append(
-                t.JobCondition(
-                    type="Complete", status="True", last_transition_time=now_iso()
+        def apply(st):
+            st.active = len(active)
+            st.succeeded = len(succeeded)
+            st.failed = len(failed)
+            if not st.start_time:
+                st.start_time = now_iso()
+            if indexed:
+                st.completed_indexes = format_indexes(done_indexes)
+            if newly_complete:
+                st.completion_time = now_iso()
+                st.conditions.append(
+                    t.JobCondition(
+                        type="Complete", status="True",
+                        last_transition_time=now_iso(),
+                    )
                 )
-            )
+            elif newly_failed:
+                st.conditions.append(
+                    t.JobCondition(
+                        type="Failed", status="True",
+                        reason="BackoffLimitExceeded",
+                        last_transition_time=now_iso(),
+                    )
+                )
+
+        try:
+            write_status_if_changed(self.cs.jobs, fresh, apply)
+        except NotFound:
+            return
+        if newly_complete:
             self.recorder.event(job, "Normal", "Completed", "job completed")
-        elif len(failed) > job.spec.backoff_limit and not self._finished(fresh):
-            fresh.status.conditions.append(
-                t.JobCondition(
-                    type="Failed",
-                    status="True",
-                    reason="BackoffLimitExceeded",
-                    last_transition_time=now_iso(),
-                )
-            )
+        elif newly_failed:
             self.recorder.event(
                 job, "Warning", "BackoffLimitExceeded",
                 f"{len(failed)} failed pods exceed backoffLimit={job.spec.backoff_limit}",
             )
-        try:
-            self.cs.jobs.update_status(fresh)
-        except NotFound:
-            pass

@@ -9,7 +9,7 @@ from ..api import types as t
 from ..machinery import ApiError, NotFound
 from ..machinery.labels import label_selector_matches
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller
+from .base import Controller, write_status_if_changed
 
 
 def owned_by(pod: t.Pod, kind: str, uid: str) -> bool:
@@ -103,12 +103,14 @@ class ReplicaSetController(Controller):
             for p in alive
             if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
         ]
-        fresh.status.replicas = len(alive)
-        fresh.status.ready_replicas = len(ready)
-        fresh.status.available_replicas = len(ready)
-        fresh.status.fully_labeled_replicas = len(alive)
-        fresh.status.observed_generation = fresh.metadata.generation
+        def apply(st):
+            st.replicas = len(alive)
+            st.ready_replicas = len(ready)
+            st.available_replicas = len(ready)
+            st.fully_labeled_replicas = len(alive)
+            st.observed_generation = fresh.metadata.generation
+
         try:
-            self.cs.replicasets.update_status(fresh)
+            write_status_if_changed(self.cs.replicasets, fresh, apply)
         except ApiError:
             pass

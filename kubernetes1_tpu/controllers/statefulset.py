@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 from ..api import types as t
 from ..machinery import ApiError, NotFound
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller
+from .base import Controller, write_status_if_changed
 from .deployment import template_hash
 
 POD_NAME_LABEL = "statefulset.kubernetes.io/pod-name"
@@ -174,17 +174,19 @@ class StatefulSetController(Controller):
             if o < want and not p.metadata.deletion_timestamp
             and p.status.phase not in (t.POD_FAILED, t.POD_SUCCEEDED)
         ]
-        fresh.status.replicas = len(alive)
-        fresh.status.ready_replicas = sum(1 for p in alive if is_ready(p))
-        fresh.status.updated_replicas = sum(
-            1 for p in alive if p.metadata.labels.get(REVISION_LABEL) == update_rev
-        )
-        fresh.status.current_replicas = fresh.status.updated_replicas
-        fresh.status.update_revision = update_rev
-        if fresh.status.updated_replicas == len(alive):
-            fresh.status.current_revision = update_rev
-        fresh.status.observed_generation = fresh.metadata.generation
+        def apply(st):
+            st.replicas = len(alive)
+            st.ready_replicas = sum(1 for p in alive if is_ready(p))
+            st.updated_replicas = sum(
+                1 for p in alive if p.metadata.labels.get(REVISION_LABEL) == update_rev
+            )
+            st.current_replicas = st.updated_replicas
+            st.update_revision = update_rev
+            if st.updated_replicas == len(alive):
+                st.current_revision = update_rev
+            st.observed_generation = fresh.metadata.generation
+
         try:
-            self.cs.statefulsets.update_status(fresh)
+            write_status_if_changed(self.cs.statefulsets, fresh, apply)
         except ApiError:
             pass

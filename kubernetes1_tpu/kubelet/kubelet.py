@@ -57,6 +57,9 @@ class Kubelet:
         pleg_interval: float = 1.0,
         restart_backoff_base: float = 1.0,
         sync_workers: int = 4,
+        eviction_interval: float = 10.0,
+        eviction_thresholds: Optional[Dict[str, float]] = None,
+        eviction_signals_fn=None,
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -90,6 +93,18 @@ class Kubelet:
         self._threads: List[threading.Thread] = []
         self._lock = threading.RLock()
         self._metrics_rv: Dict[Tuple[str, str], str] = {}  # (kind, key) -> rv
+
+        from .eviction import EvictionManager, default_signals
+        from .prober import ProberManager
+
+        self.prober = ProberManager(exec_in_container=self._exec_in_container)
+        self.eviction_interval = eviction_interval
+        self.eviction = EvictionManager(
+            thresholds=eviction_thresholds,
+            signals_fn=eviction_signals_fn or default_signals,
+            evict_fn=self._evict_pod,
+            list_pods=self._my_pods,
+        )
 
     # ---------------------------------------------------------------- start
 
@@ -129,6 +144,7 @@ class Kubelet:
             (self._pleg_relist, self.pleg_interval, "pleg"),
             (self._tick_all, self.sync_interval, "sync-ticker"),
             (self._publish_metrics, self.heartbeat_interval, "stats"),
+            (self._eviction_pass, self.eviction_interval, "eviction"),
         ):
             th = threading.Thread(
                 target=self._loop, args=(fn, period), daemon=True, name=name
@@ -142,6 +158,7 @@ class Kubelet:
         self._queue.shut_down()
         self.pods.stop()
         self.device_manager.stop()
+        self.prober.stop()
 
     def _loop(self, fn, period: float):
         while not self._stop.is_set():
@@ -211,7 +228,7 @@ class Kubelet:
                 reason="KubeletReady",
                 last_heartbeat_time=now,
             )
-        ]
+        ] + self.eviction.node_conditions()
         node.status.addresses = [t.NodeAddress(type="Hostname", address=self.node_name)]
         node.status.node_info = t.NodeSystemInfo(
             kubelet_version="ktpu-0.1",
@@ -240,6 +257,31 @@ class Kubelet:
             self.cs.nodes.update_status(node)
         except Conflict:
             pass  # next beat wins
+
+    # -------------------------------------------------- probes and eviction
+
+    def _exec_in_container(self, pod_uid: str, container_name: str, command) -> int:
+        with self._lock:
+            cid = self._containers.get((pod_uid, container_name))
+        if cid is None:
+            return -1
+        exec_fn = getattr(self.runtime, "exec_in_container", None)
+        if exec_fn is None:
+            return -1
+        return exec_fn(cid, command)
+
+    def _my_pods(self) -> List[t.Pod]:
+        return [p for p in self.pods.list() if p.spec.node_name == self.node_name]
+
+    def _evict_pod(self, pod: t.Pod, reason: str):
+        """Pressure eviction = fail the pod; its controller reschedules it
+        elsewhere (ref: eviction_manager.go evictPod)."""
+        self.recorder.event(pod, "Warning", "Evicted", reason)
+        self._set_failed(pod, "Evicted", reason)
+        self._heartbeat_now()  # surface the pressure condition promptly
+
+    def _eviction_pass(self):
+        self.eviction.synchronize()
 
     # -------------------------------------------------------- stats pipeline
 
@@ -409,6 +451,7 @@ class Kubelet:
 
         sandbox_id = self._ensure_sandbox(pod)
         self._sync_containers(pod, sandbox_id)
+        self.prober.ensure_pod(pod)
         self._sync_status(pod)
 
     ADMISSION_GRACE_SECONDS = 30.0
@@ -480,7 +523,20 @@ class Kubelet:
                 cid = self._containers.get(ckey)
             record = self.runtime.container_status(cid) if cid else None
             if record is not None and record.state == CONTAINER_RUNNING:
-                continue
+                if self.prober.liveness_failed(uid, container.name):
+                    # failing liveness => kill; the restart path below brings
+                    # it back with backoff (ref: prober result -> syncPod kill)
+                    self.recorder.event(
+                        pod, "Warning", "Unhealthy",
+                        f"liveness probe failed for {container.name}; restarting",
+                    )
+                    self.runtime.stop_container(record.id, timeout=2.0)
+                    self.prober.restart_container(uid, container.name)
+                    record = self.runtime.container_status(record.id)
+                    if record is None or record.state == CONTAINER_RUNNING:
+                        continue
+                else:
+                    continue
             if record is not None and record.state == CONTAINER_EXITED:
                 if not self._should_restart(pod, record.exit_code):
                     continue
@@ -571,6 +627,7 @@ class Kubelet:
     def _prune_pod_state(self, uid: str):
         """Drop every per-pod bookkeeping entry (unbounded growth otherwise
         under Job-style pod churn)."""
+        self.prober.remove_pod(uid)
         with self._lock:
             self._admitted.pop(uid, None)
             self._admit_first_seen.pop(uid, None)
@@ -621,7 +678,7 @@ class Kubelet:
                 cs.state.waiting = t.ContainerStateWaiting(reason="ContainerCreating")
             elif record.state == CONTAINER_RUNNING:
                 running += 1
-                cs.ready = True
+                cs.ready = self.prober.is_ready(uid, container.name)
                 cs.container_id = record.id
                 cs.state.running = t.ContainerStateRunning(
                     started_at=_iso(record.started_at)

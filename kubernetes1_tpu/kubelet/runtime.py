@@ -117,6 +117,11 @@ class RuntimeService:
         pipeline (ref: cadvisor ContainerStats → kubelet Summary API)."""
         return {"cpu": 0.0, "memory": 0.0}
 
+    def exec_in_container(self, container_id: str, command) -> int:
+        """Run a command in the container's context; returns exit code
+        (exec probes + `ktpu exec` ride this)."""
+        return -1
+
 
 class ImageService:
     """ref: api.proto ImageService (5 RPCs) — advisory here."""
@@ -153,6 +158,7 @@ class FakeRuntime(RuntimeService):
         # else the default. Tests drive HPA behavior through set_usage().
         self.default_usage: Dict[str, float] = {"cpu": 0.001, "memory": 1 << 20}
         self._usage_by_name: Dict[str, Dict[str, float]] = {}
+        self._exec_results: Dict[str, int] = {}
 
     def set_usage(self, container_name: str, cpu: float, memory: float = 1 << 20):
         self._usage_by_name[container_name] = {"cpu": cpu, "memory": memory}
@@ -163,6 +169,17 @@ class FakeRuntime(RuntimeService):
         if c is None or c.state != CONTAINER_RUNNING:
             return {"cpu": 0.0, "memory": 0.0}
         return dict(self._usage_by_name.get(c.name, self.default_usage))
+
+    def set_exec_result(self, container_name: str, code: int):
+        """Script exec-probe outcomes per container name (default 0)."""
+        self._exec_results[container_name] = code
+
+    def exec_in_container(self, container_id: str, command) -> int:
+        with self._lock:
+            c = self._containers.get(container_id)
+        if c is None or c.state != CONTAINER_RUNNING:
+            return -1
+        return self._exec_results.get(c.name, 0)
 
     def version(self) -> str:
         return "fake://0.1"
@@ -426,6 +443,25 @@ class ProcessRuntime(RuntimeService):
         if tail:
             lines = lines[-tail:]
         return "".join(lines)
+
+    def exec_in_container(self, container_id: str, command) -> int:
+        """Exec probes for process containers: run the command with the
+        container's env (process analog of CRI ExecSync)."""
+        with self._lock:
+            proc = self._procs.get(container_id)
+            config = self._configs.get(container_id)
+        if proc is None or proc.poll() is not None:
+            return -1
+        env = dict(os.environ)
+        if config is not None:
+            env.update(config.env)
+        try:
+            res = subprocess.run(
+                list(command), env=env, capture_output=True, timeout=10
+            )
+            return res.returncode
+        except (OSError, subprocess.TimeoutExpired, ValueError):
+            return -1
 
     def container_stats(self, container_id: str) -> Dict[str, float]:
         """CPU from /proc/<pid>/stat utime+stime deltas between calls, RSS

@@ -168,6 +168,19 @@ class Scheme:
         self.namespaced[plural] = namespaced
         return cls
 
+    def copy(self) -> "Scheme":
+        """Independent registry sharing the same type classes — an apiserver
+        registers CRD kinds on its own copy so dynamic registrations never
+        leak across Master instances in one process."""
+        s = Scheme()
+        s.by_kind = dict(self.by_kind)
+        s.by_resource = dict(self.by_resource)
+        s.resource_of = dict(self.resource_of)
+        s.namespaced = dict(self.namespaced)
+        s.dynamic_kinds = dict(self.dynamic_kinds)
+        s.dynamic_resources = dict(self.dynamic_resources)
+        return s
+
     def register_dynamic(self, kind: str, plural: str, api_version: str,
                          namespaced: bool = True):
         """Register a CRD-backed kind served as Unstructured."""

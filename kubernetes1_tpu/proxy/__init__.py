@@ -1,3 +1,4 @@
 from .proxier import Proxier
+from .rules import RuleTableProxier
 
-__all__ = ["Proxier"]
+__all__ = ["Proxier", "RuleTableProxier"]

@@ -232,6 +232,93 @@ class TestCSREscalation:
             acs.close()
 
 
+class TestNodeSecretsScoping:
+    def test_node_reads_only_referenced_secrets(self, rbac_master):
+        """A kubelet may GET a secret only when a pod bound to it mounts
+        that secret; cluster-wide secret list/get is denied (the upstream
+        node-authorizer graph posture)."""
+        master, _ = rbac_master
+        acs = admin(master)
+        for name in ("mounted", "unrelated"):
+            s = t.Secret(data={"k": "v"})
+            s.metadata.name = name
+            acs.secrets.create(s)
+        pod = simple_pod("consumer", node="n1")
+        pod.spec.volumes = [
+            t.Volume(name="v", secret=t.SecretVolumeSource(secret_name="mounted"))
+        ]
+        acs.pods.create(pod)
+
+        cert = issue_certificate(
+            "ktpu-ca-key", "system:node:n1", "req", groups=["system:nodes"]
+        )
+        n1 = Clientset(master.url, token=cert)
+        assert n1.secrets.get("mounted").data["k"] == "v"
+        with pytest.raises(Forbidden):
+            n1.secrets.get("unrelated")
+        with pytest.raises(Forbidden):
+            n1.secrets.list(namespace="default")
+        n1.close()
+        acs.close()
+
+
+class TestCSRImpersonation:
+    def test_csr_for_foreign_node_identity_not_auto_approved(self, rbac_master):
+        """spec.username is client-controlled: a CSR whose authenticated
+        creator is not that identity (nor a bootstrapper/admin) must wait
+        for manual approval."""
+        import time
+
+        from kubernetes1_tpu.client import InformerFactory
+        from kubernetes1_tpu.controllers.certificates import CertificateController
+
+        master, _ = rbac_master
+        acs = admin(master)
+        factory = InformerFactory(acs)
+        ctl = CertificateController(acs, factory)
+        ctl.setup()
+        factory.start_all()
+        factory.wait_for_sync()
+        ctl.start_workers()
+        try:
+            # n1 requests a credential for n2's identity
+            cert = issue_certificate(
+                "ktpu-ca-key", "system:node:n1", "r", groups=["system:nodes"]
+            )
+            n1 = Clientset(master.url, token=cert)
+            csr = t.CertificateSigningRequest()
+            csr.metadata.name = "impersonation"
+            csr.spec.request = "r"
+            csr.spec.username = "system:node:n2"
+            csr.spec.groups = ["system:nodes"]
+            created = n1.certificatesigningrequests.create(csr)
+            # creator identity was stamped server-side and is not the target
+            assert created.metadata.annotations["ktpu.io/created-by"] == "system:node:n1"
+            time.sleep(1.0)
+            got = acs.certificatesigningrequests.get("impersonation", "")
+            assert not got.status.certificate
+            assert not any(c.type == "Approved" for c in got.status.conditions)
+
+            # the node renewing its OWN identity is auto-approved
+            own = t.CertificateSigningRequest()
+            own.metadata.name = "renewal"
+            own.spec.request = "r2"
+            own.spec.username = "system:node:n1"
+            own.spec.groups = ["system:nodes"]
+            n1.certificatesigningrequests.create(own)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if acs.certificatesigningrequests.get("renewal", "").status.certificate:
+                    break
+                time.sleep(0.1)
+            assert acs.certificatesigningrequests.get("renewal", "").status.certificate
+            n1.close()
+        finally:
+            ctl.stop()
+            factory.stop_all()
+            acs.close()
+
+
 class TestAudit:
     def test_mutations_carry_user_identity(self, rbac_master):
         master, audit = rbac_master

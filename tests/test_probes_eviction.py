@@ -1,0 +1,251 @@
+"""Kubelet probe + eviction tests (ref: pkg/kubelet/prober + eviction test
+areas): readiness gates the Ready condition and Endpoints membership,
+liveness failures restart containers, node pressure evicts lowest-QoS pods
+and raises node conditions."""
+
+import threading
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes1_tpu.kubelet.eviction import (
+    QOS_BESTEFFORT,
+    QOS_BURSTABLE,
+    QOS_GUARANTEED,
+    EvictionManager,
+    qos_class,
+)
+from kubernetes1_tpu.kubelet.prober import ProberManager, run_probe
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+@pytest.fixture()
+def node(tmp_path):
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+    runtime = FakeRuntime()
+    kubelet = Kubelet(
+        cs, node_name="probe-node", runtime=runtime,
+        plugin_dir=str(tmp_path / "plugins"),
+        heartbeat_interval=0.5, sync_interval=0.2, pleg_interval=0.2,
+        eviction_interval=0.5,
+        eviction_signals_fn=lambda: {"memory.available": 1.0},
+    )
+    kubelet.start()
+    env = {"master": master, "cs": cs, "kubelet": kubelet, "runtime": runtime}
+    yield env
+    kubelet.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def probed_pod(name, exec_cmd=("check",), kind="readiness", period=1,
+               failure_threshold=1):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.spec.containers = [t.Container(name="c", image="x", command=["serve"])]
+    probe = t.Probe(
+        exec_action=t.ExecAction(command=list(exec_cmd)),
+        period_seconds=period, failure_threshold=failure_threshold,
+    )
+    if kind == "readiness":
+        pod.spec.containers[0].readiness_probe = probe
+    else:
+        pod.spec.containers[0].liveness_probe = probe
+    return pod
+
+
+class TestProbeActions:
+    def test_tcp_probe(self):
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        probe = t.Probe(tcp_socket=t.TCPSocketAction(port=port))
+        assert run_probe(probe, "127.0.0.1") is True
+        srv.close()
+        probe_bad = t.Probe(tcp_socket=t.TCPSocketAction(port=1))
+        assert run_probe(probe_bad, "127.0.0.1") is False
+
+    def test_http_probe(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                code = 200 if self.path == "/healthy" else 500
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        srv = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+        ok = t.Probe(http_get=t.HTTPGetAction(path="/healthy", port=port))
+        bad = t.Probe(http_get=t.HTTPGetAction(path="/broken", port=port))
+        assert run_probe(ok, "127.0.0.1") is True
+        assert run_probe(bad, "127.0.0.1") is False
+        srv.shutdown()
+        srv.server_close()
+
+    def test_exec_probe_uses_runtime(self):
+        results = {"code": 1}
+        probe = t.Probe(exec_action=t.ExecAction(command=["check"]))
+        assert run_probe(probe, "", exec_fn=lambda cmd: results["code"]) is False
+        results["code"] = 0
+        assert run_probe(probe, "", exec_fn=lambda cmd: results["code"]) is True
+
+
+class TestReadiness:
+    def test_failing_readiness_keeps_pod_unready(self, node):
+        cs, runtime = node["cs"], node["runtime"]
+        runtime.set_exec_result("c", 1)  # readiness exec fails
+        cs.pods.create(probed_pod("unready", kind="readiness"))
+        must_poll_until(
+            lambda: cs.pods.get("unready").status.phase == t.POD_RUNNING,
+            timeout=15.0, desc="running",
+        )
+
+        def ready_condition():
+            conds = cs.pods.get("unready").status.conditions
+            return next((c.status for c in conds if c.type == "Ready"), None)
+
+        must_poll_until(lambda: ready_condition() == "False", timeout=10.0,
+                        desc="NotReady while probe fails")
+        # flip the probe to success -> pod becomes Ready
+        runtime.set_exec_result("c", 0)
+        must_poll_until(lambda: ready_condition() == "True", timeout=15.0,
+                        desc="Ready after probe passes")
+
+
+class TestLiveness:
+    def test_failing_liveness_restarts_container(self, node):
+        cs, runtime = node["cs"], node["runtime"]
+        cs.pods.create(probed_pod("flappy", kind="liveness"))
+        must_poll_until(
+            lambda: cs.pods.get("flappy").status.phase == t.POD_RUNNING,
+            timeout=15.0, desc="running",
+        )
+        runtime.set_exec_result("c", 1)  # liveness starts failing
+
+        def restarted():
+            sts = cs.pods.get("flappy").status.container_statuses
+            return sts and sts[0].restart_count >= 1
+
+        must_poll_until(restarted, timeout=20.0, desc="container restarted")
+        runtime.set_exec_result("c", 0)  # recover so teardown is clean
+
+
+class TestQoS:
+    def test_qos_classes(self):
+        best_effort = t.Pod()
+        best_effort.spec.containers = [t.Container(name="c", image="x")]
+        assert qos_class(best_effort) == QOS_BESTEFFORT
+
+        burstable = t.Pod()
+        burstable.spec.containers = [
+            t.Container(name="c", image="x",
+                        resources=t.ResourceRequirements(requests={"cpu": "100m"}))
+        ]
+        assert qos_class(burstable) == QOS_BURSTABLE
+
+        guaranteed = t.Pod()
+        guaranteed.spec.containers = [
+            t.Container(name="c", image="x",
+                        resources=t.ResourceRequirements(
+                            requests={"cpu": "1", "memory": "1Gi"},
+                            limits={"cpu": "1", "memory": "1Gi"}))
+        ]
+        assert qos_class(guaranteed) == QOS_GUARANTEED
+
+
+class TestEviction:
+    def test_picks_besteffort_before_burstable(self):
+        be = t.Pod()
+        be.metadata.name = "be"
+        be.metadata.creation_timestamp = "2026-01-01T00:00:00Z"
+        be.status.phase = t.POD_RUNNING
+        be.spec.containers = [t.Container(name="c", image="x")]
+        bu = t.Pod()
+        bu.metadata.name = "bu"
+        bu.metadata.creation_timestamp = "2026-01-02T00:00:00Z"
+        bu.status.phase = t.POD_RUNNING
+        bu.spec.containers = [
+            t.Container(name="c", image="x",
+                        resources=t.ResourceRequirements(requests={"cpu": "1"}))
+        ]
+        evicted = []
+        mgr = EvictionManager(
+            thresholds={"memory.available": 0.10},
+            signals_fn=lambda: {"memory.available": 0.01},
+            evict_fn=lambda pod, reason: evicted.append(pod.metadata.name),
+            list_pods=lambda: [bu, be],
+        )
+        assert mgr.synchronize() == ["be"]
+        assert evicted == ["be"]
+        conds = {c.type: c.status for c in mgr.node_conditions()}
+        assert conds["MemoryPressure"] == "True"
+
+    def test_no_pressure_no_eviction(self):
+        mgr = EvictionManager(
+            thresholds={"memory.available": 0.05},
+            signals_fn=lambda: {"memory.available": 0.50},
+            evict_fn=lambda pod, reason: pytest.fail("must not evict"),
+            list_pods=lambda: [],
+        )
+        assert mgr.synchronize() == []
+        conds = {c.type: c.status for c in mgr.node_conditions()}
+        assert conds["MemoryPressure"] == "False"
+
+    def test_node_pressure_evicts_pod_end_to_end(self, tmp_path):
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs)
+        sched.start()
+        pressure = {"memory.available": 1.0}
+        kubelet = Kubelet(
+            cs, node_name="pressured", runtime=FakeRuntime(),
+            plugin_dir=str(tmp_path / "p"),
+            heartbeat_interval=0.3, sync_interval=0.2, pleg_interval=0.2,
+            eviction_interval=0.3,
+            eviction_signals_fn=lambda: dict(pressure),
+        )
+        kubelet.start()
+        try:
+            pod = t.Pod()
+            pod.metadata.name = "victim"
+            pod.spec.containers = [t.Container(name="c", image="x", command=["serve"])]
+            cs.pods.create(pod)
+            must_poll_until(
+                lambda: cs.pods.get("victim").status.phase == t.POD_RUNNING,
+                timeout=15.0, desc="running",
+            )
+            pressure["memory.available"] = 0.01
+            must_poll_until(
+                lambda: cs.pods.get("victim").status.phase == t.POD_FAILED,
+                timeout=15.0, desc="evicted",
+            )
+            assert cs.pods.get("victim").status.reason == "Evicted"
+            must_poll_until(
+                lambda: any(
+                    c.type == "MemoryPressure" and c.status == "True"
+                    for c in cs.nodes.get("pressured", "").status.conditions
+                ),
+                timeout=10.0, desc="pressure condition",
+            )
+        finally:
+            kubelet.stop()
+            sched.stop()
+            cs.close()
+            master.stop()

@@ -227,3 +227,111 @@ class TestProxier:
         finally:
             s1.shutdown()
             s2.shutdown()
+
+
+class TestRuleTableProxier:
+    """iptables-mode analog: compiled rule table, O(1) resolution, no
+    per-service sockets (ref: pkg/proxy/iptables/proxier.go)."""
+
+    def _mk_endpoints(self, name, backends):
+        eps = t.Endpoints(subsets=[
+            t.EndpointSubset(
+                addresses=[t.EndpointAddress(ip=ip) for ip, _ in backends],
+                ports=[t.EndpointPort(port=backends[0][1])],
+            )
+        ])
+        eps.metadata.name = name
+        eps.metadata.namespace = "default"
+        return eps
+
+    def test_compiles_and_resolves(self, master):
+        from kubernetes1_tpu.proxy import RuleTableProxier
+
+        _, cs = master
+        svc = cs.services.create(make_service("rt", port=80))
+        cs.endpoints.create(self._mk_endpoints("rt", [("10.0.0.1", 8080),
+                                                      ("10.0.0.2", 8080)]))
+        proxier = RuleTableProxier(cs)
+        proxier.start()
+        try:
+            must_poll_until(
+                lambda: proxier.resolve(svc.spec.cluster_ip, 80) is not None,
+                timeout=10.0, desc="table compiled",
+            )
+            seen = {proxier.resolve(svc.spec.cluster_ip, 80) for _ in range(64)}
+            assert seen == {("10.0.0.1", 8080), ("10.0.0.2", 8080)}
+            assert proxier.resolve(svc.spec.cluster_ip, 81) is None
+            assert proxier.resolve("10.96.99.99", 80) is None
+        finally:
+            proxier.stop()
+
+    def test_nodeport_and_dump(self, master):
+        from kubernetes1_tpu.proxy import RuleTableProxier
+
+        _, cs = master
+        svc = cs.services.create(
+            make_service("np", port=80, typ="NodePort")
+        )
+        cs.endpoints.create(self._mk_endpoints("np", [("10.0.0.5", 9000)]))
+        proxier = RuleTableProxier(cs)
+        proxier.start()
+        try:
+            node_port = svc.spec.ports[0].node_port or cs.services.get("np").spec.ports[0].node_port
+            must_poll_until(
+                lambda: proxier.resolve_node_port(node_port) == ("10.0.0.5", 9000),
+                timeout=10.0, desc="nodeport rule",
+            )
+            dump = proxier.dump()
+            assert "*nat" in dump and dump.rstrip().endswith("COMMIT")
+            assert "KTPU-SERVICES" in dump and "KTPU-SVC-" in dump
+            assert f"--dport {node_port}" in dump
+            assert "DNAT --to-destination 10.0.0.5:9000" in dump
+        finally:
+            proxier.stop()
+
+    def test_session_affinity_sticks(self, master):
+        from kubernetes1_tpu.proxy import RuleTableProxier
+
+        _, cs = master
+        svc = make_service("aff", port=80)
+        svc.spec.session_affinity = "ClientIP"
+        svc = cs.services.create(svc)
+        cs.endpoints.create(self._mk_endpoints("aff", [("10.0.1.1", 80),
+                                                       ("10.0.1.2", 80)]))
+        proxier = RuleTableProxier(cs)
+        proxier.start()
+        try:
+            must_poll_until(
+                lambda: proxier.resolve(svc.spec.cluster_ip, 80, "1.2.3.4") is not None,
+                timeout=10.0, desc="compiled",
+            )
+            first = proxier.resolve(svc.spec.cluster_ip, 80, "1.2.3.4")
+            assert all(
+                proxier.resolve(svc.spec.cluster_ip, 80, "1.2.3.4") == first
+                for _ in range(32)
+            )
+        finally:
+            proxier.stop()
+
+    def test_endpoint_change_triggers_recompile(self, master):
+        from kubernetes1_tpu.proxy import RuleTableProxier
+
+        _, cs = master
+        svc = cs.services.create(make_service("rc", port=80))
+        cs.endpoints.create(self._mk_endpoints("rc", [("10.2.0.1", 80)]))
+        proxier = RuleTableProxier(cs)
+        proxier.start()
+        try:
+            must_poll_until(
+                lambda: proxier.resolve(svc.spec.cluster_ip, 80) == ("10.2.0.1", 80),
+                timeout=10.0, desc="initial",
+            )
+            fresh = cs.endpoints.get("rc")
+            fresh.subsets = self._mk_endpoints("rc", [("10.2.0.9", 80)]).subsets
+            cs.endpoints.update(fresh)
+            must_poll_until(
+                lambda: proxier.resolve(svc.spec.cluster_ip, 80) == ("10.2.0.9", 80),
+                timeout=10.0, desc="recompiled",
+            )
+        finally:
+            proxier.stop()
