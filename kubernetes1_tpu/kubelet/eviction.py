@@ -27,26 +27,24 @@ _QOS_EVICTION_ORDER = {QOS_BESTEFFORT: 0, QOS_BURSTABLE: 1, QOS_GUARANTEED: 2}
 
 
 def qos_class(pod: t.Pod) -> str:
-    """ref: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS."""
-    requests: Dict[str, float] = {}
-    limits: Dict[str, float] = {}
-    any_request = False
+    """ref: pkg/apis/core/v1/helper/qos/qos.go GetPodQOS. Requests default
+    to limits when unset (the apiserver's defaulting), so limits-only pods
+    are Guaranteed, not Burstable."""
+    any_resources = False
     guaranteed = True
     for c in pod.spec.containers:
-        for res, val in (c.resources.requests or {}).items():
-            requests[res] = requests.get(res, 0.0) + parse_quantity(val)
-            any_request = True
-        for res, val in (c.resources.limits or {}).items():
-            limits[res] = limits.get(res, 0.0) + parse_quantity(val)
-    if not any_request and not limits:
-        return QOS_BESTEFFORT
-    for c in pod.spec.containers:
         req, lim = c.resources.requests or {}, c.resources.limits or {}
+        if req or lim:
+            any_resources = True
         for res in ("cpu", "memory"):
-            if req.get(res) is None or lim.get(res) is None:
+            limit = lim.get(res)
+            request = req.get(res, limit)  # defaulting: request := limit
+            if limit is None or request is None:
                 guaranteed = False
-            elif parse_quantity(req[res]) != parse_quantity(lim[res]):
+            elif parse_quantity(request) != parse_quantity(limit):
                 guaranteed = False
+    if not any_resources:
+        return QOS_BESTEFFORT
     return QOS_GUARANTEED if guaranteed else QOS_BURSTABLE
 
 
@@ -136,7 +134,10 @@ class EvictionManager:
                 continue
             with self._lock:
                 self._pressure_until[signal] = now + self.pressure_transition_period
-            victim = self._pick_victim()
+            # exclude this pass's victims: their Failed status hasn't
+            # propagated to the lister yet, and double-evicting one pod
+            # reclaims nothing for the second signal
+            victim = self._pick_victim(exclude=set(evicted))
             if victim is not None and self.evict_fn is not None:
                 reason = (
                     f"node pressure: {signal} {value:.1%} below "
@@ -146,7 +147,7 @@ class EvictionManager:
                 evicted.append(victim.metadata.name)
         return evicted
 
-    def _pick_victim(self) -> Optional[t.Pod]:
+    def _pick_victim(self, exclude: Optional[set] = None) -> Optional[t.Pod]:
         """Rank: lowest QoS first, then newest (the reference ranks by usage
         over request; without per-pod usage attribution newest-first bounds
         the blast radius the same way)."""
@@ -156,6 +157,7 @@ class EvictionManager:
             p for p in self.list_pods()
             if p.status.phase == t.POD_RUNNING
             and not p.metadata.deletion_timestamp
+            and p.metadata.name not in (exclude or set())
             # static/mirror control-plane pods are never pressure-evicted
             and p.spec.priority < 1_000_000
         ]

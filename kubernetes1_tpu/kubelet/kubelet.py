@@ -97,7 +97,10 @@ class Kubelet:
         from .eviction import EvictionManager, default_signals
         from .prober import ProberManager
 
-        self.prober = ProberManager(exec_in_container=self._exec_in_container)
+        self.prober = ProberManager(
+            exec_in_container=self._exec_in_container,
+            container_running=self._container_running,
+        )
         self.eviction_interval = eviction_interval
         self.eviction = EvictionManager(
             thresholds=eviction_thresholds,
@@ -259,6 +262,14 @@ class Kubelet:
             pass  # next beat wins
 
     # -------------------------------------------------- probes and eviction
+
+    def _container_running(self, pod_uid: str, container_name: str) -> bool:
+        with self._lock:
+            cid = self._containers.get((pod_uid, container_name))
+        if cid is None:
+            return False
+        record = self.runtime.container_status(cid)
+        return record is not None and record.state == CONTAINER_RUNNING
 
     def _exec_in_container(self, pod_uid: str, container_name: str, command) -> int:
         with self._lock:
@@ -439,6 +450,7 @@ class Kubelet:
             self._terminate_pod(pod)
             return
         if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+            self.prober.remove_pod(uid)  # finished pods are never probed
             self._ensure_stopped(pod)
             return
 
@@ -552,6 +564,9 @@ class Kubelet:
                         self.restart_backoff_base * (2**n), 300.0
                     )
                 self.runtime.remove_container(record.id)
+                # probe state belongs to the dead instance — reset so stale
+                # failures aren't charged to the replacement
+                self.prober.restart_container(uid, container.name)
                 self.recorder.event(
                     pod, "Normal", "Restarting",
                     f"container {container.name} exited {record.exit_code}; restarting",

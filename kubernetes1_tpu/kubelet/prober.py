@@ -56,12 +56,14 @@ class _Worker:
     """One probe loop (ref: prober/worker.go)."""
 
     def __init__(self, probe: t.Probe, kind: str, target_host: str,
-                 exec_fn, on_result: Callable[[str], None]):
+                 exec_fn, on_result: Callable[[str], None],
+                 is_running: Optional[Callable[[], bool]] = None):
         self.probe = probe
         self.kind = kind  # "liveness" | "readiness"
         self.target_host = target_host
         self.exec_fn = exec_fn
         self.on_result = on_result
+        self.is_running = is_running
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._successes = 0
@@ -81,6 +83,15 @@ class _Worker:
                 return
         # readiness starts False until the first success; liveness starts OK
         while not self._stop.is_set():
+            if self.is_running is not None and not self.is_running():
+                # container down (crashed / restart backoff): don't probe —
+                # a failure recorded now would be charged to the NEXT
+                # instance and kill it the moment it comes up (the reference
+                # prober likewise only probes running containers)
+                self._successes = self._failures = 0
+                if self._stop.wait(max(self.probe.period_seconds, 0.05)):
+                    return
+                continue
             ok = run_probe(self.probe, self.target_host, self.exec_fn)
             if ok:
                 self._successes += 1
@@ -100,9 +111,11 @@ class ProberManager:
     """Tracks workers per (pod_uid, container, kind) and exposes results
     (ref: prober/prober_manager.go)."""
 
-    def __init__(self, exec_in_container=None):
+    def __init__(self, exec_in_container=None, container_running=None):
         # exec_in_container(pod_uid, container_name, command) -> exit code
+        # container_running(pod_uid, container_name) -> bool
         self.exec_in_container = exec_in_container
+        self.container_running = container_running
         self._lock = threading.Lock()
         self._workers: Dict[Tuple[str, str, str], _Worker] = {}
         self._results: Dict[Tuple[str, str, str], str] = {}
@@ -125,12 +138,16 @@ class ProberManager:
                     if kind == "readiness":
                         self._results[key] = UNKNOWN  # not ready until proven
                     exec_fn = None
+                    cname = container.name
                     if self.exec_in_container is not None:
-                        cname = container.name
                         exec_fn = lambda cmd, u=uid, c=cname: self.exec_in_container(u, c, cmd)  # noqa: E731
+                    is_running = None
+                    if self.container_running is not None:
+                        is_running = lambda u=uid, c=cname: self.container_running(u, c)  # noqa: E731
                     worker = _Worker(
                         probe, kind, host, exec_fn,
                         on_result=lambda res, k=key: self._record(k, res),
+                        is_running=is_running,
                     )
                     self._workers[key] = worker
                 worker.start()

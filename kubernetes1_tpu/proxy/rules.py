@@ -56,6 +56,7 @@ class RuleTableProxier:
         self._by_vip: Dict[Tuple[str, int], _ServiceRules] = {}
         self._by_nodeport: Dict[int, _ServiceRules] = {}
         self._affinity: Dict[Tuple[str, str], Tuple[Tuple[str, int], float]] = {}
+        self._affinity_lock = threading.Lock()  # written by resolve AND sync
         self._affinity_ttl = 10800.0
         self.sync_count = 0
 
@@ -123,10 +124,13 @@ class RuleTableProxier:
         # map otherwise grows one entry per distinct client IP forever
         live = {f"{r.namespace}/{r.name}:{r.port_name}" for r in by_vip.values()}
         now = time.monotonic()
-        self._affinity = {
-            k: v for k, v in self._affinity.items()
-            if k[0] in live and now - v[1] < self._affinity_ttl
-        }
+        with self._affinity_lock:
+            for k in [
+                k for k, v in self._affinity.items()
+                if k[0] not in live or now - v[1] >= self._affinity_ttl
+            ]:
+                del self._affinity[k]  # prune in place: concurrent resolve()
+                # writes between snapshot and swap must not be lost
         self.sync_count += 1
 
     @staticmethod
@@ -167,13 +171,14 @@ class RuleTableProxier:
             return None
         if rules.affinity == "ClientIP" and client_ip:
             akey = (f"{rules.namespace}/{rules.name}:{rules.port_name}", client_ip)
-            hit = self._affinity.get(akey)
             now = time.monotonic()
-            if hit and now - hit[1] < self._affinity_ttl and hit[0] in rules.backends:
-                self._affinity[akey] = (hit[0], now)
-                return hit[0]
-            chosen = random.choice(rules.backends)
-            self._affinity[akey] = (chosen, now)
+            with self._affinity_lock:
+                hit = self._affinity.get(akey)
+                if hit and now - hit[1] < self._affinity_ttl and hit[0] in rules.backends:
+                    self._affinity[akey] = (hit[0], now)
+                    return hit[0]
+                chosen = random.choice(rules.backends)
+                self._affinity[akey] = (chosen, now)
             return chosen
         return random.choice(rules.backends)
 

@@ -8,7 +8,9 @@ jax/pjit programs covering every BASELINE.json config:
 - mnist     — single-chip JAX MNIST (config 2)
 - resnet    — ResNet-50, data-parallel over a single-host mesh (config 3)
 - llama     — Llama-3-style transformer with dp/fsdp/tp sharding, scanned
-              layers, remat, bf16 (configs 4 and 5; flagship model)
+              layers, remat, bf16 (config 5; flagship model)
+- bert      — BERT-large-class MLM encoder, same tp/fsdp treatment with
+              bidirectional fused attention (config 4)
 - ringattention — sequence-parallel blockwise attention over an `sp` mesh
               axis (long-context path; ppermute ring over ICI)
 
@@ -20,7 +22,7 @@ running only mnist doesn't pay for llama/resnet at startup.
 
 import importlib
 
-_SUBMODULES = ("mnist", "llama", "resnet", "ringattention", "sharding")
+_SUBMODULES = ("mnist", "llama", "bert", "resnet", "ringattention", "sharding")
 
 
 def __getattr__(name):

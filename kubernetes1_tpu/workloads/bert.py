@@ -1,0 +1,223 @@
+"""BERT-large-class encoder, TPU-first (BASELINE config 4: multi-host
+v5p-32 BERT-large IndexedJob with ICI-topology-aware gang scheduling).
+
+Same TPU playbook as llama.py, adapted to the bidirectional encoder shape:
+
+- stacked layers iterated with lax.scan (one compiled layer body, static
+  shapes), jax.checkpoint on the body for HBM headroom;
+- megatron tensor parallelism on heads/FFN + fsdp on the remaining weight
+  dim via per-leaf PartitionSpecs; XLA inserts the ICI collectives;
+- bf16 compute / f32 params+adam; non-causal fused attention via
+  jax.nn.dot_product_attention;
+- learned position embeddings + masked-LM head (tied decode against the
+  token embedding), the pretraining objective BERT benchmarks report.
+
+BERT-large = BertConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+vocab=30522, max_seq=512).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MASK_TOKEN = 0  # reserved id used by the synthetic MLM batch maker
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq: int = 512
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_large() -> BertConfig:
+    return BertConfig()
+
+
+def tiny(vocab: int = 256, d_model: int = 64, n_layers: int = 2, n_heads: int = 4,
+         d_ff: int = 128, max_seq: int = 64) -> BertConfig:
+    return BertConfig(vocab=vocab, d_model=d_model, n_layers=n_layers,
+                      n_heads=n_heads, d_ff=d_ff, max_seq=max_seq, remat=False)
+
+
+def param_specs(cfg: BertConfig) -> Dict[str, Any]:
+    """Per-leaf PartitionSpecs; the leading stacked-layer axis of layer
+    params (for scan) is never sharded."""
+    return {
+        "embed": P("tp", "fsdp"),              # (vocab, d)
+        "pos_embed": P(None, "fsdp"),          # (max_seq, d)
+        "layers": {
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "wq": P(None, "fsdp", "tp"), "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+            "w_in": P(None, "fsdp", "tp"),     # (L, d, f)
+            "w_out": P(None, "tp", "fsdp"),    # (L, f, d)
+        },
+        "final_ln_scale": P(None), "final_ln_bias": P(None),
+        "mlm_dense": P("fsdp", "tp"),          # (d, d) transform head
+        "mlm_bias": P(None),                   # (vocab,) decode bias
+    }
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> Dict[str, Any]:
+    k = jax.random.split(key, 9)
+    d, L = cfg.d_model, cfg.n_layers
+
+    def w(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    return {
+        "embed": w(k[0], (cfg.vocab, d), d),
+        "pos_embed": w(k[1], (cfg.max_seq, d), d),
+        "layers": {
+            "ln1_scale": jnp.ones((L, d), jnp.float32),
+            "ln1_bias": jnp.zeros((L, d), jnp.float32),
+            "wq": w(k[2], (L, d, d), d),
+            "wk": w(k[3], (L, d, d), d),
+            "wv": w(k[4], (L, d, d), d),
+            "wo": w(k[5], (L, d, d), d),
+            "ln2_scale": jnp.ones((L, d), jnp.float32),
+            "ln2_bias": jnp.zeros((L, d), jnp.float32),
+            "w_in": w(k[6], (L, d, cfg.d_ff), d),
+            "w_out": w(k[7], (L, cfg.d_ff, d), cfg.d_ff),
+        },
+        "final_ln_scale": jnp.ones((d,), jnp.float32),
+        "final_ln_bias": jnp.zeros((d,), jnp.float32),
+        "mlm_dense": w(k[8], (d, d), d),
+        "mlm_bias": jnp.zeros((cfg.vocab,), jnp.float32),
+    }
+
+
+# ------------------------------------------------------------------ modules
+
+
+def layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+              eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def layer_fn(cfg: BertConfig, x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
+    """Post-LN transformer encoder block (BERT ordering)."""
+    B, S, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    q = (x @ lp["wq"].astype(cfg.dtype)).reshape(B, S, h, hd)
+    kk = (x @ lp["wk"].astype(cfg.dtype)).reshape(B, S, h, hd)
+    v = (x @ lp["wv"].astype(cfg.dtype)).reshape(B, S, h, hd)
+    # bidirectional: no causal mask — lowers to the fused TPU attention
+    attn = jax.nn.dot_product_attention(q, kk, v)
+    attn = attn.reshape(B, S, h * hd) @ lp["wo"].astype(cfg.dtype)
+    x = layernorm(x + attn, lp["ln1_scale"], lp["ln1_bias"])
+    ff = jax.nn.gelu(x @ lp["w_in"].astype(cfg.dtype)) @ lp["w_out"].astype(cfg.dtype)
+    return layernorm(x + ff, lp["ln2_scale"], lp["ln2_bias"])
+
+
+def forward(cfg: BertConfig, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    """tokens (B, S) int32 -> MLM logits (B, S, vocab)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_embed"].astype(cfg.dtype)[:S][None, :, :]
+
+    body = partial(layer_fn, cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_step(x, lp):
+        return body(x, lp), None
+
+    x, _ = jax.lax.scan(scan_step, x, params["layers"])
+    x = layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    x = jax.nn.gelu(x @ params["mlm_dense"].astype(cfg.dtype))
+    # tied decode: reuse the token embedding as the output projection
+    logits = x @ params["embed"].astype(cfg.dtype).T + params["mlm_bias"]
+    return logits.astype(jnp.float32)
+
+
+def mlm_loss_fn(cfg: BertConfig, params, tokens: jax.Array,
+                mask: jax.Array) -> jax.Array:
+    """Masked-LM: predict original tokens at masked positions only.
+    `mask` (B, S) is 1 where the input was replaced by MASK_TOKEN."""
+    masked_in = jnp.where(mask == 1, MASK_TOKEN, tokens)
+    logits = forward(cfg, params, masked_in)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_logp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    return -(tok_logp * mask).sum() / denom
+
+
+def make_train_state(cfg: BertConfig, mesh: Mesh, lr: float = 1e-4,
+                     seed: int = 0) -> Tuple[Dict[str, Any], Any, optax.GradientTransformation]:
+    tx = optax.adamw(lr, weight_decay=0.01)
+    specs = param_specs(cfg)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda s: isinstance(s, P))
+    init = jax.jit(partial(init_params, cfg), out_shardings=shardings)
+    params = init(jax.random.key(seed))
+    opt_state = jax.jit(tx.init)(params)
+    return params, opt_state, tx
+
+
+def make_train_step(cfg: BertConfig, mesh: Mesh, tx: optax.GradientTransformation):
+    from . import sharding as sh
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, mask):
+        tokens = sh.constrain(tokens, P(("dp", "fsdp"), None))
+        mask = sh.constrain(mask, P(("dp", "fsdp"), None))
+        loss, grads = jax.value_and_grad(partial(mlm_loss_fn, cfg))(
+            params, tokens, mask
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def synthetic_batch(cfg: BertConfig, batch: int, seq: int, seed: int = 0,
+                    mask_rate: float = 0.15):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, cfg.vocab, (batch, seq))  # 0 reserved for MASK
+    mask = (rng.random((batch, seq)) < mask_rate).astype(np.int32)
+    mask[:, 0] = 1  # at least one masked position per row
+    return jnp.asarray(tokens, jnp.int32), jnp.asarray(mask, jnp.int32)
+
+
+def train_demo(cfg: Optional[BertConfig] = None, mesh: Optional[Mesh] = None,
+               steps: int = 3, batch: int = 8, seq: int = 32,
+               lr: float = 1e-3) -> float:
+    """A few MLM steps on one synthetic batch; returns final loss (used by
+    the node e2e as a Job container command and by dryrun_multichip)."""
+    from . import sharding as sh
+
+    cfg = cfg or tiny()
+    mesh = mesh or sh.auto_mesh()
+    with jax.set_mesh(mesh):
+        params, opt_state, tx = make_train_state(cfg, mesh, lr=lr)
+        step = make_train_step(cfg, mesh, tx)
+        tokens, mask = synthetic_batch(cfg, batch, seq)
+        loss = None
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens, mask)
+        return float(loss)

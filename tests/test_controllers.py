@@ -25,11 +25,12 @@ from kubernetes1_tpu.utils.waitutil import must_poll_until
 from tests.helpers import make_tpu_pod, mutate_with_retry
 
 
-def start_hollow_node(cs, name, plugin_root, tpus=4, slice_id="s0", host_index=0):
+def start_hollow_node(cs, name, plugin_root, tpus=4, slice_id="s0", host_index=0,
+                      tpu_type="v5e"):
     """Hollow kubelet + its own fake TPU plugin (kubemark pattern)."""
     plugin_dir = f"{plugin_root}/{name}"
     impl = TPUDevicePlugin(
-        devices=_fake_devices(f"v5e:{tpus}:{slice_id}:{host_index}") if tpus else []
+        devices=_fake_devices(f"{tpu_type}:{tpus}:{slice_id}:{host_index}") if tpus else []
     )
     plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
     plugin.start()

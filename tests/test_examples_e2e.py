@@ -1,0 +1,178 @@
+"""BASELINE config e2e: the five example manifests (examples/*.yaml) apply
+through the CLI against a hollow multi-host cluster and produce the
+scheduling outcomes each config claims (ref: the reference validates its
+headline configs through test/e2e/scheduling/nvidia-gpus.go + density)."""
+
+import io
+import os
+
+import pytest
+import yaml
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.cli import CLI
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.controllers import ControllerManager
+from kubernetes1_tpu.deviceplugin.tpu_plugin import ANN_WORKER_ID
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.test_controllers import start_hollow_node
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+@pytest.fixture()
+def big_cluster(tmp_path):
+    """8 v5p hosts on one slice + 2 v5e hosts + 2 CPU-only nodes."""
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=10.0)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=5.0, eviction_timeout=5.0)
+    cm.start()
+    nodes = []
+    for i in range(8):
+        nodes.append(start_hollow_node(
+            cs, f"v5p-host-{i}", str(tmp_path), tpus=4,
+            slice_id="v5p-slice", host_index=i, tpu_type="v5p",
+        ))
+    for i in range(2):
+        nodes.append(start_hollow_node(
+            cs, f"v5e-host-{i}", str(tmp_path), tpus=4,
+            slice_id="v5e-slice", host_index=i,
+        ))
+    for i in range(2):
+        nodes.append(start_hollow_node(cs, f"cpu-{i}", str(tmp_path), tpus=0))
+    env = {"master": master, "cs": cs}
+    yield env
+    for kubelet, plugin, _ in nodes:
+        kubelet.stop()
+        plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def apply_example(master, name):
+    out = io.StringIO()
+    cli = CLI(master.url, "default", out=out)
+    cli.apply(type("A", (), {"filename": os.path.join(EXAMPLES, name)})())
+    cli.cs.close()
+    return out.getvalue()
+
+
+def running(cs, selector):
+    pods, _ = cs.pods.list(namespace="default", label_selector=selector)
+    return [p for p in pods if p.status.phase == t.POD_RUNNING
+            and not p.metadata.deletion_timestamp]
+
+
+class TestGuestbook:
+    def test_cpu_only_deployment_and_service(self, big_cluster):
+        master, cs = big_cluster["master"], big_cluster["cs"]
+        apply_example(master, "guestbook.yaml")
+        must_poll_until(
+            lambda: len(running(cs, "app=guestbook")) == 3,
+            timeout=30.0, desc="3 frontends running",
+        )
+        svc = cs.services.get("guestbook-frontend")
+        assert svc.spec.cluster_ip.startswith("10.96.")
+        must_poll_until(
+            lambda: sum(
+                len(s.addresses)
+                for s in cs.endpoints.get("guestbook-frontend").subsets
+            ) == 3,
+            timeout=20.0, desc="endpoints",
+        )
+        # no TPU chips consumed by a CPU workload
+        for p in running(cs, "app=guestbook"):
+            assert not p.spec.extended_resources
+
+
+class TestMNISTSingleChip:
+    def test_single_chip_job(self, big_cluster):
+        master, cs = big_cluster["master"], big_cluster["cs"]
+        apply_example(master, "mnist-single-chip.yaml")
+        must_poll_until(
+            lambda: len(running(cs, "app=mnist")) == 1,
+            timeout=30.0, desc="mnist pod running",
+        )
+        pod = running(cs, "app=mnist")[0]
+        # ResourceV2 rewrite: raw limit gone, pod-level request present
+        assert "google.com/tpu" not in pod.spec.containers[0].resources.limits
+        assert len(pod.spec.extended_resources) == 1
+        assert pod.spec.extended_resources[0].quantity == 1
+        assert len(pod.spec.extended_resources[0].assigned) == 1
+
+
+class TestResNetV5E4:
+    def test_four_chips_one_host(self, big_cluster):
+        master, cs = big_cluster["master"], big_cluster["cs"]
+        apply_example(master, "resnet50-v5e4.yaml")
+        must_poll_until(
+            lambda: len(running(cs, "app=resnet50")) == 1,
+            timeout=30.0, desc="resnet pod running",
+        )
+        pod = running(cs, "app=resnet50")[0]
+        assigned = pod.spec.extended_resources[0].assigned
+        assert len(assigned) == 4
+        node = cs.nodes.get(pod.spec.node_name, "")
+        node_ids = {d.id for d in node.status.extended_resources["google.com/tpu"]}
+        assert set(assigned) <= node_ids  # all 4 chips on the bound host
+
+
+class TestBertV5P32:
+    def test_gang_on_one_v5p_slice_with_worker_identity(self, big_cluster):
+        master, cs = big_cluster["master"], big_cluster["cs"]
+        apply_example(master, "bert-large-v5p32.yaml")
+        must_poll_until(
+            lambda: len(running(cs, "app=bert-large")) == 8,
+            timeout=60.0, desc="8 bert workers running",
+        )
+        pods = running(cs, "app=bert-large")
+        slices, worker_ids, hosts = set(), set(), set()
+        for p in pods:
+            per = p.spec.extended_resources[0]
+            assert per.quantity == 4 and len(per.assigned) == 4
+            node = cs.nodes.get(p.spec.node_name, "")
+            devs = {d.id: d for d in node.status.extended_resources["google.com/tpu"]}
+            for chip in per.assigned:
+                assert devs[chip].attributes[t.ATTR_TPU_TYPE] == "v5p"
+                slices.add(devs[chip].attributes[t.ATTR_TPU_SLICE])
+            worker_ids.add(p.metadata.annotations[ANN_WORKER_ID])
+            hosts.add(p.spec.node_name)
+        assert slices == {"v5p-slice"}  # affinity + gang slice co-location
+        assert worker_ids == {str(i) for i in range(8)}
+        assert len(hosts) == 8  # 4 chips per host -> one worker per host
+
+
+class TestLlamaPreemptible:
+    def test_elastic_low_priority_gang(self, big_cluster):
+        master, cs = big_cluster["master"], big_cluster["cs"]
+        with open(os.path.join(EXAMPLES, "llama3-8b-v5e256-preemptible.yaml")) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        # scale the 64-worker config to the 2 v5e hosts of this fixture
+        for doc in docs:
+            if doc["kind"] == "Job":
+                doc["spec"]["completions"] = 2
+                doc["spec"]["parallelism"] = 2
+        for doc in docs:
+            obj = cs.scheme.decode(doc)
+            cs.resource(cs.scheme.resource_of[doc["kind"]]).create(obj)
+        must_poll_until(
+            lambda: len(running(cs, "app=llama3-8b")) == 2,
+            timeout=60.0, desc="2 llama workers running",
+        )
+        pods = running(cs, "app=llama3-8b")
+        for p in pods:
+            assert p.spec.priority == -100  # PriorityClass resolved
+            assert p.spec.scheduling_gang  # gang stamped by the Job controller
+            slice_ids = set()
+            node = cs.nodes.get(p.spec.node_name, "")
+            devs = {d.id: d for d in node.status.extended_resources["google.com/tpu"]}
+            for chip in p.spec.extended_resources[0].assigned:
+                slice_ids.add(devs[chip].attributes[t.ATTR_TPU_SLICE])
+            assert slice_ids == {"v5e-slice"}  # affinity kept it off v5p
