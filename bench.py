@@ -1,16 +1,22 @@
-"""End-to-end density benchmark (reference analog: test/e2e/scalability/
-density.go + test/integration/scheduler_perf).
+"""End-to-end benchmark (reference analogs: test/e2e/scalability/density.go,
+test/integration/scheduler_perf, BASELINE.md north-star metrics).
 
-Boots the full framework in-process — HTTP apiserver over the MVCC store,
-device-aware scheduler, and N hollow kubelets (FakeRuntime) each backed by
-a fake 4-chip TPU device plugin over real unix sockets — then creates M
-pods requesting google.com/tpu and measures create->Running latency.
+Three phases, one JSON line on stdout:
 
-Primary metric: pod startup p99 vs the reference's enforced 5s SLO
-(test/e2e/framework/metrics_util.go:46).  vs_baseline = 5.0 / p99, so
->1 means beating the SLO by that factor.
+1. density — full framework in-process (HTTP apiserver over the MVCC store,
+   device-aware scheduler, N hollow kubelets each backed by a fake 4-chip TPU
+   plugin over real unix sockets); M pods requesting google.com/tpu; measures
+   create->Running latency vs the reference's enforced 5s SLO
+   (test/e2e/framework/metrics_util.go:46).
+2. workload — BASELINE.md's primary metric: a ResNet-50 Job scheduled through
+   the FULL stack (admission -> scheduler chip allocation -> kubelet ->
+   ProcessRuntime) whose pod runs workloads/resnet_bench.py on the real TPU
+   chip; reports imgs/sec/chip and model-flops MFU.
+3. gang — chip-allocation efficiency for a v5p-32-shaped gang Job (8 hosts x
+   4 chips on one ICI slice, hollow): all-or-nothing placement must assign
+   every requested chip exactly once (BASELINE target >= 90%).
 
-Prints exactly ONE JSON line on stdout.
+Disable a phase with BENCH_SKIP_WORKLOAD=1 / BENCH_SKIP_GANG=1.
 """
 
 import json
@@ -19,15 +25,22 @@ import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO_ROOT)
 
 NODES = int(os.environ.get("BENCH_NODES", "20"))
 CHIPS_PER_NODE = 4
 # default exactly at chip capacity so every pod can run
 PODS = int(os.environ.get("BENCH_PODS", str(NODES * CHIPS_PER_NODE)))
+WORKLOAD_BATCH = int(os.environ.get("BENCH_WORKLOAD_BATCH", "128"))
+WORKLOAD_STEPS = int(os.environ.get("BENCH_WORKLOAD_STEPS", "20"))
 
 
-def main():
+def _pct(xs, q):
+    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("inf")
+
+
+def bench_density():
     from kubernetes1_tpu.api import types as t
     from kubernetes1_tpu.apiserver import Master
     from kubernetes1_tpu.client import Clientset
@@ -80,7 +93,7 @@ def main():
 
     running_at = {}
     sched_at = {}
-    deadline = time.time() + 120
+    deadline = time.time() + 300
     while len(running_at) < PODS and time.time() < deadline:
         for p in cs.pods.list(namespace="default")[0]:
             nm = p.metadata.name
@@ -97,12 +110,9 @@ def main():
     lat = sorted(running_at[nm] - created[nm] for nm in running_at)
     total_wall = max(running_at.values()) - t0 if running_at else float("inf")
 
-    def pct(xs, q):
-        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("inf")
-
-    p50, p90, p99 = pct(lat, 0.50), pct(lat, 0.90), pct(lat, 0.99)
+    p50, p90, p99 = _pct(lat, 0.50), _pct(lat, 0.90), _pct(lat, 0.99)
     sched_lat = sorted(sched_at[nm] - created[nm] for nm in sched_at)
-    sched_p50 = pct(sched_lat, 0.50)
+    sched_p50 = _pct(sched_lat, 0.50)
 
     # verify every running pod actually got a distinct chip assignment
     assigned = []
@@ -121,20 +131,244 @@ def main():
     cs.close()
     master.stop()
 
+    return {
+        "pods": PODS, "nodes": NODES, "running": n_ok,
+        "pod_startup_p50_s": round(p50, 4),
+        "pod_startup_p90_s": round(p90, 4),
+        "pod_startup_p99_s": round(p99, 4),
+        "chip_alloc_p50_s": round(sched_p50, 4),
+        "pods_per_sec": round(n_ok / total_wall, 1) if total_wall else 0,
+        "distinct_chips_assigned": distinct,
+    }
+
+
+def bench_workload():
+    """ResNet-50 on the real chip via a scheduled Job (ProcessRuntime)."""
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.controllers import ControllerManager
+    from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+    from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+    from kubernetes1_tpu.kubelet import Kubelet, ProcessRuntime
+
+    tmp = tempfile.mkdtemp(prefix="ktpu-bench-wl-")
+    out_path = os.path.join(tmp, "result.json")
+    master = Master().start()
+    cs = Clientset(master.url)
+    from kubernetes1_tpu.scheduler import Scheduler
+
+    sched = Scheduler(cs)
+    sched.start()
+    cm = ControllerManager(cs)
+    cm.start()
+
+    plugin_dir = os.path.join(tmp, "plugin")
+    impl = TPUDevicePlugin(devices=_fake_devices("v5e:1:local:0"))
+    plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+    plugin.start()
+    kcs = Clientset(master.url)
+    kl = Kubelet(kcs, node_name="tpu-host", runtime=ProcessRuntime(root_dir=tmp),
+                 plugin_dir=plugin_dir, heartbeat_interval=2.0,
+                 sync_interval=0.5, pleg_interval=0.5)
+    kl.start()
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        nodes, _ = cs.nodes.list()
+        if nodes and nodes[0].status.extended_resources.get("google.com/tpu"):
+            break
+        time.sleep(0.2)
+
+    job = t.Job()
+    job.metadata.name = "resnet50-bench"
+    c = t.Container(
+        name="train",
+        image="jax-workload",
+        command=[sys.executable, "-m", "kubernetes1_tpu.workloads.resnet_bench",
+                 "--out", out_path, "--batch", str(WORKLOAD_BATCH),
+                 "--steps", str(WORKLOAD_STEPS)],
+        # prepend, don't replace: the image's PYTHONPATH may carry the TPU
+        # platform sitecustomize hook
+        env=[t.EnvVar(name="PYTHONPATH",
+                      value=os.pathsep.join(
+                          p for p in [REPO_ROOT, os.environ.get("PYTHONPATH", "")]
+                          if p))],
+    )
+    c.resources.limits = {"google.com/tpu": 1}
+    job.spec.template.spec.containers = [c]
+    job.spec.template.spec.restart_policy = "Never"
+    job.spec.completions = 1
+    job.spec.parallelism = 1
+    job.spec.backoff_limit = 0  # first crash is terminal: fail fast, not 900s
+
+    t0 = time.perf_counter()
+    cs.jobs.create(job)
+    alloc_at = run_at = None
+    result = None
+    deadline = time.time() + 900
+    while time.time() < deadline:
+        pods, _ = cs.pods.list(namespace="default",
+                               label_selector="batch.ktpu.io/job-name=resnet50-bench")
+        for p in pods:
+            if alloc_at is None and p.spec.node_name:
+                alloc_at = time.perf_counter()
+            if run_at is None and p.status.phase == t.POD_RUNNING:
+                run_at = time.perf_counter()
+        j = cs.jobs.get("resnet50-bench")
+        if j.status.succeeded >= 1:
+            break
+        if any(c.type == "Failed" and c.status == "True"
+               for c in j.status.conditions):
+            break
+        time.sleep(0.5)
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            result = json.load(f)
+
+    kl.stop()
+    plugin.stop()
+    cm.stop()
+    sched.stop()
+    kcs.close()
+    cs.close()
+    master.stop()
+
+    out = {"chip_alloc_s": round(alloc_at - t0, 3) if alloc_at else None,
+           "pod_start_s": round(run_at - t0, 3) if run_at else None}
+    if result:
+        out.update(result)
+    else:
+        out["error"] = "workload pod produced no result"
+    return out
+
+
+def bench_gang():
+    """v5p-32-shaped gang Job on hollow nodes: 8 hosts x 4 chips, one slice.
+    Efficiency = distinct chips assigned / chips requested (target >= 0.9)."""
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.controllers import ControllerManager
+    from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+    from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+    from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+    from kubernetes1_tpu.scheduler import Scheduler
+
+    HOSTS, CHIPS = 8, 4
+    tmp = tempfile.mkdtemp(prefix="ktpu-bench-gang-")
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=10.0)
+    sched.start()
+    cm = ControllerManager(cs)
+    cm.start()
+
+    kubelets, plugins, clients = [], [], []
+    for i in range(HOSTS):
+        plugin_dir = os.path.join(tmp, f"host-{i}")
+        impl = TPUDevicePlugin(
+            devices=_fake_devices(f"v5p:{CHIPS}:podslice:{i}"))
+        plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+        plugin.start()
+        plugins.append(plugin)
+        kcs = Clientset(master.url)
+        clients.append(kcs)
+        kl = Kubelet(kcs, node_name=f"v5p-host-{i}", runtime=FakeRuntime(),
+                     plugin_dir=plugin_dir, heartbeat_interval=2.0,
+                     sync_interval=0.2, pleg_interval=0.2)
+        kl.start()
+        kubelets.append(kl)
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        nodes, _ = cs.nodes.list()
+        ready = [n for n in nodes
+                 if n.status.extended_resources.get("google.com/tpu")]
+        if len(ready) == HOSTS:
+            break
+        time.sleep(0.2)
+
+    job = t.Job()
+    job.metadata.name = "llama-gang"
+    c = t.Container(name="worker", image="jax-train", command=["sleep", "600"])
+    c.resources.limits = {"google.com/tpu": CHIPS}
+    job.spec.template.spec.containers = [c]
+    job.spec.completions = HOSTS
+    job.spec.parallelism = HOSTS
+    job.spec.completion_mode = "Indexed"
+    job.spec.gang_scheduling = True
+
+    t0 = time.perf_counter()
+    cs.jobs.create(job)
+    bound_at = None
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        pods, _ = cs.pods.list(namespace="default",
+                               label_selector="batch.ktpu.io/job-name=llama-gang")
+        bound = [p for p in pods if p.spec.node_name]
+        if len(bound) == HOSTS:
+            bound_at = time.perf_counter()
+            break
+        time.sleep(0.1)
+
+    assigned, slices = [], set()
+    pods, _ = cs.pods.list(namespace="default",
+                           label_selector="batch.ktpu.io/job-name=llama-gang")
+    node_names = set()
+    for p in pods:
+        node_names.add(p.spec.node_name)
+        for er in p.spec.extended_resources:
+            assigned.extend(er.assigned)
+    requested = HOSTS * CHIPS
+    efficiency = len(set(assigned)) / requested if requested else 0.0
+
+    for kl in kubelets:
+        kl.stop()
+    for pl in plugins:
+        pl.stop()
+    cm.stop()
+    sched.stop()
+    for c_ in clients:
+        c_.close()
+    cs.close()
+    master.stop()
+
+    return {
+        "gang_hosts": HOSTS, "chips_per_host": CHIPS,
+        "chips_requested": requested,
+        "chips_assigned_distinct": len(set(assigned)),
+        "chip_alloc_efficiency": round(efficiency, 3),
+        "gang_bind_s": round(bound_at - t0, 3) if bound_at else None,
+        "distinct_hosts": len(node_names - {""}),
+    }
+
+
+def main():
+    extras = {"baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46); "
+                          "north-star imgs/sec/chip + MFU (BASELINE.md)"}
+    density = bench_density()
+    extras.update(density)
+
+    if os.environ.get("BENCH_SKIP_GANG", "") != "1":
+        try:
+            extras["gang"] = bench_gang()
+        except Exception as e:  # noqa: BLE001
+            extras["gang"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if os.environ.get("BENCH_SKIP_WORKLOAD", "") != "1":
+        try:
+            extras["workload"] = bench_workload()
+        except Exception as e:  # noqa: BLE001
+            extras["workload"] = {"error": f"{type(e).__name__}: {e}"}
+
+    p99 = extras["pod_startup_p99_s"]
     result = {
         "metric": "pod_startup_p99_s",
-        "value": round(p99, 4),
+        "value": p99,
         "unit": "s",
         "vs_baseline": round(5.0 / p99, 2) if p99 > 0 else None,
-        "extra": {
-            "pods": PODS, "nodes": NODES, "running": n_ok,
-            "pod_startup_p50_s": round(p50, 4),
-            "pod_startup_p90_s": round(p90, 4),
-            "chip_alloc_p50_s": round(sched_p50, 4),
-            "pods_per_sec": round(n_ok / total_wall, 1) if total_wall else 0,
-            "distinct_chips_assigned": distinct,
-            "baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46)",
-        },
+        "extra": extras,
     }
     print(json.dumps(result))
 

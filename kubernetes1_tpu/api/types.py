@@ -53,6 +53,10 @@ NOMINATED_NODE_ANNOTATION = "scheduler.ktpu.io/nominated-node"
 # Job completion index annotation+env (reference gap; needed for TPU worker id)
 COMPLETION_INDEX_ANNOTATION = "batch.ktpu.io/completion-index"
 JOB_NAME_LABEL = "batch.ktpu.io/job-name"
+# Mirror pods: static-manifest pods the kubelet itself publishes to the
+# apiserver (ref: kubetypes.ConfigMirrorAnnotationKey). NodeRestriction
+# admission only lets a node credential create pods carrying this marker.
+STATIC_POD_ANNOTATION = "kubelet.ktpu.io/static"
 
 # --------------------------------------------------------------- shared bits
 

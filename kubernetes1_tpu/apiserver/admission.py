@@ -120,9 +120,66 @@ class GangDefaulter(AdmissionPlugin):
             raise Invalid("scheduling_gang requires gang_size > 0")
 
 
+class NodeRestriction(AdmissionPlugin):
+    """Limits what a node credential (system:node:<name>) may write
+    (ref: plugin/pkg/admission/noderestriction/admission.go:48,159-164).
+    The NodeAuthorizer alone is not enough: its mirror-pod allowance lets a
+    node create pods, and an unconstrained node-created pod bound to itself
+    would make _pod_references grant the node GET on any secret/configmap/PVC
+    it names — a one-step escalation to all cluster secrets. The reference
+    closes this exact hole by pairing the node authorizer with this plugin."""
+
+    name = "NodeRestriction"
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if user is None or not user.name.startswith("system:node:"):
+            return
+        node_name = user.name[len("system:node:"):]
+        if resource == "nodes":
+            target = obj.metadata.name
+            if target and target != node_name:
+                raise Forbidden(
+                    f"node {node_name!r} may only modify its own Node object"
+                )
+        if resource != "pods":
+            return
+        if operation == CREATE:
+            if obj.spec.node_name != node_name:
+                raise Forbidden(
+                    f"node {node_name!r} may only create mirror pods bound to itself"
+                )
+            if obj.metadata.annotations.get(t.STATIC_POD_ANNOTATION) != "true":
+                raise Forbidden(
+                    f"node {node_name!r} may only create mirror (static) pods"
+                )
+            self._check_pod_refs(obj)
+        elif operation == UPDATE and old is not None:
+            if old.spec.node_name != node_name:
+                raise Forbidden(
+                    f"node {node_name!r} may only update pods bound to itself"
+                )
+            # content checks apply to updates too — otherwise create-clean
+            # then PATCH-in-a-secret-volume re-opens the escalation
+            self._check_pod_refs(obj)
+
+    @staticmethod
+    def _check_pod_refs(obj):
+        for vol in obj.spec.volumes:
+            if vol.secret is not None or vol.config_map is not None \
+                    or vol.persistent_volume_claim is not None:
+                raise Forbidden(
+                    "node-written pods may not reference secrets, configmaps "
+                    "or persistentvolumeclaims"
+                )
+        if obj.spec.service_account_name and obj.spec.service_account_name != "default":
+            raise Forbidden("node-written pods may not use a service account")
+
+
 class LimitRanger(AdmissionPlugin):
     """Applies LimitRange defaults and enforces min/max per container
-    (ref: plugin/pkg/admission/limitranger/admission.go)."""
+    (ref: plugin/pkg/admission/limitranger/admission.go). Runs on UPDATE too —
+    the reference admits updates/patches through the same chain, so a merge
+    patch cannot raise resources past the LimitRange max."""
 
     name = "LimitRanger"
 
@@ -130,28 +187,47 @@ class LimitRanger(AdmissionPlugin):
         self._list = list_limit_ranges  # (namespace) -> [LimitRange]
 
     def admit(self, operation: str, resource: str, obj, old=None, user=None):
-        if resource != "pods" or operation != CREATE:
+        if resource != "pods" or operation not in (CREATE, UPDATE):
             return
         from ..utils.quantity import parse_quantity
+
+        # On UPDATE only values the write actually changed are judged — a
+        # LimitRange created after a pod must not make that pod unpatchable
+        # (metadata-only patches would otherwise re-judge the old spec), and
+        # defaults are applied only at create.
+        old_limits: dict = {}
+        old_requests: dict = {}
+        if operation == UPDATE and old is not None:
+            for oc in old.spec.containers:
+                old_limits[oc.name] = dict(oc.resources.limits or {})
+                old_requests[oc.name] = dict(oc.resources.requests or {})
+
+        def changed(c_name, res, val, old_map):
+            return old_map.get(c_name, {}).get(res) != val
 
         for lr in self._list(obj.metadata.namespace):
             for item in lr.spec.limits:
                 if item.type != "Container":
                     continue
                 for c in obj.spec.containers:
-                    for res, val in item.default.items():
-                        c.resources.limits.setdefault(res, val)
-                    for res, val in item.default_request.items():
-                        c.resources.requests.setdefault(res, val)
+                    if operation == CREATE:
+                        for res, val in item.default.items():
+                            c.resources.limits.setdefault(res, val)
+                        for res, val in item.default_request.items():
+                            c.resources.requests.setdefault(res, val)
                     for res, val in item.max.items():
                         have = c.resources.limits.get(res)
-                        if have is not None and parse_quantity(have) > parse_quantity(val):
+                        if have is None or parse_quantity(have) <= parse_quantity(val):
+                            continue
+                        if operation == CREATE or changed(c.name, res, have, old_limits):
                             raise Forbidden(
                                 f"container {c.name}: {res} limit {have} exceeds LimitRange max {val}"
                             )
                     for res, val in item.min.items():
                         have = c.resources.requests.get(res)
-                        if have is not None and parse_quantity(have) < parse_quantity(val):
+                        if have is None or parse_quantity(have) >= parse_quantity(val):
+                            continue
+                        if operation == CREATE or changed(c.name, res, have, old_requests):
                             raise Forbidden(
                                 f"container {c.name}: {res} request {have} below LimitRange min {val}"
                             )
@@ -173,7 +249,7 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         self._usage = usage_fn         # (namespace) -> {resource: float}
 
     def admit(self, operation: str, resource: str, obj, old=None, user=None):
-        if operation != CREATE or resource not in self.COUNTED:
+        if operation not in (CREATE, UPDATE) or resource not in self.COUNTED:
             return
         ns = obj.metadata.namespace
         quotas = self._list(ns)
@@ -182,11 +258,17 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         from ..utils.quantity import parse_quantity
 
         delta = compute_object_usage(resource, obj)
+        if operation == UPDATE and old is not None:
+            # updates are charged only for the increase over the old object
+            for res, val in compute_object_usage(resource, old).items():
+                delta[res] = delta.get(res, 0.0) - val
+        # live usage counts the old object on UPDATE, so used + (new-old)
+        # is the correct post-write total in both operations
         used = self._usage(ns)
         for q in quotas:
             for res, hard in q.spec.hard.items():
                 inc = delta.get(res, 0.0)
-                if not inc:
+                if inc <= 0:
                     continue
                 if used.get(res, 0.0) + inc > parse_quantity(hard):
                     raise Forbidden(

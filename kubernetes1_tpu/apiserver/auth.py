@@ -61,10 +61,15 @@ class StaticTokenAuthenticator:
 
 
 class ServiceAccountAuthenticator:
-    """Verifies HMAC SA tokens minted by the token controller."""
+    """Verifies HMAC SA tokens minted by the token controller. A valid
+    signature alone is not enough: the backing ServiceAccount must still
+    exist and carry the token's uid (the reference's token authenticator
+    re-validates the SA and secret, so deleting or recreating a
+    ServiceAccount revokes previously issued credentials)."""
 
-    def __init__(self, signing_key: str):
+    def __init__(self, signing_key: str, get_serviceaccount=None):
         self.signing_key = signing_key
+        self._get_sa = get_serviceaccount  # (namespace, name) -> SA | None
 
     def authenticate(self, token: str) -> Optional[UserInfo]:
         from ..controllers.serviceaccount import verify_token
@@ -76,6 +81,12 @@ class ServiceAccountAuthenticator:
         if not sub.startswith("system:serviceaccount:"):
             return None
         _, _, ns, _name = sub.split(":", 3)
+        if self._get_sa is not None:
+            sa = self._get_sa(ns, _name)
+            if sa is None:
+                return None
+            if claims.get("uid") and sa.metadata.uid != claims["uid"]:
+                return None  # SA was deleted and recreated; old tokens die
         return UserInfo(
             name=sub,
             groups=[

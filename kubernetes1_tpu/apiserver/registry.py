@@ -189,6 +189,25 @@ class ServiceStrategy(Strategy):
             raise Forbidden("spec.clusterIP is immutable")
 
 
+class CSRStrategy(Strategy):
+    """CSR spec and the server-stamped creator identity are immutable after
+    create (ref: pkg/registry/certificates — spec is immutable on update).
+    Without this, any principal with update/patch on CSRs could rewrite
+    spec.username or the created-by annotation and have the auto-approver
+    mint a credential for another node's identity."""
+
+    def prepare_for_update(self, new, old):
+        super().prepare_for_update(new, old)
+        new.spec = old.spec
+        from .admission import CREATED_BY_ANNOTATION, CREATED_BY_GROUPS_ANNOTATION
+
+        for ann in (CREATED_BY_ANNOTATION, CREATED_BY_GROUPS_ANNOTATION):
+            if ann in old.metadata.annotations:
+                new.metadata.annotations[ann] = old.metadata.annotations[ann]
+            else:
+                new.metadata.annotations.pop(ann, None)
+
+
 class CronJobStrategy(Strategy):
     def validate(self, obj):
         super().validate(obj)
@@ -216,6 +235,7 @@ def strategy_for(resource: str) -> Strategy:
             "statefulsets": StatefulSetStrategy,
             "cronjobs": CronJobStrategy,
             "services": ServiceStrategy,
+            "certificatesigningrequests": CSRStrategy,
         }.get(resource, Strategy)()
     return _STRATEGIES[resource]
 
@@ -276,9 +296,33 @@ class Registry:
         ):
             raise Invalid(f"kind {names.kind!r} shadows a built-in kind")
 
+    def _validate_apiservice(self, obj):
+        """An APIService claiming a (group, version) the scheme already
+        serves would hijack built-in (or CRD) routing: the aggregation index
+        is consulted before built-in dispatch. Upstream protects built-in
+        groups with local APIService objects; here we reject the shadow."""
+        group, version = obj.spec.group, obj.spec.version
+        if not group or not version:
+            raise Invalid("APIService requires spec.group and spec.version")
+        served = set()
+        for cls in self.scheme.by_kind.values():
+            av = getattr(cls, "API_VERSION", "")
+            if "/" in av:
+                served.add(tuple(av.split("/", 1)))
+        for av in self.scheme.dynamic_kinds.values():
+            if "/" in av:
+                served.add(tuple(av.split("/", 1)))
+        if (group, version) in served:
+            raise Invalid(
+                f"APIService group/version {group}/{version} shadows an API "
+                "served by this apiserver"
+            )
+
     def create(self, resource: str, namespace: str, obj):
         if resource == "customresourcedefinitions":
             self._validate_crd_names(obj)
+        if resource == "apiservices":
+            self._validate_apiservice(obj)
         if self.scheme.namespaced.get(resource, True):
             obj.metadata.namespace = namespace or obj.metadata.namespace or "default"
         else:
@@ -373,6 +417,8 @@ class Registry:
             # built-in plural/kind would brick that resource; the old CRD's
             # own names are dynamic, so they don't false-positive here
             self._validate_crd_names(obj)
+        if resource == "apiservices":
+            self._validate_apiservice(obj)
         strat.prepare_for_update(obj, old)
         if obj.metadata.generation or old.metadata.generation:
             if to_dict(getattr(obj, "spec", None)) != to_dict(getattr(old, "spec", None)):
@@ -403,8 +449,11 @@ class Registry:
 
         return self.store.guaranteed_update(key, apply)
 
-    def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]):
-        """RFC 7386 JSON merge patch via GuaranteedUpdate."""
+    def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any],
+              admit: Optional[Callable[[Any, Any], Any]] = None):
+        """RFC 7386 JSON merge patch via GuaranteedUpdate. `admit` runs the
+        server's admission chain on the merged object (the reference admits
+        patches through the same chain as updates)."""
         key = self.key(resource, namespace, name)
 
         def apply(cur):
@@ -413,12 +462,16 @@ class Registry:
             # map to Unstructured, which only scheme.decode reconstructs
             obj = self.scheme.decode(merged)
             obj.metadata.resource_version = cur.metadata.resource_version
+            if admit is not None:
+                obj = admit(obj, cur) or obj
             strat = strategy_for(resource)
             strat.prepare_for_update(obj, cur)
             if resource == "services":
                 self._allocate_service_fields(obj, old=cur)
             if resource == "customresourcedefinitions":
                 self._validate_crd_names(obj)
+            if resource == "apiservices":
+                self._validate_apiservice(obj)
             strat.validate(obj)  # a patch must not persist an invalid object
             return obj
 

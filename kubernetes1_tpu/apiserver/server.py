@@ -37,6 +37,7 @@ from .admission import (
     IdentityStamp,
     LimitRanger,
     NamespaceAutoProvision,
+    NodeRestriction,
     PriorityResolver,
     ResourceQuotaAdmission,
     ResourceV2,
@@ -431,8 +432,22 @@ class _Handler(BaseHTTPRequestHandler):
             raise NotFound(f"subresource {sub!r} not writable")
         else:
             old = reg.get(resource, ns, name)
-            obj = self.master.admission.admit(UPDATE, resource, obj, old, user=self._user)
-            updated = reg.update(resource, ns, name, obj)
+            # same TOCTOU serialization as POST/PATCH: quota admission on
+            # UPDATE computes usage from the store, so concurrent writes to a
+            # nearly-exhausted quota must not both pass
+            if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
+                ns or old.metadata.namespace or "default"
+            ):
+                with self.master.quota_lock:
+                    obj = self.master.admission.admit(
+                        UPDATE, resource, obj, old, user=self._user
+                    )
+                    updated = reg.update(resource, ns, name, obj)
+            else:
+                obj = self.master.admission.admit(
+                    UPDATE, resource, obj, old, user=self._user
+                )
+                updated = reg.update(resource, ns, name, obj)
             if resource == "customresourcedefinitions":
                 self.master.remove_crd(old)
                 self.master.apply_crd(updated)
@@ -451,7 +466,21 @@ class _Handler(BaseHTTPRequestHandler):
         old = None
         if resource in ("customresourcedefinitions", "apiservices"):
             old = self.master.registry.get(resource, ns, name)
-        updated = self.master.registry.patch(resource, ns, name, patch)
+        # the admission chain runs on the merged object exactly as on PUT —
+        # a patch must not bypass LimitRange/quota/NodeRestriction (the
+        # reference admits updates and patches through the same chain)
+        admit = lambda merged, cur: self.master.admission.admit(  # noqa: E731
+            UPDATE, resource, merged, cur, user=self._user
+        )
+        if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
+            ns or "default"
+        ):
+            with self.master.quota_lock:
+                updated = self.master.registry.patch(
+                    resource, ns, name, patch, admit=admit
+                )
+        else:
+            updated = self.master.registry.patch(resource, ns, name, patch, admit=admit)
         if resource == "customresourcedefinitions":
             self.master.remove_crd(old)
             self.master.apply_crd(updated)
@@ -543,7 +572,9 @@ class Master:
         self.authenticators = AuthenticatorChain(
             [
                 StaticTokenAuthenticator(tokens),
-                ServiceAccountAuthenticator(sa_signing_key),
+                ServiceAccountAuthenticator(
+                    sa_signing_key, get_serviceaccount=self._get_serviceaccount
+                ),
                 CertificateAuthenticator(ca_key),
             ]
         )
@@ -565,6 +596,7 @@ class Master:
         self.admission = AdmissionChain(
             [
                 NamespaceAutoProvision(self.registry.ensure_namespace),
+                NodeRestriction(),  # before SA defaulting: checks the raw spec
                 PriorityResolver(self._get_priority_class),
                 ResourceV2(),
                 GangDefaulter(),
@@ -597,6 +629,13 @@ class Master:
         return compute_namespace_usage(
             lambda resource, ns: self.store.list(self.registry.prefix(resource, ns))[0],
             namespace,
+        )
+
+    def _get_serviceaccount(self, namespace: str, name: str):
+        if not namespace or not name:
+            return None
+        return self.store.get_or_none(
+            self.registry.key("serviceaccounts", namespace, name)
         )
 
     def _get_pod_or_none(self, namespace: str, name: str):

@@ -380,7 +380,7 @@ class Kubelet:
                     data = yaml.safe_load(f) if fname.endswith((".yaml", ".yml")) else json.load(f)
                 pod = global_scheme.decode(data)
                 pod.spec.node_name = self.node_name
-                pod.metadata.annotations["kubelet.ktpu.io/static"] = "true"
+                pod.metadata.annotations[t.STATIC_POD_ANNOTATION] = "true"
                 try:
                     self.cs.pods.create(pod)
                 except ApiError:

@@ -1,0 +1,136 @@
+"""ResNet-50 training benchmark payload — runs INSIDE a scheduled pod.
+
+This is the measured half of BASELINE.md's north-star metric ("JAX ResNet-50
+imgs/sec/chip in a scheduled Job", ref test/e2e/scalability/density.go
+pattern): bench.py submits a Job whose container command is
+
+    python -m kubernetes1_tpu.workloads.resnet_bench --out <file>
+
+so the number on the board is produced by the full stack — admission rewrote
+the google.com/tpu limit, the scheduler picked the chip, the kubelet's
+ProcessRuntime launched this process with the device plugin's injected env —
+not by a bare script.
+
+Reports imgs/sec (total and per chip) and model-flops MFU: FLOPs per step
+come from XLA's own cost analysis of the compiled step (analytic fallback),
+peak from the device kind.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# bf16 peak TFLOP/s per chip by device kind (public cloud.google.com/tpu docs).
+PEAK_FLOPS = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.5e12,  # per chip (2 cores)
+    "TPU v4": 137.5e12,  # 275 per dual-chip package / 2
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 229.5e12,
+    "TPU v5p": 229.5e12,
+    "TPU v6 lite": 459e12,  # trillium
+    "TPU v6e": 459e12,
+    "TPU7x": 2307e12,
+}
+
+# Analytic fallback: ResNet-50 forward ≈ 4.1 GFLOP/img at 224x224 (counting
+# a MAC as 2 FLOPs); a training step costs ~3x forward (fwd + 2x bwd).
+RESNET50_TRAIN_FLOPS_PER_IMG_224 = 3 * 4.1e9
+
+
+def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from . import sharding as sh
+    from .resnet import ResNetConfig, init_params, make_train_step
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    cfg = ResNetConfig()
+    mesh = sh.auto_mesh()
+    with jax.set_mesh(mesh):
+        params = init_params(cfg, jax.random.key(0))
+        tx = optax.sgd(0.1, momentum=0.9)
+        opt_state = jax.jit(tx.init)(params)
+        step = make_train_step(cfg, tx)
+        rng = np.random.default_rng(0)
+        images = jnp.asarray(rng.normal(size=(batch, size, size, 3)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, cfg.num_classes, batch), jnp.int32)
+
+        flops_per_step = None
+        try:
+            cost = step.lower(params, opt_state, images, labels).compile().cost_analysis()
+            if cost and cost.get("flops"):
+                flops_per_step = float(cost["flops"])
+        except Exception:  # noqa: BLE001
+            pass
+        if not flops_per_step:
+            flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG_224 * batch * (size / 224.0) ** 2
+
+        t_compile0 = time.perf_counter()
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        compile_s = time.perf_counter() - t_compile0
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        wall = time.perf_counter() - t0
+
+    kind = devices[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 0.0)
+    steps_per_sec = steps / wall
+    imgs_per_sec = batch * steps_per_sec
+    mfu = (flops_per_step * steps_per_sec / (peak * n_dev)) if peak else None
+    return {
+        "workload": "resnet50",
+        "device_kind": kind,
+        "platform": devices[0].platform,
+        "n_devices": n_dev,
+        "batch": batch,
+        "image_size": size,
+        "steps": steps,
+        "compile_s": round(compile_s, 2),
+        "step_time_ms": round(1000 * wall / steps, 2),
+        "imgs_per_sec": round(imgs_per_sec, 1),
+        "imgs_per_sec_per_chip": round(imgs_per_sec / n_dev, 1),
+        "flops_per_step": flops_per_step,
+        "peak_flops_per_chip": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "final_loss": float(loss),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="", help="write result JSON here")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--size", type=int, default=224)
+    args = ap.parse_args(argv)
+    try:
+        result = run(args.batch, args.steps, args.size)
+    except Exception as e:  # noqa: BLE001
+        result = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(result), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f)
+        sys.exit(1)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

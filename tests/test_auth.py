@@ -59,12 +59,42 @@ class TestAuthn:
 
     def test_service_account_token_authenticates(self, rbac_master):
         master, _ = rbac_master
-        sa_token = sign_token("ktpu-sa-key", "default", "builder", "uid-1")
+        acs = admin(master)
+        sa = t.ServiceAccount()
+        sa.metadata.name = "builder"
+        sa = acs.serviceaccounts.create(sa, "default")
+        sa_token = sign_token("ktpu-sa-key", "default", "builder", sa.metadata.uid)
         cs = Clientset(master.url, token=sa_token)
         # authenticated, but no binding yet -> 403 mentioning the SA username
         with pytest.raises(Forbidden, match="system:serviceaccount:default:builder"):
             cs.pods.list()
         cs.close()
+        acs.close()
+
+    def test_deleted_service_account_token_is_revoked(self, rbac_master):
+        """ADVICE r1: a signed token must die with its ServiceAccount — the
+        authenticator re-validates existence and uid, so delete/recreate
+        revokes previously issued credentials."""
+        master, _ = rbac_master
+        acs = admin(master)
+        sa = t.ServiceAccount()
+        sa.metadata.name = "worker"
+        sa = acs.serviceaccounts.create(sa, "default")
+        token = sign_token("ktpu-sa-key", "default", "worker", sa.metadata.uid)
+        cs = Clientset(master.url, token=token)
+        with pytest.raises(Forbidden):  # authenticates; RBAC denies
+            cs.pods.list()
+        acs.serviceaccounts.delete("worker", "default")
+        with pytest.raises(Unauthorized):  # token no longer authenticates
+            cs.pods.list()
+        # recreating the SA mints a new uid; the old token stays dead
+        sa2 = t.ServiceAccount()
+        sa2.metadata.name = "worker"
+        acs.serviceaccounts.create(sa2, "default")
+        with pytest.raises(Unauthorized):
+            cs.pods.list()
+        cs.close()
+        acs.close()
 
     def test_certificate_credential_authenticates(self, rbac_master):
         master, _ = rbac_master
@@ -195,6 +225,130 @@ class TestNodeAuthorizer:
         with pytest.raises(Forbidden):
             n1.pods.update_status(q)
         n1.close()
+        acs.close()
+
+
+class TestNodeRestriction:
+    """ADVICE r1 (high): the node authorizer's mirror-pod allowance must be
+    paired with NodeRestriction admission (ref: plugin/pkg/admission/
+    noderestriction/admission.go:159-164) or a compromised kubelet can create
+    a pod that mounts any secret and then read it via _pod_references."""
+
+    def _node_cs(self, master, node):
+        cert = issue_certificate(
+            "ktpu-ca-key", f"system:node:{node}", "req", groups=["system:nodes"]
+        )
+        return Clientset(master.url, token=cert)
+
+    def test_node_cannot_create_secret_mounting_pod(self, rbac_master):
+        master, _ = rbac_master
+        acs = admin(master)
+        s = t.Secret(data={"k": "top-secret"})
+        s.metadata.name = "cluster-secret"
+        acs.secrets.create(s)
+
+        n1 = self._node_cs(master, "n1")
+        evil = simple_pod("evil", node="n1")
+        evil.metadata.annotations[t.STATIC_POD_ANNOTATION] = "true"
+        evil.spec.volumes = [
+            t.Volume(name="v",
+                     secret=t.SecretVolumeSource(secret_name="cluster-secret"))
+        ]
+        with pytest.raises(Forbidden, match="may not reference secrets"):
+            n1.pods.create(evil)
+        # ...and therefore the secret stays unreadable
+        with pytest.raises(Forbidden):
+            n1.secrets.get("cluster-secret")
+        n1.close()
+        acs.close()
+
+    def test_node_can_only_create_mirror_pods_bound_to_itself(self, rbac_master):
+        master, _ = rbac_master
+        n1 = self._node_cs(master, "n1")
+        plain = simple_pod("not-mirror", node="n1")
+        with pytest.raises(Forbidden, match="mirror"):
+            n1.pods.create(plain)
+
+        foreign = simple_pod("foreign", node="n2")
+        foreign.metadata.annotations[t.STATIC_POD_ANNOTATION] = "true"
+        with pytest.raises(Forbidden, match="bound to itself"):
+            n1.pods.create(foreign)
+
+        ok = simple_pod("mirror-ok", node="n1")
+        ok.metadata.annotations[t.STATIC_POD_ANNOTATION] = "true"
+        created = n1.pods.create(ok)
+        assert created.spec.node_name == "n1"
+        n1.close()
+
+    def test_node_cannot_patch_secret_volume_into_own_pod(self, rbac_master):
+        """Create-clean-then-patch-in-a-secret must not re-open the
+        escalation: content checks run on UPDATE/PATCH too."""
+        master, _ = rbac_master
+        acs = admin(master)
+        s = t.Secret(data={"k": "v"})
+        s.metadata.name = "cluster-secret"
+        acs.secrets.create(s)
+
+        n1 = self._node_cs(master, "n1")
+        clean = simple_pod("clean-mirror", node="n1")
+        clean.metadata.annotations[t.STATIC_POD_ANNOTATION] = "true"
+        n1.pods.create(clean)
+        with pytest.raises(Forbidden, match="may not reference"):
+            n1.pods.patch(
+                "clean-mirror",
+                {"spec": {"volumes": [
+                    {"name": "v", "secret": {"secretName": "cluster-secret"}}
+                ]}},
+            )
+        with pytest.raises(Forbidden):
+            n1.secrets.get("cluster-secret")
+        n1.close()
+        acs.close()
+
+    def test_node_cannot_create_other_node_object(self, rbac_master):
+        master, _ = rbac_master
+        n1 = self._node_cs(master, "n1")
+        other = t.Node()
+        other.metadata.name = "n2"
+        with pytest.raises(Forbidden, match="its own Node"):
+            n1.nodes.create(other)
+        mine = t.Node()
+        mine.metadata.name = "n1"
+        n1.nodes.create(mine)  # self-registration stays allowed
+        n1.close()
+
+
+class TestCSRImmutability:
+    def test_csr_spec_and_creator_identity_frozen_after_create(self, rbac_master):
+        """ADVICE r1: spec.username and the IdentityStamp annotations must be
+        immutable after create, else update/patch rewrites them and the
+        auto-approver mints a credential for a foreign node identity."""
+        from kubernetes1_tpu.apiserver.admission import CREATED_BY_ANNOTATION
+
+        master, _ = rbac_master
+        acs = admin(master)
+        csr = t.CertificateSigningRequest()
+        csr.metadata.name = "frozen"
+        csr.spec.request = "r"
+        csr.spec.username = "system:node:n1"
+        csr.spec.groups = ["system:nodes"]
+        created = acs.certificatesigningrequests.create(csr)
+        assert created.metadata.annotations[CREATED_BY_ANNOTATION] == "system:admin"
+
+        created.spec.username = "system:node:other"
+        created.metadata.annotations[CREATED_BY_ANNOTATION] = "system:node:other"
+        updated = acs.certificatesigningrequests.update(created)
+        assert updated.spec.username == "system:node:n1"
+        assert updated.metadata.annotations[CREATED_BY_ANNOTATION] == "system:admin"
+
+        patched = acs.certificatesigningrequests.patch(
+            "frozen",
+            {"spec": {"username": "system:node:other"},
+             "metadata": {"annotations": {CREATED_BY_ANNOTATION: "hacker"}}},
+            namespace="",
+        )
+        assert patched.spec.username == "system:node:n1"
+        assert patched.metadata.annotations[CREATED_BY_ANNOTATION] == "system:admin"
         acs.close()
 
 

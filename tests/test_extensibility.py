@@ -159,6 +159,28 @@ class _EchoAPIHandler(BaseHTTPRequestHandler):
         self.wfile.write(payload)
 
 
+class TestAPIServiceShadowGuard:
+    def test_apiservice_cannot_claim_builtin_group(self, master):
+        """ADVICE r1: an APIService claiming a built-in group/version would
+        hijack built-in routing (aggregation is consulted before built-in
+        dispatch). The registry rejects the shadow."""
+        from kubernetes1_tpu.machinery import Invalid
+
+        cs = Clientset(master.url)
+        try:
+            for group, version in (("apps", "v1"), ("rbac", "v1"), ("batch", "v1")):
+                apisvc = t.APIService()
+                apisvc.metadata.name = f"{version}.{group}"
+                apisvc.spec.group = group
+                apisvc.spec.version = version
+                apisvc.spec.service_namespace = "kube-system"
+                apisvc.spec.service_name = "rogue"
+                with pytest.raises(Invalid, match="shadows"):
+                    cs.apiservices.create(apisvc)
+        finally:
+            cs.close()
+
+
 class TestAggregation:
     def test_apiservice_proxies_to_backing_endpoints(self, master):
         cs = Clientset(master.url)

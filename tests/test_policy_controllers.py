@@ -109,6 +109,55 @@ class TestQuotaAndLimits:
         with pytest.raises(Forbidden, match="LimitRange max"):
             cs.pods.create(big)
 
+    def test_patch_cannot_bypass_limitrange(self, cluster):
+        """ADVICE r1: a merge patch must run the admission chain — raising
+        container resources past the LimitRange max via PATCH was an
+        admission bypass."""
+        cs = cluster["cs"]
+        lr = t.LimitRange()
+        lr.metadata.name = "patch-limits"
+        lr.spec.limits = [t.LimitRangeItem(type="Container", max={"cpu": "1"})]
+        cs.limitranges.create(lr)
+
+        pod = simple_pod("patch-victim")
+        pod.spec.containers[0].resources.limits = {"cpu": "500m"}
+        cs.pods.create(pod)
+        with pytest.raises(Forbidden, match="LimitRange max"):
+            cs.pods.patch(
+                "patch-victim",
+                {"spec": {"containers": [
+                    {"name": "c", "image": "busybox", "command": ["serve"],
+                     "resources": {"limits": {"cpu": "8"}}}
+                ]}},
+            )
+
+    def test_limitrange_created_later_does_not_brick_existing_pods(self, cluster):
+        """A stricter LimitRange must only judge values a write changes —
+        metadata-only patches on pre-existing pods stay possible."""
+        cs = cluster["cs"]
+        pod = simple_pod("grandfathered")
+        pod.spec.containers[0].resources.limits = {"cpu": "8"}
+        cs.pods.create(pod)
+
+        lr = t.LimitRange()
+        lr.metadata.name = "stricter"
+        lr.spec.limits = [t.LimitRangeItem(type="Container", max={"cpu": "1"})]
+        cs.limitranges.create(lr)
+
+        patched = cs.pods.patch(
+            "grandfathered", {"metadata": {"labels": {"touched": "yes"}}}
+        )
+        assert patched.metadata.labels["touched"] == "yes"
+        # but raising the limit further is still rejected
+        with pytest.raises(Forbidden, match="LimitRange max"):
+            cs.pods.patch(
+                "grandfathered",
+                {"spec": {"containers": [
+                    {"name": "c", "image": "busybox", "command": ["serve"],
+                     "resources": {"limits": {"cpu": "16"}}}
+                ]}},
+            )
+
 
 class TestServiceAccounts:
     def test_default_sa_created_with_signed_token(self, cluster):
