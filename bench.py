@@ -356,6 +356,22 @@ def main():
         except Exception as e:  # noqa: BLE001
             extras["gang"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes)
+    if os.environ.get("BENCH_SKIP_SCHED", "") != "1":
+        from scripts.sched_perf import run_sched_perf
+
+        try:
+            extras["sched_perf_100"] = run_sched_perf(100, 3000, multiproc=True)
+        except Exception as e:  # noqa: BLE001
+            extras["sched_perf_100"] = {"error": f"{type(e).__name__}: {e}"}
+        if os.environ.get("BENCH_SKIP_SCHED1K", "") != "1":
+            try:
+                extras["sched_perf_1000"] = run_sched_perf(
+                    1000, 30000, creators=6, multiproc=True
+                )
+            except Exception as e:  # noqa: BLE001
+                extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}
+
     if os.environ.get("BENCH_SKIP_WORKLOAD", "") != "1":
         try:
             extras["workload"] = bench_workload()

@@ -203,7 +203,15 @@ class LimitRanger(AdmissionPlugin):
                 old_requests[oc.name] = dict(oc.resources.requests or {})
 
         def changed(c_name, res, val, old_map):
-            return old_map.get(c_name, {}).get(res) != val
+            # compare as quantities: "2" -> "2000m" is a re-serialization,
+            # not a raise, and must not re-judge a grandfathered pod
+            old_val = old_map.get(c_name, {}).get(res)
+            if old_val is None:
+                return val is not None
+            try:
+                return parse_quantity(old_val) != parse_quantity(val)
+            except (ValueError, TypeError):
+                return old_val != val
 
         for lr in self._list(obj.metadata.namespace):
             for item in lr.spec.limits:

@@ -66,6 +66,10 @@ WATCH_HEARTBEAT_SECONDS = 5.0
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "ktpu-apiserver/0.1"
+    # response headers and body go out as separate small writes; with Nagle
+    # on, the body write stalls ~40ms behind the client's delayed ACK —
+    # TCP_NODELAY is what every real apiserver/gRPC stack runs with
+    disable_nagle_algorithm = True
 
     # quiet request logging; audit hook covers observability
     def log_message(self, fmt, *args):  # noqa: D102
@@ -293,6 +297,19 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._handle("DELETE")
 
+    def _with_quota_serialization(self, resource: str, ns: str, write_fn):
+        """Quota-counted writes serialize admission-check + commit so two
+        concurrent writes cannot both pass a nearly-exhausted quota
+        (admission computes usage from the store; unserialized it's TOCTOU).
+        One helper for POST/PUT/PATCH so the rule can't drift per-verb."""
+        effective_ns = ns or "default"
+        if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
+            effective_ns
+        ):
+            with self.master.quota_lock:
+                return write_fn()
+        return write_fn()
+
     # ------------------------------------------------------------------ GET
 
     def _do_get(self, resource, ns, name, sub, q):
@@ -399,19 +416,15 @@ class _Handler(BaseHTTPRequestHandler):
         # (NamespaceAutoProvision) see the effective namespace
         if ns and not obj.metadata.namespace:
             obj.metadata.namespace = ns
-        # Quota-counted resources serialize admission-check + commit so two
-        # concurrent creates cannot both pass a nearly-exhausted quota
-        # (admission computes usage from the store; unserialized it's TOCTOU).
-        effective_ns = ns or obj.metadata.namespace or "default"
-        if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
-            effective_ns
-        ):
-            with self.master.quota_lock:
-                obj = self.master.admission.admit(CREATE, resource, obj, user=self._user)
-                created = reg.create(resource, ns, obj)
-        else:
+
+        def admit_and_create():
+            nonlocal obj
             obj = self.master.admission.admit(CREATE, resource, obj, user=self._user)
-            created = reg.create(resource, ns, obj)
+            return reg.create(resource, ns, obj)
+
+        created = self._with_quota_serialization(
+            resource, ns or obj.metadata.namespace, admit_and_create
+        )
         self.master.audit("create", resource, ns, created.metadata.name, self._user.name)
         if resource == "customresourcedefinitions":
             self.master.apply_crd(created)
@@ -432,22 +445,17 @@ class _Handler(BaseHTTPRequestHandler):
             raise NotFound(f"subresource {sub!r} not writable")
         else:
             old = reg.get(resource, ns, name)
-            # same TOCTOU serialization as POST/PATCH: quota admission on
-            # UPDATE computes usage from the store, so concurrent writes to a
-            # nearly-exhausted quota must not both pass
-            if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
-                ns or old.metadata.namespace or "default"
-            ):
-                with self.master.quota_lock:
-                    obj = self.master.admission.admit(
-                        UPDATE, resource, obj, old, user=self._user
-                    )
-                    updated = reg.update(resource, ns, name, obj)
-            else:
+
+            def admit_and_update():
+                nonlocal obj
                 obj = self.master.admission.admit(
                     UPDATE, resource, obj, old, user=self._user
                 )
-                updated = reg.update(resource, ns, name, obj)
+                return reg.update(resource, ns, name, obj)
+
+            updated = self._with_quota_serialization(
+                resource, ns or old.metadata.namespace, admit_and_update
+            )
             if resource == "customresourcedefinitions":
                 self.master.remove_crd(old)
                 self.master.apply_crd(updated)
@@ -472,15 +480,10 @@ class _Handler(BaseHTTPRequestHandler):
         admit = lambda merged, cur: self.master.admission.admit(  # noqa: E731
             UPDATE, resource, merged, cur, user=self._user
         )
-        if resource in ResourceQuotaAdmission.COUNTED and self.master._list_quotas(
-            ns or "default"
-        ):
-            with self.master.quota_lock:
-                updated = self.master.registry.patch(
-                    resource, ns, name, patch, admit=admit
-                )
-        else:
-            updated = self.master.registry.patch(resource, ns, name, patch, admit=admit)
+        updated = self._with_quota_serialization(
+            resource, ns,
+            lambda: self.master.registry.patch(resource, ns, name, patch, admit=admit),
+        )
         if resource == "customresourcedefinitions":
             self.master.remove_crd(old)
             self.master.apply_crd(updated)

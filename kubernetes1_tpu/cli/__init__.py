@@ -257,8 +257,11 @@ class CLI:
 
     # ----------------------------------------------------------------- logs
 
-    def logs(self, args):
-        pod = self.cs.pods.get(args.pod, self.ns)
+    def _kubelet_base(self, pod) -> tuple:
+        """Resolve the pod's kubelet server endpoint + exec token from its
+        node's annotations (ref: server.go:1 — :10250 reached via the
+        apiserver's node proxy there; here the CLI talks to the kubelet
+        directly, and the right to read the Node object IS the authz gate)."""
         if not pod.spec.node_name:
             raise SystemExit("error: pod not scheduled yet")
         node = self.cs.nodes.get(pod.spec.node_name, "")
@@ -266,12 +269,40 @@ class CLI:
         if not base:
             raise SystemExit(
                 "error: node does not advertise a kubelet server endpoint")
+        return base, node.metadata.annotations.get("kubelet.ktpu.io/exec-token", "")
+
+    def logs(self, args):
+        pod = self.cs.pods.get(args.pod, self.ns)
+        base, _token = self._kubelet_base(pod)
         import urllib.request
 
         url = (f"{base}/containerLogs/{pod.metadata.namespace}/{pod.metadata.name}"
                f"/{args.container or pod.spec.containers[0].name}")
+        if getattr(args, "tail", 0):
+            url += f"?tail={args.tail}"
         with urllib.request.urlopen(url, timeout=10) as resp:
             self.out.write(resp.read().decode(errors="replace"))
+
+    def exec_(self, args):
+        pod = self.cs.pods.get(args.pod, self.ns)
+        base, token = self._kubelet_base(pod)
+        import json as _json
+        import urllib.request
+
+        url = (f"{base}/exec/{pod.metadata.namespace}/{pod.metadata.name}"
+               f"/{args.container or pod.spec.containers[0].name}")
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(
+            url, data=_json.dumps({"command": args.command}).encode(),
+            headers=headers, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            result = _json.loads(resp.read())
+        self.out.write(result.get("output", ""))
+        if result.get("exitCode", 0) != 0:
+            raise SystemExit(result["exitCode"])
 
     # ----------------------------------------------------------------- wait
 
@@ -364,6 +395,12 @@ def build_parser() -> argparse.ArgumentParser:
     lg = sub.add_parser("logs")
     lg.add_argument("pod")
     lg.add_argument("-c", "--container", default="")
+    lg.add_argument("--tail", type=int, default=0)
+
+    ex = sub.add_parser("exec")
+    ex.add_argument("pod")
+    ex.add_argument("-c", "--container", default="")
+    ex.add_argument("command", nargs="+")
 
     w = sub.add_parser("wait")
     w.add_argument("target")
@@ -426,6 +463,6 @@ def dispatch(cli: CLI, args) -> None:
         "create": cli.create, "delete": cli.delete, "scale": cli.scale,
         "cordon": cli.cordon, "uncordon": cli.uncordon, "drain": cli.drain,
         "top": cli.top, "rollout": cli.rollout, "logs": cli.logs,
-        "wait": cli.wait, "api-resources": cli.api_resources,
+        "exec": cli.exec_, "wait": cli.wait, "api-resources": cli.api_resources,
     }[args.cmd]
     handler(args)

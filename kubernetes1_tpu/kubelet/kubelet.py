@@ -60,6 +60,8 @@ class Kubelet:
         eviction_interval: float = 10.0,
         eviction_thresholds: Optional[Dict[str, float]] = None,
         eviction_signals_fn=None,
+        server_port: Optional[int] = 0,  # 0 = ephemeral; None = no server
+        server_token: str = "",
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -94,6 +96,22 @@ class Kubelet:
         self._lock = threading.RLock()
         self._metrics_rv: Dict[Tuple[str, str], str] = {}  # (kind, key) -> rv
 
+        self.server = None
+        self.server_token = server_token
+        if server_port is not None:
+            import secrets
+
+            from .server import KubeletServer
+
+            # exec must never be an open door: without an explicit token we
+            # mint one and publish it ONLY via the Node annotation, so the
+            # ability to exec is gated on apiserver node-read authorization —
+            # the shape of the reference's delegated nodes/proxy authz
+            if not self.server_token:
+                self.server_token = secrets.token_hex(16)
+            self.server = KubeletServer(self, port=server_port,
+                                        token=self.server_token)
+
         from .eviction import EvictionManager, default_signals
         from .prober import ProberManager
 
@@ -127,6 +145,8 @@ class Kubelet:
 
     def start(self):
         self.device_manager.start()
+        if self.server is not None:
+            self.server.start()
         self._reconcile_runtime()
         self._register_node()
         self.pods.add_handler(
@@ -162,6 +182,8 @@ class Kubelet:
         self.pods.stop()
         self.device_manager.stop()
         self.prober.stop()
+        if self.server is not None:
+            self.server.stop()
 
     def _loop(self, fn, period: float):
         while not self._stop.is_set():
@@ -210,6 +232,9 @@ class Kubelet:
 
     # ----------------------------------------------------------- node status
 
+    KUBELET_SERVER_ANNOTATION = "kubelet.ktpu.io/server"
+    KUBELET_TOKEN_ANNOTATION = "kubelet.ktpu.io/exec-token"
+
     def _node_object(self) -> t.Node:
         node = t.Node()
         node.metadata.name = self.node_name
@@ -217,6 +242,11 @@ class Kubelet:
             "kubernetes.io/hostname": self.node_name,
             **self.node_labels,
         }
+        if self.server is not None:
+            # `ktpu logs`/`ktpu exec` resolve the kubelet endpoint from this
+            # (the :10250 daemonEndpoints analog, ref server.go:1)
+            node.metadata.annotations[self.KUBELET_SERVER_ANNOTATION] = self.server.url
+            node.metadata.annotations[self.KUBELET_TOKEN_ANNOTATION] = self.server_token
         self._fill_status(node)
         return node
 
@@ -246,7 +276,20 @@ class Kubelet:
         try:
             self.cs.nodes.create(node)
         except ApiError:
-            pass  # exists: heartbeat will refresh status
+            # exists: heartbeat will refresh status, but the server endpoint
+            # lives in metadata (a restart may listen on a new port)
+            if self.server is not None:
+                try:
+                    self.cs.nodes.patch(
+                        self.node_name,
+                        {"metadata": {"annotations": {
+                            self.KUBELET_SERVER_ANNOTATION: self.server.url,
+                            self.KUBELET_TOKEN_ANNOTATION: self.server_token,
+                        }}},
+                        namespace="",
+                    )
+                except ApiError:
+                    pass
 
     def _heartbeat(self):
         """10s-class syncNodeStatus (ref: kubelet_node_status.go:545-621)."""
@@ -326,6 +369,43 @@ class Kubelet:
                 self._metrics_rv.pop((type(obj).KIND, obj.key()), None)
             return
         self._metrics_rv[(type(obj).KIND, obj.key())] = updated.metadata.resource_version
+
+    def stats_summary(self) -> dict:
+        """Summary-API analog (ref: pkg/kubelet/server/stats/summary.go):
+        node totals + per-pod per-container point-in-time usage, served at
+        the kubelet server's /stats/summary."""
+        pods_out = []
+        node_cpu, node_mem = 0.0, 0.0
+        for pod in self.pods.list():
+            with self._lock:
+                cids = {
+                    name: cid
+                    for (uid, name), cid in self._containers.items()
+                    if uid == pod.metadata.uid
+                }
+            containers = []
+            for cname, cid in sorted(cids.items()):
+                stats = self.runtime.container_stats(cid)
+                node_cpu += stats.get("cpu", 0.0)
+                node_mem += stats.get("memory", 0.0)
+                containers.append({
+                    "name": cname,
+                    "cpu_cores": round(stats.get("cpu", 0.0), 4),
+                    "memory_bytes": int(stats.get("memory", 0.0)),
+                })
+            pods_out.append({
+                "pod": pod.key(),
+                "containers": containers,
+            })
+        return {
+            "node": {
+                "nodeName": self.node_name,
+                "capacity": dict(self.capacity),
+                "cpu_cores": round(node_cpu, 4),
+                "memory_bytes": int(node_mem),
+            },
+            "pods": pods_out,
+        }
 
     def _publish_metrics(self):
         """Resource-metrics pipeline, one hop: runtime stats → PodMetrics /

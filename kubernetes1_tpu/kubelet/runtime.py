@@ -122,6 +122,11 @@ class RuntimeService:
         (exec probes + `ktpu exec` ride this)."""
         return -1
 
+    def exec_capture(self, container_id: str, command) -> tuple:
+        """ExecSync analog: (exit code, combined output) — the kubelet
+        server's /exec endpoint (ref: CRI api.proto ExecSync)."""
+        return self.exec_in_container(container_id, command), ""
+
 
 class ImageService:
     """ref: api.proto ImageService (5 RPCs) — advisory here."""
@@ -447,21 +452,26 @@ class ProcessRuntime(RuntimeService):
     def exec_in_container(self, container_id: str, command) -> int:
         """Exec probes for process containers: run the command with the
         container's env (process analog of CRI ExecSync)."""
+        return self.exec_capture(container_id, command)[0]
+
+    def exec_capture(self, container_id: str, command) -> tuple:
         with self._lock:
             proc = self._procs.get(container_id)
             config = self._configs.get(container_id)
         if proc is None or proc.poll() is not None:
-            return -1
+            return -1, "container not running"
         env = dict(os.environ)
         if config is not None:
             env.update(config.env)
         try:
             res = subprocess.run(
-                list(command), env=env, capture_output=True, timeout=10
+                list(command), env=env, capture_output=True, timeout=10,
+                cwd=(config.working_dir or None) if config else None,
             )
-            return res.returncode
-        except (OSError, subprocess.TimeoutExpired, ValueError):
-            return -1
+            out = res.stdout.decode(errors="replace") + res.stderr.decode(errors="replace")
+            return res.returncode, out
+        except (OSError, subprocess.TimeoutExpired, ValueError) as e:
+            return -1, str(e)
 
     def container_stats(self, container_id: str) -> Dict[str, float]:
         """CPU from /proc/<pid>/stat utime+stime deltas between calls, RSS

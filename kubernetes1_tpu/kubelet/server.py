@@ -1,0 +1,168 @@
+"""Kubelet API server (ref: pkg/kubelet/server/server.go — the :10250
+endpoint serving containerLogs/exec/stats/pods; auth there is delegated to
+the apiserver, streaming rides SPDY via client-go/tools/remotecommand).
+
+The TPU-native shape: a plain HTTP server per kubelet with
+  GET  /healthz
+  GET  /pods                                  pods this kubelet manages
+  GET  /containerLogs/<ns>/<pod>/<container>  ?tail=N
+  POST /exec/<ns>/<pod>/<container>           {"command": [...]}
+       -> {"exitCode": N, "output": "..."}    (ExecSync, the probe seam)
+  GET  /stats/summary                         node + per-pod usage
+  GET  /metrics                               prometheus text
+
+The node advertises the endpoint as the `kubelet.ktpu.io/server` annotation
+on its Node object; `ktpu logs`/`ktpu exec` resolve it from there (the
+reference publishes :10250 in nodeStatus.daemonEndpoints the same way).
+An optional bearer token gates mutating verbs (exec).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class _KubeletHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "ktpu-kubelet/0.1"
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    @property
+    def kubelet(self):
+        return self.server.kubelet  # type: ignore[attr-defined]
+
+    @property
+    def token(self) -> str:
+        return self.server.token  # type: ignore[attr-defined]
+
+    def _send(self, code: int, payload, content_type="application/json"):
+        raw = payload if isinstance(payload, bytes) else (
+            json.dumps(payload).encode()
+            if not isinstance(payload, str) else payload.encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _authorized(self) -> bool:
+        if not self.token:
+            return True
+        return self.headers.get("Authorization", "") == f"Bearer {self.token}"
+
+    def _resolve_container(self, ns: str, pod_name: str, cname: str):
+        """(pod, container_id) or (None, error_response_sent)."""
+        kl = self.kubelet
+        pod = kl.pods.get(f"{ns}/{pod_name}")
+        if pod is None:
+            self._send(404, {"error": f"pod {ns}/{pod_name} not found on this node"})
+            return None, None
+        cname = cname or pod.spec.containers[0].name
+        with kl._lock:
+            cid = kl._containers.get((pod.metadata.uid, cname))
+        if cid is None:
+            self._send(404, {"error": f"container {cname!r} has no runtime record"})
+            return None, None
+        return pod, cid
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        kl = self.kubelet
+        try:
+            if parts == ["healthz"]:
+                self._send(200, {"status": "ok"})
+            elif parts == ["pods"]:
+                self._send(200, {"pods": sorted(p.key() for p in kl.pods.list())})
+            elif parts and parts[0] == "containerLogs" and len(parts) >= 3:
+                ns, pod_name = parts[1], parts[2]
+                cname = parts[3] if len(parts) > 3 else ""
+                pod, cid = self._resolve_container(ns, pod_name, cname)
+                if pod is None:
+                    return
+                tail = int(q.get("tail") or 0)
+                self._send(200, kl.runtime.read_log(cid, tail=tail),
+                           content_type="text/plain")
+            elif parts[:2] == ["stats", "summary"] or parts == ["stats"]:
+                self._send(200, kl.stats_summary())
+            elif parts == ["metrics"]:
+                body = (
+                    f"# TYPE kubelet_running_pods gauge\n"
+                    f"kubelet_running_pods {len(kl.pods.list())}\n"
+                )
+                self._send(200, body, content_type="text/plain; version=0.0.4")
+            else:
+                self._send(404, {"error": f"unknown path {parsed.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send(500, {"error": str(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def do_POST(self):
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        try:
+            if parts and parts[0] == "exec" and len(parts) >= 3:
+                if not self._authorized():
+                    self._send(401, {"error": "unauthorized"})
+                    return
+                ns, pod_name = parts[1], parts[2]
+                cname = parts[3] if len(parts) > 3 else ""
+                pod, cid = self._resolve_container(ns, pod_name, cname)
+                if pod is None:
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length)) if length else {}
+                command = body.get("command") or []
+                if not command:
+                    self._send(400, {"error": "command required"})
+                    return
+                code, output = self.kubelet.runtime.exec_capture(cid, command)
+                self._send(200, {"exitCode": code, "output": output})
+            else:
+                self._send(404, {"error": f"unknown path {parsed.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send(500, {"error": str(e)})
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class KubeletServer:
+    """Owns the HTTP listener; the kubelet advertises `self.url` on its Node."""
+
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0,
+                 token: str = ""):
+        self._httpd = ThreadingHTTPServer((host, port), _KubeletHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.kubelet = kubelet  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="kubelet-server",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -21,34 +21,78 @@ from ..utils.quantity import parse_milli, parse_quantity
 
 DEFAULT_NODE_PODS = 110
 
+# Process-global monotonic generation source. Per-NodeInfo counters would
+# restart at 1 when a node is deleted and re-added under the same name,
+# letting stale EquivalenceCache entries falsely hit for the new node.
+_generation_lock = threading.Lock()
+_generation_counter = 0
+
+
+def _next_generation() -> int:
+    global _generation_counter
+    with _generation_lock:
+        _generation_counter += 1
+        return _generation_counter
+
 
 def pod_request_milli_cpu(pod: t.Pod) -> int:
+    # memoized on the pod object: predicates+priorities call this per NODE,
+    # and quantity parsing per call is the schedule() hot loop's biggest
+    # constant factor at 1000 nodes (informer updates replace pod objects,
+    # so staleness is impossible)
+    cached = getattr(pod, "_ktpu_mcpu", None)
+    if cached is not None:
+        return cached
     total = 0
     for c in pod.spec.containers:
         total += parse_milli(c.resources.requests.get("cpu") or c.resources.limits.get("cpu") or 0)
+    pod._ktpu_mcpu = total
     return total
 
 
 def pod_request_memory(pod: t.Pod) -> float:
+    cached = getattr(pod, "_ktpu_mem", None)
+    if cached is not None:
+        return cached
     total = 0.0
     for c in pod.spec.containers:
         total += parse_quantity(
             c.resources.requests.get("memory") or c.resources.limits.get("memory") or 0
         )
+    pod._ktpu_mem = total
     return total
 
 
 class ExtendedResourceInfo:
-    """Device accounting for one resource name on one node."""
+    """Device accounting for one resource name on one node. Per-slice
+    availability counters are maintained incrementally so the scheduler's
+    hot loops (fit counting, slice-packing score) are O(slices), not
+    O(devices) — profile-dominant at 1000 nodes x 32 chips."""
 
     def __init__(self):
         self.devices: Dict[str, t.ExtendedResourceDevice] = {}
         self.used: Set[str] = set()
+        self._avail_count = 0
+        self._slice_avail: Dict[str, int] = {}
+
+    @staticmethod
+    def _slice_of(d: t.ExtendedResourceDevice) -> str:
+        return (d.attributes or {}).get(t.ATTR_TPU_SLICE, "")
 
     def set_devices(self, devices: List[t.ExtendedResourceDevice]):
         self.devices = {d.id: d for d in devices}
         # used IDs for devices that disappeared stay; harmless (they can't
         # be re-allocated anyway)
+        self._recount()
+
+    def _recount(self):
+        self._avail_count = 0
+        self._slice_avail = {}
+        for d in self.devices.values():
+            if d.health == t.DEVICE_HEALTHY and d.id not in self.used:
+                self._avail_count += 1
+                s = self._slice_of(d)
+                self._slice_avail[s] = self._slice_avail.get(s, 0) + 1
 
     def available(self) -> List[t.ExtendedResourceDevice]:
         return [
@@ -57,11 +101,34 @@ class ExtendedResourceInfo:
             if d.health == t.DEVICE_HEALTHY and d.id not in self.used
         ]
 
+    def available_count(self) -> int:
+        return self._avail_count
+
+    def slice_available(self) -> Dict[str, int]:
+        """Live view — callers must not mutate."""
+        return self._slice_avail
+
     def use(self, ids: List[str]):
-        self.used.update(ids)
+        for i in ids:
+            if i in self.used:
+                continue
+            self.used.add(i)
+            d = self.devices.get(i)
+            if d is not None and d.health == t.DEVICE_HEALTHY:
+                self._avail_count -= 1
+                s = self._slice_of(d)
+                self._slice_avail[s] = self._slice_avail.get(s, 1) - 1
 
     def release(self, ids: List[str]):
-        self.used.difference_update(ids)
+        for i in ids:
+            if i not in self.used:
+                continue
+            self.used.discard(i)
+            d = self.devices.get(i)
+            if d is not None and d.health == t.DEVICE_HEALTHY:
+                self._avail_count += 1
+                s = self._slice_of(d)
+                self._slice_avail[s] = self._slice_avail.get(s, 0) + 1
 
 
 class NodeInfo:
@@ -74,11 +141,16 @@ class NodeInfo:
         self.allocatable_memory = 0.0
         self.allocatable_pods = DEFAULT_NODE_PODS
         self.extended: Dict[str, ExtendedResourceInfo] = {}
+        # bumped whenever the node OBJECT changes — the equivalence cache
+        # keys static-predicate results on (pod equiv hash, node, generation)
+        # (ref: plugin/pkg/scheduler/core/equivalence_cache.go)
+        self.generation = 0
         if node is not None:
             self.set_node(node)
 
     def set_node(self, node: t.Node):
         self.node = node
+        self.generation = _next_generation()
         alloc = node.status.allocatable or node.status.capacity
         self.allocatable_milli_cpu = parse_milli(alloc.get("cpu", 0))
         self.allocatable_memory = parse_quantity(alloc.get("memory", 0))
@@ -123,6 +195,7 @@ class NodeInfo:
         shares immutable node/pod objects, copies the accounting."""
         c = NodeInfo()
         c.node = self.node
+        c.generation = self.generation
         c.pods = dict(self.pods)
         c.requested_milli_cpu = self.requested_milli_cpu
         c.requested_memory = self.requested_memory
@@ -133,6 +206,8 @@ class NodeInfo:
             ci = ExtendedResourceInfo()
             ci.devices = info.devices  # device descriptors are read-only here
             ci.used = set(info.used)
+            ci._avail_count = info._avail_count
+            ci._slice_avail = dict(info._slice_avail)
             c.extended[res] = ci
         return c
 

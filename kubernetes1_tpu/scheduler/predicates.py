@@ -134,16 +134,91 @@ def node_schedulable(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, str]:
     return True, ""
 
 
-DEFAULT_PREDICATES = [
+# Static predicates read only (pod spec placement fields, node OBJECT) —
+# their result is identical for equivalent pods until the node object
+# changes, so it is cacheable (ref: core/equivalence_cache.go). Dynamic
+# predicates read the node's pod-derived accounting and must run live.
+STATIC_PREDICATES = [
     ("NodeSchedulable", node_schedulable),
     ("MatchNodeSelector", pod_matches_node_selector),
     ("PodToleratesNodeTaints", pod_tolerates_node_taints),
+]
+DYNAMIC_PREDICATES = [
     ("PodFitsHostPorts", pod_fits_host_ports),
     ("PodFitsResources", pod_fits_resources),
 ]
+DEFAULT_PREDICATES = STATIC_PREDICATES + DYNAMIC_PREDICATES
 
 
-def run_predicates(pod: t.Pod, ni: NodeInfo) -> Tuple[bool, List[str]]:
+def pod_equivalence_hash(pod: t.Pod) -> int:
+    """Hash of exactly the pod fields the static predicates read. Pods from
+    one controller share it, so a ReplicaSet's 3000th pod skips the
+    selector/affinity/taint checks on unchanged nodes. Memoized on the pod
+    object (informer updates replace objects)."""
+    cached = getattr(pod, "_ktpu_equiv", None)
+    if cached is not None:
+        return cached
+    import json as _json
+
+    from ..machinery.scheme import to_dict
+
+    h = hash((
+        _json.dumps(pod.spec.node_selector, sort_keys=True),
+        _json.dumps(to_dict(pod.spec.affinity), sort_keys=True)
+        if pod.spec.affinity else "",
+        _json.dumps([to_dict(tol) for tol in pod.spec.tolerations], sort_keys=True),
+    ))
+    pod._ktpu_equiv = h
+    return h
+
+
+class EquivalenceCache:
+    """(pod equiv hash, node name) -> cached static-predicate verdict, valid
+    while the node's generation is unchanged. Single-writer (the scheduling
+    loop), so a plain dict with a size cap suffices."""
+
+    MAX_ENTRIES = 200_000
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def lookup(self, equiv: int, node_name: str, generation: int):
+        entry = self._cache.get((equiv, node_name))
+        if entry is not None and entry[0] == generation:
+            return entry[1], entry[2]
+        return None
+
+    def store(self, equiv: int, node_name: str, generation: int, ok: bool, reason: str):
+        if len(self._cache) >= self.MAX_ENTRIES:
+            self._cache.clear()
+        self._cache[(equiv, node_name)] = (generation, ok, reason)
+
+
+def run_predicates(
+    pod: t.Pod, ni: NodeInfo, equiv_cache: "EquivalenceCache" = None
+) -> Tuple[bool, List[str]]:
+    if equiv_cache is not None and ni.node is not None:
+        equiv = pod_equivalence_hash(pod)
+        name = ni.node.metadata.name
+        hit = equiv_cache.lookup(equiv, name, ni.generation)
+        if hit is not None:
+            ok, reason = hit
+            if not ok:
+                return False, [reason]
+        else:
+            ok, reason = True, ""
+            for _name, pred in STATIC_PREDICATES:
+                ok, reason = pred(pod, ni)
+                if not ok:
+                    break
+            equiv_cache.store(equiv, name, ni.generation, ok, reason)
+            if not ok:
+                return False, [reason]
+        for _name, pred in DYNAMIC_PREDICATES:
+            ok, reason = pred(pod, ni)
+            if not ok:
+                return False, [reason]
+        return True, []
     reasons = []
     for _name, pred in DEFAULT_PREDICATES:
         ok, reason = pred(pod, ni)

@@ -64,16 +64,24 @@ def slice_packing(pod: t.Pod, ni: NodeInfo) -> float:
         return MAX_SCORE / 2  # neutral
     total = 0.0
     for per in pod.spec.extended_resources:
-        avail = [
-            d
-            for d in ni.available_devices(per.resource)
-            if device_matches(d, per.affinity)
-        ]
-        if len(avail) < per.quantity:
-            continue  # predicate will have filtered; defensive
-        by_slice: Dict[str, int] = defaultdict(int)
-        for d in avail:
-            by_slice[(d.attributes or {}).get(t.ATTR_TPU_SLICE, "")] += 1
+        info = ni.extended.get(per.resource)
+        if per.affinity is None:
+            # common case rides the cache's incremental per-slice counters —
+            # O(slices) instead of walking every device per scored node
+            by_slice = dict(info.slice_available()) if info else {}
+            if sum(by_slice.values()) < per.quantity:
+                continue  # predicate will have filtered; defensive
+        else:
+            avail = [
+                d
+                for d in ni.available_devices(per.resource)
+                if device_matches(d, per.affinity)
+            ]
+            if len(avail) < per.quantity:
+                continue
+            by_slice = defaultdict(int)
+            for d in avail:
+                by_slice[(d.attributes or {}).get(t.ATTR_TPU_SLICE, "")] += 1
         fitting = [n for n in by_slice.values() if n >= per.quantity]
         if not fitting:
             total += 1.0  # must span slices: worst

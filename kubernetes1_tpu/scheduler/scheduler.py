@@ -30,10 +30,17 @@ from ..machinery import ApiError, Conflict, NotFound
 from ..machinery.scheme import global_scheme
 from ..utils.metrics import Histogram
 from .cache import NodeInfo, SchedulerCache
-from .devices import allocate_for_pod
-from .predicates import run_predicates
+from .devices import allocate_for_pod, fits_devices
+from .predicates import EquivalenceCache, run_predicates
 from .priorities import prioritize
 from .queue import SchedulingQueue
+
+# Feasibility sampling (upstream percentageOfNodesToScore): on big clusters
+# stop the filter scan once this many feasible nodes are found — scoring 100
+# candidates instead of 1000 loses almost nothing (scores are local to a
+# node) and caps schedule() at O(feasible) instead of O(cluster).
+MIN_FEASIBLE_TO_FIND = 100
+FEASIBLE_PERCENT = 0.05
 
 
 class ScheduleResult:
@@ -62,6 +69,14 @@ class Scheduler:
         self._gang_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self.equiv_cache = EquivalenceCache()
+        self._scan_offset = 0  # rotates so sampling spreads over the cluster
+        # persistent bind workers (ref scheduler.go:482 async bind): a pool
+        # reuses per-thread HTTP connections instead of a thread per bind
+        import queue as _queue
+
+        self._bind_q: "_queue.Queue" = _queue.Queue()
+        self._bind_workers = 8
         self.e2e_latency = Histogram("scheduler_e2e_scheduling_seconds")
         self.schedule_attempts = 0
         self.schedule_failures = 0
@@ -95,11 +110,17 @@ class Scheduler:
         janitor = threading.Thread(target=self._janitor, daemon=True)
         janitor.start()
         self._threads.append(janitor)
+        for i in range(self._bind_workers):
+            b = threading.Thread(target=self._bind_loop, daemon=True, name=f"bind-{i}")
+            b.start()
+            self._threads.append(b)
         return self
 
     def stop(self):
         self._stop.set()
         self.queue.shut_down()
+        for _ in range(self._bind_workers):
+            self._bind_q.put(None)
         self.factory.stop_all()
 
     # --------------------------------------------------------- pod handlers
@@ -177,28 +198,47 @@ class Scheduler:
         snapshot = nodes if nodes is not None else self.cache.snapshot()
         if not snapshot:
             return None, "no nodes registered"
-        feasible: List[Tuple[NodeInfo, Dict[str, List[str]]]] = []
+        feasible: List[NodeInfo] = []
         reasons: Dict[str, int] = defaultdict(int)
-        for ni in snapshot.values():
+        node_list = list(snapshot.values())
+        enough = max(MIN_FEASIBLE_TO_FIND, int(len(node_list) * FEASIBLE_PERCENT))
+        # start each scan at a rotating offset: with early termination a
+        # fixed order would pile all pods onto the first feasible nodes
+        start = self._scan_offset % max(1, len(node_list))
+        self._scan_offset += 1
+        for idx in range(len(node_list)):
+            ni = node_list[(start + idx) % len(node_list)]
             if ni.node is None:
                 continue
-            ok, why = run_predicates(pod, ni)
+            ok, why = run_predicates(pod, ni, self.equiv_cache)
             if not ok:
                 reasons[why[0] if why else "predicate failed"] += 1
                 continue
-            assignments, why = allocate_for_pod(pod, ni)
-            if assignments is None:
+            ok, why = fits_devices(pod, ni)
+            if not ok:
                 reasons[why] += 1
                 continue
-            feasible.append((ni, assignments))
+            feasible.append(ni)
+            if len(feasible) >= enough:
+                break
         if not feasible:
             summary = "; ".join(f"{n} node(s): {r}" for r, n in sorted(reasons.items()))
             return None, f"0/{len(snapshot)} nodes available: {summary}"
-        scores = prioritize(pod, [ni for ni, _ in feasible])
-        best_ni, best_assign = max(
-            feasible, key=lambda fa: (scores[fa[0].node.metadata.name], fa[0].node.metadata.name)
-        )
-        return ScheduleResult(best_ni.node.metadata.name, best_assign), ""
+        scores = prioritize(pod, feasible)
+        # full device allocation runs only on the winner (best-fit slice +
+        # coordinate sort are O(devices log devices) — too hot per-candidate);
+        # on the rare count-check/allocator disagreement, fall to the next best
+        for ni in sorted(
+            feasible,
+            key=lambda n: (scores[n.node.metadata.name], n.node.metadata.name),
+            reverse=True,
+        ):
+            assignments, why = allocate_for_pod(pod, ni)
+            if assignments is not None:
+                return ScheduleResult(ni.node.metadata.name, assignments), ""
+            reasons[why] += 1
+        summary = "; ".join(f"{n} node(s): {r}" for r, n in sorted(reasons.items()))
+        return None, f"0/{len(snapshot)} nodes available: {summary}"
 
     def _assume_and_bind(self, pod: t.Pod, result: ScheduleResult):
         assumed = global_scheme.deepcopy(pod)
@@ -231,7 +271,17 @@ class Scheduler:
                 self.queue.add_backoff(pod.key(), pod.spec.priority)
 
         # async bind (ref scheduler.go:482): don't block the scheduling loop
-        threading.Thread(target=do_bind, daemon=True).start()
+        self._bind_q.put(do_bind)
+
+    def _bind_loop(self):
+        while True:
+            fn = self._bind_q.get()
+            if fn is None or self._stop.is_set():
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
 
     # ----------------------------------------------------------------- gang
 
@@ -296,13 +346,19 @@ class Scheduler:
         base = self.cache.snapshot()
         slice_ids = self._candidate_slices(members, base)
         for slice_id in slice_ids + [None]:
-            sim = {name: ni.clone() for name, ni in base.items()}
+            # clone-on-write: share the live NodeInfos for reading and clone
+            # a node only when the simulation actually places a member on it
+            # (the previous clone-everything was O(slices x nodes x pods) and
+            # the VERDICT-flagged scale killer)
             if slice_id is not None:
                 sim = {
                     name: ni
-                    for name, ni in sim.items()
+                    for name, ni in base.items()
                     if ni.node is not None and self._node_in_slice(ni, slice_id)
                 }
+            else:
+                sim = dict(base)
+            cloned: set = set()
             placements: List[Tuple[t.Pod, ScheduleResult]] = []
             ok = True
             for member in members:
@@ -316,6 +372,9 @@ class Scheduler:
                 by_name = {per.name: per for per in shadow.spec.extended_resources}
                 for name, ids in result.assignments.items():
                     by_name[name].assigned = list(ids)
+                if result.node not in cloned:
+                    sim[result.node] = sim[result.node].clone()
+                    cloned.add(result.node)
                 sim[result.node].add_pod(shadow)
                 placements.append((member, result))
             if ok:
@@ -344,10 +403,9 @@ class Scheduler:
         cap: Dict[str, int] = defaultdict(int)
         for ni in nodes.values():
             for info in ni.extended.values():
-                for d in info.available():
-                    sid = (d.attributes or {}).get(t.ATTR_TPU_SLICE)
+                for sid, n in info.slice_available().items():
                     if sid:
-                        cap[sid] += 1
+                        cap[sid] += n
         fitting = sorted((s for s, n in cap.items() if n >= need), key=lambda s: cap[s])
         return fitting
 

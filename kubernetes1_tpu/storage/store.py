@@ -97,6 +97,11 @@ class Store:
         self._scheme = scheme
         self._lock = threading.RLock()
         self._data: Dict[str, Tuple[int, Dict[str, Any]]] = {}  # key -> (rev, encoded obj)
+        # Per-collection index: first path segment after /registry/ -> keys.
+        # list("/registry/pods/...") must not scan (or sort) every event and
+        # endpoint in the store — full-store sorted scans made pod-create
+        # latency grow linearly with cluster history at 30k-pod density.
+        self._by_collection: Dict[str, set] = {}
         self._rev = 0
         # History ring for watch resume: list of (rev, type, key, encoded obj)
         self._history: List[Tuple[int, str, str, Dict[str, Any]]] = []
@@ -128,8 +133,14 @@ class Store:
                 self._rev = max(self._rev, rev)
                 if typ == DELETED:
                     self._data.pop(key, None)
+                    coll = self._by_collection.get(self._collection_of(key))
+                    if coll is not None:
+                        coll.discard(key)
                 else:
                     self._data[key] = (rev, obj)
+                    self._by_collection.setdefault(
+                        self._collection_of(key), set()
+                    ).add(key)
         # Watches cannot resume across restart below the replayed revision.
         self._compacted_rev = self._rev
 
@@ -143,8 +154,12 @@ class Store:
         obj["metadata"]["resourceVersion"] = str(rev)
         if typ == DELETED:
             self._data.pop(key, None)
+            coll = self._by_collection.get(self._collection_of(key))
+            if coll is not None:
+                coll.discard(key)
         else:
             self._data[key] = (rev, obj)
+            self._by_collection.setdefault(self._collection_of(key), set()).add(key)
         self._history.append((rev, typ, key, obj))
         if len(self._history) > self._history_limit:
             drop = len(self._history) - self._history_limit
@@ -192,13 +207,22 @@ class Store:
         except NotFound:
             return None
 
+    @staticmethod
+    def _collection_of(key: str) -> str:
+        # "/registry/<resource>/..." -> "<resource>"
+        parts = key.split("/", 3)
+        return parts[2] if len(parts) > 2 else ""
+
     def list(self, prefix: str) -> Tuple[List[Any], int]:
         """All objects under prefix + the store revision for watch resume."""
         with self._lock:
+            keys = self._by_collection.get(self._collection_of(prefix))
+            if keys is None:
+                return [], self._rev
             items = [
-                self._decode(obj)
-                for key, (_rev, obj) in sorted(self._data.items())
-                if key.startswith(prefix)
+                self._decode(self._data[key][1])
+                for key in sorted(keys)
+                if key.startswith(prefix) and key in self._data
             ]
             return items, self._rev
 

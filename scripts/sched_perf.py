@@ -1,0 +1,196 @@
+"""Scheduler density/throughput benchmark (ref: test/integration/
+scheduler_perf — "3,000 pods on 100 nodes; 30,000 pods on 1,000 nodes",
+README + scheduler_test.go:71): real apiserver over HTTP + real scheduler +
+N fake Node OBJECTS (no kubelets, like the reference's in-memory nodes),
+M pods each requesting one google.com/tpu chip so the device-allocation path
+is in the measured loop.
+
+    python scripts/sched_perf.py --nodes 100 --pods 3000
+    python scripts/sched_perf.py --nodes 1000 --pods 30000
+
+Prints one JSON line: pods/sec scheduling throughput + latency percentiles.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes1_tpu.api import types as t  # noqa: E402
+from kubernetes1_tpu.apiserver import Master  # noqa: E402
+from kubernetes1_tpu.client import Clientset  # noqa: E402
+from kubernetes1_tpu.scheduler import Scheduler  # noqa: E402
+from tests.helpers import make_node, make_tpu_pod  # noqa: E402
+
+
+def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
+                   creators: int = 4, multiproc: bool = False) -> dict:
+    """multiproc=True runs apiserver and scheduler as separate OS processes
+    (the deployment shape) so they get real parallelism; in-process mode
+    shares one GIL across every component, which caps the measurable
+    throughput well below what the scheduler core does."""
+    pods = pods or nodes * 30
+    if pods > nodes * tpus_per_node:
+        raise ValueError("pods exceed cluster chip capacity")
+
+    import socket
+    import subprocess
+
+    procs = []
+    sched = None
+    if multiproc:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        url = f"http://127.0.0.1:{port}"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubernetes1_tpu.apiserver", "--port", str(port)],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 15
+        cs = Clientset(url)
+        while time.time() < deadline:
+            try:
+                cs.api.request("GET", "/healthz")
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.1)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubernetes1_tpu.scheduler", "--server", url],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        master = None
+    else:
+        master = Master().start()
+        url = master.url
+        cs = Clientset(url)
+    try:
+        return _drive(nodes, pods, tpus_per_node, creators, multiproc,
+                      url, cs, master, sched)
+    finally:
+        # child processes must never outlive the run (a leaked apiserver/
+        # scheduler would skew every later bench phase)
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+
+
+def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
+           sched) -> dict:
+    for i in range(nodes):
+        # 8 hosts per ICI slice, v5e-32-ish geometry
+        node = make_node(f"perf-{i}", cpu="64", memory="256Gi",
+                         tpus=tpus_per_node, slice_id=f"slice-{i // 8}",
+                         host_index=i % 8)
+        cs.nodes.create(node)
+
+    if not multiproc:
+        sched = Scheduler(cs)
+        sched.start()
+
+    bound = {}
+    created = {}
+    done = threading.Event()
+
+    def watcher():
+        """Count binds from the watch stream (no full-list polling)."""
+        from kubernetes1_tpu.client.rest import ApiClient
+
+        api = ApiClient(url)
+        with api.watch("/api/v1/namespaces/default/pods",
+                       {"resourceVersion": "1"}) as stream:
+            for etype, obj in stream:
+                if etype in ("ADDED", "MODIFIED"):
+                    name = obj["metadata"]["name"]
+                    if obj.get("spec", {}).get("nodeName") and name not in bound:
+                        bound[name] = time.perf_counter()
+                        if len(bound) >= pods:
+                            done.set()
+                            return
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+
+    t0 = time.perf_counter()
+
+    def creator(start_idx):
+        ccs = Clientset(url)
+        for i in range(start_idx, pods, creators):
+            pod = make_tpu_pod(f"p-{i}", tpus=1)
+            ccs.pods.create(pod)
+            created[pod.metadata.name] = time.perf_counter()
+        ccs.close()
+
+    if os.environ.get("KTPU_SCHED_PERF_PROGRESS"):
+        def reporter():
+            last = 0
+            while not done.is_set():
+                time.sleep(10)
+                n = len(bound)
+                print(f"progress: created={len(created)} bound={n}/{pods} "
+                      f"(+{n - last}/10s)", file=sys.stderr, flush=True)
+                last = n
+        threading.Thread(target=reporter, daemon=True).start()
+
+    threads = [threading.Thread(target=creator, args=(k,), daemon=True)
+               for k in range(creators)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    create_wall = time.perf_counter() - t0
+
+    deadline = max(600.0, pods * 0.1)
+    done.wait(timeout=deadline)
+    total_wall = (max(bound.values()) if bound else time.perf_counter()) - t0
+
+    lat = sorted(bound[n] - created[n] for n in bound if n in created)
+
+    def pct(q):
+        return round(lat[min(len(lat) - 1, int(q * len(lat)))], 4) if lat else None
+
+    result = {
+        "nodes": nodes,
+        "pods_requested": pods,
+        "pods_bound": len(bound),
+        "create_wall_s": round(create_wall, 2),
+        "total_wall_s": round(total_wall, 2),
+        "pods_per_sec": round(len(bound) / total_wall, 1) if total_wall > 0 else None,
+        "bind_latency_p50_s": pct(0.50),
+        "bind_latency_p90_s": pct(0.90),
+        "bind_latency_p99_s": pct(0.99),
+        "multiproc": multiproc,
+        "schedule_attempts": sched.schedule_attempts if sched else None,
+        "schedule_failures": sched.schedule_failures if sched else None,
+    }
+    if sched:
+        sched.stop()
+    cs.close()
+    if master:
+        master.stop()
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--pods", type=int, default=0, help="default 30x nodes")
+    ap.add_argument("--tpus-per-node", type=int, default=32)
+    ap.add_argument("--creators", type=int, default=4)
+    ap.add_argument("--multiproc", action="store_true",
+                    help="apiserver+scheduler as separate processes")
+    args = ap.parse_args()
+    print(json.dumps(run_sched_perf(args.nodes, args.pods, args.tpus_per_node,
+                                    args.creators, args.multiproc)))
+
+
+if __name__ == "__main__":
+    main()

@@ -236,3 +236,85 @@ class TestRestartSafety:
         ]
         assert len(sandboxes) == 1
         assert running == before  # same single process, adopted not respawned
+
+
+class TestKubeletServer:
+    """Kubelet API server (ref: pkg/kubelet/server/server.go): logs + exec +
+    stats over HTTP, endpoint advertised on the Node, consumed by the CLI."""
+
+    def _run_cli(self, master_url, *argv):
+        import io
+
+        from kubernetes1_tpu.cli import CLI, build_parser, dispatch
+
+        out = io.StringIO()
+        cli = CLI(master_url, "default", out=out)
+        args = build_parser().parse_args(["--server", master_url] + list(argv))
+        try:
+            dispatch(cli, args)
+        finally:
+            cli.cs.close()
+        return out.getvalue()
+
+    def test_ktpu_logs_fetches_container_output(self, node_env):
+        cs, master = node_env["cs"], node_env["master"]
+        pod = py_pod(
+            "chatty",
+            "import time; print('training step 1 loss=3.14', flush=True); time.sleep(300)",
+            restart="Always",
+        )
+        cs.pods.create(pod)
+        wait_phase(cs, "chatty", t.POD_RUNNING)
+        node = cs.nodes.get("tpu-node-0", "")
+        assert node.metadata.annotations.get("kubelet.ktpu.io/server")
+        must_poll_until(
+            lambda: "loss=3.14" in self._run_cli(master.url, "logs", "chatty"),
+            timeout=10.0, desc="logs show container stdout",
+        )
+
+    def test_ktpu_exec_runs_in_container_env(self, node_env):
+        cs, master = node_env["cs"], node_env["master"]
+        pod = py_pod("exec-me", "import time; time.sleep(300)", tpus=1,
+                     restart="Always")
+        cs.pods.create(pod)
+        wait_phase(cs, "exec-me", t.POD_RUNNING)
+        # exec runs with the container's injected env: the TPU bootstrap
+        # variables the device plugin set are visible inside
+        out = self._run_cli(
+            master.url, "exec", "exec-me", "--",
+            sys.executable, "-c", "import os; print(os.environ['TPU_VISIBLE_CHIPS'])",
+        )
+        assert out.strip() != ""
+
+    def test_stats_summary_endpoint(self, node_env):
+        cs = node_env["cs"]
+        pod = py_pod("statsy", "import time; time.sleep(300)", restart="Always")
+        cs.pods.create(pod)
+        wait_phase(cs, "statsy", t.POD_RUNNING)
+        import json
+        import urllib.request
+
+        node = cs.nodes.get("tpu-node-0", "")
+        base = node.metadata.annotations["kubelet.ktpu.io/server"]
+        with urllib.request.urlopen(f"{base}/stats/summary", timeout=10) as resp:
+            summary = json.load(resp)
+        assert summary["node"]["nodeName"] == "tpu-node-0"
+        pods = {p["pod"]: p for p in summary["pods"]}
+        assert "default/statsy" in pods
+        must_poll_until(
+            lambda: _stats_mem(base) > 0, timeout=10.0,
+            desc="stats show real memory usage",
+        )
+
+
+def _stats_mem(base) -> int:
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(f"{base}/stats/summary", timeout=10) as resp:
+        summary = json.load(resp)
+    for p in summary["pods"]:
+        for c in p["containers"]:
+            if c["memory_bytes"] > 0:
+                return c["memory_bytes"]
+    return 0

@@ -231,16 +231,22 @@ class TestCronJob:
             # long outage: backlog is skipped, not replayed as a storm
             cs.cronjobs.create(self.make("stale"))
             fake_now[0] += 3 * 86400
-            ctl.sync("default/stale")
+
+            def stale_advanced():
+                # re-drive sync until the informer has observed the object
+                # (manual sync can race the watch event delivery)
+                ctl.sync("default/stale")
+                return cs.cronjobs.get("stale").status.last_schedule_time != ""
+
+            must_poll_until(
+                stale_advanced, timeout=5.0,
+                desc="lastScheduleTime advanced past backlog",
+            )
             stale_jobs = [
                 j for j in cs.jobs.list(namespace="default")[0]
                 if j.metadata.name.startswith("stale-")
             ]
             assert stale_jobs == []
-            must_poll_until(
-                lambda: cs.cronjobs.get("stale").status.last_schedule_time != "",
-                timeout=5.0, desc="lastScheduleTime advanced past backlog",
-            )
 
             # Forbid policy blocks while active
             fresh = mutate_with_retry(
