@@ -118,6 +118,35 @@ class PodStrategy(Strategy):
         # NodeName is write-once outside the binding subresource.
         if old.spec.node_name and new.spec.node_name != old.spec.node_name:
             raise Forbidden("pod.spec.nodeName is immutable once set; use the binding subresource")
+        # Resource values may be raised (LimitRanger judges the raise) but
+        # never deleted: a merge patch of {"limits": {"cpu": null}} would
+        # otherwise unbound the container while skipping every max check
+        # (the reference goes further and makes pod resources immutable,
+        # ValidatePodUpdate in pkg/apis/core/validation).
+        old_by_name = {c.name: c for c in old.spec.containers}
+        # The container set itself is immutable on update (ref ValidatePodUpdate:
+        # containers may not be added, removed, or renamed) — otherwise the
+        # removal guard below is bypassed by renaming the container.
+        if {c.name for c in new.spec.containers} != set(old_by_name):
+            raise Forbidden("pod.spec.containers may not be added, removed, or renamed on update")
+        for c in new.spec.containers:
+            oc = old_by_name[c.name]
+            for kind in ("limits", "requests"):
+                old_map = getattr(oc.resources, kind) or {}
+                # a None value is a removal too: merge patch deletes nulls at
+                # the object level, but a replaced containers *array* carries
+                # them through verbatim ({"cpu": null} survives decode)
+                new_map = {
+                    k: v for k, v in (getattr(c.resources, kind) or {}).items()
+                    if v is not None
+                }
+                setattr(c.resources, kind, new_map)
+                gone = set(old_map) - set(new_map)
+                if gone:
+                    raise Forbidden(
+                        f"container {c.name}: resource {kind} {sorted(gone)} "
+                        f"may not be removed on update"
+                    )
 
 
 class NodeStrategy(Strategy):

@@ -56,7 +56,15 @@ class _KubeletHandler(BaseHTTPRequestHandler):
     def _authorized(self) -> bool:
         if not self.token:
             return True
-        return self.headers.get("Authorization", "") == f"Bearer {self.token}"
+        import hmac
+
+        # constant-time compare: the token grants command execution, so its
+        # bytes must not leak via comparison timing (bytes, not str — str
+        # compare_digest raises on non-ASCII header values)
+        return hmac.compare_digest(
+            self.headers.get("Authorization", "").encode("utf-8", "surrogateescape"),
+            f"Bearer {self.token}".encode(),
+        )
 
     def _resolve_container(self, ns: str, pod_name: str, cname: str):
         """(pod, container_id) or (None, error_response_sent)."""

@@ -150,11 +150,14 @@ DYNAMIC_PREDICATES = [
 DEFAULT_PREDICATES = STATIC_PREDICATES + DYNAMIC_PREDICATES
 
 
-def pod_equivalence_hash(pod: t.Pod) -> int:
-    """Hash of exactly the pod fields the static predicates read. Pods from
-    one controller share it, so a ReplicaSet's 3000th pod skips the
-    selector/affinity/taint checks on unchanged nodes. Memoized on the pod
-    object (informer updates replace objects)."""
+def pod_equivalence_key(pod: t.Pod) -> tuple:
+    """Canonical serialization of exactly the pod fields the static
+    predicates read. Pods from one controller share it, so a ReplicaSet's
+    3000th pod skips the selector/affinity/taint checks on unchanged nodes.
+    The key is the serialized tuple itself — not its hash — so two distinct
+    pod classes can never collide into the same cache entry (dict keys
+    compare by content on hash collision). Memoized on the pod object
+    (informer updates replace objects, invalidating the memo)."""
     cached = getattr(pod, "_ktpu_equiv", None)
     if cached is not None:
         return cached
@@ -162,18 +165,18 @@ def pod_equivalence_hash(pod: t.Pod) -> int:
 
     from ..machinery.scheme import to_dict
 
-    h = hash((
+    key = (
         _json.dumps(pod.spec.node_selector, sort_keys=True),
         _json.dumps(to_dict(pod.spec.affinity), sort_keys=True)
         if pod.spec.affinity else "",
         _json.dumps([to_dict(tol) for tol in pod.spec.tolerations], sort_keys=True),
-    ))
-    pod._ktpu_equiv = h
-    return h
+    )
+    pod._ktpu_equiv = key
+    return key
 
 
 class EquivalenceCache:
-    """(pod equiv hash, node name) -> cached static-predicate verdict, valid
+    """(pod equiv key, node name) -> cached static-predicate verdict, valid
     while the node's generation is unchanged. Single-writer (the scheduling
     loop), so a plain dict with a size cap suffices."""
 
@@ -182,13 +185,13 @@ class EquivalenceCache:
     def __init__(self):
         self._cache: dict = {}
 
-    def lookup(self, equiv: int, node_name: str, generation: int):
+    def lookup(self, equiv: tuple, node_name: str, generation: int):
         entry = self._cache.get((equiv, node_name))
         if entry is not None and entry[0] == generation:
             return entry[1], entry[2]
         return None
 
-    def store(self, equiv: int, node_name: str, generation: int, ok: bool, reason: str):
+    def store(self, equiv: tuple, node_name: str, generation: int, ok: bool, reason: str):
         if len(self._cache) >= self.MAX_ENTRIES:
             self._cache.clear()
         self._cache[(equiv, node_name)] = (generation, ok, reason)
@@ -198,7 +201,7 @@ def run_predicates(
     pod: t.Pod, ni: NodeInfo, equiv_cache: "EquivalenceCache" = None
 ) -> Tuple[bool, List[str]]:
     if equiv_cache is not None and ni.node is not None:
-        equiv = pod_equivalence_hash(pod)
+        equiv = pod_equivalence_key(pod)
         name = ni.node.metadata.name
         hit = equiv_cache.lookup(equiv, name, ni.generation)
         if hit is not None:

@@ -23,19 +23,7 @@ import json
 import sys
 import time
 
-# bf16 peak TFLOP/s per chip by device kind (public cloud.google.com/tpu docs).
-PEAK_FLOPS = {
-    "TPU v2": 22.5e12,
-    "TPU v3": 61.5e12,  # per chip (2 cores)
-    "TPU v4": 137.5e12,  # 275 per dual-chip package / 2
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 229.5e12,
-    "TPU v5p": 229.5e12,
-    "TPU v6 lite": 459e12,  # trillium
-    "TPU v6e": 459e12,
-    "TPU7x": 2307e12,
-}
+from .tpu_peaks import peak_flops_per_device
 
 # Analytic fallback: ResNet-50 forward ≈ 4.1 GFLOP/img at 224x224 (counting
 # a MAC as 2 FLOPs); a training step costs ~3x forward (fwd + 2x bwd).
@@ -87,7 +75,7 @@ def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
         wall = time.perf_counter() - t0
 
     kind = devices[0].device_kind
-    peak = PEAK_FLOPS.get(kind, 0.0)
+    peak, granularity = peak_flops_per_device(devices[0])
     steps_per_sec = steps / wall
     imgs_per_sec = batch * steps_per_sec
     mfu = (flops_per_step * steps_per_sec / (peak * n_dev)) if peak else None
@@ -96,15 +84,16 @@ def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
         "device_kind": kind,
         "platform": devices[0].platform,
         "n_devices": n_dev,
+        "device_granularity": granularity,  # "chip" (v4+) or "core" (v2/v3)
         "batch": batch,
         "image_size": size,
         "steps": steps,
         "compile_s": round(compile_s, 2),
         "step_time_ms": round(1000 * wall / steps, 2),
         "imgs_per_sec": round(imgs_per_sec, 1),
-        "imgs_per_sec_per_chip": round(imgs_per_sec / n_dev, 1),
+        "imgs_per_sec_per_device": round(imgs_per_sec / n_dev, 1),
         "flops_per_step": flops_per_step,
-        "peak_flops_per_chip": peak,
+        "peak_flops_per_device": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "final_loss": float(loss),
     }

@@ -1,0 +1,56 @@
+"""TPU peak-FLOPs tables, normalized to JAX device granularity.
+
+`jax.devices()` granularity differs by generation: on v2/v3 each entry is
+one *core* (two cores per chip, each with its own MXU + HBM view); on v4+
+(megacore) each entry is one *chip*.  MFU and per-chip throughput numbers
+must divide by the right peak for what one `jax.Device` actually is, or
+they are off by 2x on v2/v3.
+
+Peak bf16 numbers are per *chip* from the public cloud.google.com/tpu docs;
+`peak_flops_per_device` converts to per-jax-device using the core-vs-chip
+granularity of the generation.
+"""
+
+from __future__ import annotations
+
+# bf16 peak TFLOP/s per CHIP by device kind (public cloud.google.com/tpu docs).
+PEAK_FLOPS_PER_CHIP = {
+    "TPU v2": 45e12,       # 22.5 per core x 2 cores
+    "TPU v3": 123e12,      # 61.5 per core x 2 cores
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,      # v5p: 229.5 per core x 2 (one megacore device)
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # trillium
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+# Generations whose jax.Device is a single core (2 cores per chip).
+_CORE_GRANULARITY_KINDS = {"TPU v2", "TPU v3"}
+_CORES_PER_CHIP = 2
+
+
+def peak_flops_per_device(device) -> tuple:
+    """(peak bf16 FLOP/s for ONE jax.Device, granularity label).
+
+    granularity is "chip" when a jax device is a whole chip (v4+ megacore)
+    and "core" on v2/v3 where each of the chip's two cores is its own
+    device.  Unknown kinds (CPU/GPU hosts in tests) return (0.0, "device").
+    """
+    kind = getattr(device, "device_kind", "")
+    matched = kind if kind in PEAK_FLOPS_PER_CHIP else None
+    if matched is None:
+        # tolerate minor kind-string drift ("TPU v3 pod", "TPU v5 lite" …);
+        # longest prefix wins so "TPU v5p..." doesn't match "TPU v5"
+        for known in sorted(PEAK_FLOPS_PER_CHIP, key=len, reverse=True):
+            if kind.startswith(known):
+                matched = known
+                break
+    if matched is None:
+        return 0.0, "device"
+    per_chip = PEAK_FLOPS_PER_CHIP[matched]
+    if matched in _CORE_GRANULARITY_KINDS:
+        return per_chip / _CORES_PER_CHIP, "core"
+    return per_chip, "chip"

@@ -131,6 +131,42 @@ class TestQuotaAndLimits:
                 ]}},
             )
 
+    def test_patch_cannot_delete_resource_limits(self, cluster):
+        """ADVICE r2: a merge patch of {"limits": {"cpu": null}} deletes the
+        key under RFC 7386 — which would leave the container unbounded while
+        LimitRanger's max check sees no value to judge. Removal of a
+        previously-present limit/request is forbidden at the registry."""
+        cs = cluster["cs"]
+        lr = t.LimitRange()
+        lr.metadata.name = "null-limits"
+        lr.spec.limits = [t.LimitRangeItem(type="Container", max={"cpu": "1"})]
+        cs.limitranges.create(lr)
+
+        pod = simple_pod("null-victim")
+        pod.spec.containers[0].resources.limits = {"cpu": "500m"}
+        cs.pods.create(pod)
+        with pytest.raises(Forbidden, match="may not be removed"):
+            cs.pods.patch(
+                "null-victim",
+                {"spec": {"containers": [
+                    {"name": "c", "image": "busybox", "command": ["serve"],
+                     "resources": {"limits": {"cpu": None}}}
+                ]}},
+            )
+        # requests are protected the same way, even with no LimitRange in play
+        pod2 = simple_pod("null-victim-2")
+        pod2.metadata.namespace = "default"
+        pod2.spec.containers[0].resources.requests = {"memory": "1Gi"}
+        cs.pods.create(pod2)
+        with pytest.raises(Forbidden, match="may not be removed"):
+            cs.pods.patch(
+                "null-victim-2",
+                {"spec": {"containers": [
+                    {"name": "c", "image": "busybox", "command": ["serve"],
+                     "resources": {"requests": {"memory": None}}}
+                ]}},
+            )
+
     def test_limitrange_created_later_does_not_brick_existing_pods(self, cluster):
         """A stricter LimitRange must only judge values a write changes —
         metadata-only patches on pre-existing pods stay possible."""
