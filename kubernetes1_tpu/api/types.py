@@ -138,9 +138,63 @@ ExtendedResourceMap = Dict[str, List[ExtendedResourceDevice]]
 
 
 @dataclass
+class ConfigMapKeySelector:
+    name: str = ""
+    key: str = ""
+    optional: bool = False
+
+
+@dataclass
+class SecretKeySelector:
+    name: str = ""
+    key: str = ""
+    optional: bool = False
+
+
+@dataclass
+class ObjectFieldSelector:
+    """Downward API (ref: pkg/fieldpath/fieldpath.go) — supported paths:
+    metadata.name, metadata.namespace, metadata.uid, metadata.labels['k'],
+    metadata.annotations['k'], spec.nodeName, spec.serviceAccountName,
+    status.podIP, status.hostIP."""
+
+    field_path: str = ""
+
+
+@dataclass
+class EnvVarSource:
+    config_map_key_ref: Optional[ConfigMapKeySelector] = None
+    secret_key_ref: Optional[SecretKeySelector] = None
+    field_ref: Optional[ObjectFieldSelector] = None
+
+
+@dataclass
 class EnvVar:
     name: str = ""
     value: str = ""
+    value_from: Optional[EnvVarSource] = None
+
+
+@dataclass
+class ConfigMapEnvSource:
+    name: str = ""
+    optional: bool = False
+
+
+@dataclass
+class SecretEnvSource:
+    name: str = ""
+    optional: bool = False
+
+
+@dataclass
+class EnvFromSource:
+    """envFrom: import a whole ConfigMap/Secret as env vars
+    (ref: kubelet_pods.go:591 makeEnvironmentVariables)."""
+
+    prefix: str = ""
+    config_map_ref: Optional[ConfigMapEnvSource] = None
+    secret_ref: Optional[SecretEnvSource] = None
 
 
 @dataclass
@@ -156,6 +210,7 @@ class VolumeMount:
     name: str = ""
     mount_path: str = ""
     read_only: bool = False
+    sub_path: str = ""
 
 
 @dataclass
@@ -169,18 +224,39 @@ class EmptyDirVolumeSource:
 
 
 @dataclass
+class KeyToPath:
+    key: str = ""
+    path: str = ""
+
+
+@dataclass
 class ConfigMapVolumeSource:
     name: str = ""
+    items: List[KeyToPath] = field(default_factory=list)  # empty = all keys
+    optional: bool = False
 
 
 @dataclass
 class SecretVolumeSource:
     secret_name: str = ""
+    items: List[KeyToPath] = field(default_factory=list)
+    optional: bool = False
 
 
 @dataclass
 class PersistentVolumeClaimVolumeSource:
     claim_name: str = ""
+
+
+@dataclass
+class DownwardAPIVolumeFile:
+    path: str = ""
+    field_ref: Optional[ObjectFieldSelector] = None
+
+
+@dataclass
+class DownwardAPIVolumeSource:
+    items: List[DownwardAPIVolumeFile] = field(default_factory=list)
 
 
 @dataclass
@@ -191,6 +267,7 @@ class Volume:
     config_map: Optional[ConfigMapVolumeSource] = None
     secret: Optional[SecretVolumeSource] = None
     persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    downward_api: Optional[DownwardAPIVolumeSource] = None
 
 
 @dataclass
@@ -237,6 +314,7 @@ class Container:
     args: List[str] = field(default_factory=list)
     working_dir: str = ""
     env: List[EnvVar] = field(default_factory=list)
+    env_from: List[EnvFromSource] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: List[VolumeMount] = field(default_factory=list)

@@ -112,6 +112,23 @@ class PodStrategy(Strategy):
             for ref in c.extended_resource_requests:
                 if ref not in valid:
                     raise Invalid(f"container {c.name} references unknown extended resource {ref!r}")
+        vol_names = set()
+        for v in obj.spec.volumes:
+            if v.name in vol_names:
+                raise Invalid(f"duplicate volume name {v.name!r}")
+            vol_names.add(v.name)
+            sources = [s for s in (v.host_path, v.empty_dir, v.config_map,
+                                   v.secret, v.persistent_volume_claim,
+                                   v.downward_api) if s is not None]
+            if len(sources) != 1:
+                raise Invalid(f"volume {v.name!r} must have exactly one source")
+        for c in obj.spec.containers + obj.spec.init_containers:
+            for vm in c.volume_mounts:
+                if vm.name not in vol_names:
+                    raise Invalid(
+                        f"container {c.name}: volumeMount {vm.name!r} "
+                        f"references no pod volume"
+                    )
 
     def prepare_for_update(self, new, old):
         super().prepare_for_update(new, old)
@@ -123,30 +140,35 @@ class PodStrategy(Strategy):
         # otherwise unbound the container while skipping every max check
         # (the reference goes further and makes pod resources immutable,
         # ValidatePodUpdate in pkg/apis/core/validation).
-        old_by_name = {c.name: c for c in old.spec.containers}
-        # The container set itself is immutable on update (ref ValidatePodUpdate:
-        # containers may not be added, removed, or renamed) — otherwise the
-        # removal guard below is bypassed by renaming the container.
-        if {c.name for c in new.spec.containers} != set(old_by_name):
-            raise Forbidden("pod.spec.containers may not be added, removed, or renamed on update")
-        for c in new.spec.containers:
-            oc = old_by_name[c.name]
-            for kind in ("limits", "requests"):
-                old_map = getattr(oc.resources, kind) or {}
-                # a None value is a removal too: merge patch deletes nulls at
-                # the object level, but a replaced containers *array* carries
-                # them through verbatim ({"cpu": null} survives decode)
-                new_map = {
-                    k: v for k, v in (getattr(c.resources, kind) or {}).items()
-                    if v is not None
-                }
-                setattr(c.resources, kind, new_map)
-                gone = set(old_map) - set(new_map)
-                if gone:
-                    raise Forbidden(
-                        f"container {c.name}: resource {kind} {sorted(gone)} "
-                        f"may not be removed on update"
-                    )
+        for clist in ("containers", "init_containers"):
+            old_by_name = {c.name: c for c in getattr(old.spec, clist)}
+            new_list = getattr(new.spec, clist)
+            # The container set itself is immutable on update (ref
+            # ValidatePodUpdate: containers may not be added, removed, or
+            # renamed) — otherwise the removal guard below is bypassed by
+            # renaming the container.
+            if {c.name for c in new_list} != set(old_by_name):
+                raise Forbidden(
+                    f"pod.spec.{clist} may not be added, removed, or renamed on update"
+                )
+            for c in new_list:
+                oc = old_by_name[c.name]
+                for kind in ("limits", "requests"):
+                    old_map = getattr(oc.resources, kind) or {}
+                    # a None value is a removal too: merge patch deletes nulls
+                    # at the object level, but a replaced containers *array*
+                    # carries them through verbatim ({"cpu": null} survives)
+                    new_map = {
+                        k: v for k, v in (getattr(c.resources, kind) or {}).items()
+                        if v is not None
+                    }
+                    setattr(c.resources, kind, new_map)
+                    gone = set(old_map) - set(new_map)
+                    if gone:
+                        raise Forbidden(
+                            f"container {c.name}: resource {kind} {sorted(gone)} "
+                            f"may not be removed on update"
+                        )
 
 
 class NodeStrategy(Strategy):

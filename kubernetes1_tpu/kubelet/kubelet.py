@@ -39,6 +39,7 @@ from .runtime import (
     ContainerConfig,
     RuntimeService,
 )
+from .volumemanager import VolumeError, VolumeManager, VolumeNotReady
 
 
 
@@ -62,6 +63,7 @@ class Kubelet:
         eviction_signals_fn=None,
         server_port: Optional[int] = 0,  # 0 = ephemeral; None = no server
         server_token: str = "",
+        volume_root: Optional[str] = None,
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -77,6 +79,17 @@ class Kubelet:
         self.restart_backoff_base = restart_backoff_base
         self.sync_workers = sync_workers
         self.recorder = EventRecorder(clientset, f"kubelet/{node_name}")
+        # Volume roots must be node-unique: many hollow kubelets share one
+        # process in scale tests, and two nodes' emptyDirs must not collide.
+        runtime_root = getattr(runtime, "root", None)
+        self.volume_manager = VolumeManager(
+            clientset,
+            volume_root or (
+                os.path.join(runtime_root, "volumes") if runtime_root
+                else os.path.join("/tmp/ktpu-volumes", node_name)
+            ),
+            node_name=node_name,
+        )
 
         self.pods = SharedInformer(
             clientset.pods, field_selector=f"spec.nodeName={node_name}"
@@ -90,6 +103,7 @@ class Kubelet:
         self._admit_first_seen: Dict[str, float] = {}
         self._last_status: Dict[str, dict] = {}  # uid -> last PUT status dict
         self._pleg_state: Dict[str, str] = {}
+        self._mount_warned: set = set()  # uids with a FailedMount event emitted
         self._heartbeat_event = threading.Event()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -519,6 +533,7 @@ class Kubelet:
                     for k in [k for k in self._containers if k[0] == sb.pod_uid]:
                         self._containers.pop(k, None)
                 self.device_manager.forget_pod(sb.pod_uid)
+                self.volume_manager.teardown_pod(sb.pod_uid)
                 self._prune_pod_state(sb.pod_uid)
 
     # -------------------------------------------------------------- syncPod
@@ -540,6 +555,20 @@ class Kubelet:
             return
         if verdict == "wait":
             return  # infrastructure warming up; sync ticker retries
+
+        # Volumes before containers (ref: syncPod order — WaitForAttachAndMount
+        # precedes runtime SyncPod).  Unready sources wait; broken ones fail.
+        try:
+            self.volume_manager.mount_pod(pod)
+            self.volume_manager.refresh_pod(pod)
+        except VolumeNotReady as e:
+            if uid not in self._mount_warned:
+                self._mount_warned.add(uid)
+                self.recorder.event(pod, "Warning", "FailedMount", str(e))
+            return  # sync ticker retries
+        except VolumeError as e:
+            self._set_failed(pod, "FailedMount", str(e))
+            return
 
         sandbox_id = self._ensure_sandbox(pod)
         self._sync_containers(pod, sandbox_id)
@@ -587,13 +616,18 @@ class Kubelet:
         return sid
 
     def _container_config(self, pod: t.Pod, container: t.Container) -> ContainerConfig:
-        """GenerateRunContainerOptions (ref kubelet_pods.go:468): pod env +
+        """GenerateRunContainerOptions (ref kubelet_pods.go:468): pod env
+        (incl. valueFrom/envFrom/downward API) + volume mounts +
         device-plugin injection merged into the CRI config."""
-        env = {e.name: e.value for e in container.env}
+        env = self.volume_manager.make_environment(pod, container)
+        # in-pod API access: the mounted SA token + this endpoint is the
+        # KUBERNETES_SERVICE_HOST/PORT analog
+        env.setdefault("KTPU_APISERVER", self.cs.api.url)
         spec = self.device_manager.init_container(pod, container)
         env.update(spec.envs)
         devices = [vars(d) for d in spec.devices]
-        mounts = [vars(m) for m in spec.mounts]
+        mounts = self.volume_manager.mounts_for_container(pod, container)
+        mounts += [vars(m) for m in spec.mounts]
         annotations = dict(spec.annotations)
         return ContainerConfig(
             name=container.name,
@@ -659,6 +693,19 @@ class Kubelet:
             cid = None
             try:
                 config = self._container_config(pod, container)
+            except VolumeNotReady as e:
+                # transient (envFrom source not yet visible): per-tick retry,
+                # not the exponential FailedStart backoff
+                if uid not in self._mount_warned:
+                    self._mount_warned.add(uid)
+                    self.recorder.event(pod, "Warning", "FailedMount", str(e))
+                continue
+            except VolumeError as e:
+                # permanent config error (missing key): fail the pod like the
+                # reference's CreateContainerConfigError terminal path
+                self._set_failed(pod, "CreateContainerConfigError", str(e))
+                return
+            try:
                 if hasattr(self.runtime, "images"):
                     self.runtime.images.pull_image(container.image)
                 cid = self.runtime.create_container(sandbox_id, config)
@@ -711,6 +758,7 @@ class Kubelet:
                 for k in [k for k in self._containers if k[0] == uid]:
                     self._containers.pop(k, None)
         self.device_manager.forget_pod(uid)
+        self.volume_manager.teardown_pod(uid)
         self._prune_pod_state(uid)
         try:
             self.cs.pods.delete(
@@ -723,6 +771,7 @@ class Kubelet:
         """Drop every per-pod bookkeeping entry (unbounded growth otherwise
         under Job-style pod churn)."""
         self.prober.remove_pod(uid)
+        self._mount_warned.discard(uid)
         with self._lock:
             self._admitted.pop(uid, None)
             self._admit_first_seen.pop(uid, None)

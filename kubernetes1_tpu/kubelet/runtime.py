@@ -164,6 +164,7 @@ class FakeRuntime(RuntimeService):
         self.default_usage: Dict[str, float] = {"cpu": 0.001, "memory": 1 << 20}
         self._usage_by_name: Dict[str, Dict[str, float]] = {}
         self._exec_results: Dict[str, int] = {}
+        self.configs: Dict[str, ContainerConfig] = {}  # cid -> config, kept for assertions
 
     def set_usage(self, container_name: str, cpu: float, memory: float = 1 << 20):
         self._usage_by_name[container_name] = {"cpu": cpu, "memory": memory}
@@ -225,6 +226,7 @@ class FakeRuntime(RuntimeService):
             self._containers[cid] = ContainerRecord(
                 id=cid, sandbox_id=sandbox_id, name=config.name, image=config.image
             )
+            self.configs[cid] = config  # tests assert on env/mount injection
             plan = self._plan_exit(config)
             if plan:
                 self._exit_plans[cid] = plan
@@ -278,6 +280,7 @@ class FakeRuntime(RuntimeService):
         with self._lock:
             self._containers.pop(container_id, None)
             self._exit_plans.pop(container_id, None)
+            self.configs.pop(container_id, None)
 
     def list_containers(self) -> List[ContainerRecord]:
         with self._lock:
@@ -289,6 +292,53 @@ class FakeRuntime(RuntimeService):
 
 
 # --------------------------------------------------------- process runtime
+
+
+def _probe_mount_ns() -> bool:
+    """True when this host can give containers private mount namespaces
+    with bind mounts (root + unshare).  Probed once per runtime with a real
+    bind, not just an unshare — unprivileged unshare can succeed while
+    mount(2) fails."""
+    if os.geteuid() != 0:
+        return False
+    try:
+        res = subprocess.run(
+            ["unshare", "--mount", "--propagation", "private", "sh", "-c",
+             "mount --bind /tmp /tmp"],
+            capture_output=True, timeout=10,
+        )
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _wrap_with_mounts(cmd: List[str], mounts: List[dict]) -> List[str]:
+    """Prefix `cmd` with an unshare+bind preamble realizing `mounts`
+    ({host_path, container_path, read_only}) in a private mount namespace.
+    Mount-point dirs are created on the shared fs (mkdir persists; the bind
+    itself is namespace-private) — same as a host admin pre-creating
+    mount points."""
+    import shlex
+
+    lines = ["set -e"]
+    for m in mounts:
+        src = m.get("host_path") or ""
+        dst = m.get("container_path") or ""
+        if not src or not dst or not os.path.exists(src):
+            continue
+        qsrc, qdst = shlex.quote(src), shlex.quote(dst)
+        if os.path.isdir(src):
+            lines.append(f"mkdir -p {qdst}")
+        else:
+            lines.append(f"mkdir -p $(dirname {qdst}) && touch {qdst}")
+        lines.append(f"mount --bind {qsrc} {qdst}")
+        if m.get("read_only"):
+            lines.append(f"mount -o remount,ro,bind {qdst}")
+    lines.append('exec "$@"')
+    return [
+        "unshare", "--mount", "--propagation", "private", "--",
+        "sh", "-c", "\n".join(lines), "sh",
+    ] + list(cmd)
 
 
 class ProcessRuntime(RuntimeService):
@@ -309,6 +359,7 @@ class ProcessRuntime(RuntimeService):
         self._configs: Dict[str, ContainerConfig] = {}
         self._stat_samples: Dict[str, tuple] = {}  # cid -> (cpu_ticks, mono_ts)
         self.images = ImageService()
+        self._mount_ns = _probe_mount_ns()
 
     def version(self) -> str:
         return "process://0.1"
@@ -368,6 +419,17 @@ class ProcessRuntime(RuntimeService):
         cmd += list(config.args or [])
         env = dict(os.environ)
         env.update(config.env)
+        # Volume mounts: every mount is also exported as KTPU_VOLUME_<NAME>
+        # (path-agnostic consumption), and — when the host permits mount
+        # namespaces — bind-mounted at its container_path inside a private
+        # mount ns, so /ckpt in one pod and /ckpt in another are different
+        # directories exactly like real container runtimes.
+        for m in config.mounts:
+            name = (m.get("name") or "").replace("-", "_").replace(".", "_").upper()
+            if name:
+                env[f"KTPU_VOLUME_{name}"] = m.get("host_path", "")
+        if config.mounts and self._mount_ns:
+            cmd = _wrap_with_mounts(cmd, config.mounts)
         logf = open(c.log_path, "ab")
         proc = subprocess.Popen(
             cmd,

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional, Type
 _SPECIAL_WIRE_NAMES = {
     "continue_token": "continue",
     "api_version": "apiVersion",
+    "downward_api": "downwardAPI",
 }
 
 

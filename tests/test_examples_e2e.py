@@ -176,3 +176,11 @@ class TestLlamaPreemptible:
             for chip in p.spec.extended_resources[0].assigned:
                 slice_ids.add(devs[chip].attributes[t.ATTR_TPU_SLICE])
             assert slice_ids == {"v5e-slice"}  # affinity kept it off v5p
+        # the checkpoint PVC bound and materialized: RUNNING proves the
+        # kubelet mounted it (FailedMount blocks container start), and the
+        # claim must be Bound to the example's PV
+        pvc = cs.persistentvolumeclaims.get("llama3-ckpt", "default")
+        assert pvc.status.phase == "Bound"
+        assert pvc.spec.volume_name == "llama3-ckpt-pv"
+        for p in pods:
+            assert p.spec.volumes[0].persistent_volume_claim.claim_name == "llama3-ckpt"
