@@ -1,0 +1,492 @@
+"""Container manager: cgroup QoS tree, per-pod resource enforcement, node
+allocatable, and cgroup-ground-truth stats.
+
+Ref: pkg/kubelet/cm/container_manager_linux.go:619 (the kubelet's cgroup
+owner), cm/qos_container_manager_linux.go (the qos tree
+kubepods/{burstable,besteffort}), cm/node_container_manager.go (node
+allocatable = capacity - reserved), and eviction's QoS ranking.
+
+Layout (node-unique so many kubelets on one host never collide):
+
+    <cgroupfs>/ktpu/<node>/                  node root ("kubepods")
+    <cgroupfs>/ktpu/<node>/guaranteed/pod<uid>/
+    <cgroupfs>/ktpu/<node>/burstable/pod<uid>/
+    <cgroupfs>/ktpu/<node>/besteffort/pod<uid>/
+
+Backends:
+- cgroup v2 (unified, preferred where memory+cpu controllers are delegated):
+  memory.max / cpu.max, stats from memory.current + cpu.stat.
+- cgroup v1 (hybrid hosts — this environment): memory and cpu hierarchies
+  managed in parallel; memory.limit_in_bytes / cpu.cfs_quota_us, stats from
+  memory.usage_in_bytes + cpuacct.usage.  The kernel OOM killer enforces
+  the memory limit (SIGKILL -> exit 137 -> OOMKilled status + restart).
+- null (no writable cgroupfs): limits are bookkeeping only, stats fall back
+  to the runtime's /proc sampling — FakeRuntime scale tests take this path.
+
+Processes join their pod cgroup pre-exec (the child writes itself into
+cgroup.procs between fork and exec), so grandchildren can never escape.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..utils.quantity import parse_quantity
+from .eviction import QOS_BESTEFFORT, QOS_BURSTABLE, QOS_GUARANTEED, qos_class
+
+CPU_PERIOD_US = 100_000
+
+
+def parse_milli(v) -> int:
+    return int(round(parse_quantity(v) * 1000))
+
+
+def pod_resource_totals(pod: t.Pod) -> Tuple[Optional[int], Optional[int]]:
+    """(cpu_milli_limit, memory_bytes_limit) summed over containers; None
+    when any container is unbounded for that resource (pod-level limit is
+    only enforceable if every container carries one — ref qos cgroup calc)."""
+    cpu_m = 0
+    mem = 0
+    cpu_ok = mem_ok = bool(pod.spec.containers)
+    for c in pod.spec.containers:
+        lim = c.resources.limits or {}
+        if "cpu" in lim:
+            cpu_m += parse_milli(lim["cpu"])
+        else:
+            cpu_ok = False
+        if "memory" in lim:
+            mem += int(parse_quantity(lim["memory"]))
+        else:
+            mem_ok = False
+    return (cpu_m if cpu_ok else None), (mem if mem_ok else None)
+
+
+class _Backend:
+    """One cgroup filesystem flavor. Paths are relative to the node root."""
+
+    name = "null"
+
+    def ensure(self, rel: str):  # create the cgroup dir(s)
+        pass
+
+    def remove(self, rel: str):
+        pass
+
+    def set_limits(self, rel: str, cpu_milli: Optional[int], mem_bytes: Optional[int]):
+        pass
+
+    def procs_file(self, rel: str) -> Optional[str]:
+        """cgroup.procs path a child process writes itself into (None = no
+        enforcement)."""
+        return None
+
+    def stats(self, rel: str) -> Optional[Dict[str, float]]:
+        """{"cpu_ns_total": N, "memory": bytes} or None."""
+        return None
+
+    def oom_kill_count(self, rel: str) -> int:
+        return 0
+
+
+class _V2Backend(_Backend):
+    name = "cgroup2"
+
+    def __init__(self, root: str, fs_root: str):
+        self.root = root        # e.g. /sys/fs/cgroup/ktpu/<node>
+        self.fs_root = fs_root  # the cgroup2 mount itself
+
+    def _p(self, rel: str) -> str:
+        return os.path.join(self.root, rel) if rel else self.root
+
+    def ensure(self, rel: str):
+        path = self._p(rel)
+        os.makedirs(path, exist_ok=True)
+        # v2 delegation: every ancestor must enable the controllers in its
+        # subtree_control before children see memory.max/cpu.max (the "no
+        # internal processes" rule keeps our intermediate dirs process-free,
+        # so these writes are legal)
+        cur = self.fs_root
+        parts = os.path.relpath(path, self.fs_root).split(os.sep)
+        for part in [None] + parts[:-1]:
+            if part is not None:
+                cur = os.path.join(cur, part)
+            _write(os.path.join(cur, "cgroup.subtree_control"), "+memory +cpu")
+
+    def remove(self, rel: str):
+        try:
+            os.rmdir(self._p(rel))
+        except OSError:
+            pass
+
+    def set_limits(self, rel, cpu_milli, mem_bytes):
+        base = self._p(rel)
+        if mem_bytes is not None:
+            _write(os.path.join(base, "memory.max"), str(mem_bytes))
+        if cpu_milli is not None:
+            quota = max(1000, cpu_milli * CPU_PERIOD_US // 1000)
+            _write(os.path.join(base, "cpu.max"), f"{quota} {CPU_PERIOD_US}")
+
+    def procs_file(self, rel):
+        return os.path.join(self._p(rel), "cgroup.procs")
+
+    def stats(self, rel):
+        base = self._p(rel)
+        try:
+            mem = float(open(os.path.join(base, "memory.current")).read())
+            cpu_us = 0.0
+            for line in open(os.path.join(base, "cpu.stat")):
+                if line.startswith("usage_usec"):
+                    cpu_us = float(line.split()[1])
+                    break
+            return {"cpu_ns_total": cpu_us * 1000.0, "memory": mem}
+        except OSError:
+            return None
+
+    def oom_kill_count(self, rel):
+        # memory.events at the pod level is hierarchical on v2 (includes
+        # container sub-cgroups)
+        try:
+            for line in open(os.path.join(self._p(rel), "memory.events")):
+                if line.startswith("oom_kill"):
+                    return int(line.split()[1])
+        except OSError:
+            pass
+        return 0
+
+
+class _V1Backend(_Backend):
+    """Hybrid hosts: memory + cpu (+ separately-mounted cpuacct) v1
+    hierarchies managed in parallel."""
+
+    name = "cgroup1"
+
+    def __init__(self, mem_root: str, cpu_root: str, cpuacct_root: str = ""):
+        self.mem_root = mem_root
+        self.cpu_root = cpu_root
+        # cpuacct co-mounted with cpu -> empty; separate mount -> its own
+        # hierarchy that processes must ALSO join for usage accounting
+        self.cpuacct_root = cpuacct_root
+
+    def _roots(self) -> List[str]:
+        roots = [self.mem_root, self.cpu_root]
+        if self.cpuacct_root:
+            roots.append(self.cpuacct_root)
+        return roots
+
+    def _paths(self, rel: str) -> List[str]:
+        return [os.path.join(r, rel) if rel else r for r in self._roots()]
+
+    def ensure(self, rel: str):
+        for p in self._paths(rel):
+            os.makedirs(p, exist_ok=True)
+
+    def remove(self, rel: str):
+        for p in self._paths(rel):
+            try:
+                os.rmdir(p)
+            except OSError:
+                pass
+
+    def set_limits(self, rel, cpu_milli, mem_bytes):
+        mem_dir, cpu_dir = self._paths(rel)[:2]
+        if mem_bytes is not None:
+            _write(os.path.join(mem_dir, "memory.limit_in_bytes"), str(mem_bytes))
+        if cpu_milli is not None:
+            _write(os.path.join(cpu_dir, "cpu.cfs_period_us"), str(CPU_PERIOD_US))
+            quota = max(1000, cpu_milli * CPU_PERIOD_US // 1000)
+            _write(os.path.join(cpu_dir, "cpu.cfs_quota_us"), str(quota))
+
+    def procs_file(self, rel):
+        # the child joins memory; cpu joined via a second write (see
+        # ContainerManager.preexec_files)
+        return os.path.join(self._paths(rel)[0], "cgroup.procs")
+
+    def procs_files(self, rel) -> List[str]:
+        return [os.path.join(p, "cgroup.procs") for p in self._paths(rel)]
+
+    def stats(self, rel):
+        paths = self._paths(rel)
+        mem_dir = paths[0]
+        # cpuacct.usage lives in the cpuacct hierarchy when separately
+        # mounted, else co-mounted with cpu
+        acct_dir = paths[2] if len(paths) > 2 else paths[1]
+        try:
+            mem = float(open(os.path.join(mem_dir, "memory.usage_in_bytes")).read())
+            acct = os.path.join(acct_dir, "cpuacct.usage")
+            cpu_ns = float(open(acct).read()) if os.path.exists(acct) else 0.0
+            return {"cpu_ns_total": cpu_ns, "memory": mem}
+        except OSError:
+            return None
+
+    def oom_kill_count(self, rel):
+        # memory.oom_control's oom_kill counter, not failcnt — failcnt also
+        # ticks on reclaim-able limit hits that killed nothing.  v1 counters
+        # are per-cgroup, so sum the pod dir and its container children
+        # (the victim is charged where its tasks live).
+        mem_dir = self._paths(rel)[0]
+        dirs = [mem_dir]
+        try:
+            dirs += [os.path.join(mem_dir, d) for d in os.listdir(mem_dir)
+                     if os.path.isdir(os.path.join(mem_dir, d))]
+        except OSError:
+            pass
+        total = 0
+        for d in dirs:
+            try:
+                for line in open(os.path.join(d, "memory.oom_control")):
+                    if line.startswith("oom_kill "):
+                        total += int(line.split()[1])
+            except OSError:
+                continue
+        return total
+
+
+def _write(path: str, value: str):
+    try:
+        with open(path, "w") as f:
+            f.write(value)
+    except OSError:
+        pass  # controller knob absent on this kernel — best effort
+
+
+def null_backend() -> _Backend:
+    """No-op backend: limits are bookkeeping only (hollow-node scale tests)."""
+    return _Backend()
+
+
+def detect_backend(node_name: str, cgroup_root: str = "/sys/fs/cgroup") -> _Backend:
+    """Pick the strongest *proven* flavor: unified v2 whose delegation
+    actually surfaces memory.max in a probe child > hybrid v1 with a
+    writable memory hierarchy > null."""
+    sub = os.path.join("ktpu", node_name)
+    ctrl_file = os.path.join(cgroup_root, "cgroup.controllers")
+    if os.path.exists(ctrl_file):
+        try:
+            controllers = open(ctrl_file).read().split()
+            if "memory" in controllers and _v2_delegation_works(cgroup_root):
+                return _V2Backend(os.path.join(cgroup_root, sub), cgroup_root)
+        except OSError:
+            pass
+    # hybrid: v1 memory hierarchy writable
+    mem_root = os.path.join(cgroup_root, "memory")
+    cpu_root = os.path.join(cgroup_root, "cpu")
+    cpuacct_root = os.path.join(cgroup_root, "cpuacct")
+    if os.path.isdir(mem_root) and _writable(mem_root):
+        # cpuacct co-mounted with cpu ("cpu,cpuacct") or its own mount?
+        separate_acct = (
+            os.path.isdir(cpuacct_root)
+            and not os.path.exists(os.path.join(cpu_root, "cpuacct.usage"))
+        )
+        return _V1Backend(
+            os.path.join(mem_root, sub),
+            os.path.join(cpu_root, sub),
+            os.path.join(cpuacct_root, sub) if separate_acct else "",
+        )
+    return null_backend()
+
+
+def _v2_delegation_works(cgroup_root: str) -> bool:
+    """Enabling +memory in root subtree_control must make memory.max appear
+    in a probe child — claiming enforcement that silently isn't real is
+    worse than none."""
+    probe = os.path.join(cgroup_root, f"ktpu-probe-{os.getpid()}")
+    try:
+        os.mkdir(probe)
+    except OSError:
+        return False
+    try:
+        _write(os.path.join(cgroup_root, "cgroup.subtree_control"), "+memory +cpu")
+        return os.path.exists(os.path.join(probe, "memory.max"))
+    finally:
+        try:
+            os.rmdir(probe)
+        except OSError:
+            pass
+
+
+def _writable(root: str) -> bool:
+    probe = os.path.join(root, f".ktpu-probe-{os.getpid()}")
+    try:
+        os.mkdir(probe)
+        os.rmdir(probe)
+        return True
+    except OSError:
+        return False
+
+
+class ContainerManager:
+    """Owns the node's cgroup tree (ref container_manager_linux.go:619).
+
+    The kubelet calls `ensure_pod_cgroup` before starting containers and
+    hands the returned join files to the runtime; `pod_stats` feeds the
+    stats pipeline with cgroup ground truth; `node_allocatable` reserves
+    system overhead out of capacity."""
+
+    QOS_DIRS = {QOS_GUARANTEED: "guaranteed", QOS_BURSTABLE: "burstable",
+                QOS_BESTEFFORT: "besteffort"}
+
+    def __init__(self, node_name: str, cgroup_root: str = "/sys/fs/cgroup",
+                 system_reserved: Optional[Dict[str, str]] = None,
+                 backend: Optional[_Backend] = None, enforce: bool = True):
+        self.node_name = node_name
+        if backend is not None:
+            self.backend = backend
+        elif enforce:
+            self.backend = detect_backend(node_name, cgroup_root)
+        else:
+            self.backend = null_backend()
+        self.system_reserved = system_reserved or {}
+        self._lock = threading.Lock()
+        self._pod_rel: Dict[str, str] = {}  # uid -> qos/pod<uid>
+        self._cpu_samples: Dict[str, Tuple[float, float]] = {}
+        if self.backend.name != "null":
+            for qos_dir in self.QOS_DIRS.values():
+                self.backend.ensure(qos_dir)
+
+    @property
+    def enforcing(self) -> bool:
+        return self.backend.name != "null"
+
+    # -------------------------------------------------------- pod lifecycle
+
+    def ensure_pod_cgroup(self, pod: t.Pod):
+        """Create the pod cgroup under its QoS parent and apply the summed
+        container limits (ref qos_container_manager: pod-level enforcement,
+        containers nested under it)."""
+        if not self.enforcing:
+            return
+        uid = pod.metadata.uid
+        with self._lock:
+            if uid in self._pod_rel:
+                return  # already ensured this kubelet incarnation
+        rel = f"{self.QOS_DIRS[qos_class(pod)]}/pod{uid}"
+        self.backend.ensure(rel)
+        cpu_milli, mem_bytes = pod_resource_totals(pod)
+        self.backend.set_limits(rel, cpu_milli, mem_bytes)
+        with self._lock:
+            self._pod_rel[uid] = rel
+
+    def container_join_files(self, pod: t.Pod, container: t.Container) -> List[str]:
+        """Per-container child cgroup under the pod's (inherits the pod
+        limits; container-level limits applied when set); returns the
+        cgroup.procs files the starting process writes itself into."""
+        if not self.enforcing:
+            return []
+        self.ensure_pod_cgroup(pod)
+        uid = pod.metadata.uid
+        with self._lock:
+            pod_rel = self._pod_rel[uid]
+        rel = f"{pod_rel}/{container.name}"
+        self.backend.ensure(rel)
+        lim = container.resources.limits or {}
+        self.backend.set_limits(
+            rel,
+            parse_milli(lim["cpu"]) if "cpu" in lim else None,
+            int(parse_quantity(lim["memory"])) if "memory" in lim else None,
+        )
+        if isinstance(self.backend, _V1Backend):
+            return self.backend.procs_files(rel)
+        pf = self.backend.procs_file(rel)
+        return [pf] if pf else []
+
+    def remove_pod_cgroup(self, uid: str):
+        with self._lock:
+            rel = self._pod_rel.pop(uid, None)
+            for k in [k for k in self._cpu_samples if k[0] == uid]:
+                self._cpu_samples.pop(k, None)
+        if rel:
+            # children first (rmdir requires empty dirs); ignore busy dirs —
+            # a re-sync retries after the processes die
+            for sub in self._list_children(rel):
+                self.backend.remove(f"{rel}/{sub}")
+            self.backend.remove(rel)
+
+    def _list_children(self, rel: str) -> List[str]:
+        roots = []
+        if isinstance(self.backend, _V1Backend):
+            roots = self.backend._paths(rel)
+        elif isinstance(self.backend, _V2Backend):
+            roots = [self.backend._p(rel)]
+        out = set()
+        for root in roots:
+            try:
+                out.update(d for d in os.listdir(root)
+                           if os.path.isdir(os.path.join(root, d)))
+            except OSError:
+                pass
+        return sorted(out)
+
+    def oom_kill_count(self, uid: str) -> int:
+        """Cumulative kernel OOM kills charged to this pod's cgroup subtree.
+        Callers diff against a baseline — the counter never resets, so a
+        single historic OOM must not label every later SIGKILL."""
+        with self._lock:
+            rel = self._pod_rel.get(uid)
+        return self.backend.oom_kill_count(rel) if rel else 0
+
+    # -------------------------------------------------------------- stats
+
+    def _rated_stats(self, key: tuple, rel: str) -> Optional[Dict[str, float]]:
+        raw = self.backend.stats(rel)
+        if raw is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            last = self._cpu_samples.get(key)
+            self._cpu_samples[key] = (raw["cpu_ns_total"], now)
+        cpu = 0.0
+        if last is not None and now > last[1]:
+            cpu = max(0.0, (raw["cpu_ns_total"] - last[0]) / 1e9 / (now - last[1]))
+        return {"cpu": cpu, "memory": raw["memory"]}
+
+    def pod_stats(self, uid: str) -> Optional[Dict[str, float]]:
+        """{"cpu": cores, "memory": bytes} from the pod cgroup (hierarchical
+        — includes every process of every container); cpu is a rate from
+        cumulative-usage deltas between calls (cadvisor's method)."""
+        with self._lock:
+            rel = self._pod_rel.get(uid)
+        if rel is None:
+            return None
+        return self._rated_stats((uid, ""), rel)
+
+    def container_stats(self, uid: str, container_name: str) -> Optional[Dict[str, float]]:
+        """Cgroup ground truth for one container (its child cgroup)."""
+        with self._lock:
+            rel = self._pod_rel.get(uid)
+        if rel is None:
+            return None
+        return self._rated_stats((uid, container_name), f"{rel}/{container_name}")
+
+    def cleanup(self):
+        """Best-effort teardown of this node's whole cgroup subtree (kubelet
+        stop); cgroups with live processes survive and are re-adopted."""
+        if not self.enforcing:
+            return
+        with self._lock:
+            uids = list(self._pod_rel)
+        for uid in uids:
+            self.remove_pod_cgroup(uid)
+        for qos_dir in self.QOS_DIRS.values():
+            self.backend.remove(qos_dir)
+        self.backend.remove("")
+
+    # -------------------------------------------------- node allocatable
+
+    def node_allocatable(self, capacity: Dict[str, str]) -> Dict[str, str]:
+        """allocatable = capacity - system reserved (ref:
+        node_container_manager.go; scheduling works against this)."""
+        out = dict(capacity)
+        for res, reserved in self.system_reserved.items():
+            if res not in capacity:
+                continue
+            if res == "cpu":
+                left = parse_milli(capacity[res]) - parse_milli(reserved)
+                out[res] = f"{max(0, left)}m"
+            else:
+                left = parse_quantity(capacity[res]) - parse_quantity(reserved)
+                out[res] = str(int(max(0, left)))
+        return out

@@ -39,6 +39,7 @@ from .runtime import (
     ContainerConfig,
     RuntimeService,
 )
+from .containermanager import ContainerManager
 from .volumemanager import VolumeError, VolumeManager, VolumeNotReady
 
 
@@ -64,6 +65,8 @@ class Kubelet:
         server_port: Optional[int] = 0,  # 0 = ephemeral; None = no server
         server_token: str = "",
         volume_root: Optional[str] = None,
+        enforce_cgroups: Optional[bool] = None,  # None = auto (real runtimes only)
+        system_reserved: Optional[Dict[str, str]] = None,
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -90,6 +93,16 @@ class Kubelet:
             ),
             node_name=node_name,
         )
+        # cgroup enforcement only makes sense for runtimes with real
+        # processes: hollow/Fake runtimes (30k-pod scale tests) must not
+        # create 30k cgroup dirs.  ProcessRuntime advertises via real_pids.
+        if enforce_cgroups is None:
+            enforce_cgroups = bool(getattr(runtime, "real_pids", False))
+        self.container_manager = ContainerManager(
+            node_name,
+            system_reserved=system_reserved,
+            enforce=enforce_cgroups,
+        )
 
         self.pods = SharedInformer(
             clientset.pods, field_selector=f"spec.nodeName={node_name}"
@@ -104,6 +117,8 @@ class Kubelet:
         self._last_status: Dict[str, dict] = {}  # uid -> last PUT status dict
         self._pleg_state: Dict[str, str] = {}
         self._mount_warned: set = set()  # uids with a FailedMount event emitted
+        self._oom_baseline: Dict[str, int] = {}   # uid -> consumed oom_kill count
+        self._oom_marked: set = set()             # (uid, container_id) OOMKilled
         self._heartbeat_event = threading.Event()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -196,6 +211,7 @@ class Kubelet:
         self.pods.stop()
         self.device_manager.stop()
         self.prober.stop()
+        self.container_manager.cleanup()
         if self.server is not None:
             self.server.stop()
 
@@ -266,7 +282,8 @@ class Kubelet:
 
     def _fill_status(self, node: t.Node):
         node.status.capacity = dict(self.capacity)
-        node.status.allocatable = dict(self.capacity)
+        node.status.allocatable = self.container_manager.node_allocatable(
+            self.capacity)
         now = now_iso()
         node.status.conditions = [
             t.NodeCondition(
@@ -353,6 +370,14 @@ class Kubelet:
 
     # -------------------------------------------------------- stats pipeline
 
+    def _container_usage(self, pod_uid: str, cname: str, cid: str) -> Dict[str, float]:
+        """Cgroup ground truth when enforced (counts the whole process tree,
+        not just the direct child), else the runtime's own sampling."""
+        cg = self.container_manager.container_stats(pod_uid, cname)
+        if cg is not None:
+            return cg
+        return self.runtime.container_stats(cid)
+
     @staticmethod
     def _fmt_usage(stats: Dict[str, float]) -> Dict[str, str]:
         return {
@@ -399,7 +424,7 @@ class Kubelet:
                 }
             containers = []
             for cname, cid in sorted(cids.items()):
-                stats = self.runtime.container_stats(cid)
+                stats = self._container_usage(pod.metadata.uid, cname, cid)
                 node_cpu += stats.get("cpu", 0.0)
                 node_mem += stats.get("memory", 0.0)
                 containers.append({
@@ -407,10 +432,17 @@ class Kubelet:
                     "cpu_cores": round(stats.get("cpu", 0.0), 4),
                     "memory_bytes": int(stats.get("memory", 0.0)),
                 })
-            pods_out.append({
+            entry = {
                 "pod": pod.key(),
                 "containers": containers,
-            })
+            }
+            pod_cg = self.container_manager.pod_stats(pod.metadata.uid)
+            if pod_cg is not None:
+                entry["cgroup"] = {
+                    "cpu_cores": round(pod_cg["cpu"], 4),
+                    "memory_bytes": int(pod_cg["memory"]),
+                }
+            pods_out.append(entry)
         return {
             "node": {
                 "nodeName": self.node_name,
@@ -442,7 +474,7 @@ class Kubelet:
             pm.metadata.name = pod.metadata.name
             pm.metadata.namespace = pod.metadata.namespace
             for cname, cid in sorted(cids.items()):
-                stats = self.runtime.container_stats(cid)
+                stats = self._container_usage(pod.metadata.uid, cname, cid)
                 node_cpu += stats.get("cpu", 0.0)
                 node_mem += stats.get("memory", 0.0)
                 pm.containers.append(
@@ -534,6 +566,7 @@ class Kubelet:
                         self._containers.pop(k, None)
                 self.device_manager.forget_pod(sb.pod_uid)
                 self.volume_manager.teardown_pod(sb.pod_uid)
+                self.container_manager.remove_pod_cgroup(sb.pod_uid)
                 self._prune_pod_state(sb.pod_uid)
 
     # -------------------------------------------------------------- syncPod
@@ -569,6 +602,10 @@ class Kubelet:
         except VolumeError as e:
             self._set_failed(pod, "FailedMount", str(e))
             return
+
+        # idempotent (one-time per incarnation): also re-registers adopted
+        # pods' cgroups after a kubelet restart so stats/OOM detection work
+        self.container_manager.ensure_pod_cgroup(pod)
 
         sandbox_id = self._ensure_sandbox(pod)
         self._sync_containers(pod, sandbox_id)
@@ -639,6 +676,8 @@ class Kubelet:
             devices=devices,
             mounts=mounts,
             annotations=annotations,
+            cgroup_procs_files=self.container_manager.container_join_files(
+                pod, container),
         )
 
     def _sync_containers(self, pod: t.Pod, sandbox_id: str):
@@ -759,6 +798,7 @@ class Kubelet:
                     self._containers.pop(k, None)
         self.device_manager.forget_pod(uid)
         self.volume_manager.teardown_pod(uid)
+        self.container_manager.remove_pod_cgroup(uid)
         self._prune_pod_state(uid)
         try:
             self.cs.pods.delete(
@@ -773,6 +813,9 @@ class Kubelet:
         self.prober.remove_pod(uid)
         self._mount_warned.discard(uid)
         with self._lock:
+            self._oom_baseline.pop(uid, None)
+            for k in [k for k in self._oom_marked if k[0] == uid]:
+                self._oom_marked.discard(k)
             self._admitted.pop(uid, None)
             self._admit_first_seen.pop(uid, None)
             self._last_status.pop(uid, None)
@@ -829,9 +872,26 @@ class Kubelet:
                 )
             elif record.state == CONTAINER_EXITED:
                 cs.container_id = record.id
+                reason = "Completed" if record.exit_code == 0 else "Error"
+                # SIGKILL + a NEW kill recorded in the pod's memory cgroup =
+                # the kernel OOM killer enforced the limit.  The counter is
+                # cumulative, so each kill is attributed to exactly one
+                # container instance — a historic OOM must not relabel later
+                # kubelet-initiated SIGKILLs.
+                if record.exit_code in (137, -9):
+                    ckey2 = (uid, record.id)
+                    with self._lock:
+                        if ckey2 in self._oom_marked:
+                            reason = "OOMKilled"
+                        else:
+                            count = self.container_manager.oom_kill_count(uid)
+                            if count > self._oom_baseline.get(uid, 0):
+                                self._oom_baseline[uid] = count
+                                self._oom_marked.add(ckey2)
+                                reason = "OOMKilled"
                 cs.state.terminated = t.ContainerStateTerminated(
                     exit_code=record.exit_code or 0,
-                    reason="Completed" if record.exit_code == 0 else "Error",
+                    reason=reason,
                     started_at=_iso(record.started_at),
                     finished_at=_iso(record.finished_at),
                 )

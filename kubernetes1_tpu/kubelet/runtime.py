@@ -56,6 +56,9 @@ class ContainerConfig:
     devices: List[dict] = field(default_factory=list)
     mounts: List[dict] = field(default_factory=list)
     annotations: Dict[str, str] = field(default_factory=dict)
+    # cgroup.procs files the starting process must join (the CRI
+    # cgroup_parent analog; empty = no cgroup enforcement)
+    cgroup_procs_files: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -312,6 +315,20 @@ def _probe_mount_ns() -> bool:
         return False
 
 
+def _wrap_with_cgroups(cmd: List[str], procs_files: List[str]) -> List[str]:
+    """Prefix `cmd` with a cgroup-join preamble: the sh writes itself into
+    every cgroup.procs file, then execs the real command in place (same
+    pid), so the whole future process tree is confined."""
+    import shlex
+
+    lines = []
+    for pf in procs_files:
+        # best-effort per file: a missing controller must not fail the start
+        lines.append(f"echo 0 > {shlex.quote(pf)} 2>/dev/null || true")
+    lines.append('exec "$@"')
+    return ["sh", "-c", "\n".join(lines), "sh"] + list(cmd)
+
+
 def _wrap_with_mounts(cmd: List[str], mounts: List[dict]) -> List[str]:
     """Prefix `cmd` with an unshare+bind preamble realizing `mounts`
     ({host_path, container_path, read_only}) in a private mount namespace.
@@ -348,6 +365,8 @@ class ProcessRuntime(RuntimeService):
     the merged pod env + device-plugin injection.  SIGTERM then SIGKILL on
     stop, honoring the grace timeout.
     """
+
+    real_pids = True  # containers are real processes -> cgroups apply
 
     def __init__(self, root_dir: str = "/tmp/ktpu"):
         self.root = root_dir
@@ -430,6 +449,12 @@ class ProcessRuntime(RuntimeService):
                 env[f"KTPU_VOLUME_{name}"] = m.get("host_path", "")
         if config.mounts and self._mount_ns:
             cmd = _wrap_with_mounts(cmd, config.mounts)
+        if config.cgroup_procs_files:
+            # the child joins its cgroups before exec (grandchildren inherit
+            # at fork, so nothing can be spawned outside); done via an sh
+            # preamble, NOT preexec_fn — Python-level I/O between fork and
+            # exec can deadlock in a process with this many threads
+            cmd = _wrap_with_cgroups(cmd, config.cgroup_procs_files)
         logf = open(c.log_path, "ab")
         proc = subprocess.Popen(
             cmd,
