@@ -28,6 +28,7 @@ _REGISTRY = [
     (t.LimitRange, "limitranges", True),
     (t.HorizontalPodAutoscaler, "horizontalpodautoscalers", True),
     (t.PodDisruptionBudget, "poddisruptionbudgets", True),
+    (t.Eviction, "evictions", True),
     (t.PersistentVolume, "persistentvolumes", False),
     (t.PersistentVolumeClaim, "persistentvolumeclaims", True),
     (t.CertificateSigningRequest, "certificatesigningrequests", False),

@@ -994,6 +994,18 @@ class PodDisruptionBudget(KObject):
     status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
 
 
+@dataclass
+class Eviction(KObject):
+    """Eviction subresource payload (ref: policy/v1beta1 Eviction,
+    pkg/registry/core/pod/storage/eviction.go:57): POST to
+    /pods/<name>/eviction deletes the pod only if no matching
+    PodDisruptionBudget would be violated; 429 otherwise."""
+
+    KIND = "Eviction"
+    API_VERSION = "policy/v1"
+    grace_period_seconds: Optional[int] = None
+
+
 # ------------------------------------------------------------------ volumes
 
 

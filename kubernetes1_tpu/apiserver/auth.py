@@ -183,11 +183,14 @@ class RBACAuthorizer:
         )
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
-                  namespace: str, name: str) -> bool:
+                  namespace: str, name: str, sub: str = "") -> bool:
+        # upstream semantics: a rule granting "pods" does NOT grant
+        # "pods/eviction" or "pods/exec" — subresources are named explicitly
+        effective = f"{resource}/{sub}" if sub else resource
         for rule in self._rules_for(user, namespace):
             if not _match(rule.verbs, verb):
                 continue
-            if not _match(rule.resources, resource):
+            if not _match(rule.resources, effective):
                 continue
             if rule.resource_names and name and name not in rule.resource_names:
                 continue
@@ -233,8 +236,12 @@ class NodeAuthorizer:
         return False
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
-                  namespace: str, name: str) -> bool:
+                  namespace: str, name: str, sub: str = "") -> bool:
         if not user.in_group(GROUP_NODES) or not user.name.startswith("system:node:"):
+            return False
+        if sub and sub != "status":
+            # nodes write status subresources; they never bind, evict, or
+            # exec through the API
             return False
         node_name = user.name[len("system:node:"):]
         if resource in self.REFERENCED_READ_RESOURCES:
@@ -272,7 +279,7 @@ class NodeAuthorizer:
 
 
 class AlwaysAllowAuthorizer:
-    def authorize(self, *args) -> bool:
+    def authorize(self, *args, **kwargs) -> bool:
         return True
 
 
@@ -281,11 +288,11 @@ class AuthorizerChain:
         self.authorizers = authorizers
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
-                  namespace: str, name: str) -> bool:
+                  namespace: str, name: str, sub: str = "") -> bool:
         if user.in_group(GROUP_MASTERS):
             return True
         return any(
-            a.authorize(user, verb, resource, namespace, name)
+            a.authorize(user, verb, resource, namespace, name, sub=sub)
             for a in self.authorizers
         )
 

@@ -565,6 +565,66 @@ class Registry:
         except StopUpdate:
             return pod
 
+    # PDB CAS retries against the disruption controller (ref eviction.go:57
+    # retries EvictionsRetry times on resourceVersion races)
+    EVICTION_PDB_RETRIES = 10
+
+    def evict(self, namespace: str, name: str, eviction: Optional[t.Eviction] = None):
+        """Eviction subresource: delete the pod only if no matching
+        PodDisruptionBudget would be violated; the budget is consumed with a
+        CAS decrement so concurrent evictions can't oversubscribe it
+        (ref: pkg/registry/core/pod/storage/eviction.go:57)."""
+        pod = self.store.get(self.key("pods", namespace, name))
+        # already-terminating or finished pods consume no budget — their
+        # disruption has happened
+        charging = (
+            not pod.metadata.deletion_timestamp
+            and pod.status.phase not in (t.POD_SUCCEEDED, t.POD_FAILED)
+        )
+        if charging:
+            pdbs, _ = self.list("poddisruptionbudgets", namespace)
+            matching = [
+                p for p in pdbs
+                if p.spec.selector is not None
+                and labelutil.label_selector_matches(p.spec.selector, pod.metadata.labels)
+            ]
+            if len(matching) > 1:
+                raise Invalid(
+                    f"pod {name} matches multiple PodDisruptionBudgets; "
+                    f"eviction cannot arbitrate"
+                )
+            if matching:
+                self._consume_disruption(matching[0])
+        grace = eviction.grace_period_seconds if eviction is not None else None
+        return self.delete("pods", namespace, name, grace_seconds=grace)
+
+    def _consume_disruption(self, pdb: t.PodDisruptionBudget):
+        from ..machinery import TooManyRequests
+
+        ns, pdb_name = pdb.metadata.namespace, pdb.metadata.name
+        for _ in range(self.EVICTION_PDB_RETRIES):
+            fresh = self.get("poddisruptionbudgets", ns, pdb_name)
+            if (fresh.metadata.generation
+                    and fresh.status.observed_generation < fresh.metadata.generation):
+                raise TooManyRequests(
+                    f"pod disruption budget {pdb_name} is stale "
+                    f"(status lags spec); retry later"
+                )
+            if fresh.status.disruptions_allowed <= 0:
+                raise TooManyRequests(
+                    f"cannot evict pod as it would violate the pod "
+                    f"disruption budget {pdb_name}"
+                )
+            fresh.status.disruptions_allowed -= 1
+            try:
+                self.update_status("poddisruptionbudgets", ns, pdb_name, fresh)
+                return
+            except Conflict:
+                continue  # disruption controller or a parallel eviction won
+        raise TooManyRequests(
+            f"too many concurrent evictions against {pdb_name}; retry"
+        )
+
     def _delete_namespace(self, ns):
         """Namespace deletion: mark Terminating; the namespace controller
         empties it and then finalizes with force=True."""

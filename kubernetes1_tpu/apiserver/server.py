@@ -135,8 +135,9 @@ class _Handler(BaseHTTPRequestHandler):
         if isinstance(obj, _U) and resource not in scheme.dynamic_resources:
             raise BadRequest(f"resource {resource!r} requires a typed {want_kind!r} body")
 
-    def _authz(self, user: UserInfo, verb: str, resource: str, ns: str, name: str):
-        if not self.master.authorizer.authorize(user, verb, resource, ns, name):
+    def _authz(self, user: UserInfo, verb: str, resource: str, ns: str, name: str,
+               sub: str = ""):
+        if not self.master.authorizer.authorize(user, verb, resource, ns, name, sub=sub):
             raise Forbidden(
                 f'user "{user.name}" cannot {verb} {resource}'
                 + (f' "{name}"' if name else "")
@@ -248,11 +249,11 @@ class _Handler(BaseHTTPRequestHandler):
                 else None
             )
             if apisvc is not None:
-                a_resource, a_ns, a_name, _ = self._parse_resource_path(parts)
+                a_resource, a_ns, a_name, a_sub = self._parse_resource_path(parts)
                 self._authz(
                     user,
                     verb_for(method, a_name, q.get("watch") in ("1", "true")),
-                    a_resource, a_ns, a_name,
+                    a_resource, a_ns, a_name, a_sub,
                 )
                 self._proxy_to_apiservice(apisvc, method)
                 return
@@ -263,7 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
             if resource not in self.master.scheme.by_resource:
                 raise NotFound(f"resource {resource!r} not registered")
             verb = verb_for(method, name, q.get("watch") in ("1", "true"))
-            self._authz(user, verb, resource, ns, name)
+            self._authz(user, verb, resource, ns, name, sub)
             handler = getattr(self, f"_do_{method.lower()}")
             handler(resource, ns, name, sub, q)
             self.master.metrics.observe(method, resource, time.monotonic() - start)
@@ -407,6 +408,20 @@ class _Handler(BaseHTTPRequestHandler):
             pod = reg.bind(ns, name, binding)
             self.master.audit("bind", resource, ns, name, self._user.name)
             self._send_json(201, self.master.scheme.encode(pod))
+            return
+        if resource == "pods" and sub == "eviction":
+            eviction = None
+            if body:
+                if body.get("kind") not in (None, "", "Eviction"):
+                    raise BadRequest(
+                        f"eviction body must be kind Eviction, got {body.get('kind')!r}"
+                    )
+                decoded = self.master.scheme.decode(body)
+                if hasattr(decoded, "grace_period_seconds"):
+                    eviction = decoded
+            evicted = reg.evict(ns, name, eviction)
+            self.master.audit("evict", resource, ns, name, self._user.name)
+            self._send_json(201, self.master.scheme.encode(evicted))
             return
         if sub:
             raise NotFound(f"subresource {sub!r} not writable")

@@ -186,17 +186,53 @@ class CLI:
         print(f"node/{args.node} uncordoned", file=self.out)
 
     def drain(self, args):
+        """Cordon + evict through the eviction subresource, so
+        PodDisruptionBudgets are honored: pods whose budget is exhausted are
+        retried until their peers become healthy elsewhere (ref: kubectl
+        drain + eviction.go)."""
+        from ..machinery import TooManyRequests
+
         self._set_unschedulable(args.node, True)
         pods, _ = self.cs.pods.list(field_selector=f"spec.nodeName={args.node}")
+        pending = []
         for p in pods:
             owners = {o.kind for o in p.metadata.owner_references}
             if "DaemonSet" in owners and not args.force:
                 continue
-            try:
-                self.cs.pods.delete(p.metadata.name, p.metadata.namespace, grace_seconds=0)
-            except NotFound:
-                continue  # already gone (e.g. its controller was deleted)
-            print(f"pod/{p.metadata.name} evicted", file=self.out)
+            pending.append(p)
+        deadline = time.time() + getattr(args, "timeout", 60)
+        blocked: dict = {}
+        while pending:
+            still = []
+            for p in pending:
+                try:
+                    self.cs.evict(p.metadata.namespace, p.metadata.name,
+                                  grace_seconds=0)
+                    print(f"pod/{p.metadata.name} evicted", file=self.out)
+                except NotFound:
+                    continue  # already gone (e.g. its controller was deleted)
+                except TooManyRequests as e:
+                    still.append(p)
+                    blocked[p.metadata.name] = str(e)
+            pending = still
+            if not pending or time.time() >= deadline:
+                break
+            time.sleep(1.0)
+        if pending:
+            # every leftover is reported, and the node is NOT declared
+            # drained — scripted maintenance must see the failure
+            for p in pending:
+                print(
+                    f"pod/{p.metadata.name} NOT evicted: "
+                    f"{blocked.get(p.metadata.name, 'eviction blocked')}",
+                    file=self.out,
+                )
+            print(
+                f"node/{args.node} drain INCOMPLETE: {len(pending)} pod(s) "
+                f"blocked by disruption budgets",
+                file=self.out,
+            )
+            raise SystemExit(1)
         print(f"node/{args.node} drained", file=self.out)
 
     # ------------------------------------------------------------------ top
@@ -383,6 +419,8 @@ def build_parser() -> argparse.ArgumentParser:
         c.add_argument("node")
         if verb == "drain":
             c.add_argument("--force", action="store_true")
+            c.add_argument("--timeout", type=int, default=60,
+                           help="seconds to keep retrying PDB-blocked evictions")
 
     tp = sub.add_parser("top")
     tp.add_argument("what", choices=["nodes", "pods"])

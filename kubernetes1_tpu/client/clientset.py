@@ -273,5 +273,19 @@ class Clientset:
         )
         return self.scheme.decode(data)
 
+    def evict(self, namespace: str, pod_name: str,
+              grace_seconds: "Optional[int]" = None):
+        """Eviction subresource: voluntary, PDB-respecting pod removal.
+        Raises TooManyRequests (429) when the disruption budget is spent."""
+        ev = t.Eviction(grace_period_seconds=grace_seconds)
+        ev.metadata.name = pod_name
+        ev.metadata.namespace = namespace
+        data = self.api.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{pod_name}/eviction",
+            body=self.scheme.encode(ev),
+        )
+        return self.scheme.decode(data)
+
     def close(self):
         self.api.close()

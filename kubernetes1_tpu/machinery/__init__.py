@@ -15,6 +15,7 @@ from .errors import (
     Conflict,
     Invalid,
     TooOldResourceVersion,
+    TooManyRequests,
     BadRequest,
     Forbidden,
     Unauthorized,

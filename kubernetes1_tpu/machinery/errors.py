@@ -91,8 +91,16 @@ class TooOldResourceVersion(ApiError):
     reason = "Expired"
 
 
+class TooManyRequests(ApiError):
+    """Disruption not currently allowed (eviction vs PDB); retriable later
+    (ref: eviction.go returns 429 when the budget is exhausted)."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+
 _BY_REASON = {
     c.reason: c
     for c in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden,
-              Unauthorized, TooOldResourceVersion)
+              Unauthorized, TooOldResourceVersion, TooManyRequests)
 }

@@ -1,0 +1,218 @@
+"""Eviction subresource + PDB enforcement + PDB-aware preemption (ref:
+pkg/registry/core/pod/storage/eviction.go:57, kubectl drain,
+scheduler.go:209-250 preemption, and the disruption e2e suite).
+
+The VERDICT r3 'done' bar: a high-priority gang evicts a low-priority gang
+while a PDB-protected service survives; drain goes through eviction; the
+nominated node is reserved for the preemptor."""
+
+import io
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.cli import CLI
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.controllers import ControllerManager
+from kubernetes1_tpu.machinery import NotFound, TooManyRequests
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+from tests.test_controllers import start_hollow_node
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """2 TPU hosts (4 chips each, one slice) + controllers."""
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=5.0)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=5.0, eviction_timeout=5.0)
+    cm.start()
+    nodes = [
+        start_hollow_node(cs, f"tpu-{i}", str(tmp_path), tpus=4,
+                          slice_id="s0", host_index=i)
+        for i in range(2)
+    ]
+    env = {"master": master, "cs": cs, "sched": sched}
+    yield env
+    for kubelet, plugin, _ in nodes:
+        kubelet.stop()
+        plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def _pdb(name, selector_labels, min_available):
+    pdb = t.PodDisruptionBudget()
+    pdb.metadata.name = name
+    pdb.spec.selector = t.LabelSelector(match_labels=selector_labels)
+    pdb.spec.min_available = min_available
+    return pdb
+
+
+def _wait_running(cs, selector, n, timeout=30.0):
+    def check():
+        pods, _ = cs.pods.list(label_selector=selector)
+        return len([p for p in pods if p.status.phase == t.POD_RUNNING
+                    and not p.metadata.deletion_timestamp]) == n
+    must_poll_until(check, timeout=timeout, desc=f"{n} running for {selector}")
+
+
+class TestEvictionSubresource:
+    def test_eviction_respects_pdb_with_429(self, cluster):
+        cs = cluster["cs"]
+        for i in range(3):
+            p = make_tpu_pod(f"web-{i}", tpus=0)
+            p.metadata.labels = {"app": "web"}
+            p.spec.containers[0].command = ["serve"]
+            cs.pods.create(p)
+        _wait_running(cs, "app=web", 3)
+        cs.poddisruptionbudgets.create(_pdb("web-pdb", {"app": "web"}, 2))
+        must_poll_until(
+            lambda: cs.poddisruptionbudgets.get("web-pdb", "default")
+            .status.disruptions_allowed == 1,
+            timeout=15.0, desc="PDB status settles",
+        )
+        # first eviction consumes the budget
+        cs.evict("default", "web-0")
+        # the second is rejected 429 until the replacement becomes healthy
+        with pytest.raises(TooManyRequests, match="disruption budget"):
+            cs.evict("default", "web-1")
+        # pods without any PDB evict freely
+        lone = make_tpu_pod("lone", tpus=0)
+        lone.spec.containers[0].command = ["serve"]
+        cs.pods.create(lone)
+        _wait_running(cs, "", 3 + 1 - 1, timeout=30.0)  # web-1, web-2, lone (+web-0 gone)
+        cs.evict("default", "lone")
+
+    def test_drain_retries_pdb_blocked_evictions(self, cluster):
+        cs, master = cluster["cs"], cluster["master"]
+        for i in range(2):
+            p = make_tpu_pod(f"svc-{i}", tpus=0)
+            p.metadata.labels = {"app": "svc"}
+            p.spec.containers[0].command = ["serve"]
+            # pin one pod per node for a deterministic drain
+            p.spec.node_name = f"tpu-{i}"
+            cs.pods.create(p)
+        _wait_running(cs, "app=svc", 2)
+        cs.poddisruptionbudgets.create(_pdb("svc-pdb", {"app": "svc"}, 2))
+        must_poll_until(
+            lambda: cs.poddisruptionbudgets.get("svc-pdb", "default")
+            .status.expected_pods == 2,
+            timeout=15.0, desc="PDB status",
+        )
+        out = io.StringIO()
+        cli = CLI(master.url, "default", out=out)
+        # minAvailable=2 of 2 -> no disruptions allowed -> drain must fail
+        # loudly rather than deleting around the budget
+        with pytest.raises(SystemExit):
+            cli.drain(type("A", (), {"node": "tpu-0", "force": False, "timeout": 3})())
+        cli.cs.close()
+        text = out.getvalue()
+        assert "NOT evicted" in text and "disruption budget" in text
+        assert "drain INCOMPLETE" in text
+        assert cs.pods.get("svc-0", "default") is not None
+
+
+class TestPreemption:
+    def test_preemption_respects_pdb(self, cluster):
+        """A high-priority pod must NOT preempt victims whose PDB has no
+        budget — even when that leaves it pending."""
+        cs = cluster["cs"]
+        # fill both nodes' chips with protected low-priority pods
+        for i in range(2):
+            p = make_tpu_pod(f"prot-{i}", tpus=4, priority=-10)
+            p.metadata.labels = {"app": "prot"}
+            p.spec.containers[0].command = ["serve"]
+            cs.pods.create(p)
+        _wait_running(cs, "app=prot", 2)
+        cs.poddisruptionbudgets.create(_pdb("prot-pdb", {"app": "prot"}, 2))
+        must_poll_until(
+            lambda: cs.poddisruptionbudgets.get("prot-pdb", "default")
+            .status.expected_pods == 2,
+            timeout=15.0, desc="PDB status",
+        )
+        high = make_tpu_pod("vip", tpus=4, priority=100)
+        high.spec.containers[0].command = ["serve"]
+        cs.pods.create(high)
+        time.sleep(4.0)
+        pods, _ = cs.pods.list(label_selector="app=prot")
+        assert len([p for p in pods if not p.metadata.deletion_timestamp]) == 2, \
+            "PDB-protected victims were preempted"
+        assert not cs.pods.get("vip", "default").spec.node_name
+
+    def test_preemptor_lands_on_nominated_node(self, cluster):
+        cs = cluster["cs"]
+        victims = []
+        for i in range(2):
+            p = make_tpu_pod(f"low-{i}", tpus=4, priority=-10)
+            p.metadata.labels = {"app": "low"}
+            p.spec.containers[0].command = ["serve"]
+            cs.pods.create(p)
+            victims.append(p)
+        _wait_running(cs, "app=low", 2)
+        high = make_tpu_pod("boss", tpus=4, priority=100)
+        high.spec.containers[0].command = ["serve"]
+        cs.pods.create(high)
+
+        def bound():
+            p = cs.pods.get("boss", "default")
+            return bool(p.spec.node_name)
+
+        must_poll_until(bound, timeout=30.0, desc="preemptor binds")
+        boss = cs.pods.get("boss", "default")
+        # it bound to real freed chips
+        assert len(boss.spec.extended_resources[0].assigned) == 4
+        # exactly one victim fell (fewest-victims search), via eviction
+        pods, _ = cs.pods.list(label_selector="app=low")
+        alive = [p for p in pods if not p.metadata.deletion_timestamp]
+        assert len(alive) == 1
+
+
+class TestGangPreemption:
+    def test_high_priority_gang_evicts_low_priority_gang_pdb_service_survives(
+        self, cluster
+    ):
+        cs = cluster["cs"]
+        # PDB-protected service pod on one node (cpu only, no chips)
+        svc = make_tpu_pod("frontend", tpus=0)
+        svc.metadata.labels = {"app": "frontend"}
+        svc.spec.containers[0].command = ["serve"]
+        cs.pods.create(svc)
+        _wait_running(cs, "app=frontend", 1)
+        cs.poddisruptionbudgets.create(_pdb("fe-pdb", {"app": "frontend"}, 1))
+        must_poll_until(
+            lambda: cs.poddisruptionbudgets.get("fe-pdb", "default")
+            .status.expected_pods == 1,
+            timeout=15.0, desc="PDB status",
+        )
+        # low-priority gang occupies all 8 chips
+        for i in range(2):
+            p = make_tpu_pod(f"lowgang-{i}", tpus=4, priority=-100,
+                             gang="low", gang_size=2)
+            p.metadata.labels = {"app": "lowgang"}
+            p.spec.containers[0].command = ["serve"]
+            cs.pods.create(p)
+        _wait_running(cs, "app=lowgang", 2)
+        # high-priority gang needs those same 8 chips
+        for i in range(2):
+            p = make_tpu_pod(f"higang-{i}", tpus=4, priority=100,
+                             gang="hi", gang_size=2)
+            p.metadata.labels = {"app": "higang"}
+            p.spec.containers[0].command = ["serve"]
+            cs.pods.create(p)
+        _wait_running(cs, "app=higang", 2, timeout=60.0)
+        # the low gang fell as a unit
+        pods, _ = cs.pods.list(label_selector="app=lowgang")
+        assert not [p for p in pods if not p.metadata.deletion_timestamp]
+        # the PDB-protected frontend never flinched
+        fe = cs.pods.get("frontend", "default")
+        assert fe.status.phase == t.POD_RUNNING
+        assert not fe.metadata.deletion_timestamp
