@@ -141,6 +141,16 @@ class NodeRestriction(AdmissionPlugin):
                 raise Forbidden(
                     f"node {node_name!r} may only modify its own Node object"
                 )
+        if resource == "secrets":
+            # a node may publish exactly one secret: its own kubelet token
+            # (the authorizer can't pin the name on CREATE — the URL has
+            # none — so the name check lives here)
+            if (obj.metadata.namespace != "kube-system"
+                    or obj.metadata.name != f"kubelet-token-{node_name}"):
+                raise Forbidden(
+                    f"node {node_name!r} may only write its own kubelet "
+                    f"token secret"
+                )
         if resource != "pods":
             return
         if operation == CREATE:

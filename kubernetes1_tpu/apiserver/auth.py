@@ -244,6 +244,13 @@ class NodeAuthorizer:
             # exec through the API
             return False
         node_name = user.name[len("system:node:"):]
+        if resource == "secrets":
+            # its own kubelet-token secret is writable (NodeRestriction
+            # admission pins the name on CREATE, where the URL carries none)
+            if namespace == "kube-system" and (
+                not name or name == f"kubelet-token-{node_name}"
+            ) and verb in ("create", "update", "patch"):
+                return True
         if resource in self.REFERENCED_READ_RESOURCES:
             return verb == "get" and bool(name) and self._pod_references(
                 node_name, resource, namespace, name

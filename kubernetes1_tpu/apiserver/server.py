@@ -319,6 +319,12 @@ class _Handler(BaseHTTPRequestHandler):
             obj = reg.get(resource, ns, name)
             self._send_json(200, self.master.scheme.encode(obj))
             return
+        if resource == "pods" and sub == "log":
+            self._proxy_pod_log(ns, name, q)
+            return
+        if resource == "pods" and sub.lower() in ("exec", "attach", "portforward"):
+            self._proxy_pod_stream(ns, name, sub)
+            return
         if name and sub:
             raise NotFound(f"subresource {sub!r} not readable")
         if q.get("watch") in ("1", "true"):
@@ -340,6 +346,92 @@ class _Handler(BaseHTTPRequestHandler):
                 "items": [self.master.scheme.encode(o) for o in items],
             },
         )
+
+    # --------------------------------------- kubelet proxy (exec/logs/etc.)
+
+    def _kubelet_endpoint(self, node_name: str):
+        """(host, port, bearer token) for a node's kubelet server.  The
+        token comes from the node's kube-system Secret — the apiserver is
+        the trusted hop (ref: apiserver→kubelet connection for
+        exec/logs/proxy, SURVEY §1)."""
+        node = self.master.registry.get("nodes", "", node_name)
+        url = (node.metadata.annotations or {}).get("kubelet.ktpu.io/server")
+        if not url:
+            raise NotFound(f"node {node_name} advertises no kubelet endpoint")
+        try:
+            sec = self.master.registry.get(
+                "secrets", "kube-system", f"kubelet-token-{node_name}")
+            token = sec.data.get("token", "")
+        except NotFound:
+            token = ""
+        parsed = urlparse(url)
+        return parsed.hostname, parsed.port, token
+
+    def _scheduled_pod(self, ns: str, name: str):
+        pod = self.master.registry.get("pods", ns, name)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {ns}/{name} is not scheduled to a node")
+        return pod
+
+    def _proxy_pod_log(self, ns: str, name: str, q):
+        """GET pods/<name>/log — the reference's apiserver→kubelet log
+        fetch (registry/core/pod/rest/log.go)."""
+        import http.client as _http
+
+        pod = self._scheduled_pod(ns, name)
+        host, port, token = self._kubelet_endpoint(pod.spec.node_name)
+        container = q.get("container") or pod.spec.containers[0].name
+        path = f"/containerLogs/{ns}/{name}/{container}"
+        if q.get("tailLines"):
+            path += f"?tail={int(q['tailLines'])}"
+        conn = _http.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request("GET", path,
+                         headers={"Authorization": f"Bearer {token}"})
+            resp = conn.getresponse()
+            body = resp.read()
+        finally:
+            conn.close()
+        self.send_response(resp.status)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _proxy_pod_stream(self, ns: str, name: str, sub: str):
+        """exec/attach/portForward: authorize per-verb at the apiserver,
+        then splice the upgraded client connection onto the kubelet's —
+        the credential for the kubelet hop never reaches the client."""
+        from ..utils import streams
+
+        kind = {"exec": "exec", "attach": "attach",
+                "portforward": "portForward"}[sub.lower()]
+        pod = self._scheduled_pod(ns, name)
+        host, port, token = self._kubelet_endpoint(pod.spec.node_name)
+        parsed = urlparse(self.path)
+        rq = parse_qs(parsed.query)
+        if kind == "portForward":
+            kpath = f"/portForward/{ns}/{name}"
+        else:
+            container = (rq.get("container") or [""])[0] \
+                or pod.spec.containers[0].name
+            kpath = f"/{kind}/{ns}/{name}/{container}"
+        if parsed.query:
+            kpath += f"?{parsed.query}"
+        try:
+            upstream = streams.upgrade_request(
+                host, port, kpath, {"Authorization": f"Bearer {token}"})
+        except (OSError, ConnectionError) as e:
+            raise BadRequest(f"kubelet connection failed: {e}") from None
+        client_sock = streams.accept_upgrade(self)
+        if client_sock is None:
+            upstream.close()
+            raise BadRequest("expected Connection: Upgrade, "
+                             "Upgrade: ktpu-stream")
+        try:
+            streams.splice(client_sock, upstream)
+        finally:
+            upstream.close()
 
     def _serve_watch(self, resource, ns, q):
         since = int(q.get("resourceVersion") or 0)

@@ -291,54 +291,182 @@ class CLI:
             return
         raise SystemExit(f"error: unknown rollout action {args.action!r}")
 
-    # ----------------------------------------------------------------- logs
-
-    def _kubelet_base(self, pod) -> tuple:
-        """Resolve the pod's kubelet server endpoint + exec token from its
-        node's annotations (ref: server.go:1 — :10250 reached via the
-        apiserver's node proxy there; here the CLI talks to the kubelet
-        directly, and the right to read the Node object IS the authz gate)."""
-        if not pod.spec.node_name:
-            raise SystemExit("error: pod not scheduled yet")
-        node = self.cs.nodes.get(pod.spec.node_name, "")
-        base = node.metadata.annotations.get("kubelet.ktpu.io/server")
-        if not base:
-            raise SystemExit(
-                "error: node does not advertise a kubelet server endpoint")
-        return base, node.metadata.annotations.get("kubelet.ktpu.io/exec-token", "")
+    # ------------------------------------------- logs / exec / port-forward
 
     def logs(self, args):
-        pod = self.cs.pods.get(args.pod, self.ns)
-        base, _token = self._kubelet_base(pod)
-        import urllib.request
+        """GET pods/<name>/log through the apiserver (ref: kubectl logs →
+        registry/core/pod/rest/log.go; the kubelet credential stays between
+        apiserver and kubelet)."""
+        from urllib.parse import urlencode
 
-        url = (f"{base}/containerLogs/{pod.metadata.namespace}/{pod.metadata.name}"
-               f"/{args.container or pod.spec.containers[0].name}")
+        pod = self.cs.pods.get(args.pod, self.ns)
+        params = {"container": args.container or pod.spec.containers[0].name}
         if getattr(args, "tail", 0):
-            url += f"?tail={args.tail}"
-        with urllib.request.urlopen(url, timeout=10) as resp:
-            self.out.write(resp.read().decode(errors="replace"))
+            params["tailLines"] = str(args.tail)
+        data = self.cs.api.request(
+            "GET",
+            f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/log?{urlencode(params)}",
+            raw=True,
+        )
+        self.out.write(data.decode(errors="replace")
+                       if isinstance(data, bytes) else str(data))
+
+    def _stream_headers(self) -> dict:
+        token = getattr(self.cs.api, "token", "")
+        return {"Authorization": f"Bearer {token}"} if token else {}
 
     def exec_(self, args):
-        pod = self.cs.pods.get(args.pod, self.ns)
-        base, token = self._kubelet_base(pod)
-        import json as _json
-        import urllib.request
+        """Streaming exec via the apiserver pods/exec subresource —
+        bidirectional, interactive with -i/-t (ref: kubectl exec +
+        client-go/tools/remotecommand)."""
+        from urllib.parse import urlencode, urlparse
 
-        url = (f"{base}/exec/{pod.metadata.namespace}/{pod.metadata.name}"
-               f"/{args.container or pod.spec.containers[0].name}")
-        headers = {"Content-Type": "application/json"}
-        if token:
-            headers["Authorization"] = f"Bearer {token}"
-        req = urllib.request.Request(
-            url, data=_json.dumps({"command": args.command}).encode(),
-            headers=headers, method="POST",
+        from ..utils import streams
+
+        pod = self.cs.pods.get(args.pod, self.ns)
+        if not pod.spec.node_name:
+            raise SystemExit("error: pod not scheduled yet")
+        tty = bool(getattr(args, "tty", False))
+        stdin = bool(getattr(args, "stdin", False))
+        params = [("container", args.container or pod.spec.containers[0].name)]
+        params += [("command", c) for c in args.command]
+        if tty:
+            params.append(("tty", "1"))
+        if stdin:
+            params.append(("stdin", "1"))
+        base = urlparse(self.cs.api.url)
+        sock = streams.upgrade_request(
+            base.hostname, base.port,
+            f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/exec?{urlencode(params)}",
+            self._stream_headers(),
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            result = _json.loads(resp.read())
-        self.out.write(result.get("output", ""))
-        if result.get("exitCode", 0) != 0:
-            raise SystemExit(result["exitCode"])
+        code = self._pump_stream(sock, tty=tty, stdin=stdin,
+                                 stdin_stream=getattr(args, "stdin_stream", None))
+        if code:
+            raise SystemExit(code)
+
+    def _pump_stream(self, sock, tty=False, stdin=False, stdin_stream=None) -> int:
+        import json as _json
+        import threading
+
+        from ..utils.streams import (
+            ERROR, STDERR, STDIN, STDOUT, read_frame, write_frame,
+        )
+
+        status = {"exitCode": 0}
+        if stdin:
+            src = stdin_stream or getattr(sys.stdin, "buffer", sys.stdin)
+            if tty and sys.stdin.isatty():
+                import termios
+                import tty as _tty
+
+                old = termios.tcgetattr(sys.stdin.fileno())
+                _tty.setraw(sys.stdin.fileno())
+                import atexit
+
+                atexit.register(
+                    termios.tcsetattr, sys.stdin.fileno(), termios.TCSADRAIN, old)
+
+            def feed():
+                try:
+                    while True:
+                        data = src.read(1) if tty else src.readline()
+                        if not data:
+                            write_frame(sock, STDIN, b"")  # EOF
+                            break
+                        if isinstance(data, str):
+                            data = data.encode()
+                        write_frame(sock, STDIN, data)
+                except (OSError, ValueError):
+                    pass
+
+            threading.Thread(target=feed, daemon=True).start()
+        try:
+            while True:
+                frame = read_frame(sock)
+                if frame is None:
+                    break
+                ch, payload = frame
+                if ch in (STDOUT, STDERR):
+                    self.out.write(payload.decode(errors="replace"))
+                    try:
+                        self.out.flush()
+                    except (OSError, ValueError):
+                        pass
+                elif ch == ERROR:
+                    try:
+                        status = _json.loads(payload or b"{}")
+                    except ValueError:
+                        pass
+                    break
+        finally:
+            sock.close()
+        if status.get("error"):
+            print(f"error: {status['error']}", file=self.out)
+        return int(status.get("exitCode", 0) or 0)
+
+    def port_forward(self, args):
+        """Local TCP listener relaying each connection through the
+        apiserver's pods/portForward subresource (ref: kubectl
+        port-forward)."""
+        import socket as _socket
+        import threading
+        from urllib.parse import urlparse
+
+        from ..utils import streams
+
+        local, _, remote = args.ports.partition(":")
+        remote = remote or local
+        try:
+            local, remote = int(local), int(remote)
+        except ValueError:
+            raise SystemExit(
+                f"error: ports must be numeric LOCAL:REMOTE, got {args.ports!r}")
+        pod = self.cs.pods.get(args.pod, self.ns)
+        if not pod.spec.node_name:
+            raise SystemExit("error: pod not scheduled yet")
+        base = urlparse(self.cs.api.url)
+        listener = _socket.socket()
+        listener.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", int(local)))
+        listener.listen(8)
+        bound_port = listener.getsockname()[1]
+        print(f"Forwarding from 127.0.0.1:{bound_port} -> {remote}",
+              file=self.out)
+        try:
+            self.out.flush()
+        except (OSError, ValueError):
+            pass
+
+        def serve(conn):
+            try:
+                upstream = streams.upgrade_request(
+                    base.hostname, base.port,
+                    f"/api/v1/namespaces/{self.ns}/pods/{args.pod}"
+                    f"/portForward?port={int(remote)}",
+                    self._stream_headers(),
+                )
+            except (OSError, ConnectionError):
+                conn.close()
+                return
+            try:
+                streams.splice(conn, upstream)
+            finally:
+                conn.close()
+                upstream.close()
+
+        self._pf_listener = listener  # tests close this to stop
+        count = getattr(args, "connections", 0)  # 0 = forever
+        served = 0
+        while True:
+            try:
+                conn, _addr = listener.accept()
+            except OSError:
+                break
+            threading.Thread(target=serve, args=(conn,), daemon=True).start()
+            served += 1
+            if count and served >= count:
+                break
 
     # ----------------------------------------------------------------- wait
 
@@ -438,7 +566,13 @@ def build_parser() -> argparse.ArgumentParser:
     ex = sub.add_parser("exec")
     ex.add_argument("pod")
     ex.add_argument("-c", "--container", default="")
+    ex.add_argument("-i", "--stdin", action="store_true")
+    ex.add_argument("-t", "--tty", action="store_true")
     ex.add_argument("command", nargs="+")
+
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("pod")
+    pf.add_argument("ports", help="LOCAL:REMOTE (or PORT for both)")
 
     w = sub.add_parser("wait")
     w.add_argument("target")
@@ -501,6 +635,7 @@ def dispatch(cli: CLI, args) -> None:
         "create": cli.create, "delete": cli.delete, "scale": cli.scale,
         "cordon": cli.cordon, "uncordon": cli.uncordon, "drain": cli.drain,
         "top": cli.top, "rollout": cli.rollout, "logs": cli.logs,
-        "exec": cli.exec_, "wait": cli.wait, "api-resources": cli.api_resources,
+        "exec": cli.exec_, "port-forward": cli.port_forward,
+        "wait": cli.wait, "api-resources": cli.api_resources,
     }[args.cmd]
     handler(args)

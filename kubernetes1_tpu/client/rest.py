@@ -110,7 +110,10 @@ class ApiClient:
         path: str,
         body: Optional[Dict[str, Any]] = None,
         params: Optional[Dict[str, str]] = None,
-    ) -> Dict[str, Any]:
+        raw: bool = False,
+    ) -> Any:
+        """JSON round-trip by default; raw=True returns the response bytes
+        verbatim (non-JSON subresources like pods/<name>/log)."""
         if params:
             path = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
         payload = json.dumps(body).encode() if body is not None else None
@@ -126,13 +129,18 @@ class ApiClient:
                 conn.request(method, path, body=payload, headers=self._headers())
                 sent = True
                 resp = conn.getresponse()
-                raw = resp.read()
+                raw_body = resp.read()
                 break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._reset_conn()
                 if attempt == 1 or (sent and method != "GET"):
                     raise
-        data = json.loads(raw) if raw else {}
+        if raw and resp.status < 400:
+            return raw_body
+        try:
+            data = json.loads(raw_body) if raw_body else {}
+        except ValueError:
+            data = {}
         if resp.status >= 400:
             if data.get("kind") == "Status":
                 raise ApiError.from_status(data)

@@ -263,7 +263,15 @@ class Kubelet:
     # ----------------------------------------------------------- node status
 
     KUBELET_SERVER_ANNOTATION = "kubelet.ktpu.io/server"
-    KUBELET_TOKEN_ANNOTATION = "kubelet.ktpu.io/exec-token"
+    # The per-kubelet bearer token lives in a kube-system Secret only the
+    # apiserver (and this node, via the node authorizer) can read — NOT in a
+    # Node annotation, which every kubelet can read (ADVICE r2: that enabled
+    # cluster-wide lateral movement through any one compromised node).
+    TOKEN_SECRET_NS = "kube-system"
+
+    @staticmethod
+    def token_secret_name(node_name: str) -> str:
+        return f"kubelet-token-{node_name}"
 
     def _node_object(self) -> t.Node:
         node = t.Node()
@@ -273,12 +281,29 @@ class Kubelet:
             **self.node_labels,
         }
         if self.server is not None:
-            # `ktpu logs`/`ktpu exec` resolve the kubelet endpoint from this
-            # (the :10250 daemonEndpoints analog, ref server.go:1)
+            # clients resolve the kubelet endpoint from this (the :10250
+            # daemonEndpoints analog); the credential travels separately
             node.metadata.annotations[self.KUBELET_SERVER_ANNOTATION] = self.server.url
-            node.metadata.annotations[self.KUBELET_TOKEN_ANNOTATION] = self.server_token
         self._fill_status(node)
         return node
+
+    def _publish_token_secret(self):
+        if self.server is None:
+            return
+        sec = t.Secret(type="ktpu.io/kubelet-token",
+                       data={"token": self.server_token})
+        sec.metadata.name = self.token_secret_name(self.node_name)
+        sec.metadata.namespace = self.TOKEN_SECRET_NS
+        try:
+            self.cs.secrets.create(sec, self.TOKEN_SECRET_NS)
+        except ApiError:
+            try:
+                self.cs.secrets.patch(
+                    sec.metadata.name, {"data": {"token": self.server_token}},
+                    namespace=self.TOKEN_SECRET_NS,
+                )
+            except ApiError:
+                traceback.print_exc()
 
     def _fill_status(self, node: t.Node):
         node.status.capacity = dict(self.capacity)
@@ -315,12 +340,19 @@ class Kubelet:
                         self.node_name,
                         {"metadata": {"annotations": {
                             self.KUBELET_SERVER_ANNOTATION: self.server.url,
-                            self.KUBELET_TOKEN_ANNOTATION: self.server_token,
+                            # explicit null: scrub the world-readable token
+                            # annotation older kubelets published (merge
+                            # patch deletes null keys) — without this an
+                            # upgraded node keeps leaking a valid token
+                            "kubelet.ktpu.io/exec-token": None,
                         }}},
                         namespace="",
                     )
                 except ApiError:
                     pass
+        self._publish_token_secret()
+
+    TOKEN_RECHECK_BEATS = 12  # verify the token secret every ~minute
 
     def _heartbeat(self):
         """10s-class syncNodeStatus (ref: kubelet_node_status.go:545-621)."""
@@ -334,6 +366,17 @@ class Kubelet:
             self.cs.nodes.update_status(node)
         except Conflict:
             pass  # next beat wins
+        # the token secret must outlive registration hiccups and admin
+        # deletions — without it every apiserver-proxied logs/exec 401s
+        self._beats = getattr(self, "_beats", 0) + 1
+        if self.server is not None and self._beats % self.TOKEN_RECHECK_BEATS == 0:
+            try:
+                self.cs.secrets.get(
+                    self.token_secret_name(self.node_name), self.TOKEN_SECRET_NS)
+            except NotFound:
+                self._publish_token_secret()
+            except ApiError:
+                pass
 
     # -------------------------------------------------- probes and eviction
 

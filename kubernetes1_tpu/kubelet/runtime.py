@@ -130,6 +130,13 @@ class RuntimeService:
         server's /exec endpoint (ref: CRI api.proto ExecSync)."""
         return self.exec_in_container(container_id, command), ""
 
+    def exec_stream(self, container_id: str, command, tty: bool = False,
+                    stdin: bool = False):
+        """Streaming Exec (ref: CRI api.proto Exec): start the command in
+        the container's context and return (popen, pty_master_fd or None).
+        The caller owns the pumping.  None when unsupported."""
+        return None
+
 
 class ImageService:
     """ref: api.proto ImageService (5 RPCs) — advisory here."""
@@ -559,6 +566,48 @@ class ProcessRuntime(RuntimeService):
             return res.returncode, out
         except (OSError, subprocess.TimeoutExpired, ValueError) as e:
             return -1, str(e)
+
+    def exec_stream(self, container_id: str, command, tty: bool = False,
+                    stdin: bool = False):
+        """Streaming exec with the container's env; tty=True allocates a
+        pty so interactive shells behave (line editing, SIGINT)."""
+        with self._lock:
+            proc = self._procs.get(container_id)
+            config = self._configs.get(container_id)
+        if proc is None or proc.poll() is not None:
+            return None
+        env = dict(os.environ)
+        if config is not None:
+            env.update(config.env)
+        cwd = (config.working_dir or None) if config else None
+        if tty:
+            import fcntl
+            import pty
+            import termios
+
+            master, slave = pty.openpty()
+
+            def acquire_ctty():
+                # new session + make the pty the CONTROLLING terminal, so
+                # ^C reaches the foreground process group (a single ioctl —
+                # no Python allocation/IO between fork and exec)
+                fcntl.ioctl(0, termios.TIOCSCTTY, 0)
+
+            p = subprocess.Popen(
+                list(command), env=env, cwd=cwd,
+                stdin=slave, stdout=slave, stderr=slave,
+                start_new_session=True, close_fds=True,
+                preexec_fn=acquire_ctty,
+            )
+            os.close(slave)
+            return p, master
+        p = subprocess.Popen(
+            list(command), env=env, cwd=cwd,
+            stdin=subprocess.PIPE if stdin else subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            start_new_session=True,
+        )
+        return p, None
 
     def container_stats(self, container_id: str) -> Dict[str, float]:
         """CPU from /proc/<pid>/stat utime+stime deltas between calls, RSS

@@ -81,12 +81,115 @@ class _KubeletHandler(BaseHTTPRequestHandler):
             return None, None
         return pod, cid
 
+    # ------------------------------------------------------------ streaming
+
+    def _handle_stream(self, parts, rawq, q):
+        """Upgraded bidirectional streams (ref: pkg/kubelet/server
+        remotecommand exec/attach + portforward over SPDY; here the
+        ktpu-stream channel protocol)."""
+        from ..utils.streams import STDOUT, accept_upgrade, send_status, splice, write_frame
+
+        kind = parts[0]
+        if kind == "portForward":
+            ns, pod_name = parts[1], parts[2]
+            if self.kubelet.pods.get(f"{ns}/{pod_name}") is None:
+                self._send(404, {"error": f"pod {ns}/{pod_name} not found on this node"})
+                return
+            port = int(q.get("port") or 0)
+            if not port:
+                self._send(400, {"error": "port required"})
+                return
+            import socket as _socket
+
+            try:
+                target = _socket.create_connection(("127.0.0.1", port), timeout=5)
+            except OSError as e:
+                self._send(502, {"error": f"connect 127.0.0.1:{port}: {e}"})
+                return
+            sock = accept_upgrade(self)
+            if sock is None:
+                target.close()
+                self._send(400, {"error": "expected Upgrade: ktpu-stream"})
+                return
+            try:
+                splice(sock, target)  # raw bytes, no framing — data is opaque
+            finally:
+                target.close()
+            return
+
+        ns, pod_name = parts[1], parts[2]
+        cname = parts[3] if len(parts) > 3 else ""
+        pod, cid = self._resolve_container(ns, pod_name, cname)
+        if pod is None:
+            return
+        if kind == "attach":
+            # ProcessRuntime containers write stdio to their log file;
+            # attach = live follow of that stream (honest for a runtime
+            # without a held-open stdio pipe)
+            record = self.kubelet.runtime.container_status(cid)
+            sock = accept_upgrade(self)
+            if sock is None:
+                self._send(400, {"error": "expected Upgrade: ktpu-stream"})
+                return
+            try:
+                _follow_log(sock, self.kubelet.runtime, cid,
+                            record.log_path if record else "")
+            finally:
+                sock.close()
+            return
+
+        # exec — validate the handshake BEFORE spawning: a bad Upgrade
+        # header must not leak a running process
+        command = rawq.get("command") or []
+        if not command:
+            self._send(400, {"error": "command required"})
+            return
+        if self.headers.get("Upgrade", "").lower() != "ktpu-stream":
+            self._send(400, {"error": "expected Upgrade: ktpu-stream"})
+            return
+        tty = q.get("tty") in ("1", "true")
+        stdin = q.get("stdin") in ("1", "true")
+        res = self.kubelet.runtime.exec_stream(cid, command, tty=tty, stdin=stdin)
+        if res is None:
+            self._send(400, {"error": "runtime does not support streaming exec "
+                                      "or container is not running"})
+            return
+        proc, master = res
+        sock = accept_upgrade(self)
+        if sock is None:  # defensive; header already validated above
+            import os as _os
+
+            proc.kill()
+            proc.wait()
+            if master is not None:
+                try:
+                    _os.close(master)
+                except OSError:
+                    pass
+            self._send(400, {"error": "expected Upgrade: ktpu-stream"})
+            return
+        try:
+            _pump_exec(sock, proc, master)
+        finally:
+            sock.close()
+
     def do_GET(self):
         parsed = urlparse(self.path)
         parts = [p for p in parsed.path.split("/") if p]
-        q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        rawq = parse_qs(parsed.query)
+        q = {k: v[0] for k, v in rawq.items()}
         kl = self.kubelet
         try:
+            if parts and parts[0] not in ("healthz", "metrics") \
+                    and not self._authorized():
+                # everything that exposes workload data requires the token
+                # the apiserver holds; only liveness + scrape stay open
+                self._send(401, {"error": "unauthorized"})
+                return
+            if parts and parts[0] in ("exec", "attach", "portForward") \
+                    and self.headers.get("Upgrade"):
+                self._handle_stream(parts, rawq, q)
+                return
             if parts == ["healthz"]:
                 self._send(200, {"status": "ok"})
             elif parts == ["pods"]:
@@ -148,6 +251,139 @@ class _KubeletHandler(BaseHTTPRequestHandler):
                 self._send(500, {"error": str(e)})
             except Exception:  # noqa: BLE001
                 pass
+
+
+def _pump_exec(sock, proc, master_fd):
+    """Frame-pump a streaming exec: socket frames <-> process stdio.
+
+    pty mode: one master fd carries both directions (tty semantics);
+    pipe mode: stdout/stderr are separate channels.  Ends with a status
+    frame carrying the exit code (the SPDY error-channel contract)."""
+    import json as _json
+    import os as _os
+    import threading
+
+    from ..utils.streams import (
+        ERROR, RESIZE, STDERR, STDIN, STDOUT, read_frame, send_status,
+        write_frame,
+    )
+
+    def sock_reader():
+        """Client frames -> process stdin / resize."""
+        try:
+            while True:
+                frame = read_frame(sock)
+                if frame is None:
+                    break
+                channel, payload = frame
+                if channel == STDIN:
+                    if not payload:  # EOF
+                        if master_fd is None and proc.stdin:
+                            proc.stdin.close()
+                        break
+                    try:
+                        if master_fd is not None:
+                            _os.write(master_fd, payload)
+                        elif proc.stdin:
+                            proc.stdin.write(payload)
+                            proc.stdin.flush()
+                    except (OSError, ValueError, BrokenPipeError):
+                        break
+                elif channel == RESIZE and master_fd is not None:
+                    try:
+                        import fcntl
+                        import struct as _struct
+                        import termios
+
+                        dims = _json.loads(payload)
+                        fcntl.ioctl(master_fd, termios.TIOCSWINSZ, _struct.pack(
+                            "HHHH", dims.get("rows", 24), dims.get("cols", 80), 0, 0))
+                    except (OSError, ValueError, KeyError):
+                        pass
+        except OSError:
+            pass
+
+    t_in = threading.Thread(target=sock_reader, daemon=True)
+    t_in.start()
+    try:
+        if master_fd is not None:
+            while True:
+                try:
+                    data = _os.read(master_fd, 65536)
+                except OSError:  # pty closes with EIO when the child exits
+                    break
+                if not data:
+                    break
+                write_frame(sock, STDOUT, data)
+        else:
+            def drain(f, channel):
+                try:
+                    while True:
+                        data = f.read1(65536) if hasattr(f, "read1") else f.read(65536)
+                        if not data:
+                            break
+                        write_frame(sock, channel, data)
+                except (OSError, ValueError):
+                    pass
+
+            t_err = threading.Thread(
+                target=drain, args=(proc.stderr, STDERR), daemon=True)
+            t_err.start()
+            drain(proc.stdout, STDOUT)
+            t_err.join(timeout=5.0)
+        code = proc.wait(timeout=30)
+    except Exception as e:  # noqa: BLE001
+        send_status(sock, -1, str(e))
+        proc.kill()
+        return
+    finally:
+        if master_fd is not None:
+            try:
+                _os.close(master_fd)
+            except OSError:
+                pass
+    send_status(sock, code)
+
+
+def _follow_log(sock, runtime, cid, log_path):
+    """attach: stream log growth until the container exits or the client
+    hangs up (a zero-byte read on the socket detects hangup)."""
+    import os as _os
+    import select
+    import time as _time
+
+    from ..utils.streams import STDOUT, send_status, write_frame
+
+    from .runtime import CONTAINER_RUNNING
+
+    if not log_path or not _os.path.exists(log_path):
+        send_status(sock, -1, "no log stream for container")
+        return
+    with open(log_path, "rb") as f:
+        f.seek(0, _os.SEEK_END)
+        # replay a last-page tail so the attacher has context
+        start = max(0, f.tell() - 4096)
+        f.seek(start)
+        while True:
+            data = f.read(65536)
+            if data:
+                try:
+                    write_frame(sock, STDOUT, data)
+                except OSError:
+                    return
+                continue
+            record = runtime.container_status(cid)
+            if record is None or record.state != CONTAINER_RUNNING:
+                send_status(sock, record.exit_code if record else -1)
+                return
+            # hangup detection: the client never sends frames on attach,
+            # so any readable-EOF means it is gone
+            r, _, _ = select.select([sock], [], [], 0.25)
+            if r:
+                probe = sock.recv(1)
+                if not probe:
+                    return
+            _time.sleep(0.05)
 
 
 class KubeletServer:

@@ -1,0 +1,152 @@
+"""Bidirectional streaming over an upgraded HTTP connection.
+
+Ref: the reference streams exec/attach/port-forward over SPDY channels
+(pkg/kubelet/server/remotecommand, client-go/tools/remotecommand) or
+WebSocket.  The TPU-native wire form here is a minimal channel-framed
+protocol over a hijacked socket:
+
+    client:  GET/POST <path> HTTP/1.1
+             Connection: Upgrade
+             Upgrade: ktpu-stream
+    server:  HTTP/1.1 101 Switching Protocols  (then raw frames both ways)
+
+frame  = channel(1 byte) | length(4 bytes big-endian) | payload
+channels mirror SPDY's: 0 stdin, 1 stdout, 2 stderr, 3 error/status,
+4 resize.  A zero-length frame on a stream channel means EOF for that
+channel.  The error channel carries one UTF-8 JSON object
+{"exitCode": N, "error": "..."} and closes the stream.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional, Tuple
+
+UPGRADE_PROTO = "ktpu-stream"
+
+STDIN, STDOUT, STDERR, ERROR, RESIZE = 0, 1, 2, 3, 4
+
+_HEADER = struct.Struct(">BI")
+MAX_FRAME = 1 << 20
+
+
+def write_frame(sock: socket.socket, channel: int, payload: bytes):
+    sock.sendall(_HEADER.pack(channel, len(payload)) + payload)
+
+
+def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    """(channel, payload) or None on EOF/garbage."""
+    header = read_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    channel, length = _HEADER.unpack(header)
+    if length > MAX_FRAME:
+        return None
+    if length == 0:
+        return channel, b""
+    payload = read_exact(sock, length)
+    if payload is None:
+        return None
+    return channel, payload
+
+
+def send_status(sock: socket.socket, exit_code: int, error: str = ""):
+    try:
+        write_frame(sock, ERROR, json.dumps(
+            {"exitCode": exit_code, "error": error}).encode())
+    except OSError:
+        pass
+
+
+def upgrade_request(host: str, port: int, path: str, headers: dict,
+                    timeout: float = 30.0) -> socket.socket:
+    """Open a socket, perform the Upgrade handshake, return the raw socket
+    ready for frames.  Raises ConnectionError on a non-101 response."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
+                 "Connection: Upgrade", f"Upgrade: {UPGRADE_PROTO}"]
+        for k, v in headers.items():
+            lines.append(f"{k}: {v}")
+        sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
+        status = _read_http_head(sock)
+        if " 101 " not in status.split("\r\n", 1)[0] + " ":
+            body = status.split("\r\n\r\n", 1)[-1][:300]
+            raise ConnectionError(
+                f"upgrade refused: {status.splitlines()[0] if status else 'EOF'}"
+                + (f" — {body}" if body else "")
+            )
+        sock.settimeout(None)
+        return sock
+    except BaseException:
+        sock.close()
+        raise
+
+
+def _read_http_head(sock: socket.socket) -> str:
+    """Read up to the end of the HTTP response head (and any tiny error
+    body that arrives with it)."""
+    data = b""
+    while b"\r\n\r\n" not in data:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+        if len(data) > 65536:
+            break
+    return data.decode(errors="replace")
+
+
+def accept_upgrade(handler) -> Optional[socket.socket]:
+    """Server side: validate the Upgrade header on a BaseHTTPRequestHandler,
+    send 101, and return the hijacked socket (caller owns it afterwards)."""
+    if handler.headers.get("Upgrade", "").lower() != UPGRADE_PROTO:
+        return None
+    handler.send_response(101, "Switching Protocols")
+    handler.send_header("Upgrade", UPGRADE_PROTO)
+    handler.send_header("Connection", "Upgrade")
+    handler.end_headers()
+    handler.wfile.flush()
+    sock = handler.connection
+    handler.close_connection = True
+    return sock
+
+
+def splice(a: socket.socket, b: socket.socket):
+    """Raw byte relay both directions until either side closes — the
+    apiserver's proxy hop (it terminates the handshake on each side and
+    then has no need to reframe)."""
+    import threading
+
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=pump, args=(b, a), daemon=True)
+    t.start()
+    pump(a, b)
+    t.join(timeout=5.0)

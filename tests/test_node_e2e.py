@@ -296,22 +296,30 @@ class TestKubeletServer:
 
         node = cs.nodes.get("tpu-node-0", "")
         base = node.metadata.annotations["kubelet.ktpu.io/server"]
-        with urllib.request.urlopen(f"{base}/stats/summary", timeout=10) as resp:
+        # the kubelet requires its token on workload endpoints; the
+        # apiserver holds it in the node's kube-system secret
+        token = node_env["kubelet"].server_token
+        req = urllib.request.Request(
+            f"{base}/stats/summary",
+            headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
             summary = json.load(resp)
         assert summary["node"]["nodeName"] == "tpu-node-0"
         pods = {p["pod"]: p for p in summary["pods"]}
         assert "default/statsy" in pods
         must_poll_until(
-            lambda: _stats_mem(base) > 0, timeout=10.0,
+            lambda: _stats_mem(base, token) > 0, timeout=10.0,
             desc="stats show real memory usage",
         )
 
 
-def _stats_mem(base) -> int:
+def _stats_mem(base, token) -> int:
     import json
     import urllib.request
 
-    with urllib.request.urlopen(f"{base}/stats/summary", timeout=10) as resp:
+    req = urllib.request.Request(
+        f"{base}/stats/summary", headers={"Authorization": f"Bearer {token}"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
         summary = json.load(resp)
     for p in summary["pods"]:
         for c in p["containers"]:
