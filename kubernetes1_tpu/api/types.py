@@ -338,9 +338,29 @@ class NodeAffinityTerm:
 
 
 @dataclass
+class PodAffinityTerm:
+    """Ref: core/v1 PodAffinityTerm (types.go) — co-locate (or anti-) with
+    pods matching `label_selector` within one `topology_key` domain.
+
+    TPU-native topology keys beyond node labels:
+    - kubernetes.io/hostname    -> the node itself
+    - google.com/tpu-slice      -> the ICI slice the node's chips belong to
+      (resolved from device attributes, so a trainer can require
+      co-location with its parameter-server on the same slice)."""
+
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)  # empty = pod's own
+
+
+@dataclass
 class Affinity:
     # required node affinity terms are ORed; expressions within a term ANDed
     node_affinity_required: List[NodeAffinityTerm] = field(default_factory=list)
+    # requiredDuringSchedulingIgnoredDuringExecution pod (anti-)affinity:
+    # every term must be satisfied (ref predicates.go:1036-1044)
+    pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
 
 
 @dataclass

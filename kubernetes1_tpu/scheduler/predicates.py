@@ -8,7 +8,7 @@ Each predicate returns (fits: bool, reason: str).  Device fit is separate
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..machinery import labels as labelutil
@@ -148,6 +148,135 @@ DYNAMIC_PREDICATES = [
     ("PodFitsResources", pod_fits_resources),
 ]
 DEFAULT_PREDICATES = STATIC_PREDICATES + DYNAMIC_PREDICATES
+
+
+# ------------------------------------------------- inter-pod (anti)affinity
+
+TOPOLOGY_HOSTNAME = "kubernetes.io/hostname"
+TOPOLOGY_TPU_SLICE = "google.com/tpu-slice"
+
+
+def node_topology_value(ni: NodeInfo, key: str) -> Optional[str]:
+    """A node's value for a topology key.  Hostname falls back to the node
+    name; the TPU slice key resolves from device attributes so slice
+    co-location needs no manual node labeling."""
+    if ni.node is None:
+        return None
+    if key == TOPOLOGY_HOSTNAME:
+        return ni.node.metadata.labels.get(key) or ni.node.metadata.name
+    if key == TOPOLOGY_TPU_SLICE:
+        # a node belongs to ONE slice domain only when all its chips agree —
+        # multi-slice nodes have no single value (an arbitrary first-device
+        # answer would co-locate onto the wrong ICI slice)
+        slices = set()
+        for info in ni.extended.values():
+            for d in info.devices.values():
+                s = (d.attributes or {}).get(t.ATTR_TPU_SLICE)
+                if s:
+                    slices.add(s)
+        return slices.pop() if len(slices) == 1 else None
+    return ni.node.metadata.labels.get(key)
+
+
+def _term_namespaces(term: t.PodAffinityTerm, owner: t.Pod) -> List[str]:
+    return term.namespaces or [owner.metadata.namespace]
+
+
+class PodAffinityChecker:
+    """Precomputed inter-pod (anti)affinity verdict for ONE scheduling
+    attempt (ref: predicates.go:1036 InterPodAffinityMatches).
+
+    The classic scalability killer is re-scanning every pod per candidate
+    node; instead ONE O(pods) pass over the snapshot computes, per term,
+    the set of topology values that satisfy (affinity) or block
+    (anti-affinity, including the SYMMETRY direction: an existing pod's
+    required anti-affinity blocks the incoming pod), and the per-node check
+    is O(terms) dict lookups."""
+
+    def __init__(self, pod: t.Pod, snapshot: Dict[str, NodeInfo]):
+        self.pod = pod
+        aff = pod.spec.affinity
+        self.affinity_terms = list(aff.pod_affinity_required) if aff else []
+        self.anti_terms = list(aff.pod_anti_affinity_required) if aff else []
+        # (topology_key -> satisfied values) per affinity term, aligned by index
+        self._affinity_values: List[set] = [set() for _ in self.affinity_terms]
+        # topology_key -> blocked values (own anti terms + symmetry)
+        self._blocked: Dict[str, set] = {}
+        self._topo_cache: Dict[Tuple[str, str], Optional[str]] = {}
+        # first-replica carve-out (upstream InterPodAffinityMatches): a term
+        # the pod's OWN labels satisfy is allowed when nothing matches yet —
+        # otherwise a self-co-locating ReplicaSet can never place replica 1
+        self._self_match: List[bool] = [
+            pod.metadata.namespace in _term_namespaces(term, pod)
+            and labelutil.label_selector_matches(
+                term.label_selector, pod.metadata.labels)
+            for term in self.affinity_terms
+        ]
+        for name, ni in snapshot.items():
+            if ni.node is None:
+                continue
+            for p in ni.pods.values():
+                self.note_added_pod(p, ni)
+
+    def note_added_pod(self, p: t.Pod, ni: NodeInfo):
+        """Fold one (existing or simulated) pod into the context — gang
+        placement reuses a checker across members by feeding each shadow
+        member back instead of rebuilding the O(pods) pass."""
+        if p.metadata.deletion_timestamp or ni.node is None:
+            return
+        pod = self.pod
+        name = ni.node.metadata.name
+        for i, term in enumerate(self.affinity_terms):
+            if p.metadata.namespace in _term_namespaces(term, pod) \
+                    and labelutil.label_selector_matches(
+                        term.label_selector, p.metadata.labels):
+                v = self._topo(name, ni, term.topology_key)
+                if v is not None:
+                    self._affinity_values[i].add(v)
+        for term in self.anti_terms:
+            if p.metadata.namespace in _term_namespaces(term, pod) \
+                    and labelutil.label_selector_matches(
+                        term.label_selector, p.metadata.labels):
+                v = self._topo(name, ni, term.topology_key)
+                if v is not None:
+                    self._blocked.setdefault(term.topology_key, set()).add(v)
+        # symmetry: the EXISTING pod's required anti-affinity forbids the
+        # incoming pod in its topology domain
+        p_aff = p.spec.affinity
+        if p_aff is not None:
+            for term in p_aff.pod_anti_affinity_required:
+                if pod.metadata.namespace in _term_namespaces(term, p) \
+                        and labelutil.label_selector_matches(
+                            term.label_selector, pod.metadata.labels):
+                    v = self._topo(name, ni, term.topology_key)
+                    if v is not None:
+                        self._blocked.setdefault(term.topology_key, set()).add(v)
+
+    def _topo(self, name: str, ni: NodeInfo, key: str) -> Optional[str]:
+        ck = (name, key)
+        if ck not in self._topo_cache:
+            self._topo_cache[ck] = node_topology_value(ni, key)
+        return self._topo_cache[ck]
+
+    def check(self, ni: NodeInfo) -> Tuple[bool, str]:
+        name = ni.node.metadata.name
+        for i, term in enumerate(self.affinity_terms):
+            v = self._topo(name, ni, term.topology_key)
+            if v is None:
+                return False, (
+                    f"pod affinity: node has no {term.topology_key} domain")
+            if v not in self._affinity_values[i]:
+                if self._self_match[i] and not self._affinity_values[i]:
+                    continue  # first replica of a self-co-locating workload
+                return False, (
+                    f"pod affinity: no matching pod in this node's "
+                    f"{term.topology_key} domain"
+                )
+        for key, blocked in self._blocked.items():
+            v = self._topo(name, ni, key)
+            if v is not None and v in blocked:
+                return False, f"pod anti-affinity: {key} domain already hosts a conflicting pod"
+        return True, ""
 
 
 def pod_equivalence_key(pod: t.Pod) -> tuple:

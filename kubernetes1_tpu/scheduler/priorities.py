@@ -92,10 +92,28 @@ def slice_packing(pod: t.Pod, ni: NodeInfo) -> float:
     return total / max(1, len(pod.spec.extended_resources))
 
 
+def selector_spreading(pod: t.Pod, ni: NodeInfo) -> float:
+    """Spread a controller's replicas across nodes (ref:
+    priorities/selector_spreading.go:43 — there by service/RC selector;
+    here by shared controller owner, which is what replicas actually
+    share).  Fewer siblings on the node = higher score."""
+    owners = {ref.uid for ref in pod.metadata.owner_references if ref.uid}
+    if not owners:
+        return MAX_SCORE / 2  # standalone pod: neutral
+    siblings = 0
+    for p in ni.pods.values():
+        if p.metadata.uid == pod.metadata.uid or p.metadata.deletion_timestamp:
+            continue
+        if owners & {ref.uid for ref in p.metadata.owner_references if ref.uid}:
+            siblings += 1
+    return MAX_SCORE / (1.0 + siblings)
+
+
 DEFAULT_PRIORITIES: List[Tuple[str, Callable[[t.Pod, NodeInfo], float], float]] = [
     ("LeastRequested", least_requested, 1.0),
     ("BalancedAllocation", balanced_allocation, 1.0),
     ("TaintToleration", taint_toleration, 1.0),
+    ("SelectorSpreading", selector_spreading, 1.5),
     ("SlicePacking", slice_packing, 2.0),  # device placement dominates on TPU
 ]
 

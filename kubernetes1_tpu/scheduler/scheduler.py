@@ -27,11 +27,17 @@ from typing import Dict, List, Optional, Tuple
 from ..api import types as t
 from ..client import Clientset, EventRecorder, InformerFactory
 from ..machinery import ApiError, Conflict, NotFound
-from ..machinery.scheme import global_scheme
+from ..machinery.scheme import global_scheme, to_dict
+
+
+def _json_key(obj) -> str:
+    import json as _json
+
+    return _json.dumps(obj, sort_keys=True, default=str)
 from ..utils.metrics import Histogram
 from .cache import NodeInfo, SchedulerCache
 from .devices import allocate_for_pod, fits_devices
-from .predicates import EquivalenceCache, run_predicates
+from .predicates import EquivalenceCache, PodAffinityChecker, run_predicates
 from .priorities import prioritize
 from .queue import SchedulingQueue
 
@@ -88,6 +94,11 @@ class Scheduler:
         self._nominations: Dict[str, Tuple[str, int, float]] = {}
         self._nominations_lock = threading.Lock()
         self.nomination_ttl = 60.0
+        # Sticky flag: inter-pod affinity's symmetry check costs an O(pods)
+        # pass per attempt — pay it only once the cluster has ever seen a
+        # pod carrying anti-affinity terms (the sched_perf scale guard:
+        # plain clusters never pay).
+        self._anti_affinity_seen = False
 
     # ---------------------------------------------------------------- wiring
 
@@ -141,13 +152,20 @@ class Scheduler:
             and pod.status.phase in (t.POD_PENDING, "")
         )
 
+    def _note_affinity(self, pod: t.Pod):
+        if (not self._anti_affinity_seen and pod.spec.affinity is not None
+                and pod.spec.affinity.pod_anti_affinity_required):
+            self._anti_affinity_seen = True
+
     def _on_pod_add(self, pod: t.Pod):
+        self._note_affinity(pod)
         if self._schedulable(pod):
             self.queue.add(pod.key(), pod.spec.priority)
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
 
     def _on_pod_update(self, old: t.Pod, pod: t.Pod):
+        self._note_affinity(pod)
         if self._schedulable(pod):
             self.queue.add(pod.key(), pod.spec.priority)
         elif pod.spec.node_name:
@@ -232,13 +250,27 @@ class Scheduler:
 
     # ------------------------------------------------------------- schedule
 
+    def _needs_affinity_check(self, pod: t.Pod) -> bool:
+        aff = pod.spec.affinity
+        return self._anti_affinity_seen or (
+            aff is not None and bool(
+                aff.pod_affinity_required or aff.pod_anti_affinity_required)
+        )
+
     def schedule(
-        self, pod: t.Pod, nodes: Optional[Dict[str, NodeInfo]] = None
+        self, pod: t.Pod, nodes: Optional[Dict[str, NodeInfo]] = None,
+        affinity_checker: Optional[PodAffinityChecker] = None,
     ) -> Tuple[Optional[ScheduleResult], str]:
-        """One-pod placement over the cache snapshot (or a simulation map)."""
+        """One-pod placement over the cache snapshot (or a simulation map).
+        `affinity_checker` lets gang placement reuse one O(pods) context
+        across members; when the simulation map is node-restricted, callers
+        MUST pass a checker built over the full world (a subset view would
+        miss matching pods on excluded nodes)."""
         snapshot = nodes if nodes is not None else self.cache.snapshot()
         if not snapshot:
             return None, "no nodes registered"
+        if affinity_checker is None and self._needs_affinity_check(pod):
+            affinity_checker = PodAffinityChecker(pod, snapshot)
         feasible: List[NodeInfo] = []
         reasons: Dict[str, int] = defaultdict(int)
         node_list = list(snapshot.values())
@@ -253,6 +285,8 @@ class Scheduler:
         if nominated and nominated in snapshot and snapshot[nominated].node is not None:
             ni = snapshot[nominated]
             ok, _ = run_predicates(pod, ni, self.equiv_cache)
+            if ok and affinity_checker is not None:
+                ok, _ = affinity_checker.check(ni)
             if ok:
                 assignments, _ = allocate_for_pod(pod, ni)
                 if assignments is not None:
@@ -268,6 +302,11 @@ class Scheduler:
             if not ok:
                 reasons[why[0] if why else "predicate failed"] += 1
                 continue
+            if affinity_checker is not None:
+                ok, why_a = affinity_checker.check(ni)
+                if not ok:
+                    reasons[why_a] += 1
+                    continue
             ok, why = fits_devices(pod, ni)
             if not ok:
                 reasons[why] += 1
@@ -405,6 +444,7 @@ class Scheduler:
         """
         if base is None:
             base = self.cache.snapshot()
+        need_affinity = any(self._needs_affinity_check(m) for m in members)
         slice_ids = self._candidate_slices(members, base)
         for slice_id in slice_ids + [None]:
             # clone-on-write: share the live NodeInfos for reading and clone
@@ -419,11 +459,31 @@ class Scheduler:
                 }
             else:
                 sim = dict(base)
+            # affinity context must see the FULL world — a slice-restricted
+            # view would miss matching pods on excluded nodes sharing a
+            # topology domain.  One checker per member CLASS per attempt
+            # (gang templates share labels/terms), updated incrementally
+            # with each shadow placement instead of rebuilt per member.
+            affinity_view = dict(base) if need_affinity else None
+            checkers: Dict[tuple, PodAffinityChecker] = {}
             cloned: set = set()
             placements: List[Tuple[t.Pod, ScheduleResult]] = []
             ok = True
             for member in members:
-                result, _ = self.schedule(member, nodes=sim)
+                checker = None
+                if need_affinity:
+                    ckey = (
+                        member.metadata.namespace,
+                        _json_key(member.metadata.labels),
+                        _json_key(to_dict(member.spec.affinity)
+                                  if member.spec.affinity else None),
+                    )
+                    checker = checkers.get(ckey)
+                    if checker is None:
+                        checker = PodAffinityChecker(member, affinity_view)
+                        checkers[ckey] = checker
+                result, _ = self.schedule(member, nodes=sim,
+                                          affinity_checker=checker)
                 if result is None:
                     ok = False
                     break
@@ -437,6 +497,10 @@ class Scheduler:
                     sim[result.node] = sim[result.node].clone()
                     cloned.add(result.node)
                 sim[result.node].add_pod(shadow)
+                if need_affinity:
+                    affinity_view[result.node] = sim[result.node]
+                    for c in checkers.values():
+                        c.note_added_pod(shadow, sim[result.node])
                 placements.append((member, result))
             if ok:
                 return placements
@@ -648,12 +712,22 @@ class Scheduler:
             sim = ni.clone()
             victims: List[t.Pod] = []
             placed = False
+            needs_affinity = self._needs_affinity_check(pod)
             for victim in victims_pool:
                 if not may_evict(victim):
                     continue
                 sim.remove_pod(victim)
                 victims.append(victim)
                 ok, _ = run_predicates(pod, sim)
+                if ok and needs_affinity:
+                    # the affinity world changes as victims fall (an evicted
+                    # anti-affinity blocker unblocks the node; an evicted
+                    # affinity anchor invalidates it) — judge on the
+                    # modified full snapshot, or preemption evicts innocents
+                    # for a placement that can never succeed
+                    modified = dict(base)
+                    modified[name] = sim
+                    ok, _ = PodAffinityChecker(pod, modified).check(sim)
                 if ok:
                     assignments, _ = allocate_for_pod(pod, sim)
                     if assignments is not None:

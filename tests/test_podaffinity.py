@@ -1,0 +1,200 @@
+"""Inter-pod affinity/anti-affinity + selector spreading (ref:
+predicates.go:1036 InterPodAffinityMatches, priorities/
+selector_spreading.go:43, scheduler integration affinity suites)."""
+
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.controllers import ControllerManager
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+from tests.test_controllers import start_hollow_node
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """4 nodes: 2 on slice s0, 2 on slice s1."""
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=5.0)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=5.0, eviction_timeout=5.0)
+    cm.start()
+    nodes = []
+    for i in range(4):
+        nodes.append(start_hollow_node(
+            cs, f"n{i}", str(tmp_path), tpus=4,
+            slice_id=f"s{i // 2}", host_index=i % 2,
+        ))
+    env = {"master": master, "cs": cs, "sched": sched}
+    yield env
+    for kubelet, plugin, _ in nodes:
+        kubelet.stop()
+        plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+def labeled_pod(name, labels, affinity=None):
+    pod = make_tpu_pod(name, tpus=0)
+    pod.metadata.labels = labels
+    pod.spec.containers[0].command = ["serve"]
+    pod.spec.affinity = affinity
+    return pod
+
+
+def wait_scheduled(cs, name, timeout=20.0):
+    must_poll_until(
+        lambda: bool(cs.pods.get(name, "default").spec.node_name),
+        timeout=timeout, desc=f"{name} scheduled",
+    )
+    return cs.pods.get(name, "default")
+
+
+def anti_on_host(match_labels):
+    return t.Affinity(pod_anti_affinity_required=[
+        t.PodAffinityTerm(
+            label_selector=t.LabelSelector(match_labels=match_labels),
+            topology_key="kubernetes.io/hostname",
+        )
+    ])
+
+
+class TestAntiAffinity:
+    def test_anti_affinity_pair_never_coschedules(self, cluster):
+        cs = cluster["cs"]
+        for i in range(4):
+            cs.pods.create(labeled_pod(
+                f"ha-{i}", {"app": "ha"}, anti_on_host({"app": "ha"})))
+        nodes = set()
+        for i in range(4):
+            nodes.add(wait_scheduled(cs, f"ha-{i}").spec.node_name)
+        assert len(nodes) == 4  # one per node, never together
+        # a 5th cannot fit anywhere
+        cs.pods.create(labeled_pod("ha-4", {"app": "ha"},
+                                   anti_on_host({"app": "ha"})))
+        time.sleep(3.0)
+        assert not cs.pods.get("ha-4", "default").spec.node_name
+
+    def test_symmetry_existing_anti_affinity_blocks_newcomer(self, cluster):
+        """An EXISTING pod's required anti-affinity keeps matching pods out
+        of its domain, even when the newcomer itself carries no terms."""
+        cs = cluster["cs"]
+        guard = labeled_pod("guard", {"role": "exclusive"},
+                            anti_on_host({"tenant": "other"}))
+        cs.pods.create(guard)
+        guard_node = wait_scheduled(cs, "guard").spec.node_name
+        intruder = labeled_pod("intruder", {"tenant": "other"})
+        cs.pods.create(intruder)
+        placed = wait_scheduled(cs, "intruder").spec.node_name
+        assert placed != guard_node
+
+
+class TestAffinity:
+    def test_affinity_colocates_on_hostname(self, cluster):
+        cs = cluster["cs"]
+        cs.pods.create(labeled_pod("anchor", {"app": "ps"}))
+        anchor_node = wait_scheduled(cs, "anchor").spec.node_name
+        follower = labeled_pod("follower", {"app": "worker"}, t.Affinity(
+            pod_affinity_required=[t.PodAffinityTerm(
+                label_selector=t.LabelSelector(match_labels={"app": "ps"}),
+                topology_key="kubernetes.io/hostname",
+            )]
+        ))
+        cs.pods.create(follower)
+        assert wait_scheduled(cs, "follower").spec.node_name == anchor_node
+
+    def test_affinity_on_tpu_slice_topology(self, cluster):
+        """TPU-native topology: google.com/tpu-slice resolves from device
+        attributes — co-locate on the same ICI slice, any host in it."""
+        cs = cluster["cs"]
+        anchor = labeled_pod("slice-anchor", {"app": "trainer"})
+        # pin the anchor to n2 (slice s1) via node selector
+        anchor.spec.node_selector = {"kubernetes.io/hostname": "n2"}
+        cs.pods.create(anchor)
+        assert wait_scheduled(cs, "slice-anchor").spec.node_name == "n2"
+        peer = labeled_pod("slice-peer", {"app": "trainer-peer"}, t.Affinity(
+            pod_affinity_required=[t.PodAffinityTerm(
+                label_selector=t.LabelSelector(match_labels={"app": "trainer"}),
+                topology_key="google.com/tpu-slice",
+            )]
+        ))
+        cs.pods.create(peer)
+        placed = wait_scheduled(cs, "slice-peer").spec.node_name
+        assert placed in ("n2", "n3")  # anywhere on slice s1
+
+    def test_self_colocating_replicas_bootstrap(self, cluster):
+        """A workload whose pods require affinity with THEMSELVES must not
+        deadlock on replica 1 (upstream's self-match carve-out): the first
+        lands anywhere, the rest pile onto its host."""
+        cs = cluster["cs"]
+        self_aff = t.Affinity(pod_affinity_required=[t.PodAffinityTerm(
+            label_selector=t.LabelSelector(match_labels={"app": "flock"}),
+            topology_key="kubernetes.io/hostname",
+        )])
+        for i in range(3):
+            cs.pods.create(labeled_pod(f"flock-{i}", {"app": "flock"}, self_aff))
+        nodes = {wait_scheduled(cs, f"flock-{i}").spec.node_name
+                 for i in range(3)}
+        assert len(nodes) == 1  # all co-located after replica 1 bootstraps
+
+    def test_unsatisfiable_affinity_stays_pending(self, cluster):
+        cs = cluster["cs"]
+        lonely = labeled_pod("lonely", {}, t.Affinity(
+            pod_affinity_required=[t.PodAffinityTerm(
+                label_selector=t.LabelSelector(match_labels={"app": "ghost"}),
+                topology_key="kubernetes.io/hostname",
+            )]
+        ))
+        cs.pods.create(lonely)
+        time.sleep(3.0)
+        assert not cs.pods.get("lonely", "default").spec.node_name
+
+
+class TestSelectorSpreading:
+    def test_deployment_replicas_spread_across_hosts(self, cluster):
+        cs = cluster["cs"]
+        dep = t.Deployment()
+        dep.metadata.name = "web"
+        dep.spec.replicas = 4
+        dep.spec.selector = t.LabelSelector(match_labels={"app": "web"})
+        dep.spec.template = t.PodTemplateSpec()
+        dep.spec.template.metadata.labels = {"app": "web"}
+        dep.spec.template.spec.containers = [
+            t.Container(name="c", image="x", command=["serve"],
+                        resources=t.ResourceRequirements(requests={"cpu": "100m"}))
+        ]
+        cs.deployments.create(dep)
+
+        def all_placed():
+            pods, _ = cs.pods.list(label_selector="app=web")
+            return len([p for p in pods if p.spec.node_name]) == 4
+
+        must_poll_until(all_placed, timeout=30.0, desc="4 replicas placed")
+        pods, _ = cs.pods.list(label_selector="app=web")
+        assert len({p.spec.node_name for p in pods}) == 4, \
+            "replicas piled up instead of spreading"
+
+
+class TestPerfGuard:
+    def test_no_checker_built_without_anti_affinity(self, cluster):
+        """Plain clusters never pay the O(pods) affinity pass: the sticky
+        flag only flips when an anti-affinity pod is observed."""
+        sched = cluster["sched"]
+        assert sched._anti_affinity_seen is False
+        cs = cluster["cs"]
+        cs.pods.create(labeled_pod("plain", {"app": "plain"}))
+        wait_scheduled(cs, "plain")
+        assert sched._anti_affinity_seen is False
+        cs.pods.create(labeled_pod(
+            "flagger", {"app": "f"}, anti_on_host({"app": "f"})))
+        wait_scheduled(cs, "flagger")
+        assert sched._anti_affinity_seen is True
