@@ -310,6 +310,7 @@ class Probe:
 class Container:
     name: str = ""
     image: str = ""
+    image_pull_policy: str = ""  # "" = IfNotPresent default | Always | Never
     command: List[str] = field(default_factory=list)
     args: List[str] = field(default_factory=list)
     working_dir: str = ""
@@ -1226,6 +1227,26 @@ class ClusterRoleBinding(KObject):
     API_VERSION = "rbac/v1"
     subjects: List[Subject] = field(default_factory=list)
     role_ref: RoleRef = field(default_factory=RoleRef)
+
+
+@dataclass
+class KubeletConfiguration(KObject):
+    """ComponentConfig for the kubelet (ref: pkg/apis/componentconfig +
+    pkg/kubelet/kubeletconfig/controller.go:81 — dynamic reconfiguration
+    from a ConfigMap with validation and last-known-good rollback).
+
+    Stored as the `kubelet` key (JSON) of a kube-system ConfigMap named
+    kubelet-config-<node> (per-node) or kubelet-config (cluster-wide);
+    the kubelet live-applies the dynamic fields below."""
+
+    KIND = "KubeletConfiguration"
+    API_VERSION = "kubelet.config.ktpu.io/v1"
+    sync_interval_seconds: Optional[float] = None
+    heartbeat_interval_seconds: Optional[float] = None
+    pleg_interval_seconds: Optional[float] = None
+    max_pods: Optional[int] = None
+    eviction_thresholds: Dict[str, float] = field(default_factory=dict)
+    volume_refresh_interval_seconds: Optional[float] = None
 
 
 # ------------------------------------------------------------------ metrics

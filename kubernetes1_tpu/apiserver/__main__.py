@@ -12,14 +12,25 @@ from .server import Master
 
 def main():
     ap = argparse.ArgumentParser(description="ktpu apiserver")
+    ap.add_argument("--feature-gates", default="", help="Name=true|false list (one shared gate map; utils/features.py)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8001)
     ap.add_argument("--wal", default="", help="write-ahead log path for durability")
     ap.add_argument("--token", default="", help="bearer token required from clients")
+    ap.add_argument("--authorization-mode", default="AlwaysAllow",
+                    help='AlwaysAllow | "Node,RBAC"')
+    ap.add_argument("--enable-admission-plugins", default="",
+                    help="comma list of opt-in plugins (e.g. AlwaysPullImages)")
     args = ap.parse_args()
+    if args.feature_gates:
+        from ..utils.features import gates
+        gates.apply(args.feature_gates)
 
     master = Master(
-        host=args.host, port=args.port, wal_path=args.wal or None, token=args.token
+        host=args.host, port=args.port, wal_path=args.wal or None, token=args.token,
+        authorization_mode=args.authorization_mode,
+        admission_plugins=[p.strip() for p in
+                           args.enable_admission_plugins.split(",") if p.strip()],
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

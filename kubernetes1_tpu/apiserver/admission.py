@@ -70,6 +70,116 @@ class ResourceV2(AdmissionPlugin):
                 container.extended_resource_requests.append(per.name)
 
 
+class ExtendedResourceToleration(AdmissionPlugin):
+    """Auto-tolerate taints keyed by the extended resources a pod requests
+    (ref: plugin/pkg/admission/extendedresourcetoleration/admission.go:31).
+
+    The TPU deployment pattern: taint the TPU pool with
+    `google.com/tpu:NoSchedule` so CPU pods stay off the expensive nodes;
+    TPU pods get the matching toleration injected here, so no user ever
+    writes one by hand."""
+
+    name = "ExtendedResourceToleration"
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        from ..utils.features import gates
+
+        if resource != "pods" or operation != CREATE \
+                or not gates.enabled("ExtendedResourceToleration"):
+            return
+        requested = {per.resource for per in obj.spec.extended_resources}
+        # pre-ResourceV2 form too (plugin order must not matter)
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for res_name in (c.resources.limits or {}):
+                if res_name.startswith(EXTENDED_RESOURCE_PREFIXES):
+                    requested.add(res_name)
+        for res_name in sorted(requested):
+            if not any(tol.key == res_name for tol in obj.spec.tolerations):
+                obj.spec.tolerations.append(
+                    t.Toleration(key=res_name, operator="Exists")
+                )
+
+
+# ref: cmd/kube-apiserver defaulttolerationseconds — 300s grace before the
+# node-lifecycle taints evict the pod
+DEFAULT_NOT_READY_TOLERATION_SECONDS = 300
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """Every pod tolerates not-ready/unreachable for 300s (ref:
+    plugin/pkg/admission/defaulttolerationseconds/admission.go) — transient
+    node blips don't instantly reschedule whole training jobs, but dead
+    nodes still free their chips after the window."""
+
+    name = "DefaultTolerationSeconds"
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        from ..utils.features import gates
+
+        if resource != "pods" or operation != CREATE \
+                or not gates.enabled("DefaultTolerationSeconds"):
+            return
+        for key in (TAINT_NODE_NOT_READY, TAINT_NODE_UNREACHABLE):
+            if not any(tol.key == key for tol in obj.spec.tolerations):
+                obj.spec.tolerations.append(t.Toleration(
+                    key=key, operator="Exists", effect="NoExecute",
+                    toleration_seconds=DEFAULT_NOT_READY_TOLERATION_SECONDS,
+                ))
+
+
+POD_NODE_SELECTOR_ANNOTATION = "scheduler.ktpu.io/node-selector"
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """Namespace-scoped placement policy (ref: plugin/pkg/admission/
+    podnodeselector/admission.go): a namespace annotated with
+    `scheduler.ktpu.io/node-selector: pool=tpu-v5e` has that selector
+    merged into every pod; conflicts with the pod's own selector reject."""
+
+    name = "PodNodeSelector"
+
+    def __init__(self, get_namespace):
+        self._get_namespace = get_namespace  # name -> Namespace | None
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        ns = self._get_namespace(obj.metadata.namespace)
+        if ns is None:
+            return
+        raw = (ns.metadata.annotations or {}).get(POD_NODE_SELECTOR_ANNOTATION)
+        if not raw:
+            return
+        for pair in raw.split(","):
+            key, _, value = pair.strip().partition("=")
+            if not key:
+                continue
+            have = obj.spec.node_selector.get(key)
+            if have is not None and have != value:
+                raise Forbidden(
+                    f"pod node selector {key}={have} conflicts with the "
+                    f"namespace policy {key}={value}"
+                )
+            obj.spec.node_selector[key] = value
+
+
+class AlwaysPullImages(AdmissionPlugin):
+    """Force imagePullPolicy=Always (ref: plugin/pkg/admission/
+    alwayspullimages/admission.go — in multi-tenant clusters a cached image
+    must not bypass registry authorization).  Off by default, enabled via
+    the admission plugin list like the reference."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource != "pods" or operation not in (CREATE, UPDATE):
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+
 class NamespaceAutoProvision(AdmissionPlugin):
     """Creates the namespace on first use (test/dev ergonomics; the reference
     ships NamespaceLifecycle + explicit creation — we keep lifecycle checks in

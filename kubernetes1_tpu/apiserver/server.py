@@ -21,7 +21,7 @@ import threading
 import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
@@ -32,12 +32,16 @@ from .admission import (
     CREATE,
     UPDATE,
     AdmissionChain,
+    AlwaysPullImages,
+    DefaultTolerationSeconds,
     EventRateLimit,
+    ExtendedResourceToleration,
     GangDefaulter,
     IdentityStamp,
     LimitRanger,
     NamespaceAutoProvision,
     NodeRestriction,
+    PodNodeSelector,
     PriorityResolver,
     ResourceQuotaAdmission,
     ResourceV2,
@@ -661,6 +665,7 @@ class Master:
         static_tokens: Optional[Dict[str, tuple]] = None,
         sa_signing_key: str = "ktpu-sa-key",
         ca_key: str = "ktpu-ca-key",
+        admission_plugins: Optional[List[str]] = None,  # extra opt-ins, e.g. AlwaysPullImages
     ):
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
@@ -703,20 +708,28 @@ class Master:
                 elif mode == "AlwaysAllow":
                     chain.append(AlwaysAllowAuthorizer())
             self.authorizer = AuthorizerChain(chain)
-        self.admission = AdmissionChain(
-            [
-                NamespaceAutoProvision(self.registry.ensure_namespace),
-                NodeRestriction(),  # before SA defaulting: checks the raw spec
-                PriorityResolver(self._get_priority_class),
-                ResourceV2(),
-                GangDefaulter(),
-                ServiceAccountAdmission(),
-                IdentityStamp(),
-                LimitRanger(self._list_limit_ranges),
-                ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
-                EventRateLimit(),
-            ]
-        )
+        plugins = [
+            NamespaceAutoProvision(self.registry.ensure_namespace),
+            NodeRestriction(),  # before SA defaulting: checks the raw spec
+            PodNodeSelector(self._get_namespace_or_none),
+            PriorityResolver(self._get_priority_class),
+            ExtendedResourceToleration(),  # before ResourceV2: sees raw limits too
+            DefaultTolerationSeconds(),
+            ResourceV2(),
+            GangDefaulter(),
+            ServiceAccountAdmission(),
+            IdentityStamp(),
+            LimitRanger(self._list_limit_ranges),
+            ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
+            EventRateLimit(),
+        ]
+        # opt-in plugins by name (the --admission-control list analog)
+        for name in (admission_plugins or []):
+            if name == "AlwaysPullImages":
+                plugins.append(AlwaysPullImages())
+            else:
+                raise ValueError(f"unknown admission plugin {name!r}")
+        self.admission = AdmissionChain(plugins)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.master = self  # type: ignore[attr-defined]
@@ -726,6 +739,11 @@ class Master:
 
     def _get_priority_class(self, name: str):
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
+
+    def _get_namespace_or_none(self, name: str):
+        if not name:
+            return None
+        return self.store.get_or_none(self.registry.key("namespaces", "", name))
 
     def _list_limit_ranges(self, namespace: str):
         items, _ = self.store.list(self.registry.prefix("limitranges", namespace))

@@ -13,6 +13,7 @@ from .manager import ControllerManager
 
 def main():
     ap = argparse.ArgumentParser(description="ktpu controller manager")
+    ap.add_argument("--feature-gates", default="", help="Name=true|false list (one shared gate map; utils/features.py)")
     ap.add_argument("--server", default="http://127.0.0.1:8001")
     ap.add_argument("--token", default="")
     ap.add_argument("--leader-elect", action="store_true")
@@ -20,6 +21,9 @@ def main():
     ap.add_argument("--node-monitor-grace", type=float, default=40.0)
     ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
     args = ap.parse_args()
+    if args.feature_gates:
+        from ..utils.features import gates
+        gates.apply(args.feature_gates)
 
     cs = Clientset(args.server, token=args.token)
     cm = ControllerManager(

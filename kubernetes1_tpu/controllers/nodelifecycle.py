@@ -76,14 +76,94 @@ class NodeLifecycleController:
                     stale = True
             if not stale and cond and cond.status == "True":
                 self._not_ready_since.pop(name, None)
+                # removal keys off the LISTED node's taints, not in-memory
+                # state — a restarted controller must still untaint
+                # recovered nodes (and skip the GET when no taint shows)
+                if any(tt.key == self.NOT_READY_TAINT for tt in node.spec.taints):
+                    self._remove_not_ready_taint(node)
                 continue
             # node is failing: mark NotReady (if kubelet isn't doing it) and
             # start the eviction clock
             since = self._not_ready_since.setdefault(name, now)
             if stale and cond and cond.status == "True":
                 self._mark_not_ready(node)
-            if now - since > self.eviction_timeout:
+            from ..utils.features import gates
+
+            if gates.enabled("TaintBasedEvictions"):
+                # taint-based path REPLACES the flat timer: the NoExecute
+                # taint keeps new pods off, and each pod's own
+                # tolerationSeconds (DefaultTolerationSeconds injects 300s)
+                # decides when it falls
+                if not any(tt.key == self.NOT_READY_TAINT
+                           for tt in node.spec.taints):
+                    self._apply_not_ready_taint(node)
+                self._evict_by_toleration(node, now - since)
+            elif now - since > self.eviction_timeout:
                 self._evict_pods(node)
+
+    NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+
+    def _apply_not_ready_taint(self, node: t.Node):
+        """TaintBasedEvictions (feature-gated, alpha in the reference): a
+        failing node gets the not-ready:NoExecute taint — the effect the
+        DefaultTolerationSeconds tolerations actually match."""
+        try:
+            fresh = self.cs.nodes.get(node.metadata.name, "")
+            if any(tt.key == self.NOT_READY_TAINT for tt in fresh.spec.taints):
+                return
+            fresh.spec.taints.append(
+                t.Taint(key=self.NOT_READY_TAINT, effect="NoExecute"))
+            self.cs.nodes.update(fresh)
+        except ApiError:
+            pass
+
+    def _remove_not_ready_taint(self, node: t.Node):
+        try:
+            fresh = self.cs.nodes.get(node.metadata.name, "")
+            kept = [tt for tt in fresh.spec.taints
+                    if tt.key != self.NOT_READY_TAINT]
+            if len(kept) != len(fresh.spec.taints):
+                fresh.spec.taints = kept
+                self.cs.nodes.update(fresh)
+        except ApiError:
+            pass
+
+    def _evict_by_toleration(self, node: t.Node, not_ready_for: float):
+        """NoExecute semantics (ref: the taint manager): a pod with no
+        matching toleration falls immediately; tolerationSeconds=N falls
+        after N; an unbounded toleration rides out the outage."""
+        taint = t.Taint(key=self.NOT_READY_TAINT, effect="NoExecute")
+        from ..scheduler.predicates import _tolerates
+
+        for pod in self.pods.list():
+            if pod.spec.node_name != node.metadata.name:
+                continue
+            if pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED):
+                continue
+            matching = [tol for tol in pod.spec.tolerations
+                        if _tolerates(tol, taint)]
+            if matching:
+                seconds = [tol.toleration_seconds for tol in matching]
+                if any(s is None for s in seconds):
+                    continue  # tolerates indefinitely
+                if not_ready_for <= max(s for s in seconds):
+                    continue  # still within its grace window
+            if pod.metadata.deletion_timestamp:
+                try:  # kubelet is gone; force-finalize so it reschedules
+                    self.cs.pods.delete(
+                        pod.metadata.name, pod.metadata.namespace, grace_seconds=0)
+                except ApiError:
+                    pass
+                continue
+            try:
+                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+                self.recorder.event(
+                    pod, "Warning", "TaintEviction",
+                    f"evicted: node {node.metadata.name} not-ready past "
+                    f"the pod's toleration",
+                )
+            except ApiError:
+                pass
 
     def _mark_not_ready(self, node: t.Node):
         try:

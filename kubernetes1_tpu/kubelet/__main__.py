@@ -16,6 +16,7 @@ from .runtime import FakeRuntime, ProcessRuntime
 
 def main():
     ap = argparse.ArgumentParser(description="ktpu kubelet")
+    ap.add_argument("--feature-gates", default="", help="Name=true|false list (one shared gate map; utils/features.py)")
     ap.add_argument("--server", default="http://127.0.0.1:8001")
     ap.add_argument("--token", default="")
     ap.add_argument("--node-name", default="node-0")
@@ -25,6 +26,9 @@ def main():
     ap.add_argument("--root-dir", default="/tmp/ktpu")
     ap.add_argument("--label", action="append", default=[], help="k=v node label")
     args = ap.parse_args()
+    if args.feature_gates:
+        from ..utils.features import gates
+        gates.apply(args.feature_gates)
 
     cs = Clientset(args.server, token=args.token)
     runtime = (

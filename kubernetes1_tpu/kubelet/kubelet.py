@@ -173,7 +173,10 @@ class Kubelet:
         return {"cpu": str(cpus), "memory": f"{mem_kb}Ki", "pods": "110"}
 
     def start(self):
-        self.device_manager.start()
+        from ..utils.features import gates
+
+        if gates.enabled("DevicePlugins"):
+            self.device_manager.start()
         if self.server is not None:
             self.server.start()
         self._reconcile_runtime()
@@ -191,15 +194,15 @@ class Kubelet:
             th = threading.Thread(target=self._sync_worker, daemon=True, name=f"sync-{i}")
             th.start()
             self._threads.append(th)
-        for fn, period, name in (
-            (self._heartbeat, self.heartbeat_interval, "heartbeat"),
-            (self._pleg_relist, self.pleg_interval, "pleg"),
-            (self._tick_all, self.sync_interval, "sync-ticker"),
-            (self._publish_metrics, self.heartbeat_interval, "stats"),
-            (self._eviction_pass, self.eviction_interval, "eviction"),
+        for fn, period_attr, name in (
+            (self._heartbeat, "heartbeat_interval", "heartbeat"),
+            (self._pleg_relist, "pleg_interval", "pleg"),
+            (self._tick_all, "sync_interval", "sync-ticker"),
+            (self._publish_metrics, "heartbeat_interval", "stats"),
+            (self._eviction_pass, "eviction_interval", "eviction"),
         ):
             th = threading.Thread(
-                target=self._loop, args=(fn, period), daemon=True, name=name
+                target=self._loop, args=(fn, period_attr), daemon=True, name=name
             )
             th.start()
             self._threads.append(th)
@@ -215,12 +218,15 @@ class Kubelet:
         if self.server is not None:
             self.server.stop()
 
-    def _loop(self, fn, period: float):
+    def _loop(self, fn, period_attr: str):
+        # the period is re-read each cycle so dynamic kubelet config can
+        # retune a live kubelet without restarting its loops
         while not self._stop.is_set():
             try:
                 fn()
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
+            period = getattr(self, period_attr)
             if fn is self._heartbeat:
                 # wake immediately on capacity change
                 self._heartbeat_event.wait(period)
@@ -377,6 +383,85 @@ class Kubelet:
                 self._publish_token_secret()
             except ApiError:
                 pass
+        if self._beats % self.TOKEN_RECHECK_BEATS == 0:
+            self._sync_dynamic_config()
+
+    # ------------------------------------------------ dynamic kubelet config
+
+    # fields a live kubelet re-tunes (ref kubeletconfig/controller.go)
+    _DYNAMIC_FIELDS = (
+        ("sync_interval_seconds", "sync_interval"),
+        ("heartbeat_interval_seconds", "heartbeat_interval"),
+        ("pleg_interval_seconds", "pleg_interval"),
+    )
+
+    def _sync_dynamic_config(self):
+        """DynamicKubeletConfig (feature-gated): live-reload tuning from a
+        kube-system ConfigMap — per-node kubelet-config-<node> wins over the
+        cluster-wide kubelet-config.  Invalid payloads keep the last-known-
+        good settings (the reference's rollback semantics collapsed to
+        'never apply what doesn't validate')."""
+        from ..utils.features import gates
+
+        if not gates.enabled("DynamicKubeletConfig"):
+            return
+        cm = None
+        for name in (f"kubelet-config-{self.node_name}", "kubelet-config"):
+            try:
+                cm = self.cs.configmaps.get(name, self.TOKEN_SECRET_NS)
+                break
+            except NotFound:
+                continue
+            except ApiError:
+                return
+        if cm is None:
+            return
+        rv = cm.metadata.resource_version
+        if rv == getattr(self, "_config_rv", None):
+            return
+        self._config_rv = rv  # seen (good or bad); a new write retries
+        try:
+            from ..machinery.scheme import from_dict
+
+            data = json.loads(cm.data.get("kubelet", "{}"))
+            cfg = from_dict(t.KubeletConfiguration, data)
+            self._validate_kubelet_config(cfg)
+        except (ValueError, TypeError, KeyError) as e:
+            self.recorder.event(
+                self._node_object(), "Warning", "InvalidKubeletConfig",
+                f"configmap {cm.metadata.name}: {e}; keeping last-known-good",
+            )
+            return
+        for src, dst in self._DYNAMIC_FIELDS:
+            val = getattr(cfg, src)
+            if val is not None:
+                setattr(self, dst, float(val))
+        if cfg.max_pods is not None:
+            self.capacity["pods"] = str(cfg.max_pods)
+        if cfg.eviction_thresholds:
+            self.eviction.thresholds = dict(cfg.eviction_thresholds)
+        if cfg.volume_refresh_interval_seconds is not None:
+            self.volume_manager.refresh_interval = float(
+                cfg.volume_refresh_interval_seconds)
+        self.recorder.event(
+            self._node_object(), "Normal", "KubeletConfigApplied",
+            f"applied {cm.metadata.name} rv={rv}",
+        )
+
+    @staticmethod
+    def _validate_kubelet_config(cfg: "t.KubeletConfiguration"):
+        for fname in ("sync_interval_seconds", "heartbeat_interval_seconds",
+                      "pleg_interval_seconds", "volume_refresh_interval_seconds"):
+            val = getattr(cfg, fname)
+            if val is not None and (not isinstance(val, (int, float)) or val <= 0):
+                raise ValueError(f"{fname} must be a positive number, got {val!r}")
+        if cfg.max_pods is not None and (
+                not isinstance(cfg.max_pods, int) or cfg.max_pods < 1):
+            raise ValueError(f"maxPods must be a positive integer, got {cfg.max_pods!r}")
+        for sig, frac in cfg.eviction_thresholds.items():
+            if not isinstance(frac, (int, float)) or not 0 <= frac <= 1:
+                raise ValueError(
+                    f"eviction threshold {sig}={frac!r} must be a 0..1 fraction")
 
     # -------------------------------------------------- probes and eviction
 
@@ -789,7 +874,12 @@ class Kubelet:
                 return
             try:
                 if hasattr(self.runtime, "images"):
-                    self.runtime.images.pull_image(container.image)
+                    # imagePullPolicy (ref kuberuntime_container.go:88):
+                    # Always re-pulls; Never skips; default pulls if absent
+                    policy = container.image_pull_policy or "IfNotPresent"
+                    present = self.runtime.images.image_present(container.image)
+                    if policy == "Always" or (policy != "Never" and not present):
+                        self.runtime.images.pull_image(container.image)
                 cid = self.runtime.create_container(sandbox_id, config)
                 self.runtime.start_container(cid)
                 with self._lock:

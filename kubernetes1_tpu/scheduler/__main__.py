@@ -13,12 +13,16 @@ from .scheduler import Scheduler
 
 def main():
     ap = argparse.ArgumentParser(description="ktpu scheduler")
+    ap.add_argument("--feature-gates", default="", help="Name=true|false list (one shared gate map; utils/features.py)")
     ap.add_argument("--server", default="http://127.0.0.1:8001")
     ap.add_argument("--token", default="")
     ap.add_argument("--scheduler-name", default="default-scheduler")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--identity", default="scheduler-0")
     args = ap.parse_args()
+    if args.feature_gates:
+        from ..utils.features import gates
+        gates.apply(args.feature_gates)
 
     cs = Clientset(args.server, token=args.token)
     sched = Scheduler(cs, scheduler_name=args.scheduler_name)

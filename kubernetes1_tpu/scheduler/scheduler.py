@@ -232,8 +232,12 @@ class Scheduler:
         start = time.monotonic()
         self.schedule_attempts += 1
         if pod.spec.scheduling_gang:
-            self._schedule_gang(pod)
-            return
+            from ..utils.features import gates
+
+            if gates.enabled("GangScheduling"):
+                self._schedule_gang(pod)
+                return
+            # gate off: members place independently (the pre-gang behavior)
         result, failure = self.schedule(pod)
         if result is None:
             self.schedule_failures += 1

@@ -1,0 +1,77 @@
+"""Feature gates: one `--feature-gates` map shared by every binary
+(ref: pkg/features/kube_features.go — a single alpha/beta switchboard;
+e.g. DevicePlugins :76, Accelerators :70, TaintBasedEvictions).
+
+Gates that are actually consulted in this codebase:
+
+- DevicePlugins (default on): kubelet runs the device manager / plugin
+  watcher; off = CPU-only kubelet.
+- ExtendedResourceToleration (default on): admission auto-tolerates taints
+  keyed by requested extended resources.
+- DefaultTolerationSeconds (default on): admission injects the 300s
+  not-ready/unreachable tolerations.
+- TaintBasedEvictions (default off, alpha in the reference): the node
+  lifecycle controller taints NotReady nodes with
+  node.kubernetes.io/not-ready:NoSchedule instead of relying purely on the
+  readiness predicate.
+- DynamicKubeletConfig (default on): kubelet live-reloads its
+  KubeletConfiguration from a ConfigMap with last-known-good rollback.
+- GangScheduling (default on): scheduler honors scheduling_gang
+  all-or-nothing placement.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+DEFAULT_GATES: Dict[str, bool] = {
+    "DevicePlugins": True,
+    "ExtendedResourceToleration": True,
+    "DefaultTolerationSeconds": True,
+    "TaintBasedEvictions": False,
+    "DynamicKubeletConfig": True,
+    "GangScheduling": True,
+}
+
+
+class FeatureGates:
+    def __init__(self, spec: str = "", defaults: Optional[Dict[str, bool]] = None):
+        self._lock = threading.Lock()
+        self._gates = dict(defaults if defaults is not None else DEFAULT_GATES)
+        if spec:
+            self.apply(spec)
+
+    def apply(self, spec: str):
+        """Parse 'Gate1=true,Gate2=false' (the --feature-gates flag form).
+        Unknown gates are an error — a typo silently doing nothing is how
+        clusters run for months with the wrong config."""
+        for pair in spec.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            name, sep, val = pair.partition("=")
+            if not sep or val.lower() not in ("true", "false"):
+                raise ValueError(f"feature gate {pair!r}: want Name=true|false")
+            with self._lock:
+                if name not in self._gates:
+                    raise ValueError(
+                        f"unknown feature gate {name!r} "
+                        f"(known: {', '.join(sorted(self._gates))})"
+                    )
+                self._gates[name] = val.lower() == "true"
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._gates:
+                raise KeyError(f"unknown feature gate {name!r}")
+            return self._gates[name]
+
+    def snapshot(self) -> Dict[str, bool]:
+        with self._lock:
+            return dict(self._gates)
+
+
+# the process-wide instance every component consults; binaries call
+# gates.apply(args.feature_gates) at startup
+gates = FeatureGates()

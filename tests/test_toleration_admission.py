@@ -1,0 +1,133 @@
+"""Toleration admission family (ref: plugin/pkg/admission/
+extendedresourcetoleration/admission.go:31, defaulttolerationseconds,
+podnodeselector, alwayspullimages)."""
+
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import Forbidden
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+from tests.test_controllers import start_hollow_node
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = Master(admission_plugins=["AlwaysPullImages"]).start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs)
+    sched.start()
+    # a tainted TPU pool node + an untainted CPU node
+    tpu_kl, tpu_pl, _ = start_hollow_node(cs, "tpu-pool-0", str(tmp_path), tpus=4)
+    cpu_kl, cpu_pl, _ = start_hollow_node(cs, "cpu-0", str(tmp_path), tpus=0)
+
+    def taint_applied():
+        node = cs.nodes.get("tpu-pool-0", "")
+        node.spec.taints = [t.Taint(key="google.com/tpu", effect="NoSchedule")]
+        try:
+            cs.nodes.update(node)
+            return True
+        except Exception:  # noqa: BLE001  (heartbeat conflict; retry)
+            return False
+
+    must_poll_until(taint_applied, timeout=10.0, desc="taint the TPU pool")
+    env = {"master": master, "cs": cs}
+    yield env
+    tpu_kl.stop()
+    tpu_pl.stop()
+    cpu_kl.stop()
+    cpu_pl.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+class TestExtendedResourceToleration:
+    def test_tpu_pod_lands_on_tainted_pool_without_user_tolerations(self, cluster):
+        """The VERDICT r3 'done' bar: a tainted TPU pool accepts TPU pods
+        with no user-written tolerations; CPU pods stay off it."""
+        cs = cluster["cs"]
+        tpu_pod = make_tpu_pod("trainer", tpus=2)
+        tpu_pod.spec.containers[0].command = ["serve"]
+        assert not tpu_pod.spec.tolerations  # user wrote none
+        created = cs.pods.create(tpu_pod)
+        # admission injected the matching toleration
+        assert any(tol.key == "google.com/tpu" and tol.operator == "Exists"
+                   for tol in created.spec.tolerations)
+        must_poll_until(
+            lambda: cs.pods.get("trainer", "default").spec.node_name == "tpu-pool-0",
+            timeout=15.0, desc="TPU pod on the tainted pool",
+        )
+        # a CPU pod never tolerates the pool taint
+        cpu_pod = make_tpu_pod("web", tpus=0)
+        cpu_pod.spec.containers[0].command = ["serve"]
+        created = cs.pods.create(cpu_pod)
+        assert not any(tol.key == "google.com/tpu"
+                       for tol in created.spec.tolerations)
+        must_poll_until(
+            lambda: cs.pods.get("web", "default").spec.node_name == "cpu-0",
+            timeout=15.0, desc="CPU pod on the CPU node",
+        )
+
+
+class TestDefaultTolerationSeconds:
+    def test_not_ready_tolerations_injected(self, cluster):
+        cs = cluster["cs"]
+        pod = make_tpu_pod("anypod", tpus=0)
+        pod.spec.containers[0].command = ["serve"]
+        created = cs.pods.create(pod)
+        by_key = {tol.key: tol for tol in created.spec.tolerations}
+        for key in ("node.kubernetes.io/not-ready",
+                    "node.kubernetes.io/unreachable"):
+            assert key in by_key
+            assert by_key[key].toleration_seconds == 300
+            assert by_key[key].effect == "NoExecute"
+
+    def test_user_toleration_not_overridden(self, cluster):
+        cs = cluster["cs"]
+        pod = make_tpu_pod("custom", tpus=0)
+        pod.spec.containers[0].command = ["serve"]
+        pod.spec.tolerations = [t.Toleration(
+            key="node.kubernetes.io/not-ready", operator="Exists",
+            effect="NoExecute", toleration_seconds=5)]
+        created = cs.pods.create(pod)
+        mine = [tol for tol in created.spec.tolerations
+                if tol.key == "node.kubernetes.io/not-ready"]
+        assert len(mine) == 1 and mine[0].toleration_seconds == 5
+
+
+class TestPodNodeSelector:
+    def test_namespace_selector_merged_and_conflicts_rejected(self, cluster):
+        cs = cluster["cs"]
+        ns = t.Namespace()
+        ns.metadata.name = "tpu-tenant"
+        ns.metadata.annotations = {
+            "scheduler.ktpu.io/node-selector": "pool=v5e,team=ml"}
+        cs.namespaces.create(ns, "")
+        pod = make_tpu_pod("tenant-pod", tpus=0, ns="tpu-tenant")
+        pod.spec.containers[0].command = ["serve"]
+        created = cs.pods.create(pod, "tpu-tenant")
+        assert created.spec.node_selector["pool"] == "v5e"
+        assert created.spec.node_selector["team"] == "ml"
+        # conflicting pod-level selector is rejected, not silently merged
+        bad = make_tpu_pod("rogue", tpus=0, ns="tpu-tenant")
+        bad.spec.containers[0].command = ["serve"]
+        bad.spec.node_selector = {"pool": "v5p"}
+        with pytest.raises(Forbidden, match="conflicts with the namespace"):
+            cs.pods.create(bad, "tpu-tenant")
+
+
+class TestAlwaysPullImages:
+    def test_pull_policy_forced(self, cluster):
+        cs = cluster["cs"]
+        pod = make_tpu_pod("pully", tpus=0)
+        pod.spec.containers[0].command = ["serve"]
+        pod.spec.containers[0].image_pull_policy = "Never"
+        created = cs.pods.create(pod)
+        assert created.spec.containers[0].image_pull_policy == "Always"
