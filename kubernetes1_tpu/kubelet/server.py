@@ -206,9 +206,19 @@ class _KubeletHandler(BaseHTTPRequestHandler):
             elif parts[:2] == ["stats", "summary"] or parts == ["stats"]:
                 self._send(200, kl.stats_summary())
             elif parts == ["metrics"]:
+                # ref pkg/kubelet/metrics/ + the fork's
+                # DevicePluginAllocationLatency (manager.go:231) — the
+                # signature metric must be scrapeable, not just recorded
+                running = sum(
+                    1 for c in kl.runtime.list_containers()
+                    if c.state == "RUNNING"
+                )
                 body = (
                     f"# TYPE kubelet_running_pods gauge\n"
                     f"kubelet_running_pods {len(kl.pods.list())}\n"
+                    f"# TYPE kubelet_running_containers gauge\n"
+                    f"kubelet_running_containers {running}\n"
+                    + kl.device_manager.allocation_latency.render()
                 )
                 self._send(200, body, content_type="text/plain; version=0.0.4")
             else:

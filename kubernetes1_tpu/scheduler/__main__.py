@@ -19,13 +19,18 @@ def main():
     ap.add_argument("--scheduler-name", default="default-scheduler")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--identity", default="scheduler-0")
+    ap.add_argument("--metrics-port", type=int, default=10251,
+                    help="/metrics + /healthz port (0 = ephemeral, -1 = off)")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
     cs = Clientset(args.server, token=args.token)
-    sched = Scheduler(cs, scheduler_name=args.scheduler_name)
+    sched = Scheduler(
+        cs, scheduler_name=args.scheduler_name,
+        metrics_port=None if args.metrics_port < 0 else args.metrics_port,
+    )
     stop = threading.Event()
 
     if args.leader_elect:

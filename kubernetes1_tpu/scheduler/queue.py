@@ -94,6 +94,13 @@ class SchedulingQueue:
         with self._cond:
             return len(self._heap)
 
+    def depth(self) -> int:
+        """Pending entries — active heap PLUS pods in backoff (the gauge
+        must not read ~0 exactly when everything is unschedulable and
+        backing off; the reference counts active+backoff+unschedulable)."""
+        with self._cond:
+            return len(self._heap) + len(self._timers)
+
     def shut_down(self):
         with self._cond:
             self._shutdown = True

@@ -129,3 +129,65 @@ class Registry:
 
 
 global_registry = Registry()
+
+
+class MetricsServer:
+    """Tiny /metrics + /healthz HTTP server for a component process (ref:
+    every reference binary serves prometheus on its own port — scheduler
+    :10251, kubelet :10250/metrics, controller-manager :10252)."""
+
+    def __init__(self, registry: Registry, host: str = "127.0.0.1",
+                 port: int = 0, extra: Optional[Dict[str, callable]] = None):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry_ref = registry
+        extra_fns = dict(extra or {})  # name -> () -> float, appended as gauges
+
+        class _H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = _json.dumps({"status": "ok"}).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    text = registry_ref.render()
+                    for name, fn in extra_fns.items():
+                        try:
+                            text += f"# TYPE {name} gauge\n{name} {float(fn())}\n"
+                        except Exception:  # noqa: BLE001
+                            pass
+                    body = text.encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), _H)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="metrics-server",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
