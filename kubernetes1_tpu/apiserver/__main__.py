@@ -21,16 +21,24 @@ def main():
                     help='AlwaysAllow | "Node,RBAC"')
     ap.add_argument("--enable-admission-plugins", default="",
                     help="comma list of opt-in plugins (e.g. AlwaysPullImages)")
+    ap.add_argument("--ca-key-file", default="",
+                    help="cluster CA key (certificate credentials)")
+    ap.add_argument("--sa-key-file", default="",
+                    help="service-account token signing key")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
+
+    from ..utils.procutil import read_key
 
     master = Master(
         host=args.host, port=args.port, wal_path=args.wal or None, token=args.token,
         authorization_mode=args.authorization_mode,
         admission_plugins=[p.strip() for p in
                            args.enable_admission_plugins.split(",") if p.strip()],
+        ca_key=read_key(args.ca_key_file, "ktpu-ca-key"),
+        sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)
@@ -38,6 +46,9 @@ def main():
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    from ..utils.procutil import bounded_exit
+
+    bounded_exit(5.0)
     master.stop()
 
 

@@ -97,6 +97,53 @@ class ServiceAccountAuthenticator:
         )
 
 
+BOOTSTRAP_TOKEN_SECRET_TYPE = "bootstrap.kubernetes.io/token"
+GROUP_BOOTSTRAPPERS = "system:bootstrappers"
+
+
+class BootstrapTokenAuthenticator:
+    """kubeadm-style join tokens (ref: apiserver bootstrap token authn +
+    cmd/kubeadm bootstrap tokens): a token `<id>.<secret>` matches the
+    kube-system Secret bootstrap-token-<id> of the bootstrap type and
+    authenticates as system:bootstrap:<id> in system:bootstrappers — just
+    enough identity to submit a node CSR and nothing else."""
+
+    def __init__(self, get_secret: Callable[[str, str], Optional[t.Secret]]):
+        self._get_secret = get_secret  # (namespace, name) -> Secret | None
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        import hmac as _hmac
+
+        token_id, sep, secret = token.partition(".")
+        if not sep or not token_id or not secret or "." in secret:
+            return None
+        obj = self._get_secret("kube-system", f"bootstrap-token-{token_id}")
+        if obj is None or obj.type != BOOTSTRAP_TOKEN_SECRET_TYPE:
+            return None
+        want = obj.data.get("token-secret", "")
+        if not want or not _hmac.compare_digest(secret, want):
+            return None
+        # a staged/disabled token must not authenticate, and tokens expire
+        # (ref: bootstrap token authenticator usage + expiration checks)
+        if obj.data.get("usage-bootstrap-authentication") != "true":
+            return None
+        expiry = obj.data.get("expiration", "")
+        if expiry:
+            from ..machinery.meta import parse_iso
+
+            try:
+                import time as _time
+
+                if parse_iso(expiry) < _time.time():
+                    return None
+            except ValueError:
+                return None  # unparseable expiry = unusable token
+        return UserInfo(
+            name=f"system:bootstrap:{token_id}",
+            groups=[GROUP_BOOTSTRAPPERS, GROUP_AUTHENTICATED],
+        )
+
+
 class CertificateAuthenticator:
     """Verifies KTPU-CERT credentials issued by the CSR signer."""
 
@@ -211,17 +258,23 @@ class NodeAuthorizer:
     REFERENCED_READ_RESOURCES = {"secrets", "configmaps", "persistentvolumeclaims"}
 
     def __init__(self, get_pod: Callable[[str, str], Optional[t.Pod]],
-                 list_pods: Optional[Callable[[], list]] = None):
+                 list_pods: Optional[Callable[[], list]] = None,
+                 get_serviceaccount: Optional[Callable] = None):
         self._get_pod = get_pod
         self._list_pods = list_pods
+        self._get_sa = get_serviceaccount  # (namespace, name) -> SA | None
+
+    def _node_pods(self, node_name: str, namespace: str):
+        if self._list_pods is None:
+            return
+        for pod in self._list_pods():
+            if pod.spec.node_name == node_name \
+                    and pod.metadata.namespace == namespace:
+                yield pod
 
     def _pod_references(self, node_name: str, resource: str,
                         namespace: str, name: str) -> bool:
-        if self._list_pods is None:
-            return False
-        for pod in self._list_pods():
-            if pod.spec.node_name != node_name or pod.metadata.namespace != namespace:
-                continue
+        for pod in self._node_pods(node_name, namespace):
             for vol in pod.spec.volumes:
                 if resource == "secrets" and vol.secret is not None \
                         and vol.secret.secret_name == name:
@@ -233,7 +286,21 @@ class NodeAuthorizer:
                         and vol.persistent_volume_claim is not None \
                         and vol.persistent_volume_claim.claim_name == name:
                     return True
+            # the SA token secret the kubelet automounts (the reference's
+            # node-authorizer graph walks pod -> serviceaccount -> secret)
+            if resource == "secrets" and self._get_sa is not None:
+                sa = self._get_sa(
+                    namespace, pod.spec.service_account_name or "default")
+                if sa is not None and any(ref.name == name for ref in sa.secrets):
+                    return True
         return False
+
+    def _pod_uses_serviceaccount(self, node_name: str, namespace: str,
+                                 name: str) -> bool:
+        return any(
+            (pod.spec.service_account_name or "default") == name
+            for pod in self._node_pods(node_name, namespace)
+        )
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
                   namespace: str, name: str, sub: str = "") -> bool:
@@ -244,6 +311,10 @@ class NodeAuthorizer:
             # exec through the API
             return False
         node_name = user.name[len("system:node:"):]
+        if resource == "configmaps" and namespace == "kube-system" \
+                and verb == "get" and name in (
+                    f"kubelet-config-{node_name}", "kubelet-config"):
+            return True  # dynamic kubelet config source
         if resource == "secrets":
             # its own kubelet-token secret is writable (NodeRestriction
             # admission pins the name on CREATE, where the URL carries none)
@@ -251,6 +322,9 @@ class NodeAuthorizer:
                 not name or name == f"kubelet-token-{node_name}"
             ) and verb in ("create", "update", "patch"):
                 return True
+        if resource == "serviceaccounts":
+            return verb == "get" and bool(name) \
+                and self._pod_uses_serviceaccount(node_name, namespace, name)
         if resource in self.REFERENCED_READ_RESOURCES:
             return verb == "get" and bool(name) and self._pod_references(
                 node_name, resource, namespace, name

@@ -54,6 +54,7 @@ from .auth import (
     AlwaysAllowAuthorizer,
     AuthenticatorChain,
     AuthorizerChain,
+    BootstrapTokenAuthenticator,
     CertificateAuthenticator,
     NodeAuthorizer,
     RBACAuthorizer,
@@ -691,6 +692,7 @@ class Master:
                     sa_signing_key, get_serviceaccount=self._get_serviceaccount
                 ),
                 CertificateAuthenticator(ca_key),
+                BootstrapTokenAuthenticator(self._get_secret_or_none),
             ]
         )
         if authorization_mode == "AlwaysAllow":
@@ -701,7 +703,8 @@ class Master:
                 mode = mode.strip()
                 if mode == "Node":
                     chain.append(
-                        NodeAuthorizer(self._get_pod_or_none, self._list_all_pods)
+                        NodeAuthorizer(self._get_pod_or_none, self._list_all_pods,
+                                       get_serviceaccount=self._get_serviceaccount)
                     )
                 elif mode == "RBAC":
                     chain.append(RBACAuthorizer(self._list_for_auth))
@@ -765,6 +768,11 @@ class Master:
         return self.store.get_or_none(
             self.registry.key("serviceaccounts", namespace, name)
         )
+
+    def _get_secret_or_none(self, namespace: str, name: str):
+        if not namespace or not name:
+            return None
+        return self.store.get_or_none(self.registry.key("secrets", namespace, name))
 
     def _get_pod_or_none(self, namespace: str, name: str):
         if not namespace or not name:

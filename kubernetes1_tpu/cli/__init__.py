@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import time
@@ -590,6 +591,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="FakeRuntime nodes (default: real process runtime)")
     cu.add_argument("--real-tpu", action="store_true",
                     help="node 0 advertises the host's real /dev/accel* chips")
+
+    ini = sub.add_parser("init", help="bootstrap a control-plane host (kubeadm init)")
+    ini.add_argument("--dir", default=os.path.expanduser("~/.ktpu"),
+                     help="cluster state dir (keys, manifests, logs)")
+    ini.add_argument("--port", type=int, default=6443)
+    ini.add_argument("--advertise-address", default="127.0.0.1")
+    ini.add_argument("--node-name", default=os.uname().nodename)
+
+    jn = sub.add_parser("join", help="join this host to a cluster (kubeadm join)")
+    jn.add_argument("--server", required=True)
+    jn.add_argument("--token", required=True, help="join token from `ktpu init`")
+    jn.add_argument("--node-name", default=os.uname().nodename)
+    jn.add_argument("--dir", default=os.path.expanduser("~/.ktpu"))
     return p
 
 
@@ -600,6 +614,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "version":
         print("ktpu v0.1 (kubernetes1_tpu)")
         return 0
+    if args.cmd == "init":
+        from .bootstrap import init as _init
+
+        return _init(args)
+    if args.cmd == "join":
+        from .bootstrap import join as _join
+
+        return _join(args)
     if args.cmd == "cluster-up":
         from ..localcluster import LocalCluster
 

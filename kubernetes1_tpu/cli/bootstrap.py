@@ -1,0 +1,283 @@
+"""`ktpu init` / `ktpu join`: two-command cluster bootstrap.
+
+Ref: cmd/kubeadm phases — certs (app/phases/certs), control-plane static
+manifests (app/phases/controlplane/manifests.go:45-47), bootstrap tokens
+(app/phases/bootstraptoken), and the kubelet TLS-bootstrap CSR flow.
+
+init, on the first host:
+  1. certs phase     — mint the cluster CA key, SA signing key, an admin
+                       token, and a join token; write them under --dir.
+  2. control-plane   — write static-pod manifests for
+                       apiserver/scheduler/controller-manager into
+                       <dir>/manifests AND launch those exact commands as
+                       local processes (the manifests are the restartable
+                       record; there is no pre-existing kubelet to run them).
+  3. bootstrap phase — store the join token as the kube-system
+                       bootstrap-token Secret; create the RBAC that lets
+                       system:bootstrappers submit node CSRs; print the
+                       join command.
+  4. kubelet         — bootstrap this host's kubelet through the same CSR
+                       flow join uses, then start it.
+
+join, on another host:
+  1. authenticate with the join token (system:bootstrap:<id>).
+  2. submit a node CSR; the certificate controller auto-approves node
+     client certs and signs; poll for the credential.
+  3. write kubelet.conf and start the kubelet with the signed credential.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets as _secrets
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..api import types as t
+from ..client import Clientset
+from ..machinery import AlreadyExists, ApiError, NotFound
+
+CONTROL_PLANE = ("apiserver", "controller-manager", "scheduler")
+
+
+def _write(path: str, content: str, mode: int = 0o600) -> str:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+    os.chmod(path, mode)
+    return path
+
+
+def _manifest(name: str, command: List[str]) -> dict:
+    """Static-pod manifest shape (the kubeadm manifests analog): a kubelet
+    with --static-pod-dir pointed at <dir>/manifests re-hosts the control
+    plane after a reboot."""
+    return {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {"name": f"kube-{name}", "namespace": "kube-system",
+                     "labels": {"component": name, "tier": "control-plane"}},
+        "spec": {"containers": [{
+            "name": name, "image": "ktpu-control-plane",
+            "command": command,
+        }], "restartPolicy": "Always"},
+    }
+
+
+def _spawn(command: List[str], log_path: str) -> subprocess.Popen:
+    logf = open(log_path, "ab")
+    return subprocess.Popen(
+        command, stdout=logf, stderr=subprocess.STDOUT,
+        start_new_session=True, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def _wait_healthy(cs: Clientset, timeout: float = 30.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            cs.api.request("GET", "/healthz")
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.2)
+    raise SystemExit(f"error: apiserver never became healthy: {last}")
+
+
+def bootstrap_node_credential(server: str, join_token: str, node_name: str,
+                              timeout: float = 30.0) -> str:
+    """The kubelet TLS-bootstrap flow (ref: kubelet certificate bootstrap +
+    pkg/controller/certificates): submit a CSR as the bootstrap identity,
+    wait for auto-approval + signature, return the signed credential."""
+    bcs = Clientset(server, token=join_token)
+    try:
+        csr = t.CertificateSigningRequest()
+        csr.metadata.name = f"node-csr-{node_name}"
+        csr.spec.request = f"node-client-{node_name}"
+        csr.spec.username = f"system:node:{node_name}"
+        csr.spec.groups = ["system:nodes"]
+        csr.spec.usages = ["client auth"]
+        try:
+            bcs.certificatesigningrequests.create(csr, "")
+        except AlreadyExists:
+            pass  # re-join: poll the existing CSR below
+        except ApiError as e:
+            raise SystemExit(f"error: CSR create failed: {e}")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                cur = bcs.certificatesigningrequests.get(csr.metadata.name, "")
+            except NotFound:
+                time.sleep(0.2)
+                continue
+            if any(c.type == "Denied" for c in cur.status.conditions):
+                raise SystemExit(f"error: CSR {csr.metadata.name} was denied")
+            if cur.status.certificate:
+                return cur.status.certificate
+            time.sleep(0.2)
+        raise SystemExit("error: timed out waiting for the CSR to be signed "
+                         "(is the controller-manager running?)")
+    finally:
+        bcs.close()
+
+
+def init(args) -> int:
+    d = os.path.abspath(args.dir)
+    port = args.port
+    server = f"http://{args.advertise_address}:{port}"
+
+    # ---- phase certs
+    ca_key = _secrets.token_hex(32)
+    sa_key = _secrets.token_hex(32)
+    admin_token = _secrets.token_hex(16)
+    token_id = _secrets.token_hex(3)
+    token_secret = _secrets.token_hex(8)
+    join_token = f"{token_id}.{token_secret}"
+    _write(os.path.join(d, "pki", "ca.key"), ca_key)
+    _write(os.path.join(d, "pki", "sa.key"), sa_key)
+    admin_conf = {"server": server, "token": admin_token}
+    _write(os.path.join(d, "admin.conf"), json.dumps(admin_conf, indent=1))
+    print(f"[certs] cluster keys + admin.conf written under {d}")
+
+    # ---- phase control-plane (manifests + processes)
+    commands = {
+        "apiserver": [
+            sys.executable, "-m", "kubernetes1_tpu.apiserver",
+            "--host", args.advertise_address, "--port", str(port),
+            "--authorization-mode", "Node,RBAC",
+            "--token", admin_token,
+            "--ca-key-file", os.path.join(d, "pki", "ca.key"),
+            "--sa-key-file", os.path.join(d, "pki", "sa.key"),
+            "--wal", os.path.join(d, "store.wal"),
+        ],
+        "controller-manager": [
+            sys.executable, "-m", "kubernetes1_tpu.controllers",
+            "--server", server, "--token", admin_token,
+            "--ca-key-file", os.path.join(d, "pki", "ca.key"),
+            "--sa-key-file", os.path.join(d, "pki", "sa.key"),
+        ],
+        "scheduler": [
+            sys.executable, "-m", "kubernetes1_tpu.scheduler",
+            "--server", server, "--token", admin_token,
+            "--metrics-port", "0",
+        ],
+    }
+    pids = {}
+    for name in CONTROL_PLANE:
+        # 0600: the manifests carry the admin token on their command lines
+        _write(os.path.join(d, "manifests", f"kube-{name}.json"),
+               json.dumps(_manifest(name, commands[name]), indent=1))
+        if name != "apiserver":
+            continue
+        pids[name] = _spawn(commands[name], os.path.join(d, f"{name}.log")).pid
+    # record the pid BEFORE waiting: a health-wait failure must leave a
+    # kill recipe behind, not an orphaned port-holding apiserver
+    _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
+    cs = Clientset(server, token=admin_token)
+    _wait_healthy(cs)
+    for name in ("controller-manager", "scheduler"):
+        pids[name] = _spawn(commands[name], os.path.join(d, f"{name}.log")).pid
+    _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
+    print(f"[control-plane] apiserver/scheduler/controller-manager up at {server}"
+          f" (manifests in {d}/manifests)")
+
+    # ---- phase bootstrap token + RBAC
+    sec = t.Secret(type="bootstrap.kubernetes.io/token", data={
+        "token-id": token_id, "token-secret": token_secret,
+        "usage-bootstrap-authentication": "true",
+    })
+    sec.metadata.name = f"bootstrap-token-{token_id}"
+    cs.secrets.create(sec, "kube-system")
+    role = t.ClusterRole()
+    role.metadata.name = "system:node-bootstrapper"
+    role.rules = [t.PolicyRule(
+        verbs=["create", "get", "list", "watch"],
+        resources=["certificatesigningrequests"],
+    )]
+    cs.clusterroles.create(role, "")
+    rb = t.ClusterRoleBinding()
+    rb.metadata.name = "ktpu:node-bootstrappers"
+    rb.subjects = [t.Subject(kind="Group", name="system:bootstrappers")]
+    rb.role_ref = t.RoleRef(kind="ClusterRole", name="system:node-bootstrapper")
+    cs.clusterrolebindings.create(rb, "")
+    print("[bootstrap-token] join token stored; CSR RBAC for "
+          "system:bootstrappers in place")
+
+    # ---- this host's kubelet via the SAME join flow
+    node_name = args.node_name
+    cred = bootstrap_node_credential(server, join_token, node_name)
+    _write(os.path.join(d, "kubelet.conf"),
+           json.dumps({"server": server, "token": cred}))
+    # NOTE: the kubelet is NOT pointed at <dir>/manifests here — init just
+    # launched those exact processes itself, and a static-pod dir would
+    # double-run the control plane.  The manifests are the REBOOT recipe:
+    # after a host restart, `kubelet --static-pod-dir <dir>/manifests`
+    # re-hosts everything (minus the already-running apiserver bootstrap).
+    kubelet_cmd = [
+        sys.executable, "-m", "kubernetes1_tpu.kubelet",
+        "--server", server, "--token", cred, "--node-name", node_name,
+        "--root-dir", os.path.join(d, "kubelet"),
+    ]
+    pids["kubelet"] = _spawn(kubelet_cmd, os.path.join(d, "kubelet.log")).pid
+    _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if any(c.type == t.NODE_READY and c.status == "True"
+                   for c in cs.nodes.get(node_name, "").status.conditions):
+                break
+        except ApiError:
+            pass
+        time.sleep(0.3)
+    print(f"[kubelet] node {node_name} joined via CSR bootstrap")
+    cs.close()
+
+    print()
+    print("Your cluster control plane is up. To administer it:")
+    print(f"    export KTPU_SERVER={server}")
+    print(f"    ktpu --server {server} get nodes   "
+          f"# token in {d}/admin.conf")
+    print()
+    print("To add another host, run on it:")
+    print(f"    ktpu join --server {server} --token {join_token} "
+          f"--node-name <name>")
+    return 0
+
+
+def join(args) -> int:
+    d = os.path.abspath(args.dir)
+    node_name = args.node_name
+    cred = bootstrap_node_credential(args.server, args.token, node_name)
+    _write(os.path.join(d, "kubelet.conf"),
+           json.dumps({"server": args.server, "token": cred}))
+    kubelet_cmd = [
+        sys.executable, "-m", "kubernetes1_tpu.kubelet",
+        "--server", args.server, "--token", cred, "--node-name", node_name,
+        "--root-dir", os.path.join(d, "kubelet"),
+    ]
+    pid = _spawn(kubelet_cmd, os.path.join(d, "kubelet.log")).pid
+    _write(os.path.join(d, "pids.json"), json.dumps({"kubelet": pid}),
+           mode=0o644)
+    # confirm the node goes Ready under its CSR-issued identity
+    cs = Clientset(args.server, token=cred)
+    deadline = time.time() + 30
+    ready = False
+    while time.time() < deadline and not ready:
+        try:
+            ready = any(c.type == t.NODE_READY and c.status == "True"
+                        for c in cs.nodes.get(node_name, "").status.conditions)
+        except ApiError:
+            pass
+        if not ready:
+            time.sleep(0.3)
+    cs.close()
+    if not ready:
+        raise SystemExit(f"error: node {node_name} never became Ready "
+                         f"(see {d}/kubelet.log)")
+    print(f"node {node_name} joined the cluster (kubelet pid {pid}, "
+          f"credential in {d}/kubelet.conf)")
+    return 0

@@ -20,10 +20,14 @@ def main():
     ap.add_argument("--identity", default="kcm-0")
     ap.add_argument("--node-monitor-grace", type=float, default=40.0)
     ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    ap.add_argument("--ca-key-file", default="", help="CSR signing key")
+    ap.add_argument("--sa-key-file", default="", help="SA token signing key")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
+
+    from ..utils.procutil import read_key
 
     cs = Clientset(args.server, token=args.token)
     cm = ControllerManager(
@@ -32,6 +36,8 @@ def main():
         identity=args.identity,
         monitor_grace=args.node_monitor_grace,
         eviction_timeout=args.pod_eviction_timeout,
+        ca_key=read_key(args.ca_key_file, "ktpu-ca-key"),
+        sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
     )
     cm.start()
     print("controller manager running", flush=True)
@@ -39,6 +45,9 @@ def main():
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    from ..utils.procutil import bounded_exit
+
+    bounded_exit(5.0)
     cm.stop()
 
 

@@ -35,6 +35,8 @@ class ControllerManager:
         identity: str = "kcm-0",
         monitor_grace: float = 40.0,
         eviction_timeout: float = 300.0,
+        ca_key: str = "ktpu-ca-key",
+        sa_signing_key: str = "ktpu-sa-key",
     ):
         self.cs = clientset
         self.factory = InformerFactory(clientset)
@@ -49,12 +51,13 @@ class ControllerManager:
             GarbageCollector(clientset, self.factory),
             EndpointsController(clientset, self.factory),
             ResourceQuotaController(clientset, self.factory),
-            ServiceAccountController(clientset, self.factory),
+            ServiceAccountController(clientset, self.factory,
+                                     signing_key=sa_signing_key),
             HorizontalPodAutoscalerController(clientset, self.factory),
             DisruptionController(clientset, self.factory),
             PodGCController(clientset, self.factory),
             TTLAfterFinishedController(clientset, self.factory),
-            CertificateController(clientset, self.factory),
+            CertificateController(clientset, self.factory, ca_key=ca_key),
             PersistentVolumeBinder(clientset, self.factory),
         ]
         self.node_lifecycle = NodeLifecycleController(

@@ -51,6 +51,9 @@ def main():
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    from ..utils.procutil import bounded_exit
+
+    bounded_exit(5.0)
     kubelet.stop()
 
 

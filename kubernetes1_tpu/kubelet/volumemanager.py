@@ -183,12 +183,16 @@ class VolumeManager:
         """Automount the ServiceAccount token (ref: serviceaccount admission
         plugin adds the token VolumeMount; here the volume manager does both
         halves node-side)."""
+        from ..machinery import Forbidden
+
         sa_name = pod.spec.service_account_name or "default"
         ns = pod.metadata.namespace
         try:
             sa = self.cs.serviceaccounts.get(sa_name, ns)
         except NotFound:
             return None  # no SA machinery in this cluster (unit harnesses)
+        except Forbidden:
+            return None  # authz says this node may not read the SA: no automount
         if not sa.automount_service_account_token or not sa.secrets:
             return None
         try:

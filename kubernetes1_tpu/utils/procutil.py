@@ -1,0 +1,23 @@
+"""Shared entrypoint helpers for the component binaries."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def bounded_exit(delay: float = 5.0) -> threading.Timer:
+    """Arm a daemon timer that hard-exits if graceful shutdown hangs (a
+    dead apiserver must not leave a binary wedged in informer-retry joins
+    forever).  Daemonized so a CLEAN stop is not padded by the timeout;
+    callers may .cancel() after their stop() returns."""
+    timer = threading.Timer(delay, lambda: os._exit(0))
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def read_key(path: str, default: str) -> str:
+    """Key-file flag helper: file content when a path is given, else the
+    development default."""
+    return open(path).read().strip() if path else default

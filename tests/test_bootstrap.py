@@ -1,0 +1,134 @@
+"""`ktpu init` / `ktpu join` two-host bootstrap e2e (ref: cmd/kubeadm
+init/join phases + the kubelet TLS-bootstrap CSR flow).
+
+The VERDICT r3 'done' bar: a two-host cluster bootstrapped from two shell
+commands — real binaries, real ports, Node,RBAC authorization, CSR-issued
+node credentials."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import ApiError, Unauthorized
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_ktpu(*argv, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "kubernetes1_tpu.cli", *argv],
+        capture_output=True, timeout=timeout, text=True,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+        cwd=REPO,
+    )
+
+
+@pytest.fixture()
+def two_host_cluster(tmp_path):
+    """init on 'host1' (dir1), join as 'host2' (dir2) — one machine, two
+    kubelet identities, exactly the two commands an operator runs."""
+    port = free_port()
+    d1, d2 = str(tmp_path / "host1"), str(tmp_path / "host2")
+    r = run_ktpu("init", "--dir", d1, "--port", str(port),
+                 "--node-name", "host1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the join command is printed verbatim; parse it like an operator would
+    join_line = next(line for line in r.stdout.splitlines()
+                     if "ktpu join" in line).strip()
+    parts = join_line.split()
+    server = parts[parts.index("--server") + 1]
+    token = parts[parts.index("--token") + 1]
+    r2 = run_ktpu("join", "--server", server, "--token", token,
+                  "--node-name", "host2", "--dir", d2)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    admin = json.load(open(os.path.join(d1, "admin.conf")))
+    env = {"server": server, "token": token, "admin": admin,
+           "d1": d1, "d2": d2, "init_out": r.stdout}
+    yield env
+    for d in (d1, d2):
+        try:
+            pids = json.load(open(os.path.join(d, "pids.json")))
+        except OSError:
+            continue
+        for pid in pids.values():
+            try:
+                os.killpg(pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+class TestInitJoin:
+    def test_two_hosts_ready_and_secured(self, two_host_cluster):
+        env = two_host_cluster
+        admin = Clientset(env["admin"]["server"], token=env["admin"]["token"])
+        try:
+            def both_ready():
+                try:
+                    nodes, _ = admin.nodes.list()
+                except ApiError:
+                    return False
+                ready = {n.metadata.name for n in nodes
+                         if any(c.type == "Ready" and c.status == "True"
+                                for c in n.status.conditions)}
+                return {"host1", "host2"} <= ready
+
+            must_poll_until(both_ready, timeout=30.0, desc="both hosts Ready")
+            # both kubelets joined via CSR-signed credentials
+            csrs, _ = admin.certificatesigningrequests.list()
+            names = {c.metadata.name for c in csrs}
+            assert {"node-csr-host1", "node-csr-host2"} <= names
+            for c in csrs:
+                assert c.status.certificate  # approved + signed
+            # anonymous access is locked down (Node,RBAC mode)
+            anon = Clientset(env["server"])
+            with pytest.raises(ApiError):
+                anon.pods.list()
+            anon.close()
+            # a pod schedules and runs across the bootstrapped cluster
+            from kubernetes1_tpu.api import types as t
+
+            pod = t.Pod()
+            pod.metadata.name = "hello"
+            pod.spec.restart_policy = "Never"
+            pod.spec.containers = [t.Container(
+                name="c", image="python",
+                command=[sys.executable, "-c", "print('bootstrapped')"])]
+            admin.pods.create(pod)
+            must_poll_until(
+                lambda: admin.pods.get("hello", "default").status.phase
+                == "Succeeded",
+                timeout=40.0, desc="workload runs on the bootstrapped cluster",
+            )
+            # control-plane manifests written (the restartable record)
+            manifests = os.listdir(os.path.join(env["d1"], "manifests"))
+            assert {"kube-apiserver.json", "kube-scheduler.json",
+                    "kube-controller-manager.json"} <= set(manifests)
+        finally:
+            admin.close()
+
+    def test_join_with_bad_token_fails(self, two_host_cluster):
+        env = two_host_cluster
+        r = run_ktpu("join", "--server", env["server"], "--token",
+                     "deadbe.0000000000000000", "--node-name", "intruder",
+                     "--dir", env["d2"] + "-x", timeout=60)
+        assert r.returncode != 0
+        assert "csr create failed" in (r.stdout + r.stderr).lower() \
+            or "unauthorized" in (r.stdout + r.stderr).lower() \
+            or "forbidden" in (r.stdout + r.stderr).lower()
