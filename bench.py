@@ -32,8 +32,12 @@ NODES = int(os.environ.get("BENCH_NODES", "20"))
 CHIPS_PER_NODE = 4
 # default exactly at chip capacity so every pod can run
 PODS = int(os.environ.get("BENCH_PODS", str(NODES * CHIPS_PER_NODE)))
-WORKLOAD_BATCH = int(os.environ.get("BENCH_WORKLOAD_BATCH", "128"))
+WORKLOAD_BATCH = int(os.environ.get("BENCH_WORKLOAD_BATCH", "256"))
 WORKLOAD_STEPS = int(os.environ.get("BENCH_WORKLOAD_STEPS", "20"))
+LLAMA_PRESET = os.environ.get("BENCH_LLAMA_PRESET", "1b")
+LLAMA_BATCH = int(os.environ.get("BENCH_LLAMA_BATCH", "4"))
+LLAMA_SEQ = int(os.environ.get("BENCH_LLAMA_SEQ", "2048"))
+LLAMA_STEPS = int(os.environ.get("BENCH_LLAMA_STEPS", "10"))
 
 
 def _pct(xs, q):
@@ -142,8 +146,10 @@ def bench_density():
     }
 
 
-def bench_workload():
-    """ResNet-50 on the real chip via a scheduled Job (ProcessRuntime)."""
+def bench_workload(job_name="resnet50-bench", payload_args=None):
+    """A JAX training payload on the real chip via a scheduled Job
+    (ProcessRuntime). payload_args = argv after `python -m`; default runs
+    the ResNet-50 north-star config."""
     from kubernetes1_tpu.api import types as t
     from kubernetes1_tpu.apiserver import Master
     from kubernetes1_tpu.client import Clientset
@@ -180,14 +186,16 @@ def bench_workload():
             break
         time.sleep(0.2)
 
+    if payload_args is None:
+        payload_args = ["kubernetes1_tpu.workloads.resnet_bench",
+                        "--batch", str(WORKLOAD_BATCH),
+                        "--steps", str(WORKLOAD_STEPS)]
     job = t.Job()
-    job.metadata.name = "resnet50-bench"
+    job.metadata.name = job_name
     c = t.Container(
         name="train",
         image="jax-workload",
-        command=[sys.executable, "-m", "kubernetes1_tpu.workloads.resnet_bench",
-                 "--out", out_path, "--batch", str(WORKLOAD_BATCH),
-                 "--steps", str(WORKLOAD_STEPS)],
+        command=[sys.executable, "-m"] + payload_args + ["--out", out_path],
         # prepend, don't replace: the image's PYTHONPATH may carry the TPU
         # platform sitecustomize hook
         env=[t.EnvVar(name="PYTHONPATH",
@@ -209,13 +217,13 @@ def bench_workload():
     deadline = time.time() + 900
     while time.time() < deadline:
         pods, _ = cs.pods.list(namespace="default",
-                               label_selector="batch.ktpu.io/job-name=resnet50-bench")
+                               label_selector=f"batch.ktpu.io/job-name={job_name}")
         for p in pods:
             if alloc_at is None and p.spec.node_name:
                 alloc_at = time.perf_counter()
             if run_at is None and p.status.phase == t.POD_RUNNING:
                 run_at = time.perf_counter()
-        j = cs.jobs.get("resnet50-bench")
+        j = cs.jobs.get(job_name)
         if j.status.succeeded >= 1:
             break
         if any(c.type == "Failed" and c.status == "True"
@@ -377,6 +385,18 @@ def main():
             extras["workload"] = bench_workload()
         except Exception as e:  # noqa: BLE001
             extras["workload"] = {"error": f"{type(e).__name__}: {e}"}
+        # flagship Llama single-chip number (VERDICT r2 item 5): same full
+        # stack, llama_bench payload; preset/optimizer recorded in result
+        try:
+            extras["workload_llama"] = bench_workload(
+                job_name="llama-bench",
+                payload_args=["kubernetes1_tpu.workloads.llama_bench",
+                              "--preset", LLAMA_PRESET,
+                              "--batch", str(LLAMA_BATCH),
+                              "--seq", str(LLAMA_SEQ),
+                              "--steps", str(LLAMA_STEPS)])
+        except Exception as e:  # noqa: BLE001
+            extras["workload_llama"] = {"error": f"{type(e).__name__}: {e}"}
 
     p99 = extras["pod_startup_p99_s"]
     result = {

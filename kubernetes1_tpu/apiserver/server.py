@@ -265,6 +265,24 @@ class _Handler(BaseHTTPRequestHandler):
             if parts and parts[0] == "metrics":
                 self._serve_metrics()
                 return
+            if parts and parts[0] == "debug":
+                from ..utils.debug import handle_debug
+
+                # pprof is sensitive (stack contents) and expensive (the
+                # profiler burns a thread per request): authorize like a
+                # cluster-scoped resource read — anonymous RBAC users are
+                # denied exactly as they are for every real resource
+                self._authz(user, "get", "debug", "", "", "")
+                res = handle_debug("/" + "/".join(parts), q)
+                if res is None:
+                    raise NotFound(f"unknown path {self.path}")
+                status, ctype, body = res
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             resource, ns, name, sub = self._parse_resource_path(parts)
             if resource not in self.master.scheme.by_resource:
                 raise NotFound(f"resource {resource!r} not registered")

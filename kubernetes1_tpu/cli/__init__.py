@@ -598,6 +598,8 @@ def build_parser() -> argparse.ArgumentParser:
     ini.add_argument("--port", type=int, default=6443)
     ini.add_argument("--advertise-address", default="127.0.0.1")
     ini.add_argument("--node-name", default=os.uname().nodename)
+    ini.add_argument("--token-ttl", type=int, default=24 * 3600,
+                     help="join-token lifetime in seconds (kubeadm: 24h)")
 
     jn = sub.add_parser("join", help="join this host to a cluster (kubeadm join)")
     jn.add_argument("--server", required=True)

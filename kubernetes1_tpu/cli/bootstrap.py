@@ -130,6 +130,22 @@ def init(args) -> int:
     port = args.port
     server = f"http://{args.advertise_address}:{port}"
 
+    # ---- preflight (ref kubeadm preflight): re-running init against a live
+    # control plane must not clobber pids.json with a dead pid and then
+    # trip over the existing fixed-name objects — refuse early instead
+    probe = Clientset(server)
+    try:
+        probe.api.request("GET", "/healthz")
+        raise SystemExit(
+            f"error: an apiserver is already serving at {server} "
+            f"(state in {d}; stop it via pids.json before re-running init)")
+    except SystemExit:
+        raise
+    except Exception:  # noqa: BLE001 — nothing listening: proceed
+        pass
+    finally:
+        probe.close()
+
     # ---- phase certs
     ca_key = _secrets.token_hex(32)
     sa_key = _secrets.token_hex(32)
@@ -186,9 +202,15 @@ def init(args) -> int:
           f" (manifests in {d}/manifests)")
 
     # ---- phase bootstrap token + RBAC
+    from ..machinery.meta import to_iso
+
+    ttl_s = getattr(args, "token_ttl", 24 * 3600)
     sec = t.Secret(type="bootstrap.kubernetes.io/token", data={
         "token-id": token_id, "token-secret": token_secret,
         "usage-bootstrap-authentication": "true",
+        # kubeadm default: join tokens expire (24h) — a console-printed
+        # credential must not authenticate forever
+        "expiration": to_iso(time.time() + ttl_s),
     })
     sec.metadata.name = f"bootstrap-token-{token_id}"
     cs.secrets.create(sec, "kube-system")
@@ -198,13 +220,19 @@ def init(args) -> int:
         verbs=["create", "get", "list", "watch"],
         resources=["certificatesigningrequests"],
     )]
-    cs.clusterroles.create(role, "")
+    try:
+        cs.clusterroles.create(role, "")
+    except AlreadyExists:
+        pass  # WAL-backed store survives restarts; fixed names are idempotent
     rb = t.ClusterRoleBinding()
     rb.metadata.name = "ktpu:node-bootstrappers"
     rb.subjects = [t.Subject(kind="Group", name="system:bootstrappers")]
     rb.role_ref = t.RoleRef(kind="ClusterRole", name="system:node-bootstrapper")
-    cs.clusterrolebindings.create(rb, "")
-    print("[bootstrap-token] join token stored; CSR RBAC for "
+    try:
+        cs.clusterrolebindings.create(rb, "")
+    except AlreadyExists:
+        pass
+    print(f"[bootstrap-token] join token stored (ttl {ttl_s}s); CSR RBAC for "
           "system:bootstrappers in place")
 
     # ---- this host's kubelet via the SAME join flow

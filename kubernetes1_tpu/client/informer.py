@@ -131,6 +131,14 @@ class SharedInformer:
                 self._watch_loop(rv)
             except ApiError:
                 self._stop.wait(0.5)
+            except ConnectionError:
+                # unreachable/stopping apiserver: the reflector's answer is
+                # silent backoff-and-retry (reflector.go relist), not a
+                # traceback — this also keeps test teardown logs clean when
+                # a server stops before its watchers.  Deliberately ONLY
+                # connection errors: other OSErrors (fd exhaustion, …) keep
+                # the loud path below.
+                self._stop.wait(0.5)
             except Exception:  # noqa: BLE001
                 if not self._stop.is_set():
                     traceback.print_exc()

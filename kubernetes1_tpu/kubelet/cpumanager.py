@@ -1,0 +1,327 @@
+"""CPU manager: exclusive core pinning for Guaranteed integer-CPU pods.
+
+Ref: pkg/kubelet/cm/cpumanager/cpu_manager.go (policies none/static),
+cm/cpumanager/topology/topology.go (socket/core/thread discovery from
+cadvisor), cm/cpumanager/state/state_file.go:45-119 (JSON checkpoint of
+assignments + default pool), cm/cpumanager/cpu_assignment.go
+(takeByTopology: whole sockets, then whole physical cores, then threads).
+
+TPU-native twist: the reference writes cpuset cgroups; here containers are
+ProcessRuntime host processes, so pinning rides the same pre-exec channel
+as cgroup joining — the child applies its cpuset with sched_setaffinity
+(taskset preamble) before exec, and every grandchild (the JAX runtime's
+worker threads) inherits it.  Exclusive cores matter on TPU hosts: the
+host's feeding threads (infeed, dispatch) stall the chip when they migrate
+or share a hyperthread with noisy neighbors.
+
+State is checkpointed to <root>/cpu_manager_state.json exactly so a kubelet
+restart neither double-assigns a core nor leaks one (mirrors
+state_file.go's {policyName, defaultCpuSet, entries} schema).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..api import types as t
+from ..utils.quantity import parse_quantity
+from .eviction import QOS_GUARANTEED, qos_class
+
+POLICY_NONE = "none"
+POLICY_STATIC = "static"
+
+
+# ------------------------------------------------------------------ topology
+
+@dataclass(frozen=True)
+class CPUInfo:
+    cpu: int        # logical cpu id
+    core: int       # physical core id (global: socket<<16 | core_id)
+    socket: int
+
+
+@dataclass
+class CPUTopology:
+    """Logical-cpu -> (physical core, socket) map (ref topology.go)."""
+
+    cpus: List[CPUInfo] = field(default_factory=list)
+
+    @property
+    def num_cpus(self) -> int:
+        return len(self.cpus)
+
+    def cpus_per_core(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {}
+        for c in self.cpus:
+            out.setdefault(c.core, []).append(c.cpu)
+        return out
+
+    def cpus_per_socket(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {}
+        for c in self.cpus:
+            out.setdefault(c.socket, []).append(c.cpu)
+        return out
+
+    @staticmethod
+    def discover(sysfs: str = "/sys/devices/system/cpu") -> "CPUTopology":
+        """Read core/package ids from sysfs; flat fallback when absent."""
+        cpus: List[CPUInfo] = []
+        try:
+            entries = sorted(
+                int(d[3:]) for d in os.listdir(sysfs)
+                if d.startswith("cpu") and d[3:].isdigit()
+            )
+        except OSError:
+            entries = []
+        for cpu in entries:
+            topo = os.path.join(sysfs, f"cpu{cpu}", "topology")
+            try:
+                core = int(open(os.path.join(topo, "core_id")).read())
+                socket = int(open(os.path.join(topo, "physical_package_id")).read())
+            except OSError:
+                core, socket = cpu, 0
+            cpus.append(CPUInfo(cpu=cpu, core=(socket << 16) | core, socket=socket))
+        if not cpus:
+            n = os.cpu_count() or 1
+            cpus = [CPUInfo(cpu=i, core=i, socket=0) for i in range(n)]
+        return CPUTopology(cpus=cpus)
+
+    @staticmethod
+    def synthetic(sockets: int, cores_per_socket: int,
+                  threads_per_core: int) -> "CPUTopology":
+        """Deterministic topology for tests (cpu ids socket-major)."""
+        cpus = []
+        cpu = 0
+        for s in range(sockets):
+            for c in range(cores_per_socket):
+                for _ in range(threads_per_core):
+                    cpus.append(CPUInfo(cpu=cpu, core=(s << 16) | c, socket=s))
+                    cpu += 1
+        return CPUTopology(cpus=cpus)
+
+
+def take_by_topology(topo: CPUTopology, available: Set[int], want: int) -> Set[int]:
+    """Pick `want` cpus preferring whole sockets, then whole physical cores,
+    then leftover threads (ref cpu_assignment.go takeByTopology). Raises
+    ValueError when not enough cpus are free."""
+    if want > len(available):
+        raise ValueError(f"want {want} cpus, only {len(available)} available")
+    picked: Set[int] = set()
+
+    def free_in(group: List[int]) -> List[int]:
+        return [c for c in group if c in available and c not in picked]
+
+    # whole sockets first
+    for _, group in sorted(topo.cpus_per_socket().items()):
+        free = free_in(group)
+        if len(free) == len(group) and len(free) <= want - len(picked):
+            picked.update(free)
+    # whole physical cores next
+    if len(picked) < want:
+        for _, group in sorted(topo.cpus_per_core().items()):
+            free = free_in(group)
+            if free and len(free) == len(group) and len(free) <= want - len(picked):
+                picked.update(free)
+    # single threads last; prefer threads on partially-used cores so intact
+    # cores stay intact for the next exclusive pod
+    if len(picked) < want:
+        partial: List[int] = []
+        intact: List[int] = []
+        for _, group in sorted(topo.cpus_per_core().items()):
+            free = free_in(group)
+            (partial if len(free) < len(group) else intact).extend(free)
+        for c in partial + intact:
+            if len(picked) == want:
+                break
+            picked.add(c)
+    return picked
+
+
+# -------------------------------------------------------------------- state
+
+class CPUManagerState:
+    """Checkpointed assignment state (ref state_file.go:45-119)."""
+
+    def __init__(self, path: str = ""):
+        self.path = path
+        self.policy = POLICY_STATIC
+        self.default_cpuset: Set[int] = set()
+        # "uid/container" -> set of cpus
+        self.entries: Dict[str, Set[int]] = {}
+
+    def load(self) -> bool:
+        if not self.path or not os.path.exists(self.path):
+            return False
+        try:
+            with open(self.path) as f:
+                raw = json.load(f)
+            self.policy = raw.get("policyName", POLICY_STATIC)
+            self.default_cpuset = set(raw.get("defaultCpuSet", []))
+            self.entries = {k: set(v) for k, v in raw.get("entries", {}).items()}
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def save(self):
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "policyName": self.policy,
+                "defaultCpuSet": sorted(self.default_cpuset),
+                "entries": {k: sorted(v) for k, v in self.entries.items()},
+            }, f)
+        os.replace(tmp, self.path)
+
+
+# ------------------------------------------------------------------ manager
+
+def _exclusive_cpus_wanted(pod: t.Pod, container: t.Container) -> int:
+    """Static policy admits a container to the exclusive pool only when the
+    pod is Guaranteed and this container asks for a whole number of cpus
+    (ref policy_static.go guaranteedCPUs)."""
+    if qos_class(pod) != QOS_GUARANTEED:
+        return 0
+    lim = (container.resources.limits or {}).get("cpu")
+    if lim is None:
+        return 0
+    q = parse_quantity(lim)
+    if q != int(q) or int(q) == 0:
+        return 0
+    return int(q)
+
+
+class CPUManager:
+    """Static-policy CPU manager. The kubelet asks `cpuset_for_container`
+    while building the ContainerConfig; non-exclusive containers get the
+    shared (default) pool so they can never run on an exclusively-assigned
+    core."""
+
+    def __init__(self, policy: str = POLICY_NONE,
+                 topology: Optional[CPUTopology] = None,
+                 state_path: str = "",
+                 reserved_cpus: int = 0):
+        self.policy = policy
+        self._lock = threading.Lock()
+        # called (with no args, outside the lock) whenever the shared pool
+        # changes — the kubelet re-pins running shared containers so they
+        # never keep running on a newly-exclusive core
+        self.on_pool_change = None
+        if policy != POLICY_STATIC:
+            # disabled: no sysfs scan, no checkpoint I/O — hollow-node scale
+            # tests construct hundreds of kubelets with the policy off
+            self.topology = topology or CPUTopology(cpus=[])
+            self.state = CPUManagerState("")
+            self._reserved = set()
+            return
+        self.topology = topology or CPUTopology.discover()
+        self.state = CPUManagerState(state_path)
+        all_cpus = {c.cpu for c in self.topology.cpus}
+        # reserved cpus stay in the shared pool permanently (system overhead,
+        # ref policy_static.go reserved); lowest-numbered cpus by convention
+        self._reserved = set(sorted(all_cpus)[:reserved_cpus])
+        if not self.state.load():
+            self.state.default_cpuset = set(all_cpus)
+        else:
+            # drop stale cpus (topology changed across restart), re-add any
+            # cpu that vanished from both pools
+            known = set(all_cpus)
+            self.state.default_cpuset &= known
+            assigned = set()
+            for k in list(self.state.entries):
+                self.state.entries[k] &= known
+                assigned |= self.state.entries[k]
+            missing = known - self.state.default_cpuset - assigned
+            self.state.default_cpuset |= missing
+        self.state.policy = policy
+        self.state.save()
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy == POLICY_STATIC and self.topology.num_cpus > 1
+
+    # ------------------------------------------------------------ assignment
+
+    def cpuset_for_container(self, pod: t.Pod, container: t.Container) -> Optional[Set[int]]:
+        """Exclusive cpus for a Guaranteed integer-cpu container, the shared
+        pool for everything else, None when the policy is off (no pinning)."""
+        if not self.enabled:
+            return None
+        uid = pod.metadata.uid
+        key = f"{uid}/{container.name}"
+        want = _exclusive_cpus_wanted(pod, container)
+        with self._lock:
+            if key in self.state.entries:
+                return set(self.state.entries[key])
+            if want <= 0:
+                return self._shared_pool_locked()
+            allocatable = self.state.default_cpuset - self._reserved
+            try:
+                picked = take_by_topology(self.topology, allocatable, want)
+            except ValueError:
+                # not enough exclusive cpus: fall back to the shared pool
+                # rather than failing the pod (admission already fit cpu
+                # capacity; exclusivity is best-effort beyond that)
+                return self._shared_pool_locked()
+            self.state.entries[key] = picked
+            self.state.default_cpuset -= picked
+            self.state.save()
+        self._notify_pool_change()
+        return set(picked)
+
+    def _shared_pool_locked(self) -> Optional[Set[int]]:
+        """The pool a non-exclusive container runs on.  When every cpu is
+        exclusively assigned, shared containers fall back to the reserved
+        cpus — an empty cpuset would mean 'no pinning at all', i.e. free
+        run of the exclusive cores."""
+        if self.state.default_cpuset:
+            return set(self.state.default_cpuset)
+        if self._reserved:
+            return set(self._reserved)
+        return None
+
+    def shared_pool(self) -> Optional[Set[int]]:
+        with self._lock:
+            return self._shared_pool_locked()
+
+    def _notify_pool_change(self):
+        cb = self.on_pool_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — repinning is best-effort
+                pass
+
+    def release_pod(self, uid: str):
+        """Return the pod's exclusive cpus to the shared pool (pod deleted
+        or terminal — ref removeStaleState)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            changed = False
+            for key in [k for k in self.state.entries if k.startswith(f"{uid}/")]:
+                self.state.default_cpuset |= self.state.entries.pop(key)
+                changed = True
+            if changed:
+                self.state.save()
+        if changed:
+            self._notify_pool_change()
+
+    def reconcile(self, live_uids: Set[str]):
+        """Drop assignments whose pod no longer exists (kubelet restart sync:
+        state file may know pods the apiserver has deleted)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            stale = {k.split("/", 1)[0] for k in self.state.entries} - set(live_uids)
+        for uid in stale:
+            self.release_pod(uid)
+
+    def assigned_cpus(self) -> Dict[str, Set[int]]:
+        with self._lock:
+            return {k: set(v) for k, v in self.state.entries.items()}

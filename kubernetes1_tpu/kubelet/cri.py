@@ -1,0 +1,343 @@
+"""CRI over a unix socket: the kubelet↔runtime process boundary.
+
+Ref: pkg/kubelet/apis/cri/v1alpha1/runtime/api.proto (RuntimeService 20
+RPCs over a unix-socket gRPC server), pkg/kubelet/remote/ (client),
+pkg/kubelet/dockershim (server wrapping a concrete runtime).
+
+Round 2 left the CRI seam in-process (a Python ABC); this module gives it
+the same transport treatment the device-plugin API got: newline-delimited
+JSON frames over AF_UNIX (grpcio is not in this image; the protocol seams
+are what matter).  Any RuntimeService implementation can be served:
+
+    server = RuntimeServer(ProcessRuntime(root_dir=...), "/run/ktpu/cri.sock")
+    server.start()
+    kubelet = Kubelet(cs, node, runtime=RemoteRuntime("/run/ktpu/cri.sock"))
+
+so the runtime can live in a different process (or a different language —
+the wire format is trivially speakable from C++), exactly like containerd
+vs kubelet in the reference.
+
+Wire format (same as deviceplugin/api.py):
+  request:  {"id": N, "method": "...", "params": {...}}\n
+  response: {"id": N, "result": ...} | {"id": N, "error": "..."}\n
+
+exec_stream is intentionally not proxied: the reference's CRI returns a
+streaming URL from Exec() and the kubelet server dials it; here the
+interactive path lives in the kubelet server already, and a remote runtime
+serves one-shot exec (exec_capture) — streaming exec against a remote
+runtime degrades to capture, as dockershim's ExecSync does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+from .runtime import (
+    ContainerConfig,
+    ContainerRecord,
+    RuntimeService,
+    SandboxRecord,
+)
+
+
+def _sandbox_to_dict(s: SandboxRecord) -> dict:
+    return vars(s).copy()
+
+
+def _container_to_dict(c: ContainerRecord) -> dict:
+    return vars(c).copy()
+
+
+# A method table keeps dispatch explicit (no getattr-on-wire-data).
+_METHODS = (
+    "capabilities",
+    "version",
+    "run_pod_sandbox",
+    "stop_pod_sandbox",
+    "remove_pod_sandbox",
+    "list_pod_sandboxes",
+    "create_container",
+    "start_container",
+    "stop_container",
+    "remove_container",
+    "list_containers",
+    "container_status",
+    "read_log",
+    "container_stats",
+    "exec_in_container",
+    "exec_capture",
+    "set_container_affinity",
+)
+
+
+class RuntimeServer:
+    """Serves a RuntimeService over a unix socket (the dockershim role)."""
+
+    def __init__(self, runtime: RuntimeService, socket_path: str):
+        self.runtime = runtime
+        self.socket_path = socket_path
+        self._stop = threading.Event()
+        os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(socket_path)
+        self._sock.listen(16)
+
+    def start(self):
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="cri-server").start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        f = conn.makefile("rwb")
+        try:
+            for line in f:
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                rid = req.get("id")
+                try:
+                    result = self._dispatch(req.get("method"),
+                                            req.get("params") or {})
+                    f.write(json.dumps({"id": rid, "result": result}).encode()
+                            + b"\n")
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    f.write(json.dumps({"id": rid, "error": str(e)}).encode()
+                            + b"\n")
+                f.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, method: Optional[str], params: dict):
+        if method not in _METHODS:
+            raise ValueError(f"unknown CRI method {method!r}")
+        rt = self.runtime
+        if method == "capabilities":
+            # the kubelet gates cgroup enforcement + CPU pinning on
+            # real_pids; a remote ProcessRuntime must advertise it or the
+            # identical runtime silently loses enforcement across the socket
+            return {"real_pids": bool(getattr(rt, "real_pids", False)),
+                    "root": getattr(rt, "root", None)}
+        if method == "version":
+            return rt.version()
+        if method == "run_pod_sandbox":
+            return rt.run_pod_sandbox(
+                params["pod_name"], params["pod_namespace"], params["pod_uid"],
+                labels=params.get("labels"))
+        if method == "stop_pod_sandbox":
+            return rt.stop_pod_sandbox(params["sandbox_id"])
+        if method == "remove_pod_sandbox":
+            return rt.remove_pod_sandbox(params["sandbox_id"])
+        if method == "list_pod_sandboxes":
+            return [_sandbox_to_dict(s) for s in rt.list_pod_sandboxes()]
+        if method == "create_container":
+            cfg = ContainerConfig(**params["config"])
+            return rt.create_container(params["sandbox_id"], cfg)
+        if method == "start_container":
+            return rt.start_container(params["container_id"])
+        if method == "stop_container":
+            return rt.stop_container(params["container_id"],
+                                     timeout=params.get("timeout", 10.0))
+        if method == "remove_container":
+            return rt.remove_container(params["container_id"])
+        if method == "list_containers":
+            return [_container_to_dict(c) for c in rt.list_containers()]
+        if method == "container_status":
+            rec = rt.container_status(params["container_id"])
+            return _container_to_dict(rec) if rec is not None else None
+        if method == "read_log":
+            return rt.read_log(params["container_id"],
+                               tail=params.get("tail", 0))
+        if method == "container_stats":
+            return rt.container_stats(params["container_id"])
+        if method == "exec_in_container":
+            return rt.exec_in_container(params["container_id"],
+                                        params["command"])
+        if method == "exec_capture":
+            code, out = rt.exec_capture(params["container_id"],
+                                        params["command"])
+            return {"exit_code": code, "output": out}
+        if method == "set_container_affinity":
+            return rt.set_container_affinity(params["container_id"],
+                                             set(params["cpus"]))
+        raise ValueError(f"unhandled CRI method {method!r}")
+
+
+class RemoteRuntime(RuntimeService):
+    """Kubelet-side RuntimeService speaking the socket protocol (the
+    pkg/kubelet/remote role).  Reconnects per broken pipe; one in-flight
+    call per connection (the kubelet's sync workers each get their own
+    socket via a small pool)."""
+
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self._pool: List = []
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._caps: Optional[dict] = None
+
+    def _capabilities(self) -> dict:
+        if self._caps is None:
+            try:
+                self._caps = self._call("capabilities") or {}
+            except (ConnectionError, OSError, RuntimeError):
+                # server not up yet: report nothing special, but don't cache
+                # the failure — the kubelet may ask again once it is
+                return {}
+        return self._caps
+
+    @property
+    def real_pids(self) -> bool:
+        """Mirrors the wrapped runtime (queried over the socket) so the
+        kubelet's cgroup/CPU-manager gating behaves identically for a
+        remote ProcessRuntime (see _dispatch 'capabilities')."""
+        return bool(self._capabilities().get("real_pids", False))
+
+    @property
+    def root(self):
+        return self._capabilities().get("root")
+
+    # ----------------------------------------------------------- transport
+
+    def _connect(self):
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(self.timeout)
+        conn.connect(self.socket_path)
+        return conn, conn.makefile("rwb")
+
+    def _call(self, method: str, params: Optional[dict] = None):
+        with self._lock:
+            pair = self._pool.pop() if self._pool else None
+            self._next_id += 1
+            rid = self._next_id
+        if pair is None:
+            pair = self._connect()
+        conn, f = pair
+        frame = json.dumps({"id": rid, "method": method,
+                            "params": params or {}})
+        try:
+            f.write(frame.encode() + b"\n")
+            f.flush()
+            line = f.readline()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"CRI runtime {self.socket_path} unreachable")
+        if not line:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"CRI runtime {self.socket_path} closed")
+        with self._lock:
+            self._pool.append(pair)
+        resp = json.loads(line)
+        if resp.get("error"):
+            raise RuntimeError(f"CRI {method}: {resp['error']}")
+        return resp.get("result")
+
+    def close(self):
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for conn, _f in pool:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -------------------------------------------------------- RuntimeService
+
+    def version(self) -> str:
+        return self._call("version")
+
+    def run_pod_sandbox(self, pod_name, pod_namespace, pod_uid, labels=None) -> str:
+        return self._call("run_pod_sandbox", {
+            "pod_name": pod_name, "pod_namespace": pod_namespace,
+            "pod_uid": pod_uid, "labels": labels})
+
+    def stop_pod_sandbox(self, sandbox_id: str):
+        self._call("stop_pod_sandbox", {"sandbox_id": sandbox_id})
+
+    def remove_pod_sandbox(self, sandbox_id: str):
+        self._call("remove_pod_sandbox", {"sandbox_id": sandbox_id})
+
+    def list_pod_sandboxes(self) -> List[SandboxRecord]:
+        return [SandboxRecord(**d) for d in self._call("list_pod_sandboxes")]
+
+    def create_container(self, sandbox_id: str, config: ContainerConfig) -> str:
+        return self._call("create_container", {
+            "sandbox_id": sandbox_id, "config": vars(config).copy()})
+
+    def start_container(self, container_id: str):
+        self._call("start_container", {"container_id": container_id})
+
+    def stop_container(self, container_id: str, timeout: float = 10.0):
+        self._call("stop_container", {"container_id": container_id,
+                                      "timeout": timeout})
+
+    def remove_container(self, container_id: str):
+        self._call("remove_container", {"container_id": container_id})
+
+    def list_containers(self) -> List[ContainerRecord]:
+        return [ContainerRecord(**d) for d in self._call("list_containers")]
+
+    def container_status(self, container_id: str) -> Optional[ContainerRecord]:
+        d = self._call("container_status", {"container_id": container_id})
+        return ContainerRecord(**d) if d is not None else None
+
+    def read_log(self, container_id: str, tail: int = 0) -> str:
+        return self._call("read_log", {"container_id": container_id,
+                                       "tail": tail})
+
+    def container_stats(self, container_id: str) -> Dict[str, float]:
+        return self._call("container_stats", {"container_id": container_id})
+
+    def exec_in_container(self, container_id: str, command) -> int:
+        return self._call("exec_in_container", {
+            "container_id": container_id, "command": list(command)})
+
+    def exec_capture(self, container_id: str, command) -> tuple:
+        d = self._call("exec_capture", {"container_id": container_id,
+                                        "command": list(command)})
+        return d["exit_code"], d["output"]
+
+    def set_container_affinity(self, container_id: str, cpus) -> bool:
+        return bool(self._call("set_container_affinity", {
+            "container_id": container_id, "cpus": sorted(cpus)}))

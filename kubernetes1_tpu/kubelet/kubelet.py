@@ -40,6 +40,7 @@ from .runtime import (
     RuntimeService,
 )
 from .containermanager import ContainerManager
+from .cpumanager import POLICY_NONE, CPUManager
 from .volumemanager import VolumeError, VolumeManager, VolumeNotReady
 
 
@@ -67,6 +68,8 @@ class Kubelet:
         volume_root: Optional[str] = None,
         enforce_cgroups: Optional[bool] = None,  # None = auto (real runtimes only)
         system_reserved: Optional[Dict[str, str]] = None,
+        cpu_manager_policy: Optional[str] = None,  # None = "none"
+        cpu_manager_state_dir: str = "",
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -102,6 +105,15 @@ class Kubelet:
             node_name,
             system_reserved=system_reserved,
             enforce=enforce_cgroups,
+        )
+        # CPU manager (ref cm/cpumanager): static pinning only for runtimes
+        # with real processes; state checkpoint lives beside the runtime root
+        state_dir = cpu_manager_state_dir or runtime_root or ""
+        self.cpu_manager = CPUManager(
+            policy=(cpu_manager_policy or POLICY_NONE)
+            if getattr(runtime, "real_pids", False) else POLICY_NONE,
+            state_path=os.path.join(state_dir, "cpu_manager_state.json")
+            if state_dir else "",
         )
 
         self.pods = SharedInformer(
@@ -188,6 +200,14 @@ class Kubelet:
         )
         self.pods.start()
         self.pods.wait_for_sync()
+        # CPU-manager state vs world: drop checkpointed exclusive
+        # assignments for pods deleted while the kubelet was down (the
+        # informer never delivers a delete for an already-gone pod), and
+        # re-pin running shared containers whenever the pool changes
+        self.cpu_manager.on_pool_change = self._reapply_shared_cpusets
+        if self.cpu_manager.enabled:
+            live = {p.metadata.uid for p in self.pods.list()}
+            self.cpu_manager.reconcile(live)
         if self.static_pod_dir:
             self._load_static_pods()
         for i in range(self.sync_workers):
@@ -200,6 +220,11 @@ class Kubelet:
             (self._tick_all, "sync_interval", "sync-ticker"),
             (self._publish_metrics, "heartbeat_interval", "stats"),
             (self._eviction_pass, "eviction_interval", "eviction"),
+            # ref cpu_manager.go reconcileState: event-driven repinning
+            # races container exec (a shared container created before a
+            # grant but execed after it misses the on_pool_change), so a
+            # periodic pass restores the invariant within one sync period
+            (self._cpuset_reconcile, "sync_interval", "cpuset-reconcile"),
         ):
             th = threading.Thread(
                 target=self._loop, args=(fn, period_attr), daemon=True, name=name
@@ -236,6 +261,30 @@ class Kubelet:
 
     def _heartbeat_now(self):
         self._heartbeat_event.set()
+
+    def _cpuset_reconcile(self):
+        if self.cpu_manager.enabled and self.cpu_manager.assigned_cpus():
+            self._reapply_shared_cpusets()
+
+    def _reapply_shared_cpusets(self):
+        """Shared (non-exclusive) containers were taskset-pinned to the pool
+        as of their exec; when the CPU manager's pool changes (exclusive
+        grant or release) push the RUNNING ones onto the current pool so
+        none keeps running on a newly-exclusive core (the reference updates
+        live cpuset cgroups the same way)."""
+        pool = self.cpu_manager.shared_pool()
+        if pool is None:
+            return
+        exclusive = set(self.cpu_manager.assigned_cpus())
+        with self._lock:
+            containers = dict(self._containers)
+        for (uid, cname), cid in containers.items():
+            if f"{uid}/{cname}" in exclusive:
+                continue
+            try:
+                self.runtime.set_container_affinity(cid, pool)
+            except Exception:  # noqa: BLE001 — best-effort, container may be gone
+                continue
 
     def _reconcile_runtime(self):
         """Adopt pre-existing runtime state after a kubelet restart: rebuild
@@ -695,6 +744,7 @@ class Kubelet:
                 self.device_manager.forget_pod(sb.pod_uid)
                 self.volume_manager.teardown_pod(sb.pod_uid)
                 self.container_manager.remove_pod_cgroup(sb.pod_uid)
+                self.cpu_manager.release_pod(sb.pod_uid)
                 self._prune_pod_state(sb.pod_uid)
 
     # -------------------------------------------------------------- syncPod
@@ -806,6 +856,8 @@ class Kubelet:
             annotations=annotations,
             cgroup_procs_files=self.container_manager.container_join_files(
                 pod, container),
+            cpuset=sorted(self.cpu_manager.cpuset_for_container(pod, container)
+                          or []),
         )
 
     def _sync_containers(self, pod: t.Pod, sandbox_id: str):
@@ -932,6 +984,7 @@ class Kubelet:
         self.device_manager.forget_pod(uid)
         self.volume_manager.teardown_pod(uid)
         self.container_manager.remove_pod_cgroup(uid)
+        self.cpu_manager.release_pod(uid)
         self._prune_pod_state(uid)
         try:
             self.cs.pods.delete(

@@ -18,6 +18,7 @@ Two implementations, both behind the same interface the kubelet consumes:
 from __future__ import annotations
 
 import os
+import shutil
 import signal
 import subprocess
 import threading
@@ -59,6 +60,9 @@ class ContainerConfig:
     # cgroup.procs files the starting process must join (the CRI
     # cgroup_parent analog; empty = no cgroup enforcement)
     cgroup_procs_files: List[str] = field(default_factory=list)
+    # logical cpus the process tree is pinned to (CPU manager static policy;
+    # empty = no pinning)
+    cpuset: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -136,6 +140,13 @@ class RuntimeService:
         the container's context and return (popen, pty_master_fd or None).
         The caller owns the pumping.  None when unsupported."""
         return None
+
+    def set_container_affinity(self, container_id: str, cpus) -> bool:
+        """Re-pin a RUNNING container's process tree to `cpus` (the CPU
+        manager's cpuset-update analog — the reference rewrites the cpuset
+        cgroup of live containers when the shared pool changes).  Returns
+        False when unsupported."""
+        return False
 
 
 class ImageService:
@@ -322,6 +333,28 @@ def _probe_mount_ns() -> bool:
         return False
 
 
+def _pids_in_pgrp(pgid: int) -> List[int]:
+    """All pids whose process group is `pgid` (field 5 of /proc/<p>/stat;
+    the comm field is parenthesized and may contain spaces, so split after
+    the closing paren)."""
+    out = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return out
+    for name in entries:
+        if not name.isdigit():
+            continue
+        try:
+            stat = open(f"/proc/{name}/stat").read()
+            rest = stat.rsplit(")", 1)[1].split()
+            if int(rest[2]) == pgid:  # rest: state, ppid, pgrp, ...
+                out.append(int(name))
+        except (OSError, IndexError, ValueError):
+            continue
+    return out
+
+
 def _wrap_with_cgroups(cmd: List[str], procs_files: List[str]) -> List[str]:
     """Prefix `cmd` with a cgroup-join preamble: the sh writes itself into
     every cgroup.procs file, then execs the real command in place (same
@@ -334,6 +367,20 @@ def _wrap_with_cgroups(cmd: List[str], procs_files: List[str]) -> List[str]:
         lines.append(f"echo 0 > {shlex.quote(pf)} 2>/dev/null || true")
     lines.append('exec "$@"')
     return ["sh", "-c", "\n".join(lines), "sh"] + list(cmd)
+
+
+_TASKSET = shutil.which("taskset")
+
+
+def _wrap_with_cpuset(cmd: List[str], cpuset: List[int]) -> List[str]:
+    """Prefix `cmd` with a taskset exec so the process (and every child it
+    forks — JAX worker threads included) runs only on the assigned cpus.
+    taskset execs in place: same pid, no extra process.  No-op when the
+    binary is absent (pinning is best-effort beyond scheduling fit)."""
+    if not _TASKSET:
+        return list(cmd)
+    spec = ",".join(str(c) for c in sorted(cpuset))
+    return [_TASKSET, "-c", spec] + list(cmd)
 
 
 def _wrap_with_mounts(cmd: List[str], mounts: List[dict]) -> List[str]:
@@ -462,6 +509,10 @@ class ProcessRuntime(RuntimeService):
             # preamble, NOT preexec_fn — Python-level I/O between fork and
             # exec can deadlock in a process with this many threads
             cmd = _wrap_with_cgroups(cmd, config.cgroup_procs_files)
+        if config.cpuset:
+            # CPU-manager pinning: affinity set before exec is inherited by
+            # the whole future process tree (sched_setaffinity semantics)
+            cmd = _wrap_with_cpuset(cmd, config.cpuset)
         logf = open(c.log_path, "ab")
         proc = subprocess.Popen(
             cmd,
@@ -485,6 +536,31 @@ class ProcessRuntime(RuntimeService):
             c.state = CONTAINER_EXITED
             c.exit_code = code
             c.finished_at = time.time()
+
+    def set_container_affinity(self, container_id: str, cpus) -> bool:
+        """Re-pin every thread of every process in the container's process
+        group (containers start with start_new_session, so pgid == root
+        pid).  This is how shared-pool containers get pushed OFF a core the
+        CPU manager just assigned exclusively — taskset at exec time alone
+        would leave them there."""
+        with self._lock:
+            proc = self._procs.get(container_id)
+        if proc is None or proc.poll() is not None or not cpus:
+            return False
+        pgid = proc.pid
+        ok = False
+        for pid in _pids_in_pgrp(pgid):
+            try:
+                tids = os.listdir(f"/proc/{pid}/task")
+            except OSError:
+                continue
+            for tid in tids:
+                try:
+                    os.sched_setaffinity(int(tid), cpus)
+                    ok = True
+                except (OSError, ValueError):
+                    continue
+        return ok
 
     def stop_container(self, container_id: str, timeout: float = 10.0):
         with self._lock:

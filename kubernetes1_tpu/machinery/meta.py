@@ -18,6 +18,10 @@ def now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def to_iso(epoch: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
 def now_iso_micro() -> str:
     """MicroTime (ref: meta/v1 MicroTime) — leases need sub-second
     resolution or short lease durations fall below timestamp granularity."""

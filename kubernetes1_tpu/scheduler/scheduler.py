@@ -35,6 +35,7 @@ def _json_key(obj) -> str:
 
     return _json.dumps(obj, sort_keys=True, default=str)
 from ..utils.metrics import Histogram, MetricsServer, Registry
+from ..utils.trace import Trace
 from .cache import NodeInfo, SchedulerCache
 from .devices import allocate_for_pod, fits_devices
 from .predicates import EquivalenceCache, PodAffinityChecker, run_predicates
@@ -47,6 +48,10 @@ from .queue import SchedulingQueue
 # node) and caps schedule() at O(feasible) instead of O(cluster).
 MIN_FEASIBLE_TO_FIND = 100
 FEASIBLE_PERCENT = 0.05
+
+# Op tracing (ref generic_scheduler.go:110-112 utiltrace usage): a
+# scheduling attempt slower than this logs its per-step breakdown.
+TRACE_THRESHOLD_S = 0.1
 
 
 class ScheduleResult:
@@ -284,10 +289,14 @@ class Scheduler:
                 self._schedule_gang(pod, start)
                 return
             # gate off: members place independently (the pre-gang behavior)
-        result, failure = self.schedule(pod)
+        tr = Trace("scheduling", threshold=TRACE_THRESHOLD_S,
+                   pod=key, attempts=self.schedule_attempts)
+        result, failure = self.schedule(pod, trace=tr)
         self.algorithm_latency.observe(time.monotonic() - start)
         if result is None:
             self._failures_ctr.inc()
+            tr.step("schedule failed")
+            tr.log_if_long()
             self.recorder.event(pod, "Warning", "FailedScheduling", failure)
             if pod.spec.priority > 0:
                 if self._try_preempt(pod):
@@ -296,6 +305,8 @@ class Scheduler:
             self.queue.add_backoff(key, pod.spec.priority)
             return
         self._assume_and_bind(pod, result)
+        tr.step("assumed and queued bind")
+        tr.log_if_long()
         self.queue.forget(key)
         self.e2e_latency.observe(time.monotonic() - start)
 
@@ -311,13 +322,16 @@ class Scheduler:
     def schedule(
         self, pod: t.Pod, nodes: Optional[Dict[str, NodeInfo]] = None,
         affinity_checker: Optional[PodAffinityChecker] = None,
+        trace: Optional[Trace] = None,
     ) -> Tuple[Optional[ScheduleResult], str]:
         """One-pod placement over the cache snapshot (or a simulation map).
         `affinity_checker` lets gang placement reuse one O(pods) context
         across members; when the simulation map is node-restricted, callers
         MUST pass a checker built over the full world (a subset view would
         miss matching pods on excluded nodes)."""
+        tr = trace or Trace("schedule")  # unthresholded no-op unless slow-path caller set one
         snapshot = nodes if nodes is not None else self.cache.snapshot()
+        tr.step(f"snapshot of {len(snapshot)} nodes")
         if not snapshot:
             return None, "no nodes registered"
         if affinity_checker is None and self._needs_affinity_check(pod):
@@ -365,10 +379,12 @@ class Scheduler:
             feasible.append(ni)
             if len(feasible) >= enough:
                 break
+        tr.step(f"predicates done: {len(feasible)} feasible")
         if not feasible:
             summary = "; ".join(f"{n} node(s): {r}" for r, n in sorted(reasons.items()))
             return None, f"0/{len(snapshot)} nodes available: {summary}"
         scores = prioritize(pod, feasible)
+        tr.step("prioritized")
         # full device allocation runs only on the winner (best-fit slice +
         # coordinate sort are O(devices log devices) — too hot per-candidate);
         # on the rare count-check/allocator disagreement, fall to the next best

@@ -1,0 +1,105 @@
+"""/debug/pprof-style introspection endpoints for component servers.
+
+Ref: every reference binary mounts net/http/pprof (`/debug/pprof`) —
+goroutine dumps and CPU profiles are the standard tools for "why is the
+scheduler slow".  Python equivalents served here:
+
+- /debug/pprof/stacks   — all-thread stack dump (goroutine profile analog)
+- /debug/pprof/profile?seconds=N — statistical CPU profile: samples every
+  thread's frame stack at ~100Hz for N seconds (py-spy style), reports
+  aggregated (function, file:line) self/cumulative counts as text.
+
+Shared by MetricsServer, the apiserver, and the kubelet server so one
+implementation backs every component (the reference gets this for free
+from net/http/pprof).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Optional, Tuple
+
+MAX_PROFILE_SECONDS = 60.0
+
+# The sampler burns a thread at 100Hz — cap concurrent profiles so the
+# endpoint cannot be used to pile up samplers (429 beyond the cap).
+_profile_slots = threading.BoundedSemaphore(2)
+
+
+def handle_debug(path: str, query: dict) -> Optional[Tuple[int, str, bytes]]:
+    """Serve a /debug/pprof request. Returns (status, content-type, body)
+    or None when the path is not a debug path."""
+    if not path.startswith("/debug/pprof"):
+        return None
+    leaf = path[len("/debug/pprof"):].strip("/")
+    if leaf in ("", "index"):
+        body = (b"ktpu pprof analog\n"
+                b"  /debug/pprof/stacks\n"
+                b"  /debug/pprof/profile?seconds=N\n")
+        return 200, "text/plain", body
+    if leaf == "stacks":
+        return 200, "text/plain", dump_stacks().encode()
+    if leaf == "profile":
+        raw = query.get("seconds", "1")
+        if isinstance(raw, (list, tuple)):
+            raw = raw[0] if raw else "1"
+        try:
+            seconds = float(raw)
+        except (TypeError, ValueError):
+            return 400, "text/plain", b"bad seconds\n"
+        seconds = max(0.05, min(MAX_PROFILE_SECONDS, seconds))
+        if not _profile_slots.acquire(blocking=False):
+            return 429, "text/plain", b"profiler busy\n"
+        try:
+            return 200, "text/plain", sample_profile(seconds).encode()
+        finally:
+            _profile_slots.release()
+    return 404, "text/plain", b"unknown debug path\n"
+
+
+def dump_stacks() -> str:
+    """Stack of every live thread (the goroutine-dump analog)."""
+    names = {th.ident: th.name for th in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {ident} ({names.get(ident, '?')}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def sample_profile(seconds: float, hz: float = 100.0) -> str:
+    """Statistical profile: sample all thread stacks at `hz` for `seconds`,
+    aggregate self and cumulative hits per (function, file:line)."""
+    interval = 1.0 / hz
+    me = threading.get_ident()
+    self_hits: Counter = Counter()
+    cum_hits: Counter = Counter()
+    samples = 0
+    deadline = time.perf_counter() + seconds
+    while time.perf_counter() < deadline:
+        for ident, frame in list(sys._current_frames().items()):
+            if ident == me:
+                continue
+            samples += 1
+            seen = set()
+            f, leaf = frame, True
+            while f is not None:
+                code = f.f_code
+                key = f"{code.co_name} ({code.co_filename}:{code.co_firstlineno})"
+                if leaf:
+                    self_hits[key] += 1
+                    leaf = False
+                if key not in seen:
+                    cum_hits[key] += 1
+                    seen.add(key)
+                f = f.f_back
+        time.sleep(interval)
+    lines = [f"samples: {samples} over {seconds:.2f}s @ {hz:.0f}Hz",
+             f"{'self':>6} {'cum':>6}  location"]
+    for key, cum in cum_hits.most_common(60):
+        lines.append(f"{self_hits.get(key, 0):6d} {cum:6d}  {key}")
+    return "\n".join(lines) + "\n"

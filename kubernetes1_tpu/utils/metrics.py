@@ -137,12 +137,19 @@ class MetricsServer:
     :10251, kubelet :10250/metrics, controller-manager :10252)."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1",
-                 port: int = 0, extra: Optional[Dict[str, callable]] = None):
+                 port: int = 0, extra: Optional[Dict[str, callable]] = None,
+                 debug: Optional[bool] = None):
         import json as _json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         registry_ref = registry
         extra_fns = dict(extra or {})  # name -> () -> float, appended as gauges
+        # /debug/pprof exposes thread stacks and a CPU sampler; the apiserver
+        # authorizes it per-request, this bare server cannot — so default to
+        # loopback-only (None = auto) unless the caller opts in explicitly
+        if debug is None:
+            debug = host in ("127.0.0.1", "localhost", "::1")
+        debug_enabled = debug
 
         class _H(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -151,6 +158,20 @@ class MetricsServer:
                 pass
 
             def do_GET(self):
+                if self.path.startswith("/debug/pprof") and debug_enabled:
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .debug import handle_debug
+
+                    parts = urlsplit(self.path)
+                    res = handle_debug(parts.path, parse_qs(parts.query))
+                    status, ctype, body = res or (404, "text/plain", b"")
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/healthz":
                     body = _json.dumps({"status": "ok"}).encode()
                     ctype = "application/json"

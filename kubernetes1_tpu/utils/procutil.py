@@ -6,12 +6,14 @@ import os
 import threading
 
 
-def bounded_exit(delay: float = 5.0) -> threading.Timer:
+def bounded_exit(delay: float = 5.0, code: int = 1) -> threading.Timer:
     """Arm a daemon timer that hard-exits if graceful shutdown hangs (a
     dead apiserver must not leave a binary wedged in informer-retry joins
     forever).  Daemonized so a CLEAN stop is not padded by the timeout;
-    callers may .cancel() after their stop() returns."""
-    timer = threading.Timer(delay, lambda: os._exit(0))
+    callers may .cancel() after their stop() returns.  Exits NONZERO: a
+    truncated shutdown is a failure a supervisor (Restart=on-failure) must
+    see, not a clean stop."""
+    timer = threading.Timer(delay, lambda: os._exit(code))
     timer.daemon = True
     timer.start()
     return timer

@@ -1,0 +1,78 @@
+"""Operation tracing: named steps, logged only when the whole op is slow.
+
+Ref: staging/src/k8s.io/apiserver/pkg/util/trace/trace.go:39 — the
+reference creates a Trace at the top of a hot operation (scheduler's
+Schedule at generic_scheduler.go:110-112, apiserver handlers), calls
+trace.Step(...) at milestones, and defers LogIfLong(threshold): nothing is
+emitted in the fast path, while a slow op logs every step with per-step
+latency, making tail-latency forensics free.
+
+Python shape: context manager; steps are (elapsed, msg) pairs; on exit the
+trace logs through the provided sink iff total >= threshold.  A module-wide
+`trace_sink` hook lets tests capture output and components route to their
+own loggers.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, List, Optional, Tuple
+
+# process-wide default sink (tests may swap it)
+trace_sink: Callable[[str], None] = lambda line: print(line, file=sys.stderr)
+
+
+class Trace:
+    """utiltrace.Trace analog.
+
+    with Trace("scheduling", threshold=0.1, pod="ns/name") as tr:
+        ...
+        tr.step("computed predicates")
+        ...
+        tr.step("prioritized")
+    # on exit: logs all steps iff the op took >= threshold seconds
+    """
+
+    def __init__(self, name: str, threshold: Optional[float] = None,
+                 sink: Optional[Callable[[str], None]] = None, **fields):
+        self.name = name
+        self.threshold = threshold
+        self.fields = fields
+        self._sink = sink
+        self._t0 = time.perf_counter()
+        self._steps: List[Tuple[float, str]] = []
+
+    # -- utiltrace API ------------------------------------------------------
+
+    def step(self, msg: str):
+        self._steps.append((time.perf_counter() - self._t0, msg))
+
+    @property
+    def total_seconds(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def log_if_long(self, threshold: Optional[float] = None):
+        th = threshold if threshold is not None else self.threshold
+        total = self.total_seconds
+        if th is None or total < th:
+            return
+        sink = self._sink or trace_sink
+        tag = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}"{(" " + tag) if tag else ""} '
+                 f"(total {total * 1000:.1f}ms, threshold {th * 1000:.0f}ms):"]
+        prev = 0.0
+        for at, msg in self._steps:
+            lines.append(f"  [{at * 1000:8.1f}ms] (+{(at - prev) * 1000:.1f}ms) {msg}")
+            prev = at
+        lines.append(f"  [{total * 1000:8.1f}ms] end")
+        sink("\n".join(lines))
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self) -> "Trace":
+        return self
+
+    def __exit__(self, *exc):
+        self.log_if_long()
+        return False

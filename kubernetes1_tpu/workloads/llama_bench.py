@@ -1,0 +1,200 @@
+"""Llama training benchmark payload — runs INSIDE a scheduled pod.
+
+The flagship workload half of BASELINE.md ("Llama-3-8B JAX training Job");
+this module produces the measured single-chip tokens/sec + MFU number the
+bench board carries (the 8B config itself is multi-host — a single v5e chip
+cannot hold 8B params + optimizer state, so the single-chip bench runs a
+smaller preset of the SAME architecture and records every knob in the
+output so the number is reproducible and honest).
+
+Like resnet_bench, it is launched by bench.py as a Job container command so
+the number reflects the full stack: admission rewrote the google.com/tpu
+limit, the scheduler allocated the chip, the kubelet's ProcessRuntime
+started this process with the device-plugin-injected TPU env.
+
+Two utilization numbers are reported:
+- mfu: model-FLOPs utilization, analytic 6N + attention convention
+  (PaLM appendix-B style: 6*N_matmul_params + 12*L*S*d per token,
+  fwd+bwd) — does NOT credit remat recompute.
+- hfu: hardware-FLOPs utilization from XLA's cost analysis of the compiled
+  step (includes rematerialized FLOPs), when available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .tpu_peaks import peak_flops_per_device
+
+# Presets are Llama-3-family architectures scaled to the memory on hand.
+# "1b" ~= TinyLlama-1.1B geometry; fits one 16GB v5e chip with adafactor +
+# remat. "8b" is the real multi-host flagship (dryrun/multichip only).
+PRESETS = {
+    "tiny": dict(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, d_ff=128),
+    "1b": dict(vocab=32000, d_model=2048, n_layers=22, n_heads=32,
+               n_kv_heads=4, d_ff=5632),
+    "8b": dict(vocab=128256, d_model=4096, n_layers=32, n_heads=32,
+               n_kv_heads=8, d_ff=14336),
+}
+
+
+def n_matmul_params(cfg) -> int:
+    """Parameter count in the matmuls (excl. norms; incl. embed+unembed,
+    which are real matmuls in this implementation)."""
+    d, hd = cfg.d_model, cfg.head_dim
+    per_layer = (d * cfg.n_heads * hd            # wq
+                 + 2 * d * cfg.n_kv_heads * hd   # wk, wv
+                 + cfg.n_heads * hd * d          # wo
+                 + 3 * d * cfg.d_ff)             # gate, up, down
+    return cfg.n_layers * per_layer + 2 * cfg.vocab * d
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """Analytic fwd+bwd FLOPs per trained token (no remat credit):
+    6 * matmul params + attention 12 * L * S * d."""
+    return 6.0 * n_matmul_params(cfg) + 12.0 * cfg.n_layers * seq * cfg.d_model
+
+
+def make_optimizer(name: str, lr: float):
+    import optax
+
+    if name == "adamw":
+        return optax.adamw(lr, weight_decay=0.1)
+    if name == "adafactor":
+        return optax.adafactor(lr)
+    if name == "sgdm":
+        return optax.sgd(lr, momentum=0.9)
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
+        warmup: int = 2, lr: float = 3e-4, remat: bool = True) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from . import sharding as sh
+    from .llama import LlamaConfig, init_params, loss_fn
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    cfg = LlamaConfig(max_seq=seq, remat=remat, **PRESETS[preset])
+    tx = make_optimizer(optimizer, lr)
+    mesh = sh.auto_mesh()
+
+    from functools import partial
+
+    import optax
+
+    with jax.set_mesh(mesh):
+        params = jax.jit(partial(init_params, cfg))(jax.random.key(0))
+        opt_state = jax.jit(tx.init)(params)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, tokens)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        rng = np.random.default_rng(0)
+        # +1: loss_fn trains next-token over tokens[:, :-1] -> [:, 1:]
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (batch, seq + 1)),
+                             jnp.int32)
+
+        exec_flops = None
+        try:
+            cost = step.lower(params, opt_state, tokens).compile().cost_analysis()
+            if cost and cost.get("flops"):
+                exec_flops = float(cost["flops"])
+        except Exception:  # noqa: BLE001
+            pass
+
+        # barrier = float(loss): a device-to-host transfer of the step's
+        # result.  block_until_ready alone is NOT a reliable fence on the
+        # tunneled single-chip platform after a manual lower().compile()
+        # (observed: it returns immediately and all work piles up on the
+        # next transfer), and a wrong fence here silently inflates MFU 1000x.
+        t_c0 = time.perf_counter()
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        compile_s = time.perf_counter() - t_c0
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        wall = time.perf_counter() - t0
+
+    peak, granularity = peak_flops_per_device(devices[0])
+    steps_per_sec = steps / wall
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps_per_sec
+    model_fps = model_flops_per_token(cfg, seq) * tokens_per_step
+    mfu = (model_fps * steps_per_sec / (peak * n_dev)) if peak else None
+    # XLA's cost analysis counts a lax.scan body ONCE, not n_layers times,
+    # so exec_flops badly undercounts scanned models; only report hfu when
+    # the count is at least plausible relative to the analytic model flops
+    if exec_flops is not None and exec_flops < 0.5 * model_fps:
+        exec_flops = None
+    hfu = (exec_flops * steps_per_sec / (peak * n_dev)) \
+        if (peak and exec_flops) else None
+    return {
+        "workload": f"llama-{preset}",
+        "device_kind": devices[0].device_kind,
+        "platform": devices[0].platform,
+        "n_devices": n_dev,
+        "device_granularity": granularity,
+        "params_matmul": n_matmul_params(cfg),
+        "batch": batch,
+        "seq": seq,
+        "steps": steps,
+        "optimizer": optimizer,
+        "remat": remat,
+        "compile_s": round(compile_s, 2),
+        "step_time_ms": round(1000 * wall / steps, 2),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "tokens_per_sec_per_device": round(tokens_per_sec / n_dev, 1),
+        "model_flops_per_step": model_fps,
+        "exec_flops_per_step": exec_flops,
+        "peak_flops_per_device": peak,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "hfu": round(hfu, 4) if hfu is not None else None,
+        "final_loss": float(loss),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="", help="write result JSON here")
+    ap.add_argument("--preset", default="1b", choices=sorted(PRESETS))
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--optimizer", default="adafactor",
+                    choices=["adamw", "adafactor", "sgdm"])
+    ap.add_argument("--no-remat", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        result = run(args.preset, args.batch, args.seq, args.steps,
+                     args.optimizer, remat=not args.no_remat)
+    except Exception as e:  # noqa: BLE001
+        result = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(result), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f)
+        sys.exit(1)
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,12 +81,20 @@ def _conv(x, w, stride=1, dtype=jnp.bfloat16):
 
 
 def _bn(x, bn):
-    # training-mode batch norm; stats over batch+space in f32
-    x = x.astype(jnp.float32)
-    mean = jnp.mean(x, axis=(0, 1, 2), keepdims=True)
-    var = jnp.var(x, axis=(0, 1, 2), keepdims=True)
-    x = (x - mean) * jax.lax.rsqrt(var + 1e-5)
-    return x * bn["scale"] + bn["bias"]
+    """Training-mode batch norm, HBM-lean: stats accumulate in f32 (one
+    fused pass, E[x^2]-E[x]^2 form), but the normalized output stays in the
+    compute dtype.  Folding (scale*inv, bias-mean*scale*inv) into two
+    per-channel vectors keeps the big-tensor math a single fused
+    multiply-add that XLA fuses into the producing conv's epilogue —
+    round-tripping activations through f32 here was the #1 HBM cost."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + 1e-5) * bn["scale"]
+    w = inv.astype(x.dtype)
+    b = (bn["bias"] - mean * inv).astype(x.dtype)
+    return x * w + b
 
 
 def forward(cfg: ResNetConfig, params: Dict[str, Any], images: jax.Array) -> jax.Array:

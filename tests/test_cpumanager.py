@@ -1,0 +1,268 @@
+"""CPU manager: topology-aware exclusive pinning + state checkpoint.
+
+Ref: pkg/kubelet/cm/cpumanager/{cpu_manager,policy_static,cpu_assignment}.go
+and state/state_file.go:45-119.
+"""
+
+import os
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.kubelet.cpumanager import (
+    POLICY_NONE,
+    POLICY_STATIC,
+    CPUManager,
+    CPUTopology,
+    take_by_topology,
+)
+
+
+def make_pod(uid, cpu=None, memory=None, name="p"):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.uid = uid
+    c = t.Container(name="main", image="img", command=["sleep", "1"])
+    if cpu is not None:
+        c.resources.limits = {"cpu": cpu, **({"memory": memory} if memory else {})}
+        c.resources.requests = dict(c.resources.limits)
+    pod.spec.containers = [c]
+    return pod
+
+
+def guaranteed_pod(uid, cpu="2"):
+    return make_pod(uid, cpu=cpu, memory="64Mi")
+
+
+class TestTopology:
+    def test_synthetic_layout(self):
+        topo = CPUTopology.synthetic(2, 4, 2)  # 2 sockets x 4 cores x 2 threads
+        assert topo.num_cpus == 16
+        assert len(topo.cpus_per_core()) == 8
+        assert len(topo.cpus_per_socket()) == 2
+
+    def test_discover_falls_back_flat(self, tmp_path):
+        topo = CPUTopology.discover(sysfs=str(tmp_path / "missing"))
+        assert topo.num_cpus == (os.cpu_count() or 1)
+
+    def test_take_prefers_whole_cores(self):
+        topo = CPUTopology.synthetic(1, 4, 2)
+        got = take_by_topology(topo, set(range(8)), 2)
+        # 2 cpus should be the two threads of ONE physical core
+        cores = {topo.cpus[c].core for c in got}
+        assert len(cores) == 1
+
+    def test_take_prefers_whole_socket(self):
+        topo = CPUTopology.synthetic(2, 2, 2)  # sockets of 4 cpus
+        got = take_by_topology(topo, set(range(8)), 4)
+        sockets = {topo.cpus[c].socket for c in got}
+        assert len(sockets) == 1
+
+    def test_take_leftover_threads_prefer_partial_cores(self):
+        topo = CPUTopology.synthetic(1, 2, 2)
+        # cpu 1 (thread of core 0) taken -> available 0,2,3; want 1
+        got = take_by_topology(topo, {0, 2, 3}, 1)
+        # should pick cpu 0 (its core already broken) keeping core 1 intact
+        assert got == {0}
+
+    def test_take_insufficient_raises(self):
+        topo = CPUTopology.synthetic(1, 1, 2)
+        try:
+            take_by_topology(topo, {0}, 2)
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+
+class TestStaticPolicy:
+    def mgr(self, tmp_path, sockets=1, cores=4, threads=2):
+        return CPUManager(
+            policy=POLICY_STATIC,
+            topology=CPUTopology.synthetic(sockets, cores, threads),
+            state_path=str(tmp_path / "cpu_manager_state.json"),
+        )
+
+    def test_guaranteed_integer_gets_exclusive(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="2")
+        got = m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert len(got) == 2
+        # removed from the shared pool
+        assert not (got & m.state.default_cpuset)
+
+    def test_burstable_gets_shared_pool(self, tmp_path):
+        m = self.mgr(tmp_path)
+        gpod = guaranteed_pod("u1", cpu="2")
+        excl = m.cpuset_for_container(gpod, gpod.spec.containers[0])
+        bpod = make_pod("u2", cpu="500m")  # fractional -> not exclusive
+        shared = m.cpuset_for_container(bpod, bpod.spec.containers[0])
+        assert shared == m.state.default_cpuset
+        assert not (shared & excl)
+
+    def test_fractional_guaranteed_not_exclusive(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="1500m")
+        got = m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert got == m.state.default_cpuset
+
+    def test_release_returns_cpus(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="4")
+        got = m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert len(got) == 4
+        m.release_pod("u1")
+        assert m.state.default_cpuset == {c.cpu for c in m.topology.cpus}
+
+    def test_same_container_stable_assignment(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="2")
+        a = m.cpuset_for_container(pod, pod.spec.containers[0])
+        b = m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert a == b
+
+    def test_exhaustion_falls_back_to_shared(self, tmp_path):
+        m = self.mgr(tmp_path, sockets=1, cores=2, threads=1)  # 2 cpus
+        p1 = guaranteed_pod("u1", cpu="2")
+        m.cpuset_for_container(p1, p1.spec.containers[0])
+        p2 = guaranteed_pod("u2", cpu="1")
+        got = m.cpuset_for_container(p2, p2.spec.containers[0])
+        # pool empty, no reserved -> None (no pinning), not a crash and
+        # never an empty set (which taskset would treat as unpinned anyway)
+        assert got is None
+
+    def test_checkpoint_survives_restart(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="2")
+        got = m.cpuset_for_container(pod, pod.spec.containers[0])
+        # new manager over the same state file: assignment restored
+        m2 = self.mgr(tmp_path)
+        assert m2.state.entries["u1/main"] == got
+        assert not (got & m2.state.default_cpuset)
+
+    def test_reconcile_drops_stale_pods(self, tmp_path):
+        m = self.mgr(tmp_path)
+        pod = guaranteed_pod("u1", cpu="2")
+        m.cpuset_for_container(pod, pod.spec.containers[0])
+        m.reconcile(live_uids={"other"})
+        assert "u1/main" not in m.state.entries
+        assert m.state.default_cpuset == {c.cpu for c in m.topology.cpus}
+
+    def test_reserved_cpus_never_exclusive(self, tmp_path):
+        m = CPUManager(
+            policy=POLICY_STATIC,
+            topology=CPUTopology.synthetic(1, 4, 1),
+            state_path=str(tmp_path / "s.json"),
+            reserved_cpus=2,
+        )
+        pod = guaranteed_pod("u1", cpu="2")
+        got = m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert not (got & {0, 1})
+
+    def test_none_policy_disabled(self, tmp_path):
+        m = CPUManager(policy=POLICY_NONE,
+                       topology=CPUTopology.synthetic(1, 4, 2))
+        pod = guaranteed_pod("u1", cpu="2")
+        assert m.cpuset_for_container(pod, pod.spec.containers[0]) is None
+
+
+class TestRuntimeWrap:
+    def test_wrap_with_cpuset_uses_taskset(self):
+        from kubernetes1_tpu.kubelet import runtime as rt
+
+        cmd = rt._wrap_with_cpuset(["sleep", "1"], [2, 0])
+        if rt._TASKSET:
+            assert cmd[1:3] == ["-c", "0,2"]
+            assert cmd[3:] == ["sleep", "1"]
+        else:
+            assert cmd == ["sleep", "1"]
+
+
+class TestPoolChangeRepin:
+    def test_empty_pool_falls_back_to_reserved_or_none(self, tmp_path):
+        m = CPUManager(policy=POLICY_STATIC,
+                       topology=CPUTopology.synthetic(1, 2, 1),
+                       state_path=str(tmp_path / "s.json"))
+        p1 = guaranteed_pod("u1", cpu="2")
+        m.cpuset_for_container(p1, p1.spec.containers[0])
+        # pool empty, no reserved -> None (pin nowhere is better than
+        # an empty-set no-op that unpins from everything)
+        bpod = make_pod("u2", cpu="500m")
+        assert m.cpuset_for_container(bpod, bpod.spec.containers[0]) is None
+
+        m2 = CPUManager(policy=POLICY_STATIC,
+                        topology=CPUTopology.synthetic(1, 3, 1),
+                        state_path=str(tmp_path / "s2.json"),
+                        reserved_cpus=1)
+        p2 = guaranteed_pod("u3", cpu="2")
+        m2.cpuset_for_container(p2, p2.spec.containers[0])
+        got = m2.cpuset_for_container(bpod, bpod.spec.containers[0])
+        assert got == {0}  # the reserved cpu
+
+    def test_on_pool_change_fires_on_grant_and_release(self, tmp_path):
+        events = []
+        m = CPUManager(policy=POLICY_STATIC,
+                       topology=CPUTopology.synthetic(1, 4, 1),
+                       state_path=str(tmp_path / "s.json"))
+        m.on_pool_change = lambda: events.append("changed")
+        pod = guaranteed_pod("u1", cpu="2")
+        m.cpuset_for_container(pod, pod.spec.containers[0])
+        assert events == ["changed"]
+        m.release_pod("u1")
+        assert events == ["changed", "changed"]
+        # shared lookup does not fire
+        bpod = make_pod("u2", cpu="500m")
+        m.cpuset_for_container(bpod, bpod.spec.containers[0])
+        assert len(events) == 2
+
+    def test_none_policy_skips_discovery_and_state(self, tmp_path):
+        state = tmp_path / "never.json"
+        m = CPUManager(policy=POLICY_NONE, state_path=str(state))
+        assert not state.exists()
+        assert m.topology.num_cpus == 0
+
+
+class TestAffinityRepin:
+    def test_process_runtime_repins_live_tree(self, tmp_path):
+        import time as _t
+
+        from kubernetes1_tpu.kubelet.runtime import (
+            CONTAINER_RUNNING,
+            ContainerConfig,
+            ProcessRuntime,
+        )
+
+        rt = ProcessRuntime(root_dir=str(tmp_path))
+        sid = rt.run_pod_sandbox("p", "default", "u1")
+        cid = rt.create_container(
+            sid, ContainerConfig(name="c", image="i",
+                                 command=["sleep", "30"]))
+        rt.start_container(cid)
+        assert rt.container_status(cid).state == CONTAINER_RUNNING
+        avail = sorted(os.sched_getaffinity(0))
+        ok = rt.set_container_affinity(cid, set(avail[:1]))
+        assert ok
+        proc = rt._procs[cid]
+        assert os.sched_getaffinity(proc.pid) == set(avail[:1])
+        rt.stop_container(cid, timeout=1.0)
+
+    def test_remote_runtime_proxies_capabilities_and_affinity(self, tmp_path):
+        from kubernetes1_tpu.kubelet.cri import RemoteRuntime, RuntimeServer
+        from kubernetes1_tpu.kubelet.runtime import (
+            ContainerConfig,
+            ProcessRuntime,
+        )
+
+        backend = ProcessRuntime(root_dir=str(tmp_path / "rt"))
+        server = RuntimeServer(backend, str(tmp_path / "cri.sock")).start()
+        client = RemoteRuntime(server.socket_path)
+        try:
+            assert client.real_pids is True
+            sid = client.run_pod_sandbox("p", "default", "u1")
+            cid = client.create_container(
+                sid, ContainerConfig(name="c", image="i",
+                                     command=["sleep", "30"]))
+            client.start_container(cid)
+            avail = sorted(os.sched_getaffinity(0))
+            assert client.set_container_affinity(cid, set(avail[:1]))
+            client.stop_container(cid, timeout=1.0)
+        finally:
+            client.close()
+            server.stop()

@@ -1,0 +1,137 @@
+"""Op tracing (utiltrace analog) + /debug/pprof endpoints.
+
+Ref: staging/src/k8s.io/apiserver/pkg/util/trace/trace.go:39 and
+net/http/pprof mounted on every reference binary.
+"""
+
+import time
+import urllib.request
+
+from kubernetes1_tpu.utils.debug import dump_stacks, handle_debug, sample_profile
+from kubernetes1_tpu.utils.metrics import MetricsServer, Registry
+from kubernetes1_tpu.utils.trace import Trace
+
+
+class TestTrace:
+    def test_silent_under_threshold(self):
+        lines = []
+        with Trace("fast-op", threshold=10.0, sink=lines.append) as tr:
+            tr.step("one")
+        assert lines == []
+
+    def test_logs_steps_when_slow(self):
+        lines = []
+        with Trace("slow-op", threshold=0.0, sink=lines.append, pod="ns/p") as tr:
+            tr.step("alpha")
+            time.sleep(0.01)
+            tr.step("beta")
+        assert len(lines) == 1
+        out = lines[0]
+        assert "slow-op" in out and "pod=ns/p" in out
+        assert "alpha" in out and "beta" in out
+
+    def test_no_threshold_never_logs(self):
+        lines = []
+        with Trace("op", sink=lines.append) as tr:
+            tr.step("x")
+        assert lines == []
+
+    def test_explicit_log_if_long_threshold(self):
+        lines = []
+        tr = Trace("op", sink=lines.append)
+        tr.step("x")
+        tr.log_if_long(0.0)
+        assert len(lines) == 1
+
+
+class TestDebugHandlers:
+    def test_stacks_contains_this_thread(self):
+        out = dump_stacks()
+        assert "test_stacks_contains_this_thread" in out
+
+    def test_profile_samples(self):
+        out = sample_profile(0.05, hz=200.0)
+        assert out.startswith("samples:")
+
+    def test_handle_debug_routes(self):
+        assert handle_debug("/metrics", {}) is None
+        status, _, body = handle_debug("/debug/pprof", {})
+        assert status == 200 and b"stacks" in body
+        status, _, _ = handle_debug("/debug/pprof/stacks", {})
+        assert status == 200
+        status, _, _ = handle_debug("/debug/pprof/unknown", {})
+        assert status == 404
+
+    def test_handle_debug_seconds_scalar_and_list(self):
+        for q in ({"seconds": "0.05"}, {"seconds": ["0.05"]}):
+            status, _, body = handle_debug("/debug/pprof/profile", q)
+            assert status == 200 and body.startswith(b"samples:")
+
+
+class TestServedEndpoints:
+    def test_metrics_server_serves_debug(self):
+        srv = MetricsServer(Registry(), port=0).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/debug/pprof/stacks") as r:
+                assert r.status == 200
+                assert b"thread" in r.read()
+        finally:
+            srv.stop()
+
+    def test_apiserver_serves_debug(self):
+        from kubernetes1_tpu.apiserver import Master
+
+        master = Master().start()
+        try:
+            with urllib.request.urlopen(master.url + "/debug/pprof/stacks") as r:
+                assert r.status == 200
+                assert b"thread" in r.read()
+        finally:
+            master.stop()
+
+    def test_scheduler_trace_logs_slow_attempt(self, monkeypatch):
+        """A slow schedule() emits its step breakdown through the sink."""
+        from kubernetes1_tpu.utils import trace as trace_mod
+
+        lines = []
+        monkeypatch.setattr(trace_mod, "trace_sink", lines.append)
+        from kubernetes1_tpu.api import types as t
+        from kubernetes1_tpu.scheduler import scheduler as sched_mod
+
+        monkeypatch.setattr(sched_mod, "TRACE_THRESHOLD_S", 0.0)
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset
+        from kubernetes1_tpu.scheduler import Scheduler
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs)
+        sched.start()
+        try:
+            node = t.Node()
+            node.metadata.name = "n1"
+            node.status.capacity = {"cpu": "4", "memory": "8Gi", "pods": "10"}
+            node.status.allocatable = dict(node.status.capacity)
+            node.status.conditions = [
+                t.NodeCondition(type="Ready", status="True")]
+            cs.nodes.create(node)
+            pod = t.Pod()
+            pod.metadata.name = "traced"
+            pod.spec.containers = [
+                t.Container(name="c", image="img", command=["sleep"])]
+            cs.pods.create(pod)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                p = cs.pods.get("traced")
+                if p.spec.node_name:
+                    break
+                time.sleep(0.05)
+            assert p.spec.node_name == "n1"
+            deadline = time.time() + 2
+            while time.time() < deadline and not lines:
+                time.sleep(0.05)
+            assert any("scheduling" in ln and "feasible" in ln for ln in lines)
+        finally:
+            sched.stop()
+            cs.close()
+            master.stop()
