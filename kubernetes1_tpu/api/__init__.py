@@ -33,6 +33,8 @@ _REGISTRY = [
     (t.PersistentVolumeClaim, "persistentvolumeclaims", True),
     (t.CertificateSigningRequest, "certificatesigningrequests", False),
     (t.CustomResourceDefinition, "customresourcedefinitions", False),
+    (t.MutatingWebhookConfiguration, "mutatingwebhookconfigurations", False),
+    (t.ValidatingWebhookConfiguration, "validatingwebhookconfigurations", False),
     (t.APIService, "apiservices", False),
     (t.PodMetrics, "podmetrics", True),
     (t.NodeMetrics, "nodemetrics", False),

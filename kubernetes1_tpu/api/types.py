@@ -1279,6 +1279,47 @@ class NodeMetrics(KObject):
 
 
 @dataclass
+class WebhookRule:
+    """Which (operations x resources) a webhook intercepts (ref:
+    admissionregistration/v1beta1 RuleWithOperations)."""
+
+    operations: List[str] = field(default_factory=lambda: ["CREATE", "UPDATE"])
+    resources: List[str] = field(default_factory=list)  # plurals; ["*"] = all
+
+
+@dataclass
+class Webhook:
+    """One webhook endpoint (ref: admissionregistration Webhook).  The
+    client config is a plain URL (no CA bundle layer here); the response
+    `patch` is an RFC 7386 merge-patch object rather than upstream's
+    base64 JSONPatch — consistent with this API server's PATCH support."""
+
+    name: str = ""
+    url: str = ""
+    rules: List[WebhookRule] = field(default_factory=list)
+    failure_policy: str = "Fail"  # Fail | Ignore
+    timeout_seconds: float = 10.0
+
+
+@dataclass
+class MutatingWebhookConfiguration(KObject):
+    """Ref: staging admissionregistration MutatingWebhookConfiguration —
+    dynamic admission: matching requests POST an AdmissionReview to each
+    webhook, which may return a patch to apply."""
+
+    KIND = "MutatingWebhookConfiguration"
+    API_VERSION = "admissionregistration/v1"
+    webhooks: List[Webhook] = field(default_factory=list)
+
+
+@dataclass
+class ValidatingWebhookConfiguration(KObject):
+    KIND = "ValidatingWebhookConfiguration"
+    API_VERSION = "admissionregistration/v1"
+    webhooks: List[Webhook] = field(default_factory=list)
+
+
+@dataclass
 class APIService(KObject):
     """Ref: kube-aggregator APIService — requests under /apis/<group>/<ver>
     proxy to the backing service's endpoints."""

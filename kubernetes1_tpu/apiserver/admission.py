@@ -479,6 +479,112 @@ class EventRateLimit(AdmissionPlugin):
         self._buckets[src] = (tokens - 1.0, now)
 
 
+class _WebhookAdmission(AdmissionPlugin):
+    """Dynamic admission via HTTP callout (ref: plugin/pkg/admission/webhook
+    + admissionregistration).  POSTs an AdmissionReview-shaped JSON body:
+
+        {"request": {"operation", "resource", "namespace", "name",
+                     "object", "oldObject", "userInfo"}}
+
+    and expects {"response": {"allowed": bool, "status": {"message"},
+    "patch": {...merge patch...}}}.  failurePolicy governs callout errors:
+    Fail rejects the request, Ignore skips the webhook.
+
+    Webhook configs never pass through webhooks themselves (upstream
+    exempts admissionregistration resources to avoid self-lockout)."""
+
+    mutating = False
+    _EXEMPT = ("mutatingwebhookconfigurations",
+               "validatingwebhookconfigurations")
+
+    def __init__(self, list_configs):
+        self._list_configs = list_configs  # () -> [**WebhookConfiguration]
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource in self._EXEMPT:
+            return
+        configs = self._list_configs()
+        if not configs:
+            return
+        from ..machinery.scheme import global_scheme
+
+        for cfg in configs:
+            for wh in cfg.webhooks:
+                if not self._matches(wh, operation, resource):
+                    continue
+                self._call_one(wh, operation, resource, obj, old, user,
+                               global_scheme)
+
+    @staticmethod
+    def _matches(wh, operation: str, resource: str) -> bool:
+        for rule in wh.rules:
+            if operation not in rule.operations:
+                continue
+            if "*" in rule.resources or resource in rule.resources:
+                return True
+        return False
+
+    def _call_one(self, wh, operation, resource, obj, old, user, scheme):
+        import json as _json
+        import urllib.request
+
+        review = {"request": {
+            "operation": operation,
+            "resource": resource,
+            "namespace": getattr(obj.metadata, "namespace", ""),
+            "name": obj.metadata.name,
+            "object": scheme.encode(obj),
+            "oldObject": scheme.encode(old) if old is not None else None,
+            "userInfo": {"username": getattr(user, "name", ""),
+                         "groups": list(getattr(user, "groups", []) or [])},
+        }}
+        try:
+            req = urllib.request.Request(
+                wh.url, data=_json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=wh.timeout_seconds) as r:
+                body = _json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — callout failure
+            if wh.failure_policy == "Ignore":
+                return
+            raise Invalid(f"admission webhook {wh.name!r} failed: {e}")
+        resp = (body or {}).get("response") or {}
+        if not resp.get("allowed", False):
+            msg = ((resp.get("status") or {}).get("message")
+                   or "denied by webhook")
+            raise Forbidden(f"admission webhook {wh.name!r} denied the "
+                            f"request: {msg}")
+        patch = resp.get("patch")
+        if self.mutating and patch:
+            merged = _merge_into(scheme.encode(obj), patch)
+            new_obj = scheme.decode(merged)
+            # mutate the caller's object in place (the chain passes `obj` on)
+            obj.__dict__.update(new_obj.__dict__)
+
+
+def _merge_into(doc: dict, patch: dict) -> dict:
+    """RFC 7386 merge (same semantics as the registry's PATCH verb)."""
+    out = dict(doc)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_into(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class MutatingWebhookAdmission(_WebhookAdmission):
+    name = "MutatingAdmissionWebhook"
+    mutating = True
+
+
+class ValidatingWebhookAdmission(_WebhookAdmission):
+    name = "ValidatingAdmissionWebhook"
+    mutating = False
+
+
 CREATED_BY_ANNOTATION = "ktpu.io/created-by"
 CREATED_BY_GROUPS_ANNOTATION = "ktpu.io/created-by-groups"
 

@@ -97,6 +97,65 @@ class ServiceAccountAuthenticator:
         )
 
 
+class WebhookTokenAuthenticator:
+    """Remote authn via TokenReview callout (ref: apiserver webhook token
+    authenticator, staging/src/k8s.io/apiserver/plugin/pkg/authenticator/
+    token/webhook): POST {"spec": {"token": ...}} to the configured URL and
+    trust {"status": {"authenticated": true, "user": {...}}} back.
+
+    Results are cached briefly (upstream's --authentication-token-webhook-
+    cache-ttl, default 2m) so a webhook outage or slow IdP does not turn
+    every request into a callout."""
+
+    def __init__(self, url: str, timeout: float = 5.0, cache_ttl: float = 120.0,
+                 clock=None):
+        import time as _time
+
+        self.url = url
+        self.timeout = timeout
+        self.cache_ttl = cache_ttl
+        self._clock = clock or _time.monotonic
+        self._cache: Dict[str, tuple] = {}  # token -> (expires, UserInfo|None)
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        import json as _json
+        import urllib.request
+
+        now = self._clock()
+        hit = self._cache.get(token)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        review = {"kind": "TokenReview", "spec": {"token": token}}
+        try:
+            req = urllib.request.Request(
+                self.url, data=_json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = _json.loads(r.read())
+        except Exception:  # noqa: BLE001 — webhook down: not our credential
+            return None
+        status = (body or {}).get("status") or {}
+        user = None
+        if status.get("authenticated"):
+            u = status.get("user") or {}
+            if u.get("username"):
+                user = UserInfo(
+                    name=u["username"],
+                    groups=list(u.get("groups") or []) + [GROUP_AUTHENTICATED],
+                )
+        self._cache[token] = (now + self.cache_ttl, user)
+        if len(self._cache) > 10000:
+            # hard bound: expired entries first, then oldest-expiry — under
+            # unique-bogus-token floods everything is unexpired, and keeping
+            # it all would grow without bound on unauthenticated traffic
+            live = sorted(
+                ((k, v) for k, v in self._cache.items() if v[0] > now),
+                key=lambda kv: kv[1][0], reverse=True,
+            )
+            self._cache = dict(live[:5000])
+        return user
+
+
 BOOTSTRAP_TOKEN_SECRET_TYPE = "bootstrap.kubernetes.io/token"
 GROUP_BOOTSTRAPPERS = "system:bootstrappers"
 

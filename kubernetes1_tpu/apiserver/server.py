@@ -39,6 +39,7 @@ from .admission import (
     GangDefaulter,
     IdentityStamp,
     LimitRanger,
+    MutatingWebhookAdmission,
     NamespaceAutoProvision,
     NodeRestriction,
     PodNodeSelector,
@@ -46,6 +47,7 @@ from .admission import (
     ResourceQuotaAdmission,
     ResourceV2,
     ServiceAccountAdmission,
+    ValidatingWebhookAdmission,
     compute_namespace_usage,
 )
 from .auth import (
@@ -61,6 +63,7 @@ from .auth import (
     ServiceAccountAuthenticator,
     StaticTokenAuthenticator,
     UserInfo,
+    WebhookTokenAuthenticator,
     verb_for,
 )
 from .registry import Registry
@@ -290,6 +293,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._authz(user, verb, resource, ns, name, sub)
             handler = getattr(self, f"_do_{method.lower()}")
             handler(resource, ns, name, sub, q)
+            if method != "GET" and resource in (
+                "mutatingwebhookconfigurations",
+                "validatingwebhookconfigurations",
+            ):
+                # a just-written config must be enforced immediately — the
+                # 1s admission cache is for steady-state reads only
+                self.master._webhook_cache.pop(resource, None)
             self.master.metrics.observe(method, resource, time.monotonic() - start)
         except ApiError as e:
             try:
@@ -685,6 +695,7 @@ class Master:
         sa_signing_key: str = "ktpu-sa-key",
         ca_key: str = "ktpu-ca-key",
         admission_plugins: Optional[List[str]] = None,  # extra opt-ins, e.g. AlwaysPullImages
+        authentication_webhook_url: str = "",  # TokenReview callout (webhook authn)
     ):
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
@@ -699,20 +710,23 @@ class Master:
         self._audit_path = audit_path
         self._audit_lock = threading.Lock()
         self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
+        self._webhook_cache: Dict[str, tuple] = {}  # resource -> (ts, items)
         self.authorization_mode = authorization_mode
         tokens = dict(static_tokens or {})
         if token:
             tokens[token] = ("system:admin", [GROUP_MASTERS])
-        self.authenticators = AuthenticatorChain(
-            [
-                StaticTokenAuthenticator(tokens),
-                ServiceAccountAuthenticator(
-                    sa_signing_key, get_serviceaccount=self._get_serviceaccount
-                ),
-                CertificateAuthenticator(ca_key),
-                BootstrapTokenAuthenticator(self._get_secret_or_none),
-            ]
-        )
+        authns = [
+            StaticTokenAuthenticator(tokens),
+            ServiceAccountAuthenticator(
+                sa_signing_key, get_serviceaccount=self._get_serviceaccount
+            ),
+            CertificateAuthenticator(ca_key),
+            BootstrapTokenAuthenticator(self._get_secret_or_none),
+        ]
+        if authentication_webhook_url:
+            # last: local authenticators win, unknown tokens go remote
+            authns.append(WebhookTokenAuthenticator(authentication_webhook_url))
+        self.authenticators = AuthenticatorChain(authns)
         if authorization_mode == "AlwaysAllow":
             self.authorizer = AuthorizerChain([AlwaysAllowAuthorizer()])
         else:
@@ -740,9 +754,16 @@ class Master:
             GangDefaulter(),
             ServiceAccountAdmission(),
             IdentityStamp(),
+            # dynamic admission: mutating webhooks run after the built-in
+            # mutators (they see the rewritten object) and before the
+            # validating phase; validating webhooks run dead last
+            MutatingWebhookAdmission(
+                lambda: self._list_webhook_configs("mutatingwebhookconfigurations")),
             LimitRanger(self._list_limit_ranges),
             ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
             EventRateLimit(),
+            ValidatingWebhookAdmission(
+                lambda: self._list_webhook_configs("validatingwebhookconfigurations")),
         ]
         # opt-in plugins by name (the --admission-control list analog)
         for name in (admission_plugins or []):
@@ -760,6 +781,27 @@ class Master:
 
     def _get_priority_class(self, name: str):
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
+
+    def _list_webhook_configs(self, resource: str):
+        """Webhook configs for the admission chain, cached ~1s: admission
+        runs on EVERY write and a store scan per write is pure overhead on
+        webhook-free clusters (upstream reads these through an informer
+        with comparable staleness).
+
+        Re-entrancy note: webhook callouts can run while the quota lock is
+        held (_with_quota_serialization); a webhook handler that writes a
+        quota-counted object back into THIS apiserver blocks on that lock
+        until the callout times out — bounded by timeout_seconds, same
+        hazard class as upstream's re-entrant webhook writes."""
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._webhook_cache.get(resource)
+        if hit is not None and now - hit[0] < 1.0:
+            return hit[1]
+        items, _ = self.store.list(self.registry.prefix(resource, ""))
+        self._webhook_cache[resource] = (now, items)
+        return items
 
     def _get_namespace_or_none(self, name: str):
         if not name:

@@ -294,6 +294,107 @@ class CLI:
 
     # ------------------------------------------- logs / exec / port-forward
 
+    # ------------------------------------------- patch / label / annotate
+
+    def patch(self, args):
+        """`ktpu patch <resource> <name> -p '<json>'` — RFC 7386 merge
+        patch through the server's patch+admission path (kubectl patch)."""
+        plural = resolve_resource(args.resource)
+        try:
+            body = json.loads(args.patch)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"error: -p is not valid JSON: {e}")
+        ns = self.ns if self.scheme.namespaced[plural] else ""
+        obj = self.cs.resource(plural).patch(args.name, body, ns)
+        print(f"{plural}/{obj.metadata.name} patched", file=self.out)
+
+    def _meta_kv_patch(self, args, field: str):
+        plural = resolve_resource(args.resource)
+        ns = self.ns if self.scheme.namespaced[plural] else ""
+        client = self.cs.resource(plural)
+        obj = client.get(args.name, ns)
+        current = dict(getattr(obj.metadata, field) or {})
+        changes = {}
+        for pair in args.pairs:
+            if pair.endswith("-") and "=" not in pair:
+                changes[pair[:-1]] = None  # merge-patch null deletes
+                continue
+            if "=" not in pair:
+                raise SystemExit(f"error: {pair!r} is not key=value or key-")
+            k, v = pair.split("=", 1)
+            if k in current and current[k] != v and not args.overwrite:
+                raise SystemExit(
+                    f"error: {field[:-1]} {k!r} already set to "
+                    f"{current[k]!r}; use --overwrite")
+            changes[k] = v
+        patched = client.patch(args.name, {"metadata": {field: changes}}, ns)
+        verb = "labeled" if field == "labels" else "annotated"
+        print(f"{plural}/{patched.metadata.name} {verb}", file=self.out)
+
+    def label(self, args):
+        self._meta_kv_patch(args, "labels")
+
+    def annotate(self, args):
+        self._meta_kv_patch(args, "annotations")
+
+    def edit(self, args):
+        """`ktpu edit <resource> <name>` — fetch, open $EDITOR on the YAML,
+        PUT the result back (kubectl edit; replace-on-save semantics)."""
+        import subprocess
+        import tempfile
+
+        plural = resolve_resource(args.resource)
+        ns = self.ns if self.scheme.namespaced[plural] else ""
+        client = self.cs.resource(plural)
+        obj = client.get(args.name, ns)
+        doc = self.scheme.encode(obj)
+        with tempfile.NamedTemporaryFile("w+", suffix=".yaml",
+                                         delete=False) as f:
+            yaml.safe_dump(doc, f, sort_keys=False)
+            path = f.name
+        try:
+            import shlex
+
+            # EDITOR may carry arguments ("code --wait"): shell-split like
+            # kubectl/git do
+            editor = shlex.split(os.environ.get("EDITOR", "vi"))
+            subprocess.run(editor + [path], check=True)
+            with open(path) as f:
+                edited = yaml.safe_load(f)
+            if edited == doc:
+                print("no changes", file=self.out)
+            else:
+                updated = client.update(self.scheme.decode(edited))
+                print(f"{plural}/{updated.metadata.name} edited", file=self.out)
+        except Exception as e:  # noqa: BLE001
+            # NEVER discard the user's edits: keep the file and say where
+            print(f"error: {e}\nedits preserved in {path}", file=sys.stderr)
+            raise SystemExit(1)
+        os.unlink(path)
+
+    def attach(self, args):
+        """`ktpu attach <pod>` — live stream of the running container's
+        output through the apiserver pods/attach subresource (honest for a
+        process runtime: attach to stdout, no terminal takeover)."""
+        from urllib.parse import urlencode, urlparse
+
+        from ..utils import streams
+
+        pod = self.cs.pods.get(args.pod, self.ns)
+        if not pod.spec.node_name:
+            raise SystemExit("error: pod not scheduled yet")
+        params = [("container", args.container or pod.spec.containers[0].name)]
+        base = urlparse(self.cs.api.url)
+        sock = streams.upgrade_request(
+            base.hostname, base.port,
+            f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/attach?"
+            + urlencode(params),
+            self._stream_headers(),
+        )
+        code = self._pump_stream(sock)
+        if code:
+            raise SystemExit(code)
+
     def logs(self, args):
         """GET pods/<name>/log through the apiserver (ref: kubectl logs →
         registry/core/pod/rest/log.go; the kubelet credential stays between
@@ -554,6 +655,28 @@ def build_parser() -> argparse.ArgumentParser:
     tp = sub.add_parser("top")
     tp.add_argument("what", choices=["nodes", "pods"])
 
+    pa = sub.add_parser("patch")
+    pa.add_argument("resource")
+    pa.add_argument("name")
+    pa.add_argument("-p", "--patch", required=True,
+                    help="JSON merge patch (RFC 7386)")
+
+    for verb in ("label", "annotate"):
+        lb = sub.add_parser(verb)
+        lb.add_argument("resource")
+        lb.add_argument("name")
+        lb.add_argument("pairs", nargs="+",
+                        help="key=value to set, key- to remove")
+        lb.add_argument("--overwrite", action="store_true")
+
+    ed = sub.add_parser("edit")
+    ed.add_argument("resource")
+    ed.add_argument("name")
+
+    at = sub.add_parser("attach")
+    at.add_argument("pod")
+    at.add_argument("-c", "--container", default="")
+
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "restart"])
     ro.add_argument("target")
@@ -661,5 +784,7 @@ def dispatch(cli: CLI, args) -> None:
         "top": cli.top, "rollout": cli.rollout, "logs": cli.logs,
         "exec": cli.exec_, "port-forward": cli.port_forward,
         "wait": cli.wait, "api-resources": cli.api_resources,
+        "patch": cli.patch, "label": cli.label, "annotate": cli.annotate,
+        "edit": cli.edit, "attach": cli.attach,
     }[args.cmd]
     handler(args)

@@ -496,3 +496,122 @@ class TestLegacyTokenMode:
         bad.close()
         cs.close()
         master.stop()
+
+
+class TestWebhookTokenAuthn:
+    """Remote TokenReview authn (ref: apiserver webhook token authenticator)."""
+
+    def _idp(self, valid_tokens):
+        import json as _json
+        import threading as _th
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                review = _json.loads(self.rfile.read(n))
+                tok = review.get("spec", {}).get("token", "")
+                if tok in valid_tokens:
+                    body = {"status": {"authenticated": True,
+                                       "user": {"username": valid_tokens[tok],
+                                                "groups": ["idp-users"]}}}
+                else:
+                    body = {"status": {"authenticated": False}}
+                raw = _json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        httpd.daemon_threads = True
+        _th.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/tokenreview"
+
+    def test_webhook_authenticates_and_rbac_applies(self):
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset
+        from kubernetes1_tpu.machinery import ApiError
+
+        httpd, url = self._idp({"idp-tok-1": "alice@corp"})
+        master = Master(authorization_mode="Node,RBAC", token="admintok",
+                        authentication_webhook_url=url).start()
+        admin = Clientset(master.url, token="admintok")
+        try:
+            # grant alice read on pods via RBAC
+            from kubernetes1_tpu.api import types as t
+
+            role = t.ClusterRole()
+            role.metadata.name = "pod-reader"
+            role.rules = [t.PolicyRule(verbs=["get", "list"],
+                                       resources=["pods"])]
+            admin.clusterroles.create(role, "")
+            rb = t.ClusterRoleBinding()
+            rb.metadata.name = "alice-reads"
+            rb.subjects = [t.Subject(kind="User", name="alice@corp")]
+            rb.role_ref = t.RoleRef(kind="ClusterRole", name="pod-reader")
+            admin.clusterrolebindings.create(rb, "")
+
+            alice = Clientset(master.url, token="idp-tok-1")
+            pods, _ = alice.pods.list(namespace="default")  # allowed
+            assert pods == []
+            try:
+                alice.pods.create(__import__(
+                    "tests.helpers", fromlist=["make_tpu_pod"]
+                ).make_tpu_pod("nope"))
+                raise AssertionError("create should be denied")
+            except ApiError:
+                pass
+            alice.close()
+
+            # an unknown token is rejected outright
+            mallory = Clientset(master.url, token="bogus")
+            try:
+                mallory.pods.list(namespace="default")
+                raise AssertionError("bogus token should 401/403")
+            except ApiError:
+                pass
+            mallory.close()
+        finally:
+            admin.close()
+            master.stop()
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_webhook_result_cached(self):
+        import itertools
+
+        from kubernetes1_tpu.apiserver.auth import WebhookTokenAuthenticator
+
+        calls = []
+
+        class _CountingAuth(WebhookTokenAuthenticator):
+            def __init__(self, url):
+                clock = itertools.count()
+                super().__init__(url, cache_ttl=1000.0,
+                                 clock=lambda: next(clock))
+
+        httpd, url = self._idp({"tok": "bob"})
+        try:
+            a = _CountingAuth(url)
+            import urllib.request as _ur
+
+            real = _ur.urlopen
+
+            def counted(*args, **kw):
+                calls.append(1)
+                return real(*args, **kw)
+
+            _ur.urlopen = counted
+            try:
+                assert a.authenticate("tok").name == "bob"
+                assert a.authenticate("tok").name == "bob"
+            finally:
+                _ur.urlopen = real
+            assert len(calls) == 1  # second hit served from cache
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

@@ -120,3 +120,73 @@ def test_wait_for_delete(cluster):
     out = run_cli(cluster, "wait", "pods/wait-pod", "--for", "delete", "--timeout", "20")
     assert "condition met" in out
     cli.cs.close()
+
+
+def test_patch_verb(cluster, tmp_path):
+    manifest = {
+        "kind": "ConfigMap", "apiVersion": "v1",
+        "metadata": {"name": "patch-me"},
+        "data": {"a": "1"},
+    }
+    f = tmp_path / "cm.yaml"
+    f.write_text(yaml.safe_dump(manifest))
+    run_cli(cluster, "apply", "-f", str(f))
+    out = run_cli(cluster, "patch", "configmap", "patch-me",
+                  "-p", '{"data":{"b":"2"}}')
+    assert "patched" in out
+    got = json.loads(run_cli(cluster, "get", "configmaps", "patch-me",
+                             "-o", "json"))
+    assert got["data"] == {"a": "1", "b": "2"}
+
+
+def test_label_and_annotate(cluster, tmp_path):
+    manifest = {
+        "kind": "ConfigMap", "apiVersion": "v1",
+        "metadata": {"name": "label-me"},
+    }
+    f = tmp_path / "cm.yaml"
+    f.write_text(yaml.safe_dump(manifest))
+    run_cli(cluster, "apply", "-f", str(f))
+    run_cli(cluster, "label", "configmap", "label-me", "tier=web")
+    got = json.loads(run_cli(cluster, "get", "configmaps", "label-me",
+                             "-o", "json"))
+    assert got["metadata"]["labels"] == {"tier": "web"}
+
+    # changing without --overwrite refuses
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        run_cli(cluster, "label", "configmap", "label-me", "tier=db")
+    run_cli(cluster, "label", "configmap", "label-me", "tier=db",
+            "--overwrite")
+    # key- removes
+    run_cli(cluster, "label", "configmap", "label-me", "tier-")
+    got = json.loads(run_cli(cluster, "get", "configmaps", "label-me",
+                             "-o", "json"))
+    assert not (got["metadata"].get("labels") or {})
+
+    run_cli(cluster, "annotate", "configmap", "label-me", "note=hi")
+    got = json.loads(run_cli(cluster, "get", "configmaps", "label-me",
+                             "-o", "json"))
+    assert got["metadata"]["annotations"]["note"] == "hi"
+
+
+def test_edit_verb(cluster, tmp_path, monkeypatch):
+    manifest = {
+        "kind": "ConfigMap", "apiVersion": "v1",
+        "metadata": {"name": "edit-me"},
+        "data": {"k": "v0"},
+    }
+    f = tmp_path / "cm.yaml"
+    f.write_text(yaml.safe_dump(manifest))
+    run_cli(cluster, "apply", "-f", str(f))
+    # EDITOR = a script that rewrites v0 -> v1 in place
+    editor = tmp_path / "editor.sh"
+    editor.write_text("#!/bin/sh\nsed -i 's/v0/v1/' \"$1\"\n")
+    editor.chmod(0o755)
+    monkeypatch.setenv("EDITOR", str(editor))
+    out = run_cli(cluster, "edit", "configmap", "edit-me")
+    assert "edited" in out
+    got = json.loads(run_cli(cluster, "get", "configmaps", "edit-me",
+                             "-o", "json"))
+    assert got["data"]["k"] == "v1"

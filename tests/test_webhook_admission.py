@@ -1,0 +1,193 @@
+"""Dynamic admission webhooks (ref: plugin/pkg/admission/webhook +
+admissionregistration): mutating patch application, validating denial,
+failurePolicy semantics, and self-exemption."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import ApiError
+
+
+class _WebhookServer:
+    """Scriptable admission webhook endpoint."""
+
+    def __init__(self, handler_fn):
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                review = json.loads(self.rfile.read(n))
+                outer.requests.append(review)
+                body = json.dumps({"response": handler_fn(review)}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.requests = []
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}/admit"
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def env():
+    master = Master().start()
+    cs = Clientset(master.url)
+    yield master, cs
+    cs.close()
+    master.stop()
+
+
+def make_pod(name, labels=None):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.labels = labels or {}
+    pod.spec.containers = [t.Container(name="c", image="img",
+                                       command=["sleep", "1"])]
+    return pod
+
+
+def webhook_config(kind_cls, name, url, resources=("pods",),
+                   failure_policy="Fail"):
+    cfg = kind_cls()
+    cfg.metadata.name = name
+    cfg.webhooks = [t.Webhook(
+        name=f"{name}.test.ktpu.io", url=url,
+        rules=[t.WebhookRule(operations=["CREATE", "UPDATE"],
+                             resources=list(resources))],
+        failure_policy=failure_policy,
+    )]
+    return cfg
+
+
+class TestValidatingWebhook:
+    def test_denies_matching_request(self, env):
+        _, cs = env
+
+        def handler(review):
+            labels = (review["request"]["object"]["metadata"].get("labels")
+                      or {})
+            if labels.get("forbidden") == "true":
+                return {"allowed": False,
+                        "status": {"message": "forbidden label"}}
+            return {"allowed": True}
+
+        wh = _WebhookServer(handler)
+        try:
+            cs.resource("validatingwebhookconfigurations").create(
+                webhook_config(t.ValidatingWebhookConfiguration,
+                               "deny-label", wh.url))
+            with pytest.raises(ApiError) as e:
+                cs.pods.create(make_pod("bad", labels={"forbidden": "true"}))
+            assert "forbidden label" in str(e.value)
+            cs.pods.create(make_pod("good"))
+            assert wh.requests  # the webhook actually saw the requests
+        finally:
+            wh.stop()
+
+    def test_failure_policy_fail_rejects_on_dead_url(self, env):
+        _, cs = env
+        cs.resource("validatingwebhookconfigurations").create(
+            webhook_config(t.ValidatingWebhookConfiguration, "dead",
+                           "http://127.0.0.1:9/admit",
+                           failure_policy="Fail"))
+        with pytest.raises(ApiError):
+            cs.pods.create(make_pod("p1"))
+
+    def test_failure_policy_ignore_skips_dead_url(self, env):
+        _, cs = env
+        cs.resource("validatingwebhookconfigurations").create(
+            webhook_config(t.ValidatingWebhookConfiguration, "dead-ok",
+                           "http://127.0.0.1:9/admit",
+                           failure_policy="Ignore"))
+        cs.pods.create(make_pod("p2"))  # must succeed
+
+    def test_non_matching_resource_not_called(self, env):
+        _, cs = env
+        wh = _WebhookServer(lambda review: {"allowed": False})
+        try:
+            cs.resource("validatingwebhookconfigurations").create(
+                webhook_config(t.ValidatingWebhookConfiguration,
+                               "pods-only", wh.url, resources=("pods",)))
+            cm = t.ConfigMap()
+            cm.metadata.name = "untouched"
+            cs.configmaps.create(cm)  # not a pod: webhook must not fire
+            assert not wh.requests
+        finally:
+            wh.stop()
+
+
+class TestMutatingWebhook:
+    def test_patch_applied(self, env):
+        _, cs = env
+
+        def handler(review):
+            return {"allowed": True,
+                    "patch": {"metadata": {"annotations":
+                                           {"injected": "yes"}}}}
+
+        wh = _WebhookServer(handler)
+        try:
+            cs.resource("mutatingwebhookconfigurations").create(
+                webhook_config(t.MutatingWebhookConfiguration,
+                               "inject", wh.url))
+            created = cs.pods.create(make_pod("mutated"))
+            assert created.metadata.annotations.get("injected") == "yes"
+        finally:
+            wh.stop()
+
+    def test_webhook_configs_exempt_from_webhooks(self, env):
+        """A deny-all validating webhook must not block webhook-config
+        management itself (self-lockout prevention)."""
+        _, cs = env
+        wh = _WebhookServer(lambda review: {"allowed": False})
+        try:
+            cs.resource("validatingwebhookconfigurations").create(
+                webhook_config(t.ValidatingWebhookConfiguration,
+                               "deny-all", wh.url, resources=("*",)))
+            # still able to create/delete webhook configurations
+            cs.resource("mutatingwebhookconfigurations").create(
+                webhook_config(t.MutatingWebhookConfiguration,
+                               "escape-hatch", wh.url))
+            cs.resource("validatingwebhookconfigurations").delete(
+                "deny-all", "")
+            cs.resource("mutatingwebhookconfigurations").delete(
+                "escape-hatch", "")
+            cs.pods.create(make_pod("after-removal"))
+        finally:
+            wh.stop()
+
+    def test_user_info_forwarded(self, env):
+        _, cs = env
+        seen = {}
+
+        def handler(review):
+            seen.update(review["request"].get("userInfo") or {})
+            return {"allowed": True}
+
+        wh = _WebhookServer(handler)
+        try:
+            cs.resource("validatingwebhookconfigurations").create(
+                webhook_config(t.ValidatingWebhookConfiguration,
+                               "peek", wh.url))
+            cs.pods.create(make_pod("who"))
+            assert "username" in seen
+        finally:
+            wh.stop()
