@@ -47,4 +47,67 @@ _REGISTRY = [
 for cls, plural, namespaced in _REGISTRY:
     global_scheme.register(cls, plural, namespaced)
 
+
+# ---- multi-version serving (ref: runtime.Scheme conversion funcs;
+# the reference serves Deployment at both extensions/v1beta1 and apps/*,
+# with generated Convert_* functions between versions and the internal
+# hub form — staging/src/k8s.io/api has both trees).
+
+
+def _deployment_v1beta1_from_internal(d: dict) -> dict:
+    """apps/v1 (hub) -> extensions/v1beta1: same shape; v1beta1 never
+    requires a selector, so one defaulted from the template labels is
+    elided on the way out."""
+    out = dict(d)
+    spec = dict(out.get("spec") or {})
+    tmpl_labels = (((spec.get("template") or {}).get("metadata") or {})
+                   .get("labels") or {})
+    sel = spec.get("selector") or {}
+    # elide ONLY a pure matchLabels selector equal to the template labels —
+    # a selector carrying matchExpressions must round-trip intact
+    if set(sel.keys()) == {"matchLabels"} and sel["matchLabels"] == tmpl_labels:
+        spec.pop("selector", None)
+    out["spec"] = spec
+    return out
+
+
+def _deployment_v1beta1_to_internal(d: dict) -> dict:
+    """extensions/v1beta1 -> apps/v1 (hub): default the optional selector
+    from template labels (v1beta1 semantics) and drop rollbackTo (the
+    deprecated imperative rollback field has no internal representation)."""
+    out = dict(d)
+    out["apiVersion"] = t.Deployment.API_VERSION
+    spec = dict(out.get("spec") or {})
+    spec.pop("rollbackTo", None)
+    # v1beta1 defaulting applies only when the selector is entirely unset —
+    # a matchExpressions-only selector is a real selector, not an absence
+    if not spec.get("selector"):
+        tmpl_labels = (((spec.get("template") or {}).get("metadata") or {})
+                       .get("labels") or {})
+        if tmpl_labels:
+            spec["selector"] = {"matchLabels": dict(tmpl_labels)}
+    out["spec"] = spec
+    return out
+
+
+def _identity_version(to_version: str):
+    def from_internal(d: dict) -> dict:
+        return dict(d)
+
+    def to_internal(d: dict, _hub=to_version) -> dict:
+        out = dict(d)
+        out["apiVersion"] = _hub
+        return out
+
+    return from_internal, to_internal
+
+
+global_scheme.register_conversion(
+    "Deployment", "extensions/v1beta1",
+    _deployment_v1beta1_from_internal, _deployment_v1beta1_to_internal)
+# batch/v1beta1 CronJob is shape-identical to the hub version (as in 1.9,
+# where v1beta1 vs v2alpha1 differ only in defaults we don't carry)
+_cj_from, _cj_to = _identity_version(t.CronJob.API_VERSION)
+global_scheme.register_conversion("CronJob", "batch/v1beta1", _cj_from, _cj_to)
+
 scheme = global_scheme

@@ -208,6 +208,12 @@ class _Handler(BaseHTTPRequestHandler):
         """
         if not parts or parts[0] not in ("api", "apis"):
             raise NotFound(f"unknown path {self.path}")
+        # requested group/version drives response conversion (multi-version
+        # serving, ref: runtime.Scheme conversion + negotiated serializers)
+        if parts[0] == "api":
+            self._req_version = parts[1] if len(parts) > 1 else "v1"
+        else:
+            self._req_version = "/".join(parts[1:3]) if len(parts) > 2 else ""
         rest = parts[2:] if parts[0] == "api" else parts[3:]
         if not rest:
             raise NotFound("missing resource")
@@ -331,6 +337,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._handle("DELETE")
 
+    def _enc(self, obj):
+        """Encode a response object in the REQUESTED API version when a
+        conversion is registered (multi-version serving); the internal/hub
+        form otherwise."""
+        return self.master.scheme.encode(
+            obj, version=getattr(self, "_req_version", ""))
+
     def _with_quota_serialization(self, resource: str, ns: str, write_fn):
         """Quota-counted writes serialize admission-check + commit so two
         concurrent writes cannot both pass a nearly-exhausted quota
@@ -350,7 +363,7 @@ class _Handler(BaseHTTPRequestHandler):
         reg = self.master.registry
         if name and not sub:
             obj = reg.get(resource, ns, name)
-            self._send_json(200, self.master.scheme.encode(obj))
+            self._send_json(200, self._enc(obj))
             return
         if resource == "pods" and sub == "log":
             self._proxy_pod_log(ns, name, q)
@@ -376,7 +389,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "kind": kind,
                 "apiVersion": "v1",
                 "metadata": {"resourceVersion": str(rev)},
-                "items": [self.master.scheme.encode(o) for o in items],
+                "items": [self._enc(o) for o in items],
             },
         )
 
@@ -494,8 +507,11 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if not w.event_matches(ev.object):
                     continue
+                # watch frames honor the requested version like every verb
+                obj = self.master.scheme.convert_dict(
+                    ev.object, getattr(self, "_req_version", ""))
                 frame = json.dumps(
-                    {"type": ev.type, "object": ev.object}, separators=(",", ":")
+                    {"type": ev.type, "object": obj}, separators=(",", ":")
                 ).encode() + b"\n"
                 self._write_chunk(frame)
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
@@ -532,7 +548,7 @@ class _Handler(BaseHTTPRequestHandler):
             binding = self.master.scheme.decode(body)
             pod = reg.bind(ns, name, binding)
             self.master.audit("bind", resource, ns, name, self._user.name)
-            self._send_json(201, self.master.scheme.encode(pod))
+            self._send_json(201, self._enc(pod))
             return
         if resource == "pods" and sub == "eviction":
             eviction = None
@@ -546,7 +562,7 @@ class _Handler(BaseHTTPRequestHandler):
                     eviction = decoded
             evicted = reg.evict(ns, name, eviction)
             self.master.audit("evict", resource, ns, name, self._user.name)
-            self._send_json(201, self.master.scheme.encode(evicted))
+            self._send_json(201, self._enc(evicted))
             return
         if sub:
             raise NotFound(f"subresource {sub!r} not writable")
@@ -570,7 +586,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.master.apply_crd(created)
         elif resource == "apiservices":
             self.master.apply_apiservice(created)
-        self._send_json(201, self.master.scheme.encode(created))
+        self._send_json(201, self._enc(created))
 
     # ------------------------------------------------------------------ PUT
 
@@ -603,7 +619,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.master.remove_apiservice(old)
                 self.master.apply_apiservice(updated)
         self.master.audit("update", resource, ns, name, self._user.name)
-        self._send_json(200, self.master.scheme.encode(updated))
+        self._send_json(200, self._enc(updated))
 
     # ---------------------------------------------------------------- PATCH
 
@@ -631,7 +647,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.master.remove_apiservice(old)
             self.master.apply_apiservice(updated)
         self.master.audit("patch", resource, ns, name, self._user.name)
-        self._send_json(200, self.master.scheme.encode(updated))
+        self._send_json(200, self._enc(updated))
 
     # --------------------------------------------------------------- DELETE
 
@@ -647,7 +663,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.master.remove_crd(obj)
         elif resource == "apiservices":
             self.master.remove_apiservice(obj)
-        self._send_json(200, self.master.scheme.encode(obj))
+        self._send_json(200, self._enc(obj))
 
 
 class Metrics:

@@ -159,6 +159,8 @@ class Scheme:
         self.namespaced: Dict[str, bool] = {}  # plural -> bool
         self.dynamic_kinds: Dict[str, str] = {}  # kind -> apiVersion
         self.dynamic_resources: Dict[str, str] = {}  # plural -> kind
+        # (kind, apiVersion) -> (from_internal, to_internal) dict converters
+        self.conversions: Dict[tuple, tuple] = {}
 
     def register(self, cls: Type, plural: Optional[str] = None, namespaced: bool = True):
         kind = cls.KIND or cls.__name__
@@ -180,6 +182,7 @@ class Scheme:
         s.namespaced = dict(self.namespaced)
         s.dynamic_kinds = dict(self.dynamic_kinds)
         s.dynamic_resources = dict(self.dynamic_resources)
+        s.conversions = dict(self.conversions)
         return s
 
     def register_dynamic(self, kind: str, plural: str, api_version: str,
@@ -200,7 +203,23 @@ class Scheme:
         self.by_resource.pop(plural, None)
         self.namespaced.pop(plural, None)
 
-    def encode(self, obj: Any) -> Dict[str, Any]:
+    def register_conversion(self, kind: str, api_version: str,
+                            from_internal, to_internal):
+        """Serve `kind` additionally at `api_version` (ref: runtime.Scheme
+        conversion funcs; the dataclass wire form is the hub/internal
+        version).  `from_internal(dict) -> dict` produces the versioned
+        wire form; `to_internal(dict) -> dict` the reverse.  Both operate
+        on plain JSON dicts, mirroring the reference's generated
+        Convert_v1beta1_X_To_internal_X functions."""
+        self.conversions[(kind, api_version)] = (from_internal, to_internal)
+
+    def served_versions(self, kind: str) -> list:
+        cls = self.by_kind.get(kind)
+        out = [cls.API_VERSION] if cls is not None else []
+        out += [v for (k, v) in self.conversions if k == kind]
+        return out
+
+    def encode(self, obj: Any, version: str = "") -> Dict[str, Any]:
         if isinstance(obj, Unstructured):
             d = dict(obj.content)
             d["metadata"] = to_dict(obj.metadata)
@@ -210,7 +229,20 @@ class Scheme:
         d = to_dict(obj)
         d["kind"] = type(obj).KIND or type(obj).__name__
         d["apiVersion"] = type(obj).API_VERSION
-        return d
+        return self.convert_dict(d, version) if version else d
+
+    def convert_dict(self, d: Dict[str, Any], version: str) -> Dict[str, Any]:
+        """Convert an internal-form wire dict to `version` when a conversion
+        is registered (used for both single objects and watch frames)."""
+        kind = d.get("kind", "")
+        if not version or not kind or version == d.get("apiVersion"):
+            return d
+        conv = self.conversions.get((kind, version))
+        if conv is None:
+            return d
+        out = conv[0](d)
+        out["kind"], out["apiVersion"] = kind, version
+        return out
 
     def encode_json(self, obj: Any) -> str:
         return json.dumps(self.encode(obj), separators=(",", ":"))
@@ -219,6 +251,11 @@ class Scheme:
         from .meta import ObjectMeta
 
         kind = data.get("kind", "")
+        ver = data.get("apiVersion", "")
+        conv = self.conversions.get((kind, ver))
+        if conv is not None:
+            data = dict(conv[1](data))
+            data["kind"] = kind  # converter output: internal wire form
         cls = self.by_kind.get(kind)
         if cls is None or cls is Unstructured:
             # unknown or dynamic kind -> Unstructured passthrough (the

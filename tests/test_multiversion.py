@@ -1,0 +1,158 @@
+"""Multi-version API serving + conversion (ref: runtime.Scheme conversion;
+the reference serves Deployment at extensions/v1beta1 AND apps/* with
+generated Convert_* funcs; SURVEY L1 'Scheme (convert/default/serialize)')."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery.scheme import global_scheme
+
+
+@pytest.fixture
+def env():
+    master = Master().start()
+    cs = Clientset(master.url)
+    yield master, cs
+    cs.close()
+    master.stop()
+
+
+def _req(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+V1BETA1_DEPLOY = {
+    "kind": "Deployment", "apiVersion": "extensions/v1beta1",
+    "metadata": {"name": "legacy", "namespace": "default"},
+    "spec": {
+        # no selector: v1beta1 defaults it from template labels
+        "replicas": 2,
+        "rollbackTo": {"revision": 3},  # deprecated field: accepted, dropped
+        "template": {
+            "metadata": {"labels": {"app": "legacy"}},
+            "spec": {"containers": [{"name": "c", "image": "img",
+                                     "command": ["sleep", "60"]}]},
+        },
+    },
+}
+
+
+class TestServedVersions:
+    def test_scheme_lists_versions(self):
+        assert set(global_scheme.served_versions("Deployment")) == {
+            "apps/v1", "extensions/v1beta1"}
+
+    def test_create_via_v1beta1_reads_back_converted(self, env):
+        master, cs = env
+        out = _req(f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+                   f"/deployments", "POST", V1BETA1_DEPLOY)
+        # response comes back in the REQUESTED version
+        assert out["apiVersion"] == "extensions/v1beta1"
+        # internally it is the hub version with the selector defaulted
+        internal = cs.deployments.get("legacy")
+        assert internal.API_VERSION == "apps/v1"
+        assert internal.spec.selector.match_labels == {"app": "legacy"}
+        assert internal.spec.replicas == 2
+
+    def test_hub_read_at_both_versions(self, env):
+        master, cs = env
+        _req(f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+             f"/deployments", "POST", V1BETA1_DEPLOY)
+        hub = _req(f"{master.url}/apis/apps/v1/namespaces/default"
+                   f"/deployments/legacy")
+        assert hub["apiVersion"] == "apps/v1"
+        assert hub["spec"]["selector"]["matchLabels"] == {"app": "legacy"}
+        legacy = _req(f"{master.url}/apis/extensions/v1beta1/namespaces"
+                      f"/default/deployments/legacy")
+        assert legacy["apiVersion"] == "extensions/v1beta1"
+        # round-trip elides the defaulted selector on the way out
+        assert "selector" not in legacy["spec"]
+
+    def test_cronjob_v1beta1_alias(self, env):
+        master, cs = env
+        body = {
+            "kind": "CronJob", "apiVersion": "batch/v1beta1",
+            "metadata": {"name": "nightly", "namespace": "default"},
+            "spec": {"schedule": "0 3 * * *", "suspend": True,
+                     "jobTemplate": {"spec": {"template": {"spec": {
+                         "containers": [{"name": "c", "image": "i",
+                                         "command": ["true"]}]}}}}},
+        }
+        out = _req(f"{master.url}/apis/batch/v1beta1/namespaces/default"
+                   f"/cronjobs", "POST", body)
+        assert out["apiVersion"] == "batch/v1beta1"
+        assert cs.cronjobs.get("nightly").spec.schedule == "0 3 * * *"
+
+    def test_explicit_selector_preserved(self, env):
+        master, _ = env
+        body = json.loads(json.dumps(V1BETA1_DEPLOY))
+        body["metadata"]["name"] = "explicit"
+        body["spec"]["selector"] = {"matchLabels": {"app": "legacy",
+                                                    "tier": "x"}}
+        body["spec"]["template"]["metadata"]["labels"] = {
+            "app": "legacy", "tier": "x"}
+        _req(f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+             f"/deployments", "POST", body)
+        hub = _req(f"{master.url}/apis/apps/v1/namespaces/default"
+                   f"/deployments/explicit")
+        assert hub["spec"]["selector"]["matchLabels"] == {
+            "app": "legacy", "tier": "x"}
+
+
+class TestConversionEdgeCases:
+    def test_match_expressions_selector_round_trips(self, env):
+        """A matchExpressions selector must never be replaced or elided by
+        v1beta1 selector defaulting."""
+        master, cs = env
+        body = json.loads(json.dumps(V1BETA1_DEPLOY))
+        body["metadata"]["name"] = "expr"
+        body["spec"]["selector"] = {
+            "matchExpressions": [{"key": "app", "operator": "In",
+                                  "values": ["legacy"]}]}
+        _req(f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+             f"/deployments", "POST", body)
+        hub = _req(f"{master.url}/apis/apps/v1/namespaces/default"
+                   f"/deployments/expr")
+        assert hub["spec"]["selector"].get("matchExpressions")
+        assert "matchLabels" not in hub["spec"]["selector"]
+        legacy = _req(f"{master.url}/apis/extensions/v1beta1/namespaces"
+                      f"/default/deployments/expr")
+        assert legacy["spec"]["selector"].get("matchExpressions")
+
+    def test_watch_frames_in_requested_version(self, env):
+        import threading
+        import urllib.request as _ur
+
+        master, _ = env
+        frames = []
+
+        def watcher():
+            req = _ur.Request(
+                f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+                f"/deployments?watch=1&timeoutSeconds=5")
+            with _ur.urlopen(req) as r:
+                for line in r:
+                    line = line.strip()
+                    if line:
+                        frames.append(json.loads(line))
+                        return
+
+        th = threading.Thread(target=watcher, daemon=True)
+        th.start()
+        import time as _t
+
+        _t.sleep(0.3)
+        _req(f"{master.url}/apis/extensions/v1beta1/namespaces/default"
+             f"/deployments", "POST", V1BETA1_DEPLOY)
+        th.join(timeout=10)
+        assert frames and frames[0]["object"]["apiVersion"] == \
+            "extensions/v1beta1"
