@@ -1,0 +1,50 @@
+"""hyperkube analog: every component binary behind one entrypoint.
+
+Ref: cmd/hyperkube — `hyperkube kube-apiserver ...` dispatches to the
+named component's main.  Here:
+
+    python -m kubernetes1_tpu apiserver --port 6443
+    python -m kubernetes1_tpu scheduler --server ...
+    python -m kubernetes1_tpu controller-manager --server ...
+    python -m kubernetes1_tpu kubelet --server ...
+    python -m kubernetes1_tpu ktpu get pods
+"""
+
+from __future__ import annotations
+
+import sys
+
+COMPONENTS = {
+    "apiserver": "kubernetes1_tpu.apiserver.__main__",
+    "scheduler": "kubernetes1_tpu.scheduler.__main__",
+    "controller-manager": "kubernetes1_tpu.controllers.__main__",
+    "controllers": "kubernetes1_tpu.controllers.__main__",
+    "kubelet": "kubernetes1_tpu.kubelet.__main__",
+    "ktpu": "kubernetes1_tpu.cli",  # cli's main lives in the package
+    "cli": "kubernetes1_tpu.cli",
+}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        names = ", ".join(sorted(set(COMPONENTS)))
+        print(f"usage: python -m kubernetes1_tpu <component> [args...]\n"
+              f"components: {names}")
+        return 0 if argv else 2
+    name, rest = argv[0], argv[1:]
+    mod_name = COMPONENTS.get(name)
+    if mod_name is None:
+        print(f"error: unknown component {name!r} "
+              f"(have {', '.join(sorted(set(COMPONENTS)))})", file=sys.stderr)
+        return 2
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    sys.argv = [f"ktpu-{name}"] + rest
+    result = mod.main()
+    return 0 if result is None else result
+
+
+if __name__ == "__main__":
+    sys.exit(main())

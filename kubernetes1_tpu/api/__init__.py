@@ -33,6 +33,7 @@ _REGISTRY = [
     (t.PersistentVolumeClaim, "persistentvolumeclaims", True),
     (t.CertificateSigningRequest, "certificatesigningrequests", False),
     (t.CustomResourceDefinition, "customresourcedefinitions", False),
+    (t.PodPreset, "podpresets", True),
     (t.MutatingWebhookConfiguration, "mutatingwebhookconfigurations", False),
     (t.ValidatingWebhookConfiguration, "validatingwebhookconfigurations", False),
     (t.APIService, "apiservices", False),

@@ -1279,6 +1279,30 @@ class NodeMetrics(KObject):
 
 
 @dataclass
+class PodPresetSpec:
+    """Ref: settings.k8s.io/v1alpha1 PodPresetSpec — what to inject into
+    pods matching the selector (env, envFrom, volumes, volumeMounts)."""
+
+    selector: Optional[LabelSelector] = None
+    env: List[EnvVar] = field(default_factory=list)
+    env_from: List[EnvFromSource] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+
+
+@dataclass
+class PodPreset(KObject):
+    """Ref: staging settings.k8s.io PodPreset + the PodPreset admission
+    plugin (1.9 alpha) — declarative injection of config into pods at
+    admission time; TPU use: one preset gives every training pod the
+    checkpoint volume + coordinator env without touching Job templates."""
+
+    KIND = "PodPreset"
+    API_VERSION = "settings/v1alpha1"
+    spec: PodPresetSpec = field(default_factory=PodPresetSpec)
+
+
+@dataclass
 class WebhookRule:
     """Which (operations x resources) a webhook intercepts (ref:
     admissionregistration/v1beta1 RuleWithOperations)."""

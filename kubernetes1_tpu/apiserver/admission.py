@@ -479,6 +479,102 @@ class EventRateLimit(AdmissionPlugin):
         self._buckets[src] = (tokens - 1.0, now)
 
 
+class PodPresetAdmission(AdmissionPlugin):
+    """Inject env/envFrom/volumes/volumeMounts from matching PodPresets
+    (ref: plugin/pkg/admission/podpreset/admission.go, settings.k8s.io).
+
+    Conflict semantics follow the reference: if a preset's env or mounts
+    collide with values already on the pod (same name, different value),
+    that preset is skipped entirely and the pod is annotated with the
+    conflict — partial injection would be worse than none."""
+
+    name = "PodPreset"
+    EXCLUDE_ANNOTATION = "podpreset.admission.ktpu.io/exclude"
+
+    def __init__(self, list_presets):
+        self._list_presets = list_presets  # (namespace) -> [PodPreset]
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        ann = obj.metadata.annotations or {}
+        if ann.get(self.EXCLUDE_ANNOTATION) == "true":
+            return
+        from ..machinery.labels import label_selector_matches
+
+        # an ABSENT selector on a PodPreset means match-all (settings
+        # v1alpha1's non-pointer empty selector), unlike the controllers'
+        # nil-selects-nothing contract — check before the shared matcher
+        def _matches(preset) -> bool:
+            sel = preset.spec.selector
+            if sel is None or (not sel.match_labels
+                               and not sel.match_expressions):
+                return True
+            return label_selector_matches(sel, obj.metadata.labels or {})
+
+        presets = [
+            p for p in self._list_presets(obj.metadata.namespace or "default")
+            if _matches(p)
+        ]
+        for preset in sorted(presets, key=lambda p: p.metadata.name):
+            conflict = self._find_conflict(obj, preset)
+            if conflict:
+                obj.metadata.annotations = dict(ann)
+                obj.metadata.annotations[
+                    f"podpreset.admission.ktpu.io/conflict-{preset.metadata.name}"
+                ] = conflict
+                ann = obj.metadata.annotations
+                continue
+            self._apply(obj, preset)
+            obj.metadata.annotations = dict(ann)
+            obj.metadata.annotations[
+                f"podpreset.admission.ktpu.io/podpreset-{preset.metadata.name}"
+            ] = preset.metadata.resource_version or "0"
+            ann = obj.metadata.annotations
+
+    @staticmethod
+    def _find_conflict(pod, preset) -> str:
+        for c in pod.spec.containers:
+            have = {e.name: e.value for e in c.env}
+            for e in preset.spec.env:
+                if e.name in have and have[e.name] != e.value:
+                    return f"env {e.name!r} differs on container {c.name!r}"
+            mounts = {m.name: m.mount_path for m in c.volume_mounts}
+            for m in preset.spec.volume_mounts:
+                if m.name in mounts and mounts[m.name] != m.mount_path:
+                    return (f"volumeMount {m.name!r} differs on "
+                            f"container {c.name!r}")
+        from ..machinery.scheme import to_dict
+
+        by_name = {v.name: v for v in pod.spec.volumes}
+        for v in preset.spec.volumes:
+            existing = by_name.get(v.name)
+            # same name is fine only if it's literally the same source
+            if existing is not None and to_dict(existing) != to_dict(v):
+                return f"volume {v.name!r} differs"
+        return ""
+
+    @staticmethod
+    def _apply(pod, preset):
+        from ..machinery.scheme import global_scheme
+
+        for c in pod.spec.containers:
+            have_env = {e.name for e in c.env}
+            c.env = list(c.env) + [
+                global_scheme.deepcopy(e) for e in preset.spec.env
+                if e.name not in have_env]
+            c.env_from = list(c.env_from) + [
+                global_scheme.deepcopy(e) for e in preset.spec.env_from]
+            have_mounts = {m.name for m in c.volume_mounts}
+            c.volume_mounts = list(c.volume_mounts) + [
+                global_scheme.deepcopy(m) for m in preset.spec.volume_mounts
+                if m.name not in have_mounts]
+        have_vols = {v.name for v in pod.spec.volumes}
+        pod.spec.volumes = list(pod.spec.volumes) + [
+            global_scheme.deepcopy(v) for v in preset.spec.volumes
+            if v.name not in have_vols]
+
+
 class _WebhookAdmission(AdmissionPlugin):
     """Dynamic admission via HTTP callout (ref: plugin/pkg/admission/webhook
     + admissionregistration).  POSTs an AdmissionReview-shaped JSON body:

@@ -43,6 +43,7 @@ from .admission import (
     NamespaceAutoProvision,
     NodeRestriction,
     PodNodeSelector,
+    PodPresetAdmission,
     PriorityResolver,
     ResourceQuotaAdmission,
     ResourceV2,
@@ -383,13 +384,18 @@ class _Handler(BaseHTTPRequestHandler):
             field_selector=q.get("fieldSelector", ""),
         )
         kind = self.master.scheme.by_resource[resource].KIND + "List"
+        encoded = [self._enc(o) for o in items]
+        # the List envelope carries the version the items are encoded in —
+        # envelope/items disagreement breaks version-trusting decoders
+        list_version = (encoded[0]["apiVersion"] if encoded
+                        else getattr(self, "_req_version", "") or "v1")
         self._send_json(
             200,
             {
                 "kind": kind,
-                "apiVersion": "v1",
+                "apiVersion": list_version,
                 "metadata": {"resourceVersion": str(rev)},
-                "items": [self._enc(o) for o in items],
+                "items": encoded,
             },
         )
 
@@ -769,6 +775,7 @@ class Master:
             ResourceV2(),
             GangDefaulter(),
             ServiceAccountAdmission(),
+            PodPresetAdmission(self._list_podpresets),
             IdentityStamp(),
             # dynamic admission: mutating webhooks run after the built-in
             # mutators (they see the rewritten object) and before the
@@ -797,6 +804,10 @@ class Master:
 
     def _get_priority_class(self, name: str):
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
+
+    def _list_podpresets(self, namespace: str):
+        items, _ = self.store.list(self.registry.prefix("podpresets", namespace))
+        return items
 
     def _list_webhook_configs(self, resource: str):
         """Webhook configs for the admission chain, cached ~1s: admission

@@ -254,29 +254,31 @@ class IPVSProxier:
 
     # ----------------------------------------------------------------- sync
 
-    def _endpoints_for(self, ns: str, name: str, port_name: str):
-        for ep in self.endpoints.list():
-            if ep.metadata.namespace != ns or ep.metadata.name != name:
+    @staticmethod
+    def _subset_backends(ep, port_name: str):
+        out = []
+        for subset in ep.subsets:
+            port = None
+            for p in subset.ports:
+                if not port_name or p.name == port_name:
+                    port = p.port
+                    break
+            if port is None and subset.ports:
+                # single-unnamed-port fallback, matching rules.py /
+                # proxier.py: a named service port still routes to a
+                # subset whose lone port carries no name
+                port = subset.ports[0].port
+            if port is None:
                 continue
-            out = []
-            for subset in ep.subsets:
-                port = None
-                for p in subset.ports:
-                    if not port_name or p.name == port_name:
-                        port = p.port
-                        break
-                if port is None and subset.ports:
-                    # single-unnamed-port fallback, matching rules.py /
-                    # proxier.py: a named service port still routes to a
-                    # subset whose lone port carries no name
-                    port = subset.ports[0].port
-                if port is None:
-                    continue
-                out.extend((a.ip, port) for a in subset.addresses)
-            return out
-        return []
+            out.extend((a.ip, port) for a in subset.addresses)
+        return out
 
     def _sync(self):
+        # one pass over the endpoints informer: per-port lookups below are
+        # O(1), not a rescan of every Endpoints object (O(svc x eps) sync
+        # would also stall resolve() behind the lock on big clusters)
+        eps_by_key = {(ep.metadata.namespace, ep.metadata.name): ep
+                      for ep in self.endpoints.list()}
         wanted = {}
         for svc in self.services.list():
             if not svc.spec.cluster_ip or svc.spec.cluster_ip == "None":
@@ -293,8 +295,9 @@ class IPVSProxier:
                 if vs is None:
                     vs = VirtualServer(self.listen_host, 0, self.scheduler)
                     self._virtuals[key] = vs
-                backends = self._endpoints_for(*key)
-                vs.set_backends(backends)
+                ep = eps_by_key.get(key[:2])
+                vs.set_backends(
+                    self._subset_backends(ep, key[2]) if ep else [])
                 self._vip_index[(svc.spec.cluster_ip, port.port)] = key
 
     # ------------------------------------------------------------- routing

@@ -21,15 +21,26 @@ def main():
     ap.add_argument("--identity", default="scheduler-0")
     ap.add_argument("--metrics-port", type=int, default=10251,
                     help="/metrics + /healthz port (0 = ephemeral, -1 = off)")
+    ap.add_argument("--policy-config-file", default="",
+                    help="scheduler policy JSON (extenders; ref "
+                         "examples/scheduler-policy-config.json)")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
+    policy = None
+    if args.policy_config_file:
+        import json
+
+        with open(args.policy_config_file) as f:
+            policy = json.load(f)
+
     cs = Clientset(args.server, token=args.token)
     sched = Scheduler(
         cs, scheduler_name=args.scheduler_name,
         metrics_port=None if args.metrics_port < 0 else args.metrics_port,
+        policy=policy,
     )
     stop = threading.Event()
 

@@ -1,0 +1,135 @@
+"""Scheduler extenders: out-of-process filter/prioritize/bind webhooks.
+
+Ref: plugin/pkg/scheduler/core/extender.go + the policy JSON that
+configures them (examples/scheduler-policy-config.json — urlPrefix,
+filterVerb, prioritizeVerb, bindVerb, weight, ignorable).  An extender
+lets a third party veto nodes (filter), add weighted scores (prioritize),
+or take over the final bind — the 1.9-era extension seam that predates
+the scheduler framework.
+
+Wire shapes mirror the reference's schedulerapi types:
+
+  POST <urlPrefix>/<filterVerb>
+    {"pod": {...}, "nodeNames": [...]}
+    -> {"nodeNames": [...], "failedNodes": {"node": "reason"}, "error": ""}
+  POST <urlPrefix>/<prioritizeVerb>
+    {"pod": {...}, "nodeNames": [...]}
+    -> [{"host": "node", "score": 0-10}, ...]
+  POST <urlPrefix>/<bindVerb>
+    {"podName","podNamespace","podUID","node"} -> {"error": ""}
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    def __init__(self, url_prefix: str, filter_verb: str = "",
+                 prioritize_verb: str = "", bind_verb: str = "",
+                 weight: int = 1, timeout: float = 5.0,
+                 ignorable: bool = False):
+        self.url_prefix = url_prefix.rstrip("/")
+        self.filter_verb = filter_verb
+        self.prioritize_verb = prioritize_verb
+        self.bind_verb = bind_verb
+        self.weight = weight
+        self.timeout = timeout
+        # ignorable (ref extender.go IsIgnorable): an unreachable extender
+        # is skipped instead of failing the scheduling attempt
+        self.ignorable = ignorable
+
+    @staticmethod
+    def from_policy(cfg: dict) -> "HTTPExtender":
+        """One entry of the policy JSON's "extenders" list."""
+        return HTTPExtender(
+            url_prefix=cfg.get("urlPrefix", ""),
+            filter_verb=cfg.get("filterVerb", ""),
+            prioritize_verb=cfg.get("prioritizeVerb", ""),
+            bind_verb=cfg.get("bindVerb", ""),
+            weight=int(cfg.get("weight", 1)),
+            timeout=float(cfg.get("httpTimeout", 5.0)),
+            ignorable=bool(cfg.get("ignorable", False)),
+        )
+
+    def _post(self, verb: str, payload: dict):
+        req = urllib.request.Request(
+            f"{self.url_prefix}/{verb}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    # ------------------------------------------------------------- filter
+
+    def filter(self, pod_doc: dict,
+               node_names: List[str]) -> Tuple[List[str], Dict[str, str]]:
+        """Returns (surviving node names, failed {node: reason}).  Raises
+        ExtenderError on callout failure unless ignorable."""
+        if not self.filter_verb:
+            return node_names, {}
+        try:
+            out = self._post(self.filter_verb,
+                             {"pod": pod_doc, "nodeNames": node_names})
+        except Exception as e:  # noqa: BLE001
+            if self.ignorable:
+                return node_names, {}
+            raise ExtenderError(f"extender {self.url_prefix} filter: {e}")
+        if out.get("error"):
+            raise ExtenderError(
+                f"extender {self.url_prefix}: {out['error']}")
+        return list(out.get("nodeNames") or []), dict(
+            out.get("failedNodes") or {})
+
+    # --------------------------------------------------------- prioritize
+
+    def prioritize(self, pod_doc: dict,
+                   node_names: List[str]) -> Dict[str, float]:
+        """{node: weighted score}; empty on ignorable failure."""
+        if not self.prioritize_verb:
+            return {}
+        try:
+            out = self._post(self.prioritize_verb,
+                             {"pod": pod_doc, "nodeNames": node_names})
+        except Exception as e:  # noqa: BLE001
+            if self.ignorable:
+                return {}
+            raise ExtenderError(
+                f"extender {self.url_prefix} prioritize: {e}")
+        return {e["host"]: float(e.get("score", 0)) * self.weight
+                for e in out if e.get("host")}
+
+    # --------------------------------------------------------------- bind
+
+    @property
+    def handles_bind(self) -> bool:
+        return bool(self.bind_verb)
+
+    def bind(self, namespace: str, name: str, uid: str, node: str):
+        """Delegate the final bind to the extender (which POSTs the Binding
+        itself, device assignments included, the way the reference's
+        extender-bind contract works).  Transport errors surface as
+        ExtenderError so the scheduler's bind failure path (forget assumed
+        pod + requeue) fires like any other failed bind."""
+        try:
+            out = self._post(self.bind_verb, {
+                "podNamespace": namespace, "podName": name,
+                "podUID": uid, "node": node})
+        except Exception as e:  # noqa: BLE001
+            raise ExtenderError(f"extender {self.url_prefix} bind: {e}")
+        if out.get("error"):
+            raise ExtenderError(
+                f"extender {self.url_prefix} bind: {out['error']}")
+
+
+def extenders_from_policy(policy: Optional[dict]) -> List[HTTPExtender]:
+    if not policy:
+        return []
+    return [HTTPExtender.from_policy(e)
+            for e in policy.get("extenders") or []]

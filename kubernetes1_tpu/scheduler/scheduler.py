@@ -36,6 +36,7 @@ def _json_key(obj) -> str:
     return _json.dumps(obj, sort_keys=True, default=str)
 from ..utils.metrics import Histogram, MetricsServer, Registry
 from ..utils.trace import Trace
+from .extender import ExtenderError, HTTPExtender, extenders_from_policy
 from .cache import NodeInfo, SchedulerCache
 from .devices import allocate_for_pod, fits_devices
 from .predicates import EquivalenceCache, PodAffinityChecker, run_predicates
@@ -67,6 +68,8 @@ class Scheduler:
         scheduler_name: str = "default-scheduler",
         gang_wait_seconds: float = 30.0,
         metrics_port: Optional[int] = None,  # None = no endpoint; 0 = ephemeral
+        extenders: Optional[List[HTTPExtender]] = None,
+        policy: Optional[dict] = None,  # scheduler policy JSON (extenders)
     ):
         self.cs = clientset
         self.name = scheduler_name
@@ -84,6 +87,8 @@ class Scheduler:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.equiv_cache = EquivalenceCache()
+        # out-of-process extension (ref core/extender.go + policy JSON)
+        self.extenders = list(extenders or []) + extenders_from_policy(policy)
         self._scan_offset = 0  # rotates so sampling spreads over the cluster
         # persistent bind workers (ref scheduler.go:482 async bind): a pool
         # reuses per-thread HTTP connections instead of a thread per bind
@@ -352,6 +357,18 @@ class Scheduler:
             ok, _ = run_predicates(pod, ni, self.equiv_cache)
             if ok and affinity_checker is not None:
                 ok, _ = affinity_checker.check(ni)
+            if ok and self.extenders:
+                # the fast path must not bypass extender vetoes (ref: the
+                # extender runs inside findNodesThatFit for every pod)
+                pod_doc = global_scheme.encode(pod)
+                names = [nominated]
+                for ext in self.extenders:
+                    try:
+                        names, _failed = ext.filter(pod_doc, names)
+                    except ExtenderError:
+                        names = []
+                        break
+                ok = nominated in names
             if ok:
                 assignments, _ = allocate_for_pod(pod, ni)
                 if assignments is not None:
@@ -383,7 +400,35 @@ class Scheduler:
         if not feasible:
             summary = "; ".join(f"{n} node(s): {r}" for r, n in sorted(reasons.items()))
             return None, f"0/{len(snapshot)} nodes available: {summary}"
+        ext_scores: Dict[str, float] = {}
+        if self.extenders:
+            pod_doc = global_scheme.encode(pod)
+            names = [ni.node.metadata.name for ni in feasible]
+            for ext in self.extenders:
+                try:
+                    names, failed = ext.filter(pod_doc, names)
+                except ExtenderError as e:
+                    return None, str(e)
+                for why in failed.values():
+                    reasons[f"extender: {why}"] += 1
+            keep = set(names)
+            feasible = [ni for ni in feasible
+                        if ni.node.metadata.name in keep]
+            if not feasible:
+                summary = "; ".join(f"{n} node(s): {r}"
+                                    for r, n in sorted(reasons.items()))
+                return None, f"0/{len(snapshot)} nodes available: {summary}"
+            for ext in self.extenders:
+                try:
+                    for node, s in ext.prioritize(pod_doc, names).items():
+                        ext_scores[node] = ext_scores.get(node, 0.0) + s
+                except ExtenderError as e:
+                    return None, str(e)
+            tr.step("extenders done")
         scores = prioritize(pod, feasible)
+        for node, s in ext_scores.items():
+            if node in scores:
+                scores[node] += s
         tr.step("prioritized")
         # full device allocation runs only on the winner (best-fit slice +
         # coordinate sort are O(devices log devices) — too hot per-candidate);
@@ -408,6 +453,12 @@ class Scheduler:
             by_name[name].assigned = list(ids)
         self.cache.assume_pod(assumed, result.node)
 
+        # extender bind delegation (ref extender.go Bind): only when no
+        # device assignments ride the binding — the extender wire shape
+        # carries just the node, and chip IDs must never be dropped
+        ext_binder = next((e for e in self.extenders if e.handles_bind), None) \
+            if not result.assignments else None
+
         def do_bind():
             binding = t.Binding(
                 target_node=result.node,
@@ -417,7 +468,12 @@ class Scheduler:
             binding.metadata.namespace = pod.metadata.namespace
             bind_t0 = time.monotonic()
             try:
-                self.cs.bind(pod.metadata.namespace, pod.metadata.name, binding)
+                if ext_binder is not None:
+                    ext_binder.bind(pod.metadata.namespace, pod.metadata.name,
+                                    pod.metadata.uid, result.node)
+                else:
+                    self.cs.bind(pod.metadata.namespace, pod.metadata.name,
+                                 binding)
                 self.binding_latency.observe(time.monotonic() - bind_t0)
                 self._clear_nomination_for(pod.key())
                 self.recorder.event(
@@ -428,7 +484,7 @@ class Scheduler:
             except (Conflict, NotFound) as e:
                 self.cache.forget_pod(assumed)
                 self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-            except ApiError as e:
+            except (ApiError, ExtenderError) as e:
                 self.cache.forget_pod(assumed)
                 self.recorder.event(pod, "Warning", "FailedBinding", str(e))
                 self.queue.add_backoff(pod.key(), pod.spec.priority)

@@ -1,0 +1,214 @@
+"""Scheduler extenders (ref: plugin/pkg/scheduler/core/extender.go +
+examples/scheduler-policy-config.json): out-of-process filter/prioritize/
+bind callouts."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.scheduler.extender import (
+    ExtenderError,
+    HTTPExtender,
+    extenders_from_policy,
+)
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+class _ExtenderServer:
+    """Scriptable extender endpoint: handlers per verb."""
+
+    def __init__(self, handlers):
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                verb = self.path.strip("/").split("/")[-1]
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n)) if n else {}
+                outer.calls.append((verb, payload))
+                fn = handlers.get(verb)
+                if fn is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = json.dumps(fn(payload)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.calls = []
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self._httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def make_node(cs, name):
+    node = t.Node()
+    node.metadata.name = name
+    node.status.capacity = {"cpu": "4", "memory": "8Gi", "pods": "10"}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions = [t.NodeCondition(type="Ready", status="True")]
+    cs.nodes.create(node)
+
+
+def make_pod(name):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.spec.containers = [t.Container(name="c", image="i",
+                                       command=["sleep", "60"])]
+    return pod
+
+
+class TestExtenderUnit:
+    def test_policy_parsing(self):
+        exts = extenders_from_policy({"extenders": [{
+            "urlPrefix": "http://e/x", "filterVerb": "filter",
+            "prioritizeVerb": "prioritize", "weight": 3,
+            "ignorable": True}]})
+        assert len(exts) == 1
+        assert exts[0].weight == 3 and exts[0].ignorable
+
+    def test_ignorable_down_extender_is_skipped(self):
+        ext = HTTPExtender("http://127.0.0.1:9", filter_verb="filter",
+                           prioritize_verb="prioritize", ignorable=True,
+                           timeout=0.2)
+        nodes, failed = ext.filter({}, ["a", "b"])
+        assert nodes == ["a", "b"] and failed == {}
+        assert ext.prioritize({}, ["a"]) == {}
+
+    def test_non_ignorable_down_extender_raises(self):
+        ext = HTTPExtender("http://127.0.0.1:9", filter_verb="filter",
+                           timeout=0.2)
+        with pytest.raises(ExtenderError):
+            ext.filter({}, ["a"])
+
+
+class TestExtenderScheduling:
+    @pytest.fixture
+    def env(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        yield master, cs
+        cs.close()
+        master.stop()
+
+    def _wait_bound(self, cs, name, timeout=15):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            p = cs.pods.get(name)
+            if p.spec.node_name:
+                return p
+            time.sleep(0.1)
+        raise AssertionError(f"pod {name} never bound")
+
+    def test_filter_vetoes_nodes(self, env):
+        _, cs = env
+        srv = _ExtenderServer({
+            "filter": lambda p: {
+                "nodeNames": [n for n in p["nodeNames"] if n == "good"],
+                "failedNodes": {n: "vetoed" for n in p["nodeNames"]
+                                if n != "good"}},
+        })
+        sched = Scheduler(cs, extenders=[
+            HTTPExtender(srv.url, filter_verb="filter")])
+        sched.start()
+        try:
+            make_node(cs, "bad-1")
+            make_node(cs, "bad-2")
+            make_node(cs, "good")
+            cs.pods.create(make_pod("veto-me"))
+            p = self._wait_bound(cs, "veto-me")
+            assert p.spec.node_name == "good"
+            assert any(v == "filter" for v, _ in srv.calls)
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_prioritize_steers_choice(self, env):
+        _, cs = env
+        srv = _ExtenderServer({
+            "prioritize": lambda p: [
+                {"host": n, "score": 10 if n == "preferred" else 0}
+                for n in p["nodeNames"]],
+        })
+        sched = Scheduler(cs, extenders=[
+            HTTPExtender(srv.url, prioritize_verb="prioritize",
+                         weight=100)])
+        sched.start()
+        try:
+            make_node(cs, "a-node")
+            make_node(cs, "preferred")
+            make_node(cs, "z-node")
+            cs.pods.create(make_pod("steer-me"))
+            p = self._wait_bound(cs, "steer-me")
+            assert p.spec.node_name == "preferred"
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_extender_bind_delegation(self, env):
+        master, cs = env
+        bound = {}
+
+        def do_bind(p):
+            # the extender itself POSTs the Binding (the reference's
+            # extender-bind contract)
+            bcs = Clientset(master.url)
+            binding = t.Binding(target_node=p["node"])
+            binding.metadata.name = p["podName"]
+            binding.metadata.namespace = p["podNamespace"]
+            bcs.bind(p["podNamespace"], p["podName"], binding)
+            bcs.close()
+            bound.update(p)
+            return {}
+
+        srv = _ExtenderServer({"bind": do_bind})
+        sched = Scheduler(cs, extenders=[
+            HTTPExtender(srv.url, bind_verb="bind")])
+        sched.start()
+        try:
+            make_node(cs, "only-node")
+            cs.pods.create(make_pod("ext-bound"))
+            p = self._wait_bound(cs, "ext-bound")
+            assert p.spec.node_name == "only-node"
+            assert bound.get("podName") == "ext-bound"
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_policy_json_via_scheduler(self, env):
+        _, cs = env
+        srv = _ExtenderServer({
+            "filter": lambda p: {"nodeNames": p["nodeNames"],
+                                 "failedNodes": {}},
+        })
+        sched = Scheduler(cs, policy={"extenders": [{
+            "urlPrefix": srv.url, "filterVerb": "filter"}]})
+        sched.start()
+        try:
+            make_node(cs, "n1")
+            cs.pods.create(make_pod("via-policy"))
+            self._wait_bound(cs, "via-policy")
+            assert any(v == "filter" for v, _ in srv.calls)
+        finally:
+            sched.stop()
+            srv.stop()

@@ -1,0 +1,104 @@
+"""PodPreset admission (ref: plugin/pkg/admission/podpreset/admission.go,
+settings.k8s.io/v1alpha1): declarative injection into matching pods."""
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+
+
+@pytest.fixture
+def env():
+    master = Master().start()
+    cs = Clientset(master.url)
+    yield master, cs
+    cs.close()
+    master.stop()
+
+
+def make_preset(name, selector_labels, env=None, volumes=None, mounts=None):
+    p = t.PodPreset()
+    p.metadata.name = name
+    p.spec.selector = t.LabelSelector(match_labels=selector_labels)
+    p.spec.env = env or []
+    p.spec.volumes = volumes or []
+    p.spec.volume_mounts = mounts or []
+    return p
+
+
+def make_pod(name, labels=None, env=None):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.labels = labels or {}
+    c = t.Container(name="train", image="jax", command=["sleep", "1"])
+    c.env = env or []
+    pod.spec.containers = [c]
+    return pod
+
+
+class TestPodPreset:
+    def test_injects_env_and_volumes(self, env):
+        _, cs = env
+        cs.resource("podpresets").create(make_preset(
+            "tpu-defaults", {"role": "train"},
+            env=[t.EnvVar(name="CKPT_DIR", value="/ckpt")],
+            volumes=[t.Volume(name="ckpt",
+                              empty_dir=t.EmptyDirVolumeSource())],
+            mounts=[t.VolumeMount(name="ckpt", mount_path="/ckpt")],
+        ))
+        created = cs.pods.create(make_pod("worker", {"role": "train"}))
+        c = created.spec.containers[0]
+        assert any(e.name == "CKPT_DIR" and e.value == "/ckpt" for e in c.env)
+        assert any(m.name == "ckpt" and m.mount_path == "/ckpt"
+                   for m in c.volume_mounts)
+        assert any(v.name == "ckpt" for v in created.spec.volumes)
+        assert any(k.startswith("podpreset.admission.ktpu.io/podpreset-")
+                   for k in created.metadata.annotations)
+
+    def test_non_matching_pod_untouched(self, env):
+        _, cs = env
+        cs.resource("podpresets").create(make_preset(
+            "tpu-defaults", {"role": "train"},
+            env=[t.EnvVar(name="CKPT_DIR", value="/ckpt")]))
+        created = cs.pods.create(make_pod("other", {"role": "serve"}))
+        assert not any(e.name == "CKPT_DIR"
+                       for e in created.spec.containers[0].env)
+
+    def test_conflict_skips_whole_preset(self, env):
+        _, cs = env
+        cs.resource("podpresets").create(make_preset(
+            "tpu-defaults", {"role": "train"},
+            env=[t.EnvVar(name="CKPT_DIR", value="/ckpt"),
+                 t.EnvVar(name="EXTRA", value="yes")]))
+        created = cs.pods.create(make_pod(
+            "conflicted", {"role": "train"},
+            env=[t.EnvVar(name="CKPT_DIR", value="/elsewhere")]))
+        c = created.spec.containers[0]
+        # the user's value wins AND nothing else from the preset lands
+        assert [e.value for e in c.env if e.name == "CKPT_DIR"] == ["/elsewhere"]
+        assert not any(e.name == "EXTRA" for e in c.env)
+        assert any(k.startswith("podpreset.admission.ktpu.io/conflict-")
+                   for k in created.metadata.annotations)
+
+    def test_exclude_annotation(self, env):
+        _, cs = env
+        cs.resource("podpresets").create(make_preset(
+            "tpu-defaults", {"role": "train"},
+            env=[t.EnvVar(name="CKPT_DIR", value="/ckpt")]))
+        pod = make_pod("opted-out", {"role": "train"})
+        pod.metadata.annotations = {
+            "podpreset.admission.ktpu.io/exclude": "true"}
+        created = cs.pods.create(pod)
+        assert not any(e.name == "CKPT_DIR"
+                       for e in created.spec.containers[0].env)
+
+    def test_absent_selector_matches_all(self, env):
+        _, cs = env
+        p = t.PodPreset()
+        p.metadata.name = "match-all"
+        p.spec.env = [t.EnvVar(name="GLOBAL", value="1")]
+        cs.resource("podpresets").create(p)
+        created = cs.pods.create(make_pod("anyone", {"whatever": "x"}))
+        assert any(e.name == "GLOBAL"
+                   for e in created.spec.containers[0].env)
