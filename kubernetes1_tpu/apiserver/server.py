@@ -307,6 +307,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # a just-written config must be enforced immediately — the
                 # 1s admission cache is for steady-state reads only
                 self.master._webhook_cache.pop(resource, None)
+            if method != "GET" and resource == "podpresets":
+                self.master._podpreset_cache.pop(ns or "default", None)
             self.master.metrics.observe(method, resource, time.monotonic() - start)
         except ApiError as e:
             try:
@@ -733,6 +735,7 @@ class Master:
         self._audit_lock = threading.Lock()
         self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
         self._webhook_cache: Dict[str, tuple] = {}  # resource -> (ts, items)
+        self._podpreset_cache: Dict[str, tuple] = {}  # namespace -> (ts, items)
         self.authorization_mode = authorization_mode
         tokens = dict(static_tokens or {})
         if token:
@@ -806,7 +809,16 @@ class Master:
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
 
     def _list_podpresets(self, namespace: str):
+        # same ~1s cache + write-through invalidation as webhook configs:
+        # admission runs per pod CREATE and most clusters have no presets
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._podpreset_cache.get(namespace)
+        if hit is not None and now - hit[0] < 1.0:
+            return hit[1]
         items, _ = self.store.list(self.registry.prefix("podpresets", namespace))
+        self._podpreset_cache[namespace] = (now, items)
         return items
 
     def _list_webhook_configs(self, resource: str):

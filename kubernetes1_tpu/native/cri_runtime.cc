@@ -1,0 +1,701 @@
+// ktpu-cri-runtime — native container runtime behind the CRI socket.
+//
+// C++ implementation of the kubelet's RuntimeService protocol
+// (kubelet/cri.py; ref: pkg/kubelet/apis/cri/v1alpha1/runtime/api.proto +
+// dockershim as the server role): newline-delimited JSON frames over a
+// unix socket. Containers are host processes — fork/exec with the
+// ContainerSpec's env (TPU_* injection included), own process group,
+// per-container log files, cgroup joining via the cgroup_procs_files the
+// kubelet computes, cpuset pinning via sched_setaffinity — the same
+// contract as the Python ProcessRuntime, with no Python runtime needed on
+// the node. A kubelet pointed at this socket via RemoteRuntime drives it
+// unchanged:
+//
+//   ktpu-cri-runtime --socket /run/ktpu/cri.sock --root /var/lib/ktpu
+//   Kubelet(cs, node, runtime=RemoteRuntime("/run/ktpu/cri.sock"))
+//
+// Build: make -C kubernetes1_tpu/native
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <sched.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.hpp"
+
+using ktpu::Json;
+using ktpu::JsonArray;
+using ktpu::JsonObject;
+
+namespace {
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+void mkdirs(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    cur += path[i];
+    if ((path[i] == '/' && i > 0) || i + 1 == path.size())
+      mkdir(cur.c_str(), 0755);
+  }
+}
+
+bool probe_mount_ns() {
+  // can this host enter a private mount namespace? (mirrors the Python
+  // runtime's _probe_mount_ns; without it, mounts degrade to env-only)
+  int rc = system(
+      "unshare --mount --propagation private -- sh -c 'exit 0' "
+      ">/dev/null 2>&1");
+  return rc == 0;
+}
+
+std::string sh_quote(const std::string& s) {
+  std::string out = "'";
+  for (char c : s) {
+    if (c == '\'') out += "'\\''";
+    else out += c;
+  }
+  out += "'";
+  return out;
+}
+
+std::string gen_id(const char* prefix) {
+  static std::atomic<uint64_t> counter{0};
+  char buf[64];
+  snprintf(buf, sizeof buf, "%s-%lx-%llx", prefix, (unsigned long)getpid(),
+           (unsigned long long)++counter);
+  return buf;
+}
+
+struct Sandbox {
+  std::string id, pod_name, pod_namespace, pod_uid;
+  std::string state = "SANDBOX_READY";
+  double created_at = 0;
+  JsonObject labels;
+};
+
+struct Container {
+  std::string id, sandbox_id, name, image;
+  std::string state = "CREATED";  // CREATED | RUNNING | EXITED
+  bool has_exit = false;
+  int exit_code = 0;
+  double started_at = 0, finished_at = 0;
+  int restart_count = 0;
+  std::string log_path;
+  // config
+  std::vector<std::string> argv;
+  JsonObject env;
+  std::string working_dir;
+  std::vector<std::string> cgroup_procs_files;
+  std::vector<int> cpuset;
+  JsonArray mounts;  // [{name, host_path, container_path, read_only}]
+  pid_t pid = -1;
+};
+
+class Runtime {
+ public:
+  explicit Runtime(const std::string& root)
+      : root_(root), mount_ns_(probe_mount_ns()) {
+    mkdirs(root_);
+    mkdirs(root_ + "/logs");
+  }
+
+  Json dispatch(const std::string& method, const Json& p) {
+    if (method == "capabilities") {
+      JsonObject o;
+      o["real_pids"] = Json(true);
+      o["root"] = Json(root_);
+      return Json(o);
+    }
+    if (method == "version") return Json(std::string("ktpu-cri-runtime/0.1"));
+    if (method == "run_pod_sandbox") return run_pod_sandbox(p);
+    if (method == "stop_pod_sandbox") return stop_pod_sandbox(p);
+    if (method == "remove_pod_sandbox") return remove_pod_sandbox(p);
+    if (method == "list_pod_sandboxes") return list_pod_sandboxes();
+    if (method == "create_container") return create_container(p);
+    if (method == "start_container") return start_container(p);
+    if (method == "stop_container") return stop_container(p);
+    if (method == "remove_container") return remove_container(p);
+    if (method == "list_containers") return list_containers();
+    if (method == "container_status") return container_status(p);
+    if (method == "read_log") return read_log(p);
+    if (method == "container_stats") return container_stats(p);
+    if (method == "exec_in_container") return exec_in_container(p);
+    if (method == "exec_capture") return exec_capture(p);
+    if (method == "set_container_affinity") return set_affinity(p);
+    throw std::runtime_error("unknown CRI method '" + method + "'");
+  }
+
+ private:
+  std::string root_;
+  bool mount_ns_;
+  std::mutex mu_;
+  std::map<std::string, Sandbox> sandboxes_;
+  std::map<std::string, Container> containers_;
+
+  // ------------------------------------------------------------ sandboxes
+
+  Json run_pod_sandbox(const Json& p) {
+    Sandbox sb;
+    sb.id = gen_id("sb");
+    sb.pod_name = p.get("pod_name");
+    sb.pod_namespace = p.get("pod_namespace");
+    sb.pod_uid = p.get("pod_uid");
+    sb.created_at = now_s();
+    if (p["labels"].is_object()) sb.labels = p["labels"].as_object();
+    std::lock_guard<std::mutex> l(mu_);
+    sandboxes_[sb.id] = sb;
+    return Json(sb.id);
+  }
+
+  Json stop_pod_sandbox(const Json& p) {
+    const std::string id = p.get("sandbox_id");
+    std::vector<std::string> cids;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = sandboxes_.find(id);
+      if (it != sandboxes_.end()) it->second.state = "SANDBOX_NOTREADY";
+      for (auto& kv : containers_)
+        if (kv.second.sandbox_id == id) cids.push_back(kv.first);
+    }
+    for (auto& cid : cids) kill_container(cid, 5.0);
+    return Json();
+  }
+
+  Json remove_pod_sandbox(const Json& p) {
+    const std::string id = p.get("sandbox_id");
+    std::lock_guard<std::mutex> l(mu_);
+    for (auto it = containers_.begin(); it != containers_.end();)
+      it = (it->second.sandbox_id == id) ? containers_.erase(it) : ++it;
+    sandboxes_.erase(id);
+    return Json();
+  }
+
+  Json list_pod_sandboxes() {
+    std::lock_guard<std::mutex> l(mu_);
+    JsonArray out;
+    for (auto& kv : sandboxes_) {
+      JsonObject o;
+      const Sandbox& s = kv.second;
+      o["id"] = Json(s.id);
+      o["pod_name"] = Json(s.pod_name);
+      o["pod_namespace"] = Json(s.pod_namespace);
+      o["pod_uid"] = Json(s.pod_uid);
+      o["state"] = Json(s.state);
+      o["created_at"] = Json(s.created_at);
+      o["labels"] = Json(s.labels);
+      out.push_back(Json(o));
+    }
+    return Json(out);
+  }
+
+  // ----------------------------------------------------------- containers
+
+  Json create_container(const Json& p) {
+    const Json& cfg = p["config"];
+    Container c;
+    c.id = gen_id("ct");
+    c.sandbox_id = p.get("sandbox_id");
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      if (!sandboxes_.count(c.sandbox_id))
+        throw std::runtime_error("no such sandbox " + c.sandbox_id);
+    }
+    c.name = cfg.get("name");
+    c.image = cfg.get("image");
+    for (const auto& v : cfg["command"].as_array())
+      c.argv.push_back(v.as_string());
+    for (const auto& v : cfg["args"].as_array())
+      c.argv.push_back(v.as_string());
+    if (c.argv.empty())
+      throw std::runtime_error("container " + c.name +
+                               ": command required for process runtime");
+    if (cfg["env"].is_object()) c.env = cfg["env"].as_object();
+    c.working_dir = cfg.get("working_dir");
+    for (const auto& v : cfg["cgroup_procs_files"].as_array())
+      c.cgroup_procs_files.push_back(v.as_string());
+    for (const auto& v : cfg["cpuset"].as_array())
+      c.cpuset.push_back((int)v.as_int());
+    if (cfg["mounts"].is_array()) c.mounts = cfg["mounts"].as_array();
+    c.log_path = root_ + "/logs/" + c.id + ".log";
+    std::lock_guard<std::mutex> l(mu_);
+    containers_[c.id] = c;
+    return Json(c.id);
+  }
+
+  Json start_container(const Json& p) {
+    const std::string id = p.get("container_id");
+    Container snapshot;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(id);
+      if (it == containers_.end())
+        throw std::runtime_error("no such container " + id);
+      snapshot = it->second;
+    }
+    // ---- everything allocated BEFORE fork: a multithreaded parent must
+    // not malloc between fork and exec (another thread may hold the heap
+    // lock at fork time and the child would deadlock — same reason the
+    // Python runtime uses an sh preamble instead of preexec_fn)
+    std::vector<std::string> argv_store = snapshot.argv;
+    if (!snapshot.mounts.empty() && mount_ns_) {
+      // unshare+bind preamble (parity with runtime.py _wrap_with_mounts):
+      // binds live in a private mount ns; mkdir of mount points persists
+      std::string script = "set -e\n";
+      for (const auto& mj : snapshot.mounts) {
+        const JsonObject& m = mj.as_object();
+        auto get = [&](const char* k) {
+          auto it2 = m.find(k);
+          return it2 == m.end() ? std::string() : it2->second.as_string();
+        };
+        std::string s = get("host_path"), d = get("container_path");
+        if (s.empty() || d.empty()) continue;
+        struct stat st;
+        if (stat(s.c_str(), &st) != 0) continue;
+        if (S_ISDIR(st.st_mode))
+          script += "mkdir -p " + sh_quote(d) + "\n";
+        else
+          script += "mkdir -p $(dirname " + sh_quote(d) + ") && touch " +
+                    sh_quote(d) + "\n";
+        script += "mount --bind " + sh_quote(s) + " " + sh_quote(d) + "\n";
+        auto ro = m.find("read_only");
+        if (ro != m.end() && ro->second.as_bool())
+          script += "mount -o remount,ro,bind " + sh_quote(d) + "\n";
+      }
+      script += "exec \"$@\"";
+      std::vector<std::string> wrapped = {
+          "unshare", "--mount", "--propagation", "private", "--",
+          "sh", "-c", script, "sh"};
+      wrapped.insert(wrapped.end(), argv_store.begin(), argv_store.end());
+      argv_store = std::move(wrapped);
+    }
+    std::vector<char*> argv;
+    for (auto& a : argv_store) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    std::vector<std::string> env_store;
+    for (char** e = environ; *e; ++e) {
+      const char* eq = strchr(*e, '=');
+      if (!eq) continue;
+      std::string key(*e, eq - *e);
+      if (!snapshot.env.count(key)) env_store.push_back(*e);
+    }
+    for (auto& kv : snapshot.env)
+      env_store.push_back(kv.first + "=" + kv.second.as_string());
+    for (const auto& mj : snapshot.mounts) {
+      // path-agnostic consumption parity: KTPU_VOLUME_<NAME>=host_path
+      const JsonObject& m = mj.as_object();
+      auto itn = m.find("name");
+      auto ith = m.find("host_path");
+      if (itn == m.end() || ith == m.end()) continue;
+      std::string name = itn->second.as_string();
+      for (auto& ch : name) {
+        if (ch == '-' || ch == '.') ch = '_';
+        ch = toupper((unsigned char)ch);
+      }
+      if (!name.empty())
+        env_store.push_back("KTPU_VOLUME_" + name + "=" +
+                            ith->second.as_string());
+    }
+    std::vector<char*> envp;
+    for (auto& s : env_store) envp.push_back(const_cast<char*>(s.c_str()));
+    envp.push_back(nullptr);
+    std::vector<int> cgroup_fds;
+    for (const auto& pf : snapshot.cgroup_procs_files) {
+      int fd = open(pf.c_str(), O_WRONLY);
+      if (fd >= 0) cgroup_fds.push_back(fd);
+    }
+    int logfd = open(snapshot.log_path.c_str(),
+                     O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (logfd < 0) {
+      for (int fd : cgroup_fds) close(fd);
+      throw std::runtime_error("cannot open log file");
+    }
+    const char* wd =
+        snapshot.working_dir.empty() ? nullptr : snapshot.working_dir.c_str();
+    cpu_set_t cpuset;
+    CPU_ZERO(&cpuset);
+    for (int cn : snapshot.cpuset) CPU_SET(cn, &cpuset);
+    pid_t pid = fork();
+    if (pid < 0) {
+      close(logfd);
+      for (int fd : cgroup_fds) close(fd);
+      throw std::runtime_error("fork failed");
+    }
+    if (pid == 0) {
+      // child: async-signal-safe syscalls only — no allocation
+      setsid();
+      char pidbuf[16];
+      int n = snprintf(pidbuf, sizeof pidbuf, "%d", (int)getpid());
+      for (int fd : cgroup_fds) {
+        if (write(fd, pidbuf, n) < 0) { /* best effort */ }
+        close(fd);
+      }
+      if (!snapshot.cpuset.empty())
+        sched_setaffinity(0, sizeof cpuset, &cpuset);
+      dup2(logfd, 1);
+      dup2(logfd, 2);
+      if (wd && chdir(wd) != 0) _exit(127);
+      execvpe(argv[0], argv.data(), envp.data());
+      dprintf(2, "exec failed: %s\n", strerror(errno));
+      _exit(127);
+    }
+    close(logfd);
+    for (int fd : cgroup_fds) close(fd);
+    std::lock_guard<std::mutex> l(mu_);
+    Container& c = containers_[id];
+    c.pid = pid;
+    c.state = "RUNNING";
+    c.started_at = now_s();
+    return Json();
+  }
+
+  void reap_locked(Container& c) {
+    if (c.state != "RUNNING" || c.pid <= 0) return;
+    int status = 0;
+    pid_t r = waitpid(c.pid, &status, WNOHANG);
+    if (r == c.pid) {
+      c.state = "EXITED";
+      c.has_exit = true;
+      c.exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                      : 128 + WTERMSIG(status);
+      c.finished_at = now_s();
+    }
+  }
+
+  void kill_container(const std::string& id, double timeout) {
+    pid_t pid = -1;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(id);
+      if (it == containers_.end()) return;
+      reap_locked(it->second);
+      if (it->second.state != "RUNNING") return;
+      pid = it->second.pid;
+    }
+    if (pid > 0) kill(-pid, SIGTERM);
+    double deadline = now_s() + timeout;
+    while (now_s() < deadline) {
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        auto it = containers_.find(id);
+        if (it == containers_.end()) return;
+        reap_locked(it->second);
+        if (it->second.state != "RUNNING") return;
+      }
+      usleep(50 * 1000);
+    }
+    if (pid > 0) kill(-pid, SIGKILL);
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = containers_.find(id);
+    if (it != containers_.end() && it->second.state == "RUNNING") {
+      // use the REAL status when the process beat the SIGKILL to the exit;
+      // only an actual kill is reported as 137
+      int status = 0;
+      pid_t r = waitpid(it->second.pid, &status, 0);
+      it->second.state = "EXITED";
+      it->second.has_exit = true;
+      if (r == it->second.pid && WIFEXITED(status))
+        it->second.exit_code = WEXITSTATUS(status);
+      else if (r == it->second.pid && WIFSIGNALED(status))
+        it->second.exit_code = 128 + WTERMSIG(status);
+      else
+        it->second.exit_code = 137;
+      it->second.finished_at = now_s();
+    }
+  }
+
+  Json stop_container(const Json& p) {
+    kill_container(p.get("container_id"),
+                   p["timeout"].as_number(10.0));
+    return Json();
+  }
+
+  Json remove_container(const Json& p) {
+    const std::string id = p.get("container_id");
+    kill_container(id, 1.0);
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = containers_.find(id);
+    if (it != containers_.end()) {
+      unlink(it->second.log_path.c_str());
+      containers_.erase(it);
+    }
+    return Json();
+  }
+
+  JsonObject record(const Container& c) {
+    JsonObject o;
+    o["id"] = Json(c.id);
+    o["sandbox_id"] = Json(c.sandbox_id);
+    o["name"] = Json(c.name);
+    o["image"] = Json(c.image);
+    o["state"] = Json(c.state);
+    o["exit_code"] = c.has_exit ? Json(c.exit_code) : Json();
+    o["started_at"] = Json(c.started_at);
+    o["finished_at"] = Json(c.finished_at);
+    o["restart_count"] = Json(c.restart_count);
+    o["log_path"] = Json(c.log_path);
+    return o;
+  }
+
+  Json list_containers() {
+    std::lock_guard<std::mutex> l(mu_);
+    JsonArray out;
+    for (auto& kv : containers_) {
+      reap_locked(kv.second);
+      out.push_back(Json(record(kv.second)));
+    }
+    return Json(out);
+  }
+
+  Json container_status(const Json& p) {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = containers_.find(p.get("container_id"));
+    if (it == containers_.end()) return Json();
+    reap_locked(it->second);
+    return Json(record(it->second));
+  }
+
+  Json read_log(const Json& p) {
+    std::string path;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(p.get("container_id"));
+      if (it == containers_.end()) return Json(std::string());
+      path = it->second.log_path;
+    }
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return Json(std::string());
+    std::string out;
+    char buf[65536];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
+    fclose(f);
+    int64_t tail = p["tail"].as_int(0);
+    if (tail > 0) {
+      // keep the last `tail` lines
+      size_t pos = out.size();
+      int64_t lines = 0;
+      while (pos > 0 && lines < tail) {
+        --pos;
+        if (out[pos] == '\n' && pos != out.size() - 1) ++lines;
+        if (lines == tail) { ++pos; break; }
+      }
+      out = out.substr(pos);
+    }
+    return Json(out);
+  }
+
+  Json container_stats(const Json& p) {
+    pid_t pid = -1;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(p.get("container_id"));
+      if (it != containers_.end() && it->second.state == "RUNNING")
+        pid = it->second.pid;
+    }
+    JsonObject o;
+    o["cpu"] = Json(0.0);
+    o["memory"] = Json(0.0);
+    if (pid > 0) {
+      char path[64];
+      snprintf(path, sizeof path, "/proc/%d/statm", (int)pid);
+      FILE* f = fopen(path, "r");
+      if (f) {
+        long size = 0, resident = 0;
+        if (fscanf(f, "%ld %ld", &size, &resident) == 2)
+          o["memory"] = Json((double)resident * sysconf(_SC_PAGESIZE));
+        fclose(f);
+      }
+    }
+    return Json(o);
+  }
+
+  // ------------------------------------------------------------ exec/affinity
+
+  Json exec_capture(const Json& p) {
+    // run the command in the container's env context, capture output
+    Container snapshot;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(p.get("container_id"));
+      if (it == containers_.end())
+        throw std::runtime_error("no such container");
+      snapshot = it->second;
+    }
+    std::vector<std::string> argv;
+    for (const auto& v : p["command"].as_array())
+      argv.push_back(v.as_string());
+    if (argv.empty()) throw std::runtime_error("empty exec command");
+    int fds[2];
+    if (pipe(fds) != 0) throw std::runtime_error("pipe failed");
+    pid_t pid = fork();
+    if (pid < 0) throw std::runtime_error("fork failed");
+    if (pid == 0) {
+      close(fds[0]);
+      dup2(fds[1], 1);
+      dup2(fds[1], 2);
+      for (auto& kv : snapshot.env)
+        setenv(kv.first.c_str(), kv.second.as_string().c_str(), 1);
+      std::vector<char*> cargv;
+      for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+      cargv.push_back(nullptr);
+      execvp(cargv[0], cargv.data());
+      _exit(127);
+    }
+    close(fds[1]);
+    std::string out;
+    char buf[4096];
+    ssize_t n;
+    while ((n = read(fds[0], buf, sizeof buf)) > 0) out.append(buf, n);
+    close(fds[0]);
+    int status = 0;
+    waitpid(pid, &status, 0);
+    JsonObject o;
+    o["exit_code"] = Json(WIFEXITED(status) ? WEXITSTATUS(status) : 128);
+    o["output"] = Json(out);
+    return Json(o);
+  }
+
+  Json exec_in_container(const Json& p) {
+    Json r = exec_capture(p);
+    return r["exit_code"];
+  }
+
+  Json set_affinity(const Json& p) {
+    pid_t pgid = -1;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(p.get("container_id"));
+      if (it == containers_.end() || it->second.state != "RUNNING")
+        return Json(false);
+      pgid = it->second.pid;  // setsid -> pgid == root pid
+    }
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    for (const auto& v : p["cpus"].as_array()) CPU_SET((int)v.as_int(), &set);
+    bool ok = false;
+    DIR* proc = opendir("/proc");
+    if (!proc) return Json(false);
+    struct dirent* de;
+    while ((de = readdir(proc)) != nullptr) {
+      if (de->d_name[0] < '0' || de->d_name[0] > '9') continue;
+      pid_t pid = atoi(de->d_name);
+      if (getpgid(pid) != pgid) continue;
+      char tdir[64];
+      snprintf(tdir, sizeof tdir, "/proc/%d/task", (int)pid);
+      DIR* tasks = opendir(tdir);
+      if (!tasks) continue;
+      struct dirent* te;
+      while ((te = readdir(tasks)) != nullptr) {
+        if (te->d_name[0] < '0' || te->d_name[0] > '9') continue;
+        if (sched_setaffinity(atoi(te->d_name), sizeof set, &set) == 0)
+          ok = true;
+      }
+      closedir(tasks);
+    }
+    closedir(proc);
+    return Json(ok);
+  }
+};
+
+// ----------------------------------------------------------------- server
+
+void serve_conn(Runtime* rt, int fd) {
+  std::string buf;
+  char chunk[65536];
+  for (;;) {
+    ssize_t n = read(fd, chunk, sizeof chunk);
+    if (n <= 0) break;
+    buf.append(chunk, n);
+    size_t nl;
+    while ((nl = buf.find('\n')) != std::string::npos) {
+      std::string line = buf.substr(0, nl);
+      buf.erase(0, nl + 1);
+      if (line.empty()) continue;
+      Json resp;
+      JsonObject ro;
+      try {
+        Json req = Json::parse(line);
+        ro["id"] = req["id"];
+        ro["result"] = rt->dispatch(req.get("method"), req["params"]);
+      } catch (const std::exception& e) {
+        ro["error"] = Json(std::string(e.what()));
+      }
+      std::string out = Json(ro).dump() + "\n";
+      size_t off = 0;
+      while (off < out.size()) {
+        ssize_t w = write(fd, out.data() + off, out.size() - off);
+        if (w <= 0) { close(fd); return; }
+        off += w;
+      }
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = "/run/ktpu/cri.sock";
+  std::string root = "/var/lib/ktpu";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (strcmp(argv[i], "--socket") == 0) socket_path = argv[++i];
+    else if (strcmp(argv[i], "--root") == 0) root = argv[++i];
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  Runtime rt(root);
+  unlink(socket_path.c_str());
+  // ensure the socket's parent dir exists
+  std::string dir = socket_path.substr(0, socket_path.find_last_of('/'));
+  if (!dir.empty()) {
+    std::string cur;
+    for (size_t i = 0; i < dir.size(); ++i) {
+      cur += dir[i];
+      if ((dir[i] == '/' && i > 0) || i + 1 == dir.size())
+        mkdir(cur.c_str(), 0755);
+    }
+  }
+  int srv = socket(AF_UNIX, SOCK_STREAM, 0);
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, socket_path.c_str(), sizeof addr.sun_path - 1);
+  if (bind(srv, (sockaddr*)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 16);
+  fprintf(stderr, "ktpu-cri-runtime: serving on %s (root %s)\n",
+          socket_path.c_str(), root.c_str());
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::thread(serve_conn, &rt, fd).detach();
+  }
+  return 0;
+}

@@ -178,3 +178,150 @@ class TestNativeExporter:
         finally:
             proc.terminate()
             proc.wait(timeout=5)
+
+
+class TestNativeCRIRuntime:
+    """The C++ CRI runtime behind the unix-socket protocol must be driven
+    by RemoteRuntime/kubelet exactly like the Python ProcessRuntime
+    (kubelet/cri.py is the contract)."""
+
+    @pytest.fixture
+    def native_cri(self, native_bins, tmp_path):
+        binary = os.path.join(NATIVE_DIR, "bin", "ktpu-cri-runtime")
+        assert os.access(binary, os.X_OK)
+        sock = str(tmp_path / "cri.sock")
+        root = str(tmp_path / "rt")
+        proc = subprocess.Popen([binary, "--socket", sock, "--root", root],
+                                stderr=subprocess.PIPE, text=True)
+        deadline = time.monotonic() + 5
+        while not os.path.exists(sock):
+            assert proc.poll() is None, proc.stderr.read()
+            assert time.monotonic() < deadline, "socket never appeared"
+            time.sleep(0.05)
+        from kubernetes1_tpu.kubelet.cri import RemoteRuntime
+
+        client = RemoteRuntime(sock)
+        yield client, root
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+    def test_capabilities_and_version(self, native_cri):
+        client, root = native_cri
+        assert client.real_pids is True
+        assert client.root == root
+        assert "ktpu-cri-runtime" in client.version()
+
+    def test_real_process_lifecycle(self, native_cri, tmp_path):
+        from kubernetes1_tpu.kubelet.runtime import (
+            CONTAINER_EXITED,
+            CONTAINER_RUNNING,
+            ContainerConfig,
+        )
+
+        client, _ = native_cri
+        sid = client.run_pod_sandbox("p", "default", "uid-1",
+                                     labels={"pod-uid": "uid-1"})
+        marker = str(tmp_path / "native-marker")
+        cid = client.create_container(sid, ContainerConfig(
+            name="c", image="img",
+            command=["sh", "-c", f"echo from-native > {marker}; sleep 60"],
+            env={"WHO": "native"}))
+        client.start_container(cid)
+        rec = client.container_status(cid)
+        assert rec.state == CONTAINER_RUNNING
+        deadline = time.monotonic() + 10
+        while not os.path.exists(marker) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(marker)
+        # exec sees the container env
+        code, out = client.exec_capture(cid, ["sh", "-c", "echo $WHO"])
+        assert code == 0 and out.strip() == "native"
+        client.stop_container(cid, timeout=2.0)
+        rec = client.container_status(cid)
+        assert rec.state == CONTAINER_EXITED
+        client.stop_pod_sandbox(sid)
+        client.remove_pod_sandbox(sid)
+        assert client.list_pod_sandboxes() == []
+
+    def test_exit_code_and_logs(self, native_cri):
+        from kubernetes1_tpu.kubelet.runtime import (
+            CONTAINER_EXITED,
+            ContainerConfig,
+        )
+
+        client, _ = native_cri
+        sid = client.run_pod_sandbox("p", "default", "uid-2")
+        cid = client.create_container(sid, ContainerConfig(
+            name="c", image="img",
+            command=["sh", "-c", "echo line-one; echo line-two; exit 3"]))
+        client.start_container(cid)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rec = client.container_status(cid)
+            if rec.state == CONTAINER_EXITED:
+                break
+            time.sleep(0.05)
+        assert rec.state == CONTAINER_EXITED and rec.exit_code == 3
+        log = client.read_log(cid)
+        assert "line-one" in log and "line-two" in log
+        assert client.read_log(cid, tail=1).strip() == "line-two"
+
+    def test_kubelet_drives_native_runtime(self, native_cri):
+        """Full kubelet sync loop -> C++ runtime -> real process."""
+        from kubernetes1_tpu.kubelet.cri import RemoteRuntime
+
+        client, _ = native_cri
+        master = Master().start()
+        cs = Clientset(master.url)
+        kl = Kubelet(cs, node_name="native-node", runtime=client,
+                     heartbeat_interval=1.0, sync_interval=0.2,
+                     pleg_interval=0.2, server_port=None)
+        kl.start()
+        try:
+            pod = t.Pod()
+            pod.metadata.name = "on-native"
+            pod.spec.node_name = "native-node"
+            pod.spec.containers = [
+                t.Container(name="c", image="img",
+                            command=["sh", "-c", "sleep 60"])]
+            cs.pods.create(pod)
+            deadline = time.monotonic() + 20
+            phase = None
+            while time.monotonic() < deadline:
+                p = cs.pods.get("on-native")
+                phase = p.status.phase
+                if phase == t.POD_RUNNING:
+                    break
+                time.sleep(0.2)
+            assert phase == t.POD_RUNNING
+        finally:
+            kl.stop()
+            cs.close()
+            master.stop()
+
+    def test_mounts_env_and_bind(self, native_cri, tmp_path):
+        """Volume parity with ProcessRuntime: KTPU_VOLUME_<NAME> env always;
+        bind mount at container_path when the host allows mount
+        namespaces."""
+        from kubernetes1_tpu.kubelet.runtime import ContainerConfig
+
+        client, _ = native_cri
+        vol = tmp_path / "voldata"
+        vol.mkdir()
+        (vol / "file.txt").write_text("from-volume")
+        out_path = tmp_path / "copied"
+        sid = client.run_pod_sandbox("p", "default", "uid-3")
+        cid = client.create_container(sid, ContainerConfig(
+            name="c", image="img",
+            command=["sh", "-c",
+                     'cp "$KTPU_VOLUME_DATA/file.txt" ' + str(out_path)
+                     + "; sleep 0.1"],
+            mounts=[{"name": "data", "host_path": str(vol),
+                     "container_path": "/mnt/ktpu-test-data",
+                     "read_only": False}]))
+        client.start_container(cid)
+        deadline = time.monotonic() + 10
+        while not out_path.exists() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert out_path.read_text() == "from-volume"
