@@ -25,12 +25,27 @@ def main():
                     help="cluster CA key (certificate credentials)")
     ap.add_argument("--sa-key-file", default="",
                     help="service-account token signing key")
+    ap.add_argument("--audit-log-path", default="",
+                    help="JSONL audit log file")
+    ap.add_argument("--audit-policy-file", default="",
+                    help="audit policy JSON (levels/rules)")
+    ap.add_argument("--audit-webhook-url", default="",
+                    help="batching audit event sink URL")
+    ap.add_argument("--authentication-token-webhook-url", default="",
+                    help="TokenReview webhook authn URL")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
     from ..utils.procutil import read_key
+
+    audit_policy = None
+    if args.audit_policy_file:
+        import json
+
+        with open(args.audit_policy_file) as f:
+            audit_policy = json.load(f)
 
     master = Master(
         host=args.host, port=args.port, wal_path=args.wal or None, token=args.token,
@@ -39,6 +54,10 @@ def main():
                            args.enable_admission_plugins.split(",") if p.strip()],
         ca_key=read_key(args.ca_key_file, "ktpu-ca-key"),
         sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
+        audit_path=args.audit_log_path or None,
+        audit_policy=audit_policy,
+        audit_webhook_url=args.audit_webhook_url,
+        authentication_webhook_url=args.authentication_token_webhook_url,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -308,7 +308,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # 1s admission cache is for steady-state reads only
                 self.master._webhook_cache.pop(resource, None)
             if method != "GET" and resource == "podpresets":
-                self.master._podpreset_cache.pop(ns or "default", None)
+                # the namespace may live only in the object body (no-ns URL
+                # form), so clear the whole cache — preset writes are rare
+                self.master._podpreset_cache.clear()
             self.master.metrics.observe(method, resource, time.monotonic() - start)
         except ApiError as e:
             try:
@@ -589,7 +591,9 @@ class _Handler(BaseHTTPRequestHandler):
         created = self._with_quota_serialization(
             resource, ns or obj.metadata.namespace, admit_and_create
         )
-        self.master.audit("create", resource, ns, created.metadata.name, self._user.name)
+        self.master.audit("create", resource, ns, created.metadata.name,
+                          self._user.name, request_obj=body,
+                          response_obj=lambda: self.master.scheme.encode(created))
         if resource == "customresourcedefinitions":
             self.master.apply_crd(created)
         elif resource == "apiservices":
@@ -626,7 +630,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif resource == "apiservices":
                 self.master.remove_apiservice(old)
                 self.master.apply_apiservice(updated)
-        self.master.audit("update", resource, ns, name, self._user.name)
+        self.master.audit("update", resource, ns, name, self._user.name,
+                          request_obj=body,
+                          response_obj=lambda: self.master.scheme.encode(updated))
         self._send_json(200, self._enc(updated))
 
     # ---------------------------------------------------------------- PATCH
@@ -654,7 +660,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif resource == "apiservices":
             self.master.remove_apiservice(old)
             self.master.apply_apiservice(updated)
-        self.master.audit("patch", resource, ns, name, self._user.name)
+        self.master.audit("patch", resource, ns, name, self._user.name,
+                          request_obj=patch,
+                          response_obj=lambda: self.master.scheme.encode(updated))
         self._send_json(200, self._enc(updated))
 
     # --------------------------------------------------------------- DELETE
@@ -720,6 +728,8 @@ class Master:
         ca_key: str = "ktpu-ca-key",
         admission_plugins: Optional[List[str]] = None,  # extra opt-ins, e.g. AlwaysPullImages
         authentication_webhook_url: str = "",  # TokenReview callout (webhook authn)
+        audit_policy: Optional[dict] = None,   # audit policy doc (levels/rules)
+        audit_webhook_url: str = "",           # batching audit sink
     ):
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
@@ -733,6 +743,11 @@ class Master:
         self._audit_log = audit_log
         self._audit_path = audit_path
         self._audit_lock = threading.Lock()
+        from .audit import AuditPolicy, WebhookAuditBackend
+
+        self.audit_policy = AuditPolicy.from_dict(audit_policy)
+        self._audit_webhook = (WebhookAuditBackend(audit_webhook_url)
+                               if audit_webhook_url else None)
         self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
         self._webhook_cache: Dict[str, tuple] = {}  # resource -> (ts, items)
         self._podpreset_cache: Dict[str, tuple] = {}  # namespace -> (ts, items)
@@ -949,20 +964,36 @@ class Master:
                 return addr.ip, port
         return None
 
-    def audit(self, verb: str, resource: str, ns: str, name: str, user: str = ""):
-        """Audit backend (ref: apiserver/pkg/audit — Metadata level): one
-        entry per mutating request, to the in-memory sink and/or a JSONL
-        file."""
-        if self._audit_log is None and self._audit_path is None:
+    def audit(self, verb: str, resource: str, ns: str, name: str,
+              user: str = "", request_obj=None, response_obj=None):
+        """Advanced audit (ref: apiserver/pkg/audit + plugin/pkg/audit):
+        the policy decides the level per request (None drops it; Request /
+        RequestResponse capture object payloads); entries flow to the
+        in-memory sink, the JSONL file, and the batching webhook."""
+        if (self._audit_log is None and self._audit_path is None
+                and self._audit_webhook is None):
             return
-        entry = {"ts": time.time(), "user": user, "verb": verb,
-                 "resource": resource, "ns": ns, "name": name}
+        from .audit import LEVEL_NONE, LEVEL_REQUEST_RESPONSE, build_entry
+
+        level = self.audit_policy.level_for(user, verb, resource, ns)
+        if level == LEVEL_NONE:
+            return
+        if callable(response_obj):
+            # lazily materialized: the hot write path must not pay a second
+            # full encode unless this request's level actually captures it
+            response_obj = (response_obj()
+                            if level == LEVEL_REQUEST_RESPONSE else None)
+        entry = build_entry(level, user, verb, resource, ns, name,
+                            request_obj=request_obj,
+                            response_obj=response_obj)
         if self._audit_log is not None:
             self._audit_log.append(entry)
         if self._audit_path is not None:
             with self._audit_lock:
                 with open(self._audit_path, "a") as f:
                     f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+        if self._audit_webhook is not None:
+            self._audit_webhook.add(entry)
 
     def start(self) -> "Master":
         self.registry.ensure_namespace("default")
@@ -978,4 +1009,8 @@ class Master:
         self.stopping.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # audit sink last: in-flight requests finishing during shutdown
+        # still audit, and the final flush must include them
+        if self._audit_webhook is not None:
+            self._audit_webhook.stop()
         self.store.close()

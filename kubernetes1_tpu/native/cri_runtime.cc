@@ -112,6 +112,9 @@ struct Container {
   std::vector<int> cpuset;
   JsonArray mounts;  // [{name, host_path, container_path, read_only}]
   pid_t pid = -1;
+  // previous cpu sample for rate computation (cadvisor's method)
+  double cpu_ticks_prev = -1;
+  double cpu_sample_ts = 0;
 };
 
 class Runtime {
@@ -186,6 +189,15 @@ class Runtime {
 
   Json remove_pod_sandbox(const Json& p) {
     const std::string id = p.get("sandbox_id");
+    // stop before erase (ProcessRuntime contract: remove implies stop) —
+    // erasing a RUNNING container would orphan its process tree forever
+    std::vector<std::string> cids;
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      for (auto& kv : containers_)
+        if (kv.second.sandbox_id == id) cids.push_back(kv.first);
+    }
+    for (auto& cid : cids) kill_container(cid, 2.0);
     std::lock_guard<std::mutex> l(mu_);
     for (auto it = containers_.begin(); it != containers_.end();)
       it = (it->second.sandbox_id == id) ? containers_.erase(it) : ++it;
@@ -253,6 +265,8 @@ class Runtime {
       auto it = containers_.find(id);
       if (it == containers_.end())
         throw std::runtime_error("no such container " + id);
+      if (it->second.state != "CREATED")
+        throw std::runtime_error("container " + id + " already started");
       snapshot = it->second;
     }
     // ---- everything allocated BEFORE fork: a multithreaded parent must
@@ -364,10 +378,18 @@ class Runtime {
     close(logfd);
     for (int fd : cgroup_fds) close(fd);
     std::lock_guard<std::mutex> l(mu_);
-    Container& c = containers_[id];
-    c.pid = pid;
-    c.state = "RUNNING";
-    c.started_at = now_s();
+    auto it = containers_.find(id);
+    if (it == containers_.end()) {
+      // removed concurrently: never resurrect a ghost entry — reap the
+      // freshly forked process instead
+      kill(-pid, SIGKILL);
+      int status = 0;
+      waitpid(pid, &status, 0);
+      throw std::runtime_error("container " + id + " removed during start");
+    }
+    it->second.pid = pid;
+    it->second.state = "RUNNING";
+    it->second.started_at = now_s();
     return Json();
   }
 
@@ -508,26 +530,56 @@ class Runtime {
   }
 
   Json container_stats(const Json& p) {
-    pid_t pid = -1;
-    {
-      std::lock_guard<std::mutex> l(mu_);
-      auto it = containers_.find(p.get("container_id"));
-      if (it != containers_.end() && it->second.state == "RUNNING")
-        pid = it->second.pid;
-    }
+    const std::string id = p.get("container_id");
     JsonObject o;
     o["cpu"] = Json(0.0);
     o["memory"] = Json(0.0);
-    if (pid > 0) {
-      char path[64];
-      snprintf(path, sizeof path, "/proc/%d/statm", (int)pid);
-      FILE* f = fopen(path, "r");
-      if (f) {
-        long size = 0, resident = 0;
-        if (fscanf(f, "%ld %ld", &size, &resident) == 2)
-          o["memory"] = Json((double)resident * sysconf(_SC_PAGESIZE));
-        fclose(f);
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = containers_.find(id);
+    if (it == containers_.end() || it->second.state != "RUNNING")
+      return Json(o);
+    Container& c = it->second;
+    char path[64];
+    snprintf(path, sizeof path, "/proc/%d/statm", (int)c.pid);
+    FILE* f = fopen(path, "r");
+    if (f) {
+      long size = 0, resident = 0;
+      if (fscanf(f, "%ld %ld", &size, &resident) == 2)
+        o["memory"] = Json((double)resident * sysconf(_SC_PAGESIZE));
+      fclose(f);
+    }
+    // cpu cores = d(utime+stime)/dt (ProcessRuntime/cadvisor parity)
+    snprintf(path, sizeof path, "/proc/%d/stat", (int)c.pid);
+    f = fopen(path, "r");
+    if (f) {
+      char statbuf[1024];
+      if (fgets(statbuf, sizeof statbuf, f)) {
+        // fields after the parenthesized comm: state ppid pgrp session
+        // tty tpgid flags minflt cminflt majflt cmajflt utime stime ...
+        char* close_paren = strrchr(statbuf, ')');
+        if (close_paren) {
+          unsigned long utime = 0, stime = 0;
+          int field = 0;
+          char* tok = strtok(close_paren + 1, " ");
+          while (tok && field < 13) {
+            ++field;
+            if (field == 12) utime = strtoul(tok, nullptr, 10);
+            if (field == 13) stime = strtoul(tok, nullptr, 10);
+            tok = strtok(nullptr, " ");
+          }
+          double ticks = (double)(utime + stime);
+          double now = now_s();
+          if (c.cpu_ticks_prev >= 0 && now > c.cpu_sample_ts) {
+            double hz = (double)sysconf(_SC_CLK_TCK);
+            double cores = (ticks - c.cpu_ticks_prev) / hz /
+                           (now - c.cpu_sample_ts);
+            o["cpu"] = Json(cores < 0 ? 0.0 : cores);
+          }
+          c.cpu_ticks_prev = ticks;
+          c.cpu_sample_ts = now;
+        }
       }
+      fclose(f);
     }
     return Json(o);
   }
@@ -535,42 +587,87 @@ class Runtime {
   // ------------------------------------------------------------ exec/affinity
 
   Json exec_capture(const Json& p) {
-    // run the command in the container's env context, capture output
+    // ProcessRuntime parity: refuse non-running containers, bound the
+    // whole exec at 10s, and (like start_container) allocate NOTHING
+    // between fork and exec — argv/envp buffers are prepared up front.
     Container snapshot;
     {
       std::lock_guard<std::mutex> l(mu_);
       auto it = containers_.find(p.get("container_id"));
       if (it == containers_.end())
         throw std::runtime_error("no such container");
+      reap_locked(it->second);
+      if (it->second.state != "RUNNING") {
+        JsonObject o;
+        o["exit_code"] = Json(-1);
+        o["output"] = Json(std::string("container not running"));
+        return Json(o);
+      }
       snapshot = it->second;
     }
-    std::vector<std::string> argv;
+    std::vector<std::string> argv_store;
     for (const auto& v : p["command"].as_array())
-      argv.push_back(v.as_string());
-    if (argv.empty()) throw std::runtime_error("empty exec command");
+      argv_store.push_back(v.as_string());
+    if (argv_store.empty()) throw std::runtime_error("empty exec command");
+    std::vector<char*> argv;
+    for (auto& a : argv_store) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    std::vector<std::string> env_store;
+    for (char** e = environ; *e; ++e) {
+      const char* eq = strchr(*e, '=');
+      if (!eq) continue;
+      std::string key(*e, eq - *e);
+      if (!snapshot.env.count(key)) env_store.push_back(*e);
+    }
+    for (auto& kv : snapshot.env)
+      env_store.push_back(kv.first + "=" + kv.second.as_string());
+    std::vector<char*> envp;
+    for (auto& s : env_store) envp.push_back(const_cast<char*>(s.c_str()));
+    envp.push_back(nullptr);
     int fds[2];
     if (pipe(fds) != 0) throw std::runtime_error("pipe failed");
     pid_t pid = fork();
-    if (pid < 0) throw std::runtime_error("fork failed");
+    if (pid < 0) {
+      close(fds[0]);
+      close(fds[1]);
+      throw std::runtime_error("fork failed");
+    }
     if (pid == 0) {
       close(fds[0]);
       dup2(fds[1], 1);
       dup2(fds[1], 2);
-      for (auto& kv : snapshot.env)
-        setenv(kv.first.c_str(), kv.second.as_string().c_str(), 1);
-      std::vector<char*> cargv;
-      for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
-      cargv.push_back(nullptr);
-      execvp(cargv[0], cargv.data());
+      execvpe(argv[0], argv.data(), envp.data());
       _exit(127);
     }
     close(fds[1]);
+    // non-blocking drain with a 10s deadline (exec probes must not wedge a
+    // server thread on a hung command or an inherited-pipe background child)
+    fcntl(fds[0], F_SETFL, O_NONBLOCK);
     std::string out;
     char buf[4096];
-    ssize_t n;
-    while ((n = read(fds[0], buf, sizeof buf)) > 0) out.append(buf, n);
+    double deadline = now_s() + 10.0;
+    bool timed_out = false;
+    for (;;) {
+      ssize_t n = read(fds[0], buf, sizeof buf);
+      if (n > 0) {
+        out.append(buf, n);
+        continue;
+      }
+      if (n == 0) break;
+      if (errno != EAGAIN && errno != EWOULDBLOCK) break;
+      if (now_s() >= deadline) { timed_out = true; break; }
+      usleep(20 * 1000);
+    }
     close(fds[0]);
     int status = 0;
+    if (timed_out) {
+      kill(pid, SIGKILL);
+      waitpid(pid, &status, 0);
+      JsonObject o;
+      o["exit_code"] = Json(-1);
+      o["output"] = Json(out + "\n(exec timed out)");
+      return Json(o);
+    }
     waitpid(pid, &status, 0);
     JsonObject o;
     o["exit_code"] = Json(WIFEXITED(status) ? WEXITSTATUS(status) : 128);

@@ -1,0 +1,123 @@
+"""Advanced audit: policy levels + webhook backend (ref:
+staging/src/k8s.io/apiserver/pkg/audit, plugin/pkg/audit/{log,webhook})."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.apiserver.audit import AuditPolicy
+from kubernetes1_tpu.client import Clientset
+
+
+def make_pod(name):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.spec.containers = [t.Container(name="c", image="i",
+                                       command=["sleep", "1"])]
+    return pod
+
+
+class TestPolicy:
+    def test_first_match_wins(self):
+        p = AuditPolicy.from_dict({"rules": [
+            {"level": "None", "resources": ["events"]},
+            {"level": "RequestResponse", "resources": ["pods"]},
+            {"level": "Metadata"},
+        ]})
+        assert p.level_for("u", "create", "events", "default") == "None"
+        assert p.level_for("u", "create", "pods", "default") == "RequestResponse"
+        assert p.level_for("u", "create", "nodes", "") == "Metadata"
+
+    def test_user_and_namespace_scoping(self):
+        p = AuditPolicy.from_dict({"rules": [
+            {"level": "Request", "users": ["system:admin"],
+             "namespaces": ["kube-system"]},
+        ], "defaultLevel": "Metadata"})
+        assert p.level_for("system:admin", "create", "pods",
+                           "kube-system") == "Request"
+        assert p.level_for("system:admin", "create", "pods",
+                           "default") == "Metadata"
+        assert p.level_for("alice", "create", "pods",
+                           "kube-system") == "Metadata"
+
+
+class TestLevels:
+    def test_none_drops_and_request_captures(self):
+        log = []
+        master = Master(audit_log=log, audit_policy={"rules": [
+            {"level": "None", "resources": ["events"]},
+            {"level": "RequestResponse", "resources": ["pods"]},
+        ]}).start()
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(make_pod("audited"))
+            ev = t.Event()
+            ev.metadata.name = "noisy"
+            ev.source_component = "test"
+            cs.events.create(ev)
+            pod_entries = [e for e in log if e["resource"] == "pods"]
+            assert pod_entries and pod_entries[0]["level"] == "RequestResponse"
+            assert pod_entries[0]["requestObject"]["metadata"]["name"] == "audited"
+            assert pod_entries[0]["responseObject"]["kind"] == "Pod"
+            assert not any(e["resource"] == "events" for e in log)
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_metadata_level_has_no_objects(self):
+        log = []
+        master = Master(audit_log=log).start()  # default: Metadata
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(make_pod("meta"))
+            entry = [e for e in log if e["resource"] == "pods"][0]
+            assert entry["level"] == "Metadata"
+            assert "requestObject" not in entry
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestWebhookBackend:
+    def test_events_batched_to_sink(self):
+        batches = []
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                batches.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/audit"
+        master = Master(audit_webhook_url=url).start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(3):
+                cs.pods.create(make_pod(f"whk-{i}"))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                got = [i for b in batches for i in b.get("items", [])
+                       if i["resource"] == "pods"]
+                if len(got) >= 3:
+                    break
+                time.sleep(0.1)
+            assert len(got) >= 3
+            assert batches[0]["kind"] == "EventList"
+        finally:
+            cs.close()
+            master.stop()
+            httpd.shutdown()
+            httpd.server_close()

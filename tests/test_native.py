@@ -325,3 +325,58 @@ class TestNativeCRIRuntime:
         while not out_path.exists() and time.monotonic() < deadline:
             time.sleep(0.05)
         assert out_path.read_text() == "from-volume"
+
+    def test_exec_refused_on_exited_and_stats_cpu(self, native_cri):
+        from kubernetes1_tpu.kubelet.runtime import (
+            CONTAINER_EXITED,
+            ContainerConfig,
+        )
+
+        client, _ = native_cri
+        sid = client.run_pod_sandbox("p", "default", "uid-4")
+        # a busy-loop container: stats must report real cpu usage
+        cid = client.create_container(sid, ContainerConfig(
+            name="busy", image="img",
+            command=["sh", "-c", "while true; do :; done"]))
+        client.start_container(cid)
+        time.sleep(0.3)
+        client.container_stats(cid)  # first sample primes the rate
+        time.sleep(0.5)
+        stats = client.container_stats(cid)
+        assert stats["cpu"] > 0.05
+        assert stats["memory"] > 0
+        client.stop_container(cid, timeout=1.0)
+        rec = client.container_status(cid)
+        assert rec.state == CONTAINER_EXITED
+        # exec against an exited container is refused, not silently run
+        code, out = client.exec_capture(cid, ["true"])
+        assert code == -1 and "not running" in out
+
+    def test_double_start_refused(self, native_cri):
+        from kubernetes1_tpu.kubelet.runtime import ContainerConfig
+
+        client, _ = native_cri
+        sid = client.run_pod_sandbox("p", "default", "uid-5")
+        cid = client.create_container(sid, ContainerConfig(
+            name="c", image="img", command=["sleep", "30"]))
+        client.start_container(cid)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            client.start_container(cid)
+        client.stop_container(cid, timeout=1.0)
+
+    def test_remove_sandbox_kills_running_containers(self, native_cri):
+        from kubernetes1_tpu.kubelet.runtime import ContainerConfig
+
+        client, _ = native_cri
+        sid = client.run_pod_sandbox("p", "default", "uid-6")
+        cid = client.create_container(sid, ContainerConfig(
+            name="c", image="img", command=["sleep", "300"]))
+        client.start_container(cid)
+        # find the real pid via exec
+        code, out = client.exec_capture(cid, ["sh", "-c", "echo ok"])
+        assert code == 0
+        client.remove_pod_sandbox(sid)  # no explicit stop first
+        assert client.list_pod_sandboxes() == []
+        assert client.list_containers() == []
