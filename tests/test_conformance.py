@@ -1,0 +1,215 @@
+"""Conformance battery (ref: test/conformance — the reference pins a
+minimal set of API behaviors every conforming cluster must exhibit).
+
+One fixture boots the full in-process control plane; each test asserts a
+behavioral contract a client may rely on.  These dedup with deeper suites
+on purpose: conformance is about the CONTRACT surface, stated in one
+place, cheap enough to run against any deployment of the framework.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import ApiError, Conflict, NotFound
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    master = Master().start()
+    cs = Clientset(master.url)
+    yield master, cs
+    cs.close()
+    master.stop()
+
+
+def mk_pod(name, ns="default"):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    pod.spec.containers = [t.Container(name="c", image="img",
+                                       command=["sleep", "1"])]
+    return pod
+
+
+class TestAPIContract:
+    def test_api_discovery_groups_present(self, cluster):
+        master, _ = cluster
+        with urllib.request.urlopen(master.url + "/healthz") as r:
+            assert r.status == 200
+        # every registered resource is reachable under a group prefix
+        for path in ("/api/v1/pods", "/apis/apps/v1/deployments",
+                     "/apis/batch/v1/jobs"):
+            with urllib.request.urlopen(master.url + path) as r:
+                doc = json.loads(r.read())
+                assert doc["kind"].endswith("List")
+
+    def test_create_returns_uid_and_rv(self, cluster):
+        _, cs = cluster
+        created = cs.pods.create(mk_pod("conf-uid"))
+        assert created.metadata.uid
+        assert created.metadata.resource_version
+        assert created.metadata.creation_timestamp
+
+    def test_names_are_unique_within_namespace(self, cluster):
+        _, cs = cluster
+        cs.pods.create(mk_pod("conf-dup"))
+        with pytest.raises(ApiError):
+            cs.pods.create(mk_pod("conf-dup"))
+
+    def test_get_unknown_is_404(self, cluster):
+        _, cs = cluster
+        with pytest.raises(NotFound):
+            cs.pods.get("never-existed")
+
+    def test_optimistic_concurrency_conflict(self, cluster):
+        _, cs = cluster
+        cm = t.ConfigMap()
+        cm.metadata.name = "conf-occ"
+        cs.configmaps.create(cm)
+        a = cs.configmaps.get("conf-occ")
+        b = cs.configmaps.get("conf-occ")
+        a.data = {"v": "1"}
+        cs.configmaps.update(a)
+        b.data = {"v": "2"}
+        with pytest.raises(Conflict):
+            cs.configmaps.update(b)  # stale resourceVersion must 409
+
+    def test_label_selector_list(self, cluster):
+        _, cs = cluster
+        p = mk_pod("conf-labeled")
+        p.metadata.labels = {"conformance": "yes"}
+        cs.pods.create(p)
+        items, _ = cs.pods.list(namespace="default",
+                                label_selector="conformance=yes")
+        assert [i.metadata.name for i in items] == ["conf-labeled"]
+
+    def test_namespace_isolation(self, cluster):
+        _, cs = cluster
+        cs.pods.create(mk_pod("conf-ns-a", ns="conf-ns-one"))
+        items, _ = cs.pods.list(namespace="conf-ns-two")
+        assert all(i.metadata.name != "conf-ns-a" for i in items)
+
+
+class TestWatchContract:
+    def test_watch_resumes_from_resource_version(self, cluster):
+        _, cs = cluster
+        _, rv = cs.pods.list(namespace="default")
+        cs.pods.create(mk_pod("conf-watch-1"))
+        seen = []
+        with cs.pods.watch(namespace="default", resource_version=rv) as stream:
+            for etype, obj in stream:
+                seen.append((etype, obj["metadata"]["name"]))
+                break
+        assert ("ADDED", "conf-watch-1") in seen
+
+    def test_watch_sees_delete(self, cluster):
+        _, cs = cluster
+        cs.pods.create(mk_pod("conf-watch-del"))
+        _, rv = cs.pods.list(namespace="default")
+        got = []
+
+        def watcher():
+            with cs.pods.watch(namespace="default",
+                               resource_version=rv) as stream:
+                for etype, obj in stream:
+                    if obj["metadata"]["name"] == "conf-watch-del":
+                        got.append(etype)
+                        return
+
+        th = threading.Thread(target=watcher, daemon=True)
+        th.start()
+        time.sleep(0.2)
+        cs.pods.delete("conf-watch-del", grace_seconds=0)
+        th.join(timeout=10)
+        assert got and got[0] == "DELETED"
+
+    def test_compacted_watch_410s(self, cluster):
+        """A watch from an ancient resourceVersion must signal Expired so
+        clients relist (the reflector contract)."""
+        master, cs = cluster
+        store = master.store
+        # force compaction if supported; at minimum rv=1 must not hang
+        if hasattr(store, "compact"):
+            items, rv = cs.pods.list(namespace="default")
+            store.compact(int(rv) - 1 if int(rv) > 1 else 1)
+        from kubernetes1_tpu.machinery.errors import TooOldResourceVersion
+
+        try:
+            with cs.pods.watch(namespace="default",
+                               resource_version="1") as stream:
+                for _ in stream:
+                    break
+        except TooOldResourceVersion:
+            pass  # 410 is the conforming answer post-compaction
+
+
+class TestSubresourceContract:
+    def test_status_update_does_not_touch_spec(self, cluster):
+        _, cs = cluster
+        pod = cs.pods.create(mk_pod("conf-status"))
+        pod.status.phase = t.POD_RUNNING
+        pod.spec.containers[0].image = "mutated"  # must be ignored
+        cs.pods.update_status(pod)
+        got = cs.pods.get("conf-status")
+        assert got.status.phase == t.POD_RUNNING
+        assert got.spec.containers[0].image == "img"
+
+    def test_binding_sets_node_and_rebind_conflicts(self, cluster):
+        _, cs = cluster
+        cs.pods.create(mk_pod("conf-bind"))
+        binding = t.Binding(target_node="conf-node")
+        binding.metadata.name = "conf-bind"
+        cs.bind("default", "conf-bind", binding)
+        assert cs.pods.get("conf-bind").spec.node_name == "conf-node"
+        # same-node re-bind is idempotent (scheduler retry tolerance);
+        # binding to a DIFFERENT node must 409
+        cs.bind("default", "conf-bind", binding)
+        other = t.Binding(target_node="other-node")
+        other.metadata.name = "conf-bind"
+        with pytest.raises(Conflict):
+            cs.bind("default", "conf-bind", other)
+
+    def test_tpu_limit_rewritten_to_v2(self, cluster):
+        """The fork's signature behavior: google.com/tpu container limits
+        become pod-level ExtendedResources."""
+        _, cs = cluster
+        pod = mk_pod("conf-tpu")
+        pod.spec.containers[0].resources.limits = {"google.com/tpu": 2}
+        created = cs.pods.create(pod)
+        assert len(created.spec.extended_resources) == 1
+        er = created.spec.extended_resources[0]
+        assert er.resource == "google.com/tpu" and er.quantity == 2
+        assert created.spec.containers[0].extended_resource_requests == [er.name]
+
+
+class TestAuthContract:
+    def test_rbac_denies_until_granted(self):
+        master = Master(authorization_mode="Node,RBAC", token="root",
+                        static_tokens={"usr": ("u1", [])}).start()
+        admin = Clientset(master.url, token="root")
+        user = Clientset(master.url, token="usr")
+        try:
+            with pytest.raises(ApiError):
+                user.pods.list(namespace="default")
+            role = t.ClusterRole()
+            role.metadata.name = "conf-reader"
+            role.rules = [t.PolicyRule(verbs=["list"], resources=["pods"])]
+            admin.clusterroles.create(role, "")
+            rb = t.ClusterRoleBinding()
+            rb.metadata.name = "conf-reader-b"
+            rb.subjects = [t.Subject(kind="User", name="u1")]
+            rb.role_ref = t.RoleRef(kind="ClusterRole", name="conf-reader")
+            admin.clusterrolebindings.create(rb, "")
+            items, _ = user.pods.list(namespace="default")
+            assert items == []
+        finally:
+            user.close()
+            admin.close()
+            master.stop()
