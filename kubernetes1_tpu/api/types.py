@@ -355,9 +355,21 @@ class PodAffinityTerm:
 
 
 @dataclass
+class PreferredSchedulingTerm:
+    """Ref: core/v1 PreferredSchedulingTerm — a weighted soft node-affinity
+    preference (preferredDuringSchedulingIgnoredDuringExecution)."""
+
+    weight: int = 1  # 1-100
+    preference: NodeAffinityTerm = field(default_factory=NodeAffinityTerm)
+
+
+@dataclass
 class Affinity:
     # required node affinity terms are ORed; expressions within a term ANDed
     node_affinity_required: List[NodeAffinityTerm] = field(default_factory=list)
+    # soft preferences scored by the NodeAffinity priority
+    # (priorities/node_affinity.go)
+    node_affinity_preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
     # requiredDuringSchedulingIgnoredDuringExecution pod (anti-)affinity:
     # every term must be satisfied (ref predicates.go:1036-1044)
     pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)

@@ -381,6 +381,14 @@ class Kubelet:
             os_image="linux",
         )
         node.status.extended_resources = self.device_manager.get_capacity()
+        # image inventory feeds the scheduler's ImageLocality priority
+        # (ref kubelet_node_status.go setNodeStatusImages)
+        image_svc = getattr(self.runtime, "images", None)
+        if image_svc is not None:
+            try:
+                node.status.images = image_svc.list_images()
+            except ConnectionError:
+                pass  # remote runtime hiccup: keep the previous inventory
 
     def _register_node(self):
         node = self._node_object()

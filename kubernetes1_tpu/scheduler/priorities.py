@@ -109,12 +109,97 @@ def selector_spreading(pod: t.Pod, ni: NodeInfo) -> float:
     return MAX_SCORE / (1.0 + siblings)
 
 
+def node_affinity(pod: t.Pod, ni: NodeInfo) -> float:
+    """Soft node-affinity preferences (ref: priorities/node_affinity.go):
+    the score is the satisfied fraction of the preferred terms' weights."""
+    aff = pod.spec.affinity
+    terms = aff.node_affinity_preferred if aff else []
+    if not terms:
+        return MAX_SCORE / 2  # neutral when the pod expresses no preference
+    from .predicates import _term_matches
+
+    labels = ni.node.metadata.labels or {}
+    total = sum(max(1, term.weight) for term in terms)
+    got = sum(max(1, term.weight) for term in terms
+              if _term_matches(term.preference, labels))
+    return MAX_SCORE * got / total
+
+
+def image_locality(pod: t.Pod, ni: NodeInfo) -> float:
+    """Favor nodes that already hold the pod's images (ref:
+    priorities/image_locality.go; node.status.images is the inventory the
+    kubelet publishes)."""
+    images = set(ni.node.status.images or [])
+    wanted = [c.image for c in pod.spec.containers if c.image]
+    if not images or not wanted:
+        return 0.0
+    present = sum(1 for img in wanted if img in images)
+    return MAX_SCORE * present / len(wanted)
+
+
+PREFER_AVOID_PODS_ANNOTATION = "scheduler.alpha.ktpu.io/preferAvoidPods"
+
+
+def node_prefer_avoid_pods(pod: t.Pod, ni: NodeInfo) -> float:
+    """Ref: priorities/node_prefer_avoid_pods.go — a node may carry an
+    annotation listing controller UIDs whose pods should land elsewhere
+    (used when draining a node softly); upstream weights this priority so
+    heavily it effectively overrides the others.
+
+    Annotation value: {"preferAvoidPods": [{"podSignature":
+    {"podController": {"uid": "..."}}}]}."""
+    ann = (ni.node.metadata.annotations or {}).get(PREFER_AVOID_PODS_ANNOTATION)
+    if not ann:
+        return MAX_SCORE
+    avoided = _parse_avoided_uids(ann)
+    if not avoided:
+        return MAX_SCORE
+    owners = {ref.uid for ref in pod.metadata.owner_references if ref.uid}
+    return 0.0 if owners & avoided else MAX_SCORE
+
+
+# annotation string -> frozenset of avoided controller UIDs; the string
+# rarely changes and this runs per (pod, node) in the scoring hot loop
+_avoid_memo: Dict[str, frozenset] = {}
+
+
+def _parse_avoided_uids(ann: str) -> frozenset:
+    hit = _avoid_memo.get(ann)
+    if hit is not None:
+        return hit
+    import json as _json
+
+    avoided: set = set()
+    try:
+        doc = _json.loads(ann)
+        entries = doc.get("preferAvoidPods") if isinstance(doc, dict) else []
+        for e in entries or []:
+            if not isinstance(e, dict):
+                continue
+            sig = e.get("podSignature")
+            ctl = sig.get("podController") if isinstance(sig, dict) else None
+            uid = ctl.get("uid") if isinstance(ctl, dict) else None
+            if uid:
+                avoided.add(uid)
+    except (ValueError, TypeError, AttributeError):
+        pass  # a malformed annotation must never take down scheduling
+    out = frozenset(avoided)
+    if len(_avoid_memo) > 1000:
+        _avoid_memo.clear()
+    _avoid_memo[ann] = out
+    return out
+
+
 DEFAULT_PRIORITIES: List[Tuple[str, Callable[[t.Pod, NodeInfo], float], float]] = [
     ("LeastRequested", least_requested, 1.0),
     ("BalancedAllocation", balanced_allocation, 1.0),
     ("TaintToleration", taint_toleration, 1.0),
+    ("NodeAffinity", node_affinity, 1.0),
+    ("ImageLocality", image_locality, 0.5),
     ("SelectorSpreading", selector_spreading, 1.5),
     ("SlicePacking", slice_packing, 2.0),  # device placement dominates on TPU
+    # upstream weight 10000: an avoid-marked node loses to any alternative
+    ("NodePreferAvoidPods", node_prefer_avoid_pods, 100.0),
 ]
 
 

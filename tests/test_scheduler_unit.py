@@ -171,3 +171,93 @@ class TestCacheAccounting:
         cache.assume_pod(pod, "n1")
         cache.cleanup_expired_assumes()
         assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 2
+
+
+class TestNewPriorities:
+    """ImageLocality, NodeAffinity (preferred), NodePreferAvoidPods
+    (ref: priorities/{image_locality,node_affinity,node_prefer_avoid_pods}.go)."""
+
+    def _ni(self, name="n1", labels=None, images=None, annotations=None):
+        from kubernetes1_tpu.scheduler.cache import NodeInfo
+
+        node = t.Node()
+        node.metadata.name = name
+        node.metadata.labels = labels or {}
+        node.metadata.annotations = annotations or {}
+        node.status.capacity = {"cpu": "4", "memory": "8Gi", "pods": "10"}
+        node.status.allocatable = dict(node.status.capacity)
+        node.status.images = images or []
+        ni = NodeInfo()
+        ni.set_node(node)
+        return ni
+
+    def _pod(self, name="p", images=("img-a",), owner_uid=""):
+        pod = t.Pod()
+        pod.metadata.name = name
+        if owner_uid:
+            pod.metadata.owner_references = [
+                t.OwnerReference(kind="ReplicaSet", name="rs", uid=owner_uid)]
+        pod.spec.containers = [
+            t.Container(name=f"c{i}", image=img, command=["x"])
+            for i, img in enumerate(images)]
+        return pod
+
+    def test_image_locality_prefers_cached_images(self):
+        from kubernetes1_tpu.scheduler.priorities import image_locality
+
+        pod = self._pod(images=("img-a", "img-b"))
+        assert image_locality(pod, self._ni(images=["img-a", "img-b"])) == 10.0
+        assert image_locality(pod, self._ni(images=["img-a"])) == 5.0
+        assert image_locality(pod, self._ni(images=[])) == 0.0
+
+    def test_node_affinity_preferred_weights(self):
+        from kubernetes1_tpu.scheduler.priorities import node_affinity
+
+        pod = self._pod()
+        pod.spec.affinity = t.Affinity(node_affinity_preferred=[
+            t.PreferredSchedulingTerm(
+                weight=3,
+                preference=t.NodeAffinityTerm(match_expressions=[
+                    t.NodeSelectorRequirement(key="zone", operator="In",
+                                              values=["a"])])),
+            t.PreferredSchedulingTerm(
+                weight=1,
+                preference=t.NodeAffinityTerm(match_expressions=[
+                    t.NodeSelectorRequirement(key="disk", operator="In",
+                                              values=["ssd"])])),
+        ])
+        both = self._ni(labels={"zone": "a", "disk": "ssd"})
+        heavy = self._ni(labels={"zone": "a"})
+        light = self._ni(labels={"disk": "ssd"})
+        assert node_affinity(pod, both) == 10.0
+        assert node_affinity(pod, heavy) == 7.5   # 3 of 4 weight
+        assert node_affinity(pod, light) == 2.5   # 1 of 4 weight
+
+    def test_prefer_avoid_pods_zeroes_marked_node(self):
+        import json as _json
+
+        from kubernetes1_tpu.scheduler.priorities import (
+            PREFER_AVOID_PODS_ANNOTATION,
+            node_prefer_avoid_pods,
+        )
+
+        pod = self._pod(owner_uid="rs-uid-1")
+        ann = {PREFER_AVOID_PODS_ANNOTATION: _json.dumps({
+            "preferAvoidPods": [{"podSignature": {"podController": {
+                "uid": "rs-uid-1"}}}]})}
+        assert node_prefer_avoid_pods(pod, self._ni(annotations=ann)) == 0.0
+        assert node_prefer_avoid_pods(pod, self._ni()) == 10.0
+        other = self._pod(owner_uid="other-rs")
+        assert node_prefer_avoid_pods(other, self._ni(annotations=ann)) == 10.0
+
+    def test_prefer_avoid_pods_malformed_annotation_is_inert(self):
+        from kubernetes1_tpu.scheduler.priorities import (
+            PREFER_AVOID_PODS_ANNOTATION,
+            node_prefer_avoid_pods,
+        )
+
+        pod = self._pod(owner_uid="rs-uid-1")
+        for bad in ("[]", '{"preferAvoidPods": ["x"]}', "not-json",
+                    '{"preferAvoidPods": [{"podSignature": null}]}'):
+            ni = self._ni(annotations={PREFER_AVOID_PODS_ANNOTATION: bad})
+            assert node_prefer_avoid_pods(pod, ni) == 10.0, bad
