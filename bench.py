@@ -34,7 +34,7 @@ CHIPS_PER_NODE = 4
 PODS = int(os.environ.get("BENCH_PODS", str(NODES * CHIPS_PER_NODE)))
 WORKLOAD_BATCH = int(os.environ.get("BENCH_WORKLOAD_BATCH", "256"))
 WORKLOAD_STEPS = int(os.environ.get("BENCH_WORKLOAD_STEPS", "20"))
-LLAMA_PRESET = os.environ.get("BENCH_LLAMA_PRESET", "1b")
+LLAMA_PRESET = os.environ.get("BENCH_LLAMA_PRESET", "1b-tpu")
 LLAMA_BATCH = int(os.environ.get("BENCH_LLAMA_BATCH", "4"))
 LLAMA_SEQ = int(os.environ.get("BENCH_LLAMA_SEQ", "2048"))
 LLAMA_STEPS = int(os.environ.get("BENCH_LLAMA_STEPS", "10"))

@@ -33,6 +33,13 @@ def main():
                     help="batching audit event sink URL")
     ap.add_argument("--authentication-token-webhook-url", default="",
                     help="TokenReview webhook authn URL")
+    ap.add_argument("--oidc-issuer-url", default="",
+                    help="OIDC issuer (enables JWT authn)")
+    ap.add_argument("--oidc-client-id", default="")
+    ap.add_argument("--oidc-hs256-key-file", default="",
+                    help="shared HS256 verification key file")
+    ap.add_argument("--oidc-username-claim", default="sub")
+    ap.add_argument("--oidc-groups-claim", default="groups")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
@@ -58,6 +65,11 @@ def main():
         audit_policy=audit_policy,
         audit_webhook_url=args.audit_webhook_url,
         authentication_webhook_url=args.authentication_token_webhook_url,
+        oidc_issuer=args.oidc_issuer_url,
+        oidc_client_id=args.oidc_client_id,
+        oidc_hs256_key=read_key(args.oidc_hs256_key_file, ""),
+        oidc_username_claim=args.oidc_username_claim,
+        oidc_groups_claim=args.oidc_groups_claim,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -19,6 +19,7 @@ Authorizers:
 
 from __future__ import annotations
 
+import hmac as _hmac
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -95,6 +96,116 @@ class ServiceAccountAuthenticator:
                 GROUP_AUTHENTICATED,
             ],
         )
+
+
+class OIDCAuthenticator:
+    """OIDC-style JWT authn (ref: apiserver OIDC token authenticator —
+    --oidc-issuer-url/--oidc-client-id/--oidc-username-claim/
+    --oidc-groups-claim).  This environment has zero egress, so instead of
+    fetching JWKS over HTTPS the verifier takes a shared HMAC key (HS256);
+    the claim validation contract is upstream's: signature, `iss` must
+    equal the configured issuer, `aud` must contain the client id, `exp`
+    must be in the future, and the username/groups claims map to the
+    UserInfo (username prefixed with the issuer, as upstream does to
+    prevent impersonating built-in identities)."""
+
+    def __init__(self, issuer: str, client_id: str, hs256_key: str,
+                 username_claim: str = "sub", groups_claim: str = "groups",
+                 clock=None):
+        import time as _time
+
+        if not hs256_key:
+            # an empty key would let anyone mint valid tokens (HMAC with ""
+            # is computable by every client) — refuse loudly at startup
+            raise ValueError(
+                "OIDC authn requires a non-empty HS256 key "
+                "(--oidc-hs256-key-file)")
+        self.issuer = issuer
+        self.client_id = client_id
+        self.key = hs256_key
+        self.username_claim = username_claim
+        self.groups_claim = groups_claim
+        self._clock = clock or _time.time
+
+    def authenticate(self, token: str) -> Optional[UserInfo]:
+        claims = self._verify(token)
+        if not isinstance(claims, dict):
+            return None
+        if claims.get("iss") != self.issuer:
+            return None
+        aud = claims.get("aud")
+        if isinstance(aud, str):
+            aud = [aud]
+        if self.client_id not in (aud or []):
+            return None
+        exp = claims.get("exp")
+        if not isinstance(exp, (int, float)) or exp < self._clock():
+            return None
+        username = claims.get(self.username_claim)
+        if not username:
+            return None
+        groups = claims.get(self.groups_claim) or []
+        if not isinstance(groups, list):
+            groups = [groups]
+        # like the username, groups must not collide with built-in system:*
+        # identities (system:masters would be instant cluster-admin) — the
+        # reference's --oidc-groups-prefix exists for exactly this
+        safe_groups = [str(g) for g in groups
+                       if not str(g).startswith("system:")]
+        return UserInfo(
+            name=f"{self.issuer}#{username}",
+            groups=safe_groups + [GROUP_AUTHENTICATED],
+        )
+
+    def _verify(self, token: str) -> Optional[dict]:
+        """Compact JWS (header.payload.sig), HS256 only."""
+        import base64 as _b64
+        import hashlib as _hashlib
+        import json as _json
+
+        parts = token.split(".")
+        if len(parts) != 3:
+            return None
+
+        def b64d(s: str) -> bytes:
+            return _b64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+        try:
+            header = _json.loads(b64d(parts[0]))
+            if not isinstance(header, dict) or header.get("alg") != "HS256":
+                return None  # alg confusion is not a feature
+            signing_input = f"{parts[0]}.{parts[1]}".encode()
+            want = _hmac.new(self.key.encode(), signing_input,
+                             _hashlib.sha256).digest()
+            if not _hmac.compare_digest(b64d(parts[2]), want):
+                return None
+            payload_doc = _json.loads(b64d(parts[1]))
+            return payload_doc if isinstance(payload_doc, dict) else None
+        except (ValueError, TypeError):
+            return None
+
+
+def mint_oidc_token(key: str, issuer: str, audience: str, subject: str,
+                    groups: Optional[List[str]] = None,
+                    ttl: float = 3600.0,
+                    extra_claims: Optional[dict] = None) -> str:
+    """Test/dev helper: mint an HS256 JWT the OIDCAuthenticator accepts."""
+    import base64 as _b64
+    import hashlib as _hashlib
+    import json as _json
+    import time as _time
+
+    def b64e(b: bytes) -> str:
+        return _b64.urlsafe_b64encode(b).decode().rstrip("=")
+
+    header = b64e(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = {"iss": issuer, "aud": audience, "sub": subject,
+              "exp": _time.time() + ttl, "groups": groups or []}
+    claims.update(extra_claims or {})
+    payload = b64e(_json.dumps(claims).encode())
+    sig = _hmac.new(key.encode(), f"{header}.{payload}".encode(),
+                    _hashlib.sha256).digest()
+    return f"{header}.{payload}.{b64e(sig)}"
 
 
 class WebhookTokenAuthenticator:

@@ -60,6 +60,7 @@ from .auth import (
     BootstrapTokenAuthenticator,
     CertificateAuthenticator,
     NodeAuthorizer,
+    OIDCAuthenticator,
     RBACAuthorizer,
     ServiceAccountAuthenticator,
     StaticTokenAuthenticator,
@@ -728,6 +729,11 @@ class Master:
         ca_key: str = "ktpu-ca-key",
         admission_plugins: Optional[List[str]] = None,  # extra opt-ins, e.g. AlwaysPullImages
         authentication_webhook_url: str = "",  # TokenReview callout (webhook authn)
+        oidc_issuer: str = "",                 # OIDC-style JWT authn (HS256)
+        oidc_client_id: str = "",
+        oidc_hs256_key: str = "",
+        oidc_username_claim: str = "sub",
+        oidc_groups_claim: str = "groups",
         audit_policy: Optional[dict] = None,   # audit policy doc (levels/rules)
         audit_webhook_url: str = "",           # batching audit sink
     ):
@@ -763,6 +769,13 @@ class Master:
             CertificateAuthenticator(ca_key),
             BootstrapTokenAuthenticator(self._get_secret_or_none),
         ]
+        if oidc_issuer:
+            # OIDCAuthenticator itself refuses an empty key; surface the
+            # misconfiguration at construction, not first request
+            authns.append(OIDCAuthenticator(
+                oidc_issuer, oidc_client_id, oidc_hs256_key,
+                username_claim=oidc_username_claim,
+                groups_claim=oidc_groups_claim))
         if authentication_webhook_url:
             # last: local authenticators win, unknown tokens go remote
             authns.append(WebhookTokenAuthenticator(authentication_webhook_url))

@@ -72,6 +72,9 @@ _METHODS = (
     "exec_in_container",
     "exec_capture",
     "set_container_affinity",
+    "pull_image",
+    "list_images",
+    "image_present",
 )
 
 
@@ -194,6 +197,15 @@ class RuntimeServer:
         if method == "set_container_affinity":
             return rt.set_container_affinity(params["container_id"],
                                              set(params["cpus"]))
+        # ImageService RPCs (ref api.proto ImageService) proxy to the
+        # runtime's image service when it has one
+        images = getattr(rt, "images", None)
+        if method == "pull_image":
+            return images.pull_image(params["image"]) if images else ""
+        if method == "list_images":
+            return images.list_images() if images else []
+        if method == "image_present":
+            return images.image_present(params["image"]) if images else False
         raise ValueError(f"unhandled CRI method {method!r}")
 
 
@@ -341,3 +353,24 @@ class RemoteRuntime(RuntimeService):
     def set_container_affinity(self, container_id: str, cpus) -> bool:
         return bool(self._call("set_container_affinity", {
             "container_id": container_id, "cpus": sorted(cpus)}))
+
+    @property
+    def images(self) -> "_RemoteImages":
+        """ImageService facade over the socket — imagePullPolicy handling
+        and the kubelet's node.status.images inventory both work for
+        remote runtimes exactly as for in-process ones."""
+        return _RemoteImages(self)
+
+
+class _RemoteImages:
+    def __init__(self, rt: RemoteRuntime):
+        self._rt = rt
+
+    def pull_image(self, image: str) -> str:
+        return self._rt._call("pull_image", {"image": image})
+
+    def list_images(self) -> List[str]:
+        return self._rt._call("list_images") or []
+
+    def image_present(self, image: str) -> bool:
+        return bool(self._rt._call("image_present", {"image": image}))

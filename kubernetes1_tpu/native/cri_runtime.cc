@@ -34,6 +34,7 @@
 
 #include <atomic>
 #include <map>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -148,6 +149,21 @@ class Runtime {
     if (method == "exec_in_container") return exec_in_container(p);
     if (method == "exec_capture") return exec_capture(p);
     if (method == "set_container_affinity") return set_affinity(p);
+    if (method == "pull_image") {
+      std::lock_guard<std::mutex> l(mu_);
+      images_.insert(p.get("image"));
+      return Json(p.get("image"));
+    }
+    if (method == "list_images") {
+      std::lock_guard<std::mutex> l(mu_);
+      JsonArray out;
+      for (const auto& img : images_) out.push_back(Json(img));
+      return Json(out);
+    }
+    if (method == "image_present") {
+      std::lock_guard<std::mutex> l(mu_);
+      return Json(images_.count(p.get("image")) > 0);
+    }
     throw std::runtime_error("unknown CRI method '" + method + "'");
   }
 
@@ -157,6 +173,7 @@ class Runtime {
   std::mutex mu_;
   std::map<std::string, Sandbox> sandboxes_;
   std::map<std::string, Container> containers_;
+  std::set<std::string> images_;  // advisory image inventory (ImageService)
 
   // ------------------------------------------------------------ sandboxes
 

@@ -615,3 +615,129 @@ class TestWebhookTokenAuthn:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+class TestOIDCAuthn:
+    """OIDC-style JWT authn (HS256, zero-egress JWKS stand-in)."""
+
+    def test_valid_token_authenticates_with_issuer_prefix(self):
+        from kubernetes1_tpu.apiserver.auth import (
+            OIDCAuthenticator,
+            mint_oidc_token,
+        )
+
+        a = OIDCAuthenticator("https://idp.corp", "ktpu", "k1")
+        tok = mint_oidc_token("k1", "https://idp.corp", "ktpu", "alice",
+                              groups=["dev"])
+        u = a.authenticate(tok)
+        assert u is not None
+        assert u.name == "https://idp.corp#alice"
+        assert "dev" in u.groups
+
+    def test_rejections(self):
+        from kubernetes1_tpu.apiserver.auth import (
+            OIDCAuthenticator,
+            mint_oidc_token,
+        )
+
+        a = OIDCAuthenticator("https://idp.corp", "ktpu", "k1")
+        # wrong key (signature)
+        assert a.authenticate(mint_oidc_token(
+            "other", "https://idp.corp", "ktpu", "alice")) is None
+        # wrong issuer
+        assert a.authenticate(mint_oidc_token(
+            "k1", "https://evil", "ktpu", "alice")) is None
+        # wrong audience
+        assert a.authenticate(mint_oidc_token(
+            "k1", "https://idp.corp", "other-app", "alice")) is None
+        # expired
+        assert a.authenticate(mint_oidc_token(
+            "k1", "https://idp.corp", "ktpu", "alice", ttl=-10)) is None
+        # not a JWT
+        assert a.authenticate("garbage") is None
+
+    def test_alg_none_rejected(self):
+        import base64
+        import json as _json
+
+        from kubernetes1_tpu.apiserver.auth import OIDCAuthenticator
+
+        def b64e(b):
+            return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+        a = OIDCAuthenticator("https://idp.corp", "ktpu", "k1")
+        header = b64e(_json.dumps({"alg": "none"}).encode())
+        payload = b64e(_json.dumps({"iss": "https://idp.corp",
+                                    "aud": "ktpu", "sub": "x",
+                                    "exp": 9e12}).encode())
+        assert a.authenticate(f"{header}.{payload}.") is None
+
+    def test_end_to_end_with_rbac(self):
+        from kubernetes1_tpu.api import types as t
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.apiserver.auth import mint_oidc_token
+        from kubernetes1_tpu.client import Clientset
+        from kubernetes1_tpu.machinery import ApiError
+
+        master = Master(authorization_mode="Node,RBAC", token="root",
+                        oidc_issuer="https://idp.corp",
+                        oidc_client_id="ktpu",
+                        oidc_hs256_key="sekrit").start()
+        admin = Clientset(master.url, token="root")
+        try:
+            role = t.ClusterRole()
+            role.metadata.name = "oidc-reader"
+            role.rules = [t.PolicyRule(verbs=["list"], resources=["pods"])]
+            admin.clusterroles.create(role, "")
+            rb = t.ClusterRoleBinding()
+            rb.metadata.name = "oidc-reader-b"
+            rb.subjects = [t.Subject(kind="Group", name="platform-team")]
+            rb.role_ref = t.RoleRef(kind="ClusterRole", name="oidc-reader")
+            admin.clusterrolebindings.create(rb, "")
+            tok = mint_oidc_token("sekrit", "https://idp.corp", "ktpu",
+                                  "bob", groups=["platform-team"])
+            bob = Clientset(master.url, token=tok)
+            items, _ = bob.pods.list(namespace="default")
+            assert items == []
+            with pytest.raises(ApiError):
+                bob.nodes.list()  # not granted
+            bob.close()
+        finally:
+            admin.close()
+            master.stop()
+
+    def test_empty_key_refused_and_system_groups_stripped(self):
+        from kubernetes1_tpu.apiserver.auth import (
+            OIDCAuthenticator,
+            mint_oidc_token,
+        )
+
+        with pytest.raises(ValueError):
+            OIDCAuthenticator("https://idp.corp", "ktpu", "")
+        a = OIDCAuthenticator("https://idp.corp", "ktpu", "k1")
+        tok = mint_oidc_token("k1", "https://idp.corp", "ktpu", "mallory",
+                              groups=["system:masters", "dev"])
+        u = a.authenticate(tok)
+        assert "system:masters" not in u.groups and "dev" in u.groups
+
+    def test_non_dict_jwt_segments_rejected_not_crash(self):
+        import base64
+        import json as _json
+
+        from kubernetes1_tpu.apiserver.auth import OIDCAuthenticator
+
+        def b64e(b):
+            return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+        a = OIDCAuthenticator("https://idp.corp", "ktpu", "k1")
+        # list header
+        assert a.authenticate(f"{b64e(b'[]')}.{b64e(b'{}')}.x") is None
+        # validly-signed non-dict payload
+        import hashlib
+        import hmac as _hm
+
+        header = b64e(_json.dumps({"alg": "HS256"}).encode())
+        payload = b64e(_json.dumps("just-a-string").encode())
+        sig = b64e(_hm.new(b"k1", f"{header}.{payload}".encode(),
+                           hashlib.sha256).digest())
+        assert a.authenticate(f"{header}.{payload}.{sig}") is None

@@ -380,3 +380,10 @@ class TestNativeCRIRuntime:
         client.remove_pod_sandbox(sid)  # no explicit stop first
         assert client.list_pod_sandboxes() == []
         assert client.list_containers() == []
+
+    def test_image_service_over_socket(self, native_cri):
+        client, _ = native_cri
+        assert client.images.image_present("jax-train") is False
+        client.images.pull_image("jax-train")
+        assert client.images.image_present("jax-train") is True
+        assert "jax-train" in client.images.list_images()
