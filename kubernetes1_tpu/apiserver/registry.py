@@ -97,7 +97,11 @@ class PodStrategy(Strategy):
         super().validate(obj)
         if not obj.spec.containers:
             raise Invalid("spec.containers must not be empty")
-        names = [c.name for c in obj.spec.containers]
+        # names must be unique across init AND app containers: the kubelet
+        # keys runtime state by (pod, name), so a collision would let an
+        # exited init container masquerade as the app container
+        names = [c.name for c in obj.spec.containers] + [
+            c.name for c in obj.spec.init_containers]
         if len(set(names)) != len(names):
             raise Invalid("duplicate container names")
         seen = set()

@@ -794,9 +794,113 @@ class Kubelet:
         self.container_manager.ensure_pod_cgroup(pod)
 
         sandbox_id = self._ensure_sandbox(pod)
+        # init containers run sequentially to completion BEFORE any app
+        # container starts (ref kuberuntime_manager.go computePodActions:
+        # next init container gates the whole pod)
+        init_state = self._sync_init_containers(pod, sandbox_id)
+        if init_state == "failed":
+            return  # _set_failed already PUT the terminal status
+        if init_state == "wait":
+            self._sync_status(pod)
+            return
         self._sync_containers(pod, sandbox_id)
         self.prober.ensure_pod(pod)
         self._sync_status(pod)
+
+    def _sync_init_containers(self, pod: t.Pod, sandbox_id: str) -> str:
+        """Advance the init-container sequence one sync at a time.
+        Returns "done" (all exited 0), "wait" (in progress / backoff), or
+        "failed" (terminal status already written)."""
+        uid = pod.metadata.uid
+        for container in pod.spec.init_containers:
+            ckey = (uid, container.name)
+            with self._lock:
+                cid = self._containers.get(ckey)
+            record = self.runtime.container_status(cid) if cid else None
+            if record is not None and record.state == CONTAINER_RUNNING:
+                return "wait"  # wait for it; ticker re-syncs
+            if record is not None and record.state not in (
+                    CONTAINER_RUNNING, CONTAINER_EXITED):
+                # CREATED (kubelet died between create and start, record
+                # adopted on restart): start it — falling through here
+                # would skip the init container entirely
+                try:
+                    self.runtime.start_container(record.id)
+                except Exception as e:  # noqa: BLE001
+                    self.recorder.event(pod, "Warning", "FailedStart",
+                                        f"init {container.name}: {e}")
+                return "wait"
+            if record is not None and record.state == CONTAINER_EXITED:
+                if record.exit_code == 0:
+                    continue  # done; on to the next init container
+                # failed init container: Never fails the pod; otherwise the
+                # SAME instance restarts with crash backoff (ref: init
+                # containers restart under OnFailure/Always alike)
+                if pod.spec.restart_policy == "Never":
+                    self._set_failed(
+                        pod, "InitContainerError",
+                        f"init container {container.name} exited "
+                        f"{record.exit_code}")
+                    return "failed"
+                now = time.monotonic()
+                with self._lock:
+                    n = self._restarts.get(ckey, 0)
+                    if now < self._restart_at.get(ckey, 0.0):
+                        return "wait"  # backoff; ticker retries
+                    self._restarts[ckey] = n + 1
+                    self._restart_at[ckey] = now + min(
+                        self.restart_backoff_base * (2**n), 300.0)
+                self.runtime.remove_container(record.id)
+                self.recorder.event(
+                    pod, "Normal", "Restarting",
+                    f"init container {container.name} exited "
+                    f"{record.exit_code}; restarting")
+                record = None
+            if record is None:
+                with self._lock:
+                    if time.monotonic() < self._restart_at.get(ckey, 0.0):
+                        return "wait"
+                try:
+                    config = self._container_config(pod, container)
+                except VolumeNotReady:
+                    return "wait"  # ticker retries once sources appear
+                except VolumeError as e:
+                    self._set_failed(pod, "CreateContainerConfigError", str(e))
+                    return "failed"
+                cid = None  # the looked-up id is stale past this point
+                try:
+                    if hasattr(self.runtime, "images"):
+                        # imagePullPolicy applies to init containers too
+                        # (AlwaysPullImages admission sets it on them)
+                        policy = container.image_pull_policy or "IfNotPresent"
+                        present = self.runtime.images.image_present(
+                            container.image)
+                        if policy == "Always" or (policy != "Never"
+                                                  and not present):
+                            self.runtime.images.pull_image(container.image)
+                    cid = self.runtime.create_container(sandbox_id, config)
+                    self.runtime.start_container(cid)
+                    with self._lock:
+                        self._containers[ckey] = cid
+                    self.recorder.event(
+                        pod, "Normal", "Started",
+                        f"init container {container.name}")
+                except Exception as e:  # noqa: BLE001
+                    if cid is not None:
+                        try:
+                            self.runtime.remove_container(cid)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    now = time.monotonic()
+                    with self._lock:
+                        n = self._restarts.get(ckey, 0)
+                        self._restarts[ckey] = n + 1
+                        self._restart_at[ckey] = now + min(
+                            self.restart_backoff_base * (2**n), 300.0)
+                    self.recorder.event(pod, "Warning", "FailedStart",
+                                        f"init {container.name}: {e}")
+                return "wait"  # started (or failed to): wait for next sync
+        return "done"
 
     ADMISSION_GRACE_SECONDS = 30.0
 

@@ -37,8 +37,10 @@ PRESETS = {
                  n_kv_heads=2, d_ff=128),
     "1b": dict(vocab=32000, d_model=2048, n_layers=22, n_heads=32,
                n_kv_heads=4, d_ff=5632),
-    # TPU-first 1B geometry: identical params/FLOPs to "1b" but
-    # head_dim=128 matches the MXU's 128 lanes (measured +25% MFU on v5e)
+    # TPU-first 1B geometry: head_dim=128 matches the MXU's 128 lanes
+    # (measured +25% MFU on v5e vs "1b"'s hd=64).  NOT flop-identical to
+    # "1b": kv-proj width doubles (4 kv heads x 128), ~+23M params; the
+    # reported MFU is computed from THIS config's analytic flops
     "1b-tpu": dict(vocab=32000, d_model=2048, n_layers=22, n_heads=16,
                    n_kv_heads=4, d_ff=5632),
     "8b": dict(vocab=128256, d_model=4096, n_layers=32, n_heads=32,

@@ -328,3 +328,65 @@ def _stats_mem(base, token) -> int:
             if c["memory_bytes"] > 0:
                 return c["memory_bytes"]
     return 0
+
+
+class TestInitContainers:
+    """Init containers run sequentially to completion before app containers
+    (ref: kuberuntime_manager.go computePodActions init gating)."""
+
+    def test_init_sequence_gates_app_container(self, node_env, tmp_path):
+        cs = node_env["cs"]
+        order = tmp_path / "order.txt"
+        pod = t.Pod()
+        pod.metadata.name = "with-init"
+        pod.spec.restart_policy = "Never"
+        pod.spec.init_containers = [
+            t.Container(name="init-a", image="img",
+                        command=["sh", "-c", f"echo a >> {order}"]),
+            t.Container(name="init-b", image="img",
+                        command=["sh", "-c", f"echo b >> {order}"]),
+        ]
+        pod.spec.containers = [
+            t.Container(name="main", image="img",
+                        command=["sh", "-c", f"echo main >> {order}; sleep 60"]),
+        ]
+        cs.pods.create(pod)
+        wait_phase(cs, "with-init", t.POD_RUNNING, timeout=45)
+        assert order.read_text().split() == ["a", "b", "main"]
+
+    def test_failing_init_fails_pod_with_restart_never(self, node_env):
+        cs = node_env["cs"]
+        pod = t.Pod()
+        pod.metadata.name = "bad-init"
+        pod.spec.restart_policy = "Never"
+        pod.spec.init_containers = [
+            t.Container(name="boom", image="img", command=["sh", "-c", "exit 7"]),
+        ]
+        pod.spec.containers = [
+            t.Container(name="main", image="img", command=["sleep", "60"]),
+        ]
+        cs.pods.create(pod)
+        wait_phase(cs, "bad-init", t.POD_FAILED, timeout=45)
+        # the app container was never created AT ALL (any state)
+        assert all(c.name != "main"
+                   for c in node_env["runtime"].list_containers())
+
+    def test_failing_init_retries_under_onfailure(self, node_env, tmp_path):
+        cs = node_env["cs"]
+        marker = tmp_path / "attempts"
+        pod = t.Pod()
+        pod.metadata.name = "retry-init"
+        pod.spec.restart_policy = "OnFailure"
+        # fails once, then succeeds (state kept on the shared fs)
+        pod.spec.init_containers = [
+            t.Container(name="flaky", image="img", command=[
+                "sh", "-c",
+                f"if [ -f {marker} ]; then exit 0; fi; touch {marker}; exit 1",
+            ]),
+        ]
+        pod.spec.containers = [
+            t.Container(name="main", image="img", command=["sleep", "60"]),
+        ]
+        cs.pods.create(pod)
+        wait_phase(cs, "retry-init", t.POD_RUNNING, timeout=60)
+        assert marker.exists()
