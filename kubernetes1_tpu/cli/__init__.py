@@ -290,7 +290,72 @@ class CLI:
                 "annotations": {"ktpu.io/restartedAt": stamp}}}}}, self.ns)
             print(f"deployment/{name} restarted", file=self.out)
             return
+        if args.action == "history":
+            for rev, rs in self._revisions(name):
+                cause = (rs.spec.template.metadata.annotations or {}).get(
+                    "ktpu.io/change-cause", "<none>")
+                print(f"{rev}\t{cause}", file=self.out)
+            return
+        if args.action == "undo":
+            # unstamped RSes (rev 0: controller hasn't caught up) are not
+            # valid rollback targets and must not shift the ordering
+            revisions = [(rev, rs) for rev, rs in self._revisions(name)
+                         if rev > 0]
+            if not revisions:
+                raise SystemExit(f"error: no rollout history for {name}")
+            if args.to_revision:
+                match = [rs for rev, rs in revisions
+                         if rev == args.to_revision]
+                if not match:
+                    raise SystemExit(
+                        f"error: revision {args.to_revision} not found")
+                target = match[0]
+            else:
+                if len(revisions) < 2:
+                    raise SystemExit("error: no previous revision to roll "
+                                     "back to")
+                target = revisions[-2][1]  # second-newest = previous
+            # rollback = wholesale template REPLACE (kubectl semantics: a
+            # merge patch would leave post-target keys behind), via
+            # read-modify-write with conflict retry
+            from ..controllers.deployment import template_hash
+            from ..machinery import Conflict
+            from ..machinery.scheme import from_dict, to_dict
+
+            tmpl_doc = to_dict(target.spec.template)
+            labels = (tmpl_doc.get("metadata") or {}).get("labels") or {}
+            labels.pop("pod-template-hash", None)
+            new_tmpl = from_dict(t.PodTemplateSpec, tmpl_doc)
+            for _attempt in range(5):
+                dep = self.cs.deployments.get(name, self.ns)
+                if template_hash(dep.spec.template) == template_hash(new_tmpl):
+                    print(f"deployment/{name} skipped rollback (current "
+                          f"template already matches)", file=self.out)
+                    return
+                dep.spec.template = new_tmpl
+                try:
+                    self.cs.deployments.update(dep)
+                    break
+                except Conflict:
+                    continue
+            else:
+                raise SystemExit("error: rollback kept conflicting; retry")
+            print(f"deployment/{name} rolled back", file=self.out)
+            return
         raise SystemExit(f"error: unknown rollout action {args.action!r}")
+
+    def _revisions(self, name):
+        """Owned ReplicaSets sorted by revision annotation (rollout
+        history's data source)."""
+        dep = self.cs.deployments.get(name, self.ns)
+        rsets, _ = self.cs.replicasets.list(namespace=self.ns)
+        owned = [rs for rs in rsets
+                 if any(ref.uid == dep.metadata.uid
+                        for ref in rs.metadata.owner_references)]
+        from ..controllers.deployment import revision_of
+
+        return sorted(((revision_of(rs), rs) for rs in owned),
+                      key=lambda p: p[0])
 
     # ------------------------------------------- logs / exec / port-forward
 
@@ -678,9 +743,11 @@ def build_parser() -> argparse.ArgumentParser:
     at.add_argument("-c", "--container", default="")
 
     ro = sub.add_parser("rollout")
-    ro.add_argument("action", choices=["status", "restart"])
+    ro.add_argument("action", choices=["status", "restart", "history", "undo"])
     ro.add_argument("target")
     ro.add_argument("--timeout", type=float, default=60)
+    ro.add_argument("--to-revision", type=int, default=0,
+                    help="undo: target revision (default: previous)")
 
     lg = sub.add_parser("logs")
     lg.add_argument("pod")

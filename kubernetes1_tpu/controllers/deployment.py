@@ -16,6 +16,19 @@ from .base import Controller, write_status_if_changed
 HASH_LABEL = "pod-template-hash"
 
 
+REVISION_ANNOTATION = "deployment.ktpu.io/revision"
+
+
+def revision_of(rs) -> int:
+    """The RS's stamped rollout revision; 0 = not yet stamped by the
+    controller (shared by the controller and `ktpu rollout`)."""
+    try:
+        return int((rs.metadata.annotations or {})
+                   .get(REVISION_ANNOTATION, "0"))
+    except ValueError:
+        return 0
+
+
 def template_hash(spec: t.PodTemplateSpec) -> str:
     canon = json.dumps(to_dict(spec), sort_keys=True)
     return hashlib.sha1(canon.encode()).hexdigest()[:10]
@@ -80,6 +93,7 @@ class DeploymentController(Controller):
             new_rs = self._create_rs(dep, want_hash, initial=0 if old else replicas)
             if new_rs is None:
                 return
+        new_rs = self._ensure_revision(new_rs, old)
 
         if dep.spec.strategy.type == "Recreate":
             if any((rs.spec.replicas or 0) > 0 for rs in old):
@@ -91,6 +105,24 @@ class DeploymentController(Controller):
             self._rolling(dep, new_rs, old, replicas)
         self._cleanup_old(dep, old)
         self._update_status(dep, new_rs, owned)
+
+    def _ensure_revision(self, new_rs: t.ReplicaSet,
+                         old: List[t.ReplicaSet]) -> t.ReplicaSet:
+        """Revision bookkeeping (ref: deployment_util.go maxRevision/
+        SetNewReplicaSetAnnotations): the active RS always carries the
+        highest revision — a rollback reuses an OLD RS, which then gets a
+        fresh max+1 number rather than its historical one."""
+        max_old = max([revision_of(rs) for rs in old] or [0])
+        if revision_of(new_rs) > max_old:
+            return new_rs
+        try:
+            return self.cs.replicasets.patch(
+                new_rs.metadata.name,
+                {"metadata": {"annotations": {
+                    REVISION_ANNOTATION: str(max_old + 1)}}},
+                new_rs.metadata.namespace)
+        except ApiError:
+            return new_rs
 
     def _create_rs(self, dep: t.Deployment, hash_: str, initial: int) -> Optional[t.ReplicaSet]:
         rs = t.ReplicaSet()

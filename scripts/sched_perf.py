@@ -195,8 +195,13 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
     # backlog would make the SLO numbers measure backoff churn instead
     if throughput > 0 and free_chips > 10 and len(bound) >= pods \
             and not os.environ.get("KTPU_SCHED_PERF_SKIP_STEADY"):
+        # 0.4x measured saturation: the SLO claim is about steady-state
+        # latency, not peak rate, and the saturation number itself is
+        # optimistic when external load appears mid-run — 0.6x was observed
+        # to overload (p99 4.5s) on a box sharing its one CPU with a
+        # concurrent test suite while 0.4x stays in the ms regime
         steady = _steady_state(
-            url, rate=min(100.0, max(5.0, throughput * 0.6)), duration=20.0,
+            url, rate=min(80.0, max(5.0, throughput * 0.4)), duration=20.0,
             max_pods=free_chips)
 
     mx = scrape_metrics(metrics_url) if metrics_url else {}

@@ -190,3 +190,70 @@ def test_edit_verb(cluster, tmp_path, monkeypatch):
     got = json.loads(run_cli(cluster, "get", "configmaps", "edit-me",
                              "-o", "json"))
     assert got["data"]["k"] == "v1"
+
+
+def test_rollout_history_and_undo(cluster):
+    import time as _t
+
+    manifest = {
+        "kind": "Deployment", "apiVersion": "apps/v1",
+        "metadata": {"name": "rollme"},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "rollme"}},
+            "template": {
+                "metadata": {"labels": {"app": "rollme"},
+                             "annotations": {"ktpu.io/change-cause": "v1"}},
+                "spec": {"containers": [{"name": "c", "image": "img:v1",
+                                         "command": ["sleep", "60"]}]},
+            },
+        },
+    }
+    import tempfile
+
+    import yaml as _yaml
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml",
+                                     delete=False) as f:
+        _yaml.safe_dump(manifest, f)
+        path = f.name
+    run_cli(cluster, "apply", "-f", path)
+    # rev 2: new image
+    manifest["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+    manifest["spec"]["template"]["metadata"]["annotations"][
+        "ktpu.io/change-cause"] = "v2"
+    with open(path, "w") as f:
+        _yaml.safe_dump(manifest, f)
+    run_cli(cluster, "apply", "-f", path)
+
+    deadline = _t.time() + 20
+    while _t.time() < deadline:
+        out = run_cli(cluster, "rollout", "history", "deployment/rollme")
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        if len(lines) >= 2:
+            break
+        _t.sleep(0.3)
+    assert any(ln.startswith("1\t") and "v1" in ln for ln in lines), lines
+    assert any(ln.startswith("2\t") and "v2" in ln for ln in lines), lines
+
+    out = run_cli(cluster, "rollout", "undo", "deployment/rollme")
+    assert "rolled back" in out
+    from kubernetes1_tpu.client import Clientset
+
+    cs = Clientset(cluster.url)
+    try:
+        dep = cs.deployments.get("rollme")
+        assert dep.spec.template.spec.containers[0].image == "img:v1"
+        # the rolled-back template becomes the NEW highest revision
+        deadline = _t.time() + 20
+        top = None
+        while _t.time() < deadline:
+            out = run_cli(cluster, "rollout", "history", "deployment/rollme")
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            top = lines[-1] if lines else None
+            if top and top.startswith("3\t"):
+                break
+            _t.sleep(0.3)
+        assert top is not None and top.startswith("3\t"), lines
+    finally:
+        cs.close()
