@@ -297,50 +297,59 @@ class CLI:
                 print(f"{rev}\t{cause}", file=self.out)
             return
         if args.action == "undo":
-            # unstamped RSes (rev 0: controller hasn't caught up) are not
-            # valid rollback targets and must not shift the ordering
-            revisions = [(rev, rs) for rev, rs in self._revisions(name)
-                         if rev > 0]
-            if not revisions:
+            # an unstamped RS (rev 0: controller hasn't caught up) is the
+            # NEWEST template, not a missing one — order it last so the
+            # default "previous" target stays correct, but never offer it
+            # as a rollback target itself
+            all_revs = self._revisions(name)
+            if not all_revs:
                 raise SystemExit(f"error: no rollout history for {name}")
+            ordered = sorted(
+                all_revs,
+                key=lambda p: (p[0] if p[0] > 0 else float("inf"),
+                               p[1].metadata.creation_timestamp))
+            stamped = [(rev, rs) for rev, rs in ordered if rev > 0]
             if args.to_revision:
-                match = [rs for rev, rs in revisions
+                match = [rs for rev, rs in stamped
                          if rev == args.to_revision]
                 if not match:
                     raise SystemExit(
                         f"error: revision {args.to_revision} not found")
                 target = match[0]
             else:
-                if len(revisions) < 2:
+                candidates = [rs for rev, rs in ordered[:-1] if rev > 0]
+                if not candidates:
                     raise SystemExit("error: no previous revision to roll "
                                      "back to")
-                target = revisions[-2][1]  # second-newest = previous
+                target = candidates[-1]  # newest stamped below current
             # rollback = wholesale template REPLACE (kubectl semantics: a
             # merge patch would leave post-target keys behind), via
             # read-modify-write with conflict retry
             from ..controllers.deployment import template_hash
-            from ..machinery import Conflict
             from ..machinery.scheme import from_dict, to_dict
 
             tmpl_doc = to_dict(target.spec.template)
             labels = (tmpl_doc.get("metadata") or {}).get("labels") or {}
             labels.pop("pod-template-hash", None)
             new_tmpl = from_dict(t.PodTemplateSpec, tmpl_doc)
-            for _attempt in range(5):
+            from ..client.retry import retry_on_conflict
+
+            outcome = {}
+
+            def attempt():
                 dep = self.cs.deployments.get(name, self.ns)
                 if template_hash(dep.spec.template) == template_hash(new_tmpl):
-                    print(f"deployment/{name} skipped rollback (current "
-                          f"template already matches)", file=self.out)
+                    outcome["skipped"] = True
                     return
                 dep.spec.template = new_tmpl
-                try:
-                    self.cs.deployments.update(dep)
-                    break
-                except Conflict:
-                    continue
+                self.cs.deployments.update(dep)
+
+            retry_on_conflict(attempt)
+            if outcome.get("skipped"):
+                print(f"deployment/{name} skipped rollback (current "
+                      f"template already matches)", file=self.out)
             else:
-                raise SystemExit("error: rollback kept conflicting; retry")
-            print(f"deployment/{name} rolled back", file=self.out)
+                print(f"deployment/{name} rolled back", file=self.out)
             return
         raise SystemExit(f"error: unknown rollout action {args.action!r}")
 

@@ -115,14 +115,13 @@ class DeploymentController(Controller):
         max_old = max([revision_of(rs) for rs in old] or [0])
         if revision_of(new_rs) > max_old:
             return new_rs
-        try:
-            return self.cs.replicasets.patch(
-                new_rs.metadata.name,
-                {"metadata": {"annotations": {
-                    REVISION_ANNOTATION: str(max_old + 1)}}},
-                new_rs.metadata.namespace)
-        except ApiError:
-            return new_rs
+        # a failed stamp must propagate: the worker requeues with backoff,
+        # so the active RS never silently stays at revision 0
+        return self.cs.replicasets.patch(
+            new_rs.metadata.name,
+            {"metadata": {"annotations": {
+                REVISION_ANNOTATION: str(max_old + 1)}}},
+            new_rs.metadata.namespace)
 
     def _create_rs(self, dep: t.Deployment, hash_: str, initial: int) -> Optional[t.ReplicaSet]:
         rs = t.ReplicaSet()

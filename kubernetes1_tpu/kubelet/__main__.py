@@ -25,17 +25,25 @@ def main():
     ap.add_argument("--static-pod-dir", default="")
     ap.add_argument("--root-dir", default="/tmp/ktpu")
     ap.add_argument("--label", action="append", default=[], help="k=v node label")
+    ap.add_argument("--container-runtime-endpoint", default="",
+                    help="unix socket of a remote CRI runtime (e.g. the "
+                         "native ktpu-cri-runtime); overrides --runtime")
+    ap.add_argument("--cpu-manager-policy", choices=["none", "static"],
+                    default="none")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
     cs = Clientset(args.server, token=args.token)
-    runtime = (
-        ProcessRuntime(root_dir=args.root_dir)
-        if args.runtime == "process"
-        else FakeRuntime()
-    )
+    if args.container_runtime_endpoint:
+        from .cri import RemoteRuntime
+
+        runtime = RemoteRuntime(args.container_runtime_endpoint)
+    elif args.runtime == "process":
+        runtime = ProcessRuntime(root_dir=args.root_dir)
+    else:
+        runtime = FakeRuntime()
     labels = dict(kv.split("=", 1) for kv in args.label)
     kubelet = Kubelet(
         cs,
@@ -44,9 +52,13 @@ def main():
         plugin_dir=args.plugin_dir,
         static_pod_dir=args.static_pod_dir or None,
         node_labels=labels,
+        cpu_manager_policy=args.cpu_manager_policy,
     )
     kubelet.start()
-    print(f"kubelet {args.node_name} running ({args.runtime} runtime)", flush=True)
+    runtime_desc = (f"remote CRI {args.container_runtime_endpoint}"
+                    if args.container_runtime_endpoint else
+                    f"{args.runtime} runtime")
+    print(f"kubelet {args.node_name} running ({runtime_desc})", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -213,6 +213,8 @@ def test_rollout_history_and_undo(cluster):
 
     import yaml as _yaml
 
+    import os as _os
+
     with tempfile.NamedTemporaryFile("w", suffix=".yaml",
                                      delete=False) as f:
         _yaml.safe_dump(manifest, f)
@@ -257,3 +259,4 @@ def test_rollout_history_and_undo(cluster):
         assert top is not None and top.startswith("3\t"), lines
     finally:
         cs.close()
+        _os.unlink(path)
