@@ -592,7 +592,12 @@ class _Handler(BaseHTTPRequestHandler):
         created = self._with_quota_serialization(
             resource, ns or obj.metadata.namespace, admit_and_create
         )
-        self.master.audit("create", resource, ns, created.metadata.name,
+        # audit with the effective namespace: creates may carry the ns only
+        # in the object body (no-ns URL form), and namespace-scoped audit
+        # rules must still match those writes
+        self.master.audit("create", resource,
+                          ns or created.metadata.namespace,
+                          created.metadata.name,
                           self._user.name, request_obj=body,
                           response_obj=lambda: self.master.scheme.encode(created))
         if resource == "customresourcedefinitions":

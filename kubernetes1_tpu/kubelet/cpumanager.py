@@ -35,6 +35,11 @@ POLICY_NONE = "none"
 POLICY_STATIC = "static"
 
 
+class CPUExhaustedError(Exception):
+    """Exclusive-cpu pool can't cover a Guaranteed integer-cpu request
+    (ref policy_static.go Allocate error path)."""
+
+
 # ------------------------------------------------------------------ topology
 
 @dataclass(frozen=True)
@@ -205,7 +210,7 @@ class CPUManager:
     def __init__(self, policy: str = POLICY_NONE,
                  topology: Optional[CPUTopology] = None,
                  state_path: str = "",
-                 reserved_cpus: int = 0):
+                 reserved_cpus: Optional[int] = None):
         self.policy = policy
         self._lock = threading.Lock()
         # called (with no args, outside the lock) whenever the shared pool
@@ -223,7 +228,12 @@ class CPUManager:
         self.state = CPUManagerState(state_path)
         all_cpus = {c.cpu for c in self.topology.cpus}
         # reserved cpus stay in the shared pool permanently (system overhead,
-        # ref policy_static.go reserved); lowest-numbered cpus by convention
+        # ref policy_static.go reserved); lowest-numbered cpus by convention.
+        # The static policy REQUIRES a nonzero reserve upstream (the kubelet
+        # refuses to start otherwise) — default to one cpu so the shared pool
+        # can never drain to nothing and void exclusivity for everyone.
+        if reserved_cpus is None:
+            reserved_cpus = 1
         self._reserved = set(sorted(all_cpus)[:reserved_cpus])
         if not self.state.load():
             self.state.default_cpuset = set(all_cpus)
@@ -235,6 +245,16 @@ class CPUManager:
             assigned = set()
             for k in list(self.state.entries):
                 self.state.entries[k] &= known
+                # a checkpoint written under a different reserve may have
+                # handed a now-reserved cpu out exclusively; reclaim it so
+                # the reserved-fallback pool never overlaps an exclusive
+                # assignment (the repin callback re-pins live containers)
+                self.state.entries[k] -= self._reserved
+                if not self.state.entries[k]:
+                    # fully reclaimed: drop the entry so the container is
+                    # reallocated on its next lookup instead of pinned to {}
+                    del self.state.entries[k]
+                    continue
                 assigned |= self.state.entries[k]
             missing = known - self.state.default_cpuset - assigned
             self.state.default_cpuset |= missing
@@ -264,10 +284,14 @@ class CPUManager:
             try:
                 picked = take_by_topology(self.topology, allocatable, want)
             except ValueError:
-                # not enough exclusive cpus: fall back to the shared pool
-                # rather than failing the pod (admission already fit cpu
-                # capacity; exclusivity is best-effort beyond that)
-                return self._shared_pool_locked()
+                # not enough exclusive cpus: fail the container start (ref
+                # policy_static.go Allocate returns an error) — a silent
+                # shared-pool fallback would void the exclusivity other
+                # Guaranteed containers were promised.  The kubelet turns
+                # this into FailedStart + backoff, so freed cpus are retried.
+                raise CPUExhaustedError(
+                    f"not enough exclusive cpus for {key}: want {want}, "
+                    f"allocatable {len(allocatable)}")
             self.state.entries[key] = picked
             self.state.default_cpuset -= picked
             self.state.save()

@@ -34,6 +34,7 @@ import json
 import os
 import socket
 import threading
+import time
 import traceback
 from typing import Dict, List, Optional
 
@@ -222,6 +223,7 @@ class RemoteRuntime(RuntimeService):
         self._lock = threading.Lock()
         self._next_id = 0
         self._caps: Optional[dict] = None
+        self._ever_connected = False
 
     def _capabilities(self) -> dict:
         if self._caps is None:
@@ -246,11 +248,27 @@ class RemoteRuntime(RuntimeService):
 
     # ----------------------------------------------------------- transport
 
-    def _connect(self):
-        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        conn.settimeout(self.timeout)
-        conn.connect(self.socket_path)
-        return conn, conn.makefile("rwb")
+    def _connect(self, retry_window: float = 5.0):
+        # Bounded dial retry ONLY until the first successful connection: the
+        # runtime is typically spawned concurrently with the kubelet and its
+        # listener may lag by a beat (upstream kubelet blocks on the CRI
+        # socket too, cmd/kubelet/app/server.go).  Once the runtime has been
+        # reachable, reconnects fail fast — a crashed runtime must not turn
+        # every PLEG relist into a 5s blocking loop.
+        deadline = time.monotonic() + (
+            retry_window if not self._ever_connected else 0.0)
+        while True:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(self.timeout)
+            try:
+                conn.connect(self.socket_path)
+                self._ever_connected = True
+                return conn, conn.makefile("rwb")
+            except (ConnectionRefusedError, FileNotFoundError):
+                conn.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
 
     def _call(self, method: str, params: Optional[dict] = None):
         with self._lock:
@@ -278,9 +296,28 @@ class RemoteRuntime(RuntimeService):
             except OSError:
                 pass
             raise ConnectionError(f"CRI runtime {self.socket_path} closed")
+        # Parse + match the response id BEFORE re-pooling: a corrupt or
+        # misaligned frame means this connection is desynchronized and must
+        # not be reused by a later call.
+        try:
+            resp = json.loads(line)
+        except ValueError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"CRI runtime {self.socket_path}: corrupt response frame")
+        if resp.get("id") != rid:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"CRI runtime {self.socket_path}: response id mismatch "
+                f"(got {resp.get('id')!r}, want {rid})")
         with self._lock:
             self._pool.append(pair)
-        resp = json.loads(line)
         if resp.get("error"):
             raise RuntimeError(f"CRI {method}: {resp['error']}")
         return resp.get("result")

@@ -40,7 +40,7 @@ from .runtime import (
     RuntimeService,
 )
 from .containermanager import ContainerManager
-from .cpumanager import POLICY_NONE, CPUManager
+from .cpumanager import POLICY_NONE, CPUExhaustedError, CPUManager
 from .volumemanager import VolumeError, VolumeManager, VolumeNotReady
 
 
@@ -99,8 +99,14 @@ class Kubelet:
         # cgroup enforcement only makes sense for runtimes with real
         # processes: hollow/Fake runtimes (30k-pod scale tests) must not
         # create 30k cgroup dirs.  ProcessRuntime advertises via real_pids.
+        # For a RemoteRuntime this is a live socket call against a runtime
+        # that may still be starting (kubelet + runtime boot concurrently);
+        # the upstream kubelet blocks on the CRI socket before proceeding
+        # (cmd/kubelet/app/server.go), so wait briefly rather than freezing
+        # a False answer for the life of the process.
+        real_pids = self._probe_real_pids(runtime)
         if enforce_cgroups is None:
-            enforce_cgroups = bool(getattr(runtime, "real_pids", False))
+            enforce_cgroups = real_pids
         self.container_manager = ContainerManager(
             node_name,
             system_reserved=system_reserved,
@@ -111,7 +117,7 @@ class Kubelet:
         state_dir = cpu_manager_state_dir or runtime_root or ""
         self.cpu_manager = CPUManager(
             policy=(cpu_manager_policy or POLICY_NONE)
-            if getattr(runtime, "real_pids", False) else POLICY_NONE,
+            if real_pids else POLICY_NONE,
             state_path=os.path.join(state_dir, "cpu_manager_state.json")
             if state_dir else "",
         )
@@ -167,6 +173,30 @@ class Kubelet:
             evict_fn=self._evict_pod,
             list_pods=self._my_pods,
         )
+
+    @staticmethod
+    def _probe_real_pids(runtime, wait: float = 10.0) -> bool:
+        """Resolve runtime.real_pids, waiting out a not-yet-listening CRI
+        endpoint first.  RemoteRuntime.real_pids swallows dial failures and
+        answers False, so probe reachability via version() (which raises);
+        once the endpoint answers anything, real_pids is authoritative —
+        RemoteRuntime deliberately doesn't cache failed capability reads."""
+        deadline = time.monotonic() + wait
+        probe = getattr(runtime, "version", None)
+        while callable(probe):
+            try:
+                probe()
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(0.2)
+            except RuntimeError:
+                # the endpoint answered (an error response still needed a
+                # full round-trip; in-process stubs may not implement
+                # version at all) — reachability is established
+                break
+        return bool(getattr(runtime, "real_pids", False))
 
     # ---------------------------------------------------------------- start
 
@@ -864,6 +894,18 @@ class Kubelet:
                     config = self._container_config(pod, container)
                 except VolumeNotReady:
                     return "wait"  # ticker retries once sources appear
+                except CPUExhaustedError as e:
+                    # exclusive-cpu exhaustion: same FailedStart + backoff as
+                    # app containers — releases free cpus, the ticker retries
+                    now = time.monotonic()
+                    with self._lock:
+                        n = self._restarts.get(ckey, 0)
+                        self._restarts[ckey] = n + 1
+                        self._restart_at[ckey] = now + min(
+                            self.restart_backoff_base * (2**n), 300.0)
+                    self.recorder.event(pod, "Warning", "FailedStart",
+                                        f"init {container.name}: {e}")
+                    return "wait"
                 except VolumeError as e:
                     self._set_failed(pod, "CreateContainerConfigError", str(e))
                     return "failed"
@@ -1024,6 +1066,20 @@ class Kubelet:
             cid = None
             try:
                 config = self._container_config(pod, container)
+            except CPUExhaustedError as e:
+                # exclusive-cpu pool exhausted (ref policy_static.go fails
+                # the container): backoff + retry — releases free cpus
+                with self._lock:
+                    n = self._restarts.get(ckey, 0)
+                    self._restarts[ckey] = n + 1
+                    self._restart_at[ckey] = time.monotonic() + min(
+                        self.restart_backoff_base * (2**n), 300.0
+                    )
+                self.recorder.event(
+                    pod, "Warning", "FailedStart",
+                    f"container {container.name}: {e}",
+                )
+                continue
             except VolumeNotReady as e:
                 # transient (envFrom source not yet visible): per-tick retry,
                 # not the exponential FailedStart backoff

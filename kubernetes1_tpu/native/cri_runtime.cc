@@ -284,8 +284,20 @@ class Runtime {
         throw std::runtime_error("no such container " + id);
       if (it->second.state != "CREATED")
         throw std::runtime_error("container " + id + " already started");
+      // claim under the lock: the fork below runs unlocked, and a second
+      // concurrent start for the same id must not also pass the CREATED
+      // check (it would leak one forked process)
+      it->second.state = "STARTING";
       snapshot = it->second;
     }
+    // from here on, any failure before the pid is recorded must surrender
+    // the claim so a retry can start the container
+    auto unclaim = [&]() {
+      std::lock_guard<std::mutex> l(mu_);
+      auto it = containers_.find(id);
+      if (it != containers_.end() && it->second.state == "STARTING")
+        it->second.state = "CREATED";
+    };
     // ---- everything allocated BEFORE fork: a multithreaded parent must
     // not malloc between fork and exec (another thread may hold the heap
     // lock at fork time and the child would deadlock — same reason the
@@ -361,6 +373,7 @@ class Runtime {
                      O_WRONLY | O_CREAT | O_APPEND, 0644);
     if (logfd < 0) {
       for (int fd : cgroup_fds) close(fd);
+      unclaim();
       throw std::runtime_error("cannot open log file");
     }
     const char* wd =
@@ -372,6 +385,7 @@ class Runtime {
     if (pid < 0) {
       close(logfd);
       for (int fd : cgroup_fds) close(fd);
+      unclaim();
       throw std::runtime_error("fork failed");
     }
     if (pid == 0) {
@@ -446,22 +460,35 @@ class Runtime {
       usleep(50 * 1000);
     }
     if (pid > 0) kill(-pid, SIGKILL);
+    // bounded post-SIGKILL reap: never hold mu_ across a blocking waitpid —
+    // a child lingering in uninterruptible sleep would stall every CRI RPC.
+    // reap_locked (WNOHANG) under short lock holds instead.
+    double kill_deadline = now_s() + 2.0;
+    while (now_s() < kill_deadline) {
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        auto it = containers_.find(id);
+        if (it == containers_.end()) return;
+        reap_locked(it->second);
+        if (it->second.state != "RUNNING") return;
+      }
+      usleep(10 * 1000);
+    }
     std::lock_guard<std::mutex> l(mu_);
     auto it = containers_.find(id);
     if (it != containers_.end() && it->second.state == "RUNNING") {
-      // use the REAL status when the process beat the SIGKILL to the exit;
-      // only an actual kill is reported as 137
-      int status = 0;
-      pid_t r = waitpid(it->second.pid, &status, 0);
+      // still not reaped (D-state straggler): record the kill as the
+      // outcome and hand the eventual zombie to a detached reaper so the
+      // pid table entry is released whenever the kernel lets go
+      pid_t stuck = it->second.pid;
       it->second.state = "EXITED";
       it->second.has_exit = true;
-      if (r == it->second.pid && WIFEXITED(status))
-        it->second.exit_code = WEXITSTATUS(status);
-      else if (r == it->second.pid && WIFSIGNALED(status))
-        it->second.exit_code = 128 + WTERMSIG(status);
-      else
-        it->second.exit_code = 137;
+      it->second.exit_code = 137;
       it->second.finished_at = now_s();
+      std::thread([stuck] {
+        int status = 0;
+        waitpid(stuck, &status, 0);
+      }).detach();
     }
   }
 
@@ -489,7 +516,10 @@ class Runtime {
     o["sandbox_id"] = Json(c.sandbox_id);
     o["name"] = Json(c.name);
     o["image"] = Json(c.image);
-    o["state"] = Json(c.state);
+    // STARTING is an internal claim (start in flight, pid not yet
+    // recorded); on the wire it is still a created-not-running container
+    o["state"] = Json(c.state == "STARTING" ? std::string("CREATED")
+                                            : c.state);
     o["exit_code"] = c.has_exit ? Json(c.exit_code) : Json();
     o["started_at"] = Json(c.started_at);
     o["finished_at"] = Json(c.finished_at);

@@ -7,9 +7,12 @@ and state/state_file.go:45-119.
 import os
 
 from kubernetes1_tpu.api import types as t
+import pytest
+
 from kubernetes1_tpu.kubelet.cpumanager import (
     POLICY_NONE,
     POLICY_STATIC,
+    CPUExhaustedError,
     CPUManager,
     CPUTopology,
     take_by_topology,
@@ -118,15 +121,26 @@ class TestStaticPolicy:
         b = m.cpuset_for_container(pod, pod.spec.containers[0])
         assert a == b
 
-    def test_exhaustion_falls_back_to_shared(self, tmp_path):
-        m = self.mgr(tmp_path, sockets=1, cores=2, threads=1)  # 2 cpus
-        p1 = guaranteed_pod("u1", cpu="2")
-        m.cpuset_for_container(p1, p1.spec.containers[0])
+    def test_exhaustion_fails_container(self, tmp_path):
+        # ref policy_static.go: exclusive exhaustion is an allocation ERROR,
+        # never a silent fallback onto someone else's exclusive cores
+        m = self.mgr(tmp_path, sockets=1, cores=2, threads=1)  # 2 cpus, 1 reserved
+        p1 = guaranteed_pod("u1", cpu="1")
+        assert m.cpuset_for_container(p1, p1.spec.containers[0]) == {1}
         p2 = guaranteed_pod("u2", cpu="1")
-        got = m.cpuset_for_container(p2, p2.spec.containers[0])
-        # pool empty, no reserved -> None (no pinning), not a crash and
-        # never an empty set (which taskset would treat as unpinned anyway)
-        assert got is None
+        with pytest.raises(CPUExhaustedError):
+            m.cpuset_for_container(p2, p2.spec.containers[0])
+        # non-exclusive containers still land on the reserved shared pool
+        bpod = make_pod("u3", cpu="500m")
+        assert m.cpuset_for_container(bpod, bpod.spec.containers[0]) == {0}
+
+    def test_default_reserve_keeps_one_cpu_shared(self, tmp_path):
+        # static policy defaults to reserving cpu 0 (upstream mandates a
+        # nonzero system reserve) so the shared pool can never fully drain
+        m = self.mgr(tmp_path)  # 8 cpus
+        p1 = guaranteed_pod("u1", cpu="7")
+        got = m.cpuset_for_container(p1, p1.spec.containers[0])
+        assert len(got) == 7 and 0 not in got
 
     def test_checkpoint_survives_restart(self, tmp_path):
         m = self.mgr(tmp_path)
@@ -177,13 +191,15 @@ class TestRuntimeWrap:
 
 class TestPoolChangeRepin:
     def test_empty_pool_falls_back_to_reserved_or_none(self, tmp_path):
+        # explicit reserved_cpus=0 is the escape hatch that allows a fully
+        # drained shared pool; the lookup then answers None (pin nowhere is
+        # better than an empty-set no-op that unpins from everything)
         m = CPUManager(policy=POLICY_STATIC,
                        topology=CPUTopology.synthetic(1, 2, 1),
-                       state_path=str(tmp_path / "s.json"))
+                       state_path=str(tmp_path / "s.json"),
+                       reserved_cpus=0)
         p1 = guaranteed_pod("u1", cpu="2")
         m.cpuset_for_container(p1, p1.spec.containers[0])
-        # pool empty, no reserved -> None (pin nowhere is better than
-        # an empty-set no-op that unpins from everything)
         bpod = make_pod("u2", cpu="500m")
         assert m.cpuset_for_container(bpod, bpod.spec.containers[0]) is None
 
