@@ -40,6 +40,11 @@ def main():
                     help="shared HS256 verification key file")
     ap.add_argument("--oidc-username-claim", default="sub")
     ap.add_argument("--oidc-groups-claim", default="groups")
+    ap.add_argument("--tls-cert-file", default="",
+                    help="serve HTTPS with this cert (no plaintext fallback)")
+    ap.add_argument("--tls-key-file", default="")
+    ap.add_argument("--client-ca-file", default="",
+                    help="CA bundle for x509 client-cert authn")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
@@ -70,6 +75,9 @@ def main():
         oidc_hs256_key=read_key(args.oidc_hs256_key_file, ""),
         oidc_username_claim=args.oidc_username_claim,
         oidc_groups_claim=args.oidc_groups_claim,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_key_file,
+        client_ca_file=args.client_ca_file,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -53,6 +53,7 @@ from .admission import (
 )
 from .auth import (
     ANONYMOUS,
+    GROUP_AUTHENTICATED,
     GROUP_MASTERS,
     AlwaysAllowAuthorizer,
     AuthenticatorChain,
@@ -85,6 +86,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
+    def setup(self):
+        # TLS handshake runs HERE, in the per-connection thread — wrapping
+        # the listener with do_handshake_on_connect=False keeps a slow or
+        # plaintext client from stalling the accept loop for everyone
+        handshake = getattr(self.request, "do_handshake", None)
+        if handshake is not None:
+            handshake()
+        super().setup()
+
     # ------------------------------------------------------------- plumbing
 
     @property
@@ -114,6 +124,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _authn(self) -> UserInfo:
         """Resolve the request's user (ref: authn filter, config.go:530).
         Raises Unauthorized for a presented-but-invalid credential."""
+        # x509 first: a verified client certificate on the TLS connection IS
+        # the identity (CN=user, O=groups; ref authenticator/request/x509) —
+        # the handshake already proved possession against the client CA
+        x509_user = self._peer_cert_user()
+        if x509_user is not None:
+            return x509_user
         header = self.headers.get("Authorization", "")
         if not header.startswith("Bearer "):
             if self.master.token or self.master.authorization_mode != "AlwaysAllow":
@@ -124,6 +140,28 @@ class _Handler(BaseHTTPRequestHandler):
         if user is None:
             raise Unauthorized("invalid bearer token")
         return user
+
+    def _peer_cert_user(self) -> Optional[UserInfo]:
+        """UserInfo from the verified TLS peer certificate, if any."""
+        getpeercert = getattr(self.connection, "getpeercert", None)
+        if getpeercert is None:
+            return None
+        try:
+            cert = getpeercert()
+        except (ValueError, OSError):
+            return None
+        if not cert:
+            return None  # no client cert presented (token path instead)
+        name, orgs = "", []
+        for rdn in cert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+                elif key == "organizationName":
+                    orgs.append(value)
+        if not name:
+            return None
+        return UserInfo(name=name, groups=orgs + [GROUP_AUTHENTICATED])
 
     def _check_kind(self, resource: str, obj):
         """The body's kind must be the resource's registered kind — the
@@ -249,10 +287,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             user = self._authn()
             # legacy single-token mode: the shared secret IS the cluster
+            # (a verified client certificate is an equally strong credential)
             if self.master.token and self.master.authorization_mode == "AlwaysAllow":
-                if self.headers.get("Authorization", "") != f"Bearer {self.master.token}":
-                    raise Unauthorized("invalid bearer token")
-                user = UserInfo(name="system:admin", groups=[GROUP_MASTERS])
+                if self._peer_cert_user() is None:
+                    if self.headers.get("Authorization", "") != f"Bearer {self.master.token}":
+                        raise Unauthorized("invalid bearer token")
+                    user = UserInfo(name="system:admin", groups=[GROUP_MASTERS])
             self._user = user
             # aggregation: /apis/<group>/<version> claimed by an APIService
             # with a backing service proxies to that server (kube-aggregator).
@@ -422,7 +462,25 @@ class _Handler(BaseHTTPRequestHandler):
         except NotFound:
             token = ""
         parsed = urlparse(url)
-        return parsed.hostname, parsed.port, token
+        return parsed.hostname, parsed.port, token, parsed.scheme == "https"
+
+    def _kubelet_ssl_context(self):
+        """Verify the kubelet's serving cert against the cluster CA (the
+        CSR signer issued it); unverified TLS only when this apiserver has
+        no CA configured.  One shared context — the CA is immutable for the
+        Master's lifetime."""
+        ctx = self.master._kubelet_client_ctx
+        if ctx is None:
+            import ssl as _ssl
+
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            if self.master.client_ca_file:
+                ctx.load_verify_locations(cafile=self.master.client_ca_file)
+            else:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self.master._kubelet_client_ctx = ctx
+        return ctx
 
     def _scheduled_pod(self, ns: str, name: str):
         pod = self.master.registry.get("pods", ns, name)
@@ -436,12 +494,16 @@ class _Handler(BaseHTTPRequestHandler):
         import http.client as _http
 
         pod = self._scheduled_pod(ns, name)
-        host, port, token = self._kubelet_endpoint(pod.spec.node_name)
+        host, port, token, tls = self._kubelet_endpoint(pod.spec.node_name)
         container = q.get("container") or pod.spec.containers[0].name
         path = f"/containerLogs/{ns}/{name}/{container}"
         if q.get("tailLines"):
             path += f"?tail={int(q['tailLines'])}"
-        conn = _http.HTTPConnection(host, port, timeout=30)
+        if tls:
+            conn = _http.HTTPSConnection(host, port, timeout=30,
+                                         context=self._kubelet_ssl_context())
+        else:
+            conn = _http.HTTPConnection(host, port, timeout=30)
         try:
             conn.request("GET", path,
                          headers={"Authorization": f"Bearer {token}"})
@@ -464,7 +526,7 @@ class _Handler(BaseHTTPRequestHandler):
         kind = {"exec": "exec", "attach": "attach",
                 "portforward": "portForward"}[sub.lower()]
         pod = self._scheduled_pod(ns, name)
-        host, port, token = self._kubelet_endpoint(pod.spec.node_name)
+        host, port, token, tls = self._kubelet_endpoint(pod.spec.node_name)
         parsed = urlparse(self.path)
         rq = parse_qs(parsed.query)
         if kind == "portForward":
@@ -477,7 +539,8 @@ class _Handler(BaseHTTPRequestHandler):
             kpath += f"?{parsed.query}"
         try:
             upstream = streams.upgrade_request(
-                host, port, kpath, {"Authorization": f"Bearer {token}"})
+                host, port, kpath, {"Authorization": f"Bearer {token}"},
+                ssl_context=self._kubelet_ssl_context() if tls else None)
         except (OSError, ConnectionError) as e:
             raise BadRequest(f"kubelet connection failed: {e}") from None
         client_sock = streams.accept_upgrade(self)
@@ -741,6 +804,9 @@ class Master:
         oidc_groups_claim: str = "groups",
         audit_policy: Optional[dict] = None,   # audit policy doc (levels/rules)
         audit_webhook_url: str = "",           # batching audit sink
+        tls_cert_file: str = "",               # serve HTTPS (ref serve.go)
+        tls_key_file: str = "",
+        client_ca_file: str = "",              # verify client certs (x509 authn)
     ):
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
@@ -835,7 +901,31 @@ class Master:
         self._httpd.daemon_threads = True
         self._httpd.master = self  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
-        self.url = f"http://{self.host}:{self.port}"
+        self.client_ca_file = client_ca_file
+        self._kubelet_client_ctx = None  # built lazily, shared (immutable CA)
+        if tls_cert_file:
+            # HTTPS-only: there is no plaintext fallback listener (ref
+            # apiserver/pkg/server/serve.go — the secure port is the port)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls_cert_file,
+                                keyfile=tls_key_file or None)
+            if client_ca_file:
+                ctx.load_verify_locations(cafile=client_ca_file)
+                # OPTIONAL: bearer-token clients (bootstrap tokens, SA
+                # tokens) handshake without a cert; x509 clients get
+                # verified and mapped in _peer_cert_user
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            from ..utils.streams import quiet_tls_errors
+
+            quiet_tls_errors(self._httpd)
+            self.url = f"https://{self.host}:{self.port}"
+        else:
+            self.url = f"http://{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def _get_priority_class(self, name: str):

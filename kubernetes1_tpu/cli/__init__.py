@@ -72,8 +72,8 @@ def load_manifests(path: str) -> List[dict]:
 
 
 class CLI:
-    def __init__(self, server: str, namespace: str, out=None):
-        self.cs = Clientset(server)
+    def __init__(self, server: str, namespace: str, out=None, clientset=None):
+        self.cs = clientset or Clientset(server)
         self.ns = namespace
         self.out = out or sys.stdout
         self.scheme = global_scheme
@@ -464,6 +464,7 @@ class CLI:
             f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/attach?"
             + urlencode(params),
             self._stream_headers(),
+            ssl_context=self.cs.api.ssl_context,
         )
         code = self._pump_stream(sock)
         if code:
@@ -515,6 +516,7 @@ class CLI:
             base.hostname, base.port,
             f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/exec?{urlencode(params)}",
             self._stream_headers(),
+            ssl_context=self.cs.api.ssl_context,
         )
         code = self._pump_stream(sock, tty=tty, stdin=stdin,
                                  stdin_stream=getattr(args, "stdin_stream", None))
@@ -621,6 +623,7 @@ class CLI:
                     f"/api/v1/namespaces/{self.ns}/pods/{args.pod}"
                     f"/portForward?port={int(remote)}",
                     self._stream_headers(),
+                    ssl_context=self.cs.api.ssl_context,
                 )
             except (OSError, ConnectionError):
                 conn.close()
@@ -689,6 +692,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server", "-s", default=None,
                    help=f"apiserver URL (default $KTPU_SERVER or {DEFAULT_SERVER})")
     p.add_argument("--namespace", "-n", default="default")
+    p.add_argument("--kubeconfig", default=None,
+                   help="ktpu config JSON (default $KTPU_KUBECONFIG); "
+                        "`ktpu init` writes admin.conf in this format")
+    p.add_argument("--token", default="", help="bearer token")
+    p.add_argument("--ca-file", default="", help="CA to verify the apiserver")
+    p.add_argument("--client-cert-file", default="", help="x509 client cert")
+    p.add_argument("--client-key-file", default="")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     g = sub.add_parser("get")
@@ -803,6 +813,10 @@ def build_parser() -> argparse.ArgumentParser:
     jn = sub.add_parser("join", help="join this host to a cluster (kubeadm join)")
     jn.add_argument("--server", required=True)
     jn.add_argument("--token", required=True, help="join token from `ktpu init`")
+    jn.add_argument("--ca-cert-hash", default="",
+                    help="sha256:<hex> CA pin from `ktpu init` (kubeadm "
+                         "--discovery-token-ca-cert-hash; omitting skips "
+                         "CA verification, loudly)")
     jn.add_argument("--node-name", default=os.uname().nodename)
     jn.add_argument("--dir", default=os.path.expanduser("~/.ktpu"))
     return p
@@ -840,8 +854,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         cluster.stop()
         return 0
 
+    kubeconfig = args.kubeconfig or os.environ.get("KTPU_KUBECONFIG", "")
     server = args.server or os.environ.get("KTPU_SERVER", DEFAULT_SERVER)
-    cli = CLI(server, args.namespace)
+    if kubeconfig:
+        cs = Clientset.from_config(kubeconfig)
+    else:
+        cs = Clientset(server, token=args.token, ca_file=args.ca_file,
+                       cert_file=args.client_cert_file,
+                       key_file=args.client_key_file)
+    cli = CLI(server, args.namespace, clientset=cs)
     try:
         dispatch(cli, args)
         return 0

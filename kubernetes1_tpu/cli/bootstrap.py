@@ -1,29 +1,39 @@
-"""`ktpu init` / `ktpu join`: two-command cluster bootstrap.
+"""`ktpu init` / `ktpu join`: two-command cluster bootstrap — over TLS.
 
-Ref: cmd/kubeadm phases — certs (app/phases/certs), control-plane static
-manifests (app/phases/controlplane/manifests.go:45-47), bootstrap tokens
-(app/phases/bootstraptoken), and the kubelet TLS-bootstrap CSR flow.
+Ref: cmd/kubeadm phases — certs (app/phases/certs/certs.go:37
+CreatePKIAssets: CA, apiserver serving cert, component client certs),
+control-plane static manifests (app/phases/controlplane/manifests.go:45-47),
+bootstrap tokens (app/phases/bootstraptoken), the cluster-info discovery
+ConfigMap (app/phases/bootstraptoken/clusterinfo), and the kubelet
+TLS-bootstrap CSR flow (pkg/controller/certificates).
 
 init, on the first host:
-  1. certs phase     — mint the cluster CA key, SA signing key, an admin
-                       token, and a join token; write them under --dir.
-  2. control-plane   — write static-pod manifests for
-                       apiserver/scheduler/controller-manager into
-                       <dir>/manifests AND launch those exact commands as
-                       local processes (the manifests are the restartable
-                       record; there is no pre-existing kubelet to run them).
+  1. certs phase     — mint the cluster CA (x509), the apiserver serving
+                       cert, client certs for admin/scheduler/KCM, and the
+                       SA signing key; write them under --dir/pki.
+  2. control-plane   — write static-pod manifests for an HTTPS-only
+                       apiserver/scheduler/controller-manager AND launch
+                       those exact commands as local processes.
   3. bootstrap phase — store the join token as the kube-system
-                       bootstrap-token Secret; create the RBAC that lets
+                       bootstrap-token Secret; publish the CA in the
+                       kube-public cluster-info ConfigMap (anonymous +
+                       bootstrapper readable); create the RBAC that lets
                        system:bootstrappers submit node CSRs; print the
-                       join command.
+                       join command with the CA pin hash.
   4. kubelet         — bootstrap this host's kubelet through the same CSR
-                       flow join uses, then start it.
+                       flow join uses: a real key + PEM CSR, signed by the
+                       certificate controller into a dual-EKU node cert
+                       used BOTH as the kubelet's apiserver client
+                       credential and its :10250 serving cert.
 
 join, on another host:
-  1. authenticate with the join token (system:bootstrap:<id>).
-  2. submit a node CSR; the certificate controller auto-approves node
-     client certs and signs; poll for the credential.
-  3. write kubelet.conf and start the kubelet with the signed credential.
+  1. fetch the CA from cluster-info over unverified TLS, pin it against
+     --ca-cert-hash (kubeadm's --discovery-token-ca-cert-hash), THEN
+     reconnect fully verified.
+  2. authenticate with the join token (system:bootstrap:<id>); submit a
+     node CSR; the certificate controller auto-approves + signs.
+  3. write kubelet.conf (cert/key/ca paths) and start the kubelet with the
+     signed credential — zero plaintext sockets anywhere.
 """
 
 from __future__ import annotations
@@ -34,11 +44,12 @@ import secrets as _secrets
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset
 from ..machinery import AlreadyExists, ApiError, NotFound
+from ..utils import pki
 
 CONTROL_PLANE = ("apiserver", "controller-manager", "scheduler")
 
@@ -89,22 +100,33 @@ def _wait_healthy(cs: Clientset, timeout: float = 30.0):
 
 
 def bootstrap_node_credential(server: str, join_token: str, node_name: str,
-                              timeout: float = 30.0) -> str:
+                              ca_file: str = "",
+                              timeout: float = 30.0) -> Tuple[str, str]:
     """The kubelet TLS-bootstrap flow (ref: kubelet certificate bootstrap +
-    pkg/controller/certificates): submit a CSR as the bootstrap identity,
-    wait for auto-approval + signature, return the signed credential."""
-    bcs = Clientset(server, token=join_token)
+    pkg/controller/certificates): generate a key, submit a PEM CSR as the
+    bootstrap identity, wait for auto-approval + signature, and return
+    (cert_pem, key_pem) — a real x509 credential for the wire."""
+    csr_pem, key_pem = pki.create_csr(
+        cn=f"system:node:{node_name}", orgs=["system:nodes"],
+        dns_sans=[node_name, "localhost"], ip_sans=["127.0.0.1"])
+    bcs = Clientset(server, token=join_token, ca_file=ca_file)
     try:
         csr = t.CertificateSigningRequest()
         csr.metadata.name = f"node-csr-{node_name}"
-        csr.spec.request = f"node-client-{node_name}"
+        csr.spec.request = csr_pem
         csr.spec.username = f"system:node:{node_name}"
         csr.spec.groups = ["system:nodes"]
-        csr.spec.usages = ["client auth"]
+        csr.spec.usages = ["client auth", "server auth"]
         try:
             bcs.certificatesigningrequests.create(csr, "")
         except AlreadyExists:
-            pass  # re-join: poll the existing CSR below
+            # re-join: the old CSR carries the OLD public key — this host
+            # only has the new one, so resubmit under the same name
+            try:
+                bcs.certificatesigningrequests.delete(csr.metadata.name, "")
+                bcs.certificatesigningrequests.create(csr, "")
+            except ApiError as e:
+                raise SystemExit(f"error: CSR recreate failed: {e}")
         except ApiError as e:
             raise SystemExit(f"error: CSR create failed: {e}")
         deadline = time.time() + timeout
@@ -117,7 +139,7 @@ def bootstrap_node_credential(server: str, join_token: str, node_name: str,
             if any(c.type == "Denied" for c in cur.status.conditions):
                 raise SystemExit(f"error: CSR {csr.metadata.name} was denied")
             if cur.status.certificate:
-                return cur.status.certificate
+                return cur.status.certificate, key_pem
             time.sleep(0.2)
         raise SystemExit("error: timed out waiting for the CSR to be signed "
                          "(is the controller-manager running?)")
@@ -125,66 +147,119 @@ def bootstrap_node_credential(server: str, join_token: str, node_name: str,
         bcs.close()
 
 
+def _discover_ca(server: str, join_token: str, ca_cert_hash: str) -> str:
+    """kubeadm token discovery: read cluster-info over UNVERIFIED TLS, pin
+    the CA against the printed hash, and only then trust it."""
+    dcs = Clientset(server, token=join_token, insecure=True)
+    try:
+        info = dcs.configmaps.get("cluster-info", "kube-public")
+    except ApiError as e:
+        raise SystemExit(f"error: cluster-info discovery failed: {e}")
+    finally:
+        dcs.close()
+    ca_pem = (info.data or {}).get("ca", "")
+    if not ca_pem:
+        raise SystemExit("error: cluster-info has no CA (server predates TLS?)")
+    if ca_cert_hash:
+        got = pki.ca_cert_hash(ca_pem)
+        if got != ca_cert_hash:
+            raise SystemExit(
+                f"error: cluster CA hash mismatch: got {got}, "
+                f"pinned {ca_cert_hash} — possible MITM, refusing")
+    else:
+        print("WARNING: no --ca-cert-hash given; the fetched CA is "
+              "unauthenticated (kubeadm's unsafe-skip-ca-verification mode)")
+    return ca_pem
+
+
 def init(args) -> int:
     d = os.path.abspath(args.dir)
     port = args.port
-    server = f"http://{args.advertise_address}:{port}"
+    server = f"https://{args.advertise_address}:{port}"
 
     # ---- preflight (ref kubeadm preflight): re-running init against a live
     # control plane must not clobber pids.json with a dead pid and then
-    # trip over the existing fixed-name objects — refuse early instead
-    probe = Clientset(server)
-    try:
-        probe.api.request("GET", "/healthz")
-        raise SystemExit(
-            f"error: an apiserver is already serving at {server} "
-            f"(state in {d}; stop it via pids.json before re-running init)")
-    except SystemExit:
-        raise
-    except Exception:  # noqa: BLE001 — nothing listening: proceed
-        pass
-    finally:
-        probe.close()
+    # trip over the existing fixed-name objects — refuse early instead.
+    # Probe BOTH protocols: a live pre-TLS apiserver answers plaintext only
+    # (its reply makes the TLS probe raise SSLError, not ConnectionRefused).
+    for probe_url in (server, f"http://{args.advertise_address}:{port}"):
+        probe = Clientset(probe_url, insecure=True)
+        try:
+            probe.api.request("GET", "/healthz")
+            raise SystemExit(
+                f"error: an apiserver is already serving at {probe_url} "
+                f"(state in {d}; stop it via pids.json before re-running init)")
+        except SystemExit:
+            raise
+        except Exception:  # noqa: BLE001 — nothing listening on this proto
+            pass
+        finally:
+            probe.close()
 
-    # ---- phase certs
-    ca_key = _secrets.token_hex(32)
+    # ---- phase certs (ref certs.go:37 CreatePKIAssets)
+    pki_dir = os.path.join(d, "pki")
+    ca_cert, ca_key = pki.create_ca("ktpu-ca")
+    ca_crt_path, ca_key_path = pki.write_pki(pki_dir, "ca", ca_cert, ca_key)
+    apiserver_cert, apiserver_key = pki.issue_cert(
+        ca_cert, ca_key, cn="kube-apiserver", server=True,
+        dns_sans=["localhost", os.uname().nodename],
+        ip_sans=[args.advertise_address, "127.0.0.1"])
+    pki.write_pki(pki_dir, "apiserver", apiserver_cert, apiserver_key)
+    # component client certs: O=system:masters so RBAC grants are uniform
+    # (kubeadm binds per-component roles; one group keeps the flag surface
+    # small while every hop still carries a distinct x509 identity)
+    component_confs = {}
+    for comp, cn in (("admin", "ktpu-admin"),
+                     ("controller-manager", "system:kube-controller-manager"),
+                     ("scheduler", "system:kube-scheduler")):
+        cert, key = pki.issue_cert(ca_cert, ca_key, cn=cn,
+                                   orgs=["system:masters"], client=True)
+        pki.write_pki(pki_dir, comp, cert, key)
+        conf_path = os.path.join(
+            d, "admin.conf" if comp == "admin" else f"{comp}.conf")
+        _write(conf_path, json.dumps({
+            "server": server, "ca": "pki/ca.crt",
+            "cert": f"pki/{comp}.crt", "key": f"pki/{comp}.key"}, indent=1))
+        component_confs[comp] = conf_path
     sa_key = _secrets.token_hex(32)
     admin_token = _secrets.token_hex(16)
     token_id = _secrets.token_hex(3)
     token_secret = _secrets.token_hex(8)
     join_token = f"{token_id}.{token_secret}"
-    _write(os.path.join(d, "pki", "ca.key"), ca_key)
-    _write(os.path.join(d, "pki", "sa.key"), sa_key)
-    admin_conf = {"server": server, "token": admin_token}
-    _write(os.path.join(d, "admin.conf"), json.dumps(admin_conf, indent=1))
-    print(f"[certs] cluster keys + admin.conf written under {d}")
+    _write(os.path.join(pki_dir, "sa.key"), sa_key)
+    ca_hash = pki.ca_cert_hash(ca_cert)
+    print(f"[certs] cluster CA + serving/client certs under {pki_dir}; "
+          f"admin.conf written")
 
-    # ---- phase control-plane (manifests + processes)
+    # ---- phase control-plane (manifests + processes) — HTTPS only
     commands = {
         "apiserver": [
             sys.executable, "-m", "kubernetes1_tpu.apiserver",
             "--host", args.advertise_address, "--port", str(port),
             "--authorization-mode", "Node,RBAC",
             "--token", admin_token,
-            "--ca-key-file", os.path.join(d, "pki", "ca.key"),
-            "--sa-key-file", os.path.join(d, "pki", "sa.key"),
+            "--tls-cert-file", os.path.join(pki_dir, "apiserver.crt"),
+            "--tls-key-file", os.path.join(pki_dir, "apiserver.key"),
+            "--client-ca-file", ca_crt_path,
+            "--ca-key-file", ca_key_path,
+            "--sa-key-file", os.path.join(pki_dir, "sa.key"),
             "--wal", os.path.join(d, "store.wal"),
         ],
         "controller-manager": [
             sys.executable, "-m", "kubernetes1_tpu.controllers",
-            "--server", server, "--token", admin_token,
-            "--ca-key-file", os.path.join(d, "pki", "ca.key"),
-            "--sa-key-file", os.path.join(d, "pki", "sa.key"),
+            "--kubeconfig", component_confs["controller-manager"],
+            "--ca-key-file", ca_key_path,
+            "--ca-cert-file", ca_crt_path,
+            "--sa-key-file", os.path.join(pki_dir, "sa.key"),
         ],
         "scheduler": [
             sys.executable, "-m", "kubernetes1_tpu.scheduler",
-            "--server", server, "--token", admin_token,
+            "--kubeconfig", component_confs["scheduler"],
             "--metrics-port", "0",
         ],
     }
     pids = {}
     for name in CONTROL_PLANE:
-        # 0600: the manifests carry the admin token on their command lines
         _write(os.path.join(d, "manifests", f"kube-{name}.json"),
                json.dumps(_manifest(name, commands[name]), indent=1))
         if name != "apiserver":
@@ -193,15 +268,15 @@ def init(args) -> int:
     # record the pid BEFORE waiting: a health-wait failure must leave a
     # kill recipe behind, not an orphaned port-holding apiserver
     _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
-    cs = Clientset(server, token=admin_token)
+    cs = Clientset.from_config(component_confs["admin"])
     _wait_healthy(cs)
     for name in ("controller-manager", "scheduler"):
         pids[name] = _spawn(commands[name], os.path.join(d, f"{name}.log")).pid
     _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
-    print(f"[control-plane] apiserver/scheduler/controller-manager up at {server}"
-          f" (manifests in {d}/manifests)")
+    print(f"[control-plane] apiserver/scheduler/controller-manager up at "
+          f"{server} (TLS; manifests in {d}/manifests)")
 
-    # ---- phase bootstrap token + RBAC
+    # ---- phase bootstrap token + cluster-info + RBAC
     from ..machinery.meta import to_iso
 
     ttl_s = getattr(args, "token_ttl", 24 * 3600)
@@ -214,10 +289,37 @@ def init(args) -> int:
     })
     sec.metadata.name = f"bootstrap-token-{token_id}"
     cs.secrets.create(sec, "kube-system")
+    # cluster-info: the CA a joining host fetches and pins (ref
+    # bootstraptoken/clusterinfo; readable without a full credential)
+    info = t.ConfigMap(data={"ca": ca_cert, "server": server})
+    info.metadata.name = "cluster-info"
+    try:
+        cs.configmaps.create(info, "kube-public")
+    except AlreadyExists:
+        pass
+    info_role = t.Role()
+    info_role.metadata.name = "ktpu:bootstrap-signer-clusterinfo"
+    info_role.metadata.namespace = "kube-public"
+    info_role.rules = [t.PolicyRule(verbs=["get"], resources=["configmaps"])]
+    info_rb = t.RoleBinding()
+    info_rb.metadata.name = "ktpu:bootstrap-signer-clusterinfo"
+    info_rb.metadata.namespace = "kube-public"
+    info_rb.subjects = [
+        t.Subject(kind="User", name="system:anonymous"),
+        t.Subject(kind="Group", name="system:bootstrappers"),
+        t.Subject(kind="Group", name="system:unauthenticated"),
+    ]
+    info_rb.role_ref = t.RoleRef(kind="Role",
+                                 name="ktpu:bootstrap-signer-clusterinfo")
+    for maker, client in ((info_role, cs.roles), (info_rb, cs.rolebindings)):
+        try:
+            client.create(maker, "kube-public")
+        except AlreadyExists:
+            pass
     role = t.ClusterRole()
     role.metadata.name = "system:node-bootstrapper"
     role.rules = [t.PolicyRule(
-        verbs=["create", "get", "list", "watch"],
+        verbs=["create", "get", "list", "watch", "delete"],
         resources=["certificatesigningrequests"],
     )]
     try:
@@ -232,23 +334,25 @@ def init(args) -> int:
         cs.clusterrolebindings.create(rb, "")
     except AlreadyExists:
         pass
-    print(f"[bootstrap-token] join token stored (ttl {ttl_s}s); CSR RBAC for "
-          "system:bootstrappers in place")
+    print(f"[bootstrap-token] join token stored (ttl {ttl_s}s); cluster-info "
+          "published; CSR RBAC for system:bootstrappers in place")
 
     # ---- this host's kubelet via the SAME join flow
     node_name = args.node_name
-    cred = bootstrap_node_credential(server, join_token, node_name)
-    _write(os.path.join(d, "kubelet.conf"),
-           json.dumps({"server": server, "token": cred}))
-    # NOTE: the kubelet is NOT pointed at <dir>/manifests here — init just
-    # launched those exact processes itself, and a static-pod dir would
-    # double-run the control plane.  The manifests are the REBOOT recipe:
-    # after a host restart, `kubelet --static-pod-dir <dir>/manifests`
-    # re-hosts everything (minus the already-running apiserver bootstrap).
+    cert_pem, key_pem = bootstrap_node_credential(
+        server, join_token, node_name, ca_file=ca_crt_path)
+    kubelet_crt, kubelet_key = pki.write_pki(pki_dir, "kubelet",
+                                             cert_pem, key_pem)
+    _write(os.path.join(d, "kubelet.conf"), json.dumps({
+        "server": server, "ca": "pki/ca.crt",
+        "cert": "pki/kubelet.crt", "key": "pki/kubelet.key"}, indent=1))
     kubelet_cmd = [
         sys.executable, "-m", "kubernetes1_tpu.kubelet",
-        "--server", server, "--token", cred, "--node-name", node_name,
+        "--kubeconfig", os.path.join(d, "kubelet.conf"),
+        "--node-name", node_name,
         "--root-dir", os.path.join(d, "kubelet"),
+        "--tls-cert-file", kubelet_crt,
+        "--tls-key-file", kubelet_key,
     ]
     pids["kubelet"] = _spawn(kubelet_cmd, os.path.join(d, "kubelet.log")).pid
     _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
@@ -261,37 +365,50 @@ def init(args) -> int:
         except ApiError:
             pass
         time.sleep(0.3)
-    print(f"[kubelet] node {node_name} joined via CSR bootstrap")
+    print(f"[kubelet] node {node_name} joined via CSR bootstrap "
+          f"(dual-EKU cert: client + :10250 serving)")
     cs.close()
 
     print()
-    print("Your cluster control plane is up. To administer it:")
-    print(f"    export KTPU_SERVER={server}")
-    print(f"    ktpu --server {server} get nodes   "
-          f"# token in {d}/admin.conf")
+    print("Your cluster control plane is up (TLS everywhere). To administer:")
+    print(f"    export KTPU_KUBECONFIG={component_confs['admin']}")
+    print("    ktpu get nodes")
     print()
     print("To add another host, run on it:")
     print(f"    ktpu join --server {server} --token {join_token} "
-          f"--node-name <name>")
+          f"--ca-cert-hash {ca_hash} --node-name <name>")
     return 0
 
 
 def join(args) -> int:
     d = os.path.abspath(args.dir)
     node_name = args.node_name
-    cred = bootstrap_node_credential(args.server, args.token, node_name)
-    _write(os.path.join(d, "kubelet.conf"),
-           json.dumps({"server": args.server, "token": cred}))
+    # ---- discovery: fetch + pin the cluster CA, then go fully verified
+    ca_pem = _discover_ca(args.server, args.token,
+                          getattr(args, "ca_cert_hash", ""))
+    pki_dir = os.path.join(d, "pki")
+    ca_path, _ = pki.write_pki(pki_dir, "ca", ca_pem)
+    cert_pem, key_pem = bootstrap_node_credential(
+        args.server, args.token, node_name, ca_file=ca_path)
+    kubelet_crt, kubelet_key = pki.write_pki(pki_dir, "kubelet",
+                                             cert_pem, key_pem)
+    _write(os.path.join(d, "kubelet.conf"), json.dumps({
+        "server": args.server, "ca": "pki/ca.crt",
+        "cert": "pki/kubelet.crt", "key": "pki/kubelet.key"}, indent=1))
     kubelet_cmd = [
         sys.executable, "-m", "kubernetes1_tpu.kubelet",
-        "--server", args.server, "--token", cred, "--node-name", node_name,
+        "--kubeconfig", os.path.join(d, "kubelet.conf"),
+        "--node-name", node_name,
         "--root-dir", os.path.join(d, "kubelet"),
+        "--tls-cert-file", kubelet_crt,
+        "--tls-key-file", kubelet_key,
     ]
     pid = _spawn(kubelet_cmd, os.path.join(d, "kubelet.log")).pid
     _write(os.path.join(d, "pids.json"), json.dumps({"kubelet": pid}),
            mode=0o644)
-    # confirm the node goes Ready under its CSR-issued identity
-    cs = Clientset(args.server, token=cred)
+    # confirm the node goes Ready under its CSR-issued x509 identity
+    cs = Clientset(args.server, ca_file=ca_path,
+                   cert_file=kubelet_crt, key_file=kubelet_key)
     deadline = time.time() + 30
     ready = False
     while time.time() < deadline and not ready:
@@ -307,5 +424,5 @@ def join(args) -> int:
         raise SystemExit(f"error: node {node_name} never became Ready "
                          f"(see {d}/kubelet.log)")
     print(f"node {node_name} joined the cluster (kubelet pid {pid}, "
-          f"credential in {d}/kubelet.conf)")
+          f"x509 credential in {d}/pki)")
     return 0

@@ -127,10 +127,32 @@ class ResourceClient:
 
 
 class Clientset:
-    def __init__(self, url: str, token: str = "", scheme: Optional[Scheme] = None):
-        self.api = ApiClient(url, token=token)
+    def __init__(self, url: str, token: str = "", scheme: Optional[Scheme] = None,
+                 ca_file: str = "", cert_file: str = "", key_file: str = "",
+                 insecure: bool = False):
+        self.api = ApiClient(url, token=token, ca_file=ca_file,
+                             cert_file=cert_file, key_file=key_file,
+                             insecure=insecure)
         self.scheme = scheme or global_scheme
         self._clients: Dict[str, ResourceClient] = {}
+
+    @classmethod
+    def from_config(cls, path: str, scheme: Optional[Scheme] = None) -> "Clientset":
+        """Build from a ktpu config file — the kubeconfig analog written by
+        `ktpu init`/`join`: JSON {"server", "token"?, "ca"?, "cert"?, "key"?}
+        with cert paths relative to the config file's directory."""
+        import json as _json
+        import os as _os
+
+        with open(path) as f:
+            cfg = _json.load(f)
+        base = _os.path.dirname(_os.path.abspath(path))
+        rel = lambda p: (p if not p or _os.path.isabs(p)  # noqa: E731
+                         else _os.path.join(base, p))
+        return cls(cfg["server"], token=cfg.get("token", ""), scheme=scheme,
+                   ca_file=rel(cfg.get("ca", "")),
+                   cert_file=rel(cfg.get("cert", "")),
+                   key_file=rel(cfg.get("key", "")))
 
     def resource(self, plural: str) -> ResourceClient:
         if plural not in self._clients:

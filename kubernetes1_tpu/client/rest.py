@@ -12,11 +12,38 @@ from __future__ import annotations
 import http.client
 import json
 import socket
+import ssl
 import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlparse
 
 from ..machinery import ApiError
+
+
+def client_ssl_context(
+    ca_file: str = "",
+    cert_file: str = "",
+    key_file: str = "",
+    insecure: bool = False,
+) -> ssl.SSLContext:
+    """TLS context for talking to a ktpu server: verify the cluster CA,
+    present a client certificate when given (the x509 authn channel —
+    CN=user, O=groups)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if insecure:
+        # EXPLICIT opt-out only (join-time discovery connects unverified
+        # once, pins the CA hash, then reconnects verified — kubeadm token
+        # discovery shape).  No ca_file is NOT an implicit opt-out: that
+        # would silently hand bearer tokens to any MITM.
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    elif ca_file:
+        ctx.load_verify_locations(cafile=ca_file)
+    else:
+        ctx.load_default_certs(ssl.Purpose.SERVER_AUTH)
+    if cert_file:
+        ctx.load_cert_chain(certfile=cert_file, keyfile=key_file or None)
+    return ctx
 
 
 class WatchStream:
@@ -71,13 +98,20 @@ class WatchStream:
 
 
 class ApiClient:
-    def __init__(self, url: str, token: str = "", timeout: float = 30.0):
+    def __init__(self, url: str, token: str = "", timeout: float = 30.0,
+                 ca_file: str = "", cert_file: str = "", key_file: str = "",
+                 insecure: bool = False):
         self.url = url.rstrip("/")
         parsed = urlparse(self.url)
         self.host = parsed.hostname or "127.0.0.1"
-        self.port = parsed.port or 80
+        self.tls = parsed.scheme == "https"
+        self.port = parsed.port or (443 if self.tls else 80)
         self.token = token
         self.timeout = timeout
+        self.ssl_context: Optional[ssl.SSLContext] = (
+            client_ssl_context(ca_file, cert_file, key_file, insecure)
+            if self.tls else None
+        )
         self._local = threading.local()
 
     # ------------------------------------------------------------- plumbing
@@ -88,10 +122,18 @@ class ApiClient:
             h["Authorization"] = f"Bearer {self.token}"
         return h
 
+    def _new_conn(self, timeout) -> http.client.HTTPConnection:
+        if self.tls:
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=timeout,
+                context=self.ssl_context)
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+            conn = self._new_conn(self.timeout)
             self._local.conn = conn
         return conn
 
@@ -155,7 +197,7 @@ class ApiClient:
         params = dict(params or {})
         params["watch"] = "true"
         full = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=None)
+        conn = self._new_conn(None)
         conn.request("GET", full, headers=self._headers())
         resp = conn.getresponse()
         if resp.status >= 400:

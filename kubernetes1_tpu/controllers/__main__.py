@@ -7,7 +7,6 @@ import argparse
 import signal
 import threading
 
-from ..client import Clientset
 from .manager import ControllerManager
 
 
@@ -21,15 +20,18 @@ def main():
     ap.add_argument("--node-monitor-grace", type=float, default=40.0)
     ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
     ap.add_argument("--ca-key-file", default="", help="CSR signing key")
+    ap.add_argument("--ca-cert-file", default="",
+                    help="cluster CA cert (enables x509 CSR signing)")
     ap.add_argument("--sa-key-file", default="", help="SA token signing key")
+    from ..utils.procutil import add_client_args, clientset_from_args, read_key
+
+    add_client_args(ap)
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
-    from ..utils.procutil import read_key
-
-    cs = Clientset(args.server, token=args.token)
+    cs = clientset_from_args(args)
     cm = ControllerManager(
         cs,
         leader_elect=args.leader_elect,
@@ -37,6 +39,7 @@ def main():
         monitor_grace=args.node_monitor_grace,
         eviction_timeout=args.pod_eviction_timeout,
         ca_key=read_key(args.ca_key_file, "ktpu-ca-key"),
+        ca_cert_pem=read_key(args.ca_cert_file, ""),
         sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
     )
     cm.start()

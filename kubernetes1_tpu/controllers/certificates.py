@@ -61,9 +61,37 @@ def verify_certificate(ca_key: str, username: str, request: str, cert: str) -> b
 class CertificateController(Controller):
     name = "certificate-controller"
 
-    def __init__(self, clientset, factory, ca_key: str = "ktpu-ca-key", workers: int = 1):
+    def __init__(self, clientset, factory, ca_key: str = "ktpu-ca-key",
+                 ca_cert_pem: str = "", workers: int = 1):
         super().__init__(clientset, factory, workers)
         self.ca_key = ca_key
+        # x509 mode: ca_key is a PEM private key and ca_cert_pem its cert —
+        # PEM CSRs get real certificates (ref certificates/signer); the HMAC
+        # attestation path stays for CA-less in-process clusters
+        self.ca_cert_pem = ca_cert_pem
+        self.x509 = bool(ca_cert_pem) and "-----BEGIN" in (ca_key or "")
+
+    def _sign(self, csr) -> str:
+        from ..utils import pki
+
+        if self.x509 and pki.is_pem_csr(csr.spec.request):
+            # the approver already vetted spec.username/groups; the SIGNER
+            # must also pin the CSR's x509 subject to that vetted identity,
+            # or a node could smuggle an admin CN past the approver
+            cn, orgs = pki.csr_identity(csr.spec.request)
+            if cn != csr.spec.username or not set(orgs) <= set(csr.spec.groups):
+                raise ValueError(
+                    f"CSR subject CN={cn!r} O={orgs!r} does not match "
+                    f"spec.username={csr.spec.username!r}/groups")
+            # honor the requested usages (nodes ask for both: the kubelet
+            # dials the apiserver AND serves :10250 from one CSR round-trip)
+            usages = csr.spec.usages or ["client auth"]
+            return pki.sign_csr(self.ca_cert_pem, self.ca_key,
+                                csr.spec.request,
+                                client="client auth" in usages,
+                                server="server auth" in usages)
+        return issue_certificate(self.ca_key, csr.spec.username,
+                                 csr.spec.request, groups=csr.spec.groups)
 
     def setup(self):
         self.csrs = self.factory.informer("certificatesigningrequests")
@@ -138,10 +166,12 @@ class CertificateController(Controller):
             else:
                 return
         if self._condition(csr, "Approved") and not csr.status.certificate:
-            csr.status.certificate = issue_certificate(
-                self.ca_key, csr.spec.username, csr.spec.request,
-                groups=csr.spec.groups,
-            )
+            try:
+                csr.status.certificate = self._sign(csr)
+            except ValueError as e:
+                csr.status.conditions.append(t.CSRCondition(
+                    type="Denied", reason="SubjectMismatch", message=str(e),
+                    last_update_time=now_iso()))
             changed = True
         if not changed:
             return

@@ -36,6 +36,7 @@ class ControllerManager:
         monitor_grace: float = 40.0,
         eviction_timeout: float = 300.0,
         ca_key: str = "ktpu-ca-key",
+        ca_cert_pem: str = "",
         sa_signing_key: str = "ktpu-sa-key",
     ):
         self.cs = clientset
@@ -57,7 +58,8 @@ class ControllerManager:
             DisruptionController(clientset, self.factory),
             PodGCController(clientset, self.factory),
             TTLAfterFinishedController(clientset, self.factory),
-            CertificateController(clientset, self.factory, ca_key=ca_key),
+            CertificateController(clientset, self.factory, ca_key=ca_key,
+                                  ca_cert_pem=ca_cert_pem),
             PersistentVolumeBinder(clientset, self.factory),
         ]
         self.node_lifecycle = NodeLifecycleController(

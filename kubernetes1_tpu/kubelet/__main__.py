@@ -8,7 +8,6 @@ import argparse
 import signal
 import threading
 
-from ..client import Clientset
 from ..deviceplugin.api import DEFAULT_PLUGIN_DIR
 from .kubelet import Kubelet
 from .runtime import FakeRuntime, ProcessRuntime
@@ -30,12 +29,18 @@ def main():
                          "native ktpu-cri-runtime); overrides --runtime")
     ap.add_argument("--cpu-manager-policy", choices=["none", "static"],
                     default="none")
+    ap.add_argument("--tls-cert-file", default="",
+                    help="serving cert for the kubelet server (:10250 TLS)")
+    ap.add_argument("--tls-key-file", default="")
+    from ..utils.procutil import add_client_args, clientset_from_args
+
+    add_client_args(ap)
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
 
-    cs = Clientset(args.server, token=args.token)
+    cs = clientset_from_args(args)
     if args.container_runtime_endpoint:
         from .cri import RemoteRuntime
 
@@ -53,6 +58,8 @@ def main():
         static_pod_dir=args.static_pod_dir or None,
         node_labels=labels,
         cpu_manager_policy=args.cpu_manager_policy,
+        server_tls_cert_file=args.tls_cert_file,
+        server_tls_key_file=args.tls_key_file,
     )
     kubelet.start()
     runtime_desc = (f"remote CRI {args.container_runtime_endpoint}"

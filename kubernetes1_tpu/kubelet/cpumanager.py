@@ -249,10 +249,11 @@ class CPUManager:
                 # handed a now-reserved cpu out exclusively; reclaim it so
                 # the reserved-fallback pool never overlaps an exclusive
                 # assignment (the repin callback re-pins live containers)
-                self.state.entries[k] -= self._reserved
-                if not self.state.entries[k]:
-                    # fully reclaimed: drop the entry so the container is
-                    # reallocated on its next lookup instead of pinned to {}
+                if self.state.entries[k] & self._reserved:
+                    # a now-reserved cpu was in the exclusive set: drop the
+                    # whole entry so the container is REALLOCATED at full
+                    # size on its next lookup — shrinking it in place would
+                    # silently under-deliver the cpus it was promised
                     del self.state.entries[k]
                     continue
                 assigned |= self.state.entries[k]

@@ -65,6 +65,8 @@ class Kubelet:
         eviction_signals_fn=None,
         server_port: Optional[int] = 0,  # 0 = ephemeral; None = no server
         server_token: str = "",
+        server_tls_cert_file: str = "",  # CSR-issued serving cert (:10250 TLS)
+        server_tls_key_file: str = "",
         volume_root: Optional[str] = None,
         enforce_cgroups: Optional[bool] = None,  # None = auto (real runtimes only)
         system_reserved: Optional[Dict[str, str]] = None,
@@ -157,7 +159,9 @@ class Kubelet:
             if not self.server_token:
                 self.server_token = secrets.token_hex(16)
             self.server = KubeletServer(self, port=server_port,
-                                        token=self.server_token)
+                                        token=self.server_token,
+                                        tls_cert_file=server_tls_cert_file,
+                                        tls_key_file=server_tls_key_file)
 
         from .eviction import EvictionManager, default_signals
         from .prober import ProberManager

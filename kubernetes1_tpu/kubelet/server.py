@@ -34,6 +34,13 @@ class _KubeletHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
+    def setup(self):
+        # per-connection-thread TLS handshake (see apiserver _Handler.setup)
+        handshake = getattr(self.request, "do_handshake", None)
+        if handshake is not None:
+            handshake()
+        super().setup()
+
     @property
     def kubelet(self):
         return self.server.kubelet  # type: ignore[attr-defined]
@@ -397,16 +404,35 @@ def _follow_log(sock, runtime, cid, log_path):
 
 
 class KubeletServer:
-    """Owns the HTTP listener; the kubelet advertises `self.url` on its Node."""
+    """Owns the HTTP listener; the kubelet advertises `self.url` on its Node.
+
+    With tls_cert_file set, the listener is HTTPS-only (the reference's
+    kubelet serves :10250 over TLS with a CSR-issued serving cert) — the
+    apiserver verifies it against the cluster CA on the exec/logs hop."""
 
     def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0,
-                 token: str = ""):
+                 token: str = "", tls_cert_file: str = "",
+                 tls_key_file: str = ""):
         self._httpd = ThreadingHTTPServer((host, port), _KubeletHandler)
         self._httpd.daemon_threads = True
         self._httpd.kubelet = kubelet  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
-        self.url = f"http://{self.host}:{self.port}"
+        if tls_cert_file:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls_cert_file,
+                                keyfile=tls_key_file or None)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+            from ..utils.streams import quiet_tls_errors
+
+            quiet_tls_errors(self._httpd)
+            self.url = f"https://{self.host}:{self.port}"
+        else:
+            self.url = f"http://{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     def start(self):

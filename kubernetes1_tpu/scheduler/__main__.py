@@ -7,7 +7,7 @@ import argparse
 import signal
 import threading
 
-from ..client import Clientset, LeaderElector
+from ..client import LeaderElector
 from .scheduler import Scheduler
 
 
@@ -24,6 +24,9 @@ def main():
     ap.add_argument("--policy-config-file", default="",
                     help="scheduler policy JSON (extenders; ref "
                          "examples/scheduler-policy-config.json)")
+    from ..utils.procutil import add_client_args, clientset_from_args
+
+    add_client_args(ap)
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
@@ -36,7 +39,7 @@ def main():
         with open(args.policy_config_file) as f:
             policy = json.load(f)
 
-    cs = Clientset(args.server, token=args.token)
+    cs = clientset_from_args(args)
     sched = Scheduler(
         cs, scheduler_name=args.scheduler_name,
         metrics_port=None if args.metrics_port < 0 else args.metrics_port,

@@ -23,3 +23,28 @@ def read_key(path: str, default: str) -> str:
     """Key-file flag helper: file content when a path is given, else the
     development default."""
     return open(path).read().strip() if path else default
+
+
+def add_client_args(ap) -> None:
+    """The shared client-connection flag set every component binary takes
+    (ref: each cmd/* --kubeconfig): --kubeconfig overrides the individual
+    --server/--token/--ca-file/--client-{cert,key}-file flags."""
+    ap.add_argument("--kubeconfig", default="",
+                    help='JSON {"server","token"?,"ca"?,"cert"?,"key"?}')
+    ap.add_argument("--ca-file", default="",
+                    help="CA bundle to verify the apiserver's TLS cert")
+    ap.add_argument("--client-cert-file", default="",
+                    help="x509 client cert (CN=user, O=groups)")
+    ap.add_argument("--client-key-file", default="")
+
+
+def clientset_from_args(args):
+    """Build the component's Clientset from add_client_args flags."""
+    from ..client import Clientset
+
+    if getattr(args, "kubeconfig", ""):
+        return Clientset.from_config(args.kubeconfig)
+    return Clientset(args.server, token=args.token,
+                     ca_file=getattr(args, "ca_file", ""),
+                     cert_file=getattr(args, "client_cert_file", ""),
+                     key_file=getattr(args, "client_key_file", ""))

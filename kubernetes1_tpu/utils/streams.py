@@ -70,11 +70,31 @@ def send_status(sock: socket.socket, exit_code: int, error: str = ""):
         pass
 
 
+def quiet_tls_errors(httpd):
+    """Failed handshakes (plaintext probe, wrong CA, port scan) are routine
+    noise on a TLS port — drop them instead of stack-tracing to stderr."""
+    import ssl as _ssl
+    import sys as _sys
+
+    orig = httpd.handle_error
+
+    def handle_error(request, client_address):
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (_ssl.SSLError, ConnectionError, TimeoutError)):
+            return
+        orig(request, client_address)
+
+    httpd.handle_error = handle_error
+
+
 def upgrade_request(host: str, port: int, path: str, headers: dict,
-                    timeout: float = 30.0) -> socket.socket:
-    """Open a socket, perform the Upgrade handshake, return the raw socket
-    ready for frames.  Raises ConnectionError on a non-101 response."""
+                    timeout: float = 30.0, ssl_context=None) -> socket.socket:
+    """Open a socket (TLS when ssl_context is given), perform the Upgrade
+    handshake, return the socket ready for frames.  Raises ConnectionError
+    on a non-101 response."""
     sock = socket.create_connection((host, port), timeout=timeout)
+    if ssl_context is not None:
+        sock = ssl_context.wrap_socket(sock, server_hostname=host)
     try:
         lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
                  "Connection: Upgrade", f"Upgrade: {UPGRADE_PROTO}"]

@@ -52,11 +52,13 @@ def two_host_cluster(tmp_path):
     parts = join_line.split()
     server = parts[parts.index("--server") + 1]
     token = parts[parts.index("--token") + 1]
+    ca_hash = parts[parts.index("--ca-cert-hash") + 1]
     r2 = run_ktpu("join", "--server", server, "--token", token,
+                  "--ca-cert-hash", ca_hash,
                   "--node-name", "host2", "--dir", d2)
     assert r2.returncode == 0, r2.stdout + r2.stderr
-    admin = json.load(open(os.path.join(d1, "admin.conf")))
-    env = {"server": server, "token": token, "admin": admin,
+    env = {"server": server, "token": token, "ca_hash": ca_hash,
+           "admin_conf": os.path.join(d1, "admin.conf"),
            "d1": d1, "d2": d2, "init_out": r.stdout}
     yield env
     for d in (d1, d2):
@@ -77,7 +79,8 @@ def two_host_cluster(tmp_path):
 class TestInitJoin:
     def test_two_hosts_ready_and_secured(self, two_host_cluster):
         env = two_host_cluster
-        admin = Clientset(env["admin"]["server"], token=env["admin"]["token"])
+        assert env["server"].startswith("https://")
+        admin = Clientset.from_config(env["admin_conf"])
         try:
             def both_ready():
                 try:
@@ -96,8 +99,10 @@ class TestInitJoin:
             assert {"node-csr-host1", "node-csr-host2"} <= names
             for c in csrs:
                 assert c.status.certificate  # approved + signed
-            # anonymous access is locked down (Node,RBAC mode)
-            anon = Clientset(env["server"])
+            # anonymous access is locked down (Node,RBAC mode) — verified
+            # TLS, no credential
+            anon = Clientset(env["server"],
+                             ca_file=os.path.join(env["d1"], "pki", "ca.crt"))
             with pytest.raises(ApiError):
                 anon.pods.list()
             anon.close()
@@ -120,6 +125,48 @@ class TestInitJoin:
             manifests = os.listdir(os.path.join(env["d1"], "manifests"))
             assert {"kube-apiserver.json", "kube-scheduler.json",
                     "kube-controller-manager.json"} <= set(manifests)
+            # ---- zero plaintext sockets (VERDICT r3 #1 'done' bar) ----
+            # the apiserver port does not speak plaintext HTTP
+            import http.client as _http
+            from urllib.parse import urlparse as _up
+
+            parsed = _up(env["server"])
+            with pytest.raises((OSError, _http.HTTPException)):
+                c = _http.HTTPConnection(parsed.hostname, parsed.port,
+                                         timeout=5)
+                c.request("GET", "/healthz")
+                c.getresponse()
+            # every kubelet advertises an HTTPS endpoint, and that port
+            # refuses plaintext too
+            nodes, _ = admin.nodes.list()
+            for n in nodes:
+                kurl = (n.metadata.annotations or {}).get(
+                    "kubelet.ktpu.io/server", "")
+                assert kurl.startswith("https://"), \
+                    f"{n.metadata.name} kubelet serves plaintext: {kurl}"
+                kp = _up(kurl)
+                with pytest.raises((OSError, _http.HTTPException)):
+                    c = _http.HTTPConnection(kp.hostname, kp.port, timeout=5)
+                    c.request("GET", "/healthz")
+                    c.getresponse()
+            # exec works END TO END over the TLS hops (client → apiserver
+            # → kubelet, both TLS): run a command in a fresh pod
+            sleeper = t.Pod()
+            sleeper.metadata.name = "tls-exec"
+            sleeper.spec.containers = [t.Container(
+                name="c", image="python",
+                command=[sys.executable, "-c",
+                         "import time; time.sleep(30)"])]
+            admin.pods.create(sleeper)
+            must_poll_until(
+                lambda: admin.pods.get("tls-exec", "default").status.phase
+                == "Running",
+                timeout=40.0, desc="exec target pod running")
+            r = run_ktpu("--kubeconfig", env["admin_conf"],
+                         "exec", "tls-exec", "--", "echo", "over-tls",
+                         timeout=30)
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "over-tls" in r.stdout
         finally:
             admin.close()
 
@@ -129,6 +176,10 @@ class TestInitJoin:
                      "deadbe.0000000000000000", "--node-name", "intruder",
                      "--dir", env["d2"] + "-x", timeout=60)
         assert r.returncode != 0
-        assert "csr create failed" in (r.stdout + r.stderr).lower() \
-            or "unauthorized" in (r.stdout + r.stderr).lower() \
-            or "forbidden" in (r.stdout + r.stderr).lower()
+        out = (r.stdout + r.stderr).lower()
+        # a bad token now dies at the earliest gate: token-discovery of the
+        # cluster CA (presented-but-invalid credentials are rejected even
+        # for the anonymous-readable cluster-info)
+        assert ("csr create failed" in out or "unauthorized" in out
+                or "forbidden" in out or "invalid bearer token" in out
+                or "discovery failed" in out)

@@ -1,0 +1,162 @@
+"""TLS end to end: PKI, HTTPS apiserver, x509 authn, CSR x509 signing.
+
+Ref: cmd/kubeadm/app/phases/certs/certs.go:37 (CreatePKIAssets),
+staging/src/k8s.io/apiserver/pkg/server/serve.go (secure serving),
+staging authenticator/request/x509 (CN=user, O=groups mapping),
+pkg/controller/certificates/signer (CSR → signed cert).
+"""
+
+import http.client
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver.server import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import ApiError
+from kubernetes1_tpu.utils import pki
+
+
+@pytest.fixture(scope="module")
+def cluster_pki(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("pki"))
+    ca_cert, ca_key = pki.create_ca()
+    pki.write_pki(d, "ca", ca_cert, ca_key)
+    srv_cert, srv_key = pki.issue_cert(
+        ca_cert, ca_key, cn="kube-apiserver", server=True,
+        dns_sans=["localhost"], ip_sans=["127.0.0.1"])
+    pki.write_pki(d, "apiserver", srv_cert, srv_key)
+    adm_cert, adm_key = pki.issue_cert(
+        ca_cert, ca_key, cn="ktpu-admin", orgs=["system:masters"], client=True)
+    pki.write_pki(d, "admin", adm_cert, adm_key)
+    return {"dir": d, "ca_cert": ca_cert, "ca_key": ca_key}
+
+
+@pytest.fixture(scope="module")
+def tls_master(cluster_pki):
+    d = cluster_pki["dir"]
+    m = Master(tls_cert_file=f"{d}/apiserver.crt",
+               tls_key_file=f"{d}/apiserver.key",
+               client_ca_file=f"{d}/ca.crt",
+               authorization_mode="Node,RBAC").start()
+    yield m
+    m.stop()
+
+
+class TestPKI:
+    def test_ca_and_leaf_roundtrip(self):
+        ca_cert, ca_key = pki.create_ca("test-ca")
+        cert, _key = pki.issue_cert(ca_cert, ca_key, cn="u", orgs=["g1", "g2"],
+                                    client=True)
+        assert pki.cert_identity(cert) == ("u", ["g1", "g2"])
+
+    def test_csr_identity_and_sign(self):
+        ca_cert, ca_key = pki.create_ca()
+        csr, _key = pki.create_csr("system:node:n1", ["system:nodes"],
+                                   dns_sans=["n1"], ip_sans=["127.0.0.1"])
+        assert pki.is_pem_csr(csr)
+        assert pki.csr_identity(csr) == ("system:node:n1", ["system:nodes"])
+        cert = pki.sign_csr(ca_cert, ca_key, csr, client=True, server=True)
+        assert pki.cert_identity(cert) == ("system:node:n1", ["system:nodes"])
+
+    def test_ca_hash_pins(self):
+        a, _ = pki.create_ca("a")
+        b, _ = pki.create_ca("b")
+        assert pki.ca_cert_hash(a).startswith("sha256:")
+        assert pki.ca_cert_hash(a) != pki.ca_cert_hash(b)
+
+
+class TestTLSMaster:
+    def test_https_with_ca_verification(self, tls_master, cluster_pki):
+        d = cluster_pki["dir"]
+        assert tls_master.url.startswith("https://")
+        cs = Clientset(tls_master.url, ca_file=f"{d}/ca.crt",
+                       cert_file=f"{d}/admin.crt", key_file=f"{d}/admin.key")
+        assert cs.api.request("GET", "/healthz") == {"status": "ok"}
+        cs.close()
+
+    def test_x509_identity_is_cn_and_o(self, tls_master, cluster_pki):
+        # the admin cert (O=system:masters) passes RBAC with no token at all
+        d = cluster_pki["dir"]
+        cs = Clientset(tls_master.url, ca_file=f"{d}/ca.crt",
+                       cert_file=f"{d}/admin.crt", key_file=f"{d}/admin.key")
+        ns = t.Namespace()
+        ns.metadata.name = "x509-test"
+        assert cs.namespaces.create(ns, "").metadata.name == "x509-test"
+        cs.close()
+
+    def test_no_credential_is_anonymous(self, tls_master, cluster_pki):
+        d = cluster_pki["dir"]
+        cs = Clientset(tls_master.url, ca_file=f"{d}/ca.crt")
+        with pytest.raises(ApiError):
+            cs.pods.list()
+        cs.close()
+
+    def test_plaintext_rejected(self, tls_master):
+        with pytest.raises((OSError, http.client.HTTPException)):
+            c = http.client.HTTPConnection(tls_master.host, tls_master.port,
+                                           timeout=5)
+            c.request("GET", "/healthz")
+            c.getresponse()
+
+    def test_wrong_ca_client_rejected(self, tls_master, tmp_path):
+        evil_cert, evil_key = pki.create_ca("evil")
+        pki.write_pki(str(tmp_path), "evil", evil_cert, evil_key)
+        cs = Clientset(tls_master.url, ca_file=f"{tmp_path}/evil.crt")
+        with pytest.raises(OSError):
+            cs.api.request("GET", "/healthz")
+        cs.close()
+
+    def test_cert_from_untrusted_ca_gets_no_identity(self, tls_master,
+                                                     tmp_path, cluster_pki):
+        # handshake with a cert signed by a DIFFERENT CA must fail outright
+        evil_ca, evil_key = pki.create_ca("evil")
+        cert, key = pki.issue_cert(evil_ca, evil_key, cn="ktpu-admin",
+                                   orgs=["system:masters"], client=True)
+        pki.write_pki(str(tmp_path), "fake-admin", cert, key)
+        d = cluster_pki["dir"]
+        cs = Clientset(tls_master.url, ca_file=f"{d}/ca.crt",
+                       cert_file=f"{tmp_path}/fake-admin.crt",
+                       key_file=f"{tmp_path}/fake-admin.key")
+        with pytest.raises(OSError):
+            cs.namespaces.list()
+        cs.close()
+
+
+class TestX509Signer:
+    def test_signer_issues_real_cert_for_pem_csr(self, cluster_pki):
+        from kubernetes1_tpu.controllers.certificates import (
+            CertificateController,
+        )
+
+        ctrl = CertificateController.__new__(CertificateController)
+        ctrl.ca_key = cluster_pki["ca_key"]
+        ctrl.ca_cert_pem = cluster_pki["ca_cert"]
+        ctrl.x509 = True
+        csr_pem, _key = pki.create_csr("system:node:n2", ["system:nodes"])
+        csr = t.CertificateSigningRequest()
+        csr.spec.request = csr_pem
+        csr.spec.username = "system:node:n2"
+        csr.spec.groups = ["system:nodes"]
+        csr.spec.usages = ["client auth", "server auth"]
+        cert = ctrl._sign(csr)
+        assert pki.cert_identity(cert) == ("system:node:n2", ["system:nodes"])
+
+    def test_signer_rejects_subject_smuggling(self, cluster_pki):
+        # CSR x509 subject asks for admin while spec.username is a node:
+        # the signer must refuse (approval checked spec.username only)
+        from kubernetes1_tpu.controllers.certificates import (
+            CertificateController,
+        )
+
+        ctrl = CertificateController.__new__(CertificateController)
+        ctrl.ca_key = cluster_pki["ca_key"]
+        ctrl.ca_cert_pem = cluster_pki["ca_cert"]
+        ctrl.x509 = True
+        csr_pem, _key = pki.create_csr("ktpu-admin", ["system:masters"])
+        csr = t.CertificateSigningRequest()
+        csr.spec.request = csr_pem
+        csr.spec.username = "system:node:n3"
+        csr.spec.groups = ["system:nodes"]
+        with pytest.raises(ValueError):
+            ctrl._sign(csr)
