@@ -45,6 +45,11 @@ def main():
     ap.add_argument("--tls-key-file", default="")
     ap.add_argument("--client-ca-file", default="",
                     help="CA bundle for x509 client-cert authn")
+    ap.add_argument("--store-address", default="",
+                    help="external store (unix path or host:port); makes "
+                         "this apiserver stateless — run several")
+    ap.add_argument("--store-ca-file", default="",
+                    help="CA to verify the store's TLS cert")
     args = ap.parse_args()
     if args.feature_gates:
         from ..utils.features import gates
@@ -78,6 +83,8 @@ def main():
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_key_file,
         client_ca_file=args.client_ca_file,
+        store_address=args.store_address,
+        store_ca_file=args.store_ca_file,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -807,11 +807,24 @@ class Master:
         tls_cert_file: str = "",               # serve HTTPS (ref serve.go)
         tls_key_file: str = "",
         client_ca_file: str = "",              # verify client certs (x509 authn)
+        store_address: str = "",               # external StoreServer (etcd role):
+                                               # unix path or host:port — makes
+                                               # this apiserver stateless
+        store_ca_file: str = "",               # verify the store's TLS cert
     ):
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
         self.scheme = scheme or global_scheme.copy()
-        self.store = Store(self.scheme, wal_path=wal_path)
+        if store_address:
+            from ..storage.remote import RemoteStore
+
+            addr: object = store_address
+            if ":" in store_address and "/" not in store_address:
+                host, _, port = store_address.rpartition(":")
+                addr = (host, int(port))
+            self.store = RemoteStore(self.scheme, addr, ca_file=store_ca_file)
+        else:
+            self.store = Store(self.scheme, wal_path=wal_path)
         self.registry = Registry(self.store, self.scheme)
         self.token = token
         self.metrics = Metrics()

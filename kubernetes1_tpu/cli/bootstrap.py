@@ -112,21 +112,17 @@ def bootstrap_node_credential(server: str, join_token: str, node_name: str,
     bcs = Clientset(server, token=join_token, ca_file=ca_file)
     try:
         csr = t.CertificateSigningRequest()
-        csr.metadata.name = f"node-csr-{node_name}"
+        # kubeadm-style random suffix: every (re-)join submits a FRESH CSR
+        # carrying the new public key, and bootstrappers need no delete
+        # grant (a shared join token must not let one holder delete another
+        # host's in-flight CSR)
+        csr.metadata.name = f"node-csr-{node_name}-{_secrets.token_hex(3)}"
         csr.spec.request = csr_pem
         csr.spec.username = f"system:node:{node_name}"
         csr.spec.groups = ["system:nodes"]
         csr.spec.usages = ["client auth", "server auth"]
         try:
             bcs.certificatesigningrequests.create(csr, "")
-        except AlreadyExists:
-            # re-join: the old CSR carries the OLD public key — this host
-            # only has the new one, so resubmit under the same name
-            try:
-                bcs.certificatesigningrequests.delete(csr.metadata.name, "")
-                bcs.certificatesigningrequests.create(csr, "")
-            except ApiError as e:
-                raise SystemExit(f"error: CSR recreate failed: {e}")
         except ApiError as e:
             raise SystemExit(f"error: CSR create failed: {e}")
         deadline = time.time() + timeout
@@ -147,10 +143,13 @@ def bootstrap_node_credential(server: str, join_token: str, node_name: str,
         bcs.close()
 
 
-def _discover_ca(server: str, join_token: str, ca_cert_hash: str) -> str:
+def _discover_ca(server: str, ca_cert_hash: str) -> str:
     """kubeadm token discovery: read cluster-info over UNVERIFIED TLS, pin
-    the CA against the printed hash, and only then trust it."""
-    dcs = Clientset(server, token=join_token, insecure=True)
+    the CA against the printed hash, and only then trust it.  NO credential
+    rides this connection — cluster-info is anonymous-readable precisely so
+    the join token is never exposed to an unverified peer (kubeadm's
+    insecure discovery is likewise unauthenticated)."""
+    dcs = Clientset(server, insecure=True)
     try:
         info = dcs.configmaps.get("cluster-info", "kube-public")
     except ApiError as e:
@@ -300,7 +299,8 @@ def init(args) -> int:
     info_role = t.Role()
     info_role.metadata.name = "ktpu:bootstrap-signer-clusterinfo"
     info_role.metadata.namespace = "kube-public"
-    info_role.rules = [t.PolicyRule(verbs=["get"], resources=["configmaps"])]
+    info_role.rules = [t.PolicyRule(verbs=["get"], resources=["configmaps"],
+                                    resource_names=["cluster-info"])]
     info_rb = t.RoleBinding()
     info_rb.metadata.name = "ktpu:bootstrap-signer-clusterinfo"
     info_rb.metadata.namespace = "kube-public"
@@ -319,7 +319,7 @@ def init(args) -> int:
     role = t.ClusterRole()
     role.metadata.name = "system:node-bootstrapper"
     role.rules = [t.PolicyRule(
-        verbs=["create", "get", "list", "watch", "delete"],
+        verbs=["create", "get", "list", "watch"],
         resources=["certificatesigningrequests"],
     )]
     try:
@@ -384,8 +384,7 @@ def join(args) -> int:
     d = os.path.abspath(args.dir)
     node_name = args.node_name
     # ---- discovery: fetch + pin the cluster CA, then go fully verified
-    ca_pem = _discover_ca(args.server, args.token,
-                          getattr(args, "ca_cert_hash", ""))
+    ca_pem = _discover_ca(args.server, getattr(args, "ca_cert_hash", ""))
     pki_dir = os.path.join(d, "pki")
     ca_path, _ = pki.write_pki(pki_dir, "ca", ca_pem)
     cert_pem, key_pem = bootstrap_node_credential(

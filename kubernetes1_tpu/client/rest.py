@@ -98,14 +98,28 @@ class WatchStream:
 
 
 class ApiClient:
+    """REST client; `url` may be a comma-separated server list — on a
+    connection failure the client fails over to the next server (HA
+    apiservers are stateless peers over one store, so any of them serves;
+    the reference's client-go takes the same server list via kubeconfig)."""
+
     def __init__(self, url: str, token: str = "", timeout: float = 30.0,
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
                  insecure: bool = False):
-        self.url = url.rstrip("/")
-        parsed = urlparse(self.url)
-        self.host = parsed.hostname or "127.0.0.1"
-        self.tls = parsed.scheme == "https"
-        self.port = parsed.port or (443 if self.tls else 80)
+        self.urls = [u.strip().rstrip("/") for u in url.split(",")
+                     if u.strip()]
+        schemes = {urlparse(u).scheme for u in self.urls}
+        if len(schemes) > 1:
+            raise ValueError(
+                f"server list mixes schemes {sorted(schemes)}: every HA "
+                f"peer must be dialed the same way ({url!r})")
+        self.tls = schemes == {"https"}
+        self._servers = [
+            (p.hostname or "127.0.0.1",
+             p.port or (443 if self.tls else 80))
+            for p in map(urlparse, self.urls)
+        ]
+        self._active = 0
         self.token = token
         self.timeout = timeout
         self.ssl_context: Optional[ssl.SSLContext] = (
@@ -113,6 +127,24 @@ class ApiClient:
             if self.tls else None
         )
         self._local = threading.local()
+
+    @property
+    def url(self) -> str:
+        return self.urls[self._active]
+
+    @property
+    def host(self) -> str:
+        return self._servers[self._active][0]
+
+    @property
+    def port(self) -> int:
+        return self._servers[self._active][1]
+
+    def _rotate(self, from_idx: int):
+        """Advance to the next server (no-op if another thread already
+        did); per-thread pooled connections notice via the index stamp."""
+        if len(self._servers) > 1 and self._active == from_idx:
+            self._active = (from_idx + 1) % len(self._servers)
 
     # ------------------------------------------------------------- plumbing
 
@@ -123,18 +155,21 @@ class ApiClient:
         return h
 
     def _new_conn(self, timeout) -> http.client.HTTPConnection:
+        host, port = self._servers[self._active]
         if self.tls:
             return http.client.HTTPSConnection(
-                self.host, self.port, timeout=timeout,
-                context=self.ssl_context)
-        return http.client.HTTPConnection(self.host, self.port,
-                                          timeout=timeout)
+                host, port, timeout=timeout, context=self.ssl_context)
+        return http.client.HTTPConnection(host, port, timeout=timeout)
 
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
+        if conn is not None and getattr(self._local, "idx", -1) != self._active:
+            self._reset_conn()  # failed over: stale server's socket
+            conn = None
         if conn is None:
             conn = self._new_conn(self.timeout)
             self._local.conn = conn
+            self._local.idx = self._active
         return conn
 
     def _reset_conn(self):
@@ -163,8 +198,11 @@ class ApiClient:
         # only when the failure happened while *sending* (stale keep-alive
         # connection — the server never saw the request).  A mutation whose
         # response was lost may have been applied, so re-sending it could
-        # duplicate the action.
-        for attempt in (0, 1):
+        # duplicate the action.  Each connection-level failure also fails
+        # over to the next server in the list (HA apiservers).
+        attempts = 1 + max(1, len(self._servers))
+        for attempt in range(attempts):
+            idx = self._active
             conn = self._conn()
             sent = False
             try:
@@ -175,7 +213,8 @@ class ApiClient:
                 break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._reset_conn()
-                if attempt == 1 or (sent and method != "GET"):
+                self._rotate(idx)
+                if attempt == attempts - 1 or (sent and method != "GET"):
                     raise
         if raw and resp.status < 400:
             return raw_body
@@ -197,9 +236,23 @@ class ApiClient:
         params = dict(params or {})
         params["watch"] = "true"
         full = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
-        conn = self._new_conn(None)
-        conn.request("GET", full, headers=self._headers())
-        resp = conn.getresponse()
+        last_exc: Optional[Exception] = None
+        for _ in range(max(1, len(self._servers))):
+            idx = self._active
+            conn = self._new_conn(None)
+            try:
+                conn.request("GET", full, headers=self._headers())
+                resp = conn.getresponse()
+                break
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._rotate(idx)
+                last_exc = e
+        else:
+            raise last_exc  # every server refused the watch dial
         if resp.status >= 400:
             raw = resp.read()
             conn.close()

@@ -1,0 +1,244 @@
+"""RemoteStore: the apiserver's client to a StoreServer (the etcd3 client
+role — staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go).
+
+Implements the exact Store surface the registry consumes (create/get/list/
+update_cas/guaranteed_update/delete/watch/current_revision/compact/close),
+so a Master can be pointed at a store process instead of an in-process
+Store and N such Masters serve one cluster.  guaranteed_update runs its
+read-modify-CAS loop client-side, same as etcd3's txn retry (store.go:263).
+
+Request/response calls use a small per-thread-free connection pool; every
+watch gets its own dedicated streaming connection whose iterator mirrors
+storage.store.Watcher (next_timeout semantics included) so the apiserver's
+chunked-watch loop cannot tell the difference.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..machinery import Conflict, NotFound, WatchEvent
+from ..machinery.scheme import Scheme
+from .server import error_from_wire
+
+
+class RemoteWatcher:
+    """Iterator over WatchEvents from a dedicated store connection;
+    duck-types storage.store.Watcher (incl. next_timeout/stop)."""
+
+    def __init__(self, conn, f):
+        self._conn = conn
+        self._f = f
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+        t = threading.Thread(target=self._pump, daemon=True,
+                             name="remote-store-watch")
+        t.start()
+
+    def _pump(self):
+        try:
+            for line in self._f:
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                frame = json.loads(line)
+                ev = frame.get("event")
+                if ev is None:
+                    continue
+                self._q.put(WatchEvent(ev["type"], ev["object"]))
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._q.put(None)  # EOF sentinel: the stream is dead
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> WatchEvent:
+        ev = self._q.get()
+        if ev is None or self._stopped.is_set():
+            raise StopIteration
+        return ev
+
+    def next_timeout(self, timeout: float) -> Optional[WatchEvent]:
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if ev is None:
+            self._stopped.set()
+            return None
+        return ev
+
+
+class RemoteStore:
+    def __init__(self, scheme: Scheme,
+                 address: Union[str, Tuple[str, int]],
+                 ca_file: str = "", timeout: float = 30.0):
+        self._scheme = scheme
+        self.address = address
+        self.timeout = timeout
+        self._ssl_ctx = None
+        if ca_file:
+            import ssl
+
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self._ssl_ctx.load_verify_locations(cafile=ca_file)
+        self._pool: List = []
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    # ------------------------------------------------------------- transport
+
+    def _connect(self, timeout: Optional[float]):
+        if isinstance(self.address, str):
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(timeout)
+            conn.connect(self.address)
+        else:
+            conn = socket.create_connection(tuple(self.address),
+                                            timeout=timeout)
+        if self._ssl_ctx is not None:
+            host = self.address if isinstance(self.address, str) \
+                else self.address[0]
+            conn = self._ssl_ctx.wrap_socket(conn, server_hostname=host)
+        return conn, conn.makefile("rwb")
+
+    def _call(self, method: str, params: Optional[dict] = None):
+        with self._lock:
+            pair = self._pool.pop() if self._pool else None
+            self._next_id += 1
+            rid = self._next_id
+        if pair is None:
+            pair = self._connect(self.timeout)
+        conn, f = pair
+        try:
+            f.write(json.dumps({"id": rid, "method": method,
+                                "params": params or {}}).encode() + b"\n")
+            f.flush()
+            line = f.readline()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"store {self.address} unreachable")
+        if not line:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"store {self.address} closed")
+        try:
+            resp = json.loads(line)
+        except ValueError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError("store: corrupt response frame")
+        if resp.get("id") != rid:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError("store: response id mismatch")
+        with self._lock:
+            self._pool.append(pair)
+        if resp.get("error"):
+            raise error_from_wire(resp["error"])
+        return resp.get("result")
+
+    # ------------------------------------------------------------ operations
+
+    def create(self, key: str, obj) -> Any:
+        return self._scheme.decode(
+            self._call("create", {"key": key,
+                                  "obj": self._scheme.encode(obj)}))
+
+    def get(self, key: str) -> Any:
+        return self._scheme.decode(self._call("get", {"key": key}))
+
+    def get_or_none(self, key: str):
+        try:
+            return self.get(key)
+        except NotFound:
+            return None
+
+    def list(self, prefix: str) -> Tuple[List[Any], int]:
+        res = self._call("list", {"prefix": prefix})
+        return [self._scheme.decode(o) for o in res["items"]], res["rev"]
+
+    def update_cas(self, key: str, obj) -> Any:
+        return self._scheme.decode(
+            self._call("update_cas", {"key": key,
+                                      "obj": self._scheme.encode(obj)}))
+
+    def guaranteed_update(self, key: str,
+                          update_fn: Callable[[Any], Any]) -> Any:
+        while True:
+            cur = self.get(key)
+            updated = update_fn(cur)
+            if updated is None:
+                updated = cur
+            try:
+                return self.update_cas(key, updated)
+            except Conflict:
+                continue
+
+    def delete(self, key: str, expect_rv: str = "") -> Any:
+        return self._scheme.decode(
+            self._call("delete", {"key": key, "expect_rv": expect_rv}))
+
+    def current_revision(self) -> int:
+        return int(self._call("current_revision"))
+
+    def compact(self, keep_last: int = 1000):
+        self._call("compact", {"keep_last": keep_last})
+
+    # ------------------------------------------------------------------ watch
+
+    def watch(self, prefix: str, since_rev: int = 0) -> RemoteWatcher:
+        conn, f = self._connect(self.timeout)
+        try:
+            f.write(json.dumps({"id": 0, "method": "watch",
+                                "params": {"prefix": prefix,
+                                           "since_rev": since_rev}})
+                    .encode() + b"\n")
+            f.flush()
+            line = f.readline()
+            if not line:
+                raise ConnectionError(f"store {self.address} closed")
+            resp = json.loads(line)
+            if resp.get("error"):
+                raise error_from_wire(resp["error"])
+        except BaseException:
+            conn.close()
+            raise
+        conn.settimeout(None)  # the stream blocks until events arrive
+        return RemoteWatcher(conn, f)
+
+    def close(self):
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for conn, _f in pool:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -93,10 +93,12 @@ class TestInitJoin:
                 return {"host1", "host2"} <= ready
 
             must_poll_until(both_ready, timeout=30.0, desc="both hosts Ready")
-            # both kubelets joined via CSR-signed credentials
+            # both kubelets joined via CSR-signed credentials (kubeadm-style
+            # random-suffix names: node-csr-<node>-<rand>)
             csrs, _ = admin.certificatesigningrequests.list()
             names = {c.metadata.name for c in csrs}
-            assert {"node-csr-host1", "node-csr-host2"} <= names
+            for node in ("host1", "host2"):
+                assert any(n.startswith(f"node-csr-{node}-") for n in names)
             for c in csrs:
                 assert c.status.certificate  # approved + signed
             # anonymous access is locked down (Node,RBAC mode) — verified

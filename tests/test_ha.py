@@ -1,0 +1,283 @@
+"""Control-plane HA: store behind its own socket, N stateless apiservers,
+SIGKILL failover mid-Job.
+
+Ref: the reference's L0 is a separately-clustered etcd behind stateless
+apiservers (staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go:152,263);
+kill any apiserver and the control plane keeps going.  The VERDICT r3 bar:
+kill the active apiserver mid-Job (SIGKILL), the standby takes over, all
+watches resume via resourceVersion, no write lost, the Job completes.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver.server import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+from kubernetes1_tpu.storage.remote import RemoteStore
+from kubernetes1_tpu.storage.server import StoreServer
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestRemoteStore:
+    """The split store: RemoteStore(unix socket) against StoreServer."""
+
+    @pytest.fixture()
+    def remote(self, tmp_path):
+        store = Store(global_scheme.copy())
+        server = StoreServer(store, str(tmp_path / "store.sock")).start()
+        rs = RemoteStore(global_scheme.copy(), str(tmp_path / "store.sock"))
+        yield rs, store
+        rs.close()
+        server.stop()
+
+    def test_crud_roundtrip(self, remote):
+        rs, _ = remote
+        pod = t.Pod()
+        pod.metadata.name = "p"
+        pod.metadata.namespace = "d"
+        created = rs.create("/registry/pods/d/p", pod)
+        assert created.metadata.uid
+        got = rs.get("/registry/pods/d/p")
+        assert got.metadata.name == "p"
+        got.metadata.labels = {"a": "b"}
+        rs.update_cas("/registry/pods/d/p", got)
+        items, rev = rs.list("/registry/pods/")
+        assert len(items) == 1 and rev >= 2
+        rs.delete("/registry/pods/d/p")
+        assert rs.get_or_none("/registry/pods/d/p") is None
+
+    def test_cas_conflict_and_guaranteed_update(self, remote):
+        rs, _ = remote
+        pod = t.Pod()
+        pod.metadata.name = "p"
+        rs.create("/registry/pods/d/p", pod)
+        stale = rs.get("/registry/pods/d/p")
+        fresh = rs.get("/registry/pods/d/p")
+        fresh.metadata.labels = {"v": "1"}
+        rs.update_cas("/registry/pods/d/p", fresh)
+        from kubernetes1_tpu.machinery import Conflict
+
+        stale.metadata.labels = {"v": "stale"}
+        with pytest.raises(Conflict):
+            rs.update_cas("/registry/pods/d/p", stale)
+
+        def bump(obj):
+            obj.metadata.labels["v"] = "2"
+            return obj
+
+        assert rs.guaranteed_update("/registry/pods/d/p",
+                                    bump).metadata.labels["v"] == "2"
+
+    def test_watch_streams_and_resumes(self, remote):
+        rs, _ = remote
+        pod = t.Pod()
+        pod.metadata.name = "p"
+        rs.create("/registry/pods/d/p", pod)
+        _, rev = rs.list("/registry/pods/")
+        w = rs.watch("/registry/pods/", since_rev=0)
+        pod2 = t.Pod()
+        pod2.metadata.name = "q"
+        rs.create("/registry/pods/d/q", pod2)
+        ev = w.next_timeout(5.0)
+        assert ev is not None and ev.object["metadata"]["name"] == "q"
+        w.stop()
+        # resume from a known revision replays history
+        w2 = rs.watch("/registry/pods/", since_rev=rev)
+        ev2 = w2.next_timeout(5.0)
+        assert ev2 is not None and ev2.object["metadata"]["name"] == "q"
+        w2.stop()
+
+
+class TestTwoMastersOneStore:
+    def test_write_one_read_other_watch_crosses(self, tmp_path):
+        store = Store(global_scheme.copy(),
+                      wal_path=str(tmp_path / "store.wal"))
+        ss = StoreServer(store, str(tmp_path / "store.sock")).start()
+        m1 = Master(store_address=str(tmp_path / "store.sock")).start()
+        m2 = Master(store_address=str(tmp_path / "store.sock")).start()
+        try:
+            c1, c2 = Clientset(m1.url), Clientset(m2.url)
+            ns = t.Namespace()
+            ns.metadata.name = "ha"
+            c1.namespaces.create(ns, "")
+            assert c2.namespaces.get("ha", "").metadata.name == "ha"
+            with c2.pods.watch(namespace="ha") as w:
+                pod = t.Pod()
+                pod.metadata.name = "p1"
+                pod.spec.containers = [t.Container(name="c", image="i")]
+                c1.pods.create(pod, "ha")
+                etype, obj = next(iter(w))
+                assert (etype, obj["metadata"]["name"]) == ("ADDED", "p1")
+            c1.close()
+            c2.close()
+        finally:
+            m1.stop()
+            m2.stop()
+            ss.stop()
+
+
+def _spawn(cmd, log):
+    return subprocess.Popen(
+        cmd, stdout=open(log, "ab"), stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"), cwd=REPO)
+
+
+@pytest.fixture()
+def ha_cluster(tmp_path):
+    """store + 2 apiservers + KCM + scheduler + kubelet, all real
+    processes; every client takes the two-server list."""
+    d = str(tmp_path)
+    sock = os.path.join(d, "store.sock")
+    pa, pb = free_port(), free_port()
+    servers = f"http://127.0.0.1:{pa},http://127.0.0.1:{pb}"
+    py = sys.executable
+    procs = {}
+    procs["store"] = _spawn(
+        [py, "-m", "kubernetes1_tpu.storage", "--socket", sock,
+         "--wal", os.path.join(d, "store.wal")],
+        os.path.join(d, "store.log"))
+    for name, port in (("api-a", pa), ("api-b", pb)):
+        procs[name] = _spawn(
+            [py, "-m", "kubernetes1_tpu.apiserver", "--port", str(port),
+             "--store-address", sock],
+            os.path.join(d, f"{name}.log"))
+    cs = Clientset(servers)
+    # BOTH apiservers must be individually healthy before the kill test has
+    # meaning — a dead standby would pass a through-the-active-server check
+    for port in (pa, pb):
+        one = Clientset(f"http://127.0.0.1:{port}")
+        must_poll_until(lambda: _healthy(one), timeout=20.0,
+                        desc=f"apiserver :{port} healthy")
+        one.close()
+    procs["kcm"] = _spawn(
+        [py, "-m", "kubernetes1_tpu.controllers", "--server", servers],
+        os.path.join(d, "kcm.log"))
+    procs["sched"] = _spawn(
+        [py, "-m", "kubernetes1_tpu.scheduler", "--server", servers,
+         "--metrics-port", "-1"],
+        os.path.join(d, "sched.log"))
+    procs["kubelet"] = _spawn(
+        [py, "-m", "kubernetes1_tpu.kubelet", "--server", servers,
+         "--node-name", "ha-node", "--runtime", "fake",
+         "--root-dir", os.path.join(d, "kubelet")],
+        os.path.join(d, "kubelet.log"))
+    yield {"cs": cs, "procs": procs, "servers": servers, "dir": d,
+           "ports": (pa, pb)}
+    cs.close()
+    for p in procs.values():
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _healthy(cs):
+    try:
+        cs.api.request("GET", "/healthz")
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class TestApiserverFailover:
+    def test_sigkill_active_apiserver_mid_job(self, ha_cluster):
+        env = ha_cluster
+        cs = env["cs"]
+        must_poll_until(
+            lambda: any(c.type == "Ready" and c.status == "True"
+                        for n in cs.nodes.list()[0]
+                        for c in n.status.conditions),
+            timeout=30.0, desc="node Ready")
+        job = t.Job()
+        job.metadata.name = "ha-job"
+        job.spec.completions = 4
+        job.spec.parallelism = 2
+        pod_t = t.PodTemplateSpec()
+        pod_t.spec.restart_policy = "Never"
+        pod_t.spec.containers = [t.Container(
+            name="w", image="img", command=["sleep", "1"])]
+        job.spec.template = pod_t
+        cs.jobs.create(job, "default")
+        # wait until the job is actually in flight (pods exist)
+        must_poll_until(
+            lambda: len(cs.pods.list(namespace="default")[0]) >= 1,
+            timeout=30.0, desc="job pods created")
+        # a write landed just before the kill must survive it
+        marker = t.ConfigMap(data={"written": "before-kill"})
+        marker.metadata.name = "pre-kill-marker"
+        cs.configmaps.create(marker, "default")
+        # SIGKILL the ACTIVE apiserver (the one this client — and every
+        # component, since all start at index 0 — is talking to)
+        active_name = "api-a" if cs.api._active == 0 else "api-b"
+        os.killpg(env["procs"][active_name].pid, signal.SIGKILL)
+        # the standby takes over: job completes, nothing lost
+        must_poll_until(
+            lambda: (cs.jobs.get("ha-job", "default").status.succeeded
+                     or 0) >= 4,
+            timeout=90.0, desc="job completes through the standby apiserver")
+        assert cs.configmaps.get(
+            "pre-kill-marker", "default").data["written"] == "before-kill"
+        # the client did fail over
+        assert ("api-a" if cs.api._active == 0 else "api-b") != active_name
+
+    def test_watches_resume_after_kill(self, ha_cluster):
+        env = ha_cluster
+        cs = env["cs"]
+        must_poll_until(lambda: _healthy(cs), timeout=20.0, desc="healthy")
+        seen = []
+        import threading
+
+        stop = threading.Event()
+
+        def watch_loop():
+            # the reflector pattern: rewatch from last rv on stream death
+            rv = cs.configmaps.list(namespace="default")[1]
+            while not stop.is_set():
+                try:
+                    with cs.configmaps.watch(namespace="default",
+                                             resource_version=rv) as w:
+                        for etype, obj in w:
+                            seen.append(obj["metadata"]["name"])
+                            rv = obj["metadata"]["resourceVersion"]
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+
+        thr = threading.Thread(target=watch_loop, daemon=True)
+        thr.start()
+        active_name = "api-a" if cs.api._active == 0 else "api-b"
+        os.killpg(env["procs"][active_name].pid, signal.SIGKILL)
+        time.sleep(0.5)
+        after = t.ConfigMap(data={"k": "v"})
+        after.metadata.name = "post-kill-event"
+        must_poll_until(lambda: _try_create(cs, after), timeout=20.0,
+                        desc="write through standby")
+        must_poll_until(lambda: "post-kill-event" in seen, timeout=20.0,
+                        desc="watch resumed and saw the post-kill event")
+        stop.set()
+
+
+def _try_create(cs, obj):
+    try:
+        cs.configmaps.create(obj, "default")
+        return True
+    except Exception:  # noqa: BLE001
+        return False
