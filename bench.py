@@ -353,8 +353,13 @@ def bench_gang():
 
 
 def main():
+    from kubernetes1_tpu.utils.benchstamp import contention_stamp
+
     extras = {"baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46); "
-                          "north-star imgs/sec/chip + MFU (BASELINE.md)"}
+                          "north-star imgs/sec/chip + MFU (BASELINE.md)",
+              # box state BEFORE any phase: numbers from a loaded box are
+              # noise (22x p99 swing observed r3) — compare like-with-like
+              "contention": contention_stamp()}
     density = bench_density()
     extras.update(density)
 

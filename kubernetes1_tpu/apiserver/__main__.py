@@ -51,6 +51,10 @@ def main():
     ap.add_argument("--store-ca-file", default="",
                     help="CA to verify the store's TLS cert")
     args = ap.parse_args()
+    if args.store_address and args.wal:
+        ap.error("--wal and --store-address are mutually exclusive: with an "
+                 "external store, durability belongs to the STORE process's "
+                 "--wal — a local WAL here would silently never be written")
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)

@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..machinery import (
+    AlreadyExists,
     BadRequest,
     Conflict,
     Invalid,
@@ -327,7 +328,12 @@ class Registry:
             if self.store.get_or_none(key) is None:
                 ns = t.Namespace()
                 ns.metadata.name = name
-                self.store.create(key, ns)
+                try:
+                    self.store.create(key, ns)
+                except AlreadyExists:
+                    # the check-then-create races PEER apiservers on a
+                    # shared external store — losing that race IS success
+                    pass
 
     def check_namespace_active(self, name: str):
         ns = self.store.get_or_none(self.key("namespaces", "", name))

@@ -288,12 +288,14 @@ class Clientset:
         return self.resource("nodemetrics")
 
     def bind(self, namespace: str, pod_name: str, binding: t.Binding):
-        data = self.api.request(
+        """POST the binding subresource.  Returns the server's Status dict
+        (upstream's BindingREST returns a Status, not the pod — re-GET the
+        pod if the updated object is needed)."""
+        return self.api.request(
             "POST",
             f"/api/v1/namespaces/{namespace}/pods/{pod_name}/binding",
             body=self.scheme.encode(binding),
         )
-        return self.scheme.decode(data)
 
     def evict(self, namespace: str, pod_name: str,
               grace_seconds: "Optional[int]" = None):

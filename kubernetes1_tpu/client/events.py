@@ -1,8 +1,17 @@
 """Event recorder (ref: client-go tools/record) — best-effort, rate-bounded
-event creation with count aggregation for repeats."""
+event creation with count aggregation for repeats.
+
+Like the reference's EventBroadcaster, recording is ASYNCHRONOUS: event()
+enqueues onto a bounded buffer drained by one background sink thread
+(client-go's StartRecordingToSink over a buffered channel), so an event
+never adds an API round trip to the caller's hot path — the scheduler's
+bind loop and the kubelet's sync workers record thousands of events under
+load.  When the buffer is full the newest event is dropped (events are
+best-effort by contract; upstream's channel send behaves the same)."""
 
 from __future__ import annotations
 
+import queue
 import threading
 from typing import Dict
 
@@ -12,47 +21,86 @@ from .clientset import Clientset
 
 
 class EventRecorder:
-    def __init__(self, clientset: Clientset, component: str, max_cached: int = 4096):
+    def __init__(self, clientset: Clientset, component: str,
+                 max_cached: int = 4096, buffer: int = 2048):
         self.cs = clientset
         self.component = component
         self._lock = threading.Lock()
         self._seen: Dict[tuple, str] = {}  # aggregation key -> event name
         self._max = max_cached
+        self._q: "queue.Queue" = queue.Queue(maxsize=buffer)
+        self._worker: threading.Thread = None  # started on first event
 
     def event(self, obj, event_type: str, reason: str, message: str):
-        """Record an event about obj; repeats bump count instead of piling up."""
+        """Record an event about obj; repeats bump count instead of piling
+        up.  Returns immediately — the API write happens on the sink
+        thread."""
         ref = t.ObjectReference(
             kind=type(obj).KIND,
             namespace=obj.metadata.namespace,
             name=obj.metadata.name,
             uid=obj.metadata.uid,
         )
+        try:
+            self._q.put_nowait((ref, event_type, reason, message, now_iso()))
+        except queue.Full:
+            return  # overloaded: drop (best-effort, as upstream)
+        self._ensure_worker()
+
+    def flush(self, timeout: float = 5.0):
+        """Block until every event enqueued so far has been sent (tests and
+        orderly shutdown; upstream's Shutdown analog)."""
+        done = threading.Event()
+        try:
+            self._q.put(done, timeout=timeout)
+        except queue.Full:
+            return
+        self._ensure_worker()
+        done.wait(timeout)
+
+    def _ensure_worker(self):
+        if self._worker is None:
+            with self._lock:
+                if self._worker is None:
+                    self._worker = threading.Thread(
+                        target=self._drain, daemon=True,
+                        name=f"event-sink/{self.component}")
+                    self._worker.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            try:
+                self._send(*item)
+            except Exception:  # noqa: BLE001 — events are best-effort
+                pass
+
+    def _send(self, ref, event_type: str, reason: str, message: str, now: str):
         key = (ref.kind, ref.namespace, ref.name, reason, message[:64])
-        now = now_iso()
         with self._lock:
             existing = self._seen.get(key)
         ns = ref.namespace or "default"
-        try:
-            if existing:
-                self._bump(existing, ns, now)
-                return
-            ev = t.Event()
-            ev.metadata.generate_name = f"{ref.name}."
-            ev.metadata.namespace = ns
-            ev.involved_object = ref
-            ev.type = event_type
-            ev.reason = reason
-            ev.message = message
-            ev.source_component = self.component
-            ev.first_timestamp = now
-            ev.last_timestamp = now
-            created = self.cs.events.create(ev, ns)
-            with self._lock:
-                if len(self._seen) > self._max:
-                    self._seen.clear()
-                self._seen[key] = created.metadata.name
-        except Exception:  # noqa: BLE001 — events are best-effort
-            pass
+        if existing:
+            self._bump(existing, ns, now)
+            return
+        ev = t.Event()
+        ev.metadata.generate_name = f"{ref.name}."
+        ev.metadata.namespace = ns
+        ev.involved_object = ref
+        ev.type = event_type
+        ev.reason = reason
+        ev.message = message
+        ev.source_component = self.component
+        ev.first_timestamp = now
+        ev.last_timestamp = now
+        created = self.cs.events.create(ev, ns)
+        with self._lock:
+            if len(self._seen) > self._max:
+                self._seen.clear()
+            self._seen[key] = created.metadata.name
 
     def _bump(self, name: str, ns: str, now: str):
         ev = self.cs.events.get(name, ns)

@@ -157,9 +157,18 @@ class ApiClient:
     def _new_conn(self, timeout) -> http.client.HTTPConnection:
         host, port = self._servers[self._active]
         if self.tls:
-            return http.client.HTTPSConnection(
+            conn = http.client.HTTPSConnection(
                 host, port, timeout=timeout, context=self.ssl_context)
-        return http.client.HTTPConnection(host, port, timeout=timeout)
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        # request body goes out in a separate send from the headers; without
+        # NODELAY, Nagle can hold the second segment behind a delayed ACK
+        conn.connect()
+        try:
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except (OSError, AttributeError):
+            pass
+        return conn
 
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
@@ -203,9 +212,9 @@ class ApiClient:
         attempts = 1 + max(1, len(self._servers))
         for attempt in range(attempts):
             idx = self._active
-            conn = self._conn()
             sent = False
             try:
+                conn = self._conn()
                 conn.request(method, path, body=payload, headers=self._headers())
                 sent = True
                 resp = conn.getresponse()
@@ -237,18 +246,20 @@ class ApiClient:
         params["watch"] = "true"
         full = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
         last_exc: Optional[Exception] = None
+        conn = None
         for _ in range(max(1, len(self._servers))):
             idx = self._active
-            conn = self._new_conn(None)
             try:
+                conn = self._new_conn(None)
                 conn.request("GET", full, headers=self._headers())
                 resp = conn.getresponse()
                 break
             except (http.client.HTTPException, ConnectionError, OSError) as e:
-                try:
-                    conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
                 self._rotate(idx)
                 last_exc = e
         else:

@@ -128,9 +128,41 @@ class CertificateController(Controller):
             or "system:masters" in groups
         )
 
+    # CSR garbage collection (ref pkg/controller/certificates/cleaner):
+    # bootstrap mints a fresh random-named CSR per (re-)join, so without a
+    # TTL the store grows one object per join forever
+    SIGNED_TTL_S = 3600.0       # issued certs: the node already has it
+    PENDING_TTL_S = 24 * 3600.0  # never-approved/denied leftovers
+
+    def _gc(self, csr) -> bool:
+        """Delete expired CSRs; returns True when the object is gone.
+        Re-enqueues itself for the remaining TTL otherwise."""
+        import time as _time
+
+        from ..machinery.meta import parse_iso
+
+        try:
+            age = _time.time() - parse_iso(csr.metadata.creation_timestamp)
+        except (ValueError, TypeError):
+            return False
+        ttl = (self.SIGNED_TTL_S
+               if csr.status.certificate or self._condition(csr, "Denied")
+               else self.PENDING_TTL_S)
+        if age >= ttl:
+            try:
+                self.cs.certificatesigningrequests.delete(csr.metadata.name, "")
+            except ApiError:
+                pass
+            return True
+        self.enqueue_after(csr.metadata.name, ttl - age + 1.0)
+        return False
+
     def sync(self, key: str):
         cached = self.csrs.get(key)
-        if cached is None or self._condition(cached, "Denied"):
+        if cached is None:
+            return
+        if self._condition(cached, "Denied"):
+            self._gc(cached)
             return
         from ..api import types as t
 
@@ -139,6 +171,8 @@ class CertificateController(Controller):
         try:
             csr = self.cs.certificatesigningrequests.get(cached.metadata.name, "")
         except NotFound:
+            return
+        if self._gc(csr):
             return
         changed = False
         if not self._condition(csr, "Approved"):

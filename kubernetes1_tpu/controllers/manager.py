@@ -86,6 +86,9 @@ class ControllerManager:
         self.node_lifecycle.start()
 
     def start(self):
+        from ..utils.gctune import tune_for_server
+
+        tune_for_server()
         if self.leader_elect:
             self._elector = LeaderElector(
                 self.cs,

@@ -23,6 +23,7 @@ import json
 import os
 import socket
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
@@ -219,11 +220,22 @@ class PluginClient:
         self._f = None
         self._next_id = 0
 
-    def _connect(self):
-        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        conn.settimeout(self.timeout)
-        conn.connect(self.socket_path)
-        return conn
+    def _connect(self, retry_window: float = 3.0):
+        # bounded dial retry: the plugin's socket FILE appears at bind(),
+        # a beat before listen() — the plugin watcher (and tests) race
+        # that gap and must not fail a plugin that is 10ms from ready
+        deadline = time.monotonic() + retry_window
+        while True:
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(self.timeout)
+            try:
+                conn.connect(self.socket_path)
+                return conn
+            except (ConnectionRefusedError, FileNotFoundError):
+                conn.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
 
     def _ensure(self):
         if self._conn is None:

@@ -220,6 +220,9 @@ class Kubelet:
 
     def start(self):
         from ..utils.features import gates
+        from ..utils.gctune import tune_for_server
+
+        tune_for_server()
 
         if gates.enabled("DevicePlugins"):
             self.device_manager.start()

@@ -415,6 +415,9 @@ class KubeletServer:
                  tls_key_file: str = ""):
         self._httpd = ThreadingHTTPServer((host, port), _KubeletHandler)
         self._httpd.daemon_threads = True
+        from ..utils.streams import quiet_connection_errors
+
+        quiet_connection_errors(self._httpd)
         self._httpd.kubelet = kubelet  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self.host, self.port = self._httpd.server_address[:2]
@@ -427,9 +430,6 @@ class KubeletServer:
             self._httpd.socket = ctx.wrap_socket(
                 self._httpd.socket, server_side=True,
                 do_handshake_on_connect=False)
-            from ..utils.streams import quiet_tls_errors
-
-            quiet_tls_errors(self._httpd)
             self.url = f"https://{self.host}:{self.port}"
         else:
             self.url = f"http://{self.host}:{self.port}"

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import threading
 import typing
 from typing import Any, Dict, Optional, Type
 
@@ -76,32 +77,87 @@ def to_dict(obj: Any) -> Any:
     return obj
 
 
+# Decoding is THE framework hot path (every watch event / LIST item crosses
+# it, and a 1000-node bench decodes millions of objects), so the per-type
+# decode plan is compiled ONCE into a closure instead of re-deriving
+# typing.get_origin/get_args/field info on every call.  _DECODERS only ever
+# holds FINISHED decoders (lock-free fast path for readers); compilation
+# runs under an RLock, with self-referential dataclasses resolved through a
+# private in-progress map only the building thread can see.
+_DECODERS: Dict[Any, Any] = {}
+_DECODERS_BUILDING: Dict[Any, Any] = {}
+_DECODERS_LOCK = threading.RLock()
+
+
+def _decoder(tp):
+    dec = _DECODERS.get(tp)
+    if dec is not None:
+        return dec
+    with _DECODERS_LOCK:
+        dec = _DECODERS.get(tp)
+        if dec is not None:
+            return dec
+        thunk = _DECODERS_BUILDING.get(tp)
+        if thunk is not None:
+            return thunk  # recursive self-reference during this build
+        cell = []
+        _DECODERS_BUILDING[tp] = lambda data: cell[0](data)
+        try:
+            real = _build_decoder(tp)  # recurses into _decoder (RLock)
+            cell.append(real)
+            _DECODERS[tp] = real
+        finally:
+            del _DECODERS_BUILDING[tp]
+        return real
+
+
+def _build_decoder(tp):
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list, tuple):
+        (item_tp,) = typing.get_args(tp) or (Any,)
+        item_dec = _decoder(item_tp)
+
+        def dec_list(data):
+            if data is None:
+                return None
+            return [item_dec(v) for v in data]
+        return dec_list
+    if origin is dict:
+        args = typing.get_args(tp)
+        val_dec = _decoder(args[1] if len(args) == 2 else Any)
+
+        def dec_dict(data):
+            if data is None:
+                return None
+            return {k: val_dec(v) for k, v in data.items()}
+        return dec_dict
+    if dataclasses.is_dataclass(tp):
+        fields = tuple((name, wire, _decoder(f_tp))
+                       for name, wire, f_tp, _d in _field_info(tp))
+
+        def dec_dc(data):
+            if data is None:
+                return None
+            if not isinstance(data, dict):
+                raise TypeError(f"cannot decode {data!r} into {tp.__name__}")
+            kwargs = {}
+            for name, wire, dec in fields:
+                if wire in data:
+                    kwargs[name] = dec(data[wire])
+            return tp(**kwargs)
+        return dec_dc
+    if tp in (int, float, str, bool):
+        def dec_prim(data):
+            return tp(data) if data is not None else data
+        return dec_prim
+    # Any, TypeVars, unions with >1 concrete arm: pass through unchanged
+    return lambda data: data
+
+
 def from_dict(cls: Type, data: Any) -> Any:
     """Decode plain data into `cls` using its type hints."""
-    cls = _unwrap_optional(cls)
-    if data is None:
-        return None
-    origin = typing.get_origin(cls)
-    if origin in (list, tuple):
-        (item_tp,) = typing.get_args(cls) or (Any,)
-        return [from_dict(item_tp, v) for v in data]
-    if origin is dict:
-        args = typing.get_args(cls)
-        val_tp = args[1] if len(args) == 2 else Any
-        return {k: from_dict(val_tp, v) for k, v in data.items()}
-    if dataclasses.is_dataclass(cls):
-        kwargs = {}
-        if not isinstance(data, dict):
-            raise TypeError(f"cannot decode {data!r} into {cls.__name__}")
-        for name, wire, tp, default in _field_info(cls):
-            if wire in data:
-                kwargs[name] = from_dict(tp, data[wire])
-        return cls(**kwargs)
-    if cls is Any or isinstance(cls, typing.TypeVar):
-        return data
-    if cls in (int, float, str, bool):
-        return cls(data) if data is not None else data
-    return data
+    return _decoder(cls)(data)
 
 
 class Unstructured:

@@ -127,7 +127,7 @@ class Scheduler:
         # pass per attempt — pay it only once the cluster has ever seen a
         # pod carrying anti-affinity terms (the sched_perf scale guard:
         # plain clusters never pay).
-        self._anti_affinity_seen = False
+        self._anti_affinity_uids: set = set()
 
     # legacy int views kept for in-process callers (tests, bench)
     @property
@@ -141,6 +141,9 @@ class Scheduler:
     # ---------------------------------------------------------------- wiring
 
     def start(self):
+        from ..utils.gctune import tune_for_server
+
+        tune_for_server()
         if self._metrics_port is not None and self.metrics_server is None:
             try:
                 self.metrics_server = MetricsServer(
@@ -207,9 +210,15 @@ class Scheduler:
         )
 
     def _note_affinity(self, pod: t.Pod):
-        if (not self._anti_affinity_seen and pod.spec.affinity is not None
+        """Track WHICH pods carry required anti-affinity (not a sticky
+        latch): the O(pods) PodAffinityChecker build is paid only while at
+        least one such pod is alive — scheduling goes back to the cheap
+        path once an anti-affinity workload drains."""
+        if (pod.spec.affinity is not None
                 and pod.spec.affinity.pod_anti_affinity_required):
-            self._anti_affinity_seen = True
+            self._anti_affinity_uids.add(pod.metadata.uid)
+        else:
+            self._anti_affinity_uids.discard(pod.metadata.uid)
 
     def _on_pod_add(self, pod: t.Pod):
         self._note_affinity(pod)
@@ -226,6 +235,7 @@ class Scheduler:
             self.cache.add_pod(pod)
 
     def _on_pod_delete(self, pod: t.Pod):
+        self._anti_affinity_uids.discard(pod.metadata.uid)
         self.cache.remove_pod(pod)
         # freed resources may unblock backing-off pods
         self.queue.flush_backoffs()
@@ -319,7 +329,7 @@ class Scheduler:
 
     def _needs_affinity_check(self, pod: t.Pod) -> bool:
         aff = pod.spec.affinity
-        return self._anti_affinity_seen or (
+        return bool(self._anti_affinity_uids) or (
             aff is not None and bool(
                 aff.pod_affinity_required or aff.pod_anti_affinity_required)
         )
@@ -380,6 +390,15 @@ class Scheduler:
             if self._node_reserved_against(ni.node.metadata.name, pod):
                 reasons["node reserved for a nominated preemptor"] += 1
                 continue
+            # device fit FIRST: it is the cheapest check (O(1) availability
+            # counters) and the dominant rejector on a filling cluster —
+            # near chip saturation most nodes fail here, and paying the
+            # full predicate walk before a counter comparison is the
+            # difference between O(free) and O(nodes) scans at density
+            ok, why = fits_devices(pod, ni)
+            if not ok:
+                reasons[why] += 1
+                continue
             ok, why = run_predicates(pod, ni, self.equiv_cache)
             if not ok:
                 reasons[why[0] if why else "predicate failed"] += 1
@@ -389,10 +408,6 @@ class Scheduler:
                 if not ok:
                     reasons[why_a] += 1
                     continue
-            ok, why = fits_devices(pod, ni)
-            if not ok:
-                reasons[why] += 1
-                continue
             feasible.append(ni)
             if len(feasible) >= enough:
                 break

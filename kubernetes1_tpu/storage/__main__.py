@@ -26,13 +26,21 @@ def main():
     ap.add_argument("--wal", default="", help="write-ahead log for durability")
     ap.add_argument("--tls-cert-file", default="")
     ap.add_argument("--tls-key-file", default="")
+    ap.add_argument("--client-ca-file", default="",
+                    help="require client certs signed by this CA (mTLS); "
+                         "strongly recommended for TCP mode")
     args = ap.parse_args()
+    if args.port and not args.socket and not args.client_ca_file:
+        print("WARNING: TCP store without --client-ca-file accepts any "
+              "client that can reach the port — use mTLS or a unix socket",
+              flush=True)
 
     store = Store(global_scheme.copy(), wal_path=args.wal or None)
     address = args.socket if args.socket else (args.host, args.port)
     server = StoreServer(store, address,
                          tls_cert_file=args.tls_cert_file,
-                         tls_key_file=args.tls_key_file).start()
+                         tls_key_file=args.tls_key_file,
+                         client_ca_file=args.client_ca_file).start()
     shown = server.address if isinstance(server.address, str) \
         else f"{server.address[0]}:{server.address[1]}"
     print(f"ktpu-store serving on {shown}", flush=True)

@@ -35,6 +35,10 @@ class RemoteWatcher:
         self._f = f
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
+        # closed=True means the stream is DEAD (store gone), not idle —
+        # consumers must distinguish this from a heartbeat timeout or a
+        # store restart would leave every watch silently stalled forever
+        self.closed = False
         t = threading.Thread(target=self._pump, daemon=True,
                              name="remote-store-watch")
         t.start()
@@ -53,10 +57,12 @@ class RemoteWatcher:
         except (OSError, ValueError):
             pass
         finally:
+            self.closed = True
             self._q.put(None)  # EOF sentinel: the stream is dead
 
     def stop(self):
         self._stopped.set()
+        self.closed = True
         try:
             self._conn.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -90,7 +96,8 @@ class RemoteWatcher:
 class RemoteStore:
     def __init__(self, scheme: Scheme,
                  address: Union[str, Tuple[str, int]],
-                 ca_file: str = "", timeout: float = 30.0):
+                 ca_file: str = "", cert_file: str = "", key_file: str = "",
+                 timeout: float = 30.0):
         self._scheme = scheme
         self.address = address
         self.timeout = timeout
@@ -100,6 +107,10 @@ class RemoteStore:
 
             self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
             self._ssl_ctx.load_verify_locations(cafile=ca_file)
+            if cert_file:
+                # mTLS: the store requires a cluster-CA client cert
+                self._ssl_ctx.load_cert_chain(certfile=cert_file,
+                                              keyfile=key_file or None)
         self._pool: List = []
         self._lock = threading.Lock()
         self._next_id = 0
@@ -120,31 +131,50 @@ class RemoteStore:
             conn = self._ssl_ctx.wrap_socket(conn, server_hostname=host)
         return conn, conn.makefile("rwb")
 
+    _IDEMPOTENT = frozenset({"get", "list", "current_revision", "compact"})
+
     def _call(self, method: str, params: Optional[dict] = None):
-        with self._lock:
-            pair = self._pool.pop() if self._pool else None
-            self._next_id += 1
-            rid = self._next_id
-        if pair is None:
-            pair = self._connect(self.timeout)
-        conn, f = pair
-        try:
-            f.write(json.dumps({"id": rid, "method": method,
-                                "params": params or {}}).encode() + b"\n")
-            f.flush()
-            line = f.readline()
-        except (BrokenPipeError, ConnectionResetError, OSError):
+        # A pooled connection may be stale (store restarted); one retry on
+        # a FRESH connection is safe only when the store cannot have seen
+        # the request (failure while SENDING) or the method is idempotent —
+        # a fully-sent create/delete/update_cas may have been APPLIED, and
+        # re-sending it would fabricate AlreadyExists/NotFound/Conflict
+        # errors (same rule as the REST client's stale-keep-alive retry).
+        for attempt in (0, 1):
+            with self._lock:
+                pair = self._pool.pop() if self._pool else None
+                self._next_id += 1
+                rid = self._next_id
+            pooled = pair is not None
+            if pair is None:
+                pair = self._connect(self.timeout)
+            conn, f = pair
+            sent = False
+            retriable = lambda: (pooled and attempt == 0  # noqa: E731
+                                 and (not sent or method in self._IDEMPOTENT))
             try:
-                conn.close()
-            except OSError:
-                pass
-            raise ConnectionError(f"store {self.address} unreachable")
-        if not line:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            raise ConnectionError(f"store {self.address} closed")
+                f.write(json.dumps({"id": rid, "method": method,
+                                    "params": params or {}}).encode() + b"\n")
+                f.flush()
+                sent = True
+                line = f.readline()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if retriable():
+                    continue
+                raise ConnectionError(f"store {self.address} unreachable")
+            if not line:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if retriable():
+                    continue
+                raise ConnectionError(f"store {self.address} closed")
+            break
         try:
             resp = json.loads(line)
         except ValueError:

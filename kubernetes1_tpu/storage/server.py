@@ -70,7 +70,13 @@ class StoreServer:
     encoded dict representation throughout (no double decode)."""
 
     def __init__(self, store: Store, address: Union[str, Tuple[str, int]],
-                 tls_cert_file: str = "", tls_key_file: str = ""):
+                 tls_cert_file: str = "", tls_key_file: str = "",
+                 client_ca_file: str = ""):
+        """The store IS the cluster — its socket must never be an
+        unauthenticated bypass of the apiserver's authz stack.  Unix
+        sockets are chmod 0600 (same-user only, the etcd-on-localhost
+        posture); TCP mode with client_ca_file REQUIRES a client cert
+        signed by that CA (etcd's peer/client mTLS)."""
         self.store = store
         self._threads = []
         self._stop = threading.Event()
@@ -81,6 +87,7 @@ class StoreServer:
                 pass
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             self._sock.bind(address)
+            os.chmod(address, 0o600)
             self.address: Union[str, Tuple[str, int]] = address
         else:
             self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -93,11 +100,17 @@ class StoreServer:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile=tls_cert_file,
                                 keyfile=tls_key_file or None)
+            if client_ca_file:
+                ctx.load_verify_locations(cafile=client_ca_file)
+                ctx.verify_mode = ssl.CERT_REQUIRED
             self._sock = ctx.wrap_socket(self._sock, server_side=True,
                                          do_handshake_on_connect=False)
         self._sock.listen(64)
 
     def start(self) -> "StoreServer":
+        from ..utils.gctune import tune_for_server
+
+        tune_for_server()
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="store-server")
         t.start()

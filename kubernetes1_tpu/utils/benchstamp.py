@@ -1,0 +1,48 @@
+"""Box-contention stamp for benchmark outputs.
+
+Round-3 lesson: the same benchmark swung 22x (29ms vs 0.65s steady-state
+p99) purely from concurrent load on this one-CPU box, and the JSON recorded
+nothing about it — making round-over-round comparisons noise-prone.  Every
+bench JSON now carries this stamp; judges and scripts compare only
+like-with-like and treat contaminated=true runs as unusable.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def _calibration_spin_ms(iters: int = 2_000_000) -> float:
+    """Wall time of a fixed arithmetic loop — the most direct measure of
+    how much CPU this process is actually getting.  Best-of-3 so a single
+    descheduling blip doesn't poison the stamp itself."""
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x = 0
+        for i in range(iters):
+            x += i & 7
+        best = min(best, time.perf_counter() - t0)
+    return best * 1000.0
+
+
+def contention_stamp() -> dict:
+    cpus = os.cpu_count() or 1
+    try:
+        with open("/proc/loadavg") as f:
+            load1 = float(f.read().split()[0])
+    except (OSError, ValueError):
+        load1 = -1.0
+    spin_ms = round(_calibration_spin_ms(), 1)
+    return {
+        "load1": load1,
+        "cpus": cpus,
+        "spin_ms": spin_ms,
+        # More than ~1.25 busy cores per core before we start = someone
+        # else is eating the box.  (Ambient load1 on the bench VM idles
+        # around 0.3-1.0 with full CPU access per the spin — genuinely
+        # dirty runs showed load1 3.4+ with a 2x spin.)  spin_ms is the
+        # direct signal: compare it across runs on the same host.
+        "contaminated": bool(load1 >= 0 and load1 > 1.25 * cpus),
+    }

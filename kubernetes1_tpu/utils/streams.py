@@ -70,9 +70,10 @@ def send_status(sock: socket.socket, exit_code: int, error: str = ""):
         pass
 
 
-def quiet_tls_errors(httpd):
-    """Failed handshakes (plaintext probe, wrong CA, port scan) are routine
-    noise on a TLS port — drop them instead of stack-tracing to stderr."""
+def quiet_connection_errors(httpd):
+    """Peer-gone noise (a watcher hanging up mid-stream, a plaintext probe
+    or wrong-CA handshake on a TLS port, a scanner) is routine on any
+    server socket — drop it instead of stack-tracing to stderr."""
     import ssl as _ssl
     import sys as _sys
 
@@ -85,6 +86,10 @@ def quiet_tls_errors(httpd):
         orig(request, client_address)
 
     httpd.handle_error = handle_error
+
+
+# back-compat alias (TLS servers were the first callers)
+quiet_tls_errors = quiet_connection_errors
 
 
 def upgrade_request(host: str, port: int, path: str, headers: dict,

@@ -72,7 +72,12 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     """PartitionSpecs per leaf.  Layer params carry a leading stacked-layer
     axis (for scan), which is never sharded."""
     return {
-        "embed": P("tp", "fsdp"),             # (vocab, d)
+        # vocab sharded over BOTH model axes, d replicated: same per-device
+        # bytes as a (tp, fsdp) 2-D tiling, but the embedding gather's
+        # output then reshards to batch-sharded activations without the
+        # mesh-transposed d-resharding that made XLA fall back to full
+        # rematerialization (the MULTICHIP dryrun hard-fails on that)
+        "embed": P(("tp", "fsdp"), None),     # (vocab, d)
         "layers": {
             "attn_norm": P(None, None),       # (L, d)
             "wq": P(None, "fsdp", "tp"),      # (L, d, n_heads*hd)

@@ -24,6 +24,7 @@ from kubernetes1_tpu.api import types as t  # noqa: E402
 from kubernetes1_tpu.apiserver import Master  # noqa: E402
 from kubernetes1_tpu.client import Clientset  # noqa: E402
 from kubernetes1_tpu.scheduler import Scheduler  # noqa: E402
+from kubernetes1_tpu.utils.benchstamp import contention_stamp  # noqa: E402
 from tests.helpers import make_node, make_tpu_pod  # noqa: E402
 
 
@@ -36,6 +37,11 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
     pods = pods or nodes * 30
     if pods > nodes * tpus_per_node:
         raise ValueError("pods exceed cluster chip capacity")
+    # contention stamp BEFORE the run: the bench itself saturates the box
+    # by design, so an end-of-run loadavg would flag every run as dirty.
+    # Numbers from an already-loaded box are noise (22x p99 swing observed
+    # round 3); contaminated=true marks the run unusable for comparisons.
+    stamp = contention_stamp()
 
     import socket
     import subprocess
@@ -77,7 +83,7 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
     try:
         return _drive(nodes, pods, tpus_per_node, creators, multiproc,
                       url, cs, master if not multiproc else None, sched,
-                      metrics_url)
+                      metrics_url, stamp)
     finally:
         # child processes must never outlive the run (a leaked apiserver/
         # scheduler would skew every later bench phase)
@@ -111,7 +117,7 @@ def scrape_metrics(metrics_url: str) -> dict:
 
 
 def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
-           sched, metrics_url=None) -> dict:
+           sched, metrics_url=None, stamp=None) -> dict:
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
         node = make_node(f"perf-{i}", cpu="64", memory="256Gi",
@@ -214,6 +220,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "nodes": nodes,
         "pods_requested": pods,
         "pods_bound": len(bound),
+        "contention": stamp,
         "create_wall_s": round(create_wall, 2),
         "total_wall_s": round(total_wall, 2),
         "pods_per_sec": round(throughput, 1) if total_wall > 0 else None,

@@ -139,7 +139,9 @@ class TestBindingSubresource:
             extended_resource_assignments={per_name: ["tpu-0", "tpu-1"]},
         )
         binding.metadata.name = "bind-a"
-        bound = cs.bind("default", "bind-a", binding)
+        status = cs.bind("default", "bind-a", binding)
+        assert status.get("status") == "Success"  # upstream returns Status
+        bound = cs.pods.get("bind-a", "default")
         assert bound.spec.node_name == "node-1"
         assert bound.spec.extended_resources[0].assigned == ["tpu-0", "tpu-1"]
         # double-bind to another node must conflict

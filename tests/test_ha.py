@@ -165,7 +165,7 @@ def ha_cluster(tmp_path):
     # meaning — a dead standby would pass a through-the-active-server check
     for port in (pa, pb):
         one = Clientset(f"http://127.0.0.1:{port}")
-        must_poll_until(lambda: _healthy(one), timeout=20.0,
+        must_poll_until(lambda: _healthy(one), timeout=60.0,
                         desc=f"apiserver :{port} healthy")
         one.close()
     procs["kcm"] = _spawn(

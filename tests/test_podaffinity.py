@@ -186,15 +186,21 @@ class TestSelectorSpreading:
 
 class TestPerfGuard:
     def test_no_checker_built_without_anti_affinity(self, cluster):
-        """Plain clusters never pay the O(pods) affinity pass: the sticky
-        flag only flips when an anti-affinity pod is observed."""
+        """Plain clusters never pay the O(pods) affinity pass; the tracking
+        is a live refcount, not a sticky latch — draining the anti-affinity
+        pods returns scheduling to the cheap path."""
         sched = cluster["sched"]
-        assert sched._anti_affinity_seen is False
+        assert not sched._anti_affinity_uids
         cs = cluster["cs"]
         cs.pods.create(labeled_pod("plain", {"app": "plain"}))
         wait_scheduled(cs, "plain")
-        assert sched._anti_affinity_seen is False
+        assert not sched._anti_affinity_uids
         cs.pods.create(labeled_pod(
             "flagger", {"app": "f"}, anti_on_host({"app": "f"})))
         wait_scheduled(cs, "flagger")
-        assert sched._anti_affinity_seen is True
+        assert sched._anti_affinity_uids
+        cs.pods.delete("flagger", grace_seconds=0)
+        from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+        must_poll_until(lambda: not sched._anti_affinity_uids, timeout=10.0,
+                        desc="anti-affinity refcount drains with the pod")
