@@ -39,6 +39,7 @@ _REGISTRY = [
     (t.APIService, "apiservices", False),
     (t.PodMetrics, "podmetrics", True),
     (t.NodeMetrics, "nodemetrics", False),
+    (t.PodSecurityPolicy, "podsecuritypolicies", False),
     (t.Role, "roles", True),
     (t.ClusterRole, "clusterroles", False),
     (t.RoleBinding, "rolebindings", True),

@@ -307,6 +307,28 @@ class Probe:
 
 
 @dataclass
+class SecurityContext:
+    """Per-container security settings (ref: core/v1 SecurityContext +
+    pkg/securitycontext): who the process runs as and whether it may touch
+    privileged host resources (/dev/accel* hostPaths on a TPU host)."""
+
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+    privileged: Optional[bool] = None
+
+
+@dataclass
+class PodSecurityContext:
+    """Pod-level defaults every container inherits unless it overrides
+    (ref: core/v1 PodSecurityContext; DetermineEffectiveSecurityContext)."""
+
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
@@ -321,6 +343,7 @@ class Container:
     volume_mounts: List[VolumeMount] = field(default_factory=list)
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    security_context: Optional[SecurityContext] = None
     # Names of PodSpec.extended_resources entries this container consumes
     # (ref: types.go:2202-2204).
     extended_resource_requests: List[str] = field(default_factory=list)
@@ -393,6 +416,7 @@ class PodSpec:
     active_deadline_seconds: Optional[int] = None
     host_network: bool = False
     service_account_name: str = ""
+    security_context: Optional[PodSecurityContext] = None
     # fork v2: pod-level device requests with attribute affinity
     extended_resources: List[PodExtendedResource] = field(default_factory=list)
     # gang scheduling (TPU multi-host slices): pods sharing
@@ -1364,3 +1388,50 @@ class APIService(KObject):
     API_VERSION = "apiregistration/v1"
     spec: APIServiceSpec = field(default_factory=APIServiceSpec)
     status: APIServiceStatus = field(default_factory=APIServiceStatus)
+
+
+# ------------------------------------------------------- pod security policy
+
+@dataclass
+class PodSecurityPolicySpec:
+    """Ref: pkg/apis/policy PodSecurityPolicySpec (the subset with teeth on
+    a shared TPU host): may pods run privileged, which hostPath prefixes
+    are mountable, and must they run as non-root."""
+
+    privileged: bool = False
+    # path PREFIXES a hostPath volume may use; empty = any path
+    allowed_host_paths: List[str] = field(default_factory=list)
+    # RunAsAny | MustRunAsNonRoot (ref RunAsUserStrategyOptions)
+    run_as_user_rule: str = "RunAsAny"
+
+
+@dataclass
+class PodSecurityPolicy(KObject):
+    """Ref: pkg/security/podsecuritypolicy + its admission plugin: a
+    cluster-scoped policy every pod must satisfy (any one matching policy
+    admits the pod)."""
+
+    KIND = "PodSecurityPolicy"
+    API_VERSION = "policy/v1beta1"
+    spec: PodSecurityPolicySpec = field(default_factory=PodSecurityPolicySpec)
+
+
+def effective_security_context(pod: "Pod", container: "Container") -> SecurityContext:
+    """Container overrides pod (ref pkg/securitycontext
+    DetermineEffectiveSecurityContext)."""
+    psc = pod.spec.security_context
+    csc = container.security_context
+    out = SecurityContext()
+    if psc is not None:
+        out.run_as_user = psc.run_as_user
+        out.run_as_group = psc.run_as_group
+        out.run_as_non_root = psc.run_as_non_root
+    if csc is not None:
+        if csc.run_as_user is not None:
+            out.run_as_user = csc.run_as_user
+        if csc.run_as_group is not None:
+            out.run_as_group = csc.run_as_group
+        if csc.run_as_non_root is not None:
+            out.run_as_non_root = csc.run_as_non_root
+        out.privileged = csc.privileged
+    return out

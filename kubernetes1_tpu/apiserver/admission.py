@@ -715,3 +715,71 @@ class AdmissionChain:
         for p in self.plugins:
             p.admit(operation, resource, obj, old, user=user)
         return obj
+
+
+class PodSecurityPolicyAdmission(AdmissionPlugin):
+    """Ref: pkg/security/podsecuritypolicy + plugin/pkg/admission/
+    security/podsecuritypolicy — every pod must satisfy at least ONE
+    PodSecurityPolicy: privileged containers need a policy allowing
+    privileged, hostPath volumes must match a policy's allowed path
+    prefixes, and MustRunAsNonRoot policies reject root-effective pods.
+
+    Posture when NO policies exist: allow (the plugin is always in the
+    chain here, whereas upstream only enables it alongside installed
+    policies — an empty policy set must not brick every cluster)."""
+
+    name = "PodSecurityPolicy"
+
+    def __init__(self, list_policies):
+        self._list_policies = list_policies
+
+    def admit(self, operation: str, resource: str, obj, old=None, user=None):
+        if resource != "pods" or operation != CREATE:
+            return
+        policies = self._list_policies()
+        if not policies:
+            return
+        reasons = []
+        for psp in policies:
+            why = self._violation(psp, obj)
+            if why is None:
+                return  # any one satisfied policy admits the pod
+            reasons.append(f"{psp.metadata.name}: {why}")
+        raise Forbidden(
+            "pod rejected by every PodSecurityPolicy: " + "; ".join(reasons))
+
+    @staticmethod
+    def _violation(psp, pod) -> "Optional[str]":
+        from ..api import types as t
+
+        spec = psp.spec
+        containers = list(pod.spec.containers) + list(pod.spec.init_containers)
+        for c in containers:
+            sc = t.effective_security_context(pod, c)
+            if sc.privileged and not spec.privileged:
+                return f"privileged container {c.name!r} not allowed"
+            if spec.run_as_user_rule == "MustRunAsNonRoot" and (
+                    sc.run_as_user is None or sc.run_as_user == 0):
+                return (f"container {c.name!r} must run as non-root "
+                        f"(effective runAsUser is "
+                        f"{'unset' if sc.run_as_user is None else '0'})")
+        if spec.allowed_host_paths:
+            import posixpath
+
+            allowed = tuple(spec.allowed_host_paths)
+            for v in pod.spec.volumes:
+                hp = getattr(v, "host_path", None)
+                if hp is None or not hp.path:
+                    continue
+                # normalized comparison: '/var/log/../../etc' must be
+                # judged as '/etc', not by its '/var/log/' spelling
+                # (lstrip first: normpath preserves a double leading slash)
+                norm = lambda s: posixpath.normpath(  # noqa: E731
+                    "/" + s.lstrip("/"))
+                path = norm(hp.path)
+                if not any(path == norm(p)
+                           or path.startswith(norm(p).rstrip("/") + "/")
+                           for p in allowed):
+                    return (f"hostPath {path!r} not under any allowed "
+                            f"prefix {list(allowed)}")
+        return None

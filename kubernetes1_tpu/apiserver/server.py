@@ -44,6 +44,7 @@ from .admission import (
     NodeRestriction,
     PodNodeSelector,
     PodPresetAdmission,
+    PodSecurityPolicyAdmission,
     PriorityResolver,
     ResourceQuotaAdmission,
     ResourceV2,
@@ -357,6 +358,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # the namespace may live only in the object body (no-ns URL
                 # form), so clear the whole cache — preset writes are rare
                 self.master._podpreset_cache.clear()
+            if method != "GET" and resource == "podsecuritypolicies":
+                # a just-written policy must gate the very next pod create
+                # (the generation bump also voids any in-flight stale scan)
+                self.master._psp_gen += 1
+                self.master._psp_cache = None
             self.master.metrics.observe(method, resource, time.monotonic() - start)
         except ApiError as e:
             try:
@@ -870,6 +876,8 @@ class Master:
         self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
         self._webhook_cache: Dict[str, tuple] = {}  # resource -> (ts, items)
         self._podpreset_cache: Dict[str, tuple] = {}  # namespace -> (ts, items)
+        self._psp_cache: Optional[tuple] = None       # (gen, ts, items)
+        self._psp_gen = 0
         self.authorization_mode = authorization_mode
         tokens = dict(static_tokens or {})
         if token:
@@ -928,6 +936,7 @@ class Master:
                 lambda: self._list_webhook_configs("mutatingwebhookconfigurations")),
             LimitRanger(self._list_limit_ranges),
             ResourceQuotaAdmission(self._list_quotas, self._quota_usage),
+            PodSecurityPolicyAdmission(self._list_psps),
             EventRateLimit(),
             ValidatingWebhookAdmission(
                 lambda: self._list_webhook_configs("validatingwebhookconfigurations")),
@@ -984,6 +993,24 @@ class Master:
             return hit[1]
         items, _ = self.store.list(self.registry.prefix("podpresets", namespace))
         self._podpreset_cache[namespace] = (now, items)
+        return items
+
+    def _list_psps(self):
+        """PodSecurityPolicies for admission, cached ~1s like webhook
+        configs: pod CREATE is hot and most clusters define no policies.
+        Generation-stamped so a scan racing a policy write can't overwrite
+        the write's invalidation with its stale result."""
+        import time as _time
+
+        now = _time.monotonic()
+        gen = self._psp_gen
+        hit = self._psp_cache
+        if hit is not None and hit[0] == gen and now - hit[1] < 1.0:
+            return hit[2]
+        items, _ = self.store.list(self.registry.prefix(
+            "podsecuritypolicies", ""))
+        if self._psp_gen == gen:
+            self._psp_cache = (gen, now, items)
         return items
 
     def _list_webhook_configs(self, resource: str):

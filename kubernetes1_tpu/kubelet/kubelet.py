@@ -1005,6 +1005,33 @@ class Kubelet:
         mounts = self.volume_manager.mounts_for_container(pod, container)
         mounts += [vars(m) for m in spec.mounts]
         annotations = dict(spec.annotations)
+        # securityContext (ref pkg/securitycontext + kuberuntime's
+        # verifyRunAsNonRoot): resolve the effective identity, refuse a
+        # runAsNonRoot container that would land on uid 0, and gate raw
+        # /dev hostPath mounts on privileged — unprivileged pods get TPU
+        # chips ONLY through the device-plugin allocation path
+        sc = t.effective_security_context(pod, container)
+        if sc.run_as_non_root and (sc.run_as_user is None
+                                   or sc.run_as_user == 0):
+            raise VolumeError(
+                f"container {container.name}: runAsNonRoot is set but the "
+                f"effective runAsUser is "
+                f"{'unset' if sc.run_as_user is None else 'root (0)'}")
+        if not sc.privileged:
+            import posixpath
+
+            for m in mounts:
+                # normalize BEFORE checking: '/tmp/../dev/accel0' and
+                # '//dev/accel0' must not sneak past a raw prefix match
+                # (lstrip first: normpath PRESERVES a double leading slash)
+                host = posixpath.normpath(
+                    "/" + (m.get("host_path") or "").lstrip("/"))
+                if host == "/dev" or host.startswith("/dev/"):
+                    raise VolumeError(
+                        f"container {container.name}: hostPath {host!r} "
+                        f"requires privileged: true (device access is "
+                        f"granted via google.com/tpu requests, not raw "
+                        f"/dev mounts)")
         return ContainerConfig(
             name=container.name,
             image=container.image,
@@ -1019,6 +1046,9 @@ class Kubelet:
                 pod, container),
             cpuset=sorted(self.cpu_manager.cpuset_for_container(pod, container)
                           or []),
+            run_as_user=sc.run_as_user,
+            run_as_group=sc.run_as_group,
+            privileged=bool(sc.privileged),
         )
 
     def _sync_containers(self, pod: t.Pod, sandbox_id: str):

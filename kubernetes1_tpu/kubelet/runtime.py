@@ -63,6 +63,11 @@ class ContainerConfig:
     # logical cpus the process tree is pinned to (CPU manager static policy;
     # empty = no pinning)
     cpuset: List[int] = field(default_factory=list)
+    # effective security context (ref pkg/securitycontext): the runtime
+    # drops to this uid/gid before exec; None = run as the kubelet's user
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+    privileged: bool = False
 
 
 @dataclass
@@ -370,6 +375,35 @@ def _wrap_with_cgroups(cmd: List[str], procs_files: List[str]) -> List[str]:
 
 
 _TASKSET = shutil.which("taskset")
+_SETPRIV = shutil.which("setpriv")
+
+
+def _wrap_with_user(cmd: List[str], uid: Optional[int],
+                    gid: Optional[int]) -> List[str]:
+    """Prefix `cmd` with a setpriv exec dropping to uid/gid before the
+    container command runs (ref: runc's process.user; pkg/securitycontext).
+    Either may be None (gid defaults to uid; a gid-only request keeps the
+    uid).  setpriv execs in place — same pid, privileges irrevocably
+    dropped.  Raises when the host cannot honor the request: silently
+    running a workload as the wrong identity is a security lie."""
+    g = gid if gid is not None else uid
+    need_uid = uid is not None and uid != os.geteuid()
+    need_gid = g is not None and g != os.getegid()
+    if not need_uid and not need_gid:
+        return list(cmd)  # already the requested identity
+    if os.geteuid() != 0:
+        raise PermissionError(
+            f"runAsUser/runAsGroup ({uid}/{g}) requires a root kubelet "
+            f"(running as {os.geteuid()})")
+    if not _SETPRIV:
+        raise PermissionError("runAsUser/runAsGroup requested but setpriv "
+                              "is not available on this host")
+    args = [_SETPRIV]
+    if uid is not None:
+        args.append(f"--reuid={uid}")
+    if g is not None:
+        args += [f"--regid={g}", "--clear-groups"]
+    return args + ["--"] + list(cmd)
 
 
 def _wrap_with_cpuset(cmd: List[str], cpuset: List[int]) -> List[str]:
@@ -501,6 +535,12 @@ class ProcessRuntime(RuntimeService):
             name = (m.get("name") or "").replace("-", "_").replace(".", "_").upper()
             if name:
                 env[f"KTPU_VOLUME_{name}"] = m.get("host_path", "")
+        if config.run_as_user is not None or config.run_as_group is not None:
+            # applied FIRST = innermost: the cgroup-join/mount/pinning
+            # preambles run with the kubelet's privileges, then setpriv
+            # drops to the container's uid/gid and execs the workload
+            cmd = _wrap_with_user(cmd, config.run_as_user,
+                                  config.run_as_group)
         if config.mounts and self._mount_ns:
             cmd = _wrap_with_mounts(cmd, config.mounts)
         if config.cgroup_procs_files:

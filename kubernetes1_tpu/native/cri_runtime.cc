@@ -19,6 +19,7 @@
 #include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <grp.h>
 #include <sched.h>
 #include <signal.h>
 #include <stdio.h>
@@ -112,6 +113,9 @@ struct Container {
   std::vector<std::string> cgroup_procs_files;
   std::vector<int> cpuset;
   JsonArray mounts;  // [{name, host_path, container_path, read_only}]
+  // securityContext (ref pkg/securitycontext): drop to this uid/gid in
+  // the child before exec; -1 = inherit the runtime's user
+  long run_as_user = -1, run_as_group = -1;
   pid_t pid = -1;
   // previous cpu sample for rate computation (cadvisor's method)
   double cpu_ticks_prev = -1;
@@ -268,6 +272,16 @@ class Runtime {
     for (const auto& v : cfg["cpuset"].as_array())
       c.cpuset.push_back((int)v.as_int());
     if (cfg["mounts"].is_array()) c.mounts = cfg["mounts"].as_array();
+    if (!cfg["run_as_user"].is_null())
+      c.run_as_user = (long)cfg["run_as_user"].as_int();
+    if (!cfg["run_as_group"].is_null())
+      c.run_as_group = (long)cfg["run_as_group"].as_int();
+    if (geteuid() != 0 &&
+        ((c.run_as_user >= 0 && (uid_t)c.run_as_user != geteuid()) ||
+         (c.run_as_group >= 0 && (gid_t)c.run_as_group != getegid())))
+      // refuse at CREATE, not silently at start: running a workload as
+      // the wrong identity would be a security lie
+      throw std::runtime_error("runAsUser/runAsGroup requires a root runtime");
     c.log_path = root_ + "/logs/" + c.id + ".log";
     std::lock_guard<std::mutex> l(mu_);
     containers_[c.id] = c;
@@ -402,6 +416,23 @@ class Runtime {
       dup2(logfd, 1);
       dup2(logfd, 2);
       if (wd && chdir(wd) != 0) _exit(127);
+      // drop privileges LAST (after cgroup join, which needed root):
+      // gid first — setuid would forfeit the right to setgid.  Skip any
+      // part already satisfied (a non-root runtime asked for its own
+      // uid/gid must not fail a setgid it cannot and need not perform).
+      {
+        long g = snapshot.run_as_group;
+        if (g < 0 && snapshot.run_as_user >= 0) g = snapshot.run_as_user;
+        bool need_gid = g >= 0 && (gid_t)g != getegid();
+        bool need_uid = snapshot.run_as_user >= 0 &&
+                        (uid_t)snapshot.run_as_user != geteuid();
+        if (need_gid || need_uid) {
+          if (setgroups(0, nullptr) != 0 && geteuid() == 0) _exit(126);
+          if (need_gid && setgid((gid_t)g) != 0) _exit(126);
+          if (need_uid &&
+              setuid((uid_t)snapshot.run_as_user) != 0) _exit(126);
+        }
+      }
       execvpe(argv[0], argv.data(), envp.data());
       dprintf(2, "exec failed: %s\n", strerror(errno));
       _exit(127);

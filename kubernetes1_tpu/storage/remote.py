@@ -142,7 +142,11 @@ class RemoteStore:
         # errors (same rule as the REST client's stale-keep-alive retry).
         for attempt in (0, 1):
             with self._lock:
-                pair = self._pool.pop() if self._pool else None
+                # the retry attempt dials FRESH: after a store restart the
+                # whole pool is stale, and popping another dead pair would
+                # burn the one retry without ever reaching the live server
+                pair = (self._pool.pop()
+                        if self._pool and attempt == 0 else None)
                 self._next_id += 1
                 rid = self._next_id
             pooled = pair is not None

@@ -1,0 +1,208 @@
+"""SecurityContext enforcement + PodSecurityPolicy admission.
+
+Ref: pkg/securitycontext (DetermineEffectiveSecurityContext, runAsNonRoot
+verification in kuberuntime), pkg/security/podsecuritypolicy + its
+admission plugin.  On a shared TPU host this is the single-tenant vs
+multi-tenant line: who processes run as, and whether a pod can reach
+/dev/accel* outside the device-plugin allocation path.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver.server import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import Forbidden
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+def make_pod(name, uid=None, gid=None, non_root=None, privileged=None,
+             pod_uid=None, host_path=None, command=None):
+    pod = t.Pod()
+    pod.metadata.name = name
+    c = t.Container(name="c", image="img",
+                    command=command or ["sh", "-c", "true"])
+    if any(v is not None for v in (uid, gid, non_root, privileged)):
+        c.security_context = t.SecurityContext(
+            run_as_user=uid, run_as_group=gid, run_as_non_root=non_root,
+            privileged=privileged)
+    pod.spec.containers = [c]
+    if pod_uid is not None:
+        pod.spec.security_context = t.PodSecurityContext(run_as_user=pod_uid)
+    if host_path:
+        pod.spec.volumes = [t.Volume(
+            name="h", host_path=t.HostPathVolumeSource(path=host_path))]
+        c.volume_mounts = [t.VolumeMount(name="h", mount_path="/mnt/h")]
+    return pod
+
+
+class TestEffectiveContext:
+    def test_container_overrides_pod(self):
+        pod = make_pod("p", uid=1000, pod_uid=2000)
+        sc = t.effective_security_context(pod, pod.spec.containers[0])
+        assert sc.run_as_user == 1000
+
+    def test_pod_level_inherited(self):
+        pod = make_pod("p", pod_uid=2000)
+        sc = t.effective_security_context(pod, pod.spec.containers[0])
+        assert sc.run_as_user == 2000
+
+    def test_unset_everywhere(self):
+        pod = make_pod("p")
+        sc = t.effective_security_context(pod, pod.spec.containers[0])
+        assert sc.run_as_user is None and not sc.privileged
+
+
+class TestPSPAdmission:
+    @pytest.fixture()
+    def cluster(self):
+        m = Master().start()
+        cs = Clientset(m.url)
+        yield m, cs
+        cs.close()
+        m.stop()
+
+    @staticmethod
+    def _psp(name, privileged=False, host_paths=None, rule="RunAsAny"):
+        psp = t.PodSecurityPolicy()
+        psp.metadata.name = name
+        psp.spec.privileged = privileged
+        psp.spec.allowed_host_paths = list(host_paths or [])
+        psp.spec.run_as_user_rule = rule
+        return psp
+
+    def test_no_policies_allows_everything(self, cluster):
+        _, cs = cluster
+        cs.pods.create(make_pod("free", privileged=True))
+
+    def test_privileged_requires_allowing_policy(self, cluster):
+        _, cs = cluster
+        cs.resource("podsecuritypolicies").create(self._psp("restricted"))
+        with pytest.raises(Forbidden):
+            cs.pods.create(make_pod("priv", privileged=True))
+        cs.pods.create(make_pod("plain"))  # unprivileged passes
+        # adding a privileged-allowing policy admits it (any one admits)
+        cs.resource("podsecuritypolicies").create(
+            self._psp("privileged", privileged=True))
+        cs.pods.create(make_pod("priv2", privileged=True))
+
+    def test_hostpath_allowlist(self, cluster):
+        _, cs = cluster
+        cs.resource("podsecuritypolicies").create(
+            self._psp("paths", host_paths=["/var/data"]))
+        cs.pods.create(make_pod("ok", host_path="/var/data/ckpt"))
+        with pytest.raises(Forbidden):
+            cs.pods.create(make_pod("bad", host_path="/etc"))
+        with pytest.raises(Forbidden):
+            # prefix match must be path-segment aware
+            cs.pods.create(make_pod("sneaky", host_path="/var/database"))
+
+    def test_must_run_as_non_root(self, cluster):
+        _, cs = cluster
+        cs.resource("podsecuritypolicies").create(
+            self._psp("nonroot", rule="MustRunAsNonRoot"))
+        with pytest.raises(Forbidden):
+            cs.pods.create(make_pod("root-implicit"))  # unset = may be root
+        with pytest.raises(Forbidden):
+            cs.pods.create(make_pod("root-explicit", uid=0))
+        cs.pods.create(make_pod("user", uid=1000))
+
+
+class TestRuntimeEnforcement:
+    """The kubelet + ProcessRuntime actually realize the identity."""
+
+    @pytest.fixture()
+    def node(self, tmp_path):
+        from kubernetes1_tpu.kubelet import Kubelet, ProcessRuntime
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        runtime = ProcessRuntime(root_dir=str(tmp_path / "ktpu"))
+        kubelet = Kubelet(cs, node_name="sec-node", runtime=runtime,
+                          plugin_dir=str(tmp_path / "plugins"),
+                          heartbeat_interval=0.5, sync_interval=0.3,
+                          pleg_interval=0.3)
+        kubelet.start()
+        yield {"cs": cs, "node": "sec-node", "runtime": runtime}
+        kubelet.stop()
+        cs.close()
+        master.stop()
+
+    @pytest.mark.skipif(os.geteuid() != 0, reason="setuid needs root")
+    def test_pod_runs_as_requested_uid(self, node):
+        cs = node["cs"]
+        # stdout goes to the container log — no host file permissions to
+        # fight (the dropped uid can't traverse pytest's 0700 tmp dirs)
+        pod = make_pod("as-nobody", uid=65534, gid=65534,
+                       command=["sh", "-c", "id -u; id -g"])
+        pod.spec.restart_policy = "Never"
+        pod.spec.node_name = node["node"]
+        cs.pods.create(pod)
+        must_poll_until(
+            lambda: cs.pods.get("as-nobody", "default").status.phase
+            == "Succeeded", timeout=30.0, desc="pod completes")
+        runtime = node["runtime"]
+        cid = next(c.id for c in runtime.list_containers()
+                   if c.name == "c" and c.state == "EXITED")
+        assert runtime.read_log(cid).split() == ["65534", "65534"]
+
+    def test_run_as_non_root_with_root_uid_fails(self, node):
+        cs = node["cs"]
+        pod = make_pod("lying", non_root=True)  # uid unset -> would be root
+        pod.spec.restart_policy = "Never"
+        pod.spec.node_name = node["node"]
+        cs.pods.create(pod)
+        must_poll_until(
+            lambda: cs.pods.get("lying", "default").status.phase == "Failed",
+            timeout=30.0, desc="runAsNonRoot violation fails the pod")
+
+    def test_unprivileged_dev_hostpath_denied(self, node):
+        cs = node["cs"]
+        pod = make_pod("devgrab", host_path="/dev/null")
+        pod.spec.restart_policy = "Never"
+        pod.spec.node_name = node["node"]
+        cs.pods.create(pod)
+        must_poll_until(
+            lambda: cs.pods.get("devgrab", "default").status.phase
+            == "Failed", timeout=30.0,
+            desc="unprivileged /dev hostPath fails the pod")
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="setuid needs root")
+class TestNativeRuntimeUser:
+    def test_native_runtime_drops_uid(self, tmp_path):
+        import subprocess
+
+        from kubernetes1_tpu.kubelet.cri import RemoteRuntime
+        from kubernetes1_tpu.kubelet.runtime import ContainerConfig
+
+        binary = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "kubernetes1_tpu", "native", "bin", "ktpu-cri-runtime")
+        if not os.access(binary, os.X_OK):
+            pytest.skip("native runtime not built")
+        sock = str(tmp_path / "cri.sock")
+        root = str(tmp_path / "root")
+        proc = subprocess.Popen([binary, "--socket", sock, "--root", root])
+        try:
+            rt = RemoteRuntime(sock)
+            sid = rt.run_pod_sandbox("p", "default", "u1")
+            cid = rt.create_container(sid, ContainerConfig(
+                name="c", image="img", command=["id", "-u"],
+                run_as_user=65534, run_as_group=65534))
+            rt.start_container(cid)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                rec = rt.container_status(cid)
+                if rec is not None and rec.state == "EXITED":
+                    break
+                time.sleep(0.2)
+            assert rt.read_log(cid).strip() == "65534"
+            rt.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
