@@ -764,22 +764,16 @@ class PodSecurityPolicyAdmission(AdmissionPlugin):
                         f"(effective runAsUser is "
                         f"{'unset' if sc.run_as_user is None else '0'})")
         if spec.allowed_host_paths:
-            import posixpath
+            from ..utils.hostpath import is_under, normalize_abs
 
             allowed = tuple(spec.allowed_host_paths)
             for v in pod.spec.volumes:
                 hp = getattr(v, "host_path", None)
                 if hp is None or not hp.path:
                     continue
-                # normalized comparison: '/var/log/../../etc' must be
-                # judged as '/etc', not by its '/var/log/' spelling
-                # (lstrip first: normpath preserves a double leading slash)
-                norm = lambda s: posixpath.normpath(  # noqa: E731
-                    "/" + s.lstrip("/"))
-                path = norm(hp.path)
-                if not any(path == norm(p)
-                           or path.startswith(norm(p).rstrip("/") + "/")
-                           for p in allowed):
-                    return (f"hostPath {path!r} not under any allowed "
-                            f"prefix {list(allowed)}")
+                # judged by the RESOLVED path ('/var/log/../../etc' is
+                # /etc), not its spelling — see utils/hostpath.py
+                if not any(is_under(hp.path, p) for p in allowed):
+                    return (f"hostPath {normalize_abs(hp.path)!r} not under "
+                            f"any allowed prefix {list(allowed)}")
         return None

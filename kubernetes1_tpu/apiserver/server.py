@@ -75,6 +75,33 @@ from .registry import Registry
 WATCH_HEARTBEAT_SECONDS = 5.0
 
 
+class _AdmissionTTLCache:
+    """~1s TTL cache for hot admission inputs, generation-stamped: a
+    write-through invalidate() bumps the generation so a store scan that
+    RACED the write (started before, finished after) cannot re-publish the
+    pre-write view.  Peer apiservers on a shared store see only the TTL."""
+
+    def __init__(self, ttl: float = 1.0):
+        self.ttl = ttl
+        self._gen = 0
+        self._data: Dict[str, tuple] = {}  # key -> (gen, ts, items)
+
+    def get(self, key: str, fetch):
+        now = time.monotonic()
+        gen = self._gen
+        hit = self._data.get(key)
+        if hit is not None and hit[0] == gen and now - hit[1] < self.ttl:
+            return hit[2]
+        items = fetch()
+        if self._gen == gen:
+            self._data[key] = (gen, now, items)
+        return items
+
+    def invalidate(self):
+        self._gen += 1
+        self._data.clear()
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "ktpu-apiserver/0.1"
@@ -347,22 +374,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._authz(user, verb, resource, ns, name, sub)
             handler = getattr(self, f"_do_{method.lower()}")
             handler(resource, ns, name, sub, q)
-            if method != "GET" and resource in (
-                "mutatingwebhookconfigurations",
-                "validatingwebhookconfigurations",
-            ):
-                # a just-written config must be enforced immediately — the
-                # 1s admission cache is for steady-state reads only
-                self.master._webhook_cache.pop(resource, None)
-            if method != "GET" and resource == "podpresets":
-                # the namespace may live only in the object body (no-ns URL
-                # form), so clear the whole cache — preset writes are rare
-                self.master._podpreset_cache.clear()
-            if method != "GET" and resource == "podsecuritypolicies":
-                # a just-written policy must gate the very next pod create
-                # (the generation bump also voids any in-flight stale scan)
-                self.master._psp_gen += 1
-                self.master._psp_cache = None
+            if method != "GET":
+                # a just-written admission input must be enforced on the
+                # very next request; the generation bump also voids any
+                # in-flight stale scan racing this write
+                if resource in ("mutatingwebhookconfigurations",
+                                "validatingwebhookconfigurations"):
+                    self.master._webhook_cache.invalidate()
+                elif resource == "podpresets":
+                    self.master._podpreset_cache.invalidate()
+                elif resource == "podsecuritypolicies":
+                    self.master._psp_cache.invalidate()
             self.master.metrics.observe(method, resource, time.monotonic() - start)
         except ApiError as e:
             try:
@@ -874,10 +896,13 @@ class Master:
         self._audit_webhook = (WebhookAuditBackend(audit_webhook_url)
                                if audit_webhook_url else None)
         self._apiservice_index: Dict[tuple, str] = {}  # (group, version) -> name
-        self._webhook_cache: Dict[str, tuple] = {}  # resource -> (ts, items)
-        self._podpreset_cache: Dict[str, tuple] = {}  # namespace -> (ts, items)
-        self._psp_cache: Optional[tuple] = None       # (gen, ts, items)
-        self._psp_gen = 0
+        # one generation-stamped ~1s TTL cache per hot admission input
+        # (webhook configs / pod presets / pod security policies) — the
+        # SAME idiom everywhere so write-through invalidation can't race
+        # a stale scan back in (see _AdmissionTTLCache)
+        self._webhook_cache = _AdmissionTTLCache()    # key: resource
+        self._podpreset_cache = _AdmissionTTLCache()  # key: namespace
+        self._psp_cache = _AdmissionTTLCache()        # key: ""
         self.authorization_mode = authorization_mode
         tokens = dict(static_tokens or {})
         if token:
@@ -983,56 +1008,31 @@ class Master:
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))
 
     def _list_podpresets(self, namespace: str):
-        # same ~1s cache + write-through invalidation as webhook configs:
-        # admission runs per pod CREATE and most clusters have no presets
-        import time as _time
-
-        now = _time.monotonic()
-        hit = self._podpreset_cache.get(namespace)
-        if hit is not None and now - hit[0] < 1.0:
-            return hit[1]
-        items, _ = self.store.list(self.registry.prefix("podpresets", namespace))
-        self._podpreset_cache[namespace] = (now, items)
-        return items
+        return self._podpreset_cache.get(
+            namespace,
+            lambda: self.store.list(
+                self.registry.prefix("podpresets", namespace))[0])
 
     def _list_psps(self):
-        """PodSecurityPolicies for admission, cached ~1s like webhook
-        configs: pod CREATE is hot and most clusters define no policies.
-        Generation-stamped so a scan racing a policy write can't overwrite
-        the write's invalidation with its stale result."""
-        import time as _time
-
-        now = _time.monotonic()
-        gen = self._psp_gen
-        hit = self._psp_cache
-        if hit is not None and hit[0] == gen and now - hit[1] < 1.0:
-            return hit[2]
-        items, _ = self.store.list(self.registry.prefix(
-            "podsecuritypolicies", ""))
-        if self._psp_gen == gen:
-            self._psp_cache = (gen, now, items)
-        return items
+        return self._psp_cache.get(
+            "", lambda: self.store.list(self.registry.prefix(
+                "podsecuritypolicies", ""))[0])
 
     def _list_webhook_configs(self, resource: str):
-        """Webhook configs for the admission chain, cached ~1s: admission
-        runs on EVERY write and a store scan per write is pure overhead on
-        webhook-free clusters (upstream reads these through an informer
-        with comparable staleness).
+        """Webhook configs for the admission chain, cached ~1s (see
+        _AdmissionTTLCache): admission runs on EVERY write and a store
+        scan per write is pure overhead on webhook-free clusters
+        (upstream reads these through an informer with comparable
+        staleness).
 
         Re-entrancy note: webhook callouts can run while the quota lock is
         held (_with_quota_serialization); a webhook handler that writes a
         quota-counted object back into THIS apiserver blocks on that lock
         until the callout times out — bounded by timeout_seconds, same
         hazard class as upstream's re-entrant webhook writes."""
-        import time as _time
-
-        now = _time.monotonic()
-        hit = self._webhook_cache.get(resource)
-        if hit is not None and now - hit[0] < 1.0:
-            return hit[1]
-        items, _ = self.store.list(self.registry.prefix(resource, ""))
-        self._webhook_cache[resource] = (now, items)
-        return items
+        return self._webhook_cache.get(
+            resource,
+            lambda: self.store.list(self.registry.prefix(resource, ""))[0])
 
     def _get_namespace_or_none(self, name: str):
         if not name:

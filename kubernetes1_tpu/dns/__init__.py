@@ -1,0 +1,1 @@
+from .server import ClusterDNS, encode_query, parse_response  # noqa: F401

@@ -72,6 +72,7 @@ class Kubelet:
         system_reserved: Optional[Dict[str, str]] = None,
         cpu_manager_policy: Optional[str] = None,  # None = "none"
         cpu_manager_state_dir: str = "",
+        cluster_dns: bool = True,  # node-local resolver (real runtimes only)
     ):
         self.cs = clientset
         self.node_name = node_name
@@ -109,6 +110,19 @@ class Kubelet:
         real_pids = self._probe_real_pids(runtime)
         if enforce_cgroups is None:
             enforce_cgroups = real_pids
+        # node-local cluster DNS (ref --cluster-dns + kube-dns addon; see
+        # dns/server.py): real-process runtimes only — hollow nodes must
+        # not each open informers and a resolver socket.  Binding the
+        # loopback alias needs root/port-53 rights; fall back to no DNS
+        # (env-injection still works) when the host refuses.
+        self.cluster_dns = None
+        if real_pids and cluster_dns:
+            try:
+                from ..dns import ClusterDNS
+
+                self.cluster_dns = ClusterDNS(clientset)
+            except OSError:
+                pass
         self.container_manager = ContainerManager(
             node_name,
             system_reserved=system_reserved,
@@ -228,6 +242,8 @@ class Kubelet:
             self.device_manager.start()
         if self.server is not None:
             self.server.start()
+        if self.cluster_dns is not None:
+            self.cluster_dns.start()
         self._reconcile_runtime()
         self._register_node()
         self.pods.add_handler(
@@ -279,6 +295,8 @@ class Kubelet:
         self.container_manager.cleanup()
         if self.server is not None:
             self.server.stop()
+        if self.cluster_dns is not None:
+            self.cluster_dns.stop()
 
     def _loop(self, fn, period_attr: str):
         # the period is re-read each cycle so dynamic kubelet config can
@@ -940,6 +958,10 @@ class Kubelet:
                             self.runtime.remove_container(cid)
                         except Exception:  # noqa: BLE001
                             pass
+                    if self._is_terminal_config_error(e):
+                        self._set_failed(pod, "CreateContainerConfigError",
+                                         f"init {container.name}: {e}")
+                        return "failed"
                     now = time.monotonic()
                     with self._lock:
                         n = self._restarts.get(ckey, 0)
@@ -950,6 +972,16 @@ class Kubelet:
                                         f"init {container.name}: {e}")
                 return "wait"  # started (or failed to): wait for next sync
         return "done"
+
+    @staticmethod
+    def _is_terminal_config_error(e: Exception) -> bool:
+        """Start failures that can NEVER succeed by retrying: an identity
+        request the host cannot honor (non-root kubelet, missing setpriv —
+        runtime.py _wrap_with_user; the native runtime raises the same
+        wording over the CRI socket).  These must fail the pod terminally,
+        not back off forever."""
+        return isinstance(e, PermissionError) or \
+            "requires a root" in str(e)
 
     ADMISSION_GRACE_SECONDS = 30.0
 
@@ -991,6 +1023,23 @@ class Kubelet:
             self._sandboxes[uid] = sid
         return sid
 
+    def _resolv_conf_path(self, namespace: str) -> str:
+        """Per-namespace resolv.conf under the volume root (the search
+        path differs per namespace), written once and reused."""
+        d = os.path.join(self.volume_manager.root, "resolv")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{namespace}.conf")
+        content = self.cluster_dns.resolv_conf(namespace)
+        try:
+            with open(path) as f:
+                if f.read() == content:
+                    return path
+        except OSError:
+            pass
+        with open(path, "w") as f:
+            f.write(content)
+        return path
+
     def _container_config(self, pod: t.Pod, container: t.Container) -> ContainerConfig:
         """GenerateRunContainerOptions (ref kubelet_pods.go:468): pod env
         (incl. valueFrom/envFrom/downward API) + volume mounts +
@@ -999,11 +1048,25 @@ class Kubelet:
         # in-pod API access: the mounted SA token + this endpoint is the
         # KUBERNETES_SERVICE_HOST/PORT analog
         env.setdefault("KTPU_APISERVER", self.cs.api.url)
+        dns_mount = None
+        if self.cluster_dns is not None:
+            # cluster DNS wiring (ref --cluster-dns): the resolver address
+            # rides env for library clients, and the pod's resolv.conf is
+            # bind-mounted so glibc's gethostbyname('redis-master') just
+            # works inside the mount namespace
+            env.setdefault("KTPU_DNS_SERVER", self.cluster_dns.ip)
+            ns = pod.metadata.namespace or "default"
+            dns_mount = {"name": "cluster-dns-resolv",
+                         "host_path": self._resolv_conf_path(ns),
+                         "container_path": "/etc/resolv.conf",
+                         "read_only": True}
         spec = self.device_manager.init_container(pod, container)
         env.update(spec.envs)
         devices = [vars(d) for d in spec.devices]
         mounts = self.volume_manager.mounts_for_container(pod, container)
         mounts += [vars(m) for m in spec.mounts]
+        if dns_mount is not None:
+            mounts.append(dns_mount)
         annotations = dict(spec.annotations)
         # securityContext (ref pkg/securitycontext + kuberuntime's
         # verifyRunAsNonRoot): resolve the effective identity, refuse a
@@ -1018,15 +1081,11 @@ class Kubelet:
                 f"effective runAsUser is "
                 f"{'unset' if sc.run_as_user is None else 'root (0)'}")
         if not sc.privileged:
-            import posixpath
+            from ..utils.hostpath import is_under, normalize_abs
 
             for m in mounts:
-                # normalize BEFORE checking: '/tmp/../dev/accel0' and
-                # '//dev/accel0' must not sneak past a raw prefix match
-                # (lstrip first: normpath PRESERVES a double leading slash)
-                host = posixpath.normpath(
-                    "/" + (m.get("host_path") or "").lstrip("/"))
-                if host == "/dev" or host.startswith("/dev/"):
+                host = normalize_abs(m.get("host_path") or "")
+                if is_under(host, "/dev"):
                     raise VolumeError(
                         f"container {container.name}: hostPath {host!r} "
                         f"requires privileged: true (device access is "
@@ -1150,6 +1209,10 @@ class Kubelet:
                         self.runtime.remove_container(cid)
                     except Exception:  # noqa: BLE001
                         pass
+                if self._is_terminal_config_error(e):
+                    self._set_failed(pod, "CreateContainerConfigError",
+                                     f"container {container.name}: {e}")
+                    return
                 with self._lock:
                     n = self._restarts.get(ckey, 0)
                     self._restarts[ckey] = n + 1

@@ -1,0 +1,26 @@
+"""Shared hostPath normalization for the security gates.
+
+Both the PodSecurityPolicy admission check (allowedHostPaths) and the
+kubelet's unprivileged-/dev gate must judge a path by what it RESOLVES to,
+not how it is spelled — '/tmp/../dev/accel0' and '//dev/accel0' are /dev
+paths.  One implementation, because two drifting copies of a security
+normalizer is how one side quietly stops catching what the other does.
+"""
+
+from __future__ import annotations
+
+import posixpath
+
+
+def normalize_abs(path: str) -> str:
+    """Absolute, '..'-free, single-leading-slash form of `path`.  The
+    lstrip matters: POSIX normpath PRESERVES a double leading slash."""
+    return posixpath.normpath("/" + (path or "").lstrip("/"))
+
+
+def is_under(path: str, prefix: str) -> bool:
+    """True when normalized `path` equals or lives under normalized
+    `prefix` (path-segment aware: /var/database is NOT under /var/data)."""
+    p = normalize_abs(path)
+    pre = normalize_abs(prefix)
+    return p == pre or p.startswith(pre.rstrip("/") + "/")

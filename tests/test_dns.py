@@ -1,0 +1,170 @@
+"""Cluster DNS: service discovery by stable name (ref: kube-dns addon +
+kubelet --cluster-dns; dns/server.py docstring for the node-local shape)."""
+
+import os
+import socket
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver.server import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.dns import ClusterDNS, encode_query, parse_response
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+def make_service(name, ns="default", cluster_ip="", selector=None):
+    svc = t.Service()
+    svc.metadata.name = name
+    svc.metadata.namespace = ns
+    svc.spec.cluster_ip = cluster_ip
+    svc.spec.selector = selector or {"app": name}
+    svc.spec.ports = [t.ServicePort(port=80)]
+    return svc
+
+
+@pytest.fixture()
+def dns_env():
+    master = Master().start()
+    cs = Clientset(master.url)
+    dns = ClusterDNS(cs, bind_ip="127.0.0.1", port=0).start()
+    yield {"cs": cs, "dns": dns}
+    dns.stop()
+    cs.close()
+    master.stop()
+
+
+def query(dns, name, timeout=5.0):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    s.sendto(encode_query(name), (dns.ip, dns.port))
+    data, _ = s.recvfrom(4096)
+    s.close()
+    return parse_response(data)
+
+
+class TestResolution:
+    def test_service_a_record_all_name_forms(self, dns_env):
+        cs, dns = dns_env["cs"], dns_env["dns"]
+        created = cs.services.create(make_service("redis-master"))
+        ip = created.spec.cluster_ip
+        assert ip.startswith("10.96.")
+        must_poll_until(lambda: dns.resolve("redis-master.default") == [ip],
+                        timeout=10.0, desc="informer sees the service")
+        for form in ("redis-master.default",
+                     "redis-master.default.svc",
+                     "redis-master.default.svc.cluster.local",
+                     "redis-master.default.svc.cluster.local."):
+            rcode, ips = query(dns, form)
+            assert (rcode, ips) == (0, [ip]), form
+
+    def test_unknown_service_nxdomain(self, dns_env):
+        rcode, ips = query(dns_env["dns"], "nope.default.svc.cluster.local")
+        assert rcode == 3 and ips == []
+
+    def test_headless_service_returns_endpoints(self, dns_env):
+        cs, dns = dns_env["cs"], dns_env["dns"]
+        cs.services.create(make_service("gang", cluster_ip="None"))
+        ep = t.Endpoints()
+        ep.metadata.name = "gang"
+        ep.subsets = [t.EndpointSubset(addresses=[
+            t.EndpointAddress(ip="10.0.0.1"), t.EndpointAddress(ip="10.0.0.2"),
+        ])]
+        cs.endpoints.create(ep)
+        must_poll_until(
+            lambda: sorted(dns.resolve("gang.default") or []) ==
+            ["10.0.0.1", "10.0.0.2"],
+            timeout=10.0, desc="headless endpoints resolve")
+        rcode, ips = query(dns, "gang.default.svc.cluster.local")
+        assert rcode == 0 and sorted(ips) == ["10.0.0.1", "10.0.0.2"]
+
+    def test_service_created_after_watcher_resolves(self, dns_env):
+        """THE r3 gap: *_SERVICE_HOST env is snapshot-at-start; DNS answers
+        live — a service created later must become resolvable."""
+        cs, dns = dns_env["cs"], dns_env["dns"]
+        rcode, _ = query(dns, "late.default.svc.cluster.local")
+        assert rcode == 3  # not there yet
+        created = cs.services.create(make_service("late"))
+        must_poll_until(
+            lambda: query(dns, "late.default.svc.cluster.local")
+            == (0, [created.spec.cluster_ip]),
+            timeout=10.0, desc="late-created service resolves")
+
+    def test_non_cluster_name_not_ours(self, dns_env):
+        # upstream-less server answers SERVFAIL rather than lying NXDOMAIN
+        dns = dns_env["dns"]
+        dns._upstream = ""
+        rcode, ips = query(dns, "example.com")
+        assert rcode == 2 and ips == []
+
+    def test_aaaa_for_existing_name_empty_noerror(self, dns_env):
+        cs, dns = dns_env["cs"], dns_env["dns"]
+        created = cs.services.create(make_service("v6less"))
+        must_poll_until(lambda: dns.resolve("v6less.default"), timeout=10.0,
+                        desc="service visible")
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(5.0)
+        s.sendto(encode_query("v6less.default.svc.cluster.local", qtype=28),
+                 (dns.ip, dns.port))
+        rcode, ips = parse_response(s.recvfrom(4096)[0])
+        s.close()
+        assert rcode == 0 and ips == []  # exists, no AAAA records
+
+    def test_resolv_conf_shape(self, dns_env):
+        rc = dns_env["dns"].resolv_conf("team-a")
+        assert f"nameserver {dns_env['dns'].ip}" in rc
+        assert "search team-a.svc.cluster.local svc.cluster.local" in rc
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="port 53 + mount ns need root")
+class TestPodResolution:
+    def test_pod_resolves_service_by_bare_name(self, tmp_path):
+        """guestbook shape: the frontend reaches redis-master by NAME, via
+        the bind-mounted resolv.conf + search path — including a service
+        created AFTER the pod started."""
+        from kubernetes1_tpu.kubelet import Kubelet, ProcessRuntime
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        runtime = ProcessRuntime(root_dir=str(tmp_path / "ktpu"))
+        if not runtime._mount_ns:
+            master.stop()
+            pytest.skip("host cannot create mount namespaces")
+        kubelet = Kubelet(cs, node_name="dns-node", runtime=runtime,
+                          plugin_dir=str(tmp_path / "plugins"),
+                          heartbeat_interval=0.5, sync_interval=0.3,
+                          pleg_interval=0.3)
+        if kubelet.cluster_dns is None:
+            kubelet.stop = lambda: None
+            master.stop()
+            pytest.skip("cluster DNS bind unavailable")
+        kubelet.start()
+        try:
+            pod = t.Pod()
+            pod.metadata.name = "frontend"
+            pod.spec.node_name = "dns-node"
+            pod.spec.restart_policy = "Never"
+            # the service does NOT exist when the pod starts; the pod polls
+            # until the name resolves (closing the env-snapshot gap)
+            pod.spec.containers = [t.Container(
+                name="c", image="img",
+                command=["sh", "-c",
+                         "for i in $(seq 1 60); do "
+                         "getent hosts redis-master && exit 0; sleep 0.5; "
+                         "done; exit 1"])]
+            cs.pods.create(pod)
+            must_poll_until(
+                lambda: cs.pods.get("frontend", "default").status.phase
+                == "Running", timeout=30.0, desc="frontend running")
+            created = cs.services.create(make_service("redis-master"))
+            must_poll_until(
+                lambda: cs.pods.get("frontend", "default").status.phase
+                == "Succeeded", timeout=45.0,
+                desc="frontend resolved redis-master by bare name")
+            cid = next(c.id for c in runtime.list_containers()
+                       if c.state == "EXITED")
+            assert created.spec.cluster_ip in runtime.read_log(cid)
+        finally:
+            kubelet.stop()
+            cs.close()
+            master.stop()
