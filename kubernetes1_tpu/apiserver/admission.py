@@ -758,11 +758,19 @@ class PodSecurityPolicyAdmission(AdmissionPlugin):
             sc = t.effective_security_context(pod, c)
             if sc.privileged and not spec.privileged:
                 return f"privileged container {c.name!r} not allowed"
-            if spec.run_as_user_rule == "MustRunAsNonRoot" and (
-                    sc.run_as_user is None or sc.run_as_user == 0):
-                return (f"container {c.name!r} must run as non-root "
-                        f"(effective runAsUser is "
-                        f"{'unset' if sc.run_as_user is None else '0'})")
+            if spec.run_as_user_rule == "MustRunAsNonRoot":
+                # runAsNonRoot=true satisfies the rule even with no numeric
+                # uid: the image may declare a non-root USER, and the
+                # kubelet's runtime check still rejects if the effective uid
+                # resolves to 0 (matches upstream's MustRunAsNonRoot
+                # strategy, which defers uid verification to the kubelet).
+                if sc.run_as_user == 0:
+                    return (f"container {c.name!r} must run as non-root "
+                            f"(effective runAsUser is 0)")
+                if sc.run_as_user is None and not sc.run_as_non_root:
+                    return (f"container {c.name!r} must run as non-root "
+                            f"(effective runAsUser is unset and "
+                            f"runAsNonRoot is not true)")
         if spec.allowed_host_paths:
             from ..utils.hostpath import is_under, normalize_abs
 

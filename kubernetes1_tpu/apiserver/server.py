@@ -79,7 +79,18 @@ class _AdmissionTTLCache:
     """~1s TTL cache for hot admission inputs, generation-stamped: a
     write-through invalidate() bumps the generation so a store scan that
     RACED the write (started before, finished after) cannot re-publish the
-    pre-write view.  Peer apiservers on a shared store see only the TTL."""
+    pre-write view.
+
+    HA semantics (deliberate): invalidation is per-apiserver, so in an
+    N-apiserver topology a policy write (PodSecurityPolicy, webhook config)
+    through peer A leaves peers B..N admitting against the stale set for up
+    to the 1s TTL.  This matches upstream, where admission plugins read
+    policy through informer caches that lag the watch stream by the same
+    order of staleness (and carry no cross-apiserver invalidation either);
+    closing the window would cost a store current_revision round-trip on
+    every admission-chain cache hit — the pod-create hot path.  Anything
+    needing read-your-write policy enforcement must route the subsequent
+    requests through the same apiserver that took the policy write."""
 
     def __init__(self, ttl: float = 1.0):
         self.ttl = ttl

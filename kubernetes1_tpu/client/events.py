@@ -73,18 +73,60 @@ class EventRecorder:
             if isinstance(item, threading.Event):
                 item.set()
                 continue
-            try:
-                self._send(*item)
-            except Exception:  # noqa: BLE001 — events are best-effort
-                pass
+            # Coalesce identical items queued behind this one: a burst of N
+            # identical events enqueued before the first create completes
+            # would each miss _seen (populated only here, after the create)
+            # and become N duplicate Event objects.  Collapsing the burst
+            # in-queue keeps aggregation semantics identical to the old
+            # synchronous path.  Coalescing stops at a flush() fence (so
+            # the fence still means "everything enqueued before me was
+            # sent") and after at most one buffer's worth of items (so hot
+            # producers refilling the queue can't starve sends forever).
+            # slot = [first_item, n, latest_ts]: the create keeps the FIRST
+            # occurrence's timestamp (when the condition started) while
+            # last_timestamp reports the latest repeat, as the synchronous
+            # path did.
+            batch: Dict[tuple, list] = {}
+            batch[self._agg_key(item[0], item[2], item[3])] = \
+                [item, 1, item[4]]
+            fence = None
+            drained = 1
+            while fence is None and drained < self._q.maxsize:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if isinstance(nxt, threading.Event):
+                    fence = nxt
+                    break
+                drained += 1
+                k = self._agg_key(nxt[0], nxt[2], nxt[3])
+                slot = batch.get(k)
+                if slot is not None:
+                    slot[1] += 1
+                    slot[2] = nxt[4]
+                else:
+                    batch[k] = [nxt, 1, nxt[4]]
+            for it, n, last in batch.values():  # dicts keep insertion order
+                try:
+                    self._send(*it, repeat=n, last_now=last)
+                except Exception:  # noqa: BLE001 — events are best-effort
+                    pass
+            if fence is not None:
+                fence.set()
 
-    def _send(self, ref, event_type: str, reason: str, message: str, now: str):
-        key = (ref.kind, ref.namespace, ref.name, reason, message[:64])
+    @staticmethod
+    def _agg_key(ref, reason: str, message: str) -> tuple:
+        return (ref.kind, ref.namespace, ref.name, reason, message[:64])
+
+    def _send(self, ref, event_type: str, reason: str, message: str,
+              now: str, repeat: int = 1, last_now: str = ""):
+        key = self._agg_key(ref, reason, message)
         with self._lock:
             existing = self._seen.get(key)
         ns = ref.namespace or "default"
         if existing:
-            self._bump(existing, ns, now)
+            self._bump(existing, ns, last_now or now, repeat)
             return
         ev = t.Event()
         ev.metadata.generate_name = f"{ref.name}."
@@ -95,15 +137,17 @@ class EventRecorder:
         ev.message = message
         ev.source_component = self.component
         ev.first_timestamp = now
-        ev.last_timestamp = now
+        ev.last_timestamp = last_now or now
+        if repeat > 1:
+            ev.count = repeat
         created = self.cs.events.create(ev, ns)
         with self._lock:
             if len(self._seen) > self._max:
                 self._seen.clear()
             self._seen[key] = created.metadata.name
 
-    def _bump(self, name: str, ns: str, now: str):
+    def _bump(self, name: str, ns: str, now: str, repeat: int = 1):
         ev = self.cs.events.get(name, ns)
-        ev.count += 1
+        ev.count += repeat
         ev.last_timestamp = now
         self.cs.events.update(ev)

@@ -131,6 +131,9 @@ class ClusterDNS:
         self.ip, self.port = self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # at most 16 in-flight upstream forwards (each may block up to the
+        # 2s upstream timeout); beyond that, _answer SERVFAILs immediately
+        self._forward_slots = threading.Semaphore(16)
 
     @staticmethod
     def _host_upstream(self_ip: str) -> str:
@@ -238,10 +241,23 @@ class ClusterDNS:
         ips = self.resolve(name)
         if ips is None:
             # upstream forwards run OFF the serve thread: one slow external
-            # lookup must not head-of-line-block every pod's cluster query
-            threading.Thread(
-                target=self._forward_and_send,
-                args=(data, qid, question, peer), daemon=True).start()
+            # lookup must not head-of-line-block every pod's cluster query.
+            # Concurrency is BOUNDED (semaphore): an untrusted pod spamming
+            # external lookups must not exhaust threads inside the kubelet
+            # process hosting this resolver — saturation answers SERVFAIL
+            # so the client can back off and retry.
+            if not self._forward_slots.acquire(blocking=False):
+                return _build_response(qid, question, _RCODE_SERVFAIL, [])
+            try:
+                threading.Thread(
+                    target=self._forward_and_send,
+                    args=(data, qid, question, peer), daemon=True).start()
+            except RuntimeError:
+                # can't spawn (process out of threads — the very pressure
+                # this bound defends against): surrender the slot or 16
+                # such failures would wedge forwarding permanently
+                self._forward_slots.release()
+                return _build_response(qid, question, _RCODE_SERVFAIL, [])
             return None
         if not ips:
             return _build_response(qid, question, _RCODE_NXDOMAIN, [])
@@ -255,6 +271,8 @@ class ClusterDNS:
             self._sock.sendto(self._forward(query, qid, question), peer)
         except OSError:
             pass
+        finally:
+            self._forward_slots.release()
 
     def _forward(self, query: bytes, qid: int, question: bytes) -> bytes:
         if not self._upstream:

@@ -1074,12 +1074,23 @@ class Kubelet:
         # /dev hostPath mounts on privileged — unprivileged pods get TPU
         # chips ONLY through the device-plugin allocation path
         sc = t.effective_security_context(pod, container)
-        if sc.run_as_non_root and (sc.run_as_user is None
-                                   or sc.run_as_user == 0):
-            raise VolumeError(
-                f"container {container.name}: runAsNonRoot is set but the "
-                f"effective runAsUser is "
-                f"{'unset' if sc.run_as_user is None else 'root (0)'}")
+        if sc.run_as_non_root:
+            uid = sc.run_as_user
+            if uid is None:
+                # No numeric uid anywhere in the spec: the container will
+                # exec as the runtime's own identity — this framework's
+                # analog of the image USER that upstream kuberuntime
+                # resolves for verifyRunAsNonRoot.  Verify THAT, so
+                # runAsNonRoot=true works on a non-root runtime and is
+                # refused (not silently root) on a root one.
+                uid = getattr(self.runtime, "default_uid", None)
+                if uid is None:
+                    uid = os.geteuid()
+            if uid == 0:
+                raise VolumeError(
+                    f"container {container.name}: runAsNonRoot is set but "
+                    f"the container would run as root"
+                    f"{' (runtime identity)' if sc.run_as_user is None else ''}")
         if not sc.privileged:
             from ..utils.hostpath import is_under, normalize_abs
 

@@ -276,9 +276,15 @@ class Runtime {
       c.run_as_user = (long)cfg["run_as_user"].as_int();
     if (!cfg["run_as_group"].is_null())
       c.run_as_group = (long)cfg["run_as_group"].as_int();
+    // the child defaults gid to run_as_user when run_as_group is unset
+    // (see the setgid in exec_child) — the create-time check must model
+    // the same defaulting, or that combination passes create and then
+    // fails setgid at start as an opaque exit-126 crash
+    const long target_gid =
+        c.run_as_group >= 0 ? c.run_as_group : c.run_as_user;
     if (geteuid() != 0 &&
         ((c.run_as_user >= 0 && (uid_t)c.run_as_user != geteuid()) ||
-         (c.run_as_group >= 0 && (gid_t)c.run_as_group != getegid())))
+         (target_gid >= 0 && (gid_t)target_gid != getegid())))
       // refuse at CREATE, not silently at start: running a workload as
       // the wrong identity would be a security lie
       throw std::runtime_error("runAsUser/runAsGroup requires a root runtime");

@@ -115,6 +115,47 @@ class TestResolution:
         assert f"nameserver {dns_env['dns'].ip}" in rc
         assert "search team-a.svc.cluster.local svc.cluster.local" in rc
 
+    def test_forward_concurrency_bounded(self, dns_env):
+        """A pod spamming external lookups must not exhaust threads in the
+        kubelet process hosting the resolver: beyond the semaphore bound
+        the server answers SERVFAIL instead of spawning another forward
+        thread (ADVICE r4 medium)."""
+        import threading
+
+        from kubernetes1_tpu.dns.server import _build_response
+
+        dns = dns_env["dns"]
+        slow = threading.Event()
+
+        def stuck_forward(query, qid, question):
+            slow.wait(2.0)  # models an unresponsive upstream
+            return _build_response(qid, question, 2, [])
+
+        dns._forward = stuck_forward
+        before = threading.active_count()
+        # saturate all 16 slots, then some: the excess must come back
+        # SERVFAIL immediately rather than waiting out the 2s timeout
+        got_servfail = 0
+        socks = []
+        for i in range(40):  # rapid-fire so slots can't free up in between
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.settimeout(0.5)
+            s.sendto(encode_query(f"x{i}.example.com"), (dns.ip, dns.port))
+            socks.append(s)
+        for s in socks:
+            try:
+                rcode, _ = parse_response(s.recvfrom(4096)[0])
+                if rcode == 2:
+                    got_servfail += 1
+            except socket.timeout:
+                pass  # slot held: answer comes only when the upstream does
+            s.close()
+        # 40 queries minus 16 slots: the rest SERVFAIL immediately
+        assert got_servfail >= 10
+        # thread growth bounded by the slot count, not the query count
+        assert threading.active_count() - before <= 17
+        slow.set()  # release the stuck forwards before teardown
+
 
 @pytest.mark.skipif(os.geteuid() != 0, reason="port 53 + mount ns need root")
 class TestPodResolution:

@@ -159,3 +159,34 @@ class TestLeaseExpiry:
             lease_duration_seconds=10,
         )
         assert elector._expired(stale)
+
+
+class TestEventRecorderAggregation:
+    def test_burst_coalesces_to_count_not_duplicates(self):
+        """A burst of identical events enqueued before the async sink sends
+        the first must become ONE Event with count=N, not N duplicates
+        (ADVICE r4: the _seen cache is populated only on the sink thread,
+        so enqueue-side bursts used to miss it)."""
+        from kubernetes1_tpu.api import types as t
+        from kubernetes1_tpu.apiserver.server import Master
+        from kubernetes1_tpu.client import Clientset, EventRecorder
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            pod = t.Pod()
+            pod.metadata.name = "burst-pod"
+            pod.metadata.namespace = "default"
+            pod.spec.containers = [t.Container(name="c", image="img")]
+            pod = cs.pods.create(pod)
+            rec = EventRecorder(cs, "test-component")
+            for _ in range(25):
+                rec.event(pod, "Warning", "FailedMount", "volume not ready")
+            rec.flush()
+            evs = [e for e in cs.events.list()[0]
+                   if e.reason == "FailedMount"]
+            assert len(evs) == 1, [e.metadata.name for e in evs]
+            assert evs[0].count == 25
+        finally:
+            cs.close()
+            master.stop()

@@ -110,6 +110,14 @@ class TestPSPAdmission:
         with pytest.raises(Forbidden):
             cs.pods.create(make_pod("root-explicit", uid=0))
         cs.pods.create(make_pod("user", uid=1000))
+        # runAsNonRoot=true with NO numeric uid satisfies the rule (image
+        # may declare a non-root USER; the kubelet's runtime check still
+        # rejects if the effective uid resolves to 0) — upstream's
+        # MustRunAsNonRoot strategy defers uid verification the same way
+        cs.pods.create(make_pod("image-user", non_root=True))
+        with pytest.raises(Forbidden):
+            # but an explicit uid 0 loses to runAsNonRoot=true
+            cs.pods.create(make_pod("contradiction", uid=0, non_root=True))
 
 
 class TestRuntimeEnforcement:
