@@ -886,11 +886,10 @@ class Master:
         if store_address:
             from ..storage.remote import RemoteStore
 
-            addr: object = store_address
-            if ":" in store_address and "/" not in store_address:
-                host, _, port = store_address.rpartition(":")
-                addr = (host, int(port))
-            self.store = RemoteStore(self.scheme, addr, ca_file=store_ca_file)
+            # may be comma-separated primary,standby — RemoteStore parses
+            # and fails over between them (storage/remote.py)
+            self.store = RemoteStore(self.scheme, store_address,
+                                     ca_file=store_ca_file)
         else:
             self.store = Store(self.scheme, wal_path=wal_path)
         self.registry = Registry(self.store, self.scheme)

@@ -153,9 +153,13 @@ class RuntimeServer:
         if method == "capabilities":
             # the kubelet gates cgroup enforcement + CPU pinning on
             # real_pids; a remote ProcessRuntime must advertise it or the
-            # identical runtime silently loses enforcement across the socket
+            # identical runtime silently loses enforcement across the socket.
+            # default_uid: the identity a container with no runAsUser execs
+            # as — the kubelet's runAsNonRoot verification needs the
+            # RUNTIME's euid, not its own (they can differ across the socket)
             return {"real_pids": bool(getattr(rt, "real_pids", False)),
-                    "root": getattr(rt, "root", None)}
+                    "root": getattr(rt, "root", None),
+                    "default_uid": getattr(rt, "default_uid", None)}
         if method == "version":
             return rt.version()
         if method == "run_pod_sandbox":
@@ -245,6 +249,13 @@ class RemoteRuntime(RuntimeService):
     @property
     def root(self):
         return self._capabilities().get("root")
+
+    @property
+    def default_uid(self):
+        """The runtime daemon's euid — what a no-runAsUser container execs
+        as over there.  None until the runtime has answered capabilities;
+        the kubelet treats unknown as fail-closed for runAsNonRoot."""
+        return self._capabilities().get("default_uid")
 
     # ----------------------------------------------------------- transport
 

@@ -1078,14 +1078,24 @@ class Kubelet:
             uid = sc.run_as_user
             if uid is None:
                 # No numeric uid anywhere in the spec: the container will
-                # exec as the runtime's own identity — this framework's
-                # analog of the image USER that upstream kuberuntime
-                # resolves for verifyRunAsNonRoot.  Verify THAT, so
-                # runAsNonRoot=true works on a non-root runtime and is
-                # refused (not silently root) on a root one.
+                # exec as the RUNTIME's identity — this framework's analog
+                # of the image USER that upstream kuberuntime resolves for
+                # verifyRunAsNonRoot.  Ask the runtime (over the CRI
+                # capabilities RPC for a remote one); the kubelet's own
+                # euid is NOT a substitute — kubelet and runtime daemon
+                # can run as different users.  Unknown identity fails
+                # CLOSED: admitting would risk silently running as root.
                 uid = getattr(self.runtime, "default_uid", None)
                 if uid is None:
-                    uid = os.geteuid()
+                    # unknown is usually TRANSIENT (a remote runtime that
+                    # hasn't answered capabilities yet — kubelet and
+                    # runtime start concurrently by design): defer and let
+                    # the sync ticker retry rather than terminally failing
+                    # the pod; still fail-closed, never run-as-maybe-root
+                    raise VolumeNotReady(
+                        f"container {container.name}: runAsNonRoot is set "
+                        f"with no runAsUser and the runtime's identity is "
+                        f"not known yet — deferring rather than risk root")
             if uid == 0:
                 raise VolumeError(
                     f"container {container.name}: runAsNonRoot is set but "

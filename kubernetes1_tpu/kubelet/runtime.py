@@ -88,6 +88,10 @@ class RuntimeService:
     """The interface the kubelet drives (20-RPC RuntimeService condensed to
     the calls the sync loop actually needs)."""
 
+    # identity a container with no runAsUser execs as; None = unknown
+    # (the kubelet's runAsNonRoot verification fails closed on None)
+    default_uid: "Optional[int]" = None
+
     def version(self) -> str:
         raise NotImplementedError
 
@@ -185,6 +189,9 @@ class FakeRuntime(RuntimeService):
         self._containers: Dict[str, ContainerRecord] = {}
         self._exit_plans: Dict[str, tuple] = {}  # cid -> (deadline, code)
         self.images = ImageService()
+        # hollow containers "run" as nobody: non-root, so runAsNonRoot
+        # pods with image-declared users are exercisable in e2e tests
+        self.default_uid = 65534
         # Synthetic usage for the stats pipeline: per-container-name override,
         # else the default. Tests drive HPA behavior through set_usage().
         self.default_usage: Dict[str, float] = {"cpu": 0.001, "memory": 1 << 20}
@@ -467,6 +474,9 @@ class ProcessRuntime(RuntimeService):
         self._stat_samples: Dict[str, tuple] = {}  # cid -> (cpu_ticks, mono_ts)
         self.images = ImageService()
         self._mount_ns = _probe_mount_ns()
+        # identity a container with no runAsUser execs as (children are
+        # forks of this process) — the kubelet's runAsNonRoot check reads it
+        self.default_uid = os.geteuid()
 
     def version(self) -> str:
         return "process://0.1"

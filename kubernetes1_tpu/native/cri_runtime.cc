@@ -135,6 +135,9 @@ class Runtime {
       JsonObject o;
       o["real_pids"] = Json(true);
       o["root"] = Json(root_);
+      // identity a no-runAsUser container execs as: the kubelet's
+      // runAsNonRoot verification checks THIS, not its own euid
+      o["default_uid"] = Json((int64_t)geteuid());
       return Json(o);
     }
     if (method == "version") return Json(std::string("ktpu-cri-runtime/0.1"));

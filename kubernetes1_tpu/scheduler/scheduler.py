@@ -503,6 +503,17 @@ class Scheduler:
                 self.cache.forget_pod(assumed)
                 self.recorder.event(pod, "Warning", "FailedBinding", str(e))
                 self.queue.add_backoff(pod.key(), pod.spec.priority)
+            except Exception as e:  # noqa: BLE001
+                # connection-level failure (e.g. the apiserver was KILLED
+                # mid-request): the bind may or may not have landed.  Forget
+                # the assumption and requeue — a re-bind that raced a landed
+                # one answers Conflict, which the branch above absorbs.
+                # Without this, the assumed-but-unbound pod wedges forever
+                # (found by the apiserver SIGKILL test under load).
+                self.cache.forget_pod(assumed)
+                self.recorder.event(pod, "Warning", "FailedBinding",
+                                    f"transport: {e}")
+                self.queue.add_backoff(pod.key(), pod.spec.priority)
 
         # async bind (ref scheduler.go:482): don't block the scheduling loop
         self._bind_q.put(do_bind)

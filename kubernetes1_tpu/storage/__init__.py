@@ -1,1 +1,1 @@
-from .store import Store, Watcher, StopUpdate
+from .store import ReplicaFeed, StopUpdate, Store, Watcher

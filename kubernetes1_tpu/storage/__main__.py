@@ -29,14 +29,43 @@ def main():
     ap.add_argument("--client-ca-file", default="",
                     help="require client certs signed by this CA (mTLS); "
                          "strongly recommended for TCP mode")
+    ap.add_argument("--standby-of", default="",
+                    help="run as a warm standby replicating from this "
+                         "primary store address; serves NotPrimary until "
+                         "the primary dies, then self-promotes")
+    ap.add_argument("--failover-grace", type=float, default=1.0,
+                    help="seconds the primary must refuse connections "
+                         "before the standby promotes itself")
     args = ap.parse_args()
     if args.port and not args.socket and not args.client_ca_file:
         print("WARNING: TCP store without --client-ca-file accepts any "
               "client that can reach the port — use mTLS or a unix socket",
               flush=True)
 
-    store = Store(global_scheme.copy(), wal_path=args.wal or None)
     address = args.socket if args.socket else (args.host, args.port)
+    if args.standby_of:
+        from .remote import _parse_addresses
+        from .standby import StandbyServer
+
+        primary = _parse_addresses(args.standby_of)[0]
+        standby = StandbyServer(primary, address,
+                                wal_path=args.wal or None,
+                                failover_grace=args.failover_grace,
+                                tls_cert_file=args.tls_cert_file,
+                                tls_key_file=args.tls_key_file,
+                                client_ca_file=args.client_ca_file).start()
+        shown = standby.address if isinstance(standby.address, str) \
+            else f"{standby.address[0]}:{standby.address[1]}"
+        print(f"ktpu-store STANDBY serving on {shown} "
+              f"(replicating from {args.standby_of})", flush=True)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        stop.wait()
+        standby.stop()
+        return
+
+    store = Store(global_scheme.copy(), wal_path=args.wal or None)
     server = StoreServer(store, address,
                          tls_cert_file=args.tls_cert_file,
                          tls_key_file=args.tls_key_file,

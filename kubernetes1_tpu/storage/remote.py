@@ -19,11 +19,37 @@ import json
 import queue
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ..machinery import Conflict, NotFound, WatchEvent
 from ..machinery.scheme import Scheme
-from .server import error_from_wire
+from .server import NotPrimary, error_from_wire
+
+
+def _parse_addresses(address) -> List[Union[str, Tuple[str, int]]]:
+    """Accept a single address, a comma-separated string, or a list.
+    Strings with ':' and no '/' are host:port; everything else is a unix
+    socket path.  Multiple addresses = primary + standby(s): the client
+    fails over on NotPrimary / connection refusal, mirroring the etcd
+    client's multi-endpoint balancer."""
+    if isinstance(address, (list, tuple)) and address and \
+            not (len(address) == 2 and isinstance(address[1], int)):
+        raw = list(address)
+    elif isinstance(address, str):
+        raw = [a.strip() for a in address.split(",") if a.strip()]
+    else:
+        raw = [address]
+    out: List[Union[str, Tuple[str, int]]] = []
+    for a in raw:
+        if isinstance(a, str) and ":" in a and "/" not in a:
+            host, _, port = a.rpartition(":")
+            out.append((host, int(port)))
+        elif isinstance(a, (list, tuple)):
+            out.append((a[0], int(a[1])))
+        else:
+            out.append(a)
+    return out
 
 
 class RemoteWatcher:
@@ -99,7 +125,8 @@ class RemoteStore:
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
                  timeout: float = 30.0):
         self._scheme = scheme
-        self.address = address
+        self._addrs = _parse_addresses(address)
+        self._active = 0
         self.timeout = timeout
         self._ssl_ctx = None
         if ca_file:
@@ -115,89 +142,140 @@ class RemoteStore:
         self._lock = threading.Lock()
         self._next_id = 0
 
+    @property
+    def address(self):
+        """The currently-active server (first one at construction)."""
+        return self._addrs[self._active]
+
     # ------------------------------------------------------------- transport
 
-    def _connect(self, timeout: Optional[float]):
-        if isinstance(self.address, str):
+    def _advance(self, failed_addr):
+        """Fail over to the next server.  Guarded so N threads observing
+        the same dead primary advance ONCE, and the pool (connections to
+        the failed server) is dropped with it."""
+        with self._lock:
+            if self._addrs[self._active] != failed_addr \
+                    or len(self._addrs) < 2:
+                return
+            self._active = (self._active + 1) % len(self._addrs)
+            pool, self._pool = self._pool, []
+        for conn, _f in pool:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _connect(self, timeout: Optional[float], addr=None):
+        addr = addr if addr is not None else self.address
+        if isinstance(addr, str):
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(timeout)
-            conn.connect(self.address)
+            conn.connect(addr)
         else:
-            conn = socket.create_connection(tuple(self.address),
-                                            timeout=timeout)
+            conn = socket.create_connection(tuple(addr), timeout=timeout)
         if self._ssl_ctx is not None:
-            host = self.address if isinstance(self.address, str) \
-                else self.address[0]
+            host = addr if isinstance(addr, str) else addr[0]
             conn = self._ssl_ctx.wrap_socket(conn, server_hostname=host)
         return conn, conn.makefile("rwb")
 
     _IDEMPOTENT = frozenset({"get", "list", "current_revision", "compact"})
 
     def _call(self, method: str, params: Optional[dict] = None):
-        # A pooled connection may be stale (store restarted); one retry on
-        # a FRESH connection is safe only when the store cannot have seen
-        # the request (failure while SENDING) or the method is idempotent —
-        # a fully-sent create/delete/update_cas may have been APPLIED, and
-        # re-sending it would fabricate AlreadyExists/NotFound/Conflict
-        # errors (same rule as the REST client's stale-keep-alive retry).
-        for attempt in (0, 1):
+        # Retry/failover rules (same safety contract as the REST client's
+        # stale-keep-alive retry, extended across servers):
+        #  - a pooled connection may be stale (store restarted): retry on a
+        #    FRESH connection only when the store cannot have seen the
+        #    request (failure while SENDING) or the method is idempotent —
+        #    a fully-sent create/delete/update_cas may have been APPLIED,
+        #    and re-sending would fabricate AlreadyExists/NotFound/Conflict
+        #  - a NotPrimary answer means the request was definitely NOT
+        #    applied: always safe to fail over to the next server
+        #  - a fresh-dial refusal means this server is down: fail over
+        #    (nothing was sent)
+        last_exc: Optional[Exception] = None
+        # enough attempts (with a small sleep once every server has been
+        # tried) to ride out a standby's failover grace window (~1s):
+        # during it the old primary refuses and the standby still answers
+        # NotPrimary — a client that gave up instantly would surface a
+        # spurious 500 for a blip the system is designed to absorb
+        for attempt in range(2 + 6 * len(self._addrs)):
+            if attempt > len(self._addrs):
+                time.sleep(0.2)
             with self._lock:
-                # the retry attempt dials FRESH: after a store restart the
-                # whole pool is stale, and popping another dead pair would
-                # burn the one retry without ever reaching the live server
+                # retries dial FRESH: after a store restart the whole pool
+                # is stale, and popping another dead pair would burn the
+                # attempt without ever reaching a live server
                 pair = (self._pool.pop()
                         if self._pool and attempt == 0 else None)
                 self._next_id += 1
                 rid = self._next_id
+                addr = self._addrs[self._active]
             pooled = pair is not None
             if pair is None:
-                pair = self._connect(self.timeout)
+                try:
+                    pair = self._connect(self.timeout, addr)
+                except OSError as e:
+                    last_exc = ConnectionError(
+                        f"store {addr} unreachable: {e}")
+                    self._advance(addr)
+                    continue
             conn, f = pair
             sent = False
-            retriable = lambda: (pooled and attempt == 0  # noqa: E731
-                                 and (not sent or method in self._IDEMPOTENT))
             try:
                 f.write(json.dumps({"id": rid, "method": method,
                                     "params": params or {}}).encode() + b"\n")
                 f.flush()
                 sent = True
                 line = f.readline()
-            except (BrokenPipeError, ConnectionResetError, OSError):
+                if not line:
+                    raise BrokenPipeError("store closed the connection")
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 try:
                     conn.close()
                 except OSError:
                     pass
-                if retriable():
-                    continue
-                raise ConnectionError(f"store {self.address} unreachable")
-            if not line:
+                last_exc = ConnectionError(f"store {addr}: {e}")
+                if sent and method not in self._IDEMPOTENT:
+                    # may have been applied over there — nowhere is it safe
+                    # to re-send (the standby shares the replicated state)
+                    raise last_exc
+                if not pooled:
+                    self._advance(addr)  # fresh connection failed: move on
+                continue
+            try:
+                resp = json.loads(line)
+            except ValueError:
                 try:
                     conn.close()
                 except OSError:
                     pass
-                if retriable():
+                raise ConnectionError("store: corrupt response frame")
+            if resp.get("id") != rid:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise ConnectionError("store: response id mismatch")
+            if resp.get("error"):
+                err = error_from_wire(resp["error"])
+                if isinstance(err, NotPrimary):
+                    # standby answered: request NOT applied; try the next
+                    # server (it may have just been promoted)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    last_exc = err
+                    self._advance(addr)
                     continue
-                raise ConnectionError(f"store {self.address} closed")
-            break
-        try:
-            resp = json.loads(line)
-        except ValueError:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            raise ConnectionError("store: corrupt response frame")
-        if resp.get("id") != rid:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            raise ConnectionError("store: response id mismatch")
-        with self._lock:
-            self._pool.append(pair)
-        if resp.get("error"):
-            raise error_from_wire(resp["error"])
-        return resp.get("result")
+                with self._lock:
+                    self._pool.append(pair)
+                raise err
+            with self._lock:
+                self._pool.append(pair)
+            return resp.get("result")
+        raise last_exc if last_exc else ConnectionError(
+            f"store unreachable on every address: {self._addrs}")
 
     # ------------------------------------------------------------ operations
 
@@ -249,24 +327,48 @@ class RemoteStore:
     # ------------------------------------------------------------------ watch
 
     def watch(self, prefix: str, since_rev: int = 0) -> RemoteWatcher:
-        conn, f = self._connect(self.timeout)
-        try:
-            f.write(json.dumps({"id": 0, "method": "watch",
-                                "params": {"prefix": prefix,
-                                           "since_rev": since_rev}})
-                    .encode() + b"\n")
-            f.flush()
-            line = f.readline()
-            if not line:
-                raise ConnectionError(f"store {self.address} closed")
-            resp = json.loads(line)
-            if resp.get("error"):
-                raise error_from_wire(resp["error"])
-        except BaseException:
-            conn.close()
-            raise
-        conn.settimeout(None)  # the stream blocks until events arrive
-        return RemoteWatcher(conn, f)
+        last_exc: Optional[Exception] = None
+        for attempt in range(2 + 6 * len(self._addrs)):
+            if attempt > len(self._addrs):
+                time.sleep(0.2)  # ride out a failover grace window
+            addr = self._addrs[self._active]
+            try:
+                conn, f = self._connect(self.timeout, addr)
+            except OSError as e:
+                last_exc = ConnectionError(f"store {addr} unreachable: {e}")
+                self._advance(addr)
+                continue
+            try:
+                f.write(json.dumps({"id": 0, "method": "watch",
+                                    "params": {"prefix": prefix,
+                                               "since_rev": since_rev}})
+                        .encode() + b"\n")
+                f.flush()
+                line = f.readline()
+                if not line:
+                    raise ConnectionError(f"store {addr} closed")
+                resp = json.loads(line)
+                if resp.get("error"):
+                    err = error_from_wire(resp["error"])
+                    if isinstance(err, NotPrimary):
+                        conn.close()
+                        last_exc = err
+                        self._advance(addr)
+                        continue
+                    conn.close()
+                    raise err  # e.g. TooOldResourceVersion: a real answer
+            except (ConnectionError, OSError, ValueError) as e:
+                conn.close()
+                last_exc = e
+                self._advance(addr)
+                continue
+            except BaseException:
+                conn.close()
+                raise
+            conn.settimeout(None)  # the stream blocks until events arrive
+            return RemoteWatcher(conn, f)
+        raise last_exc if last_exc else ConnectionError(
+            f"store watch failed on every address: {self._addrs}")
 
     def close(self):
         with self._lock:

@@ -30,6 +30,7 @@ import json
 import os
 import socket
 import threading
+import time
 import traceback
 from typing import Optional, Tuple, Union
 
@@ -42,14 +43,26 @@ from ..machinery import (
 )
 from .store import Store
 
+class NotPrimary(ApiError):
+    """Raised by a standby store for any client operation before promotion.
+    The client (RemoteStore) treats it as 'try the next server' — the
+    request was definitely NOT applied, so failover-retry is always safe."""
+
+
 _ERROR_KINDS = {
     "NotFound": NotFound,
     "AlreadyExists": AlreadyExists,
     "Conflict": Conflict,
     "TooOldResourceVersion": TooOldResourceVersion,
+    "NotPrimary": NotPrimary,
 }
 
 WATCH_HEARTBEAT_SECONDS = 5.0
+# How long a write waits for the standby's ack before the standby is
+# declared a laggard and dropped (availability over a stuck replica —
+# the dropped standby reconnects and resyncs; the un-replicated window
+# is logged).  See StoreServer._await_replication.
+REPLICATION_ACK_TIMEOUT_SECONDS = 2.0
 
 
 def error_to_wire(e: Exception) -> dict:
@@ -71,15 +84,23 @@ class StoreServer:
 
     def __init__(self, store: Store, address: Union[str, Tuple[str, int]],
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 client_ca_file: str = ""):
+                 client_ca_file: str = "", primary: bool = True):
         """The store IS the cluster — its socket must never be an
         unauthenticated bypass of the apiserver's authz stack.  Unix
         sockets are chmod 0600 (same-user only, the etcd-on-localhost
         posture); TCP mode with client_ca_file REQUIRES a client cert
-        signed by that CA (etcd's peer/client mTLS)."""
+        signed by that CA (etcd's peer/client mTLS).
+
+        primary=False serves a warm standby: every client operation
+        answers NotPrimary (so RemoteStore fails over to the real primary)
+        until promote() flips it live."""
         self.store = store
+        self.primary = primary
         self._threads = []
         self._stop = threading.Event()
+        # replication: feed -> last acked rev, guarded by _repl_cond
+        self._repl_cond = threading.Condition()
+        self._replica_acks: dict = {}
         if isinstance(address, str):
             try:
                 os.unlink(address)
@@ -158,7 +179,18 @@ class StoreServer:
                 rid = req.get("id")
                 method = req.get("method")
                 params = req.get("params") or {}
+                if method == "replicate":
+                    self._serve_replica(conn, f, rid, params)
+                    return  # connection consumed by the stream
                 if method == "watch":
+                    if not self.primary:
+                        f.write(json.dumps(
+                            {"id": rid, "error": {
+                                "kind": "NotPrimary",
+                                "msg": "standby: not serving watches"}})
+                            .encode() + b"\n")
+                        f.flush()
+                        continue
                     self._serve_watch(conn, f, rid, params)
                     return  # connection consumed by the stream
                 try:
@@ -184,9 +216,13 @@ class StoreServer:
     # private encoded form directly to avoid a decode+encode per op.
     def _dispatch(self, method: Optional[str], p: dict):
         s = self.store
+        if not self.primary and method != "current_revision":
+            # current_revision stays answerable for replication-lag
+            # monitoring; everything else must go to the primary
+            raise NotPrimary("standby store: not serving client operations")
         if method == "create":
             obj = s.create(p["key"], s._scheme.decode(p["obj"]))
-            return s._scheme.encode(obj)
+            return self._replicated(s._scheme.encode(obj))
         if method == "get":
             return s._scheme.encode(s.get(p["key"]))
         if method == "list":
@@ -195,16 +231,146 @@ class StoreServer:
                     "rev": rev}
         if method == "update_cas":
             obj = s.update_cas(p["key"], s._scheme.decode(p["obj"]))
-            return s._scheme.encode(obj)
+            return self._replicated(s._scheme.encode(obj))
         if method == "delete":
             obj = s.delete(p["key"], p.get("expect_rv", ""))
-            return s._scheme.encode(obj)
+            return self._replicated(s._scheme.encode(obj))
         if method == "current_revision":
             return s.current_revision()
         if method == "compact":
             s.compact(p.get("keep_last", 1000))
             return None
         raise ValueError(f"unknown store method {method!r}")
+
+    def promote(self):
+        """Standby -> primary: start serving client operations."""
+        self.primary = True
+
+    # ------------------------------------------------------------ replication
+
+    def _replicated(self, encoded: dict) -> dict:
+        """Semi-synchronous replication gate: a write is acked to the
+        client only after every attached standby has acked its revision —
+        so a SIGKILLed primary cannot take an acknowledged write with it.
+        A standby that stalls past the timeout is DROPPED (it reconnects
+        and resyncs) rather than wedging the control plane: the etcd
+        answer is quorum; with exactly two members, availability wins."""
+        if not self._replica_acks:
+            return encoded
+        rev = int(encoded["metadata"]["resourceVersion"])
+        deadline = time.monotonic() + REPLICATION_ACK_TIMEOUT_SECONDS
+        with self._repl_cond:
+            while True:
+                laggards = [fd for fd, acked in self._replica_acks.items()
+                            if acked < rev]
+                if not laggards:
+                    return encoded
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._repl_cond.wait(remaining)
+            for fd in laggards:
+                print(f"store: dropping laggard standby (rev {rev} unacked "
+                      f"after {REPLICATION_ACK_TIMEOUT_SECONDS}s)",
+                      flush=True)
+                self._replica_acks.pop(fd, None)
+                fd._stopped.set()
+                fd._q.put(None)
+                # sever the socket too: a wedged standby (SIGSTOP, full
+                # buffer) leaves send_loop blocked in flush() where the
+                # queue sentinel can't wake it — only shutdown() can
+                drop = getattr(fd, "drop_conn", None)
+                if drop is not None:
+                    drop()
+        return encoded
+
+    def _serve_replica(self, conn, f, rid, params):
+        """A standby's connection: stream commit records to it, read its
+        {"ack": rev} lines back on the same socket (reads here, writes on
+        the sender thread — the two directions have independent buffers)."""
+        feed = self.store.replication_feed(int(params.get("since_rev", 0)))
+
+        def drop_conn():
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+        feed.drop_conn = drop_conn
+        with self._repl_cond:
+            self._replica_acks[feed] = 0
+        f.write(json.dumps({"id": rid, "result": {
+            "rev": self.store.current_revision()}}).encode() + b"\n")
+        f.flush()
+
+        def send_loop():
+            try:
+                if feed.snapshot is not None:
+                    items, rev = feed.snapshot
+                    f.write(json.dumps({"snap": {
+                        "items": [[k, r, o] for k, r, o in items],
+                        "rev": rev}}).encode() + b"\n")
+                    f.flush()
+                while not self._stop.is_set() and not feed._stopped.is_set():
+                    rec = feed.next_timeout(WATCH_HEARTBEAT_SECONDS)
+                    if rec is None:
+                        if feed._stopped.is_set():
+                            break
+                        f.write(b"\n")  # heartbeat
+                    else:
+                        rev, typ, key, obj = rec
+                        f.write(json.dumps({"rec": {
+                            "rev": rev, "type": typ, "key": key,
+                            "obj": obj}}).encode() + b"\n")
+                    f.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError,
+                    ValueError):
+                pass
+            finally:
+                # shutdown, not just close: the ack reader below still
+                # holds the makefile object, so close() alone would keep
+                # the fd open and neither side would ever see EOF
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        sender = threading.Thread(target=send_loop, daemon=True,
+                                  name="store-replica-send")
+        sender.start()
+        try:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    acked = int(json.loads(line).get("ack", 0))
+                except (ValueError, TypeError):
+                    continue
+                with self._repl_cond:
+                    if feed in self._replica_acks:
+                        self._replica_acks[feed] = max(
+                            self._replica_acks[feed], acked)
+                    self._repl_cond.notify_all()
+        except (BrokenPipeError, ConnectionResetError, OSError, ValueError):
+            pass
+        finally:
+            feed.stop(self.store)
+            with self._repl_cond:
+                self._replica_acks.pop(feed, None)
+                self._repl_cond.notify_all()
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _serve_watch(self, conn, f, rid, params):
         try:

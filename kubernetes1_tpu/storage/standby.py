@@ -1,0 +1,174 @@
+"""Warm-standby store: WAL shipping + self-promotion on primary death.
+
+Ref role: the reference's L0 survives member loss because etcd is a raft
+quorum and apiservers are just clients (staging/src/k8s.io/apiserver/pkg/
+storage/etcd3/store.go:152,263).  This is the two-member analog: the
+standby replays the primary's commit stream into an identical local store
+(same revision numbering, own WAL), acks each applied revision — the
+primary gates client write-acks on those acks, so an acknowledged write
+exists on BOTH disks — and serves NotPrimary to clients until promoted.
+
+Promotion is self-driven: when the replication link drops, the standby
+probes the primary's address for `failover_grace` seconds; only a
+connection REFUSED verdict (process dead — on a unix socket this is
+immediate and unambiguous) promotes.  A transient hiccup with the primary
+still listening just reconnects and resyncs.  Split-brain caveat vs raft:
+over TCP across hosts a network partition is indistinguishable from death;
+a real quorum needs >= 3 members — documented tradeoff, the interface is
+shaped so a raft group can replace this later (storage/server.py:21).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Optional, Tuple, Union
+
+from ..machinery.scheme import Scheme, global_scheme
+from .server import StoreServer
+from .store import Store
+
+
+class StandbyServer:
+    """Runs a Store fed only by replication + a StoreServer in standby
+    mode; promotes itself when the primary is observed dead."""
+
+    def __init__(self, primary_address: Union[str, Tuple[str, int]],
+                 serve_address: Union[str, Tuple[str, int]],
+                 wal_path: Optional[str] = None,
+                 failover_grace: float = 1.0,
+                 scheme: Optional[Scheme] = None,
+                 tls_cert_file: str = "", tls_key_file: str = "",
+                 client_ca_file: str = ""):
+        self.primary_address = primary_address
+        self.failover_grace = failover_grace
+        self.store = Store(scheme or global_scheme.copy(), wal_path=wal_path)
+        self.server = StoreServer(self.store, serve_address,
+                                  tls_cert_file=tls_cert_file,
+                                  tls_key_file=tls_key_file,
+                                  client_ca_file=client_ca_file,
+                                  primary=False)
+        self.address = self.server.address
+        self.promoted = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_applied_rev = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "StandbyServer":
+        self.server.start()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="store-standby")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.server.stop()
+
+    def promote(self):
+        """Standby -> primary.  Its store already holds every acknowledged
+        write (the primary's ack gate guarantees it)."""
+        if not self.promoted.is_set():
+            self.promoted.set()
+            self.server.promote()
+            print(f"ktpu-store standby PROMOTED at rev "
+                  f"{self.store.current_revision()}", flush=True)
+
+    # ----------------------------------------------------------- replication
+
+    def _dial(self, timeout: float = 5.0):
+        if isinstance(self.primary_address, str):
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(timeout)
+            conn.connect(self.primary_address)
+        else:
+            conn = socket.create_connection(tuple(self.primary_address),
+                                            timeout=timeout)
+        return conn
+
+    def _run(self):
+        while not self._stop.is_set() and not self.promoted.is_set():
+            try:
+                self._stream_once()
+            except (OSError, ValueError):
+                pass
+            if self._stop.is_set() or self.promoted.is_set():
+                return
+            if self._primary_dead():
+                self.promote()
+                return
+            time.sleep(0.1)  # primary alive: transient drop — resync
+
+    def _stream_once(self):
+        """One replication session: handshake, then apply records until the
+        connection drops."""
+        conn = self._dial()
+        try:
+            f = conn.makefile("rwb")
+            f.write(json.dumps({
+                "id": 1, "method": "replicate",
+                "params": {"since_rev": self.store.current_revision()}})
+                .encode() + b"\n")
+            f.flush()
+            line = f.readline()
+            if not line:
+                return
+            resp = json.loads(line)
+            if resp.get("error"):
+                # primary refused (e.g. itself a standby): wait and retry
+                time.sleep(0.2)
+                return
+            conn.settimeout(None)  # stream blocks until commits arrive
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                frame = json.loads(line)
+                snap = frame.get("snap")
+                if snap is not None:
+                    self.store.apply_snapshot(
+                        [(k, r, o) for k, r, o in snap["items"]],
+                        int(snap["rev"]))
+                    self.last_applied_rev = int(snap["rev"])
+                rec = frame.get("rec")
+                if rec is not None:
+                    self.store.apply_replicated(
+                        int(rec["rev"]), rec["type"], rec["key"], rec["obj"])
+                    self.last_applied_rev = int(rec["rev"])
+                f.write(json.dumps(
+                    {"ack": self.last_applied_rev}).encode() + b"\n")
+                f.flush()
+        finally:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ failure detection
+
+    def _primary_dead(self) -> bool:
+        """True only when the primary's address refuses connections for the
+        whole grace window.  A successful connect means it's alive (the
+        stream drop was transient): resync instead of promoting."""
+        deadline = time.monotonic() + self.failover_grace
+        while not self._stop.is_set():
+            try:
+                conn = self._dial(timeout=1.0)
+                conn.close()
+                return False
+            except (ConnectionRefusedError, FileNotFoundError):
+                pass  # nobody listening: the death signal
+            except OSError:
+                pass  # unreachable: treat like refused, keep probing
+            if time.monotonic() >= deadline:
+                return True
+            time.sleep(0.1)
+        return False

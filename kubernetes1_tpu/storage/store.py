@@ -87,6 +87,32 @@ class Watcher:
         return ev
 
 
+class ReplicaFeed:
+    """A standby's subscription to the primary's commit stream: a queue of
+    (rev, type, key, obj) records, optionally preceded by a full snapshot
+    (set when the standby's since_rev predates the history floor)."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._stopped = threading.Event()
+        self.snapshot: Optional[tuple] = None  # (items, rev) or None
+
+    def _push(self, rec: tuple):
+        if not self._stopped.is_set():
+            self._q.put(rec)
+
+    def next_timeout(self, timeout: float) -> Optional[tuple]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self, store: "Store"):
+        self._stopped.set()
+        self._q.put(None)
+        store._remove_replica(self)
+
+
 class Store:
     def __init__(
         self,
@@ -108,6 +134,7 @@ class Store:
         self._history_limit = history_limit
         self._compacted_rev = 0  # watches must start > this
         self._watchers: List[Watcher] = []
+        self._replicas: List["ReplicaFeed"] = []
         self._wal_path = wal_path
         self._wal = None
         if wal_path:
@@ -131,6 +158,8 @@ class Store:
                 rec = json.loads(line)
                 rev, typ, key, obj = rec["rev"], rec["type"], rec["key"], rec["obj"]
                 self._rev = max(self._rev, rev)
+                if typ == "NOP":  # snapshot revision pin, no data
+                    continue
                 if typ == DELETED:
                     self._data.pop(key, None)
                     coll = self._by_collection.get(self._collection_of(key))
@@ -173,6 +202,8 @@ class Store:
         for w in self._watchers:
             if key.startswith(w.prefix):
                 w._push(event)
+        for r in self._replicas:
+            r._push((rev, typ, key, obj))
         return rev, obj
 
     def _decode(self, obj: Dict[str, Any]):
@@ -296,6 +327,99 @@ class Store:
                 self._watchers.remove(w)
             except ValueError:
                 pass
+
+    # ------------------------------------------------------------ replication
+    #
+    # WAL shipping to a warm standby (the role etcd's raft quorum plays for
+    # the reference — staging/src/k8s.io/apiserver/pkg/storage/etcd3/
+    # store.go:263: apiservers are stateless clients of a store that
+    # survives member loss).  The feed carries the full commit record
+    # (rev, type, key, obj) — exactly the WAL line — so a standby replays
+    # commits verbatim and its store is revision-identical to the primary.
+
+    def replication_feed(self, since_rev: int = 0) -> "ReplicaFeed":
+        """Subscribe to commit records > since_rev.  If since_rev is below
+        the history floor the feed carries a snapshot first (the standby's
+        state is too old to catch up incrementally)."""
+        with self._lock:
+            feed = ReplicaFeed()
+            if since_rev < self._compacted_rev:
+                # too old: full-state snapshot at the current revision,
+                # then stream from here
+                feed.snapshot = ([(k, rev, obj)
+                                  for k, (rev, obj) in self._data.items()],
+                                 self._rev)
+            else:
+                for rev, typ, key, obj in self._history:
+                    if rev > since_rev:
+                        feed._push((rev, typ, key, obj))
+            self._replicas.append(feed)
+            return feed
+
+    def _remove_replica(self, feed: "ReplicaFeed"):
+        with self._lock:
+            try:
+                self._replicas.remove(feed)
+            except ValueError:
+                pass
+
+    def apply_replicated(self, rev: int, typ: str, key: str,
+                         obj: Dict[str, Any]):
+        """Standby-side: apply a shipped commit record verbatim, preserving
+        the primary's revision numbering (the standby must be able to serve
+        watches resuming from primary-issued resourceVersions after
+        promotion).  Fans out to local watchers and the local WAL."""
+        with self._lock:
+            if rev <= self._rev:
+                return  # replay overlap after reconnect: already applied
+            self._rev = rev
+            if typ == DELETED:
+                self._data.pop(key, None)
+                coll = self._by_collection.get(self._collection_of(key))
+                if coll is not None:
+                    coll.discard(key)
+            else:
+                self._data[key] = (rev, obj)
+                self._by_collection.setdefault(
+                    self._collection_of(key), set()).add(key)
+            self._history.append((rev, typ, key, obj))
+            if len(self._history) > self._history_limit:
+                drop = len(self._history) - self._history_limit
+                self._compacted_rev = self._history[drop - 1][0]
+                del self._history[:drop]
+            if self._wal:
+                self._wal.write(json.dumps(
+                    {"rev": rev, "type": typ, "key": key, "obj": obj}) + "\n")
+            event = WatchEvent(typ, obj)
+            for w in self._watchers:
+                if key.startswith(w.prefix):
+                    w._push(event)
+
+    def apply_snapshot(self, items, rev: int):
+        """Standby-side: replace local state with a primary snapshot."""
+        with self._lock:
+            self._data = {k: (r, obj) for k, r, obj in items}
+            self._by_collection = {}
+            for k in self._data:
+                self._by_collection.setdefault(
+                    self._collection_of(k), set()).add(k)
+            self._rev = rev
+            self._history = []
+            self._compacted_rev = rev
+            if self._wal:
+                # rewrite the WAL as a snapshot so a standby restart
+                # replays to the same state
+                self._wal.close()
+                self._wal = open(self._wal_path, "w", buffering=1)
+                for k, (r, obj) in self._data.items():
+                    self._wal.write(json.dumps(
+                        {"rev": r, "type": ADDED, "key": k,
+                         "obj": obj}) + "\n")
+                # deletes can make the store revision exceed every live
+                # item's rev; a NOP record pins it for WAL replay
+                self._wal.write(json.dumps(
+                    {"rev": rev, "type": "NOP", "key": "", "obj": {}})
+                    + "\n")
 
     def compact(self, keep_last: int = 1000):
         with self._lock:

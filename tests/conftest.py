@@ -19,3 +19,75 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------- leak police
+#
+# Round 4 left ten leaked store/apiserver pairs on the box (fixture setup
+# failures skipped the post-yield teardown), and those stragglers poisoned
+# every later benchmark.  The suite now polices itself: any framework
+# process that appears during the run and survives it FAILS the session.
+
+def _ktpu_procs(marker: str = "") -> dict:
+    """pid -> cmdline for every framework process on the box (spawned
+    components match `-m kubernetes1_tpu` / the native `bin/ktpu-*`).
+    With a marker, only processes whose ENVIRON carries it are returned —
+    i.e. descendants of this pytest run, even after re-parenting — so a
+    concurrent session's processes can never fail OUR run."""
+    out = {}
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").replace("\0", " ")
+        except OSError:
+            continue
+        if "-m kubernetes1_tpu" not in cmd and "bin/ktpu-" not in cmd:
+            continue
+        if marker:
+            try:
+                with open(f"/proc/{pid}/environ", "rb") as f:
+                    if marker.encode() not in f.read():
+                        continue
+            except OSError:
+                continue
+        out[int(pid)] = cmd.strip()
+    return out
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _leak_police():
+    """Teardown runs after every test and fixture has finalized; raising
+    here fails the whole run (a sessionfinish hook can only print — its
+    exitstatus mutation is not honored)."""
+    import time
+    import uuid
+
+    pre = _ktpu_procs()
+    if pre:
+        print(f"\n[leak-police] WARNING: {len(pre)} framework process(es) "
+              f"already running before this suite (not ours; only "
+              f"marker-carrying descendants can fail this run):",
+              file=sys.stderr)
+        for pid, cmd in pre.items():
+            print(f"  pid {pid}: {cmd[:120]}", file=sys.stderr)
+    # every child this pytest run spawns (directly or transitively)
+    # inherits the marker via os.environ; /proc/<pid>/environ keeps it
+    # even after an orphan is re-parented to init
+    marker = f"KTPU_LEAKPOLICE={uuid.uuid4().hex}"
+    os.environ["KTPU_LEAKPOLICE"] = marker.split("=", 1)[1]
+    yield
+    leaked = {}
+    for _ in range(20):  # grace: SIGKILLed children may take a beat to reap
+        leaked = _ktpu_procs(marker)
+        if not leaked:
+            return
+        time.sleep(0.25)
+    lines = "\n".join(f"  pid {p}: {c[:120]}" for p, c in leaked.items())
+    raise RuntimeError(
+        f"[leak-police] {len(leaked)} framework process(es) outlived the "
+        f"suite:\n{lines}")

@@ -220,3 +220,4 @@ class TestDeviceManager:
             dm.stop()
             if proc.poll() is None:
                 proc.kill()
+            proc.wait(timeout=5)  # collect the exit: no zombie left

@@ -50,6 +50,7 @@ def start_native_plugin(binary, plugin_dir, fake="v5e:4:sliceN:0"):
     while not os.path.exists(sock):
         if time.monotonic() > deadline:
             proc.terminate()
+            proc.wait(timeout=5)
             raise TimeoutError("native plugin socket never appeared")
         time.sleep(0.05)
     return proc
@@ -193,14 +194,21 @@ class TestNativeCRIRuntime:
         root = str(tmp_path / "rt")
         proc = subprocess.Popen([binary, "--socket", sock, "--root", root],
                                 stderr=subprocess.PIPE, text=True)
-        deadline = time.monotonic() + 5
-        while not os.path.exists(sock):
-            assert proc.poll() is None, proc.stderr.read()
-            assert time.monotonic() < deadline, "socket never appeared"
-            time.sleep(0.05)
-        from kubernetes1_tpu.kubelet.cri import RemoteRuntime
+        # pre-yield failures must still reap the spawned runtime: a bare
+        # assert here would leak the process (r4's leaked-process lesson)
+        try:
+            deadline = time.monotonic() + 5
+            while not os.path.exists(sock):
+                assert proc.poll() is None, proc.stderr.read()
+                assert time.monotonic() < deadline, "socket never appeared"
+                time.sleep(0.05)
+            from kubernetes1_tpu.kubelet.cri import RemoteRuntime
 
-        client = RemoteRuntime(sock)
+            client = RemoteRuntime(sock)
+        except BaseException:
+            proc.terminate()
+            proc.wait(timeout=5)
+            raise
         yield client, root
         client.close()
         proc.terminate()
