@@ -31,6 +31,7 @@ _REGISTRY = [
     (t.Eviction, "evictions", True),
     (t.PersistentVolume, "persistentvolumes", False),
     (t.PersistentVolumeClaim, "persistentvolumeclaims", True),
+    (t.StorageClass, "storageclasses", False),
     (t.CertificateSigningRequest, "certificatesigningrequests", False),
     (t.CustomResourceDefinition, "customresourcedefinitions", False),
     (t.PodPreset, "podpresets", True),

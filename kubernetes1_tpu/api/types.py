@@ -1114,6 +1114,27 @@ class PersistentVolumeClaim(KObject):
     )
 
 
+@dataclass
+class StorageClass(KObject):
+    """Ref: pkg/apis/storage/types.go:28 — names a provisioner so PVCs can
+    ask for storage that doesn't exist yet (dynamic provisioning) instead
+    of binding only to pre-created PVs.
+
+    volumeBindingMode (storage/types.go VolumeBindingMode):
+      Immediate            — provision/bind as soon as the PVC appears
+      WaitForFirstConsumer — hold the PVC Pending until a pod consuming it
+                             is scheduled; on a TPU cluster this keeps a
+                             checkpoint volume's hostPath on the node the
+                             gang actually landed on."""
+
+    KIND = "StorageClass"
+    API_VERSION = "storage.k8s.io/v1"
+    provisioner: str = ""
+    reclaim_policy: str = "Delete"   # Delete | Retain
+    volume_binding_mode: str = "Immediate"
+    parameters: Dict[str, str] = field(default_factory=dict)
+
+
 # -------------------------------------------------------------- certificates
 
 

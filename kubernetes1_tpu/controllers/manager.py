@@ -19,6 +19,7 @@ from .namespace import GarbageCollector, NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .podautoscaler import HorizontalPodAutoscalerController
 from .podgc import PodGCController
+from .provisioner import HostPathProvisioner
 from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
@@ -38,6 +39,7 @@ class ControllerManager:
         ca_key: str = "ktpu-ca-key",
         ca_cert_pem: str = "",
         sa_signing_key: str = "ktpu-sa-key",
+        pv_base_dir: str = "/var/lib/ktpu/pv",
     ):
         self.cs = clientset
         self.factory = InformerFactory(clientset)
@@ -61,6 +63,8 @@ class ControllerManager:
             CertificateController(clientset, self.factory, ca_key=ca_key,
                                   ca_cert_pem=ca_cert_pem),
             PersistentVolumeBinder(clientset, self.factory),
+            HostPathProvisioner(clientset, self.factory,
+                                base_dir=pv_base_dir),
         ]
         self.node_lifecycle = NodeLifecycleController(
             clientset,

@@ -1,0 +1,152 @@
+"""Dynamic volume provisioner (ref: pkg/controller/volume/persistentvolume/
+pv_controller.go provisionClaim + the external-provisioner contract;
+StorageClass: pkg/apis/storage/types.go:28).
+
+A Pending PVC naming a StorageClass whose provisioner is ours gets a
+hostPath PV created on demand (pvc-<uid> under base_dir), pre-bound via
+claim_ref so the binder's resume path completes the bind.  On a TPU
+training cluster this is the checkpoint-volume path: a Job's PVC
+provisions storage the moment it's needed, and the data outlives pod
+restarts (reclaim Retain) or is cleaned with the claim (Delete).
+
+volumeBindingMode=WaitForFirstConsumer (storage/types.go
+VolumeBindingWaitForFirstConsumer) is honored as API behavior: the PVC
+stays Pending until a pod that consumes it has been SCHEDULED, so
+provisioning happens where (and only when) the workload actually lands.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from ..api import types as t
+from ..machinery import AlreadyExists, ApiError, NotFound
+from .base import Controller
+from .volumeutil import has_scheduled_consumer, pod_claim_keys
+
+HOSTPATH_PROVISIONER = "ktpu.io/hostpath"
+PROVISIONED_BY = "pv.kubernetes.io/provisioned-by"
+HOSTPATH_DIR_ANNOTATION = "ktpu.io/hostpath-dir"
+
+
+class HostPathProvisioner(Controller):
+    name = "hostpath-provisioner"
+
+    def __init__(self, clientset, factory, workers: int = 2,
+                 base_dir: str = "/var/lib/ktpu/pv",
+                 provisioner_name: str = HOSTPATH_PROVISIONER):
+        super().__init__(clientset, factory, workers)
+        self.base_dir = base_dir
+        self.provisioner_name = provisioner_name
+
+    def setup(self):
+        self.pvcs = self.factory.informer("persistentvolumeclaims")
+        self.pvs = self.factory.informer("persistentvolumes")
+        self.classes = self.factory.informer("storageclasses")
+        self.pods = self.factory.informer("pods")
+        self.pvcs.add_handler(
+            on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n),
+            on_delete=self._claim_deleted)
+        # a StorageClass created after its PVCs must un-stick them
+        self.classes.add_handler(on_add=self._class_event)
+        # WaitForFirstConsumer trigger: a pod landing on a node makes its
+        # claims provisionable
+        self.pods.add_handler(
+            on_add=self._pod_event, on_update=lambda _o, n: self._pod_event(n))
+        # reclaim: deleting a PV we provisioned removes its directory
+        self.pvs.add_handler(on_delete=self._pv_deleted)
+
+    def _class_event(self, sc):
+        for pvc in self.pvcs.list():
+            if pvc.spec.storage_class_name == sc.metadata.name:
+                self.enqueue(pvc)
+
+    def _pod_event(self, pod: t.Pod):
+        if not pod.spec.node_name:
+            return
+        for key in pod_claim_keys(pod):
+            self.queue.add(key)
+
+    def _claim_deleted(self, pvc: t.PersistentVolumeClaim):
+        """A claim deleted BEFORE the binder finished leaves our pre-bound
+        PV orphaned (never Bound, so the binder's release path skips it):
+        delete it here, which also reclaims the directory via _pv_deleted."""
+        pv_name = f"pvc-{pvc.metadata.uid}"
+        pv = self.pvs.get(pv_name)
+        if pv is None or pv.status.phase == "Bound" \
+                or pv.metadata.annotations.get(PROVISIONED_BY) != \
+                self.provisioner_name:
+            return
+        try:
+            self.cs.persistentvolumes.delete(pv_name, "")
+        except (NotFound, ApiError):
+            pass
+
+    def _pv_deleted(self, pv: t.PersistentVolume):
+        if pv.metadata.annotations.get(PROVISIONED_BY) != \
+                self.provisioner_name:
+            return
+        # Retain means what it says: deleting the PV OBJECT must not touch
+        # the data (upstream semantics); only Delete reclaims the directory
+        if pv.spec.persistent_volume_reclaim_policy != "Delete":
+            return
+        path = pv.metadata.annotations.get(HOSTPATH_DIR_ANNOTATION, "")
+        # only ever remove directories we created, under our base_dir
+        base = os.path.realpath(self.base_dir)
+        real = os.path.realpath(path) if path else ""
+        if real and real.startswith(base + os.sep):
+            shutil.rmtree(real, ignore_errors=True)
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, key: str):
+        pvc = self.pvcs.get(key)
+        if pvc is None or pvc.status.phase == "Bound" \
+                or pvc.spec.volume_name:
+            return
+        if not pvc.spec.storage_class_name:
+            return  # static binding only
+        sc = self.classes.get(pvc.spec.storage_class_name)
+        if sc is None or sc.provisioner != self.provisioner_name:
+            return  # not ours (an external provisioner's class, or typo)
+        if sc.volume_binding_mode == "WaitForFirstConsumer" \
+                and not has_scheduled_consumer(self.pods, pvc):
+            return  # re-enqueued by _pod_event when a consumer lands
+        pv_name = f"pvc-{pvc.metadata.uid}"
+        if self.pvs.get(pv_name) is not None:
+            return  # already provisioned (informer lag: binder will finish)
+        path = os.path.join(self.base_dir, pv_name)
+        os.makedirs(path, exist_ok=True)
+        pv = t.PersistentVolume()
+        pv.metadata.name = pv_name
+        pv.metadata.annotations = {
+            PROVISIONED_BY: self.provisioner_name,
+            HOSTPATH_DIR_ANNOTATION: path,
+        }
+        pv.spec.capacity = {
+            "storage": pvc.spec.resources.requests.get("storage", "1Gi")}
+        pv.spec.access_modes = list(pvc.spec.access_modes) or [
+            "ReadWriteOnce"]
+        pv.spec.host_path = t.HostPathVolumeSource(path=path)
+        pv.spec.storage_class_name = sc.metadata.name
+        pv.spec.persistent_volume_reclaim_policy = sc.reclaim_policy
+        # pre-bound: the binder's resume path (claim_ref match) completes
+        # the PVC side — the same crash-safe handoff a half-finished static
+        # bind uses
+        pv.spec.claim_ref = t.ObjectReference(
+            kind="PersistentVolumeClaim",
+            namespace=pvc.metadata.namespace or "default",
+            name=pvc.metadata.name,
+            uid=pvc.metadata.uid,
+        )
+        try:
+            self.cs.persistentvolumes.create(pv, "")
+        except AlreadyExists:
+            return
+        except ApiError:
+            self.enqueue_after(key, 0.5)
+            return
+        self.recorder.event(
+            pvc, "Normal", "ProvisioningSucceeded",
+            f"provisioned volume {pv_name} ({self.provisioner_name})")

@@ -10,6 +10,7 @@ from ..api import types as t
 from ..machinery import ApiError, NotFound
 from ..utils.quantity import parse_quantity
 from .base import Controller
+from .volumeutil import has_scheduled_consumer, pod_claim_keys
 
 
 class PersistentVolumeBinder(Controller):
@@ -18,6 +19,8 @@ class PersistentVolumeBinder(Controller):
     def setup(self):
         self.pvs = self.factory.informer("persistentvolumes")
         self.pvcs = self.factory.informer("persistentvolumeclaims")
+        self.classes = self.factory.informer("storageclasses")
+        self.pods = self.factory.informer("pods")
         self.pvcs.add_handler(
             on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n),
             on_delete=self._claim_deleted,
@@ -25,6 +28,27 @@ class PersistentVolumeBinder(Controller):
         self.pvs.add_handler(
             on_add=self._pv_event, on_update=lambda _o, n: self._pv_event(n)
         )
+        # WaitForFirstConsumer: a pod landing on a node unblocks binding
+        self.pods.add_handler(
+            on_add=self._pod_event, on_update=lambda _o, n: self._pod_event(n)
+        )
+
+    def _pod_event(self, pod: t.Pod):
+        if not pod.spec.node_name:
+            return
+        for key in pod_claim_keys(pod):
+            self.queue.add(key)
+
+    def _must_wait_for_consumer(self, pvc: t.PersistentVolumeClaim) -> bool:
+        """StorageClass volumeBindingMode=WaitForFirstConsumer (ref
+        storage/types.go): hold binding until a pod consuming the claim is
+        scheduled — applies to pre-created PVs exactly as to dynamic ones."""
+        if not pvc.spec.storage_class_name:
+            return False
+        sc = self.classes.get(pvc.spec.storage_class_name)
+        if sc is None or sc.volume_binding_mode != "WaitForFirstConsumer":
+            return False
+        return not has_scheduled_consumer(self.pods, pvc)
 
     def _pv_event(self, pv: t.PersistentVolume):
         # a new/updated volume may satisfy a pending claim; also reconcile
@@ -74,16 +98,23 @@ class PersistentVolumeBinder(Controller):
             self._finish_bind(pvc, pvc.spec.volume_name)
             return
         # a previous pass may have claimed a PV but crashed before finishing —
-        # resume that bind instead of claiming a second volume
+        # resume that bind instead of claiming a second volume (the dynamic
+        # provisioner's pre-bound PVs ride the same path).  The uid must
+        # match: a same-name claim RECREATED after a delete is a different
+        # claim, and handing it a stale pre-bound volume would serve it the
+        # old claim's data with the old claim's class/size.
         for pv in self.pvs.list():
             ref = pv.spec.claim_ref
             if (
                 ref is not None
                 and ref.namespace == pvc.metadata.namespace
                 and ref.name == pvc.metadata.name
+                and (not ref.uid or ref.uid == pvc.metadata.uid)
             ):
                 self._finish_bind(pvc, pv.metadata.name)
                 return
+        if self._must_wait_for_consumer(pvc):
+            return  # _pod_event re-enqueues when a consumer is scheduled
         # smallest satisfying volume wins (reference's findBestMatchForClaim)
         candidates = [pv for pv in self.pvs.list() if self._matches(pv, pvc)]
         if not candidates:

@@ -1,0 +1,27 @@
+"""Shared claim/consumer helpers for the PV binder and the dynamic
+provisioner (both gate WaitForFirstConsumer on the same question: has a
+pod consuming this claim been scheduled?)."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..api import types as t
+
+
+def pod_claim_keys(pod: t.Pod) -> Iterator[str]:
+    """'<ns>/<claim-name>' for every PVC the pod consumes."""
+    ns = pod.metadata.namespace or "default"
+    for v in pod.spec.volumes:
+        src = v.persistent_volume_claim
+        if src is not None and src.claim_name:
+            yield f"{ns}/{src.claim_name}"
+
+
+def has_scheduled_consumer(pods_informer, pvc: t.PersistentVolumeClaim) -> bool:
+    """True when some pod consuming the claim has landed on a node."""
+    want = f"{pvc.metadata.namespace or 'default'}/{pvc.metadata.name}"
+    for pod in pods_informer.list():
+        if pod.spec.node_name and want in pod_claim_keys(pod):
+            return True
+    return False

@@ -257,6 +257,15 @@ class RemoteRuntime(RuntimeService):
         the kubelet treats unknown as fail-closed for runAsNonRoot."""
         return self._capabilities().get("default_uid")
 
+    @property
+    def identity_known(self) -> bool:
+        """True once capabilities HAVE been answered — lets the kubelet
+        tell 'runtime not up yet' (transient, defer) from 'runtime answered
+        without an identity' (version skew: permanent, fail the pod with a
+        real error instead of deferring forever)."""
+        self._capabilities()
+        return self._caps is not None
+
     # ----------------------------------------------------------- transport
 
     def _connect(self, retry_window: float = 5.0):

@@ -1087,11 +1087,18 @@ class Kubelet:
                 # CLOSED: admitting would risk silently running as root.
                 uid = getattr(self.runtime, "default_uid", None)
                 if uid is None:
-                    # unknown is usually TRANSIENT (a remote runtime that
-                    # hasn't answered capabilities yet — kubelet and
-                    # runtime start concurrently by design): defer and let
-                    # the sync ticker retry rather than terminally failing
-                    # the pod; still fail-closed, never run-as-maybe-root
+                    # fail-closed either way, but distinguish WHY: a remote
+                    # runtime that hasn't answered capabilities yet is
+                    # transient (kubelet and runtime start concurrently by
+                    # design) — defer; one that ANSWERED without an
+                    # identity (version skew) will never change its mind —
+                    # fail the pod with a real error, don't livelock
+                    if getattr(self.runtime, "identity_known", True):
+                        raise VolumeError(
+                            f"container {container.name}: runAsNonRoot is "
+                            f"set with no runAsUser and the runtime does "
+                            f"not report its identity — refusing rather "
+                            f"than risk root")
                     raise VolumeNotReady(
                         f"container {container.name}: runAsNonRoot is set "
                         f"with no runAsUser and the runtime's identity is "

@@ -36,6 +36,11 @@ def main():
     ap.add_argument("--failover-grace", type=float, default=1.0,
                     help="seconds the primary must refuse connections "
                          "before the standby promotes itself")
+    ap.add_argument("--primary-ca-file", default="",
+                    help="CA to verify a TLS primary when replicating")
+    ap.add_argument("--primary-cert-file", default="",
+                    help="client cert for mTLS replication to the primary")
+    ap.add_argument("--primary-key-file", default="")
     args = ap.parse_args()
     if args.port and not args.socket and not args.client_ca_file:
         print("WARNING: TCP store without --client-ca-file accepts any "
@@ -53,7 +58,11 @@ def main():
                                 failover_grace=args.failover_grace,
                                 tls_cert_file=args.tls_cert_file,
                                 tls_key_file=args.tls_key_file,
-                                client_ca_file=args.client_ca_file).start()
+                                client_ca_file=args.client_ca_file,
+                                primary_ca_file=args.primary_ca_file,
+                                primary_cert_file=args.primary_cert_file,
+                                primary_key_file=args.primary_key_file,
+                                ).start()
         shown = standby.address if isinstance(standby.address, str) \
             else f"{standby.address[0]}:{standby.address[1]}"
         print(f"ktpu-store STANDBY serving on {shown} "

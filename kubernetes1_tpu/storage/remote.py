@@ -193,12 +193,15 @@ class RemoteStore:
         #  - a fresh-dial refusal means this server is down: fail over
         #    (nothing was sent)
         last_exc: Optional[Exception] = None
-        # enough attempts (with a small sleep once every server has been
-        # tried) to ride out a standby's failover grace window (~1s):
-        # during it the old primary refuses and the standby still answers
-        # NotPrimary — a client that gave up instantly would surface a
-        # spurious 500 for a blip the system is designed to absorb
-        for attempt in range(2 + 6 * len(self._addrs)):
+        # Multi-server: enough attempts (with a small sleep once every
+        # server has been tried) to ride out a standby's failover grace
+        # window (~1s) — during it the old primary refuses and the standby
+        # still answers NotPrimary, and a client that gave up instantly
+        # would surface a spurious 500 for a blip the system is designed
+        # to absorb.  Single-server: failover is impossible, so keep the
+        # old fast-fail (one pooled try + one fresh redial, no sleeps).
+        attempts = 2 if len(self._addrs) == 1 else 2 + 6 * len(self._addrs)
+        for attempt in range(attempts):
             if attempt > len(self._addrs):
                 time.sleep(0.2)
             with self._lock:
@@ -328,7 +331,8 @@ class RemoteStore:
 
     def watch(self, prefix: str, since_rev: int = 0) -> RemoteWatcher:
         last_exc: Optional[Exception] = None
-        for attempt in range(2 + 6 * len(self._addrs)):
+        attempts = 2 if len(self._addrs) == 1 else 2 + 6 * len(self._addrs)
+        for attempt in range(attempts):
             if attempt > len(self._addrs):
                 time.sleep(0.2)  # ride out a failover grace window
             addr = self._addrs[self._active]

@@ -41,9 +41,23 @@ class StandbyServer:
                  failover_grace: float = 1.0,
                  scheme: Optional[Scheme] = None,
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 client_ca_file: str = ""):
+                 client_ca_file: str = "",
+                 primary_ca_file: str = "", primary_cert_file: str = "",
+                 primary_key_file: str = ""):
         self.primary_address = primary_address
         self.failover_grace = failover_grace
+        # a TLS-enabled primary (TCP+mTLS deployment) needs a TLS dial for
+        # the replication stream — a plaintext handshake would just die
+        self._ssl_ctx = None
+        if primary_ca_file:
+            import ssl
+
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self._ssl_ctx.load_verify_locations(cafile=primary_ca_file)
+            if primary_cert_file:
+                self._ssl_ctx.load_cert_chain(
+                    certfile=primary_cert_file,
+                    keyfile=primary_key_file or None)
         self.store = Store(scheme or global_scheme.copy(), wal_path=wal_path)
         self.server = StoreServer(self.store, serve_address,
                                   tls_cert_file=tls_cert_file,
@@ -80,7 +94,7 @@ class StandbyServer:
 
     # ----------------------------------------------------------- replication
 
-    def _dial(self, timeout: float = 5.0):
+    def _dial(self, timeout: float = 5.0, tls: bool = True):
         if isinstance(self.primary_address, str):
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(timeout)
@@ -88,6 +102,11 @@ class StandbyServer:
         else:
             conn = socket.create_connection(tuple(self.primary_address),
                                             timeout=timeout)
+        if tls and self._ssl_ctx is not None:
+            host = self.primary_address if \
+                isinstance(self.primary_address, str) \
+                else self.primary_address[0]
+            conn = self._ssl_ctx.wrap_socket(conn, server_hostname=host)
         return conn
 
     def _run(self):
@@ -161,7 +180,10 @@ class StandbyServer:
         deadline = time.monotonic() + self.failover_grace
         while not self._stop.is_set():
             try:
-                conn = self._dial(timeout=1.0)
+                # liveness probe: a bare connect (no TLS) — an accepting
+                # listener means the primary PROCESS is alive even if the
+                # TLS handshake would need the full dial
+                conn = self._dial(timeout=1.0, tls=False)
                 conn.close()
                 return False
             except (ConnectionRefusedError, FileNotFoundError):
