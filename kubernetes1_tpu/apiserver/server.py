@@ -357,6 +357,26 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 self._proxy_to_apiservice(apisvc, method)
                 return
+            # SelfSubjectAccessReview (ref: pkg/registry/authorization/
+            # selfsubjectaccessreview): any authenticated user may ask what
+            # THEY can do — the answer evaluates the server's own
+            # authorizer chain, which is what `kubectl auth can-i` wraps
+            if (method == "POST" and len(parts) == 4 and parts[0] == "apis"
+                    and parts[1] == "authorization.k8s.io"
+                    and parts[3] == "selfsubjectaccessreviews"):
+                attrs = ((self._read_body().get("spec") or {})
+                         .get("resourceAttributes") or {})
+                allowed = self.master.authorizer.authorize(
+                    user,
+                    attrs.get("verb", "get"), attrs.get("resource", ""),
+                    attrs.get("namespace", ""), attrs.get("name", ""),
+                    sub=attrs.get("subresource", ""))
+                self._send_json(201, {
+                    "kind": "SelfSubjectAccessReview",
+                    "apiVersion": "authorization.k8s.io/v1",
+                    "status": {"allowed": bool(allowed)},
+                })
+                return
             if parts and parts[0] == "metrics":
                 self._serve_metrics()
                 return

@@ -3,8 +3,10 @@ the subset that covers daily driving of the cluster).
 
 Usage: python -m kubernetes1_tpu.cli [--server URL] <command> ...
 
-Commands: get, describe, apply, create, delete, scale, cordon, uncordon,
-drain, top, rollout, logs, wait, api-resources, version, cluster-up.
+Commands: get, describe, apply (3-way), create, delete, scale, cordon,
+uncordon, drain, taint, expose, cp, auth can-i, explain, top, rollout,
+logs, exec, attach, port-forward, patch, label, annotate, edit, wait,
+api-resources, version, cluster-up, init, join.
 """
 
 from __future__ import annotations
@@ -22,8 +24,41 @@ import yaml
 from ..api import types as t
 from ..client import Clientset
 from ..machinery import ApiError, NotFound
-from ..machinery.scheme import global_scheme
+from ..machinery.scheme import _camel, global_scheme
 from . import printers
+
+
+def _shq(s: str) -> str:
+    import shlex
+
+    return shlex.quote(s)
+
+
+def _snake_name(camel: str) -> str:
+    import re
+
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", camel).lower()
+
+
+def _unwrap_type(hint):
+    """List[X] / Dict[_, X] / Optional[X] -> X (for `explain` descent)."""
+    import typing
+
+    origin = typing.get_origin(hint)
+    if origin in (list, dict):
+        args = typing.get_args(hint)
+        return args[-1] if args else None
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        return args[0] if args else None
+    return hint
+
+
+def _type_name(hint) -> str:
+    if hint is None:
+        return "?"
+    return getattr(hint, "__name__", None) or str(hint).replace(
+        "typing.", "")
 
 DEFAULT_SERVER = "http://127.0.0.1:8001"
 
@@ -112,7 +147,34 @@ class CLI:
 
     # ---------------------------------------------------------- apply/create
 
+    LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+    @staticmethod
+    def _three_way_patch(last: dict, new: dict) -> dict:
+        """3-way apply patch (ref: pkg/kubectl/cmd/apply.go:35-38 +
+        last-applied-configuration): re-assert every field the manifest
+        specifies, and DELETE (merge-patch null) every field the previous
+        apply specified that this manifest dropped.  Server-owned fields
+        (status, nodeName, assigned devices) appear in neither manifest
+        and therefore survive — the live object is never clobbered
+        wholesale."""
+        patch: dict = {}
+        for k, vnew in new.items():
+            vlast = last.get(k) if isinstance(last, dict) else None
+            if isinstance(vnew, dict):
+                patch[k] = CLI._three_way_patch(
+                    vlast if isinstance(vlast, dict) else {}, vnew)
+            else:
+                patch[k] = vnew
+        if isinstance(last, dict):
+            for k in last:
+                if k not in new:
+                    patch[k] = None  # dropped from the manifest: remove live
+        return patch
+
     def _apply_one(self, doc: dict, create_only: bool = False):
+        import json as _json
+
         obj = self.scheme.decode(doc)
         plural = self.scheme.resource_of[obj.KIND]
         client = self.cs.resource(plural)
@@ -122,14 +184,36 @@ class CLI:
         try:
             existing = client.get(obj.metadata.name, ns)
         except NotFound:
+            # stamp the applied manifest so the NEXT apply can compute
+            # deletions (kubectl's last-applied-configuration annotation)
+            if not create_only:
+                obj.metadata.annotations = dict(obj.metadata.annotations)
+                obj.metadata.annotations[self.LAST_APPLIED] = \
+                    _json.dumps(doc, sort_keys=True)
             created = client.create(obj)
             print(f"{plural}/{created.metadata.name} created", file=self.out)
             return
         if create_only:
             raise SystemExit(f"error: {plural}/{obj.metadata.name} already exists")
-        # apply = merge patch of the manifest over the live object, so
-        # server-owned fields (nodeName, assigned devices, status) survive
-        updated = client.patch(obj.metadata.name, doc, ns)
+        last = {}
+        raw = existing.metadata.annotations.get(self.LAST_APPLIED, "")
+        if raw:
+            try:
+                last = _json.loads(raw)
+            except ValueError:
+                last = {}
+        patch = self._three_way_patch(last, doc)
+        meta = patch.setdefault("metadata", {})
+        ann = meta.get("annotations")
+        if not isinstance(ann, dict):
+            # the manifest dropped annotations wholesale: null each
+            # previously-applied key individually — a bare null would
+            # collide with the stamp we are about to add
+            prev = (last.get("metadata") or {}).get("annotations") or {}
+            ann = {k: None for k in prev}
+            meta["annotations"] = ann
+        ann[self.LAST_APPLIED] = _json.dumps(doc, sort_keys=True)
+        updated = client.patch(obj.metadata.name, patch, ns)
         print(f"{plural}/{updated.metadata.name} configured", file=self.out)
 
     def apply(self, args):
@@ -235,6 +319,223 @@ class CLI:
             )
             raise SystemExit(1)
         print(f"node/{args.node} drained", file=self.out)
+
+    def taint(self, args):
+        """`ktpu taint [nodes] <node> key=value:Effect ... key:Effect-`
+        (ref: kubectl taint + node spec.taints; the toleration admission
+        and scheduler predicates consume these)."""
+        targets = list(args.targets)
+        if targets and targets[0] in ("nodes", "node", "no"):
+            targets = targets[1:]
+        if len(targets) < 2:
+            raise SystemExit("error: taint needs <node> and >=1 taint spec")
+        args.node, args.taints = targets[0], targets[1:]
+        node = self.cs.nodes.get(args.node, "")
+        taints = list(node.spec.taints)
+        changed = []
+        for spec in args.taints:
+            if spec.endswith("-"):
+                spec = spec[:-1]
+                key, _, effect = spec.partition(":")
+                key = key.split("=", 1)[0]
+                before = len(taints)
+                taints = [tn for tn in taints
+                          if not (tn.key == key
+                                  and (not effect or tn.effect == effect))]
+                if len(taints) == before:
+                    raise SystemExit(
+                        f"error: taint {key!r} not found on node {args.node}")
+                changed.append(f"{key} removed")
+                continue
+            kv, _, effect = spec.rpartition(":")
+            if not effect or effect not in (
+                    "NoSchedule", "PreferNoSchedule", "NoExecute"):
+                raise SystemExit(
+                    f"error: taint {spec!r} needs key[=value]:Effect with "
+                    f"Effect one of NoSchedule|PreferNoSchedule|NoExecute")
+            key, _, value = kv.partition("=")
+            existing = next((tn for tn in taints
+                             if tn.key == key and tn.effect == effect), None)
+            if existing is not None:
+                if not getattr(args, "overwrite", False):
+                    raise SystemExit(
+                        f"error: node {args.node} already has taint "
+                        f"{key}:{effect}; use --overwrite")
+                existing.value = value
+            else:
+                taints.append(t.Taint(key=key, value=value, effect=effect))
+            changed.append(f"{key}:{effect}")
+        self.cs.nodes.patch(
+            args.node,
+            {"spec": {"taints": [
+                {"key": tn.key, "value": tn.value, "effect": tn.effect}
+                for tn in taints]}}, "")
+        print(f"node/{args.node} tainted ({', '.join(changed)})",
+              file=self.out)
+
+    # ---------------------------------------------------------------- expose
+
+    def expose(self, args):
+        """`ktpu expose <resource> <name> --port N` — create a Service
+        selecting the workload's pods (ref: kubectl expose)."""
+        plural, name = split_target(
+            [args.resource] + ([args.name] if args.name else []))
+        if not name:
+            raise SystemExit("error: expose needs <resource> <name>")
+        obj = self.cs.resource(plural).get(name, self.ns)
+        if plural in ("deployments", "replicasets", "statefulsets",
+                      "daemonsets", "jobs"):
+            selector = dict(obj.spec.selector.match_labels or {}) \
+                if obj.spec.selector else {}
+            if not selector:
+                selector = dict(
+                    obj.spec.template.metadata.labels or {})
+        elif plural == "pods":
+            selector = dict(obj.metadata.labels or {})
+        elif plural == "services":
+            selector = dict(obj.spec.selector or {})
+        else:
+            raise SystemExit(f"error: cannot expose {plural}")
+        if not selector:
+            raise SystemExit(
+                f"error: {plural}/{name} has no labels/selector to select by")
+        svc = t.Service()
+        svc.metadata.name = args.name_out or name
+        svc.metadata.namespace = self.ns
+        svc.spec.selector = selector
+        svc.spec.type = args.type
+        svc.spec.ports = [t.ServicePort(
+            port=args.port,
+            target_port=args.target_port or args.port,
+            protocol=args.protocol)]
+        created = self.cs.services.create(svc, self.ns)
+        print(f"service/{created.metadata.name} exposed "
+              f"(port {args.port} -> {args.target_port or args.port}, "
+              f"selector {selector})", file=self.out)
+
+    # -------------------------------------------------------------------- cp
+
+    def cp(self, args):
+        """`ktpu cp <pod>:<path> <local>` / `ktpu cp <local> <pod>:<path>`
+        — file copy THROUGH the exec stream (ref: kubectl cp, which runs
+        tar over exec; a single file needs only cat)."""
+        src, dst = args.src, args.dst
+
+        def parse(spec):
+            if ":" in spec and "/" != spec[0]:
+                pod, _, path = spec.partition(":")
+                return pod, path
+            return None, spec
+
+        src_pod, src_path = parse(src)
+        dst_pod, dst_path = parse(dst)
+        if (src_pod is None) == (dst_pod is None):
+            raise SystemExit(
+                "error: exactly one of src/dst must be pod:path")
+        if src_pod is not None:
+            # pod -> local: cat the remote file, stream stdout to a TEMP
+            # file — a failed copy must leave any pre-existing destination
+            # untouched (no truncate-then-delete of the user's file)
+            tmp = dst_path + ".ktpu-cp-tmp"
+            sock = self._exec_sock(
+                src_pod, ["sh", "-c", f"cat {_shq(src_path)}"],
+                container=args.container)
+            try:
+                with open(tmp, "wb") as out:
+                    code = self._pump_stream(sock, out_stream=out)
+                if code:
+                    raise SystemExit(code)
+                os.replace(tmp, dst_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            print(f"{src} -> {dst_path}", file=self.out)
+        else:
+            # local -> pod: stream the file into `cat > path` via stdin
+            with open(src_path, "rb") as f:
+                sock = self._exec_sock(
+                    dst_pod, ["sh", "-c", f"cat > {_shq(dst_path)}"],
+                    container=args.container, stdin=True)
+                code = self._pump_stream(sock, stdin=True, stdin_stream=f)
+            if code:
+                raise SystemExit(code)
+            print(f"{src_path} -> {dst}", file=self.out)
+
+    # ------------------------------------------------------------------ auth
+
+    def auth(self, args):
+        """`ktpu auth can-i <verb> <resource> [<name>]` — wraps
+        SelfSubjectAccessReview (ref: kubectl auth can-i)."""
+        if args.subcmd != "can-i":
+            raise SystemExit(f"error: unknown auth subcommand {args.subcmd}")
+        # resolve aliases/singulars to the registered plural (RBAC rules
+        # name plurals), and send a namespace only for namespaced
+        # resources — the real request for a cluster-scoped resource is
+        # authorized with ns="" and the answer must match it
+        plural = resolve_resource(args.resource)
+        namespaced = self.scheme.namespaced.get(plural, True)
+        body = {
+            "kind": "SelfSubjectAccessReview",
+            "apiVersion": "authorization.k8s.io/v1",
+            "spec": {"resourceAttributes": {
+                "verb": args.verb,
+                "resource": plural,
+                "namespace": self.ns if namespaced else "",
+                "name": args.name or "",
+            }},
+        }
+        resp = self.cs.api.request(
+            "POST", "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+            body=body)
+        allowed = bool((resp.get("status") or {}).get("allowed"))
+        print("yes" if allowed else "no", file=self.out)
+        if not allowed:
+            raise SystemExit(1)
+
+    # --------------------------------------------------------------- explain
+
+    def explain(self, args):
+        """`ktpu explain <resource>[.path.to.field]` — field documentation
+        straight from the API types (ref: kubectl explain / OpenAPI)."""
+        import dataclasses
+        import typing
+
+        dotted = args.resource.split(".")
+        plural, rest = dotted[0], dotted[1:]
+        plural_l = ALIASES.get(plural.lower(), plural.lower())
+        cls = None
+        for k, c in self.scheme.by_kind.items():
+            if self.scheme.resource_of.get(k, "").lower() == plural_l \
+                    or k.lower() == plural_l:
+                cls = c
+                break
+        if cls is None:
+            raise SystemExit(f"error: unknown resource {plural!r}")
+        path = [getattr(cls, "KIND", cls.__name__)]
+        for seg in rest:
+            hints = typing.get_type_hints(cls)
+            fname = _snake_name(seg)
+            if fname not in hints:
+                raise SystemExit(
+                    f"error: field {seg!r} not in {cls.__name__}")
+            nxt = _unwrap_type(hints[fname])
+            path.append(seg)
+            if nxt is None or not dataclasses.is_dataclass(nxt):
+                print(f"FIELD: {'.'.join(path)} "
+                      f"<{_type_name(hints[fname])}>", file=self.out)
+                return
+            cls = nxt
+        print(f"KIND:     {path[0]}", file=self.out)
+        if len(path) > 1:
+            print(f"FIELD:    {'.'.join(path[1:])} <{cls.__name__}>",
+                  file=self.out)
+        if cls.__doc__:
+            print(f"\nDESCRIPTION:\n  {cls.__doc__.strip()}", file=self.out)
+        print("\nFIELDS:", file=self.out)
+        hints = typing.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            print(f"  {_camel(f.name)} \t"
+                  f"<{_type_name(hints.get(f.name))}>", file=self.out)
 
     # ------------------------------------------------------------------ top
 
@@ -492,38 +793,51 @@ class CLI:
         token = getattr(self.cs.api, "token", "")
         return {"Authorization": f"Bearer {token}"} if token else {}
 
-    def exec_(self, args):
-        """Streaming exec via the apiserver pods/exec subresource —
-        bidirectional, interactive with -i/-t (ref: kubectl exec +
-        client-go/tools/remotecommand)."""
+    def _exec_sock(self, pod_name: str, command, container: str = "",
+                   stdin: bool = False, tty: bool = False):
+        """Dial the pods/exec subresource and return the upgraded stream
+        socket (the one transport exec_ and cp share)."""
         from urllib.parse import urlencode, urlparse
 
         from ..utils import streams
 
-        pod = self.cs.pods.get(args.pod, self.ns)
+        pod = self.cs.pods.get(pod_name, self.ns)
         if not pod.spec.node_name:
             raise SystemExit("error: pod not scheduled yet")
-        tty = bool(getattr(args, "tty", False))
-        stdin = bool(getattr(args, "stdin", False))
-        params = [("container", args.container or pod.spec.containers[0].name)]
-        params += [("command", c) for c in args.command]
+        params = [("container", container or pod.spec.containers[0].name)]
+        params += [("command", c) for c in command]
         if tty:
             params.append(("tty", "1"))
         if stdin:
             params.append(("stdin", "1"))
         base = urlparse(self.cs.api.url)
-        sock = streams.upgrade_request(
+        return streams.upgrade_request(
             base.hostname, base.port,
-            f"/api/v1/namespaces/{self.ns}/pods/{args.pod}/exec?{urlencode(params)}",
+            f"/api/v1/namespaces/{self.ns}/pods/{pod_name}/exec?"
+            f"{urlencode(params)}",
             self._stream_headers(),
             ssl_context=self.cs.api.ssl_context,
         )
+
+    def exec_(self, args):
+        """Streaming exec via the apiserver pods/exec subresource —
+        bidirectional, interactive with -i/-t (ref: kubectl exec +
+        client-go/tools/remotecommand)."""
+        tty = bool(getattr(args, "tty", False))
+        stdin = bool(getattr(args, "stdin", False))
+        sock = self._exec_sock(args.pod, args.command,
+                               container=args.container,
+                               stdin=stdin, tty=tty)
         code = self._pump_stream(sock, tty=tty, stdin=stdin,
                                  stdin_stream=getattr(args, "stdin_stream", None))
         if code:
             raise SystemExit(code)
 
-    def _pump_stream(self, sock, tty=False, stdin=False, stdin_stream=None) -> int:
+    def _pump_stream(self, sock, tty=False, stdin=False, stdin_stream=None,
+                     out_stream=None) -> int:
+        """Frame pump for an exec/attach stream.  out_stream=None renders
+        text to self.out (interactive exec); a binary out_stream receives
+        raw STDOUT payloads (cp's transport) with STDERR still rendered."""
         import json as _json
         import threading
 
@@ -548,7 +862,12 @@ class CLI:
             def feed():
                 try:
                     while True:
-                        data = src.read(1) if tty else src.readline()
+                        if tty:
+                            data = src.read(1)
+                        elif hasattr(src, "read1"):
+                            data = src.read1(64 * 1024)
+                        else:
+                            data = src.readline()
                         if not data:
                             write_frame(sock, STDIN, b"")  # EOF
                             break
@@ -565,7 +884,9 @@ class CLI:
                 if frame is None:
                     break
                 ch, payload = frame
-                if ch in (STDOUT, STDERR):
+                if ch == STDOUT and out_stream is not None:
+                    out_stream.write(payload)
+                elif ch in (STDOUT, STDERR):
                     self.out.write(payload.decode(errors="replace"))
                     try:
                         self.out.flush()
@@ -736,6 +1057,37 @@ def build_parser() -> argparse.ArgumentParser:
             c.add_argument("--timeout", type=int, default=60,
                            help="seconds to keep retrying PDB-blocked evictions")
 
+    tn = sub.add_parser("taint")
+    tn.add_argument("targets", nargs="+",
+                    help="[nodes] <node> key=value:Effect... "
+                         "(key[:Effect]- removes)")
+    tn.add_argument("--overwrite", action="store_true")
+
+    ex = sub.add_parser("expose")
+    ex.add_argument("resource")
+    ex.add_argument("name", nargs="?", default="")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int, default=0)
+    ex.add_argument("--protocol", default="TCP")
+    ex.add_argument("--type", default="ClusterIP",
+                    choices=["ClusterIP", "NodePort"])
+    ex.add_argument("--name", dest="name_out", default="",
+                    help="service name (defaults to the workload's)")
+
+    cp = sub.add_parser("cp")
+    cp.add_argument("src", help="pod:path or local path")
+    cp.add_argument("dst", help="pod:path or local path")
+    cp.add_argument("-c", "--container", default="")
+
+    au = sub.add_parser("auth")
+    au.add_argument("subcmd", choices=["can-i"])
+    au.add_argument("verb")
+    au.add_argument("resource")
+    au.add_argument("name", nargs="?", default="")
+
+    xp = sub.add_parser("explain")
+    xp.add_argument("resource", help="resource[.field.path]")
+
     tp = sub.add_parser("top")
     tp.add_argument("what", choices=["nodes", "pods"])
 
@@ -882,6 +1234,8 @@ def dispatch(cli: CLI, args) -> None:
         "exec": cli.exec_, "port-forward": cli.port_forward,
         "wait": cli.wait, "api-resources": cli.api_resources,
         "patch": cli.patch, "label": cli.label, "annotate": cli.annotate,
-        "edit": cli.edit, "attach": cli.attach,
+        "edit": cli.edit, "attach": cli.attach, "taint": cli.taint,
+        "expose": cli.expose, "cp": cli.cp, "auth": cli.auth,
+        "explain": cli.explain,
     }[args.cmd]
     handler(args)

@@ -325,3 +325,30 @@ class TestSecurity:
             admin.close()
         finally:
             master.stop()
+
+
+class TestCp:
+    def test_cp_both_directions(self, env, tmp_path):
+        """`ktpu cp` rides the exec stream (ref kubectl cp over SPDY exec):
+        local -> pod writes through `cat > path`, pod -> local reads
+        `cat path` — binary-safe both ways."""
+        run_pod(env["cs"], "cp-pod", "import time; time.sleep(60)")
+        payload = bytes(range(256)) * 64  # binary: every byte value
+        src = tmp_path / "in.bin"
+        src.write_bytes(payload)
+        cli = cli_for(env["master"])
+        try:
+            remote = str(tmp_path / "remote.bin")  # host-process runtime:
+            # the pod's fs IS the host fs, so any absolute path works
+            cli.cp(type("A", (), {
+                "src": str(src), "dst": f"cp-pod:{remote}",
+                "container": "",
+            })())
+            back = tmp_path / "back.bin"
+            cli.cp(type("A", (), {
+                "src": f"cp-pod:{remote}", "dst": str(back),
+                "container": "",
+            })())
+            assert back.read_bytes() == payload
+        finally:
+            cli.cs.close()
