@@ -36,13 +36,32 @@ def _rand_suffix(n=5):
     return "".join(random.choice(_NAME_SUFFIX_ALPHABET) for _ in range(n))
 
 
-def field_get(obj_dict: Dict[str, Any], dotted: str) -> Any:
+# The scheme elides default-valued fields from the wire form, so a field
+# selector evaluated against the encoded dict would MISS objects in their
+# default state — `status.phase=Pending` must match a pod whose phase was
+# never written (the default IS Pending).  Defaults are PER RESOURCE
+# (Namespace defaults to Active, PV to Available), mirroring upstream's
+# per-resource fieldSelectorConversions; the selectable-field whitelist is
+# tiny, so enumerating them beats decoding every object per match.
+_FIELD_DEFAULTS = {
+    ("pods", "status.phase"): "Pending",
+    ("persistentvolumeclaims", "status.phase"): "Pending",
+    ("namespaces", "status.phase"): "Active",
+    ("persistentvolumes", "status.phase"): "Available",
+}
+
+
+def field_get(obj_dict: Dict[str, Any], dotted: str,
+              resource: str = "") -> Any:
     cur: Any = obj_dict
     for part in dotted.split("."):
         if not isinstance(cur, dict):
-            return None
+            cur = None
+            break
         cur = cur.get(part)
-    return "" if cur is None else cur
+    if cur is None:
+        return _FIELD_DEFAULTS.get((resource, dotted), "")
+    return cur
 
 
 def parse_field_selector(s: str) -> List[Tuple[str, str, str]]:
@@ -60,9 +79,9 @@ def parse_field_selector(s: str) -> List[Tuple[str, str, str]]:
     return out
 
 
-def field_selector_matches(reqs, obj_dict) -> bool:
+def field_selector_matches(reqs, obj_dict, resource: str = "") -> bool:
     for path, op, val in reqs:
-        have = str(field_get(obj_dict, path))
+        have = str(field_get(obj_dict, path, resource))
         if op == "=" and have != val:
             return False
         if op == "!=" and have == val:
@@ -665,7 +684,9 @@ class Registry:
         if field_selector:
             freqs = parse_field_selector(field_selector)
             items = [
-                o for o in items if field_selector_matches(freqs, self.scheme.encode(o))
+                o for o in items
+                if field_selector_matches(freqs, self.scheme.encode(o),
+                                          resource)
             ]
         return items, rev
 
@@ -686,7 +707,8 @@ class Registry:
                 lreqs, (obj_dict.get("metadata") or {}).get("labels") or {}
             ):
                 return False
-            if freqs is not None and not field_selector_matches(freqs, obj_dict):
+            if freqs is not None and not field_selector_matches(
+                    freqs, obj_dict, resource):
                 return False
             return True
 

@@ -23,6 +23,9 @@ from .base import Controller
 NAMESPACED_RESOURCES = (
     "pods", "jobs", "cronjobs", "replicasets", "deployments", "daemonsets",
     "statefulsets", "services", "endpoints", "configmaps", "events", "leases",
+    "secrets", "serviceaccounts", "persistentvolumeclaims",
+    "resourcequotas", "limitranges", "horizontalpodautoscalers",
+    "poddisruptionbudgets", "podpresets", "roles", "rolebindings",
 )
 
 
@@ -61,9 +64,16 @@ class NamespaceController(Controller):
             self.enqueue_after(key, 0.5)
 
 
-OWNED_RESOURCES = ("pods", "replicasets", "jobs")
+# The reference's GC is fully KIND-GENERIC (graph built from every
+# resource's ownerReferences, pkg/controller/garbagecollector); this
+# covers the kinds that participate in ownership in practice — the
+# controller-owned chain plus the config/service kinds users hang off
+# their workloads (a ConfigMap owned by its Job dies with the Job).
+OWNED_RESOURCES = ("pods", "replicasets", "jobs", "configmaps", "secrets",
+                   "services", "persistentvolumeclaims")
 OWNER_RESOURCES = ("jobs", "replicasets", "deployments", "daemonsets",
-                   "statefulsets", "cronjobs")
+                   "statefulsets", "cronjobs", "pods", "configmaps",
+                   "services", "secrets")
 
 
 class GarbageCollector(Controller):
@@ -76,27 +86,58 @@ class GarbageCollector(Controller):
         "DaemonSet": "daemonsets",
         "StatefulSet": "statefulsets",
         "CronJob": "cronjobs",
+        "Pod": "pods",
+        "ConfigMap": "configmaps",
+        "Service": "services",
+        "Secret": "secrets",
     }
 
     def setup(self):
+        import threading
+
         self.informers: Dict[str, object] = {}
+        # owner uid -> owned "<resource>|<key>"s: an owner's deletion
+        # enqueues exactly its dependents (the reference's GC builds the
+        # same dependency graph, pkg/controller/garbagecollector/graph.go)
+        # — a full-cluster rescan per delete would be O(deletes x objects)
+        # at 30k-pod density
+        self._by_owner: Dict[str, set] = {}
+        self._owner_lock = threading.Lock()
         for resource in set(OWNED_RESOURCES + OWNER_RESOURCES):
             self.informers[resource] = self.factory.informer(resource)
         for resource in OWNED_RESOURCES:
             inf = self.informers[resource]
             inf.add_handler(
-                on_add=lambda o, r=resource: self.queue.add(f"{r}|{o.key()}")
+                on_add=lambda o, r=resource: self._owned_added(r, o),
+                on_delete=lambda o, r=resource: self._owned_removed(r, o),
             )
-        # owner deletions re-scan owned kinds
         for owner in OWNER_RESOURCES:
             self.informers[owner].add_handler(
-                on_delete=lambda o: self._rescan()
+                on_delete=self._owner_deleted
             )
 
-    def _rescan(self):
-        for resource in OWNED_RESOURCES:
-            for obj in self.informers[resource].list():
-                self.queue.add(f"{resource}|{obj.key()}")
+    def _owned_added(self, resource: str, obj):
+        key = f"{resource}|{obj.key()}"
+        with self._owner_lock:
+            for ref in obj.metadata.owner_references:
+                self._by_owner.setdefault(ref.uid, set()).add(key)
+        self.queue.add(key)
+
+    def _owned_removed(self, resource: str, obj):
+        key = f"{resource}|{obj.key()}"
+        with self._owner_lock:
+            for ref in obj.metadata.owner_references:
+                deps = self._by_owner.get(ref.uid)
+                if deps is not None:
+                    deps.discard(key)
+                    if not deps:
+                        del self._by_owner[ref.uid]
+
+    def _owner_deleted(self, obj):
+        with self._owner_lock:
+            deps = self._by_owner.pop(obj.metadata.uid, ())
+        for key in deps:
+            self.queue.add(key)
 
     def sync(self, key: str):
         resource, obj_key = key.split("|", 1)

@@ -1,0 +1,251 @@
+"""Chaos tier (ref: test/e2e/chaosmonkey/chaosmonkey.go + the upgrade
+suite's disruption model): random component SIGKILL mid-workload, with
+respawn, asserting the cluster CONVERGES — the Job completes, the
+Deployment reaches spec, no acknowledged write is lost.
+
+The kill set is every restartable control-plane component (apiservers,
+KCM, scheduler, kubelets) plus ONE primary-store kill (the warm standby
+promotes; the promoted store is then the cluster's L0 and is not killed
+again — the two-member replication design's contract, storage/standby.py).
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(cmd, log):
+    with open(log, "ab") as lf:
+        return subprocess.Popen(
+            cmd, stdout=lf, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            cwd=REPO)
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ChaosCluster:
+    """Process cluster whose components can be killed and respawned by
+    name — the chaosmonkey's substrate."""
+
+    def __init__(self, d: str):
+        self.d = d
+        self.procs: dict = {}
+        self.cmds: dict = {}
+        psock = os.path.join(d, "p.sock")
+        ssock = os.path.join(d, "s.sock")
+        self.psock, self.ssock = psock, ssock
+        pa, pb = _free_port(), _free_port()
+        self.servers = f"http://127.0.0.1:{pa},http://127.0.0.1:{pb}"
+        py = sys.executable
+        stores = f"{psock},{ssock}"
+        self.cmds = {
+            "store-primary": [py, "-m", "kubernetes1_tpu.storage",
+                              "--socket", psock,
+                              "--wal", os.path.join(d, "p.wal")],
+            "store-standby": [py, "-m", "kubernetes1_tpu.storage",
+                              "--socket", ssock,
+                              "--wal", os.path.join(d, "s.wal"),
+                              "--standby-of", psock,
+                              "--failover-grace", "0.5"],
+            "api-a": [py, "-m", "kubernetes1_tpu.apiserver",
+                      "--port", str(pa), "--store-address", stores],
+            "api-b": [py, "-m", "kubernetes1_tpu.apiserver",
+                      "--port", str(pb), "--store-address", stores],
+            "kcm": [py, "-m", "kubernetes1_tpu.controllers",
+                    "--server", self.servers],
+            "sched": [py, "-m", "kubernetes1_tpu.scheduler",
+                      "--server", self.servers, "--metrics-port", "-1"],
+            "kubelet-0": [py, "-m", "kubernetes1_tpu.kubelet",
+                          "--server", self.servers,
+                          "--node-name", "chaos-0", "--runtime", "fake",
+                          "--root-dir", os.path.join(d, "kl0")],
+            "kubelet-1": [py, "-m", "kubernetes1_tpu.kubelet",
+                          "--server", self.servers,
+                          "--node-name", "chaos-1", "--runtime", "fake",
+                          "--root-dir", os.path.join(d, "kl1")],
+        }
+
+    def spawn(self, name: str):
+        self.procs[name] = _spawn(
+            self.cmds[name], os.path.join(self.d, f"{name}.log"))
+
+    def kill(self, name: str):
+        p = self.procs.get(name)
+        if p is None:
+            return
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            p.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def reap_all(self):
+        for name in list(self.procs):
+            self.kill(name)
+
+
+@pytest.fixture()
+def chaos(tmp_path, request):
+    c = ChaosCluster(str(tmp_path))
+    request.addfinalizer(c.reap_all)  # registered BEFORE any spawn
+    c.spawn("store-primary")
+    must_poll_until(lambda: os.path.exists(c.psock), timeout=20.0,
+                    desc="primary store up")
+    for name in ("store-standby", "api-a", "api-b"):
+        c.spawn(name)
+    cs = Clientset(c.servers)
+    request.addfinalizer(cs.close)
+
+    def healthy():
+        try:
+            cs.api.request("GET", "/healthz")
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    must_poll_until(healthy, timeout=60.0, desc="apiserver healthy")
+    for name in ("kcm", "sched", "kubelet-0", "kubelet-1"):
+        c.spawn(name)
+    must_poll_until(
+        lambda: sum(1 for n in cs.nodes.list()[0]
+                    for cond in n.status.conditions
+                    if cond.type == "Ready" and cond.status == "True") >= 2,
+        timeout=60.0, desc="both nodes Ready")
+    return c, cs
+
+
+KILLABLE = ["api-a", "api-b", "kcm", "sched", "kubelet-0", "kubelet-1"]
+
+
+class TestChaosMonkey:
+    def test_random_component_kills_converge(self, chaos):
+        c, cs = chaos
+        rng = random.Random(1729)  # deterministic chaos: replayable CI
+
+        # --- workloads under test
+        dep = t.Deployment()
+        dep.metadata.name = "steady-web"
+        dep.spec.replicas = 3
+        dep.spec.selector = t.LabelSelector(match_labels={"app": "web"})
+        tmpl = t.PodTemplateSpec()
+        tmpl.metadata.labels = {"app": "web"}
+        tmpl.spec.containers = [t.Container(
+            name="c", image="img", command=["sleep", "3600"])]
+        dep.spec.template = tmpl
+        cs.deployments.create(dep, "default")
+
+        job = t.Job()
+        job.metadata.name = "chaos-job"
+        job.spec.completions = 6
+        job.spec.parallelism = 2
+        jt = t.PodTemplateSpec()
+        jt.spec.restart_policy = "Never"
+        jt.spec.containers = [t.Container(
+            name="w", image="img", command=["sleep", "2"])]
+        job.spec.template = jt
+        cs.jobs.create(job, "default")
+
+        # --- steady writer: every acknowledged write must survive
+        acked = []
+        stop_writer = threading.Event()
+
+        def writer():
+            from kubernetes1_tpu.machinery import AlreadyExists
+
+            i = 0
+            while not stop_writer.is_set():
+                cm = t.ConfigMap(data={"i": str(i)})
+                cm.metadata.name = f"chaos-w-{i}"
+                try:
+                    cs.configmaps.create(cm, "default")
+                except AlreadyExists:
+                    # a kill landed between commit and response on a prior
+                    # attempt: the write IS durable — count it and move on
+                    acked.append(cm.metadata.name)
+                    i += 1
+                except Exception:  # noqa: BLE001 — mid-kill blips: retry
+                    pass
+                else:
+                    acked.append(cm.metadata.name)
+                    i += 1
+                time.sleep(0.1)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+        # --- the monkey: 8 kill/respawn cycles + one primary-store kill
+        kills = []
+        store_killed = False
+        for cycle in range(8):
+            name = rng.choice(KILLABLE)
+            c.kill(name)
+            kills.append(name)
+            time.sleep(1.0)
+            c.spawn(name)
+            time.sleep(1.5)
+            if cycle == 3 and not store_killed:
+                c.kill("store-primary")  # standby promotes; not respawned
+                kills.append("store-primary")
+                store_killed = True
+                time.sleep(2.0)
+        stop_writer.set()
+        wt.join(timeout=5)
+
+        # --- convergence: the Job completes...
+        must_poll_until(
+            lambda: _succeeded(cs, "chaos-job") >= 6,
+            timeout=240.0,
+            desc=f"job completes despite kills {kills}")
+        # ...the Deployment is back at spec with all pods running...
+        must_poll_until(
+            lambda: _running_web_pods(cs) >= 3,
+            timeout=240.0, desc="deployment converges to 3 running")
+        # ...and every acknowledged write survived the chaos (incl. the
+        # store failover)
+        live = {cm.metadata.name
+                for cm in cs.configmaps.list(namespace="default")[0]}
+        lost = [n for n in acked if n not in live]
+        assert not lost, f"acknowledged writes lost: {lost} (kills={kills})"
+        assert len(acked) > 10, "writer barely ran; chaos window too short"
+
+
+def _succeeded(cs, name):
+    try:
+        return cs.jobs.get(name, "default").status.succeeded or 0
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def _running_web_pods(cs):
+    try:
+        pods, _ = cs.pods.list(namespace="default",
+                               label_selector="app=web")
+        return sum(1 for p in pods
+                   if p.status.phase == t.POD_RUNNING
+                   and not p.metadata.deletion_timestamp)
+    except Exception:  # noqa: BLE001
+        return 0

@@ -213,3 +213,249 @@ class TestAuthContract:
             user.close()
             admin.close()
             master.stop()
+
+
+class TestObjectMetaContract:
+    def test_generate_name_yields_unique_names(self, cluster):
+        _, cs = cluster
+        names = set()
+        for _ in range(5):
+            p = mk_pod("")
+            p.metadata.generate_name = "gen-"
+            created = cs.pods.create(p)
+            assert created.metadata.name.startswith("gen-")
+            names.add(created.metadata.name)
+        assert len(names) == 5
+
+    def test_resource_version_monotonic_across_kinds(self, cluster):
+        _, cs = cluster
+        a = cs.configmaps.create(_cm("rv-a"))
+        b = cs.secrets.create(_sec("rv-b"))
+        assert int(b.metadata.resource_version) > \
+            int(a.metadata.resource_version)
+
+    def test_labels_annotations_roundtrip(self, cluster):
+        _, cs = cluster
+        cm = _cm("meta-rt")
+        cm.metadata.labels = {"a/b": "c", "x": ""}
+        cm.metadata.annotations = {"long": "v" * 4096}
+        got = cs.configmaps.create(cm)
+        assert got.metadata.labels == {"a/b": "c", "x": ""}
+        assert got.metadata.annotations["long"] == "v" * 4096
+
+    def test_error_shape_is_status_object(self, cluster):
+        master, _ = cluster
+        import urllib.error
+
+        try:
+            urllib.request.urlopen(
+                master.url + "/api/v1/namespaces/default/pods/nope-404")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+            assert e.code == 404
+            assert body.get("kind") == "Status"
+            assert body.get("code") == 404
+            assert body.get("reason") == "NotFound"
+
+
+class TestFieldSelectorContract:
+    def test_field_selector_phase_and_nodename(self, cluster):
+        _, cs = cluster
+        p = cs.pods.create(mk_pod("fsel-1"))
+        pods, _ = cs.pods.list(namespace="default",
+                               field_selector="status.phase=Pending")
+        assert any(x.metadata.name == "fsel-1" for x in pods)
+        pods, _ = cs.pods.list(namespace="default",
+                               field_selector="spec.nodeName=nowhere")
+        assert not any(x.metadata.name == "fsel-1" for x in pods)
+
+
+class TestPatchContract:
+    def test_merge_patch_sets_and_null_deletes(self, cluster):
+        _, cs = cluster
+        cs.configmaps.create(_cm("patchy", data={"keep": "1", "drop": "2"}))
+        cs.configmaps.patch("patchy",
+                            {"data": {"drop": None, "new": "3"}}, "default")
+        got = cs.configmaps.get("patchy", "default")
+        assert got.data == {"keep": "1", "new": "3"}
+
+    def test_patch_cannot_change_immutable_node_name(self, cluster):
+        _, cs = cluster
+        from kubernetes1_tpu.machinery import Forbidden
+
+        p = cs.pods.create(mk_pod("immut-1"))
+        binding = t.Binding(target_node="n-1")
+        binding.metadata.name = "immut-1"
+        cs.bind("default", "immut-1", binding)
+        with pytest.raises(Forbidden):
+            cs.pods.patch("immut-1", {"spec": {"nodeName": "n-2"}},
+                          "default")
+
+
+class TestServiceContract:
+    def test_cluster_ip_allocated_and_stable(self, cluster):
+        _, cs = cluster
+        svc = t.Service()
+        svc.metadata.name = "conf-svc"
+        svc.spec.selector = {"app": "x"}
+        svc.spec.ports = [t.ServicePort(port=80)]
+        created = cs.services.create(svc, "default")
+        assert created.spec.cluster_ip.startswith("10.96.")
+        # updates must not re-allocate the IP
+        created.metadata.labels = {"touched": "yes"}
+        updated = cs.services.update(created)
+        assert updated.spec.cluster_ip == created.spec.cluster_ip
+
+    def test_headless_service_keeps_none(self, cluster):
+        _, cs = cluster
+        svc = t.Service()
+        svc.metadata.name = "conf-headless"
+        svc.spec.cluster_ip = "None"
+        svc.spec.selector = {"app": "y"}
+        svc.spec.ports = [t.ServicePort(port=80)]
+        created = cs.services.create(svc, "default")
+        assert created.spec.cluster_ip == "None"
+
+    def test_nodeport_allocated_in_range(self, cluster):
+        _, cs = cluster
+        svc = t.Service()
+        svc.metadata.name = "conf-np"
+        svc.spec.type = "NodePort"
+        svc.spec.selector = {"app": "z"}
+        svc.spec.ports = [t.ServicePort(port=80)]
+        created = cs.services.create(svc, "default")
+        assert 30000 <= created.spec.ports[0].node_port <= 32767
+
+
+class TestCRDContract:
+    def test_crd_registration_and_custom_resource_crud(self, cluster):
+        _, cs = cluster
+        crd = t.CustomResourceDefinition()
+        crd.metadata.name = "trainjobs.ml.ktpu.io"
+        crd.spec.group = "ml.ktpu.io"
+        crd.spec.version = "v1"
+        crd.spec.names = t.CRDNames(kind="TrainJob", plural="trainjobs")
+        crd.spec.scope = "Namespaced"
+        cs.resource("customresourcedefinitions").create(crd, "")
+        tj = {"apiVersion": "ml.ktpu.io/v1", "kind": "TrainJob",
+              "metadata": {"name": "t1", "namespace": "default"},
+              "spec": {"chips": 8}}
+        created = cs.api.request(
+            "POST", "/apis/ml.ktpu.io/v1/namespaces/default/trainjobs",
+            body=tj)
+        assert created["metadata"]["uid"]
+        got = cs.api.request(
+            "GET", "/apis/ml.ktpu.io/v1/namespaces/default/trainjobs/t1")
+        assert got["spec"]["chips"] == 8
+        cs.api.request(
+            "DELETE", "/apis/ml.ktpu.io/v1/namespaces/default/trainjobs/t1")
+
+
+def _cm(name, data=None):
+    cm = t.ConfigMap(data=data or {"k": "v"})
+    cm.metadata.name = name
+    return cm
+
+
+def _sec(name):
+    s = t.Secret(data={"k": "v"})
+    s.metadata.name = name
+    return s
+
+
+class TestControllerConformance:
+    """Contracts that need the controller manager (namespace lifecycle,
+    ServiceAccount defaulting, ownerRef cascade — ref conformance's
+    'Guaranteed' controller behaviors)."""
+
+    @pytest.fixture(scope="class")
+    def kcm_cluster(self):
+        from kubernetes1_tpu.controllers import ControllerManager
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        cm = ControllerManager(cs)
+        cm.start()
+        yield master, cs
+        cm.stop()
+        cs.close()
+        master.stop()
+
+    def test_new_namespace_gets_default_serviceaccount(self, kcm_cluster):
+        _, cs = kcm_cluster
+        ns = t.Namespace()
+        ns.metadata.name = "conf-ns-sa"
+        cs.namespaces.create(ns, "")
+        from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+        must_poll_until(
+            lambda: any(sa.metadata.name == "default"
+                        for sa in cs.serviceaccounts.list(
+                            namespace="conf-ns-sa")[0]),
+            timeout=15.0, desc="default SA created")
+
+    def test_namespace_delete_cascades_objects(self, kcm_cluster):
+        _, cs = kcm_cluster
+        from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+        ns = t.Namespace()
+        ns.metadata.name = "conf-ns-gone"
+        cs.namespaces.create(ns, "")
+        cm = _cm("inside")
+        cm.metadata.namespace = "conf-ns-gone"
+        cs.configmaps.create(cm, "conf-ns-gone")
+        cs.namespaces.delete("conf-ns-gone", "")
+        must_poll_until(
+            lambda: not _exists(cs, "configmaps", "inside", "conf-ns-gone"),
+            timeout=20.0, desc="namespaced object cascaded")
+        must_poll_until(
+            lambda: not _exists(cs, "namespaces", "conf-ns-gone", ""),
+            timeout=20.0, desc="namespace finalized")
+
+    def test_owner_reference_cascade(self, kcm_cluster):
+        _, cs = kcm_cluster
+        from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+        owner = cs.configmaps.create(_cm("gc-owner"))
+        child = _cm("gc-child")
+        child.metadata.owner_references = [t.OwnerReference(
+            api_version="v1", kind="ConfigMap",
+            name="gc-owner", uid=owner.metadata.uid)]
+        cs.configmaps.create(child)
+        cs.configmaps.delete("gc-owner", "default")
+        must_poll_until(
+            lambda: not _exists(cs, "configmaps", "gc-child", "default"),
+            timeout=20.0, desc="orphaned child garbage-collected")
+
+    def test_deployment_materializes_replicaset_and_pods(self, kcm_cluster):
+        _, cs = kcm_cluster
+        from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+        dep = t.Deployment()
+        dep.metadata.name = "conf-dep"
+        dep.spec.replicas = 2
+        dep.spec.selector = t.LabelSelector(match_labels={"app": "cd"})
+        tmpl = t.PodTemplateSpec()
+        tmpl.metadata.labels = {"app": "cd"}
+        tmpl.spec.containers = [t.Container(name="c", image="i",
+                                            command=["sleep", "9"])]
+        dep.spec.template = tmpl
+        cs.deployments.create(dep, "default")
+        must_poll_until(
+            lambda: len(cs.pods.list(namespace="default",
+                                     label_selector="app=cd")[0]) == 2,
+            timeout=20.0, desc="deployment -> RS -> 2 pods")
+        rss, _ = cs.replicasets.list(namespace="default",
+                                     label_selector="app=cd")
+        assert len(rss) == 1
+        assert any(o.kind == "Deployment"
+                   for o in rss[0].metadata.owner_references)
+
+
+def _exists(cs, resource, name, ns):
+    try:
+        cs.resource(resource).get(name, ns)
+        return True
+    except NotFound:
+        return False
