@@ -44,6 +44,73 @@ def _pct(xs, q):
     return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("inf")
 
 
+def preflight_reap() -> dict:
+    """The bench must not run on a poisoned box: leftover framework
+    processes from earlier tests/drives skew every phase (ten leaked
+    store/apiserver pairs did exactly that to round 4).  Default: REAP
+    them and record what was killed (the driver runs unattended — refusing
+    would forfeit the round's numbers); BENCH_NO_REAP=1 refuses instead."""
+    import signal as _signal
+
+    stragglers = {}
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").replace("\0", " ")
+        except OSError:
+            continue
+        if "-m kubernetes1_tpu" in cmd or "bin/ktpu-" in cmd \
+                or "workloads/resnet_bench" in cmd \
+                or "workloads/llama_bench" in cmd:
+            stragglers[int(pid)] = cmd.strip()[:120]
+    if not stragglers:
+        return {"stragglers": 0}
+    if os.environ.get("BENCH_NO_REAP") == "1":
+        raise RuntimeError(
+            f"refusing to bench on a dirty box: {len(stragglers)} leftover "
+            f"framework process(es): {stragglers}")
+    for pid in stragglers:
+        try:
+            os.killpg(pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    time.sleep(1.0)
+    # verify the kills took: claiming "reaped" while an unkillable process
+    # still poisons the box would be the exact r4 lie this guards against
+    survivors = {pid: cmd for pid, cmd in stragglers.items()
+                 if os.path.exists(f"/proc/{pid}")}
+    if survivors:
+        raise RuntimeError(
+            f"preflight could not reap {len(survivors)} framework "
+            f"process(es); refusing to bench dirty: {survivors}")
+    return {"stragglers": len(stragglers), "reaped": list(stragglers.values())}
+
+
+def _sched_perf_with_retry(*args, attempts=3, quiesce_s=10.0, **kw):
+    """A contaminated sched_perf number is unusable for comparisons —
+    instead of stamping it and moving on (r4), quiesce and retry a bounded
+    number of times; the LAST result is returned either way, carrying its
+    own contamination stamp and the retry count."""
+    from scripts.sched_perf import run_sched_perf
+
+    last = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(quiesce_s)  # quiesce BEFORE a retry, never after
+        last = run_sched_perf(*args, **kw)
+        if not (last.get("contention") or {}).get("contaminated"):
+            last["retries"] = attempt
+            return last
+    last["retries"] = attempts - 1
+    last["retries_exhausted"] = True
+    return last
+
+
 def bench_density():
     from kubernetes1_tpu.api import types as t
     from kubernetes1_tpu.apiserver import Master
@@ -158,8 +225,11 @@ def bench_workload(job_name="resnet50-bench", payload_args=None):
     from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
     from kubernetes1_tpu.kubelet import Kubelet, ProcessRuntime
 
+    from kubernetes1_tpu.utils.benchstamp import contention_stamp
+
     tmp = tempfile.mkdtemp(prefix="ktpu-bench-wl-")
     out_path = os.path.join(tmp, "result.json")
+    phase_stamp = contention_stamp()  # per-phase: box state AT this phase
     master = Master().start()
     cs = Clientset(master.url)
     from kubernetes1_tpu.scheduler import Scheduler
@@ -174,7 +244,8 @@ def bench_workload(job_name="resnet50-bench", payload_args=None):
     plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
     plugin.start()
     kcs = Clientset(master.url)
-    kl = Kubelet(kcs, node_name="tpu-host", runtime=ProcessRuntime(root_dir=tmp),
+    runtime = ProcessRuntime(root_dir=tmp)
+    kl = Kubelet(kcs, node_name="tpu-host", runtime=runtime,
                  plugin_dir=plugin_dir, heartbeat_interval=2.0,
                  sync_interval=0.5, pleg_interval=0.5)
     kl.start()
@@ -234,7 +305,15 @@ def bench_workload(job_name="resnet50-bench", payload_args=None):
         with open(out_path) as f:
             result = json.load(f)
 
+    # teardown REAPS (r4's leaked payload held the chip for hours): delete
+    # the Job, stop components, then force-kill anything the runtime still
+    # tracks and ASSERT nothing survived
+    try:
+        cs.jobs.delete(job_name, "default")
+    except Exception:  # noqa: BLE001
+        pass
     kl.stop()
+    survivors = runtime.kill_all()
     plugin.stop()
     cm.stop()
     sched.stop()
@@ -243,7 +322,10 @@ def bench_workload(job_name="resnet50-bench", payload_args=None):
     master.stop()
 
     out = {"chip_alloc_s": round(alloc_at - t0, 3) if alloc_at else None,
-           "pod_start_s": round(run_at - t0, 3) if run_at else None}
+           "pod_start_s": round(run_at - t0, 3) if run_at else None,
+           "contention": phase_stamp}
+    if survivors:
+        out["teardown_survivors"] = survivors  # should never happen
     if result:
         out.update(result)
     else:
@@ -356,10 +438,18 @@ def main():
     from kubernetes1_tpu.utils.benchstamp import contention_stamp
 
     extras = {"baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46); "
-                          "north-star imgs/sec/chip + MFU (BASELINE.md)",
-              # box state BEFORE any phase: numbers from a loaded box are
-              # noise (22x p99 swing observed r3) — compare like-with-like
-              "contention": contention_stamp()}
+                          "north-star imgs/sec/chip + MFU (BASELINE.md)"}
+    # a poisoned box poisons every number: reap stragglers FIRST
+    try:
+        extras["preflight"] = preflight_reap()
+    except RuntimeError as e:
+        print(json.dumps({"metric": "bench_refused", "value": 0,
+                          "unit": "", "vs_baseline": None,
+                          "error": str(e)}))
+        return
+    # box state BEFORE any phase: numbers from a loaded box are
+    # noise (22x p99 swing observed r3) — compare like-with-like
+    extras["contention"] = contention_stamp()
     density = bench_density()
     extras.update(density)
 
@@ -369,21 +459,33 @@ def main():
         except Exception as e:  # noqa: BLE001
             extras["gang"] = {"error": f"{type(e).__name__}: {e}"}
 
-    # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes)
+    # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes);
+    # contaminated runs are retried after a quiesce, not just stamped
     if os.environ.get("BENCH_SKIP_SCHED", "") != "1":
-        from scripts.sched_perf import run_sched_perf
-
         try:
-            extras["sched_perf_100"] = run_sched_perf(100, 3000, multiproc=True)
+            extras["sched_perf_100"] = _sched_perf_with_retry(
+                100, 3000, multiproc=True)
         except Exception as e:  # noqa: BLE001
             extras["sched_perf_100"] = {"error": f"{type(e).__name__}: {e}"}
         if os.environ.get("BENCH_SKIP_SCHED1K", "") != "1":
             try:
-                extras["sched_perf_1000"] = run_sched_perf(
+                extras["sched_perf_1000"] = _sched_perf_with_retry(
                     1000, 30000, creators=6, multiproc=True
                 )
             except Exception as e:  # noqa: BLE001
                 extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # kubemark: 200 hollow nodes (real kubelet loops) vs one apiserver
+    # process, with an enforced apiserver CPU/RSS budget (VERDICT r4 #6)
+    if os.environ.get("BENCH_SKIP_KUBEMARK", "") != "1":
+        from scripts.kubemark_bench import run_kubemark
+
+        try:
+            extras["kubemark_200"] = run_kubemark(
+                nodes=int(os.environ.get("BENCH_KUBEMARK_NODES", "200")),
+                pods_per_node=3)
+        except Exception as e:  # noqa: BLE001
+            extras["kubemark_200"] = {"error": f"{type(e).__name__}: {e}"}
 
     if os.environ.get("BENCH_SKIP_WORKLOAD", "") != "1":
         try:

@@ -1,0 +1,111 @@
+"""Kubemark: hollow nodes at scale (ref: pkg/kubemark/hollow_kubelet.go:43-100).
+
+A hollow node is the REAL kubelet loop — sync workers, PLEG, heartbeats,
+status manager, device manager — over a FakeRuntime and a fake TPU
+device plugin, so control-plane scale tests exercise the true node agent
+code paths (watch fan-out, heartbeat write pressure, bind handling)
+without containers or chips.  One worker process hosts K hollow nodes;
+the scale harness (scripts/kubemark_bench.py) spawns W workers against
+one real apiserver process and measures the apiserver's CPU/RSS budget,
+the way the reference's density tests enforce per-size resource budgets
+(test/e2e/scalability/density.go:129-162).
+
+    python -m kubernetes1_tpu.kubemark --server http://... \
+        --count 50 --index-base 0 --tpus-per-node 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import tempfile
+import threading
+
+from .client import Clientset
+from .deviceplugin.api import PluginServer, plugin_socket_path
+from .deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+from .kubelet import FakeRuntime, Kubelet
+
+
+class HollowNode:
+    """One hollow kubelet + its fake TPU plugin (hollow_kubelet.go:43)."""
+
+    def __init__(self, server: str, name: str, root_dir: str,
+                 tpus_per_node: int = 4, tpu_type: str = "v5e",
+                 slice_id: str = "", host_index: int = 0,
+                 heartbeat_interval: float = 10.0,
+                 sync_interval: float = 1.0):
+        plugin_dir = os.path.join(root_dir, name, "device-plugins")
+        devices = _fake_devices(
+            f"{tpu_type}:{tpus_per_node}:{slice_id or name}:{host_index}")
+        self.plugin = PluginServer(
+            TPUDevicePlugin(devices=devices),
+            plugin_socket_path(plugin_dir, "google.com/tpu"))
+        self.plugin.start()
+        self.cs = Clientset(server)
+        self.kubelet = Kubelet(
+            self.cs,
+            node_name=name,
+            runtime=FakeRuntime(),
+            plugin_dir=plugin_dir,
+            heartbeat_interval=heartbeat_interval,
+            sync_interval=sync_interval,
+            pleg_interval=sync_interval,
+        )
+
+    def start(self):
+        self.kubelet.start()
+        return self
+
+    def stop(self):
+        self.kubelet.stop()
+        self.plugin.stop()
+        self.cs.close()
+
+
+def run_worker(server: str, count: int, index_base: int,
+               tpus_per_node: int, tpu_type: str, root_dir: str,
+               heartbeat_interval: float, sync_interval: float,
+               hosts_per_slice: int = 8):
+    nodes = []
+    for i in range(count):
+        idx = index_base + i
+        nodes.append(HollowNode(
+            server, f"hollow-{idx}", root_dir,
+            tpus_per_node=tpus_per_node, tpu_type=tpu_type,
+            slice_id=f"slice-{idx // hosts_per_slice}",
+            host_index=idx % hosts_per_slice,
+            heartbeat_interval=heartbeat_interval,
+            sync_interval=sync_interval).start())
+    return nodes
+
+
+def main():
+    ap = argparse.ArgumentParser(description="kubemark hollow-node worker")
+    ap.add_argument("--server", required=True)
+    ap.add_argument("--count", type=int, default=50)
+    ap.add_argument("--index-base", type=int, default=0)
+    ap.add_argument("--tpus-per-node", type=int, default=4)
+    ap.add_argument("--tpu-type", default="v5e")
+    ap.add_argument("--root-dir", default="")
+    ap.add_argument("--heartbeat-interval", type=float, default=10.0)
+    ap.add_argument("--sync-interval", type=float, default=1.0)
+    args = ap.parse_args()
+    root = args.root_dir or tempfile.mkdtemp(prefix="kubemark-")
+    nodes = run_worker(args.server, args.count, args.index_base,
+                       args.tpus_per_node, args.tpu_type, root,
+                       args.heartbeat_interval, args.sync_interval)
+    print(f"kubemark worker: {len(nodes)} hollow nodes up "
+          f"(hollow-{args.index_base}..hollow-{args.index_base + args.count - 1})",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    for n in nodes:
+        n.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -78,7 +78,8 @@ def make_optimizer(name: str, lr: float):
 
 
 def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
-        warmup: int = 2, lr: float = 3e-4, remat: bool = True) -> dict:
+        warmup: int = 2, lr: float = 3e-4, remat: bool = True,
+        watchdog=None, profile: bool = True) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -87,6 +88,8 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
     from .llama import LlamaConfig, init_params, loss_fn
 
     devices = jax.devices()
+    if watchdog is not None:
+        watchdog.cancel()  # chip claim succeeded: stand down
     n_dev = len(devices)
     cfg = LlamaConfig(max_seq=seq, remat=remat, **PRESETS[preset])
     tx = make_optimizer(optimizer, lr)
@@ -137,6 +140,20 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
         float(loss)
         wall = time.perf_counter() - t0
 
+        prof = None
+        if profile:
+            import tempfile
+
+            from .benchguard import collect_profile
+
+            def one_step():
+                nonlocal params, opt_state, loss
+                params, opt_state, loss = step(params, opt_state, tokens)
+                float(loss)
+
+            prof = collect_profile(
+                one_step, tempfile.mkdtemp(prefix="llama-prof-"))
+
     peak, granularity = peak_flops_per_device(devices[0])
     steps_per_sec = steps / wall
     tokens_per_step = batch * seq
@@ -172,6 +189,7 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hfu": round(hfu, 4) if hfu is not None else None,
         "final_loss": float(loss),
+        "profile": prof,
     }
 
 
@@ -185,10 +203,17 @@ def main(argv=None):
     ap.add_argument("--optimizer", default="adafactor",
                     choices=["adamw", "adafactor", "sgdm"])
     ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--no-profile", action="store_true")
+    ap.add_argument("--acquire-timeout", type=float, default=180.0,
+                    help="hard exit if the chip claim hangs this long")
     args = ap.parse_args(argv)
+    from .benchguard import device_acquisition_watchdog
+
+    watchdog = device_acquisition_watchdog(args.out, args.acquire_timeout)
     try:
         result = run(args.preset, args.batch, args.seq, args.steps,
-                     args.optimizer, remat=not args.no_remat)
+                     args.optimizer, remat=not args.no_remat,
+                     watchdog=watchdog, profile=not args.no_profile)
     except Exception as e:  # noqa: BLE001
         result = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(result), flush=True)

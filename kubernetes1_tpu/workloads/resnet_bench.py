@@ -30,7 +30,8 @@ from .tpu_peaks import peak_flops_per_device
 RESNET50_TRAIN_FLOPS_PER_IMG_224 = 3 * 4.1e9
 
 
-def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
+def run(batch: int, steps: int, size: int, warmup: int = 2,
+        watchdog=None, profile: bool = True) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -40,6 +41,8 @@ def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
     from .resnet import ResNetConfig, init_params, make_train_step
 
     devices = jax.devices()
+    if watchdog is not None:
+        watchdog.cancel()  # chip claim succeeded: stand down
     n_dev = len(devices)
     cfg = ResNetConfig()
     mesh = sh.auto_mesh()
@@ -80,6 +83,21 @@ def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
         float(loss)
         wall = time.perf_counter() - t0
 
+        prof = None
+        if profile:
+            import tempfile
+
+            from .benchguard import collect_profile
+
+            def one_step():
+                nonlocal params, opt_state, loss
+                params, opt_state, loss = step(params, opt_state,
+                                               images, labels)
+                float(loss)
+
+            prof = collect_profile(
+                one_step, tempfile.mkdtemp(prefix="resnet-prof-"))
+
     kind = devices[0].device_kind
     peak, granularity = peak_flops_per_device(devices[0])
     steps_per_sec = steps / wall
@@ -102,6 +120,7 @@ def run(batch: int, steps: int, size: int, warmup: int = 2) -> dict:
         "peak_flops_per_device": peak,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "final_loss": float(loss),
+        "profile": prof,
     }
 
 
@@ -111,9 +130,16 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--no-profile", action="store_true")
+    ap.add_argument("--acquire-timeout", type=float, default=180.0,
+                    help="hard exit if the chip claim hangs this long")
     args = ap.parse_args(argv)
+    from .benchguard import device_acquisition_watchdog
+
+    watchdog = device_acquisition_watchdog(args.out, args.acquire_timeout)
     try:
-        result = run(args.batch, args.steps, args.size)
+        result = run(args.batch, args.steps, args.size,
+                     watchdog=watchdog, profile=not args.no_profile)
     except Exception as e:  # noqa: BLE001
         result = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(result), flush=True)

@@ -1,0 +1,284 @@
+"""Kubemark scale bench: N hollow nodes (real kubelet loops) against one
+real apiserver process, with an enforced apiserver resource budget.
+
+Ref: test/e2e/scalability/density.go:129-162 (per-cluster-size apiserver
+CPU/memory constraints) + pkg/kubemark (hollow nodes).  The r4 VERDICT
+ask: 200+ hollow kubelets, record apiserver CPU/RSS, assert a budget
+tier, fix what falls over.
+
+    python scripts/kubemark_bench.py --nodes 200 --pods-per-node 3
+
+Prints one JSON dict: node count, readiness wall, pods/s through real
+kubelet acks (Running, not just bound), apiserver cpu%/RSS, budget verdict.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes1_tpu.api import types as t  # noqa: E402
+from kubernetes1_tpu.client import Clientset  # noqa: E402
+from kubernetes1_tpu.utils.benchstamp import contention_stamp  # noqa: E402
+from kubernetes1_tpu.utils.waitutil import must_poll_until  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# density.go-style budget tiers: (max_nodes, apiserver_rss_mb, cpu_pct)
+# cpu_pct is of ONE core, averaged over the measurement window.
+BUDGET_TIERS = [
+    (100, 400, 90.0),
+    (250, 600, 95.0),
+    (1000, 1200, 100.0),
+]
+
+
+def _budget_for(nodes: int):
+    for max_nodes, rss_mb, cpu in BUDGET_TIERS:
+        if nodes <= max_nodes:
+            return {"rss_mb": rss_mb, "cpu_pct": cpu}
+    return {"rss_mb": None, "cpu_pct": None}
+
+
+class ProcSampler:
+    """Samples /proc/<pid> cpu+rss every interval (the budget evidence)."""
+
+    def __init__(self, pid: int, interval: float = 1.0):
+        self.pid = pid
+        self.interval = interval
+        self.samples = []  # (cpu_pct_of_core, rss_mb)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _read(self):
+        with open(f"/proc/{self.pid}/stat") as f:
+            parts = f.read().split()
+        utime, stime = int(parts[13]), int(parts[14])
+        with open(f"/proc/{self.pid}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return utime + stime, rss_pages * os.sysconf("SC_PAGE_SIZE")
+
+    def _run(self):
+        hz = os.sysconf("SC_CLK_TCK")
+        try:
+            last_ticks, _ = self._read()
+        except OSError:
+            return
+        last_t = time.monotonic()
+        while not self._stop.wait(self.interval):
+            try:
+                ticks, rss = self._read()
+            except OSError:
+                return
+            now = time.monotonic()
+            cpu = 100.0 * (ticks - last_ticks) / hz / (now - last_t)
+            self.samples.append((cpu, rss / (1 << 20)))
+            last_ticks, last_t = ticks, now
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if not self.samples:
+            return {"cpu_pct_avg": None, "cpu_pct_max": None,
+                    "rss_mb_max": None}
+        cpus = [c for c, _ in self.samples]
+        rsss = [r for _, r in self.samples]
+        return {"cpu_pct_avg": round(sum(cpus) / len(cpus), 1),
+                "cpu_pct_max": round(max(cpus), 1),
+                "rss_mb_max": round(max(rsss), 1)}
+
+
+def _spawn(cmd, log):
+    with open(log, "ab") as lf:
+        return subprocess.Popen(
+            cmd, stdout=lf, stderr=subprocess.STDOUT,
+            start_new_session=True,
+            env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+            cwd=REPO)
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_kubemark(nodes: int = 200, pods_per_node: int = 3,
+                 nodes_per_worker: int = 50, tpus_per_node: int = 4,
+                 heartbeat_interval: float = 10.0,
+                 workdir: str = "") -> dict:
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    stamp = contention_stamp()
+    d = workdir or tempfile.mkdtemp(prefix="kubemark-bench-")
+    py = sys.executable
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    procs = {}
+    result = {"nodes": nodes, "pods_per_node": pods_per_node,
+              "contention": stamp}
+    try:
+        procs["apiserver"] = _spawn(
+            [py, "-m", "kubernetes1_tpu.apiserver", "--port", str(port)],
+            os.path.join(d, "apiserver.log"))
+        cs = Clientset(url)
+
+        def healthy():
+            try:
+                cs.api.request("GET", "/healthz")
+                return True
+            except Exception:  # noqa: BLE001
+                return False
+
+        must_poll_until(healthy, timeout=60.0, desc="apiserver healthy")
+        procs["sched"] = _spawn(
+            [py, "-m", "kubernetes1_tpu.scheduler", "--server", url,
+             "--metrics-port", "-1"],
+            os.path.join(d, "sched.log"))
+        procs["kcm"] = _spawn(
+            [py, "-m", "kubernetes1_tpu.controllers", "--server", url],
+            os.path.join(d, "kcm.log"))
+
+        sampler = ProcSampler(procs["apiserver"].pid).start()
+
+        # hollow-node workers
+        t0 = time.monotonic()
+        idx = 0
+        w = 0
+        while idx < nodes:
+            k = min(nodes_per_worker, nodes - idx)
+            procs[f"worker-{w}"] = _spawn(
+                [py, "-m", "kubernetes1_tpu.kubemark", "--server", url,
+                 "--count", str(k), "--index-base", str(idx),
+                 "--tpus-per-node", str(tpus_per_node),
+                 "--heartbeat-interval", str(heartbeat_interval),
+                 "--root-dir", os.path.join(d, f"w{w}")],
+                os.path.join(d, f"worker-{w}.log"))
+            idx += k
+            w += 1
+
+        def ready_count():
+            try:
+                return sum(
+                    1 for n in cs.nodes.list()[0]
+                    for c in n.status.conditions
+                    if c.type == "Ready" and c.status == "True")
+            except Exception:  # noqa: BLE001
+                return 0
+
+        must_poll_until(lambda: ready_count() >= nodes,
+                        timeout=60.0 + nodes * 1.5,
+                        desc=f"{nodes} hollow nodes Ready")
+        result["node_ready_wall_s"] = round(time.monotonic() - t0, 1)
+
+        # pod churn through REAL kubelet acks: create pods-per-node x N
+        # pods; measure create->Running (bind + hollow kubelet sync + PUT)
+        total = nodes * pods_per_node
+        created_t: dict = {}
+        running: dict = {}
+        done = threading.Event()
+
+        def watcher():
+            from kubernetes1_tpu.client.rest import ApiClient
+
+            api = ApiClient(url)
+            with api.watch("/api/v1/namespaces/default/pods",
+                           {"resourceVersion": "1"}) as stream:
+                for etype, obj in stream:
+                    name = obj["metadata"]["name"]
+                    phase = (obj.get("status") or {}).get("phase")
+                    if phase == "Running" and name not in running:
+                        running[name] = time.monotonic()
+                        if len(running) >= total:
+                            done.set()
+                            return
+
+        threading.Thread(target=watcher, daemon=True).start()
+        t1 = time.monotonic()
+        for i in range(total):
+            pod = t.Pod()
+            pod.metadata.name = f"km-{i}"
+            c = t.Container(name="c", image="img", command=["sleep", "3600"])
+            c.resources.limits = {"google.com/tpu": "1"}
+            pod.spec.containers = [c]
+            cs.pods.create(pod)
+            created_t[pod.metadata.name] = time.monotonic()
+        create_wall = time.monotonic() - t1
+        done.wait(timeout=120.0 + total * 0.5)
+        # snapshot: on timeout the watcher thread is still inserting, and
+        # iterating the live dict would crash the whole phase
+        running_snap = dict(running)
+        run_wall = (max(running_snap.values()) if running_snap
+                    else time.monotonic()) - t1
+        lat = sorted(running_snap[n] - created_t[n]
+                     for n in running_snap if n in created_t)
+
+        def pct(q):
+            return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3) \
+                if lat else None
+
+        # hold steady 10s so the sampler sees heartbeat-only pressure too
+        time.sleep(10)
+        usage = sampler.stop()
+        budget = _budget_for(nodes)
+        result.update({
+            "pods_requested": total,
+            "pods_running": len(running_snap),
+            "create_wall_s": round(create_wall, 1),
+            "pods_per_sec_to_running": round(len(running_snap) / run_wall, 1)
+            if run_wall > 0 else None,
+            "startup_latency_p50_s": pct(0.50),
+            "startup_latency_p99_s": pct(0.99),
+            "apiserver": usage,
+            "budget": budget,
+            "within_budget": bool(
+                usage["rss_mb_max"] is not None
+                and budget["rss_mb"] is not None
+                and usage["rss_mb_max"] <= budget["rss_mb"]
+                and usage["cpu_pct_avg"] <= budget["cpu_pct"]),
+        })
+        cs.close()
+        return result
+    finally:
+        for p in procs.values():
+            try:
+                os.killpg(p.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if not workdir:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=200)
+    ap.add_argument("--pods-per-node", type=int, default=3)
+    ap.add_argument("--nodes-per-worker", type=int, default=50)
+    ap.add_argument("--heartbeat-interval", type=float, default=10.0)
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args()
+    print(json.dumps(run_kubemark(
+        args.nodes, args.pods_per_node, args.nodes_per_worker,
+        heartbeat_interval=args.heartbeat_interval, workdir=args.workdir)))
+
+
+if __name__ == "__main__":
+    main()
