@@ -193,11 +193,46 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
     }
 
 
+def run_sweep(candidates, preset, seq, steps, optimizer, remat=True,
+              watchdog=None, profile=True, probe_steps=3) -> dict:
+    """Batch sweep (the r3 ask toward 0.42 MFU): probe each candidate
+    batch with a few steps, run the winner at full length.  An OOM
+    candidate (RESOURCE_EXHAUSTED) is recorded and skipped — HBM limits
+    are discovered, not guessed."""
+    probes = {}
+    best, best_tps = None, -1.0
+    for i, b in enumerate(candidates):
+        try:
+            r = run(preset, b, seq, probe_steps, optimizer, warmup=1,
+                    remat=remat, watchdog=watchdog if i == 0 else None,
+                    profile=False)
+            probes[b] = {"tokens_per_sec": r["tokens_per_sec"],
+                         "mfu": r["mfu"]}
+            if r["tokens_per_sec"] > best_tps:
+                best, best_tps = b, r["tokens_per_sec"]
+        except Exception as e:  # noqa: BLE001 — OOM candidate: record, skip
+            if i == 0 and watchdog is not None:
+                # run() may have raised before reaching its cancel(): a
+                # still-armed timer would hard-kill a later healthy run
+                watchdog.cancel()
+            probes[b] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    if best is None:
+        return {"error": "every sweep candidate failed", "sweep": probes}
+    result = run(preset, best, seq, steps, optimizer, remat=remat,
+                 profile=profile)
+    result["sweep"] = probes
+    result["sweep_winner_batch"] = best
+    return result
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="", help="write result JSON here")
     ap.add_argument("--preset", default="1b-tpu", choices=sorted(PRESETS))
     ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--sweep", default="",
+                    help="comma-separated batch candidates; probe each, "
+                         "run the best at full --steps")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--optimizer", default="adafactor",
@@ -206,14 +241,28 @@ def main(argv=None):
     ap.add_argument("--no-profile", action="store_true")
     ap.add_argument("--acquire-timeout", type=float, default=180.0,
                     help="hard exit if the chip claim hangs this long")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (the env var alone loses "
+                         "to this image's sitecustomize axon hook)")
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     from .benchguard import device_acquisition_watchdog
 
     watchdog = device_acquisition_watchdog(args.out, args.acquire_timeout)
     try:
-        result = run(args.preset, args.batch, args.seq, args.steps,
-                     args.optimizer, remat=not args.no_remat,
-                     watchdog=watchdog, profile=not args.no_profile)
+        if args.sweep:
+            result = run_sweep(
+                [int(b) for b in args.sweep.split(",") if b.strip()],
+                args.preset, args.seq, args.steps, args.optimizer,
+                remat=not args.no_remat, watchdog=watchdog,
+                profile=not args.no_profile)
+        else:
+            result = run(args.preset, args.batch, args.seq, args.steps,
+                         args.optimizer, remat=not args.no_remat,
+                         watchdog=watchdog, profile=not args.no_profile)
     except Exception as e:  # noqa: BLE001
         result = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps(result), flush=True)

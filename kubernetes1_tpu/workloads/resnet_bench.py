@@ -133,7 +133,14 @@ def main(argv=None):
     ap.add_argument("--no-profile", action="store_true")
     ap.add_argument("--acquire-timeout", type=float, default=180.0,
                     help="hard exit if the chip claim hangs this long")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (the env var alone loses "
+                         "to this image's sitecustomize axon hook)")
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     from .benchguard import device_acquisition_watchdog
 
     watchdog = device_acquisition_watchdog(args.out, args.acquire_timeout)
