@@ -108,8 +108,9 @@ class ChaosCluster:
             self.kill(name)
 
 
-@pytest.fixture()
-def chaos(tmp_path, request):
+def boot_cluster(tmp_path, request):
+    """Shared bring-up for the chaos and rolling-upgrade suites: the full
+    replicated process cluster, reaper registered before any spawn."""
     c = ChaosCluster(str(tmp_path))
     request.addfinalizer(c.reap_all)  # registered BEFORE any spawn
     c.spawn("store-primary")
@@ -136,6 +137,11 @@ def chaos(tmp_path, request):
                     if cond.type == "Ready" and cond.status == "True") >= 2,
         timeout=60.0, desc="both nodes Ready")
     return c, cs
+
+
+@pytest.fixture()
+def chaos(tmp_path, request):
+    return boot_cluster(tmp_path, request)
 
 
 KILLABLE = ["api-a", "api-b", "kcm", "sched", "kubelet-0", "kubelet-1"]
