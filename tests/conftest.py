@@ -29,11 +29,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # process that appears during the run and survives it FAILS the session.
 
 def _ktpu_procs(marker: str = "") -> dict:
-    """pid -> cmdline for every framework process on the box (spawned
-    components match `-m kubernetes1_tpu` / the native `bin/ktpu-*`).
-    With a marker, only processes whose ENVIRON carries it are returned —
-    i.e. descendants of this pytest run, even after re-parenting — so a
-    concurrent session's processes can never fail OUR run."""
+    """pid -> cmdline of leak suspects.
+
+    Without a marker (session-start warning): framework processes by
+    cmdline (`-m kubernetes1_tpu` / the native `bin/ktpu-*`).
+
+    With a marker (session-end check): ANY process whose ENVIRON carries
+    it — i.e. every descendant of this pytest run, even after
+    re-parenting.  Matching by marker alone matters: a leaked pod
+    CONTAINER runs an arbitrary command (a `python -c http.server` from
+    the port-forward test leaked exactly this way) and would slip a
+    cmdline filter, while a concurrent session's processes can never
+    carry our marker and so can never fail our run."""
     out = {}
     for pid in os.listdir("/proc"):
         if not pid.isdigit() or int(pid) == os.getpid():
@@ -43,8 +50,6 @@ def _ktpu_procs(marker: str = "") -> dict:
                 cmd = f.read().decode(errors="replace").replace("\0", " ")
         except OSError:
             continue
-        if "-m kubernetes1_tpu" not in cmd and "bin/ktpu-" not in cmd:
-            continue
         if marker:
             try:
                 with open(f"/proc/{pid}/environ", "rb") as f:
@@ -52,6 +57,8 @@ def _ktpu_procs(marker: str = "") -> dict:
                         continue
             except OSError:
                 continue
+        elif "-m kubernetes1_tpu" not in cmd and "bin/ktpu-" not in cmd:
+            continue
         out[int(pid)] = cmd.strip()
     return out
 

@@ -118,6 +118,7 @@ def cg_env(tmp_path):
     env = {"master": master, "cs": cs, "kubelet": kubelet, "runtime": runtime}
     yield env
     kubelet.stop()
+    runtime.kill_all()  # containers must not outlive the fixture
     sched.stop()
     cs.close()
     master.stop()

@@ -110,6 +110,7 @@ class TestRemoteRuntime:
         finally:
             client.close()
             server.stop()
+            backend.kill_all()  # containers must not outlive the test
 
 
 class TestKubeletOverSocket:

@@ -207,5 +207,6 @@ class TestPodResolution:
             assert created.spec.cluster_ip in runtime.read_log(cid)
         finally:
             kubelet.stop()
+            runtime.kill_all()  # containers must not outlive the test
             cs.close()
             master.stop()

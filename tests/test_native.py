@@ -219,6 +219,10 @@ class TestNativeCRIRuntime:
         assert client.real_pids is True
         assert client.root == root
         assert "ktpu-cri-runtime" in client.version()
+        # the runtime's identity crosses the wire: the kubelet's
+        # runAsNonRoot verification checks the RUNTIME's euid, not its own
+        assert client.default_uid == os.geteuid()
+        assert client.identity_known is True
 
     def test_real_process_lifecycle(self, native_cri, tmp_path):
         from kubernetes1_tpu.kubelet.runtime import (

@@ -55,6 +55,7 @@ def node_env(tmp_path):
     }
     yield env
     env["kubelet"].stop()
+    runtime.kill_all()  # containers must not outlive the fixture
     sched.stop()
     plugin.stop()
     cs.close()

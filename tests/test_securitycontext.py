@@ -137,6 +137,7 @@ class TestRuntimeEnforcement:
         kubelet.start()
         yield {"cs": cs, "node": "sec-node", "runtime": runtime}
         kubelet.stop()
+        runtime.kill_all()  # containers must not outlive the fixture
         cs.close()
         master.stop()
 

@@ -44,6 +44,7 @@ def env(tmp_path):
     e = {"master": master, "cs": cs, "kubelet": kubelet, "tmp": tmp_path}
     yield e
     kubelet.stop()
+    runtime.kill_all()  # containers must not outlive the fixture
     sched.stop()
     cs.close()
     master.stop()
@@ -320,10 +321,14 @@ class TestSecurity:
             status = json.loads(
                 next(p for c, p in frames if c == streams.ERROR))
             assert status["exitCode"] == 0
+        finally:
+            # in finally, or an assertion failure above leaks the kubelet,
+            # scheduler, and the pod's sleep process (and the leak police
+            # would bury the real failure under its own)
             kubelet.stop()
+            runtime.kill_all()  # containers must not outlive the test
             sched.stop()
             admin.close()
-        finally:
             master.stop()
 
 

@@ -50,7 +50,11 @@ def vol_env(tmp_path):
         "runtime": runtime, "kubelet": kubelet, "tmp": tmp_path,
     }
     yield env
-    kubelet.stop()
+    # env["kubelet"], not the local: the restart-safety test swaps in a
+    # NEW kubelet — stopping the stale one would leave the live one
+    # restarting containers right after kill_all reaps them
+    env["kubelet"].stop()
+    runtime.kill_all()  # containers must not outlive the fixture
     cm.stop()
     sched.stop()
     cs.close()
