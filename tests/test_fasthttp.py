@@ -1,0 +1,73 @@
+"""utils/fasthttp parity: the fast header parser must be byte-for-byte
+faithful to stdlib http.client.parse_headers on every behavior our HTTP
+stack (or a peer) could observe — a parser differential between patched
+and unpatched processes is request-smuggling surface, so parity is
+asserted empirically against stdlib itself, not against expectations."""
+
+import io
+import http.client
+
+from kubernetes1_tpu.utils.fasthttp import (
+    _fast_parse_headers,
+    _orig_parse_headers,
+    install,
+    uninstall,
+)
+
+CASES = [
+    b"Host: x\r\nContent-Length: 3\r\n\r\n",
+    b"A: v  \r\n\r\n",                      # trailing value spaces kept
+    b"A:  two  spaces\r\n\r\n",             # leading stripped, inner kept
+    b"A:\r\n\r\n",                          # empty value
+    b"NoSpace:v\r\n\r\n",
+    b"Dup: a\r\nDup: b\r\n\r\n",            # duplicates append
+    b"A: one\r\n two\r\n\r\n",              # obs-fold keeps CRLF + spaces
+    b"A: 1\r\n \r\nB: 2\r\n\r\n",           # whitespace-only continuation
+    b"Good: 1\r\nBADLINE\r\nAfter: 2\r\n\r\n",  # defect drops the rest
+    b"Name : v\r\nB: 2\r\n\r\n",            # space before colon: rejected
+    b"\tBad: start\r\n\r\n",                # leading continuation: rejected
+    b"A: one\r\n two\r\nBAD\r\nC: 3\r\n\r\n",   # fold then defect
+    b"MiXeD-CaSe: yes\r\n\r\n",
+    b"X: a\nY: b\n\n",                      # bare-LF line endings
+    b"\r\n",                                # empty block
+]
+
+
+def _both(raw: bytes):
+    std = _orig_parse_headers(io.BufferedReader(io.BytesIO(raw)))
+    fast = _fast_parse_headers(io.BufferedReader(io.BytesIO(raw)))
+    return std, fast
+
+
+class TestParity:
+    def test_items_identical_for_every_case(self):
+        for raw in CASES:
+            std, fast = _both(raw)
+            assert list(std.items()) == list(fast.items()), raw
+
+    def test_case_insensitive_get(self):
+        _, fast = _both(b"Content-Type: json\r\n\r\n")
+        assert fast.get("content-type") == "json"
+        assert fast["CONTENT-TYPE"] == "json"
+
+    def test_socket_consumption_identical(self):
+        # framing safety: both must leave the stream at the same offset
+        for raw in CASES:
+            tail = b"PAYLOAD"
+            s = io.BufferedReader(io.BytesIO(raw + tail))
+            _orig_parse_headers(s)
+            std_rest = s.read()
+            f = io.BufferedReader(io.BytesIO(raw + tail))
+            _fast_parse_headers(f)
+            fast_rest = f.read()
+            assert std_rest == fast_rest, raw
+
+    def test_install_idempotent_and_reversible(self):
+        try:
+            install()
+            install()
+            assert http.client.parse_headers is _fast_parse_headers
+        finally:
+            uninstall()
+            assert http.client.parse_headers is _orig_parse_headers
+            install()  # other tests in the process expect it installed
