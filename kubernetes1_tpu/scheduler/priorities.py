@@ -203,7 +203,10 @@ DEFAULT_PRIORITIES: List[Tuple[str, Callable[[t.Pod, NodeInfo], float], float]] 
 ]
 
 
-def prioritize(pod: t.Pod, nodes: List[NodeInfo]) -> Dict[str, float]:
+def prioritize_reference(pod: t.Pod, nodes: List[NodeInfo]) -> Dict[str, float]:
+    """The unfused definition: every priority evaluated for every node.
+    Kept as the semantic reference — tests assert prioritize() (the fused
+    hot path below) produces IDENTICAL scores."""
     scores: Dict[str, float] = {}
     for ni in nodes:
         s = 0.0
@@ -211,3 +214,120 @@ def prioritize(pod: t.Pod, nodes: List[NodeInfo]) -> Dict[str, float]:
             s += weight * fn(pod, ni)
         scores[ni.node.metadata.name] = s
     return scores
+
+
+def prioritize(pod: t.Pod, nodes: List[NodeInfo]) -> Dict[str, float]:
+    """Fused scoring loop — arithmetic identical to prioritize_reference
+    (parity-asserted in tests/test_scheduler_unit.py), restructured for
+    the hot path: per-pod invariants (resource requests, tolerations,
+    owner set, image list, affinity terms) are computed ONCE instead of
+    per node, and priorities whose answer is a constant for this pod
+    (no affinity terms, no owners, no device request, untainted node)
+    skip their function call entirely.  At 1000-node density this loop
+    runs ~100 node scorings per pod at 30k pods — it IS the scheduler's
+    saturation throughput."""
+    from .predicates import _term_matches, _tolerates
+
+    req_cpu = pod_request_milli_cpu(pod)
+    req_mem = pod_request_memory(pod)
+    owners = frozenset(
+        ref.uid for ref in pod.metadata.owner_references if ref.uid)
+    pod_uid = pod.metadata.uid
+    wanted = [c.image for c in pod.spec.containers if c.image]
+    n_wanted = len(wanted)
+    tolerations = pod.spec.tolerations
+    aff = pod.spec.affinity
+    terms = (aff.node_affinity_preferred if aff else None) or []
+    terms_total = sum(max(1, term.weight) for term in terms)
+    ext_res = pod.spec.extended_resources
+
+    base = 0.0
+    if not terms:
+        base += _W_NODE_AFFINITY * (MAX_SCORE / 2)      # neutral
+    if not owners:
+        base += _W_SELECTOR_SPREADING * (MAX_SCORE / 2)  # neutral
+    if not ext_res:
+        base += _W_SLICE_PACKING * (MAX_SCORE / 2)       # neutral
+
+    scores: Dict[str, float] = {}
+    for ni in nodes:
+        node = ni.node
+        s = base
+        # LeastRequested
+        ac, am = ni.allocatable_milli_cpu, ni.allocatable_memory
+        lr = 0.0
+        if ac > 0:
+            lr += max(0.0, 1 - (ni.requested_milli_cpu + req_cpu) / ac) \
+                * MAX_SCORE
+        if am > 0:
+            lr += max(0.0, 1 - (ni.requested_memory + req_mem) / am) \
+                * MAX_SCORE
+        s += _W_LEAST_REQUESTED * (lr / 2)
+        # BalancedAllocation
+        if ac > 0 and am > 0:
+            cpu_frac = min(1.0, (ni.requested_milli_cpu + req_cpu) / ac)
+            mem_frac = min(1.0, (ni.requested_memory + req_mem) / am)
+            s += _W_BALANCED * (1 - abs(cpu_frac - mem_frac)) * MAX_SCORE
+        # TaintToleration: untainted node = full score (the common case)
+        taints = node.spec.taints
+        if taints:
+            bad = 0
+            for taint in taints:
+                if taint.effect == "PreferNoSchedule" and not any(
+                        _tolerates(tol, taint) for tol in tolerations):
+                    bad += 1
+            s += _W_TAINT * max(0.0, MAX_SCORE - 2.0 * bad)
+        else:
+            s += _W_TAINT * MAX_SCORE
+        # NodeAffinity (terms hoisted; total weight precomputed)
+        if terms:
+            labels = node.metadata.labels or {}
+            got = sum(max(1, term.weight) for term in terms
+                      if _term_matches(term.preference, labels))
+            s += _W_NODE_AFFINITY * MAX_SCORE * got / terms_total
+        # ImageLocality (wanted hoisted)
+        if wanted:
+            images = node.status.images
+            if images:
+                iset = set(images)
+                present = sum(1 for img in wanted if img in iset)
+                s += _W_IMAGE * MAX_SCORE * present / n_wanted
+        # SelectorSpreading (owner set hoisted)
+        if owners:
+            siblings = 0
+            for p in ni.pods.values():
+                if p.metadata.uid == pod_uid or p.metadata.deletion_timestamp:
+                    continue
+                for ref in p.metadata.owner_references:
+                    if ref.uid and ref.uid in owners:
+                        siblings += 1
+                        break
+            s += _W_SELECTOR_SPREADING * MAX_SCORE / (1.0 + siblings)
+        if ext_res:
+            s += _W_SLICE_PACKING * slice_packing(pod, ni)
+        # NodePreferAvoidPods: no annotation = full score
+        if (node.metadata.annotations or {}).get(
+                PREFER_AVOID_PODS_ANNOTATION):
+            s += _W_AVOID * node_prefer_avoid_pods(pod, ni)
+        else:
+            s += _W_AVOID * MAX_SCORE
+        scores[node.metadata.name] = s
+    return scores
+
+
+# The fused loop's weights MUST be the registry's weights: a tuned
+# DEFAULT_PRIORITIES entry that the fused loop ignored would silently
+# not affect real scheduling.  Bound at import; editing one side without
+# the other fails fast here.
+_BY_NAME = {name: weight for name, _fn, weight in DEFAULT_PRIORITIES}
+_W_LEAST_REQUESTED = _BY_NAME["LeastRequested"]
+_W_BALANCED = _BY_NAME["BalancedAllocation"]
+_W_TAINT = _BY_NAME["TaintToleration"]
+_W_NODE_AFFINITY = _BY_NAME["NodeAffinity"]
+_W_IMAGE = _BY_NAME["ImageLocality"]
+_W_SELECTOR_SPREADING = _BY_NAME["SelectorSpreading"]
+_W_SLICE_PACKING = _BY_NAME["SlicePacking"]
+_W_AVOID = _BY_NAME["NodePreferAvoidPods"]
+assert len(_BY_NAME) == 8, (
+    "a priority was added to DEFAULT_PRIORITIES without teaching the "
+    "fused prioritize() loop about it — update both (and the parity test)")

@@ -23,13 +23,20 @@ CASES = [
     b"Dup: a\r\nDup: b\r\n\r\n",            # duplicates append
     b"A: one\r\n two\r\n\r\n",              # obs-fold keeps CRLF + spaces
     b"A: 1\r\n \r\nB: 2\r\n\r\n",           # whitespace-only continuation
-    b"Good: 1\r\nBADLINE\r\nAfter: 2\r\n\r\n",  # defect drops the rest
-    b"Name : v\r\nB: 2\r\n\r\n",            # space before colon: rejected
-    b"\tBad: start\r\n\r\n",                # leading continuation: rejected
+    b"Good: 1\r\nBADLINE\r\nAfter: 2\r\n\r\n",  # defect line mid-block
+    b"Name : v\r\nB: 2\r\n\r\n",            # space before colon
+    b"\tBad: start\r\n\r\n",                # leading continuation
     b"A: one\r\n two\r\nBAD\r\nC: 3\r\n\r\n",   # fold then defect
     b"MiXeD-CaSe: yes\r\n\r\n",
     b"X: a\nY: b\n\n",                      # bare-LF line endings
     b"\r\n",                                # empty block
+    # adversarial shapes from review: each must match stdlib EXACTLY
+    b":x\r\nContent-Length: 5\r\n\r\n",     # empty header name
+    b"From x\r\nHost: h\r\n\r\n",           # unix-From line
+    b"Na me: v\r\nHost: h\r\n\r\n",         # space inside the name
+    b"\x01Bad: v\r\nHost: h\r\n\r\n",       # control char in the name
+    b"A: one\n two\n\n",                    # LF-terminated fold
+    b"A: one\r\r\n cont\r\n\r\n",           # stray CR before CRLF
 ]
 
 
@@ -61,6 +68,34 @@ class TestParity:
             _fast_parse_headers(f)
             fast_rest = f.read()
             assert std_rest == fast_rest, raw
+
+    def test_header_count_limit_matches_stdlib(self):
+        # stdlib counts the blank terminator toward _MAXHEADERS, so a
+        # block of exactly _MAXHEADERS headers RAISES — both must agree
+        n = http.client._MAXHEADERS
+        block = b"".join(b"H%d: v\r\n" % i for i in range(n)) + b"\r\n"
+        import pytest
+
+        with pytest.raises(http.client.HTTPException):
+            _orig_parse_headers(io.BufferedReader(io.BytesIO(block)))
+        with pytest.raises(http.client.HTTPException):
+            _fast_parse_headers(io.BufferedReader(io.BytesIO(block)))
+        ok = b"".join(b"H%d: v\r\n" % i for i in range(n - 1)) + b"\r\n"
+        std, fast = _both(ok)
+        assert list(std.items()) == list(fast.items())
+
+    def test_fuzz_parity_random_blocks(self):
+        import random
+
+        rng = random.Random(31337)
+        atoms = [b"Host: h\r\n", b"X-Y: v  \r\n", b" cont\r\n", b"BAD\r\n",
+                 b":e\r\n", b"From x\r\n", b"A:\r\n", b"K:v\n", b"\tq\r\n",
+                 b"Na me: v\r\n", b"Dup: 1\r\n", b"Dup: 2\r\n"]
+        for _ in range(300):
+            block = b"".join(rng.choice(atoms)
+                             for _ in range(rng.randint(0, 8))) + b"\r\n"
+            std, fast = _both(block)
+            assert list(std.items()) == list(fast.items()), block
 
     def test_install_idempotent_and_reversible(self):
         try:

@@ -261,3 +261,80 @@ class TestNewPriorities:
                     '{"preferAvoidPods": [{"podSignature": null}]}'):
             ni = self._ni(annotations={PREFER_AVOID_PODS_ANNOTATION: bad})
             assert node_prefer_avoid_pods(pod, ni) == 10.0, bad
+
+
+class TestPrioritizeFusionParity:
+    """prioritize() is a fused hot-path rewrite of prioritize_reference()
+    — the scores must be IDENTICAL across pod/node shapes that exercise
+    every skip branch (taints, affinity terms, owners, images, device
+    requests, avoid-pods annotations)."""
+
+    def _cases(self):
+        import random
+
+        from kubernetes1_tpu.scheduler.priorities import (
+            PREFER_AVOID_PODS_ANNOTATION,
+        )
+
+        rng = random.Random(7)
+        nodes = []
+        for i in range(12):
+            node = make_node(f"pp-{i}", cpu=str(rng.choice([4, 8, 64])),
+                             memory=rng.choice(["8Gi", "64Gi", "256Gi"]),
+                             tpus=rng.choice([0, 4, 8]),
+                             slice_id=f"s{i % 3}", host_index=i % 4)
+            if i % 3 == 0:
+                node.spec.taints = [t.Taint(key="dedicated", value="tpu",
+                                            effect="PreferNoSchedule")]
+            if i % 4 == 0:
+                node.metadata.annotations = {
+                    PREFER_AVOID_PODS_ANNOTATION:
+                    '{"preferAvoidPods": [{"podSignature": '
+                    '{"podController": {"uid": "u-avoid"}}}]}'}
+            node.status.images = ["img-a"] if i % 2 else []
+            info = ni(node)
+            # some load so least-requested/balanced differ per node
+            filler = make_tpu_pod(f"fill-{i}", tpus=0)
+            filler.spec.containers[0].resources.requests = {
+                "cpu": f"{rng.choice([1, 2])}", "memory": "1Gi"}
+            info.add_pod(filler)
+            nodes.append(info)
+
+        pods = []
+        plain = make_tpu_pod("plain", tpus=0)
+        pods.append(plain)
+        chippy = make_tpu_pod("chippy", tpus=4)
+        pods.append(chippy)
+        owned = make_tpu_pod("owned", tpus=0)
+        owned.metadata.owner_references = [t.OwnerReference(
+            api_version="v1", kind="ReplicaSet", name="rs", uid="u-avoid")]
+        owned.spec.containers[0].image = "img-a"
+        pods.append(owned)
+        tolerant = make_tpu_pod("tolerant", tpus=0)
+        tolerant.spec.tolerations = [t.Toleration(
+            key="dedicated", operator="Equal", value="tpu",
+            effect="PreferNoSchedule")]
+        pods.append(tolerant)
+        prefery = make_tpu_pod("prefery", tpus=0)
+        prefery.spec.affinity = t.Affinity(node_affinity_preferred=[
+            t.PreferredSchedulingTerm(
+                weight=3, preference=t.NodeAffinityTerm(match_expressions=[
+                    t.NodeSelectorRequirement(
+                        key="ktpu.io/tpu-slice", operator="Exists")]))])
+        pods.append(prefery)
+        return pods, nodes
+
+    def test_scores_identical(self):
+        from kubernetes1_tpu.scheduler.priorities import (
+            prioritize,
+            prioritize_reference,
+        )
+
+        pods, nodes = self._cases()
+        for pod in pods:
+            want = prioritize_reference(pod, nodes)
+            got = prioritize(pod, nodes)
+            assert got.keys() == want.keys()
+            for name in want:
+                assert abs(got[name] - want[name]) < 1e-9, \
+                    (pod.metadata.name, name, got[name], want[name])
