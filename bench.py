@@ -540,6 +540,21 @@ def main():
         except Exception as e:  # noqa: BLE001
             extras["workload_llama"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # flag the environment loudly when the chip itself is the failure:
+    # a wedged claim (round 4's leaked payload held it; the claim
+    # outlived that process on the relay side) is not a framework
+    # regression — the watchdog turning it into a fast distinct error
+    # IS the round-5 fix working
+    wl_errs = {name: (extras.get(name) or {}).get("error")
+               for name in ("workload", "workload_llama")}
+    if any(e == "device acquisition timeout" for e in wl_errs.values()):
+        extras["environment_flag"] = (
+            "TPU chip unclaimable: jax.devices() hung past the payload "
+            "watchdog. This is an environment condition, not a workload "
+            "failure — the watchdog failing FAST with this distinct error "
+            "(instead of hanging 900s and poisoning later phases) is the "
+            "designed behavior. Attribution belongs to the round report.")
+
     p99 = extras["pod_startup_p99_s"]
     result = {
         "metric": "pod_startup_p99_s",
