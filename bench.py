@@ -545,9 +545,8 @@ def main():
     # outlived that process on the relay side) is not a framework
     # regression — the watchdog turning it into a fast distinct error
     # IS the round-5 fix working
-    wl_errs = {name: (extras.get(name) or {}).get("error")
-               for name in ("workload", "workload_llama")}
-    if any(e == "device acquisition timeout" for e in wl_errs.values()):
+    if any((extras.get(n) or {}).get("error") == "device acquisition timeout"
+           for n in ("workload", "workload_llama")):
         extras["environment_flag"] = (
             "TPU chip unclaimable: jax.devices() hung past the payload "
             "watchdog. This is an environment condition, not a workload "

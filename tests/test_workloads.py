@@ -5,6 +5,9 @@ dp/fsdp/tp Llama train step, and sequence-parallel ring attention vs the
 dense reference.
 """
 
+import os
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -153,3 +156,47 @@ def test_bert_masked_positions_drive_loss():
         l_one = float(bert.mlm_loss_fn(cfg, params, tokens, one))
     assert np.isfinite(l_full) and np.isfinite(l_one)
     assert l_full != l_one
+
+
+class TestBenchguardWatchdog:
+    """The device-acquisition watchdog is the round-5 fix for the wedged
+    chip claim that cost round 4 its flagship number — it must fire from
+    a TIMER THREAD (SIGALRM can't: the hang sits in a C call), write the
+    distinct error, and hard-exit."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _run(self, code):
+        import subprocess
+
+        return subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=20, cwd=self.REPO,
+            env=dict(os.environ, PYTHONPATH=self.REPO))
+
+    def test_fires_writes_error_and_exits_3(self, tmp_path):
+        import json
+        import time
+
+        out = tmp_path / "result.json"
+        t0 = time.monotonic()
+        p = self._run(
+            "from kubernetes1_tpu.workloads.benchguard import "
+            "device_acquisition_watchdog\n"
+            f"device_acquisition_watchdog({str(out)!r}, 0.3)\n"
+            "import time; time.sleep(30)\n")  # models the stuck claim
+        assert p.returncode == 3
+        assert time.monotonic() - t0 < 10  # fast-fail, not the sleep(30)
+        assert json.load(open(out))["error"] == "device acquisition timeout"
+
+    def test_cancel_stands_down(self, tmp_path):
+        out = tmp_path / "result.json"
+        p = self._run(
+            "from kubernetes1_tpu.workloads.benchguard import "
+            "device_acquisition_watchdog\n"
+            f"t = device_acquisition_watchdog({str(out)!r}, 0.3)\n"
+            "t.cancel()\n"                    # claim succeeded
+            "import time; time.sleep(0.6)\n"  # past the timeout
+            "print('survived')\n")
+        assert p.returncode == 0 and "survived" in p.stdout
+        assert not out.exists()
