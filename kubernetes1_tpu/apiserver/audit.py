@@ -22,6 +22,8 @@ import time
 import urllib.request
 from typing import Dict, List, Optional
 
+from ..utils.logutil import RateLimitedReporter
+
 LEVEL_NONE = "None"
 LEVEL_METADATA = "Metadata"
 LEVEL_REQUEST = "Request"
@@ -99,6 +101,7 @@ class WebhookAuditBackend:
         self.timeout = timeout
         self._buf: List[dict] = []
         self._lock = threading.Lock()
+        self._drop_reporter = RateLimitedReporter("audit")
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -133,8 +136,10 @@ class WebhookAuditBackend:
                 headers={"Content-Type": "application/json"}, method="POST")
             with urllib.request.urlopen(req, timeout=self.timeout):
                 pass
-        except Exception:  # noqa: BLE001 — audit sink down: drop, don't block
-            self.dropped += len(batch)
+        except Exception as e:  # noqa: BLE001 — audit sink down: drop, don't block
+            with self._lock:
+                self.dropped += len(batch)
+            self._drop_reporter.report(f"webhook sink: {e}", n=len(batch))
 
     def stop(self):
         self._stop.set()
@@ -146,7 +151,7 @@ class WebhookAuditBackend:
 def build_entry(level: str, user: str, verb: str, resource: str, ns: str,
                 name: str, request_obj: Optional[dict] = None,
                 response_obj: Optional[dict] = None) -> dict:
-    entry = {"ts": time.time(), "level": level, "user": user, "verb": verb,
+    entry = {"ts": time.time(), "level": level, "user": user, "verb": verb,  # ktpulint: ignore[KTPU005] audit-log wall time
              "resource": resource, "ns": ns, "name": name}
     if level in (LEVEL_REQUEST, LEVEL_REQUEST_RESPONSE) \
             and request_obj is not None:

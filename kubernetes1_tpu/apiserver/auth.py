@@ -200,7 +200,7 @@ def mint_oidc_token(key: str, issuer: str, audience: str, subject: str,
 
     header = b64e(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
     claims = {"iss": issuer, "aud": audience, "sub": subject,
-              "exp": _time.time() + ttl, "groups": groups or []}
+              "exp": _time.time() + ttl, "groups": groups or []}  # ktpulint: ignore[KTPU005] token expiry is epoch wall time
     claims.update(extra_claims or {})
     payload = b64e(_json.dumps(claims).encode())
     sig = _hmac.new(key.encode(), f"{header}.{payload}".encode(),
@@ -304,7 +304,7 @@ class BootstrapTokenAuthenticator:
             try:
                 import time as _time
 
-                if parse_iso(expiry) < _time.time():
+                if parse_iso(expiry) < _time.time():  # ktpulint: ignore[KTPU005] compares an API ISO timestamp
                     return None
             except ValueError:
                 return None  # unparseable expiry = unusable token

@@ -431,8 +431,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 err = ApiError(str(e))
                 self._send_error(err)
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # client connection already gone
 
     def do_GET(self):
         self._handle("GET")
@@ -680,8 +680,8 @@ class _Handler(BaseHTTPRequestHandler):
             w.stop()
             try:
                 self.wfile.write(b"0\r\n\r\n")
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # watcher hung up mid-stream
             self.close_connection = True
 
     def _write_chunk(self, data: bytes):

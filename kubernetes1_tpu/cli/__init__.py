@@ -285,7 +285,7 @@ class CLI:
             if "DaemonSet" in owners and not args.force:
                 continue
             pending.append(p)
-        deadline = time.time() + getattr(args, "timeout", 60)
+        deadline = time.monotonic() + getattr(args, "timeout", 60)
         blocked: dict = {}
         while pending:
             still = []
@@ -300,7 +300,7 @@ class CLI:
                     still.append(p)
                     blocked[p.metadata.name] = str(e)
             pending = still
-            if not pending or time.time() >= deadline:
+            if not pending or time.monotonic() >= deadline:
                 break
             time.sleep(1.0)
         if pending:
@@ -573,8 +573,8 @@ class CLI:
         if plural != "deployments":
             raise SystemExit("error: rollout supports deployments")
         if args.action == "status":
-            deadline = time.time() + args.timeout
-            while time.time() < deadline:
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
                 d = self.cs.deployments.get(name, self.ns)
                 want = d.spec.replicas or 0
                 if (d.status.observed_generation >= d.metadata.generation
@@ -973,9 +973,9 @@ class CLI:
     def wait(self, args):
         plural, name = split_target([args.target])
         cond = args.condition.removeprefix("condition=").lower()
-        deadline = time.time() + args.timeout
+        deadline = time.monotonic() + args.timeout
         client = self.cs.resource(plural)
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             try:
                 obj = client.get(name, self.ns)
             except NotFound:

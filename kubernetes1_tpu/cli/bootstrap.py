@@ -38,6 +38,7 @@ join, on another host:
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import secrets as _secrets
@@ -87,9 +88,9 @@ def _spawn(command: List[str], log_path: str) -> subprocess.Popen:
 
 
 def _wait_healthy(cs: Clientset, timeout: float = 30.0):
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     last = None
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         try:
             cs.api.request("GET", "/healthz")
             return
@@ -125,8 +126,8 @@ def bootstrap_node_credential(server: str, join_token: str, node_name: str,
             bcs.certificatesigningrequests.create(csr, "")
         except ApiError as e:
             raise SystemExit(f"error: CSR create failed: {e}")
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             try:
                 cur = bcs.certificatesigningrequests.get(csr.metadata.name, "")
             except NotFound:
@@ -190,8 +191,8 @@ def init(args) -> int:
                 f"(state in {d}; stop it via pids.json before re-running init)")
         except SystemExit:
             raise
-        except Exception:  # noqa: BLE001 — nothing listening on this proto
-            pass
+        except (ApiError, OSError, http.client.HTTPException):
+            pass  # nothing (or not an apiserver) listening on this proto
         finally:
             probe.close()
 
@@ -284,7 +285,7 @@ def init(args) -> int:
         "usage-bootstrap-authentication": "true",
         # kubeadm default: join tokens expire (24h) — a console-printed
         # credential must not authenticate forever
-        "expiration": to_iso(time.time() + ttl_s),
+        "expiration": to_iso(time.time() + ttl_s),  # ktpulint: ignore[KTPU005] user-visible token expiry
     })
     sec.metadata.name = f"bootstrap-token-{token_id}"
     cs.secrets.create(sec, "kube-system")
@@ -356,8 +357,8 @@ def init(args) -> int:
     ]
     pids["kubelet"] = _spawn(kubelet_cmd, os.path.join(d, "kubelet.log")).pid
     _write(os.path.join(d, "pids.json"), json.dumps(pids), mode=0o644)
-    deadline = time.time() + 30
-    while time.time() < deadline:
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
         try:
             if any(c.type == t.NODE_READY and c.status == "True"
                    for c in cs.nodes.get(node_name, "").status.conditions):
@@ -408,9 +409,9 @@ def join(args) -> int:
     # confirm the node goes Ready under its CSR-issued x509 identity
     cs = Clientset(args.server, ca_file=ca_path,
                    cert_file=kubelet_crt, key_file=kubelet_key)
-    deadline = time.time() + 30
+    deadline = time.monotonic() + 30
     ready = False
-    while time.time() < deadline and not ready:
+    while time.monotonic() < deadline and not ready:
         try:
             ready = any(c.type == t.NODE_READY and c.status == "True"
                         for c in cs.nodes.get(node_name, "").status.conditions)

@@ -17,6 +17,8 @@ from typing import Dict
 
 from ..api import types as t
 from ..machinery import now_iso
+from ..utils.logutil import RateLimitedReporter
+from ..utils import locksan
 from .clientset import Clientset
 
 
@@ -25,11 +27,12 @@ class EventRecorder:
                  max_cached: int = 4096, buffer: int = 2048):
         self.cs = clientset
         self.component = component
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("EventRecorder._lock")
         self._seen: Dict[tuple, str] = {}  # aggregation key -> event name
         self._max = max_cached
         self._q: "queue.Queue" = queue.Queue(maxsize=buffer)
         self._worker: threading.Thread = None  # started on first event
+        self._drop_reporter = RateLimitedReporter(f"events({component})")
 
     def event(self, obj, event_type: str, reason: str, message: str):
         """Record an event about obj; repeats bump count instead of piling
@@ -110,8 +113,11 @@ class EventRecorder:
             for it, n, last in batch.values():  # dicts keep insertion order
                 try:
                     self._send(*it, repeat=n, last_now=last)
-                except Exception:  # noqa: BLE001 — events are best-effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — events are best-effort
+                    # rate-limited: during an apiserver outage EVERY batch
+                    # entry fails — one summary line per window, not one
+                    # line per event, or the flood buries real diagnostics
+                    self._drop_reporter.report(f"last {it[2]}: {e}", n=n)
             if fence is not None:
                 fence.set()
 

@@ -18,6 +18,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional
 
 from ..machinery import ApiError, TooOldResourceVersion
+from ..utils import locksan
 from .clientset import Clientset, ResourceClient
 
 
@@ -36,7 +37,7 @@ class SharedInformer:
         self.field_selector = field_selector
         self.resync_period = resync_period
         self._cache: Dict[str, Any] = {}
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("SharedInformer._lock")
         self._handlers: List[Dict[str, Callable]] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -192,7 +193,7 @@ class InformerFactory:
     def __init__(self, clientset: Clientset):
         self.clientset = clientset
         self._informers: Dict[tuple, SharedInformer] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("InformerFactory._lock")
 
     def informer(
         self,

@@ -8,13 +8,14 @@ lease_duration since the last observed renewal.  Non-leaders hot-standby.
 
 from __future__ import annotations
 
+import http.client
 import threading
 import time
 import traceback
 from typing import Callable, Optional
 
 from ..api import types as t
-from ..machinery.errors import AlreadyExists, Conflict, NotFound
+from ..machinery.errors import AlreadyExists, ApiError, Conflict, NotFound
 from ..machinery.meta import now_iso_micro, parse_iso
 from .clientset import Clientset
 
@@ -123,7 +124,7 @@ class LeaderElector:
 
     def _expired(self, lease: t.Lease) -> bool:
         renew = parse_iso(lease.renew_time)  # UTC, microsecond resolution
-        return (time.time() - renew) > max(
+        return (time.time() - renew) > max(  # ktpulint: ignore[KTPU005] cross-process lease timestamp
             float(lease.lease_duration_seconds), self.lease_duration
         )
 
@@ -133,5 +134,5 @@ class LeaderElector:
             if lease.holder_identity == self.identity:
                 lease.holder_identity = ""
                 self.cs.leases.update(lease)
-        except Exception:  # noqa: BLE001
-            pass
+        except (ApiError, OSError, http.client.HTTPException):
+            pass  # best-effort release on shutdown; lease expires anyway

@@ -95,7 +95,7 @@ class WatchStream:
             pass
         try:
             self._conn.close()
-        except Exception:  # noqa: BLE001
+        except OSError:
             pass
 
 
@@ -191,7 +191,7 @@ class ApiClient:
         if conn is not None:
             try:
                 conn.close()
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
             self._local.conn = None
 
@@ -263,7 +263,7 @@ class ApiClient:
                 if conn is not None:
                     try:
                         conn.close()
-                    except Exception:  # noqa: BLE001
+                    except OSError:
                         pass
                 self._rotate(idx)
                 last_exc = e

@@ -142,7 +142,7 @@ class CertificateController(Controller):
         from ..machinery.meta import parse_iso
 
         try:
-            age = _time.time() - parse_iso(csr.metadata.creation_timestamp)
+            age = _time.time() - parse_iso(csr.metadata.creation_timestamp)  # ktpulint: ignore[KTPU005] vs API timestamp
         except (ValueError, TypeError):
             return False
         ttl = (self.SIGNED_TTL_S

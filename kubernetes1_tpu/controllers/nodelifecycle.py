@@ -64,7 +64,7 @@ class NodeLifecycleController:
         return None
 
     def _monitor(self):
-        now = time.time()
+        now = time.time()  # ktpulint: ignore[KTPU005] vs heartbeat API timestamps
         for node in self.nodes.list():
             name = node.metadata.name
             cond = self._ready_condition(node)

@@ -24,6 +24,8 @@ import struct
 import threading
 from typing import List, Optional, Tuple
 
+from ..utils.logutil import RateLimitedReporter
+
 DEFAULT_DNS_IP = "127.0.51.1"   # loopback alias, systemd-resolved style
 CLUSTER_DOMAIN = "cluster.local"
 
@@ -134,6 +136,7 @@ class ClusterDNS:
         # at most 16 in-flight upstream forwards (each may block up to the
         # 2s upstream timeout); beyond that, _answer SERVFAILs immediately
         self._forward_slots = threading.Semaphore(16)
+        self._drop_reporter = RateLimitedReporter("dns")
 
     @staticmethod
     def _host_upstream(self_ip: str) -> str:
@@ -221,7 +224,10 @@ class ClusterDNS:
                 return
             try:
                 resp = self._answer(data, peer)
-            except Exception:  # noqa: BLE001 — a bad packet must not kill DNS
+            except Exception as e:  # noqa: BLE001 — a bad packet must not kill DNS
+                # rate-limited: a spoofed-garbage flood must not turn the
+                # single receive loop into a stderr-writing loop
+                self._drop_reporter.report(f"malformed query from {peer}: {e}")
                 continue
             if resp is not None:
                 try:

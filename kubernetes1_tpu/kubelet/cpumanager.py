@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ..api import types as t
+from ..utils import locksan
 from ..utils.quantity import parse_quantity
 from .eviction import QOS_GUARANTEED, qos_class
 
@@ -212,7 +213,7 @@ class CPUManager:
                  state_path: str = "",
                  reserved_cpus: Optional[int] = None):
         self.policy = policy
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("CPUManager._lock")
         # called (with no args, outside the lock) whenever the shared pool
         # changes — the kubelet re-pins running shared containers so they
         # never keep running on a newly-exclusive core
@@ -319,8 +320,9 @@ class CPUManager:
         if cb is not None:
             try:
                 cb()
-            except Exception:  # noqa: BLE001 — repinning is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — repinning is best-effort
+                print(f"cpumanager: pool-change callback failed: {e}",
+                      file=sys.stderr)
 
     def release_pod(self, uid: str):
         """Return the pod's exclusive cpus to the shared pool (pod deleted

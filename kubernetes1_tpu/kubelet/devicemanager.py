@@ -32,6 +32,7 @@ from typing import Dict, List, NamedTuple, Optional
 from ..api import types as t
 from ..deviceplugin.api import ContainerSpec, PluginClient, resource_from_socket
 from ..machinery.scheme import from_dict
+from ..utils import locksan
 from ..utils.metrics import Histogram
 
 class AdmitResult(NamedTuple):
@@ -121,7 +122,7 @@ class DeviceManager:
     def __init__(self, plugin_dir: str, poll_interval: float = 0.5):
         self.plugin_dir = plugin_dir
         self.poll_interval = poll_interval
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("DeviceManager._lock")
         self._endpoints: Dict[str, Endpoint] = {}  # resource -> endpoint
         self._store: Dict[str, List[dict]] = {}  # resource -> device dicts
         self._admit_cache: Dict[str, dict] = {}  # pod uid -> admit result
@@ -243,7 +244,8 @@ class DeviceManager:
         """
         if not pod.spec.extended_resources:
             return AdmitResult(True, "", False)
-        cached = self._admit_cache.get(pod.metadata.uid)
+        with self._lock:
+            cached = self._admit_cache.get(pod.metadata.uid)
         if cached is not None:
             return AdmitResult(
                 cached.get("allowed", False), cached.get("reason", ""), False
@@ -288,7 +290,8 @@ class DeviceManager:
                     False, result.get("reason", "plugin denied admission"), False
                 )
         self.allocation_latency.observe(time.monotonic() - start)
-        self._admit_cache[pod.metadata.uid] = {"allowed": True, "reason": ""}
+        with self._lock:
+            self._admit_cache[pod.metadata.uid] = {"allowed": True, "reason": ""}
         return AdmitResult(True, "", False)
 
     def init_container(self, pod: t.Pod, container: t.Container) -> ContainerSpec:
@@ -315,4 +318,5 @@ class DeviceManager:
 
     def forget_pod(self, pod_uid: str):
         """Lazy per-pod cache pruning (manager.go:293-310)."""
-        self._admit_cache.pop(pod_uid, None)
+        with self._lock:
+            self._admit_cache.pop(pod_uid, None)

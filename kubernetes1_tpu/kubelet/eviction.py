@@ -12,11 +12,11 @@ OOMs the libtpu runtime.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..api import types as t
+from ..utils import locksan
 from ..utils.quantity import parse_quantity
 
 QOS_GUARANTEED = "Guaranteed"
@@ -98,7 +98,7 @@ class EvictionManager:
         self.list_pods = list_pods
         self.pressure_transition_period = pressure_transition_period
         self._pressure_until: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("EvictionManager._lock")
 
     # ------------------------------------------------------------ conditions
 

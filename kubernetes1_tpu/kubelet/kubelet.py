@@ -338,7 +338,7 @@ class Kubelet:
                 continue
             try:
                 self.runtime.set_container_affinity(cid, pool)
-            except Exception:  # noqa: BLE001 — best-effort, container may be gone
+            except (OSError, RuntimeError, KeyError):  # best-effort, container may be gone
                 continue
 
     def _reconcile_runtime(self):
@@ -956,8 +956,8 @@ class Kubelet:
                     if cid is not None:
                         try:
                             self.runtime.remove_container(cid)
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except (OSError, RuntimeError, KeyError):
+                            pass  # cleanup of a half-created container is best-effort
                     if self._is_terminal_config_error(e):
                         self._set_failed(pod, "CreateContainerConfigError",
                                          f"init {container.name}: {e}")
@@ -1235,8 +1235,8 @@ class Kubelet:
                 if cid is not None:
                     try:
                         self.runtime.remove_container(cid)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except (OSError, RuntimeError, KeyError):
+                        pass  # cleanup of a half-created container is best-effort
                 if self._is_terminal_config_error(e):
                     self._set_failed(pod, "CreateContainerConfigError",
                                      f"container {container.name}: {e}")

@@ -16,6 +16,7 @@ import urllib.request
 from typing import Callable, Dict, Optional, Tuple
 
 from ..api import types as t
+from ..utils import locksan
 
 SUCCESS = "success"
 FAILURE = "failure"
@@ -116,7 +117,7 @@ class ProberManager:
         # container_running(pod_uid, container_name) -> bool
         self.exec_in_container = exec_in_container
         self.container_running = container_running
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("ProberManager._lock")
         self._workers: Dict[Tuple[str, str, str], _Worker] = {}
         self._results: Dict[Tuple[str, str, str], str] = {}
 

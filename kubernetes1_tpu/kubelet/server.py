@@ -235,8 +235,8 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             try:
                 self._send(500, {"error": str(e)})
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # client already disconnected
 
     def do_POST(self):
         parsed = urlparse(self.path)
@@ -266,8 +266,8 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             try:
                 self._send(500, {"error": str(e)})
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # client already disconnected
 
 
 def _pump_exec(sock, proc, master_fd):

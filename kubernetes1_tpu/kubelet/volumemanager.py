@@ -36,13 +36,13 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..api import types as t
 from ..machinery import NotFound
+from ..utils import locksan
 
 SA_TOKEN_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
 SA_TOKEN_VOLUME = "ktpu-sa-token"
@@ -75,7 +75,7 @@ class VolumeManager:
         self.root = root_dir
         self.node_name = node_name
         self.refresh_interval = refresh_interval
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("VolumeManager._lock")
         self._mounted: Dict[str, Dict[str, MountedVolume]] = {}  # uid -> name -> mv
         self._last_refresh: Dict[str, float] = {}
 

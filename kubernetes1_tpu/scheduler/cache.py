@@ -12,11 +12,11 @@ async bind lands (ref: scheduler.go:365 assume + cache AddPod).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Set
 
 from ..api import types as t
+from ..utils import locksan
 from ..utils.quantity import parse_milli, parse_quantity
 
 DEFAULT_NODE_PODS = 110
@@ -24,7 +24,7 @@ DEFAULT_NODE_PODS = 110
 # Process-global monotonic generation source. Per-NodeInfo counters would
 # restart at 1 when a node is deleted and re-added under the same name,
 # letting stale EquivalenceCache entries falsely hit for the new node.
-_generation_lock = threading.Lock()
+_generation_lock = locksan.make_lock("scheduler.cache._generation_lock")
 _generation_counter = 0
 
 
@@ -219,7 +219,7 @@ class SchedulerCache:
     ASSUME_EXPIRY_SECONDS = 30.0
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("SchedulerCache._lock")
         self._nodes: Dict[str, NodeInfo] = {}
         self._assumed: Dict[str, float] = {}  # pod key -> deadline
         self._pod_node: Dict[str, str] = {}  # pod key -> node name

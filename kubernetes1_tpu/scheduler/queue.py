@@ -13,10 +13,12 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from ..utils import locksan
+
 
 class SchedulingQueue:
     def __init__(self, base_backoff: float = 0.1, max_backoff: float = 10.0):
-        self._cond = threading.Condition()
+        self._cond = locksan.make_condition(name="SchedulingQueue._cond")
         self._heap: list = []  # (-priority, seq, key)
         self._entries: set = set()
         self._seq = 0

@@ -28,6 +28,7 @@ from ..api import types as t
 from ..client import Clientset, EventRecorder, InformerFactory
 from ..machinery import ApiError, Conflict, NotFound
 from ..machinery.scheme import global_scheme, to_dict
+from ..utils import locksan
 
 
 def _json_key(obj) -> str:
@@ -83,7 +84,7 @@ class Scheduler:
         self.gang_wait_seconds = gang_wait_seconds
         self._gang_first_seen: Dict[Tuple[str, str], float] = {}
         self._gang_victims: Dict[Tuple[str, str], set] = {}
-        self._gang_lock = threading.Lock()
+        self._gang_lock = locksan.make_lock("Scheduler._gang_lock")
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self.equiv_cache = EquivalenceCache()
@@ -121,7 +122,7 @@ class Scheduler:
         # reserved for the preemptor until it binds or the claim expires
         # (ref: NominatedNodeAnnotationKey + the later PodNominator)
         self._nominations: Dict[str, Tuple[str, int, float]] = {}
-        self._nominations_lock = threading.Lock()
+        self._nominations_lock = locksan.make_lock("Scheduler._nominations_lock")
         self.nomination_ttl = 60.0
         # Sticky flag: inter-pod affinity's symmetry check costs an O(pods)
         # pass per attempt — pay it only once the cluster has ever seen a

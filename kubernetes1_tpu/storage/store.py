@@ -147,7 +147,7 @@ class Store:
         with self._lock:
             return self._rev
 
-    def _replay_wal(self, path: str):
+    def _replay_wal(self, path: str):  # ktpulint: ignore[KTPU001] construction-time, pre-concurrency
         if not os.path.exists(path):
             return
         with open(path) as f:
@@ -173,7 +173,7 @@ class Store:
         # Watches cannot resume across restart below the replayed revision.
         self._compacted_rev = self._rev
 
-    def _commit(self, typ: str, key: str, obj: Dict[str, Any]):
+    def _commit_locked(self, typ: str, key: str, obj: Dict[str, Any]):
         """Must hold lock. Assigns the next revision and fans out."""
         self._rev += 1
         rev = self._rev
@@ -222,7 +222,7 @@ class Store:
         with self._lock:
             if key in self._data:
                 raise AlreadyExists(f"{key} already exists")
-            _, stored = self._commit(ADDED, key, encoded)
+            _, stored = self._commit_locked(ADDED, key, encoded)
             return self._decode(stored)
 
     def get(self, key: str) -> Any:
@@ -270,7 +270,7 @@ class Store:
                 raise Conflict(
                     f"{key}: resourceVersion mismatch (have {cur_rev}, want {expect})"
                 )
-            _, stored = self._commit(MODIFIED, key, encoded)
+            _, stored = self._commit_locked(MODIFIED, key, encoded)
             return self._decode(stored)
 
     def guaranteed_update(self, key: str, update_fn: Callable[[Any], Any]) -> Any:
@@ -297,7 +297,7 @@ class Store:
             cur_rev, obj = ent
             if expect_rv and str(cur_rev) != expect_rv:
                 raise Conflict(f"{key}: resourceVersion mismatch")
-            _, stored = self._commit(DELETED, key, obj)
+            _, stored = self._commit_locked(DELETED, key, obj)
             return self._decode(stored)
 
     # ------------------------------------------------------------------ watch

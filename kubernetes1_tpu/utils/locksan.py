@@ -1,0 +1,316 @@
+"""Runtime lock-order + hold-time sanitizer for the threaded control plane.
+
+Every hand-rolled thread+lock component (scheduler cache/queue, informers,
+workqueues, kubelet managers, prober, eviction) creates its locks through
+this factory.  With `KTPU_LOCKSAN` unset (production) the factory returns
+plain `threading.Lock`/`RLock`/`Condition` objects — zero overhead, zero
+behavior change.  With `KTPU_LOCKSAN=1` (the test suite turns it on in
+`tests/conftest.py`) every acquisition is tracked:
+
+- **Lock-order cycles.**  Locks are grouped into classes by NAME (the
+  lockdep model: "SchedulerCache._lock" is one class across every
+  instance).  A per-thread stack records what each thread holds; each
+  acquisition adds held-class -> acquired-class edges to a global graph.
+  An edge that closes a cycle means two threads can interleave into a
+  deadlock — `LockOrderViolation` is raised at acquire time, with the
+  cycle, while both stacks still exist, instead of a silent freeze in
+  production at 3am.
+- **Hold-time budget.**  A lock held longer than `KTPU_LOCKSAN_BUDGET`
+  seconds (default 10) raises `HoldTimeViolation` at release.  A lock
+  held across a blocking call is the #1 way orchestration-layer stalls
+  tax accelerator goodput: every thread that needs the lock (heartbeats,
+  admission, binding) convoys behind the holder.
+
+`threading.Condition.wait()` cooperates for free: waiting releases the
+underlying (wrapped) lock through the factory lock's own release/acquire
+path, so blocked-in-wait time is never charged as hold time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+
+class LockSanError(RuntimeError):
+    """Base class for sanitizer findings."""
+
+
+class LockOrderViolation(LockSanError):
+    """Acquiring this lock here can deadlock against another thread."""
+
+
+class HoldTimeViolation(LockSanError):
+    """A lock was held longer than the configured budget."""
+
+
+def enabled() -> bool:
+    return os.environ.get("KTPU_LOCKSAN", "") not in ("", "0")
+
+
+def hold_budget() -> float:
+    try:
+        return float(os.environ.get("KTPU_LOCKSAN_BUDGET", "10.0"))
+    except ValueError:
+        return 10.0
+
+
+class _OrderGraph:
+    """Global directed graph over lock classes: edge A->B means some
+    thread acquired B while holding A.  A path B..->A at the moment a
+    thread holding A acquires B is a potential deadlock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}
+
+    def check_and_add(self, frm: str, to: str) -> Optional[List[str]]:
+        """Add edge frm->to; return the cycle path if it closes one."""
+        if frm == to:
+            # same class, different instances, nested: A(1)->A(2) in one
+            # thread deadlocks against A(2)->A(1) in another
+            return [frm, to]
+        with self._lock:
+            if to in self._edges.get(frm, ()):
+                return None
+            path = self._path(to, frm)
+            if path is not None:
+                return [frm] + path
+            self._edges.setdefault(frm, set()).add(to)
+        return None
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def reset(self):
+        with self._lock:
+            self._edges.clear()
+
+
+_graph = _OrderGraph()
+_tls = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def reset_order_graph():
+    """Tests only: forget learned ordering between cases."""
+    _graph.reset()
+
+
+_owners_lock = threading.Lock()
+
+
+class _SanBase:
+    """Shared acquire/release tracking for Lock and RLock wrappers."""
+
+    _reentrant = False
+
+    def __init__(self, inner, name: str, budget: Optional[float]):
+        self._inner = inner
+        self.name = name
+        self._budget = budget
+        # live acquisitions of THIS instance as (holder_stack, entry) pairs,
+        # so a release from a different thread (legal Lock handoff pattern)
+        # can still find and retire the acquirer's stack entry instead of
+        # leaking it into false held-class edges forever
+        self._owners: List[tuple] = []
+
+    # ------------------------------------------------------------- tracking
+
+    def _before_acquire(self, blocking: bool = True):
+        stack = _held_stack()
+        for entry in list(stack):
+            if entry[0] is self:
+                if self._reentrant or not blocking:
+                    # RLock re-entry is legal; a non-blocking re-acquire
+                    # just returns False
+                    return
+                # blocking re-acquire of a non-reentrant lock this thread
+                # already holds: a GUARANTEED deadlock — report it instead
+                # of freezing, which is the sanitizer's whole job
+                raise LockOrderViolation(
+                    f"self-deadlock: thread re-acquiring non-reentrant "
+                    f"lock {self.name!r} it already holds")
+        checked: Set[str] = set()
+        for entry in list(stack):
+            lock = entry[0]
+            if lock.name in checked:
+                continue
+            checked.add(lock.name)
+            cycle = _graph.check_and_add(lock.name, self.name)
+            if cycle is not None:
+                raise LockOrderViolation(
+                    f"lock-order cycle: acquiring {self.name!r} while "
+                    f"holding {lock.name!r} closes the cycle "
+                    f"{' -> '.join(cycle)} (another thread acquires these "
+                    f"in the opposite order)")
+
+    def _after_acquire(self):
+        stack = _held_stack()
+        entry = (self, time.monotonic())
+        stack.append(entry)
+        with _owners_lock:
+            self._owners.append((stack, entry))
+
+    def _retire_mine(self):
+        """Pop THIS thread's most recent live entry for this lock.  Must
+        run BEFORE the inner release: once the inner lock is free, a
+        contending waiter's _after_acquire appends its own entry and a
+        blind LIFO pop would retire the WAITER's entry — leaving a stale
+        held-state on the releaser (false lock-order edges) and charging
+        two threads' hold time to one release."""
+        my_stack = _held_stack()
+        with _owners_lock:
+            for i in range(len(self._owners) - 1, -1, -1):
+                stack, entry = self._owners[i]
+                if stack is my_stack:
+                    del self._owners[i]
+                    stack.remove(entry)
+                    return entry
+        return None
+
+    def _retire_oldest(self):
+        """Cross-thread handoff (acquire in A, release in B): retire the
+        OLDEST live entry.  Runs after the inner release; popping from the
+        front is immune to the waiter-append race (appends go to the
+        end)."""
+        with _owners_lock:
+            if not self._owners:
+                return None
+            stack, entry = self._owners.pop(0)
+        try:
+            stack.remove(entry)
+        except ValueError:
+            pass  # holder's stack already unwound
+        return entry
+
+    def _check_budget(self, entry, check: bool = True):
+        if entry is None or not check:
+            return
+        held = time.monotonic() - entry[1]
+        budget = self._budget if self._budget is not None else hold_budget()
+        if held > budget:
+            raise HoldTimeViolation(
+                f"{self.name!r} held for {held:.3f}s "
+                f"(budget {budget:.3f}s) — a blocking call under "
+                f"this lock convoys every other thread")
+
+    # --------------------------------------------------------- lock protocol
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # Trylocks are exempt from ordering (the lockdep rule): a
+        # non-blocking acquire cannot deadlock its caller, and recording
+        # its edges would poison the graph against the deadlock-AVOIDANCE
+        # pattern trylock exists for.
+        if blocking:
+            self._before_acquire(blocking)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._after_acquire()
+        return got
+
+    def release(self):
+        entry = self._retire_mine()
+        self._inner.release()  # raises on erroneous release, as the inner does
+        if entry is None:
+            entry = self._retire_oldest()  # legal cross-thread handoff
+        self._check_budget(entry)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        entry = self._retire_mine()
+        self._inner.release()
+        if entry is None:
+            entry = self._retire_oldest()
+        # When the critical section is already unwinding an exception, a
+        # HoldTimeViolation raised here would REPLACE it and hide the real
+        # failure — stay silent and let the original propagate.
+        self._check_budget(entry, check=exc_type is None)
+        return False
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") else None
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r} wrapping {self._inner!r}>"
+
+
+class SanLock(_SanBase):
+    pass
+
+
+class SanRLock(_SanBase):
+    """RLock wrapper.  The _release_save/_acquire_restore/_is_owned trio
+    lets threading.Condition fully release a multiply-acquired RLock while
+    waiting; tracking hooks keep hold-time honest across the wait."""
+
+    _reentrant = True
+
+    def _release_save(self):
+        # Retire BEFORE the inner release (see _retire_mine), and with no
+        # hold-time check: raising here would leave Condition.wait's
+        # caller releasing an already-released lock, and the interesting
+        # hold time (post-wakeup critical section) is charged by the
+        # normal release.  The inner RLock releases ALL recursion levels
+        # at once, so every one of this thread's entries must retire with
+        # it — a partial retire would leave pre-wait timestamps behind and
+        # charge the whole wait as hold time at the final release.
+        levels = 0
+        while self._retire_mine() is not None:
+            levels += 1
+        return (self._inner._release_save(), levels)
+
+    def _acquire_restore(self, state):
+        inner_state, levels = state
+        self._before_acquire()
+        self._inner._acquire_restore(inner_state)
+        for _ in range(max(levels, 1)):  # fresh post-wakeup timestamps
+            self._after_acquire()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def make_lock(name: str, hold_budget: Optional[float] = None):
+    """A named Lock: plain threading.Lock when the sanitizer is off."""
+    if not enabled():
+        return threading.Lock()
+    return SanLock(threading.Lock(), name, hold_budget)
+
+
+def make_rlock(name: str, hold_budget: Optional[float] = None):
+    if not enabled():
+        return threading.RLock()
+    return SanRLock(threading.RLock(), name, hold_budget)
+
+
+def make_condition(lock=None, name: str = "", hold_budget: Optional[float] = None):
+    """A Condition whose underlying lock goes through the sanitizer.
+    Waiting releases the wrapped lock via its own release path, so time
+    blocked in wait() is not charged against the hold budget."""
+    if not enabled():
+        return threading.Condition(lock)
+    if lock is None:
+        lock = make_rlock(name or "condition", hold_budget)
+    return threading.Condition(lock)

@@ -1,0 +1,38 @@
+"""Small logging helpers for best-effort paths.
+
+`RateLimitedReporter` is the shared shape for "count every drop, emit at
+most one summary line per window": best-effort subsystems (event sink,
+DNS receive loop, audit webhook) must not be silent about failures, but
+a per-occurrence print turns an outage or a packet flood into a stderr
+flood exactly when the operator is reading the logs.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class RateLimitedReporter:
+    """Count occurrences; print one `<prefix>: dropped N (<detail>)`
+    summary line per `window` seconds.  The first occurrence after a
+    quiet period reports immediately, so a single failure is never
+    silent.  Intended for use from one thread at a time (each subsystem's
+    own loop); a lost increment under rare concurrent use only undercounts
+    a log line."""
+
+    def __init__(self, prefix: str, window: float = 5.0, stream=None):
+        self.prefix = prefix
+        self.window = window
+        self.stream = stream
+        self._count = 0
+        self._last = 0.0
+
+    def report(self, detail: str, n: int = 1):
+        self._count += n
+        now = time.monotonic()
+        if now - self._last >= self.window:
+            print(f"{self.prefix}: dropped {self._count} ({detail})",
+                  file=self.stream or sys.stderr)
+            self._count = 0
+            self._last = now

@@ -11,6 +11,7 @@ from __future__ import annotations
 import bisect
 import random
 import threading
+from .logutil import RateLimitedReporter
 from typing import Dict, List, Optional
 
 
@@ -144,6 +145,8 @@ class MetricsServer:
 
         registry_ref = registry
         extra_fns = dict(extra or {})  # name -> () -> float, appended as gauges
+        # a permanently-broken gauge fn must not print once per scrape
+        gauge_err_reporter = RateLimitedReporter("metrics", window=60.0)
         # /debug/pprof exposes thread stacks and a CPU sampler; the apiserver
         # authorizes it per-request, this bare server cannot — so default to
         # loopback-only (None = auto) unless the caller opts in explicitly
@@ -180,8 +183,9 @@ class MetricsServer:
                     for name, fn in extra_fns.items():
                         try:
                             text += f"# TYPE {name} gauge\n{name} {float(fn())}\n"
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as e:  # noqa: BLE001 — one bad gauge must not kill /metrics
+                            gauge_err_reporter.report(
+                                f"extra gauge {name}: {e}")
                     body = text.encode()
                     ctype = "text/plain; version=0.0.4"
                 else:

@@ -15,10 +15,12 @@ import threading
 import time
 from typing import Any, Hashable, Optional
 
+from . import locksan
+
 
 class WorkQueue:
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = locksan.make_condition(name="WorkQueue._cond")
         self._queue: list = []
         self._dirty: set = set()
         self._processing: set = set()
@@ -81,7 +83,7 @@ class DelayingQueue(WorkQueue):
         super().__init__()
         self._heap: list = []  # (ready_at, seq, item)
         self._seq = 0
-        self._timer_cond = threading.Condition()
+        self._timer_cond = locksan.make_condition(name="DelayingQueue._timer_cond")
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -124,7 +126,7 @@ class RateLimitingQueue(DelayingQueue):
         self._base = base_delay
         self._max = max_delay
         self._failures: dict = {}
-        self._fail_lock = threading.Lock()
+        self._fail_lock = locksan.make_lock("RateLimitingQueue._fail_lock")
 
     def add_rate_limited(self, item: Hashable):
         with self._fail_lock:

@@ -120,8 +120,8 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
             cost = step.lower(params, opt_state, tokens).compile().cost_analysis()
             if cost and cost.get("flops"):
                 exec_flops = float(cost["flops"])
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — cost_analysis is best-effort on some backends
+            print(f"llama_bench: cost_analysis unavailable: {e}")
 
         # barrier = float(loss): a device-to-host transfer of the step's
         # result.  block_until_ready alone is NOT a reliable fence on the

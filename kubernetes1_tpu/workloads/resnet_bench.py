@@ -62,8 +62,8 @@ def run(batch: int, steps: int, size: int, warmup: int = 2,
             cost = step.lower(params, opt_state, images, labels).compile().cost_analysis()
             if cost and cost.get("flops"):
                 flops_per_step = float(cost["flops"])
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — cost_analysis is best-effort on some backends
+            print(f"resnet_bench: cost_analysis unavailable: {e}")
         if not flops_per_step:
             flops_per_step = RESNET50_TRAIN_FLOPS_PER_IMG_224 * batch * (size / 224.0) ** 2
 

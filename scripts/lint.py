@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""CI lint gate: run ktpulint over kubernetes1_tpu/ and tools/.
+
+Prints findings as `file:line: PASS-ID message` (repo-relative) and exits
+non-zero when any exist.  `tests/test_lint_clean.py` runs the same check
+in tier-1, so the tree stays at zero findings.
+
+Usage: python scripts/lint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.ktpulint.engine import run_gate  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(run_gate(sys.argv[1:], rel_root=REPO))

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Runtime lock sanitizer (utils/locksan): every control-plane lock created
+# after this point (and in every spawned server subprocess, via env
+# inheritance) checks lock-order cycles and hold-time budgets.  setdefault
+# so `KTPU_LOCKSAN=0 pytest ...` can switch it off for A/B timing runs.
+os.environ.setdefault("KTPU_LOCKSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -64,6 +70,46 @@ def _ktpu_procs(marker: str = "") -> dict:
 
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "thread_leak_ok: test intentionally leaves background threads "
+        "running (opts out of the per-test thread-leak guard)")
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """After each test, no NEW non-daemon thread may survive: a leaked
+    non-daemon thread blocks interpreter exit (the process-level analog is
+    the leak-police below).  Daemon threads get a short grace too, purely
+    to keep one test's stragglers from being blamed on the next test's
+    baseline.  Opt out with @pytest.mark.thread_leak_ok for tests that
+    intentionally background work."""
+    import threading
+    import time
+
+    if request.node.get_closest_marker("thread_leak_ok"):
+        yield
+        return
+    # snapshot thread OBJECTS, not idents: CPython recycles idents after a
+    # thread exits, which would let a leaked thread hide behind a baseline
+    # thread's recycled id
+    before = set(threading.enumerate())
+    yield
+    def new_nondaemon():
+        return [th for th in threading.enumerate()
+                if th not in before and not th.daemon and th.is_alive()]
+    deadline = time.monotonic() + 2.0
+    leaked = new_nondaemon()
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = new_nondaemon()
+    assert not leaked, (
+        f"non-daemon thread(s) leaked by this test: "
+        f"{[th.name for th in leaked]} — join them or mark the test "
+        f"thread_leak_ok")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -1,0 +1,412 @@
+"""Unit tests for the ktpulint passes: every pass must fire on a minimal
+bad example AND stay quiet on the corresponding good one."""
+
+import textwrap
+
+from tools.ktpulint import lint_file
+
+
+def _lint(src: str):
+    return lint_file("<mem>", textwrap.dedent(src))
+
+
+def _ids(src: str):
+    return [f.pass_id for f in _lint(src)]
+
+
+# ----------------------------------------------------------- KTPU001 (locks)
+
+BAD_MUTATION = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            self._items.pop(k, None)  # no lock!
+"""
+
+GOOD_MUTATION = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            with self._lock:
+                self._items.pop(k, None)
+"""
+
+
+def test_ktpu001_fires_on_unlocked_mutation():
+    findings = _lint(BAD_MUTATION)
+    assert [f.pass_id for f in findings] == ["KTPU001"]
+    assert "_items" in findings[0].message
+
+
+def test_ktpu001_quiet_on_locked_mutation():
+    assert _ids(GOOD_MUTATION) == []
+
+
+def test_ktpu001_init_and_locked_suffix_exempt():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+                self._items["seed"] = 1
+
+            def put(self, k, v):
+                with self._lock:
+                    self._put_locked(k, v)
+
+            def _put_locked(self, k, v):
+                self._items[k] = v
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu001_factory_locks_recognized():
+    src = """
+        from kubernetes1_tpu.utils import locksan
+
+        class C:
+            def __init__(self):
+                self._lock = locksan.make_rlock("C._lock")
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                self._n = 0
+    """
+    assert _ids(src) == ["KTPU001"]
+
+
+def test_ktpu001_def_line_pragma_exempts_method():
+    src = BAD_MUTATION.replace(
+        "def drop(self, k):",
+        "def drop(self, k):  # ktpulint: ignore[KTPU001] single-threaded teardown")
+    assert _ids(src) == []
+
+
+# -------------------------------------------------------- KTPU002 (blocking)
+
+def test_ktpu002_fires_on_sleep_under_lock():
+    src = """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.5)
+    """
+    ids = _ids(src)
+    assert "KTPU002" in ids
+
+
+def test_ktpu002_quiet_on_sleep_outside_lock():
+    src = """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def poll(self):
+                with self._lock:
+                    self._n += 1
+                time.sleep(0.5)
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu002_def_line_pragma_exempts_method():
+    src = """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):  # ktpulint: ignore[KTPU002] lock is private to this test helper
+                with self._lock:
+                    time.sleep(0.5)
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu002_fires_on_thread_join_under_lock():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._worker = threading.Thread(target=print, daemon=True)
+
+            def stop(self):
+                with self._lock:
+                    self._worker.join()
+    """
+    assert "KTPU002" in _ids(src)
+
+
+# ------------------------------------------------------ KTPU003 (exceptions)
+
+def test_ktpu003_fires_on_bare_except():
+    src = """
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """
+    assert _ids(src) == ["KTPU003"]
+
+
+def test_ktpu003_fires_on_swallowed_broad_exception():
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """
+    assert _ids(src) == ["KTPU003"]
+
+
+def test_ktpu003_quiet_when_narrowed_or_handled():
+    src = """
+        import traceback
+
+        def f():
+            try:
+                g()
+            except OSError:
+                pass
+            try:
+                g()
+            except Exception:
+                traceback.print_exc()
+            try:
+                g()
+            except BaseException:
+                cleanup()
+                raise
+    """
+    assert _ids(src) == []
+
+
+# --------------------------------------------------------- KTPU004 (threads)
+
+def test_ktpu004_fires_on_undaemonized_unjoined_thread():
+    src = """
+        import threading
+
+        def f():
+            threading.Thread(target=print).start()
+    """
+    assert _ids(src) == ["KTPU004"]
+
+
+def test_ktpu004_quiet_on_daemon_kwarg():
+    src = """
+        import threading
+
+        def f():
+            threading.Thread(target=print, daemon=True).start()
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu004_quiet_on_daemon_attribute_or_join():
+    src = """
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.daemon = True
+                self._t.start()
+                w = threading.Thread(target=print)
+                w.start()
+                w.join()
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu004_annassign_handle_and_joined_collection():
+    src = """
+        import threading
+
+        class C:
+            def start(self):
+                self._t: threading.Thread = threading.Thread(target=print)
+                self._threads = []
+                self._threads.append(threading.Thread(target=print))
+
+            def stop(self):
+                self._t.join()
+                for th in self._threads:
+                    th.join(timeout=2)
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu004_join_in_other_method_of_same_class_counts():
+    src = """
+        import threading
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=2)
+    """
+    assert _ids(src) == []
+
+
+# ------------------------------------------------------- KTPU005 (wallclock)
+
+def test_ktpu005_fires_on_time_time():
+    src = """
+        import time
+
+        def deadline():
+            return time.time() + 30
+    """
+    assert _ids(src) == ["KTPU005"]
+
+
+def test_ktpu005_quiet_on_monotonic_and_pragma():
+    src = """
+        import time
+
+        def deadline():
+            return time.monotonic() + 30
+
+        def stamp():
+            return time.time()  # ktpulint: ignore[KTPU005] user-visible timestamp
+    """
+    assert _ids(src) == []
+
+
+# ------------------------------------------------------- KTPU006 (iteration)
+
+def test_ktpu006_fires_on_unlocked_iteration():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._m = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._m[k] = v
+
+            def dump(self):
+                return [v for v in self._m.values()]
+    """
+    assert "KTPU006" in _ids(src)
+
+
+def test_ktpu006_def_line_pragma_exempts_method():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._m = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._m[k] = v
+
+            def dump(self):  # ktpulint: ignore[KTPU006] single-threaded reporting path
+                return [v for v in self._m.values()]
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu006_quiet_on_snapshot_under_lock():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._m = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._m[k] = v
+
+            def dump(self):
+                with self._lock:
+                    snap = list(self._m.values())
+                return [v for v in snap]
+    """
+    assert _ids(src) == []
+
+
+# ------------------------------------------------------------------- engine
+
+def test_only_filter_matches_finding_ids_not_registry_keys():
+    """KTPU002/006 come from the pass registered as KTPU001; filtering
+    must work on the emitted id."""
+    import textwrap
+
+    from tools.ktpulint import lint_file
+
+    src = textwrap.dedent("""
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(0.5)
+    """)
+    findings = lint_file("<mem>", src, only=("KTPU002",))
+    assert [f.pass_id for f in findings] == ["KTPU002"]
+    assert lint_file("<mem>", src, only=("KTPU004",)) == []
+
+
+def test_syntax_error_reported_not_raised():
+    findings = _lint("def broken(:\n")
+    assert [f.pass_id for f in findings] == ["KTPU000"]
+
+
+def test_render_format_is_file_line_passid():
+    f = _lint(BAD_MUTATION)[0]
+    rendered = f.render()
+    assert rendered.startswith("<mem>:")
+    assert " KTPU001 " in rendered

@@ -1,0 +1,28 @@
+"""Self-enforcing lint gate: the tree must stay at zero ktpulint findings.
+
+This is the tier-1 half of the CI gate (`scripts/lint.py` is the
+command-line half): any commit that introduces an unlocked mutation, a
+blocking call under a lock, a swallowed exception, an undaemonized
+thread, a wall-clock deadline, or an unsnapshotted iteration fails the
+suite with the exact file:line: PASS-ID it must fix."""
+
+import os
+
+from tools.ktpulint import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_lint_clean():
+    findings = lint_paths([os.path.join(REPO, "kubernetes1_tpu")])
+    rendered = "\n".join(
+        os.path.relpath(f.path, REPO) + f":{f.line}: {f.pass_id} {f.message}"
+        for f in findings)
+    assert not findings, f"ktpulint findings:\n{rendered}"
+
+
+def test_tools_dir_is_lint_clean():
+    """The linter holds itself to its own rules."""
+    findings = lint_paths([os.path.join(REPO, "tools")])
+    rendered = "\n".join(f.render() for f in findings)
+    assert not findings, f"ktpulint findings in tools/:\n{rendered}"

@@ -353,6 +353,11 @@ class TestInitContainers:
         ]
         cs.pods.create(pod)
         wait_phase(cs, "with-init", t.POD_RUNNING, timeout=45)
+        # Running means main's PROCESS started; its shell may not have
+        # reached the echo yet — poll briefly before judging the order.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and "main" not in order.read_text():
+            time.sleep(0.05)
         assert order.read_text().split() == ["a", "b", "main"]
 
     def test_failing_init_fails_pod_with_restart_never(self, node_env):

@@ -1,0 +1,18 @@
+"""ktpulint — project-specific static analysis for kubernetes1_tpu.
+
+Passes (see each module's docstring for the rationale):
+- KTPU001 lock-guarded attribute mutated outside its lock
+- KTPU002 blocking call while holding a lock
+- KTPU003 bare except / silently swallowed broad exception
+- KTPU004 thread neither daemon=True nor joined
+- KTPU005 wall-clock time.time() in deadline/backoff/generation paths
+- KTPU006 iterating a lock-guarded container outside the lock
+
+Run the gate: `python scripts/lint.py` (exits non-zero on any finding);
+suppress a deliberate exception to a rule with
+`# ktpulint: ignore[KTPU00X] <justification>` on the offending line.
+"""
+
+from .engine import Finding, lint_file, lint_paths, registered_passes
+
+__all__ = ["Finding", "lint_file", "lint_paths", "registered_passes"]

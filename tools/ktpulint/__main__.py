@@ -1,0 +1,11 @@
+"""CLI: python -m tools.ktpulint [paths...] — defaults to the CI gate's
+scope (kubernetes1_tpu/ and tools/)."""
+
+from __future__ import annotations
+
+import sys
+
+from .engine import run_gate
+
+if __name__ == "__main__":
+    sys.exit(run_gate(sys.argv[1:]))

@@ -1,0 +1,161 @@
+"""ktpulint engine: file walking, pragma suppression, pass registry.
+
+The linter is AST-based and project-specific: every pass encodes a rule
+this codebase's threaded control plane actually depends on (SURVEY.md §7
+calls the scheduler cache's assume/confirm/forget path "the
+concurrency-critical piece" — silent races there erase the banked
+throughput wins).  Passes are deliberately conservative: each one infers
+its facts from the file under inspection (e.g. which attributes a class
+guards with which lock) instead of relying on annotations, so a finding
+is near-certainly real.
+
+Suppression: a line comment `# ktpulint: ignore[KTPU005]` (comma-separate
+for several ids, `ignore[*]` for all) silences findings reported on that
+line.  Every suppression should carry a justification after the bracket —
+the pragma is for the rare case the rule's premise doesn't hold (e.g.
+`time.time()` producing a user-visible timestamp), not for quieting bugs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Sequence, Set
+
+_PRAGMA_RE = re.compile(r"#\s*ktpulint:\s*ignore\[([^\]]*)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    pass_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.pass_id} {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Everything a pass needs about one source file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+Pass = Callable[[FileContext], List[Finding]]
+
+_REGISTRY: Dict[str, Pass] = {}
+
+
+def register(pass_id: str):
+    def deco(fn: Pass) -> Pass:
+        _REGISTRY[pass_id] = fn
+        return fn
+
+    return deco
+
+
+def registered_passes() -> Dict[str, Pass]:
+    return dict(_REGISTRY)
+
+
+def suppressed_ids(line_text: str) -> Set[str]:
+    """Pass ids suppressed by a pragma on this physical line."""
+    out: Set[str] = set()
+    for m in _PRAGMA_RE.finditer(line_text):
+        for tok in m.group(1).split(","):
+            tok = tok.strip().split()[0] if tok.strip() else ""
+            if tok:
+                out.add(tok)
+    return out
+
+
+def lint_file(path: str, source: str = None,
+              only: Sequence[str] = ()) -> List[Finding]:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "KTPU000",
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(path=path, source=source, tree=tree)
+    findings: List[Finding] = []
+    for fn in _REGISTRY.values():
+        findings.extend(fn(ctx))
+    # filter on the FINDING id, not the registry key: one registered pass
+    # may emit several ids (the lock pass emits KTPU001/002/006)
+    if only:
+        findings = [f for f in findings if f.pass_id in only]
+    kept = []
+    for f in findings:
+        idx = f.line - 1
+        text = ctx.lines[idx] if 0 <= idx < len(ctx.lines) else ""
+        ids = suppressed_ids(text)
+        if f.pass_id in ids or "*" in ids:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return kept
+
+
+def lint_paths(paths: Sequence[str], only: Sequence[str] = ()) -> List[Finding]:
+    """Lint every .py file under the given files/directories."""
+    findings: List[Finding] = []
+    for root in paths:
+        if os.path.isfile(root):
+            findings.extend(lint_file(root, only=only))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, name), only=only))
+    return findings
+
+
+def default_gate_paths() -> List[str]:
+    """What the CI gate lints by default: the package AND the linter
+    itself (tools/ holds itself to its own rules)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return [os.path.join(repo, "kubernetes1_tpu"),
+            os.path.join(repo, "tools")]
+
+
+def run_gate(paths: Sequence[str] = (), rel_root: str = "") -> int:
+    """Shared CLI body for scripts/lint.py and `python -m tools.ktpulint`:
+    print findings as `file:line: PASS-ID message`, return the exit code."""
+    import sys as _sys
+
+    findings = lint_paths(list(paths) or default_gate_paths())
+    for f in findings:
+        path = os.path.relpath(f.path, rel_root) if rel_root else f.path
+        print(f"{path}:{f.line}: {f.pass_id} {f.message}")
+    if findings:
+        print(f"lint: {len(findings)} finding(s)", file=_sys.stderr)
+        return 1
+    print("lint: clean", file=_sys.stderr)
+    return 0
+
+
+# importing the pass modules populates the registry
+from . import exceptions_pass  # noqa: E402,F401
+from . import locks_pass  # noqa: E402,F401
+from . import threads_pass  # noqa: E402,F401
+from . import wallclock_pass  # noqa: E402,F401

@@ -1,0 +1,69 @@
+"""KTPU003 — swallowed control-plane errors.
+
+Two shapes are flagged:
+- a bare `except:` — it catches SystemExit/KeyboardInterrupt too, which
+  turns Ctrl-C and interpreter shutdown into silent hangs;
+- `except Exception:` (or BaseException, alone or in a tuple) whose body
+  does nothing but pass/continue — an error in a reconcile loop vanishes
+  without a trace, the exact silent-failure class the survey warns erases
+  banked throughput (a dead informer handler looks identical to an idle
+  one).
+
+A handler that logs, re-raises, records, or returns a value is handling,
+not swallowing, and is not flagged.  `except BaseException: ...; raise`
+cleanup blocks are fine (they re-raise).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileContext, Finding, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names(type_node: ast.expr) -> List[str]:
+    if type_node is None:
+        return []
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    out = []
+    for n in nodes:
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
+
+
+def _swallows(body: List[ast.stmt]) -> bool:
+    """True when the handler body does nothing observable."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+@register("KTPU003")
+def swallowed_exceptions(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU003",
+                "bare `except:` — it also catches SystemExit/"
+                "KeyboardInterrupt; name the exception types"))
+            continue
+        broad = [n for n in _names(node.type) if n in _BROAD]
+        if broad and _swallows(node.body):
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU003",
+                f"`except {broad[0]}:` swallows the error silently — "
+                f"narrow the type or log it with component context"))
+    return findings

@@ -1,0 +1,336 @@
+"""Lock-discipline passes.
+
+KTPU001 — an attribute a class mutates under one of its locks is a
+shared-state attribute; mutating the same attribute outside every lock
+that ever guards it is a race.  The guarded set is inferred per class
+from the code itself: no annotations, so the pass can't drift from the
+implementation.
+
+KTPU002 — no blocking call (sleep, network round-trip, subprocess,
+thread join) while holding a lock: a wedged callee freezes every other
+thread that needs the lock (the device-manager endpoint RPC incident
+class).
+
+KTPU006 — iterating a guarded container attribute outside its lock:
+`RuntimeError: dictionary changed size during iteration` in the informer
+dispatch path is exactly the intermittent failure that survives a
+thousand clean runs.  Snapshot under the lock (`list(...)`/`dict(...)`)
+and iterate the snapshot.
+
+Conventions honored:
+- `__init__`/`__post_init__` are exempt (construction is single-threaded
+  by contract);
+- methods named `*_locked` are exempt (caller holds the lock — the
+  suffix is the project idiom for lock-held helpers);
+- nested functions/lambdas are skipped: they execute later, on another
+  thread's schedule, so their lock context is unknowable statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .engine import FileContext, Finding, register, suppressed_ids
+
+LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition",          # threading.*
+    "make_lock", "make_rlock", "make_condition",  # utils.locksan factory
+}
+
+MUTATOR_METHODS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "remove", "setdefault", "update",
+}
+
+EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "setup"}
+
+# dotted call names that block the calling thread
+BLOCKING_CALLS = {
+    ("time", "sleep"),
+    ("urllib", "request", "urlopen"),
+    ("socket", "create_connection"),
+    ("subprocess", "run"),
+    ("subprocess", "call"),
+    ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+    ("os", "system"),
+    ("shutil", "rmtree"),
+}
+
+
+def _dotted(node: ast.expr) -> Tuple[str, ...]:
+    """('time','sleep') for time.sleep; () when not a plain dotted name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _self_base_attr(node: ast.expr) -> Optional[str]:
+    """X for any expression rooted at `self.X` (self.X, self.X[k],
+    self.X.items(), self.X.y.z); None otherwise."""
+    while isinstance(node, (ast.Subscript, ast.Attribute, ast.Call)):
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:  # Call: only descend through method chains like self.X.items()
+            node = node.func
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned from a lock factory anywhere in the class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        func = node.value.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if name not in LOCK_FACTORIES:
+            continue
+        for tgt in node.targets:
+            attr = _self_base_attr(tgt)
+            if attr is not None and isinstance(tgt, ast.Attribute):
+                out.add(attr)
+    return out
+
+
+class _Mutation:
+    __slots__ = ("attr", "held", "line", "method")
+
+    def __init__(self, attr: str, held: FrozenSet[str], line: int, method: str):
+        self.attr = attr
+        self.held = held
+        self.line = line
+        self.method = method
+
+
+class _Iteration(_Mutation):
+    pass
+
+
+class _MethodWalker:
+    """Walk one method's statements tracking which of the class's locks
+    are held, recording mutations/iterations of self.* attributes and
+    blocking calls made under a lock."""
+
+    def __init__(self, lock_attrs: Set[str], method: str):
+        self.lock_attrs = lock_attrs
+        self.method = method
+        self.mutations: List[_Mutation] = []
+        self.iterations: List[_Iteration] = []
+        self.blocking: List[Tuple[int, str, str]] = []  # line, call, lock
+
+    # ----------------------------------------------------------- traversal
+
+    def walk(self, body: List[ast.stmt], held: FrozenSet[str]):
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: FrozenSet[str]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # deferred execution: lock context unknowable
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in stmt.items:
+                attr = _self_base_attr(item.context_expr)
+                if attr in self.lock_attrs:
+                    newly.add(attr)
+                else:
+                    self._expr(item.context_expr, held)
+            self.walk(stmt.body, held | frozenset(newly))
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for tgt in targets:
+                self._target(tgt, held, stmt.lineno)
+            value = stmt.value
+            if value is not None:
+                self._expr(value, held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._target(tgt, held, stmt.lineno)
+            return
+        if isinstance(stmt, ast.For):
+            self._iter_expr(stmt.iter, held, stmt.lineno)
+            self._expr(stmt.iter, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.While):
+            self._expr(stmt.test, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body, held)
+            for handler in stmt.handlers:
+                self.walk(handler.body, held)
+            self.walk(stmt.orelse, held)
+            self.walk(stmt.finalbody, held)
+            return
+        # default: scan contained expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+    # ------------------------------------------------------------- records
+
+    def _target(self, tgt: ast.expr, held: FrozenSet[str], line: int):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._target(el, held, line)
+            return
+        attr = _self_base_attr(tgt)
+        if attr is not None and attr not in self.lock_attrs and isinstance(
+                tgt, (ast.Attribute, ast.Subscript)):
+            self.mutations.append(_Mutation(attr, held, line, self.method))
+
+    def _iter_expr(self, it: ast.expr, held: FrozenSet[str], line: int):
+        """Record `for x in self.X` / `for x in self.X.items()` style
+        direct iteration over a self attribute (a snapshot wrapper like
+        list(self.X) is an ast.Call around it and doesn't match)."""
+        target = it
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute)
+                and it.func.attr in ("items", "values", "keys") and not it.args):
+            target = it.func.value
+        if isinstance(target, ast.Attribute):
+            attr = _self_base_attr(target)
+            if attr is not None and attr not in self.lock_attrs:
+                self.iterations.append(_Iteration(attr, held, line, self.method))
+
+    def _expr(self, node: ast.expr, held: FrozenSet[str]):
+        # manual DFS so Lambda subtrees are PRUNED (a lambda body runs
+        # later, under whatever locks its eventual caller holds)
+        stack: List[ast.AST] = [node]
+        while stack:
+            call = stack.pop()
+            if isinstance(call, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(call))
+            if isinstance(call, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                for gen in call.generators:
+                    self._iter_expr(gen.iter, held, call.lineno)
+            if not isinstance(call, ast.Call):
+                continue
+            # mutator method on a self attribute
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in MUTATOR_METHODS:
+                attr = _self_base_attr(call.func.value)
+                if attr is not None and attr not in self.lock_attrs:
+                    self.mutations.append(
+                        _Mutation(attr, held, call.lineno, self.method))
+            if held:
+                self._blocking(call, held)
+
+    def _blocking(self, call: ast.Call, held: FrozenSet[str]):
+        dotted = _dotted(call.func)
+        label = ""
+        if dotted and (dotted in BLOCKING_CALLS or dotted[-2:] in BLOCKING_CALLS
+                       or (len(dotted) >= 2 and dotted[-3:] in BLOCKING_CALLS)):
+            label = ".".join(dotted)
+        elif isinstance(call.func, ast.Attribute) and call.func.attr == "join":
+            recv = call.func.value
+            name = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else "")
+            if any(tok in name.lower() for tok in ("thread", "worker", "proc")):
+                label = f"{name}.join"
+        if label:
+            self.blocking.append(
+                (call.lineno, label, "/".join(sorted(held))))
+
+
+def _analyze_class(cls: ast.ClassDef, ctx: FileContext) -> List[Finding]:
+    path = ctx.path
+    lock_attrs = _lock_attrs(cls)
+    if not lock_attrs:
+        return []
+    walkers: List[_MethodWalker] = []
+    def_pragmas: Dict[str, Set[str]] = {}
+    for node in cls.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        # a pragma on the def line exempts the whole method from the named
+        # pass (the idiom for construction-time helpers and methods whose
+        # lock context the analysis can't see)
+        def_line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) else ""
+        def_pragmas[node.name] = suppressed_ids(def_line)
+        w = _MethodWalker(lock_attrs, node.name)
+        w.walk(node.body, frozenset())
+        walkers.append(w)
+
+    def pragma_off(method: str, pass_id: str) -> bool:
+        ids = def_pragmas.get(method, set())
+        return pass_id in ids or "*" in ids
+
+    findings: List[Finding] = []
+    for w in walkers:
+        if pragma_off(w.method, "KTPU002"):
+            continue
+        for line, call, lock in w.blocking:
+            findings.append(Finding(
+                path, line, "KTPU002",
+                f"blocking call {call}() while holding {cls.name}.{lock} — "
+                f"move it outside the lock"))
+
+    def exempt(method: str) -> bool:
+        return (method in EXEMPT_METHODS or method.endswith("_locked")
+                or pragma_off(method, "KTPU001"))
+
+    # infer guarded attrs from mutations that happen under a lock
+    guards: Dict[str, Set[str]] = {}
+    for w in walkers:
+        for m in w.mutations:
+            if exempt(m.method):
+                continue
+            if m.held:
+                guards.setdefault(m.attr, set()).update(m.held)
+
+    for w in walkers:
+        for m in w.mutations:
+            if exempt(m.method):
+                continue
+            locks = guards.get(m.attr)
+            if locks and not (m.held & locks):
+                findings.append(Finding(
+                    path, m.line, "KTPU001",
+                    f"{cls.name}.{m.attr} is mutated under "
+                    f"{cls.name}.{'/'.join(sorted(locks))} elsewhere but "
+                    f"mutated here without it"))
+        for it in w.iterations:
+            if exempt(it.method) or pragma_off(it.method, "KTPU006"):
+                continue
+            locks = guards.get(it.attr)
+            if locks and not (it.held & locks):
+                findings.append(Finding(
+                    path, it.line, "KTPU006",
+                    f"iterating {cls.name}.{it.attr} outside "
+                    f"{cls.name}.{'/'.join(sorted(locks))} — snapshot it "
+                    f"under the lock first (list(...)/dict(...))"))
+    return findings
+
+
+@register("KTPU001")
+def lock_discipline(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_analyze_class(node, ctx))
+    return findings

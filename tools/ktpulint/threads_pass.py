@@ -1,0 +1,142 @@
+"""KTPU004 — every thread must be daemon=True or provably joined.
+
+A non-daemon thread that nobody joins keeps the process alive after
+main() returns — test runs hang, kubelets refuse to die on SIGTERM, and
+the leak-police conftest fixture fails whole suites.  A thread is
+acceptable when:
+- constructed with `daemon=True`;
+- or its handle has `.daemon = True` assigned in the same function;
+- or its handle is `.join()`ed somewhere in the same class/module scope
+  (an owned worker with an orderly shutdown).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .engine import FileContext, Finding, register
+
+_THREAD_CTORS = {"Thread", "Timer"}
+
+
+def _ctor_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _THREAD_CTORS:
+        base = f.value
+        if isinstance(base, ast.Name) and base.id == "threading":
+            return f.attr
+        return None
+    if isinstance(f, ast.Name) and f.id in _THREAD_CTORS:
+        return f.id
+    return None
+
+
+def _target_repr(node: ast.expr) -> Optional[str]:
+    """'x' for Name, 'self.X' for self attribute."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _daemonized_or_joined(handle: str, scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        # handle.daemon = True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                        and _target_repr(tgt.value) == handle
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True):
+                    return True
+        # handle.join(...)
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and _target_repr(node.func.value) == handle):
+            return True
+    return False
+
+
+def _collection_joined(collection: str, scope: ast.AST) -> bool:
+    """True when the scope iterates `collection` and joins the loop var
+    (or comprehension var): `for th in self._threads: th.join()`."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if isinstance(it, ast.Call):  # list(...)/reversed(...) wrappers
+            it = it.args[0] if it.args else it
+        if _target_repr(it) != collection:
+            continue
+        var = node.target.id if isinstance(node.target, ast.Name) else None
+        if var is None:
+            continue
+        for inner in ast.walk(node):
+            if (isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "join"
+                    and _target_repr(inner.func.value) == var):
+                return True
+    return False
+
+
+@register("KTPU004")
+def undaemonized_threads(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, func: Optional[ast.AST], cls: Optional[ast.AST]):
+        for child in ast.iter_child_nodes(node):
+            new_func, new_cls = func, cls
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                new_func = child
+            elif isinstance(child, ast.ClassDef):
+                new_cls = child
+            if isinstance(child, ast.Call):
+                ctor = _ctor_name(child)
+                if ctor is not None:
+                    check(child, ctor, func, cls)
+            visit(child, new_func, new_cls)
+
+    def check(call: ast.Call, ctor: str, func, cls):
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                if isinstance(kw.value, ast.Constant) and kw.value.value is True:
+                    return
+                if not isinstance(kw.value, ast.Constant):
+                    return  # daemon=<expr>: caller decides, give benefit of doubt
+        # find the handle holding this call's result: plain/annotated
+        # assignment, or append into a collection that is later iterated
+        # and joined (`self._threads.append(Thread(...))` + `for th in
+        # self._threads: th.join()`)
+        handle = None
+        collection = None
+        search = func or ctx.tree
+        for node in ast.walk(search):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for tgt in node.targets:
+                    handle = _target_repr(tgt)
+            elif isinstance(node, ast.AnnAssign) and node.value is call:
+                handle = _target_repr(node.target)
+            elif (isinstance(node, ast.Call) and node.args
+                  and node.args[0] is call
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "append"):
+                collection = _target_repr(node.func.value)
+        lookup_scopes = [s for s in (func, cls, ctx.tree) if s is not None]
+        if handle:
+            for scope in lookup_scopes:
+                if _daemonized_or_joined(handle, scope):
+                    return
+        if collection:
+            for scope in lookup_scopes:
+                if _collection_joined(collection, scope):
+                    return
+        findings.append(Finding(
+            ctx.path, call.lineno, "KTPU004",
+            f"threading.{ctor}(...) is neither daemon=True nor joined — "
+            f"it will outlive shutdown; set daemon=True or join it"))
+
+    visit(ctx.tree, None, None)
+    return findings

@@ -1,0 +1,37 @@
+"""KTPU005 — wall-clock `time.time()` where the code means elapsed time.
+
+NTP steps, suspend/resume, and leap smearing move `time.time()` both
+ways; a deadline or backoff computed from it can fire years late or
+instantly.  Deadlines, TTLs, backoffs, generation stamps, and latency
+measurements must use `time.monotonic()`.
+
+`time.time()` is legitimate exactly when the value is user-visible wall
+time (an API timestamp, an audit-log entry, a certificate expiry).
+Those sites carry `# ktpulint: ignore[KTPU005] <why>` — the pragma is
+the documentation that a human judged the wall-clock semantics correct.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileContext, Finding, register
+
+
+@register("KTPU005")
+def wallclock(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr == "time"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("time", "_time")):
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU005",
+                "time.time() — use time.monotonic() for deadlines/"
+                "backoffs/generations; if this is a user-visible "
+                "timestamp, say so with a pragma"))
+    return findings
