@@ -147,11 +147,17 @@ def bench_density():
     from kubernetes1_tpu.scheduler import Scheduler
     from tests.helpers import make_tpu_pod
 
+    from kubernetes1_tpu.utils.slo import StartupSLITracker
+
     tmp = tempfile.mkdtemp(prefix="ktpu-bench-")
     master = Master().start()
     cs = Clientset(master.url)
     sched = Scheduler(cs)
     sched.start()
+    # per-phase pod-startup SLIs (created→scheduled→bound→admitted→running
+    # + device_allocation): the same decomposition /metrics exports
+    sli_cs = Clientset(master.url)
+    sli = StartupSLITracker(sli_cs).start()
 
     kubelets, plugins, clients = [], [], []
     for i in range(NODES):
@@ -218,6 +224,9 @@ def bench_density():
             assigned.extend(er.assigned)
     distinct = len(set(assigned))
 
+    sli_phases = sli.report()
+    sli.stop()
+    sli_cs.close()
     for kl in kubelets:
         kl.stop()
     for pl in plugins:
@@ -236,6 +245,7 @@ def bench_density():
         "chip_alloc_p50_s": round(sched_p50, 4),
         "pods_per_sec": round(n_ok / total_wall, 1) if total_wall else 0,
         "distinct_chips_assigned": distinct,
+        "sli_phases": sli_phases,
     }
 
 

@@ -58,6 +58,24 @@ JOB_NAME_LABEL = "batch.ktpu.io/job-name"
 # admission only lets a node credential create pods carrying this marker.
 STATIC_POD_ANNOTATION = "kubelet.ktpu.io/static"
 
+# Request tracing: the apiserver stamps the creating request's trace id on
+# pods so scheduler/kubelet spans correlate across the watch path
+# (utils/spans; the k8s Audit-ID analog made durable on the object).
+TRACE_ID_ANNOTATION = "trace.ktpu.io/trace-id"
+# Pod-startup SLI phase stamps (utils/slo): wall-clock seconds as "%.6f"
+# strings, written by the component that owns each transition —
+#   created-at    apiserver, at pod admission into the registry
+#   scheduled-at  scheduler, when the placement algorithm picked node+chips
+#                 (carried on the Binding, merged into the pod at bind)
+#   bound-at      apiserver registry, when the binding commits
+#   admitted-at   kubelet, when device admission (incl. plugin AdmitPod)
+#                 accepted the pod on its node
+# running is observed from the watch stream by the SLI tracker itself.
+CREATED_AT_ANNOTATION = "slo.ktpu.io/created-at"
+SCHEDULED_AT_ANNOTATION = "slo.ktpu.io/scheduled-at"
+BOUND_AT_ANNOTATION = "slo.ktpu.io/bound-at"
+ADMITTED_AT_ANNOTATION = "slo.ktpu.io/admitted-at"
+
 # --------------------------------------------------------------- shared bits
 
 

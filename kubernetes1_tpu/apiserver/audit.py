@@ -23,6 +23,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..utils.logutil import RateLimitedReporter
+from ..utils import locksan
 
 LEVEL_NONE = "None"
 LEVEL_METADATA = "Metadata"
@@ -100,7 +101,7 @@ class WebhookAuditBackend:
         self.max_buffer = max_buffer
         self.timeout = timeout
         self._buf: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("WebhookAuditBackend._lock")
         self._drop_reporter = RateLimitedReporter("audit")
         self._stop = threading.Event()
         self._wake = threading.Event()

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import random
 import string
-import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import locksan
 
 from ..api import types as t
 from ..machinery import (
@@ -322,8 +324,8 @@ class Registry:
     def __init__(self, store: Store, scheme: Scheme):
         self.store = store
         self.scheme = scheme
-        self._ns_lock = threading.Lock()
-        self._svc_lock = threading.Lock()
+        self._ns_lock = locksan.make_lock("Registry._ns_lock")
+        self._svc_lock = locksan.make_lock("Registry._svc_lock")
 
     # ------------------------------------------------------------------ keys
 
@@ -739,6 +741,15 @@ class Registry:
                     )
                 per.assigned = list(ids)
             pod.metadata.annotations.pop(t.NOMINATED_NODE_ANNOTATION, None)
+            # observability stamps riding the binding (scheduler's
+            # scheduled-at, trace context) are merged — prefix-gated so a
+            # binding can't overwrite arbitrary pod metadata — and the
+            # commit itself is the authoritative bound-at instant
+            for k, v in (binding.metadata.annotations or {}).items():
+                if k.startswith(("slo.ktpu.io/", "trace.ktpu.io/")):
+                    pod.metadata.annotations[k] = v
+            pod.metadata.annotations[t.BOUND_AT_ANNOTATION] = \
+                f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
             return pod
 
         return self.store.guaranteed_update(key, apply)

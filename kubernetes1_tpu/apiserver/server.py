@@ -23,7 +23,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from ..utils import fasthttp
+from ..utils import fasthttp, locksan, spans as spanlib
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
@@ -322,6 +322,23 @@ class _Handler(BaseHTTPRequestHandler):
         return resource, "", name, sub
 
     def _handle(self, method: str):
+        # request tracing (utils/spans): a client-sent X-Ktpu-Trace context
+        # opens a server span around the whole request so the apiserver leg
+        # of a pod's journey lands in /debug/traces under the pod's trace
+        # id.  Watches are excluded (a span per hours-long stream is noise)
+        # and so are plain GETs: reads dominate traffic at density
+        # (informer lists, pre-heartbeat node gets) and would evict the
+        # mutation spans forensics actually wants from the bounded
+        # collector — the journey's legs are all writes (create, binding,
+        # status, SLI patch).
+        ctx = spanlib.parse_header(self.headers.get(spanlib.HEADER, ""))
+        if ctx is None or method == "GET":
+            return self._handle_inner(method)
+        with self.master.spans.start_span(
+                f"apiserver.{method}", parent=ctx, path=self.path):
+            return self._handle_inner(method)
+
+    def _handle_inner(self, method: str):
         start = time.monotonic()
         try:
             parts, q = self._route()
@@ -390,6 +407,14 @@ class _Handler(BaseHTTPRequestHandler):
                 # cluster-scoped resource read — anonymous RBAC users are
                 # denied exactly as they are for every real resource
                 self._authz(user, "get", "debug", "", "", "")
+                if parts == ["debug", "traces"]:
+                    body = self.master.spans.to_json(q.get("trace", ""))
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 res = handle_debug("/" + "/".join(parts), q)
                 if res is None:
                     raise NotFound(f"unknown path {self.path}")
@@ -736,6 +761,17 @@ class _Handler(BaseHTTPRequestHandler):
         # (NamespaceAutoProvision) see the effective namespace
         if ns and not obj.metadata.namespace:
             obj.metadata.namespace = ns
+        if resource == "pods":
+            # observability stamps (server-set): the creating request's
+            # trace id rides the object through the watch path, and the
+            # creation wall time anchors the pod-startup SLI decomposition
+            # (utils/slo) — now_iso's 1s resolution is too coarse for it
+            tid = spanlib.current_trace_id()
+            if tid:
+                obj.metadata.annotations.setdefault(
+                    t.TRACE_ID_ANNOTATION, tid)
+            obj.metadata.annotations.setdefault(
+                t.CREATED_AT_ANNOTATION, f"{time.time():.6f}")  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
 
         def admit_and_create():
             nonlocal obj
@@ -846,7 +882,7 @@ class Metrics:
     request metrics; full component metrics live in utils/metrics.py)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("apiserver.Metrics._lock")
         self._counts: Dict[str, int] = {}
         self._sums: Dict[str, float] = {}
 
@@ -924,11 +960,16 @@ class Master:
         self.registry = Registry(self.store, self.scheme)
         self.token = token
         self.metrics = Metrics()
-        self.quota_lock = threading.Lock()
+        # request spans land here, served at /debug/traces (utils/spans).
+        # Sized for the write rate: a ring buffer of the newest mutations
+        # (heartbeat status PUTs included), not a durable trace store —
+        # scrape or query promptly after the incident window.
+        self.spans = spanlib.SpanCollector("apiserver", capacity=4096)
+        self.quota_lock = locksan.make_lock("Master.quota_lock")
         self.stopping = threading.Event()
         self._audit_log = audit_log
         self._audit_path = audit_path
-        self._audit_lock = threading.Lock()
+        self._audit_lock = locksan.make_lock("Master._audit_lock")
         from .audit import AuditPolicy, WebhookAuditBackend
 
         self.audit_policy = AuditPolicy.from_dict(audit_policy)

@@ -17,7 +17,7 @@ import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlparse
 
-from ..utils import fasthttp
+from ..utils import fasthttp, spans
 
 from ..machinery import ApiError
 
@@ -157,6 +157,10 @@ class ApiClient:
         h = {"Content-Type": "application/json", "Accept": "application/json"}
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
+        # request tracing (utils/spans): propagate the caller's active span
+        # context, or mint a fresh root so every request is correlatable —
+        # the server side stamps the id into created objects' metadata
+        h[spans.HEADER] = spans.inject_header()
         return h
 
     def _new_conn(self, timeout) -> http.client.HTTPConnection:

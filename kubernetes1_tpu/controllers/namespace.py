@@ -10,7 +10,6 @@ when their Job/ReplicaSet is removed.
 
 from __future__ import annotations
 
-import threading
 import time
 import traceback
 from typing import Dict, List
@@ -19,6 +18,7 @@ from ..api import types as t
 from ..client import Clientset, InformerFactory
 from ..machinery import ApiError, NotFound
 from .base import Controller
+from ..utils import locksan
 
 NAMESPACED_RESOURCES = (
     "pods", "jobs", "cronjobs", "replicasets", "deployments", "daemonsets",
@@ -102,7 +102,7 @@ class GarbageCollector(Controller):
         # — a full-cluster rescan per delete would be O(deletes x objects)
         # at 30k-pod density
         self._by_owner: Dict[str, set] = {}
-        self._owner_lock = threading.Lock()
+        self._owner_lock = locksan.make_lock("GarbageCollector._owner_lock")
         for resource in set(OWNED_RESOURCES + OWNER_RESOURCES):
             self.informers[resource] = self.factory.informer(resource)
         for resource in OWNED_RESOURCES:

@@ -27,6 +27,7 @@ import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
+from ..utils import locksan
 
 DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
 
@@ -185,7 +186,7 @@ class PluginServer:
         raise ValueError(f"unknown method {method!r}")
 
     def _serve_stream(self, f, rid):
-        send_lock = threading.Lock()
+        send_lock = locksan.make_lock("PluginServer.send_lock")
 
         def send(devices: List[dict]):
             with send_lock:
@@ -215,7 +216,7 @@ class PluginClient:
     def __init__(self, socket_path: str, timeout: float = 10.0):
         self.socket_path = socket_path
         self.timeout = timeout
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("PluginClient._lock")
         self._conn: Optional[socket.socket] = None
         self._f = None
         self._next_id = 0

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 from .. import TPU_RESOURCE
 from ..api import types as t
+from ..utils import locksan
 from .api import (
     DEFAULT_PLUGIN_DIR,
     ContainerSpec,
@@ -126,7 +127,7 @@ class TPUDevicePlugin:
         self._by_id = {d["id"]: d for d in self.devices}
         self._admitted_pods: Dict[str, dict] = {}
         self.health_check_interval = health_check_interval
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("TPUDevicePlugin._lock")
         # one wakeup Event per live ListAndWatch stream: a shared event could
         # be consumed (and cleared) by a dead stream, losing the update for
         # the live one

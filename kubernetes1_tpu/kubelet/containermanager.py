@@ -30,13 +30,13 @@ cgroup.procs between fork and exec), so grandchildren can never escape.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..utils.quantity import parse_quantity
 from .eviction import QOS_BESTEFFORT, QOS_BURSTABLE, QOS_GUARANTEED, qos_class
+from ..utils import locksan
 
 CPU_PERIOD_US = 100_000
 
@@ -340,7 +340,7 @@ class ContainerManager:
         else:
             self.backend = null_backend()
         self.system_reserved = system_reserved or {}
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("ContainerManager._lock")
         self._pod_rel: Dict[str, str] = {}  # uid -> qos/pod<uid>
         self._cpu_samples: Dict[str, Tuple[float, float]] = {}
         if self.backend.name != "null":

@@ -38,6 +38,7 @@ import time
 import traceback
 from typing import Dict, List, Optional
 
+from ..utils import locksan
 from .runtime import (
     ContainerConfig,
     ContainerRecord,
@@ -224,7 +225,7 @@ class RemoteRuntime(RuntimeService):
         self.socket_path = socket_path
         self.timeout = timeout
         self._pool: List = []
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("RemoteRuntime._lock")
         self._next_id = 0
         self._caps: Optional[dict] = None
         self._ever_connected = False

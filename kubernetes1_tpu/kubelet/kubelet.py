@@ -30,6 +30,8 @@ from ..api import types as t
 from ..client import Clientset, EventRecorder, SharedInformer
 from ..machinery import ApiError, Conflict, NotFound, now_iso
 from ..machinery.scheme import global_scheme
+from ..utils import locksan
+from ..utils.spans import SpanCollector
 from ..utils.workqueue import WorkQueue
 from ..deviceplugin.api import DEFAULT_PLUGIN_DIR
 from .devicemanager import DeviceManager
@@ -156,8 +158,11 @@ class Kubelet:
         self._heartbeat_event = threading.Event()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("Kubelet._lock")
         self._metrics_rv: Dict[Tuple[str, str], str] = {}  # (kind, key) -> rv
+        # per-pod spans under the creating request's trace id (utils/spans),
+        # served at the kubelet server's /debug/traces
+        self.spans = SpanCollector(f"kubelet/{node_name}")
 
         self.server = None
         self.server_token = server_token
@@ -985,6 +990,27 @@ class Kubelet:
 
     ADMISSION_GRACE_SECONDS = 30.0
 
+    @staticmethod
+    def _pod_trace_id(pod: t.Pod) -> str:
+        return (pod.metadata.annotations or {}).get(t.TRACE_ID_ANNOTATION, "")
+
+    def _stamp_admitted(self, pod: t.Pod):
+        """Persist the device-admission instant for the pod-startup SLI
+        decomposition (utils/slo).  Once per pod (a kubelet restart must
+        not overwrite the original stamp); best-effort — SLI bookkeeping
+        must never block a pod from starting."""
+        if t.ADMITTED_AT_ANNOTATION in (pod.metadata.annotations or {}):
+            return
+        try:
+            self.cs.pods.patch(
+                pod.metadata.name,
+                {"metadata": {"annotations": {
+                    t.ADMITTED_AT_ANNOTATION: f"{time.time():.6f}"}}},  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
+                namespace=pod.metadata.namespace,
+            )
+        except (ApiError, OSError):
+            pass
+
     def _admit(self, pod: t.Pod) -> Tuple[str, str]:
         """Returns ('ok'|'wait'|'fail', reason).  Retriable denials (device
         manager warming up after kubelet/plugin restart) wait up to
@@ -994,11 +1020,20 @@ class Kubelet:
             cached = self._admitted.get(uid)
         if cached is not None:
             return cached
-        result = self.device_manager.admit_pod(pod)
-        if result.allowed:
-            with self._lock:
-                self._admitted[uid] = ("ok", "")
-            return "ok", ""
+        # the TPU path's signature span: scheduler-assigned device IDs
+        # verified against local inventory + the plugin's AdmitPod RPC
+        span_name = ("kubelet.device_allocation"
+                     if pod.spec.extended_resources else "kubelet.admit")
+        with self.spans.start_span(span_name,
+                                   trace_id=self._pod_trace_id(pod),
+                                   pod=pod.key()) as sp:
+            result = self.device_manager.admit_pod(pod)
+            if result.allowed:
+                with self._lock:
+                    self._admitted[uid] = ("ok", "")
+                self._stamp_admitted(pod)
+                return "ok", ""
+            sp.annotate(denied=result.reason, retriable=result.retriable)
         if result.retriable:
             with self._lock:
                 first = self._admit_first_seen.setdefault(uid, time.monotonic())
@@ -1015,10 +1050,13 @@ class Kubelet:
             sid = self._sandboxes.get(uid)
         if sid is not None:
             return sid
-        sid = self.runtime.run_pod_sandbox(
-            pod.metadata.name, pod.metadata.namespace, uid,
-            labels={"pod-uid": uid},
-        )
+        with self.spans.start_span("kubelet.create_sandbox",
+                                   trace_id=self._pod_trace_id(pod),
+                                   pod=pod.key()):
+            sid = self.runtime.run_pod_sandbox(
+                pod.metadata.name, pod.metadata.namespace, uid,
+                labels={"pod-uid": uid},
+            )
         with self._lock:
             self._sandboxes[uid] = sid
         return sid
@@ -1224,8 +1262,15 @@ class Kubelet:
                     present = self.runtime.images.image_present(container.image)
                     if policy == "Always" or (policy != "Never" and not present):
                         self.runtime.images.pull_image(container.image)
-                cid = self.runtime.create_container(sandbox_id, config)
-                self.runtime.start_container(cid)
+                # the span covers the /dev/accel* injection spec landing in
+                # the CRI create — the tail of the device_allocation path
+                with self.spans.start_span(
+                        "kubelet.start_container",
+                        trace_id=self._pod_trace_id(pod), pod=pod.key(),
+                        container=container.name,
+                        devices=len(config.devices)):
+                    cid = self.runtime.create_container(sandbox_id, config)
+                    self.runtime.start_container(cid)
                 with self._lock:
                     self._containers[ckey] = cid
                 self.recorder.event(
@@ -1430,6 +1475,10 @@ class Kubelet:
             with self._lock:
                 self._last_status[uid] = comparable
         except NotFound:
+            pass
+        except Conflict:
+            # stale informer copy (e.g. the SLI admitted-at patch just
+            # bumped the rv): the next sync retries from the fresh object
             pass
         except ApiError:
             traceback.print_exc()

@@ -26,6 +26,7 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+from ..utils import locksan
 
 SANDBOX_READY = "SANDBOX_READY"
 SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
@@ -184,7 +185,7 @@ class FakeRuntime(RuntimeService):
     which case they exit after N seconds with the given code."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("FakeRuntime._lock")
         self._sandboxes: Dict[str, SandboxRecord] = {}
         self._containers: Dict[str, ContainerRecord] = {}
         self._exit_plans: Dict[str, tuple] = {}  # cid -> (deadline, code)
@@ -466,7 +467,7 @@ class ProcessRuntime(RuntimeService):
     def __init__(self, root_dir: str = "/tmp/ktpu"):
         self.root = root_dir
         os.makedirs(os.path.join(self.root, "logs"), exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("ProcessRuntime._lock")
         self._sandboxes: Dict[str, SandboxRecord] = {}
         self._containers: Dict[str, ContainerRecord] = {}
         self._procs: Dict[str, subprocess.Popen] = {}

@@ -187,10 +187,11 @@ class _KubeletHandler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in rawq.items()}
         kl = self.kubelet
         try:
-            if parts and parts[0] not in ("healthz", "metrics") \
+            if parts and parts[0] not in ("healthz", "readyz", "metrics") \
                     and not self._authorized():
                 # everything that exposes workload data requires the token
-                # the apiserver holds; only liveness + scrape stay open
+                # the apiserver holds; only liveness/readiness + scrape
+                # stay open
                 self._send(401, {"error": "unauthorized"})
                 return
             if parts and parts[0] in ("exec", "attach", "portForward") \
@@ -199,6 +200,18 @@ class _KubeletHandler(BaseHTTPRequestHandler):
                 return
             if parts == ["healthz"]:
                 self._send(200, {"status": "ok"})
+            elif parts == ["readyz"]:
+                # ready once the pod informer delivered its first LIST —
+                # before that the kubelet can't know what it should be
+                # running, and admitting traffic would report stale truth
+                ready = kl.pods.has_synced()
+                if ready:
+                    self._send(200, {"status": "ok"})
+                else:
+                    self._send(503, {"status": "unready"})
+            elif parts == ["debug", "traces"]:
+                self._send(200, kl.spans.to_json(q.get("trace", "")),
+                           content_type="application/json")
             elif parts == ["pods"]:
                 self._send(200, {"pods": sorted(p.key() for p in kl.pods.list())})
             elif parts and parts[0] == "containerLogs" and len(parts) >= 3:

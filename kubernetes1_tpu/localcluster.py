@@ -23,6 +23,7 @@ from .deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices, discover_tp
 from .kubelet import FakeRuntime, Kubelet, ProcessRuntime
 from .proxy import Proxier
 from .scheduler import Scheduler
+from .utils.slo import StartupSLITracker
 
 
 @dataclass
@@ -62,6 +63,7 @@ class LocalCluster:
         self.scheduler: Optional[Scheduler] = None
         self.kcm: Optional[ControllerManager] = None
         self.proxier: Optional[Proxier] = None
+        self.sli: Optional[StartupSLITracker] = None
         self.nodes: List[NodeHandle] = []
 
     @property
@@ -71,12 +73,17 @@ class LocalCluster:
     def start(self) -> "LocalCluster":
         self.master = Master(port=self.port).start()
         self.cs = Clientset(self.master.url)
-        self.scheduler = Scheduler(Clientset(self.master.url))
+        # ephemeral /metrics + /debug/traces endpoint: the observability
+        # surface is part of the cluster, not an opt-in extra
+        self.scheduler = Scheduler(Clientset(self.master.url), metrics_port=0)
         self.scheduler.start()
         self.kcm = ControllerManager(Clientset(self.master.url))
         self.kcm.start()
         self._proxier_cs = Clientset(self.master.url)
         self.proxier = Proxier(self._proxier_cs).start()
+        # pod-startup SLIs (utils/slo): per-phase histograms on /metrics
+        self._sli_cs = Clientset(self.master.url)
+        self.sli = StartupSLITracker(self._sli_cs, metrics_port=0).start()
         for i in range(self.n_nodes):
             self._add_node(i)
         return self
@@ -132,6 +139,9 @@ class LocalCluster:
             if h.plugin:
                 h.plugin.stop()
             h.clientset.close()
+        if self.sli:
+            self.sli.stop()
+            self._sli_cs.close()
         if self.proxier:
             self.proxier.stop()
             self._proxier_cs.close()

@@ -86,7 +86,7 @@ def to_dict(obj: Any) -> Any:
 # private in-progress map only the building thread can see.
 _DECODERS: Dict[Any, Any] = {}
 _DECODERS_BUILDING: Dict[Any, Any] = {}
-_DECODERS_LOCK = threading.RLock()
+_DECODERS_LOCK = threading.RLock()  # ktpulint: ignore[KTPU007] hot decode-path leaf lock, module-scope (machinery must not depend on utils)
 
 
 def _decoder(tp):

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset, InformerFactory
+from ..utils import locksan
 
 SCHEDULERS = ("rr", "wrr", "lc", "sh")
 
@@ -85,7 +86,7 @@ class VirtualServer:
         self.port = self.sock.getsockname()[1]
         self.backends: List[RealServer] = []
         self._rr_state = [0]
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("VirtualServer._lock")
         self._closed = False
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
@@ -210,7 +211,7 @@ class IPVSProxier:
         # (ns, svc, port_name) -> VirtualServer
         self._virtuals: Dict[tuple, VirtualServer] = {}
         self._vip_index: Dict[tuple, tuple] = {}  # (clusterIP, port) -> key
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("IPVSProxier._lock")
         self._dirty = threading.Event()
         self._stop = threading.Event()
 

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api import types as t
 from ..client import Clientset, InformerFactory
 from ..utils.workqueue import RateLimitingQueue
+from ..utils import locksan
 
 
 class _PortProxy:
@@ -39,7 +40,7 @@ class _PortProxy:
         self.affinity_ttl = 10800.0
         self._affinity_map: Dict[str, Tuple[Tuple[str, int], float]] = {}
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("_PortProxy._lock")
         self._closed = False
         self.connections = 0
         self.errors = 0
@@ -139,7 +140,7 @@ class Proxier:
         self._vips: Dict[Tuple[str, int], Tuple[str, int]] = {}
         # (ns, svc_name) -> vip keys owned by that service, for pruning
         self._svc_vips: Dict[Tuple[str, str], set] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("Proxier._lock")
         self._stop = threading.Event()
         self._own_factory = factory is None
 

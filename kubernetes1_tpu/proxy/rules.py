@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset, InformerFactory
+from ..utils import locksan
 
 
 class _ServiceRules:
@@ -56,7 +57,7 @@ class RuleTableProxier:
         self._by_vip: Dict[Tuple[str, int], _ServiceRules] = {}
         self._by_nodeport: Dict[int, _ServiceRules] = {}
         self._affinity: Dict[Tuple[str, str], Tuple[Tuple[str, int], float]] = {}
-        self._affinity_lock = threading.Lock()  # written by resolve AND sync
+        self._affinity_lock = locksan.make_lock("RuleTableProxier._affinity_lock")  # written by resolve AND sync
         self._affinity_ttl = 10800.0
         self.sync_count = 0
 

@@ -36,6 +36,7 @@ def _json_key(obj) -> str:
 
     return _json.dumps(obj, sort_keys=True, default=str)
 from ..utils.metrics import Histogram, MetricsServer, Registry
+from ..utils.spans import SpanCollector
 from ..utils.trace import Trace
 from .extender import ExtenderError, HTTPExtender, extenders_from_policy
 from .cache import NodeInfo, SchedulerCache
@@ -118,6 +119,9 @@ class Scheduler:
             "scheduler_preemption_victims_total")
         self.metrics_server: Optional[MetricsServer] = None
         self._metrics_port = metrics_port
+        # per-attempt spans under the pod's trace id (utils/spans), served
+        # at /debug/traces next to /metrics
+        self.spans = SpanCollector("scheduler")
         # node -> (pod_key, priority, expiry): chips freed by preemption are
         # reserved for the preemptor until it binds or the claim expires
         # (ref: NominatedNodeAnnotationKey + the later PodNominator)
@@ -150,6 +154,9 @@ class Scheduler:
                 self.metrics_server = MetricsServer(
                     self.metrics, port=self._metrics_port,
                     extra={"scheduler_pending_pods": self.queue.depth},
+                    spans=self.spans,
+                    ready_fn=lambda: (self.pods.has_synced()
+                                      and self.nodes.has_synced()),
                 ).start()
             except OSError as e:
                 # a busy port (HA failover overlap, second scheduler on one
@@ -290,41 +297,55 @@ class Scheduler:
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
 
+    @staticmethod
+    def _pod_trace_id(pod: t.Pod) -> str:
+        return (pod.metadata.annotations or {}).get(t.TRACE_ID_ANNOTATION, "")
+
     def _schedule_one(self, key: str):
         pod = self.pods.get(key)
         if pod is None or not self._schedulable(pod):
             return
         start = time.monotonic()
         self._attempts_ctr.inc()
+        tid = self._pod_trace_id(pod)
         if pod.spec.scheduling_gang:
             from ..utils.features import gates
 
             if gates.enabled("GangScheduling"):
                 # the latency histograms must see the fork's signature
                 # workload too, not just singleton pods
-                self._schedule_gang(pod, start)
+                with self.spans.start_span("scheduler.schedule_gang",
+                                           trace_id=tid, pod=key):
+                    self._schedule_gang(pod, start)
                 return
             # gate off: members place independently (the pre-gang behavior)
-        tr = Trace("scheduling", threshold=TRACE_THRESHOLD_S,
-                   pod=key, attempts=self.schedule_attempts)
-        result, failure = self.schedule(pod, trace=tr)
-        self.algorithm_latency.observe(time.monotonic() - start)
-        if result is None:
-            self._failures_ctr.inc()
-            tr.step("schedule failed")
+        # the span is active for the whole attempt, so the Trace below (and
+        # its slow-op step log) carries this pod's trace id
+        with self.spans.start_span("scheduler.schedule",
+                                   trace_id=tid, pod=key) as sp:
+            tr = Trace("scheduling", threshold=TRACE_THRESHOLD_S,
+                       pod=key, attempts=self.schedule_attempts)
+            result, failure = self.schedule(pod, trace=tr)
+            self.algorithm_latency.observe(time.monotonic() - start)
+            if result is None:
+                self._failures_ctr.inc()
+                sp.annotate(failure=failure)
+                tr.step("schedule failed")
+                tr.log_if_long()
+                self.recorder.event(pod, "Warning", "FailedScheduling", failure)
+                if pod.spec.priority > 0:
+                    if self._try_preempt(pod):
+                        self.queue.add_backoff(key, pod.spec.priority)
+                        return
+                self.queue.add_backoff(key, pod.spec.priority)
+                return
+            sp.annotate(node=result.node,
+                        devices=sum(len(v) for v in result.assignments.values()))
+            self._assume_and_bind(pod, result)
+            tr.step("assumed and queued bind")
             tr.log_if_long()
-            self.recorder.event(pod, "Warning", "FailedScheduling", failure)
-            if pod.spec.priority > 0:
-                if self._try_preempt(pod):
-                    self.queue.add_backoff(key, pod.spec.priority)
-                    return
-            self.queue.add_backoff(key, pod.spec.priority)
-            return
-        self._assume_and_bind(pod, result)
-        tr.step("assumed and queued bind")
-        tr.log_if_long()
-        self.queue.forget(key)
-        self.e2e_latency.observe(time.monotonic() - start)
+            self.queue.forget(key)
+            self.e2e_latency.observe(time.monotonic() - start)
 
     # ------------------------------------------------------------- schedule
 
@@ -474,6 +495,10 @@ class Scheduler:
         # carries just the node, and chip IDs must never be dropped
         ext_binder = next((e for e in self.extenders if e.handles_bind), None) \
             if not result.assignments else None
+        # SLI stamp: the algorithm (incl. device-ID pick) finished NOW; the
+        # binding carries it so registry.bind persists it onto the pod
+        scheduled_at = f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
+        tid = self._pod_trace_id(pod)
 
         def do_bind():
             binding = t.Binding(
@@ -482,39 +507,49 @@ class Scheduler:
             )
             binding.metadata.name = pod.metadata.name
             binding.metadata.namespace = pod.metadata.namespace
+            binding.metadata.annotations[t.SCHEDULED_AT_ANNOTATION] = scheduled_at
             bind_t0 = time.monotonic()
-            try:
-                if ext_binder is not None:
-                    ext_binder.bind(pod.metadata.namespace, pod.metadata.name,
-                                    pod.metadata.uid, result.node)
-                else:
-                    self.cs.bind(pod.metadata.namespace, pod.metadata.name,
-                                 binding)
-                self.binding_latency.observe(time.monotonic() - bind_t0)
-                self._clear_nomination_for(pod.key())
-                self.recorder.event(
-                    pod, "Normal", "Scheduled",
-                    f"assigned to {result.node}"
-                    + (f" devices={result.assignments}" if result.assignments else ""),
-                )
-            except (Conflict, NotFound) as e:
-                self.cache.forget_pod(assumed)
-                self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-            except (ApiError, ExtenderError) as e:
-                self.cache.forget_pod(assumed)
-                self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-                self.queue.add_backoff(pod.key(), pod.spec.priority)
-            except Exception as e:  # noqa: BLE001
-                # connection-level failure (e.g. the apiserver was KILLED
-                # mid-request): the bind may or may not have landed.  Forget
-                # the assumption and requeue — a re-bind that raced a landed
-                # one answers Conflict, which the branch above absorbs.
-                # Without this, the assumed-but-unbound pod wedges forever
-                # (found by the apiserver SIGKILL test under load).
-                self.cache.forget_pod(assumed)
-                self.recorder.event(pod, "Warning", "FailedBinding",
-                                    f"transport: {e}")
-                self.queue.add_backoff(pod.key(), pod.spec.priority)
+            # span active across the POST so the apiserver's bind handling
+            # joins this pod's trace via the propagated header
+            with self.spans.start_span("scheduler.bind", trace_id=tid,
+                                       pod=pod.key(), node=result.node) as sp:
+                try:
+                    if ext_binder is not None:
+                        ext_binder.bind(pod.metadata.namespace,
+                                        pod.metadata.name,
+                                        pod.metadata.uid, result.node)
+                    else:
+                        self.cs.bind(pod.metadata.namespace, pod.metadata.name,
+                                     binding)
+                    self.binding_latency.observe(time.monotonic() - bind_t0)
+                    self._clear_nomination_for(pod.key())
+                    self.recorder.event(
+                        pod, "Normal", "Scheduled",
+                        f"assigned to {result.node}"
+                        + (f" devices={result.assignments}" if result.assignments else ""),
+                    )
+                except (Conflict, NotFound) as e:
+                    self.cache.forget_pod(assumed)
+                    sp.annotate(failure=str(e))
+                    self.recorder.event(pod, "Warning", "FailedBinding", str(e))
+                except (ApiError, ExtenderError) as e:
+                    self.cache.forget_pod(assumed)
+                    sp.annotate(failure=str(e))
+                    self.recorder.event(pod, "Warning", "FailedBinding", str(e))
+                    self.queue.add_backoff(pod.key(), pod.spec.priority)
+                except Exception as e:  # noqa: BLE001
+                    # connection-level failure (e.g. the apiserver was KILLED
+                    # mid-request): the bind may or may not have landed.
+                    # Forget the assumption and requeue — a re-bind that
+                    # raced a landed one answers Conflict, which the branch
+                    # above absorbs.  Without this, the assumed-but-unbound
+                    # pod wedges forever (found by the apiserver SIGKILL test
+                    # under load).
+                    self.cache.forget_pod(assumed)
+                    sp.annotate(failure=f"transport: {e}")
+                    self.recorder.event(pod, "Warning", "FailedBinding",
+                                        f"transport: {e}")
+                    self.queue.add_backoff(pod.key(), pod.spec.priority)
 
         # async bind (ref scheduler.go:482): don't block the scheduling loop
         self._bind_q.put(do_bind)

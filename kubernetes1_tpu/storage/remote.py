@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 from ..machinery import Conflict, NotFound, WatchEvent
 from ..machinery.scheme import Scheme
 from .server import NotPrimary, error_from_wire
+from ..utils import locksan
 
 
 def _parse_addresses(address) -> List[Union[str, Tuple[str, int]]]:
@@ -139,7 +140,7 @@ class RemoteStore:
                 self._ssl_ctx.load_cert_chain(certfile=cert_file,
                                               keyfile=key_file or None)
         self._pool: List = []
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("RemoteStore._lock")
         self._next_id = 0
 
     @property

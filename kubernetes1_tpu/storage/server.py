@@ -42,6 +42,7 @@ from ..machinery import (
     TooOldResourceVersion,
 )
 from .store import Store
+from ..utils import locksan
 
 class NotPrimary(ApiError):
     """Raised by a standby store for any client operation before promotion.
@@ -99,7 +100,7 @@ class StoreServer:
         self._threads = []
         self._stop = threading.Event()
         # replication: feed -> last acked rev, guarded by _repl_cond
-        self._repl_cond = threading.Condition()
+        self._repl_cond = locksan.make_condition(name="StoreServer._repl_cond")
         self._replica_acks: dict = {}
         if isinstance(address, str):
             try:

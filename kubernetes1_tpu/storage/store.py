@@ -121,7 +121,7 @@ class Store:
         history_limit: int = DEFAULT_HISTORY_LIMIT,
     ):
         self._scheme = scheme
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # ktpulint: ignore[KTPU007] hottest lock in the process (every MVCC op); sanitizer tracking would tax every request
         self._data: Dict[str, Tuple[int, Dict[str, Any]]] = {}  # key -> (rev, encoded obj)
         # Per-collection index: first path segment after /registry/ -> keys.
         # list("/registry/pods/...") must not scan (or sort) every event and

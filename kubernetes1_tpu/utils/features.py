@@ -22,8 +22,8 @@ Gates that are actually consulted in this codebase:
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
+from . import locksan
 
 DEFAULT_GATES: Dict[str, bool] = {
     "DevicePlugins": True,
@@ -37,7 +37,7 @@ DEFAULT_GATES: Dict[str, bool] = {
 
 class FeatureGates:
     def __init__(self, spec: str = "", defaults: Optional[Dict[str, bool]] = None):
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("FeatureGates._lock")
         self._gates = dict(defaults if defaults is not None else DEFAULT_GATES)
         if spec:
             self.apply(spec)

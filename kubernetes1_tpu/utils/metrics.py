@@ -3,7 +3,14 @@
 Ref: the reference's prometheus client usage (scheduler metrics/, kubelet
 metrics/ — incl. the fork's DevicePluginAllocationLatency observed at
 devicemanager/manager.go:231).  Histograms keep a bounded sample reservoir
-so p50/p90/p99 are queryable in-process (bench.py reads them directly).
+so p50/p90/p99 are queryable in-process (bench.py reads them directly), AND
+cumulative `_bucket` counters so a real Prometheus can aggregate across
+scrapes/instances (reservoir quantiles can't be summed; buckets can).
+
+Labels: every metric doubles as a family — `counter(name).labels(phase=
+"bind")` returns a child carrying that label set, rendered as
+`name{phase="bind"} v` under one TYPE header (the prometheus_client
+parent/child shape).
 """
 
 from __future__ import annotations
@@ -12,15 +19,46 @@ import bisect
 import random
 import threading
 from .logutil import RateLimitedReporter
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_str(labels: Optional[_LabelKey], extra: str = "") -> str:
+    """'{a="b",c="d"}' (optionally merged with an extra 'k="v"' pair)."""
+    parts = [f'{k}="{v}"' for k, v in (labels or ())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
 class Counter:
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(self, name: str, help_: str = "",
+                 labels: Optional[_LabelKey] = None):
         self.name = name
         self.help = help_
         self._v = 0.0
-        self._lock = threading.Lock()
+        self._labels = labels
+        self._children: Dict[_LabelKey, "Counter"] = {}
+        # hot leaf lock (taken on every inc/observe); plain threading — the
+        # runtime sanitizer tracking would tax every metric update
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf metric lock
+
+    def _make_child(self, key: _LabelKey) -> "Counter":
+        return type(self)(self.name, self.help, labels=key)
+
+    def labels(self, **kv: object) -> "Counter":
+        """Child metric for this label set (created on first use)."""
+        key = tuple(sorted((k, str(v)) for k, v in kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child(key)
+        return child
+
+    def _children_snapshot(self) -> List["Counter"]:
+        with self._lock:
+            return [self._children[k] for k in sorted(self._children)]
 
     def inc(self, amount: float = 1.0):
         with self._lock:
@@ -31,35 +69,83 @@ class Counter:
         with self._lock:
             return self._v
 
+    TYPE = "counter"
+
+    def _sample_lines(self) -> List[str]:
+        return [f"{self.name}{_label_str(self._labels)} {self.value}"]
+
     def render(self) -> str:
-        return f"# TYPE {self.name} counter\n{self.name} {self.value}\n"
+        children = self._children_snapshot()
+        lines = [f"# TYPE {self.name} {self.TYPE}"]
+        # the bare (unlabeled) series renders unless this is purely a
+        # family handle for labeled children
+        if not children or self._touched():
+            lines.extend(self._sample_lines())
+        for child in children:
+            lines.extend(child._sample_lines())
+        return "\n".join(lines) + "\n"
+
+    def _touched(self) -> bool:
+        return self.value != 0.0
 
 
 class Gauge(Counter):
+    TYPE = "gauge"
+
     def set(self, v: float):
         with self._lock:
             self._v = v
 
-    def render(self) -> str:
-        return f"# TYPE {self.name} gauge\n{self.name} {self.value}\n"
+
+# Default latency buckets (seconds) — the prometheus client defaults plus a
+# 30/60s tail for pod-startup SLIs (the GenAI-inference studies' regime).
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
 class Histogram:
-    """Reservoir-sampled histogram with exact quantiles over the reservoir."""
+    """Reservoir-sampled histogram: exact quantiles over the reservoir for
+    in-process readers, plus cumulative `_bucket` counters for scrapers."""
 
-    def __init__(self, name: str, help_: str = "", reservoir: int = 10000):
+    def __init__(self, name: str, help_: str = "", reservoir: int = 10000,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 labels: Optional[_LabelKey] = None):
         self.name = name
         self.help = help_
         self._samples: List[float] = []
         self._count = 0
         self._sum = 0.0
         self._max_reservoir = reservoir
-        self._lock = threading.Lock()
+        self.buckets = tuple(sorted(buckets))
+        self._bucket_counts = [0] * len(self.buckets)
+        self._labels = labels
+        self._children: Dict[_LabelKey, "Histogram"] = {}
+        # hot leaf lock (every observe) — see Counter._lock
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf metric lock
+
+    def _make_child(self, key: _LabelKey) -> "Histogram":
+        return Histogram(self.name, self.help, reservoir=self._max_reservoir,
+                         buckets=self.buckets, labels=key)
+
+    def labels(self, **kv: object) -> "Histogram":
+        key = tuple(sorted((k, str(v)) for k, v in kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child(key)
+        return child
+
+    def _children_snapshot(self) -> List["Histogram"]:
+        with self._lock:
+            return [self._children[k] for k in sorted(self._children)]
 
     def observe(self, v: float):
         with self._lock:
             self._count += 1
             self._sum += v
+            idx = bisect.bisect_left(self.buckets, v)
+            if idx < len(self._bucket_counts):
+                self._bucket_counts[idx] += 1
             if len(self._samples) < self._max_reservoir:
                 bisect.insort(self._samples, v)
             else:
@@ -85,61 +171,99 @@ class Histogram:
         with self._lock:
             return self._sum
 
-    def render(self) -> str:
-        lines = [f"# TYPE {self.name} summary"]
+    def _sample_lines(self) -> List[str]:
+        lines = []
         for q in (0.5, 0.9, 0.99):
             v = self.quantile(q)
             if v is not None:
-                lines.append(f'{self.name}{{quantile="{q}"}} {v:.6f}')
-        lines.append(f"{self.name}_sum {self.sum:.6f}")
-        lines.append(f"{self.name}_count {self.count}")
+                lines.append("%s%s %.6f" % (
+                    self.name,
+                    _label_str(self._labels, 'quantile="%s"' % q), v))
+        with self._lock:
+            cum, counts = 0, list(self._bucket_counts)
+            count, total = self._count, self._sum
+        for le, n in zip(self.buckets, counts):
+            cum += n
+            lines.append("%s_bucket%s %d" % (
+                self.name, _label_str(self._labels, 'le="%s"' % le), cum))
+        lines.append("%s_bucket%s %d" % (
+            self.name, _label_str(self._labels, 'le="+Inf"'), count))
+        lines.append("%s_sum%s %.6f" % (
+            self.name, _label_str(self._labels), total))
+        lines.append("%s_count%s %d" % (
+            self.name, _label_str(self._labels), count))
+        return lines
+
+    def render(self) -> str:
+        children = self._children_snapshot()
+        lines = [f"# TYPE {self.name} histogram"]
+        if not children or self.count:
+            lines.extend(self._sample_lines())
+        for child in children:
+            lines.extend(child._sample_lines())
         return "\n".join(lines) + "\n"
 
 
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf registry lock
 
     def register(self, metric):
         with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and existing is not metric:
+                raise ValueError(
+                    f"metric {metric.name!r} already registered as "
+                    f"{type(existing).__name__}")
             self._metrics[metric.name] = metric
         return metric
 
-    def counter(self, name: str, help_: str = "") -> Counter:
+    def _get_or_create(self, name: str, cls, help_: str):
         with self._lock:
-            if name not in self._metrics:
-                self._metrics[name] = Counter(name, help_)
-            return self._metrics[name]  # type: ignore[return-value]
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_)
+            elif type(m) is not cls:
+                # a silent wrong-type return here sent .observe() calls to a
+                # Counter once — fail loudly at registration instead
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help_)
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
-        with self._lock:
-            if name not in self._metrics:
-                self._metrics[name] = Gauge(name, help_)
-            return self._metrics[name]  # type: ignore[return-value]
+        return self._get_or_create(name, Gauge, help_)
 
     def histogram(self, name: str, help_: str = "") -> Histogram:
-        with self._lock:
-            if name not in self._metrics:
-                self._metrics[name] = Histogram(name, help_)
-            return self._metrics[name]  # type: ignore[return-value]
+        return self._get_or_create(name, Histogram, help_)
 
     def render(self) -> str:
         with self._lock:
-            return "".join(m.render() for m in self._metrics.values())  # type: ignore[attr-defined]
+            metrics = list(self._metrics.values())
+        return "".join(m.render() for m in metrics)  # type: ignore[attr-defined]
 
 
 global_registry = Registry()
 
 
 class MetricsServer:
-    """Tiny /metrics + /healthz HTTP server for a component process (ref:
-    every reference binary serves prometheus on its own port — scheduler
-    :10251, kubelet :10250/metrics, controller-manager :10252)."""
+    """Tiny /metrics + /healthz + /readyz (+ /debug/*) HTTP server for a
+    component process (ref: every reference binary serves prometheus on its
+    own port — scheduler :10251, kubelet :10250/metrics, controller-manager
+    :10252).
+
+    `ready_fn` backs /readyz: None means ready-when-serving (same as
+    /healthz); a callable gates readiness on component state (informers
+    synced, leader lease held, ...) and a falsy/raising callable answers
+    503.  `spans` (a utils.spans.SpanCollector) backs /debug/traces."""
 
     def __init__(self, registry: Registry, host: str = "127.0.0.1",
                  port: int = 0, extra: Optional[Dict[str, callable]] = None,
-                 debug: Optional[bool] = None):
+                 debug: Optional[bool] = None, ready_fn=None, spans=None):
         import json as _json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -153,6 +277,8 @@ class MetricsServer:
         if debug is None:
             debug = host in ("127.0.0.1", "localhost", "::1")
         debug_enabled = debug
+        ready_ref = ready_fn
+        spans_ref = spans
 
         class _H(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -161,12 +287,23 @@ class MetricsServer:
                 pass
 
             def do_GET(self):
-                if self.path.startswith("/debug/pprof") and debug_enabled:
-                    from urllib.parse import parse_qs, urlsplit
+                from urllib.parse import parse_qs, urlsplit
 
+                parts = urlsplit(self.path)
+                if parts.path == "/debug/traces" and debug_enabled \
+                        and spans_ref is not None:
+                    q = parse_qs(parts.query)
+                    trace = (q.get("trace") or [""])[0]
+                    body = spans_ref.to_json(trace)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/debug/pprof") and debug_enabled:
                     from .debug import handle_debug
 
-                    parts = urlsplit(self.path)
                     res = handle_debug(parts.path, parse_qs(parts.query))
                     status, ctype, body = res or (404, "text/plain", b"")
                     self.send_response(status)
@@ -177,6 +314,23 @@ class MetricsServer:
                     return
                 if self.path == "/healthz":
                     body = _json.dumps({"status": "ok"}).encode()
+                    ctype = "application/json"
+                elif self.path == "/readyz":
+                    ready = True
+                    if ready_ref is not None:
+                        try:
+                            ready = bool(ready_ref())
+                        except Exception:  # noqa: BLE001 — a broken check reads as unready
+                            ready = False
+                    body = _json.dumps(
+                        {"status": "ok" if ready else "unready"}).encode()
+                    if not ready:
+                        self.send_response(503)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     ctype = "application/json"
                 elif self.path.startswith("/metrics"):
                     text = registry_ref.render()

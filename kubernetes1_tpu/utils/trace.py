@@ -42,11 +42,21 @@ class Trace:
         self._sink = sink
         self._t0 = time.perf_counter()
         self._steps: List[Tuple[float, str]] = []
+        # attach to the thread's active span (utils/spans): the slow-op log
+        # line carries the trace id, and steps land on the span too, so
+        # /debug/traces and the step log cross-reference each other
+        from . import spans as _spans
+
+        self._span = _spans.current_span()
+        if self._span is not None:
+            self.fields.setdefault("trace", self._span.trace_id)
 
     # -- utiltrace API ------------------------------------------------------
 
     def step(self, msg: str):
         self._steps.append((time.perf_counter() - self._t0, msg))
+        if self._span is not None:
+            self._span.log(f"{self.name}: {msg}")
 
     @property
     def total_seconds(self) -> float:
@@ -57,10 +67,15 @@ class Trace:
         total = self.total_seconds
         if th is None or total < th:
             return
+        self._emit(total, th)
+
+    def _emit(self, total: float, th: Optional[float]):
         sink = self._sink or trace_sink
         tag = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        th_part = (f"threshold {th * 1000:.0f}ms" if th is not None
+                   else "exception exit")
         lines = [f'Trace "{self.name}"{(" " + tag) if tag else ""} '
-                 f"(total {total * 1000:.1f}ms, threshold {th * 1000:.0f}ms):"]
+                 f"(total {total * 1000:.1f}ms, {th_part}):"]
         prev = 0.0
         for at, msg in self._steps:
             lines.append(f"  [{at * 1000:8.1f}ms] (+{(at - prev) * 1000:.1f}ms) {msg}")
@@ -73,6 +88,16 @@ class Trace:
     def __enter__(self) -> "Trace":
         return self
 
-    def __exit__(self, *exc):
-        self.log_if_long()
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # an op that died mid-flight is ALWAYS worth its breakdown —
+            # record what blew up and log regardless of threshold (the
+            # exception's traceback says where; the trace says how long
+            # each step before it took)
+            self.step(f"error={exc_type.__name__}")
+            # th=None labels the line "exception exit" — a threshold label
+            # here would read as a threshold the op never actually crossed
+            self._emit(self.total_seconds, None)
+        else:
+            self.log_if_long()
         return False

@@ -21,7 +21,7 @@ BAD_MUTATION = """
 
     class C:
         def __init__(self):
-            self._lock = threading.Lock()
+            self._lock = make_lock("C._lock")
             self._items = {}
 
         def put(self, k, v):
@@ -37,7 +37,7 @@ GOOD_MUTATION = """
 
     class C:
         def __init__(self):
-            self._lock = threading.Lock()
+            self._lock = make_lock("C._lock")
             self._items = {}
 
         def put(self, k, v):
@@ -66,7 +66,7 @@ def test_ktpu001_init_and_locked_suffix_exempt():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._items = {}
                 self._items["seed"] = 1
 
@@ -114,7 +114,7 @@ def test_ktpu002_fires_on_sleep_under_lock():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
 
             def poll(self):
                 with self._lock:
@@ -130,7 +130,7 @@ def test_ktpu002_quiet_on_sleep_outside_lock():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._n = 0
 
             def poll(self):
@@ -147,7 +147,7 @@ def test_ktpu002_def_line_pragma_exempts_method():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
 
             def poll(self):  # ktpulint: ignore[KTPU002] lock is private to this test helper
                 with self._lock:
@@ -162,7 +162,7 @@ def test_ktpu002_fires_on_thread_join_under_lock():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._worker = threading.Thread(target=print, daemon=True)
 
             def stop(self):
@@ -322,7 +322,7 @@ def test_ktpu006_fires_on_unlocked_iteration():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._m = {}
 
             def put(self, k, v):
@@ -341,7 +341,7 @@ def test_ktpu006_def_line_pragma_exempts_method():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._m = {}
 
             def put(self, k, v):
@@ -360,7 +360,7 @@ def test_ktpu006_quiet_on_snapshot_under_lock():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
                 self._m = {}
 
             def put(self, k, v):
@@ -389,7 +389,7 @@ def test_only_filter_matches_finding_ids_not_registry_keys():
 
         class C:
             def __init__(self):
-                self._lock = threading.Lock()
+                self._lock = make_lock("C._lock")
 
             def poll(self):
                 with self._lock:
@@ -410,3 +410,41 @@ def test_render_format_is_file_line_passid():
     rendered = f.render()
     assert rendered.startswith("<mem>:")
     assert " KTPU001 " in rendered
+
+
+# ----------------------------------------------------- KTPU007 (lock factory)
+
+def test_ktpu007_fires_on_direct_lock_rlock_condition():
+    src = """
+        import threading
+
+        a = threading.Lock()
+        b = threading.RLock()
+        c = threading.Condition()
+    """
+    ids = _ids(src)
+    assert ids.count("KTPU007") == 3
+    msgs = [f.message for f in _lint(src)]
+    assert any("make_lock" in m for m in msgs)
+    assert any("make_rlock" in m for m in msgs)
+    assert any("make_condition" in m for m in msgs)
+
+
+def test_ktpu007_quiet_on_locksan_factories():
+    src = """
+        from kubernetes1_tpu.utils import locksan
+
+        a = locksan.make_lock("X._lock")
+        b = locksan.make_rlock("X._rlock")
+        c = locksan.make_condition(name="X._cond")
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu007_pragma_and_locksan_file_exempt():
+    src = 'import threading\nL = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf\n'
+    assert [f.pass_id for f in lint_file("<mem>", src)] == []
+    # the factory module itself wraps the primitives and is exempt
+    src2 = "import threading\nL = threading.Lock()\n"
+    assert lint_file("pkg/utils/locksan.py", src2) == []
+    assert [f.pass_id for f in lint_file("pkg/utils/other.py", src2)] == ["KTPU007"]

@@ -1,0 +1,400 @@
+"""End-to-end request tracing + pod-startup SLIs (ISSUE 2 tentpole).
+
+The e2e test drives one TPU pod through a LocalCluster and asserts the
+acceptance shape: ONE trace id whose spans are retrievable from the
+apiserver's, the scheduler's, and the kubelet's /debug/traces, and a
+/metrics endpoint exposing the per-phase startup histograms (labels +
+cumulative _bucket series) including the TPU device_allocation phase.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.utils import spans
+from kubernetes1_tpu.utils.metrics import MetricsServer, Registry
+from kubernetes1_tpu.utils.slo import PHASE_METRIC, StartupSLITracker
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+
+
+def _get(url, token=""):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, r.read()
+
+
+# ------------------------------------------------------------------ e2e
+
+
+class TestSpanPropagationE2E:
+    def test_one_trace_id_across_apiserver_scheduler_kubelet(self):
+        from kubernetes1_tpu.localcluster import LocalCluster
+
+        cluster = LocalCluster(nodes=1).start()
+        try:
+            cluster.wait_ready()
+            pod = make_tpu_pod("traced-pod", tpus=1)
+            pod.spec.containers[0].command = ["serve"]
+            cluster.cs.pods.create(pod)
+            must_poll_until(
+                lambda: cluster.cs.pods.get("traced-pod", "default")
+                .status.phase == t.POD_RUNNING,
+                timeout=30.0, desc="traced pod running")
+            live = cluster.cs.pods.get("traced-pod", "default")
+            tid = live.metadata.annotations.get(t.TRACE_ID_ANNOTATION)
+            assert tid, "apiserver did not stamp the trace id"
+            # every SLI phase stamp landed on the object
+            for key in (t.CREATED_AT_ANNOTATION, t.SCHEDULED_AT_ANNOTATION,
+                        t.BOUND_AT_ANNOTATION, t.ADMITTED_AT_ANNOTATION):
+                assert key in live.metadata.annotations, key
+
+            # apiserver leg
+            _, raw = _get(cluster.master.url + f"/debug/traces?trace={tid}")
+            api_spans = json.loads(raw)["spans"]
+            assert any(s["name"].startswith("apiserver.") for s in api_spans)
+            assert all(s["traceId"] == tid for s in api_spans)
+
+            # scheduler leg (schedule + bind spans)
+            _, raw = _get(cluster.scheduler.metrics_server.url
+                          + f"/debug/traces?trace={tid}")
+            sch_spans = json.loads(raw)["spans"]
+            names = {s["name"] for s in sch_spans}
+            assert "scheduler.schedule" in names
+            assert "scheduler.bind" in names
+
+            # kubelet leg (device allocation through container start)
+            kubelet = cluster.nodes[0].kubelet
+            _, raw = _get(kubelet.server.url + f"/debug/traces?trace={tid}",
+                          token=kubelet.server_token)
+            kl_spans = json.loads(raw)["spans"]
+            names = {s["name"] for s in kl_spans}
+            assert "kubelet.device_allocation" in names
+            assert "kubelet.start_container" in names
+
+            # SLI endpoint: labeled per-phase histograms with _bucket series
+            _, raw = _get(cluster.sli.metrics_server.url + "/metrics")
+            text = raw.decode()
+            for phase in ("scheduled", "bind", "admitted", "running",
+                          "total", "device_allocation"):
+                assert f'{PHASE_METRIC}_count{{phase="{phase}"}}' in text
+            assert f'{PHASE_METRIC}_bucket{{phase="device_allocation",le="+Inf"}}' in text
+
+            # readiness endpoints answer on live components
+            status, _ = _get(cluster.scheduler.metrics_server.url + "/readyz")
+            assert status == 200
+            status, _ = _get(kubelet.server.url + "/readyz")
+            assert status == 200
+        finally:
+            cluster.stop()
+
+
+# ------------------------------------------------------------------ spans
+
+
+class TestSpans:
+    def test_header_round_trip(self):
+        ctx = spans.SpanContext("aaaa", "bbbb")
+        assert spans.parse_header(spans.format_context(ctx)) == ctx
+        assert spans.parse_header("") is None
+        assert spans.parse_header("garbage") is None
+        assert spans.parse_header("/half") is None
+
+    def test_span_nesting_and_collection(self):
+        col = spans.SpanCollector("test")
+        with col.start_span("outer", trace_id="t1") as outer:
+            assert spans.current_span() is outer
+            assert spans.current_trace_id() == "t1"
+            with col.start_span("inner") as inner:
+                assert inner.trace_id == "t1"
+                assert inner.parent_id == outer.span_id
+        assert spans.current_span() is None
+        got = col.spans("t1")
+        assert [s["name"] for s in got] == ["inner", "outer"]
+
+    def test_exception_exit_records_error(self):
+        col = spans.SpanCollector("test")
+        with pytest.raises(ValueError):
+            with col.start_span("boom"):
+                raise ValueError("x")
+        assert col.spans()[0]["error"] == "ValueError"
+
+    def test_inject_header_fresh_vs_active(self):
+        fresh = spans.parse_header(spans.inject_header())
+        assert fresh is not None
+        col = spans.SpanCollector("test")
+        with col.start_span("op", trace_id="tid9") as sp:
+            ctx = spans.parse_header(spans.inject_header())
+            assert ctx == spans.SpanContext("tid9", sp.span_id)
+
+    def test_collector_bounded(self):
+        col = spans.SpanCollector("test", capacity=4)
+        for i in range(10):
+            col.start_span(f"s{i}").finish()
+        assert len(col.spans()) == 4
+
+    def test_trace_attaches_to_active_span(self):
+        from kubernetes1_tpu.utils.trace import Trace
+
+        col = spans.SpanCollector("test")
+        lines = []
+        with col.start_span("op", trace_id="tr77"):
+            with Trace("slow", threshold=0.0, sink=lines.append) as tr:
+                tr.step("one")
+        assert lines and "trace=tr77" in lines[0]
+        assert any("slow: one" in l for l in col.spans()[0]["logs"])
+
+
+# ---------------------------------------------------------------- metrics
+
+
+class TestLabeledMetrics:
+    def test_counter_labels_render(self):
+        reg = Registry()
+        c = reg.counter("req_total")
+        c.labels(verb="GET").inc(2)
+        c.labels(verb="POST").inc()
+        out = reg.render()
+        assert '# TYPE req_total counter' in out
+        assert 'req_total{verb="GET"} 2.0' in out
+        assert 'req_total{verb="POST"} 1.0' in out
+
+    def test_histogram_buckets_cumulative(self):
+        reg = Registry()
+        h = reg.histogram("lat")
+        for v in (0.003, 0.02, 0.02, 7.0):
+            h.observe(v)
+        out = reg.render()
+        assert 'lat_bucket{le="0.005"} 1' in out
+        assert 'lat_bucket{le="0.025"} 3' in out
+        assert 'lat_bucket{le="10.0"} 4' in out
+        assert 'lat_bucket{le="+Inf"} 4' in out
+        assert 'lat_count 4' in out
+
+    def test_labeled_histogram_merges_label_sets(self):
+        reg = Registry()
+        h = reg.histogram("phase_s")
+        h.labels(phase="bind").observe(0.3)
+        out = reg.render()
+        assert 'phase_s_bucket{phase="bind",le="0.5"} 1' in out
+        assert 'phase_s{phase="bind",quantile="0.5"} 0.300000' in out
+        assert 'phase_s_sum{phase="bind"} 0.300000' in out
+
+    def test_same_labels_same_child(self):
+        reg = Registry()
+        c = reg.counter("x")
+        c.labels(a="1").inc()
+        c.labels(a="1").inc()
+        assert c.labels(a="1").value == 2.0
+
+    def test_registry_type_collision_raises(self):
+        reg = Registry()
+        reg.counter("m1")
+        with pytest.raises(ValueError):
+            reg.histogram("m1")
+        with pytest.raises(ValueError):
+            reg.gauge("m1")
+        # same-type lookup still returns the existing metric
+        assert reg.counter("m1") is reg.counter("m1")
+
+    def test_register_collision_raises(self):
+        from kubernetes1_tpu.utils.metrics import Counter, Histogram
+
+        reg = Registry()
+        h = reg.register(Histogram("h1"))
+        assert reg.register(h) is h  # same object is fine
+        with pytest.raises(ValueError):
+            reg.register(Counter("h1"))
+
+
+class TestReadyz:
+    def test_readyz_follows_ready_fn(self):
+        state = {"ready": False}
+        srv = MetricsServer(Registry(), port=0,
+                            ready_fn=lambda: state["ready"]).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url + "/readyz")
+            assert ei.value.code == 503
+            state["ready"] = True
+            status, raw = _get(srv.url + "/readyz")
+            assert status == 200 and b"ok" in raw
+            # healthz stays unconditionally live
+            status, _ = _get(srv.url + "/healthz")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_readyz_default_is_ready(self):
+        srv = MetricsServer(Registry(), port=0).start()
+        try:
+            status, _ = _get(srv.url + "/readyz")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_metrics_server_serves_traces(self):
+        col = spans.SpanCollector("comp")
+        col.start_span("op", trace_id="abc").finish()
+        srv = MetricsServer(Registry(), port=0, spans=col).start()
+        try:
+            _, raw = _get(srv.url + "/debug/traces?trace=abc")
+            doc = json.loads(raw)
+            assert doc["component"] == "comp"
+            assert [s["name"] for s in doc["spans"]] == ["op"]
+        finally:
+            srv.stop()
+
+
+# -------------------------------------------------------------------- SLI
+
+
+def _sli_pod(name="p1", uid="u1", tpus=1, phase=t.POD_RUNNING, node="n1",
+             created=100.0, scheduled=100.5, bound=100.6, admitted=101.0):
+    pod = make_tpu_pod(name, tpus=tpus) if tpus else _plain_pod(name)
+    pod.metadata.uid = uid
+    pod.spec.node_name = node
+    pod.status.phase = phase
+    ann = pod.metadata.annotations
+    if created is not None:
+        ann[t.CREATED_AT_ANNOTATION] = f"{created:.6f}"
+    if scheduled is not None:
+        ann[t.SCHEDULED_AT_ANNOTATION] = f"{scheduled:.6f}"
+    if bound is not None:
+        ann[t.BOUND_AT_ANNOTATION] = f"{bound:.6f}"
+    if admitted is not None:
+        ann[t.ADMITTED_AT_ANNOTATION] = f"{admitted:.6f}"
+    return pod
+
+
+def _plain_pod(name):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = "default"
+    pod.spec.containers = [t.Container(name="c", image="img")]
+    return pod
+
+
+class _FakeClientset:
+    """Just enough for StartupSLITracker.__init__ (informer never started)."""
+
+    class _C:
+        scheme = None
+
+        def __getattr__(self, item):
+            raise AssertionError("unit test must not hit the API")
+
+    pods = _C()
+
+
+class TestStartupSLIMath:
+    def _tracker(self):
+        return StartupSLITracker(_FakeClientset())
+
+    @staticmethod
+    def _watch_pending(tr, uid="u1", tpus=1, created=100.0):
+        """Replay the real watch sequence's first event: ADDED, Pending,
+        unscheduled — what a tracker running since cluster boot sees."""
+        tr.record(_sli_pod(uid=uid, tpus=tpus, phase=t.POD_PENDING, node="",
+                           created=created, scheduled=None, bound=None,
+                           admitted=None), now=created + 0.01)
+
+    def test_phase_decomposition(self):
+        tr = self._tracker()
+        self._watch_pending(tr)
+        pod = _sli_pod()
+        tr.record(pod, now=102.0)
+        h = tr.phase_seconds
+
+        def one(phase):
+            child = h.labels(phase=phase)
+            assert child.count == 1, phase
+            return child.sum
+
+        assert one("scheduled") == pytest.approx(0.5)
+        assert one("bind") == pytest.approx(0.1)
+        assert one("admitted") == pytest.approx(0.4)
+        assert one("running") == pytest.approx(1.0)
+        assert one("total") == pytest.approx(2.0)
+        # TPU pod: device_allocation = scheduled-at -> admitted-at
+        assert one("device_allocation") == pytest.approx(0.5)
+        assert tr.pods_started.value == 1
+        assert set(tr.report()) == {
+            "scheduled", "bind", "admitted", "running", "total",
+            "device_allocation"}
+
+    def test_running_only_counted_once(self):
+        tr = self._tracker()
+        self._watch_pending(tr)
+        pod = _sli_pod()
+        tr.record(pod, now=102.0)
+        tr.record(pod, now=109.0)  # later resync must not double-observe
+        assert tr.phase_seconds.labels(phase="total").count == 1
+
+    def test_non_tpu_pod_has_no_device_phase(self):
+        tr = self._tracker()
+        self._watch_pending(tr, tpus=0)
+        pod = _sli_pod(tpus=0)
+        tr.record(pod, now=102.0)
+        assert tr.phase_seconds.labels(phase="device_allocation").count == 0
+        assert tr.phase_seconds.labels(phase="total").count == 1
+
+    def test_replayed_running_pod_ignored(self):
+        tr = self._tracker()
+        pod = _sli_pod()
+        # first ever sighting is already Running: history replay, skip
+        tr.record(pod, now=500.0)
+        # identical record for a pod WATCHED through pending first: counted
+        pending = _sli_pod(uid="u2", phase=t.POD_PENDING, node="",
+                           scheduled=None, bound=None, admitted=None)
+        tr.record(pending, now=100.1)
+        tr.record(_sli_pod(uid="u2"), now=102.0)
+        assert tr.phase_seconds.labels(phase="total").count == 1
+        assert tr.pods_started.value == 1
+
+    def test_missing_stamp_skips_phase_not_pod(self):
+        tr = self._tracker()
+        self._watch_pending(tr, uid="u3")
+        pod = _sli_pod(uid="u3", admitted=None)
+        tr.record(pod, now=102.0)
+        assert tr.phase_seconds.labels(phase="scheduled").count == 1
+        assert tr.phase_seconds.labels(phase="admitted").count == 0
+        assert tr.phase_seconds.labels(phase="total").count == 1
+        # incomplete decomposition: not counted as a fully-tracked start
+        assert tr.pods_started.value == 0
+
+
+class TestTraceExceptionExit:
+    def test_exception_exit_always_logs_with_error_step(self):
+        from kubernetes1_tpu.utils.trace import Trace
+
+        lines = []
+        with pytest.raises(RuntimeError):
+            # huge threshold: would never log on the normal path
+            with Trace("doomed", threshold=1e9, sink=lines.append) as tr:
+                tr.step("prep")
+                raise RuntimeError("boom")
+        assert len(lines) == 1
+        assert "error=RuntimeError" in lines[0] and "prep" in lines[0]
+
+    def test_exception_exit_logs_even_without_threshold(self):
+        from kubernetes1_tpu.utils.trace import Trace
+
+        lines = []
+        with pytest.raises(KeyError):
+            with Trace("doomed2", sink=lines.append):
+                raise KeyError("k")
+        assert len(lines) == 1 and "error=KeyError" in lines[0]
+
+    def test_clean_exit_still_respects_threshold(self):
+        from kubernetes1_tpu.utils.trace import Trace
+
+        lines = []
+        with Trace("fast", threshold=1e9, sink=lines.append) as tr:
+            tr.step("x")
+        assert lines == []

@@ -156,6 +156,7 @@ def run_gate(paths: Sequence[str] = (), rel_root: str = "") -> int:
 
 # importing the pass modules populates the registry
 from . import exceptions_pass  # noqa: E402,F401
+from . import lockfactory_pass  # noqa: E402,F401
 from . import locks_pass  # noqa: E402,F401
 from . import threads_pass  # noqa: E402,F401
 from . import wallclock_pass  # noqa: E402,F401

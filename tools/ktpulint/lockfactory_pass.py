@@ -1,0 +1,50 @@
+"""KTPU007 — direct threading.Lock()/RLock()/Condition() construction.
+
+Threaded control-plane code creates its locks through the
+`utils/locksan.py` factories (`make_lock`/`make_rlock`/`make_condition`)
+so every lock carries a lockdep class name and participates in the
+runtime lock-order/hold-time sanitizer the tier-1 suite runs under
+(`KTPU_LOCKSAN=1`).  A lock constructed directly from `threading` is
+invisible to the sanitizer: a deadlock through it surfaces as a 3am
+freeze instead of a `LockOrderViolation` at test time.
+
+`utils/locksan.py` itself is exempt — it is the wrapper around the
+primitives.  The rare legitimate direct construction (a leaf lock on a
+path hot enough that sanitizer tracking would tax every operation)
+carries `# ktpulint: ignore[KTPU007] <why>` — the pragma is the
+documentation that a human weighed the trade.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileContext, Finding, register
+
+_PRIMITIVES = {
+    "Lock": "make_lock",
+    "RLock": "make_rlock",
+    "Condition": "make_condition",
+}
+
+
+@register("KTPU007")
+def direct_lock_construction(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if path.endswith("utils/locksan.py"):
+        return []  # the factory implementation wraps the primitives
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _PRIMITIVES
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "threading"):
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU007",
+                f"direct threading.{f.attr}() — use "
+                f"utils/locksan.{_PRIMITIVES[f.attr]}(name) so the runtime "
+                f"lock sanitizer covers it"))
+    return findings
