@@ -224,6 +224,14 @@ def bench_density():
             assigned.extend(er.assigned)
     distinct = len(set(assigned))
 
+    # read-path economics for this phase (BENCH_r06 delta vs r05): how
+    # often the once-per-revision serialization cache served list/watch
+    # bytes, and whether any slow watcher had to be 410-evicted
+    enc_hits, enc_misses = master.scheme.serialization_cache.stats()
+    enc_total = enc_hits + enc_misses
+    watch_evictions = (master.cacher.watch_evictions
+                       + getattr(master.store, "watch_evictions", 0))
+
     sli_phases = sli.report()
     sli.stop()
     sli_cs.close()
@@ -246,6 +254,11 @@ def bench_density():
         "pods_per_sec": round(n_ok / total_wall, 1) if total_wall else 0,
         "distinct_chips_assigned": distinct,
         "sli_phases": sli_phases,
+        "encode_cache_hit_ratio": round(enc_hits / enc_total, 4)
+        if enc_total else 0.0,
+        "encode_cache_hits": enc_hits,
+        "encode_cache_misses": enc_misses,
+        "watch_evictions": watch_evictions,
     }
 
 

@@ -677,20 +677,39 @@ class Registry:
         label_selector: str = "",
         field_selector: str = "",
     ):
-        items, rev = self.store.list(self.prefix(resource, namespace))
+        # same raw-dict matching as the cached path (list_raw); only the
+        # survivors get decoded — selectors can't drift between the two
+        dicts, rev = self.list_raw(self.store, resource, namespace,
+                                   label_selector=label_selector,
+                                   field_selector=field_selector)
+        return [self.scheme.decode(d) for d in dicts], rev
+
+    def list_raw(
+        self,
+        via,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ):
+        """Cached LIST: raw wire dicts from the watch cache (`via`),
+        filtered with the SAME selector semantics as list/watch — the
+        matching rules live here so the cached and authoritative paths
+        cannot drift apart."""
+        entries, rev = via.list_raw(self.prefix(resource, namespace))
+        dicts = [obj for _key, _rev, obj in entries]
         if label_selector:
             reqs = labelutil.parse_selector(label_selector)
-            items = [
-                o for o in items if labelutil.selector_matches(reqs, o.metadata.labels)
+            dicts = [
+                d for d in dicts
+                if labelutil.selector_matches(
+                    reqs, (d.get("metadata") or {}).get("labels") or {})
             ]
         if field_selector:
             freqs = parse_field_selector(field_selector)
-            items = [
-                o for o in items
-                if field_selector_matches(freqs, self.scheme.encode(o),
-                                          resource)
-            ]
-        return items, rev
+            dicts = [d for d in dicts
+                     if field_selector_matches(freqs, d, resource)]
+        return dicts, rev
 
     def watch(
         self,
@@ -699,8 +718,17 @@ class Registry:
         since_rev: int = 0,
         label_selector: str = "",
         field_selector: str = "",
+        via=None,
+        queue_limit=None,
     ):
-        w = self.store.watch(self.prefix(resource, namespace), since_rev)
+        """`via` overrides the event source (the apiserver passes its
+        watch cache so client watches never register on the store itself);
+        selector predicates attach the same way either way.  queue_limit
+        (None = the source's default) bounds the delivery queue before
+        slow-consumer eviction."""
+        source = via if via is not None else self.store
+        kw = {} if queue_limit is None else {"queue_limit": queue_limit}
+        w = source.watch(self.prefix(resource, namespace), since_rev, **kw)
         lreqs = labelutil.parse_selector(label_selector) if label_selector else None
         freqs = parse_field_selector(field_selector) if field_selector else None
 

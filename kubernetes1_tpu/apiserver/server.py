@@ -27,9 +27,17 @@ from ..utils import fasthttp, locksan, spans as spanlib
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
-from ..machinery import ApiError, BadRequest, Forbidden, NotFound, Unauthorized
+from ..machinery import (
+    ApiError,
+    BadRequest,
+    ERROR,
+    Forbidden,
+    NotFound,
+    TooOldResourceVersion,
+    Unauthorized,
+)
 from ..machinery.scheme import Scheme, global_scheme
-from ..storage import Store
+from ..storage import CacheNotReady, Cacher, DEFAULT_WATCH_QUEUE_LIMIT, Store
 from .admission import (
     CREATE,
     UPDATE,
@@ -147,13 +155,24 @@ class _Handler(BaseHTTPRequestHandler):
     def master(self) -> "Master":
         return self.server.master  # type: ignore[attr-defined]
 
-    def _send_json(self, code: int, payload: Dict[str, Any]):
-        raw = json.dumps(payload, separators=(",", ":")).encode()
+    def _send_raw_json(self, code: int, raw: bytes):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
         self.wfile.write(raw)
+
+    def _send_json(self, code: int, payload: Dict[str, Any]):
+        self._send_raw_json(
+            code, json.dumps(payload, separators=(",", ":")).encode())
+
+    def _send_obj(self, code: int, obj):
+        """Single-object response through the once-per-revision
+        serialization cache: the encode this pays (on miss) populates the
+        SAME entry every watch fan-out and list touching this
+        (uid, resourceVersion) then reuses."""
+        self._send_raw_json(code, self.master.scheme.encode_obj_bytes(
+            obj, getattr(self, "_req_version", "")))
 
     def _send_error(self, err: ApiError):
         self._send_json(err.code, err.to_status())
@@ -497,10 +516,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------------ GET
 
     def _do_get(self, resource, ns, name, sub, q):
-        reg = self.master.registry
         if name and not sub:
-            obj = reg.get(resource, ns, name)
-            self._send_json(200, self._enc(obj))
+            self._get_object(resource, ns, name)
             return
         if resource == "pods" and sub == "log":
             self._proxy_pod_log(ns, name, q)
@@ -513,27 +530,64 @@ class _Handler(BaseHTTPRequestHandler):
         if q.get("watch") in ("1", "true"):
             self._serve_watch(resource, ns, q)
             return
-        items, rev = reg.list(
-            resource,
-            ns,
-            label_selector=q.get("labelSelector", ""),
-            field_selector=q.get("fieldSelector", ""),
-        )
-        kind = self.master.scheme.by_resource[resource].KIND + "List"
-        encoded = [self._enc(o) for o in items]
-        # the List envelope carries the version the items are encoded in —
-        # envelope/items disagreement breaks version-trusting decoders
-        list_version = (encoded[0]["apiVersion"] if encoded
-                        else getattr(self, "_req_version", "") or "v1")
-        self._send_json(
-            200,
-            {
+        self._list_objects(resource, ns, q)
+
+    def _get_object(self, resource, ns, name):
+        """Single-object GET from the watch cache: committed wire dict ->
+        cached bytes, zero decode/encode.  Falls back to the store when
+        the cache can't answer fresh (still seeding, pump behind)."""
+        reg = self.master.registry
+        try:
+            raw = self.master.cacher.get_raw(reg.key(resource, ns, name))
+        except CacheNotReady:
+            self._send_obj(200, reg.get(resource, ns, name))
+            return
+        if raw is None:
+            raise NotFound(f'{resource} "{name}" not found')
+        self._send_raw_json(200, self.master.scheme.encode_bytes(
+            raw, getattr(self, "_req_version", "")))
+
+    def _list_objects(self, resource, ns, q):
+        """LIST from the watch cache: selector predicates run on the raw
+        wire dicts and the response body is assembled from per-object
+        cached bytes — one serialization per (object, revision) across
+        every list, get, and watch frame that touches it."""
+        master = self.master
+        scheme = master.scheme
+        reg = master.registry
+        label_selector = q.get("labelSelector", "")
+        field_selector = q.get("fieldSelector", "")
+        kind = scheme.by_resource[resource].KIND + "List"
+        ver = getattr(self, "_req_version", "")
+        try:
+            dicts, rev = reg.list_raw(master.cacher, resource, ns,
+                                      label_selector=label_selector,
+                                      field_selector=field_selector)
+        except CacheNotReady:
+            # authoritative fallback: decoded store list + per-item encode
+            items, rev = reg.list(resource, ns,
+                                  label_selector=label_selector,
+                                  field_selector=field_selector)
+            encoded = [self._enc(o) for o in items]
+            list_version = (encoded[0]["apiVersion"] if encoded
+                            else ver or "v1")
+            self._send_json(200, {
                 "kind": kind,
                 "apiVersion": list_version,
                 "metadata": {"resourceVersion": str(rev)},
                 "items": encoded,
-            },
-        )
+            })
+            return
+        # the List envelope carries the version the items are encoded in —
+        # envelope/items disagreement breaks version-trusting decoders
+        list_version = (scheme.converted_api_version(dicts[0], ver)
+                        if dicts else ver or "v1")
+        head = ('{"kind":"%s","apiVersion":"%s",'
+                '"metadata":{"resourceVersion":"%s"},"items":['
+                % (kind, list_version, rev)).encode()
+        body = head + b",".join(
+            scheme.encode_bytes(d, ver) for d in dicts) + b"]}"
+        self._send_raw_json(200, body)
 
     # --------------------------------------- kubelet proxy (exec/logs/etc.)
 
@@ -647,13 +701,32 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve_watch(self, resource, ns, q):
         since = int(q.get("resourceVersion") or 0)
         timeout = float(q.get("timeoutSeconds") or 0)
-        w = self.master.registry.watch(
-            resource,
-            ns,
-            since_rev=since,
-            label_selector=q.get("labelSelector", ""),
-            field_selector=q.get("fieldSelector", ""),
-        )
+        try:
+            w = self.master.registry.watch(
+                resource,
+                ns,
+                since_rev=since,
+                label_selector=q.get("labelSelector", ""),
+                field_selector=q.get("fieldSelector", ""),
+                via=self.master.cacher,
+            )
+        except (CacheNotReady, TooOldResourceVersion):
+            # Cache can't serve: still seeding / pump behind, OR the
+            # resume revision predates the cache's window (an apiserver
+            # restart seeds the window at the CURRENT revision while the
+            # store's history ring may reach much further back).  Watch
+            # the store directly — at the same configured queue bound —
+            # instead of 410ing every reconnecting informer into a
+            # synchronized relist storm; the store raises its own 410 if
+            # the revision is truly compacted.
+            w = self.master.registry.watch(
+                resource,
+                ns,
+                since_rev=since,
+                label_selector=q.get("labelSelector", ""),
+                field_selector=q.get("fieldSelector", ""),
+                queue_limit=self.master.watch_queue_limit,
+            )
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -671,8 +744,20 @@ class _Handler(BaseHTTPRequestHandler):
                 if self.master.stopping.is_set():
                     break
                 if ev is None:
-                    if getattr(w, "closed", False):
-                        # upstream (external store) stream died: END this
+                    if getattr(w, "evicted", False):
+                        # slow consumer (or cache reseed): this stream can
+                        # no longer be gap-free.  Answer 410 Expired so
+                        # the reflector relists — the reference cacher's
+                        # eviction contract (storage/cacher.go).
+                        status = TooOldResourceVersion(
+                            "watch evicted; relist required").to_status()
+                        self._write_chunk(json.dumps(
+                            {"type": ERROR, "object": status},
+                            separators=(",", ":")).encode() + b"\n")
+                        break
+                    if getattr(w, "closed", False) or w._stopped.is_set():
+                        # upstream (external store) stream died or the
+                        # watcher was stopped server-side: END this
                         # client's watch so its reflector relists/rewatches
                         # — heartbeating a dead pipe would stall the
                         # cluster's control loops silently
@@ -683,22 +768,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if not w.event_matches(ev.object):
                     continue
                 # watch frames honor the requested version like every verb.
-                # The WatchEvent object is SHARED by every watcher of the
-                # resource (one fan-out per commit), so the serialized
-                # frame is memoized on it — N watchers cost one encode,
-                # the Cacher economics the reference gets from its watch
-                # cache (storage/cacher.go).
-                ver = getattr(self, "_req_version", "")
-                wire = getattr(ev, "_wire", None)
-                if wire is None or wire[0] != ver:
-                    obj = self.master.scheme.convert_dict(ev.object, ver)
-                    frame = json.dumps(
-                        {"type": ev.type, "object": obj},
-                        separators=(",", ":")).encode() + b"\n"
-                    ev._wire = (ver, frame)
-                else:
-                    frame = wire[1]
-                self._write_chunk(frame)
+                # The WatchEvent is SHARED by every watcher of the resource
+                # (one fan-out per commit) and the payload bytes come from
+                # the scheme's once-per-revision serialization cache — N
+                # watchers plus every list/get of the same revision cost
+                # ONE encode (the reference's cacher economics,
+                # storage/cacher.go).
+                self._write_chunk(self.master.scheme.watch_frame_bytes(
+                    ev.type, ev.object, getattr(self, "_req_version", "")))
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:
@@ -717,7 +794,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _serve_metrics(self):
-        body = self.master.metrics.render().encode()
+        master = self.master
+        hits, misses = master.scheme.serialization_cache.stats()
+        total = hits + misses
+        evictions = (master.cacher.watch_evictions
+                     + getattr(master.store, "watch_evictions", 0))
+        extra = [
+            "# TYPE ktpu_encode_cache_hits_total counter",
+            f"ktpu_encode_cache_hits_total {hits}",
+            "# TYPE ktpu_encode_cache_misses_total counter",
+            f"ktpu_encode_cache_misses_total {misses}",
+            "# TYPE ktpu_encode_cache_hit_ratio gauge",
+            f"ktpu_encode_cache_hit_ratio "
+            f"{(hits / total) if total else 0.0:.6f}",
+            "# TYPE ktpu_watch_slow_consumer_evictions_total counter",
+            f"ktpu_watch_slow_consumer_evictions_total {evictions}",
+            "# TYPE ktpu_watch_cache_reseeds_total counter",
+            f"ktpu_watch_cache_reseeds_total {master.cacher.reseeds}",
+        ]
+        body = (master.metrics.render() + "\n".join(extra) + "\n").encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
         self.send_header("Content-Length", str(len(body)))
@@ -751,7 +846,7 @@ class _Handler(BaseHTTPRequestHandler):
                     eviction = decoded
             evicted = reg.evict(ns, name, eviction)
             self.master.audit("evict", resource, ns, name, self._user.name)
-            self._send_json(201, self._enc(evicted))
+            self._send_obj(201, evicted)
             return
         if sub:
             raise NotFound(f"subresource {sub!r} not writable")
@@ -793,7 +888,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.master.apply_crd(created)
         elif resource == "apiservices":
             self.master.apply_apiservice(created)
-        self._send_json(201, self._enc(created))
+        self._send_obj(201, created)
 
     # ------------------------------------------------------------------ PUT
 
@@ -828,7 +923,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.master.audit("update", resource, ns, name, self._user.name,
                           request_obj=body,
                           response_obj=lambda: self.master.scheme.encode(updated))
-        self._send_json(200, self._enc(updated))
+        self._send_obj(200, updated)
 
     # ---------------------------------------------------------------- PATCH
 
@@ -858,7 +953,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.master.audit("patch", resource, ns, name, self._user.name,
                           request_obj=patch,
                           response_obj=lambda: self.master.scheme.encode(updated))
-        self._send_json(200, self._enc(updated))
+        self._send_obj(200, updated)
 
     # --------------------------------------------------------------- DELETE
 
@@ -874,7 +969,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.master.remove_crd(obj)
         elif resource == "apiservices":
             self.master.remove_apiservice(obj)
-        self._send_json(200, self._enc(obj))
+        self._send_obj(200, obj)
 
 
 class Metrics:
@@ -943,6 +1038,9 @@ class Master:
                                                # unix path or host:port — makes
                                                # this apiserver stateless
         store_ca_file: str = "",               # verify the store's TLS cert
+        watch_queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,  # per-watcher
+                                               # event bound before slow-
+                                               # consumer eviction (410)
     ):
         fasthttp.install()  # idempotent (see class docstring)
         # own copy: CRD registrations must not leak into the process-global
@@ -958,6 +1056,14 @@ class Master:
         else:
             self.store = Store(self.scheme, wal_path=wal_path)
         self.registry = Registry(self.store, self.scheme)
+        # k8s-cacher-analog read layer: GET/LIST/WATCH serve from an
+        # in-memory watch-fed view (one store watch and zero decode/encode
+        # per request); writes keep going straight to the store.  Paired
+        # with scheme.serialization_cache, encode work per event is O(1)
+        # in watcher count.
+        self.watch_queue_limit = watch_queue_limit
+        self.cacher = Cacher(self.store, self.scheme,
+                             queue_limit=watch_queue_limit).start()
         self.token = token
         self.metrics = Metrics()
         # request spans land here, served at /debug/traces (utils/spans).
@@ -1267,6 +1373,9 @@ class Master:
 
     def stop(self):
         self.stopping.set()
+        # cacher first: its pump is a store watcher, and open client
+        # watches must see their streams end before the store closes
+        self.cacher.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         # audit sink last: in-flight requests finishing during shutdown

@@ -38,6 +38,9 @@ class SharedInformer:
         self.resync_period = resync_period
         self._cache: Dict[str, Any] = {}
         self._lock = locksan.make_rlock("SharedInformer._lock")
+        # observability: how often this informer had to fall back to a
+        # full LIST (initial sync, watch stream end, 410-eviction recovery)
+        self.relists = 0
         self._handlers: List[Dict[str, Callable]] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -113,6 +116,7 @@ class SharedInformer:
         with self._lock:
             old = self._cache
             self._cache = fresh
+            self.relists += 1
         for key, obj in fresh.items():
             if key in old:
                 self._dispatch("update", old[key], obj)

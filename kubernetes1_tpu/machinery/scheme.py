@@ -11,12 +11,13 @@ values equal to the field default are omitted (the `omitempty` convention).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import json
 import threading
 import typing
-from typing import Any, Dict, Optional, Type
+from typing import Any, Dict, Optional, Tuple, Type
 
 # Fields whose wire name is not the mechanical snake->camel conversion.
 _SPECIAL_WIRE_NAMES = {
@@ -200,6 +201,60 @@ class Unstructured:
         return self.metadata.name
 
 
+class SerializationCache:
+    """Once-per-revision serializer memo (the watch-cache economics of the
+    reference's storage/cacher.go: one encode serves every watcher and
+    every list/get response touching the same object revision).
+
+    Entries are keyed by (uid, resourceVersion, requested api version).
+    Both identifiers are server-stamped and immutable for a committed
+    object state, so an entry can never go stale — it only ages out of
+    the LRU window.  The reuse window is short (the fan-out of the commit
+    that produced the revision, plus the lists and gets racing it), so a
+    bounded LRU holds the entire hot set."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._data: "collections.OrderedDict[tuple, bytes]" = \
+            collections.OrderedDict()
+        # hot leaf lock: one acquire per cached encode on the read path
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf serializer lock; machinery must not depend on utils
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> Optional[bytes]:
+        with self._lock:
+            raw = self._data.get(key)
+            if raw is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return raw
+
+    def put(self, key: tuple, raw: bytes):
+        with self._lock:
+            self._data[key] = raw
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def clear(self):
+        """Conversion/CRD (de)registration changes what an encode means;
+        drop everything rather than reason about which keys survive."""
+        with self._lock:
+            self._data.clear()
+
+    def stats(self) -> Tuple[int, int]:
+        with self._lock:
+            return self.hits, self.misses
+
+    def hit_ratio(self) -> float:
+        hits, misses = self.stats()
+        total = hits + misses
+        return hits / total if total else 0.0
+
+
 class Scheme:
     """Kind registry: maps (kind) <-> dataclass and resource plural names.
 
@@ -217,6 +272,8 @@ class Scheme:
         self.dynamic_resources: Dict[str, str] = {}  # plural -> kind
         # (kind, apiVersion) -> (from_internal, to_internal) dict converters
         self.conversions: Dict[tuple, tuple] = {}
+        # once-per-revision canonical JSON bytes (see SerializationCache)
+        self.serialization_cache = SerializationCache()
 
     def register(self, cls: Type, plural: Optional[str] = None, namespaced: bool = True):
         kind = cls.KIND or cls.__name__
@@ -239,6 +296,9 @@ class Scheme:
         s.dynamic_kinds = dict(self.dynamic_kinds)
         s.dynamic_resources = dict(self.dynamic_resources)
         s.conversions = dict(self.conversions)
+        # fresh cache: two copies may register DIFFERENT conversions for
+        # the same version string, so cached bytes must not cross schemes
+        s.serialization_cache = SerializationCache()
         return s
 
     def register_dynamic(self, kind: str, plural: str, api_version: str,
@@ -250,6 +310,7 @@ class Scheme:
         self.by_resource[plural] = Unstructured
         self.resource_of[kind] = plural
         self.namespaced[plural] = namespaced
+        self.serialization_cache.clear()
 
     def deregister_dynamic(self, kind: str):
         plural = self.resource_of.pop(kind, "")
@@ -258,6 +319,7 @@ class Scheme:
         self.by_kind.pop(kind, None)
         self.by_resource.pop(plural, None)
         self.namespaced.pop(plural, None)
+        self.serialization_cache.clear()
 
     def register_conversion(self, kind: str, api_version: str,
                             from_internal, to_internal):
@@ -268,6 +330,7 @@ class Scheme:
         on plain JSON dicts, mirroring the reference's generated
         Convert_v1beta1_X_To_internal_X functions."""
         self.conversions[(kind, api_version)] = (from_internal, to_internal)
+        self.serialization_cache.clear()
 
     def served_versions(self, kind: str) -> list:
         cls = self.by_kind.get(kind)
@@ -302,6 +365,64 @@ class Scheme:
 
     def encode_json(self, obj: Any) -> str:
         return json.dumps(self.encode(obj), separators=(",", ":"))
+
+    # ---------------------------------------------- once-per-revision bytes
+    #
+    # The apiserver's whole read path (single GETs, list items, watch
+    # frames) funnels through these two helpers so N watchers and M list
+    # responses touching the same committed object state share ONE
+    # json.dumps — the economics the reference gets from its watch cache
+    # (storage/cacher.go serves pre-serialized event payloads).
+
+    def encode_bytes(self, d: Dict[str, Any], version: str = "") -> bytes:
+        """Canonical JSON bytes for an ALREADY-ENCODED wire dict (the form
+        the store commits and watch events carry), memoized per
+        (uid, resourceVersion, version).  Uncommitted objects (no uid/rv —
+        Status payloads, ERROR frames) bypass the cache."""
+        meta = d.get("metadata") or {}
+        uid, rv = meta.get("uid"), meta.get("resourceVersion")
+        key = (uid, rv, version) if uid and rv else None
+        if key is not None:
+            raw = self.serialization_cache.get(key)
+            if raw is not None:
+                return raw
+        out = self.convert_dict(d, version) if version else d
+        raw = json.dumps(out, separators=(",", ":")).encode()
+        if key is not None:
+            self.serialization_cache.put(key, raw)
+        return raw
+
+    def encode_obj_bytes(self, obj: Any, version: str = "") -> bytes:
+        """Canonical JSON bytes for a DECODED object, sharing the same
+        (uid, resourceVersion, version) cache as encode_bytes — a write
+        response populates the entry the watch fan-out then hits."""
+        meta = getattr(obj, "metadata", None)
+        uid = getattr(meta, "uid", "") if meta is not None else ""
+        rv = getattr(meta, "resource_version", "") if meta is not None else ""
+        key = (uid, rv, version) if uid and rv else None
+        if key is not None:
+            raw = self.serialization_cache.get(key)
+            if raw is not None:
+                return raw
+        raw = json.dumps(self.encode(obj, version),
+                         separators=(",", ":")).encode()
+        if key is not None:
+            self.serialization_cache.put(key, raw)
+        return raw
+
+    def watch_frame_bytes(self, typ: str, d: Dict[str, Any],
+                          version: str = "") -> bytes:
+        """One line-delimited watch frame; the object payload comes from
+        the shared serialization cache."""
+        return (b'{"type":"' + typ.encode() + b'","object":'
+                + self.encode_bytes(d, version) + b"}\n")
+
+    def converted_api_version(self, d: Dict[str, Any], version: str) -> str:
+        """The apiVersion encode_bytes(d, version) will emit — what the
+        List envelope must carry so envelope and items agree."""
+        if version and (d.get("kind", ""), version) in self.conversions:
+            return version
+        return d.get("apiVersion", "")
 
     def decode(self, data: Dict[str, Any]) -> Any:
         from .meta import ObjectMeta

@@ -1,1 +1,8 @@
-from .store import ReplicaFeed, StopUpdate, Store, Watcher
+from .store import (
+    DEFAULT_WATCH_QUEUE_LIMIT,
+    ReplicaFeed,
+    StopUpdate,
+    Store,
+    Watcher,
+)
+from .cacher import CacheNotReady, Cacher

@@ -301,6 +301,12 @@ class RemoteStore:
         res = self._call("list", {"prefix": prefix})
         return [self._scheme.decode(o) for o in res["items"]], res["rev"]
 
+    def list_raw(self, prefix: str) -> Tuple[List[Tuple[str, int, dict]], int]:
+        """(key, rev, encoded obj) entries — the watch cache's seed path.
+        The store ships its committed wire form with keys verbatim."""
+        res = self._call("list_raw", {"prefix": prefix})
+        return [(k, r, o) for k, r, o in res["items"]], res["rev"]
+
     def update_cas(self, key: str, obj) -> Any:
         return self._scheme.decode(
             self._call("update_cas", {"key": key,
@@ -330,7 +336,11 @@ class RemoteStore:
 
     # ------------------------------------------------------------------ watch
 
-    def watch(self, prefix: str, since_rev: int = 0) -> RemoteWatcher:
+    def watch(self, prefix: str, since_rev: int = 0,
+              queue_limit: Optional[int] = None) -> RemoteWatcher:
+        """queue_limit rides the RPC so the server-side Watcher honors it
+        (0 = unbounded — the cacher's own feed must never be evicted by
+        the bound meant for slow CLIENTS; None = the server default)."""
         last_exc: Optional[Exception] = None
         attempts = 2 if len(self._addrs) == 1 else 2 + 6 * len(self._addrs)
         for attempt in range(attempts):
@@ -343,10 +353,12 @@ class RemoteStore:
                 last_exc = ConnectionError(f"store {addr} unreachable: {e}")
                 self._advance(addr)
                 continue
+            params = {"prefix": prefix, "since_rev": since_rev}
+            if queue_limit is not None:
+                params["queue_limit"] = queue_limit
             try:
                 f.write(json.dumps({"id": 0, "method": "watch",
-                                    "params": {"prefix": prefix,
-                                               "since_rev": since_rev}})
+                                    "params": params})
                         .encode() + b"\n")
                 f.flush()
                 line = f.readline()

@@ -230,6 +230,11 @@ class StoreServer:
             items, rev = s.list(p["prefix"])
             return {"items": [s._scheme.encode(o) for o in items],
                     "rev": rev}
+        if method == "list_raw":
+            # watch-cache seed path: ship the committed wire form with its
+            # keys verbatim — no decode/encode for a whole-store list
+            entries, rev = s.list_raw(p["prefix"])
+            return {"items": [[k, r, o] for k, r, o in entries], "rev": rev}
         if method == "update_cas":
             obj = s.update_cas(p["key"], s._scheme.decode(p["obj"]))
             return self._replicated(s._scheme.encode(obj))
@@ -375,8 +380,11 @@ class StoreServer:
 
     def _serve_watch(self, conn, f, rid, params):
         try:
+            kw = {}
+            if "queue_limit" in params:
+                kw["queue_limit"] = int(params["queue_limit"])
             w = self.store.watch(params.get("prefix", ""),
-                                 int(params.get("since_rev", 0)))
+                                 int(params.get("since_rev", 0)), **kw)
         except Exception as e:  # noqa: BLE001
             f.write(json.dumps({"id": rid, "error": error_to_wire(e)})
                     .encode() + b"\n")
@@ -388,6 +396,11 @@ class StoreServer:
             while not self._stop.is_set():
                 ev = w.next_timeout(WATCH_HEARTBEAT_SECONDS)
                 if ev is None:
+                    if w.evicted or w._stopped.is_set():
+                        # slow remote consumer: end the stream — the
+                        # client-side watcher reads EOF as a dead stream
+                        # and its cacher reseeds with a fresh list
+                        break
                     f.write(b"\n")  # heartbeat: detect half-open peers
                 else:
                     # store watch events already carry the encoded dict form

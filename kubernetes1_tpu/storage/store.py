@@ -41,33 +41,130 @@ from ..machinery import (
     now_iso,
 )
 from ..machinery.scheme import Scheme
+from ..utils import locksan
 
 # Keep this many events for watch resume before compaction kicks in.
 DEFAULT_HISTORY_LIMIT = 100_000
+# Per-watcher delivery queue bound: a consumer this far behind the commit
+# stream is wedged, not slow — evict it (it relists) instead of growing the
+# queue without limit.  0 disables the bound (internal consumers like the
+# watch cache's feed, which is drained by a dedicated pump thread).
+DEFAULT_WATCH_QUEUE_LIMIT = 4096
+# Replication feeds ride out longer bursts (an evicted standby pays a full
+# snapshot resync), but a wedged standby must not pin the commit history.
+DEFAULT_REPLICA_QUEUE_LIMIT = 65536
 
 
 class StopUpdate(Exception):
     """Raised by a GuaranteedUpdate callback to abort without error."""
 
 
-class Watcher:
-    """A single watch stream; iterate to receive WatchEvents; stop() to end."""
+def collection_of(key: str) -> str:
+    """"/registry/<resource>/..." -> "<resource>" — THE key-layout parser,
+    shared by the store's per-collection index and the watch cache."""
+    parts = key.split("/", 3)
+    return parts[2] if len(parts) > 2 else ""
 
-    def __init__(self, store: "Store", prefix: str):
-        self._store = store
+
+def history_index(history, since_rev: int) -> int:
+    """First index in a revision-ordered history list whose rev is
+    > since_rev (binary search — the history ring can hold 100k entries
+    and this runs under the owner's lock)."""
+    lo, hi = 0, len(history)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if history[mid][0] <= since_rev:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+class Watcher:
+    """A single watch stream; iterate to receive WatchEvents; stop() to end.
+
+    Delivery is BOUNDED (queue_limit events; 0 = unbounded): a consumer
+    that stops draining — a wedged HTTP client, a stalled informer — is
+    EVICTED instead of backing the whole control plane's memory.  Eviction
+    ends the stream with `evicted` set so the serving layer answers 410
+    Gone and the client relists, the reference cacher's slow-watcher
+    contract (storage/cacher.go terminateAllWatchers).
+
+    With buffering=True the watcher starts in replay mode: live pushes are
+    buffered while the owner replays history OUTSIDE its lock, then
+    flushed in order — so a resume-from-revision neither scans history
+    under the hottest lock in the process nor reorders events."""
+
+    def __init__(self, owner, prefix: str,
+                 queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,
+                 buffering: bool = False):
+        self._owner = owner
         self.prefix = prefix
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._limit = queue_limit
         self._stopped = threading.Event()
+        self.evicted = False
+        self._pending: Optional[List[WatchEvent]] = [] if buffering else None
+        self._plock = locksan.make_lock("storage.Watcher._plock")
 
     def _push(self, ev: WatchEvent):
-        if not self._stopped.is_set():
-            self._q.put(ev)
+        """Owner-side: enqueue a live event (buffered during replay)."""
+        with self._plock:
+            if self._pending is not None:
+                self._pending.append(ev)
+                return
+            self._deliver_locked(ev)
+
+    def _deliver_locked(self, ev: WatchEvent):
+        """Must hold _plock: queue the event, or evict on overflow."""
+        if self._stopped.is_set():
+            return
+        if self._limit and self._q.qsize() >= self._limit:
+            self._evict_locked()
+            return
+        self._q.put(ev)
+
+    def _evict_locked(self, note: bool = True):
+        """Must hold _plock: end this stream as a slow/stale consumer.
+        Queued events still drain; then the consumer sees the stream end
+        with `evicted` set and answers 410.  note=False skips the
+        slow-consumer counter (reseed evictions are not the client's
+        fault and are tracked separately)."""
+        if self._stopped.is_set():
+            return
+        self.evicted = True
+        self._stopped.set()
+        self._q.put(None)
+        if note:
+            self._owner._note_watch_eviction()
+
+    def _evict(self, note: bool = True):
+        with self._plock:
+            self._evict_locked(note)
+
+    def _replay_and_go_live(self, entries):
+        """Deliver a history snapshot (taken under the owner's lock, but
+        filtered and delivered outside it), then flush any live events
+        that were buffered while replaying — revision order preserved.
+        _plock is taken per event, NOT across the whole replay: a commit's
+        fan-out blocks on _plock while holding the owner's lock, so one
+        watcher resuming far behind must not convoy every writer."""
+        for _rev, typ, key, obj in entries:
+            if self._stopped.is_set():
+                break
+            if key.startswith(self.prefix):
+                with self._plock:
+                    self._deliver_locked(WatchEvent(typ, obj))
+        with self._plock:
+            for ev in self._pending:
+                self._deliver_locked(ev)
+            self._pending = None
 
     def stop(self):
         if not self._stopped.is_set():
             self._stopped.set()
             self._q.put(None)
-            self._store._remove_watcher(self)
+            self._owner._remove_watcher(self)
 
     def __iter__(self):
         return self
@@ -90,16 +187,29 @@ class Watcher:
 class ReplicaFeed:
     """A standby's subscription to the primary's commit stream: a queue of
     (rev, type, key, obj) records, optionally preceded by a full snapshot
-    (set when the standby's since_rev predates the history floor)."""
+    (set when the standby's since_rev predates the history floor).
 
-    def __init__(self):
+    Bounded like Watcher: a standby that stops draining is cut loose
+    (`evicted` set, stream ends) rather than pinning the commit backlog in
+    RAM — it reconnects and resyncs, via snapshot if it fell past the
+    history floor."""
+
+    def __init__(self, queue_limit: int = DEFAULT_REPLICA_QUEUE_LIMIT):
         self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._limit = queue_limit
         self._stopped = threading.Event()
+        self.evicted = False
         self.snapshot: Optional[tuple] = None  # (items, rev) or None
 
     def _push(self, rec: tuple):
-        if not self._stopped.is_set():
-            self._q.put(rec)
+        if self._stopped.is_set():
+            return
+        if self._limit and self._q.qsize() >= self._limit:
+            self.evicted = True
+            self._stopped.set()
+            self._q.put(None)
+            return
+        self._q.put(rec)
 
     def next_timeout(self, timeout: float) -> Optional[tuple]:
         try:
@@ -135,6 +245,19 @@ class Store:
         self._compacted_rev = 0  # watches must start > this
         self._watchers: List[Watcher] = []
         self._replicas: List["ReplicaFeed"] = []
+        # slow-consumer eviction counters (surfaced as
+        # ktpu_watch_slow_consumer_evictions_total on /metrics).  The
+        # watcher counter has its own leaf lock because evictions can fire
+        # from a replay thread that does NOT hold self._lock.
+        self.watch_evictions = 0
+        self.replica_evictions = 0
+        self._stats_lock = locksan.make_lock("storage.Store._stats_lock")
+        # synchronous commit sinks (the in-process watch cache): called as
+        # fn(rev, typ, key, obj) inside the commit critical section, so a
+        # sink is NEVER behind the store — no feed queue, no pump-thread
+        # wakeup per commit (measured ~35% of write throughput on the
+        # GIL), no freshness wait on reads
+        self._commit_hooks: List[Callable] = []
         self._wal_path = wal_path
         self._wal = None
         if wal_path:
@@ -198,13 +321,48 @@ class Store:
             self._wal.write(
                 json.dumps({"rev": rev, "type": typ, "key": key, "obj": obj}) + "\n"
             )
+        self._fanout_locked(rev, typ, key, obj)
+        for r in self._replicas:
+            r._push((rev, typ, key, obj))
+        dead = [r for r in self._replicas if r.evicted]
+        if dead:
+            self.replica_evictions += len(dead)
+            self._replicas = [r for r in self._replicas if not r.evicted]
+        return rev, obj
+
+    def _fanout_locked(self, rev: int, typ: str, key: str,
+                       obj: Dict[str, Any]):
+        """Must hold lock: one shared event to every matching watcher plus
+        the synchronous commit hooks (used by local commits AND replicated
+        applies — the delivery rules must not drift between them)."""
         event = WatchEvent(typ, obj)
+        evicted = False
         for w in self._watchers:
             if key.startswith(w.prefix):
                 w._push(event)
-        for r in self._replicas:
-            r._push((rev, typ, key, obj))
-        return rev, obj
+            evicted = evicted or w.evicted
+        if evicted:
+            # prune lazily: eviction fires inside the fan-out loop, where
+            # removing from the list being iterated would skip watchers
+            self._watchers = [w for w in self._watchers if not w.evicted]
+        for hook in self._commit_hooks:
+            hook(rev, typ, key, obj)
+
+    def add_commit_hook(self, fn: Callable):
+        """Register a synchronous commit sink (see _commit_hooks)."""
+        with self._lock:
+            self._commit_hooks.append(fn)
+
+    def remove_commit_hook(self, fn: Callable):
+        with self._lock:
+            try:
+                self._commit_hooks.remove(fn)
+            except ValueError:
+                pass
+
+    def _note_watch_eviction(self):
+        with self._stats_lock:
+            self.watch_evictions += 1
 
     def _decode(self, obj: Dict[str, Any]):
         return self._scheme.decode(obj)
@@ -223,14 +381,19 @@ class Store:
             if key in self._data:
                 raise AlreadyExists(f"{key} already exists")
             _, stored = self._commit_locked(ADDED, key, encoded)
-            return self._decode(stored)
+        # decode OUTSIDE the lock (here and in get/update_cas/delete):
+        # committed dicts are immutable, and response decoding under the
+        # hottest lock in the process serialized every reader and writer
+        # behind each individual request's deserialization
+        return self._decode(stored)
 
     def get(self, key: str) -> Any:
         with self._lock:
             ent = self._data.get(key)
             if ent is None:
                 raise NotFound(f"{key} not found")
-            return self._decode(ent[1])
+            raw = ent[1]
+        return self._decode(raw)
 
     def get_or_none(self, key: str):
         try:
@@ -238,24 +401,38 @@ class Store:
         except NotFound:
             return None
 
-    @staticmethod
-    def _collection_of(key: str) -> str:
-        # "/registry/<resource>/..." -> "<resource>"
-        parts = key.split("/", 3)
-        return parts[2] if len(parts) > 2 else ""
+    _collection_of = staticmethod(collection_of)
 
-    def list(self, prefix: str) -> Tuple[List[Any], int]:
-        """All objects under prefix + the store revision for watch resume."""
+    def list_raw(self, prefix: str) -> Tuple[List[Tuple[str, int, Dict[str, Any]]], int]:
+        """Raw (key, rev, encoded obj) entries under prefix + the store
+        revision.  No decode: the watch cache and the HTTP read path
+        consume the committed wire form directly (committed dicts are
+        immutable by the _commit_locked copy contract)."""
         with self._lock:
-            keys = self._by_collection.get(self._collection_of(prefix))
-            if keys is None:
-                return [], self._rev
-            items = [
-                self._decode(self._data[key][1])
-                for key in sorted(keys)
+            coll = self._collection_of(prefix)
+            if coll:
+                keys = self._by_collection.get(coll)
+                if keys is None:
+                    return [], self._rev
+                keys = sorted(keys)
+            else:
+                # cross-collection prefix ("/registry/"): the watch cache
+                # seeds its whole view in one list — full scan is the point
+                keys = sorted(self._data)
+            entries = [
+                (key,) + self._data[key]
+                for key in keys
                 if key.startswith(prefix) and key in self._data
             ]
-            return items, self._rev
+            return entries, self._rev
+
+    def list(self, prefix: str) -> Tuple[List[Any], int]:
+        """All objects under prefix + the store revision for watch resume.
+        Raw entries are snapshotted under the lock and decoded AFTER
+        release — decoding is the expensive half of a list, and doing it
+        under the lock serialized every read against every write."""
+        entries, rev = self.list_raw(prefix)
+        return [self._decode(obj) for _key, _rev, obj in entries], rev
 
     def update_cas(self, key: str, obj) -> Any:
         """Single compare-and-swap using obj.metadata.resource_version."""
@@ -271,7 +448,7 @@ class Store:
                     f"{key}: resourceVersion mismatch (have {cur_rev}, want {expect})"
                 )
             _, stored = self._commit_locked(MODIFIED, key, encoded)
-            return self._decode(stored)
+        return self._decode(stored)
 
     def guaranteed_update(self, key: str, update_fn: Callable[[Any], Any]) -> Any:
         """Read-modify-CAS retry loop (ref: etcd3 store.go:263).
@@ -298,28 +475,35 @@ class Store:
             if expect_rv and str(cur_rev) != expect_rv:
                 raise Conflict(f"{key}: resourceVersion mismatch")
             _, stored = self._commit_locked(DELETED, key, obj)
-            return self._decode(stored)
+        return self._decode(stored)
 
     # ------------------------------------------------------------------ watch
 
-    def watch(self, prefix: str, since_rev: int = 0) -> Watcher:
+    def watch(self, prefix: str, since_rev: int = 0,
+              queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT) -> Watcher:
         """Watch events for keys under prefix with rev > since_rev.
 
         since_rev==0 means "from now".  Resuming below the compaction floor
-        raises TooOldResourceVersion — the client must relist.
+        raises TooOldResourceVersion — the client must relist.  The replay
+        slice is located by binary search and delivered OUTSIDE the store
+        lock (the watcher buffers live pushes until the replay lands), so
+        registering a resuming watcher no longer scans up to
+        history_limit entries under the hottest lock in the process.
         """
+        replay: List[Tuple[int, str, str, Dict[str, Any]]] = []
         with self._lock:
             if since_rev and since_rev < self._compacted_rev:
                 raise TooOldResourceVersion(
                     f"revision {since_rev} compacted (floor {self._compacted_rev})"
                 )
-            w = Watcher(self, prefix)
+            w = Watcher(self, prefix, queue_limit=queue_limit,
+                        buffering=bool(since_rev))
             if since_rev:
-                for rev, typ, key, obj in self._history:
-                    if rev > since_rev and key.startswith(prefix):
-                        w._push(WatchEvent(typ, obj))
+                replay = self._history[history_index(self._history, since_rev):]
             self._watchers.append(w)
-            return w
+        if since_rev:
+            w._replay_and_go_live(replay)
+        return w
 
     def _remove_watcher(self, w: Watcher):
         with self._lock:
@@ -337,12 +521,14 @@ class Store:
     # (rev, type, key, obj) — exactly the WAL line — so a standby replays
     # commits verbatim and its store is revision-identical to the primary.
 
-    def replication_feed(self, since_rev: int = 0) -> "ReplicaFeed":
+    def replication_feed(self, since_rev: int = 0,
+                         queue_limit: int = DEFAULT_REPLICA_QUEUE_LIMIT,
+                         ) -> "ReplicaFeed":
         """Subscribe to commit records > since_rev.  If since_rev is below
         the history floor the feed carries a snapshot first (the standby's
         state is too old to catch up incrementally)."""
         with self._lock:
-            feed = ReplicaFeed()
+            feed = ReplicaFeed(queue_limit=queue_limit)
             if since_rev < self._compacted_rev:
                 # too old: full-state snapshot at the current revision,
                 # then stream from here
@@ -350,10 +536,17 @@ class Store:
                                   for k, (rev, obj) in self._data.items()],
                                  self._rev)
             else:
-                for rev, typ, key, obj in self._history:
-                    if rev > since_rev:
-                        feed._push((rev, typ, key, obj))
-            self._replicas.append(feed)
+                # binary-search the start instead of scanning the whole
+                # ring under the lock; the slice holds only rev > since_rev
+                start = history_index(self._history, since_rev)
+                for rec in self._history[start:]:
+                    feed._push(rec)
+            if feed.evicted:
+                # overflowed during the replay itself (standby too far
+                # behind): count it now and never register the dead feed
+                self.replica_evictions += 1
+            else:
+                self._replicas.append(feed)
             return feed
 
     def _remove_replica(self, feed: "ReplicaFeed"):
@@ -390,10 +583,7 @@ class Store:
             if self._wal:
                 self._wal.write(json.dumps(
                     {"rev": rev, "type": typ, "key": key, "obj": obj}) + "\n")
-            event = WatchEvent(typ, obj)
-            for w in self._watchers:
-                if key.startswith(w.prefix):
-                    w._push(event)
+            self._fanout_locked(rev, typ, key, obj)
 
     def apply_snapshot(self, items, rev: int):
         """Standby-side: replace local state with a primary snapshot."""

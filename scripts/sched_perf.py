@@ -260,6 +260,17 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         v = mx.get(name)
         return round(v, 4) if v is not None else None
 
+    # read-path economics off the APISERVER's /metrics (the watch cache +
+    # once-per-revision serialization layer this burst leans on)
+    amx = scrape_metrics(url)
+    read_path = {
+        "encode_cache_hit_ratio": amx.get("ktpu_encode_cache_hit_ratio"),
+        "encode_cache_hits": amx.get("ktpu_encode_cache_hits_total"),
+        "encode_cache_misses": amx.get("ktpu_encode_cache_misses_total"),
+        "watch_evictions": amx.get(
+            "ktpu_watch_slow_consumer_evictions_total"),
+    } if amx else None
+
     result = {
         "nodes": nodes,
         "pods_requested": pods,
@@ -273,6 +284,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "bind_latency_p99_s": pct(0.99),
         "burst_tail": burst_model,
         "multiproc": multiproc,
+        "read_path": read_path,
         "steady_state": steady,
         # per-attempt algorithm latency from the scheduler's own histogram —
         # in-process via the object, multiproc via the /metrics endpoint

@@ -1,0 +1,304 @@
+"""Watch-cache semantics: fresh reads, exact resume, 410 floor, slow-watcher
+eviction at both the cache and store layers, and informer 410-recovery."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.machinery import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    TooOldResourceVersion,
+)
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+from kubernetes1_tpu.storage.cacher import Cacher, key_for_dict
+
+from tests.test_machinery import make_pod
+
+
+@pytest.fixture
+def store():
+    s = Store(global_scheme)
+    yield s
+    s.close()
+
+
+# both feed modes must expose identical semantics: synchronous commit-hook
+# feeding (in-process store, the Master default) and the watch-fed pump
+# (remote stores)
+@pytest.fixture(params=["sync", "pump"])
+def feed_mode(request):
+    return request.param
+
+
+def make_cacher(store, feed_mode="sync", **kw):
+    return Cacher(store, global_scheme,
+                  force_watch_feed=(feed_mode == "pump"), **kw).start()
+
+
+def key(pod):
+    return f"/registry/pods/{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+class TestCacherReads:
+    def test_list_serves_preexisting_state(self, store, feed_mode):
+        for i in range(3):
+            store.create(key(make_pod(f"p{i}")), make_pod(f"p{i}"))
+        c = make_cacher(store, feed_mode)
+        try:
+            entries, rev = c.list_raw("/registry/pods/default/")
+            assert [e[2]["metadata"]["name"] for e in entries] == \
+                ["p0", "p1", "p2"]
+            assert rev == store.current_revision()
+        finally:
+            c.stop()
+
+    def test_read_your_write_freshness(self, store, feed_mode):
+        c = make_cacher(store, feed_mode)
+        try:
+            # every write must be visible to the immediately-following
+            # read, even though the cache is fed asynchronously
+            for i in range(20):
+                pod = make_pod(f"rw{i}")
+                store.create(key(pod), pod)
+                raw = c.get_raw(key(pod))
+                assert raw is not None and \
+                    raw["metadata"]["name"] == f"rw{i}"
+        finally:
+            c.stop()
+
+    def test_get_raw_missing_is_none(self, store):
+        c = make_cacher(store)
+        try:
+            assert c.get_raw("/registry/pods/default/nope") is None
+        finally:
+            c.stop()
+
+    def test_delete_removes_from_cache(self, store, feed_mode):
+        c = make_cacher(store, feed_mode)
+        try:
+            pod = make_pod("gone")
+            store.create(key(pod), pod)
+            store.delete(key(pod))
+            assert c.get_raw(key(pod)) is None
+            entries, _ = c.list_raw("/registry/pods/default/")
+            assert entries == []
+        finally:
+            c.stop()
+
+
+class TestCacherWatch:
+    def test_resume_from_revision_returns_exactly_missed_events(self, store, feed_mode):
+        c = make_cacher(store, feed_mode)
+        try:
+            store.create(key(make_pod("a")), make_pod("a"))
+            _, rev = c.list_raw("/registry/pods/")
+            store.create(key(make_pod("b")), make_pod("b"))
+            fresh = store.get(key(make_pod("b")))
+            fresh.spec.node_name = "n1"
+            store.update_cas(key(make_pod("b")), fresh)
+            store.delete(key(make_pod("a")))
+            w = c.watch("/registry/pods/", since_rev=rev)
+            evs = [w.next_timeout(2) for _ in range(3)]
+            assert [(e.type, e.object["metadata"]["name"]) for e in evs] == \
+                [(ADDED, "b"), (MODIFIED, "b"), (DELETED, "a")]
+            # exactly the missed events: nothing more queued
+            assert w.next_timeout(0.2) is None
+            # revision order is strict
+            revs = [int(e.object["metadata"]["resourceVersion"])
+                    for e in evs]
+            assert revs == sorted(revs) and revs[0] > rev
+            w.stop()
+        finally:
+            c.stop()
+
+    def test_resume_below_floor_is_410_and_relist_recovers(self, store, feed_mode):
+        c = make_cacher(store, feed_mode, history_limit=4)
+        try:
+            for i in range(10):
+                store.create(key(make_pod(f"p{i}")), make_pod(f"p{i}"))
+            c.wait_fresh()
+            with pytest.raises(TooOldResourceVersion):
+                c.watch("/registry/pods/", since_rev=1)
+            # the relist + re-watch path recovers cleanly
+            entries, rev = c.list_raw("/registry/pods/default/")
+            assert len(entries) == 10
+            w = c.watch("/registry/pods/", since_rev=rev)
+            store.create(key(make_pod("p10")), make_pod("p10"))
+            ev = w.next_timeout(2)
+            assert ev.type == ADDED
+            assert ev.object["metadata"]["name"] == "p10"
+            w.stop()
+        finally:
+            c.stop()
+
+    def test_slow_watcher_evicted_with_410(self, store, feed_mode):
+        c = make_cacher(store, feed_mode)
+        try:
+            w = c.watch("/registry/pods/", queue_limit=3)
+            for i in range(8):
+                store.create(key(make_pod(f"s{i}")), make_pod(f"s{i}"))
+            deadline = time.monotonic() + 5
+            while not w.evicted and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert w.evicted
+            assert c.watch_evictions == 1
+            # queued events (a prefix of the stream, in order) still
+            # drain, then the stream ends
+            got = []
+            while True:
+                ev = w.next_timeout(1)
+                if ev is None:
+                    break
+                got.append(ev.object["metadata"]["name"])
+            assert got == [f"s{i}" for i in range(len(got))]
+            assert len(got) <= 3
+            # the cacher itself keeps serving; new watchers are unaffected
+            entries, rev = c.list_raw("/registry/pods/default/")
+            assert len(entries) == 8
+        finally:
+            c.stop()
+
+    def test_feed_death_reseeds_and_evicts_open_watchers(self, store):
+        c = make_cacher(store, "pump")
+        try:
+            c.wait_fresh()
+            w = c.watch("/registry/pods/")
+            # kill the internal feed: the pump must reseed and 410 the
+            # open watcher (it may have a gap it can't prove it doesn't)
+            c._feed.stop()
+            deadline = time.monotonic() + 5
+            while not w.evicted and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert w.evicted
+            assert w.next_timeout(1) is None  # stream ended
+            # post-reseed the cache still answers fresh reads
+            pod = make_pod("after-reseed")
+            store.create(key(pod), pod)
+            assert c.get_raw(key(pod)) is not None
+            assert c.reseeds >= 1
+        finally:
+            c.stop()
+
+
+class TestKeyForDict:
+    def test_namespaced_cluster_scoped_and_unknown(self):
+        pod = global_scheme.encode(make_pod("k1"))
+        assert key_for_dict(global_scheme, pod) == \
+            "/registry/pods/default/k1"
+        node = t.Node()
+        node.metadata.name = "n1"
+        assert key_for_dict(global_scheme, global_scheme.encode(node)) == \
+            "/registry/nodes/n1"
+        assert key_for_dict(global_scheme, {"kind": "NoSuchKind",
+                                            "metadata": {"name": "x"}}) is None
+        assert key_for_dict(global_scheme, {"kind": "Pod",
+                                            "metadata": {}}) is None
+
+
+class TestStoreWatcherBounds:
+    def test_store_watcher_evicted_on_overflow(self, store):
+        w = store.watch("/registry/pods/", queue_limit=2)
+        for i in range(6):
+            store.create(key(make_pod(f"b{i}")), make_pod(f"b{i}"))
+        assert w.evicted
+        assert store.watch_evictions == 1
+        got = []
+        while True:
+            ev = w.next_timeout(1)
+            if ev is None:
+                break
+            got.append(ev.object["metadata"]["name"])
+        assert got == ["b0", "b1"]
+        # the evicted watcher is pruned from fan-out; new ones still work
+        w2 = store.watch("/registry/pods/")
+        store.create(key(make_pod("b9")), make_pod("b9"))
+        ev = w2.next_timeout(1)
+        assert ev.object["metadata"]["name"] == "b9"
+        w2.stop()
+
+    def test_replica_feed_evicted_on_overflow(self, store):
+        feed = store.replication_feed(queue_limit=3)
+        for i in range(8):
+            store.create(key(make_pod(f"r{i}")), make_pod(f"r{i}"))
+        assert feed.evicted
+        assert store.replica_evictions == 1
+        # queued records drain in order, then the feed ends (standby
+        # reconnects and resyncs)
+        got = []
+        while True:
+            rec = feed.next_timeout(1)
+            if rec is None:
+                break
+            got.append(rec[3]["metadata"]["name"])
+        assert got == ["r0", "r1", "r2"]
+
+    def test_resume_replay_is_ordered_with_concurrent_commits(self, store):
+        """Replay now happens outside the store lock with live events
+        buffered; revision order must survive the interleave."""
+        for i in range(50):
+            store.create(key(make_pod(f"o{i}")), make_pod(f"o{i}"))
+        stop = threading.Event()
+
+        def writer():
+            i = 50
+            while not stop.is_set():
+                store.create(key(make_pod(f"o{i}")), make_pod(f"o{i}"))
+                i += 1
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        try:
+            for _ in range(10):
+                w = store.watch("/registry/pods/", since_rev=5)
+                revs = []
+                for _ in range(60):
+                    ev = w.next_timeout(1)
+                    if ev is None:
+                        break
+                    revs.append(int(ev.object["metadata"]["resourceVersion"]))
+                w.stop()
+                assert revs == sorted(revs), "events out of revision order"
+                assert revs and revs[0] == 6
+        finally:
+            stop.set()
+            th.join(timeout=5)
+
+
+class TestDeepHistoryFallback:
+    def test_resume_below_cache_window_falls_back_to_store_history(self):
+        """A resume below the cache's window but inside the store's deeper
+        history ring must replay from the store (no 410, no relist storm —
+        e.g. informers reconnecting after the cache window rolled)."""
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(make_pod("base"))
+            _, rv0 = cs.pods.list(namespace="default")
+            # shrink the CACHE window only; the store ring stays deep
+            with master.cacher._cond:
+                master.cacher._history_limit = 2
+            names = [f"deep-{i}" for i in range(8)]
+            for n in names:
+                cs.pods.create(make_pod(n))
+            # cache floor has rolled past rv0 by now
+            assert master.cacher._compacted_rev > int(rv0)
+            got = []
+            with cs.pods.watch(namespace="default",
+                               resource_version=rv0) as stream:
+                for ev_type, obj in stream:
+                    assert ev_type != "ERROR", obj
+                    got.append(obj["metadata"]["name"])
+                    if len(got) == len(names):
+                        break
+            assert got == names
+        finally:
+            cs.close()
+            master.stop()

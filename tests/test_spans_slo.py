@@ -398,3 +398,77 @@ class TestTraceExceptionExit:
         with Trace("fast", threshold=1e9, sink=lines.append) as tr:
             tr.step("x")
         assert lines == []
+
+
+# ------------------------------------------------- watch-cache regression
+#
+# ISSUE 3 satellite: the trace/SLI pipeline consumes pods through the
+# apiserver's watch cache now — the stamps written via the binding
+# subresource and the kubelet's admitted-at PATCH must still reach watch
+# consumers, in revision order, with nothing skipped or reordered.
+
+
+class TestSLIStampsThroughWatchCache:
+    def test_bind_and_patch_stamps_reach_watchers_in_revision_order(self):
+        import threading
+
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            stream = cs.pods.watch(namespace="default")
+            frames = []
+            done = threading.Event()
+
+            def drain():
+                for _ev_type, obj in stream:
+                    frames.append(obj)
+                    ann = (obj.get("metadata") or {}).get("annotations") or {}
+                    if t.ADMITTED_AT_ANNOTATION in ann:
+                        done.set()
+                        return
+
+            th = threading.Thread(target=drain, daemon=True)
+            th.start()
+
+            pod = make_tpu_pod("sli-watch-pod", tpus=0)
+            cs.pods.create(pod)
+            # scheduler path: slo./trace. stamps ride the Binding and are
+            # merged onto the pod by registry.bind in ONE commit
+            binding = t.Binding(target_node="n1")
+            binding.metadata.name = "sli-watch-pod"
+            binding.metadata.namespace = "default"
+            binding.metadata.annotations = {
+                t.SCHEDULED_AT_ANNOTATION: f"{time.time():.6f}",
+                t.TRACE_ID_ANNOTATION: "cafecafecafecafe",
+            }
+            cs.bind("default", "sli-watch-pod", binding)
+            # kubelet path: admitted-at lands via a metadata PATCH
+            cs.pods.patch("sli-watch-pod", {"metadata": {"annotations": {
+                t.ADMITTED_AT_ANNOTATION: f"{time.time():.6f}"}}})
+
+            assert done.wait(10), "admitted-at never reached the watcher"
+            stream.close()
+            th.join(timeout=5)
+
+            revs = [int(o["metadata"]["resourceVersion"]) for o in frames]
+            assert revs == sorted(revs), "events out of revision order"
+            assert len(set(revs)) == len(revs), "duplicate revisions"
+            # the bind commit carries BOTH the merged stamps and bound-at
+            bind_frame = next(
+                o for o in frames
+                if o.get("spec", {}).get("nodeName") == "n1")
+            ann = bind_frame["metadata"]["annotations"]
+            assert t.SCHEDULED_AT_ANNOTATION in ann
+            assert t.BOUND_AT_ANNOTATION in ann
+            assert ann[t.TRACE_ID_ANNOTATION] == "cafecafecafecafe"
+            # the final frame has the full stamp set, admitted-at included
+            final = frames[-1]["metadata"]["annotations"]
+            for key in (t.SCHEDULED_AT_ANNOTATION, t.BOUND_AT_ANNOTATION,
+                        t.ADMITTED_AT_ANNOTATION):
+                assert key in final, key
+        finally:
+            cs.close()
+            master.stop()
