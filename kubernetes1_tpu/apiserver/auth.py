@@ -247,8 +247,8 @@ class WebhookTokenAuthenticator:
             return None
         status = (body or {}).get("status") or {}
         user = None
-        if status.get("authenticated"):
-            u = status.get("user") or {}
+        if status.get("authenticated"):  # ktpulint: ignore[KTPU009] TokenReview wire shape — no registered dataclass
+            u = status.get("user") or {}  # ktpulint: ignore[KTPU009] TokenReview wire shape — no registered dataclass
             if u.get("username"):
                 user = UserInfo(
                     name=u["username"],

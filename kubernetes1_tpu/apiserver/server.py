@@ -402,7 +402,7 @@ class _Handler(BaseHTTPRequestHandler):
             if (method == "POST" and len(parts) == 4 and parts[0] == "apis"
                     and parts[1] == "authorization.k8s.io"
                     and parts[3] == "selfsubjectaccessreviews"):
-                attrs = ((self._read_body().get("spec") or {})
+                attrs = ((self._read_body().get("spec") or {})  # ktpulint: ignore[KTPU009] SelfSubjectAccessReview wire shape — no registered dataclass
                          .get("resourceAttributes") or {})
                 allowed = self.master.authorizer.authorize(
                     user,

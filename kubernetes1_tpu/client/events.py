@@ -39,7 +39,9 @@ class EventRecorder:
         up.  Returns immediately — the API write happens on the sink
         thread."""
         ref = t.ObjectReference(
-            kind=type(obj).KIND,
+            # instance lookup, not type(obj).KIND: obj may be a frozen
+            # mutsan proxy (informer handout), which forwards per-instance
+            kind=obj.KIND,
             namespace=obj.metadata.namespace,
             name=obj.metadata.name,
             uid=obj.metadata.uid,

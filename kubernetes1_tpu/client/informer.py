@@ -18,7 +18,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional
 
 from ..machinery import ApiError, TooOldResourceVersion
-from ..utils import locksan
+from ..utils import locksan, mutsan
 from .clientset import Clientset, ResourceClient
 
 
@@ -76,17 +76,36 @@ class SharedInformer:
         return self._synced.wait(timeout)
 
     # ------------------------------------------------------------- store api
+    #
+    # SNAPSHOT SEMANTICS: get()/list() hand out the informer's cached
+    # objects — shared with every other consumer of this informer and
+    # replaced (never mutated) on watch updates.  Treat them as immutable
+    # snapshots; clone() before mutating.  Under KTPU_MUTSAN the cache
+    # holds frozen proxies (utils/mutsan) so a violation raises
+    # SharedObjectMutationError at the mutation site; without the
+    # sanitizer the rule is enforced statically (ktpulint KTPU008).
+    # list() always builds a fresh list object, so iterating a snapshot
+    # can never be invalidated by a concurrent resync.
 
     @staticmethod
     def _key(obj) -> str:
         m = obj.metadata
         return f"{m.namespace}/{m.name}" if m.namespace else m.name
 
+    def _shared(self, obj):
+        """Freeze an object entering the shared cache (no-op when the
+        sanitizer is off).  The origin names this informer so a mutation
+        error points back at the handout."""
+        return mutsan.freeze(
+            obj, f"SharedInformer[{self.client.resource}] cache")
+
     def get(self, key: str):
+        """The cached object for key — a shared, immutable snapshot."""
         with self._lock:
             return self._cache.get(key)
 
     def list(self) -> List[Any]:
+        """Fresh list of the cached objects (shared, immutable snapshots)."""
         with self._lock:
             return list(self._cache.values())
 
@@ -112,7 +131,7 @@ class SharedInformer:
             label_selector=self.label_selector,
             field_selector=self.field_selector,
         )
-        fresh = {self._key(o): o for o in items}
+        fresh = {self._key(o): self._shared(o) for o in items}
         with self._lock:
             old = self._cache
             self._cache = fresh
@@ -165,7 +184,7 @@ class SharedInformer:
                 for ev_type, obj_dict in stream:
                     if self._stop.is_set():
                         return
-                    obj = self.client.scheme.decode(obj_dict)
+                    obj = self._shared(self.client.scheme.decode(obj_dict))
                     rv = obj.metadata.resource_version or rv
                     key = self._key(obj)
                     if ev_type == "DELETED":

@@ -1359,7 +1359,7 @@ class Kubelet:
             self.runtime.stop_pod_sandbox(sid)
 
     def _set_failed(self, pod: t.Pod, reason: str, message: str):
-        fresh = global_scheme.deepcopy(pod)
+        fresh = pod.clone()  # clone-before-mutate: pod is an informer snapshot
         fresh.status.phase = t.POD_FAILED
         fresh.status.reason = reason
         fresh.status.message = message
@@ -1468,7 +1468,7 @@ class Kubelet:
         with self._lock:
             if self._last_status.get(uid) == comparable:
                 return
-        fresh = global_scheme.deepcopy(pod)
+        fresh = pod.clone()  # clone-before-mutate: pod is an informer snapshot
         fresh.status = status
         try:
             self.cs.pods.update_status(fresh)

@@ -104,3 +104,13 @@ class KObject:
         if self.metadata.namespace:
             return f"{self.metadata.namespace}/{self.metadata.name}"
         return self.metadata.name
+
+    def clone(self) -> "KObject":
+        """Sanctioned deep copy for the clone-before-mutate rule: objects
+        handed out by an informer or any other shared cache are immutable
+        snapshots (enforced under KTPU_MUTSAN, see utils/mutsan.py); call
+        clone() and mutate the copy.  Works on frozen proxies too — the
+        result is always a fresh, mutable object graph."""
+        import copy
+
+        return copy.deepcopy(self)

@@ -12,6 +12,7 @@ values equal to the field default are omitted (the `omitempty` convention).
 from __future__ import annotations
 
 import collections
+import copy
 import dataclasses
 import functools
 import json
@@ -61,6 +62,10 @@ def _unwrap_optional(tp):
 
 def to_dict(obj: Any) -> Any:
     """Encode a dataclass (or primitive/list/dict) to plain JSON-able data."""
+    # a frozen mutsan proxy (utils/mutsan) encodes as its target — encoding
+    # only reads; the attribute protocol keeps machinery free of a utils
+    # dependency, and is a no-op getattr for ordinary objects
+    obj = getattr(obj, "_mutsan_target_", obj)
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for name, wire, _tp, default in _field_info(type(obj)):
@@ -199,6 +204,13 @@ class Unstructured:
         if self.metadata.namespace:
             return f"{self.metadata.namespace}/{self.metadata.name}"
         return self.metadata.name
+
+    def clone(self) -> "Unstructured":
+        """Deep copy (the KObject.clone analog for dynamic kinds): the
+        clone-before-mutate rule applies to CRD objects too."""
+        import copy
+
+        return copy.deepcopy(self)
 
 
 class SerializationCache:
@@ -339,8 +351,13 @@ class Scheme:
         return out
 
     def encode(self, obj: Any, version: str = "") -> Dict[str, Any]:
+        obj = getattr(obj, "_mutsan_target_", obj)  # thaw frozen proxies
         if isinstance(obj, Unstructured):
-            d = dict(obj.content)
+            # deep copy for the same reason decode() deep-copies: the
+            # encoded dict is what the store COMMITS, and sharing nested
+            # dicts with the caller's live object would let a later
+            # mutation of that object rewrite committed history
+            d = copy.deepcopy(obj.content)
             d["metadata"] = to_dict(obj.metadata)
             d["kind"] = obj.kind
             d["apiVersion"] = obj.api_version
@@ -436,9 +453,17 @@ class Scheme:
         cls = self.by_kind.get(kind)
         if cls is None or cls is Unstructured:
             # unknown or dynamic kind -> Unstructured passthrough (the
-            # client-go dynamic-client behavior)
+            # client-go dynamic-client behavior).  content must be a DEEP
+            # copy: a shallow one aliases the nested spec/status dicts of
+            # the source — for an in-process store.get that source is the
+            # COMMITTED store entry (shared with the history ring, the
+            # watch cache and the serialization cache keyed on its
+            # resourceVersion), so an in-place mutation of the decoded
+            # object would corrupt stored state at an unchanged revision
+            # (typed kinds never alias: their decoder builds fresh
+            # containers at every level)
             content = {
-                k: v for k, v in data.items()
+                k: copy.deepcopy(v) for k, v in data.items()
                 if k not in ("kind", "apiVersion", "metadata")
             }
             return Unstructured(
@@ -453,8 +478,12 @@ class Scheme:
         return self.decode(json.loads(raw))
 
     def deepcopy(self, obj: Any) -> Any:
+        obj = getattr(obj, "_mutsan_target_", obj)  # thaw frozen proxies
         if isinstance(obj, Unstructured):
-            return self.decode(self.encode(obj))
+            # one deepcopy, not the encode->decode round trip: both of
+            # those now defensively deep-copy content, so chaining them
+            # would pay the dominant cost twice
+            return copy.deepcopy(obj)
         return from_dict(type(obj), to_dict(obj))
 
 

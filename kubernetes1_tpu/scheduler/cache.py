@@ -39,7 +39,10 @@ def pod_request_milli_cpu(pod: t.Pod) -> int:
     # memoized on the pod object: predicates+priorities call this per NODE,
     # and quantity parsing per call is the schedule() hot loop's biggest
     # constant factor at 1000 nodes (informer updates replace pod objects,
-    # so staleness is impossible)
+    # so staleness is impossible).  The _ktpu_ prefix marks the blessed
+    # memo-slot exception to the shared-snapshot immutability rule:
+    # utils/mutsan writes it through on frozen informer handouts, and
+    # KTPU008 exempts it — derived, never serialized, dies with the object
     cached = getattr(pod, "_ktpu_mcpu", None)
     if cached is not None:
         return cached
@@ -246,8 +249,11 @@ class SchedulerCache:
             return self._nodes.get(name)
 
     def snapshot(self) -> Dict[str, NodeInfo]:
-        """Reference to the live map; callers hold the scheduling lock (the
-        scheduler is single-threaded over scheduling decisions)."""
+        """Fresh dict over the LIVE NodeInfo objects; callers hold the
+        scheduling lock (the scheduler is single-threaded over scheduling
+        decisions).  The NodeInfos are shared accounting state — what-if
+        simulation must go through NodeInfo.clone() (ktpulint KTPU008
+        flags mutation of snapshot values without it)."""
         with self._lock:
             return dict(self._nodes)
 

@@ -483,7 +483,7 @@ class Scheduler:
         return None, f"0/{len(snapshot)} nodes available: {summary}"
 
     def _assume_and_bind(self, pod: t.Pod, result: ScheduleResult):
-        assumed = global_scheme.deepcopy(pod)
+        assumed = pod.clone()  # clone-before-mutate: pod is an informer snapshot
         assumed.spec.node_name = result.node
         by_name = {per.name: per for per in assumed.spec.extended_resources}
         for name, ids in result.assignments.items():
@@ -678,7 +678,7 @@ class Scheduler:
                     ok = False
                     break
                 # deduct in simulation so the next member sees it
-                shadow = global_scheme.deepcopy(member)
+                shadow = member.clone()  # member is an informer/queue snapshot
                 shadow.spec.node_name = result.node
                 by_name = {per.name: per for per in shadow.spec.extended_resources}
                 for name, ids in result.assignments.items():

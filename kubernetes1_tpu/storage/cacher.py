@@ -41,7 +41,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..machinery import DELETED, TooOldResourceVersion, WatchEvent
-from ..utils import locksan
+from ..utils import locksan, mutsan
 from .store import (
     DEFAULT_WATCH_QUEUE_LIMIT,
     Watcher,
@@ -308,15 +308,28 @@ class Cacher:
         revision (== a store revision at least as new as every write
         acknowledged before this call)."""
         self.wait_fresh()
+        # handouts are SHARED with the cache, the store's history ring and
+        # the serialization cache keyed on their resourceVersion: freeze
+        # them (sanitizer on, i.e. tests) so an in-place mutation cannot
+        # silently diverge live state from already-cached bytes.  The
+        # enabled() check is hoisted OUT of the loop: this is the 2000-pod
+        # LIST hot path, inside the lock the commit feed contends on —
+        # production must pay zero per-entry sanitizer cost
+        frozen = mutsan.enabled()
         with self._cond:
             keys = self._by_collection.get(_collection_of(prefix))
             if not keys:
                 return [], self._rev
-            entries = [
-                (key,) + self._data[key]
-                for key in sorted(keys)
-                if key.startswith(prefix) and key in self._data
-            ]
+            entries = []
+            for key in sorted(keys):
+                if not key.startswith(prefix):
+                    continue
+                ent = self._data.get(key)
+                if ent is None:
+                    continue
+                obj = mutsan.freeze(ent[1], "Cacher.list_raw") if frozen \
+                    else ent[1]
+                entries.append((key, ent[0], obj))
             return entries, self._rev
 
     def get_raw(self, key: str) -> Optional[Dict[str, Any]]:
@@ -324,7 +337,9 @@ class Cacher:
         self.wait_fresh()
         with self._cond:
             ent = self._data.get(key)
-            return None if ent is None else ent[1]
+            # frozen: shared with the cache and the serialized-bytes cache
+            return None if ent is None else mutsan.freeze(
+                ent[1], "Cacher.get_raw")
 
     # ---------------------------------------------------------------- watch
 

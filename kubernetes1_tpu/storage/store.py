@@ -454,7 +454,10 @@ class Store:
         """Read-modify-CAS retry loop (ref: etcd3 store.go:263).
 
         update_fn receives a fresh decoded copy and returns the new object
-        (mutating in place is fine).  Raise StopUpdate to abort cleanly.
+        (mutating in place is fine — decode builds fresh containers at
+        every level, including a deep-copied Unstructured.content, so the
+        copy never aliases committed state; see Scheme.decode).  Raise
+        StopUpdate to abort cleanly.
         """
         while True:
             cur = self.get(key)

@@ -1,0 +1,276 @@
+"""Runtime shared-object mutation sanitizer (KTPU_MUTSAN).
+
+The control plane's correctness rests on a convention stock Kubernetes
+also only enforces by discipline: **objects handed out by a cache are
+immutable snapshots**.  An informer's `get()/list()` and the apiserver
+watch cache's `get_raw()/list_raw()` return THE stored object — one
+in-place mutation silently corrupts what every other consumer (and,
+since the read path caches serialized bytes per `(uid, resourceVersion)`,
+every LIST/watch response) sees for that revision.  The bug class is
+invisible in tests that don't race and catastrophic under load.
+
+This module is the runtime half of the mutation-safety layer (the static
+half is ktpulint KTPU008/KTPU009).  With `KTPU_MUTSAN` unset (production)
+`freeze()` is the identity function — zero overhead, zero behavior
+change.  With `KTPU_MUTSAN=1` (the test suite turns it on in
+`tests/conftest.py`, like KTPU_LOCKSAN) cache handouts are wrapped in
+recursively freezing proxies:
+
+- attribute assignment, item assignment, and mutating container methods
+  (`append`, `update`, `setdefault`, …) raise `SharedObjectMutationError`
+  carrying BOTH sites: the mutation site (the raised traceback) and the
+  acquisition site (where the shared object was handed out), so the fix —
+  `clone()` at the acquisition site — is one hop away.
+- reads recurse: `pod.spec.containers[0].resources.requests` is frozen
+  at every level, so deep aliasing cannot escape the sanitizer.
+- the sanctioned escape hatch is `KObject.clone()` (machinery/meta.py):
+  a deep copy that is yours to mutate.  `copy.deepcopy` of a frozen
+  proxy likewise returns an unfrozen deep copy.
+- attributes prefixed `_ktpu_` write through to the target: they are the
+  blessed memoization slots (scheduler request-size memos) — derived,
+  never serialized, and replaced together with the object on update.
+
+Design note: proxies, not flags.  Freezing by flipping a bit on the
+object would require a `__setattr__` hook on every dataclass AND could
+not catch `pod.metadata.annotations["x"] = ...` (dict mutation).  The
+proxy wraps lazily on access instead, so freezing is O(1) per handout
+and containers are snapshotted (a frozen dict/list holds its own entry
+array — concurrent resyncs can never invalidate an iteration).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import traceback
+from typing import Any
+
+__all__ = [
+    "SharedObjectMutationError",
+    "enabled",
+    "freeze",
+    "unwrap",
+]
+
+_MEMO_PREFIX = "_ktpu_"  # sanctioned write-through memoization slots
+
+
+class SharedObjectMutationError(RuntimeError):
+    """In-place mutation of a shared cache object.  The traceback of this
+    exception is the MUTATION site; the message carries the ACQUISITION
+    site (where the shared snapshot was handed out) and the fix."""
+
+
+def enabled() -> bool:
+    return os.environ.get("KTPU_MUTSAN", "") not in ("", "0")
+
+
+def _acquisition_site() -> str:
+    """file:line of the frame that asked for the freeze — the cache
+    boundary handing out the shared object."""
+    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+        if not frame.filename.endswith("mutsan.py"):
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _mutation_error(origin: str, what: str) -> SharedObjectMutationError:
+    return SharedObjectMutationError(
+        f"in-place mutation of a shared cache object: {what} "
+        f"(object acquired at {origin}); this object is a shared snapshot "
+        f"— clone() it (KObject.clone / copy.deepcopy) before mutating"
+    )
+
+
+def unwrap(value: Any) -> Any:
+    """The raw object behind a FrozenObject proxy (identity otherwise).
+    Frozen containers are snapshots, not views — they have no single
+    backing object to return and are handled by their own __deepcopy__."""
+    return getattr(value, "_mutsan_target_", value)
+
+
+def freeze(value: Any, origin: str = "") -> Any:
+    """Frozen view of `value` when the sanitizer is on; `value` itself
+    otherwise.  Dataclass instances wrap lazily (reads freeze on access);
+    dicts/lists snapshot their entries at freeze time."""
+    if not enabled():
+        return value
+    return _freeze(value, origin or _acquisition_site())
+
+
+def _freeze(value: Any, origin: str) -> Any:
+    if isinstance(value, (FrozenObject, FrozenDict, FrozenList)):
+        return value
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return FrozenObject(value, origin)
+    # Unstructured (CRD objects) duck-typed by its KIND class attr: not a
+    # dataclass, but every bit as shared when an informer caches it
+    if getattr(type(value), "KIND", None) is not None:
+        return FrozenObject(value, origin)
+    # exact types only: subclasses may carry behavior a blind snapshot
+    # would drop, and the wire model uses plain dict/list everywhere
+    if type(value) is dict:
+        return FrozenDict(value, origin)
+    if type(value) is list:
+        return FrozenList(value, origin)
+    if type(value) is tuple:
+        return tuple(_freeze(v, origin) for v in value)
+    return value
+
+
+class FrozenObject:
+    """Read-only proxy over a dataclass instance.  Field reads return
+    frozen views; writes raise.  Methods resolve on the target — the API
+    model's methods are read-only accessors (`key()`, `clone()`), and
+    `clone()` on the raw target is exactly the sanctioned escape."""
+
+    __slots__ = ("_mutsan_target_", "_mutsan_origin_")
+
+    def __init__(self, target: Any, origin: str):
+        object.__setattr__(self, "_mutsan_target_", target)
+        object.__setattr__(self, "_mutsan_origin_", origin)
+
+    # reads ---------------------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        value = getattr(self._mutsan_target_, name)
+        if (dataclasses.is_dataclass(value) and not isinstance(value, type)) \
+                or type(value) in (dict, list, tuple):
+            return _freeze(value, self._mutsan_origin_)
+        return value  # str/int/bound read-only method/...
+
+    @property  # isinstance(frozen_pod, Pod) must keep working
+    def __class__(self):  # noqa: D105
+        return type(self._mutsan_target_)
+
+    def __repr__(self) -> str:
+        return f"<frozen {self._mutsan_target_!r}>"
+
+    def __eq__(self, other: Any) -> bool:
+        return self._mutsan_target_ == unwrap(other)
+
+    def __ne__(self, other: Any) -> bool:
+        return self._mutsan_target_ != unwrap(other)
+
+    def __deepcopy__(self, memo) -> Any:
+        return copy.deepcopy(self._mutsan_target_, memo)
+
+    # writes --------------------------------------------------------------
+    def __setattr__(self, name: str, value: Any):
+        if name.startswith(_MEMO_PREFIX):
+            setattr(self._mutsan_target_, name, value)
+            return
+        raise _mutation_error(
+            self._mutsan_origin_,
+            f"setattr {type(self._mutsan_target_).__name__}.{name}")
+
+    def __delattr__(self, name: str):
+        raise _mutation_error(
+            self._mutsan_origin_,
+            f"delattr {type(self._mutsan_target_).__name__}.{name}")
+
+
+def _frozen_dict_mutator(name: str):
+    def fail(self, *a, **kw):
+        raise _mutation_error(self._mutsan_origin_, f"dict.{name}()")
+    fail.__name__ = name
+    return fail
+
+
+class FrozenDict(dict):
+    """Read-only dict SNAPSHOT: entries are copied in at freeze time (an
+    iteration can never be invalidated by a concurrent resync) and value
+    reads freeze lazily.  Still a real dict, so json.dumps and isinstance
+    checks keep working."""
+
+    __slots__ = ("_mutsan_origin_",)
+
+    def __init__(self, src: dict, origin: str):
+        dict.__init__(self, src)
+        self._mutsan_origin_ = origin
+
+    # reads wrap lazily
+    def __getitem__(self, key):
+        return _freeze(dict.__getitem__(self, key), self._mutsan_origin_)
+
+    def get(self, key, default=None):
+        if dict.__contains__(self, key):
+            return self[key]
+        return default
+
+    def values(self):
+        return [self[k] for k in dict.keys(self)]
+
+    def items(self):
+        return [(k, self[k]) for k in dict.keys(self)]
+
+    def copy(self):  # explicit copy = explicit unfreeze (shallow, raw)
+        return {k: dict.__getitem__(self, k) for k in dict.keys(self)}
+
+    def __deepcopy__(self, memo):
+        return {copy.deepcopy(k, memo): copy.deepcopy(dict.__getitem__(self, k), memo)
+                for k in dict.keys(self)}
+
+    def __reduce__(self):
+        return (dict, (self.copy(),))
+
+    # writes raise
+    __setitem__ = _frozen_dict_mutator("__setitem__")
+    __delitem__ = _frozen_dict_mutator("__delitem__")
+    clear = _frozen_dict_mutator("clear")
+    pop = _frozen_dict_mutator("pop")
+    popitem = _frozen_dict_mutator("popitem")
+    setdefault = _frozen_dict_mutator("setdefault")
+    update = _frozen_dict_mutator("update")
+    __ior__ = _frozen_dict_mutator("__ior__")
+
+
+def _frozen_list_mutator(name: str):
+    def fail(self, *a, **kw):
+        raise _mutation_error(self._mutsan_origin_, f"list.{name}()")
+    fail.__name__ = name
+    return fail
+
+
+class FrozenList(list):
+    """Read-only list SNAPSHOT (see FrozenDict)."""
+
+    __slots__ = ("_mutsan_origin_",)
+
+    def __init__(self, src: list, origin: str):
+        list.__init__(self, src)
+        self._mutsan_origin_ = origin
+
+    def __getitem__(self, idx):
+        item = list.__getitem__(self, idx)
+        if isinstance(idx, slice):
+            return [_freeze(v, self._mutsan_origin_) for v in item]
+        return _freeze(item, self._mutsan_origin_)
+
+    def __iter__(self):
+        origin = self._mutsan_origin_
+        for item in list.__iter__(self):
+            yield _freeze(item, origin)
+
+    def copy(self):
+        return list(list.__iter__(self))
+
+    def __deepcopy__(self, memo):
+        return [copy.deepcopy(v, memo) for v in list.__iter__(self)]
+
+    def __reduce__(self):
+        return (list, (self.copy(),))
+
+    # writes raise
+    __setitem__ = _frozen_list_mutator("__setitem__")
+    __delitem__ = _frozen_list_mutator("__delitem__")
+    __iadd__ = _frozen_list_mutator("__iadd__")
+    __imul__ = _frozen_list_mutator("__imul__")
+    append = _frozen_list_mutator("append")
+    extend = _frozen_list_mutator("extend")
+    insert = _frozen_list_mutator("insert")
+    remove = _frozen_list_mutator("remove")
+    pop = _frozen_list_mutator("pop")
+    clear = _frozen_list_mutator("clear")
+    sort = _frozen_list_mutator("sort")
+    reverse = _frozen_list_mutator("reverse")

@@ -5,18 +5,94 @@ Prints findings as `file:line: PASS-ID message` (repo-relative) and exits
 non-zero when any exist.  `tests/test_lint_clean.py` runs the same check
 in tier-1, so the tree stays at zero findings.
 
-Usage: python scripts/lint.py [paths...]
+Usage: python scripts/lint.py [paths...] [--output json] [--baseline FILE]
+                              [--changed-only]
+
+--changed-only is the fast local/pre-commit mode: lint only the .py files
+changed vs the merge-base with main (plus uncommitted changes).  The FULL
+tree stays the CI gate — changed-only can miss cross-file regressions
+(e.g. a lock-class rename that orphans a pragma elsewhere), so it trades
+coverage for latency on purpose.
 """
 
 from __future__ import annotations
 
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.ktpulint.engine import run_gate  # noqa: E402
+from tools.ktpulint.engine import default_gate_paths, main  # noqa: E402
+
+
+def _changed_paths():
+    """Repo .py files changed vs merge-base with main — committed,
+    staged, unstaged AND untracked (a brand-new file is exactly where
+    new findings live) — restricted to the gate's scope (kubernetes1_tpu/
+    and tools/).  Returns None when git can't answer: the caller must
+    fall back to the FULL tree, never to a false 'clean'."""
+    try:
+        base = subprocess.run(
+            ["git", "merge-base", "HEAD", "main"], cwd=REPO,
+            capture_output=True, text=True, check=True).stdout.strip()
+        if not base:
+            return None
+    except (subprocess.CalledProcessError, OSError) as e:
+        # detached HEAD / no local main (shallow CI checkout): the changed
+        # set is unknowable — diffing against bare HEAD would miss every
+        # COMMITTED change and report a false clean, so full tree it is
+        print(f"lint: --changed-only can't find merge-base with main ({e}); "
+              f"linting full tree", file=sys.stderr)
+        return None
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"], cwd=REPO,
+            capture_output=True, text=True, check=True).stdout
+        out += subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"], cwd=REPO,
+            capture_output=True, text=True, check=True).stdout
+    except (subprocess.CalledProcessError, OSError) as e:
+        print(f"lint: --changed-only needs git ({e}); linting full tree",
+              file=sys.stderr)
+        return None
+    scope = tuple(os.path.relpath(p, REPO) + os.sep
+                  for p in default_gate_paths())
+    files = []
+    for rel in dict.fromkeys(out.splitlines()):  # dedupe, keep order
+        if not rel.endswith(".py") or not rel.startswith(scope):
+            continue
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):  # deleted files have nothing to lint
+            files.append(path)
+    return files
+
 
 if __name__ == "__main__":
-    sys.exit(run_gate(sys.argv[1:], rel_root=REPO))
+    argv = sys.argv[1:]
+    if "--changed-only" in argv:
+        argv.remove("--changed-only")
+        # explicit PATHS conflict with --changed-only; option VALUES
+        # (--output json, --baseline FILE) do not
+        positional, skip_next = [], False
+        for a in argv:
+            if skip_next:
+                skip_next = False
+            elif a in ("--output", "--baseline"):
+                skip_next = True
+            elif not a.startswith("-"):
+                positional.append(a)
+        if positional:
+            print("lint: --changed-only replaces explicit paths",
+                  file=sys.stderr)
+            sys.exit(2)
+        changed = _changed_paths()
+        if changed is None:
+            pass  # no git: main() lints the default full-tree scope
+        elif not changed:
+            print("lint: clean (no changed files in scope)", file=sys.stderr)
+            sys.exit(0)
+        else:
+            argv = changed + argv
+    sys.exit(main(argv, rel_root=REPO))

@@ -20,6 +20,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # so `KTPU_LOCKSAN=0 pytest ...` can switch it off for A/B timing runs.
 os.environ.setdefault("KTPU_LOCKSAN", "1")
 
+# Shared-object mutation sanitizer (utils/mutsan): informer caches and the
+# apiserver watch cache hand out freezing proxies — an in-place mutation of
+# a shared snapshot raises SharedObjectMutationError at the mutation site
+# instead of silently corrupting cached state/serialized bytes.  setdefault
+# so `KTPU_MUTSAN=0 pytest ...` can A/B a suspected sanitizer-induced
+# failure, exactly like KTPU_LOCKSAN above.
+os.environ.setdefault("KTPU_MUTSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

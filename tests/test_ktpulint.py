@@ -448,3 +448,200 @@ def test_ktpu007_pragma_and_locksan_file_exempt():
     src2 = "import threading\nL = threading.Lock()\n"
     assert lint_file("pkg/utils/locksan.py", src2) == []
     assert [f.pass_id for f in lint_file("pkg/utils/other.py", src2)] == ["KTPU007"]
+
+
+# ------------------------------------------------- KTPU008 (shared snapshots)
+
+def test_ktpu008_informer_mutations_flagged():
+    src = """
+        class C:
+            def setup(self):
+                self.pods = self.factory.informer("pods")
+
+            def sync(self, key):
+                pod = self.pods.get(key)
+                pod.status.phase = "Failed"
+                pod.metadata.annotations["x"] = "y"
+                pod.metadata.labels.update({"a": "b"})
+                for p in self.pods.list():
+                    p.spec.node_name = "n1"
+    """
+    assert _ids(src).count("KTPU008") == 4
+
+
+def test_ktpu008_clone_sanitizes():
+    src = """
+        class C:
+            def setup(self):
+                self.pods = self.factory.informer("pods")
+
+            def sync(self, key):
+                pod = self.pods.get(key).clone()
+                pod.status.phase = "Failed"
+                other = self.pods.get(key)
+                fresh = other.clone()
+                fresh.metadata.annotations["x"] = "y"
+                dc = deepcopy(self.pods.get(key))
+                dc.spec.node_name = "n"
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu008_shallow_copies_keep_elements_shared():
+    src = """
+        class C:
+            def setup(self):
+                self.pods = self.factory.informer("pods")
+
+            def sync(self, key):
+                items = list(self.pods.list())
+                items.append(1)          # private container: fine
+                items[0].status.reason = "x"   # element: shared
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU008"]
+    assert len(findings) == 1
+
+
+def test_ktpu008_snapshot_and_raw_sources():
+    src = """
+        def f(cache, cacher):
+            snap = cache.snapshot()
+            for name, ni in snap.items():
+                ni.pods["k"] = 1
+            d = cacher.get_raw("/registry/pods/a/b")
+            d["spec"]["nodeName"] = "n"
+            entries, rev = cacher.list_raw("/registry/pods/")
+    """
+    assert _ids(src).count("KTPU008") == 2
+
+
+def test_ktpu008_memo_slots_exempt():
+    src = """
+        def f(informer, key):
+            pod = informer.get(key)
+            pod._ktpu_mcpu = 500
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu008_reassignment_kills_taint():
+    src = """
+        def f(informer, key):
+            pod = informer.get(key)
+            pod = make_pod()
+            pod.status.phase = "Failed"
+    """
+    assert _ids(src) == []
+
+
+# ------------------------------------------------- KTPU009 (raw-dict schema)
+
+def test_ktpu009_typo_flagged_and_valid_chain_quiet():
+    src = """
+        def f(d):
+            good = d["spec"]["nodeName"]
+            meta = d.get("metadata") or {}
+            rv = meta.get("resourceVersion")
+            bad = d["spec"]["nodename"]
+            worse = (d.get("metdata") or {}).get("name")
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU009"]
+    assert len(findings) == 1  # 'nodename'; 'metdata' is not an API root
+    assert "nodename" in findings[0].message
+
+
+def test_ktpu009_metadata_typo_below_root():
+    src = """
+        def f(d):
+            x = (d.get("metadata") or {}).get("resourceVerison")
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU009"]
+    assert len(findings) == 1
+    assert "resourceVerison" in findings[0].message
+
+
+def test_ktpu009_freeform_subtrees_unchecked():
+    src = """
+        def f(d):
+            lbl = d["metadata"]["labels"]["anything-goes"]
+            ann = (d.get("metadata") or {}).get("annotations", {}).get("x.y/z")
+            data = d["spec"]["nodeSelector"]["my.custom/key"]
+    """
+    assert [f.pass_id for f in _lint(src) if f.pass_id == "KTPU009"] == []
+
+
+def test_ktpu009_context_flows_through_assignment():
+    src = """
+        def f(d):
+            spec = d.get("spec") or {}
+            tmpl = spec.get("template") or {}
+            labels = (tmpl.get("metadata") or {}).get("labels") or {}
+            bad = spec.get("templtae")
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU009"]
+    assert len(findings) == 1
+    assert "templtae" in findings[0].message
+
+
+# ------------------------------------------- KTPU010 (pragma justification)
+
+def test_ktpu010_bare_pragma_flagged_and_unsuppressible():
+    src = "import time\nx = time.time()  # ktpulint: ignore[KTPU005]\n"
+    ids = [f.pass_id for f in lint_file("<mem>", src)]
+    assert ids == ["KTPU010"]  # KTPU005 suppressed; the bare pragma is not
+    src2 = "import time\nx = time.time()  # ktpulint: ignore[*]\n"
+    assert [f.pass_id for f in lint_file("<mem>", src2)] == ["KTPU010"]
+
+
+def test_ktpu010_justified_pragma_clean():
+    src = ("import time\n"
+           "x = time.time()  # ktpulint: ignore[KTPU005] user-visible stamp\n")
+    assert lint_file("<mem>", src) == []
+
+
+# ------------------------------------------------- CLI: JSON output+baseline
+
+def test_finding_json_schema_and_baseline_diff():
+    from tools.ktpulint.engine import Finding, diff_against_baseline
+
+    f1 = Finding("/repo/a.py", 3, "KTPU005", "msg one")
+    f2 = Finding("/repo/b.py", 9, "KTPU008", "msg two")
+    assert f1.to_json("/repo") == {
+        "rule": "KTPU005", "path": "a.py", "line": 3, "message": "msg one"}
+    baseline = [f1.to_json("/repo")]
+    # f1 is grandfathered even if its line MOVED; f2 is new
+    moved = Finding("/repo/a.py", 33, "KTPU005", "msg one")
+    new = diff_against_baseline([moved, f2], baseline, "/repo")
+    assert [f.pass_id for f in new] == ["KTPU008"]
+    # multiset: a second copy of a baselined finding still fails
+    new2 = diff_against_baseline([moved, moved], baseline, "/repo")
+    assert len(new2) == 1
+
+
+def test_ktpu009_context_does_not_bleed_across_functions():
+    """Regression: the module-scope walk must PRUNE function bodies — a
+    parameter that shares a name with another function's context variable
+    must not inherit that context."""
+    src = """
+        def a(d):
+            spec = d.get("spec") or {}
+            return spec
+
+        def b(spec):
+            return spec.get("anything_else")
+    """
+    assert [f.pass_id for f in _lint(src) if f.pass_id == "KTPU009"] == []
+
+
+def test_multiple_pragmas_on_one_line_each_parse():
+    """Regression: the justification group is bounded at the next '#', so
+    two pragmas on one line both suppress, and a BARE second pragma is
+    still caught by KTPU010 (it must not hide inside the first pragma's
+    justification)."""
+    from tools.ktpulint.engine import bare_pragmas, suppressed_ids
+
+    both = "x = 1  # ktpulint: ignore[KTPU001] why  # ktpulint: ignore[KTPU002] why"
+    assert suppressed_ids(both) == {"KTPU001", "KTPU002"}
+    assert bare_pragmas([both], "x.py") == []
+    bare_second = "x = 1  # ktpulint: ignore[KTPU001] why  # ktpulint: ignore[KTPU002]"
+    assert [f.pass_id for f in bare_pragmas([bare_second], "x.py")] == ["KTPU010"]

@@ -3,12 +3,15 @@
 This is the tier-1 half of the CI gate (`scripts/lint.py` is the
 command-line half): any commit that introduces an unlocked mutation, a
 blocking call under a lock, a swallowed exception, an undaemonized
-thread, a wall-clock deadline, or an unsnapshotted iteration fails the
-suite with the exact file:line: PASS-ID it must fix."""
+thread, a wall-clock deadline, an unsnapshotted iteration, a shared-
+snapshot mutation (KTPU008), a typo'd raw-dict key (KTPU009), or a
+bare suppression pragma (KTPU010) fails the suite with the exact
+file:line: PASS-ID it must fix."""
 
 import os
 
 from tools.ktpulint import lint_paths
+from tools.ktpulint.engine import bare_pragmas
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -26,3 +29,28 @@ def test_tools_dir_is_lint_clean():
     findings = lint_paths([os.path.join(REPO, "tools")])
     rendered = "\n".join(f.render() for f in findings)
     assert not findings, f"ktpulint findings in tools/:\n{rendered}"
+
+
+def test_every_pragma_is_justified():
+    """Pragma-justification gate, explicitly and tree-wide (tests/ and
+    scripts/ included — the lint gate itself only walks the package
+    trees): a `# ktpulint: ignore[...]` without a justification is
+    indistinguishable from quieting a bug, so KTPU010 covers every
+    directory a pragma could hide in."""
+    findings = []
+    for tree in ("kubernetes1_tpu", "tools", "tests", "scripts"):
+        root = os.path.join(REPO, tree)
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for name in filenames:
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path, encoding="utf-8") as f:
+                    findings.extend(
+                        bare_pragmas(f.read().splitlines(), path))
+    rendered = "\n".join(
+        os.path.relpath(f.path, REPO) + f":{f.line}: {f.message}"
+        for f in findings)
+    assert not findings, f"unjustified ktpulint pragmas:\n{rendered}"

@@ -1,0 +1,350 @@
+"""Shared-object mutation sanitizer (utils/mutsan, KTPU_MUTSAN=1) tests:
+freeze semantics, the clone() escape hatch across every registered API
+type, informer snapshot semantics, and the stale-serialization hazard
+the sanitizer exists to catch (a mutated shared object vs the bytes
+already cached for its resourceVersion)."""
+
+import copy
+import dataclasses
+import json
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.machinery.meta import KObject, ObjectMeta
+from kubernetes1_tpu.machinery.scheme import (
+    Unstructured,
+    global_scheme,
+    to_dict,
+)
+from kubernetes1_tpu.storage import Store
+from kubernetes1_tpu.storage.cacher import Cacher
+from kubernetes1_tpu.utils import mutsan
+from kubernetes1_tpu.utils.mutsan import SharedObjectMutationError
+
+from tests.test_machinery import make_pod
+
+# tests/conftest.py turns the sanitizer on for the whole suite; these
+# tests are about its semantics, so double-check rather than assume
+pytestmark = pytest.mark.skipif(
+    not mutsan.enabled(), reason="KTPU_MUTSAN disabled")
+
+
+def frozen_pod(name="p1", origin="test-origin"):
+    pod = make_pod(name)
+    pod.metadata.uid = f"uid-{name}"
+    pod.metadata.resource_version = "7"
+    pod.metadata.annotations = {"a": "1"}
+    pod.spec.extended_resources = [
+        t.PodExtendedResource(name="tpu", resource="google.com/tpu",
+                              quantity=2, assigned=["0", "1"])
+    ]
+    return pod, mutsan.freeze(pod, origin)
+
+
+class TestFreezeSemantics:
+    def test_attribute_assignment_raises_with_both_sites(self):
+        _pod, froz = frozen_pod()
+        with pytest.raises(SharedObjectMutationError) as ei:
+            froz.status.phase = "Failed"
+        assert "test-origin" in str(ei.value)  # acquisition site
+        assert "clone()" in str(ei.value)      # the fix
+
+    def test_nested_dict_and_list_mutations_raise(self):
+        _pod, froz = frozen_pod()
+        with pytest.raises(SharedObjectMutationError):
+            froz.metadata.annotations["x"] = "y"
+        with pytest.raises(SharedObjectMutationError):
+            froz.metadata.labels.update({"x": "y"})
+        with pytest.raises(SharedObjectMutationError):
+            froz.spec.containers.append(t.Container(name="evil"))
+        with pytest.raises(SharedObjectMutationError):
+            froz.spec.containers[0].resources.limits.pop("cpu")
+        with pytest.raises(SharedObjectMutationError):
+            froz.spec.extended_resources[0].assigned.clear()
+        with pytest.raises(SharedObjectMutationError):
+            del froz.metadata.annotations["a"]
+
+    def test_reads_recurse_and_match_the_raw_object(self):
+        pod, froz = frozen_pod()
+        assert froz.metadata.name == "p1"
+        assert froz.spec.containers[0].resources.limits["cpu"] == "500m"
+        assert [c.name for c in froz.spec.containers] == ["main"]
+        assert froz.key() == pod.key()
+        assert isinstance(froz, t.Pod)
+        assert froz == pod
+        assert froz.KIND == "Pod"  # class attrs forward per-instance
+
+    def test_container_handouts_are_snapshots(self):
+        pod, froz = frozen_pod()
+        anns = froz.metadata.annotations
+        pod.metadata.annotations["later"] = "write"  # raw write-side update
+        assert "later" not in anns  # the earlier handout is a snapshot
+
+    def test_memo_slots_write_through(self):
+        pod, froz = frozen_pod()
+        froz._ktpu_mcpu = 500  # the scheduler's request-size memo idiom
+        assert pod._ktpu_mcpu == 500
+
+    def test_encode_paths_thaw_transparently(self):
+        pod, froz = frozen_pod()
+        assert to_dict(froz) == to_dict(pod)
+        assert global_scheme.encode(froz) == global_scheme.encode(pod)
+        assert global_scheme.encode_obj_bytes(froz) == \
+            global_scheme.encode_obj_bytes(pod)
+
+    def test_clone_and_deepcopy_thaw(self):
+        pod, froz = frozen_pod()
+        for thawed in (froz.clone(), copy.deepcopy(froz),
+                       global_scheme.deepcopy(froz)):
+            thawed.status.phase = "Failed"
+            thawed.metadata.annotations["x"] = "y"
+            assert pod.status.phase != "Failed"
+            assert "x" not in pod.metadata.annotations
+
+    def test_frozen_dict_still_jsons(self):
+        d = global_scheme.encode(make_pod())
+        froz = mutsan.freeze(d, "test-origin")
+        assert json.loads(json.dumps(froz)) == d
+        with pytest.raises(SharedObjectMutationError):
+            froz["spec"]["nodeName"] = "n1"
+        with pytest.raises(SharedObjectMutationError):
+            froz["metadata"].setdefault("labels", {})
+
+    def test_unstructured_freezes_too(self):
+        u = Unstructured(kind="Widget", api_version="example/v1",
+                         content={"spec": {"size": 3}})
+        froz = mutsan.freeze(u, "test-origin")
+        assert froz.spec["size"] == 3
+        with pytest.raises(SharedObjectMutationError):
+            froz.spec["size"] = 4
+        c = froz.clone()
+        c.content["spec"]["size"] = 4
+        assert u.content["spec"]["size"] == 3
+
+    def test_disabled_is_identity(self, monkeypatch):
+        monkeypatch.setenv("KTPU_MUTSAN", "0")
+        pod = make_pod()
+        assert mutsan.freeze(pod) is pod
+
+
+class TestCloneRegistryRoundTrip:
+    """clone() deep-copy independence for EVERY registered API type,
+    driven off the scheme's registry so new kinds are covered the moment
+    they register."""
+
+    @staticmethod
+    def _mutate_everything(obj, depth=0):
+        """Recursively deface every reachable field of a clone."""
+        if depth > 6 or not dataclasses.is_dataclass(obj):
+            return
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if isinstance(v, str):
+                setattr(obj, f.name, "mutated")
+            elif isinstance(v, bool):
+                setattr(obj, f.name, not v)
+            elif isinstance(v, int):
+                setattr(obj, f.name, 999)
+            elif isinstance(v, dict):
+                v["__mutated__"] = "x"
+            elif isinstance(v, list):
+                v.append("__mutated__")
+            elif dataclasses.is_dataclass(v):
+                TestCloneRegistryRoundTrip._mutate_everything(v, depth + 1)
+
+    def test_every_registered_type_clones_independently(self):
+        kinds = {kind: cls for kind, cls in global_scheme.by_kind.items()
+                 if dataclasses.is_dataclass(cls)}
+        assert len(kinds) > 20  # the registry is populated
+        for kind, cls in sorted(kinds.items()):
+            obj = cls()
+            obj.metadata = ObjectMeta(
+                name="orig", namespace="ns", uid="u1", resource_version="5",
+                labels={"k": "v"}, annotations={"a": "1"})
+            before = to_dict(obj)
+            clone = obj.clone()
+            assert clone is not obj
+            self._mutate_everything(clone)
+            assert to_dict(obj) == before, (
+                f"{kind}: mutating a clone leaked into the original")
+
+    def test_clone_covers_deep_pod_structure(self):
+        pod = make_pod(tpus=4)
+        pod.spec.extended_resources = [
+            t.PodExtendedResource(name="tpu", resource="google.com/tpu",
+                                  quantity=4, assigned=["0", "1", "2", "3"])
+        ]
+        before = to_dict(pod)
+        c = pod.clone()
+        c.spec.containers[0].resources.limits["cpu"] = "9"
+        c.spec.extended_resources[0].assigned.append("4")
+        c.metadata.labels["x"] = "y"
+        assert to_dict(pod) == before
+
+
+class _FakeResourceClient:
+    """Just enough of ResourceClient for SharedInformer._relist."""
+
+    resource = "pods"
+    scheme = global_scheme
+
+    def __init__(self, items):
+        self.items = items
+
+    def list(self, namespace="", label_selector="", field_selector=""):
+        return list(self.items), "5"
+
+
+class TestInformerSnapshotSemantics:
+    def _informer(self, pods):
+        from kubernetes1_tpu.client.informer import SharedInformer
+
+        inf = SharedInformer(_FakeResourceClient(pods))
+        inf._relist()
+        return inf
+
+    def test_handouts_are_frozen_and_list_is_fresh(self):
+        pods = [make_pod("a"), make_pod("b")]
+        inf = self._informer(pods)
+        got = inf.list()
+        assert {p.metadata.name for p in got} == {"a", "b"}
+        assert got is not inf.list()  # fresh list object per call
+        with pytest.raises(SharedObjectMutationError):
+            got[0].status.phase = "Failed"
+        with pytest.raises(SharedObjectMutationError):
+            inf.get("default/a").metadata.annotations["x"] = "y"
+
+    def test_handlers_see_frozen_objects(self):
+        seen = []
+        pods = [make_pod("a")]
+        inf = self._informer(pods)
+        inf.add_handler(on_add=lambda o: seen.append(o))
+        inf._relist()  # resync dispatches adds/updates against the cache
+        update_args = []
+        inf.add_handler(on_update=lambda o, n: update_args.append((o, n)))
+        inf._relist()
+        for obj in seen + [o for pair in update_args for o in pair]:
+            with pytest.raises(SharedObjectMutationError):
+                obj.metadata.labels["x"] = "y"
+
+    def test_clone_then_write_is_the_sanctioned_path(self):
+        inf = self._informer([make_pod("a")])
+        fresh = inf.get("default/a").clone()
+        fresh.status.phase = "Failed"  # fine: private copy
+        assert inf.get("default/a").status.phase != "Failed"
+
+
+class TestStaleSerializationHazard:
+    """The PR 3 read path caches serialized bytes per
+    (uid, resourceVersion): an in-place mutation of a shared object
+    CANNOT invalidate those bytes — live state and every cached response
+    silently diverge at the same revision.  This is the hazard class the
+    sanitizer turns into a loud error at the mutation site."""
+
+    def test_mutating_a_shared_dict_would_go_stale(self):
+        # demonstrate the hazard with the cache machinery itself, on a
+        # private (unfrozen) dict standing in for an aliased cache entry
+        d = global_scheme.encode(make_pod("stale"))
+        d["metadata"]["uid"] = "u-stale"
+        d["metadata"]["resourceVersion"] = "42"
+        raw1 = global_scheme.encode_bytes(d)
+        d["spec"]["nodeName"] = "mutated-in-place"  # the bug class
+        raw2 = global_scheme.encode_bytes(d)
+        # same (uid, rv) -> same cached bytes: the mutation is INVISIBLE
+        # to every LIST/GET/watch consumer — live object and wire bytes
+        # now disagree at revision 42
+        assert raw2 == raw1
+        assert b"mutated-in-place" not in raw2
+
+    def test_cacher_handouts_refuse_the_mutation(self):
+        store = Store(global_scheme)
+        try:
+            pod = make_pod("guarded")
+            key = "/registry/pods/default/guarded"
+            store.create(key, pod)
+            cacher = Cacher(store, global_scheme).start()
+            try:
+                d = cacher.get_raw(key)
+                raw_before = global_scheme.encode_bytes(d)
+                with pytest.raises(SharedObjectMutationError):
+                    d["spec"]["nodeName"] = "mutated-in-place"
+                with pytest.raises(SharedObjectMutationError):
+                    d["metadata"]["annotations"] = {"x": "y"}
+                (entry,), _rev = cacher.list_raw("/registry/pods/default/")
+                with pytest.raises(SharedObjectMutationError):
+                    entry[2]["metadata"]["labels"]["x"] = "y"
+                # the cached bytes for this revision stayed authoritative
+                assert global_scheme.encode_bytes(
+                    cacher.get_raw(key)) == raw_before
+            finally:
+                cacher.stop()
+        finally:
+            store.close()
+
+    def test_unstructured_decode_no_longer_aliases_committed_state(self):
+        """Regression for a real pre-existing bug the mutation-safety work
+        surfaced: Scheme.decode built Unstructured.content as a SHALLOW
+        copy, so a decoded CRD object's spec/status dicts WERE the
+        committed store entry's dicts — and `guaranteed_update`'s
+        documented mutate-in-place idiom then rewrote committed history,
+        the watch cache, and the bytes cached for an UNCHANGED
+        resourceVersion.  (encode had the same aliasing in the write
+        direction.)  Both now deep-copy."""
+        scheme = global_scheme.copy()
+        scheme.register_dynamic("Widget", "widgets", "example/v1")
+        store = Store(scheme)
+        try:
+            key = "/registry/widgets/default/w1"
+            u = Unstructured(kind="Widget", api_version="example/v1",
+                             content={"spec": {"replicas": 1}})
+            u.metadata.name = "w1"
+            u.metadata.namespace = "default"
+            store.create(key, u)
+            # write-direction isolation: the caller keeps mutating its own
+            # object after create — committed state must not follow
+            u.spec["replicas"] = 50
+            cacher = Cacher(store, scheme).start()
+            try:
+                d = cacher.get_raw(key)
+                rv = d["metadata"]["resourceVersion"]
+                raw_before = scheme.encode_bytes(d)
+                # read-direction isolation: mutate a decoded object the way
+                # guaranteed_update's update_fn is invited to
+                cur = store.get(key)
+                cur.spec["replicas"] = 99
+                again = store.get(key)
+                assert again.spec["replicas"] == 1  # pristine at same rv
+                assert again.metadata.resource_version == rv
+                # and the cached bytes for that revision still match the
+                # live committed state — no silent divergence
+                d2 = cacher.get_raw(key)
+                assert d2["spec"]["replicas"] == 1
+                assert scheme.encode_bytes(d2) == raw_before
+            finally:
+                cacher.stop()
+        finally:
+            store.close()
+
+    def test_sanctioned_path_produces_new_revision_and_new_bytes(self):
+        store = Store(global_scheme)
+        try:
+            pod = make_pod("rewrite")
+            key = "/registry/pods/default/rewrite"
+            store.create(key, pod)
+            cacher = Cacher(store, global_scheme).start()
+            try:
+                d = cacher.get_raw(key)
+                fresh = copy.deepcopy(d)  # clone-before-mutate on a raw dict
+                fresh["spec"]["nodeName"] = "node-9"
+                obj = global_scheme.decode(fresh)  # carries the CAS rv
+                store.update_cas(key, obj)
+                d2 = cacher.get_raw(key)
+                assert d2["spec"]["nodeName"] == "node-9"
+                assert d2["metadata"]["resourceVersion"] != \
+                    d["metadata"]["resourceVersion"]
+                assert b"node-9" in global_scheme.encode_bytes(d2)
+            finally:
+                cacher.stop()
+        finally:
+            store.close()

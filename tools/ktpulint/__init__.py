@@ -7,10 +7,17 @@ Passes (see each module's docstring for the rationale):
 - KTPU004 thread neither daemon=True nor joined
 - KTPU005 wall-clock time.time() in deadline/backoff/generation paths
 - KTPU006 iterating a lock-guarded container outside the lock
+- KTPU007 direct threading.Lock/RLock/Condition outside the locksan factory
+- KTPU008 mutating a shared cache snapshot without clone() (dataflow)
+- KTPU009 unknown wire-field key on an API-shaped raw dict (schema-aware)
+- KTPU010 suppression pragma without a justification
 
-Run the gate: `python scripts/lint.py` (exits non-zero on any finding);
+Run the gate: `python scripts/lint.py` (exits non-zero on any finding;
+`--changed-only` for the fast pre-commit mode, `--output json` for the
+stable finding schema, `--baseline FILE` to fail only on new findings);
 suppress a deliberate exception to a rule with
-`# ktpulint: ignore[KTPU00X] <justification>` on the offending line.
+`# ktpulint: ignore[KTPU00X] <justification>` on the offending line —
+the justification is mandatory (KTPU010).
 """
 
 from .engine import Finding, lint_file, lint_paths, registered_passes

@@ -1,11 +1,11 @@
-"""CLI: python -m tools.ktpulint [paths...] — defaults to the CI gate's
-scope (kubernetes1_tpu/ and tools/)."""
+"""CLI: python -m tools.ktpulint [paths...] [--output json] [--baseline F]
+— defaults to the CI gate's scope (kubernetes1_tpu/ and tools/)."""
 
 from __future__ import annotations
 
 import sys
 
-from .engine import run_gate
+from .engine import main
 
 if __name__ == "__main__":
-    sys.exit(run_gate(sys.argv[1:]))
+    sys.exit(main(sys.argv[1:]))

@@ -11,20 +11,25 @@ is near-certainly real.
 
 Suppression: a line comment `# ktpulint: ignore[KTPU005]` (comma-separate
 for several ids, `ignore[*]` for all) silences findings reported on that
-line.  Every suppression should carry a justification after the bracket —
+line.  Every suppression MUST carry a justification after the bracket —
 the pragma is for the rare case the rule's premise doesn't hold (e.g.
 `time.time()` producing a user-visible timestamp), not for quieting bugs.
+A bare pragma is itself a finding (KTPU010) that no pragma can silence.
 """
 
 from __future__ import annotations
 
 import ast
+import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
-_PRAGMA_RE = re.compile(r"#\s*ktpulint:\s*ignore\[([^\]]*)\]")
+# justification (group 2) is bounded at the next '#', so several pragmas
+# on one line each parse — and a bare second pragma can't hide inside the
+# first one's justification
+_PRAGMA_RE = re.compile(r"#\s*ktpulint:\s*ignore\[([^\]]*)\]([^#]*)")
 
 
 @dataclass(frozen=True)
@@ -36,6 +41,12 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.pass_id} {self.message}"
+
+    def to_json(self, rel_root: str = "") -> Dict[str, object]:
+        """Stable finding schema for --output json / --baseline files."""
+        path = os.path.relpath(self.path, rel_root) if rel_root else self.path
+        return {"rule": self.pass_id, "path": path, "line": self.line,
+                "message": self.message}
 
 
 @dataclass
@@ -80,6 +91,24 @@ def suppressed_ids(line_text: str) -> Set[str]:
     return out
 
 
+def bare_pragmas(lines: Sequence[str], path: str) -> List[Finding]:
+    """KTPU010 — every suppression pragma must justify itself.  The
+    justification is the documentation that a human judged the rule's
+    premise inapplicable; a bare pragma is indistinguishable from
+    quieting a bug.  Deliberately NOT suppressible: emitted after the
+    pragma filter, so `ignore[*]` cannot silence it."""
+    out: List[Finding] = []
+    for i, text in enumerate(lines):
+        for m in _PRAGMA_RE.finditer(text):
+            if not m.group(2).strip():
+                out.append(Finding(
+                    path, i + 1, "KTPU010",
+                    "suppression pragma without a justification — say WHY "
+                    "the rule's premise doesn't hold here, e.g. "
+                    "`# ktpulint: ignore[KTPU005] user-visible timestamp`"))
+    return out
+
+
 def lint_file(path: str, source: str = None,
               only: Sequence[str] = ()) -> List[Finding]:
     if source is None:
@@ -106,6 +135,8 @@ def lint_file(path: str, source: str = None,
         if f.pass_id in ids or "*" in ids:
             continue
         kept.append(f)
+    if not only or "KTPU010" in only:
+        kept.extend(bare_pragmas(ctx.lines, path))
     kept.sort(key=lambda f: (f.path, f.line, f.pass_id))
     return kept
 
@@ -138,25 +169,93 @@ def default_gate_paths() -> List[str]:
             os.path.join(repo, "tools")]
 
 
-def run_gate(paths: Sequence[str] = (), rel_root: str = "") -> int:
+def load_baseline(path: str) -> List[Dict[str, object]]:
+    """A baseline file is the JSON `--output json` emits (a list of
+    finding objects); line numbers are ignored when diffing — code above
+    a pre-existing finding must not re-trigger CI."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    return list(data)
+
+
+def _baseline_key(d: Dict[str, object]) -> tuple:
+    return (d.get("rule"), d.get("path"), d.get("message"))
+
+
+def diff_against_baseline(
+        findings: Sequence[Finding], baseline: Sequence[Dict[str, object]],
+        rel_root: str = "") -> List[Finding]:
+    """Findings NOT accounted for by the baseline (multiset semantics: a
+    baseline entry absolves ONE occurrence — two copies of the same bug
+    with one grandfathered still fails on the second)."""
+    budget: Dict[tuple, int] = {}
+    for b in baseline:
+        k = _baseline_key(b)
+        budget[k] = budget.get(k, 0) + 1
+    new: List[Finding] = []
+    for f in findings:
+        k = _baseline_key(f.to_json(rel_root))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            continue
+        new.append(f)
+    return new
+
+
+def run_gate(paths: Sequence[str] = (), rel_root: str = "",
+             output: str = "text", baseline: Optional[str] = None) -> int:
     """Shared CLI body for scripts/lint.py and `python -m tools.ktpulint`:
-    print findings as `file:line: PASS-ID message`, return the exit code."""
+    print findings (`file:line: PASS-ID message`, or a stable JSON list
+    with --output json), optionally diffing against a baseline file so CI
+    can fail only on NEW findings.  Returns the exit code."""
     import sys as _sys
 
     findings = lint_paths(list(paths) or default_gate_paths())
-    for f in findings:
-        path = os.path.relpath(f.path, rel_root) if rel_root else f.path
-        print(f"{path}:{f.line}: {f.pass_id} {f.message}")
+    if baseline is not None:
+        findings = diff_against_baseline(
+            findings, load_baseline(baseline), rel_root)
+    if output == "json":
+        print(json.dumps([f.to_json(rel_root) for f in findings], indent=2))
+    else:
+        for f in findings:
+            path = os.path.relpath(f.path, rel_root) if rel_root else f.path
+            print(f"{path}:{f.line}: {f.pass_id} {f.message}")
+    label = "new finding(s) vs baseline" if baseline is not None else "finding(s)"
     if findings:
-        print(f"lint: {len(findings)} finding(s)", file=_sys.stderr)
+        print(f"lint: {len(findings)} {label}", file=_sys.stderr)
         return 1
     print("lint: clean", file=_sys.stderr)
     return 0
+
+
+def main(argv: Sequence[str], rel_root: str = "") -> int:
+    """argv = CLI args after the program name.  Shared by
+    `python -m tools.ktpulint` and scripts/lint.py."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="ktpulint",
+        description="project-specific static analysis (KTPU001-KTPU010)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories (default: kubernetes1_tpu/ and tools/)")
+    p.add_argument("--output", choices=("text", "json"), default="text",
+                   help="finding format; json is the stable CI/baseline schema "
+                        "(rule, path, line, message)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="fail only on findings NOT in this baseline file "
+                        "(a previous `--output json` capture; lines ignored)")
+    args = p.parse_args(list(argv))
+    return run_gate(args.paths, rel_root=rel_root, output=args.output,
+                    baseline=args.baseline)
 
 
 # importing the pass modules populates the registry
 from . import exceptions_pass  # noqa: E402,F401
 from . import lockfactory_pass  # noqa: E402,F401
 from . import locks_pass  # noqa: E402,F401
+from . import mutation_pass  # noqa: E402,F401
+from . import schema_pass  # noqa: E402,F401
 from . import threads_pass  # noqa: E402,F401
 from . import wallclock_pass  # noqa: E402,F401

@@ -1,0 +1,355 @@
+"""KTPU008 — in-place mutation of a shared cache snapshot.
+
+Informer `get()/list()`, scheduler-cache `snapshot()`, and watch-cache
+`get_raw()/list_raw()` hand out THE stored object: one object graph
+shared by every consumer, the cache itself, and — on the apiserver —
+the serialization cache keyed `(uid, resourceVersion)`.  Mutating it in
+place silently diverges live state from what every other reader (and
+every cached LIST/watch response at that revision) sees.  The rule is
+clone-before-mutate: `KObject.clone()` / `copy.deepcopy` /
+`scheme.deepcopy` produce a private copy that is yours.
+
+This pass is the static half of the mutation-safety layer (the runtime
+half is `utils/mutsan.py`, KTPU_MUTSAN=1): an intraprocedural dataflow
+walk that tracks values originating from snapshot sources and flags
+
+- attribute/subscript assignment through them (`pod.status.phase = ...`,
+  `d["spec"]["nodeName"] = ...`),
+- mutating-method calls on them or anything reached from them
+  (`pod.metadata.annotations.update(...)`, `d["items"].append(...)`),
+
+without an intervening `clone()`/`deepcopy()`.  Taint is deliberately
+conservative in BOTH directions: it follows plain assignments,
+subscripts, attribute loads and `for` targets, but dies at function
+boundaries and at any sanitizing call — a finding is near-certainly a
+real aliasing bug, at the cost of not chasing aliases across calls.
+
+Sources are inferred from the file itself (no annotations):
+- `X.get(...)` / `X.list()` where `X` was assigned from
+  `*.informer(...)` / `SharedInformer(...)` anywhere in the file, or
+  where X's name contains "informer"/"lister";
+- any `*.snapshot()` call (the scheduler-cache idiom);
+- any `*.get_raw(...)` / `*.list_raw(...)` call (cacher/store raw-dict
+  reads).
+
+Shallow copies (`list(x)`, `sorted(x)`, `dict(x)`, `x[:]`, `x.copy()`)
+copy the CONTAINER but alias the elements: the result may be appended
+to freely, but elements drawn from it are still shared and stay
+tracked.
+
+Writes to `_ktpu_*` attributes are exempt — the sanctioned memoization
+slots (see utils/mutsan), derived and never serialized.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from .engine import FileContext, Finding, register
+
+# taint levels
+FULL = 2    # the value IS a shared snapshot (or part of one)
+ELEMS = 1   # private container whose ELEMENTS are shared snapshots
+
+MUTATOR_METHODS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "remove", "reverse", "setdefault", "sort", "update",
+}
+
+# calls that return a PRIVATE deep copy: taint dies
+SANITIZERS = {"clone", "deepcopy", "to_dict", "from_dict", "decode", "encode"}
+
+# calls that return a private container of SHARED elements
+SHALLOW_COPIES = {"list", "sorted", "dict", "tuple", "set", "frozenset",
+                  "reversed"}
+
+RAW_SOURCE_METHODS = {"get_raw", "list_raw", "snapshot"}
+INFORMER_SOURCE_METHODS = {"get", "list"}
+INFORMER_NAME_TOKENS = ("informer", "lister")
+
+
+def _name_is_informerish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in INFORMER_NAME_TOKENS)
+
+
+def _informer_bindings(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(self-attribute names, local/global names) assigned from
+    `*.informer(...)` or `SharedInformer(...)` anywhere in the file."""
+    attrs: Set[str] = set()
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        func = node.value.func
+        fname = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if fname not in ("informer", "SharedInformer"):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                attrs.add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return attrs, names
+
+
+def _receiver_name(node: ast.expr) -> str:
+    """'self.X' -> 'X', bare name -> the name, else ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+class _FuncWalker:
+    """Statement-order taint walk over one function body."""
+
+    def __init__(self, ctx: FileContext, informer_attrs: Set[str],
+                 informer_names: Set[str]):
+        self.ctx = ctx
+        self.informer_attrs = informer_attrs
+        self.informer_names = informer_names
+        self.taint: Dict[str, int] = {}
+        self.origin: Dict[str, str] = {}
+        self.findings: List[Finding] = []
+
+    # ------------------------------------------------------------- sources
+
+    def _source_of_call(self, call: ast.Call) -> Optional[str]:
+        """Describe the snapshot source a call expression is, or None."""
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr in RAW_SOURCE_METHODS:
+            return f".{func.attr}()"
+        if func.attr in INFORMER_SOURCE_METHODS:
+            recv = func.value
+            # informer-factory chain: factory.informer("pods").list()
+            if isinstance(recv, ast.Call):
+                rf = recv.func
+                rname = rf.attr if isinstance(rf, ast.Attribute) else (
+                    rf.id if isinstance(rf, ast.Name) else "")
+                if rname in ("informer", "SharedInformer"):
+                    return f"informer.{func.attr}()"
+                return None
+            rname = _receiver_name(recv)
+            if not rname:
+                return None
+            if (rname in self.informer_attrs and isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                return f"informer self.{rname}.{func.attr}()"
+            if rname in self.informer_names and isinstance(recv, ast.Name):
+                return f"informer {rname}.{func.attr}()"
+            if _name_is_informerish(rname):
+                return f"informer {rname}.{func.attr}()"
+        return None
+
+    def _expr_taint(self, node: ast.expr) -> Tuple[int, str]:
+        """(taint level, origin) of evaluating `node` — 0 when private."""
+        if isinstance(node, ast.Name):
+            return self.taint.get(node.id, 0), self.origin.get(node.id, "")
+        if isinstance(node, ast.Call):
+            src = self._source_of_call(node)
+            if src is not None:
+                return FULL, src
+            func = node.func
+            fname = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else "")
+            if fname in SANITIZERS:
+                return 0, ""
+            if fname in SHALLOW_COPIES and node.args:
+                lvl, org = self._expr_taint(node.args[0])
+                return (ELEMS, org) if lvl else (0, "")
+            if fname == "copy" and isinstance(func, ast.Attribute):
+                lvl, org = self._expr_taint(func.value)
+                return (ELEMS, org) if lvl else (0, "")
+            if fname in ("get", "values", "items") and isinstance(
+                    func, ast.Attribute):
+                # d.get(k) / d.values() on a tainted dict yields shared values
+                lvl, org = self._expr_taint(func.value)
+                return (FULL, org) if lvl else (0, "")
+            return 0, ""  # unknown call: assume it returns private data
+        if isinstance(node, ast.Attribute):
+            lvl, org = self._expr_taint(node.value)
+            return (FULL, org) if lvl == FULL else (0, "")
+        if isinstance(node, ast.Subscript):
+            lvl, org = self._expr_taint(node.value)
+            if isinstance(node.slice, ast.Slice):
+                return (ELEMS, org) if lvl else (0, "")
+            return (FULL, org) if lvl else (0, "")
+        if isinstance(node, ast.BoolOp):
+            # `x or {}` keeps x's taint
+            for v in node.values:
+                lvl, org = self._expr_taint(v)
+                if lvl:
+                    return lvl, org
+            return 0, ""
+        if isinstance(node, ast.IfExp):
+            for v in (node.body, node.orelse):
+                lvl, org = self._expr_taint(v)
+                if lvl:
+                    return lvl, org
+            return 0, ""
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            # [p for p in SRC if ...] — elements stay shared
+            for gen in node.generators:
+                lvl, org = self._expr_taint(gen.iter)
+                if lvl:
+                    return ELEMS, org
+            return 0, ""
+        if isinstance(node, ast.Starred):
+            return self._expr_taint(node.value)
+        return 0, ""
+
+    # ----------------------------------------------------------- traversal
+
+    def walk(self, body: List[ast.stmt]):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs get their own (empty-state) analysis
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self._scan_expr(value)
+                lvl, org = self._expr_taint(value)
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for tgt in targets:
+                    self._assign_target(tgt, lvl, org, stmt.lineno)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._flag_if_shared_target(stmt.target, stmt.lineno, "augmented assignment")
+            self._scan_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._flag_if_shared_target(tgt, stmt.lineno, "del")
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter)
+            lvl, org = self._expr_taint(stmt.iter)
+            # iterating a shared container OR a shallow copy of one yields
+            # shared elements; .items() tuple targets taint every binding
+            elem_lvl = FULL if lvl else 0
+            self._assign_target(stmt.target, elem_lvl, org, stmt.lineno)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+                if item.optional_vars is not None and isinstance(
+                        item.optional_vars, ast.Name):
+                    lvl, org = self._expr_taint(item.context_expr)
+                    self._assign_target(item.optional_vars, lvl, org,
+                                        stmt.lineno)
+            self.walk(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            for handler in stmt.handlers:
+                self.walk(handler.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child)
+
+    def _assign_target(self, tgt: ast.expr, lvl: int, org: str, line: int):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._assign_target(el, lvl, org, line)
+            return
+        if isinstance(tgt, ast.Name):
+            if lvl:
+                self.taint[tgt.id] = lvl
+                self.origin[tgt.id] = org
+            else:
+                self.taint.pop(tgt.id, None)
+                self.origin.pop(tgt.id, None)
+            return
+        # writing INTO an attribute/subscript: flag when the chain is shared
+        self._flag_if_shared_target(tgt, line, "assignment")
+
+    # ------------------------------------------------------------- flagging
+
+    def _chain_taint(self, node: ast.expr) -> Tuple[int, str]:
+        """Taint of the object a write/mutator chain dereferences: the
+        chain root's value, walked through attributes/subscripts/reads."""
+        return self._expr_taint(node)
+
+    def _flag_if_shared_target(self, tgt: ast.expr, line: int, what: str):
+        if not isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            return
+        if isinstance(tgt, ast.Attribute) and tgt.attr.startswith("_ktpu_"):
+            return  # sanctioned memoization slot
+        lvl, org = self._chain_taint(tgt.value)
+        if lvl == FULL:
+            self._emit(line, org, what)
+
+    def _scan_expr(self, node: ast.expr):
+        """Find mutator-method calls on shared chains anywhere in an
+        expression (lambdas pruned: they run later, on other state)."""
+        stack: List[ast.AST] = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(cur))
+            if not isinstance(cur, ast.Call):
+                continue
+            func = cur.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in MUTATOR_METHODS:
+                continue
+            lvl, org = self._chain_taint(func.value)
+            if lvl == FULL:
+                self._emit(cur.lineno, org, f".{func.attr}()")
+
+    def _emit(self, line: int, origin: str, what: str):
+        src = origin or "a shared cache read"
+        self.findings.append(Finding(
+            self.ctx.path, line, "KTPU008",
+            f"{what} mutates a shared cache snapshot (from {src}) — "
+            f"these objects are shared with the cache and other readers; "
+            f"clone() before mutating (utils/mutsan)"))
+
+
+@register("KTPU008")
+def mutation_pass(ctx: FileContext) -> List[Finding]:
+    informer_attrs, informer_names = _informer_bindings(ctx.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        w = _FuncWalker(ctx, informer_attrs, informer_names)
+        w.walk(node.body)
+        findings.extend(w.findings)
+    return findings

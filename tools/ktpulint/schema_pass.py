@@ -1,0 +1,234 @@
+"""KTPU009 — schema-aware key checking for API-shaped raw dicts.
+
+The read path works on ENCODED wire dicts (selector matching, response
+assembly, watch-cache bookkeeping) precisely so it never pays a decode —
+which also means a typo'd key (`d["spec"]["nodename"]`,
+`.get("metdata")`) is not an AttributeError but a silently-empty match
+with zero static coverage.  This pass derives the wire-field schema
+from the `api/types.py` dataclasses (the same source the serializer
+derives the wire form from, so the check cannot drift) and validates
+every string-literal key access on an API-shaped dict chain.
+
+What counts as API-shaped: a subscript/`.get()` chain whose first
+literal key is `metadata`, `spec` or `status` (the universal KObject
+envelope), or a variable assigned from such a chain earlier in the same
+function.  Keys BELOW a `Dict[str, ...]` field (labels, annotations,
+data, …) are free-form and never checked; keys under a typed field must
+exist on SOME registered API type reachable under that parent key (the
+schema is a union across kinds — conservative, so a finding is a real
+typo, not a modeling gap).
+
+The schema is imported from the package (lazily, once); if the import
+fails — linting a checkout with a broken api/types.py — the pass skips
+rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import typing
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .engine import FileContext, Finding, register
+
+# parent wire key -> set of valid child wire keys, or None = free-form
+# (Dict[str, ...]/Any valued: anything goes below here)
+_SCHEMA: Optional[Dict[str, Optional[Set[str]]]] = None
+_ROOTS = ("metadata", "spec", "status")
+
+
+def _build_schema() -> Dict[str, Optional[Set[str]]]:
+    """children[parent_wire_key] = union of child wire keys across every
+    registered type whose field (or list-element) type is a dataclass;
+    None when any type makes the subtree free-form."""
+    from kubernetes1_tpu.api import types as _t  # noqa: F401 registers types
+    from kubernetes1_tpu.machinery import scheme as _scheme
+    from kubernetes1_tpu.machinery.meta import ObjectMeta
+
+    children: Dict[str, Optional[Set[str]]] = {}
+    seen: Set[type] = set()
+
+    def field_entries(cls) -> List[Tuple[str, Any]]:
+        hints = typing.get_type_hints(cls)
+        return [(_scheme._camel(f.name), hints[f.name])
+                for f in dataclasses.fields(cls)]
+
+    def element_type(tp):
+        """The dataclass a wire key leads into, or 'free' for open maps,
+        or None for scalars."""
+        tp = _scheme._unwrap_optional(tp)
+        origin = typing.get_origin(tp)
+        if origin in (list, tuple):
+            args = typing.get_args(tp)
+            return element_type(args[0]) if args else "free"
+        if origin is dict:
+            return "free"
+        if tp is Any:
+            return "free"
+        if dataclasses.is_dataclass(tp):
+            return tp
+        return None
+
+    def note(parent_key: str, et):
+        if et == "free":
+            children[parent_key] = None  # free-form wins over any union
+        elif et is not None and children.get(parent_key, set()) is not None:
+            children.setdefault(parent_key, set())
+            children[parent_key].update(
+                wire for wire, _tp in field_entries(et))
+            walk(et)
+
+    def walk(cls):
+        if cls in seen:
+            return
+        seen.add(cls)
+        for wire, tp in field_entries(cls):
+            note(wire, element_type(tp))
+
+    roots = {cls for cls in _scheme.global_scheme.by_kind.values()
+             if dataclasses.is_dataclass(cls)}
+    for cls in roots:
+        walk(cls)
+        for wire, tp in field_entries(cls):
+            if wire in ("spec", "status"):
+                et = element_type(tp)
+                if dataclasses.is_dataclass(et):
+                    pass  # note() above already recorded spec/status children
+    # the metadata envelope is ObjectMeta for every kind
+    walk(ObjectMeta)
+    children["metadata"] = {w for w, _tp in field_entries(ObjectMeta)}
+    return children
+
+
+def _schema() -> Optional[Dict[str, Optional[Set[str]]]]:
+    global _SCHEMA
+    if _SCHEMA is None:
+        try:
+            _SCHEMA = _build_schema()
+        except Exception:  # noqa: BLE001 — no schema, no findings (see module doc)
+            _SCHEMA = {}
+    return _SCHEMA or None
+
+
+def _literal_key(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _unwrap_or(node: ast.expr) -> ast.expr:
+    """`X or {}` / `X or []` -> X (the ubiquitous default idiom)."""
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) and node.values:
+        return node.values[0]
+    return node
+
+
+def _chain_keys(node: ast.expr) -> Tuple[Optional[str], List[Tuple[str, int]]]:
+    """Decompose a subscript/.get() chain into (root variable name or
+    None, [(literal key, line), ...] outermost-last).  Non-literal links
+    (indexes, variables) appear as a '*' wildcard that breaks matching
+    but keeps deeper keys validated against the union schema."""
+    keys: List[Tuple[str, int]] = []
+    while True:
+        node = _unwrap_or(node)
+        if isinstance(node, ast.Subscript):
+            k = _literal_key(node.slice)
+            keys.append((k if k is not None else "*", node.lineno))
+            node = node.value
+            continue
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args):
+            k = _literal_key(node.args[0])
+            keys.append((k if k is not None else "*", node.lineno))
+            node = node.func.value
+            continue
+        break
+    keys.reverse()
+    root = node.id if isinstance(node, ast.Name) else None
+    return root, keys
+
+
+def _check_chain(ctx: FileContext, schema, context_key: Optional[str],
+                 keys: List[Tuple[str, int]], findings: List[Finding],
+                 reported: Set[Tuple[int, str]]):
+    """Validate consecutive (parent, child) literal-key pairs; parent
+    context carries across a variable assignment via `context_key`."""
+    parent = context_key
+    for key, line in keys:
+        if key == "*":
+            parent = None
+            continue
+        if parent is not None:
+            allowed = schema.get(parent, "missing")
+            if allowed is None:
+                return  # free-form subtree: stop checking deeper
+            if allowed != "missing" and key not in allowed:
+                mark = (line, key)
+                if mark not in reported:
+                    reported.add(mark)
+                    findings.append(Finding(
+                        ctx.path, line, "KTPU009",
+                        f"unknown wire field {key!r} under {parent!r} — "
+                        f"no registered API type (api/types.py) has it; "
+                        f"typo'd keys on raw dicts match nothing silently"))
+                parent = None
+                continue
+        parent = key
+
+
+def _api_rooted(keys: List[Tuple[str, int]]) -> bool:
+    return bool(keys) and keys[0][0] in _ROOTS
+
+
+def _scoped_nodes(root: ast.AST):
+    """DFS over one scope's OWN nodes: nested function defs are PRUNED
+    (they get their own walk — and their own key-context, so a parameter
+    that happens to share a name with an outer variable never inherits
+    the outer context)."""
+    for child in ast.iter_child_nodes(root):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _scoped_nodes(child)  # pre-order = source order,
+            # which the assignment-context flow depends on
+
+
+@register("KTPU009")
+def schema_pass(ctx: FileContext) -> List[Finding]:
+    schema = _schema()
+    if schema is None:
+        return []
+    findings: List[Finding] = []
+    reported: Set[Tuple[int, str]] = set()
+    scopes: List[ast.AST] = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in scopes:
+        # context_of[var] = the wire key whose subtree the var holds
+        context_of: Dict[str, Optional[str]] = {}
+        for node in _scoped_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                root, keys = _chain_keys(node.value)
+                ctx_key = context_of.get(root) if root else None
+                if _api_rooted(keys) or (ctx_key and keys):
+                    _check_chain(ctx, schema, ctx_key, keys, findings, reported)
+                    last = keys[-1][0] if keys else None
+                    if last and last != "*" and (
+                            _api_rooted(keys) or ctx_key):
+                        context_of[node.targets[0].id] = last
+                    else:
+                        context_of.pop(node.targets[0].id, None)
+                else:
+                    context_of.pop(node.targets[0].id, None)
+                continue
+            if isinstance(node, (ast.Subscript, ast.Call)):
+                root, keys = _chain_keys(node)
+                if not keys:
+                    continue
+                ctx_key = context_of.get(root) if root else None
+                if _api_rooted(keys):
+                    _check_chain(ctx, schema, None, keys, findings, reported)
+                elif ctx_key:
+                    _check_chain(ctx, schema, ctx_key, keys, findings, reported)
+    return findings
