@@ -231,6 +231,23 @@ def bench_density():
     enc_total = enc_hits + enc_misses
     watch_evictions = (master.cacher.watch_evictions
                        + getattr(master.store, "watch_evictions", 0))
+    # write-path economics (group commit, new in r06): batch occupancy,
+    # fan-out coalescing ratio, and the scheduler's bind batch sizes
+    st = master.store
+    fan_wakeups = st.watch_wakeups + master.cacher.watch_wakeups
+    fan_events = st.watch_events + master.cacher.watch_events
+    write_path = {
+        "store_commits": st.commit_count,
+        "store_commit_batches": st.commit_batches,
+        "store_batch_occupancy": round(
+            st.commit_count / st.commit_batches, 3)
+        if st.commit_batches else None,
+        "watch_wakeups_per_event": round(fan_wakeups / fan_events, 4)
+        if fan_events else None,
+        "bind_batch_p50": sched.bind_batch_size.quantile(0.5),
+        "bind_batch_p99": sched.bind_batch_size.quantile(0.99),
+        "bind_batches": sched.bind_batch_size.count,
+    }
 
     sli_phases = sli.report()
     sli.stop()
@@ -259,6 +276,7 @@ def bench_density():
         "encode_cache_hits": enc_hits,
         "encode_cache_misses": enc_misses,
         "watch_evictions": watch_evictions,
+        "write_path": write_path,
     }
 
 

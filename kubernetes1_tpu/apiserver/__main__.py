@@ -50,6 +50,16 @@ def main():
                          "this apiserver stateless — run several")
     ap.add_argument("--store-ca-file", default="",
                     help="CA to verify the store's TLS cert")
+    ap.add_argument("--wal-sync", default="batch",
+                    choices=("none", "batch", "always"),
+                    help="local-WAL fsync policy: per group commit "
+                         "(batch, default), per record (always), or page-"
+                         "cache only (none)")
+    ap.add_argument("--write-coalesce-ms", type=float, default=0.0,
+                    help="opt-in write-coalescing window (~1-5ms): under "
+                         "a write burst, singleton POST/PUT handlers park "
+                         "up to this long so the store commits them as "
+                         "one batch; 0 disables (default)")
     args = ap.parse_args()
     if args.store_address and args.wal:
         ap.error("--wal and --store-address are mutually exclusive: with an "
@@ -89,6 +99,8 @@ def main():
         client_ca_file=args.client_ca_file,
         store_address=args.store_address,
         store_ca_file=args.store_ca_file,
+        wal_sync=args.wal_sync,
+        write_coalesce_window=args.write_coalesce_ms / 1000.0,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -747,40 +747,102 @@ class Registry:
 
     # ---------------------------------------------------------- binding
 
+    @staticmethod
+    def _apply_binding(pod, pod_name: str, binding: t.Binding):
+        """Fold one Binding into a pod object (shared by the singleton and
+        bulk bind paths so the placement rules cannot drift)."""
+        if pod.spec.node_name and pod.spec.node_name != binding.target_node:
+            raise Conflict(
+                f"pod {pod_name} already bound to {pod.spec.node_name}"
+            )
+        pod.spec.node_name = binding.target_node
+        by_name = {per.name: per for per in pod.spec.extended_resources}
+        for req_name, ids in binding.extended_resource_assignments.items():
+            per = by_name.get(req_name)
+            if per is None:
+                raise Invalid(f"unknown extended resource {req_name!r} in binding")
+            if len(ids) != per.quantity:
+                raise Invalid(
+                    f"binding assigns {len(ids)} devices to {req_name}, want {per.quantity}"
+                )
+            per.assigned = list(ids)
+        pod.metadata.annotations.pop(t.NOMINATED_NODE_ANNOTATION, None)
+        # observability stamps riding the binding (scheduler's
+        # scheduled-at, trace context) are merged — prefix-gated so a
+        # binding can't overwrite arbitrary pod metadata — and the
+        # commit itself is the authoritative bound-at instant
+        for k, v in (binding.metadata.annotations or {}).items():
+            if k.startswith(("slo.ktpu.io/", "trace.ktpu.io/")):
+                pod.metadata.annotations[k] = v
+        pod.metadata.annotations[t.BOUND_AT_ANNOTATION] = \
+            f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
+        return pod
+
     def bind(self, namespace: str, pod_name: str, binding: t.Binding):
         """Apply the scheduler's placement transactionally
         (ref: storage.go:147,181-186)."""
         key = self.key("pods", namespace, pod_name)
+        return self.store.guaranteed_update(
+            key, lambda pod: self._apply_binding(pod, pod_name, binding))
 
-        def apply(pod):
-            if pod.spec.node_name and pod.spec.node_name != binding.target_node:
-                raise Conflict(
-                    f"pod {pod_name} already bound to {pod.spec.node_name}"
-                )
-            pod.spec.node_name = binding.target_node
-            by_name = {per.name: per for per in pod.spec.extended_resources}
-            for req_name, ids in binding.extended_resource_assignments.items():
-                per = by_name.get(req_name)
-                if per is None:
-                    raise Invalid(f"unknown extended resource {req_name!r} in binding")
-                if len(ids) != per.quantity:
-                    raise Invalid(
-                        f"binding assigns {len(ids)} devices to {req_name}, want {per.quantity}"
-                    )
-                per.assigned = list(ids)
-            pod.metadata.annotations.pop(t.NOMINATED_NODE_ANNOTATION, None)
-            # observability stamps riding the binding (scheduler's
-            # scheduled-at, trace context) are merged — prefix-gated so a
-            # binding can't overwrite arbitrary pod metadata — and the
-            # commit itself is the authoritative bound-at instant
-            for k, v in (binding.metadata.annotations or {}).items():
-                if k.startswith(("slo.ktpu.io/", "trace.ktpu.io/")):
-                    pod.metadata.annotations[k] = v
-            pod.metadata.annotations[t.BOUND_AT_ANNOTATION] = \
-                f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
-            return pod
+    def bind_batch(self, namespace: str,
+                   bindings: List[t.Binding]) -> List[Optional[Exception]]:
+        """Bulk bind: commit every member binding of a gang (or a drained
+        bind-queue burst) through ONE store group commit per round —
+        2 RPCs (get_many + commit_batch) for N pods in remote-store mode
+        instead of 2N, and one lock acquisition / WAL fsync / watch
+        wakeup for the whole set in-process.
 
-        return self.store.guaranteed_update(key, apply)
+        Returns one outcome per binding, same order: None on success or
+        the ApiError that sank it.  Members fail independently — a bulk
+        bind is amortization, not a transaction (the gang's all-or-nothing
+        guarantee lives in the scheduler's placement, which only ships a
+        gang once every member has a seat).  CAS races (a concurrent
+        status writer bumping a pod's revision) retry like
+        guaranteed_update; real conflicts (already bound elsewhere)
+        surface as errors."""
+        results: List[Optional[Exception]] = [None] * len(bindings)
+        keys: Dict[int, str] = {}
+        for i, b in enumerate(bindings):
+            ns = b.metadata.namespace or namespace or "default"
+            try:
+                keys[i] = self.key("pods", ns, b.metadata.name)
+            except BadRequest as e:
+                results[i] = e
+        pending = list(keys)
+        while pending:
+            raws = self.store.get_raw_many([keys[i] for i in pending])
+            ops, op_idx = [], []
+            for i, raw in zip(pending, raws):
+                b = bindings[i]
+                if raw is None:
+                    results[i] = NotFound(
+                        f'pods "{b.metadata.name}" not found')
+                    continue
+                pod = self.scheme.decode(raw)
+                try:
+                    pod = self._apply_binding(pod, b.metadata.name, b)
+                except (Conflict, Invalid) as e:
+                    results[i] = e  # real conflict: no retry
+                    continue
+                ops.append({"op": "update_cas", "key": keys[i],
+                            "obj": self.scheme.encode(pod),
+                            "expect_rv": raw["metadata"]["resourceVersion"]})
+                op_idx.append(i)
+            if not ops:
+                break
+            outs = self.store.commit_batch(ops)
+            retry = []
+            for i, out in zip(op_idx, outs):
+                err = out.get("error")
+                if err is None:
+                    results[i] = None  # bound
+                elif isinstance(err, Conflict):
+                    retry.append(i)  # CAS race: re-read and re-apply
+                else:
+                    results[i] = err
+            pending = retry
+        return results
 
 
 def _merge_patch(target: Any, patch: Any) -> Any:

@@ -123,6 +123,49 @@ class _AdmissionTTLCache:
         self._data.clear()
 
 
+class _WriteCoalescer:
+    """Opt-in write-coalescing window for singleton POST/PUT handlers
+    (Master(write_coalesce_window=...), seconds; 0 = off, the default).
+
+    When enabled it engages ONLY under burst: the first writer in flight
+    passes straight through (an isolated write pays zero added latency);
+    a writer that finds another already in flight parks until the current
+    window expires, so a create storm's handlers release toward the store
+    in lockstep and the store's group commit drains them as one batch
+    (one fan-out wakeup, one WAL fsync).  ~1-5ms windows trade that much
+    p50 under burst for batch occupancy; the gate sleeps OUTSIDE every
+    lock."""
+
+    def __init__(self, window: float):
+        self.window = window
+        self._lock = locksan.make_lock("Master._coalesce_lock")
+        self._inflight = 0
+        self._deadline = 0.0
+        self.waits = 0  # ktpu_write_coalesce_waits_total
+
+    def __enter__(self):
+        if not self.window:
+            return self
+        delay = 0.0
+        with self._lock:
+            self._inflight += 1
+            if self._inflight > 1:  # burst: another write is in flight
+                now = time.monotonic()
+                if self._deadline <= now:
+                    self._deadline = now + self.window
+                delay = self._deadline - now
+                self.waits += 1
+        if delay > 0:
+            time.sleep(delay)
+        return self
+
+    def __exit__(self, *exc):
+        if self.window:
+            with self._lock:
+                self._inflight -= 1
+        return False
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "ktpu-apiserver/0.1"
@@ -448,7 +491,17 @@ class _Handler(BaseHTTPRequestHandler):
             if resource not in self.master.scheme.by_resource:
                 raise NotFound(f"resource {resource!r} not registered")
             verb = verb_for(method, name, q.get("watch") in ("1", "true"))
-            self._authz(user, verb, resource, ns, name, sub)
+            if (method == "POST" and resource == "pods"
+                    and name == "bindings:batch" and not sub):
+                # a bulk bind is N binding-subresource creates: it must be
+                # gated by the SAME pods/binding permission as a singleton
+                # bind — authorizing it as plain `create pods` would let a
+                # pod-creating principal bind arbitrary pods (the exact
+                # escalation the subresource naming exists to prevent),
+                # and a scheduler granted only pods/binding would 403
+                self._authz(user, "create", resource, ns, "", "binding")
+            else:
+                self._authz(user, verb, resource, ns, name, sub)
             handler = getattr(self, f"_do_{method.lower()}")
             handler(resource, ns, name, sub, q)
             if method != "GET":
@@ -535,7 +588,11 @@ class _Handler(BaseHTTPRequestHandler):
     def _get_object(self, resource, ns, name):
         """Single-object GET from the watch cache: committed wire dict ->
         cached bytes, zero decode/encode.  Falls back to the store when
-        the cache can't answer fresh (still seeding, pump behind)."""
+        the cache can't answer fresh (still seeding, pump behind) — and
+        before answering 404 on a cache miss in remote-store mode, where
+        stream-progress freshness means a PEER apiserver's create may not
+        have reached this cache yet (the upstream get-from-etcd-on-miss
+        shape; existing objects — the hot path — never pay it)."""
         reg = self.master.registry
         try:
             raw = self.master.cacher.get_raw(reg.key(resource, ns, name))
@@ -543,6 +600,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_obj(200, reg.get(resource, ns, name))
             return
         if raw is None:
+            if self.master.store_is_remote:
+                self._send_obj(200, reg.get(resource, ns, name))  # authoritative
+                return
             raise NotFound(f'{resource} "{name}" not found')
         self._send_raw_json(200, self.master.scheme.encode_bytes(
             raw, getattr(self, "_req_version", "")))
@@ -736,14 +796,15 @@ class _Handler(BaseHTTPRequestHandler):
         # in getresponse() until the headers actually hit the wire
         self.wfile.flush()
         deadline = time.monotonic() + timeout if timeout else None
+        ver = getattr(self, "_req_version", "")
         try:
             while True:
                 if deadline and time.monotonic() >= deadline:
                     break
-                ev = w.next_timeout(WATCH_HEARTBEAT_SECONDS)
+                evs = w.next_batch_timeout(WATCH_HEARTBEAT_SECONDS)
                 if self.master.stopping.is_set():
                     break
-                if ev is None:
+                if evs is None:
                     if getattr(w, "evicted", False):
                         # slow consumer (or cache reseed): this stream can
                         # no longer be gap-free.  Answer 410 Expired so
@@ -765,17 +826,19 @@ class _Handler(BaseHTTPRequestHandler):
                     # heartbeat chunk keeps half-open connections detectable
                     self._write_chunk(b"")
                     continue
-                if not w.event_matches(ev.object):
-                    continue
                 # watch frames honor the requested version like every verb.
-                # The WatchEvent is SHARED by every watcher of the resource
-                # (one fan-out per commit) and the payload bytes come from
-                # the scheme's once-per-revision serialization cache — N
-                # watchers plus every list/get of the same revision cost
-                # ONE encode (the reference's cacher economics,
-                # storage/cacher.go).
-                self._write_chunk(self.master.scheme.watch_frame_bytes(
-                    ev.type, ev.object, getattr(self, "_req_version", "")))
+                # WatchEvents are SHARED by every watcher of the resource
+                # (one fan-out wakeup per group commit) and the payload
+                # bytes come from the scheme's once-per-revision
+                # serialization cache — N watchers plus every list/get of
+                # the same revision cost ONE encode (the reference's
+                # cacher economics, storage/cacher.go).  A batch's frames
+                # go out as ONE buffered write + flush: the syscall and
+                # the client's recv wakeup amortize across the batch too.
+                self._write_chunks(
+                    self.master.scheme.watch_frame_bytes(
+                        ev.type, ev.object, ver)
+                    for ev in evs if w.event_matches(ev.object))
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:
@@ -787,11 +850,22 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
     def _write_chunk(self, data: bytes):
-        if not data:
-            # zero-length would terminate chunked encoding; send a newline
-            data = b"\n"
-        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-        self.wfile.flush()
+        self._write_chunks([data])
+
+    def _write_chunks(self, frames):
+        """Frame N chunks and ship them as ONE buffered write + flush (a
+        batch's worth of watch frames costs one syscall and one client
+        recv wakeup).  The chunked-encoding wire format lives only here."""
+        buf = bytearray()
+        for data in frames:
+            if not data:
+                # zero-length would terminate chunked encoding; a newline
+                # keeps the stream alive (heartbeats ride this)
+                data = b"\n"
+            buf += b"%x\r\n" % len(data) + data + b"\r\n"
+        if buf:
+            self.wfile.write(buf)
+            self.wfile.flush()
 
     def _serve_metrics(self):
         master = self.master
@@ -811,7 +885,41 @@ class _Handler(BaseHTTPRequestHandler):
             f"ktpu_watch_slow_consumer_evictions_total {evictions}",
             "# TYPE ktpu_watch_cache_reseeds_total counter",
             f"ktpu_watch_cache_reseeds_total {master.cacher.reseeds}",
+            "# TYPE ktpu_write_coalesce_waits_total counter",
+            f"ktpu_write_coalesce_waits_total {master.write_coalescer.waits}",
         ]
+        # write-path economics (in-process store only; a remote store
+        # exports these from its own process): group-commit occupancy and
+        # the fan-out coalescing ratio — wakeups-per-event < 1.0 means
+        # watcher/replica/cacher wakeups are being amortized across
+        # batched commits (the BENCH_r06 acceptance metric)
+        commits = getattr(master.store, "commit_count", None)
+        if commits is not None:
+            batches = master.store.commit_batches
+            # client watchers hang off the CACHER in-process (the store's
+            # own watcher list is empty in sync-feed mode): aggregate both
+            # fan-out layers so the ratio reflects what clients cost
+            wakeups = (master.store.watch_wakeups
+                       + master.cacher.watch_wakeups)
+            events = (master.store.watch_events
+                      + master.cacher.watch_events)
+            extra += [
+                "# TYPE ktpu_store_commits_total counter",
+                f"ktpu_store_commits_total {commits}",
+                "# TYPE ktpu_store_commit_batches_total counter",
+                f"ktpu_store_commit_batches_total {batches}",
+                "# TYPE ktpu_store_batch_occupancy gauge",
+                f"ktpu_store_batch_occupancy "
+                f"{(commits / batches) if batches else 0.0:.6f}",
+                "# TYPE ktpu_store_watch_wakeups_total counter",
+                f"ktpu_store_watch_wakeups_total {wakeups}",
+                "# TYPE ktpu_store_watch_events_total counter",
+                f"ktpu_store_watch_events_total {events}",
+                "# TYPE ktpu_store_watch_wakeups_per_event gauge",
+                f"ktpu_store_watch_wakeups_per_event "
+                f"{(wakeups / events) if events else 0.0:.6f}",
+                master.store.wal_fsync_seconds.render().rstrip("\n"),
+            ]
         body = (master.metrics.render() + "\n".join(extra) + "\n").encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -824,6 +932,33 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_post(self, resource, ns, name, sub, q):
         reg = self.master.registry
         body = self._read_body()
+        if resource == "pods" and name == "bindings:batch" and not sub:
+            # bulk bind: every member binding of a gang (or a drained
+            # scheduler bind queue) lands in ONE store group commit —
+            # per-item outcomes, HTTP 200 for the envelope
+            bindings = []
+            for d in body.get("items") or []:
+                obj = self.master.scheme.decode(d)
+                if getattr(obj, "KIND", "") != "Binding":
+                    raise BadRequest(
+                        f"bindings:batch items must be Binding, got "
+                        f"{d.get('kind')!r}")
+                bindings.append(obj)
+            if not bindings:
+                raise BadRequest("bindings:batch requires items")
+            outcomes = reg.bind_batch(ns, bindings)
+            self.master.audit("bind", resource, ns,
+                              f"bindings:batch[{len(bindings)}]",
+                              self._user.name)
+            self._send_json(200, {
+                "kind": "BindingBatchResult", "apiVersion": "v1",
+                "results": [
+                    {"kind": "Status", "apiVersion": "v1",
+                     "status": "Success"} if e is None else e.to_status()
+                    for e in outcomes
+                ],
+            })
+            return
         if resource == "pods" and sub == "binding":
             binding = self.master.scheme.decode(body)
             reg.bind(ns, name, binding)
@@ -873,9 +1008,13 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self.master.admission.admit(CREATE, resource, obj, user=self._user)
             return reg.create(resource, ns, obj)
 
-        created = self._with_quota_serialization(
-            resource, ns or obj.metadata.namespace, admit_and_create
-        )
+        # coalescer gate BEFORE the quota lock: parking happens with no
+        # locks held, then the burst's handlers hit the store together
+        # and its group commit drains them as one batch
+        with self.master.write_coalescer:
+            created = self._with_quota_serialization(
+                resource, ns or obj.metadata.namespace, admit_and_create
+            )
         # audit with the effective namespace: creates may carry the ns only
         # in the object body (no-ns URL form), and namespace-scoped audit
         # rules must still match those writes
@@ -911,9 +1050,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 return reg.update(resource, ns, name, obj)
 
-            updated = self._with_quota_serialization(
-                resource, ns or old.metadata.namespace, admit_and_update
-            )
+            with self.master.write_coalescer:
+                updated = self._with_quota_serialization(
+                    resource, ns or old.metadata.namespace, admit_and_update
+                )
             if resource == "customresourcedefinitions":
                 self.master.remove_crd(old)
                 self.master.apply_crd(updated)
@@ -1041,11 +1181,17 @@ class Master:
         watch_queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,  # per-watcher
                                                # event bound before slow-
                                                # consumer eviction (410)
+        write_coalesce_window: float = 0.0,    # seconds; opt-in singleton
+                                               # write coalescing under
+                                               # burst (see _WriteCoalescer)
+        wal_sync: str = "batch",               # WAL fsync policy
+                                               # (none|batch|always)
     ):
         fasthttp.install()  # idempotent (see class docstring)
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
         self.scheme = scheme or global_scheme.copy()
+        self.store_is_remote = bool(store_address)
         if store_address:
             from ..storage.remote import RemoteStore
 
@@ -1054,7 +1200,9 @@ class Master:
             self.store = RemoteStore(self.scheme, store_address,
                                      ca_file=store_ca_file)
         else:
-            self.store = Store(self.scheme, wal_path=wal_path)
+            self.store = Store(self.scheme, wal_path=wal_path,
+                               wal_sync=wal_sync)
+        self.write_coalescer = _WriteCoalescer(write_coalesce_window)
         self.registry = Registry(self.store, self.scheme)
         # k8s-cacher-analog read layer: GET/LIST/WATCH serve from an
         # in-memory watch-fed view (one store watch and zero decode/encode

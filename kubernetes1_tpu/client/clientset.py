@@ -297,6 +297,27 @@ class Clientset:
             body=self.scheme.encode(binding),
         )
 
+    def bind_batch(self, namespace: str, bindings):
+        """POST N bindings as ONE bulk request (pods/bindings:batch): the
+        apiserver commits them through one store group commit — the
+        scheduler's gang-bind / drained-bind-queue fast path.  Returns one
+        outcome per binding, same order: None on success or the ApiError
+        that sank that member (members fail independently)."""
+        from ..machinery import ApiError
+
+        body = {"kind": "BindingList", "apiVersion": "v1",
+                "items": [self.scheme.encode(b) for b in bindings]}
+        data = self.api.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/bindings:batch",
+            body=body,
+        )
+        out = []
+        for r in data.get("results", []):
+            out.append(None if r.get("status") == "Success"
+                       else ApiError.from_status(r))
+        return out
+
     def evict(self, namespace: str, pod_name: str,
               grace_seconds: "Optional[int]" = None):
         """Eviction subresource: voluntary, PDB-respecting pod removal.

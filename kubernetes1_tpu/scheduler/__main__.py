@@ -21,6 +21,9 @@ def main():
     ap.add_argument("--identity", default="scheduler-0")
     ap.add_argument("--metrics-port", type=int, default=10251,
                     help="/metrics + /healthz port (0 = ephemeral, -1 = off)")
+    ap.add_argument("--bind-workers", type=int, default=8,
+                    help="bind worker pool size; each worker drains the "
+                         "bind queue greedily and ships bulk bind requests")
     ap.add_argument("--policy-config-file", default="",
                     help="scheduler policy JSON (extenders; ref "
                          "examples/scheduler-policy-config.json)")
@@ -44,6 +47,7 @@ def main():
         cs, scheduler_name=args.scheduler_name,
         metrics_port=None if args.metrics_port < 0 else args.metrics_port,
         policy=policy,
+        bind_workers=args.bind_workers,
     )
     stop = threading.Event()
 

@@ -63,6 +63,23 @@ class ScheduleResult:
         self.assignments = assignments
 
 
+class _BindItem:
+    """One queued bind: everything a bind worker needs to ship it — alone
+    (extender delegation, singleton) or as part of a bulk request (the
+    greedy bind-queue drain groups items by namespace and POSTs them as
+    one pods/bindings:batch)."""
+
+    __slots__ = ("pod", "assumed", "binding", "result", "ext_binder", "tid")
+
+    def __init__(self, pod, assumed, binding, result, ext_binder, tid):
+        self.pod = pod
+        self.assumed = assumed
+        self.binding = binding
+        self.result = result
+        self.ext_binder = ext_binder
+        self.tid = tid
+
+
 class Scheduler:
     def __init__(
         self,
@@ -72,6 +89,8 @@ class Scheduler:
         metrics_port: Optional[int] = None,  # None = no endpoint; 0 = ephemeral
         extenders: Optional[List[HTTPExtender]] = None,
         policy: Optional[dict] = None,  # scheduler policy JSON (extenders)
+        bind_workers: int = 8,          # bind pool size (--bind-workers)
+        max_bind_batch: int = 128,      # per-request cap on bulk binds
     ):
         self.cs = clientset
         self.name = scheduler_name
@@ -93,11 +112,17 @@ class Scheduler:
         self.extenders = list(extenders or []) + extenders_from_policy(policy)
         self._scan_offset = 0  # rotates so sampling spreads over the cluster
         # persistent bind workers (ref scheduler.go:482 async bind): a pool
-        # reuses per-thread HTTP connections instead of a thread per bind
+        # reuses per-thread HTTP connections instead of a thread per bind.
+        # Each worker drains the queue GREEDILY: everything queued when it
+        # wakes ships as ONE bulk pods/bindings:batch request (gang
+        # members land together by construction — _assume_and_bind
+        # enqueues them back-to-back), so a 30k-pod burst's binds amortize
+        # HTTP round-trips and store commits instead of paying both per pod.
         import queue as _queue
 
         self._bind_q: "_queue.Queue" = _queue.Queue()
-        self._bind_workers = 8
+        self._bind_workers = max(1, int(bind_workers))
+        self._max_bind_batch = max(1, int(max_bind_batch))
         # /metrics surface (ref plugin/pkg/scheduler/metrics/): the SLO
         # check reads these from OUTSIDE the process — queue wait under a
         # create burst is not attempt latency, and VERDICT r2 couldn't tell
@@ -111,6 +136,19 @@ class Scheduler:
                       "predicate+priority+allocate time per attempt"))
         self.binding_latency = self.metrics.register(
             Histogram("scheduler_binding_seconds", "bind POST round-trip"))
+        self.bind_batch_size = self.metrics.register(
+            Histogram("scheduler_bind_batch_size",
+                      "binds shipped per bulk request (greedy queue drain)",
+                      buckets=(1, 2, 4, 8, 16, 32, 64, 128)))
+        # bulk-envelope failures falling back to per-pod binds: nonzero
+        # means batching is NOT engaging (authz gap, old apiserver) — the
+        # rate-limited log says why
+        self._bulk_fallbacks_ctr = self.metrics.counter(
+            "scheduler_bulk_bind_fallbacks_total")
+        from ..utils.logutil import RateLimitedReporter
+
+        self._bulk_fallback_reporter = RateLimitedReporter(
+            "scheduler-bulk-bind", window=30.0)
         self._attempts_ctr = self.metrics.counter(
             "scheduler_schedule_attempts_total")
         self._failures_ctr = self.metrics.counter(
@@ -153,7 +191,10 @@ class Scheduler:
             try:
                 self.metrics_server = MetricsServer(
                     self.metrics, port=self._metrics_port,
-                    extra={"scheduler_pending_pods": self.queue.depth},
+                    extra={"scheduler_pending_pods": self.queue.depth,
+                           # backlog visibility during density runs: the
+                           # burst tail IS this queue's depth
+                           "scheduler_bind_queue_depth": self._bind_q.qsize},
                     spans=self.spans,
                     ready_fn=lambda: (self.pods.has_synced()
                                       and self.nodes.has_synced()),
@@ -495,72 +536,160 @@ class Scheduler:
         # carries just the node, and chip IDs must never be dropped
         ext_binder = next((e for e in self.extenders if e.handles_bind), None) \
             if not result.assignments else None
+        binding = t.Binding(
+            target_node=result.node,
+            extended_resource_assignments=result.assignments,
+        )
+        binding.metadata.name = pod.metadata.name
+        binding.metadata.namespace = pod.metadata.namespace
         # SLI stamp: the algorithm (incl. device-ID pick) finished NOW; the
         # binding carries it so registry.bind persists it onto the pod
-        scheduled_at = f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
-        tid = self._pod_trace_id(pod)
+        binding.metadata.annotations[t.SCHEDULED_AT_ANNOTATION] = \
+            f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
+        # async bind (ref scheduler.go:482): don't block the scheduling
+        # loop.  Gang members enqueue back-to-back, so the greedy drain
+        # naturally ships a gang as one bulk request.
+        self._bind_q.put(_BindItem(pod, assumed, binding, result,
+                                   ext_binder, self._pod_trace_id(pod)))
 
-        def do_bind():
-            binding = t.Binding(
-                target_node=result.node,
-                extended_resource_assignments=result.assignments,
-            )
-            binding.metadata.name = pod.metadata.name
-            binding.metadata.namespace = pod.metadata.namespace
-            binding.metadata.annotations[t.SCHEDULED_AT_ANNOTATION] = scheduled_at
-            bind_t0 = time.monotonic()
-            # span active across the POST so the apiserver's bind handling
-            # joins this pod's trace via the propagated header
-            with self.spans.start_span("scheduler.bind", trace_id=tid,
-                                       pod=pod.key(), node=result.node) as sp:
-                try:
-                    if ext_binder is not None:
-                        ext_binder.bind(pod.metadata.namespace,
-                                        pod.metadata.name,
-                                        pod.metadata.uid, result.node)
+    # ---------------------------------------------------------- bind workers
+
+    def _bind_success(self, item: _BindItem):
+        self._clear_nomination_for(item.pod.key())
+        self.recorder.event(
+            item.pod, "Normal", "Scheduled",
+            f"assigned to {item.result.node}"
+            + (f" devices={item.result.assignments}"
+               if item.result.assignments else ""),
+        )
+
+    def _bind_failed(self, item: _BindItem, err, sp=None):
+        """Shared failure handling for singleton and bulk binds: forget the
+        assumption; terminal placement races (Conflict/NotFound) stay
+        forgotten while retryable failures (5xx, extender, transport — the
+        bind may or may not have landed; a re-bind racing a landed one
+        answers Conflict, absorbed above) also re-queue with backoff."""
+        self.cache.forget_pod(item.assumed)
+        if sp is not None:
+            sp.annotate(failure=str(err))
+        self.recorder.event(item.pod, "Warning", "FailedBinding", str(err))
+        if not isinstance(err, (Conflict, NotFound)):
+            self.queue.add_backoff(item.pod.key(), item.pod.spec.priority)
+
+    def _bind_one(self, item: _BindItem):
+        """Ship one bind alone: the extender-delegation path, a batch of
+        one, or the per-item fallback when a bulk request's envelope
+        failed."""
+        pod, result = item.pod, item.result
+        bind_t0 = time.monotonic()
+        # span active across the POST so the apiserver's bind handling
+        # joins this pod's trace via the propagated header
+        with self.spans.start_span("scheduler.bind", trace_id=item.tid,
+                                   pod=pod.key(), node=result.node) as sp:
+            try:
+                if item.ext_binder is not None:
+                    item.ext_binder.bind(pod.metadata.namespace,
+                                         pod.metadata.name,
+                                         pod.metadata.uid, result.node)
+                else:
+                    self.cs.bind(pod.metadata.namespace, pod.metadata.name,
+                                 item.binding)
+                self.binding_latency.observe(time.monotonic() - bind_t0)
+                self._bind_success(item)
+            except (ApiError, ExtenderError) as e:
+                self._bind_failed(item, e, sp)
+            except Exception as e:  # noqa: BLE001
+                # connection-level failure (e.g. the apiserver was KILLED
+                # mid-request): treated as retryable by _bind_failed —
+                # without the requeue, the assumed-but-unbound pod wedges
+                # forever (found by the apiserver SIGKILL test under load)
+                self._bind_failed(item, f"transport: {e}", sp)
+
+    def _bind_many(self, namespace: str, items: List[_BindItem]):
+        """Ship a drained batch as ONE bulk request; outcomes are per-item.
+        An envelope-level failure (transport, authz, or an apiserver
+        without the batch endpoint) falls back to singleton binds — item
+        state is untouched until its own outcome lands, and the fallback
+        is LOUD (counter + rate-limited log): a cluster silently stuck on
+        per-pod binds would look like an unexplained throughput loss."""
+        import contextlib
+
+        bind_t0 = time.monotonic()
+        fallback_err = None
+        with contextlib.ExitStack() as stack:
+            # one span per pod (each under its own trace id) around the
+            # shared POST — per-pod trace completeness survives batching
+            sps = [stack.enter_context(self.spans.start_span(
+                "scheduler.bind", trace_id=it.tid, pod=it.pod.key(),
+                node=it.result.node, batched=len(items))) for it in items]
+            try:
+                outcomes = self.cs.bind_batch(
+                    namespace, [it.binding for it in items])
+            except Exception as e:  # noqa: BLE001 — envelope, not the binds
+                fallback_err = e
+                outcomes = None
+            if outcomes is not None and len(outcomes) != len(items):
+                fallback_err = RuntimeError(
+                    f"malformed bulk response: {len(outcomes)} results "
+                    f"for {len(items)} bindings")
+                outcomes = None
+            if outcomes is not None:
+                self.binding_latency.observe(time.monotonic() - bind_t0)
+                for it, sp, err in zip(items, sps, outcomes):
+                    if err is None:
+                        self._bind_success(it)
                     else:
-                        self.cs.bind(pod.metadata.namespace, pod.metadata.name,
-                                     binding)
-                    self.binding_latency.observe(time.monotonic() - bind_t0)
-                    self._clear_nomination_for(pod.key())
-                    self.recorder.event(
-                        pod, "Normal", "Scheduled",
-                        f"assigned to {result.node}"
-                        + (f" devices={result.assignments}" if result.assignments else ""),
-                    )
-                except (Conflict, NotFound) as e:
-                    self.cache.forget_pod(assumed)
-                    sp.annotate(failure=str(e))
-                    self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-                except (ApiError, ExtenderError) as e:
-                    self.cache.forget_pod(assumed)
-                    sp.annotate(failure=str(e))
-                    self.recorder.event(pod, "Warning", "FailedBinding", str(e))
-                    self.queue.add_backoff(pod.key(), pod.spec.priority)
-                except Exception as e:  # noqa: BLE001
-                    # connection-level failure (e.g. the apiserver was KILLED
-                    # mid-request): the bind may or may not have landed.
-                    # Forget the assumption and requeue — a re-bind that
-                    # raced a landed one answers Conflict, which the branch
-                    # above absorbs.  Without this, the assumed-but-unbound
-                    # pod wedges forever (found by the apiserver SIGKILL test
-                    # under load).
-                    self.cache.forget_pod(assumed)
-                    sp.annotate(failure=f"transport: {e}")
-                    self.recorder.event(pod, "Warning", "FailedBinding",
-                                        f"transport: {e}")
-                    self.queue.add_backoff(pod.key(), pod.spec.priority)
-
-        # async bind (ref scheduler.go:482): don't block the scheduling loop
-        self._bind_q.put(do_bind)
+                        self._bind_failed(it, err, sp)
+                return
+            for sp in sps:
+                sp.annotate(failure=f"bulk envelope: {fallback_err}")
+        # batch spans are CLOSED here: the per-item fallback opens its own
+        # scheduler.bind spans, so a pod's trace never carries two live
+        # bind spans for one attempt
+        self._bulk_fallbacks_ctr.inc()
+        self._bulk_fallback_reporter.report(
+            f"scheduler: bulk bind of {len(items)} pods failed "
+            f"({fallback_err}); falling back to per-pod binds")
+        for it in items:
+            self._bind_one(it)
 
     def _bind_loop(self):
+        import queue as _queue
+
         while True:
-            fn = self._bind_q.get()
-            if fn is None or self._stop.is_set():
+            item = self._bind_q.get()
+            if item is None or self._stop.is_set():
                 return
+            batch = [item]
+            # greedy drain: everything already queued ships together —
+            # batch size adapts to backlog (1 under light load, the whole
+            # burst tail under a create storm)
+            while len(batch) < self._max_bind_batch:
+                try:
+                    nxt = self._bind_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    self._bind_q.put(None)  # keep shutdown sentinel for peers
+                    break
+                batch.append(nxt)
+            self.bind_batch_size.observe(len(batch))
             try:
-                fn()
+                singles = [it for it in batch if it.ext_binder is not None]
+                bulk = [it for it in batch if it.ext_binder is None]
+                for it in singles:  # extender wire shape: one pod per call
+                    self._bind_one(it)
+                if len(bulk) == 1:
+                    self._bind_one(bulk[0])
+                elif bulk:
+                    by_ns: Dict[str, List[_BindItem]] = defaultdict(list)
+                    for it in bulk:
+                        by_ns[it.pod.metadata.namespace].append(it)
+                    for ns, group in by_ns.items():
+                        if len(group) == 1:
+                            self._bind_one(group[0])
+                        else:
+                            self._bind_many(ns, group)
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
 

@@ -24,6 +24,11 @@ def main():
     ap.add_argument("--port", type=int, default=0,
                     help="TCP port (used when --socket is not given)")
     ap.add_argument("--wal", default="", help="write-ahead log for durability")
+    ap.add_argument("--wal-sync", default="batch",
+                    choices=("none", "batch", "always"),
+                    help="WAL durability: one fsync per group commit "
+                         "(batch, default), per record (always), or page-"
+                         "cache only (none — loses the host-crash window)")
     ap.add_argument("--tls-cert-file", default="")
     ap.add_argument("--tls-key-file", default="")
     ap.add_argument("--client-ca-file", default="",
@@ -74,7 +79,8 @@ def main():
         standby.stop()
         return
 
-    store = Store(global_scheme.copy(), wal_path=args.wal or None)
+    store = Store(global_scheme.copy(), wal_path=args.wal or None,
+                  wal_sync=args.wal_sync)
     server = StoreServer(store, address,
                          tls_cert_file=args.tls_cert_file,
                          tls_key_file=args.tls_key_file,

@@ -12,15 +12,24 @@ is that layer:
   decoding anything — the HTTP layer pairs them with the scheme's
   once-per-revision serialization cache, so a read costs a dict lookup,
   not a decode+encode.
-- Feeding has two modes.  An IN-PROCESS Store feeds the cache
+- Feeding has two modes, both BATCHED: one feed delivery = one group
+  commit's worth of events, applied under ONE cache-lock acquisition with
+  freshness advanced once per batch.  An IN-PROCESS Store feeds the cache
   synchronously from its commit path (`add_commit_hook`): the cache is
   never behind the store, reads are read-your-writes by construction, and
   there is no pump thread to wake per commit (a per-commit thread wakeup
   measured ~35% of write throughput on the GIL).  A REMOTE store
   (StoreServer over a socket) is fed the reference way: one internal
-  watch (prefix "/registry/") drained by a pump thread, with `wait_fresh`
-  blocking reads until the cache has applied every revision the store had
-  committed when the read arrived (cacher.go's waitUntilFreshAndBlock).
+  watch (prefix "/registry/") drained by a pump thread.  `wait_fresh`
+  blocks reads until the cache has caught up to a freshness target
+  (cacher.go's waitUntilFreshAndBlock); with a stream that carries
+  progress revisions on its heartbeats (StoreServer watches — the etcd
+  progress-notify analog) the target comes from the highest revision this
+  apiserver's RemoteStore has OBSERVED in responses, so reads are
+  read-your-writes for writes through this apiserver and progress-bounded
+  for peers' writes WITHOUT a current_revision round-trip per GET/LIST
+  (upstream's consistent-list-from-cache semantics).  Feeds without
+  progress support keep the strict current_revision target.
   `CacheNotReady` sends callers to the authoritative store path.
 - Watches resume from the cache's own history window; resuming below the
   floor raises TooOldResourceVersion (HTTP 410 upstairs) and the client
@@ -106,8 +115,16 @@ class Cacher:
         self._feed = None
         self._sync = (hasattr(store, "add_commit_hook")
                       and not force_watch_feed)
+        # pump mode: True once the feed proves it carries progress
+        # revisions on heartbeats (RemoteWatcher) — wait_fresh then skips
+        # the per-read current_revision RPC
+        self._stream_progress = False
         self.reseeds = 0
         self.watch_evictions = 0
+        # fan-out coalescing economics (mutated under _cond): one wakeup
+        # may deliver a whole batch — wakeups/events < 1.0 under burst
+        self.watch_wakeups = 0
+        self.watch_events = 0
         # eviction can fire from a replay thread that holds no cache lock
         self._evict_lock = locksan.make_lock("storage.Cacher._evict_lock")
         self._thread: Optional[threading.Thread] = None
@@ -118,7 +135,7 @@ class Cacher:
         if self._sync:
             # hook FIRST so no commit is missed; the seed then applies any
             # records that raced in between hook and list
-            self._store.add_commit_hook(self._on_commit)
+            self._store.add_commit_hook(self._on_commit_batch)
             entries, rev = self._store.list_raw(self._prefix)
             self._seed(entries, rev)
             self._ready.set()
@@ -131,7 +148,7 @@ class Cacher:
     def stop(self):
         self._stopping.set()
         if self._sync:
-            self._store.remove_commit_hook(self._on_commit)
+            self._store.remove_commit_hook(self._on_commit_batch)
         feed = self._feed
         if feed is not None:
             feed.stop()
@@ -170,51 +187,60 @@ class Cacher:
             self._rev = rev
             self._compacted_rev = rev
             pending, self._pending_records = self._pending_records, None
-            for p_rev, typ, key, obj in pending or ():
-                if p_rev > rev:
-                    self._apply_locked(p_rev, typ, key, obj,
-                                       WatchEvent(typ, obj))
+            raced = [r for r in (pending or ()) if r[0] > rev]
+            if raced:
+                self._apply_batch_locked(raced)
             self._cond.notify_all()
         return stale
 
-    def _on_commit(self, rev: int, typ: str, key: str, obj: Dict[str, Any]):
+    def _on_commit_batch(self, records: List[tuple]):
         """Synchronous sink: runs inside the store's commit critical
-        section, so the cache is fresh the moment the write returns."""
-        if not key.startswith(self._prefix):
+        section with one GROUP COMMIT's records, so the cache is fresh the
+        moment the write returns — one cache-lock acquisition, one
+        freshness advance, one wakeup per watcher for the whole batch."""
+        records = [r for r in records if r[2].startswith(self._prefix)]
+        if not records:
             return
         with self._cond:
             if self._pending_records is not None:  # hook beat the seed
-                self._pending_records.append((rev, typ, key, obj))
+                self._pending_records.extend(records)
                 return
-            self._apply_locked(rev, typ, key, obj, WatchEvent(typ, obj))
+            self._apply_batch_locked(records)
+            self._cond.notify_all()
 
-    def _apply_locked(self, rev: int, typ: str, key: str,
-                      obj: Dict[str, Any], ev: WatchEvent):
-        """Must hold _cond: fold one commit into the view and fan out."""
-        if typ == DELETED:
-            self._data.pop(key, None)
-            coll = self._by_collection.get(_collection_of(key))
-            if coll is not None:
-                coll.discard(key)
-        else:
-            self._data[key] = (rev, obj)
-            self._by_collection.setdefault(
-                _collection_of(key), set()).add(key)
-        self._history.append((rev, typ, key, obj))
+    def _apply_batch_locked(self, records: List[tuple]):
+        """Must hold _cond: fold one batch into the view and fan out with
+        ONE push per matching watcher (events shared across watchers).
+        Callers notify _cond once per batch."""
+        events = []
+        for rev, typ, key, obj in records:
+            if typ == DELETED:
+                self._data.pop(key, None)
+                coll = self._by_collection.get(_collection_of(key))
+                if coll is not None:
+                    coll.discard(key)
+            else:
+                self._data[key] = (rev, obj)
+                self._by_collection.setdefault(
+                    _collection_of(key), set()).add(key)
+            self._history.append((rev, typ, key, obj))
+            if rev > self._rev:
+                self._rev = rev
+            events.append((key, WatchEvent(typ, obj)))
         if len(self._history) > self._history_limit:
             drop = len(self._history) - self._history_limit
             self._compacted_rev = self._history[drop - 1][0]
             del self._history[:drop]
-        if rev > self._rev:
-            self._rev = rev
         evicted = False
         for w in self._watchers:
-            if key.startswith(w.prefix):
-                w._push(ev)  # SHARED event: one fan-out per commit
+            evs = [ev for key, ev in events if key.startswith(w.prefix)]
+            if evs:
+                w._push_batch(evs)
+                self.watch_wakeups += 1
+                self.watch_events += len(evs)
             evicted = evicted or w.evicted
         if evicted:
             self._watchers = [w for w in self._watchers if not w.evicted]
-        self._cond.notify_all()
 
     # ------------------------------------------------- pump (remote store)
 
@@ -232,6 +258,9 @@ class Cacher:
                     return
                 continue
             self._feed = feed
+            # a feed that carries progress revisions (RemoteWatcher over a
+            # StoreServer stream) lets wait_fresh go RPC-free
+            self._stream_progress = hasattr(feed, "progress_rev")
             stale = self._seed(entries, rev)
             for w in stale:
                 # watchers from the previous epoch may have a gap: 410
@@ -241,37 +270,57 @@ class Cacher:
                 w._evict(note=False)
             self._ready.set()
             while not self._stopping.is_set():
-                ev = feed.next_timeout(1.0)
-                if ev is None:
+                evs = feed.next_batch_timeout(1.0)
+                if evs is None:
                     if feed._stopped.is_set() or getattr(feed, "closed", False):
                         break  # upstream ended: reseed
                     continue
-                if not self._apply(ev):
+                if not evs:
+                    # progress-only wakeup: the stream proved the store is
+                    # at progress_rev with nothing in flight — advance
+                    # freshness so waiters unblock without an event
+                    self._note_progress(getattr(feed, "progress_rev", 0))
+                    continue
+                if not self._apply_batch(evs):
                     break  # unmappable event (unknown kind): reseed
             feed.stop()
             if not self._stopping.is_set():
                 self._stopping.wait(0.05)  # tiny backoff between reseeds
 
-    def _apply(self, ev: WatchEvent) -> bool:
-        """Pump-side: fold a remote watch event (no key on the wire).
-        Returns False when the event cannot be mapped to a key — a kind
-        this scheme doesn't know yet (CRD racing its registration on a
-        peer apiserver).  Silently dropping it would leave a permanent
-        hole in the view and stall freshness; the pump reseeds instead —
-        the seed path ships keys verbatim, so it is kind-agnostic."""
-        d = ev.object
-        meta = d.get("metadata") or {}
-        try:
-            rev = int(meta.get("resourceVersion") or 0)
-        except (TypeError, ValueError):
-            return True  # malformed event: ignore, don't reseed-loop
+    def _note_progress(self, rev: int):
         if not rev:
-            return True
-        key = key_for_dict(self._scheme, d)
-        if key is None:
-            return False
+            return
         with self._cond:
-            self._apply_locked(rev, ev.type, key, d, ev)
+            if rev > self._rev:
+                self._rev = rev
+                self._cond.notify_all()
+
+    def _apply_batch(self, evs: List[WatchEvent]) -> bool:
+        """Pump-side: fold a batch of remote watch events (no key on the
+        wire) under ONE cache-lock acquisition.  Returns False when an
+        event cannot be mapped to a key — a kind this scheme doesn't know
+        yet (CRD racing its registration on a peer apiserver).  Silently
+        dropping it would leave a permanent hole in the view and stall
+        freshness; the pump reseeds instead — the seed path ships keys
+        verbatim, so it is kind-agnostic."""
+        records = []
+        for ev in evs:
+            d = ev.object
+            meta = d.get("metadata") or {}
+            try:
+                rev = int(meta.get("resourceVersion") or 0)
+            except (TypeError, ValueError):
+                continue  # malformed event: ignore, don't reseed-loop
+            if not rev:
+                continue
+            key = key_for_dict(self._scheme, d)
+            if key is None:
+                return False
+            records.append((rev, ev.type, key, d))
+        if records:
+            with self._cond:
+                self._apply_batch_locked(records)
+                self._cond.notify_all()
         return True
 
     # ---------------------------------------------------------------- reads
@@ -288,12 +337,24 @@ class Cacher:
             raise CacheNotReady("watch cache not seeded yet")
         if self._sync:
             return
-        # Pump mode pays one current_revision round-trip per read for
-        # strict read-your-writes.  The reference avoids this with watch
-        # bookmarks/progress-notify from the stream itself; teaching the
-        # store watch protocol to carry its revision on heartbeats would
-        # let this wait go RPC-free (ROADMAP open item).
-        target = self._store.current_revision()
+        seen = getattr(self._store, "last_seen_revision", None)
+        if self._stream_progress and seen is not None:
+            # RPC-free freshness (the etcd progress-notify analog): the
+            # target is the highest revision THIS apiserver's store client
+            # has observed in any response — strict read-your-writes for
+            # writes through this apiserver; peers' writes are bounded by
+            # stream latency plus the progress heartbeat, the same
+            # staleness upstream's watch-cache reads carry.
+            target = seen()
+        else:
+            # no progress on this stream: strict freshness via one
+            # current_revision round-trip per read (cheap for an
+            # in-process store in forced-pump mode, the only such feed)
+            target = self._store.current_revision()
+        self._wait_rev_locked_entry(target, timeout)
+
+    def _wait_rev_locked_entry(self, target: int, timeout: float):
+        """Block until the cache has applied revision `target`."""
         deadline = time.monotonic() + timeout
         with self._cond:
             while self._rev < target:
@@ -352,6 +413,13 @@ class Cacher:
         TooOldResourceVersion and the client relists."""
         limit = self._queue_limit if queue_limit is None else queue_limit
         self.wait_fresh()
+        if since_rev:
+            # the client PROVED since_rev exists by presenting it (a list
+            # rv, a write response) — in progress-tracked pump mode the
+            # wait_fresh target can lag a PEER apiserver's write, and
+            # registering below since_rev would replay rev <= since_rev
+            # events as duplicates when the stream catches up
+            self._wait_rev_locked_entry(since_rev, self._fresh_timeout)
         replay: List[Tuple[int, str, str, Dict[str, Any]]] = []
         with self._cond:
             if since_rev and since_rev < self._compacted_rev:

@@ -20,6 +20,7 @@ import queue
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ..machinery import Conflict, NotFound, WatchEvent
@@ -55,13 +56,25 @@ def _parse_addresses(address) -> List[Union[str, Tuple[str, int]]]:
 
 class RemoteWatcher:
     """Iterator over WatchEvents from a dedicated store connection;
-    duck-types storage.store.Watcher (incl. next_timeout/stop)."""
+    duck-types storage.store.Watcher (incl. next_timeout/
+    next_batch_timeout/stop).
+
+    Batch frames ({"events": [...]}) arrive as ONE queue wakeup; progress
+    heartbeats ({"progress": {"rev": N}}) update `progress_rev` (the
+    highest store revision the stream has proven fully delivered — the
+    etcd progress-notify analog the remote cacher's freshness rides on)
+    and wake `next_batch_timeout` with an EMPTY list so the consumer can
+    advance freshness without waiting out its poll timeout."""
 
     def __init__(self, conn, f):
         self._conn = conn
         self._f = f
-        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        # items: a non-empty List[WatchEvent], a ("progress",) sentinel,
+        # or None (EOF)
+        self._q: "queue.Queue[Optional[list]]" = queue.Queue()
+        self._buf: "deque[WatchEvent]" = deque()  # consumer thread only
         self._stopped = threading.Event()
+        self.progress_rev = 0
         # closed=True means the stream is DEAD (store gone), not idle —
         # consumers must distinguish this from a heartbeat timeout or a
         # store restart would leave every watch silently stalled forever
@@ -70,17 +83,30 @@ class RemoteWatcher:
                              name="remote-store-watch")
         t.start()
 
+    _PROGRESS = ["progress"]  # shared sentinel; identity-compared
+
     def _pump(self):
         try:
             for line in self._f:
                 line = line.strip()
                 if not line:
-                    continue  # heartbeat
+                    continue  # legacy heartbeat
                 frame = json.loads(line)
                 ev = frame.get("event")
-                if ev is None:
+                if ev is not None:
+                    self._q.put([WatchEvent(ev["type"], ev["object"])])
                     continue
-                self._q.put(WatchEvent(ev["type"], ev["object"]))
+                evs = frame.get("events")
+                if evs is not None:
+                    self._q.put([WatchEvent(e["type"], e["object"])
+                                 for e in evs])
+                    continue
+                prog = frame.get("progress")
+                if prog is not None:
+                    rev = int(prog.get("rev") or 0)
+                    if rev > self.progress_rev:
+                        self.progress_rev = rev
+                    self._q.put(self._PROGRESS)
         except (OSError, ValueError):
             pass
         finally:
@@ -104,20 +130,64 @@ class RemoteWatcher:
         return self
 
     def __next__(self) -> WatchEvent:
-        ev = self._q.get()
-        if ev is None or self._stopped.is_set():
-            raise StopIteration
-        return ev
+        while True:
+            if self._buf:
+                return self._buf.popleft()
+            item = self._q.get()
+            if item is None or self._stopped.is_set():
+                raise StopIteration
+            if item is self._PROGRESS:
+                continue
+            self._buf.extend(item)
 
     def next_timeout(self, timeout: float) -> Optional[WatchEvent]:
-        try:
-            ev = self._q.get(timeout=timeout)
-        except queue.Empty:
-            return None
-        if ev is None:
-            self._stopped.set()
-            return None
-        return ev
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._buf:
+                return self._buf.popleft()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                return None
+            if item is None:
+                self._stopped.set()
+                return None
+            if item is self._PROGRESS:
+                continue  # progress_rev already updated by the pump
+            self._buf.extend(item)
+
+    def next_batch_timeout(self, timeout: float) -> Optional[list]:
+        """One batch of events, [] on a progress-only wakeup, None on
+        timeout/stream-end."""
+        if not self._buf:
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                return None
+            if item is None:
+                self._stopped.set()
+                return None
+            if item is self._PROGRESS:
+                return []
+            self._buf.extend(item)
+        # drain whatever else already arrived — one apply per wakeup
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._q.put(None)
+                break
+            if nxt is self._PROGRESS:
+                continue
+            self._buf.extend(nxt)
+        out = list(self._buf)
+        self._buf.clear()
+        return out
 
 
 class RemoteStore:
@@ -142,6 +212,29 @@ class RemoteStore:
         self._pool: List = []
         self._lock = locksan.make_lock("RemoteStore._lock")
         self._next_id = 0
+        # highest store revision observed in any response from this
+        # client: the remote cacher's RPC-free freshness target (a write
+        # through this client is read-your-writes; see Cacher.wait_fresh)
+        self._seen_rev = 0
+
+    def _note_rev(self, rev) -> None:
+        try:
+            rev = int(rev)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            if rev > self._seen_rev:
+                self._seen_rev = rev
+
+    def _note_obj_rev(self, encoded: Optional[dict]) -> Optional[dict]:
+        if encoded:
+            self._note_rev((encoded.get("metadata") or {})
+                           .get("resourceVersion"))
+        return encoded
+
+    def last_seen_revision(self) -> int:
+        with self._lock:
+            return self._seen_rev
 
     @property
     def address(self):
@@ -284,12 +377,13 @@ class RemoteStore:
     # ------------------------------------------------------------ operations
 
     def create(self, key: str, obj) -> Any:
-        return self._scheme.decode(
+        return self._scheme.decode(self._note_obj_rev(
             self._call("create", {"key": key,
-                                  "obj": self._scheme.encode(obj)}))
+                                  "obj": self._scheme.encode(obj)})))
 
     def get(self, key: str) -> Any:
-        return self._scheme.decode(self._call("get", {"key": key}))
+        return self._scheme.decode(self._note_obj_rev(
+            self._call("get", {"key": key})))
 
     def get_or_none(self, key: str):
         try:
@@ -297,20 +391,44 @@ class RemoteStore:
         except NotFound:
             return None
 
+    def get_raw_many(self, keys: List[str]) -> List[Optional[dict]]:
+        """Encoded wire dicts for N keys (None where absent) in ONE RPC —
+        the read half of a bulk read-modify-CAS (registry.bind_batch)."""
+        items = self._call("get_many", {"keys": keys})["items"]
+        for it in items:
+            self._note_obj_rev(it)
+        return items
+
     def list(self, prefix: str) -> Tuple[List[Any], int]:
         res = self._call("list", {"prefix": prefix})
+        self._note_rev(res["rev"])
         return [self._scheme.decode(o) for o in res["items"]], res["rev"]
 
     def list_raw(self, prefix: str) -> Tuple[List[Tuple[str, int, dict]], int]:
         """(key, rev, encoded obj) entries — the watch cache's seed path.
         The store ships its committed wire form with keys verbatim."""
         res = self._call("list_raw", {"prefix": prefix})
+        self._note_rev(res["rev"])
         return [(k, r, o) for k, r, o in res["items"]], res["rev"]
 
     def update_cas(self, key: str, obj) -> Any:
-        return self._scheme.decode(
+        return self._scheme.decode(self._note_obj_rev(
             self._call("update_cas", {"key": key,
-                                      "obj": self._scheme.encode(obj)}))
+                                      "obj": self._scheme.encode(obj)})))
+
+    def commit_batch(self, ops: List[dict]) -> List[dict]:
+        """N mutations in one RPC and one store group commit.  Same
+        contract as Store.commit_batch: encoded dicts in, per-op
+        {"obj": encoded} or {"error": ApiError instance} out."""
+        res = self._call("commit_batch", {"ops": ops})
+        out = []
+        for r in res["results"]:
+            err = r.get("error")
+            if err is not None:
+                out.append({"error": error_from_wire(err)})
+            else:
+                out.append({"obj": self._note_obj_rev(r["obj"])})
+        return out
 
     def guaranteed_update(self, key: str,
                           update_fn: Callable[[Any], Any]) -> Any:
@@ -325,11 +443,13 @@ class RemoteStore:
                 continue
 
     def delete(self, key: str, expect_rv: str = "") -> Any:
-        return self._scheme.decode(
-            self._call("delete", {"key": key, "expect_rv": expect_rv}))
+        return self._scheme.decode(self._note_obj_rev(
+            self._call("delete", {"key": key, "expect_rv": expect_rv})))
 
     def current_revision(self) -> int:
-        return int(self._call("current_revision"))
+        rev = int(self._call("current_revision"))
+        self._note_rev(rev)
+        return rev
 
     def compact(self, keep_last: int = 1000):
         self._call("compact", {"keep_last": keep_last})

@@ -14,9 +14,20 @@ Wire protocol (newline-JSON over AF_UNIX or TCP, optionally TLS):
   request:  {"id": N, "method": "...", "params": {...}}\n
   response: {"id": N, "result": ...} | {"id": N, "error": {"kind","msg"}}\n
 A `watch` request commits its CONNECTION to streaming: after the ack, the
-server pushes {"event": {"type", "object"}} frames (blank lines are
-heartbeats) until either side closes.  Objects cross as their encoded dict
-form — the scheme lives in the clients.
+server pushes {"event": {"type", "object"}} frames — or, when a group
+commit delivered several at once, ONE {"events": [{"type", "object"},
+...]} frame (one socket write+flush and one client-side queue wakeup per
+batch) — until either side closes.  Heartbeats are {"progress": {"rev":
+N}} frames stamping the store revision (the etcd progress-notify /
+watch-bookmark analog: the client's cacher tracks freshness from the
+stream instead of polling current_revision); blank lines remain accepted
+as legacy heartbeats.  Objects cross as their encoded dict form — the
+scheme lives in the clients.
+
+The `commit_batch` method ships N mutations in one RPC and one store
+group commit ({"ops": [{"op", "key", "obj"?, "expect_rv"?}, ...]} ->
+{"results": [{"obj": ...} | {"error": ...}, ...]}); `get_many` is its
+read half.
 
 Why not raft here: etcd's quorum is WHY the reference gets store HA for
 free, but a correct raft is a project of its own.  This server + WAL gives
@@ -241,6 +252,29 @@ class StoreServer:
         if method == "delete":
             obj = s.delete(p["key"], p.get("expect_rv", ""))
             return self._replicated(s._scheme.encode(obj))
+        if method == "commit_batch":
+            # N mutations, one RPC, one store group commit; per-op errors
+            # cross as wire error dicts (the batch itself never fails as a
+            # unit — it is amortization, not a transaction)
+            results = s.commit_batch(p.get("ops") or [])
+            wire = []
+            max_rev = 0
+            for r in results:
+                err = r.get("error")
+                if err is not None:
+                    wire.append({"error": error_to_wire(err)})
+                else:
+                    max_rev = max(max_rev, int(
+                        r["obj"]["metadata"]["resourceVersion"]))
+                    wire.append({"obj": r["obj"]})
+            if max_rev:
+                # one replication-ack gate for the whole batch: every
+                # standby must reach the batch's highest revision before
+                # any member is acked (same guarantee, 1/N the waits)
+                self._await_replication(max_rev)
+            return {"results": wire}
+        if method == "get_many":
+            return {"items": s.get_raw_many(p.get("keys") or [])}
         if method == "current_revision":
             return s.current_revision()
         if method == "compact":
@@ -255,6 +289,13 @@ class StoreServer:
     # ------------------------------------------------------------ replication
 
     def _replicated(self, encoded: dict) -> dict:
+        """Gate one write's ack on replication (see _await_replication)."""
+        if self._replica_acks:
+            self._await_replication(
+                int(encoded["metadata"]["resourceVersion"]))
+        return encoded
+
+    def _await_replication(self, rev: int):
         """Semi-synchronous replication gate: a write is acked to the
         client only after every attached standby has acked its revision —
         so a SIGKILLed primary cannot take an acknowledged write with it.
@@ -262,15 +303,14 @@ class StoreServer:
         and resyncs) rather than wedging the control plane: the etcd
         answer is quorum; with exactly two members, availability wins."""
         if not self._replica_acks:
-            return encoded
-        rev = int(encoded["metadata"]["resourceVersion"])
+            return
         deadline = time.monotonic() + REPLICATION_ACK_TIMEOUT_SECONDS
         with self._repl_cond:
             while True:
                 laggards = [fd for fd, acked in self._replica_acks.items()
                             if acked < rev]
                 if not laggards:
-                    return encoded
+                    return
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
@@ -288,7 +328,6 @@ class StoreServer:
                 drop = getattr(fd, "drop_conn", None)
                 if drop is not None:
                     drop()
-        return encoded
 
     def _serve_replica(self, conn, f, rid, params):
         """A standby's connection: stream commit records to it, read its
@@ -318,16 +357,19 @@ class StoreServer:
                         "rev": rev}}).encode() + b"\n")
                     f.flush()
                 while not self._stop.is_set() and not feed._stopped.is_set():
-                    rec = feed.next_timeout(WATCH_HEARTBEAT_SECONDS)
-                    if rec is None:
+                    recs = feed.next_batch_timeout(WATCH_HEARTBEAT_SECONDS)
+                    if recs is None:
                         if feed._stopped.is_set():
                             break
                         f.write(b"\n")  # heartbeat
                     else:
-                        rev, typ, key, obj = rec
-                        f.write(json.dumps({"rec": {
-                            "rev": rev, "type": typ, "key": key,
-                            "obj": obj}}).encode() + b"\n")
+                        # per-record frames (the standby applies and acks
+                        # each), ONE write+flush per group commit
+                        f.write(b"".join(
+                            json.dumps({"rec": {
+                                "rev": rev, "type": typ, "key": key,
+                                "obj": obj}}).encode() + b"\n"
+                            for rev, typ, key, obj in recs))
                     f.flush()
             except (BrokenPipeError, ConnectionResetError, OSError,
                     ValueError):
@@ -394,19 +436,33 @@ class StoreServer:
         f.flush()
         try:
             while not self._stop.is_set():
-                ev = w.next_timeout(WATCH_HEARTBEAT_SECONDS)
-                if ev is None:
+                # progress floor read BEFORE the wait: any commit <= this
+                # revision fanned out to w (under the store lock) before
+                # current_revision returned, so a timed-out wait proves the
+                # client has already received everything up to it — safe
+                # to stamp on the heartbeat (etcd progress-notify)
+                rev_floor = self.store.current_revision()
+                evs = w.next_batch_timeout(WATCH_HEARTBEAT_SECONDS)
+                if evs is None:
                     if w.evicted or w._stopped.is_set():
                         # slow remote consumer: end the stream — the
                         # client-side watcher reads EOF as a dead stream
                         # and its cacher reseeds with a fresh list
                         break
-                    f.write(b"\n")  # heartbeat: detect half-open peers
-                else:
+                    f.write(json.dumps(
+                        {"progress": {"rev": rev_floor}}).encode() + b"\n")
+                elif len(evs) == 1:
                     # store watch events already carry the encoded dict form
                     f.write(json.dumps(
-                        {"event": {"type": ev.type, "object": ev.object}})
+                        {"event": {"type": evs[0].type,
+                                   "object": evs[0].object}})
                         .encode() + b"\n")
+                else:
+                    # one frame, one flush, one client-side wakeup per
+                    # group commit
+                    f.write(json.dumps(
+                        {"events": [{"type": ev.type, "object": ev.object}
+                                    for ev in evs]}).encode() + b"\n")
                 f.flush()
         except (BrokenPipeError, ConnectionResetError, OSError, ValueError):
             pass

@@ -271,6 +271,35 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             "ktpu_watch_slow_consumer_evictions_total"),
     } if amx else None
 
+    # write-path economics (group commit, BENCH_r06 delta vs r05): bind
+    # batch-size distribution off the scheduler's /metrics, store batch
+    # occupancy / fan-out coalescing / WAL fsync off the apiserver's
+    commits = amx.get("ktpu_store_commits_total")
+    batches = amx.get("ktpu_store_commit_batches_total")
+    write_path = {
+        "bind_batch_p50": from_metrics(
+            'scheduler_bind_batch_size{quantile="0.5"}'),
+        "bind_batch_p99": from_metrics(
+            'scheduler_bind_batch_size{quantile="0.99"}'),
+        "bind_batches": from_metrics("scheduler_bind_batch_size_count"),
+        "bind_queue_depth_at_scrape": from_metrics(
+            "scheduler_bind_queue_depth"),
+        "store_commits": commits,
+        "store_commit_batches": batches,
+        "store_batch_occupancy": (
+            round(commits / batches, 3) if commits and batches else None),
+        "watch_wakeups_per_event": amx.get(
+            "ktpu_store_watch_wakeups_per_event"),
+        "wal_fsync_p99_s": amx.get(
+            'ktpu_store_wal_fsync_seconds{quantile="0.99"}'),
+        "write_coalesce_waits": amx.get("ktpu_write_coalesce_waits_total"),
+    } if (amx or mx) else None
+    if write_path is not None and sched is not None:
+        # in-process runs read the scheduler's histogram directly
+        write_path["bind_batch_p50"] = sched.bind_batch_size.quantile(0.5)
+        write_path["bind_batch_p99"] = sched.bind_batch_size.quantile(0.99)
+        write_path["bind_batches"] = sched.bind_batch_size.count
+
     result = {
         "nodes": nodes,
         "pods_requested": pods,
@@ -285,6 +314,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "burst_tail": burst_model,
         "multiproc": multiproc,
         "read_path": read_path,
+        "write_path": write_path,
         "steady_state": steady,
         # per-attempt algorithm latency from the scheduler's own histogram —
         # in-process via the object, multiproc via the /metrics endpoint

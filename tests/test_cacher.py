@@ -269,6 +269,93 @@ class TestStoreWatcherBounds:
             th.join(timeout=5)
 
 
+class TestBatchedCommitOrdering:
+    def test_concurrent_batched_and_singleton_commits_ordered(
+            self, store, feed_mode):
+        """Group commit must not reorder or drop events: with N writers
+        landing commits via commit_batch INTERLEAVED with singleton
+        creates, watchers (store + cacher), the replica feed, and the
+        cacher's own history must each observe strict revision order and
+        the complete event set."""
+        c = make_cacher(store, feed_mode)
+        cw = c.watch("/registry/pods/")
+        sw = store.watch("/registry/pods/", queue_limit=0)
+        feed = store.replication_feed()
+        n_writers, per_writer = 4, 5  # batch writers: 5 batches of 3
+        total = n_writers * per_writer * 3 + n_writers * per_writer
+        barrier = threading.Barrier(2 * n_writers)
+
+        def batch_writer(k):
+            barrier.wait()
+            for i in range(per_writer):
+                ops = []
+                for j in range(3):
+                    name = f"bw{k}-{i}-{j}"
+                    pod = make_pod(name)
+                    pod.metadata.uid = f"uid-{name}"
+                    ops.append({"op": "create", "key": key(pod),
+                                "obj": global_scheme.encode(pod)})
+                out = store.commit_batch(ops)
+                assert all("obj" in r for r in out), out
+
+        def single_writer(k):
+            barrier.wait()
+            for i in range(per_writer):
+                pod = make_pod(f"sw{k}-{i}")
+                store.create(key(pod), pod)
+
+        threads = [threading.Thread(target=batch_writer, args=(k,))
+                   for k in range(n_writers)]
+        threads += [threading.Thread(target=single_writer, args=(k,))
+                    for k in range(n_writers)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+
+        def drain_events(w):
+            revs, names = [], set()
+            while len(revs) < total:
+                ev = w.next_timeout(5)
+                if ev is None:
+                    break
+                revs.append(int(ev.object["metadata"]["resourceVersion"]))
+                names.add(ev.object["metadata"]["name"])
+            return revs, names
+
+        want = {f"bw{k}-{i}-{j}" for k in range(n_writers)
+                for i in range(per_writer) for j in range(3)}
+        want |= {f"sw{k}-{i}" for k in range(n_writers)
+                 for i in range(per_writer)}
+        try:
+            for label, w in (("store", sw), ("cacher", cw)):
+                revs, names = drain_events(w)
+                assert len(revs) == total, (label, len(revs))
+                assert revs == sorted(revs) and len(set(revs)) == total, label
+                assert names == want, label
+            # replica feed sees the same commit records, in order
+            rrevs = []
+            while len(rrevs) < total:
+                rec = feed.next_timeout(5)
+                if rec is None:
+                    break
+                rrevs.append(rec[0])
+            assert rrevs == sorted(rrevs) and len(rrevs) == total
+            # the cacher's own view converged: every key present, history
+            # strictly ordered
+            entries, _rev = c.list_raw("/registry/pods/default/")
+            assert {e[2]["metadata"]["name"] for e in entries} == want
+            with c._cond:
+                hrevs = [r for r, _t, _k, _o in c._history]
+            assert hrevs == sorted(hrevs)
+        finally:
+            cw.stop()
+            sw.stop()
+            feed.stop(store)
+            c.stop()
+
+
 class TestDeepHistoryFallback:
     def test_resume_below_cache_window_falls_back_to_store_history(self):
         """A resume below the cache's window but inside the store's deeper

@@ -1,0 +1,405 @@
+"""Write-path smoke guards (tier-1, non-slow).
+
+Group-commit properties the write path must keep as the tree grows:
+
+1. under a 16-writer create storm the store's fan-out coalesces — watch
+   wakeups per delivered event < 1.0 (one queue wakeup serves a whole
+   batch), and group-commit occupancy > 1;
+2. batched and singleton commit paths produce BYTE-IDENTICAL watch
+   frames — group commit is an amortization, never a wire-format fork;
+3. the bulk-bind endpoint binds N pods in one request with per-item
+   outcomes, and the scheduler's bulk path drives it correctly;
+4. remote-store mode serves fresh reads WITHOUT a current_revision
+   round-trip per GET (stream-progress freshness, the etcd
+   progress-notify analog);
+5. the write-path modules stay at zero ktpulint findings.
+"""
+
+import os
+import threading
+import time
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import NotFound
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+
+from tests.helpers import make_node, make_tpu_pod
+from tests.test_machinery import make_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the modules this PR's write path lives in
+WRITEPATH_MODULES = [
+    "kubernetes1_tpu/storage/store.py",
+    "kubernetes1_tpu/storage/server.py",
+    "kubernetes1_tpu/storage/remote.py",
+    "kubernetes1_tpu/storage/cacher.py",
+    "kubernetes1_tpu/apiserver/registry.py",
+    "kubernetes1_tpu/apiserver/server.py",
+    "kubernetes1_tpu/scheduler/scheduler.py",
+]
+
+
+def key(pod):
+    return f"/registry/pods/{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+class TestGroupCommitCoalescing:
+    def test_wakeups_per_event_below_one_under_16_writers(self):
+        """16 concurrent singleton writers must coalesce into shared
+        drains: one fan-out wakeup covers a whole batch, so the
+        wakeups-per-event ratio drops below 1.0 (it is exactly 1.0
+        without group commit)."""
+        store = Store(global_scheme)
+        w = store.watch("/registry/pods/", queue_limit=0)
+        barrier = threading.Barrier(16)
+
+        def writer(k):
+            barrier.wait()
+            for i in range(25):
+                pod = make_pod(f"gc{k}-{i}")
+                store.create(key(pod), pod)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(16)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        assert not any(th.is_alive() for th in threads)
+        try:
+            assert store.watch_events == 400
+            ratio = store.watch_wakeups / store.watch_events
+            assert ratio < 1.0, (
+                f"fan-out not coalescing: {store.watch_wakeups} wakeups "
+                f"for {store.watch_events} events")
+            assert store.commit_count == 400
+            assert store.commit_batches < store.commit_count, \
+                "every batch was a singleton — group commit is not grouping"
+            # the watcher still received every event, in order
+            revs = []
+            while True:
+                batch = w.next_batch_timeout(0.5)
+                if batch is None:
+                    break
+                revs.extend(int(e.object["metadata"]["resourceVersion"])
+                            for e in batch)
+            assert len(revs) == 400 and revs == sorted(revs)
+        finally:
+            w.stop()
+            store.close()
+
+    def test_batched_and_singleton_commits_frame_identically(self):
+        """The same object committed via create() and via commit_batch
+        must produce byte-identical watch frames (separate schemes so the
+        serialization cache cannot mask a divergence)."""
+        s_single = Store(global_scheme.copy())
+        s_batch = Store(global_scheme.copy())
+        w1 = s_single.watch("/registry/pods/")
+        w2 = s_batch.watch("/registry/pods/")
+        try:
+            pod = make_pod("framed")
+            pod.metadata.uid = "uid-framed"
+            pod.metadata.creation_timestamp = "2026-01-01T00:00:00Z"
+            s_single.create(key(pod), pod)
+            out = s_batch.commit_batch([{
+                "op": "create", "key": key(pod),
+                "obj": global_scheme.copy().encode(pod)}])
+            assert "obj" in out[0]
+            ev1 = w1.next_timeout(5)
+            ev2 = w2.next_timeout(5)
+            assert ev1 is not None and ev2 is not None
+            f1 = s_single._scheme.watch_frame_bytes(ev1.type, ev1.object)
+            f2 = s_batch._scheme.watch_frame_bytes(ev2.type, ev2.object)
+            assert f1 == f2, (f1, f2)
+            # and the committed state matches too
+            assert s_single.list_raw("/registry/pods/")[0][0][2] == \
+                s_batch.list_raw("/registry/pods/")[0][0][2]
+        finally:
+            w1.stop()
+            w2.stop()
+            s_single.close()
+            s_batch.close()
+
+
+class TestBulkBindEndpoint:
+    def test_bulk_bind_per_item_outcomes(self):
+        """One bindings:batch request binds every member and reports
+        per-item outcomes — a bogus member fails alone."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.nodes.create(make_node("bb-n1", tpus=8))
+            for i in range(4):
+                cs.pods.create(make_tpu_pod(f"bb-{i}", tpus=1))
+            bindings = []
+            for i in range(4):
+                b = t.Binding(
+                    target_node="bb-n1",
+                    extended_resource_assignments={
+                        f"bb-{i}-tpu": [f"chip-{i}"]})
+                b.metadata.name = f"bb-{i}"
+                b.metadata.namespace = "default"
+                bindings.append(b)
+            ghost = t.Binding(target_node="bb-n1")
+            ghost.metadata.name = "bb-ghost"
+            ghost.metadata.namespace = "default"
+            bindings.append(ghost)
+            outcomes = cs.bind_batch("default", bindings)
+            assert outcomes[:4] == [None] * 4
+            assert isinstance(outcomes[4], NotFound)
+            before_commits = master.store.commit_count
+            for i in range(4):
+                p = cs.pods.get(f"bb-{i}")
+                assert p.spec.node_name == "bb-n1"
+                assert p.spec.extended_resources[0].assigned == [f"chip-{i}"]
+                # SLI stamp merged by the shared binding apply
+                assert t.BOUND_AT_ANNOTATION in p.metadata.annotations
+            assert master.store.commit_count == before_commits  # reads free
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_scheduler_bind_many_uses_bulk_request(self):
+        """The scheduler's _bind_many path drives bindings:batch: all
+        members bound, batch-size histogram fed, failures handled
+        per-item."""
+        from kubernetes1_tpu.scheduler.scheduler import Scheduler, \
+            ScheduleResult, _BindItem
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs)  # not started: no informers needed here
+        try:
+            cs.nodes.create(make_node("sb-n1", tpus=8))
+            items = []
+            for i in range(3):
+                cs.pods.create(make_tpu_pod(f"sb-{i}", tpus=1))
+                pod = cs.pods.get(f"sb-{i}")
+                result = ScheduleResult(
+                    "sb-n1", {f"sb-{i}-tpu": [f"chip-{i}"]})
+                binding = t.Binding(
+                    target_node=result.node,
+                    extended_resource_assignments=result.assignments)
+                binding.metadata.name = pod.metadata.name
+                binding.metadata.namespace = pod.metadata.namespace
+                items.append(_BindItem(pod, pod.clone(), binding, result,
+                                       None, ""))
+            sched._bind_many("default", items)
+            for i in range(3):
+                assert cs.pods.get(f"sb-{i}").spec.node_name == "sb-n1"
+            assert sched.binding_latency.count >= 1
+        finally:
+            sched.stop()
+            cs.close()
+            master.stop()
+
+    def test_write_coalescing_window_correctness(self):
+        """With the opt-in coalescing window armed, a concurrent create
+        burst still lands every write exactly once (the window only
+        delays, never drops or duplicates)."""
+        master = Master(write_coalesce_window=0.003).start()
+        cs_list = [Clientset(master.url) for _ in range(6)]
+        try:
+            barrier = threading.Barrier(6)
+            errs = []
+
+            def creator(k, ccs):
+                barrier.wait()
+                try:
+                    for i in range(5):
+                        ccs.pods.create(make_pod(f"wc{k}-{i}"))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=creator, args=(k, c))
+                       for k, c in enumerate(cs_list)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+            assert not errs
+            pods, _ = cs_list[0].pods.list(namespace="default")
+            assert len([p for p in pods
+                        if p.metadata.name.startswith("wc")]) == 30
+        finally:
+            for c in cs_list:
+                c.close()
+            master.stop()
+
+
+class TestBulkBindAuthz:
+    def test_bulk_bind_requires_binding_subresource_permission(self):
+        """bindings:batch must be gated by the SAME pods/binding
+        permission as a singleton bind: create-pods alone is Forbidden,
+        and a scheduler-shaped grant (pods/binding create) is enough."""
+        from kubernetes1_tpu.machinery import Forbidden
+
+        master = Master(
+            authorization_mode="Node,RBAC",
+            static_tokens={
+                "admin-tok": ("system:admin", ["system:masters"]),
+                "maker-tok": ("podmaker", []),
+                "sched-tok": ("binder", []),
+            }).start()
+        admin_cs = Clientset(master.url, token="admin-tok")
+        maker = Clientset(master.url, token="maker-tok")
+        binder = Clientset(master.url, token="sched-tok")
+        try:
+            cr = t.ClusterRole(rules=[t.PolicyRule(
+                verbs=["create", "get", "list"], resources=["pods"])])
+            cr.metadata.name = "pod-maker"
+            admin_cs.clusterroles.create(cr)
+            crb = t.ClusterRoleBinding(
+                subjects=[t.Subject(kind="User", name="podmaker")],
+                role_ref=t.RoleRef(kind="ClusterRole", name="pod-maker"))
+            crb.metadata.name = "podmaker-binding"
+            admin_cs.clusterrolebindings.create(crb)
+            cr2 = t.ClusterRole(rules=[t.PolicyRule(
+                verbs=["create"], resources=["pods/binding"])])
+            cr2.metadata.name = "pod-binder"
+            admin_cs.clusterroles.create(cr2)
+            crb2 = t.ClusterRoleBinding(
+                subjects=[t.Subject(kind="User", name="binder")],
+                role_ref=t.RoleRef(kind="ClusterRole", name="pod-binder"))
+            crb2.metadata.name = "binder-binding"
+            admin_cs.clusterrolebindings.create(crb2)
+
+            maker.pods.create(make_pod("authz-p0"))
+            b = t.Binding(target_node="some-node")
+            b.metadata.name = "authz-p0"
+            b.metadata.namespace = "default"
+            # create-pods alone must NOT bind (escalation guard)
+            try:
+                maker.bind_batch("default", [b])
+                raise AssertionError("bulk bind allowed without "
+                                     "pods/binding permission")
+            except Forbidden:
+                pass
+            # the binding-subresource grant is sufficient
+            outcomes = binder.bind_batch("default", [b])
+            assert outcomes == [None]
+        finally:
+            maker.close()
+            binder.close()
+            admin_cs.close()
+            master.stop()
+
+
+class TestRemoteFreshnessWithoutRPC:
+    def test_reads_fresh_with_zero_current_revision_calls(self, tmp_path):
+        """--store-address mode: the watch stream's progress revisions
+        (and the client's own observed writes) replace the per-read
+        current_revision round-trip — reads stay fresh with ZERO such
+        RPCs."""
+        from kubernetes1_tpu.storage.server import StoreServer
+
+        store = Store(global_scheme.copy())
+        server = StoreServer(store, str(tmp_path / "store.sock")).start()
+        master = Master(store_address=str(tmp_path / "store.sock")).start()
+        cs = Clientset(master.url)
+        try:
+            calls = []
+            orig = master.store.current_revision
+
+            def counting():
+                calls.append(1)
+                return orig()
+
+            master.store.current_revision = counting
+            for i in range(10):
+                cs.pods.create(make_pod(f"rf-{i}"))
+                # read-your-writes through the same apiserver, no RPC
+                assert cs.pods.get(f"rf-{i}").metadata.name == f"rf-{i}"
+            items, _ = cs.pods.list(namespace="default")
+            assert len([p for p in items
+                        if p.metadata.name.startswith("rf-")]) == 10
+            assert not calls, (
+                f"{len(calls)} current_revision round-trips on the read "
+                f"path — stream-progress freshness regressed")
+        finally:
+            cs.close()
+            master.stop()
+            server.stop()
+
+    def test_progress_heartbeat_advances_freshness(self, tmp_path):
+        """A quiet stream still advances the cache's revision via progress
+        heartbeats (so freshness never wedges on an idle cluster)."""
+        import kubernetes1_tpu.storage.server as srv
+        from kubernetes1_tpu.storage.remote import RemoteStore
+        from kubernetes1_tpu.storage.cacher import Cacher
+
+        old_hb = srv.WATCH_HEARTBEAT_SECONDS
+        srv.WATCH_HEARTBEAT_SECONDS = 0.1
+        store = Store(global_scheme.copy())
+        server = srv.StoreServer(store, str(tmp_path / "hb.sock")).start()
+        rs = RemoteStore(global_scheme.copy(), str(tmp_path / "hb.sock"))
+        cacher = Cacher(rs, global_scheme.copy()).start()
+        try:
+            cacher.wait_fresh(timeout=5)
+            # a commit OUTSIDE the cacher's /registry/ prefix bumps the
+            # store revision without producing any event for this feed —
+            # only the progress heartbeat can carry the new revision
+            oob = make_pod("hb-oob")
+            store.create("/oob/things/hb-oob", oob)
+            target = store.current_revision()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with cacher._cond:
+                    if cacher._rev >= target:
+                        break
+                time.sleep(0.05)
+            with cacher._cond:
+                assert cacher._rev >= target, \
+                    (cacher._rev, target, "progress never arrived")
+            # and event-carried freshness still works alongside progress
+            pod = make_pod("hb-peer")
+            store.create(key(pod), pod)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if cacher.get_raw(key(pod)) is not None:
+                    break
+                time.sleep(0.05)
+            assert cacher.get_raw(key(pod)) is not None
+        finally:
+            cacher.stop()
+            rs.close()
+            server.stop()
+            srv.WATCH_HEARTBEAT_SECONDS = old_hb
+
+
+class TestWritepathLintClean:
+    def test_zero_ktpulint_findings_in_writepath_modules(self):
+        from tools.ktpulint import lint_paths
+
+        findings = lint_paths(
+            [os.path.join(REPO, m) for m in WRITEPATH_MODULES])
+        rendered = "\n".join(
+            os.path.relpath(f.path, REPO) + f":{f.line}: {f.pass_id} "
+            f"{f.message}" for f in findings)
+        assert not findings, f"ktpulint findings:\n{rendered}"
+
+
+class TestWritePathMetricsExported:
+    def test_store_write_metrics_on_apiserver_metrics(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(make_pod("wm-0"))
+            import urllib.request
+
+            raw = urllib.request.urlopen(
+                master.url + "/metrics", timeout=5).read().decode()
+            for name in ("ktpu_store_commits_total",
+                         "ktpu_store_commit_batches_total",
+                         "ktpu_store_batch_occupancy",
+                         "ktpu_store_watch_wakeups_per_event",
+                         "ktpu_store_wal_fsync_seconds",
+                         "ktpu_write_coalesce_waits_total"):
+                assert name in raw, name
+        finally:
+            cs.close()
+            master.stop()
