@@ -147,9 +147,13 @@ def bench_density():
     from kubernetes1_tpu.scheduler import Scheduler
     from tests.helpers import make_tpu_pod
 
+    from kubernetes1_tpu.client import retry as client_retry
     from kubernetes1_tpu.utils.slo import StartupSLITracker
 
     tmp = tempfile.mkdtemp(prefix="ktpu-bench-")
+    # robustness counters (BENCH_r06+): delta the process-wide client
+    # retry counter across this phase only
+    retries_before = client_retry.retries_snapshot()
     master = Master().start()
     cs = Clientset(master.url)
     sched = Scheduler(cs)
@@ -248,6 +252,20 @@ def bench_density():
         "bind_batch_p99": sched.bind_batch_size.quantile(0.99),
         "bind_batches": sched.bind_batch_size.count,
     }
+    # robustness surface (new in r06): retries every client loop took, by
+    # reason; apiserver overload shedding; WAL torn-tail repairs.  A clean
+    # unfaulted density run should show ~zero everywhere — nonzero numbers
+    # here mean the box (or a regression) injected real partial failures
+    # into the benchmark.  The chaos tier (scripts/chaos.py) exercises the
+    # same counters under seeded fault schedules, incl. standby resyncs
+    # (this single-store topology has no standby).
+    robustness = {
+        "client_retries": client_retry.retries_delta(retries_before),
+        "apiserver_shed_total": master.inflight.shed_total,
+        "apiserver_peak_inflight_mutating": master.inflight.peak_mutating,
+        "wal_torn_tail_repairs": getattr(
+            master.store, "wal_torn_tail_repairs", 0),
+    }
 
     sli_phases = sli.report()
     sli.stop()
@@ -277,6 +295,7 @@ def bench_density():
         "encode_cache_misses": enc_misses,
         "watch_evictions": watch_evictions,
         "write_path": write_path,
+        "robustness": robustness,
     }
 
 

@@ -55,6 +55,10 @@ def main():
                     help="local-WAL fsync policy: per group commit "
                          "(batch, default), per record (always), or page-"
                          "cache only (none)")
+    ap.add_argument("--max-inflight-mutating", type=int, default=256,
+                    help="overload shedding: mutating requests beyond "
+                         "this many in flight are refused with 429 + "
+                         "Retry-After (reads are never shed); 0 disables")
     ap.add_argument("--write-coalesce-ms", type=float, default=0.0,
                     help="opt-in write-coalescing window (~1-5ms): under "
                          "a write burst, singleton POST/PUT handlers park "
@@ -101,6 +105,7 @@ def main():
         store_ca_file=args.store_ca_file,
         wal_sync=args.wal_sync,
         write_coalesce_window=args.write_coalesce_ms / 1000.0,
+        max_inflight_mutating=args.max_inflight_mutating,
     )
     master.start()
     print(f"ktpu-apiserver listening on {master.url}", flush=True)

@@ -36,6 +36,7 @@ from ..machinery import (
     TooOldResourceVersion,
     Unauthorized,
 )
+from ..machinery.errors import TooManyRequests
 from ..machinery.scheme import Scheme, global_scheme
 from ..storage import CacheNotReady, Cacher, DEFAULT_WATCH_QUEUE_LIMIT, Store
 from .admission import (
@@ -166,6 +167,68 @@ class _WriteCoalescer:
         return False
 
 
+class _InflightLimiter:
+    """Max-inflight overload shedding (ref: apiserver/pkg/server/filters/
+    maxinflight.go).  Per-verb-class inflight gauges; MUTATING requests
+    past the bound are shed with 429 + Retry-After BEFORE authn/admission/
+    commit — the commit queue never sees them, so a write storm degrades
+    into client backoff instead of queue collapse.  Reads are never shed:
+    they're answered off the watch cache at dict-lookup cost, and a
+    degraded control plane that can still be OBSERVED is the difference
+    between an incident and an outage."""
+
+    MUTATING = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+
+    def __init__(self, max_mutating: int):
+        self.max_mutating = max_mutating  # 0 disables shedding
+        self._lock = locksan.make_lock("Master._inflight_lock")
+        self._inflight = {"mutating": 0, "readonly": 0}
+        self.peak_mutating = 0
+        self.shed_total = 0
+        # refusals since the last successful mutating admit: the gauge
+        # itself is capped at the bound, so THIS is the signal that keeps
+        # growing with overload depth (see retry_after)
+        self._shed_burst = 0
+
+    def _class_of(self, method: str) -> str:
+        return "mutating" if method in self.MUTATING else "readonly"
+
+    def acquire(self, method: str) -> bool:
+        cls = self._class_of(method)
+        with self._lock:
+            if (cls == "mutating" and self.max_mutating
+                    and self._inflight["mutating"] >= self.max_mutating):
+                self.shed_total += 1
+                self._shed_burst += 1
+                return False
+            self._inflight[cls] += 1
+            if cls == "mutating":
+                self._shed_burst = 0  # admitting again: the burst drained
+                if self._inflight["mutating"] > self.peak_mutating:
+                    self.peak_mutating = self._inflight["mutating"]
+        return True
+
+    def release(self, method: str):
+        cls = self._class_of(method)
+        with self._lock:
+            self._inflight[cls] -= 1
+
+    def inflight(self, cls: str) -> int:
+        with self._lock:
+            return self._inflight[cls]
+
+    def retry_after(self) -> float:
+        """Seconds the shed client should wait — a 0.5s base scaled up
+        with the depth of the current shed burst (refusals since the last
+        successful admit; the inflight gauge itself is capped at the
+        bound, so it can't measure how far past it demand is), capped so
+        a burst's retries still land while it drains.  Clients jitter
+        UNDER this floor, so even at the cap the herd spreads."""
+        with self._lock:
+            burst = self._shed_burst
+        return max(0.1, min(2.0, 0.5 * (1.0 + burst / 64.0)))
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "ktpu-apiserver/0.1"
@@ -198,9 +261,12 @@ class _Handler(BaseHTTPRequestHandler):
     def master(self) -> "Master":
         return self.server.master  # type: ignore[attr-defined]
 
-    def _send_raw_json(self, code: int, raw: bytes):
+    def _send_raw_json(self, code: int, raw: bytes,
+                       extra_headers: Optional[Dict[str, str]] = None):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
         self.wfile.write(raw)
@@ -218,10 +284,66 @@ class _Handler(BaseHTTPRequestHandler):
             obj, getattr(self, "_req_version", "")))
 
     def _send_error(self, err: ApiError):
-        self._send_json(err.code, err.to_status())
+        # any error answered before the handler read the request body
+        # (shed, authn, authz, routing) leaves the body bytes in the
+        # keep-alive stream, where the NEXT request on the connection
+        # parses them as a request line (observed as a bogus 400 by the
+        # shed e2e test) — drain before every error response
+        self._drain_unread_body()
+        retry_after = getattr(err, "retry_after", None)
+        # fractional seconds (the ktpu client parses floats; RFC readers
+        # round up) — overload sheds ride this header
+        self._send_raw_json(
+            err.code,
+            json.dumps(err.to_status(), separators=(",", ":")).encode(),
+            extra_headers=({"Retry-After": f"{retry_after:.3f}"}
+                           if retry_after is not None else None))
+
+    # past this, draining a refused request costs more than closing the
+    # connection does — the drain exists to keep keep-alive usable, not
+    # to make the server swallow arbitrary bytes it already rejected
+    MAX_DRAIN_BYTES = 1 << 20
+
+    def _drain_unread_body(self):
+        """Consume the request body if no handler has read it yet (see
+        _send_error).  _body_consumed is reset per request in _handle —
+        the handler instance is reused across keep-alive requests.
+        Chunked reads, bounded: an overload shed must stay CHEAP, so an
+        oversized rejected body closes the connection instead of being
+        read into memory."""
+        if getattr(self, "_body_consumed", True):
+            return
+        self._body_consumed = True
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > self.MAX_DRAIN_BYTES:
+                self.close_connection = True
+                return
+            if not length:
+                return
+            # time-bounded: a client that trickles (or stalls) its body
+            # must not pin this handler thread — shedding exists to FREE
+            # threads.  On stall, give up and close; the response still
+            # goes out (the timeout is restored first).
+            old_timeout = self.connection.gettimeout()
+            self.connection.settimeout(5.0)
+            try:
+                while length > 0:
+                    chunk = self.rfile.read(min(length, 65536))
+                    if not chunk:
+                        self.close_connection = True  # client went away
+                        break
+                    length -= len(chunk)
+            except socket.timeout:
+                self.close_connection = True  # undrained bytes: no reuse
+            finally:
+                self.connection.settimeout(old_timeout)
+        except (OSError, ValueError):
+            pass  # client already gone, or sent a bad length
 
     def _read_body(self) -> Dict[str, Any]:
         length = int(self.headers.get("Content-Length") or 0)
+        self._body_consumed = True
         if length == 0:
             raise BadRequest("request body required")
         try:
@@ -316,6 +438,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
         host, port = addr
         length = int(self.headers.get("Content-Length") or 0)
+        self._body_consumed = True
         body = self.rfile.read(length) if length else None
         conn = http.client.HTTPConnection(host, port, timeout=30)
         try:
@@ -384,6 +507,9 @@ class _Handler(BaseHTTPRequestHandler):
         return resource, "", name, sub
 
     def _handle(self, method: str):
+        # fresh request on a (possibly reused keep-alive) connection: its
+        # body is unread until _read_body / the proxy path consumes it
+        self._body_consumed = False
         # request tracing (utils/spans): a client-sent X-Ktpu-Trace context
         # opens a server span around the whole request so the apiserver leg
         # of a pod's journey lands in /debug/traces under the pod's trace
@@ -401,6 +527,30 @@ class _Handler(BaseHTTPRequestHandler):
             return self._handle_inner(method)
 
     def _handle_inner(self, method: str):
+        # overload shedding FIRST: a mutating request past the inflight
+        # bound is refused before it costs authn, admission, or a commit-
+        # queue slot.  Reads (incl. watches) always pass — they're served
+        # off the cacher.
+        limiter = self.master.inflight
+        if not limiter.acquire(method):
+            err = TooManyRequests(
+                "apiserver overloaded: too many in-flight mutating "
+                "requests; retry after the indicated backoff")
+            err.retry_after = limiter.retry_after()
+            try:
+                # _send_error drains the unread request body before
+                # answering — shedding happens before any read, and the
+                # leftover bytes would poison the keep-alive stream
+                self._send_error(err)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # shed client already gone
+            return
+        try:
+            self._handle_limited(method)
+        finally:
+            limiter.release(method)
+
+    def _handle_limited(self, method: str):
         start = time.monotonic()
         try:
             parts, q = self._route()
@@ -887,7 +1037,22 @@ class _Handler(BaseHTTPRequestHandler):
             f"ktpu_watch_cache_reseeds_total {master.cacher.reseeds}",
             "# TYPE ktpu_write_coalesce_waits_total counter",
             f"ktpu_write_coalesce_waits_total {master.write_coalescer.waits}",
+            # robustness surface (BENCH_r06+ records these next to perf):
+            # overload shedding + per-verb-class inflight gauges
+            "# TYPE ktpu_apiserver_inflight gauge",
+            f'ktpu_apiserver_inflight{{verb="mutating"}} '
+            f'{master.inflight.inflight("mutating")}',
+            f'ktpu_apiserver_inflight{{verb="readonly"}} '
+            f'{master.inflight.inflight("readonly")}',
+            "# TYPE ktpu_apiserver_shed_total counter",
+            f"ktpu_apiserver_shed_total {master.inflight.shed_total}",
         ]
+        from ..client import retry as _client_retry
+
+        # every in-process client loop (informers, controllers, kubelets
+        # in a LocalCluster) shares this counter; remote components export
+        # it from their own /metrics
+        extra.append(_client_retry.retries_total.render().rstrip("\n"))
         # write-path economics (in-process store only; a remote store
         # exports these from its own process): group-commit occupancy and
         # the fan-out coalescing ratio — wakeups-per-event < 1.0 means
@@ -918,6 +1083,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "# TYPE ktpu_store_watch_wakeups_per_event gauge",
                 f"ktpu_store_watch_wakeups_per_event "
                 f"{(wakeups / events) if events else 0.0:.6f}",
+                "# TYPE ktpu_wal_torn_tail_repairs_total counter",
+                f"ktpu_wal_torn_tail_repairs_total "
+                f"{getattr(master.store, 'wal_torn_tail_repairs', 0)}",
                 master.store.wal_fsync_seconds.render().rstrip("\n"),
             ]
         body = (master.metrics.render() + "\n".join(extra) + "\n").encode()
@@ -1186,6 +1354,11 @@ class Master:
                                                # burst (see _WriteCoalescer)
         wal_sync: str = "batch",               # WAL fsync policy
                                                # (none|batch|always)
+        max_inflight_mutating: int = 256,      # overload shedding: mutating
+                                               # requests past this bound
+                                               # get 429 + Retry-After
+                                               # (0 disables; reads are
+                                               # never shed)
     ):
         fasthttp.install()  # idempotent (see class docstring)
         # own copy: CRD registrations must not leak into the process-global
@@ -1203,6 +1376,7 @@ class Master:
             self.store = Store(self.scheme, wal_path=wal_path,
                                wal_sync=wal_sync)
         self.write_coalescer = _WriteCoalescer(write_coalesce_window)
+        self.inflight = _InflightLimiter(max_inflight_mutating)
         self.registry = Registry(self.store, self.scheme)
         # k8s-cacher-analog read layer: GET/LIST/WATCH serve from an
         # in-memory watch-fed view (one store watch and zero decode/encode

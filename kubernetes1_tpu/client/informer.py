@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..machinery import ApiError, TooOldResourceVersion
 from ..utils import locksan, mutsan
+from . import retry as _retry
 from .clientset import Clientset, ResourceClient
 
 
@@ -39,8 +40,14 @@ class SharedInformer:
         self._cache: Dict[str, Any] = {}
         self._lock = locksan.make_rlock("SharedInformer._lock")
         # observability: how often this informer had to fall back to a
-        # full LIST (initial sync, watch stream end, 410-eviction recovery)
+        # full LIST (initial sync, watch stream end, 410-eviction
+        # recovery), and how often it re-dialed a watch stream without
+        # relisting (mid-stream disconnect resumed from the last rv)
         self.relists = 0
+        self.reconnects = 0
+        # unified retry policy: capped full-jitter backoff between relist
+        # attempts, reset whenever a relist succeeds (client/retry.py)
+        self._backoff = _retry.Backoff(base=0.2, factor=2.0, cap=2.0)
         self._handlers: List[Dict[str, Callable]] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -152,9 +159,15 @@ class SharedInformer:
         while not self._stop.is_set():
             try:
                 rv = self._relist()
+                self._backoff.reset()
                 self._watch_loop(rv)
-            except ApiError:
-                self._stop.wait(0.5)
+            except ApiError as e:
+                # capped full-jitter backoff, honoring a 429's Retry-After
+                # as the floor — a shed informer must not hammer an
+                # already-overloaded apiserver in lockstep with its peers
+                _retry.note_retry("informer_relist")
+                self._stop.wait(max(_retry.retry_after_of(e) or 0.0,
+                                    self._backoff.next()))
             except ConnectionError:
                 # unreachable/stopping apiserver: the reflector's answer is
                 # silent backoff-and-retry (reflector.go relist), not a
@@ -162,13 +175,16 @@ class SharedInformer:
                 # a server stops before its watchers.  Deliberately ONLY
                 # connection errors: other OSErrors (fd exhaustion, …) keep
                 # the loud path below.
-                self._stop.wait(0.5)
+                _retry.note_retry("informer_relist")
+                self._stop.wait(self._backoff.next())
             except Exception:  # noqa: BLE001
                 if not self._stop.is_set():
                     traceback.print_exc()
                     self._stop.wait(1.0)
 
     def _watch_loop(self, rv: str):
+        first_stream = True
+        dial_failures = 0
         while not self._stop.is_set():
             try:
                 stream = self.client.watch(
@@ -179,9 +195,30 @@ class SharedInformer:
                 )
             except TooOldResourceVersion:
                 return  # relist
+            except ConnectionError:
+                # watch DIAL failed (server restarting, injected drop): a
+                # few jittered re-dials from the same rv before falling
+                # back to the outer relist path — reflector.go re-watches
+                # from lastSyncResourceVersion, it does not relist on
+                # every blip
+                dial_failures += 1
+                if dial_failures > 3 or self._stop.is_set():
+                    raise
+                _retry.note_retry("watch_redial")
+                self._stop.wait(self._backoff.next())
+                continue
+            dial_failures = 0
+            if not first_stream:
+                # a re-dial after a mid-stream disconnect, resumed from
+                # the last delivered rv — no relist needed, no event lost
+                self.reconnects += 1
+                _retry.note_retry("watch_reconnect")
+            first_stream = False
             self._watch_stream = stream
+            delivered = False
             try:
                 for ev_type, obj_dict in stream:
+                    delivered = True
                     if self._stop.is_set():
                         return
                     obj = self._shared(self.client.scheme.decode(obj_dict))
@@ -206,8 +243,21 @@ class SharedInformer:
             finally:
                 self._watch_stream = None
                 stream.close()
-            # stream ended (server timeout / restart): re-watch from last rv;
-            # outer loop relists if that rv is compacted.
+            # stream ended — server timeout/restart, or a mid-frame cut
+            # (WatchStream.__iter__ absorbs connection errors and ends
+            # the iteration): every event up to the cut was delivered
+            # and applied, so re-watch from the last delivered rv; the
+            # outer loop's relist is only for a compacted rv (410).
+            if delivered:
+                self._backoff.reset()  # productive stream: blip starts small
+            else:
+                # the server ACCEPTED the dial then ended the stream with
+                # nothing on it (cacher reseeding mid-failover, an LB
+                # accepting-then-closing): re-dialing at full speed
+                # hammers exactly the server that is struggling — treat
+                # it like a failed dial and back off (reflector.go backs
+                # off between watch attempts for the same reason)
+                self._stop.wait(self._backoff.next())
 
 
 class InformerFactory:

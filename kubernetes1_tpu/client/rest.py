@@ -17,9 +17,27 @@ import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 from urllib.parse import urlencode, urlparse
 
-from ..utils import fasthttp, spans
+from ..utils import fasthttp, faultline, spans
 
 from ..machinery import ApiError
+from . import retry as _retry
+
+# How many times a request that was shed (HTTP 429 carrying Retry-After)
+# is transparently re-submitted after honoring the server's wait.  A shed
+# is refused BEFORE dispatch, so re-sending is safe even for mutations.
+SHED_RETRIES = 2
+
+
+def _parse_retry_after(resp) -> Optional[float]:
+    """Seconds from a Retry-After header, or None.  Fractional values are
+    accepted (the ktpu apiserver sheds with sub-second waits)."""
+    v = resp.getheader("Retry-After")
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
 
 
 def client_ssl_context(
@@ -59,6 +77,10 @@ class WatchStream:
     def __iter__(self) -> Iterator[Tuple[str, Dict[str, Any]]]:
         try:
             while not self._closed:
+                # fault injection: an injected drop/sever here ends the
+                # stream exactly like a mid-frame connection cut — the
+                # consumer (informer) must reconnect/relist losslessly
+                faultline.check("client.watch")
                 line = self._resp.readline()
                 if not line:
                     return
@@ -164,6 +186,7 @@ class ApiClient:
         return h
 
     def _new_conn(self, timeout) -> http.client.HTTPConnection:
+        faultline.check("client.dial")
         host, port = self._servers[self._active]
         if self.tls:
             conn = http.client.HTTPSConnection(
@@ -212,28 +235,54 @@ class ApiClient:
         if params:
             path = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
         payload = json.dumps(body).encode() if body is not None else None
-        # Retry rules: GET retries on any connection error; mutations retry
-        # only when the failure happened while *sending* (stale keep-alive
-        # connection — the server never saw the request).  A mutation whose
-        # response was lost may have been applied, so re-sending it could
-        # duplicate the action.  Each connection-level failure also fails
-        # over to the next server in the list (HA apiservers).
+        # Retry rules (the unified client/retry policy): GET retries on any
+        # connection error; mutations retry only when the failure happened
+        # while *sending* (stale keep-alive connection — the server never
+        # saw the request).  A mutation whose response was lost may have
+        # been applied, so re-sending it could duplicate the action.  Each
+        # connection-level failure also fails over to the next server in
+        # the list (HA apiservers), with capped full-jitter backoff
+        # between redials.  An HTTP 429 that carries Retry-After is an
+        # overload SHED — refused before dispatch — so it is re-submitted
+        # (mutations included) after honoring the server's wait; a 429
+        # without the header (e.g. a PDB eviction denial) is a real answer
+        # and surfaces immediately.
         attempts = 1 + max(1, len(self._servers))
-        for attempt in range(attempts):
-            idx = self._active
-            sent = False
-            try:
-                conn = self._conn()
-                conn.request(method, path, body=payload, headers=self._headers())
-                sent = True
-                resp = conn.getresponse()
-                raw_body = resp.read()
-                break
-            except (http.client.HTTPException, ConnectionError, OSError):
-                self._reset_conn()
-                self._rotate(idx)
-                if attempt == attempts - 1 or (sent and method != "GET"):
-                    raise
+        if method == "GET":
+            # idempotent: a deeper redial budget (jitter-backed) — a
+            # couple of dropped frames must not fail a read that any
+            # retry would serve; mutations keep the strict
+            # may-have-been-applied rules above
+            attempts = max(4, attempts)
+        backoff = _retry.Backoff(base=0.02, cap=0.5)
+        retry_after: Optional[float] = None
+        for shed_attempt in range(SHED_RETRIES + 1):
+            for attempt in range(attempts):
+                idx = self._active
+                sent = False
+                try:
+                    conn = self._conn()
+                    faultline.check("client.request")
+                    conn.request(method, path, body=payload,
+                                 headers=self._headers())
+                    sent = True
+                    resp = conn.getresponse()
+                    raw_body = resp.read()
+                    break
+                except (http.client.HTTPException, ConnectionError, OSError):
+                    self._reset_conn()
+                    self._rotate(idx)
+                    if attempt == attempts - 1 or (sent and method != "GET"):
+                        raise
+                    _retry.note_retry("transport")
+                    backoff.sleep()
+            retry_after = _parse_retry_after(resp)
+            if (resp.status == 429 and retry_after is not None
+                    and shed_attempt < SHED_RETRIES):
+                _retry.note_retry("shed")
+                backoff.sleep(floor=min(retry_after, 2.0))
+                continue
+            break
         if raw and resp.status < 400:
             return raw_body
         try:
@@ -242,9 +291,14 @@ class ApiClient:
             data = {}
         if resp.status >= 400:
             if data.get("kind") == "Status":
-                raise ApiError.from_status(data)
-            err = ApiError(f"{method} {path}: HTTP {resp.status}")
-            err.code = resp.status
+                err = ApiError.from_status(data)
+            else:
+                err = ApiError(f"{method} {path}: HTTP {resp.status}")
+                err.code = resp.status
+            if retry_after is not None:
+                # callers (informers, controllers) honor this as a
+                # backoff floor — see client/retry.retry_after_of
+                err.retry_after = retry_after
             raise err
         return data
 
@@ -256,9 +310,12 @@ class ApiClient:
         full = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
         last_exc: Optional[Exception] = None
         conn = None
-        for _ in range(max(1, len(self._servers))):
+        backoff = _retry.Backoff(base=0.02, cap=0.5)
+        dials = max(1, len(self._servers))
+        for dial in range(dials):
             idx = self._active
             try:
+                faultline.check("client.watch")
                 conn = self._new_conn(None)
                 conn.request("GET", full, headers=self._headers())
                 resp = conn.getresponse()
@@ -271,6 +328,9 @@ class ApiClient:
                         pass
                 self._rotate(idx)
                 last_exc = e
+                if dial < dials - 1:
+                    _retry.note_retry("watch_dial")
+                    backoff.sleep()
         else:
             raise last_exc  # every server refused the watch dial
         if resp.status >= 400:

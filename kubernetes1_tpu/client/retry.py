@@ -1,23 +1,132 @@
-"""Conflict-retry helper for read-modify-write loops.
+"""Unified client retry policy: backoff, jitter, and error classification.
 
-Ref: client-go staging/src/k8s.io/client-go/util/retry/util.go (RetryOnConflict,
-DefaultRetry backoff). Any client that does get → mutate → update races with
-controllers updating the same object's status; the idiomatic answer is to retry
-the whole read-modify-write on a 409 with a short backoff.
+Ref: client-go staging/src/k8s.io/client-go/util/retry/util.go
+(RetryOnConflict) + util/flowcontrol's backoff managers, and the AWS
+"exponential backoff and jitter" shape (full jitter: sleep ~ U(0, cap)).
+One policy, shared by every client-side loop that talks to an apiserver —
+the REST transport (client/rest.py), informer watch reconnects, the
+scheduler's bind fallback, and the kubelet's status sync — so the answers
+to "which errors retry, and with what backoff" cannot drift per caller:
+
+- TRANSIENT (retry): connection-level failures (incl. injected faults —
+  utils/faultline raises a ConnectionError subclass), HTTP 429 overload
+  sheds, and 5xx server errors.  A 429's ``Retry-After`` is honored as a
+  FLOOR under the jittered backoff.
+- TERMINAL (surface to the caller): everything else — 4xx semantics
+  (Conflict has its own loop below, NotFound/Forbidden mean what they
+  say), and 410 Expired, whose answer is a relist, not a retry.
+
+Jitter is FULL jitter from a seeded stream when a faultline schedule is
+active, so chaos runs replay their sleeps too.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Dict, Optional, TypeVar
 
-from ..machinery.errors import Conflict
+from ..machinery.errors import ApiError, Conflict, TooOldResourceVersion
+from ..utils import faultline
+from ..utils.metrics import Counter
 
 T = TypeVar("T")
 
-# Mirrors client-go's DefaultRetry: 5 steps, 10ms base, factor 1.0 + jitter.
+# Mirrors client-go's DefaultRetry: 5 steps from a 10ms base.
 DEFAULT_STEPS = 5
 DEFAULT_SLEEP = 0.01
+
+# HTTP codes that mean "the server (or the path to it) is momentarily
+# unhappy, the request semantics are fine": overload shed + server errors.
+TRANSIENT_CODES = frozenset({429, 500, 502, 503, 504})
+
+# Every retry any client takes, by reason — scraped into bench.py's
+# density JSON and rendered on the apiserver's /metrics (same process for
+# LocalCluster; remote components export it from their own /metrics).
+retries_total = Counter(
+    "ktpu_client_retries_total", "client retries by reason")
+
+
+def note_retry(reason: str) -> None:
+    retries_total.labels(reason=reason).inc()
+
+
+def retries_snapshot() -> Dict[str, int]:
+    """{reason: count} across every labeled child (bench.py's scrape)."""
+    out: Dict[str, int] = {}
+    for child in retries_total._children_snapshot():
+        for k, v in (child._labels or ()):
+            if k == "reason":
+                out[v] = int(child.value)
+    return out
+
+
+def retries_delta(before: Dict[str, int]) -> Dict[str, int]:
+    """Nonzero {reason: count} growth since a retries_snapshot() —
+    retries_total is process-cumulative, so per-phase reporters
+    (bench.py, scripts/chaos.py) diff against their entry snapshot."""
+    now = retries_snapshot()
+    return {k: v - before.get(k, 0) for k, v in now.items()
+            if v - before.get(k, 0)}
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient-vs-terminal classification (see module docstring)."""
+    if isinstance(exc, TooOldResourceVersion):
+        return False  # 410: relist, don't retry
+    if isinstance(exc, Conflict):
+        return False  # 409: re-GET then retry — retry_on_conflict's job
+    if isinstance(exc, ApiError):
+        return getattr(exc, "code", 500) in TRANSIENT_CODES
+    # connection-level failures, incl. faultline's FaultInjected
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
+def retry_after_of(exc: BaseException) -> Optional[float]:
+    """The server-requested wait (seconds) carried by a 429/503 response
+    (client/rest.py stamps it from the Retry-After header)."""
+    ra = getattr(exc, "retry_after", None)
+    try:
+        return float(ra) if ra is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+class Backoff:
+    """Capped exponential backoff with FULL jitter: attempt n sleeps
+    ~ U(0, min(cap, base * factor**n)).  Full jitter (vs the +/-10%
+    decorrelation client-go uses) is what de-synchronizes a thundering
+    herd of identical clients after a shared failure — the exact shape a
+    shed-and-retry storm has."""
+
+    def __init__(self, base: float = 0.02, factor: float = 2.0,
+                 cap: float = 1.0, rng: Optional[random.Random] = None):
+        self.base = base
+        self.factor = factor
+        self.cap = cap
+        self._rng = rng
+        self._n = 0
+
+    def _random(self) -> random.Random:
+        # seeded stream under an active faultline schedule → deterministic
+        # chaos; the process-global stream otherwise
+        return self._rng or faultline.rng() or random  # type: ignore[return-value]
+
+    def ceiling(self) -> float:
+        return min(self.cap, self.base * self.factor ** self._n)
+
+    def next(self) -> float:
+        d = self._random().uniform(0.0, self.ceiling())
+        self._n += 1
+        return d
+
+    def reset(self) -> None:
+        self._n = 0
+
+    def sleep(self, floor: float = 0.0) -> None:
+        """One jittered backoff sleep; `floor` (a server's Retry-After) is
+        honored as a minimum."""
+        time.sleep(max(floor, self.next()))
 
 
 def retry_on_conflict(
@@ -25,16 +134,42 @@ def retry_on_conflict(
     steps: int = DEFAULT_STEPS,
     sleep: float = DEFAULT_SLEEP,
 ) -> T:
-    """Run fn (a full read-modify-write closure) retrying on Conflict.
+    """Run fn (a full read-modify-write closure) retrying on Conflict,
+    with capped-exponential full-jitter backoff between attempts.
 
     fn must re-GET the object on each attempt; retrying a stale in-memory
     object would conflict forever.
     """
+    backoff = Backoff(base=sleep, factor=2.0, cap=sleep * 16)
     last: Conflict
     for i in range(steps):
         try:
             return fn()
         except Conflict as e:
             last = e
-            time.sleep(sleep * (i + 1))
+            if i < steps - 1:
+                note_retry("conflict")
+                backoff.sleep()
     raise last
+
+
+def call_with_retries(
+    fn: Callable[[], T],
+    steps: int = 4,
+    backoff: Optional[Backoff] = None,
+    reason: str = "transient",
+    classify: Callable[[BaseException], bool] = is_transient,
+) -> T:
+    """Run fn retrying TRANSIENT failures (per `classify`) with jittered
+    backoff, honoring any Retry-After the failure carries as a sleep
+    floor.  Terminal errors — and the last attempt's — surface as-is."""
+    bo = backoff or Backoff()
+    for i in range(steps):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classified right below; terminal re-raises
+            if i == steps - 1 or not classify(e):
+                raise
+            note_retry(reason)
+            bo.sleep(floor=retry_after_of(e) or 0.0)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset, EventRecorder, SharedInformer
+from ..client import retry as _retry
 from ..machinery import ApiError, Conflict, NotFound, now_iso
 from ..machinery.scheme import global_scheme
 from ..utils import locksan
@@ -1471,7 +1472,13 @@ class Kubelet:
         fresh = pod.clone()  # clone-before-mutate: pod is an informer snapshot
         fresh.status = status
         try:
-            self.cs.pods.update_status(fresh)
+            # unified retry policy (client/retry): transient failures —
+            # overload sheds past the transport's own budget, 5xx, link
+            # faults — back off with full jitter and retry in place;
+            # terminal ones fall through to the handlers below
+            _retry.call_with_retries(
+                lambda: self.cs.pods.update_status(fresh),
+                steps=3, reason="status_sync")
             with self._lock:
                 self._last_status[uid] = comparable
         except NotFound:
@@ -1479,6 +1486,10 @@ class Kubelet:
         except Conflict:
             # stale informer copy (e.g. the SLI admitted-at patch just
             # bumped the rv): the next sync retries from the fresh object
+            pass
+        except (ConnectionError, TimeoutError):
+            # transport still down after the retry budget: the next sync
+            # tick retries from a fresh informer snapshot
             pass
         except ApiError:
             traceback.print_exc()

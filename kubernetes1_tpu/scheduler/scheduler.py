@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset, EventRecorder, InformerFactory
+from ..client import retry as _retry
 from ..machinery import ApiError, Conflict, NotFound
 from ..machinery.scheme import global_scheme, to_dict
 from ..utils import locksan
@@ -574,6 +575,13 @@ class Scheduler:
             sp.annotate(failure=str(err))
         self.recorder.event(item.pod, "Warning", "FailedBinding", str(err))
         if not isinstance(err, (Conflict, NotFound)):
+            # unified retry policy accounting: a 429 here means the
+            # apiserver shed the bind under overload (the transport layer
+            # already honored its Retry-After) — the re-queue with backoff
+            # below IS the scheduler's half of that contract
+            _retry.note_retry(
+                "bind_shed" if getattr(err, "code", 0) == 429
+                else "bind_requeue")
             self.queue.add_backoff(item.pod.key(), item.pod.spec.priority)
 
     def _bind_one(self, item: _BindItem):

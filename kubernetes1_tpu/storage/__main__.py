@@ -46,11 +46,37 @@ def main():
     ap.add_argument("--primary-cert-file", default="",
                     help="client cert for mTLS replication to the primary")
     ap.add_argument("--primary-key-file", default="")
+    ap.add_argument("--repl-ack-policy", default="available",
+                    choices=("available", "durable"),
+                    help="replication ack gate on a timed-out standby: "
+                         "'available' (default) acks unprotected and "
+                         "counts it; 'durable' fails the write 503 until "
+                         "a standby covers it — no ack ever outruns the "
+                         "standby (applies to a standby after promotion)")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="serve /metrics on this port (robustness "
+                         "counters: WAL torn-tail repairs, standby "
+                         "resyncs, unprotected acks); -1 disables, "
+                         "0 picks a free port")
     args = ap.parse_args()
     if args.port and not args.socket and not args.client_ca_file:
         print("WARNING: TCP store without --client-ca-file accepts any "
               "client that can reach the port — use mTLS or a unix socket",
               flush=True)
+
+    def serve_metrics(extra):
+        """Optional /metrics for the store process (the apiserver exports
+        the IN-PROCESS store's counters itself; a standalone store/standby
+        needs its own port for the robustness counters)."""
+        if args.metrics_port < 0:
+            return None
+        from ..utils.metrics import MetricsServer, Registry
+
+        srv = MetricsServer(Registry(), port=args.metrics_port, extra=extra)
+        srv.start()
+        print(f"ktpu-store metrics on 127.0.0.1:{srv.port}/metrics",
+              flush=True)
+        return srv
 
     address = args.socket if args.socket else (args.host, args.port)
     if args.standby_of:
@@ -67,16 +93,27 @@ def main():
                                 primary_ca_file=args.primary_ca_file,
                                 primary_cert_file=args.primary_cert_file,
                                 primary_key_file=args.primary_key_file,
+                                repl_ack_policy=args.repl_ack_policy,
                                 ).start()
         shown = standby.address if isinstance(standby.address, str) \
             else f"{standby.address[0]}:{standby.address[1]}"
         print(f"ktpu-store STANDBY serving on {shown} "
               f"(replicating from {args.standby_of})", flush=True)
+        metrics = serve_metrics({
+            "ktpu_standby_resyncs_total": lambda: standby.resyncs,
+            "ktpu_standby_promoted": lambda: int(standby.promoted.is_set()),
+            "ktpu_wal_torn_tail_repairs_total":
+                lambda: standby.store.wal_torn_tail_repairs,
+            "ktpu_store_unprotected_acks_total":
+                lambda: standby.server.unprotected_acks,
+        })
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
         stop.wait()
         standby.stop()
+        if metrics is not None:
+            metrics.stop()
         return
 
     store = Store(global_scheme.copy(), wal_path=args.wal or None,
@@ -84,15 +121,25 @@ def main():
     server = StoreServer(store, address,
                          tls_cert_file=args.tls_cert_file,
                          tls_key_file=args.tls_key_file,
-                         client_ca_file=args.client_ca_file).start()
+                         client_ca_file=args.client_ca_file,
+                         repl_ack_policy=args.repl_ack_policy).start()
     shown = server.address if isinstance(server.address, str) \
         else f"{server.address[0]}:{server.address[1]}"
     print(f"ktpu-store serving on {shown}", flush=True)
+    metrics = serve_metrics({
+        "ktpu_wal_torn_tail_repairs_total":
+            lambda: store.wal_torn_tail_repairs,
+        "ktpu_store_unprotected_acks_total":
+            lambda: server.unprotected_acks,
+        "ktpu_store_commits_total": lambda: store.commit_count,
+    })
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     server.stop()
+    if metrics is not None:
+        metrics.stop()
 
 
 if __name__ == "__main__":

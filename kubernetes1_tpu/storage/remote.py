@@ -26,7 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 from ..machinery import Conflict, NotFound, WatchEvent
 from ..machinery.scheme import Scheme
 from .server import NotPrimary, error_from_wire
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 
 def _parse_addresses(address) -> List[Union[str, Tuple[str, int]]]:
@@ -88,6 +88,10 @@ class RemoteWatcher:
     def _pump(self):
         try:
             for line in self._f:
+                # fault injection: an injected drop here kills the stream
+                # like a mid-frame cut — `closed` is set below and the
+                # cacher reseeds (list + fresh watch), losing nothing
+                faultline.check("store.watch")
                 line = line.strip()
                 if not line:
                     continue  # legacy heartbeat
@@ -319,6 +323,10 @@ class RemoteStore:
             conn, f = pair
             sent = False
             try:
+                # fault injection BEFORE the send: `sent` stays False, so
+                # the existing may-have-been-applied retry rules stay
+                # exactly as safe under chaos as under real dial failures
+                faultline.check("store.rpc")
                 f.write(json.dumps({"id": rid, "method": method,
                                     "params": params or {}}).encode() + b"\n")
                 f.flush()
@@ -468,6 +476,7 @@ class RemoteStore:
                 time.sleep(0.2)  # ride out a failover grace window
             addr = self._addrs[self._active]
             try:
+                faultline.check("store.watch")  # injected dial refusal
                 conn, f = self._connect(self.timeout, addr)
             except OSError as e:
                 last_exc = ConnectionError(f"store {addr} unreachable: {e}")

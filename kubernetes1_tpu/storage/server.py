@@ -37,6 +37,7 @@ keeps L0 behind one interface a raft group could replace later.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import socket
@@ -53,12 +54,24 @@ from ..machinery import (
     TooOldResourceVersion,
 )
 from .store import Store
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 class NotPrimary(ApiError):
     """Raised by a standby store for any client operation before promotion.
     The client (RemoteStore) treats it as 'try the next server' — the
     request was definitely NOT applied, so failover-retry is always safe."""
+
+
+class ReplicationUnavailable(ApiError):
+    """Durable ack policy: replication cannot currently protect this
+    answer (standby absent or lagging past the ack timeout), so the write
+    is NOT acknowledged — it may or may not be durable, and the client's
+    transient-retry policy (503) re-asks until the standby catches up.
+    This is the etcd no-quorum answer: fail the write, never ack a
+    revision a primary death could take with it."""
+
+    code = 503
+    reason = "ServiceUnavailable"
 
 
 _ERROR_KINDS = {
@@ -67,6 +80,7 @@ _ERROR_KINDS = {
     "Conflict": Conflict,
     "TooOldResourceVersion": TooOldResourceVersion,
     "NotPrimary": NotPrimary,
+    "Unavailable": ReplicationUnavailable,
 }
 
 WATCH_HEARTBEAT_SECONDS = 5.0
@@ -96,7 +110,8 @@ class StoreServer:
 
     def __init__(self, store: Store, address: Union[str, Tuple[str, int]],
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 client_ca_file: str = "", primary: bool = True):
+                 client_ca_file: str = "", primary: bool = True,
+                 repl_ack_policy: str = "available"):
         """The store IS the cluster — its socket must never be an
         unauthenticated bypass of the apiserver's authz stack.  Unix
         sockets are chmod 0600 (same-user only, the etcd-on-localhost
@@ -110,9 +125,50 @@ class StoreServer:
         self.primary = primary
         self._threads = []
         self._stop = threading.Event()
+        # every ACCEPTED connection, so stop() can sever them: closing
+        # only the listener left established connections serving (and
+        # ACKING WRITES on) a closed store — an in-process split brain the
+        # chaos suite caught; a killed process severs everything, so stop
+        # must too
+        self._conns: set = set()
+        self._conns_lock = locksan.make_lock("StoreServer._conns_lock")
         # replication: feed -> last acked rev, guarded by _repl_cond
         self._repl_cond = locksan.make_condition(name="StoreServer._repl_cond")
         self._replica_acks: dict = {}
+        # Once a standby has EVER attached, write acks keep waiting for
+        # one even across link flaps (see _await_replication): without
+        # this, every write landing in a reconnect-resync window would be
+        # silently unprotected, and a primary death mid-flap would lose
+        # acknowledged writes — the chaos suite's repl-sever + kill
+        # schedule found exactly that.  Guarded by _repl_cond.
+        self._expect_replicas = False
+        # sticky: has ANY standby ever attached?  Distinguishes "never
+        # configured replication" (unprotected is meaningless — nothing
+        # counts) from "standby died" (every ack until one reattaches is
+        # real exposure and counts).  Guarded by _repl_cond.
+        self._ever_attached = False
+        self.unprotected_acks = 0
+        # "available" (default): an ack-gate timeout counts + logs an
+        # UNPROTECTED ack and availability wins — the 2-member tradeoff
+        # tier-1's laggard contract codifies.  "durable": a timeout FAILS
+        # the request with ReplicationUnavailable instead (503, client
+        # retries); no client-visible answer ever outruns the standby, so
+        # a primary kill cannot lose an acknowledged write — the chaos
+        # suite's repl-sever + kill schedules run in this mode.
+        if repl_ack_policy not in ("available", "durable"):
+            raise ValueError(
+                f"repl_ack_policy must be 'available' or 'durable', "
+                f"got {repl_ack_policy!r}")
+        self.repl_ack_policy = repl_ack_policy
+        if primary and repl_ack_policy == "durable":
+            # durable has no boot window: writes accepted before the
+            # standby's FIRST attach must wait for it (or fail 503) —
+            # arming lazily on attach let pre-attach writes ack with zero
+            # replication, exactly the loss the policy forbids.  A
+            # PROMOTED standby (primary=False here) keeps the lazy arm:
+            # with two members, post-failover writes proceeding alone is
+            # the documented tradeoff.
+            self._expect_replicas = True
         if isinstance(address, str):
             try:
                 os.unlink(address)
@@ -156,6 +212,20 @@ class StoreServer:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            # shutdown, not just close: per-connection threads blocked in
+            # a read must see EOF NOW, and their clients must observe a
+            # dead server — not a half-alive one that still answers
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         self.store.close()
 
     # ----------------------------------------------------------------- serve
@@ -166,6 +236,14 @@ class StoreServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                if self._stop.is_set():
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                self._conns.add(conn)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
@@ -176,7 +254,7 @@ class StoreServer:
             if handshake is not None:
                 handshake()
         except (OSError, ValueError):
-            conn.close()
+            self._drop_conn(conn)
             return
         f = conn.makefile("rwb")
         try:
@@ -219,10 +297,15 @@ class StoreServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._drop_conn(conn)
+
+    def _drop_conn(self, conn):
+        with self._conns_lock:
+            self._conns.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     # The store's decoded-object API re-encodes at the edge; here we use the
     # private encoded form directly to avoid a decode+encode per op.
@@ -233,7 +316,8 @@ class StoreServer:
             # monitoring; everything else must go to the primary
             raise NotPrimary("standby store: not serving client operations")
         if method == "create":
-            obj = s.create(p["key"], s._scheme.decode(p["obj"]))
+            with self._gated_state_errors():
+                obj = s.create(p["key"], s._scheme.decode(p["obj"]))
             return self._replicated(s._scheme.encode(obj))
         if method == "get":
             return s._scheme.encode(s.get(p["key"]))
@@ -247,10 +331,12 @@ class StoreServer:
             entries, rev = s.list_raw(p["prefix"])
             return {"items": [[k, r, o] for k, r, o in entries], "rev": rev}
         if method == "update_cas":
-            obj = s.update_cas(p["key"], s._scheme.decode(p["obj"]))
+            with self._gated_state_errors():
+                obj = s.update_cas(p["key"], s._scheme.decode(p["obj"]))
             return self._replicated(s._scheme.encode(obj))
         if method == "delete":
-            obj = s.delete(p["key"], p.get("expect_rv", ""))
+            with self._gated_state_errors():
+                obj = s.delete(p["key"], p.get("expect_rv", ""))
             return self._replicated(s._scheme.encode(obj))
         if method == "commit_batch":
             # N mutations, one RPC, one store group commit; per-op errors
@@ -267,11 +353,48 @@ class StoreServer:
                     max_rev = max(max_rev, int(
                         r["obj"]["metadata"]["resourceVersion"]))
                     wire.append({"obj": r["obj"]})
-            if max_rev:
+            gate_rev = max_rev
+            if (self.repl_ack_policy == "durable"
+                    and any("error" in w for w in wire)):
+                # a per-op error answer proves state the way a
+                # singleton's does (see _gated_state_errors) — and what
+                # it proves may be a revision ANOTHER connection
+                # committed after this batch's own max, so the gate must
+                # cover the store's current revision, not just the
+                # batch's highest successful commit
+                gate_rev = max(gate_rev, s.current_revision())
+            if gate_rev and (self._replica_acks or self._expect_replicas):
                 # one replication-ack gate for the whole batch: every
                 # standby must reach the batch's highest revision before
-                # any member is acked (same guarantee, 1/N the waits)
-                self._await_replication(max_rev)
+                # any member is acked (same guarantee, 1/N the waits).
+                # The unlocked standby-less check mirrors _replicated's
+                # fast path — group commits are THE hot write path and
+                # must not serialize on _repl_cond when there is no
+                # replica to wait for (same benign race, absorbed by the
+                # locked re-check inside _await_replication).
+                try:
+                    if self._await_replication(gate_rev):
+                        # the one wait covered N successful ops: the gate
+                        # counted its own unprotected ack, the batch's
+                        # other members are just as exposed — count them
+                        # too or the exported exposure measure undercounts
+                        # by N-1 on every transition batch
+                        extra = sum(1 for w in wire if "obj" in w) - 1
+                        if extra > 0:
+                            with self._repl_cond:
+                                self.unprotected_acks += extra
+                except ReplicationUnavailable as e:
+                    # durable: no member of the batch may ack or prove
+                    # state — every writer fails 503 and retries (the
+                    # WAL-failure precedent: fail the whole batch loudly)
+                    unavailable = {"error": error_to_wire(e)}
+                    wire = [unavailable for _ in wire]
+            elif max_rev and self._ever_attached:
+                # degraded window: the batch's successful ops ack
+                # unprotected — count each (see _replicated)
+                with self._repl_cond:
+                    self.unprotected_acks += sum(
+                        1 for w in wire if "obj" in w)
             return {"results": wire}
         if method == "get_many":
             return {"items": s.get_raw_many(p.get("keys") or [])}
@@ -288,52 +411,168 @@ class StoreServer:
 
     # ------------------------------------------------------------ replication
 
+    @contextlib.contextmanager
+    def _gated_state_errors(self):
+        """Durable policy: a conflict-class answer (AlreadyExists /
+        Conflict / NotFound...) PROVES server state to the client — a
+        writer whose first attempt's ack failed at the gate retries,
+        reads AlreadyExists off the doomed primary, and would launder an
+        unreplicated commit into a durable-looking ack.  So such answers
+        ship only once every attached standby has caught up to the
+        revision window they prove; a gate timeout answers 503 instead
+        and the client keeps retrying until the standby has the state
+        too.  Identity under the available policy."""
+        if self.repl_ack_policy != "durable":
+            yield
+            return
+        try:
+            yield
+        except ApiError:
+            self._await_replication(self.store.current_revision())
+            raise
+
     def _replicated(self, encoded: dict) -> dict:
         """Gate one write's ack on replication (see _await_replication)."""
-        if self._replica_acks:
-            self._await_replication(
-                int(encoded["metadata"]["resourceVersion"]))
+        # unlocked fast path for the standby-less deployment: same benign
+        # race the locked re-check in _await_replication absorbs, and it
+        # keeps singleton writes off the shared _repl_cond
+        if not self._replica_acks and not self._expect_replicas:
+            if self._ever_attached:
+                # degraded window (the standby died and the timeout reset
+                # the expectation): EVERY ack until one reattaches goes
+                # out unprotected, not just the writes in flight at the
+                # timeout — count them all or the exported exposure
+                # measure lies to the operator
+                with self._repl_cond:
+                    self.unprotected_acks += 1
+            return encoded
+        self._await_replication(int(encoded["metadata"]["resourceVersion"]))
         return encoded
 
     def _await_replication(self, rev: int):
         """Semi-synchronous replication gate: a write is acked to the
         client only after every attached standby has acked its revision —
         so a SIGKILLed primary cannot take an acknowledged write with it.
-        A standby that stalls past the timeout is DROPPED (it reconnects
-        and resyncs) rather than wedging the control plane: the etcd
-        answer is quorum; with exactly two members, availability wins."""
-        if not self._replica_acks:
-            return
+        If a standby is EXPECTED (one attached before) but currently
+        DISCONNECTED — a link flap mid-resync — the ack WAITS for it to
+        reattach and catch up, under the same timeout; returning
+        immediately there acked writes unprotected exactly when the link
+        was least trustworthy.  What a timeout means is the
+        repl_ack_policy (see __init__): available counts + logs an
+        unprotected ack (laggards dropped, absent standbys stop being
+        expected); durable raises ReplicationUnavailable — no ack, the
+        client retries — the etcd answer is quorum; with exactly two
+        members, this knob is the documented tradeoff.
+
+        Returns True when the ack goes out UNPROTECTED (counted once
+        here): batch callers gating N ops on one wait use it to count
+        the other N-1 exposed acks."""
         deadline = time.monotonic() + REPLICATION_ACK_TIMEOUT_SECONDS
         with self._repl_cond:
+            if not self._replica_acks and not self._expect_replicas:
+                if self._ever_attached:
+                    self.unprotected_acks += 1  # degraded window: exposed
+                    return True
+                return False
             while True:
+                if not self._replica_acks and not self._expect_replicas:
+                    # another writer's timeout already reset the
+                    # expectation (absent/dropped standby): this write
+                    # rides the same unprotected verdict instead of
+                    # burning its own remaining timeout parked on a
+                    # condition that can no longer come true.  It still
+                    # COUNTS — it was in flight during the window and
+                    # goes out unprotected just like the writer that
+                    # timed out (the exported counter is the operator's
+                    # measure of the exposure, not of timeout events).
+                    self.unprotected_acks += 1
+                    return True
                 laggards = [fd for fd, acked in self._replica_acks.items()
                             if acked < rev]
-                if not laggards:
-                    return
+                if self._replica_acks and not laggards:
+                    return False
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._repl_cond.wait(remaining)
-            for fd in laggards:
-                print(f"store: dropping laggard standby (rev {rev} unacked "
-                      f"after {REPLICATION_ACK_TIMEOUT_SECONDS}s)",
+            if not self._replica_acks:
+                if self.repl_ack_policy == "durable":
+                    # expectation stays armed: every answer keeps failing
+                    # 503 until a standby reattaches and catches up —
+                    # write availability is what durable trades away
+                    raise ReplicationUnavailable(
+                        f"rev {rev} unreplicated: standby absent for "
+                        f"{REPLICATION_ACK_TIMEOUT_SECONDS}s")
+                # expected standby never came back inside the window:
+                # stop expecting (writes go back to fast, unprotected
+                # acks) until one reattaches
+                self._expect_replicas = False
+                self.unprotected_acks += 1
+                # wake writers parked in the wait loop above: their
+                # condition can no longer come true and they'd otherwise
+                # each burn their own remaining timeout
+                self._repl_cond.notify_all()
+                print(f"store: acking rev {rev} UNPROTECTED — standby "
+                      f"absent for {REPLICATION_ACK_TIMEOUT_SECONDS}s; "
+                      f"expectation reset until one reattaches",
                       flush=True)
-                self._replica_acks.pop(fd, None)
-                fd._stopped.set()
-                fd._q.put(None)
-                # sever the socket too: a wedged standby (SIGSTOP, full
-                # buffer) leaves send_loop blocked in flush() where the
-                # queue sentinel can't wake it — only shutdown() can
-                drop = getattr(fd, "drop_conn", None)
-                if drop is not None:
-                    drop()
+                return True
+            if self.repl_ack_policy == "durable":
+                # drop the laggards (reconnect + resync from the acked
+                # cursor is their fastest path back to current) but keep
+                # the expectation armed and fail this answer — durable
+                # never converts a timeout into an ack
+                for fd in laggards:
+                    self._drop_laggard_locked(fd, rev)
+                raise ReplicationUnavailable(
+                    f"rev {rev} unreplicated: standby "
+                    f"{REPLICATION_ACK_TIMEOUT_SECONDS}s behind; dropped "
+                    f"for resync")
+            # deliberate drop = deliberate unprotection: the laggard cost
+            # this write the full timeout and availability won — if it was
+            # the LAST standby, writes go back to fast, unprotected acks
+            # until one REATTACHES (re-arming the expectation); leaving
+            # the expectation armed there made every subsequent write pay
+            # the timeout too, a 2s/write wedge the laggard contract
+            # explicitly forbids.  With another healthy standby still
+            # acking, the expectation stays armed: a later flap of ITS
+            # link must keep waiting (disarming globally here silently
+            # reopened the unprotected reconnect window for it).
+            for fd in laggards:
+                self._drop_laggard_locked(fd, rev)
+            self._expect_replicas = bool(self._replica_acks)
+            self._repl_cond.notify_all()  # release parked writers (see above)
+            if not self._replica_acks:
+                # every replica that could have covered this rev was just
+                # dropped: this ack is as unprotected as the absent case
+                self.unprotected_acks += 1
+                print(f"store: acking rev {rev} UNPROTECTED — laggard "
+                      f"standby dropped; expectation reset until one "
+                      f"reattaches", flush=True)
+                return True
+            return False
+
+    def _drop_laggard_locked(self, fd, rev: int):
+        """Detach one laggard replication feed (caller holds _repl_cond)."""
+        print(f"store: dropping laggard standby (rev {rev} unacked "
+              f"after {REPLICATION_ACK_TIMEOUT_SECONDS}s)",
+              flush=True)
+        self._replica_acks.pop(fd, None)
+        fd._stopped.set()
+        fd._q.put(None)
+        # sever the socket too: a wedged standby (SIGSTOP, full
+        # buffer) leaves send_loop blocked in flush() where the
+        # queue sentinel can't wake it — only shutdown() can
+        drop = getattr(fd, "drop_conn", None)
+        if drop is not None:
+            drop()
 
     def _serve_replica(self, conn, f, rid, params):
         """A standby's connection: stream commit records to it, read its
         {"ack": rev} lines back on the same socket (reads here, writes on
         the sender thread — the two directions have independent buffers)."""
-        feed = self.store.replication_feed(int(params.get("since_rev", 0)))
+        since_rev = int(params.get("since_rev", 0))
+        feed = self.store.replication_feed(since_rev)
 
         def drop_conn():
             try:
@@ -343,16 +582,38 @@ class StoreServer:
 
         feed.drop_conn = drop_conn
         with self._repl_cond:
-            self._replica_acks[feed] = 0
+            # the standby resumes from its last ACKED rev, so it durably
+            # holds everything <= since_rev; seeding 0 made a caught-up
+            # reconnector look like a laggard to writers parked on old revs
+            # (2s stall + spurious drop when its final ack died in a sever)
+            self._replica_acks[feed] = since_rev
+            self._expect_replicas = True
+            self._ever_attached = True
+            self._repl_cond.notify_all()  # wake writes parked on the flap
         f.write(json.dumps({"id": rid, "result": {
             "rev": self.store.current_revision()}}).encode() + b"\n")
         f.flush()
+
+        def send(data: bytes):
+            """One replication write, subject to fault injection: an
+            injected sever writes a strict PREFIX (the torn frame the
+            standby's parser chokes on) then raises — the except below
+            tears the session down and the standby reconnect-resyncs
+            from its last acked revision."""
+            exc = None
+            if faultline.active():
+                data, exc = faultline.filter_bytes("repl.link", data)
+            if data:
+                f.write(data)
+            if exc is not None:
+                f.flush()
+                raise exc
 
         def send_loop():
             try:
                 if feed.snapshot is not None:
                     items, rev = feed.snapshot
-                    f.write(json.dumps({"snap": {
+                    send(json.dumps({"snap": {
                         "items": [[k, r, o] for k, r, o in items],
                         "rev": rev}}).encode() + b"\n")
                     f.flush()
@@ -361,11 +622,11 @@ class StoreServer:
                     if recs is None:
                         if feed._stopped.is_set():
                             break
-                        f.write(b"\n")  # heartbeat
+                        send(b"\n")  # heartbeat
                     else:
                         # per-record frames (the standby applies and acks
                         # each), ONE write+flush per group commit
-                        f.write(b"".join(
+                        send(b"".join(
                             json.dumps({"rec": {
                                 "rev": rev, "type": typ, "key": key,
                                 "obj": obj}}).encode() + b"\n"

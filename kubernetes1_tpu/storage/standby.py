@@ -27,6 +27,7 @@ import time
 from typing import Optional, Tuple, Union
 
 from ..machinery.scheme import Scheme, global_scheme
+from ..utils import faultline
 from .server import StoreServer
 from .store import Store
 
@@ -43,7 +44,8 @@ class StandbyServer:
                  tls_cert_file: str = "", tls_key_file: str = "",
                  client_ca_file: str = "",
                  primary_ca_file: str = "", primary_cert_file: str = "",
-                 primary_key_file: str = ""):
+                 primary_key_file: str = "",
+                 repl_ack_policy: str = "available"):
         self.primary_address = primary_address
         self.failover_grace = failover_grace
         # a TLS-enabled primary (TCP+mTLS deployment) needs a TLS dial for
@@ -63,12 +65,31 @@ class StandbyServer:
                                   tls_cert_file=tls_cert_file,
                                   tls_key_file=tls_key_file,
                                   client_ca_file=client_ca_file,
-                                  primary=False)
+                                  primary=False,
+                                  repl_ack_policy=repl_ack_policy)
         self.address = self.server.address
         self.promoted = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # live replication socket, published by _stream_once after a
+        # successful dial; None until then (a standby that never reached
+        # the primary has nothing to sever in stop())
+        self._conn: Optional[socket.socket] = None
         self.last_applied_rev = 0
+        # Resync cursor: the last revision this standby ACKED back to the
+        # primary (ack written AND flushed).  Reconnects resume from here,
+        # not from the store's in-memory revision — under a mid-frame
+        # sever a record can be applied while its ack never leaves the
+        # socket, and resuming from the applied revision would leave the
+        # primary's ack gate waiting on a revision the new session never
+        # re-ships.  Re-shipped records the store already holds are
+        # deduped by apply_replicated, so resuming low is always safe.
+        # Seeded from the local WAL replay (acked in a previous life).
+        self.last_acked_rev = self.store.current_revision()
+        # ktpu_standby_resyncs_total: replication sessions re-established
+        # after a link drop (link flap ≠ promotion — see _primary_dead)
+        self.resyncs = 0
+        self._sessions = 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -81,6 +102,17 @@ class StandbyServer:
 
     def stop(self):
         self._stop.set()
+        # sever the live replication session too: a "stopped" standby
+        # whose consumer thread keeps applying and ACKING the primary's
+        # commits is still vouching for durability it no longer provides
+        # (the same stop-must-sever rule StoreServer.stop() enforces —
+        # the primary must see this standby detach NOW)
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self.server.stop()
 
     def promote(self):
@@ -95,6 +127,10 @@ class StandbyServer:
     # ----------------------------------------------------------- replication
 
     def _dial(self, timeout: float = 5.0, tls: bool = True):
+        # fault injection on EVERY primary-ward dial — replication stream
+        # and liveness probe alike: an injected drop must read as a link
+        # flap (ambiguous), never as the refused death signal
+        faultline.check("repl.link")
         if isinstance(self.primary_address, str):
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(timeout)
@@ -124,13 +160,22 @@ class StandbyServer:
 
     def _stream_once(self):
         """One replication session: handshake, then apply records until the
-        connection drops."""
-        conn = self._dial()
+        connection drops.  Resumes from the last ACKED revision (see
+        last_acked_rev) — the primary re-ships anything applied-but-
+        unacked and apply_replicated dedups it."""
+        conn = self._dial()  # _dial carries the repl.link fault site
+        self._conn = conn  # published so stop() can sever a live session
+        if self._stop.is_set():
+            # stop() raced the dial: it may have missed _conn — sever here
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         try:
             f = conn.makefile("rwb")
             f.write(json.dumps({
                 "id": 1, "method": "replicate",
-                "params": {"since_rev": self.store.current_revision()}})
+                "params": {"since_rev": self.last_acked_rev}})
                 .encode() + b"\n")
             f.flush()
             line = f.readline()
@@ -141,8 +186,15 @@ class StandbyServer:
                 # primary refused (e.g. itself a standby): wait and retry
                 time.sleep(0.2)
                 return
+            self._sessions += 1
+            if self._sessions > 1:
+                self.resyncs += 1
             conn.settimeout(None)  # stream blocks until commits arrive
             for line in f:
+                # consumer-side fault injection: a drop here is the read
+                # half of a mid-frame sever — the session dies, _run
+                # reconnects and resyncs from last_acked_rev
+                faultline.check("repl.link")
                 line = line.strip()
                 if not line:
                     continue  # heartbeat
@@ -161,6 +213,8 @@ class StandbyServer:
                 f.write(json.dumps(
                     {"ack": self.last_applied_rev}).encode() + b"\n")
                 f.flush()
+                # flushed, so the primary will see it: safe resume point
+                self.last_acked_rev = self.last_applied_rev
         finally:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
@@ -174,23 +228,49 @@ class StandbyServer:
     # ------------------------------------------------------ failure detection
 
     def _primary_dead(self) -> bool:
-        """True only when the primary's address refuses connections for the
-        whole grace window.  A successful connect means it's alive (the
-        stream drop was transient): resync instead of promoting."""
-        deadline = time.monotonic() + self.failover_grace
+        """True when the primary's address REFUSES connections for a full,
+        uninterrupted grace window — or when NO probe succeeds at all for
+        a longer hard window.  A successful connect means it's alive (the
+        stream drop was transient): resync instead of promoting.
+        AMBIGUOUS failures — timeouts, resets, injected drops on a
+        flapping link — are NOT the fast death signal: they reset the
+        refused-streak (before this distinction the deadline path
+        promoted after ANY failure mix, so a flaky link could split-brain
+        the pair without the primary ever dying).  But a host that died
+        without an RST — power loss, a partition black-holing SYNs —
+        only ever times out, so an uninterrupted streak of failures of
+        ANY kind for the hard window promotes too: a genuinely flapping
+        link produces interleaved successes, a dead host produces
+        none."""
+        grace = self.failover_grace
+        hard = max(4 * grace, grace + 3.0)
+        refused_since: Optional[float] = None
+        failing_since: Optional[float] = None
         while not self._stop.is_set():
             try:
                 # liveness probe: a bare connect (no TLS) — an accepting
                 # listener means the primary PROCESS is alive even if the
-                # TLS handshake would need the full dial
+                # TLS handshake would need the full dial.  The probe runs
+                # through _dial, so injected link faults hit it too —
+                # exactly the flap that must NOT promote.
                 conn = self._dial(timeout=1.0, tls=False)
                 conn.close()
                 return False
             except (ConnectionRefusedError, FileNotFoundError):
-                pass  # nobody listening: the death signal
+                refused = True  # nobody listening: the death signal
             except OSError:
-                pass  # unreachable: treat like refused, keep probing
-            if time.monotonic() >= deadline:
-                return True
+                refused = False  # unreachable/reset/injected: ambiguous
+            now = time.monotonic()
+            if failing_since is None:
+                failing_since = now
+            if refused:
+                if refused_since is None:
+                    refused_since = now
+                if now - refused_since >= grace:
+                    return True
+            else:
+                refused_since = None
+            if now - failing_since >= hard:
+                return True  # not one successful connect all window: dead
             time.sleep(0.1)
         return False

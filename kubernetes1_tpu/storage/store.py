@@ -47,6 +47,7 @@ import os
 import queue
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -64,7 +65,7 @@ from ..machinery import (
     now_iso,
 )
 from ..machinery.scheme import Scheme
-from ..utils import locksan
+from ..utils import faultline, locksan
 from ..utils.metrics import Histogram
 
 # Keep this many events for watch resume before compaction kicks in.
@@ -436,12 +437,28 @@ class Store:
         self.wal_sync = wal_sync
         self._wal_path = wal_path
         self._wal = None
+        # torn-tail repairs on open (ktpu_wal_torn_tail_repairs_total): a
+        # crash mid-WAL-write leaves a partial record at the tail; replay
+        # detects it (CRC/parse) and truncates it away — the write was
+        # never acknowledged (the batch's writers all error on WAL
+        # failure), so dropping it loses nothing
+        self.wal_torn_tail_repairs = 0
+        # mid-file damage is NOT a torn tail: when valid records follow a
+        # bad line, truncating there would discard acknowledged durable
+        # state — replay skips the bad line(s), keeps everything after,
+        # and counts them here (loud log; the tail rule stays truncate)
+        self.wal_corrupt_records_skipped = 0
+        # failed WAL writes on a LIVE store roll the torn prefix back out
+        # (see _wal_emit) so later batches don't append after garbage
+        self.wal_write_rollbacks = 0
         if wal_path:
             self._replay_wal(wal_path)
-            # block-buffered: the group-commit drain flushes (and fsyncs,
-            # per wal_sync) explicitly ONCE per batch — line buffering
-            # would pay a write syscall per record again
-            self._wal = open(wal_path, "a")
+            # block-buffered binary: the group-commit drain flushes (and
+            # fsyncs, per wal_sync) explicitly ONCE per batch — line
+            # buffering would pay a write syscall per record again; bytes
+            # (not text) so the fault injector can tear mid-record exactly
+            # like a crash does
+            self._wal = open(wal_path, "ab")
 
     # ---------------------------------------------------------------- helpers
 
@@ -449,16 +466,74 @@ class Store:
         with self._lock:
             return self._rev
 
+    @staticmethod
+    def _wal_frame(rec: dict) -> bytes:
+        """One CRC-framed WAL record: `<crc32 hex8>:<json>\\n`.  The CRC
+        covers the JSON payload, so replay can tell a torn tail (crash or
+        full disk mid-write) from a complete record without trusting the
+        JSON parser alone."""
+        payload = json.dumps(rec).encode()
+        return b"%08x:" % zlib.crc32(payload) + payload + b"\n"
+
+    @staticmethod
+    def _parse_wal_frame(line: bytes) -> Optional[dict]:
+        """Decode one WAL line; None means torn/corrupt.  Legacy lines
+        (bare JSON, pre-CRC WALs) stay replayable — their torn tails are
+        caught by the parse alone, as before."""
+        line = line.strip()
+        try:
+            if line.startswith(b"{"):
+                rec = json.loads(line)
+            else:
+                crc, sep, payload = line.partition(b":")
+                if not sep or len(crc) != 8:
+                    return None
+                if int(crc, 16) != zlib.crc32(payload):
+                    return None
+                rec = json.loads(payload)
+            # a record missing its fields is as unusable as an unparsable
+            # one — surface both as torn
+            rec["rev"], rec["type"], rec["key"], rec["obj"]
+            return rec
+        except (ValueError, KeyError, TypeError):
+            return None
+
     def _replay_wal(self, path: str):  # ktpulint: ignore[KTPU001] construction-time, pre-concurrency
         if not os.path.exists(path):
             return
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
+        # offset where the current run of unparsable lines began; a run
+        # still open at EOF is the torn TAIL (truncate — those bytes are a
+        # record that was never acked); a run with valid records AFTER it
+        # is mid-file damage (skip it, keep the later acked records —
+        # truncating there would silently discard durable state)
+        bad_start: Optional[int] = None
+        bad_lines = 0
+        with open(path, "rb") as f:
+            while True:
+                start = f.tell()
+                line = f.readline()
                 if not line:
+                    break
+                if not line.strip():
+                    continue  # blank padding line: harmless
+                rec = self._parse_wal_frame(line)
+                if rec is None:
+                    if bad_start is None:
+                        bad_start = start
+                    bad_lines += 1
                     continue
-                rec = json.loads(line)
-                rev, typ, key, obj = rec["rev"], rec["type"], rec["key"], rec["obj"]
+                if bad_start is not None:
+                    self.wal_corrupt_records_skipped += bad_lines
+                    print(f"store: WAL CORRUPTION mid-file — skipped "
+                          f"{bad_lines} unreadable line(s) at offset "
+                          f"{bad_start} of {path}; later records are "
+                          f"intact and were replayed (NOT truncating — "
+                          f"that would discard acknowledged state)",
+                          flush=True)
+                    bad_start = None
+                    bad_lines = 0
+                rev, typ, key, obj = (rec["rev"], rec["type"], rec["key"],
+                                      rec["obj"])
                 self._rev = max(self._rev, rev)
                 if typ == "NOP":  # snapshot revision pin, no data
                     continue
@@ -472,6 +547,27 @@ class Store:
                     self._by_collection.setdefault(
                         self._collection_of(key), set()
                     ).add(key)
+        if bad_start is not None:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(bad_start)
+            self.wal_torn_tail_repairs += 1
+            print(f"store: WAL torn tail repaired — truncated "
+                  f"{size - bad_start} byte(s) at offset {bad_start} of "
+                  f"{path} (replayed to rev {self._rev}; a standby resync "
+                  f"covers anything newer)", flush=True)
+        # A crash can land after the last record's bytes but before its
+        # trailing newline: the record parses (the CRC covers the JSON,
+        # not the \n) and replays as acked state — but reopening in
+        # append mode would weld the NEXT frame onto the same line,
+        # turning two durable records into one unparsable line a later
+        # replay would truncate or skip.  Restore the frame terminator
+        # before any append can happen.
+        if os.path.getsize(path) > 0:
+            with open(path, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
         # Watches cannot resume across restart below the replayed revision.
         self._compacted_rev = self._rev
 
@@ -575,6 +671,54 @@ class Store:
         self._batch_records.append((rev, typ, key, obj))
         return rev, obj
 
+    def _wal_emit(self, data: bytes):
+        """Write framed WAL bytes, subject to fault injection: an injected
+        `truncate` persists a strict PREFIX (the torn record a crash
+        leaves) and then raises — the batch's writers all error (no
+        silent ack).  A LIVE store that survives the failure (ENOSPC, an
+        injected tear) must not keep appending after the torn bytes —
+        later acked records would land beyond garbage and replay-on-open
+        could not tell them from a torn tail — so the failure path rolls
+        the file back to the pre-write offset.  Only a CRASH mid-write
+        leaves a torn tail for the open-time repair."""
+        exc: Optional[Exception] = None
+        if faultline.active():
+            data, exc = faultline.filter_bytes("wal.write", data)
+        pre = self._wal.tell()
+        try:
+            if data:
+                self._wal.write(data)
+            if exc is not None:
+                self._wal.flush()  # the torn bytes land, as in a crash...
+                raise exc
+            # flush INSIDE the guard: the WAL is block-buffered, so a small
+            # write() merely buffers and the real I/O error (ENOSPC, EIO)
+            # surfaces here — an unguarded flush left torn bytes the next
+            # batch appended after, corrupting an acked record on replay
+            self._wal.flush()
+        except OSError:
+            self._rollback_wal(pre)  # ...then the live store repairs them
+            raise
+
+    def _rollback_wal(self, pre: int):
+        """Best-effort truncate back to the pre-write offset after a
+        failed WAL write.  If the rollback itself fails, replay-on-open
+        still copes: a trailing run of garbage truncates as a torn tail,
+        and garbage followed by later valid records is skipped without
+        truncation."""
+        try:
+            try:
+                self._wal.flush()
+            except OSError:
+                pass  # buffered remainder may be what failed; truncate anyway
+            os.ftruncate(self._wal.fileno(), pre)
+            self._wal.seek(pre)
+            self.wal_write_rollbacks += 1
+        except OSError as e:
+            print(f"store: WAL rollback after failed write ALSO failed "
+                  f"({e}) — open-time replay will skip or truncate the "
+                  f"damage", flush=True)
+
     def _write_wal_locked(self, records: List[tuple]):
         """Must hold lock: one WAL write+flush per batch; fsync per the
         wal_sync policy (see class docstring)."""
@@ -582,17 +726,16 @@ class Store:
             return
         if self.wal_sync == "always":
             for rev, typ, key, obj in records:
-                self._wal.write(json.dumps(
-                    {"rev": rev, "type": typ, "key": key, "obj": obj}) + "\n")
-                self._wal.flush()
+                self._wal_emit(self._wal_frame(
+                    {"rev": rev, "type": typ, "key": key, "obj": obj}))
                 t0 = time.monotonic()
                 os.fsync(self._wal.fileno())
                 self.wal_fsync_seconds.observe(time.monotonic() - t0)
             return
-        self._wal.write("".join(
-            json.dumps({"rev": rev, "type": typ, "key": key, "obj": obj})
-            + "\n" for rev, typ, key, obj in records))
-        self._wal.flush()
+        self._wal_emit(b"".join(
+            self._wal_frame({"rev": rev, "type": typ, "key": key,
+                             "obj": obj})
+            for rev, typ, key, obj in records))
         if self.wal_sync == "batch":
             t0 = time.monotonic()
             os.fsync(self._wal.fileno())
@@ -956,10 +1099,22 @@ class Store:
                 self._compacted_rev = self._history[drop - 1][0]
                 del self._history[:drop]
             records = [(rev, typ, key, obj)]
-            self._write_wal_locked(records)
+            wal_exc: Optional[BaseException] = None
+            try:
+                self._write_wal_locked(records)
+            except OSError as e:  # injected tear / ENOSPC
+                wal_exc = e
+            # fan out even on WAL failure (same rule as _drain_commits):
+            # the in-memory state WAS mutated above and local views must
+            # stay coherent with it
             self._fanout_batch_locked(records)
             self.commit_count += 1
             self.commit_batches += 1
+            if wal_exc is not None:
+                # surface to the replication consumer: it must NOT ack
+                # this record as durable; the reconnect-resync (and a
+                # torn-tail repair on restart) covers the gap
+                raise wal_exc
 
     def apply_snapshot(self, items, rev: int):
         """Standby-side: replace local state with a primary snapshot."""
@@ -976,16 +1131,14 @@ class Store:
                 # rewrite the WAL as a snapshot so a standby restart
                 # replays to the same state
                 self._wal.close()
-                self._wal = open(self._wal_path, "w")
+                self._wal = open(self._wal_path, "wb")
                 for k, (r, obj) in self._data.items():
-                    self._wal.write(json.dumps(
-                        {"rev": r, "type": ADDED, "key": k,
-                         "obj": obj}) + "\n")
+                    self._wal.write(self._wal_frame(
+                        {"rev": r, "type": ADDED, "key": k, "obj": obj}))
                 # deletes can make the store revision exceed every live
                 # item's rev; a NOP record pins it for WAL replay
-                self._wal.write(json.dumps(
-                    {"rev": rev, "type": "NOP", "key": "", "obj": {}})
-                    + "\n")
+                self._wal.write(self._wal_frame(
+                    {"rev": rev, "type": "NOP", "key": "", "obj": {}}))
                 self._wal.flush()
                 if self.wal_sync != "none":
                     os.fsync(self._wal.fileno())

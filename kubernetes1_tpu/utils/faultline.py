@@ -1,0 +1,297 @@
+"""faultline: seeded, deterministic wire-level fault injection.
+
+The reference survives real clusters because every layer assumes the layer
+below it fails *partially* — etcd clients retry with backoff, apiservers
+shed load, watches resume after any disconnect.  This module is the lever
+that makes those partial failures REPRODUCIBLE: every socket/file boundary
+in the framework calls a named *site* hook, and an activated injector
+decides — from a seeded RNG, so the same seed replays the same schedule of
+decisions per site — whether that I/O proceeds, stalls, dies cleanly, or
+dies MID-FRAME.
+
+Activation (either):
+  - environment: ``KTPU_FAULTS="<seed>:<spec>"`` (parsed at import, so
+    spawned server subprocesses inherit faults with zero plumbing);
+  - programmatic: ``faultline.activate(seed, spec)`` / ``deactivate()``
+    (what the chaos suite uses in-process).
+
+Spec grammar (documented in README "Fault injection & recovery")::
+
+    spec  = rule[;rule...]
+    rule  = <site>=<fault>[|<fault>...]
+    fault = <action>[:<param>][@<prob>]
+
+    actions:
+      drop              abort the op before any bytes move (FaultInjected,
+                        a ConnectionError — transport-error handlers fire)
+      delay:<dur>       sleep <dur> (``20ms``, ``0.5s``, or bare seconds),
+                        then proceed — a stalled link / slow disk
+      error             fail the op as if the kernel said EIO (same
+                        exception class as drop; counted separately)
+      sever[:frac]      byte-stream ops deliver only a PREFIX of the
+                        payload (frac of it, default seeded-random), then
+                        fail — the mid-frame cut that leaves torn JSON on
+                        the peer; non-stream ops treat it as drop
+      truncate[:frac]   same cut, intended for at-rest writes (the WAL
+                        site): the prefix IS persisted, the writer errors,
+                        and recovery must repair the torn tail
+
+    prob: @0.1 fires on ~10% of decisions at that site (seeded RNG);
+    default 1.0.  Multiple faults on one site evaluate in spec order; the
+    first that fires wins.
+
+Wired sites:
+  client.dial / client.request / client.watch   (client/rest.py)
+  store.rpc / store.watch                       (storage/remote.py)
+  repl.link                                     (storage/server.py sender,
+                                                 storage/standby.py consumer)
+  wal.write                                     (storage/store.py)
+
+With no injector active every hook is identity — one module-global ``is
+None`` test on the hot path; no locks, no RNG, no allocation.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "KTPU_FAULTS"
+
+
+class FaultInjected(ConnectionError):
+    """An injected transport/storage fault.  Subclasses ConnectionError on
+    purpose: every recovery path under test already classifies connection
+    errors as transient, and the injector must exercise THOSE paths, not
+    grow special cases for itself."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed KTPU_FAULTS spec — raised at activation, never mid-run."""
+
+
+def _parse_duration(s: str) -> float:
+    s = s.strip()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    return float(s)
+
+
+class _Fault:
+    __slots__ = ("action", "param", "prob")
+
+    ACTIONS = ("drop", "delay", "error", "sever", "truncate")
+
+    def __init__(self, action: str, param: Optional[float], prob: float):
+        self.action = action
+        self.param = param
+        self.prob = prob
+
+
+class _Site:
+    """One named injection point: its fault list, its own seeded RNG (so
+    decision sequences are per-site deterministic regardless of which
+    other sites fire), and decision counters."""
+
+    def __init__(self, name: str, seed: int):
+        self.name = name
+        # independent, stable stream per (seed, site)
+        self.rng = random.Random((seed << 32) ^ zlib.crc32(name.encode()))
+        self.faults: List[_Fault] = []
+        self.injected: Dict[str, int] = {}
+        self.decisions = 0
+
+
+class _LockedJitter:
+    """Thread-safe facade over the injector's jitter stream.  Exposes the
+    one method Backoff draws with; the lock keeps concurrent client
+    threads from corrupting the shared Random state (Random is not
+    thread-safe for seeded use).  Draw ORDER across threads still follows
+    the scheduler, so exact sleep replay holds per thread interleave —
+    single-threaded consumers (the unit tests) replay exactly."""
+
+    __slots__ = ("_rng", "_lock")
+
+    def __init__(self, seed: int):
+        self._rng = random.Random((seed << 32) ^ 0x6A177E12)
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf lock around one RNG draw; only taken when faults are ACTIVE
+
+    def uniform(self, a: float, b: float) -> float:
+        with self._lock:
+            return self._rng.uniform(a, b)
+
+
+class Injector:
+    def __init__(self, seed: int, spec: str):
+        self.seed = seed
+        self.spec = spec
+        self._sites: Dict[str, _Site] = {}
+        # one leaf lock serializes RNG draws + counters; sites are touched
+        # from many threads and Random is not thread-safe
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf lock inside the injector; taken only when faults are ACTIVE
+        # a dedicated jitter stream for consumers (client/retry backoff)
+        # that want deterministic randomness under an active schedule
+        self.jitter_rng = _LockedJitter(seed)
+        for rule in spec.split(";"):
+            rule = rule.strip()
+            if not rule:
+                continue
+            site_name, sep, faults = rule.partition("=")
+            site_name = site_name.strip()
+            if not sep or not site_name:
+                raise FaultSpecError(f"rule {rule!r} is not <site>=<fault>")
+            site = self._sites.get(site_name)
+            if site is None:
+                site = self._sites[site_name] = _Site(site_name, seed)
+            for f in faults.split("|"):
+                f = f.strip()
+                if not f:
+                    continue
+                body, _, prob_s = f.partition("@")
+                action, _, param_s = body.partition(":")
+                action = action.strip()
+                if action not in _Fault.ACTIONS:
+                    raise FaultSpecError(
+                        f"unknown action {action!r} in rule {rule!r} "
+                        f"(want one of {_Fault.ACTIONS})")
+                try:
+                    prob = float(prob_s) if prob_s else 1.0
+                    param: Optional[float] = None
+                    if param_s:
+                        param = (_parse_duration(param_s)
+                                 if action == "delay" else float(param_s))
+                except ValueError as e:
+                    raise FaultSpecError(
+                        f"bad parameter in fault {f!r}: {e}") from e
+                if not 0.0 <= prob <= 1.0:
+                    raise FaultSpecError(f"probability {prob} not in [0,1]")
+                site.faults.append(_Fault(action, param, prob))
+
+    # ------------------------------------------------------------ decisions
+
+    def decide(self, site_name: str) -> Optional[Tuple[str, Optional[float]]]:
+        """(action, param) when a fault fires at this site, else None.
+        One seeded draw per configured fault per decision — the schedule
+        is a pure function of (seed, site, decision index)."""
+        site = self._sites.get(site_name)
+        if site is None:
+            return None
+        with self._lock:
+            site.decisions += 1
+            for f in site.faults:
+                if f.prob >= 1.0 or site.rng.random() < f.prob:
+                    site.injected[f.action] = \
+                        site.injected.get(f.action, 0) + 1
+                    if f.action in ("sever", "truncate") and f.param is None:
+                        # the cut point is part of the schedule: draw it
+                        # under the same per-site stream
+                        return (f.action, site.rng.uniform(0.1, 0.9))
+                    return (f.action, f.param)
+        return None
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {name: dict(s.injected)
+                    for name, s in self._sites.items() if s.injected}
+
+
+_injector: Optional[Injector] = None
+
+
+def active() -> bool:
+    return _injector is not None
+
+
+def activate(seed: int, spec: str) -> Injector:
+    """Install an injector process-wide (replacing any active one)."""
+    global _injector
+    inj = Injector(int(seed), spec)
+    _injector = inj
+    return inj
+
+
+def activate_from_value(value: str) -> Injector:
+    """Parse the ``<seed>:<spec>`` env form and activate it."""
+    seed_s, sep, spec = value.partition(":")
+    if not sep:
+        raise FaultSpecError(
+            f"{ENV_VAR} must be <seed>:<spec>, got {value!r}")
+    try:
+        seed = int(seed_s)
+    except ValueError as e:
+        raise FaultSpecError(f"bad seed {seed_s!r}: {e}") from e
+    return activate(seed, spec)
+
+
+def deactivate() -> None:
+    global _injector
+    _injector = None
+
+
+def rng() -> Optional["_LockedJitter"]:
+    """The active injector's dedicated jitter stream (None when inactive).
+    Backoff jitter rides this so a seeded chaos run's sleeps come from the
+    schedule's seed; draws are lock-serialized across threads."""
+    inj = _injector
+    return inj.jitter_rng if inj is not None else None
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Per-site injected-fault counts (empty when inactive) — the chaos
+    runner's proof that a schedule actually exercised its sites."""
+    inj = _injector
+    return inj.stats() if inj is not None else {}
+
+
+def check(site: str) -> None:
+    """Gate a non-stream operation (a dial, an RPC, a frame read): no-op
+    when inactive; may sleep (delay) or raise FaultInjected (drop/error —
+    sever/truncate degrade to drop here, there are no bytes to cut)."""
+    inj = _injector
+    if inj is None:
+        return
+    d = inj.decide(site)
+    if d is None:
+        return
+    action, param = d
+    if action == "delay":
+        time.sleep(param or 0.0)
+        return
+    raise FaultInjected(f"faultline[{site}]: injected {action}")
+
+
+def filter_bytes(site: str, data: bytes) -> Tuple[bytes, Optional[Exception]]:
+    """Gate a byte-stream write.  Returns (bytes_to_write, exc): the
+    caller MUST write the returned bytes, then raise exc if set — that
+    ordering is what puts a torn frame on the wire / a torn record on
+    disk before the failure surfaces (the partial-failure shape whole-
+    process kills can never produce)."""
+    inj = _injector
+    if inj is None:
+        return data, None
+    d = inj.decide(site)
+    if d is None:
+        return data, None
+    action, param = d
+    if action == "delay":
+        time.sleep(param or 0.0)
+        return data, None
+    if action in ("sever", "truncate") and len(data) > 1:
+        frac = param if param is not None else 0.5
+        cut = max(1, min(len(data) - 1, int(len(data) * frac)))
+        return data[:cut], FaultInjected(
+            f"faultline[{site}]: injected {action} at byte {cut}/{len(data)}")
+    if action == "error":
+        return b"", FaultInjected(f"faultline[{site}]: injected error")
+    return b"", FaultInjected(f"faultline[{site}]: injected {action}")
+
+
+_env = os.environ.get(ENV_VAR, "")
+if _env:
+    activate_from_value(_env)

@@ -1,0 +1,358 @@
+#!/usr/bin/env python
+"""Seeded chaos runner: fault schedules against a replicated control plane,
+with per-seed invariant verdicts.
+
+For each seed this boots the full partial-failure topology IN-PROCESS — a
+primary Store+StoreServer with a WAL, a warm StandbyServer replicating
+from it, a Master (apiserver) dialing the pair over store RPCs, writer
+clients, and an informer — activates a faultline schedule that drops,
+delays, severs, and tears I/O at every wired site (client dials/requests/
+watch streams, store RPCs and watch frames, the replication link, the WAL
+write path), optionally kills the primary store mid-run (the standby
+promotes), then deactivates the faults and checks the standing invariants
+under fire:
+
+  - no acknowledged write lost (every acked ConfigMap is listable after
+    recovery, across the failover);
+  - strict revision order at the primary store's watch fan-out, the
+    standby replica's, and per key at the informer;
+  - the informer converges losslessly (cache == authoritative list);
+  - recovery time after the faults lift is bounded.
+
+Usage:
+    python scripts/chaos.py                       # default 5-seed sweep
+    python scripts/chaos.py --seeds 7,1729 --duration 4 --no-kill
+
+Prints one JSON verdict line per seed plus a summary; exits non-zero if
+any invariant failed.  The slow tier of tests/test_chaos.py drives the
+same engine (run_schedule) with fewer seeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Every wired site, every action class: drop + delay on request paths,
+# drop on watch streams, sever (mid-frame) on the replication link, tear
+# (truncate) on the WAL.  Probabilities are low enough that forward
+# progress continues UNDER the faults — the point is partial failure, not
+# a dead cluster.
+DEFAULT_SPEC = (
+    "client.dial=drop@0.05;"
+    "client.request=drop@0.05|delay:10ms@0.05;"
+    "client.watch=drop@0.10;"
+    "store.rpc=drop@0.05|delay:5ms@0.05;"
+    "store.watch=drop@0.10;"
+    "repl.link=sever@0.08|drop@0.05;"
+    "wal.write=truncate@0.03"
+)
+
+CONVERGE_TIMEOUT = 60.0
+
+
+def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
+                 spec: str = DEFAULT_SPEC, writers: int = 3,
+                 tmpdir: str = "") -> dict:
+    """One seeded chaos schedule; returns the verdict dict (see module
+    docstring for the invariants it encodes)."""
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset, SharedInformer
+    from kubernetes1_tpu.client import retry as client_retry
+    from kubernetes1_tpu.machinery import AlreadyExists
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.storage import Store
+    from kubernetes1_tpu.storage.server import StoreServer
+    from kubernetes1_tpu.storage.standby import StandbyServer
+    from kubernetes1_tpu.utils import faultline
+
+    own_tmp = not tmpdir
+    if own_tmp:
+        tmpdir = tempfile.mkdtemp(prefix=f"ktpu-chaos-{seed}-")
+    psock = os.path.join(tmpdir, "p.sock")
+    ssock = os.path.join(tmpdir, "s.sock")
+    store = Store(global_scheme.copy(),
+                  wal_path=os.path.join(tmpdir, "p.wal"))
+    # retries_total is process-cumulative and a multi-seed sweep runs in
+    # one process: report this run's DELTA, not the absolute counters
+    retries_before = client_retry.retries_snapshot()
+    primary = standby = master = cs = inf = None
+    ledger_p = ledger_s = order_thread = None
+    order_stop = threading.Event()
+    stop = threading.Event()
+    threads: list = []
+    verdict = {"seed": seed, "spec": spec, "killed_primary": False}
+    try:
+        # durable ack policy: a replication-gate timeout FAILS the write (503,
+        # client retries) instead of acking it unprotected — the only policy
+        # under which "zero acked writes lost" can hold against a repl-link
+        # sever followed by a primary kill (the available policy's unprotected
+        # window is a documented durability trade, and seed sweeps land in it)
+        primary = StoreServer(store, psock, repl_ack_policy="durable").start()
+        standby = StandbyServer(psock, ssock,
+                                wal_path=os.path.join(tmpdir, "s.wal"),
+                                failover_grace=0.5,
+                                repl_ack_policy="durable").start()
+        master = Master(store_address=f"{psock},{ssock}").start()
+        cs = Clientset(master.url)
+
+        # revision-order ledgers: raw watchers on BOTH stores' fan-out
+        def ledger(st):
+            w = st.watch("/registry/", queue_limit=0)
+            revs: list = []
+
+            def pump():
+                for ev in w:
+                    try:
+                        revs.append(int((ev.object.get("metadata") or {})
+                                        .get("resourceVersion") or 0))
+                    except (TypeError, ValueError):
+                        revs.append(-1)  # malformed: fails the order check
+
+            th = threading.Thread(target=pump, daemon=True, name="chaos-ledger")
+            th.start()
+            return w, revs
+
+        ledger_p, primary_revs = ledger(store)
+        ledger_s, standby_revs = ledger(standby.store)
+
+        # cacher-stream order check: every watch stream the apiserver's
+        # cacher serves must deliver strictly increasing revisions WITHIN the
+        # stream (across streams a failover may legitimately reuse revision
+        # numbers the dead primary burned on unreplicated commits — the
+        # evict/relist boundary is where clients resynchronize)
+        order_ok = [True]
+
+        def cacher_order_check():
+            while not order_stop.is_set():
+                try:
+                    w = master.cacher.watch("/registry/", since_rev=0)
+                except Exception:  # noqa: BLE001 — cacher reseeding mid-failover
+                    if order_stop.wait(0.2):
+                        return
+                    continue
+                last = 0
+                try:
+                    while not order_stop.is_set():
+                        ev = w.next_timeout(0.5)
+                        if ev is None:
+                            if w.evicted or w._stopped.is_set():
+                                break  # reseed/evict: open a fresh stream
+                            continue
+                        try:
+                            rv = int((ev.object.get("metadata") or {})
+                                     .get("resourceVersion") or 0)
+                        except (TypeError, ValueError):
+                            order_ok[0] = False
+                            continue
+                        if rv <= last:
+                            order_ok[0] = False
+                        last = rv
+                finally:
+                    w.stop()
+
+        order_thread = threading.Thread(target=cacher_order_check, daemon=True,
+                                        name="chaos-cacher-order")
+        order_thread.start()
+
+        inf = SharedInformer(cs.configmaps, namespace="default")
+        inf.start()
+        if not inf.wait_for_sync(15.0):
+            raise RuntimeError("chaos boot: informer never synced")
+
+        acked: list = []
+
+        def writer(wid: int):
+            wcs = Clientset(master.url)
+            i = 0
+            while not stop.is_set():
+                name = f"chaos-{seed}-{wid}-{i}"
+                cm = t.ConfigMap(data={"i": str(i)})
+                cm.metadata.name = name
+                try:
+                    wcs.configmaps.create(cm, "default")
+                except AlreadyExists:
+                    # a fault landed between commit and response on a prior
+                    # attempt: the write IS durable — count it and move on
+                    acked.append(name)
+                    i += 1
+                except Exception:  # noqa: BLE001 — mid-fault blip: retry same name
+                    pass
+                else:
+                    acked.append(name)
+                    i += 1
+                time.sleep(0.02)
+            wcs.close()
+
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True,
+                                    name=f"chaos-writer-{w}")
+                   for w in range(writers)]
+        # an empty spec is the IDENTITY control: the injector is never
+        # activated, proving the invariant suite (and the wired hooks) cost
+        # nothing and change nothing when faults are off
+        if spec:
+            faultline.activate(seed, spec)
+        try:
+            for th in threads:
+                th.start()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < duration:
+                if (kill_primary and not verdict["killed_primary"]
+                        and time.monotonic() - t0 > duration / 2):
+                    primary.stop()  # the SIGKILL analog; standby promotes
+                    verdict["killed_primary"] = True
+                time.sleep(0.05)
+            stop.set()
+            for th in threads:
+                th.join(timeout=10.0)
+        finally:
+            verdict["injected"] = faultline.stats()
+            faultline.deactivate()
+
+        # ---- recovery + invariants (faults OFF now)
+        recover_t0 = time.monotonic()
+
+        def live_names():
+            try:
+                return {c.metadata.name
+                        for c in cs.configmaps.list(namespace="default")[0]}
+            except Exception:  # noqa: BLE001 — failover may still be settling
+                return None
+
+        lost: list = list(acked)
+        while time.monotonic() - recover_t0 < CONVERGE_TIMEOUT:
+            names = live_names()
+            if names is not None:
+                lost = [n for n in acked if n not in names]
+                if not lost:
+                    break
+            time.sleep(0.25)
+        verdict["acked"] = len(acked)
+        verdict["lost"] = lost
+        verdict["recovery_s"] = round(time.monotonic() - recover_t0, 2)
+
+        informer_ok = False
+        deadline = time.monotonic() + CONVERGE_TIMEOUT
+        want = {n for n in acked}
+        while time.monotonic() < deadline:
+            have = {o.metadata.name for o in inf.list()}
+            if want <= have:
+                informer_ok = True
+                break
+            time.sleep(0.25)
+        verdict["informer_converged"] = informer_ok
+
+        def strictly_increasing(revs):
+            return all(b > a for a, b in zip(revs, revs[1:]))
+
+        order_stop.set()
+        order_thread.join(timeout=5.0)
+        verdict["revision_order_ok"] = (
+            strictly_increasing(primary_revs)
+            and strictly_increasing(standby_revs)
+            and order_ok[0])
+        verdict["unprotected_acks"] = (primary.unprotected_acks
+                                       + standby.server.unprotected_acks)
+        verdict["standby_promoted"] = standby.promoted.is_set()
+        verdict["standby_resyncs"] = standby.resyncs
+        verdict["apiserver_shed_total"] = master.inflight.shed_total
+        verdict["wal_torn_tail_repairs"] = store.wal_torn_tail_repairs
+        verdict["client_retries"] = client_retry.retries_delta(
+            retries_before)
+        verdict["ok"] = (not lost and informer_ok
+                         and verdict["revision_order_ok"]
+                         and len(acked) > 10
+                         and verdict["unprotected_acks"] == 0
+                         and (verdict["standby_promoted"]
+                              or not verdict["killed_primary"]))
+
+    finally:
+        # ---- teardown (exception-safe): a leaked Master/store/informer
+        # would keep serving into the NEXT seed's run; each stop is
+        # guarded so one failure doesn't leak the rest
+        def _stop_quietly(fn):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+        stop.set()
+        order_stop.set()
+        faultline.deactivate()
+        for th in threads:
+            th.join(timeout=5.0)
+        if order_thread is not None:
+            order_thread.join(timeout=5.0)
+        for component in (inf, ledger_p, ledger_s):
+            if component is not None:
+                _stop_quietly(component.stop)
+        if cs is not None:
+            _stop_quietly(cs.close)
+        if master is not None:
+            _stop_quietly(master.stop)
+        if standby is not None:
+            _stop_quietly(standby.stop)
+        if primary is not None and not verdict["killed_primary"]:
+            _stop_quietly(primary.stop)
+    # torn-WAL repair happens on store OPEN: reopen both WALs the way a
+    # restarted store process would — injected tears (wal.write truncate)
+    # must be repaired, not fatal, and the replay must reach a revision
+    wal_repairs = store.wal_torn_tail_repairs
+    for wal in ("p.wal", "s.wal"):
+        path = os.path.join(tmpdir, wal)
+        if os.path.exists(path):
+            reopened = Store(global_scheme.copy(), wal_path=path)
+            wal_repairs += reopened.wal_torn_tail_repairs
+            reopened.close()
+    verdict["wal_torn_tail_repairs"] = wal_repairs
+    if own_tmp:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return verdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="ktpu seeded chaos runner")
+    ap.add_argument("--seeds", default="1,7,42,1729,9000",
+                    help="comma-separated seed sweep")
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="seconds of fault injection per seed")
+    ap.add_argument("--writers", type=int, default=3)
+    ap.add_argument("--spec", default=DEFAULT_SPEC,
+                    help="faultline spec (see utils/faultline.py grammar)")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="skip the mid-run primary-store kill")
+    args = ap.parse_args()
+    seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    verdicts = []
+    for seed in seeds:
+        v = run_schedule(seed, duration=args.duration,
+                         kill_primary=not args.no_kill,
+                         spec=args.spec, writers=args.writers)
+        print(json.dumps(v), flush=True)
+        verdicts.append(v)
+    ok = all(v["ok"] for v in verdicts)
+    recs = [v["recovery_s"] for v in verdicts]
+    print(json.dumps({
+        "summary": "chaos", "seeds": seeds,
+        "passed": sum(1 for v in verdicts if v["ok"]),
+        "failed": [v["seed"] for v in verdicts if not v["ok"]],
+        "recovery_s_max": max(recs) if recs else None,
+        "acked_total": sum(v["acked"] for v in verdicts),
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

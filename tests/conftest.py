@@ -85,6 +85,10 @@ def pytest_configure(config):
         "markers",
         "thread_leak_ok: test intentionally leaves background threads "
         "running (opts out of the per-test thread-leak guard)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running schedule (multi-seed chaos sweeps, minutes of "
+        "fault injection) — excluded from tier-1 (`-m 'not slow'`)")
 
 
 @pytest.fixture(autouse=True)

@@ -356,6 +356,71 @@ class TestBatchedCommitOrdering:
             c.stop()
 
 
+class TestInformerUnderWatchTruncation:
+    @pytest.mark.slow  # up-to-40s probabilistic schedule: the exit waits
+    # for BOTH recovery paths to fire, which on a loaded box can take the
+    # whole budget — long fault schedules stay out of tier-1 (the
+    # faultline smoke covers injected-disconnect convergence there)
+    @pytest.mark.thread_leak_ok  # Master's HTTP worker threads
+    def test_relist_and_reconnect_converge_losslessly(self):
+        """Injected watch-stream truncation (utils/faultline on the
+        client.watch site), with the cacher's history window shrunk so a
+        re-dial can land below the 410 floor: the informer must take BOTH
+        recovery paths — reconnect-from-last-rv after a mid-stream cut,
+        and a full relist after a 410 — and the cache must still end
+        byte-equal to the authoritative list (no event lost, none
+        double-applied)."""
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset, SharedInformer
+        from kubernetes1_tpu.utils import faultline
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.pods, namespace="default")
+        try:
+            inf.start()
+            assert inf.wait_for_sync(10.0)
+            # a 2-revision watch window: any reconnect that lags a couple
+            # of commits is below the floor -> 410 -> relist
+            with master.cacher._cond:
+                master.cacher._history_limit = 2
+            faultline.activate(5, "client.watch=drop@0.25")
+            created = []
+            try:
+                deadline = time.monotonic() + 40.0
+                i = 0
+                # create until both recovery paths have demonstrably run
+                while time.monotonic() < deadline:
+                    name = f"cut-{i}"
+                    cs.pods.create(make_pod(name))
+                    created.append(name)
+                    i += 1
+                    time.sleep(0.01)
+                    if inf.reconnects >= 1 and inf.relists >= 2 \
+                            and i >= 30:
+                        break
+            finally:
+                faultline.deactivate()
+            assert inf.reconnects >= 1, (inf.reconnects, inf.relists)
+            assert inf.relists >= 2, (inf.reconnects, inf.relists)
+            # lossless convergence: informer cache == authoritative list
+            want = set(created)
+            deadline = time.monotonic() + 30.0
+            have: set = set()
+            while time.monotonic() < deadline:
+                have = {p.metadata.name for p in inf.list()}
+                if have == want:
+                    break
+                time.sleep(0.1)
+            assert have == want, (
+                f"missing={sorted(want - have)[:5]} "
+                f"extra={sorted(have - want)[:5]}")
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()
+
+
 class TestDeepHistoryFallback:
     def test_resume_below_cache_window_falls_back_to_store_history(self):
         """A resume below the cache's window but inside the store's deeper

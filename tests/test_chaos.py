@@ -239,6 +239,50 @@ class TestChaosMonkey:
         assert len(acked) > 10, "writer barely ran; chaos window too short"
 
 
+@pytest.mark.slow
+class TestSeededFaultSchedules:
+    """Wire-level partial-failure tier (scripts/chaos.py's engine): seeded
+    fault schedules — drop/delay/sever/truncate at every faultline site —
+    against the replicated in-process topology, plus the mid-run primary
+    kill.  The standing invariants must hold under fire for EVERY seed:
+    zero acknowledged writes lost, strict per-stream revision order at the
+    store/replica/cacher fan-outs, informers converge, recovery bounded.
+
+    `slow` tier: each seed is ~6s of injection plus convergence; tier-1
+    keeps the short no-kill smoke in tests/test_faultline.py instead.
+    """
+
+    @pytest.mark.thread_leak_ok  # full in-process topology per seed
+    @pytest.mark.parametrize("seed", [1, 7, 42, 1729, 9000])
+    def test_schedule_with_primary_kill(self, seed, tmp_path):
+        from scripts.chaos import run_schedule
+
+        v = run_schedule(seed, duration=6.0, kill_primary=True,
+                         tmpdir=str(tmp_path))
+        assert v["ok"], v
+        assert v["lost"] == [], f"acknowledged writes lost: {v['lost']}"
+        assert v["revision_order_ok"]
+        assert v["informer_converged"]
+        assert v["standby_promoted"]
+        assert v["recovery_s"] < 30.0, v  # bounded recovery after faults
+        # the schedule must actually have exercised the wired sites
+        assert v["injected"], "no faults fired"
+
+    @pytest.mark.thread_leak_ok
+    def test_heavy_replication_sever_schedule(self, tmp_path):
+        # concentrate mid-frame severs on the replication link (the
+        # torn-frame + resync-cursor path) with the primary kill landing
+        # mid-flap — the schedule that found the unprotected-ack hole
+        from scripts.chaos import run_schedule
+
+        v = run_schedule(4242, duration=6.0, kill_primary=True,
+                         spec="repl.link=sever@0.25|drop@0.1;"
+                              "wal.write=truncate@0.05",
+                         tmpdir=str(tmp_path))
+        assert v["ok"], v
+        assert v["standby_resyncs"] >= 1
+
+
 def _succeeded(cs, name):
     try:
         return cs.jobs.get(name, "default").status.succeeded or 0

@@ -1,0 +1,688 @@
+"""Fault-injection layer + hardened-recovery units (tier-1, non-slow).
+
+Covers the faultline injector itself (spec grammar, seeded determinism,
+identity when inactive, byte-stream tearing), the recovery code it
+exercises — WAL torn-tail repair on store open, the unified client retry
+policy (transient-vs-terminal classification, capped full-jitter backoff,
+Retry-After honoring), apiserver max-inflight overload shedding — and the
+standby's flap-vs-death distinction (link flap resync ≠ promotion).
+
+The multi-seed, multi-minute schedules live in tests/test_chaos.py under
+the `slow` marker; this module keeps one short smoke schedule in tier-1.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, SharedInformer
+from kubernetes1_tpu.client import retry as client_retry
+from kubernetes1_tpu.machinery import (
+    ApiError,
+    Conflict,
+    NotFound,
+    TooOldResourceVersion,
+)
+from kubernetes1_tpu.machinery.errors import TooManyRequests
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+from kubernetes1_tpu.storage.remote import RemoteStore
+from kubernetes1_tpu.storage.server import StoreServer
+from kubernetes1_tpu.storage.standby import StandbyServer
+from kubernetes1_tpu.utils import faultline
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.test_machinery import make_pod
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_injector():
+    """Every test starts and ends with the injector inactive — a leaked
+    schedule would make unrelated tests fail nondeterministically."""
+    faultline.deactivate()
+    yield
+    faultline.deactivate()
+
+
+def _retries(reason: str) -> int:
+    return client_retry.retries_snapshot().get(reason, 0)
+
+
+# ---------------------------------------------------------------- the injector
+
+
+class TestSpecGrammar:
+    def test_full_grammar_parses(self):
+        inj = faultline.Injector(
+            1,
+            "client.request=drop@0.1|delay:20ms@0.5|error;"
+            "repl.link=sever:0.3@0.2;"
+            "wal.write=truncate@0.03")
+        assert set(inj._sites) == {"client.request", "repl.link",
+                                   "wal.write"}
+        faults = inj._sites["client.request"].faults
+        assert [f.action for f in faults] == ["drop", "delay", "error"]
+        assert faults[1].param == pytest.approx(0.02)  # 20ms
+        assert faults[0].prob == pytest.approx(0.1)
+        assert faults[2].prob == 1.0  # default
+
+    @pytest.mark.parametrize("spec", [
+        "client.request",                 # no '='
+        "client.request=explode",         # unknown action
+        "client.request=drop@1.5",        # prob out of range
+        "client.request=delay:xyz",       # bad duration
+    ])
+    def test_malformed_specs_raise_at_activation(self, spec):
+        with pytest.raises(faultline.FaultSpecError):
+            faultline.Injector(1, spec)
+
+    def test_env_form(self):
+        inj = faultline.activate_from_value("42:wal.write=truncate@0.5")
+        assert inj.seed == 42
+        assert faultline.active()
+        with pytest.raises(faultline.FaultSpecError):
+            faultline.activate_from_value("no-seed-spec-separator")
+        with pytest.raises(faultline.FaultSpecError):
+            faultline.activate_from_value("abc:wal.write=drop")
+
+    @pytest.mark.parametrize("s, want", [
+        ("20ms", 0.02), ("0.5s", 0.5), ("2", 2.0)])
+    def test_duration_units(self, s, want):
+        assert faultline._parse_duration(s) == pytest.approx(want)
+
+
+class TestDeterminism:
+    SPEC = "a=drop@0.5;b=sever@0.5"
+
+    def _sequence(self, inj, site, n=64):
+        return [inj.decide(site) for _ in range(n)]
+
+    def test_same_seed_same_schedule(self):
+        a = self._sequence(faultline.Injector(7, self.SPEC), "a")
+        b = self._sequence(faultline.Injector(7, self.SPEC), "a")
+        assert a == b
+        assert any(d is not None for d in a)  # the schedule actually fires
+
+    def test_different_seeds_differ(self):
+        a = self._sequence(faultline.Injector(7, self.SPEC), "a")
+        b = self._sequence(faultline.Injector(8, self.SPEC), "a")
+        assert a != b
+
+    def test_sites_are_independent_streams(self):
+        # site a's decision sequence must not shift when site b is also
+        # being exercised — per-site RNG streams, not one shared stream
+        alone = self._sequence(faultline.Injector(7, self.SPEC), "a")
+        inj = faultline.Injector(7, self.SPEC)
+        interleaved = []
+        for _ in range(64):
+            interleaved.append(inj.decide("a"))
+            inj.decide("b")
+        assert alone == interleaved
+
+    def test_unknown_site_never_fires(self):
+        inj = faultline.Injector(7, self.SPEC)
+        assert all(inj.decide("never.wired") is None for _ in range(16))
+
+
+class TestIdentityWhenInactive:
+    def test_check_and_filter_are_noops(self):
+        assert not faultline.active()
+        faultline.check("client.request")  # no raise
+        data = b"x" * 1024
+        out, exc = faultline.filter_bytes("wal.write", data)
+        assert out is data  # not even a copy on the inactive path
+        assert exc is None
+        assert faultline.stats() == {}
+        assert faultline.rng() is None
+
+
+class TestByteTearing:
+    def test_sever_writes_strict_prefix_then_errors(self):
+        faultline.activate(3, "repl.link=sever@1.0")
+        data = b"A" * 1000
+        out, exc = faultline.filter_bytes("repl.link", data)
+        assert isinstance(exc, faultline.FaultInjected)
+        assert 0 < len(out) < len(data)
+        assert data.startswith(out)
+
+    def test_truncate_fraction_is_honored(self):
+        faultline.activate(3, "wal.write=truncate:0.25@1.0")
+        out, exc = faultline.filter_bytes("wal.write", b"B" * 1000)
+        assert len(out) == 250
+        assert isinstance(exc, faultline.FaultInjected)
+
+    def test_error_keeps_no_bytes(self):
+        faultline.activate(3, "wal.write=error@1.0")
+        out, exc = faultline.filter_bytes("wal.write", b"C" * 10)
+        assert out == b""
+        assert isinstance(exc, faultline.FaultInjected)
+
+    def test_delay_passes_all_bytes(self):
+        faultline.activate(3, "wal.write=delay:1ms@1.0")
+        data = b"D" * 10
+        out, exc = faultline.filter_bytes("wal.write", data)
+        assert out == data and exc is None
+
+    def test_check_degrades_sever_to_drop(self):
+        faultline.activate(3, "store.rpc=sever@1.0")
+        with pytest.raises(faultline.FaultInjected):
+            faultline.check("store.rpc")
+
+    def test_injected_fault_is_a_connection_error(self):
+        # recovery paths classify ConnectionError as transient; the
+        # injector must walk through THOSE paths, not bespoke ones
+        assert issubclass(faultline.FaultInjected, ConnectionError)
+        assert client_retry.is_transient(faultline.FaultInjected("x"))
+
+
+# ------------------------------------------------------- WAL torn-tail repair
+
+
+class TestWalTornTailRepair:
+    def _store(self, path, n=5):
+        store = Store(global_scheme.copy(), wal_path=path)
+        for i in range(n):
+            store.create(f"/registry/pods/d/p{i}", make_pod(f"p{i}"))
+        store.close()
+        return path
+
+    def test_torn_json_tail_truncated_and_counted(self, tmp_path):
+        wal = self._store(str(tmp_path / "a.wal"))
+        intact = os.path.getsize(wal)
+        with open(wal, "ab") as f:  # a record cut mid-write by a crash
+            f.write(Store._wal_frame(
+                {"rev": 99, "type": "ADDED", "key": "/registry/pods/d/torn",
+                 "obj": {}})[:20])
+        reopened = Store(global_scheme.copy(), wal_path=str(wal))
+        assert reopened.wal_torn_tail_repairs == 1
+        assert os.path.getsize(wal) == intact  # torn suffix removed
+        items, _ = reopened.list("/registry/pods/")
+        assert len(items) == 5  # every acked write replayed
+        reopened.close()
+
+    def test_crc_mismatch_is_torn(self, tmp_path):
+        wal = self._store(str(tmp_path / "b.wal"))
+        frame = bytearray(Store._wal_frame(
+            {"rev": 99, "type": "ADDED", "key": "/registry/pods/d/x",
+             "obj": {}}))
+        frame[-10] ^= 0x01  # bit flip INSIDE the payload: CRC catches it
+        with open(wal, "ab") as f:
+            f.write(bytes(frame))
+        reopened = Store(global_scheme.copy(), wal_path=str(wal))
+        assert reopened.wal_torn_tail_repairs == 1
+        assert len(reopened.list("/registry/pods/")[0]) == 5
+        reopened.close()
+
+    def test_intact_wal_replays_without_repair(self, tmp_path):
+        wal = self._store(str(tmp_path / "c.wal"))
+        reopened = Store(global_scheme.copy(), wal_path=str(wal))
+        assert reopened.wal_torn_tail_repairs == 0
+        assert len(reopened.list("/registry/pods/")[0]) == 5
+        reopened.close()
+
+    def test_missing_final_newline_restored_before_append(self, tmp_path):
+        """A crash can land after the last record's bytes but before its
+        trailing newline: the record parses (CRC covers the JSON, not the
+        \\n) and is acked state — but appending straight after it welds
+        the next frame onto the same line, turning TWO durable records
+        into one unparsable line a later replay would truncate or skip
+        (regression: replay must restore the frame terminator)."""
+        wal = self._store(str(tmp_path / "e.wal"))
+        with open(wal, "r+b") as f:
+            f.truncate(os.path.getsize(wal) - 1)  # lose only the \n
+        reopened = Store(global_scheme.copy(), wal_path=str(wal))
+        assert reopened.wal_torn_tail_repairs == 0  # record was durable
+        assert len(reopened.list("/registry/pods/")[0]) == 5
+        reopened.create("/registry/pods/d/p5", make_pod("p5"))
+        reopened.close()
+        again = Store(global_scheme.copy(), wal_path=str(wal))
+        assert again.wal_torn_tail_repairs == 0
+        assert again.wal_corrupt_records_skipped == 0
+        assert len(again.list("/registry/pods/")[0]) == 6
+        again.close()
+
+    def test_legacy_bare_json_wal_replays(self, tmp_path):
+        # pre-CRC WALs (bare JSON lines) must stay replayable in place
+        import json
+
+        wal = str(tmp_path / "legacy.wal")
+        pod = global_scheme.encode(make_pod("old"))
+        pod["metadata"]["resourceVersion"] = "1"
+        with open(wal, "w") as f:
+            f.write(json.dumps({"rev": 1, "type": "ADDED",
+                                "key": "/registry/pods/d/old",
+                                "obj": pod}) + "\n")
+        store = Store(global_scheme.copy(), wal_path=wal)
+        assert store.wal_torn_tail_repairs == 0
+        assert store.get("/registry/pods/d/old").metadata.name == "old"
+        store.close()
+
+    def test_injected_tear_errors_writer_and_live_store_rolls_back(
+            self, tmp_path):
+        wal = str(tmp_path / "d.wal")
+        store = Store(global_scheme.copy(), wal_path=wal)
+        store.create("/registry/pods/d/ok", make_pod("ok"))
+        faultline.activate(11, "wal.write=truncate@1.0")
+        with pytest.raises(ApiError, match="WAL persistence failed"):
+            # the torn prefix lands on disk and the writer errors (the
+            # group-commit drain wraps the tear, failing every writer in
+            # the batch) — no silent ack of a non-durable write
+            store.create("/registry/pods/d/torn", make_pod("torn"))
+        faultline.deactivate()
+        # the LIVE store rolled the torn prefix back out, so records
+        # committed AFTER the failure land on a clean WAL...
+        assert store.wal_write_rollbacks == 1
+        store.create("/registry/pods/d/later", make_pod("later"))
+        store.close()
+        # ...and a restart replays every acked write with no repair needed
+        reopened = Store(global_scheme.copy(), wal_path=wal)
+        assert reopened.wal_torn_tail_repairs == 0
+        assert reopened.get("/registry/pods/d/ok").metadata.name == "ok"
+        assert reopened.get("/registry/pods/d/later").metadata.name \
+            == "later"
+        with pytest.raises(NotFound):
+            reopened.get("/registry/pods/d/torn")  # unacked: legitimately gone
+        reopened.close()
+
+    def test_midfile_damage_skipped_not_truncated(self, tmp_path):
+        # garbage BETWEEN valid records is corruption, not a torn tail:
+        # replay must keep the acked records after it — truncating there
+        # would silently discard durable state
+        wal = self._store(str(tmp_path / "e.wal"))
+        size = os.path.getsize(wal)
+        with open(wal, "r+b") as f:
+            data = f.read()
+            cut = data.index(b"\n", size // 2) + 1  # a record boundary
+            f.seek(0)
+            f.write(data[:cut] + b"xx-garbage-line\n" + data[cut:])
+        store = Store(global_scheme.copy(), wal_path=str(wal))
+        assert store.wal_corrupt_records_skipped == 1
+        assert store.wal_torn_tail_repairs == 0
+        assert len(store.list("/registry/pods/")[0]) == 5  # nothing lost
+        assert os.path.getsize(wal) > size  # and nothing truncated
+        store.close()
+
+
+# ------------------------------------------------------- unified retry policy
+
+
+class TestRetryPolicy:
+    def test_classification(self):
+        transient = [ConnectionError("x"), TimeoutError("x"),
+                     faultline.FaultInjected("x"), TooManyRequests("shed"),
+                     _api_error(503), _api_error(500)]
+        terminal = [Conflict("c"), TooOldResourceVersion("relist"),
+                    NotFound("n"), _api_error(400), ValueError("not-api")]
+        assert all(client_retry.is_transient(e) for e in transient)
+        assert not any(client_retry.is_transient(e) for e in terminal)
+
+    def test_backoff_is_capped_exponential_with_full_jitter(self):
+        bo = client_retry.Backoff(base=0.1, factor=2.0, cap=0.4,
+                                  rng=random.Random(0))
+        ceilings = []
+        for _ in range(5):
+            c = bo.ceiling()
+            d = bo.next()
+            ceilings.append(c)
+            assert 0.0 <= d <= c  # full jitter: U(0, ceiling)
+        assert ceilings == [pytest.approx(x)
+                            for x in (0.1, 0.2, 0.4, 0.4, 0.4)]
+        bo.reset()
+        assert bo.ceiling() == pytest.approx(0.1)
+
+    def test_jitter_rides_faultline_stream_when_active(self):
+        def draw_four():
+            faultline.activate(99, "x=drop@0.0")
+            ds = [client_retry.Backoff(base=0.1).next() for _ in range(4)]
+            faultline.deactivate()
+            return ds
+
+        assert draw_four() == draw_four()  # seeded: chaos sleeps replay
+
+    def test_call_with_retries_transient_then_success(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        bo = client_retry.Backoff(base=0.001, cap=0.002)
+        assert client_retry.call_with_retries(flaky, steps=4,
+                                              backoff=bo) == "ok"
+        assert len(calls) == 3
+
+    def test_call_with_retries_terminal_raises_immediately(self):
+        calls = []
+
+        def conflicted():
+            calls.append(1)
+            raise Conflict("stale")
+
+        with pytest.raises(Conflict):
+            client_retry.call_with_retries(conflicted, steps=4)
+        assert len(calls) == 1
+
+    def test_call_with_retries_honors_retry_after_floor(self):
+        calls = []
+
+        def shed_once():
+            calls.append(1)
+            if len(calls) == 1:
+                err = TooManyRequests("shed")
+                err.retry_after = 0.15
+                raise err
+            return "ok"
+
+        t0 = time.monotonic()
+        bo = client_retry.Backoff(base=0.001, cap=0.002)
+        assert client_retry.call_with_retries(shed_once, steps=3,
+                                              backoff=bo) == "ok"
+        assert time.monotonic() - t0 >= 0.15  # server's wait respected
+
+    def test_retry_on_conflict_still_converges(self):
+        calls = []
+
+        def eventually():
+            calls.append(1)
+            if len(calls) < 3:
+                raise Conflict("stale")
+            return 42
+
+        assert client_retry.retry_on_conflict(
+            eventually, sleep=0.001) == 42
+        with pytest.raises(Conflict):
+            client_retry.retry_on_conflict(
+                lambda: (_ for _ in ()).throw(Conflict("always")),
+                steps=2, sleep=0.001)
+
+
+def _api_error(code: int) -> ApiError:
+    e = ApiError(f"http {code}")
+    e.code = code
+    return e
+
+
+# -------------------------------------------------------- overload shedding
+
+
+class TestOverloadShedding:
+    def test_limiter_unit(self):
+        from kubernetes1_tpu.apiserver.server import _InflightLimiter
+
+        lim = _InflightLimiter(2)
+        assert lim.acquire("POST") and lim.acquire("PUT")
+        assert not lim.acquire("DELETE")  # third mutating: shed
+        assert lim.shed_total == 1
+        assert lim.acquire("GET")  # reads never shed
+        assert lim.inflight("mutating") == 2
+        assert lim.peak_mutating == 2
+        assert 0.1 <= lim.retry_after() <= 2.0
+        lim.release("POST")
+        assert lim.acquire("PATCH")  # slot freed
+        disabled = _InflightLimiter(0)
+        assert all(disabled.acquire("POST") for _ in range(64))
+
+    @pytest.mark.thread_leak_ok  # Master's HTTP worker threads
+    def test_apiserver_sheds_mutations_with_retry_after(self):
+        master = Master(max_inflight_mutating=1).start()
+        cs = Clientset(master.url)
+        try:
+            # pin the single mutating slot, as a wedged in-flight write
+            assert master.inflight.acquire("POST")
+            cm = t.ConfigMap(data={"k": "v"})
+            cm.metadata.name = "shed-me"
+            t0 = time.monotonic()
+            with pytest.raises(ApiError) as ei:
+                cs.configmaps.create(cm, "default")
+            # the client honored each shed's Retry-After before the final
+            # surface: total wall >= the advertised waits it slept
+            assert ei.value.code == 429
+            ra = getattr(ei.value, "retry_after", None)
+            assert ra is not None and ra > 0
+            assert time.monotonic() - t0 >= ra
+            shed = master.inflight.shed_total
+            assert shed >= 1
+            # reads keep flowing while mutations shed
+            assert cs.configmaps.list(namespace="default") is not None
+            assert master.inflight.shed_total == shed  # GETs never shed
+            # slot freed -> the same mutation goes through
+            master.inflight.release("POST")
+            created = cs.configmaps.create(cm, "default")
+            assert created.metadata.name == "shed-me"
+            # the robustness counters are on /metrics for the scraper
+            body = cs.api.request("GET", "/metrics", raw=True).decode()
+            assert "ktpu_apiserver_shed_total" in body
+            assert 'ktpu_apiserver_inflight{verb="mutating"}' in body
+            assert "ktpu_client_retries_total" in body
+        finally:
+            cs.close()
+            master.stop()
+
+
+# --------------------------------------------- standby: link flap vs death
+
+
+class TestStandbyFlapVsDeath:
+    @pytest.fixture()
+    def pair(self, tmp_path):
+        psock = str(tmp_path / "primary.sock")
+        ssock = str(tmp_path / "standby.sock")
+        store = Store(global_scheme.copy(), wal_path=str(tmp_path / "p.wal"))
+        primary = StoreServer(store, psock).start()
+        standby = StandbyServer(psock, ssock,
+                                wal_path=str(tmp_path / "s.wal"),
+                                failover_grace=0.5).start()
+        yield {"primary": primary, "standby": standby, "store": store,
+               "psock": psock}
+        standby.stop()
+        primary.stop()
+
+    @pytest.mark.thread_leak_ok  # server-side replication feed threads
+    def test_link_flap_resyncs_without_promotion_then_death_promotes(
+            self, pair):
+        standby, primary = pair["standby"], pair["primary"]
+        must_poll_until(lambda: primary._replica_acks,
+                        timeout=10.0, desc="standby attached")
+        rs = RemoteStore(global_scheme.copy(), pair["psock"])
+        # mid-frame severs + drops on the replication link the whole time
+        faultline.activate(1729, "repl.link=sever@0.2|drop@0.1")
+        try:
+            for i in range(12):
+                rs.create(f"/registry/pods/d/flap{i}", make_pod(f"flap{i}"))
+        finally:
+            faultline.deactivate()
+        # the consumer exited mid-frame at least once and came back by
+        # resuming from its last ACKED revision (not the applied one)
+        must_poll_until(lambda: standby.resyncs >= 1, timeout=15.0,
+                        desc="replication session re-established")
+        # a flapping link must NOT promote: the primary process is alive
+        assert not standby.promoted.is_set()
+        # ...and with the link healthy again the standby converges with
+        # zero lost writes (the acked-cursor resume re-ships the gap)
+        must_poll_until(
+            lambda: (standby.store.current_revision()
+                     == pair["store"].current_revision()),
+            timeout=15.0, desc="standby caught up after flaps")
+        assert len(standby.store.list("/registry/pods/")[0]) == 12
+        rs.close()
+        # death, by contrast, IS the promotion signal
+        primary.stop()
+        must_poll_until(standby.promoted.is_set, timeout=15.0,
+                        desc="standby promoted after primary death")
+        assert len(standby.store.list("/registry/pods/")[0]) == 12
+
+    @pytest.mark.thread_leak_ok  # standby worker threads
+    def test_silent_primary_death_promotes_via_hard_window(self, tmp_path):
+        # a primary host that dies WITHOUT sending RST (power loss, a
+        # partition black-holing SYNs) never produces the refused streak;
+        # an uninterrupted all-failure window must still promote
+        standby = StandbyServer(("10.255.255.1", 9),
+                                str(tmp_path / "s.sock"),
+                                failover_grace=0.3).start()
+        try:
+            must_poll_until(standby.promoted.is_set, timeout=30.0,
+                            desc="promotion despite no RST ever arriving")
+        finally:
+            standby.stop()
+
+
+class TestDurableAckPolicy:
+    """repl_ack_policy="durable": a replication-gate timeout FAILS the
+    answer (503, client retries) instead of acking unprotected — and
+    conflict-class answers (AlreadyExists) are gated too, so a retry
+    can't launder an unreplicated commit into a durable-looking ack.
+    This is the policy the chaos sweep runs under; "available" (the
+    default) keeps the tier-1 laggard contract and is covered by
+    TestStandbyFlapVsDeath above."""
+
+    @pytest.mark.thread_leak_ok  # server-side replication feed threads
+    def test_timeout_fails_write_instead_of_unprotected_ack(self, tmp_path):
+        from kubernetes1_tpu.storage.server import ReplicationUnavailable
+
+        psock = str(tmp_path / "primary.sock")
+        store = Store(global_scheme.copy(), wal_path=str(tmp_path / "p.wal"))
+        primary = StoreServer(store, psock,
+                              repl_ack_policy="durable").start()
+        standby = StandbyServer(psock, str(tmp_path / "standby.sock"),
+                                wal_path=str(tmp_path / "s.wal"),
+                                failover_grace=30.0,
+                                repl_ack_policy="durable").start()
+        rs = RemoteStore(global_scheme.copy(), psock)
+        try:
+            must_poll_until(lambda: primary._replica_acks,
+                            timeout=10.0, desc="standby attached")
+            # healthy link: durable acks flow (and are actually protected)
+            rs.create("/registry/pods/d/durable0", make_pod("durable0"))
+            # standby gone after having attached: the gate must FAIL the
+            # write — never ack it unprotected
+            standby.stop()
+            must_poll_until(lambda: not primary._replica_acks,
+                            timeout=10.0, desc="replica feed detached")
+            with pytest.raises(ApiError) as ei:
+                rs.create("/registry/pods/d/durable1", make_pod("durable1"))
+            assert ei.value.code == 503
+            assert client_retry.is_transient(ei.value), \
+                "durable-gate failures must be retriable by policy"
+            # the commit itself landed on the primary — but the retry's
+            # AlreadyExists answer proves that state, so it is gated too
+            # (laundering an unreplicated commit into an ack would lose
+            # it if the primary died here)
+            with pytest.raises(ApiError) as ei:
+                rs.create("/registry/pods/d/durable1", make_pod("durable1"))
+            assert ei.value.code == 503
+            assert primary.unprotected_acks == 0
+            # a fresh standby reattaches and resyncs: the same retry now
+            # gets the REAL answer (AlreadyExists — durably proven), and
+            # new writes ack again
+            standby2 = StandbyServer(psock, str(tmp_path / "standby2.sock"),
+                                     wal_path=str(tmp_path / "s2.wal"),
+                                     failover_grace=30.0,
+                                     repl_ack_policy="durable").start()
+            try:
+                must_poll_until(lambda: primary._replica_acks,
+                                timeout=10.0, desc="standby reattached")
+                with pytest.raises(ApiError) as ei:
+                    rs.create("/registry/pods/d/durable1",
+                              make_pod("durable1"))
+                assert ei.value.code == 409, \
+                    "caught-up standby: the gated conflict answer ships"
+                rs.create("/registry/pods/d/durable2", make_pod("durable2"))
+                must_poll_until(
+                    lambda: (standby2.store.current_revision()
+                             == store.current_revision()),
+                    timeout=10.0, desc="standby2 converged")
+                assert primary.unprotected_acks == 0
+                assert isinstance(  # wire round-trip keeps the 503 class
+                    ei.value, ApiError)
+            finally:
+                standby2.stop()
+        finally:
+            rs.close()
+            primary.stop()
+
+    def test_policy_arg_validated(self, tmp_path):
+        store = Store(global_scheme.copy())
+        with pytest.raises(ValueError):
+            StoreServer(store, str(tmp_path / "x.sock"),
+                        repl_ack_policy="quorum")
+        store.close()
+
+
+# ------------------------------------------------------- short chaos smoke
+
+
+class TestChaosSmoke:
+    """One short seeded schedule in tier-1 (the multi-seed sweep with the
+    primary kill is the `slow` tier in tests/test_chaos.py)."""
+
+    @pytest.mark.thread_leak_ok  # full in-process topology
+    def test_short_schedule_holds_invariants(self, tmp_path):
+        from scripts.chaos import run_schedule
+
+        v = run_schedule(7, duration=2.5, kill_primary=False,
+                         tmpdir=str(tmp_path))
+        assert v["ok"], v
+        assert v["lost"] == []
+        assert v["informer_converged"]
+        assert v["revision_order_ok"]
+        assert v["injected"], "schedule fired no faults at all"
+
+    @pytest.mark.thread_leak_ok  # full in-process topology
+    def test_identity_when_unset(self, tmp_path):
+        # same invariant suite, injector never activated: everything
+        # passes untouched and zero faults are recorded
+        from scripts.chaos import run_schedule
+
+        v = run_schedule(7, duration=1.5, kill_primary=False, spec="",
+                         tmpdir=str(tmp_path))
+        assert v["ok"], v
+        assert v["injected"] == {}
+        assert v["lost"] == []
+
+
+# --------------------------------------- informer under injected faults
+
+
+class TestInformerUnderFaults:
+    @pytest.mark.thread_leak_ok  # Master's HTTP worker threads
+    def test_watch_truncation_converges_losslessly(self):
+        """Injected mid-stream watch cuts: the informer reconnects from
+        the last delivered rv (counted), relists only when needed, and
+        the cache ends byte-equal to the authoritative list."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.configmaps, namespace="default")
+        try:
+            inf.start()
+            assert inf.wait_for_sync(10.0)
+            faultline.activate(5, "client.watch=drop@0.25")
+            try:
+                for i in range(40):
+                    cm = t.ConfigMap(data={"i": str(i)})
+                    cm.metadata.name = f"trunc-{i}"
+                    cs.configmaps.create(cm, "default")
+                    time.sleep(0.01)
+                deadline = time.monotonic() + 30.0
+                want = {f"trunc-{i}" for i in range(40)}
+                while time.monotonic() < deadline:
+                    if {o.metadata.name for o in inf.list()} >= want:
+                        break
+                    time.sleep(0.1)
+            finally:
+                faultline.deactivate()
+            assert {o.metadata.name for o in inf.list()} >= want
+            # the recovery paths actually ran: at least one mid-stream
+            # reconnect (the drop site fires on every frame read)
+            assert inf.reconnects >= 1, (inf.reconnects, inf.relists)
+            assert inf.relists >= 1  # initial sync at minimum
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()

@@ -305,3 +305,102 @@ class TestLaggardStandby:
         assert first < REPLICATION_ACK_TIMEOUT_SECONDS + 3.0
         assert second < 1.0
         assert not primary._replica_acks  # standby really was dropped
+
+    @pytest.mark.thread_leak_ok  # in-process standby worker threads
+    def test_laggard_drop_keeps_expectation_with_healthy_standby(
+            self, tmp_path, request):
+        """Dropping a laggard must NOT disarm the replication expectation
+        while another healthy standby remains attached: a later flap of
+        the healthy link still has to gate write acks (regression — the
+        global disarm silently reopened the unprotected reconnect window
+        for the survivor)."""
+        from kubernetes1_tpu.storage.server import (
+            REPLICATION_ACK_TIMEOUT_SECONDS,
+        )
+
+        d = str(tmp_path)
+        psock = os.path.join(d, "p.sock")
+        store = Store(global_scheme.copy())
+        primary = StoreServer(store, psock).start()
+        request.addfinalizer(primary.stop)
+        # the laggard: a subprocess standby this test can SIGSTOP
+        proc = _spawn(
+            [sys.executable, "-m", "kubernetes1_tpu.storage",
+             "--socket", os.path.join(d, "s1.sock"), "--standby-of", psock],
+            os.path.join(d, "standby1.log"))
+
+        def reap():
+            for sig in (signal.SIGCONT, signal.SIGKILL):
+                try:
+                    os.killpg(proc.pid, sig)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            proc.wait(timeout=10)
+
+        request.addfinalizer(reap)
+        # the healthy survivor: in-process, so stop() can flap its link
+        healthy = StandbyServer(psock, os.path.join(d, "s2.sock"),
+                                failover_grace=60.0).start()
+        request.addfinalizer(healthy.stop)
+        must_poll_until(lambda: len(primary._replica_acks) == 2,
+                        timeout=20.0, desc="both standbys attached")
+        rs = RemoteStore(global_scheme.copy(), psock)
+        request.addfinalizer(rs.close)
+        rs.create("/registry/pods/d/warm", make_pod("warm"))
+        os.killpg(proc.pid, signal.SIGSTOP)  # standby 1 wedges
+        # pays the ack timeout once; the laggard is dropped
+        rs.create("/registry/pods/d/during", make_pod("during"))
+        with primary._repl_cond:
+            assert len(primary._replica_acks) == 1, \
+                "healthy standby must survive the laggard drop"
+            assert primary._expect_replicas, \
+                "expectation must stay armed while a standby remains"
+        # the survivor's link now drops: the next write must WAIT for a
+        # reattach (timing out into a COUNTED unprotected ack), never
+        # fast-ack silently into the flap window
+        before = primary.unprotected_acks
+        healthy.stop()
+        must_poll_until(lambda: not primary._replica_acks, timeout=10.0,
+                        desc="healthy standby detached")
+        t0 = time.monotonic()
+        rs.create("/registry/pods/d/after", make_pod("after"))
+        waited = time.monotonic() - t0
+        assert waited >= 1.0, \
+            f"write fast-acked into the flap window after {waited:.2f}s"
+        assert waited < REPLICATION_ACK_TIMEOUT_SECONDS + 3.0
+        assert primary.unprotected_acks == before + 1
+
+
+class TestBatchUnprotectedAckCount:
+    @pytest.mark.thread_leak_ok  # in-process standby worker threads
+    def test_timed_out_gate_counts_every_batch_member(
+            self, tmp_path, request):
+        """A group commit gates N ops on ONE replication wait: when that
+        wait times out into an unprotected ack, all N successful ops ship
+        unprotected — the exposure counter must grow by N, not by 1
+        (regression: the transition batch undercounted by N-1)."""
+        d = str(tmp_path)
+        psock = os.path.join(d, "p.sock")
+        store = Store(global_scheme.copy())
+        primary = StoreServer(store, psock).start()
+        request.addfinalizer(primary.stop)
+        standby = StandbyServer(psock, os.path.join(d, "s.sock"),
+                                failover_grace=60.0).start()
+        must_poll_until(lambda: primary._replica_acks, timeout=20.0,
+                        desc="standby attached")
+        standby.stop()  # link drops; expectation stays armed
+        must_poll_until(lambda: not primary._replica_acks, timeout=10.0,
+                        desc="standby detached")
+        with primary._repl_cond:
+            assert primary._expect_replicas
+        rs = RemoteStore(global_scheme.copy(), psock)
+        request.addfinalizer(rs.close)
+        before = primary.unprotected_acks
+        scheme = global_scheme.copy()
+        out = rs.commit_batch([
+            {"op": "create", "key": f"/registry/pods/d/b{i}",
+             "obj": scheme.encode(make_pod(f"b{i}"))}
+            for i in range(3)])
+        assert all("obj" in r for r in out)
+        assert primary.unprotected_acks == before + 3, \
+            f"expected +3 exposed acks, got +{primary.unprotected_acks - before}"
