@@ -150,10 +150,14 @@ def bench_density():
     from kubernetes1_tpu.client import retry as client_retry
     from kubernetes1_tpu.utils.slo import StartupSLITracker
 
+    from kubernetes1_tpu.controllers import job as job_ctrl
+
     tmp = tempfile.mkdtemp(prefix="ktpu-bench-")
     # robustness counters (BENCH_r06+): delta the process-wide client
-    # retry counter across this phase only
+    # retry counter across this phase only; same contract for the gang
+    # recovery counters (BENCH_r07+)
     retries_before = client_retry.retries_snapshot()
+    gang_before = job_ctrl.gang_recovery_snapshot()
     master = Master().start()
     cs = Clientset(master.url)
     sched = Scheduler(cs)
@@ -221,11 +225,17 @@ def bench_density():
     sched_lat = sorted(sched_at[nm] - created[nm] for nm in sched_at)
     sched_p50 = _pct(sched_lat, 0.50)
 
-    # verify every running pod actually got a distinct chip assignment
+    # verify every running pod actually got a distinct chip assignment,
+    # and run the device double-allocation invariant over LIVE pods (the
+    # same helper the chaos node schedules sample under fault injection)
+    from kubernetes1_tpu.scheduler.devices import find_double_allocations
+
+    final_pods = cs.pods.list(namespace="default")[0]
     assigned = []
-    for p in cs.pods.list(namespace="default")[0]:
+    for p in final_pods:
         for er in p.spec.extended_resources:
             assigned.extend(er.assigned)
+    double_allocations = len(find_double_allocations(final_pods))
     distinct = len(set(assigned))
 
     # read-path economics for this phase (BENCH_r06 delta vs r05): how
@@ -259,12 +269,31 @@ def bench_density():
     # into the benchmark.  The chaos tier (scripts/chaos.py) exercises the
     # same counters under seeded fault schedules, incl. standby resyncs
     # (this single-store topology has no standby).
+    gang_now = job_ctrl.gang_recovery_snapshot()
     robustness = {
         "client_retries": client_retry.retries_delta(retries_before),
         "apiserver_shed_total": master.inflight.shed_total,
         "apiserver_peak_inflight_mutating": master.inflight.peak_mutating,
         "wal_torn_tail_repairs": getattr(
             master.store, "wal_torn_tail_repairs", 0),
+        # gang failure-domain surface (BENCH_r07+): counts are THIS phase's
+        # delta (the counters are process-cumulative, same contract as
+        # client_retries) — a clean density run shows zero recoveries/
+        # attempts and zero double-allocations; nonzero means real member
+        # deaths happened mid-bench.  MTTR quantiles are reported only when
+        # this phase recovered something (a cumulative quantile would leak
+        # other phases' distributions).  The chaos node schedules
+        # (scripts/chaos.py --schedule node-all) exercise the same counters
+        # under seeded node-kill / kubelet-restart / chip-death failures.
+        "gang_recovery": {
+            "recoveries": gang_now["recoveries"] - gang_before["recoveries"],
+            "mttr_p50_s": job_ctrl.gang_recovery_seconds.quantile(0.5)
+            if gang_now["recoveries"] > gang_before["recoveries"] else None,
+            "mttr_p99_s": job_ctrl.gang_recovery_seconds.quantile(0.99)
+            if gang_now["recoveries"] > gang_before["recoveries"] else None,
+            "attempts": gang_now["attempts"] - gang_before["attempts"],
+            "double_allocations": double_allocations,
+        },
     }
 
     sli_phases = sli.report()

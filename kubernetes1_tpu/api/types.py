@@ -53,6 +53,12 @@ NOMINATED_NODE_ANNOTATION = "scheduler.ktpu.io/nominated-node"
 # Job completion index annotation+env (reference gap; needed for TPU worker id)
 COMPLETION_INDEX_ANNOTATION = "batch.ktpu.io/completion-index"
 JOB_NAME_LABEL = "batch.ktpu.io/job-name"
+# Gang attempt: an ICI slice is all-or-nothing on the FAILURE path too —
+# when a gang member dies the Job controller tears the whole gang down and
+# recreates it as a fresh attempt.  The counter lives as an annotation on
+# the Job (current attempt) and as this label on every member pod, so a
+# restarted controller reconstructs attempt membership from the API alone.
+GANG_ATTEMPT_LABEL = "batch.ktpu.io/gang-attempt"
 # Mirror pods: static-manifest pods the kubelet itself publishes to the
 # apiserver (ref: kubetypes.ConfigMirrorAnnotationKey). NodeRestriction
 # admission only lets a node credential create pods carrying this marker.

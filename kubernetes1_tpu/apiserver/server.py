@@ -1053,6 +1053,13 @@ class _Handler(BaseHTTPRequestHandler):
         # in a LocalCluster) shares this counter; remote components export
         # it from their own /metrics
         extra.append(_client_retry.retries_total.render().rstrip("\n"))
+        # gang failure-domain surface (module-level in controllers/job.py,
+        # same aggregation contract as the retry counter): member-death ->
+        # all-members-Running MTTR + whole-gang recreate attempts
+        from ..controllers import job as _job_ctrl
+
+        extra.append(_job_ctrl.gang_recovery_seconds.render().rstrip("\n"))
+        extra.append(_job_ctrl.gang_attempts_total.render().rstrip("\n"))
         # write-path economics (in-process store only; a remote store
         # exports these from its own process): group-commit occupancy and
         # the fan-out coalescing ratio — wakeups-per-event < 1.0 means

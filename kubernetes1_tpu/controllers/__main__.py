@@ -17,6 +17,13 @@ def main():
     ap.add_argument("--token", default="")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--identity", default="kcm-0")
+    ap.add_argument("--metrics-port", type=int, default=-1,
+                    help="/metrics + /healthz port (0 = ephemeral, -1 = off);"
+                         " exports the gang failure-domain surface "
+                         "(ktpu_gang_recovery_seconds MTTR, attempts, node "
+                         "eviction counters) from a standalone controller "
+                         "manager — in-process topologies read them off the "
+                         "apiserver's /metrics instead")
     ap.add_argument("--node-monitor-grace", type=float, default=40.0)
     ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
     ap.add_argument("--ca-key-file", default="", help="CSR signing key")
@@ -43,6 +50,26 @@ def main():
         sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
     )
     cm.start()
+    metrics_server = None
+    if args.metrics_port >= 0:
+        from ..utils.metrics import MetricsServer, Registry
+        from . import job as _job
+
+        reg = Registry()
+        reg.register(_job.gang_recovery_seconds)
+        reg.register(_job.gang_attempts_total)
+        reg.register(cm.node_lifecycle.evictions_total)
+        reg.register(cm.node_lifecycle.errors_total)
+        reg.register(cm.node_lifecycle.not_ready_total)
+        try:
+            metrics_server = MetricsServer(reg, port=args.metrics_port).start()
+            print(f"controller manager metrics on {metrics_server.url}",
+                  flush=True)
+        except OSError as e:
+            # a busy port must not take down the control loops (the
+            # scheduler entrypoint makes the same call)
+            print(f"controller manager: metrics endpoint unavailable "
+                  f"(port {args.metrics_port}): {e}", flush=True)
     print("controller manager running", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -51,6 +78,8 @@ def main():
     from ..utils.procutil import bounded_exit
 
     bounded_exit(5.0)
+    if metrics_server is not None:
+        metrics_server.stop()
     cm.stop()
 
 

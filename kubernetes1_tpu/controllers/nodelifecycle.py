@@ -5,7 +5,17 @@ Ref: pkg/controller/node/node_controller.go with the reference's defaults
 monitor_grace goes NotReady; after eviction_timeout its pods are deleted so
 their controllers recreate them elsewhere — the elastic-restart primitive
 for preemptible TPU slices (a reclaimed v5e host's workers re-form on new
-hosts via the Job controller's index-preserving recreate).
+hosts via the Job controller's gang failure policy).
+
+Every API mutation routes through client/retry's shared policy (standing
+invariant): transient failures — link faults, overload sheds, 5xx — back
+off with full jitter and retry in place; Conflict re-runs the
+read-modify-write closure; errors that outlive the budget are COUNTED
+(errors_total) and retried by the next monitor pass, which recomputes the
+world from the informer (the loop is level-triggered, so a dropped write
+is delayed, never lost).  Evictions are counted exactly once per pod
+(evictions_total) with an Event on each — the chaos tier's
+NotReady→eviction-fires-exactly-once verdict reads these counters.
 """
 
 from __future__ import annotations
@@ -15,8 +25,10 @@ import time
 import traceback
 from ..api import types as t
 from ..client import Clientset, EventRecorder, InformerFactory
-from ..machinery import ApiError, now_iso
+from ..client import retry as _retry
+from ..machinery import ApiError, Conflict, NotFound, now_iso
 from ..machinery.meta import parse_iso
+from ..utils.metrics import Counter
 
 
 class NodeLifecycleController:
@@ -39,8 +51,24 @@ class NodeLifecycleController:
         self.eviction_timeout = eviction_timeout
         self.monitor_interval = monitor_interval
         self._not_ready_since: dict = {}
+        # uids whose eviction was already counted+evented: the informer may
+        # not deliver the deletion_timestamp before the next monitor pass,
+        # and the exactly-once contract must not ride on watch latency.
+        # Pruned against the live pod list each pass (a gone pod can never
+        # be re-evicted), so it stays bounded under churn.
+        self._evicted_uids: set = set()
         self._stop = threading.Event()
         self._thread = None
+        # instance-level counters (not Registry-bound): scraped by
+        # bench.py/scripts/chaos.py for exactly-once verdicts
+        self.evictions_total = Counter(
+            "ktpu_node_evictions_total", "pods evicted off failed nodes")
+        self.errors_total = Counter(
+            "ktpu_nodelifecycle_errors_total",
+            "API errors surviving the retry budget + monitor-pass crashes")
+        self.not_ready_total = Counter(
+            "ktpu_node_not_ready_transitions_total",
+            "Ready->Unknown transitions this controller marked")
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -54,8 +82,38 @@ class NodeLifecycleController:
         while not self._stop.wait(self.monitor_interval):
             try:
                 self._monitor()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — monitor must survive; counted + next pass retries
+                self.errors_total.inc()
                 traceback.print_exc()
+
+    def _mutate(self, closure):
+        """One read-modify-write through the shared retry policy: transient
+        failures back off with jitter, Conflict re-runs the closure against
+        a fresh read.  Returns the closure's result, or None once the
+        budget runs out / the object is gone — the next monitor pass
+        recomputes and retries, so None is a delay, not a loss."""
+        try:
+            return _retry.call_with_retries(
+                lambda: _retry.retry_on_conflict(closure),
+                steps=3, reason="nodelifecycle")
+        except NotFound:
+            return None  # already gone: the desired state holds
+        except Conflict:
+            return None  # persistent write race: next pass re-reads
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            self.errors_total.inc()
+            return None
+
+    def _delete_pod(self, pod: t.Pod, grace_seconds=None) -> bool:
+        def op():
+            if grace_seconds is None:
+                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+            else:
+                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace,
+                                    grace_seconds=grace_seconds)
+            return True
+
+        return bool(self._mutate(op))
 
     def _ready_condition(self, node: t.Node):
         for cond in node.status.conditions:
@@ -65,6 +123,8 @@ class NodeLifecycleController:
 
     def _monitor(self):
         now = time.time()  # ktpulint: ignore[KTPU005] vs heartbeat API timestamps
+        if self._evicted_uids:
+            self._evicted_uids &= {p.metadata.uid for p in self.pods.list()}
         for node in self.nodes.list():
             name = node.metadata.name
             cond = self._ready_condition(node)
@@ -107,26 +167,32 @@ class NodeLifecycleController:
         """TaintBasedEvictions (feature-gated, alpha in the reference): a
         failing node gets the not-ready:NoExecute taint — the effect the
         DefaultTolerationSeconds tolerations actually match."""
-        try:
-            fresh = self.cs.nodes.get(node.metadata.name, "")
+        name = node.metadata.name
+
+        def apply():
+            fresh = self.cs.nodes.get(name, "")
             if any(tt.key == self.NOT_READY_TAINT for tt in fresh.spec.taints):
-                return
+                return False
             fresh.spec.taints.append(
                 t.Taint(key=self.NOT_READY_TAINT, effect="NoExecute"))
             self.cs.nodes.update(fresh)
-        except ApiError:
-            pass
+            return True
+
+        self._mutate(apply)
 
     def _remove_not_ready_taint(self, node: t.Node):
-        try:
-            fresh = self.cs.nodes.get(node.metadata.name, "")
+        name = node.metadata.name
+
+        def remove():
+            fresh = self.cs.nodes.get(name, "")
             kept = [tt for tt in fresh.spec.taints
                     if tt.key != self.NOT_READY_TAINT]
             if len(kept) != len(fresh.spec.taints):
                 fresh.spec.taints = kept
                 self.cs.nodes.update(fresh)
-        except ApiError:
-            pass
+            return True
+
+        self._mutate(remove)
 
     def _evict_by_toleration(self, node: t.Node, not_ready_for: float):
         """NoExecute semantics (ref: the taint manager): a pod with no
@@ -149,41 +215,49 @@ class NodeLifecycleController:
                 if not_ready_for <= max(s for s in seconds):
                     continue  # still within its grace window
             if pod.metadata.deletion_timestamp:
-                try:  # kubelet is gone; force-finalize so it reschedules
-                    self.cs.pods.delete(
-                        pod.metadata.name, pod.metadata.namespace, grace_seconds=0)
-                except ApiError:
-                    pass
+                # kubelet is gone; force-finalize so it reschedules — the
+                # eviction was already counted when the first delete landed
+                self._delete_pod(pod, grace_seconds=0)
                 continue
-            try:
-                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+            if pod.metadata.uid in self._evicted_uids:
+                continue  # counted; waiting on the watch to show the delete
+            if self._delete_pod(pod):
+                # the delete stamps deletion_timestamp, so later passes take
+                # the force-finalize branch above: exactly one count + Event
+                # per evicted pod
+                self._evicted_uids.add(pod.metadata.uid)
+                self.evictions_total.inc()
                 self.recorder.event(
                     pod, "Warning", "TaintEviction",
                     f"evicted: node {node.metadata.name} not-ready past "
                     f"the pod's toleration",
                 )
-            except ApiError:
-                pass
 
     def _mark_not_ready(self, node: t.Node):
-        try:
-            fresh = self.cs.nodes.get(node.metadata.name, "")
+        name = node.metadata.name
+
+        def mark():
+            fresh = self.cs.nodes.get(name, "")
             cond = self._ready_condition(fresh)
             if cond is None:
                 cond = t.NodeCondition(type=t.NODE_READY)
                 fresh.status.conditions.append(cond)
-            if cond.status != "Unknown":
-                cond.status = "Unknown"
-                cond.reason = "NodeStatusUnknown"
-                cond.message = "kubelet stopped posting node status"
-                cond.last_transition_time = now_iso()
-                self.cs.nodes.update_status(fresh)
-                self.recorder.event(
-                    fresh, "Warning", "NodeNotReady",
-                    f"node {node.metadata.name} heartbeat stale",
-                )
-        except ApiError:
-            pass
+            if cond.status == "Unknown":
+                return None  # someone (or a prior pass) already marked it
+            cond.status = "Unknown"
+            cond.reason = "NodeStatusUnknown"
+            cond.message = "kubelet stopped posting node status"
+            cond.last_transition_time = now_iso()
+            self.cs.nodes.update_status(fresh)
+            return fresh
+
+        fresh = self._mutate(mark)
+        if fresh is not None:
+            self.not_ready_total.inc()
+            self.recorder.event(
+                fresh, "Warning", "NodeNotReady",
+                f"node {name} heartbeat stale",
+            )
 
     def _evict_pods(self, node: t.Node):
         for pod in self.pods.list():
@@ -193,19 +267,16 @@ class NodeLifecycleController:
                 continue  # finished pods hold no resources; leave the record
             if pod.metadata.deletion_timestamp:
                 # kubelet is gone and can't finalize: force delete so the
-                # controller can replace the pod
-                try:
-                    self.cs.pods.delete(
-                        pod.metadata.name, pod.metadata.namespace, grace_seconds=0
-                    )
-                except ApiError:
-                    pass
+                # controller can replace the pod (not a new eviction — it
+                # was counted when the graceful delete landed)
+                self._delete_pod(pod, grace_seconds=0)
                 continue
-            try:
-                self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
+            if pod.metadata.uid in self._evicted_uids:
+                continue  # counted; waiting on the watch to show the delete
+            if self._delete_pod(pod):
+                self._evicted_uids.add(pod.metadata.uid)
+                self.evictions_total.inc()
                 self.recorder.event(
                     pod, "Warning", "NodeEviction",
                     f"evicted: node {node.metadata.name} unreachable",
                 )
-            except ApiError:
-                pass

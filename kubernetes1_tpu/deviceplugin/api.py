@@ -27,7 +27,7 @@ import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
 
@@ -222,6 +222,10 @@ class PluginClient:
         self._next_id = 0
 
     def _connect(self, retry_window: float = 3.0):
+        # fault injection: a dropped dial looks exactly like a plugin that
+        # is down — the device manager's retriable-admit grace and the
+        # endpoint watch loop's reconnect must absorb it
+        faultline.check("plugin.dial")
         # bounded dial retry: the plugin's socket FILE appears at bind(),
         # a beat before listen() — the plugin watcher (and tests) race
         # that gap and must not fail a plugin that is 10ms from ready
@@ -245,6 +249,10 @@ class PluginClient:
 
     def call(self, method: str, params: Optional[dict] = None):
         with self._lock:
+            # covers every unary RPC on the plugin socket — AdmitPod,
+            # InitContainer, GetPluginInfo.  An injected drop surfaces as
+            # the ConnectionError the admit path classifies RETRIABLE.
+            faultline.check("plugin.rpc")
             self._ensure()
             self._next_id += 1
             rid = self._next_id
@@ -266,6 +274,7 @@ class PluginClient:
 
     def list_and_watch(self) -> Iterator[List[dict]]:
         """Dedicated streaming connection yielding device lists."""
+        faultline.check("plugin.watch")
         conn = self._connect()
         conn.settimeout(None)  # stream blocks until the plugin pushes
         f = conn.makefile("rwb")
@@ -275,6 +284,10 @@ class PluginClient:
         def gen():
             try:
                 for line in f:
+                    # an injected drop mid-stream ends it like a plugin
+                    # crash; the endpoint watch loop redials — FaultInjected
+                    # is a ConnectionError, caught by the OSError arm below
+                    faultline.check("plugin.watch")
                     frame = json.loads(line)
                     yield (frame.get("result") or {}).get("devices") or []
             except (ConnectionResetError, OSError, ValueError):

@@ -32,7 +32,7 @@ from typing import Dict, List, Optional
 
 from .. import TPU_RESOURCE
 from ..api import types as t
-from ..utils import locksan
+from ..utils import faultline, locksan
 from .api import (
     DEFAULT_PLUGIN_DIR,
     ContainerSpec,
@@ -157,6 +157,8 @@ class TPUDevicePlugin:
                 dirty.wait(self.health_check_interval)
                 if stop.is_set():
                     return
+                if self._inject_chip_death():
+                    send(self.list_devices())
                 if dirty.is_set():
                     dirty.clear()
                     send(self.list_devices())
@@ -168,6 +170,25 @@ class TPUDevicePlugin:
                     self._subscribers.remove(dirty)
                 except ValueError:
                     pass
+
+    def _inject_chip_death(self) -> Optional[str]:
+        """faultline ``device.health`` site: an injected fault on a health
+        pass IS a chip dying — flip one healthy device unhealthy so the
+        ListAndWatch stream carries the transition exactly like real-mode
+        discovery of a vanished /dev/accel node.  The chaos chip-death
+        schedules drive recovery through this seam; identity when no
+        injector is active."""
+        if not faultline.active():
+            return None
+        try:
+            faultline.check("device.health")
+        except faultline.FaultInjected:
+            with self._lock:
+                for d in self.devices:
+                    if d["health"] == t.DEVICE_HEALTHY:
+                        d["health"] = t.DEVICE_UNHEALTHY
+                        return d["id"]
+        return None
 
     def _check_health(self, send):
         """Real mode: a vanished /dev/accel node marks its chip unhealthy."""

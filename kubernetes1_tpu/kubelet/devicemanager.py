@@ -126,6 +126,12 @@ class DeviceManager:
         self._endpoints: Dict[str, Endpoint] = {}  # resource -> endpoint
         self._store: Dict[str, List[dict]] = {}  # resource -> device dicts
         self._admit_cache: Dict[str, dict] = {}  # pod uid -> admit result
+        # device ids the PLUGIN ITSELF reported unhealthy (per resource).
+        # Distinct from store_mark_unhealthy's synthetic staleness marking:
+        # only an explicit ListAndWatch unhealthy report means the chip is
+        # actually dead — endpoint/socket death must never kill running
+        # workloads (the kubelet-restart / plugin-restart contract).
+        self._reported_unhealthy: Dict[str, set] = {}
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self.allocation_latency = Histogram(
@@ -133,6 +139,12 @@ class DeviceManager:
             "AdmitPod RPC latency (the fork's DevicePluginAllocationLatency)",
         )
         self.on_capacity_change = None  # callback for node-status push
+        # callback(resource, [device ids]) fired once per plugin-reported
+        # healthy->unhealthy transition: the kubelet fails running pods
+        # holding those devices so their controller/gang policy reacts —
+        # without it a dead chip only blocks FUTURE admits while the pod
+        # that holds it spins on a bricked device forever
+        self.on_device_unhealthy = None
 
     # ------------------------------------------------------ plugin watching
 
@@ -200,8 +212,24 @@ class DeviceManager:
     # ----------------------------------------------------------- the store
 
     def store_update(self, resource: str, devices: List[dict]):
+        lost: List[str] = []
         with self._lock:
+            reported = self._reported_unhealthy.setdefault(resource, set())
+            for d in devices:
+                if d.get("health") == t.DEVICE_HEALTHY:
+                    reported.discard(d["id"])
+                elif d["id"] not in reported:
+                    # a NEW plugin-reported death (first frame after a
+                    # kubelet restart counts too: the chip may have died
+                    # while the kubelet was down)
+                    reported.add(d["id"])
+                    lost.append(d["id"])
             self._store[resource] = devices
+        if lost and self.on_device_unhealthy:
+            try:
+                self.on_device_unhealthy(resource, lost)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
         if self.on_capacity_change:
             try:
                 self.on_capacity_change()
@@ -209,6 +237,10 @@ class DeviceManager:
                 traceback.print_exc()
 
     def store_mark_unhealthy(self, resource: str):
+        """Inventory no longer trustworthy (endpoint/socket gone): blocks
+        FUTURE admits only.  Deliberately does NOT fire on_device_unhealthy
+        — a restarting plugin must not kill the healthy workloads it was
+        serving (their truth arrives with the next ListAndWatch frame)."""
         with self._lock:
             for d in self._store.get(resource, []):
                 d["health"] = t.DEVICE_UNHEALTHY

@@ -82,6 +82,7 @@ class Kubelet:
         self.runtime = runtime
         self.device_manager = DeviceManager(plugin_dir)
         self.device_manager.on_capacity_change = self._heartbeat_now
+        self.device_manager.on_device_unhealthy = self._on_device_unhealthy
         self.static_pod_dir = static_pod_dir
         self.node_labels = node_labels or {}
         self.capacity = capacity or self._default_capacity()
@@ -610,6 +611,32 @@ class Kubelet:
         self.recorder.event(pod, "Warning", "Evicted", reason)
         self._set_failed(pod, "Evicted", reason)
         self._heartbeat_now()  # surface the pressure condition promptly
+
+    def _on_device_unhealthy(self, resource: str, dead_ids):
+        """A plugin reported chips dead (ListAndWatch unhealthy): fail every
+        pod holding one of them.  Admit-time checks only protect FUTURE
+        pods; an already-running pod on a bricked chip makes no progress
+        until its controller (the gang failure policy) replaces it — every
+        second here is lost goodput.  Runs on the endpoint's watch thread;
+        _set_failed is a plain status PUT, safe off-loop."""
+        dead = set(dead_ids)
+        for pod in self.pods.list():
+            if (pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+                    or pod.metadata.deletion_timestamp):
+                continue
+            held = {
+                dev_id
+                for per in pod.spec.extended_resources
+                if per.resource == resource
+                for dev_id in per.assigned
+            }
+            hit = held & dead
+            if not hit:
+                continue
+            msg = (f"assigned device(s) {sorted(hit)} went unhealthy; "
+                   f"failing pod so its controller can re-place it")
+            self.recorder.event(pod, "Warning", "DeviceUnhealthy", msg)
+            self._set_failed(pod, "DeviceUnhealthy", msg)
 
     def _eviction_pass(self):
         self.eviction.synchronize()

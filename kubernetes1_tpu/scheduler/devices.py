@@ -142,3 +142,27 @@ def fits_devices(pod: t.Pod, node_info) -> Tuple[bool, str]:
 
 def has_extended_resources(pod: t.Pod) -> bool:
     return bool(pod.spec.extended_resources)
+
+
+def find_double_allocations(pods) -> List[dict]:
+    """Device double-allocation invariant: every (resource, device id) is
+    held by at most one LIVE bound pod — finished and deleting pods have
+    released (or are releasing) their chips and don't count.  Returns one
+    ``{"device", "pods"}`` record per violation; shared by bench.py's
+    density scan and scripts/chaos.py's node-schedule sampler so the
+    invariant cannot drift between the two."""
+    seen: Dict[Tuple[str, str], str] = {}
+    dups: List[dict] = []
+    for p in pods:
+        if (p.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+                or p.metadata.deletion_timestamp
+                or not p.spec.node_name):
+            continue
+        for per in p.spec.extended_resources:
+            for dev in per.assigned:
+                key = (per.resource, dev)
+                if key in seen and seen[key] != p.metadata.name:
+                    dups.append({"device": dev,
+                                 "pods": [seen[key], p.metadata.name]})
+                seen[key] = p.metadata.name
+    return dups

@@ -704,12 +704,17 @@ class Scheduler:
     # ----------------------------------------------------------------- gang
 
     def _gang_members(self, pod: t.Pod) -> List[t.Pod]:
+        # finished pods are not members: a Failed-but-bound member (chip
+        # death, eviction) counting toward `bound` would let a partial gang
+        # look complete exactly when the Job controller is about to tear it
+        # down — the replacement attempt must be judged on live pods only
         return [
             p
             for p in self.pods.list()
             if p.metadata.namespace == pod.metadata.namespace
             and p.spec.scheduling_gang == pod.spec.scheduling_gang
             and not p.metadata.deletion_timestamp
+            and p.status.phase not in (t.POD_SUCCEEDED, t.POD_FAILED)
         ]
 
     def _schedule_gang(self, pod: t.Pod, start: Optional[float] = None):

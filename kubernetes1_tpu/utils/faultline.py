@@ -41,11 +41,24 @@ Spec grammar (documented in README "Fault injection & recovery")::
     first that fires wins.
 
 Wired sites:
-  client.dial / client.request / client.watch   (client/rest.py)
+  client.dial / client.request / client.watch   (client/rest.py — every
+                                                 apiserver client, incl. the
+                                                 kubelet's informer, status
+                                                 PUTs, and heartbeats)
   store.rpc / store.watch                       (storage/remote.py)
   repl.link                                     (storage/server.py sender,
                                                  storage/standby.py consumer)
   wal.write                                     (storage/store.py)
+  plugin.dial / plugin.rpc / plugin.watch       (deviceplugin/api.py: the
+                                                 kubelet<->device-plugin
+                                                 socket — dial, AdmitPod/
+                                                 InitContainer RPCs, and
+                                                 the ListAndWatch stream)
+  device.health                                 (deviceplugin/tpu_plugin.py:
+                                                 an injected fault on a
+                                                 health pass flips a chip
+                                                 unhealthy — seeded chip
+                                                 death through ListAndWatch)
 
 With no injector active every hook is identity — one module-global ``is
 None`` test on the hot path; no locks, no RNG, no allocation.

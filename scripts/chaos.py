@@ -61,6 +61,25 @@ DEFAULT_SPEC = (
 
 CONVERGE_TIMEOUT = 60.0
 
+# Data-plane schedule (node/slice failure domain): faults at the kubelet's
+# apiserver client AND the device-plugin socket, low enough that the node
+# agents keep making progress under fire.
+NODE_SPEC = (
+    "client.dial=drop@0.02;"
+    "client.request=drop@0.03|delay:5ms@0.05;"
+    "client.watch=drop@0.05;"
+    "plugin.dial=drop@0.03;"
+    "plugin.rpc=drop@0.05|delay:5ms@0.05;"
+    "plugin.watch=drop@0.05"
+)
+# chip-death adds seeded background chip deaths through the plugin's
+# device.health site (each injection = one chip flips unhealthy in the
+# ListAndWatch stream), on top of one deterministic kill of a chip the
+# gang actually holds.
+CHIP_DEATH_SPEC = NODE_SPEC + ";device.health=error@0.04"
+
+NODE_MODES = ("node-kill", "kubelet-restart", "chip-death")
+
 
 def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
                  spec: str = DEFAULT_SPEC, writers: int = 3,
@@ -322,6 +341,413 @@ def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
     return verdict
 
 
+def run_node_schedule(seed: int, mode: str = "node-kill", duration: float = 6.0,
+                      spec: str = None, recovery_bound: float = 60.0,
+                      tmpdir: str = "") -> dict:
+    """One seeded node/slice failure schedule against a full data-plane
+    topology: Master + scheduler + Job/NodeLifecycle controllers + 3 hollow
+    kubelets each serving a fake TPU plugin, running a gang-scheduled Job
+    under a faultline schedule at the kubelet's apiserver client AND the
+    device-plugin socket.  Mid-run one failure is injected per `mode`:
+
+      node-kill        the kubelet (and plugin) hosting a gang member dies
+                       outright — nodelifecycle must mark NotReady once,
+                       evict exactly once per pod, and the gang policy must
+                       re-place the whole gang on surviving nodes;
+      kubelet-restart  the member's kubelet is stopped and a FRESH Kubelet
+                       instance (no local state — the no-checkpoint design)
+                       takes over the same runtime/plugin dir: assignments
+                       must reconstruct from bound pod specs with zero
+                       recreates, zero evictions, zero spurious failures;
+      chip-death       a chip the gang holds goes unhealthy (plus seeded
+                       background deaths via the device.health site): the
+                       kubelet fails the holder, the gang recreates, and
+                       the replacement must exclude every dead chip.
+
+    Invariants checked in every mode: zero device double-allocations at
+    every sample point, zero acked configmap writes lost, and bounded
+    recovery; node-kill/chip-death additionally require a non-empty
+    ktpu_gang_recovery_seconds delta (the MTTR distribution)."""
+    import random as _random
+    import urllib.request
+
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset, InformerFactory
+    from kubernetes1_tpu.client import retry as client_retry
+    from kubernetes1_tpu.controllers import JobController, NodeLifecycleController
+    from kubernetes1_tpu.controllers import job as job_ctrl
+    from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+    from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+    from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+    from kubernetes1_tpu.machinery import AlreadyExists
+    from kubernetes1_tpu.scheduler import Scheduler
+    from kubernetes1_tpu.utils import faultline
+
+    if mode not in NODE_MODES:
+        raise ValueError(f"mode {mode!r} not in {NODE_MODES}")
+    if spec is None:
+        spec = CHIP_DEATH_SPEC if mode == "chip-death" else NODE_SPEC
+    own_tmp = not tmpdir
+    if own_tmp:
+        tmpdir = tempfile.mkdtemp(prefix=f"ktpu-chaos-node-{seed}-")
+    rnd = _random.Random(seed)
+    n_nodes, chips, gang_size, tpus_per_pod = 3, 8, 2, 2
+    # the restart gap must never look like node death; only node-kill
+    # wants a hair-trigger eviction clock
+    grace, evict_after = (2.5, 1.0) if mode == "node-kill" else (8.0, 4.0)
+
+    verdict = {"seed": seed, "mode": mode, "spec": spec}
+    retries_before = client_retry.retries_snapshot()
+    gang_before = job_ctrl.gang_recovery_snapshot()
+    master = cs = sched = jobc = nlc = factory = None
+    sched_cs = ctrl_cs = None
+    nodes = []  # dicts: name/kubelet/plugin/impl/runtime/cs/plugin_dir
+    stop = threading.Event()
+    threads = []
+    acked, dup_samples = [], []
+    try:
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched_cs = Clientset(master.url)
+        sched = Scheduler(sched_cs, gang_wait_seconds=5.0)
+        sched.start()
+        ctrl_cs = Clientset(master.url)
+        factory = InformerFactory(ctrl_cs)
+        jobc = JobController(ctrl_cs, factory)
+        jobc.gang_backoff_base = 0.2
+        jobc.gang_backoff_cap = 2.0
+        nlc = NodeLifecycleController(ctrl_cs, factory, monitor_grace=grace,
+                                      eviction_timeout=evict_after,
+                                      monitor_interval=0.25)
+        jobc.setup()
+        factory.start_all()
+        factory.wait_for_sync()
+        jobc.start_workers()
+        nlc.start()
+
+        def boot_kubelet(i: int) -> dict:
+            name = f"chaos-node-{i}"
+            plugin_dir = os.path.join(tmpdir, name)
+            impl = TPUDevicePlugin(
+                devices=_fake_devices(f"v5e:{chips}:s{i}:0"),
+                health_check_interval=0.5)
+            plugin = PluginServer(
+                impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+            plugin.start()
+            kcs = Clientset(master.url)
+            runtime = FakeRuntime()
+            kl = Kubelet(kcs, node_name=name, runtime=runtime,
+                         plugin_dir=plugin_dir, heartbeat_interval=0.5,
+                         sync_interval=0.2, pleg_interval=0.2,
+                         capacity={"cpu": "16", "memory": "64Gi", "pods": "110"})
+            kl.start()
+            return {"name": name, "kubelet": kl, "plugin": plugin,
+                    "impl": impl, "runtime": runtime, "cs": kcs,
+                    "plugin_dir": plugin_dir}
+
+        # faults are live from BEFORE the first kubelet boots: discovery,
+        # registration, and gang placement all run under the schedule
+        if spec:
+            faultline.activate(seed, spec)
+        for i in range(n_nodes):
+            nodes.append(boot_kubelet(i))
+
+        def ready_nodes():
+            try:
+                listed, _ = cs.nodes.list()
+            except Exception:  # noqa: BLE001 — mid-fault blip
+                return 0
+            return len([n for n in listed
+                        if n.status.extended_resources.get("google.com/tpu")])
+
+        deadline = time.monotonic() + 30.0
+        while ready_nodes() < n_nodes and time.monotonic() < deadline:
+            time.sleep(0.2)
+
+        job = t.Job()
+        job.metadata.name = f"chaos-gang-{seed}"
+        job.spec.completions = gang_size
+        job.spec.parallelism = gang_size
+        job.spec.completion_mode = "Indexed"
+        job.spec.gang_scheduling = True
+        # attempts are the thing under test, not exhaustion: a chip-death
+        # window can legitimately break the gang several times over
+        job.spec.backoff_limit = 50
+        c = t.Container(name="worker", image="jax-train", command=["serve"])
+        c.resources.limits = {"google.com/tpu": tpus_per_pod}
+        job.spec.template.spec.containers = [c]
+        cs.jobs.create(job)
+        selector = f"{t.JOB_NAME_LABEL}={job.metadata.name}"
+
+        def members(live_only: bool = True):
+            try:
+                pods, _ = cs.pods.list(namespace="default",
+                                       label_selector=selector)
+            except Exception:  # noqa: BLE001
+                return None
+            if live_only:
+                pods = [p for p in pods
+                        if p.status.phase not in (t.POD_SUCCEEDED, t.POD_FAILED)
+                        and not p.metadata.deletion_timestamp]
+            return pods
+
+        def all_running():
+            pods = members()
+            return (pods is not None and len(pods) == gang_size
+                    and all(p.status.phase == t.POD_RUNNING for p in pods))
+
+        deadline = time.monotonic() + 60.0
+        while not all_running() and time.monotonic() < deadline:
+            time.sleep(0.2)
+        if not all_running():
+            raise RuntimeError(f"gang never reached Running under schedule "
+                               f"(seed {seed})")
+        baseline = {p.metadata.name: {
+            "uid": p.metadata.uid,
+            "node": p.spec.node_name,
+            "attempt": (p.metadata.labels or {}).get(t.GANG_ATTEMPT_LABEL, "0"),
+            "assigned": sorted(i for per in p.spec.extended_resources
+                               for i in per.assigned),
+        } for p in members()}
+        container_count_before = sum(
+            len(n["runtime"].list_containers()) for n in nodes)
+
+        # ---- invariant samplers run through the fault window AND recovery
+        from kubernetes1_tpu.scheduler.devices import find_double_allocations
+
+        def double_alloc_pass():
+            try:
+                pods, _ = cs.pods.list(namespace="default")
+            except Exception:  # noqa: BLE001
+                return
+            dup_samples.extend(find_double_allocations(pods))
+
+        def sampler():
+            while not stop.is_set():
+                double_alloc_pass()
+                stop.wait(0.2)
+
+        def writer():
+            wcs = Clientset(master.url)
+            i = 0
+            while not stop.is_set():
+                name = f"chaos-node-{seed}-{i}"
+                cm = t.ConfigMap(data={"i": str(i)})
+                cm.metadata.name = name
+                try:
+                    wcs.configmaps.create(cm, "default")
+                except AlreadyExists:
+                    acked.append(name)
+                    i += 1
+                except Exception:  # noqa: BLE001 — mid-fault blip: retry same name
+                    pass
+                else:
+                    acked.append(name)
+                    i += 1
+                time.sleep(0.05)
+            wcs.close()
+
+        threads = [threading.Thread(target=sampler, daemon=True,
+                                    name="chaos-dup-sampler"),
+                   threading.Thread(target=writer, daemon=True,
+                                    name="chaos-node-writer")]
+        for th in threads:
+            th.start()
+
+        # ---- the mode's failure (seeded): chip-death picks the CHIP first
+        # and derives the victim node from its owner, so the verdict's
+        # victim always names the node the failure actually landed on
+        member_nodes = sorted({b["node"] for b in baseline.values()})
+        dead_chip = None
+        if mode == "chip-death":
+            held = sorted({i for b in baseline.values() for i in b["assigned"]})
+            dead_chip = rnd.choice(held)
+            verdict["killed_chip"] = dead_chip
+            victim = next(n["name"] for n in nodes
+                          if dead_chip in n["impl"]._by_id)
+        else:
+            victim = rnd.choice(member_nodes)
+        verdict["victim"] = victim
+        victim_handle = next(n for n in nodes if n["name"] == victim)
+        members_on_victim = sum(1 for b in baseline.values()
+                                if b["node"] == victim)
+        kill_t0 = time.monotonic()
+        if mode == "node-kill":
+            victim_handle["kubelet"].stop()
+            victim_handle["plugin"].stop()
+        elif mode == "kubelet-restart":
+            victim_handle["kubelet"].stop()
+            kcs = Clientset(master.url)
+            fresh = Kubelet(kcs, node_name=victim,
+                            runtime=victim_handle["runtime"],
+                            plugin_dir=victim_handle["plugin_dir"],
+                            heartbeat_interval=0.5, sync_interval=0.2,
+                            pleg_interval=0.2,
+                            capacity={"cpu": "16", "memory": "64Gi",
+                                      "pods": "110"})
+            fresh.start()
+            victim_handle["kubelet"] = fresh
+            victim_handle["extra_cs"] = kcs
+        else:  # chip-death: kill the chosen chip the gang actually holds
+            victim_handle["impl"].set_health(dead_chip, t.DEVICE_UNHEALTHY)
+
+        time.sleep(duration)
+        verdict["injected"] = faultline.stats()
+        faultline.deactivate()
+
+        # ---- recovery + invariants (faults OFF now)
+        def dead_chip_ids():
+            dead = set()
+            for n in nodes:
+                if mode == "node-kill" and n["name"] == victim:
+                    continue  # its inventory died with it
+                for dev_id, d in n["impl"]._by_id.items():
+                    if d.get("health") != t.DEVICE_HEALTHY:
+                        dead.add(dev_id)
+            return dead
+
+        def recovered():
+            pods = members()
+            if pods is None or len(pods) != gang_size:
+                return False
+            if not all(p.status.phase == t.POD_RUNNING for p in pods):
+                return False
+            if mode == "node-kill" and any(
+                    p.spec.node_name == victim for p in pods):
+                return False
+            if mode == "chip-death":
+                dead = dead_chip_ids()
+                for p in pods:
+                    for per in p.spec.extended_resources:
+                        if set(per.assigned) & dead:
+                            return False
+            if mode in ("node-kill", "chip-death"):
+                # a real recovery closed the MTTR window (histogram grew)
+                snap = job_ctrl.gang_recovery_snapshot()
+                if snap["recoveries"] <= gang_before["recoveries"]:
+                    return False
+            return True
+
+        recover_t0 = time.monotonic()
+        while (not recovered()
+               and time.monotonic() - kill_t0 < recovery_bound):
+            time.sleep(0.25)
+        verdict["recovered"] = recovered()
+        verdict["recovery_s"] = round(time.monotonic() - kill_t0, 2)
+        verdict["recovery_after_faults_s"] = round(
+            time.monotonic() - recover_t0, 2)
+
+        stop.set()
+        for th in threads:
+            th.join(timeout=10.0)
+        double_alloc_pass()  # one post-recovery sample
+
+        # acked configmap writes must all be listable (no acked-write loss)
+        lost = list(acked)
+        deadline = time.monotonic() + 15.0
+        while lost and time.monotonic() < deadline:
+            try:
+                names = {c.metadata.name
+                         for c in cs.configmaps.list(namespace="default")[0]}
+                lost = [n for n in acked if n not in names]
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.25)
+        verdict["acked"] = len(acked)
+        verdict["lost"] = lost
+
+        gang_now = job_ctrl.gang_recovery_snapshot()
+        verdict["gang_recovery"] = {
+            "recoveries": gang_now["recoveries"] - gang_before["recoveries"],
+            "attempts": gang_now["attempts"] - gang_before["attempts"],
+        }
+        verdict["double_allocations"] = dup_samples
+        verdict["not_ready_marks"] = int(nlc.not_ready_total.value)
+        verdict["evictions"] = int(nlc.evictions_total.value)
+        verdict["nodelifecycle_errors"] = int(nlc.errors_total.value)
+        verdict["client_retries"] = client_retry.retries_delta(retries_before)
+        try:
+            with urllib.request.urlopen(master.url + "/metrics", timeout=5) as r:
+                verdict["mttr_exported"] = \
+                    "ktpu_gang_recovery_seconds" in r.read().decode()
+        except Exception:  # noqa: BLE001
+            verdict["mttr_exported"] = False
+
+        ok = (verdict["recovered"] and not lost and not dup_samples
+              and len(acked) > 10 and verdict["mttr_exported"])
+        if mode == "node-kill":
+            # NotReady marked exactly once; the eviction machinery fired at
+            # most once per pod on the dead node and at least once overall
+            # (the gang teardown may force-finalize the victim's second
+            # member before the next eviction pass reaches it)
+            ok = ok and verdict["not_ready_marks"] == 1
+            ok = ok and 1 <= verdict["evictions"] <= members_on_victim
+            ok = ok and verdict["gang_recovery"]["recoveries"] >= 1
+        elif mode == "kubelet-restart":
+            # the no-checkpoint contract: reconstruction, not recovery —
+            # same uids, same attempt, same assignments, nothing evicted,
+            # no duplicate containers in the adopted runtime
+            after = {p.metadata.name: {
+                "uid": p.metadata.uid,
+                "attempt": (p.metadata.labels or {}).get(
+                    t.GANG_ATTEMPT_LABEL, "0"),
+                "assigned": sorted(i for per in p.spec.extended_resources
+                                   for i in per.assigned),
+            } for p in (members() or [])}
+            same = {k: {kk: after.get(k, {}).get(kk) for kk in
+                        ("uid", "attempt", "assigned")}
+                    for k in baseline} == \
+                   {k: {kk: baseline[k][kk] for kk in
+                        ("uid", "attempt", "assigned")}
+                    for k in baseline}
+            verdict["reconstructed"] = same
+            container_count_after = sum(
+                len(n["runtime"].list_containers()) for n in nodes)
+            verdict["containers_before_after"] = [
+                container_count_before, container_count_after]
+            ok = (ok and same and verdict["evictions"] == 0
+                  and verdict["gang_recovery"]["recoveries"] == 0
+                  and container_count_after == container_count_before)
+        else:  # chip-death
+            ok = ok and verdict["gang_recovery"]["recoveries"] >= 1
+            verdict["dead_chips"] = sorted(dead_chip_ids())
+        verdict["ok"] = ok
+    finally:
+        def _stop_quietly(fn):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+        stop.set()
+        faultline.deactivate()
+        for th in threads:
+            th.join(timeout=5.0)
+        for n in nodes:
+            _stop_quietly(n["kubelet"].stop)
+            _stop_quietly(n["plugin"].stop)
+            _stop_quietly(n["cs"].close)
+            if "extra_cs" in n:
+                _stop_quietly(n["extra_cs"].close)
+        if nlc is not None:
+            _stop_quietly(nlc.stop)
+        if jobc is not None:
+            _stop_quietly(jobc.stop)
+        if factory is not None:
+            _stop_quietly(factory.stop_all)
+        if sched is not None:
+            _stop_quietly(sched.stop)
+        for handle in (ctrl_cs, sched_cs, cs):
+            if handle is not None:
+                _stop_quietly(handle.close)
+        if master is not None:
+            _stop_quietly(master.stop)
+        if own_tmp:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return verdict
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="ktpu seeded chaos runner")
     ap.add_argument("--seeds", default="1,7,42,1729,9000",
@@ -329,25 +755,51 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=6.0,
                     help="seconds of fault injection per seed")
     ap.add_argument("--writers", type=int, default=3)
-    ap.add_argument("--spec", default=DEFAULT_SPEC,
-                    help="faultline spec (see utils/faultline.py grammar)")
+    ap.add_argument("--spec", default=None,
+                    help="faultline spec override "
+                         "(see utils/faultline.py grammar)")
     ap.add_argument("--no-kill", action="store_true",
-                    help="skip the mid-run primary-store kill")
+                    help="skip the mid-run primary-store kill (wire schedule)")
+    ap.add_argument("--schedule", default="wire",
+                    choices=("wire",) + NODE_MODES + ("node-all", "all"),
+                    help="which schedule to sweep: the control plane's wire "
+                         "schedule (default), one node/slice failure mode, "
+                         "node-all (all three node modes), or all")
+    ap.add_argument("--recovery-bound", type=float, default=60.0,
+                    help="node schedules: seconds from failure injection to "
+                         "gang re-running")
     args = ap.parse_args()
     seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    if args.schedule == "wire":
+        schedules = ["wire"]
+    elif args.schedule == "node-all":
+        schedules = list(NODE_MODES)
+    elif args.schedule == "all":
+        schedules = ["wire"] + list(NODE_MODES)
+    else:
+        schedules = [args.schedule]
     verdicts = []
-    for seed in seeds:
-        v = run_schedule(seed, duration=args.duration,
-                         kill_primary=not args.no_kill,
-                         spec=args.spec, writers=args.writers)
-        print(json.dumps(v), flush=True)
-        verdicts.append(v)
+    for schedule in schedules:
+        for seed in seeds:
+            if schedule == "wire":
+                v = run_schedule(seed, duration=args.duration,
+                                 kill_primary=not args.no_kill,
+                                 spec=(DEFAULT_SPEC if args.spec is None
+                                       else args.spec),
+                                 writers=args.writers)
+                v["mode"] = "wire"
+            else:
+                v = run_node_schedule(seed, mode=schedule,
+                                      duration=args.duration, spec=args.spec,
+                                      recovery_bound=args.recovery_bound)
+            print(json.dumps(v), flush=True)
+            verdicts.append(v)
     ok = all(v["ok"] for v in verdicts)
     recs = [v["recovery_s"] for v in verdicts]
     print(json.dumps({
-        "summary": "chaos", "seeds": seeds,
+        "summary": "chaos", "seeds": seeds, "schedules": schedules,
         "passed": sum(1 for v in verdicts if v["ok"]),
-        "failed": [v["seed"] for v in verdicts if not v["ok"]],
+        "failed": [(v["mode"], v["seed"]) for v in verdicts if not v["ok"]],
         "recovery_s_max": max(recs) if recs else None,
         "acked_total": sum(v["acked"] for v in verdicts),
     }), flush=True)

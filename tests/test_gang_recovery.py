@@ -1,0 +1,412 @@
+"""Node & slice failure domain — tier-1 coverage.
+
+The chaos tier (tests/test_chaos.py TestNodeFailureSchedules, slow) proves
+the failure domain under seeded fault schedules; this file is the fast
+deterministic core:
+
+- gang failure policy: one member dies -> the WHOLE gang is torn down and
+  recreated as a new attempt (attempt label, capped backoff, attempt cap =
+  backoff_limit, ktpu_gang_recovery_seconds MTTR);
+- device-health propagation: a plugin-reported unhealthy chip fails the
+  RUNNING pod holding it (the admit-time check only protects future pods),
+  while endpoint/socket death never kills workloads;
+- kubelet restart reconstruction: the no-checkpoint design — a fresh
+  kubelet instance rebuilds device assignments from bound pod specs, with
+  the 0.5s plugin-scan grace keeping healthy workloads alive meanwhile;
+- node-lifecycle exactly-once accounting through the shared retry policy.
+"""
+
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, InformerFactory
+from kubernetes1_tpu.controllers import (
+    ControllerManager,
+    JobController,
+    NodeLifecycleController,
+)
+from kubernetes1_tpu.controllers import job as job_ctrl
+from kubernetes1_tpu.deviceplugin.api import (
+    PluginClient,
+    PluginServer,
+    plugin_socket_path,
+)
+from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+from kubernetes1_tpu.kubelet import Kubelet
+from kubernetes1_tpu.kubelet.devicemanager import DeviceManager
+from kubernetes1_tpu.machinery import NotFound
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.utils import faultline
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_node, make_tpu_pod
+from tests.test_controllers import job_with, start_hollow_node
+
+
+def gang_pods(cs, job_name, live=True):
+    pods, _ = cs.pods.list(namespace="default",
+                           label_selector=f"{t.JOB_NAME_LABEL}={job_name}")
+    if live:
+        pods = [p for p in pods
+                if p.status.phase not in (t.POD_SUCCEEDED, t.POD_FAILED)
+                and not p.metadata.deletion_timestamp]
+    return pods
+
+
+def wait_gang_running(cs, job_name, n=2, timeout=30.0):
+    def ok():
+        pods = gang_pods(cs, job_name)
+        return (len(pods) == n
+                and all(p.status.phase == t.POD_RUNNING for p in pods))
+
+    must_poll_until(ok, timeout=timeout, desc=f"gang {job_name} running")
+    return gang_pods(cs, job_name)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    master = Master().start()
+    cs = Clientset(master.url)
+    sched = Scheduler(cs, gang_wait_seconds=5.0)
+    sched.start()
+    cm = ControllerManager(cs, monitor_grace=2.0, eviction_timeout=2.0)
+    jc = next(c for c in cm.controllers if isinstance(c, JobController))
+    jc.gang_backoff_base = 0.1  # fast attempts for test turnaround
+    jc.gang_backoff_cap = 0.5
+    cm.start()
+    nodes = [
+        start_hollow_node(cs, f"gr-{i}", str(tmp_path), tpus=4,
+                          slice_id=f"grs{i}", host_index=i)
+        for i in range(2)
+    ]
+    env = {"master": master, "cs": cs, "sched": sched, "cm": cm,
+           "nodes": nodes}
+    yield env
+    for kubelet, plugin, _ in nodes:
+        kubelet.stop()
+        plugin.stop()
+    cm.stop()
+    sched.stop()
+    cs.close()
+    master.stop()
+
+
+class TestGangFailurePolicy:
+    def test_member_death_recreates_whole_gang(self, cluster):
+        """One member evicted -> EVERY member is replaced as attempt 1 (new
+        uids, new gang id), the job's attempt annotation advances, and the
+        recovery lands in ktpu_gang_recovery_seconds."""
+        cs = cluster["cs"]
+        before = job_ctrl.gang_recovery_snapshot()
+        cs.jobs.create(job_with("g1", completions=2, parallelism=2,
+                                indexed=True, tpus=2, gang=True,
+                                exit_after=600))
+        pods = wait_gang_running(cs, "g1")
+        uids0 = {p.metadata.name: p.metadata.uid for p in pods}
+        for p in pods:
+            assert (p.metadata.labels or {}).get(t.GANG_ATTEMPT_LABEL) == "0"
+            assert p.spec.scheduling_gang.endswith("-a0")
+        cs.pods.delete("g1-1", grace_seconds=0)  # a node eviction's end state
+
+        def recreated():
+            cur = gang_pods(cs, "g1")
+            return (len(cur) == 2
+                    and all(p.status.phase == t.POD_RUNNING for p in cur)
+                    and all((p.metadata.labels or {})
+                            .get(t.GANG_ATTEMPT_LABEL) == "1" for p in cur)
+                    and all(p.metadata.uid != uids0[p.metadata.name]
+                            for p in cur))
+
+        must_poll_until(recreated, timeout=30.0,
+                        desc="whole gang recreated as attempt 1")
+        job = cs.jobs.get("g1")
+        assert (job.metadata.annotations or {}).get(t.GANG_ATTEMPT_LABEL) == "1"
+        for p in gang_pods(cs, "g1"):
+            assert p.spec.scheduling_gang.endswith("-a1")
+        after = job_ctrl.gang_recovery_snapshot()
+        assert after["attempts"] == before["attempts"] + 1
+        assert after["recoveries"] == before["recoveries"] + 1
+        cs.jobs.delete("g1")
+
+    def test_attempt_exhaustion_marks_job_failed(self, cluster):
+        """backoff_limit caps ATTEMPTS for gangs: with 0 retries left, a
+        member death fails the job (GangBackoffLimitExceeded) and the
+        surviving members are torn down — a broken slice holds no chips."""
+        cs = cluster["cs"]
+        job = job_with("g2", completions=2, parallelism=2, indexed=True,
+                       tpus=2, gang=True, exit_after=600)
+        job.spec.backoff_limit = 0
+        cs.jobs.create(job)
+        wait_gang_running(cs, "g2")
+        cs.pods.delete("g2-0", grace_seconds=0)
+
+        def failed():
+            j = cs.jobs.get("g2")
+            return any(c.type == "Failed" and c.status == "True"
+                       and c.reason == "GangBackoffLimitExceeded"
+                       for c in j.status.conditions)
+
+        must_poll_until(failed, timeout=20.0, desc="gang job marked Failed")
+        must_poll_until(lambda: gang_pods(cs, "g2") == [], timeout=15.0,
+                        desc="surviving members torn down")
+        cs.jobs.delete("g2")
+
+    def test_chip_death_fails_running_pod_and_recovers_excluding_chip(
+            self, cluster):
+        """The running-pod half of the device-health contract, end to end:
+        a plugin-reported unhealthy chip FAILS the pod that holds it (not
+        just future admits), the gang policy recreates the gang, and the
+        scheduler re-places it on chips that are still healthy."""
+        cs, nodes = cluster["cs"], cluster["nodes"]
+        cs.jobs.create(job_with("g3", completions=2, parallelism=2,
+                                indexed=True, tpus=2, gang=True,
+                                exit_after=600))
+        pods = wait_gang_running(cs, "g3")
+        victim_chip = pods[0].spec.extended_resources[0].assigned[0]
+        impl = next(i for _, _, i in nodes if victim_chip in i._by_id)
+        impl.set_health(victim_chip, t.DEVICE_UNHEALTHY)
+
+        def recovered():
+            cur = gang_pods(cs, "g3")
+            return (len(cur) == 2
+                    and all(p.status.phase == t.POD_RUNNING for p in cur)
+                    and all(int((p.metadata.labels or {})
+                                .get(t.GANG_ATTEMPT_LABEL, "0")) >= 1
+                            for p in cur)
+                    and all(victim_chip not in per.assigned
+                            for p in cur
+                            for per in p.spec.extended_resources))
+
+        must_poll_until(recovered, timeout=40.0,
+                        desc="gang re-placed off the dead chip")
+        # the kubelet surfaced the reason, not a generic failure
+        evs, _ = cs.events.list(namespace="default")
+        assert any(e.reason == "DeviceUnhealthy" for e in evs)
+        cs.jobs.delete("g3")
+
+
+class TestDeviceHealthPropagation:
+    RES = "google.com/tpu"
+
+    def _dm(self, tmp_path):
+        dm = DeviceManager(str(tmp_path / "plugins"))
+        events = []
+        dm.on_device_unhealthy = lambda r, ids: events.append((r, sorted(ids)))
+        return dm, events
+
+    def test_transition_fires_once_and_rearms_on_recovery(self, tmp_path):
+        dm, events = self._dm(tmp_path)
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_HEALTHY}])
+        assert events == []
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_UNHEALTHY}])
+        assert events == [(self.RES, ["c0"])]
+        # repeat frames must not re-fire (the kubelet would spam status
+        # PUTs and events against an already-failed pod)
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_UNHEALTHY}])
+        assert len(events) == 1
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_HEALTHY}])
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_UNHEALTHY}])
+        assert len(events) == 2  # re-armed by the healthy frame
+
+    def test_first_frame_unhealthy_fires(self, tmp_path):
+        # kubelet restart: the chip died while the kubelet was down — the
+        # FIRST ListAndWatch frame after restart must still fail the holder
+        dm, events = self._dm(tmp_path)
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_UNHEALTHY}])
+        assert events == [(self.RES, ["c0"])]
+
+    def test_endpoint_death_blocks_admits_but_spares_running_pods(
+            self, tmp_path):
+        """The two halves of the health contract, side by side: socket
+        death (store_mark_unhealthy) must NOT fire the running-pod callback
+        — a restarting plugin would kill its own healthy workloads — while
+        the admit-time path still rejects terminally on the stale-marked
+        inventory."""
+        dm, events = self._dm(tmp_path)
+        dm.store_update(self.RES, [{"id": "c0", "health": t.DEVICE_HEALTHY}])
+        dm.store_mark_unhealthy(self.RES)
+        assert events == []
+        dm._endpoints[self.RES] = object()  # presence is all admit reads
+        pod = make_tpu_pod("p0", tpus=1)
+        pod.spec.extended_resources[0].assigned = ["c0"]
+        res = dm.admit_pod(pod)
+        assert not res.allowed and not res.retriable
+        assert "unhealthy" in res.reason
+
+
+class TestPluginScanGraceWindow:
+    def test_bound_pod_delivered_before_scan_is_retriable_not_fatal(
+            self, tmp_path):
+        """The kubelet-restart seam, directly: bound pods arrive from the
+        informer BEFORE the 0.5s plugin scan finds the socket.  Admission
+        must answer RETRIABLE through the whole warmup (no plugin yet, then
+        no inventory yet) — a terminal answer anywhere in that window would
+        kill healthy workloads on every kubelet restart."""
+        plugin_dir = str(tmp_path / "plugins")
+        impl = TPUDevicePlugin(devices=_fake_devices("v5e:2:sg:0"))
+        server = PluginServer(
+            impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+        server.start()
+        dm = DeviceManager(plugin_dir, poll_interval=0.1)
+        pod = make_tpu_pod("early", tpus=2)
+        pod.spec.extended_resources[0].assigned = [d["id"] for d in impl.devices]
+        try:
+            res = dm.admit_pod(pod)  # scan has not even started
+            assert not res.allowed and res.retriable
+            dm.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                res = dm.admit_pod(pod)
+                if res.allowed:
+                    break
+                assert res.retriable, res  # never terminal mid-warmup
+                time.sleep(0.05)
+            assert res.allowed, res
+        finally:
+            dm.stop()
+            server.stop()
+
+
+class TestDataPlaneFaultSites:
+    def test_plugin_rpc_drop_is_connection_error(self, tmp_path):
+        """An injected plugin.rpc fault surfaces as the ConnectionError the
+        admit path classifies RETRIABLE — the chaos schedules ride this."""
+        plugin_dir = str(tmp_path / "plugins")
+        impl = TPUDevicePlugin(devices=_fake_devices("v5e:2:sf:0"))
+        server = PluginServer(
+            impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+        server.start()
+        client = PluginClient(plugin_socket_path(plugin_dir, "google.com/tpu"))
+        try:
+            assert client.call("GetPluginInfo")["device_count"] == 2
+            faultline.activate(1, "plugin.rpc=error@1.0")
+            with pytest.raises(ConnectionError):
+                client.call("GetPluginInfo")
+        finally:
+            faultline.deactivate()
+            client.close()
+            server.stop()
+
+    def test_device_health_site_flips_one_chip_per_injection(self):
+        impl = TPUDevicePlugin(devices=_fake_devices("v5e:2:sh:0"))
+        assert impl._inject_chip_death() is None  # identity when inactive
+        try:
+            faultline.activate(1, "device.health=error@1.0")
+            first = impl._inject_chip_death()
+            assert first is not None
+            assert impl._by_id[first]["health"] == t.DEVICE_UNHEALTHY
+            second = impl._inject_chip_death()
+            assert second is not None and second != first
+            assert impl._inject_chip_death() is None  # nothing healthy left
+        finally:
+            faultline.deactivate()
+
+
+class TestKubeletRestartReconstruction:
+    @pytest.mark.thread_leak_ok  # the killed kubelet's pool drains async
+    def test_restart_mid_gang_rebuilds_from_pod_specs(self, tmp_path):
+        """SIGKILL analog mid-gang: every bit of kubelet state is
+        in-memory (no checkpoint file exists), so a fresh instance over the
+        same runtime + plugin dir IS the restarted process.  It must
+        rebuild device assignments from bound pod specs — no recreates, no
+        spurious failures, no duplicated containers."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs, gang_wait_seconds=5.0)
+        sched.start()
+        cm = ControllerManager(cs)  # default 40s grace: restart != death
+        cm.start()
+        kubelet, plugin, _impl = start_hollow_node(
+            cs, "rk-0", str(tmp_path), tpus=4, slice_id="rk")
+        fresh = None
+        try:
+            cs.jobs.create(job_with("rg", completions=2, parallelism=2,
+                                    indexed=True, tpus=2, gang=True,
+                                    exit_after=600))
+            pods = wait_gang_running(cs, "rg")
+            uids0 = {p.metadata.uid for p in pods}
+            runtime = kubelet.runtime
+            containers0 = {c.id for c in runtime.list_containers()}
+            before = job_ctrl.gang_recovery_snapshot()
+            kubelet.stop()
+            fresh = Kubelet(cs, node_name="rk-0", runtime=runtime,
+                            plugin_dir=kubelet.device_manager.plugin_dir,
+                            heartbeat_interval=0.5, sync_interval=0.2,
+                            pleg_interval=0.2)
+            fresh.start()
+            # across the reconstruction window (plugin rescan + informer
+            # redelivery + several sync passes) the gang must stay exactly
+            # as it was: same uids, Running, zero Failed phases
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline:
+                cur = gang_pods(cs, "rg", live=False)
+                assert len(cur) == 2
+                assert {p.metadata.uid for p in cur} == uids0, \
+                    "gang recreated across a mere kubelet restart"
+                assert all(p.status.phase == t.POD_RUNNING for p in cur), \
+                    "spurious pod failure across kubelet restart"
+                time.sleep(0.3)
+            assert {c.id for c in runtime.list_containers()} == containers0, \
+                "restarted kubelet duplicated containers instead of adopting"
+            after = job_ctrl.gang_recovery_snapshot()
+            assert after["recoveries"] == before["recoveries"]
+            assert after["attempts"] == before["attempts"]
+        finally:
+            (fresh or kubelet).stop()
+            plugin.stop()
+            cm.stop()
+            sched.stop()
+            cs.close()
+            master.stop()
+
+
+class TestNodeLifecycleExactlyOnce:
+    @pytest.mark.thread_leak_ok  # controller loop drains async
+    def test_stale_node_marked_once_pods_evicted_once(self):
+        """NotReady marked exactly once, the eviction counted exactly once
+        per pod (the force-finalize pass is not a second eviction), and a
+        clean run takes zero errors through the shared retry policy."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        factory = InformerFactory(cs)
+        nlc = NodeLifecycleController(cs, factory, monitor_grace=0.6,
+                                      eviction_timeout=0.3,
+                                      monitor_interval=0.1)
+        try:
+            node = make_node("dead-0")  # Ready=True, no heartbeat => stale
+            cs.nodes.create(node)
+            pod = make_tpu_pod("victim", tpus=0)
+            pod.spec.node_name = "dead-0"  # bound; its kubelet never existed
+            cs.pods.create(pod)
+            factory.start_all()
+            factory.wait_for_sync()
+            nlc.start()
+            must_poll_until(lambda: int(nlc.evictions_total.value) >= 1,
+                            timeout=10.0, desc="eviction fired")
+
+            def gone():
+                try:
+                    cs.pods.get("victim")
+                    return False
+                except NotFound:
+                    return True
+
+            must_poll_until(gone, timeout=10.0, desc="pod force-finalized")
+            time.sleep(0.5)  # several more monitor passes over the corpse
+            assert int(nlc.evictions_total.value) == 1
+            assert int(nlc.not_ready_total.value) == 1
+            assert int(nlc.errors_total.value) == 0
+            fresh = cs.nodes.get("dead-0", "")
+            cond = next(c for c in fresh.status.conditions
+                        if c.type == t.NODE_READY)
+            assert cond.status == "Unknown"
+            evs, _ = cs.events.list(namespace="default")
+            assert sum(1 for e in evs if e.reason == "NodeEviction") >= 1
+        finally:
+            nlc.stop()
+            factory.stop_all()
+            cs.close()
+            master.stop()
