@@ -41,6 +41,11 @@ LLAMA_BATCH = int(os.environ.get("BENCH_LLAMA_BATCH", "4"))
 LLAMA_SWEEP = os.environ.get("BENCH_LLAMA_SWEEP", "4,6,8")
 LLAMA_SEQ = int(os.environ.get("BENCH_LLAMA_SEQ", "2048"))
 LLAMA_STEPS = int(os.environ.get("BENCH_LLAMA_STEPS", "10"))
+# Burst-tail axes (BENCH_r06+): scheduler shard count and store-wire
+# codec for the sched_perf phases — the density JSON's burst_tail block
+# records both so rounds are attributable to the knobs that moved.
+SCHED_SHARDS = int(os.environ.get("BENCH_SCHED_SHARDS", "1"))
+WIRE_CODEC = os.environ.get("BENCH_WIRE_CODEC", "json")
 
 
 def _pct(xs, q):
@@ -580,13 +585,15 @@ def main():
     if os.environ.get("BENCH_SKIP_SCHED", "") != "1":
         try:
             extras["sched_perf_100"] = _sched_perf_with_retry(
-                100, 3000, multiproc=True)
+                100, 3000, multiproc=True,
+                sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC)
         except Exception as e:  # noqa: BLE001
             extras["sched_perf_100"] = {"error": f"{type(e).__name__}: {e}"}
         if os.environ.get("BENCH_SKIP_SCHED1K", "") != "1":
             try:
                 extras["sched_perf_1000"] = _sched_perf_with_retry(
-                    1000, 30000, creators=6, multiproc=True
+                    1000, 30000, creators=6, multiproc=True,
+                    sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC
                 )
             except Exception as e:  # noqa: BLE001
                 extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}

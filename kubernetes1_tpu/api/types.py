@@ -50,6 +50,14 @@ ATTR_TPU_CORES_PER_CHIP = "google.com/tpu/cores-per-chip"
 # Annotation carrying the scheduler's nominated node during preemption
 # (ref: scheduler.go NominatedNodeAnnotationKey).
 NOMINATED_NODE_ANNOTATION = "scheduler.ktpu.io/nominated-node"
+# Marker prefix on the Conflict message the apiserver answers when a bind
+# would double-allocate a chip another scheduler shard just claimed
+# (apiserver/registry.py device-claim guard).  The scheduler matches on it
+# to re-queue the loser with a refreshed cache instead of treating the
+# Conflict as "this pod is already bound" (terminal).  A message marker —
+# not a new error class — so it crosses old/new client-server pairs as a
+# plain 409.
+DEVICE_CLAIM_CONFLICT = "device claim conflict"
 # Job completion index annotation+env (reference gap; needed for TPU worker id)
 COMPLETION_INDEX_ANNOTATION = "batch.ktpu.io/completion-index"
 JOB_NAME_LABEL = "batch.ktpu.io/job-name"

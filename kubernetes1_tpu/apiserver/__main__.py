@@ -50,6 +50,10 @@ def main():
                          "this apiserver stateless — run several")
     ap.add_argument("--store-ca-file", default="",
                     help="CA to verify the store's TLS cert")
+    ap.add_argument("--wire-codec", default="json",
+                    help="store-wire codec (json | pybin1): non-json is "
+                         "negotiated per connection and falls back to "
+                         "newline-JSON when the store declines")
     ap.add_argument("--wal-sync", default="batch",
                     choices=("none", "batch", "always"),
                     help="local-WAL fsync policy: per group commit "
@@ -103,6 +107,7 @@ def main():
         client_ca_file=args.client_ca_file,
         store_address=args.store_address,
         store_ca_file=args.store_ca_file,
+        store_codec=args.wire_codec,
         wal_sync=args.wal_sync,
         write_coalesce_window=args.write_coalesce_ms / 1000.0,
         max_inflight_mutating=args.max_inflight_mutating,

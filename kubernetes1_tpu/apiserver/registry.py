@@ -326,6 +326,23 @@ class Registry:
         self.scheme = scheme
         self._ns_lock = locksan.make_lock("Registry._ns_lock")
         self._svc_lock = locksan.make_lock("Registry._svc_lock")
+        # Cross-scheduler device-claim guard (scheduler sharding): chips a
+        # BOUND pod owns, (node, resource, chip_id) -> (pod store key,
+        # pod uid).  With N scheduler shards placing optimistically from
+        # independently-lagging caches, two shards can race one chip —
+        # pod-level CAS cannot catch that (each CAS is on its OWN pod), so
+        # the bind path claims chips here first and answers the loser a
+        # Conflict whose message carries the DEVICE_CLAIM_CONFLICT marker
+        # (the scheduler's cue to re-queue instead of dropping the pod).
+        # Stale entries (deleted pods, reassigned chips) are validated
+        # lazily against the store on collision and purged — no delete
+        # hook to keep in sync.  Enforcement is per-apiserver: peer
+        # apiservers sharing one store need the store-level claim objects
+        # the sharded-store roadmap item owns.
+        self._claims_lock = locksan.make_lock("Registry._claims_lock")
+        self._device_claims: Dict[tuple, tuple] = {}
+        self._claims_seeded = False
+        self.device_claim_conflicts = 0  # served as a /metrics counter
 
     # ------------------------------------------------------------------ keys
 
@@ -778,12 +795,148 @@ class Registry:
             f"{time.time():.6f}"  # ktpulint: ignore[KTPU005] cross-process SLI wall stamp
         return pod
 
+    # ------------------------------------------------------- device claims
+
+    @staticmethod
+    def _chips_of(pod) -> List[tuple]:
+        """(node, resource, chip_id) triples a bound pod owns."""
+        node = pod.spec.node_name
+        return [(node, per.resource or per.name, cid)
+                for per in pod.spec.extended_resources
+                for cid in (per.assigned or [])]
+
+    def _seed_claims_locked(self):
+        """First claim after startup: rebuild the index from every bound
+        pod in the store, so an apiserver restart mid-burst doesn't open
+        a window where chips held by already-bound pods look free."""
+        entries, _rev = self.store.list_raw(self.prefix("pods"))
+        for key, _r, d in entries:
+            spec = d.get("spec") or {}
+            node = spec.get("nodeName")
+            if not node:
+                continue
+            uid = (d.get("metadata") or {}).get("uid", "")
+            for per in spec.get("extendedResources") or []:
+                res = per.get("resource") or per.get("name") or ""
+                for cid in per.get("assigned") or []:
+                    # committed state: no pending window, the store is
+                    # already the proof
+                    self._device_claims[(node, res, cid)] = (key, uid, 0.0)
+        self._claims_seeded = True
+
+    # A fresh claim is "in flight" until its bind commits; within this
+    # window the liveness check trusts the claim unconditionally (the
+    # store can't prove a bind that hasn't committed yet).  The window
+    # only matters for a binder that crashed between claim and release —
+    # normal failures release explicitly — so it just has to outlive any
+    # plausible bind round-trip.
+    CLAIM_PENDING_GRACE_SECONDS = 30.0
+
+    def _claim_is_live(self, claim_key: tuple, holder_key: str,
+                       holder_uid: str, pending_until: float) -> bool:
+        """Does the recorded holder still hold this chip?  In-flight
+        claims (bind not yet committed) are live by definition; committed
+        ones are validated against the store (lazy staleness: deleted
+        pods and reassigned chips purge on collision instead of via a
+        delete hook)."""
+        if time.monotonic() < pending_until:
+            return True
+        raw = self.store.get_raw_many([holder_key])[0]
+        if raw is None:
+            return False
+        meta = raw.get("metadata") or {}
+        if meta.get("uid") != holder_uid:
+            return False
+        return claim_key in self._chips_of(self.scheme.decode(raw))
+
+    def _claim_devices(self, pod, pod_key: str) -> List[tuple]:
+        """Claim every chip a just-applied binding assigns, all-or-
+        nothing.  Raises Conflict (DEVICE_CLAIM_CONFLICT marker) when a
+        LIVE claim by another pod holds any of them; stale claims are
+        purged and the claim retried.  Idempotent for the same pod uid
+        (CAS retries re-claim harmlessly)."""
+        wanted = self._chips_of(pod)
+        if not wanted:
+            return wanted
+        uid = pod.metadata.uid
+        while True:
+            with self._claims_lock:
+                if not self._claims_seeded:
+                    self._seed_claims_locked()
+                conflicts = [(k, self._device_claims[k]) for k in wanted
+                             if self._device_claims.get(k) is not None
+                             and self._device_claims[k][1] != uid]
+                if not conflicts:
+                    deadline = (time.monotonic()
+                                + self.CLAIM_PENDING_GRACE_SECONDS)
+                    for k in wanted:
+                        self._device_claims[k] = (pod_key, uid, deadline)
+                    return wanted
+            # verify the colliding claims OUTSIDE the lock (store reads)
+            for k, (holder_key, holder_uid, pend) in conflicts:
+                if self._claim_is_live(k, holder_key, holder_uid, pend):
+                    with self._claims_lock:
+                        self.device_claim_conflicts += 1
+                    raise Conflict(
+                        f"{t.DEVICE_CLAIM_CONFLICT}: {k[1]} chip {k[2]} "
+                        f"on node {k[0]} is held by pod {holder_key}")
+            with self._claims_lock:
+                for k, cur in conflicts:
+                    if self._device_claims.get(k) == cur:
+                        del self._device_claims[k]
+
+    def _release_claims(self, claim_keys: List[tuple], uid: str):
+        """Undo a claim whose bind did not commit (ours only — a racer
+        may already have re-claimed a purged key)."""
+        if not claim_keys:
+            return
+        with self._claims_lock:
+            for k in claim_keys:
+                if self._device_claims.get(k, ("", "", 0.0))[1] == uid:
+                    del self._device_claims[k]
+
+    def _confirm_claims(self, claim_keys: List[tuple], uid: str):
+        """Commit landed: end the pending grace so the STORE (which now
+        proves the assignment) is immediately authoritative — without
+        this, a bound-then-quickly-deleted pod's chips would stay blocked
+        for the rest of the grace window."""
+        if not claim_keys:
+            return
+        with self._claims_lock:
+            for k in claim_keys:
+                cur = self._device_claims.get(k)
+                if cur is not None and cur[1] == uid:
+                    self._device_claims[k] = (cur[0], uid, 0.0)
+
     def bind(self, namespace: str, pod_name: str, binding: t.Binding):
         """Apply the scheduler's placement transactionally
-        (ref: storage.go:147,181-186)."""
+        (ref: storage.go:147,181-186).  Chip assignments are claimed in
+        the device-claim index BEFORE the commit: the claim is what makes
+        two scheduler shards racing one chip lose deterministically
+        (Conflict with the DEVICE_CLAIM_CONFLICT marker) instead of
+        double-allocating."""
         key = self.key("pods", namespace, pod_name)
-        return self.store.guaranteed_update(
-            key, lambda pod: self._apply_binding(pod, pod_name, binding))
+        claimed: dict = {}
+
+        def update(pod):
+            updated = self._apply_binding(pod, pod_name, binding)
+            if "keys" not in claimed:
+                claimed["keys"] = self._claim_devices(updated, key)
+                claimed["uid"] = updated.metadata.uid
+            return updated
+
+        try:
+            bound = self.store.guaranteed_update(key, update)
+        except Exception:
+            # any failure after claiming (terminal CAS conflict, store
+            # down, claim conflict on a LATER loop's different chips)
+            # must free our claim — the chips were never committed
+            self._release_claims(claimed.get("keys") or [],
+                                 claimed.get("uid", ""))
+            raise
+        self._confirm_claims(claimed.get("keys") or [],
+                             claimed.get("uid", ""))
+        return bound
 
     def bind_batch(self, namespace: str,
                    bindings: List[t.Binding]) -> List[Optional[Exception]]:
@@ -803,6 +956,9 @@ class Registry:
         surface as errors."""
         results: List[Optional[Exception]] = [None] * len(bindings)
         keys: Dict[int, str] = {}
+        # claims made per item, released when that item's final outcome
+        # is an error (the chips never committed)
+        claims: Dict[int, tuple] = {}
         for i, b in enumerate(bindings):
             ns = b.metadata.namespace or namespace or "default"
             try:
@@ -810,38 +966,57 @@ class Registry:
             except BadRequest as e:
                 results[i] = e
         pending = list(keys)
-        while pending:
-            raws = self.store.get_raw_many([keys[i] for i in pending])
-            ops, op_idx = [], []
-            for i, raw in zip(pending, raws):
-                b = bindings[i]
-                if raw is None:
-                    results[i] = NotFound(
-                        f'pods "{b.metadata.name}" not found')
-                    continue
-                pod = self.scheme.decode(raw)
-                try:
-                    pod = self._apply_binding(pod, b.metadata.name, b)
-                except (Conflict, Invalid) as e:
-                    results[i] = e  # real conflict: no retry
-                    continue
-                ops.append({"op": "update_cas", "key": keys[i],
-                            "obj": self.scheme.encode(pod),
-                            "expect_rv": raw["metadata"]["resourceVersion"]})
-                op_idx.append(i)
-            if not ops:
-                break
-            outs = self.store.commit_batch(ops)
-            retry = []
-            for i, out in zip(op_idx, outs):
-                err = out.get("error")
-                if err is None:
-                    results[i] = None  # bound
-                elif isinstance(err, Conflict):
-                    retry.append(i)  # CAS race: re-read and re-apply
+        committed: set = set()
+        try:
+            while pending:
+                raws = self.store.get_raw_many([keys[i] for i in pending])
+                ops, op_idx = [], []
+                for i, raw in zip(pending, raws):
+                    b = bindings[i]
+                    if raw is None:
+                        results[i] = NotFound(
+                            f'pods "{b.metadata.name}" not found')
+                        continue
+                    pod = self.scheme.decode(raw)
+                    try:
+                        pod = self._apply_binding(pod, b.metadata.name, b)
+                        if i not in claims:
+                            claims[i] = (self._claim_devices(pod, keys[i]),
+                                         pod.metadata.uid)
+                    except (Conflict, Invalid) as e:
+                        results[i] = e  # real conflict: no retry
+                        continue
+                    ops.append({"op": "update_cas", "key": keys[i],
+                                "obj": self.scheme.encode(pod),
+                                "expect_rv":
+                                    raw["metadata"]["resourceVersion"]})
+                    op_idx.append(i)
+                if not ops:
+                    break
+                outs = self.store.commit_batch(ops)
+                retry = []
+                for i, out in zip(op_idx, outs):
+                    err = out.get("error")
+                    if err is None:
+                        results[i] = None  # bound
+                        committed.add(i)
+                    elif isinstance(err, Conflict):
+                        retry.append(i)  # CAS race: re-read and re-apply
+                    else:
+                        results[i] = err
+                pending = retry
+        finally:
+            # exception-safe (a mid-batch store failure must not leave N
+            # pods' chips claimed for the whole pending grace): COMMITTED
+            # items confirm — their claim must survive, the store is the
+            # proof — and everything else releases.  On the normal path
+            # committed == {i: results[i] is None}, so this is the same
+            # confirm/release split the success path always did.
+            for i, (claim_keys, uid) in claims.items():
+                if i in committed:
+                    self._confirm_claims(claim_keys, uid)
                 else:
-                    results[i] = err
-            pending = retry
+                    self._release_claims(claim_keys, uid)
         return results
 
 

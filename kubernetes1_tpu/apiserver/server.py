@@ -1046,6 +1046,12 @@ class _Handler(BaseHTTPRequestHandler):
             f'{master.inflight.inflight("readonly")}',
             "# TYPE ktpu_apiserver_shed_total counter",
             f"ktpu_apiserver_shed_total {master.inflight.shed_total}",
+            # scheduler-sharding surface: binds refused because another
+            # shard's pod holds the chip (the optimistic-concurrency
+            # loser count; the winner's bind is invisible here)
+            "# TYPE ktpu_bind_device_conflicts_total counter",
+            f"ktpu_bind_device_conflicts_total "
+            f"{master.registry.device_claim_conflicts}",
         ]
         from ..client import retry as _client_retry
 
@@ -1353,6 +1359,9 @@ class Master:
                                                # unix path or host:port — makes
                                                # this apiserver stateless
         store_ca_file: str = "",               # verify the store's TLS cert
+        store_codec: str = "json",             # store-wire codec (--wire-codec):
+                                               # negotiated at dial, falls back
+                                               # to newline-JSON on old stores
         watch_queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,  # per-watcher
                                                # event bound before slow-
                                                # consumer eviction (410)
@@ -1378,7 +1387,8 @@ class Master:
             # may be comma-separated primary,standby — RemoteStore parses
             # and fails over between them (storage/remote.py)
             self.store = RemoteStore(self.scheme, store_address,
-                                     ca_file=store_ca_file)
+                                     ca_file=store_ca_file,
+                                     codec=store_codec)
         else:
             self.store = Store(self.scheme, wal_path=wal_path,
                                wal_sync=wal_sync)

@@ -1,6 +1,6 @@
 from .rest import ApiClient
 from .clientset import Clientset, ResourceClient
 from .informer import SharedInformer, InformerFactory
-from .leaderelection import LeaderElector
+from .leaderelection import LeaderElector, LeaseSet
 from .events import EventRecorder
 from .retry import retry_on_conflict

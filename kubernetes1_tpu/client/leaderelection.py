@@ -1,9 +1,18 @@
-"""Leader election via CAS on a Lease object.
+"""Leader election via CAS on a Lease object — singleton and sharded.
 
 Ref: client-go tools/leaderelection/leaderelection.go:138-274 — the same
 acquire/renew loop over a resource lock: candidates try to create/update the
 Lease; the holder renews every retry_period; takers steal only after
 lease_duration since the last observed renewal.  Non-leaders hot-standby.
+
+``LeaseSet`` generalizes the machinery from ONE lease to a numbered set of
+shard leases (the scheduler's parallel-actor decomposition): every instance
+announces itself with a member lease, the live members partition the shard
+set by rendezvous hashing, and each instance claims its shards, steals
+expired ones, and hot-standbys the rest — an instance death moves its
+shards to the survivors within one lease_duration, with the same CAS
+guarantees as singleton election.  All lease traffic rides the ordinary
+clientset, so it inherits the client.* faultline sites and retry policy.
 """
 
 from __future__ import annotations
@@ -12,7 +21,8 @@ import http.client
 import threading
 import time
 import traceback
-from typing import Callable, Optional
+import zlib
+from typing import Callable, Dict, FrozenSet, Optional
 
 from ..api import types as t
 from ..machinery.errors import AlreadyExists, ApiError, Conflict, NotFound
@@ -136,3 +146,267 @@ class LeaderElector:
                 self.cs.leases.update(lease)
         except (ApiError, OSError, http.client.HTTPException):
             pass  # best-effort release on shutdown; lease expires anyway
+
+
+def _rendezvous_score(identity: str, shard: int) -> int:
+    """Stable per-(identity, shard) weight: the LIVE identity with the
+    highest score is the shard's preferred owner.  crc32, not hash() —
+    Python's hash is salted per process and the instances must agree."""
+    return zlib.crc32(f"{identity}:{shard}".encode())
+
+
+class LeaseSet:
+    """Shard-lease acquisition: N shard leases partitioned across however
+    many live instances exist, built from the same CAS-on-Lease primitive
+    as LeaderElector.
+
+    Topology discovery rides MEMBER leases (one per instance, renewed
+    every retry_period): an instance is "live" while its member lease is
+    unexpired.  Each live instance then wants the shards whose rendezvous
+    winner it is — roughly shards/instances each, recomputed as members
+    come and go:
+
+      - it CLAIMS a wanted shard whenever the shard lease is unheld,
+        released, or expired (a dead owner's lease expires after
+        lease_duration — the steal path);
+      - it SHEDS a held shard whose rendezvous winner is a DIFFERENT live
+        instance (holder -> ""), so a newly-joined instance picks up its
+        share within ~2 retry periods;
+      - as an availability net it also claims UNWANTED shards that have
+        sat unheld/expired past a full lease_duration (the designated
+        winner never showed up or wedged) — a shard is never orphaned
+        just because its preferred owner is gone;
+      - everything else it HOT-STANDBYS: watching the leases, ready to
+        steal.
+
+    With one instance the rendezvous winner of every shard is that
+    instance, so it owns the full set — shards=1 single-instance behaves
+    exactly like LeaderElector with extra steps skipped.
+
+    on_acquired(shard)/on_lost(shard) fire from the renew thread, outside
+    any lock; owned() is the race-free snapshot consumers read per
+    decision."""
+
+    def __init__(
+        self,
+        clientset: Clientset,
+        name: str,
+        identity: str,
+        shards: int,
+        namespace: str = "kube-system",
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+        on_acquired: Optional[Callable[[int], None]] = None,
+        on_lost: Optional[Callable[[int], None]] = None,
+    ):
+        self.cs = clientset
+        self.name = name
+        self.identity = identity
+        self.shards = int(shards)
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.on_acquired = on_acquired
+        self.on_lost = on_lost
+        self._stop = threading.Event()
+        self._owned: FrozenSet[int] = frozenset()
+        self._owned_event = threading.Event()  # set while owning >= 1 shard
+        self._unheld_since: Dict[int, float] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- observers
+
+    def owned(self) -> FrozenSet[int]:
+        """Current shard ownership (atomic snapshot; replaced wholesale)."""
+        return self._owned
+
+    def wait_for_any(self, timeout: float = 10.0) -> bool:
+        return self._owned_event.wait(timeout)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "LeaseSet":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"leaseset-{self.identity}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        # best-effort release so successors steal instantly instead of
+        # waiting out lease_duration; the member lease just expires
+        for shard in self._owned:
+            try:
+                lease = self.cs.leases.get(self._shard_lease_name(shard),
+                                           self.namespace)
+                if lease.holder_identity == self.identity:
+                    lease.holder_identity = ""
+                    self.cs.leases.update(lease)
+            except (ApiError, OSError, http.client.HTTPException):
+                pass
+
+    # ----------------------------------------------------------- internals
+
+    def _member_lease_name(self, identity: str) -> str:
+        return f"{self.name}-member-{identity}"
+
+    def _shard_lease_name(self, shard: int) -> str:
+        return f"{self.name}-shard-{shard}"
+
+    def _expired(self, lease: t.Lease) -> bool:
+        if not lease.renew_time:
+            return True
+        renew = parse_iso(lease.renew_time)  # UTC, microsecond resolution
+        return (time.time() - renew) > max(  # ktpulint: ignore[KTPU005] cross-process lease timestamp
+            float(lease.lease_duration_seconds), self.lease_duration
+        )
+
+    def _upsert_member(self):
+        now = now_iso_micro()
+        name = self._member_lease_name(self.identity)
+        try:
+            lease = self.cs.leases.get(name, self.namespace)
+        except NotFound:
+            lease = t.Lease()
+            lease.metadata.name = name
+            lease.metadata.namespace = self.namespace
+            lease.holder_identity = self.identity
+            lease.lease_duration_seconds = int(self.lease_duration)
+            lease.acquire_time = now
+            lease.renew_time = now
+            try:
+                self.cs.leases.create(lease, self.namespace)
+            except AlreadyExists:
+                pass
+            return
+        lease.holder_identity = self.identity
+        lease.renew_time = now
+        try:
+            self.cs.leases.update(lease)
+        except Conflict:
+            pass  # next tick retries; identity-named, nobody else writes it
+
+    def _snapshot(self):
+        """One LIST: live member identities + shard lease objects."""
+        items, _rv = self.cs.leases.list(namespace=self.namespace)
+        member_prefix = f"{self.name}-member-"
+        live = {self.identity}
+        shard_leases: Dict[int, t.Lease] = {}
+        for lease in items:
+            n = lease.metadata.name
+            if n.startswith(member_prefix):
+                if lease.holder_identity and not self._expired(lease):
+                    live.add(lease.holder_identity)
+            elif n.startswith(f"{self.name}-shard-"):
+                try:
+                    idx = int(n.rsplit("-", 1)[1])
+                except ValueError:
+                    continue
+                if 0 <= idx < self.shards:
+                    shard_leases[idx] = lease
+        return live, shard_leases
+
+    def _winner(self, shard: int, live) -> str:
+        return max(sorted(live),
+                   key=lambda ident: _rendezvous_score(ident, shard))
+
+    def _try_take(self, shard: int, lease: Optional[t.Lease]) -> bool:
+        now = now_iso_micro()
+        if lease is None:
+            lease = t.Lease()
+            lease.metadata.name = self._shard_lease_name(shard)
+            lease.metadata.namespace = self.namespace
+            lease.holder_identity = self.identity
+            lease.lease_duration_seconds = int(self.lease_duration)
+            lease.acquire_time = now
+            lease.renew_time = now
+            try:
+                self.cs.leases.create(lease, self.namespace)
+                return True
+            except AlreadyExists:
+                return False
+        lease.holder_identity = self.identity
+        lease.acquire_time = now
+        lease.renew_time = now
+        lease.lease_transitions += 1
+        try:
+            self.cs.leases.update(lease)
+            return True
+        except Conflict:
+            return False  # raced another taker; CAS decided
+
+    def _renew(self, lease: t.Lease) -> bool:
+        lease.renew_time = now_iso_micro()
+        try:
+            self.cs.leases.update(lease)
+            return True
+        except Conflict:
+            return False  # someone stole it (we were presumed dead)
+
+    def _release_shard(self, lease: t.Lease):
+        lease.holder_identity = ""
+        try:
+            self.cs.leases.update(lease)
+        except Conflict:
+            pass  # racer already took it — same outcome
+
+    def _tick(self):
+        self._upsert_member()
+        live, shard_leases = self._snapshot()
+        now = time.monotonic()
+        next_owned = set()
+        for shard in range(self.shards):
+            lease = shard_leases.get(shard)
+            holder = lease.holder_identity if lease is not None else ""
+            held_by_me = holder == self.identity
+            expired = lease is None or not holder or self._expired(lease)
+            winner = self._winner(shard, live)
+            if expired:
+                self._unheld_since.setdefault(shard, now)
+            else:
+                self._unheld_since.pop(shard, None)
+            if held_by_me and not self._expired(lease):
+                if winner != self.identity and winner in live:
+                    # shed: the rendezvous winner is a live peer — hand
+                    # the shard over so a joining instance gets its share
+                    self._release_shard(lease)
+                    continue
+                if self._renew(lease):
+                    next_owned.add(shard)
+                continue
+            if not expired:
+                continue  # live peer holds it: hot-standby
+            if winner == self.identity:
+                if self._try_take(shard, lease):
+                    next_owned.add(shard)
+                    self._unheld_since.pop(shard, None)
+            elif now - self._unheld_since.get(shard, now) \
+                    > self.lease_duration:
+                # availability net: the designated winner never claimed
+                # it for a full lease_duration — any live instance takes
+                # an orphan over leaving its pods unscheduled
+                if self._try_take(shard, lease):
+                    next_owned.add(shard)
+                    self._unheld_since.pop(shard, None)
+        self._apply_ownership(frozenset(next_owned))
+
+    def _apply_ownership(self, next_owned: FrozenSet[int]):
+        prev, self._owned = self._owned, next_owned
+        if next_owned:
+            self._owned_event.set()
+        else:
+            self._owned_event.clear()
+        for shard in sorted(next_owned - prev):
+            if self.on_acquired:
+                self.on_acquired(shard)
+        for shard in sorted(prev - next_owned):
+            if self.on_lost:
+                self.on_lost(shard)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            self._stop.wait(self.retry_period)

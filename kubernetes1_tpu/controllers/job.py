@@ -381,6 +381,29 @@ class JobController(Controller):
                 self._update_status(job, active, succeeded, failed,
                                     fail_override=False)
                 return
+        if len(cur) < size:
+            # About to create members from a view that may be STALE in the
+            # worst way: the exhausted path force-deletes the survivors and
+            # commits the Failed verdict, and those very deletion events
+            # re-enqueue this sync — if it runs before the Failed status
+            # event is delivered, cur is empty, nothing looks broken (no
+            # bound member left to prove a vanish), and the create loop
+            # would resurrect the gang as attempt-N pods no sync will ever
+            # manage again (observed: orphaned Running pods holding chips
+            # forever).  The verdict was committed through our own
+            # apiserver, so ONE authoritative read closes the window.
+            try:
+                fresh = self.cs.jobs.get(job.metadata.name,
+                                         job.metadata.namespace)
+            except NotFound:
+                self._gang_forget(key)
+                return
+            except (ApiError, ConnectionError, TimeoutError, OSError):
+                self.enqueue_after(key, 0.5)  # transient: re-judge shortly
+                return
+            if self._finished(fresh):
+                self._gang_forget(key)
+                return
         if indexed:
             have: Set[int] = set()
             for p in active:

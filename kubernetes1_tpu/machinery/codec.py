@@ -1,0 +1,99 @@
+"""Wire codecs: pluggable (encode, decode) pairs behind stable string ids.
+
+The control plane's hot wire (store<->apiserver) historically spoke
+newline-JSON only.  A codec abstracts "JSON-able data <-> bytes" so the
+framing layer (storage/wire.py) can negotiate a cheaper encoding per
+connection while JSON stays the default and the universal fallback —
+compatibility is carried by the NEGOTIATION, not by every codec being
+self-describing.
+
+Codecs only ever see plain JSON-able data (dicts/lists/str/int/float/
+bool/None): the Scheme has already flattened typed objects to their wire
+dict form before a codec touches them, and decode hands the same plain
+data back.  That restriction is what makes the binary codec safe.
+
+``pybin1`` is the stdlib binary fast path: pickle protocol 5 of plain
+data.  Encoding arbitrary pickles would be a remote-code-execution
+primitive, so decode goes through a restricted Unpickler whose
+find_class ALWAYS raises — plain-data pickles never reference a global,
+and anything that does is rejected before it can import a single name.
+The link this rides is already same-user (unix socket chmod 0600) or
+mTLS (client_ca_file), same trust posture as etcd's peer protocol.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+from typing import Any, Dict
+
+JSON = "json"
+PYBIN1 = "pybin1"
+
+
+class CodecError(ValueError):
+    """A payload that cannot be decoded under the negotiated codec."""
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Plain-data pickles reference no globals; any that try are hostile
+    or corrupt — refuse before resolution, never after."""
+
+    def find_class(self, module, name):  # noqa: D102 - pickle API
+        raise pickle.UnpicklingError(
+            f"pybin1 payload requested global {module}.{name}; "
+            f"only plain data may cross the wire")
+
+
+class JsonCodec:
+    """The default/fallback codec: canonical compact JSON."""
+
+    id = JSON
+
+    @staticmethod
+    def encode(obj: Any) -> bytes:
+        return json.dumps(obj, separators=(",", ":"), default=str).encode()
+
+    @staticmethod
+    def decode(raw: bytes) -> Any:
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise CodecError(f"corrupt json payload: {e}") from e
+
+
+class PyBin1Codec:
+    """Binary fast path: pickle protocol 5 of plain JSON-able data with a
+    globals-free restricted decode (see module docstring)."""
+
+    id = PYBIN1
+
+    @staticmethod
+    def encode(obj: Any) -> bytes:
+        return pickle.dumps(obj, protocol=5)
+
+    @staticmethod
+    def decode(raw: bytes) -> Any:
+        try:
+            return _RestrictedUnpickler(io.BytesIO(raw)).load()
+        except (pickle.UnpicklingError, EOFError, AttributeError,
+                IndexError, ValueError) as e:
+            raise CodecError(f"corrupt pybin1 payload: {e}") from e
+
+
+_CODECS: Dict[str, Any] = {JSON: JsonCodec, PYBIN1: PyBin1Codec}
+
+
+def get_codec(codec_id: str):
+    """Codec class for a stable id; raises on unknown ids so a typo'd
+    --wire-codec fails at startup, not as a silent JSON fallback."""
+    try:
+        return _CODECS[codec_id]
+    except KeyError:
+        raise ValueError(
+            f"unknown codec {codec_id!r} (known: {sorted(_CODECS)})") from None
+
+
+def known_codecs():
+    return sorted(_CODECS)

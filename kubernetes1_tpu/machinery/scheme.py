@@ -388,41 +388,66 @@ class Scheme:
     # The apiserver's whole read path (single GETs, list items, watch
     # frames) funnels through these two helpers so N watchers and M list
     # responses touching the same committed object state share ONE
-    # json.dumps — the economics the reference gets from its watch cache
-    # (storage/cacher.go serves pre-serialized event payloads).
+    # serialization — the economics the reference gets from its watch
+    # cache (storage/cacher.go serves pre-serialized event payloads).
+    # The codec axis (machinery/codec.py) lets the store's binary wire
+    # ride the SAME cache: the key carries the codec id, so a revision's
+    # JSON bytes and its pybin1 bytes are independent entries and neither
+    # can be served for the other.
 
-    def encode_bytes(self, d: Dict[str, Any], version: str = "") -> bytes:
-        """Canonical JSON bytes for an ALREADY-ENCODED wire dict (the form
-        the store commits and watch events carry), memoized per
-        (uid, resourceVersion, version).  Uncommitted objects (no uid/rv —
-        Status payloads, ERROR frames) bypass the cache."""
+    def encode_bytes(self, d: Dict[str, Any], version: str = "",
+                     codec: str = "json") -> bytes:
+        """Canonical codec bytes for an ALREADY-ENCODED wire dict (the
+        form the store commits and watch events carry), memoized per
+        (uid, resourceVersion, version, codec).  Uncommitted objects (no
+        uid/rv — Status payloads, ERROR frames) bypass the cache."""
         meta = d.get("metadata") or {}
         uid, rv = meta.get("uid"), meta.get("resourceVersion")
-        key = (uid, rv, version) if uid and rv else None
+        key = (uid, rv, version, codec) if uid and rv else None
         if key is not None:
             raw = self.serialization_cache.get(key)
             if raw is not None:
                 return raw
         out = self.convert_dict(d, version) if version else d
-        raw = json.dumps(out, separators=(",", ":")).encode()
+        if codec == "json":
+            raw = json.dumps(out, separators=(",", ":")).encode()
+        else:
+            from .codec import get_codec
+
+            raw = get_codec(codec).encode(out)
         if key is not None:
             self.serialization_cache.put(key, raw)
         return raw
 
-    def encode_obj_bytes(self, obj: Any, version: str = "") -> bytes:
-        """Canonical JSON bytes for a DECODED object, sharing the same
-        (uid, resourceVersion, version) cache as encode_bytes — a write
-        response populates the entry the watch fan-out then hits."""
+    def decode_bytes(self, raw: bytes, codec: str = "json") -> Dict[str, Any]:
+        """Codec bytes -> the encoded wire dict (encode_bytes' inverse;
+        the caller decides whether to Scheme.decode the dict further)."""
+        if codec == "json":
+            return json.loads(raw)
+        from .codec import get_codec
+
+        return get_codec(codec).decode(raw)
+
+    def encode_obj_bytes(self, obj: Any, version: str = "",
+                         codec: str = "json") -> bytes:
+        """Canonical codec bytes for a DECODED object, sharing the same
+        (uid, resourceVersion, version, codec) cache as encode_bytes — a
+        write response populates the entry the watch fan-out then hits."""
         meta = getattr(obj, "metadata", None)
         uid = getattr(meta, "uid", "") if meta is not None else ""
         rv = getattr(meta, "resource_version", "") if meta is not None else ""
-        key = (uid, rv, version) if uid and rv else None
+        key = (uid, rv, version, codec) if uid and rv else None
         if key is not None:
             raw = self.serialization_cache.get(key)
             if raw is not None:
                 return raw
-        raw = json.dumps(self.encode(obj, version),
-                         separators=(",", ":")).encode()
+        encoded = self.encode(obj, version)
+        if codec == "json":
+            raw = json.dumps(encoded, separators=(",", ":")).encode()
+        else:
+            from .codec import get_codec
+
+            raw = get_codec(codec).encode(encoded)
         if key is not None:
             self.serialization_cache.put(key, raw)
         return raw

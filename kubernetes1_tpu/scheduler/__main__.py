@@ -24,6 +24,14 @@ def main():
     ap.add_argument("--bind-workers", type=int, default=8,
                     help="bind worker pool size; each worker drains the "
                          "bind queue greedily and ships bulk bind requests")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="pod-partition count for sharded scheduling: run "
+                         "N scheduler processes with the same --shards N "
+                         "and distinct --identity; shard leases partition "
+                         "the pods across them (a gang never splits)")
+    ap.add_argument("--owned-shards", default="",
+                    help="comma list of shard indices to own STATICALLY "
+                         "instead of via shard leases (manual partition)")
     ap.add_argument("--policy-config-file", default="",
                     help="scheduler policy JSON (extenders; ref "
                          "examples/scheduler-policy-config.json)")
@@ -43,11 +51,19 @@ def main():
             policy = json.load(f)
 
     cs = clientset_from_args(args)
+    owned = None
+    if args.owned_shards:
+        owned = [int(s) for s in args.owned_shards.split(",") if s.strip()]
     sched = Scheduler(
         cs, scheduler_name=args.scheduler_name,
         metrics_port=None if args.metrics_port < 0 else args.metrics_port,
         policy=policy,
         bind_workers=args.bind_workers,
+        shards=args.shards,
+        owned_shards=owned,
+        # sharded + no static split -> shard leases (claim/steal/standby)
+        shard_lease=args.shards > 1 and owned is None,
+        identity=args.identity,
     )
     stop = threading.Event()
 

@@ -13,7 +13,7 @@ async bind lands (ref: scheduler.go:365 assume + cache AddPod).
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from ..api import types as t
 from ..utils import locksan
@@ -74,7 +74,15 @@ class ExtendedResourceInfo:
 
     def __init__(self):
         self.devices: Dict[str, t.ExtendedResourceDevice] = {}
-        self.used: Set[str] = set()
+        # chip id -> holder count.  A REFCOUNT, not a set: with sharded
+        # schedulers one cache can transiently hold TWO pods referencing
+        # one chip — this instance's assumed (bind in flight) loser plus
+        # the peer's confirmed winner arriving off the watch.  A set
+        # dropped the chip on the loser's forget even though the winner
+        # still held it, and the phantom free chip drew every retry into
+        # the same conflict forever (observed livelock).  Count zero =
+        # available; membership tests read like the old set.
+        self.used: Dict[str, int] = {}
         self._avail_count = 0
         self._slice_avail: Dict[str, int] = {}
 
@@ -113,9 +121,10 @@ class ExtendedResourceInfo:
 
     def use(self, ids: List[str]):
         for i in ids:
-            if i in self.used:
-                continue
-            self.used.add(i)
+            n = self.used.get(i, 0)
+            self.used[i] = n + 1
+            if n:
+                continue  # already unavailable; just one more holder
             d = self.devices.get(i)
             if d is not None and d.health == t.DEVICE_HEALTHY:
                 self._avail_count -= 1
@@ -124,9 +133,13 @@ class ExtendedResourceInfo:
 
     def release(self, ids: List[str]):
         for i in ids:
-            if i not in self.used:
+            n = self.used.get(i, 0)
+            if n == 0:
                 continue
-            self.used.discard(i)
+            if n > 1:
+                self.used[i] = n - 1
+                continue  # another holder remains: still unavailable
+            del self.used[i]
             d = self.devices.get(i)
             if d is not None and d.health == t.DEVICE_HEALTHY:
                 self._avail_count += 1
@@ -208,7 +221,7 @@ class NodeInfo:
         for res, info in self.extended.items():
             ci = ExtendedResourceInfo()
             ci.devices = info.devices  # device descriptors are read-only here
-            ci.used = set(info.used)
+            ci.used = dict(info.used)
             ci._avail_count = info._avail_count
             ci._slice_avail = dict(info._slice_avail)
             c.extended[res] = ci

@@ -37,13 +37,21 @@ class SchedulingQueue:
             self._seq += 1
             self._cond.notify()
 
-    def add_backoff(self, key: str, priority: int = 0):
-        """Re-add after exponential backoff (unschedulable path)."""
+    def add_backoff(self, key: str, priority: int = 0,
+                    attempts: Optional[int] = None):
+        """Re-add after exponential backoff (unschedulable path).
+        `attempts` overrides the internal schedule-failure counter with a
+        caller-tracked one — the bind-failure path uses it because a
+        successful SCHEDULE forgets the internal counter before its async
+        bind resolves, and a failing bind must still back off
+        exponentially, not restart at the base delay every cycle."""
         with self._cond:
             if self._shutdown:
                 return
             n = self._attempts.get(key, 0)
             self._attempts[key] = n + 1
+            if attempts is not None:
+                n = attempts
             delay = min(self._base * (2**n), self._max)
             if key in self._timers:
                 return

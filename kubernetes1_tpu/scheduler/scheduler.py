@@ -45,6 +45,7 @@ from .devices import allocate_for_pod, fits_devices
 from .predicates import EquivalenceCache, PodAffinityChecker, run_predicates
 from .priorities import prioritize
 from .queue import SchedulingQueue
+from .sharding import node_shard, pod_shard
 
 # Feasibility sampling (upstream percentageOfNodesToScore): on big clusters
 # stop the filter scan once this many feasible nodes are found — scoring 100
@@ -68,17 +69,23 @@ class _BindItem:
     """One queued bind: everything a bind worker needs to ship it — alone
     (extender delegation, singleton) or as part of a bulk request (the
     greedy bind-queue drain groups items by namespace and POSTs them as
-    one pods/bindings:batch)."""
+    one pods/bindings:batch).  `single` marks an item re-queued by a
+    failed bulk envelope: it must ship as a singleton (never re-enter a
+    bulk request that would fail the same way), but through the WORKER
+    POOL so the fallback drains in parallel."""
 
-    __slots__ = ("pod", "assumed", "binding", "result", "ext_binder", "tid")
+    __slots__ = ("pod", "assumed", "binding", "result", "ext_binder", "tid",
+                 "single")
 
-    def __init__(self, pod, assumed, binding, result, ext_binder, tid):
+    def __init__(self, pod, assumed, binding, result, ext_binder, tid,
+                 single=False):
         self.pod = pod
         self.assumed = assumed
         self.binding = binding
         self.result = result
         self.ext_binder = ext_binder
         self.tid = tid
+        self.single = single
 
 
 class Scheduler:
@@ -92,6 +99,18 @@ class Scheduler:
         policy: Optional[dict] = None,  # scheduler policy JSON (extenders)
         bind_workers: int = 8,          # bind pool size (--bind-workers)
         max_bind_batch: int = 128,      # per-request cap on bulk binds
+        shards: int = 1,                # pod-partition count (--shards):
+                                        # hash(namespace, gang or pod name)
+                                        # — a gang never splits (sharding.py)
+        owned_shards=None,              # static shard subset this instance
+                                        # schedules (tests / manual split);
+                                        # None + shards>1 + shard_lease ->
+                                        # LeaseSet-managed ownership
+        shard_lease: bool = False,      # acquire shards via shard leases
+                                        # (steal on instance death)
+        identity: str = "scheduler-0",  # lease identity (--identity)
+        shard_lease_duration: float = 15.0,
+        shard_retry_period: float = 2.0,
     ):
         self.cs = clientset
         self.name = scheduler_name
@@ -124,6 +143,38 @@ class Scheduler:
         self._bind_q: "_queue.Queue" = _queue.Queue()
         self._bind_workers = max(1, int(bind_workers))
         self._max_bind_batch = max(1, int(max_bind_batch))
+        # ---- scheduler sharding (optimistic-concurrency scale-out) ----
+        # shards=1 (default): this instance owns everything and the
+        # ownership check is a single int compare — byte-identical
+        # behavior to the unsharded scheduler.  shards>1: pods hash into
+        # partitions (sharding.pod_shard) and this instance schedules
+        # only the shards it OWNS — statically (owned_shards=) or through
+        # shard leases (LeaseSet: claim, steal expired, hot-standby the
+        # rest).  Binding stays optimistic: each instance places from its
+        # own informer-fed cache, and a cross-shard chip race is decided
+        # by the apiserver's device-claim guard — the loser's Conflict
+        # (DEVICE_CLAIM_CONFLICT marker) re-queues with backoff below.
+        self.shards = max(1, int(shards))
+        self.identity = identity
+        self._shard_lease = bool(shard_lease) and self.shards > 1
+        self._static_shards: Optional[frozenset] = None
+        if owned_shards is not None:
+            self._static_shards = frozenset(int(s) for s in owned_shards)
+        elif not self._shard_lease:
+            self._static_shards = frozenset(range(self.shards))
+        self._lease_set = None  # built in start() (needs the clientset live)
+        self._shard_lease_duration = shard_lease_duration
+        self._shard_retry_period = shard_retry_period
+        # Equal-score node ties break on a per-INSTANCE ordering when
+        # sharded: with the shared deterministic (score, name) order, N
+        # instances placing simultaneously from equally-stale caches all
+        # pick the SAME node and chips, and the optimistic-concurrency
+        # losers re-collide on every retry (observed as a conflict storm
+        # at small node counts).  Unsharded keeps the exact legacy order.
+        import zlib as _zlib
+
+        self._tiebreak_salt = (
+            _zlib.crc32(identity.encode()) if self.shards > 1 else None)
         # /metrics surface (ref plugin/pkg/scheduler/metrics/): the SLO
         # check reads these from OUTSIDE the process — queue wait under a
         # create burst is not attempt latency, and VERDICT r2 couldn't tell
@@ -150,6 +201,12 @@ class Scheduler:
 
         self._bulk_fallback_reporter = RateLimitedReporter(
             "scheduler-bulk-bind", window=30.0)
+        # cross-shard chip races lost at bind (apiserver device-claim
+        # guard): each one re-queues with backoff and retries on a
+        # refreshed cache — a high rate means shards are contending on
+        # too few nodes, not that work is lost
+        self._bind_conflicts_ctr = self.metrics.counter(
+            "scheduler_bind_conflicts_total")
         self._attempts_ctr = self.metrics.counter(
             "scheduler_schedule_attempts_total")
         self._failures_ctr = self.metrics.counter(
@@ -172,6 +229,14 @@ class Scheduler:
         # pod carrying anti-affinity terms (the sched_perf scale guard:
         # plain clusters never pay).
         self._anti_affinity_uids: set = set()
+        # Bind-failure backoff attempts, SEPARATE from the queue's
+        # schedule-failure counter: a successful schedule forgets the
+        # queue counter before the async bind resolves, so without this a
+        # failing bind (cross-shard claim conflict, shed) re-queued at
+        # the flat base delay forever — two shards re-colliding at 10
+        # retries/s (observed).  Benignly racy dict (GIL-atomic ops; a
+        # lost increment only shortens one backoff step).
+        self._bind_fail_counts: Dict[str, int] = {}
 
     # legacy int views kept for in-process callers (tests, bench)
     @property
@@ -195,7 +260,9 @@ class Scheduler:
                     extra={"scheduler_pending_pods": self.queue.depth,
                            # backlog visibility during density runs: the
                            # burst tail IS this queue's depth
-                           "scheduler_bind_queue_depth": self._bind_q.qsize},
+                           "scheduler_bind_queue_depth": self._bind_q.qsize,
+                           "scheduler_shards_owned":
+                               lambda: len(self.owned_shards())},
                     spans=self.spans,
                     ready_fn=lambda: (self.pods.has_synced()
                                       and self.nodes.has_synced()),
@@ -228,6 +295,20 @@ class Scheduler:
         )
         self.factory.start_all()
         self.factory.wait_for_sync()
+        if self._shard_lease and self._lease_set is None:
+            from ..client.leaderelection import LeaseSet
+
+            # started AFTER informer sync: _on_shard_acquired re-lists
+            # pending pods of a freshly-owned shard, which needs a warm
+            # informer to see them
+            self._lease_set = LeaseSet(
+                self.cs, f"ktpu-scheduler-{self.name}", self.identity,
+                self.shards,
+                lease_duration=self._shard_lease_duration,
+                retry_period=self._shard_retry_period,
+                on_acquired=self._on_shard_acquired,
+                on_lost=self._on_shard_lost,
+            ).start()
         worker = threading.Thread(target=self._loop, daemon=True, name="scheduleOne")
         worker.start()
         self._threads.append(worker)
@@ -242,12 +323,45 @@ class Scheduler:
 
     def stop(self):
         self._stop.set()
+        if self._lease_set is not None:
+            self._lease_set.stop()  # releases held shard leases (fast steal)
         self.queue.shut_down()
         for _ in range(self._bind_workers):
             self._bind_q.put(None)
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self.factory.stop_all()
+
+    # ------------------------------------------------------------- sharding
+
+    def owned_shards(self) -> frozenset:
+        """Shards this instance currently schedules (static or leased)."""
+        if self._static_shards is not None:
+            return self._static_shards
+        if self._lease_set is not None:
+            return self._lease_set.owned()
+        return frozenset()
+
+    def _owns(self, pod: t.Pod) -> bool:
+        if self.shards <= 1:
+            return True
+        return pod_shard(pod, self.shards) in self.owned_shards()
+
+    def _on_shard_acquired(self, shard: int):
+        """A shard just became ours (boot, or stolen from a dead peer):
+        everything pending in it must enter the queue NOW — its previous
+        owner's queue died with it, and watch events for these pods
+        already happened."""
+        for p in self.pods.list():
+            if self._schedulable(p) and pod_shard(p, self.shards) == shard:
+                self.queue.add(p.key(), p.spec.priority)
+
+    def _on_shard_lost(self, shard: int):
+        """Lost to a peer (shed on rebalance, or stolen while we were
+        presumed dead).  Queued keys are discarded lazily — _schedule_one
+        re-checks ownership at pop — and in-flight binds are left to
+        finish: the device-claim guard and pod-level CAS make a brief
+        dual-owner window safe, just conflict-noisier."""
 
     # --------------------------------------------------------- pod handlers
 
@@ -273,19 +387,25 @@ class Scheduler:
     def _on_pod_add(self, pod: t.Pod):
         self._note_affinity(pod)
         if self._schedulable(pod):
-            self.queue.add(pod.key(), pod.spec.priority)
+            # other shards' pods stay out of the queue, but EVERY bound
+            # pod below enters the cache: placement must see the whole
+            # cluster's chip usage regardless of who scheduled it
+            if self._owns(pod):
+                self.queue.add(pod.key(), pod.spec.priority)
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
 
     def _on_pod_update(self, old: t.Pod, pod: t.Pod):
         self._note_affinity(pod)
         if self._schedulable(pod):
-            self.queue.add(pod.key(), pod.spec.priority)
+            if self._owns(pod):
+                self.queue.add(pod.key(), pod.spec.priority)
         elif pod.spec.node_name:
             self.cache.add_pod(pod)
 
     def _on_pod_delete(self, pod: t.Pod):
         self._anti_affinity_uids.discard(pod.metadata.uid)
+        self._bind_fail_counts.pop(pod.key(), None)
         self.cache.remove_pod(pod)
         # freed resources may unblock backing-off pods
         self.queue.flush_backoffs()
@@ -347,6 +467,8 @@ class Scheduler:
         pod = self.pods.get(key)
         if pod is None or not self._schedulable(pod):
             return
+        if not self._owns(pod):
+            return  # shard moved to a peer after this key was queued
         start = time.monotonic()
         self._attempts_ctr.inc()
         tid = self._pod_trace_id(pod)
@@ -512,9 +634,31 @@ class Scheduler:
         # full device allocation runs only on the winner (best-fit slice +
         # coordinate sort are O(devices log devices) — too hot per-candidate);
         # on the rare count-check/allocator disagreement, fall to the next best
+        if self._tiebreak_salt is None:
+            def tiebreak(name):
+                return name
+
+            def node_pref(name):
+                return 0
+        else:
+            import zlib as _zlib
+
+            def tiebreak(name):
+                return _zlib.crc32(name.encode(), self._tiebreak_salt)
+
+            # soft node-space partition (see sharding.node_shard): owned
+            # nodes outrank higher-scored foreign ones, so N instances
+            # pack N disjoint node subsets instead of dogpiling the one
+            # argmax node — conflicts happen only at overflow boundaries
+            owned = self.owned_shards()
+
+            def node_pref(name):
+                return 1 if node_shard(name, self.shards) in owned else 0
         for ni in sorted(
             feasible,
-            key=lambda n: (scores[n.node.metadata.name], n.node.metadata.name),
+            key=lambda n: (node_pref(n.node.metadata.name),
+                           scores[n.node.metadata.name],
+                           tiebreak(n.node.metadata.name)),
             reverse=True,
         ):
             assignments, why = allocate_for_pod(pod, ni)
@@ -556,6 +700,7 @@ class Scheduler:
     # ---------------------------------------------------------- bind workers
 
     def _bind_success(self, item: _BindItem):
+        self._bind_fail_counts.pop(item.pod.key(), None)
         self._clear_nomination_for(item.pod.key())
         self.recorder.event(
             item.pod, "Normal", "Scheduled",
@@ -569,12 +714,26 @@ class Scheduler:
         assumption; terminal placement races (Conflict/NotFound) stay
         forgotten while retryable failures (5xx, extender, transport — the
         bind may or may not have landed; a re-bind racing a landed one
-        answers Conflict, absorbed above) also re-queue with backoff."""
+        answers Conflict, absorbed above) also re-queue with backoff.
+
+        One Conflict flavor IS retryable: the apiserver's device-claim
+        guard answering that another scheduler shard just took a chip
+        this placement wanted (DEVICE_CLAIM_CONFLICT marker).  The pod
+        itself is still unbound — re-queue it; by the time backoff
+        expires the informer has delivered the winner's bind and the
+        retry places on what is actually free.  This is the optimistic-
+        concurrency loser path, not an error."""
         self.cache.forget_pod(item.assumed)
         if sp is not None:
             sp.annotate(failure=str(err))
         self.recorder.event(item.pod, "Warning", "FailedBinding", str(err))
-        if not isinstance(err, (Conflict, NotFound)):
+        key = item.pod.key()
+        if isinstance(err, Conflict) \
+                and t.DEVICE_CLAIM_CONFLICT in str(err):
+            self._bind_conflicts_ctr.inc()
+            _retry.note_retry("bind_conflict")
+            self._requeue_failed_bind(key, item.pod.spec.priority)
+        elif not isinstance(err, (Conflict, NotFound)):
             # unified retry policy accounting: a 429 here means the
             # apiserver shed the bind under overload (the transport layer
             # already honored its Retry-After) — the re-queue with backoff
@@ -582,7 +741,14 @@ class Scheduler:
             _retry.note_retry(
                 "bind_shed" if getattr(err, "code", 0) == 429
                 else "bind_requeue")
-            self.queue.add_backoff(item.pod.key(), item.pod.spec.priority)
+            self._requeue_failed_bind(key, item.pod.spec.priority)
+
+    def _requeue_failed_bind(self, key: str, priority: int):
+        """Backoff scaled by CONSECUTIVE bind failures for this pod (the
+        queue's own counter was forgotten when the schedule succeeded)."""
+        n = self._bind_fail_counts.get(key, 0)
+        self._bind_fail_counts[key] = n + 1
+        self.queue.add_backoff(key, priority, attempts=n)
 
     def _bind_one(self, item: _BindItem):
         """Ship one bind alone: the extender-delegation path, a batch of
@@ -658,8 +824,14 @@ class Scheduler:
         self._bulk_fallback_reporter.report(
             f"scheduler: bulk bind of {len(items)} pods failed "
             f"({fallback_err}); falling back to per-pod binds")
+        # drain the fallback through the WORKER POOL, not inline: running
+        # N singleton binds sequentially in this worker serialized the
+        # whole batch behind one bad envelope (and starved the queue of
+        # this worker for N round-trips).  `single` keeps the re-queued
+        # items out of any future bulk envelope.
         for it in items:
-            self._bind_one(it)
+            it.single = True
+            self._bind_q.put(it)
 
     def _bind_loop(self):
         import queue as _queue
@@ -683,9 +855,13 @@ class Scheduler:
                 batch.append(nxt)
             self.bind_batch_size.observe(len(batch))
             try:
-                singles = [it for it in batch if it.ext_binder is not None]
-                bulk = [it for it in batch if it.ext_binder is None]
-                for it in singles:  # extender wire shape: one pod per call
+                singles = [it for it in batch
+                           if it.ext_binder is not None or it.single]
+                bulk = [it for it in batch
+                        if it.ext_binder is None and not it.single]
+                # extender wire shape is one pod per call; `single` items
+                # are bulk-envelope fallbacks that must not re-batch
+                for it in singles:
                     self._bind_one(it)
                 if len(bulk) == 1:
                     self._bind_one(bulk[0])

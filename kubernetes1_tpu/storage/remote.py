@@ -24,7 +24,9 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 from ..machinery import Conflict, NotFound, WatchEvent
+from ..machinery.codec import CodecError, get_codec
 from ..machinery.scheme import Scheme
+from . import wire
 from .server import NotPrimary, error_from_wire
 from ..utils import faultline, locksan
 
@@ -66,9 +68,14 @@ class RemoteWatcher:
     and wake `next_batch_timeout` with an EMPTY list so the consumer can
     advance freshness without waiting out its poll timeout."""
 
-    def __init__(self, conn, f):
+    def __init__(self, conn, f, framer=None, scheme: Optional[Scheme] = None):
         self._conn = conn
         self._f = f
+        # binary fast path: a negotiated BinFramer replaces line reads;
+        # event objects may arrive as codec bytes ("objraw") decoded
+        # through the scheme's codec axis
+        self._framer = framer
+        self._scheme = scheme
         # items: a non-empty List[WatchEvent], a ("progress",) sentinel,
         # or None (EOF)
         self._q: "queue.Queue[Optional[list]]" = queue.Queue()
@@ -85,25 +92,46 @@ class RemoteWatcher:
 
     _PROGRESS = ["progress"]  # shared sentinel; identity-compared
 
+    def _event(self, e: dict) -> WatchEvent:
+        raw = e.get("objraw")
+        if raw is not None:
+            return WatchEvent(
+                e["type"],
+                self._scheme.decode_bytes(raw, self._framer.codec_id))
+        return WatchEvent(e["type"], e["object"])
+
+    def _recv_frame(self) -> Optional[dict]:
+        """One wire frame (None = legacy heartbeat).  Raises on stream
+        end: BrokenPipeError/FrameTruncated/CodecError all land in the
+        pump's except and close the stream cleanly — a torn length-
+        prefixed frame is a dead stream, never a hang."""
+        if self._framer is not None:
+            return self._framer.recv()
+        line = self._f.readline()
+        if not line:
+            raise BrokenPipeError("watch stream closed")
+        line = line.strip()
+        if not line:
+            return None  # legacy heartbeat
+        return json.loads(line)
+
     def _pump(self):
         try:
-            for line in self._f:
+            while True:
                 # fault injection: an injected drop here kills the stream
                 # like a mid-frame cut — `closed` is set below and the
                 # cacher reseeds (list + fresh watch), losing nothing
                 faultline.check("store.watch")
-                line = line.strip()
-                if not line:
+                frame = self._recv_frame()
+                if frame is None:
                     continue  # legacy heartbeat
-                frame = json.loads(line)
                 ev = frame.get("event")
                 if ev is not None:
-                    self._q.put([WatchEvent(ev["type"], ev["object"])])
+                    self._q.put([self._event(ev)])
                     continue
                 evs = frame.get("events")
                 if evs is not None:
-                    self._q.put([WatchEvent(e["type"], e["object"])
-                                 for e in evs])
+                    self._q.put([self._event(e) for e in evs])
                     continue
                 prog = frame.get("progress")
                 if prog is not None:
@@ -198,11 +226,19 @@ class RemoteStore:
     def __init__(self, scheme: Scheme,
                  address: Union[str, Tuple[str, int]],
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, codec: str = "json"):
         self._scheme = scheme
         self._addrs = _parse_addresses(address)
         self._active = 0
         self.timeout = timeout
+        # wire codec: "json" = the legacy newline-JSON protocol with zero
+        # negotiation; anything else is negotiated per dial and falls
+        # back to newline-JSON when the server declines (old server,
+        # standby) — see storage/wire.py.  Validated here so a typo'd
+        # --wire-codec fails at construction, not mid-traffic.
+        if codec != "json":
+            get_codec(codec)
+        self.codec = codec
         self._ssl_ctx = None
         if ca_file:
             import ssl
@@ -257,7 +293,7 @@ class RemoteStore:
                 return
             self._active = (self._active + 1) % len(self._addrs)
             pool, self._pool = self._pool, []
-        for conn, _f in pool:
+        for conn, _f, _framer in pool:
             try:
                 conn.close()
             except OSError:
@@ -275,6 +311,43 @@ class RemoteStore:
             host = addr if isinstance(addr, str) else addr[0]
             conn = self._ssl_ctx.wrap_socket(conn, server_hostname=host)
         return conn, conn.makefile("rwb")
+
+    def _connect_negotiated(self, timeout: Optional[float], addr=None):
+        """Dial and (when a non-JSON codec is configured) negotiate the
+        binary framing for this connection.  Returns (conn, f, framer)
+        with framer=None meaning legacy newline-JSON — the fallback when
+        the server declines.  Transport failures during negotiation raise
+        OSError with NOTHING application-visible sent, so callers treat
+        them exactly like dial failures (always safe to fail over)."""
+        conn, f = self._connect(timeout, addr)
+        if self.codec == "json":
+            return conn, f, None
+        try:
+            f.write(json.dumps(wire.negotiate_request(self.codec))
+                    .encode() + b"\n")
+            f.flush()
+            line = f.readline()
+            if not line:
+                raise BrokenPipeError("store closed during negotiation")
+            resp = json.loads(line)
+        except ValueError as e:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"store: corrupt negotiation response: {e}") from e
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        if wire.negotiation_accepted(resp, self.codec):
+            return conn, f, wire.BinFramer(f, self.codec, site="store.rpc")
+        # old server / unsupported codec: the connection stays usable on
+        # the legacy protocol — negotiation is an upgrade, not a gate
+        return conn, f, None
 
     _IDEMPOTENT = frozenset({"get", "list", "current_revision", "compact"})
 
@@ -314,26 +387,43 @@ class RemoteStore:
             pooled = pair is not None
             if pair is None:
                 try:
-                    pair = self._connect(self.timeout, addr)
+                    pair = self._connect_negotiated(self.timeout, addr)
                 except OSError as e:
                     last_exc = ConnectionError(
                         f"store {addr} unreachable: {e}")
                     self._advance(addr)
                     continue
-            conn, f = pair
+            conn, f, framer = pair
             sent = False
+            resp = None
             try:
                 # fault injection BEFORE the send: `sent` stays False, so
                 # the existing may-have-been-applied retry rules stay
                 # exactly as safe under chaos as under real dial failures
                 faultline.check("store.rpc")
-                f.write(json.dumps({"id": rid, "method": method,
-                                    "params": params or {}}).encode() + b"\n")
-                f.flush()
-                sent = True
-                line = f.readline()
-                if not line:
-                    raise BrokenPipeError("store closed the connection")
+                req = {"id": rid, "method": method, "params": params or {}}
+                if framer is not None:
+                    # a send that dies mid-frame leaves an INCOMPLETE
+                    # length-prefixed frame the server can never dispatch,
+                    # but `sent` still goes True only after a full send —
+                    # the conservative rule costs nothing and keeps the
+                    # two framings under one contract
+                    framer.send(req)
+                    sent = True
+                    resp = framer.recv()
+                else:
+                    f.write(json.dumps(req).encode() + b"\n")
+                    f.flush()
+                    sent = True
+                    line = f.readline()
+                    if not line:
+                        raise BrokenPipeError("store closed the connection")
+            except CodecError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise ConnectionError("store: corrupt response frame")
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 try:
                     conn.close()
@@ -347,14 +437,15 @@ class RemoteStore:
                 if not pooled:
                     self._advance(addr)  # fresh connection failed: move on
                 continue
-            try:
-                resp = json.loads(line)
-            except ValueError:
+            if resp is None:
                 try:
-                    conn.close()
-                except OSError:
-                    pass
-                raise ConnectionError("store: corrupt response frame")
+                    resp = json.loads(line)
+                except ValueError:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    raise ConnectionError("store: corrupt response frame")
             if resp.get("id") != rid:
                 try:
                     conn.close()
@@ -477,7 +568,8 @@ class RemoteStore:
             addr = self._addrs[self._active]
             try:
                 faultline.check("store.watch")  # injected dial refusal
-                conn, f = self._connect(self.timeout, addr)
+                conn, f, framer = self._connect_negotiated(
+                    self.timeout, addr)
             except OSError as e:
                 last_exc = ConnectionError(f"store {addr} unreachable: {e}")
                 self._advance(addr)
@@ -486,14 +578,17 @@ class RemoteStore:
             if queue_limit is not None:
                 params["queue_limit"] = queue_limit
             try:
-                f.write(json.dumps({"id": 0, "method": "watch",
-                                    "params": params})
-                        .encode() + b"\n")
-                f.flush()
-                line = f.readline()
-                if not line:
-                    raise ConnectionError(f"store {addr} closed")
-                resp = json.loads(line)
+                req = {"id": 0, "method": "watch", "params": params}
+                if framer is not None:
+                    framer.send(req)
+                    resp = framer.recv()
+                else:
+                    f.write(json.dumps(req).encode() + b"\n")
+                    f.flush()
+                    line = f.readline()
+                    if not line:
+                        raise ConnectionError(f"store {addr} closed")
+                    resp = json.loads(line)
                 if resp.get("error"):
                     err = error_from_wire(resp["error"])
                     if isinstance(err, NotPrimary):
@@ -512,14 +607,15 @@ class RemoteStore:
                 conn.close()
                 raise
             conn.settimeout(None)  # the stream blocks until events arrive
-            return RemoteWatcher(conn, f)
+            return RemoteWatcher(conn, f, framer=framer,
+                                 scheme=self._scheme)
         raise last_exc if last_exc else ConnectionError(
             f"store watch failed on every address: {self._addrs}")
 
     def close(self):
         with self._lock:
             pool, self._pool = self._pool, []
-        for conn, _f in pool:
+        for conn, _f, _framer in pool:
             try:
                 conn.close()
             except OSError:

@@ -53,7 +53,9 @@ from ..machinery import (
     NotFound,
     TooOldResourceVersion,
 )
+from . import wire
 from .store import Store
+from ..machinery.codec import CodecError, known_codecs
 from ..utils import faultline, locksan
 
 class NotPrimary(ApiError):
@@ -269,6 +271,29 @@ class StoreServer:
                 rid = req.get("id")
                 method = req.get("method")
                 params = req.get("params") or {}
+                if method == wire.NEGOTIATE_METHOD:
+                    # connection-level codec/framing upgrade: answered even
+                    # by a standby (the NotPrimary verdict belongs to the
+                    # OPERATIONS that follow, not to the transport).  An
+                    # unsupported codec answers an error and the connection
+                    # STAYS newline-JSON — the client's fallback path.
+                    codec_id = params.get("codec", "")
+                    framing = params.get("framing", "")
+                    if (codec_id in known_codecs()
+                            and framing == wire.FRAMING_LP1):
+                        f.write(json.dumps({"id": rid, "result": {
+                            "codec": codec_id,
+                            "framing": wire.FRAMING_LP1}}).encode() + b"\n")
+                        f.flush()
+                        self._serve_conn_binary(conn, f, codec_id)
+                        return  # connection consumed by the binary loop
+                    f.write(json.dumps({"id": rid, "error": {
+                        "kind": "Internal",
+                        "msg": f"unsupported codec/framing "
+                               f"{codec_id!r}/{framing!r}"}}).encode()
+                        + b"\n")
+                    f.flush()
+                    continue
                 if method == "replicate":
                     self._serve_replica(conn, f, rid, params)
                     return  # connection consumed by the stream
@@ -302,10 +327,61 @@ class StoreServer:
     def _drop_conn(self, conn):
         with self._conns_lock:
             self._conns.discard(conn)
+        # shutdown, not just close: the makefile object can outlive this
+        # frame (an exception's traceback cycle holds it until a GC
+        # pass), and close() alone leaves the fd open while it does —
+        # the peer would block on a dead-but-unclosed stream instead of
+        # reading EOF (same rule as _serve_replica's teardown)
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             conn.close()
         except OSError:
             pass
+
+    def _serve_conn_binary(self, conn, f, codec_id: str):
+        """Post-negotiation request loop: length-prefixed codec frames in
+        both directions (storage/wire.py).  Replication stays on the
+        newline-JSON protocol — a standby never negotiates."""
+        framer = wire.BinFramer(f, codec_id, site="store.rpc")
+        while not self._stop.is_set():
+            try:
+                req = framer.recv()
+            except BrokenPipeError:
+                return  # clean close at a frame boundary
+            except (wire.FrameTruncated, CodecError, OSError):
+                return  # torn/corrupt frame: sever the connection
+            rid = req.get("id")
+            method = req.get("method")
+            params = req.get("params") or {}
+            if method == "replicate":
+                framer.send({"id": rid, "error": {
+                    "kind": "Internal",
+                    "msg": "replicate is not served on a binary-framed "
+                           "connection; dial a plain one"}})
+                continue
+            if method == "watch":
+                if not self.primary:
+                    framer.send({"id": rid, "error": {
+                        "kind": "NotPrimary",
+                        "msg": "standby: not serving watches"}})
+                    continue
+                self._serve_watch(conn, f, rid, params, framer=framer)
+                return  # connection consumed by the stream
+            try:
+                result = self._dispatch(method, params)
+                framer.send({"id": rid, "result": result})
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+            except Exception as e:  # noqa: BLE001
+                if not isinstance(e, ApiError):
+                    traceback.print_exc()
+                try:
+                    framer.send({"id": rid, "error": error_to_wire(e)})
+                except OSError:
+                    return
 
     # The store's decoded-object API re-encodes at the edge; here we use the
     # private encoded form directly to avoid a decode+encode per op.
@@ -681,7 +757,13 @@ class StoreServer:
             except OSError:
                 pass
 
-    def _serve_watch(self, conn, f, rid, params):
+    def _serve_watch(self, conn, f, rid, params, framer=None):
+        """framer=None is the legacy newline-JSON stream; a BinFramer
+        switches frames to length-prefixed codec payloads whose event
+        objects are per-revision cached bytes (Scheme.encode_bytes with
+        the codec id in the cache key) spliced into the envelope — one
+        encode serves every binary watcher of a revision, and one
+        send_payloads call ships a whole group-commit batch."""
         try:
             kw = {}
             if "queue_limit" in params:
@@ -689,12 +771,20 @@ class StoreServer:
             w = self.store.watch(params.get("prefix", ""),
                                  int(params.get("since_rev", 0)), **kw)
         except Exception as e:  # noqa: BLE001
-            f.write(json.dumps({"id": rid, "error": error_to_wire(e)})
-                    .encode() + b"\n")
-            f.flush()
+            err = {"id": rid, "error": error_to_wire(e)}
+            if framer is not None:
+                framer.send(err)
+            else:
+                f.write(json.dumps(err).encode() + b"\n")
+                f.flush()
             return
-        f.write(json.dumps({"id": rid, "result": "ok"}).encode() + b"\n")
-        f.flush()
+        if framer is not None:
+            framer.site = "store.watch"  # stream faults tear watch frames
+            framer.send({"id": rid, "result": "ok"})
+            scheme = self.store._scheme
+        else:
+            f.write(json.dumps({"id": rid, "result": "ok"}).encode() + b"\n")
+            f.flush()
         try:
             while not self._stop.is_set():
                 # progress floor read BEFORE the wait: any commit <= this
@@ -710,8 +800,25 @@ class StoreServer:
                         # client-side watcher reads EOF as a dead stream
                         # and its cacher reseeds with a fresh list
                         break
-                    f.write(json.dumps(
-                        {"progress": {"rev": rev_floor}}).encode() + b"\n")
+                    if framer is not None:
+                        framer.send({"progress": {"rev": rev_floor}})
+                    else:
+                        f.write(json.dumps(
+                            {"progress": {"rev": rev_floor}})
+                            .encode() + b"\n")
+                elif framer is not None:
+                    if framer.codec_id == "json":
+                        # length-prefixed JSON: no bytes values allowed in
+                        # the envelope, ship plain object dicts
+                        framer.send({"events": [
+                            {"type": ev.type, "object": ev.object}
+                            for ev in evs]})
+                    else:
+                        framer.send({"events": [
+                            {"type": ev.type,
+                             "objraw": scheme.encode_bytes(
+                                 ev.object, codec=framer.codec_id)}
+                            for ev in evs]})
                 elif len(evs) == 1:
                     # store watch events already carry the encoded dict form
                     f.write(json.dumps(
@@ -724,11 +831,19 @@ class StoreServer:
                     f.write(json.dumps(
                         {"events": [{"type": ev.type, "object": ev.object}
                                     for ev in evs]}).encode() + b"\n")
-                f.flush()
+                if framer is None:
+                    f.flush()
         except (BrokenPipeError, ConnectionResetError, OSError, ValueError):
             pass
         finally:
             w.stop()
+            # shutdown first: a torn frame's exception traceback can pin
+            # the makefile past this frame, and the client must see EOF
+            # NOW, not at the next GC pass (see _drop_conn)
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:

@@ -1,0 +1,133 @@
+"""Store wire framing: newline-JSON (legacy, default) and negotiated
+length-prefixed binary frames.
+
+The store<->apiserver link historically spoke one line of JSON per
+request/response/watch frame.  That stays the dial-time default and the
+universal fallback; a client that wants the binary fast path sends ONE
+ordinary JSON request first::
+
+    {"id": 0, "method": "negotiate",
+     "params": {"codec": "pybin1", "framing": "lp1"}}
+
+A server that supports the codec answers ``{"id": 0, "result": {"codec":
+..., "framing": "lp1"}}`` and the connection switches — every subsequent
+byte in BOTH directions is length-prefixed binary::
+
+    frame   = <len: 4-byte big-endian unsigned> <payload: len bytes>
+    payload = codec.encode(envelope dict)
+
+Any other answer (an old server's "unknown store method" error, an
+unsupported codec, a standby's NotPrimary) leaves the connection in
+newline-JSON mode — old client <-> new server and new client <-> old
+server both interoperate with zero configuration.
+
+Failure semantics the framing buys:
+
+- A frame is dispatched only when COMPLETE: a send that dies mid-frame
+  (injected sever, killed peer) leaves a prefix the receiver can never
+  mistake for a request, so mid-send failures are safely retryable.
+- A receiver hitting EOF after a partial header or mid-payload raises
+  ``FrameTruncated`` (a ConnectionError) — the torn frame surfaces as a
+  clean transport error through the existing retry/reseed machinery,
+  never as a hang or a half-parsed object.
+
+``BinFramer.send_payloads`` assembles a batch's frames into one buffer
+and ships it with a single write+flush — a group-commit watch fan-out
+batch is one syscall on the wire.  Outbound bytes run through the
+``store.rpc``/``store.watch`` faultline sites (``filter_bytes``), so
+seeded chaos can tear frames at the exact byte granularity a crash
+would.  The legacy newline-JSON protocol stays implemented inline in
+storage/server.py and storage/remote.py (a framer of None), unchanged
+byte for byte.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List
+
+from ..machinery.codec import get_codec
+from ..utils import faultline
+
+FRAMING_LP1 = "lp1"
+# Sanity cap on a declared frame length: a 30k-pod LIST response is tens
+# of MB; anything near this cap is a corrupt header, not a payload.
+MAX_FRAME_BYTES = 1 << 30
+_LEN = struct.Struct(">I")
+
+NEGOTIATE_METHOD = "negotiate"
+
+
+class FrameTruncated(ConnectionError):
+    """EOF (or an injected sever) mid-frame: the peer died or cut the
+    stream between a frame's header and its last byte."""
+
+
+class BinFramer:
+    """Length-prefixed frames carrying codec payloads (see module doc)."""
+
+    binary = True
+
+    def __init__(self, f, codec_id: str, site: str = "store.rpc"):
+        self._f = f
+        self._codec = get_codec(codec_id)
+        self.codec_id = codec_id
+        self.site = site
+
+    # ------------------------------------------------------------- sending
+
+    def send(self, obj: Any) -> None:
+        self.send_payloads([self._codec.encode(obj)])
+
+    def send_payloads(self, payloads: List[bytes]) -> None:
+        """Frame N pre-encoded payloads and ship them as ONE buffer, one
+        write+flush — batch frame assembly is the fan-out fast path."""
+        buf = bytearray()
+        for p in payloads:
+            buf += _LEN.pack(len(p))
+            buf += p
+        data = bytes(buf)
+        exc = None
+        if faultline.active():
+            data, exc = faultline.filter_bytes(self.site, data)
+        if data:
+            self._f.write(data)
+        self._f.flush()
+        if exc is not None:
+            raise exc
+
+    # ----------------------------------------------------------- receiving
+
+    def _read_exact(self, n: int, header: bool) -> bytes:
+        data = self._f.read(n)
+        if not data and header:
+            # EOF at a frame boundary: the clean-close case
+            raise BrokenPipeError("peer closed the connection")
+        if len(data) != n:
+            raise FrameTruncated(
+                f"truncated frame on {self.site}: wanted {n} bytes, "
+                f"got {len(data)}")
+        return data
+
+    def recv(self) -> dict:
+        """One decoded frame.  Raises BrokenPipeError on clean close,
+        FrameTruncated on a torn frame, CodecError on a corrupt payload."""
+        (n,) = _LEN.unpack(self._read_exact(_LEN.size, header=True))
+        if not 0 < n <= MAX_FRAME_BYTES:
+            raise FrameTruncated(
+                f"insane frame length {n} on {self.site}: corrupt header")
+        return self._codec.decode(self._read_exact(n, header=False))
+
+
+def negotiate_request(codec_id: str) -> dict:
+    return {"id": 0, "method": NEGOTIATE_METHOD,
+            "params": {"codec": codec_id, "framing": FRAMING_LP1}}
+
+
+def negotiation_accepted(resp: dict, codec_id: str) -> bool:
+    """True when the server's answer commits the connection to binary
+    framing under `codec_id` — anything else means stay on JSON."""
+    res = resp.get("result") or {}
+    return (not resp.get("error")
+            and res.get("codec") == codec_id
+            and res.get("framing") == FRAMING_LP1)

@@ -44,8 +44,17 @@ Wired sites:
   client.dial / client.request / client.watch   (client/rest.py — every
                                                  apiserver client, incl. the
                                                  kubelet's informer, status
-                                                 PUTs, and heartbeats)
-  store.rpc / store.watch                       (storage/remote.py)
+                                                 PUTs, heartbeats, and the
+                                                 scheduler's shard-lease
+                                                 renew/steal traffic)
+  store.rpc / store.watch                       (storage/remote.py op checks
+                                                 AND storage/wire.py framer
+                                                 sends: on a negotiated
+                                                 binary connection sever/
+                                                 truncate cut the length-
+                                                 prefixed frame mid-byte —
+                                                 the receiver must surface
+                                                 FrameTruncated, never hang)
   repl.link                                     (storage/server.py sender,
                                                  storage/standby.py consumer)
   wal.write                                     (storage/store.py)

@@ -38,6 +38,7 @@ import sys
 import tempfile
 import threading
 import time
+import traceback
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -79,6 +80,26 @@ NODE_SPEC = (
 CHIP_DEATH_SPEC = NODE_SPEC + ";device.health=error@0.04"
 
 NODE_MODES = ("node-kill", "kubelet-restart", "chip-death")
+
+
+def _stop_quietly_mod(fn):
+    """Guarded teardown (module-level twin of run_schedule's local): one
+    component's failing stop() must not leak the rest of a topology."""
+    try:
+        fn()
+    except Exception:  # noqa: BLE001
+        traceback.print_exc()
+
+
+# Sharded-scheduler schedule: control-plane client faults only (the
+# scheduler's informer, bind POSTs, and shard-lease renew traffic all
+# ride client.*), low enough that both instances keep making progress —
+# the seeded failure is the mid-run scheduler KILL, not the wire.
+SCHED_SPEC = (
+    "client.dial=drop@0.03;"
+    "client.request=drop@0.03|delay:5ms@0.05;"
+    "client.watch=drop@0.05"
+)
 
 
 def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
@@ -748,6 +769,108 @@ def run_node_schedule(seed: int, mode: str = "node-kill", duration: float = 6.0,
     return verdict
 
 
+def run_sched_shard_schedule(seed: int, duration: float = 6.0,
+                             spec: str = None,
+                             recovery_bound: float = 60.0) -> dict:
+    """Sharded-scheduler failure domain: two scheduler instances over a
+    4-shard pod partition (shard leases), a pod storm under client.*
+    faults, and ONE seeded mid-run scheduler KILL — the dead instance's
+    shard leases are NOT released (crash, not shutdown), so the survivor
+    must STEAL them at expiry and drain the orphaned shards' backlog.
+
+    Verdict invariants:
+      - the survivor ends up owning every shard (lease steal worked);
+      - every pod binds within recovery_bound of the kill;
+      - zero device double-allocations across the whole run (the
+        optimistic-binding guard held while BOTH instances raced);
+      - the run actually injected faults (schedule exercised).
+    """
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.scheduler import Scheduler
+    from kubernetes1_tpu.scheduler.devices import find_double_allocations
+    from kubernetes1_tpu.utils import faultline
+    from tests.helpers import make_node, make_tpu_pod
+
+    spec = SCHED_SPEC if spec is None else spec
+    SHARDS, NODES, CHIPS, PODS = 4, 6, 8, 36
+    master = cs = s_a = s_b = None
+    verdict = {"mode": "sched-shard", "seed": seed, "spec": spec,
+               "ok": False, "acked": 0, "recovery_s": None}
+    try:
+        master = Master().start()
+        cs = Clientset(master.url)
+        for i in range(NODES):
+            cs.nodes.create(make_node(
+                f"cn{i}", cpu="64", memory="256Gi", tpus=CHIPS,
+                slice_id=f"cs{i}", host_index=0))
+        kw = dict(shards=SHARDS, shard_lease=True,
+                  shard_lease_duration=1.5, shard_retry_period=0.3)
+        s_a = Scheduler(Clientset(master.url), identity="chaos-a", **kw)
+        s_b = Scheduler(Clientset(master.url), identity="chaos-b", **kw)
+        s_a.start()
+        s_b.start()
+        # both instances must actually own shards before the storm — the
+        # kill is only a steal test if ownership was split to begin with
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not (
+                s_a.owned_shards() and s_b.owned_shards()):
+            time.sleep(0.1)
+        verdict["initial_split"] = [sorted(s_a.owned_shards()),
+                                    sorted(s_b.owned_shards())]
+        faultline.activate(seed, spec)
+        for i in range(PODS):
+            cs.pods.create(make_tpu_pod(f"cp-{i}", tpus=1))
+
+        def bound_count():
+            pods, _ = cs.pods.list(namespace="default")
+            return sum(1 for p in pods if p.spec.node_name)
+
+        # let the storm get rolling, then CRASH instance a: leases stay
+        # held (no release) so the survivor must wait out expiry
+        deadline = time.monotonic() + duration
+        while time.monotonic() < deadline and bound_count() < PODS // 3:
+            time.sleep(0.1)
+        s_a._lease_set._stop.set()
+        s_a._lease_set._owned = frozenset()  # crash: nothing released
+        s_a.stop()
+        kill_t = time.monotonic()
+        verdict["killed_at_bound"] = bound_count()
+
+        deadline = kill_t + recovery_bound
+        while time.monotonic() < deadline:
+            if bound_count() >= PODS \
+                    and len(s_b.owned_shards()) == SHARDS:
+                break
+            time.sleep(0.2)
+        fault_stats = faultline.stats()  # BEFORE deactivate (else empty)
+        faultline.deactivate()
+        pods, _ = cs.pods.list(namespace="default")
+        bound = [p for p in pods if p.spec.node_name]
+        doubles = find_double_allocations(pods)
+        verdict.update({
+            "acked": len(bound),
+            "recovery_s": round(time.monotonic() - kill_t, 2),
+            "survivor_shards": sorted(s_b.owned_shards()),
+            "double_allocations": len(doubles),
+            "bind_conflicts": master.registry.device_claim_conflicts,
+            "faults": fault_stats,
+            "ok": (len(bound) >= PODS
+                   and len(s_b.owned_shards()) == SHARDS
+                   and not doubles),
+        })
+    finally:
+        faultline.deactivate()
+        for comp in (s_b, s_a):
+            if comp is not None:
+                _stop_quietly_mod(comp.stop)
+        if cs is not None:
+            _stop_quietly_mod(cs.close)
+        if master is not None:
+            _stop_quietly_mod(master.stop)
+    return verdict
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="ktpu seeded chaos runner")
     ap.add_argument("--seeds", default="1,7,42,1729,9000",
@@ -761,10 +884,12 @@ def main() -> int:
     ap.add_argument("--no-kill", action="store_true",
                     help="skip the mid-run primary-store kill (wire schedule)")
     ap.add_argument("--schedule", default="wire",
-                    choices=("wire",) + NODE_MODES + ("node-all", "all"),
+                    choices=("wire",) + NODE_MODES
+                    + ("sched-shard", "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
-                         "node-all (all three node modes), or all")
+                         "sched-shard (mid-run scheduler kill + lease "
+                         "steal), node-all (all three node modes), or all")
     ap.add_argument("--recovery-bound", type=float, default=60.0,
                     help="node schedules: seconds from failure injection to "
                          "gang re-running")
@@ -775,7 +900,7 @@ def main() -> int:
     elif args.schedule == "node-all":
         schedules = list(NODE_MODES)
     elif args.schedule == "all":
-        schedules = ["wire"] + list(NODE_MODES)
+        schedules = ["wire"] + list(NODE_MODES) + ["sched-shard"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -788,6 +913,10 @@ def main() -> int:
                                        else args.spec),
                                  writers=args.writers)
                 v["mode"] = "wire"
+            elif schedule == "sched-shard":
+                v = run_sched_shard_schedule(
+                    seed, duration=args.duration, spec=args.spec,
+                    recovery_bound=args.recovery_bound)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

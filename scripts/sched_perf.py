@@ -29,14 +29,29 @@ from tests.helpers import make_node, make_tpu_pod  # noqa: E402
 
 
 def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
-                   creators: int = 4, multiproc: bool = False) -> dict:
+                   creators: int = 4, multiproc: bool = False,
+                   sched_shards: int = 1, wire_codec: str = "json",
+                   store_proc: bool = False) -> dict:
     """multiproc=True runs apiserver and scheduler as separate OS processes
     (the deployment shape) so they get real parallelism; in-process mode
     shares one GIL across every component, which caps the measurable
-    throughput well below what the scheduler core does."""
+    throughput well below what the scheduler core does.
+
+    sched_shards=N runs N scheduler instances over an N-way pod
+    partition: separate processes with shard leases in multiproc mode
+    (the deployment shape — lease steal included), static shard ownership
+    in-process.  wire_codec != "json" (multiproc only) runs the store as
+    its OWN process and dials it with the negotiated binary framing, so
+    the store<->apiserver wire is real and the codec axis measurable."""
     pods = pods or nodes * 30
     if pods > nodes * tpus_per_node:
         raise ValueError("pods exceed cluster chip capacity")
+    if (wire_codec != "json" or store_proc) and not multiproc:
+        # in-process mode has no store wire at all — silently recording a
+        # codec that never ran would misattribute the round's numbers
+        raise ValueError(
+            "--wire-codec/--store-proc require --multiproc (the in-process "
+            "store has no wire; the codec axis would be a lie in the JSON)")
     # contention stamp BEFORE the run: the bench itself saturates the box
     # by design, so an end-of-run loadavg would flag every run as dirty.
     # Numbers from an already-loaded box are noise (22x p99 swing observed
@@ -45,6 +60,7 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
 
     import socket
     import subprocess
+    import tempfile
 
     def free_port():
         with socket.socket() as s:
@@ -52,18 +68,36 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             return s.getsockname()[1]
 
     procs = []
-    sched = None
-    metrics_url = None
+    scheds = []
+    metrics_urls = []
+    sched_shards = max(1, int(sched_shards))
     if multiproc:
         port = free_port()
-        mport = free_port()
         url = f"http://127.0.0.1:{port}"
-        metrics_url = f"http://127.0.0.1:{mport}"
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        api_args = [sys.executable, "-m", "kubernetes1_tpu.apiserver",
+                    "--port", str(port)]
+        if wire_codec != "json" or store_proc:
+            # a real store<->apiserver wire: store in its own process,
+            # negotiated binary framing on the link (store_proc=True with
+            # codec json isolates the CODEC axis: same topology, legacy
+            # framing)
+            store_sock = os.path.join(
+                tempfile.mkdtemp(prefix="ktpu-sched-perf-"), "store.sock")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "kubernetes1_tpu.storage",
+                 "--socket", store_sock],
+                cwd=repo, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+            deadline = time.time() + 15
+            while time.time() < deadline and not os.path.exists(store_sock):
+                time.sleep(0.05)
+            api_args += ["--store-address", store_sock,
+                         "--wire-codec", wire_codec]
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "kubernetes1_tpu.apiserver", "--port", str(port)],
-            cwd=repo, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            api_args, cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
         deadline = time.time() + 15
         cs = Clientset(url)
         while time.time() < deadline:
@@ -72,18 +106,33 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                 break
             except Exception:  # noqa: BLE001
                 time.sleep(0.1)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "kubernetes1_tpu.scheduler", "--server", url,
-             "--metrics-port", str(mport)],
-            cwd=repo, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for k in range(sched_shards):
+            mport = free_port()
+            metrics_urls.append(f"http://127.0.0.1:{mport}")
+            sched_args = [sys.executable, "-m", "kubernetes1_tpu.scheduler",
+                          "--server", url, "--metrics-port", str(mport),
+                          "--identity", f"sched-{k}"]
+            if sched_shards > 1:
+                sched_args += ["--shards", str(sched_shards)]
+            procs.append(subprocess.Popen(
+                sched_args, cwd=repo, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
     else:
         master = Master().start()
         url = master.url
         cs = Clientset(url)
+        if sched_shards > 1:
+            # in-process sharding: static ownership (one instance per
+            # shard, all on one GIL — conflict/partition correctness, not
+            # a parallelism win)
+            for k in range(sched_shards):
+                scheds.append(Scheduler(
+                    Clientset(url), shards=sched_shards, owned_shards={k},
+                    identity=f"sched-{k}"))
     try:
         return _drive(nodes, pods, tpus_per_node, creators, multiproc,
-                      url, cs, master if not multiproc else None, sched,
-                      metrics_url, stamp)
+                      url, cs, master if not multiproc else None, scheds,
+                      metrics_urls, stamp, sched_shards, wire_codec)
     finally:
         # child processes must never outlive the run (a leaked apiserver/
         # scheduler would skew every later bench phase)
@@ -116,8 +165,26 @@ def scrape_metrics(metrics_url: str) -> dict:
     return out
 
 
+def merge_metrics(dicts):
+    """Merge N schedulers' scraped /metrics: counters sum, everything
+    else (gauges, quantiles) takes the max — the conservative read for
+    latency percentiles across parallel instances."""
+    out = {}
+    for mx in dicts:
+        for k, v in mx.items():
+            if k not in out:
+                out[k] = v
+            elif k.rpartition("{")[0].endswith(("_total", "_count", "_sum")) \
+                    or k.endswith(("_total", "_count", "_sum")):
+                out[k] += v
+            else:
+                out[k] = max(out[k], v)
+    return out
+
+
 def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
-           sched, metrics_url=None, stamp=None) -> dict:
+           scheds, metrics_urls=None, stamp=None, sched_shards=1,
+           wire_codec="json") -> dict:
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
         node = make_node(f"perf-{i}", cpu="64", memory="256Gi",
@@ -125,9 +192,10 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
                          host_index=i % 8)
         cs.nodes.create(node)
 
-    if not multiproc:
-        sched = Scheduler(cs)
-        sched.start()
+    if not multiproc and not scheds:
+        scheds = [Scheduler(cs)]
+    for s in scheds:
+        s.start()
 
     bound = {}
     created = {}
@@ -218,12 +286,27 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         create_end = t0 + create_wall
         backlog_at_create_end = len(created) - sum(
             1 for ts in bound_snap.values() if ts <= create_end)
+        # N-shard generalization of the single-FIFO drain model: with the
+        # pod set hash-partitioned across `shards` parallel bind
+        # pipelines, drain_time = backlog / (shards x per-shard rate).
+        # The measured throughput is already the AGGREGATE (shards x
+        # per-shard), so the arithmetic reduces to backlog/throughput —
+        # recording shards, per-shard rate, and the codec id is what
+        # keeps the model-vs-measured check attributable when a BENCH
+        # round changes either axis.
+        per_shard_rate = throughput / max(1, sched_shards)
         burst_model = {
-            "model": "FIFO queue drain at measured bind rate",
+            "model": ("N-shard queue drain at measured per-shard bind rate"
+                      if sched_shards > 1
+                      else "FIFO queue drain at measured bind rate"),
+            "shards": sched_shards,
+            "codec": wire_codec,
             "bind_rate_pods_per_sec": round(throughput, 1),
+            "per_shard_bind_rate_pods_per_sec": round(per_shard_rate, 1),
             "queue_depth_at_create_end": backlog_at_create_end,
             "drain_time_for_backlog_s": round(
-                backlog_at_create_end / throughput, 1),
+                backlog_at_create_end
+                / (max(1, sched_shards) * per_shard_rate), 1),
             "expected_queue_wait_p99_s": round(expected_p99, 1),
             "measured_p99_s": measured_p99,
             # within 2x of the constant-rate drain model = the tail is
@@ -254,7 +337,8 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             url, rate=min(80.0, max(5.0, throughput * 0.4)), duration=20.0,
             max_pods=free_chips)
 
-    mx = scrape_metrics(metrics_url) if metrics_url else {}
+    mx = merge_metrics([scrape_metrics(u) for u in metrics_urls]) \
+        if metrics_urls else {}
 
     def from_metrics(name):
         v = mx.get(name)
@@ -294,11 +378,27 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             'ktpu_store_wal_fsync_seconds{quantile="0.99"}'),
         "write_coalesce_waits": amx.get("ktpu_write_coalesce_waits_total"),
     } if (amx or mx) else None
-    if write_path is not None and sched is not None:
-        # in-process runs read the scheduler's histogram directly
-        write_path["bind_batch_p50"] = sched.bind_batch_size.quantile(0.5)
-        write_path["bind_batch_p99"] = sched.bind_batch_size.quantile(0.99)
-        write_path["bind_batches"] = sched.bind_batch_size.count
+    def q(attr, quantile):
+        """Max across in-process scheduler instances' own histograms
+        (counters sum elsewhere; the max is the conservative percentile
+        merge, same rule merge_metrics applies to scraped quantiles)."""
+        vals = [getattr(s, attr).quantile(quantile) for s in scheds]
+        vals = [round(v, 4) for v in vals if v is not None]
+        return max(vals) if vals else None
+
+    if write_path is not None and scheds:
+        # in-process runs read the schedulers' histograms directly
+        write_path["bind_batch_p50"] = q("bind_batch_size", 0.5)
+        write_path["bind_batch_p99"] = q("bind_batch_size", 0.99)
+        write_path["bind_batches"] = sum(
+            s.bind_batch_size.count for s in scheds)
+
+    # optimistic-concurrency surface: cross-shard chip races lost at bind
+    # (apiserver-side authoritative count + scheduler-side requeues)
+    bind_conflicts = (
+        amx.get("ktpu_bind_device_conflicts_total") if amx
+        else sum(int(s._bind_conflicts_ctr.value) for s in scheds)
+        if scheds else None)
 
     result = {
         "nodes": nodes,
@@ -313,28 +413,30 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "bind_latency_p99_s": pct(0.99),
         "burst_tail": burst_model,
         "multiproc": multiproc,
+        "sched_shards": sched_shards,
+        "wire_codec": wire_codec,
+        "bind_device_conflicts": bind_conflicts,
         "read_path": read_path,
         "write_path": write_path,
         "steady_state": steady,
-        # per-attempt algorithm latency from the scheduler's own histogram —
-        # in-process via the object, multiproc via the /metrics endpoint
+        # per-attempt algorithm latency from the schedulers' own
+        # histograms — in-process via the objects, multiproc via the
+        # merged /metrics endpoints (counters sum, quantiles max)
         "schedule_attempts": (
-            sched.schedule_attempts if sched
+            sum(s.schedule_attempts for s in scheds) if scheds
             else from_metrics("scheduler_schedule_attempts_total")),
         "schedule_failures": (
-            sched.schedule_failures if sched
+            sum(s.schedule_failures for s in scheds) if scheds
             else from_metrics("scheduler_schedule_failures_total")),
         "algorithm_latency_p50_s": (
-            round(sched.algorithm_latency.quantile(0.5), 4)
-            if sched and sched.algorithm_latency.quantile(0.5) is not None
+            q("algorithm_latency", 0.5) if scheds
             else from_metrics('scheduler_scheduling_algorithm_seconds{quantile="0.5"}')),
         "algorithm_latency_p99_s": (
-            round(sched.algorithm_latency.quantile(0.99), 4)
-            if sched and sched.algorithm_latency.quantile(0.99) is not None
+            q("algorithm_latency", 0.99) if scheds
             else from_metrics('scheduler_scheduling_algorithm_seconds{quantile="0.99"}')),
     }
-    if sched:
-        sched.stop()
+    for s in scheds:
+        s.stop()
     cs.close()
     if master:
         master.stop()
@@ -409,9 +511,23 @@ def main():
     ap.add_argument("--creators", type=int, default=4)
     ap.add_argument("--multiproc", action="store_true",
                     help="apiserver+scheduler as separate processes")
+    ap.add_argument("--sched-shards", type=int, default=1,
+                    help="N scheduler instances over an N-way pod "
+                         "partition (processes with shard leases in "
+                         "--multiproc, static in-process otherwise)")
+    ap.add_argument("--wire-codec", default="json",
+                    help="store-wire codec (json | pybin1); non-json "
+                         "runs the store as its own process (multiproc)")
+    ap.add_argument("--store-proc", action="store_true",
+                    help="run the store as its own process even with the "
+                         "json codec (isolates the codec axis: same "
+                         "topology, legacy newline-JSON framing)")
     args = ap.parse_args()
     print(json.dumps(run_sched_perf(args.nodes, args.pods, args.tpus_per_node,
-                                    args.creators, args.multiproc)))
+                                    args.creators, args.multiproc,
+                                    sched_shards=args.sched_shards,
+                                    wire_codec=args.wire_codec,
+                                    store_proc=args.store_proc)))
 
 
 if __name__ == "__main__":

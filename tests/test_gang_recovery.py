@@ -56,7 +56,7 @@ def gang_pods(cs, job_name, live=True):
     return pods
 
 
-def wait_gang_running(cs, job_name, n=2, timeout=30.0):
+def wait_gang_running(cs, job_name, n=2, timeout=60.0):
     def ok():
         pods = gang_pods(cs, job_name)
         return (len(pods) == n
@@ -120,7 +120,7 @@ class TestGangFailurePolicy:
                     and all(p.metadata.uid != uids0[p.metadata.name]
                             for p in cur))
 
-        must_poll_until(recreated, timeout=30.0,
+        must_poll_until(recreated, timeout=60.0,
                         desc="whole gang recreated as attempt 1")
         job = cs.jobs.get("g1")
         assert (job.metadata.annotations or {}).get(t.GANG_ATTEMPT_LABEL) == "1"
@@ -149,8 +149,8 @@ class TestGangFailurePolicy:
                        and c.reason == "GangBackoffLimitExceeded"
                        for c in j.status.conditions)
 
-        must_poll_until(failed, timeout=20.0, desc="gang job marked Failed")
-        must_poll_until(lambda: gang_pods(cs, "g2") == [], timeout=15.0,
+        must_poll_until(failed, timeout=45.0, desc="gang job marked Failed")
+        must_poll_until(lambda: gang_pods(cs, "g2") == [], timeout=45.0,
                         desc="surviving members torn down")
         cs.jobs.delete("g2")
 
@@ -180,7 +180,7 @@ class TestGangFailurePolicy:
                             for p in cur
                             for per in p.spec.extended_resources))
 
-        must_poll_until(recovered, timeout=40.0,
+        must_poll_until(recovered, timeout=60.0,
                         desc="gang re-placed off the dead chip")
         # the kubelet surfaced the reason, not a generic failure
         evs, _ = cs.events.list(namespace="default")

@@ -1,0 +1,411 @@
+"""Sharded optimistic-concurrency scheduling: partition integrity, shard
+leases (claim/steal/shed), the cross-shard device-claim guard, conflict
+re-queue, and revision order under concurrent shard binds."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, LeaseSet
+from kubernetes1_tpu.machinery import Conflict
+from kubernetes1_tpu.scheduler import Scheduler
+from kubernetes1_tpu.scheduler.cache import ExtendedResourceInfo
+from kubernetes1_tpu.scheduler.devices import find_double_allocations
+from kubernetes1_tpu.scheduler.sharding import pod_shard, shard_of
+
+from .helpers import make_node, make_tpu_pod
+
+
+# ------------------------------------------------------------ partitioning
+
+
+class TestShardOf:
+    def test_deterministic_and_in_range(self):
+        for shards in (1, 2, 4, 7):
+            for i in range(50):
+                s = shard_of("ns", f"pod-{i}", shards)
+                assert 0 <= s < shards
+                assert s == shard_of("ns", f"pod-{i}", shards)
+
+    def test_shards_one_is_always_zero(self):
+        assert shard_of("any", "thing", 1) == 0
+        assert shard_of("any", "thing", 0) == 0
+
+    def test_distribution_covers_every_shard(self):
+        shards = 4
+        seen = {shard_of("ns", f"p-{i}", shards) for i in range(1000)}
+        assert seen == set(range(shards))
+
+    def test_gang_members_never_split(self):
+        """The partition key is the GANG id, not the member name: every
+        member of a gang lands on one shard regardless of its own name."""
+        for shards in (2, 4, 8):
+            for g in range(20):
+                members = [make_tpu_pod(f"m-{g}-{i}", gang=f"gang-{g}",
+                                        gang_size=8) for i in range(8)]
+                got = {pod_shard(p, shards) for p in members}
+                assert len(got) == 1, f"gang-{g} split across {got}"
+
+    def test_namespace_is_part_of_the_key(self):
+        vals = {shard_of(f"ns-{i}", "same-name", 16) for i in range(64)}
+        assert len(vals) > 1
+
+
+class TestDeviceRefcount:
+    def test_overlapping_holders_keep_chip_unavailable(self):
+        """Two holders of one chip (this shard's in-flight assumed loser
+        + the peer's confirmed winner) must keep the chip unavailable
+        until BOTH release — the set semantics freed it at the first
+        release and livelocked the conflict retry loop."""
+        info = ExtendedResourceInfo()
+        info.set_devices([t.ExtendedResourceDevice(id="c0"),
+                          t.ExtendedResourceDevice(id="c1")])
+        assert info.available_count() == 2
+        info.use(["c0"])   # assumed by this instance's pod
+        info.use(["c0"])   # peer's winner arrives off the watch
+        assert info.available_count() == 1
+        info.release(["c0"])  # loser's forget
+        assert info.available_count() == 1, \
+            "chip freed while the winner still holds it"
+        info.release(["c0"])  # winner's pod eventually removed
+        assert info.available_count() == 2
+
+
+# ------------------------------------------------------------ shard leases
+
+
+@pytest.mark.slow
+class TestLeaseSet:
+    def test_split_steal_and_single_owner(self):
+        master = Master().start()
+        try:
+            SH = 4
+            a = LeaseSet(Clientset(master.url), "ls-test", "inst-a", SH,
+                         lease_duration=1.5, retry_period=0.2).start()
+            assert a.wait_for_any(10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(a.owned()) < SH:
+                time.sleep(0.1)
+            assert a.owned() == frozenset(range(SH))  # single owner: all
+
+            b = LeaseSet(Clientset(master.url), "ls-test", "inst-b", SH,
+                         lease_duration=1.5, retry_period=0.2).start()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                oa, ob = a.owned(), b.owned()
+                if oa and ob and not (oa & ob) \
+                        and (oa | ob) == set(range(SH)):
+                    break
+                time.sleep(0.1)
+            assert a.owned() and b.owned(), "join never rebalanced"
+            assert not (a.owned() & b.owned())
+            assert (a.owned() | b.owned()) == set(range(SH))
+
+            # CRASH a (no release): b must steal at lease expiry
+            a._stop.set()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and len(b.owned()) < SH:
+                time.sleep(0.1)
+            assert b.owned() == frozenset(range(SH)), "steal failed"
+            b.stop()
+        finally:
+            master.stop()
+
+
+# ------------------------------------------------- device-claim conflicts
+
+
+def _binding(pod_name, node, ids):
+    b = t.Binding(target_node=node,
+                  extended_resource_assignments={f"{pod_name}-tpu": ids})
+    b.metadata.name = pod_name
+    b.metadata.namespace = "default"
+    return b
+
+
+class TestDeviceClaimGuard:
+    def test_loser_gets_marked_conflict_and_winner_sticks(self):
+        master = Master().start()
+        try:
+            cs = Clientset(master.url)
+            for n in ("w", "l"):
+                cs.pods.create(make_tpu_pod(n, tpus=1))
+            cs.bind("default", "w", _binding("w", "node-1", ["chip-0"]))
+            with pytest.raises(Conflict) as ei:
+                cs.bind("default", "l", _binding("l", "node-1", ["chip-0"]))
+            assert t.DEVICE_CLAIM_CONFLICT in str(ei.value)
+            assert master.registry.device_claim_conflicts == 1
+            # loser re-binds on a free chip
+            cs.bind("default", "l", _binding("l", "node-1", ["chip-1"]))
+            cs.close()
+        finally:
+            master.stop()
+
+    def test_claim_frees_after_holder_hard_delete(self):
+        master = Master().start()
+        try:
+            cs = Clientset(master.url)
+            cs.pods.create(make_tpu_pod("a", tpus=1))
+            cs.bind("default", "a", _binding("a", "node-1", ["chip-0"]))
+            cs.pods.delete("a", "default", grace_seconds=0)
+            cs.pods.create(make_tpu_pod("b", tpus=1))
+            # stale claim validated against the store and purged
+            cs.bind("default", "b", _binding("b", "node-1", ["chip-0"]))
+            cs.close()
+        finally:
+            master.stop()
+
+    def test_batch_race_loses_exactly_one(self):
+        master = Master().start()
+        try:
+            cs = Clientset(master.url)
+            cs.pods.create(make_tpu_pod("d", tpus=1))
+            cs.pods.create(make_tpu_pod("e", tpus=1))
+            outs = cs.bind_batch("default", [
+                _binding("d", "node-2", ["chip-9"]),
+                _binding("e", "node-2", ["chip-9"])])
+            assert outs[0] is None
+            assert outs[1] is not None
+            assert t.DEVICE_CLAIM_CONFLICT in str(outs[1])
+            cs.close()
+        finally:
+            master.stop()
+
+    def test_batch_store_failure_releases_claims(self):
+        """A mid-batch store failure must not leave the batch's chips
+        claimed for the pending grace window: unconfirmed claims release
+        on the exception path and the chips are immediately claimable."""
+        master = Master().start()
+        try:
+            cs = Clientset(master.url)
+            cs.pods.create(make_tpu_pod("x", tpus=1))
+            orig = master.registry.store.commit_batch
+
+            def boom(ops):
+                raise ConnectionError("store died mid-batch")
+
+            master.registry.store.commit_batch = boom
+            with pytest.raises(ConnectionError):
+                master.registry.bind_batch(
+                    "default", [_binding("x", "n1", ["c0"])])
+            master.registry.store.commit_batch = orig
+            assert not master.registry._device_claims
+            cs.pods.create(make_tpu_pod("y", tpus=1))
+            assert cs.bind_batch(
+                "default", [_binding("y", "n1", ["c0"])]) == [None]
+            cs.close()
+        finally:
+            master.stop()
+
+    def test_scheduler_requeues_on_claim_conflict(self):
+        """The DEVICE_CLAIM_CONFLICT marker flips Conflict from terminal
+        (pod already bound) to retryable (chip race lost): the pod goes
+        back to the queue with backoff."""
+        master = Master().start()
+        try:
+            sched = Scheduler(Clientset(master.url))
+            pod = make_tpu_pod("loser", tpus=1)
+            from kubernetes1_tpu.scheduler.scheduler import _BindItem
+
+            item = _BindItem(pod, pod.clone(), None, None, None, "")
+            sched._bind_failed(item, Conflict(
+                f"{t.DEVICE_CLAIM_CONFLICT}: google.com/tpu chip c on "
+                f"node n is held by pod x"))
+            assert int(sched._bind_conflicts_ctr.value) == 1
+            assert sched.queue.depth() == 1  # backing off, not dropped
+            # plain Conflict stays terminal: no requeue
+            item2 = _BindItem(pod, pod.clone(), None, None, None, "")
+            sched._bind_failed(item2, Conflict("pod already bound to n2"))
+            assert sched.queue.depth() == 1
+        finally:
+            master.stop()
+
+
+# ------------------------------------------------------- two-shard racing
+
+
+class TestTwoShardRace:
+    def test_conflict_retry_e2e_zero_double_allocations(self):
+        """Both shards race the same small chip pool: losers re-queue and
+        land elsewhere; nothing double-allocates; everything binds."""
+        master = Master().start()
+        scheds = []
+        try:
+            cs = Clientset(master.url)
+            for i in range(4):
+                cs.nodes.create(make_node(
+                    f"rn{i}", cpu="64", memory="256Gi", tpus=8,
+                    slice_id=f"rs{i}", host_index=0))
+            for k in range(2):
+                s = Scheduler(Clientset(master.url), shards=2,
+                              owned_shards={k}, identity=f"race-{k}")
+                s.start()
+                scheds.append(s)
+            N = 24
+            for i in range(N):
+                cs.pods.create(make_tpu_pod(f"rp-{i}", tpus=1))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                pods, _ = cs.pods.list(namespace="default")
+                if sum(1 for p in pods if p.spec.node_name) >= N:
+                    break
+                time.sleep(0.2)
+            pods, _ = cs.pods.list(namespace="default")
+            bound = [p for p in pods if p.spec.node_name]
+            assert len(bound) == N, \
+                f"only {len(bound)}/{N} bound; conflicts=" \
+                f"{master.registry.device_claim_conflicts}"
+            assert not find_double_allocations(pods)
+            # BOTH instances actually scheduled their partition
+            assert all(s.schedule_attempts > 0 for s in scheds)
+            cs.close()
+        finally:
+            for s in scheds:
+                s.stop()
+            master.stop()
+
+    def test_revision_order_strict_under_concurrent_shard_binds(self):
+        """Two clients bind disjoint pod sets concurrently through the
+        bulk path: every watch consumer must still observe the pod
+        collection's commits in strictly increasing revision order."""
+        master = Master().start()
+        try:
+            cs = Clientset(master.url)
+            N = 16
+            for i in range(N):
+                cs.pods.create(make_tpu_pod(f"op-{i}", tpus=1))
+            start_rev = master.store.current_revision()
+            w = master.store.watch("/registry/pods/", start_rev)
+
+            def bind_half(k):
+                ccs = Clientset(master.url)
+                outs = ccs.bind_batch("default", [
+                    _binding(f"op-{i}", f"on-{k}", [f"oc-{k}-{i}"])
+                    for i in range(k, N, 2)])
+                assert all(o is None for o in outs), outs
+                ccs.close()
+
+            threads = [threading.Thread(target=bind_half, args=(k,))
+                       for k in range(2)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            revs = []
+            deadline = time.monotonic() + 10
+            while len(revs) < N and time.monotonic() < deadline:
+                evs = w.next_batch_timeout(1.0)
+                for ev in evs or []:
+                    revs.append(int(
+                        ev.object["metadata"]["resourceVersion"]))
+            w.stop()
+            assert len(revs) == N
+            assert revs == sorted(revs) and len(set(revs)) == N, \
+                f"revision order violated: {revs}"
+            cs.close()
+        finally:
+            master.stop()
+
+
+class TestBulkFallbackThroughPool:
+    def test_envelope_failure_drains_via_workers(self):
+        """A dead bulk endpoint must not serialize the batch in one
+        worker: items re-enter the bind queue marked single and the pool
+        drains them as singleton binds."""
+        master = Master().start()
+        sched = None
+        try:
+            cs = Clientset(master.url)
+            for i in range(2):
+                cs.nodes.create(make_node(
+                    f"fn{i}", cpu="64", memory="256Gi", tpus=8,
+                    slice_id=f"fs{i}", host_index=0))
+            scs = Clientset(master.url)
+
+            def broken_bind_batch(namespace, bindings):
+                raise RuntimeError("bulk endpoint disabled")
+
+            scs.bind_batch = broken_bind_batch
+            sched = Scheduler(scs)
+            sched.start()
+            N = 12
+            for i in range(N):
+                cs.pods.create(make_tpu_pod(f"fp-{i}", tpus=1))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                pods, _ = cs.pods.list(namespace="default")
+                if sum(1 for p in pods if p.spec.node_name) >= N:
+                    break
+                time.sleep(0.2)
+            pods, _ = cs.pods.list(namespace="default")
+            assert sum(1 for p in pods if p.spec.node_name) == N
+            assert not find_double_allocations(pods)
+            # the fallback path actually engaged (or every drain was a
+            # batch of one — force at least one real batch by checking
+            # the counter only when batches formed)
+            if sched.bind_batch_size.count and \
+                    (sched.bind_batch_size.quantile(0.99) or 1) > 1:
+                assert int(sched._bulk_fallbacks_ctr.value) > 0
+            cs.close()
+        finally:
+            if sched is not None:
+                sched.stop()
+            master.stop()
+
+
+# ------------------------------------------------------------ sharded e2e
+
+
+@pytest.mark.slow
+class TestLeasedShardE2E:
+    def test_kill_one_instance_survivor_steals_and_drains(self):
+        """tests-tier twin of scripts/chaos.py run_sched_shard_schedule
+        (without wire faults): split ownership, crash one instance
+        without releasing, survivor steals every shard and binds the
+        orphaned backlog; zero double allocations."""
+        master = Master().start()
+        s_a = s_b = None
+        try:
+            cs = Clientset(master.url)
+            for i in range(4):
+                cs.nodes.create(make_node(
+                    f"ln{i}", cpu="64", memory="256Gi", tpus=8,
+                    slice_id=f"ls{i}", host_index=0))
+            kw = dict(shards=4, shard_lease=True,
+                      shard_lease_duration=1.5, shard_retry_period=0.2)
+            s_a = Scheduler(Clientset(master.url), identity="lz-a", **kw)
+            s_b = Scheduler(Clientset(master.url), identity="lz-b", **kw)
+            s_a.start()
+            s_b.start()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not (
+                    s_a.owned_shards() and s_b.owned_shards()):
+                time.sleep(0.1)
+            assert s_a.owned_shards() and s_b.owned_shards()
+            N = 24
+            for i in range(N):
+                cs.pods.create(make_tpu_pod(f"lp-{i}", tpus=1))
+            # crash a: stop its lease loop WITHOUT releasing
+            s_a._lease_set._stop.set()
+            s_a._lease_set._owned = frozenset()
+            s_a.stop()
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                pods, _ = cs.pods.list(namespace="default")
+                if sum(1 for p in pods if p.spec.node_name) >= N \
+                        and len(s_b.owned_shards()) == 4:
+                    break
+                time.sleep(0.2)
+            pods, _ = cs.pods.list(namespace="default")
+            assert sum(1 for p in pods if p.spec.node_name) == N
+            assert s_b.owned_shards() == frozenset(range(4))
+            assert not find_double_allocations(pods)
+            cs.close()
+        finally:
+            for s in (s_b, s_a):
+                if s is not None:
+                    s.stop()
+            master.stop()
