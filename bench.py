@@ -46,6 +46,15 @@ LLAMA_STEPS = int(os.environ.get("BENCH_LLAMA_STEPS", "10"))
 # records both so rounds are attributable to the knobs that moved.
 SCHED_SHARDS = int(os.environ.get("BENCH_SCHED_SHARDS", "1"))
 WIRE_CODEC = os.environ.get("BENCH_WIRE_CODEC", "json")
+# Sharded-store axes (BENCH_r07+): N store shard processes (per-shard
+# WAL/commit queue, stride revisions — storage/shardmap.py), M stateless
+# apiservers over the shard set, and the bindings:batch body codec on
+# the scheduler's hot bind leg.  The sched_perf result's store_shards
+# block records per-shard occupancy / WAL fsync p99 for the round.
+STORE_SHARDS = int(os.environ.get("BENCH_STORE_SHARDS", "1"))
+APISERVERS = int(os.environ.get("BENCH_APISERVERS", "1"))
+BIND_CODEC = os.environ.get("BENCH_BIND_CODEC", "json")
+STORE_WAL = os.environ.get("BENCH_STORE_WAL", "") == "1"
 
 
 def _pct(xs, q):
@@ -586,14 +595,18 @@ def main():
         try:
             extras["sched_perf_100"] = _sched_perf_with_retry(
                 100, 3000, multiproc=True,
-                sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC)
+                sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC,
+                store_shards=STORE_SHARDS, apiservers=APISERVERS,
+                bind_codec=BIND_CODEC, store_wal=STORE_WAL)
         except Exception as e:  # noqa: BLE001
             extras["sched_perf_100"] = {"error": f"{type(e).__name__}: {e}"}
         if os.environ.get("BENCH_SKIP_SCHED1K", "") != "1":
             try:
                 extras["sched_perf_1000"] = _sched_perf_with_retry(
                     1000, 30000, creators=6, multiproc=True,
-                    sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC
+                    sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC,
+                    store_shards=STORE_SHARDS, apiservers=APISERVERS,
+                    bind_codec=BIND_CODEC, store_wal=STORE_WAL,
                 )
             except Exception as e:  # noqa: BLE001
                 extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}

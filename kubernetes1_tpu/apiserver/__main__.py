@@ -47,7 +47,16 @@ def main():
                     help="CA bundle for x509 client-cert authn")
     ap.add_argument("--store-address", default="",
                     help="external store (unix path or host:port); makes "
-                         "this apiserver stateless — run several")
+                         "this apiserver stateless — run several.  "
+                         "';'-separated groups = one store SHARD each "
+                         "(each group its own comma-separated "
+                         "primary,standby failover list)")
+    ap.add_argument("--store-shards", type=int, default=1,
+                    help="in-process store shard count (>1 partitions "
+                         "/registry/ by key hash with per-shard WAL/"
+                         "commit queue/watch ring; storage/shardmap.py). "
+                         "With --store-address, shard count comes from "
+                         "the ';' list instead")
     ap.add_argument("--store-ca-file", default="",
                     help="CA to verify the store's TLS cert")
     ap.add_argument("--wire-codec", default="json",
@@ -73,6 +82,10 @@ def main():
         ap.error("--wal and --store-address are mutually exclusive: with an "
                  "external store, durability belongs to the STORE process's "
                  "--wal — a local WAL here would silently never be written")
+    if args.store_address and args.store_shards > 1:
+        ap.error("--store-shards applies to the IN-PROCESS store only; "
+                 "with --store-address the shard count is the number of "
+                 "';'-separated address groups")
     if args.feature_gates:
         from ..utils.features import gates
         gates.apply(args.feature_gates)
@@ -106,6 +119,7 @@ def main():
         tls_key_file=args.tls_key_file,
         client_ca_file=args.client_ca_file,
         store_address=args.store_address,
+        store_shards=args.store_shards,
         store_ca_file=args.store_ca_file,
         store_codec=args.wire_codec,
         wal_sync=args.wal_sync,

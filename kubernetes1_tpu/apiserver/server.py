@@ -38,7 +38,17 @@ from ..machinery import (
 )
 from ..machinery.errors import TooManyRequests
 from ..machinery.scheme import Scheme, global_scheme
-from ..storage import CacheNotReady, Cacher, DEFAULT_WATCH_QUEUE_LIMIT, Store
+from ..storage import (
+    CacheNotReady,
+    Cacher,
+    DEFAULT_WATCH_QUEUE_LIMIT,
+    ShardedCacher,
+    ShardedStore,
+    Store,
+    build_sharded_store,
+    parse_rv,
+    parse_shard_addresses,
+)
 from .admission import (
     CREATE,
     UPDATE,
@@ -346,8 +356,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._body_consumed = True
         if length == 0:
             raise BadRequest("request body required")
+        raw = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype.startswith("application/x-ktpu-"):
+            # codec-framed body (the bulk-bind hot path ships pybin1):
+            # decoded through the same registry as the store wire — the
+            # restricted unpickler refuses any pickle referencing a
+            # global, so this accepts only plain data, exactly like JSON
+            from ..machinery.codec import CodecError, get_codec, known_codecs
+
+            codec_id = ctype[len("application/x-ktpu-"):]
+            if codec_id not in known_codecs():
+                raise BadRequest(f"unsupported content type {ctype!r}")
+            try:
+                body = get_codec(codec_id).decode(raw)
+            except CodecError as e:
+                raise BadRequest(f"invalid {codec_id} body: {e}") from e
+            if not isinstance(body, dict):
+                raise BadRequest(f"{codec_id} body must decode to an object")
+            return body
         try:
-            return json.loads(self.rfile.read(length))
+            return json.loads(raw)
         except json.JSONDecodeError as e:
             raise BadRequest(f"invalid JSON body: {e}") from e
 
@@ -909,7 +938,17 @@ class _Handler(BaseHTTPRequestHandler):
             upstream.close()
 
     def _serve_watch(self, resource, ns, q):
-        since = int(q.get("resourceVersion") or 0)
+        try:
+            # composite "r0.r1..." resourceVersions (sharded store:
+            # per-shard resume positions) parse to a tuple; plain ints
+            # stay ints — storage/shardmap.parse_rv
+            since = parse_rv(q.get("resourceVersion"))
+        except ValueError as e:
+            raise BadRequest(f"invalid resourceVersion: {e}") from None
+        if isinstance(since, tuple) and self.master.store_shards == 1:
+            raise BadRequest(
+                "composite resourceVersion presented to an unsharded "
+                "apiserver; relist")
         timeout = float(q.get("timeoutSeconds") or 0)
         try:
             w = self.master.registry.watch(
@@ -947,6 +986,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
         deadline = time.monotonic() + timeout if timeout else None
         ver = getattr(self, "_req_version", "")
+        # merged multi-shard streams interleave shards (cross-shard order
+        # is per-shard only), so a single per-object rv cannot encode the
+        # stream's position — BOOKMARK frames carrying the composite
+        # resume position do (the Kubernetes watch-bookmark analog).
+        # Plain streams never emit them: byte-identical wire at shards=1.
+        bookmarks = getattr(w, "emit_bookmarks", False)
+
+        def bookmark_frame() -> bytes:
+            return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
+                    b'"apiVersion":"v1","metadata":{"resourceVersion":"'
+                    + w.bookmark_rv().encode() + b'"}}}\n')
+
         try:
             while True:
                 if deadline and time.monotonic() >= deadline:
@@ -973,8 +1024,12 @@ class _Handler(BaseHTTPRequestHandler):
                         # — heartbeating a dead pipe would stall the
                         # cluster's control loops silently
                         break
-                    # heartbeat chunk keeps half-open connections detectable
-                    self._write_chunk(b"")
+                    # heartbeat chunk keeps half-open connections
+                    # detectable; merged streams heartbeat with a
+                    # bookmark so even an idle informer always holds a
+                    # fresh composite resume position
+                    self._write_chunk(bookmark_frame() if bookmarks
+                                      else b"")
                     continue
                 # watch frames honor the requested version like every verb.
                 # WatchEvents are SHARED by every watcher of the resource
@@ -985,10 +1040,19 @@ class _Handler(BaseHTTPRequestHandler):
                 # cacher economics, storage/cacher.go).  A batch's frames
                 # go out as ONE buffered write + flush: the syscall and
                 # the client's recv wakeup amortize across the batch too.
-                self._write_chunks(
-                    self.master.scheme.watch_frame_bytes(
-                        ev.type, ev.object, ver)
-                    for ev in evs if w.event_matches(ev.object))
+                frames = [self.master.scheme.watch_frame_bytes(
+                              ev.type, ev.object, ver)
+                          for ev in evs if w.event_matches(ev.object)]
+                if bookmarks:
+                    # after every delivered batch: the bookmark rides the
+                    # same buffered write, so a cut can strand at most
+                    # one batch's worth of single-int rv — and the
+                    # informer resumes from the last composite it holds
+                    # (duplicates are idempotent; gaps would be lost
+                    # state).  Selector-filtered batches still bookmark:
+                    # the position advanced even if no frame matched.
+                    frames.append(bookmark_frame())
+                self._write_chunks(frames)
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:
@@ -1101,6 +1165,30 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{getattr(master.store, 'wal_torn_tail_repairs', 0)}",
                 master.store.wal_fsync_seconds.render().rstrip("\n"),
             ]
+            if isinstance(master.store, ShardedStore):
+                # per-shard write-path economics (in-process sharding):
+                # the aggregate occupancy above can hide one hot shard —
+                # these lines keep the partition honest on /metrics
+                extra.append("# TYPE ktpu_store_shard_commits_total counter")
+                for i, shard in enumerate(master.store.shard_stores):
+                    extra.append(
+                        f'ktpu_store_shard_commits_total{{shard="{i}"}} '
+                        f'{getattr(shard, "commit_count", 0)}')
+                extra.append(
+                    "# TYPE ktpu_store_shard_commit_batches_total counter")
+                for i, shard in enumerate(master.store.shard_stores):
+                    extra.append(
+                        f'ktpu_store_shard_commit_batches_total'
+                        f'{{shard="{i}"}} '
+                        f'{getattr(shard, "commit_batches", 0)}')
+                extra.append("# TYPE ktpu_store_shard_wal_fsync_p99_seconds"
+                             " gauge")
+                for i, shard in enumerate(master.store.shard_stores):
+                    hist = getattr(shard, "wal_fsync_seconds", None)
+                    p99 = hist.quantile(0.99) if hist is not None else None
+                    extra.append(
+                        f'ktpu_store_shard_wal_fsync_p99_seconds'
+                        f'{{shard="{i}"}} {p99 or 0.0}')
         body = (master.metrics.render() + "\n".join(extra) + "\n").encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -1357,7 +1445,18 @@ class Master:
         client_ca_file: str = "",              # verify client certs (x509 authn)
         store_address: str = "",               # external StoreServer (etcd role):
                                                # unix path or host:port — makes
-                                               # this apiserver stateless
+                                               # this apiserver stateless.
+                                               # ';'-separated groups = one
+                                               # SHARD each (each group its own
+                                               # comma-separated primary,standby
+                                               # failover list) — the sharded
+                                               # store set (storage/shardmap.py)
+        store_shards: int = 1,                 # in-process store shard count
+                                               # (>1 partitions /registry/ by
+                                               # key hash: per-shard WAL/commit
+                                               # queue/watch ring; ignored with
+                                               # store_address — remote shard
+                                               # count comes from the ';' list)
         store_ca_file: str = "",               # verify the store's TLS cert
         store_codec: str = "json",             # store-wire codec (--wire-codec):
                                                # negotiated at dial, falls back
@@ -1384,14 +1483,35 @@ class Master:
         if store_address:
             from ..storage.remote import RemoteStore
 
-            # may be comma-separated primary,standby — RemoteStore parses
-            # and fails over between them (storage/remote.py)
-            self.store = RemoteStore(self.scheme, store_address,
-                                     ca_file=store_ca_file,
-                                     codec=store_codec)
+            # ';'-separated shard groups; within each group, comma-
+            # separated primary,standby — RemoteStore parses the group
+            # and fails over inside it (storage/remote.py).  Multiple
+            # groups build the sharded facade: one RemoteStore per shard
+            # on its own `store.shard.*` faultline sites.
+            groups = parse_shard_addresses(store_address)
+            if len(groups) > 1:
+                self.store = ShardedStore([
+                    RemoteStore(self.scheme, g, ca_file=store_ca_file,
+                                codec=store_codec,
+                                site_prefix="store.shard")
+                    for g in groups
+                ])
+            else:
+                self.store = RemoteStore(self.scheme, store_address,
+                                         ca_file=store_ca_file,
+                                         codec=store_codec)
+            self.store_shards = len(groups)
+        elif store_shards > 1:
+            # in-process sharded store: per-shard WAL/commit queue/watch
+            # ring/serialization-cache feed, stride-encoded revisions
+            self.store = build_sharded_store(
+                self.scheme.copy, store_shards,
+                wal_path=wal_path, wal_sync=wal_sync)
+            self.store_shards = store_shards
         else:
             self.store = Store(self.scheme, wal_path=wal_path,
                                wal_sync=wal_sync)
+            self.store_shards = 1
         self.write_coalescer = _WriteCoalescer(write_coalesce_window)
         self.inflight = _InflightLimiter(max_inflight_mutating)
         self.registry = Registry(self.store, self.scheme)
@@ -1401,8 +1521,14 @@ class Master:
         # with scheme.serialization_cache, encode work per event is O(1)
         # in watcher count.
         self.watch_queue_limit = watch_queue_limit
-        self.cacher = Cacher(self.store, self.scheme,
-                             queue_limit=watch_queue_limit).start()
+        if isinstance(self.store, ShardedStore):
+            # per-shard caches: each shard's view is fed (and kept fresh)
+            # independently; reads merge, watches fan into one queue
+            self.cacher = ShardedCacher(self.store, self.scheme,
+                                        queue_limit=watch_queue_limit).start()
+        else:
+            self.cacher = Cacher(self.store, self.scheme,
+                                 queue_limit=watch_queue_limit).start()
         self.token = token
         self.metrics = Metrics()
         # request spans land here, served at /debug/traces (utils/spans).

@@ -216,13 +216,30 @@ class SharedInformer:
             first_stream = False
             self._watch_stream = stream
             delivered = False
+            # Sharded apiservers interleave shards on one stream, so a
+            # single object's rv cannot position the WHOLE stream; they
+            # emit BOOKMARK frames carrying the composite resume
+            # position instead (after every batch and on heartbeats).
+            # The resume point is COMPOSITE-STICKY: once rv is composite
+            # (the relist rv or any bookmark), per-object single-int rvs
+            # never overwrite it — a stream cut between an event and its
+            # bookmark would otherwise resume from ONE shard's revision
+            # and silently gap every other shard (resuming from the last
+            # composite merely re-delivers events, which the cache
+            # upserts idempotently).  Plain streams never mint
+            # composites: behavior unchanged.
             try:
                 for ev_type, obj_dict in stream:
                     delivered = True
                     if self._stop.is_set():
                         return
+                    if ev_type == "BOOKMARK":
+                        rv = ((obj_dict.get("metadata") or {})
+                              .get("resourceVersion")) or rv
+                        continue
                     obj = self._shared(self.client.scheme.decode(obj_dict))
-                    rv = obj.metadata.resource_version or rv
+                    if "." not in str(rv):
+                        rv = obj.metadata.resource_version or rv
                     key = self._key(obj)
                     if ev_type == "DELETED":
                         with self._lock:

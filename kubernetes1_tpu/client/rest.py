@@ -226,15 +226,28 @@ class ApiClient:
         self,
         method: str,
         path: str,
-        body: Optional[Dict[str, Any]] = None,
+        body: Optional[Any] = None,
         params: Optional[Dict[str, str]] = None,
         raw: bool = False,
+        content_type: str = "",
     ) -> Any:
         """JSON round-trip by default; raw=True returns the response bytes
-        verbatim (non-JSON subresources like pods/<name>/log)."""
+        verbatim (non-JSON subresources like pods/<name>/log).
+
+        `body` may be PRE-ENCODED bytes — the hot bind path splices
+        per-item serialized bytes into one envelope (or ships a whole
+        codec payload) instead of re-walking a dict tree through
+        json.dumps per request; `content_type` overrides the JSON
+        default for such bodies (e.g. application/x-ktpu-pybin1)."""
         if params:
             path = path + "?" + urlencode({k: v for k, v in params.items() if v != ""})
-        payload = json.dumps(body).encode() if body is not None else None
+        if isinstance(body, (bytes, bytearray)):
+            payload = bytes(body)
+        else:
+            payload = json.dumps(body).encode() if body is not None else None
+        headers = self._headers()
+        if content_type:
+            headers["Content-Type"] = content_type
         # Retry rules (the unified client/retry policy): GET retries on any
         # connection error; mutations retry only when the failure happened
         # while *sending* (stale keep-alive connection — the server never
@@ -264,7 +277,7 @@ class ApiClient:
                     conn = self._conn()
                     faultline.check("client.request")
                     conn.request(method, path, body=payload,
-                                 headers=self._headers())
+                                 headers=headers)
                     sent = True
                     resp = conn.getresponse()
                     raw_body = resp.read()

@@ -32,6 +32,13 @@ def main():
     ap.add_argument("--owned-shards", default="",
                     help="comma list of shard indices to own STATICALLY "
                          "instead of via shard leases (manual partition)")
+    ap.add_argument("--bind-codec", default="json",
+                    help="bindings:batch body codec (json | pybin1): "
+                         "pybin1 ships the bulk-bind envelope as one "
+                         "codec payload instead of a json.dumps walk per "
+                         "request — the hot bind leg's analog of the "
+                         "store wire's binary framing (falls back to "
+                         "JSON against an older apiserver)")
     ap.add_argument("--policy-config-file", default="",
                     help="scheduler policy JSON (extenders; ref "
                          "examples/scheduler-policy-config.json)")
@@ -51,6 +58,11 @@ def main():
             policy = json.load(f)
 
     cs = clientset_from_args(args)
+    if args.bind_codec != "json":
+        from ..machinery.codec import get_codec
+
+        get_codec(args.bind_codec)  # typo'd codec fails at startup
+        cs.bind_codec = args.bind_codec
     owned = None
     if args.owned_shards:
         owned = [int(s) for s in args.owned_shards.split(",") if s.strip()]

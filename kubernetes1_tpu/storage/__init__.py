@@ -6,3 +6,13 @@ from .store import (
     Watcher,
 )
 from .cacher import CacheNotReady, Cacher
+from .shardmap import (
+    FanInWatcher,
+    ShardMap,
+    ShardedCacher,
+    ShardedStore,
+    build_sharded_store,
+    format_rv,
+    parse_rv,
+    parse_shard_addresses,
+)

@@ -53,6 +53,14 @@ def main():
                          "counts it; 'durable' fails the write 503 until "
                          "a standby covers it — no ack ever outruns the "
                          "standby (applies to a standby after promotion)")
+    ap.add_argument("--shard-index", type=int, default=0,
+                    help="this store's shard index i of --shard-count N "
+                         "(storage/shardmap.py): revisions are stamped "
+                         "i + k*N so the shard set shares one globally-"
+                         "unique, per-shard-strict revision space")
+    ap.add_argument("--shard-count", type=int, default=1,
+                    help="total shard count N (1 = unsharded, today's "
+                         "revision numbering exactly)")
     ap.add_argument("--metrics-port", type=int, default=-1,
                     help="serve /metrics on this port (robustness "
                          "counters: WAL torn-tail repairs, standby "
@@ -94,6 +102,8 @@ def main():
                                 primary_cert_file=args.primary_cert_file,
                                 primary_key_file=args.primary_key_file,
                                 repl_ack_policy=args.repl_ack_policy,
+                                rev_offset=args.shard_index,
+                                rev_stride=args.shard_count,
                                 ).start()
         shown = standby.address if isinstance(standby.address, str) \
             else f"{standby.address[0]}:{standby.address[1]}"
@@ -117,7 +127,8 @@ def main():
         return
 
     store = Store(global_scheme.copy(), wal_path=args.wal or None,
-                  wal_sync=args.wal_sync)
+                  wal_sync=args.wal_sync,
+                  rev_offset=args.shard_index, rev_stride=args.shard_count)
     server = StoreServer(store, address,
                          tls_cert_file=args.tls_cert_file,
                          tls_key_file=args.tls_key_file,
@@ -132,6 +143,16 @@ def main():
         "ktpu_store_unprotected_acks_total":
             lambda: server.unprotected_acks,
         "ktpu_store_commits_total": lambda: store.commit_count,
+        # per-shard write-path economics (the bench's store_shards block
+        # scrapes these off every shard process): group-commit occupancy
+        # and the WAL fsync tail this shard actually pays
+        "ktpu_store_commit_batches_total": lambda: store.commit_batches,
+        "ktpu_store_batch_occupancy":
+            lambda: (store.commit_count / store.commit_batches
+                     if store.commit_batches else 0.0),
+        "ktpu_store_wal_fsync_p99_seconds":
+            lambda: store.wal_fsync_seconds.quantile(0.99) or 0.0,
+        "ktpu_store_shard_index": lambda: store.rev_offset,
     })
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -420,17 +420,32 @@ class Cacher:
             # registering below since_rev would replay rev <= since_rev
             # events as duplicates when the stream catches up
             self._wait_rev_locked_entry(since_rev, self._fresh_timeout)
-        replay: List[Tuple[int, str, str, Dict[str, Any]]] = []
+        w = Watcher(self, prefix, queue_limit=limit,
+                    buffering=bool(since_rev))
+        replay = self.attach_watcher(w, since_rev)
+        if since_rev:
+            w._replay_and_go_live(replay)
+        return w
+
+    def attach_watcher(self, w: Watcher, since_rev: int = 0):
+        """Register an externally-built Watcher against this cache's view
+        (the sharded fan-in path — one Watcher shared across N per-shard
+        cachers) and return the history slice the caller must replay
+        outside the lock.  The caller owns the freshness waits
+        (wait_fresh / _wait_rev_locked_entry) that Cacher.watch performs
+        before registering."""
         with self._cond:
             if since_rev and since_rev < self._compacted_rev:
                 raise TooOldResourceVersion(
                     f"revision {since_rev} compacted "
                     f"(floor {self._compacted_rev})")
-            w = Watcher(self, prefix, queue_limit=limit,
-                        buffering=bool(since_rev))
-            if since_rev:
-                replay = self._history[history_index(self._history, since_rev):]
+            replay = (self._history[history_index(self._history, since_rev):]
+                      if since_rev else [])
             self._watchers.append(w)
-        if since_rev:
-            w._replay_and_go_live(replay)
-        return w
+        return replay
+
+    def current_cached_revision(self) -> int:
+        """The cache's applied revision right now (the fan-in facade
+        seeds from-now resume positions with it)."""
+        with self._cond:
+            return self._rev

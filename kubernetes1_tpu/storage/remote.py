@@ -68,9 +68,11 @@ class RemoteWatcher:
     and wake `next_batch_timeout` with an EMPTY list so the consumer can
     advance freshness without waiting out its poll timeout."""
 
-    def __init__(self, conn, f, framer=None, scheme: Optional[Scheme] = None):
+    def __init__(self, conn, f, framer=None, scheme: Optional[Scheme] = None,
+                 fault_site: str = "store.watch"):
         self._conn = conn
         self._f = f
+        self._fault_site = fault_site
         # binary fast path: a negotiated BinFramer replaces line reads;
         # event objects may arrive as codec bytes ("objraw") decoded
         # through the scheme's codec axis
@@ -121,7 +123,7 @@ class RemoteWatcher:
                 # fault injection: an injected drop here kills the stream
                 # like a mid-frame cut — `closed` is set below and the
                 # cacher reseeds (list + fresh watch), losing nothing
-                faultline.check("store.watch")
+                faultline.check(self._fault_site)
                 frame = self._recv_frame()
                 if frame is None:
                     continue  # legacy heartbeat
@@ -226,8 +228,15 @@ class RemoteStore:
     def __init__(self, scheme: Scheme,
                  address: Union[str, Tuple[str, int]],
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
-                 timeout: float = 30.0, codec: str = "json"):
+                 timeout: float = 30.0, codec: str = "json",
+                 site_prefix: str = "store"):
         self._scheme = scheme
+        # faultline site family for this link: the default client speaks
+        # on store.rpc/store.watch; a SHARD link (storage/shardmap.py)
+        # passes site_prefix="store.shard" so chaos schedules can fault
+        # shard traffic independently of an unsharded store's
+        self._site_rpc = f"{site_prefix}.rpc"
+        self._site_watch = f"{site_prefix}.watch"
         self._addrs = _parse_addresses(address)
         self._active = 0
         self.timeout = timeout
@@ -344,7 +353,8 @@ class RemoteStore:
                 pass
             raise
         if wire.negotiation_accepted(resp, self.codec):
-            return conn, f, wire.BinFramer(f, self.codec, site="store.rpc")
+            return conn, f, wire.BinFramer(f, self.codec,
+                                           site=self._site_rpc)
         # old server / unsupported codec: the connection stays usable on
         # the legacy protocol — negotiation is an upgrade, not a gate
         return conn, f, None
@@ -400,7 +410,7 @@ class RemoteStore:
                 # fault injection BEFORE the send: `sent` stays False, so
                 # the existing may-have-been-applied retry rules stay
                 # exactly as safe under chaos as under real dial failures
-                faultline.check("store.rpc")
+                faultline.check(self._site_rpc)
                 req = {"id": rid, "method": method, "params": params or {}}
                 if framer is not None:
                     # a send that dies mid-frame leaves an INCOMPLETE
@@ -567,7 +577,7 @@ class RemoteStore:
                 time.sleep(0.2)  # ride out a failover grace window
             addr = self._addrs[self._active]
             try:
-                faultline.check("store.watch")  # injected dial refusal
+                faultline.check(self._site_watch)  # injected dial refusal
                 conn, f, framer = self._connect_negotiated(
                     self.timeout, addr)
             except OSError as e:
@@ -607,8 +617,11 @@ class RemoteStore:
                 conn.close()
                 raise
             conn.settimeout(None)  # the stream blocks until events arrive
+            if framer is not None:
+                framer.site = self._site_watch  # stream faults tear frames
             return RemoteWatcher(conn, f, framer=framer,
-                                 scheme=self._scheme)
+                                 scheme=self._scheme,
+                                 fault_site=self._site_watch)
         raise last_exc if last_exc else ConnectionError(
             f"store watch failed on every address: {self._addrs}")
 

@@ -1,0 +1,623 @@
+"""Store sharding: partition /registry/ across N stores, keep the watch.
+
+The control plane's last serial structure after scheduler sharding (PR 9)
+was the single store process: one commit queue, one WAL fsync stream, one
+watch-history ring.  This module splits it the way production Kubernetes
+splits events into a separate etcd — except the partition is a hash over
+the full object key, so even ONE hot collection (30k pods) spreads across
+every shard and the bind rate scales with shard count.
+
+Layout
+------
+- ``ShardMap``: crc32(key) % N.  Deterministic and config-free, so every
+  apiserver in a multi-apiserver deployment routes identically.
+- ``ShardedStore``: the existing Store interface over N shard stores —
+  in-process ``Store`` instances or per-shard ``RemoteStore`` clients
+  (each with its own primary,standby failover list).  Key ops route to
+  one shard; prefix ops (LIST, watch) merge across all of them.
+- ``ShardedCacher``: one watch cache per shard (sync-fed in process,
+  progress-notify pump per shard against remote stores) behind the
+  Cacher read surface.
+- ``FanInWatcher``: ONE delivery queue fed by every shard.  In-process
+  shards share the Watcher object directly (zero pump threads — each
+  shard's commit fan-out pushes into the same bounded queue); remote
+  shards get one forwarding pump per stream.
+
+Revision contract (the heart of the design)
+-------------------------------------------
+Shard i of N stamps revisions ``i + k*N`` (``Store(rev_offset=i,
+rev_stride=N)``): per-shard revision order stays STRICT and dense-enough,
+revisions are globally unique across the shard set, and ``rev % N``
+recovers the owning shard from any object's resourceVersion.  Cross-shard
+ordering is deliberately NOT defined — the multi-etcd Kubernetes posture:
+clients may observe shard B's rev 7 before shard A's rev 4.
+
+Merged LISTs return a COMPOSITE resourceVersion ``"r0.r1.…"`` (one part
+per shard, ``format_rv``/``parse_rv``); resuming a merged watch from a
+composite resumes every shard at exactly its own position — no gaps, no
+duplicates.  Merged watch streams additionally carry BOOKMARK frames (the
+Kubernetes watch-bookmark analog, emitted by the apiserver's serve loop
+from ``FanInWatcher.bookmark_rv()``) so informers always hold a composite
+to resume from.  A single-int resume R is accepted with the only
+semantics one shard's revision can prove:
+
+- ``R == 0``        → from now, every shard;
+- ``0 < R < N``     → replay everything (no event can have rev <= R);
+- ``R >= N``        → events after R on R's own shard (``R % N``), from
+  now on the others.
+
+``shards == 1`` degenerates exactly to today's behavior: offsets (0, 1)
+stamp 1, 2, 3, …, composite rvs collapse to plain ints, and bookmarks are
+not emitted (``emit_bookmarks`` False) — byte-identical wire frames.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..machinery import TooOldResourceVersion
+from ..utils import locksan
+from .cacher import Cacher
+from .store import DEFAULT_WATCH_QUEUE_LIMIT, Store, Watcher
+
+
+def parse_shard_addresses(address: str) -> List[str]:
+    """';'-separated shard groups, each group a comma-separated
+    primary,standby failover list for ONE shard (what RemoteStore's
+    multi-endpoint parser consumes).  A single group (no ';') is the
+    unsharded store address unchanged."""
+    return [g.strip() for g in str(address).split(";") if g.strip()]
+
+
+def format_rv(revs: Sequence[int]) -> str:
+    """Composite resourceVersion: one part per shard, shard order.  A
+    single shard collapses to the plain int string clients always saw."""
+    return ".".join(str(int(r)) for r in revs)
+
+
+def parse_rv(value) -> Union[int, Tuple[int, ...]]:
+    """A wire resourceVersion -> int (plain) or tuple (composite).
+    Raises ValueError on garbage — callers surface it as BadRequest."""
+    if value is None:
+        return 0
+    if isinstance(value, int):
+        return value
+    s = str(value).strip()
+    if not s:
+        return 0
+    if "." in s:
+        return tuple(int(p) for p in s.split("."))
+    return int(s)
+
+
+class ShardMap:
+    """Static key partition.  crc32 over the full ``/registry/...`` key:
+    hot collections spread across every shard (the property the bind-rate
+    scaling target needs), and the map is pure arithmetic — every
+    apiserver and every restart routes identically with zero config."""
+
+    def __init__(self, shards: int):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.shards = shards
+
+    def shard_of_key(self, key: str) -> int:
+        if self.shards == 1:
+            return 0
+        return (zlib.crc32(key.encode()) & 0xFFFFFFFF) % self.shards
+
+
+class FanInWatcher(Watcher):
+    """One bounded delivery queue fed by N shards; duck-types Watcher for
+    every consumer (the apiserver's chunked-watch loop included).
+
+    In-process shards push into it directly (the shared-object fan-in:
+    the Watcher is registered in each shard's watcher list, so a group
+    commit on any shard is one `_push_batch` — no pump thread, no extra
+    wakeup).  Remote shards stream through one forwarding pump each; a
+    dead sub-stream marks the merged stream `closed` so the serving layer
+    ends it and the client relists — a merged stream missing one shard
+    can never again be gap-free.
+
+    `bookmark_rv()` (consumer thread only) is the composite of per-shard
+    delivered positions, seeded from the resume plan and advanced as
+    events are handed to the consumer — exactly what a client must
+    present to resume with no gaps and no duplicates."""
+
+    def __init__(self, owner, prefix: str, shards: int,
+                 queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,
+                 buffering: bool = False):
+        super().__init__(owner, prefix, queue_limit=queue_limit,
+                         buffering=buffering)
+        self._nshards = shards
+        self._positions = [0] * shards  # consumer thread only (see class doc)
+        self.closed = False
+        # bookmarks only mean something when streams actually merge; a
+        # 1-shard facade must stay byte-identical to the plain path
+        self.emit_bookmarks = shards > 1
+        self._subs: List[Any] = []  # remote sub-watchers (stop() severs them)
+
+    # ------------------------------------------------------------ positions
+
+    def seed_positions(self, revs: Sequence[int]):
+        self._positions = [int(r) for r in revs]
+
+    def _take_batch(self, batch):
+        super()._take_batch(batch)
+        for ev in batch:
+            try:
+                rev = int((ev.object.get("metadata") or {})
+                          .get("resourceVersion") or 0)
+            except (TypeError, ValueError):
+                continue
+            if rev > 0:
+                i = rev % self._nshards
+                if rev > self._positions[i]:
+                    self._positions[i] = rev
+
+    def bookmark_rv(self) -> str:
+        return format_rv(self._positions)
+
+    # -------------------------------------------------------- remote shards
+
+    def add_remote(self, sub):
+        """Adopt one remote shard's stream: a pump forwards its batches
+        into the shared queue (per-shard order preserved — one pump per
+        stream, arrival order within it)."""
+        self._subs.append(sub)
+        t = threading.Thread(target=self._pump_remote, args=(sub,),
+                             daemon=True, name="store-shard-watch-pump")
+        t.start()
+
+    def _pump_remote(self, sub):
+        while not self._stopped.is_set():
+            evs = sub.next_batch_timeout(1.0)
+            if evs is None:
+                if getattr(sub, "closed", False) or sub._stopped.is_set():
+                    break
+                continue
+            if evs:  # [] is a progress-only wakeup: nothing to forward
+                self._push_batch(evs)
+        # sub-stream over: if the merged stream is still live, it just
+        # lost a shard and can never be gap-free again — end it so the
+        # consumer relists (the cacher-reseed contract, per shard)
+        self.closed = True
+        with self._plock:
+            if not self._stopped.is_set():
+                self._stopped.set()
+                self._q.put(None)
+
+    def stop(self):
+        for sub in self._subs:
+            try:
+                sub.stop()
+            except OSError:  # remote stream teardown: socket already dead
+                pass
+        super().stop()
+
+
+class ShardedStore:
+    """The Store interface over N shard stores (see module docstring).
+
+    Key ops route by ShardMap; prefix ops merge.  `commit_batch` /
+    `get_raw_many` group by shard — each shard still amortizes its
+    sub-batch through ONE group commit, and a cross-shard batch stays
+    what the single-store batch always was: amortization, NOT a
+    transaction (per-op outcomes, neighbors commit independently)."""
+
+    def __init__(self, stores: Sequence, shard_map: Optional[ShardMap] = None):
+        if not stores:
+            raise ValueError("ShardedStore needs at least one shard")
+        self._stores = list(stores)
+        self.map = shard_map or ShardMap(len(self._stores))
+        if self.map.shards != len(self._stores):
+            raise ValueError(
+                f"shard map arity {self.map.shards} != stores "
+                f"{len(self._stores)}")
+        self.shards = len(self._stores)
+        self._stats_lock = locksan.make_lock("storage.ShardedStore._stats_lock")
+        self._fanin_evictions = 0
+        # concurrent fan-out pays only when sub-calls leave the GIL (a
+        # remote shard's socket round-trip + its WAL fsync); in-process
+        # shards are pure lock+memory work where extra threads just add
+        # scheduling overhead
+        self._parallel = any(not hasattr(s, "attach_watcher")
+                             for s in self._stores)
+
+    @property
+    def shard_stores(self) -> List[Any]:
+        """The underlying shard stores, shard order (bench/metrics)."""
+        return list(self._stores)
+
+    def _shard_for(self, key: str):
+        return self._stores[self.map.shard_of_key(key)]
+
+    # ---------------------------------------------------------- aggregates
+
+    def _sum_attr(self, name: str):
+        vals = [getattr(s, name) for s in self._stores if hasattr(s, name)]
+        return sum(vals) if vals else None
+
+    @property
+    def commit_count(self):
+        return self._sum_attr("commit_count")
+
+    @property
+    def commit_batches(self):
+        return self._sum_attr("commit_batches")
+
+    @property
+    def watch_wakeups(self):
+        return self._sum_attr("watch_wakeups") or 0
+
+    @property
+    def watch_events(self):
+        return self._sum_attr("watch_events") or 0
+
+    @property
+    def watch_evictions(self):
+        with self._stats_lock:
+            own = self._fanin_evictions
+        return (self._sum_attr("watch_evictions") or 0) + own
+
+    @property
+    def wal_torn_tail_repairs(self):
+        return self._sum_attr("wal_torn_tail_repairs") or 0
+
+    @property
+    def wal_fsync_seconds(self):
+        """Shard 0's histogram (the /metrics render slot); per-shard
+        detail lives in the bench `store_shards` block and each shard
+        process's own /metrics."""
+        return self._stores[0].wal_fsync_seconds
+
+    # ------------------------------------------------------------ routing
+
+    def current_revision(self) -> int:
+        """Highest exposed revision across the shard set — a monitoring
+        number; freshness logic is per-shard (see ShardedCacher)."""
+        return max(s.current_revision() for s in self._stores)
+
+    def shard_revisions(self) -> List[int]:
+        return [s.current_revision() for s in self._stores]
+
+    def create(self, key: str, obj):
+        return self._shard_for(key).create(key, obj)
+
+    def get(self, key: str):
+        return self._shard_for(key).get(key)
+
+    def get_or_none(self, key: str):
+        return self._shard_for(key).get_or_none(key)
+
+    def update_cas(self, key: str, obj):
+        return self._shard_for(key).update_cas(key, obj)
+
+    def guaranteed_update(self, key: str, update_fn: Callable):
+        return self._shard_for(key).guaranteed_update(key, update_fn)
+
+    def delete(self, key: str, expect_rv: str = ""):
+        return self._shard_for(key).delete(key, expect_rv)
+
+    def compact(self, keep_last: int = 1000):
+        for s in self._stores:
+            s.compact(keep_last)
+
+    def close(self):
+        for s in self._stores:
+            s.close()
+
+    def add_commit_hook(self, fn: Callable):
+        for s in self._stores:
+            s.add_commit_hook(fn)
+
+    def remove_commit_hook(self, fn: Callable):
+        for s in self._stores:
+            s.remove_commit_hook(fn)
+
+    # -------------------------------------------------------------- reads
+
+    def _fan_out(self, calls: List[Callable[[], Any]]) -> List[Any]:
+        """Run per-shard sub-calls CONCURRENTLY and return their results
+        in order (re-raising the first failure).  Against remote shards
+        each sub-call is a socket round-trip (plus the shard's own
+        commit latency — WAL fsync included); running them serially
+        makes a cross-shard batch pay N round-trips back-to-back, which
+        measured a 32% bind-rate LOSS at 4 shards.  One short-lived
+        thread per additional shard: the spawn cost (~100us) is noise
+        next to the millisecond-scale RPC it overlaps."""
+        if len(calls) == 1 or not self._parallel:
+            return [c() for c in calls]
+        results: List[Any] = [None] * len(calls)
+        errors: List[Optional[BaseException]] = [None] * len(calls)
+
+        def run(i: int):
+            try:
+                results[i] = calls[i]()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors[i] = e
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                    name="store-shard-fanout")
+                   for i in range(1, len(calls))]
+        for t in threads:
+            t.start()
+        run(0)  # the caller's thread takes shard 0's slice
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
+
+    def list_raw(self, prefix: str):
+        outs = self._fan_out([
+            (lambda s=s: s.list_raw(prefix)) for s in self._stores])
+        entries: List[Tuple[str, int, Dict[str, Any]]] = []
+        revs: List[int] = []
+        for e, rev in outs:
+            entries.extend(e)
+            revs.append(rev)
+        entries.sort(key=lambda kro: kro[0])  # the single store listed sorted
+        return entries, format_rv(revs)
+
+    def list(self, prefix: str):
+        entries, rev = self.list_raw(prefix)
+        scheme = self._stores[0]._scheme
+        return [scheme.decode(obj) for _k, _r, obj in entries], rev
+
+    def _scatter(self, positions_by_shard: Dict[int, List[int]],
+                 call_for_shard: Callable[[int, List[int]], Callable],
+                 out: List[Any]) -> List[Any]:
+        shards = sorted(positions_by_shard)
+        outs = self._fan_out([
+            call_for_shard(si, positions_by_shard[si]) for si in shards])
+        for si, res in zip(shards, outs):
+            for p, r in zip(positions_by_shard[si], res):
+                out[p] = r
+        return out
+
+    def get_raw_many(self, keys: List[str]) -> List[Optional[Dict[str, Any]]]:
+        by_shard: Dict[int, List[int]] = {}
+        for pos, key in enumerate(keys):
+            by_shard.setdefault(self.map.shard_of_key(key), []).append(pos)
+        return self._scatter(
+            by_shard,
+            lambda si, poss: (lambda: self._stores[si].get_raw_many(
+                [keys[p] for p in poss])),
+            [None] * len(keys))
+
+    def commit_batch(self, ops: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        by_shard: Dict[int, List[int]] = {}
+        for pos, op in enumerate(ops):
+            by_shard.setdefault(
+                self.map.shard_of_key(op["key"]), []).append(pos)
+        return self._scatter(
+            by_shard,
+            lambda si, poss: (lambda: self._stores[si].commit_batch(
+                [ops[p] for p in poss])),
+            [None] * len(ops))
+
+    # -------------------------------------------------------------- watch
+
+    def plan_resume(self, since_rev, current_rev_of: Callable[[int], int]):
+        """-> (per_shard_since, position_seeds).  Encodes the resume
+        semantics from the module docstring.  Position seeds for
+        from-now shards are snapshotted BEFORE registration: an event
+        committed between snapshot and attach is replayed on a bookmark
+        resume instead of skipped — duplicates are idempotent upserts,
+        gaps are lost state."""
+        parsed = since_rev if isinstance(since_rev, tuple) else \
+            parse_rv(since_rev)
+        n = self.shards
+        if isinstance(parsed, tuple):
+            if len(parsed) != n:
+                # a composite minted under a different shard count: the
+                # only safe answer is the relist path
+                raise TooOldResourceVersion(
+                    f"composite resourceVersion arity {len(parsed)} does "
+                    f"not match shard count {n}; relist required")
+            # a part of 0 is SHARD 0's empty-at-list floor (its revisions
+            # start at the 0 residue), not "from now": resume it with a
+            # positive below-first-possible-rev value so everything
+            # committed after the list replays — since_rev=0 there would
+            # silently gap any event landing between the list and the
+            # watch registration.  Shards i>0 have truthy floors (i) and
+            # never hit this.
+            return [p or 1 for p in parsed], list(parsed)
+        r = int(parsed or 0)
+        if r == 0:
+            return [0] * n, [current_rev_of(i) for i in range(n)]
+        if r < n:
+            # below every possible committed revision: replay everything
+            return [r] * n, [r] * n
+        owner = r % n
+        since, seeds = [], []
+        for i in range(n):
+            if i == owner:
+                since.append(r)
+                seeds.append(r)
+            else:
+                seeds.append(current_rev_of(i))
+                since.append(0)
+        return since, seeds
+
+    def watch(self, prefix: str, since_rev=0,
+              queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT) -> FanInWatcher:
+        since, seeds = self.plan_resume(
+            since_rev, lambda i: self._stores[i].current_revision())
+        buffering = any(since)
+        w = FanInWatcher(self, prefix, self.shards, queue_limit=queue_limit,
+                         buffering=buffering)
+        w.seed_positions(seeds)
+        attached: List[Any] = []
+        replays: List[list] = []
+        try:
+            for st, sr in zip(self._stores, since):
+                if hasattr(st, "attach_watcher"):  # in-process shard
+                    replays.append(st.attach_watcher(w, sr))
+                    attached.append(st)
+                else:  # remote shard: dedicated stream, forwarded by a pump
+                    w.add_remote(st.watch(prefix, since_rev=sr,
+                                          queue_limit=0))
+        except Exception:
+            for st in attached:
+                st._remove_watcher(w)
+            w.stop()
+            raise
+        for entries in replays:
+            w._replay_entries(entries)
+        if buffering:
+            w._go_live()
+        return w
+
+    def _remove_watcher(self, w: Watcher):
+        for st in self._stores:
+            rm = getattr(st, "_remove_watcher", None)
+            if rm is not None:
+                rm(w)
+
+    def _note_watch_eviction(self):
+        with self._stats_lock:
+            self._fanin_evictions += 1
+
+
+def build_sharded_store(scheme_factory: Callable[[], Any], shards: int,
+                        wal_path: Optional[str] = None,
+                        wal_sync: str = "batch") -> ShardedStore:
+    """N in-process shard Stores with stride revisions and per-shard WALs
+    (``<wal_path>.shard<i>``).  Each shard gets its OWN scheme copy: the
+    serialization caches stay per-shard feeds, exactly like the
+    one-process-per-shard deployment."""
+    stores = [
+        Store(scheme_factory(),
+              wal_path=f"{wal_path}.shard{i}" if wal_path else None,
+              wal_sync=wal_sync, rev_offset=i, rev_stride=shards)
+        for i in range(shards)
+    ]
+    return ShardedStore(stores)
+
+
+class ShardedCacher:
+    """Per-shard watch caches behind the Cacher read surface.
+
+    Freshness is a PER-SHARD property: each shard cacher is sync-fed by
+    its in-process shard (fresh by construction) or rides its own
+    shard's progress-notify stream (RPC-free read-your-writes per
+    shard).  Merged LISTs concatenate per-shard fresh snapshots and
+    return a composite rv; merged watches fan into one queue
+    (FanInWatcher) with bookmark support."""
+
+    def __init__(self, store: ShardedStore, scheme,
+                 queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,
+                 **cacher_kwargs):
+        self._store = store
+        self.map = store.map
+        self._queue_limit = queue_limit
+        self._shards = [
+            Cacher(sub, scheme, queue_limit=queue_limit, **cacher_kwargs)
+            for sub in store.shard_stores
+        ]
+        self._evict_lock = locksan.make_lock(
+            "storage.ShardedCacher._evict_lock")
+        self._fanin_evictions = 0
+
+    @property
+    def shard_cachers(self) -> List[Cacher]:
+        return list(self._shards)
+
+    def start(self) -> "ShardedCacher":
+        for c in self._shards:
+            c.start()
+        return self
+
+    def stop(self):
+        for c in self._shards:
+            c.stop()
+
+    # ---------------------------------------------------------- aggregates
+
+    @property
+    def reseeds(self):
+        return sum(c.reseeds for c in self._shards)
+
+    @property
+    def watch_evictions(self):
+        with self._evict_lock:
+            own = self._fanin_evictions
+        return sum(c.watch_evictions for c in self._shards) + own
+
+    @property
+    def watch_wakeups(self):
+        return sum(c.watch_wakeups for c in self._shards)
+
+    @property
+    def watch_events(self):
+        return sum(c.watch_events for c in self._shards)
+
+    # --------------------------------------------------------------- reads
+
+    def get_raw(self, key: str):
+        return self._shards[self.map.shard_of_key(key)].get_raw(key)
+
+    def list_raw(self, prefix: str):
+        # per-shard wait_fresh runs inside each cacher's list_raw;
+        # against remote shards those freshness waits fan out
+        # CONCURRENTLY (the store facade's rule — N back-to-back waits
+        # would serialize the apiserver's LIST hot path), and in-process
+        # shards stay serial on the one GIL
+        outs = self._store._fan_out([
+            (lambda c=c: c.list_raw(prefix)) for c in self._shards])
+        entries: List[Tuple[str, int, Dict[str, Any]]] = []
+        revs: List[int] = []
+        for e, rev in outs:
+            entries.extend(e)
+            revs.append(rev)
+        entries.sort(key=lambda kro: kro[0])
+        return entries, format_rv(revs)
+
+    # --------------------------------------------------------------- watch
+
+    def watch(self, prefix: str, since_rev=0,
+              queue_limit: Optional[int] = None) -> FanInWatcher:
+        limit = self._queue_limit if queue_limit is None else queue_limit
+        since, seeds = self._store.plan_resume(
+            since_rev, lambda i: self._shards[i].current_cached_revision())
+        n = len(self._shards)
+        for c, sr in zip(self._shards, since):
+            c.wait_fresh()
+            if sr and sr >= n:
+                # a REAL shard revision the client proved exists: wait
+                # for this shard's cache to cover it before registering
+                # (the Cacher.watch no-duplicates contract).  Parts below
+                # n are empty-shard floor values — nothing to wait for.
+                c._wait_rev_locked_entry(sr, c._fresh_timeout)
+        w = FanInWatcher(self, prefix, n, queue_limit=limit,
+                         buffering=any(since))
+        w.seed_positions(seeds)
+        attached: List[Cacher] = []
+        replays: List[list] = []
+        try:
+            for c, sr in zip(self._shards, since):
+                replays.append(c.attach_watcher(w, sr))
+                attached.append(c)
+        except Exception:
+            for c in attached:
+                c._remove_watcher(w)
+            w.stop()
+            raise
+        for entries in replays:
+            w._replay_entries(entries)
+        if any(since):
+            w._go_live()
+        return w
+
+    def _remove_watcher(self, w: Watcher):
+        for c in self._shards:
+            c._remove_watcher(w)
+
+    def _note_watch_eviction(self):
+        with self._evict_lock:
+            self._fanin_evictions += 1

@@ -45,7 +45,8 @@ class StandbyServer:
                  client_ca_file: str = "",
                  primary_ca_file: str = "", primary_cert_file: str = "",
                  primary_key_file: str = "",
-                 repl_ack_policy: str = "available"):
+                 repl_ack_policy: str = "available",
+                 rev_offset: int = 0, rev_stride: int = 1):
         self.primary_address = primary_address
         self.failover_grace = failover_grace
         # a TLS-enabled primary (TCP+mTLS deployment) needs a TLS dial for
@@ -60,7 +61,12 @@ class StandbyServer:
                 self._ssl_ctx.load_cert_chain(
                     certfile=primary_cert_file,
                     keyfile=primary_key_file or None)
-        self.store = Store(scheme or global_scheme.copy(), wal_path=wal_path)
+        # a SHARD's standby must keep its shard's revision residue class
+        # after promotion (storage/shardmap.py: shard i of N stamps
+        # i + k*N) — replicated revs arrive pre-stamped, but the first
+        # post-promotion commit must continue the stride, not reset to +1
+        self.store = Store(scheme or global_scheme.copy(), wal_path=wal_path,
+                           rev_offset=rev_offset, rev_stride=rev_stride)
         self.server = StoreServer(self.store, serve_address,
                                   tls_cert_file=tls_cert_file,
                                   tls_key_file=tls_key_file,

@@ -185,23 +185,33 @@ class Watcher:
         with self._plock:
             self._evict_locked(note)
 
-    def _replay_and_go_live(self, entries):
-        """Deliver a history snapshot (taken under the owner's lock, but
-        filtered and delivered outside it), then flush any live events
-        that were buffered while replaying — revision order preserved.
-        _plock is taken per event, NOT across the whole replay: a commit's
-        fan-out blocks on _plock while holding the owner's lock, so one
-        watcher resuming far behind must not convoy every writer."""
+    def _replay_entries(self, entries):
+        """Deliver one history snapshot (taken under an owner's lock, but
+        filtered and delivered outside it); the watcher keeps buffering
+        live pushes until _go_live.  _plock is taken per event, NOT
+        across the whole replay: a commit's fan-out blocks on _plock
+        while holding the owner's lock, so one watcher resuming far
+        behind must not convoy every writer."""
         for _rev, typ, key, obj in entries:
             if self._stopped.is_set():
                 break
             if key.startswith(self.prefix):
                 with self._plock:
                     self._deliver_locked([WatchEvent(typ, obj)])
+
+    def _go_live(self):
+        """Flush the live events buffered during replay(s), in arrival
+        order — per-source revision order preserved."""
         with self._plock:
             for ev in self._pending:
                 self._deliver_locked([ev])
             self._pending = None
+
+    def _replay_and_go_live(self, entries):
+        """Replay one owner's snapshot, then go live (the single-source
+        path; the sharded fan-in replays N snapshots before going live)."""
+        self._replay_entries(entries)
+        self._go_live()
 
     def stop(self):
         if not self._stopped.is_set():
@@ -383,7 +393,20 @@ class Store:
         wal_path: Optional[str] = None,
         history_limit: int = DEFAULT_HISTORY_LIMIT,
         wal_sync: str = "batch",
+        rev_offset: int = 0,
+        rev_stride: int = 1,
     ):
+        # Sharded deployments (storage/shardmap.py) give shard i of N
+        # rev_offset=i, rev_stride=N: this store then stamps revisions
+        # i+N, i+2N, ... — per-shard strictly monotonic, globally unique
+        # across the shard set, and the owning shard is recoverable as
+        # rev % N.  The default (0, 1) is today's 1, 2, 3, ... exactly.
+        if rev_stride < 1 or not 0 <= rev_offset < rev_stride:
+            raise ValueError(
+                f"rev_offset must be in [0, rev_stride); got offset="
+                f"{rev_offset} stride={rev_stride}")
+        self.rev_offset = rev_offset
+        self.rev_stride = rev_stride
         self._scheme = scheme
         self._lock = threading.RLock()  # ktpulint: ignore[KTPU007] hottest lock in the process (every MVCC op); sanitizer tracking would tax every request
         self._data: Dict[str, Tuple[int, Dict[str, Any]]] = {}  # key -> (rev, encoded obj)
@@ -392,7 +415,7 @@ class Store:
         # endpoint in the store — full-store sorted scans made pod-create
         # latency grow linearly with cluster history at 30k-pod density.
         self._by_collection: Dict[str, set] = {}
-        self._rev = 0
+        self._rev = rev_offset
         # History ring for watch resume: list of (rev, type, key, encoded obj)
         self._history: List[Tuple[int, str, str, Dict[str, Any]]] = []
         self._history_limit = history_limit
@@ -649,7 +672,7 @@ class Store:
         """Must hold lock, inside a drain: assigns the next revision and
         applies to data/history.  WAL + fan-out happen ONCE per batch at
         the end of the drain (the record lands in _batch_records)."""
-        self._rev += 1
+        self._rev += self.rev_stride
         rev = self._rev
         # two-level copy: never re-stamp a dict already committed to history
         # or handed to a watcher (delete passes the stored dict back in here)
@@ -1008,20 +1031,29 @@ class Store:
         registering a resuming watcher no longer scans up to
         history_limit entries under the hottest lock in the process.
         """
-        replay: List[Tuple[int, str, str, Dict[str, Any]]] = []
-        with self._lock:
-            if since_rev and since_rev < self._compacted_rev:
-                raise TooOldResourceVersion(
-                    f"revision {since_rev} compacted (floor {self._compacted_rev})"
-                )
-            w = Watcher(self, prefix, queue_limit=queue_limit,
-                        buffering=bool(since_rev))
-            if since_rev:
-                replay = self._history[history_index(self._history, since_rev):]
-            self._watchers.append(w)
+        w = Watcher(self, prefix, queue_limit=queue_limit,
+                    buffering=bool(since_rev))
+        replay = self.attach_watcher(w, since_rev)
         if since_rev:
             w._replay_and_go_live(replay)
         return w
+
+    def attach_watcher(self, w: Watcher, since_rev: int = 0):
+        """Register an externally-built Watcher (the sharded fan-in path:
+        one Watcher shared across N shard stores feeds one queue with
+        zero pump threads) and return the history slice the CALLER must
+        replay outside the lock — empty when since_rev==0.  A resuming
+        watcher must be constructed with buffering=True and go live only
+        after every replay has been delivered."""
+        with self._lock:
+            if since_rev and since_rev < self._compacted_rev:
+                raise TooOldResourceVersion(
+                    f"revision {since_rev} compacted "
+                    f"(floor {self._compacted_rev})")
+            replay = (self._history[history_index(self._history, since_rev):]
+                      if since_rev else [])
+            self._watchers.append(w)
+        return replay
 
     def _remove_watcher(self, w: Watcher):
         with self._lock:
@@ -1151,9 +1183,14 @@ class Store:
                 del self._history[:drop]
 
     def close(self):
+        # snapshot under the lock, stop OUTSIDE it: a sharded fan-in
+        # watcher's stop() detaches from EVERY shard, and holding this
+        # shard's lock while touching a sibling's would order locks
+        # across shards (deadlock-prone against a concurrent close)
         with self._lock:
-            for w in list(self._watchers):
-                w.stop()
-            if self._wal:
-                self._wal.close()
-                self._wal = None
+            watchers = list(self._watchers)
+            wal, self._wal = self._wal, None
+        for w in watchers:
+            w.stop()
+        if wal:
+            wal.close()

@@ -55,6 +55,14 @@ Wired sites:
                                                  prefixed frame mid-byte —
                                                  the receiver must surface
                                                  FrameTruncated, never hang)
+  store.shard.rpc / store.shard.watch           (the SHARD links: each
+                                                 ShardedStore shard's
+                                                 RemoteStore dials with
+                                                 site_prefix="store.shard"
+                                                 — storage/shardmap.py —
+                                                 so chaos can fault shard
+                                                 traffic independently of
+                                                 an unsharded store's)
   repl.link                                     (storage/server.py sender,
                                                  storage/standby.py consumer)
   wal.write                                     (storage/store.py)

@@ -101,6 +101,23 @@ SCHED_SPEC = (
     "client.watch=drop@0.05"
 )
 
+# Sharded-STORE schedule: the apiserver dials each store shard on its own
+# store.shard.* faultline sites (storage/shardmap.py gives shard links a
+# distinct site family), plus the replication links and WALs — every new
+# shard boundary is under fire.  The seeded failure is one shard
+# PRIMARY's mid-storm kill: its standby must promote, the shard's
+# RemoteStore must fail over inside its group, and zero acked writes may
+# be lost (the per-shard durable ack gate is what makes that provable).
+STORE_SHARD_SPEC = (
+    "client.dial=drop@0.03;"
+    "client.request=drop@0.03|delay:5ms@0.05;"
+    "client.watch=drop@0.05;"
+    "store.shard.rpc=drop@0.05|delay:5ms@0.05;"
+    "store.shard.watch=drop@0.10;"
+    "repl.link=sever@0.08|drop@0.05;"
+    "wal.write=truncate@0.03"
+)
+
 
 def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
                  spec: str = DEFAULT_SPEC, writers: int = 3,
@@ -871,6 +888,285 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
     return verdict
 
 
+def run_store_shard_schedule(seed: int, duration: float = 6.0,
+                             spec: str = None, writers: int = 3,
+                             shards: int = 2, tmpdir: str = "") -> dict:
+    """One seeded sharded-store schedule: N store shards (each a durable
+    primary+standby pair with its own WAL and stride-encoded revisions),
+    ONE Master dialing the whole shard set over store.shard.* faultline
+    sites, configmap writers spraying keys across every shard, and an
+    informer riding the merged multi-shard watch (composite-rv bookmarks
+    included).  Mid-storm the seed picks one shard and KILLS its primary
+    — the standby must promote and that shard's client leg must fail
+    over inside its group.
+
+    Verdict invariants (the standing set, per shard):
+      - zero acked writes lost across the shard-primary failover;
+      - revision order strict PER SHARD at every shard's primary fan-out
+        and its standby's (cross-shard order is per-shard only — the
+        documented multi-etcd contract);
+      - per-shard order also strict on a merged cacher stream
+        (rev > last-seen for that rev's OWN shard, rev % N);
+      - the informer converges losslessly; recovery is bounded;
+      - zero unprotected acks (durable ack policy on every shard).
+    """
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset, SharedInformer
+    from kubernetes1_tpu.client import retry as client_retry
+    from kubernetes1_tpu.machinery import AlreadyExists
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.storage import Store
+    from kubernetes1_tpu.storage.server import StoreServer
+    from kubernetes1_tpu.storage.standby import StandbyServer
+    from kubernetes1_tpu.utils import faultline
+
+    spec = STORE_SHARD_SPEC if spec is None else spec
+    own_tmp = not tmpdir
+    if own_tmp:
+        tmpdir = tempfile.mkdtemp(prefix=f"ktpu-chaos-shard-{seed}-")
+    retries_before = client_retry.retries_snapshot()
+    verdict = {"mode": "store-shard", "seed": seed, "spec": spec,
+               "shards": shards, "killed_shard": None}
+    stores, primaries, standbys, ledgers = [], [], [], []
+    master = cs = inf = None
+    order_stop = threading.Event()
+    order_thread = None
+    stop = threading.Event()
+    threads: list = []
+    try:
+        groups = []
+        for i in range(shards):
+            st = Store(global_scheme.copy(),
+                       wal_path=os.path.join(tmpdir, f"p{i}.wal"),
+                       rev_offset=i, rev_stride=shards)
+            stores.append(st)
+            psock = os.path.join(tmpdir, f"p{i}.sock")
+            ssock = os.path.join(tmpdir, f"s{i}.sock")
+            primaries.append(StoreServer(st, psock,
+                                         repl_ack_policy="durable").start())
+            standbys.append(StandbyServer(
+                psock, ssock, wal_path=os.path.join(tmpdir, f"s{i}.wal"),
+                failover_grace=0.5, repl_ack_policy="durable",
+                rev_offset=i, rev_stride=shards).start())
+            groups.append(f"{psock},{ssock}")
+        master = Master(store_address=";".join(groups)).start()
+        cs = Clientset(master.url)
+
+        # per-shard revision-order ledgers on primary AND standby fan-outs
+        def ledger(st):
+            w = st.watch("/registry/", queue_limit=0)
+            revs: list = []
+
+            def pump():
+                for ev in w:
+                    try:
+                        revs.append(int((ev.object.get("metadata") or {})
+                                        .get("resourceVersion") or 0))
+                    except (TypeError, ValueError):
+                        revs.append(-1)  # malformed: fails the order check
+
+            th = threading.Thread(target=pump, daemon=True,
+                                  name="chaos-shard-ledger")
+            th.start()
+            return w, revs
+
+        ledger_revs = []
+        for i in range(shards):
+            wp, rp = ledger(stores[i])
+            ws, rs = ledger(standbys[i].store)
+            ledgers.extend([wp, ws])
+            ledger_revs.append((rp, rs))
+
+        # merged-stream order check: revisions must be strictly
+        # increasing PER SHARD (rev % N) within one cacher stream —
+        # cross-shard interleaving is the documented contract
+        order_ok = [True]
+
+        def merged_order_check():
+            while not order_stop.is_set():
+                try:
+                    w = master.cacher.watch("/registry/", since_rev=0)
+                except Exception:  # noqa: BLE001 — a shard cacher reseeding
+                    if order_stop.wait(0.2):
+                        return
+                    continue
+                last = [0] * shards
+                try:
+                    while not order_stop.is_set():
+                        ev = w.next_timeout(0.5)
+                        if ev is None:
+                            if w.evicted or w._stopped.is_set() or \
+                                    getattr(w, "closed", False):
+                                break  # reseed/evict: open a fresh stream
+                            continue
+                        try:
+                            rv = int((ev.object.get("metadata") or {})
+                                     .get("resourceVersion") or 0)
+                        except (TypeError, ValueError):
+                            order_ok[0] = False
+                            continue
+                        i = rv % shards
+                        if rv <= last[i]:
+                            order_ok[0] = False
+                        last[i] = rv
+                finally:
+                    w.stop()
+
+        order_thread = threading.Thread(target=merged_order_check,
+                                        daemon=True,
+                                        name="chaos-shard-order")
+        order_thread.start()
+
+        inf = SharedInformer(cs.configmaps, namespace="default")
+        inf.start()
+        if not inf.wait_for_sync(15.0):
+            raise RuntimeError("chaos boot: informer never synced")
+
+        acked: list = []
+
+        def writer(wid: int):
+            wcs = Clientset(master.url)
+            i = 0
+            while not stop.is_set():
+                name = f"chaos-shard-{seed}-{wid}-{i}"
+                cm = t.ConfigMap(data={"i": str(i)})
+                cm.metadata.name = name
+                try:
+                    wcs.configmaps.create(cm, "default")
+                except AlreadyExists:
+                    # a fault landed between commit and response on a
+                    # prior attempt: the write IS durable — count it
+                    acked.append(name)
+                    i += 1
+                except Exception:  # noqa: BLE001 — mid-fault blip: retry same name
+                    pass
+                else:
+                    acked.append(name)
+                    i += 1
+                time.sleep(0.02)
+            wcs.close()
+
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True,
+                                    name=f"chaos-shard-writer-{w}")
+                   for w in range(writers)]
+        if spec:
+            faultline.activate(seed, spec)
+        try:
+            for th in threads:
+                th.start()
+            victim = seed % shards
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < duration:
+                if (verdict["killed_shard"] is None
+                        and time.monotonic() - t0 > duration / 2):
+                    # the SIGKILL analog on ONE shard's primary: its
+                    # standby promotes; the other shards keep serving
+                    primaries[victim].stop()
+                    verdict["killed_shard"] = victim
+                time.sleep(0.05)
+            stop.set()
+            for th in threads:
+                th.join(timeout=10.0)
+        finally:
+            verdict["injected"] = faultline.stats()
+            faultline.deactivate()
+
+        # ---- recovery + invariants (faults OFF now)
+        recover_t0 = time.monotonic()
+
+        def live_names():
+            try:
+                return {c.metadata.name
+                        for c in cs.configmaps.list(namespace="default")[0]}
+            except Exception:  # noqa: BLE001 — failover may still be settling
+                return None
+
+        lost: list = list(acked)
+        while time.monotonic() - recover_t0 < CONVERGE_TIMEOUT:
+            names = live_names()
+            if names is not None:
+                lost = [n for n in acked if n not in names]
+                if not lost:
+                    break
+            time.sleep(0.25)
+        verdict["acked"] = len(acked)
+        verdict["lost"] = lost
+        verdict["recovery_s"] = round(time.monotonic() - recover_t0, 2)
+
+        informer_ok = False
+        deadline = time.monotonic() + CONVERGE_TIMEOUT
+        want = set(acked)
+        while time.monotonic() < deadline:
+            have = {o.metadata.name for o in inf.list()}
+            if want <= have:
+                informer_ok = True
+                break
+            time.sleep(0.25)
+        verdict["informer_converged"] = informer_ok
+
+        def strictly_increasing(revs):
+            return all(b > a for a, b in zip(revs, revs[1:]))
+
+        order_stop.set()
+        order_thread.join(timeout=5.0)
+        verdict["revision_order_ok"] = (
+            all(strictly_increasing(rp) and strictly_increasing(rs)
+                for rp, rs in ledger_revs)
+            and order_ok[0])
+        verdict["unprotected_acks"] = sum(
+            p.unprotected_acks for p in primaries) + sum(
+            s.server.unprotected_acks for s in standbys)
+        verdict["standby_promoted"] = standbys[victim].promoted.is_set()
+        verdict["standby_resyncs"] = sum(s.resyncs for s in standbys)
+        verdict["client_retries"] = client_retry.retries_delta(
+            retries_before)
+        verdict["ok"] = (not lost and informer_ok
+                         and verdict["revision_order_ok"]
+                         and len(acked) > 10
+                         and verdict["unprotected_acks"] == 0
+                         and verdict["standby_promoted"])
+    finally:
+        stop.set()
+        order_stop.set()
+        faultline.deactivate()
+        for th in threads:
+            th.join(timeout=5.0)
+        if order_thread is not None:
+            order_thread.join(timeout=5.0)
+        for component in [inf] + ledgers:
+            if component is not None:
+                _stop_quietly_mod(component.stop)
+        if cs is not None:
+            _stop_quietly_mod(cs.close)
+        if master is not None:
+            _stop_quietly_mod(master.stop)
+        for s in standbys:
+            _stop_quietly_mod(s.stop)
+        for i, p in enumerate(primaries):
+            if verdict.get("killed_shard") != i:
+                _stop_quietly_mod(p.stop)
+    # torn-WAL repair happens on store OPEN: reopen every shard's WALs
+    # the way restarted shard processes would — injected tears must
+    # repair, and replay must land back in each shard's residue class
+    wal_repairs = sum(st.wal_torn_tail_repairs for st in stores)
+    for i in range(shards):
+        for wal in (f"p{i}.wal", f"s{i}.wal"):
+            path = os.path.join(tmpdir, wal)
+            if os.path.exists(path):
+                from kubernetes1_tpu.machinery.scheme import global_scheme
+                from kubernetes1_tpu.storage import Store
+
+                reopened = Store(global_scheme.copy(), wal_path=path,
+                                 rev_offset=i, rev_stride=shards)
+                wal_repairs += reopened.wal_torn_tail_repairs
+                reopened.close()
+    verdict["wal_torn_tail_repairs"] = wal_repairs
+    if own_tmp:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return verdict
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="ktpu seeded chaos runner")
     ap.add_argument("--seeds", default="1,7,42,1729,9000",
@@ -885,11 +1181,15 @@ def main() -> int:
                     help="skip the mid-run primary-store kill (wire schedule)")
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
-                    + ("sched-shard", "node-all", "all"),
+                    + ("sched-shard", "store-shard", "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
-                         "steal), node-all (all three node modes), or all")
+                         "steal), store-shard (sharded store, one shard "
+                         "primary killed mid-storm -> standby failover), "
+                         "node-all (all three node modes), or all")
+    ap.add_argument("--store-shards", type=int, default=2,
+                    help="store-shard schedule: shard count")
     ap.add_argument("--recovery-bound", type=float, default=60.0,
                     help="node schedules: seconds from failure injection to "
                          "gang re-running")
@@ -900,7 +1200,8 @@ def main() -> int:
     elif args.schedule == "node-all":
         schedules = list(NODE_MODES)
     elif args.schedule == "all":
-        schedules = ["wire"] + list(NODE_MODES) + ["sched-shard"]
+        schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
+                                                   "store-shard"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -917,6 +1218,10 @@ def main() -> int:
                 v = run_sched_shard_schedule(
                     seed, duration=args.duration, spec=args.spec,
                     recovery_bound=args.recovery_bound)
+            elif schedule == "store-shard":
+                v = run_store_shard_schedule(
+                    seed, duration=args.duration, spec=args.spec,
+                    writers=args.writers, shards=args.store_shards)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

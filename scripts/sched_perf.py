@@ -28,10 +28,20 @@ from kubernetes1_tpu.utils.benchstamp import contention_stamp  # noqa: E402
 from tests.helpers import make_node, make_tpu_pod  # noqa: E402
 
 
+def rotated(urls, k):
+    """Comma server-list starting at k%len — every client keeps the full
+    failover set, but the load spreads across apiserver peers instead of
+    piling every connection on peer 0."""
+    i = k % len(urls)
+    return ",".join(urls[i:] + urls[:i])
+
+
 def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                    creators: int = 4, multiproc: bool = False,
                    sched_shards: int = 1, wire_codec: str = "json",
-                   store_proc: bool = False) -> dict:
+                   store_proc: bool = False, store_shards: int = 1,
+                   apiservers: int = 1, bind_codec: str = "json",
+                   store_wal: bool = False) -> dict:
     """multiproc=True runs apiserver and scheduler as separate OS processes
     (the deployment shape) so they get real parallelism; in-process mode
     shares one GIL across every component, which caps the measurable
@@ -42,7 +52,15 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
     (the deployment shape — lease steal included), static shard ownership
     in-process.  wire_codec != "json" (multiproc only) runs the store as
     its OWN process and dials it with the negotiated binary framing, so
-    the store<->apiserver wire is real and the codec axis measurable."""
+    the store<->apiserver wire is real and the codec axis measurable.
+
+    store_shards=N (multiproc only) runs N store SHARD processes
+    (stride-encoded revisions, per-shard WAL/commit queue — storage/
+    shardmap.py) behind every apiserver; apiservers=M runs M stateless
+    apiserver processes over the shard set, with every client's server
+    list rotated so the load spreads instead of piling on peer 0.
+    bind_codec="pybin1" ships the schedulers' bindings:batch bodies as
+    one codec payload per request (--bind-codec)."""
     pods = pods or nodes * 30
     if pods > nodes * tpus_per_node:
         raise ValueError("pods exceed cluster chip capacity")
@@ -52,6 +70,10 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
         raise ValueError(
             "--wire-codec/--store-proc require --multiproc (the in-process "
             "store has no wire; the codec axis would be a lie in the JSON)")
+    if (store_shards > 1 or apiservers > 1) and not multiproc:
+        raise ValueError(
+            "--store-shards/--apiservers require --multiproc (shard and "
+            "apiserver processes are the deployment shape being measured)")
     # contention stamp BEFORE the run: the bench itself saturates the box
     # by design, so an end-of-run loadavg would flag every run as dirty.
     # Numbers from an already-loaded box are noise (22x p99 swing observed
@@ -70,56 +92,91 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
     procs = []
     scheds = []
     metrics_urls = []
+    store_metrics_urls = []
+    api_urls = []
     sched_shards = max(1, int(sched_shards))
+    store_shards = max(1, int(store_shards))
+    apiservers = max(1, int(apiservers))
     if multiproc:
-        port = free_port()
-        url = f"http://127.0.0.1:{port}"
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        api_args = [sys.executable, "-m", "kubernetes1_tpu.apiserver",
-                    "--port", str(port)]
-        if wire_codec != "json" or store_proc:
-            # a real store<->apiserver wire: store in its own process,
-            # negotiated binary framing on the link (store_proc=True with
-            # codec json isolates the CODEC axis: same topology, legacy
-            # framing)
-            store_sock = os.path.join(
-                tempfile.mkdtemp(prefix="ktpu-sched-perf-"), "store.sock")
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "kubernetes1_tpu.storage",
-                 "--socket", store_sock],
-                cwd=repo, env=env, stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL))
+        store_addr = ""
+        need_store_proc = (wire_codec != "json" or store_proc
+                           or store_shards > 1 or apiservers > 1)
+        if need_store_proc:
+            # a real store<->apiserver wire: the store (or each store
+            # SHARD) in its own process, negotiated binary framing on the
+            # link (store_proc=True with codec json isolates the CODEC
+            # axis: same topology, legacy framing).  Shards get stride-
+            # encoded revisions and their own /metrics for the per-shard
+            # store_shards block.
+            tmp = tempfile.mkdtemp(prefix="ktpu-sched-perf-")
+            socks = []
+            for i in range(store_shards):
+                sock = os.path.join(tmp, f"store-{i}.sock")
+                sport = free_port()
+                store_args = [sys.executable, "-m", "kubernetes1_tpu.storage",
+                              "--socket", sock,
+                              "--metrics-port", str(sport)]
+                if store_wal:
+                    # durable stores: each shard pays its own WAL fsync
+                    # stream — the serial structure sharding splits; a
+                    # WAL-less store under-states what shards buy
+                    store_args += ["--wal", os.path.join(tmp, f"s{i}.wal")]
+                if store_shards > 1:
+                    store_args += ["--shard-index", str(i),
+                                   "--shard-count", str(store_shards)]
+                procs.append(subprocess.Popen(
+                    store_args, cwd=repo, env=env,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+                socks.append(sock)
+                store_metrics_urls.append(f"http://127.0.0.1:{sport}")
             deadline = time.time() + 15
-            while time.time() < deadline and not os.path.exists(store_sock):
+            while time.time() < deadline and \
+                    not all(os.path.exists(s) for s in socks):
                 time.sleep(0.05)
-            api_args += ["--store-address", store_sock,
-                         "--wire-codec", wire_codec]
-        procs.append(subprocess.Popen(
-            api_args, cwd=repo, env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
-        deadline = time.time() + 15
+            store_addr = ";".join(socks)
+        for a in range(apiservers):
+            port = free_port()
+            api_args = [sys.executable, "-m", "kubernetes1_tpu.apiserver",
+                        "--port", str(port)]
+            if store_addr:
+                api_args += ["--store-address", store_addr,
+                             "--wire-codec", wire_codec]
+            procs.append(subprocess.Popen(
+                api_args, cwd=repo, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            api_urls.append(f"http://127.0.0.1:{port}")
+        url = ",".join(api_urls)
+        for a, u in enumerate(api_urls):
+            probe = Clientset(u)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    probe.api.request("GET", "/healthz")
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.1)
+            probe.close()
         cs = Clientset(url)
-        while time.time() < deadline:
-            try:
-                cs.api.request("GET", "/healthz")
-                break
-            except Exception:  # noqa: BLE001
-                time.sleep(0.1)
         for k in range(sched_shards):
             mport = free_port()
             metrics_urls.append(f"http://127.0.0.1:{mport}")
             sched_args = [sys.executable, "-m", "kubernetes1_tpu.scheduler",
-                          "--server", url, "--metrics-port", str(mport),
+                          "--server", rotated(api_urls, k),
+                          "--metrics-port", str(mport),
                           "--identity", f"sched-{k}"]
             if sched_shards > 1:
                 sched_args += ["--shards", str(sched_shards)]
+            if bind_codec != "json":
+                sched_args += ["--bind-codec", bind_codec]
             procs.append(subprocess.Popen(
                 sched_args, cwd=repo, env=env,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
     else:
         master = Master().start()
         url = master.url
+        api_urls = [url]
         cs = Clientset(url)
         if sched_shards > 1:
             # in-process sharding: static ownership (one instance per
@@ -132,7 +189,11 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
     try:
         return _drive(nodes, pods, tpus_per_node, creators, multiproc,
                       url, cs, master if not multiproc else None, scheds,
-                      metrics_urls, stamp, sched_shards, wire_codec)
+                      metrics_urls, stamp, sched_shards, wire_codec,
+                      api_urls=api_urls,
+                      store_metrics_urls=store_metrics_urls,
+                      store_shards=store_shards, apiservers=apiservers,
+                      bind_codec=bind_codec, store_wal=store_wal)
     finally:
         # child processes must never outlive the run (a leaked apiserver/
         # scheduler would skew every later bench phase)
@@ -184,7 +245,10 @@ def merge_metrics(dicts):
 
 def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
            scheds, metrics_urls=None, stamp=None, sched_shards=1,
-           wire_codec="json") -> dict:
+           wire_codec="json", api_urls=None, store_metrics_urls=None,
+           store_shards=1, apiservers=1, bind_codec="json",
+           store_wal=False) -> dict:
+    api_urls = api_urls or [url]
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
         node = make_node(f"perf-{i}", cpu="64", memory="256Gi",
@@ -223,7 +287,9 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
     t0 = time.perf_counter()
 
     def creator(start_idx):
-        ccs = Clientset(url)
+        # rotated server list: creator k prefers apiserver k%M, keeping
+        # the full set as failover — the create storm spreads
+        ccs = Clientset(rotated(api_urls, start_idx))
         for i in range(start_idx, pods, creators):
             pod = make_tpu_pod(f"p-{i}", tpus=1)
             ccs.pods.create(pod)
@@ -344,9 +410,11 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         v = mx.get(name)
         return round(v, 4) if v is not None else None
 
-    # read-path economics off the APISERVER's /metrics (the watch cache +
-    # once-per-revision serialization layer this burst leans on)
-    amx = scrape_metrics(url)
+    # read-path economics off EVERY apiserver's /metrics, merged the same
+    # way the schedulers' are (counters sum, gauges/quantiles max): with
+    # apiservers > 1 a single-URL scrape silently reported peer 0 only —
+    # the same bug the per-shard store counters had before the merge
+    amx = merge_metrics([scrape_metrics(u) for u in api_urls])
     read_path = {
         "encode_cache_hit_ratio": amx.get("ktpu_encode_cache_hit_ratio"),
         "encode_cache_hits": amx.get("ktpu_encode_cache_hits_total"),
@@ -400,6 +468,34 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         else sum(int(s._bind_conflicts_ctr.value) for s in scheds)
         if scheds else None)
 
+    # store_shards block (BENCH_r07+): per-shard write-path economics
+    # scraped off each shard PROCESS's own /metrics — the partition's
+    # commit-batch distribution, group-commit occupancy, and the WAL
+    # fsync tail each shard actually pays.  Counters are summed into the
+    # totals; per_shard keeps the partition honest (one hot shard hides
+    # inside an aggregate).
+    store_shards_block = None
+    if store_metrics_urls:
+        per_shard = []
+        for u in store_metrics_urls:
+            smx = scrape_metrics(u)
+            c = smx.get("ktpu_store_commits_total")
+            b = smx.get("ktpu_store_commit_batches_total")
+            per_shard.append({
+                "shard": int(smx.get("ktpu_store_shard_index", len(per_shard))),
+                "commits": c,
+                "commit_batches": b,
+                "occupancy": round(c / b, 3) if c and b else None,
+                "wal_fsync_p99_s": smx.get("ktpu_store_wal_fsync_p99_seconds"),
+            })
+        totals = [p["commits"] for p in per_shard if p["commits"]]
+        store_shards_block = {
+            "shards": store_shards,
+            "wal": store_wal,
+            "commits_total": sum(totals) if totals else None,
+            "per_shard": per_shard,
+        }
+
     result = {
         "nodes": nodes,
         "pods_requested": pods,
@@ -415,6 +511,9 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "multiproc": multiproc,
         "sched_shards": sched_shards,
         "wire_codec": wire_codec,
+        "bind_codec": bind_codec,
+        "apiservers": apiservers,
+        "store_shards": store_shards_block or {"shards": store_shards},
         "bind_device_conflicts": bind_conflicts,
         "read_path": read_path,
         "write_path": write_path,
@@ -522,12 +621,31 @@ def main():
                     help="run the store as its own process even with the "
                          "json codec (isolates the codec axis: same "
                          "topology, legacy newline-JSON framing)")
+    ap.add_argument("--store-shards", type=int, default=1,
+                    help="N store SHARD processes (stride revisions, "
+                         "per-shard WAL/commit queue; multiproc only) — "
+                         "the sharded-store scaling axis")
+    ap.add_argument("--apiservers", type=int, default=1,
+                    help="M stateless apiserver processes over the store "
+                         "(shard) set, client server-lists rotated "
+                         "(multiproc only)")
+    ap.add_argument("--bind-codec", default="json",
+                    help="bindings:batch body codec for the schedulers "
+                         "(json | pybin1)")
+    ap.add_argument("--store-wal", action="store_true",
+                    help="give each store (shard) process a WAL — the "
+                         "deployment's durable shape; each shard then "
+                         "pays (and parallelizes) its own fsync stream")
     args = ap.parse_args()
     print(json.dumps(run_sched_perf(args.nodes, args.pods, args.tpus_per_node,
                                     args.creators, args.multiproc,
                                     sched_shards=args.sched_shards,
                                     wire_codec=args.wire_codec,
-                                    store_proc=args.store_proc)))
+                                    store_proc=args.store_proc,
+                                    store_shards=args.store_shards,
+                                    apiservers=args.apiservers,
+                                    bind_codec=args.bind_codec,
+                                    store_wal=args.store_wal)))
 
 
 if __name__ == "__main__":

@@ -358,3 +358,35 @@ def _running_web_pods(cs):
                    and not p.metadata.deletion_timestamp)
     except Exception:  # noqa: BLE001
         return 0
+
+
+@pytest.mark.slow
+class TestStoreShardSchedules:
+    """Sharded-store failure domain (scripts/chaos.py
+    run_store_shard_schedule): N store shards, each a durable
+    primary+standby pair with its own WAL and stride revisions, one
+    Master over the shard set on store.shard.* faultline sites, and ONE
+    shard primary killed mid-storm.  The standing invariants must hold
+    per shard: zero acked writes lost across the shard failover, strict
+    PER-SHARD revision order (primary fan-out, standby, and per-shard
+    within the merged cacher stream), informer lossless convergence over
+    the merged multi-shard watch, bounded recovery, zero unprotected
+    acks."""
+
+    @pytest.mark.thread_leak_ok  # full sharded topology per seed
+    @pytest.mark.parametrize("seed", [7, 1729])
+    def test_shard_primary_kill_schedule(self, seed, tmp_path):
+        from scripts.chaos import run_store_shard_schedule
+
+        v = run_store_shard_schedule(seed, duration=5.0,
+                                     tmpdir=str(tmp_path))
+        assert v["ok"], v
+        assert v["lost"] == [], f"acknowledged writes lost: {v['lost']}"
+        assert v["revision_order_ok"]
+        assert v["informer_converged"]
+        assert v["standby_promoted"]
+        assert v["unprotected_acks"] == 0
+        assert v["recovery_s"] < 30.0, v
+        # the schedule exercised the shard link's own fault sites
+        assert v["injected"].get("store.shard.rpc") or \
+            v["injected"].get("store.shard.watch"), v["injected"]

@@ -1,0 +1,626 @@
+"""Sharded store + multi-apiserver scale-out (storage/shardmap.py).
+
+Covers the revision contract (stride-encoded per-shard revisions,
+composite resourceVersions, bookmark resume), the ShardedStore /
+ShardedCacher facades (routing, cross-shard LIST merge, merged
+multi-shard watch with strict PER-SHARD order under concurrent
+group commits), the shards=1 byte-identical equivalence, informer
+relist convergence when one shard 410-evicts, N apiservers over one
+shard set, and the bindings:batch body-codec fast path.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.machinery import Conflict, TooOldResourceVersion
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import (
+    Cacher,
+    ShardMap,
+    ShardedCacher,
+    ShardedStore,
+    Store,
+    build_sharded_store,
+    format_rv,
+    parse_rv,
+    parse_shard_addresses,
+)
+
+
+def _cm(name, ns="default", **data):
+    cm = t.ConfigMap(data={k: str(v) for k, v in data.items()})
+    cm.metadata.name = name
+    cm.metadata.namespace = ns
+    return cm
+
+
+def _key(name, ns="default"):
+    return f"/registry/configmaps/{ns}/{name}"
+
+
+def _rev(obj) -> int:
+    return int(obj.metadata.resource_version)
+
+
+class TestShardMapAndRv:
+    def test_shard_of_key_deterministic_and_in_range(self):
+        m = ShardMap(4)
+        keys = [_key(f"x{i}") for i in range(200)]
+        shards = [m.shard_of_key(k) for k in keys]
+        assert shards == [m.shard_of_key(k) for k in keys]
+        assert set(shards) <= set(range(4))
+        # a 200-key spray should touch every shard (crc32 spreads)
+        assert len(set(shards)) == 4
+
+    def test_single_shard_short_circuits(self):
+        m = ShardMap(1)
+        assert m.shard_of_key("/registry/pods/default/x") == 0
+
+    def test_rv_round_trip(self):
+        assert parse_rv("17") == 17
+        assert parse_rv("") == 0
+        assert parse_rv(None) == 0
+        assert parse_rv(42) == 42
+        assert parse_rv("3.17.22") == (3, 17, 22)
+        assert format_rv([3, 17, 22]) == "3.17.22"
+        assert parse_rv(format_rv([5])) == 5  # 1 shard collapses to int
+        with pytest.raises(ValueError):
+            parse_rv("abc")
+
+    def test_parse_shard_addresses(self):
+        assert parse_shard_addresses("a.sock") == ["a.sock"]
+        assert parse_shard_addresses("a,b; c,d ;e") == ["a,b", "c,d", "e"]
+
+
+class TestStrideRevisions:
+    def test_default_sequence_unchanged(self):
+        st = Store(global_scheme.copy())
+        revs = [_rev(st.create(_key(f"a{i}"), _cm(f"a{i}")))
+                for i in range(3)]
+        assert revs == [1, 2, 3]
+        st.close()
+
+    def test_stride_residue_class(self):
+        for i in range(3):
+            st = Store(global_scheme.copy(), rev_offset=i, rev_stride=3)
+            revs = [_rev(st.create(_key(f"b{k}"), _cm(f"b{k}")))
+                    for k in range(4)]
+            assert revs == [i + 3, i + 6, i + 9, i + 12]
+            assert all(r % 3 == i for r in revs)
+            st.close()
+
+    def test_bad_offset_rejected(self):
+        with pytest.raises(ValueError):
+            Store(global_scheme.copy(), rev_offset=3, rev_stride=3)
+        with pytest.raises(ValueError):
+            Store(global_scheme.copy(), rev_offset=-1, rev_stride=2)
+
+    def test_wal_replay_keeps_residue(self, tmp_path):
+        wal = str(tmp_path / "s1.wal")
+        st = Store(global_scheme.copy(), wal_path=wal,
+                   rev_offset=1, rev_stride=2)
+        st.create(_key("w0"), _cm("w0"))
+        st.create(_key("w1"), _cm("w1"))
+        st.close()
+        re = Store(global_scheme.copy(), wal_path=wal,
+                   rev_offset=1, rev_stride=2)
+        assert re.current_revision() == 5  # 3 then 5
+        assert _rev(re.create(_key("w2"), _cm("w2"))) == 7  # stride continues
+        re.close()
+
+
+class TestShardedStoreOps:
+    def setup_method(self):
+        self.st = build_sharded_store(global_scheme.copy, 3)
+
+    def teardown_method(self):
+        self.st.close()
+
+    def _fill(self, n=12):
+        return {f"c{i}": self.st.create(_key(f"c{i}"), _cm(f"c{i}", i=i))
+                for i in range(n)}
+
+    def test_crud_routes_and_unique_revs(self):
+        objs = self._fill()
+        revs = sorted(_rev(o) for o in objs.values())
+        assert len(set(revs)) == len(revs)  # globally unique
+        got = self.st.get(_key("c3"))
+        assert got.data["i"] == "3"
+        got.data["i"] = "33"
+        updated = self.st.update_cas(_key("c3"), got)
+        assert self.st.get(_key("c3")).data["i"] == "33"
+        assert _rev(updated) % 3 == self.st.map.shard_of_key(_key("c3"))
+        self.st.delete(_key("c3"))
+        assert self.st.get_or_none(_key("c3")) is None
+
+    def test_list_merge_sorted_with_composite_rv(self):
+        self._fill()
+        entries, rv = self.st.list_raw("/registry/configmaps/")
+        keys = [k for k, _r, _o in entries]
+        assert keys == sorted(keys) and len(keys) == 12
+        parts = parse_rv(rv)
+        assert isinstance(parts, tuple) and len(parts) == 3
+        for i, p in enumerate(parts):
+            assert p % 3 == i  # each part is its own shard's revision
+        objs, rv2 = self.st.list("/registry/configmaps/")
+        assert len(objs) == 12 and rv2 == rv
+
+    def test_get_raw_many_preserves_order(self):
+        self._fill()
+        keys = [_key("c5"), _key("missing"), _key("c0"), _key("c11")]
+        raws = self.st.get_raw_many(keys)
+        assert raws[1] is None
+        assert raws[0]["data"]["i"] == "5"
+        assert raws[2]["data"]["i"] == "0"
+        assert raws[3]["data"]["i"] == "11"
+
+    def test_commit_batch_cross_shard_outcomes(self):
+        objs = self._fill(6)
+        scheme = global_scheme.copy()
+        ops = []
+        for i in range(6):
+            enc = scheme.encode(objs[f"c{i}"])
+            enc["data"]["i"] = str(100 + i)
+            ops.append({"op": "update_cas", "key": _key(f"c{i}"),
+                        "obj": enc,
+                        "expect_rv": objs[f"c{i}"].metadata.resource_version})
+        # one doomed op: stale rv -> per-op Conflict, neighbors commit
+        ops[2]["expect_rv"] = "999999"
+        outs = self.st.commit_batch(ops)
+        assert len(outs) == 6
+        assert isinstance(outs[2]["error"], Conflict)
+        for i in (0, 1, 3, 4, 5):
+            assert outs[i]["obj"]["data"]["i"] == str(100 + i)
+        assert self.st.get(_key("c2")).data["i"] == "2"  # untouched
+
+    def test_guaranteed_update_routes(self):
+        self._fill(3)
+
+        def bump(cur):
+            cur.data["i"] = "bumped"
+            return cur
+
+        self.st.guaranteed_update(_key("c1"), bump)
+        assert self.st.get(_key("c1")).data["i"] == "bumped"
+
+
+class TestMergedWatch:
+    def setup_method(self):
+        self.st = build_sharded_store(global_scheme.copy, 3)
+
+    def teardown_method(self):
+        self.st.close()
+
+    def test_per_shard_order_under_concurrent_commits(self):
+        w = self.st.watch("/registry/")
+        stop = threading.Event()
+
+        def writer(wid):
+            for i in range(40):
+                self.st.create(_key(f"t{wid}-{i}"), _cm(f"t{wid}-{i}"))
+
+        threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        stop.set()
+        seen = []
+        while len(seen) < 160:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None, f"merged watch stalled at {len(seen)}"
+            seen.extend(batch)
+        last = [0, 0, 0]
+        for ev in seen:
+            rv = int(ev.object["metadata"]["resourceVersion"])
+            assert rv > last[rv % 3], "per-shard revision order violated"
+            last[rv % 3] = rv
+        w.stop()
+
+    def test_composite_resume_exact(self):
+        for i in range(9):
+            self.st.create(_key(f"r{i}"), _cm(f"r{i}"))
+        _entries, rv = self.st.list_raw("/registry/configmaps/")
+        for i in range(9, 15):
+            self.st.create(_key(f"r{i}"), _cm(f"r{i}"))
+        w = self.st.watch("/registry/", since_rev=parse_rv(rv))
+        names = set()
+        while len(names) < 6:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None, f"resume stalled at {sorted(names)}"
+            names |= {ev.object["metadata"]["name"] for ev in batch}
+        # exactly the post-list creates: no duplicates from before the rv
+        assert names == {f"r{i}" for i in range(9, 15)}
+        w.stop()
+
+    def test_replay_all_from_tiny_rev(self):
+        for i in range(8):
+            self.st.create(_key(f"p{i}"), _cm(f"p{i}"))
+        w = self.st.watch("/registry/", since_rev=1)
+        names = set()
+        while len(names) < 8:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None
+            names |= {ev.object["metadata"]["name"] for ev in batch}
+        assert names == {f"p{i}" for i in range(8)}
+        w.stop()
+
+    def test_bookmark_positions_advance(self):
+        w = self.st.watch("/registry/")
+        assert w.emit_bookmarks  # 3 shards: merged stream bookmarks
+        for i in range(6):
+            self.st.create(_key(f"bm{i}"), _cm(f"bm{i}"))
+        got = 0
+        while got < 6:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None
+            got += len(batch)
+        parts = parse_rv(w.bookmark_rv())
+        assert isinstance(parts, tuple) and len(parts) == 3
+        # resuming from the bookmark replays nothing already delivered
+        w2 = self.st.watch("/registry/", since_rev=parts)
+        assert w2.next_batch_timeout(0.3) is None
+        w.stop()
+        w2.stop()
+
+    def test_empty_shard_zero_floor_does_not_gap(self):
+        """Regression: an empty shard 0 mints composite part 0 (its
+        revisions live in the 0 residue class); resuming that part as
+        from-now gapped anything committed on shard 0 between the LIST
+        and the watch registration — part 0 must replay everything."""
+        # list while shard 0 has nothing: its part is the 0 floor
+        names, attempts = [], 0
+        while True:
+            _entries, rv = self.st.list_raw("/registry/configmaps/")
+            parts = parse_rv(rv)
+            if parts[0] == 0:
+                break
+            assert attempts == 0, "shard 0 unexpectedly non-empty"
+            break
+        assert parts[0] == 0
+        # now commit a spray; some keys land on shard 0
+        for i in range(24):
+            self.st.create(_key(f"g{i}"), _cm(f"g{i}"))
+        on_shard0 = [f"g{i}" for i in range(24)
+                     if self.st.map.shard_of_key(_key(f"g{i}")) == 0]
+        assert on_shard0, "spray never hit shard 0; widen it"
+        w = self.st.watch("/registry/", since_rev=parts)
+        got = set()
+        while len(got) < 24:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None, f"gapped at {sorted(got)}"
+            got |= {ev.object["metadata"]["name"] for ev in batch}
+        assert set(on_shard0) <= got  # nothing on shard 0 was gapped
+        w.stop()
+
+    def test_composite_arity_mismatch_410s(self):
+        with pytest.raises(TooOldResourceVersion):
+            self.st.watch("/registry/", since_rev=(1, 2))  # 2 parts, 3 shards
+
+    def test_slow_consumer_evicted_once(self):
+        w = self.st.watch("/registry/", queue_limit=8)
+        for i in range(40):
+            self.st.create(_key(f"ev{i}"), _cm(f"ev{i}"))
+        # never drained: the shared bound trips no matter which shard pushed
+        deadline = time.monotonic() + 5.0
+        while not w.evicted and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert w.evicted
+        assert self.st.watch_evictions >= 1
+
+
+class TestShardsOneEquivalence:
+    """shards=1 must stay byte-identical to the unsharded store: same
+    revision sequence, same wire frames, plain-int resourceVersions, no
+    bookmark frames."""
+
+    def _drive(self, store, cacher, scheme):
+        frames = []
+        w = cacher.watch("/registry/", since_rev=0)
+        for i in range(5):
+            cm = _cm(f"e{i}", i=i)
+            cm.metadata.uid = f"uid-e{i}"  # deterministic: frames compare
+            store.create(_key(f"e{i}"), cm)
+        store.delete(_key("e2"))
+        got = 0
+        while got < 6:
+            batch = w.next_batch_timeout(2.0)
+            assert batch is not None
+            for ev in batch:
+                frames.append(scheme.watch_frame_bytes(ev.type, ev.object))
+                got += 1
+        w.stop()
+        entries, rv = cacher.list_raw("/registry/configmaps/")
+        body = [scheme.encode_bytes(obj) for _k, _r, obj in entries]
+        return frames, body, str(rv)
+
+    def test_wire_frames_identical(self):
+        plain_scheme = global_scheme.copy()
+        plain_store = Store(plain_scheme)
+        plain_cacher = Cacher(plain_store, plain_scheme).start()
+        sh_scheme = global_scheme.copy()
+        sharded = ShardedStore([Store(sh_scheme)])
+        sh_cacher = ShardedCacher(sharded, sh_scheme).start()
+        try:
+            pf, pb, prv = self._drive(plain_store, plain_cacher, plain_scheme)
+            sf, sb, srv = self._drive(sharded, sh_cacher, sh_scheme)
+            assert pf == sf  # watch frames byte-identical
+            assert pb == sb  # list bodies byte-identical
+            assert prv == srv  # plain int rv, no composite dots
+            assert "." not in srv
+        finally:
+            plain_cacher.stop()
+            sh_cacher.stop()
+            plain_store.close()
+            sharded.close()
+
+    def test_one_shard_stream_never_bookmarks(self):
+        scheme = global_scheme.copy()
+        sharded = ShardedStore([Store(scheme)])
+        w = sharded.watch("/registry/")
+        assert not w.emit_bookmarks
+        w.stop()
+        sharded.close()
+
+    def test_master_default_path_is_plain(self):
+        from kubernetes1_tpu.apiserver import Master
+
+        m = Master().start()
+        try:
+            assert isinstance(m.store, Store)  # no facade in the default path
+            assert m.store_shards == 1
+        finally:
+            m.stop()
+
+
+@pytest.mark.thread_leak_ok  # full apiserver topology
+class TestShardedMasterE2E:
+    def test_http_list_watch_and_informer_shard_evict(self):
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset, SharedInformer
+
+        m = Master(store_shards=3).start()
+        cs = Clientset(m.url)
+        try:
+            for i in range(9):
+                cs.configmaps.create(_cm(f"m{i}", i=i), "default")
+            items, rv = cs.configmaps.list(namespace="default")
+            assert len(items) == 9
+            assert isinstance(parse_rv(rv), tuple)
+
+            inf = SharedInformer(cs.configmaps, namespace="default")
+            inf.start()
+            assert inf.wait_for_sync(10.0)
+            for i in range(9, 12):
+                cs.configmaps.create(_cm(f"m{i}", i=i), "default")
+
+            def have(n):
+                return len(inf.list()) >= n
+
+            deadline = time.monotonic() + 10
+            while not have(12) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert have(12)
+
+            # one shard 410-evicts the fan-in watcher: the merged stream
+            # must end with 410 and the informer must RELIST and converge
+            # (the cross-shard eviction contract — a stream missing one
+            # shard can never again be gap-free)
+            relists_before = inf.relists
+            evicted = 0
+            for c in m.cacher.shard_cachers:
+                with c._cond:
+                    for w in list(c._watchers):
+                        w._evict()
+                        evicted += 1
+                break  # ONE shard's cacher evicts
+            assert evicted >= 1
+            for i in range(12, 15):
+                cs.configmaps.create(_cm(f"m{i}", i=i), "default")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                names = {o.metadata.name for o in inf.list()}
+                if {f"m{i}" for i in range(15)} <= names \
+                        and inf.relists > relists_before:
+                    break
+                time.sleep(0.1)
+            names = {o.metadata.name for o in inf.list()}
+            assert {f"m{i}" for i in range(15)} <= names
+            assert inf.relists > relists_before
+            inf.stop()
+        finally:
+            cs.close()
+            m.stop()
+
+    def test_watch_stream_carries_bookmarks(self):
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset
+        from kubernetes1_tpu.client.rest import ApiClient
+
+        m = Master(store_shards=2).start()
+        cs = Clientset(m.url)
+        api = ApiClient(m.url)
+        try:
+            cs.configmaps.create(_cm("seed"), "default")
+            _items, rv = cs.configmaps.list(namespace="default")
+            seen = {"bookmarks": [], "events": []}
+            done = threading.Event()
+
+            def wl():
+                with api.watch("/api/v1/namespaces/default/configmaps",
+                               {"resourceVersion": str(rv)}) as s:
+                    for et, obj in s:
+                        if et == "BOOKMARK":
+                            seen["bookmarks"].append(
+                                obj["metadata"]["resourceVersion"])
+                        else:
+                            seen["events"].append(obj["metadata"]["name"])
+                        if len(seen["events"]) >= 3 and seen["bookmarks"]:
+                            done.set()
+                            return
+
+            th = threading.Thread(target=wl, daemon=True)
+            th.start()
+            time.sleep(0.2)
+            for i in range(3):
+                cs.configmaps.create(_cm(f"bk{i}"), "default")
+            assert done.wait(10.0), seen
+            assert seen["events"] == [f"bk{i}" for i in range(3)]
+            # bookmarks are composite resume positions for the shard set
+            assert all(isinstance(parse_rv(b), tuple)
+                       for b in seen["bookmarks"])
+        finally:
+            api.close()
+            cs.close()
+            m.stop()
+
+
+@pytest.mark.thread_leak_ok  # two apiservers + two store servers
+class TestMultiApiserver:
+    def test_two_apiservers_over_one_shard_set(self, tmp_path):
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client import Clientset, SharedInformer
+        from kubernetes1_tpu.storage.server import StoreServer
+
+        socks, servers = [], []
+        for i in range(2):
+            st = Store(global_scheme.copy(), rev_offset=i, rev_stride=2)
+            sock = str(tmp_path / f"shard{i}.sock")
+            servers.append(StoreServer(st, sock).start())
+            socks.append(sock)
+        addr = ";".join(socks)
+        a = Master(store_address=addr).start()
+        b = Master(store_address=addr).start()
+        cs_a = Clientset(a.url)
+        cs_b = Clientset(b.url)
+        inf = None
+        try:
+            assert a.store_shards == 2 and b.store_shards == 2
+            # writes through A are readable through B (store-fallback on
+            # a cache miss covers the peer-write freshness window)
+            for i in range(6):
+                cs_a.configmaps.create(_cm(f"ha{i}", i=i), "default")
+            for i in range(6):
+                got = cs_b.configmaps.get(f"ha{i}", namespace="default")
+                assert got.data["i"] == str(i)
+            items_b, rv_b = cs_b.configmaps.list(namespace="default")
+            assert len(items_b) == 6
+            assert isinstance(parse_rv(rv_b), tuple)
+            # an informer on B converges on writes through A
+            inf = SharedInformer(cs_b.configmaps, namespace="default")
+            inf.start()
+            assert inf.wait_for_sync(10.0)
+            for i in range(6, 9):
+                cs_a.configmaps.create(_cm(f"ha{i}", i=i), "default")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if {o.metadata.name for o in inf.list()} >= \
+                        {f"ha{i}" for i in range(9)}:
+                    break
+                time.sleep(0.1)
+            assert {o.metadata.name for o in inf.list()} >= \
+                {f"ha{i}" for i in range(9)}
+        finally:
+            if inf is not None:
+                inf.stop()
+            cs_a.close()
+            cs_b.close()
+            a.stop()
+            b.stop()
+            for s in servers:
+                s.stop()
+
+
+@pytest.mark.thread_leak_ok
+class TestBindBatchCodec:
+    """The scheduler→apiserver hot bind leg: bindings:batch with a
+    pre-encoded spliced JSON body (always) or a pybin1 codec payload
+    (--bind-codec), over the client's persistent connection."""
+
+    def _bound_batch(self, m, codec):
+        from kubernetes1_tpu.client import Clientset
+        from tests.helpers import make_node, make_tpu_pod
+
+        cs = Clientset(m.url, bind_codec=codec)
+        try:
+            cs.nodes.create(make_node(f"bn-{codec}", cpu="64",
+                                      memory="64Gi", tpus=8,
+                                      slice_id=f"bs-{codec}", host_index=0))
+            bindings = []
+            for i in range(4):
+                name = f"bc-{codec}-{i}"
+                cs.pods.create(make_tpu_pod(name, tpus=1))
+                b = t.Binding(
+                    target_node=f"bn-{codec}",
+                    extended_resource_assignments={
+                        f"{name}-tpu": [f"bs-{codec}-h0-tpu{i}"]})
+                b.metadata.name = name
+                b.metadata.namespace = "default"
+                bindings.append(b)
+            outcomes = cs.bind_batch("default", bindings)
+            assert outcomes == [None] * 4, outcomes
+            for i in range(4):
+                p = cs.pods.get(f"bc-{codec}-{i}")
+                assert p.spec.node_name == f"bn-{codec}"
+                assert p.spec.extended_resources[0].assigned == \
+                    [f"bs-{codec}-h0-tpu{i}"]
+        finally:
+            cs.close()
+
+    def test_json_spliced_and_pybin1_bodies(self):
+        from kubernetes1_tpu.apiserver import Master
+
+        m = Master(store_shards=2).start()
+        try:
+            self._bound_batch(m, "json")
+            self._bound_batch(m, "pybin1")
+        finally:
+            m.stop()
+
+    def test_unknown_codec_content_type_400s(self):
+        from kubernetes1_tpu.apiserver import Master
+        from kubernetes1_tpu.client.rest import ApiClient
+        from kubernetes1_tpu.machinery import ApiError
+
+        m = Master().start()
+        api = ApiClient(m.url)
+        try:
+            with pytest.raises(ApiError) as ei:
+                api.request("POST",
+                            "/api/v1/namespaces/default/configmaps",
+                            body=b"\x00\x01",
+                            content_type="application/x-ktpu-nope")
+            assert ei.value.code == 400
+        finally:
+            api.close()
+            m.stop()
+
+    def test_codec_fallback_sticks_after_400(self):
+        from kubernetes1_tpu.client import Clientset
+        from kubernetes1_tpu.machinery import ApiError
+
+        cs = Clientset("http://127.0.0.1:1", bind_codec="pybin1")
+        calls = []
+
+        def fake_request(method, path, body=None, params=None, raw=False,
+                         content_type=""):
+            calls.append(content_type)
+            if content_type:
+                err = ApiError("unsupported content type")
+                err.code = 400
+                raise err
+            return {"results": [{"status": "Success"}]}
+
+        cs.api.request = fake_request
+        b = t.Binding(target_node="n")
+        b.metadata.name = "p"
+        b.metadata.namespace = "default"
+        assert cs.bind_batch("default", [b]) == [None]
+        assert calls == ["application/x-ktpu-pybin1", ""]
+        # the fallback is sticky: no re-probe on the next batch
+        assert cs.bind_batch("default", [b]) == [None]
+        assert calls[-1] == "" and len(calls) == 3
+        cs.close()
