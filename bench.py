@@ -174,141 +174,174 @@ def bench_density():
     gang_before = job_ctrl.gang_recovery_snapshot()
     master = Master().start()
     cs = Clientset(master.url)
-    sched = Scheduler(cs)
+    sched = Scheduler(cs, metrics_port=0)
     sched.start()
     # per-phase pod-startup SLIs (created→scheduled→bound→admitted→running
     # + device_allocation): the same decomposition /metrics exports
     sli_cs = Clientset(master.url)
-    sli = StartupSLITracker(sli_cs).start()
+    sli = StartupSLITracker(sli_cs, metrics_port=0).start()
+    # fleet observability plane over this phase's control plane: the
+    # collector scrapes on an interval DURING the measured run (its
+    # overhead is part of what the observability block reports) and the
+    # phase's informer-lag / relist numbers come off its merged
+    # /metrics in one pass.  Hollow kubelets are deliberately NOT
+    # registered — N scrape threads against N hollow nodes would
+    # measure the bench harness, not the control plane.
+    from kubernetes1_tpu.obs import ObsCollector
 
-    kubelets, plugins, clients = [], [], []
-    for i in range(NODES):
-        plugin_dir = os.path.join(tmp, f"node-{i}")
-        impl = TPUDevicePlugin(devices=_fake_devices(f"v5e:{CHIPS_PER_NODE}:s{i}:0"))
-        plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
-        plugin.start()
-        plugins.append(plugin)
-        kcs = Clientset(master.url)
-        clients.append(kcs)
-        kl = Kubelet(kcs, node_name=f"hollow-{i}", runtime=FakeRuntime(),
-                     plugin_dir=plugin_dir, heartbeat_interval=2.0,
-                     sync_interval=0.2, pleg_interval=0.2)
-        kl.start()
-        kubelets.append(kl)
+    obs = ObsCollector(interval=1.0)
+    obs.register("apiserver", master.url, instance="apiserver-0")
+    if sched.metrics_server is not None:
+        obs.register("scheduler", sched.metrics_server.url,
+                     instance="sched-0")
+    if sli.metrics_server is not None:
+        obs.register("sli", sli.metrics_server.url, instance="sli-0")
+    obs.start()
+    bench_t0 = time.perf_counter()
+    # obs threads must die with the phase even when it raises
+    try:
 
-    # wait for all nodes Ready with chips advertised
-    deadline = time.time() + 60
-    while time.time() < deadline:
-        nodes, _ = cs.nodes.list()
-        ready = [n for n in nodes
-                 if n.status.extended_resources.get("google.com/tpu")]
-        if len(ready) == NODES:
-            break
-        time.sleep(0.2)
-    else:
-        raise RuntimeError("nodes never became ready")
+        kubelets, plugins, clients = [], [], []
+        for i in range(NODES):
+            plugin_dir = os.path.join(tmp, f"node-{i}")
+            impl = TPUDevicePlugin(devices=_fake_devices(f"v5e:{CHIPS_PER_NODE}:s{i}:0"))
+            plugin = PluginServer(impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+            plugin.start()
+            plugins.append(plugin)
+            kcs = Clientset(master.url)
+            clients.append(kcs)
+            kl = Kubelet(kcs, node_name=f"hollow-{i}", runtime=FakeRuntime(),
+                         plugin_dir=plugin_dir, heartbeat_interval=2.0,
+                         sync_interval=0.2, pleg_interval=0.2)
+            kl.start()
+            kubelets.append(kl)
 
-    created = {}
-    t0 = time.perf_counter()
-    for i in range(PODS):
-        pod = make_tpu_pod(f"bench-{i}", tpus=1)
-        pod.spec.containers[0].command = ["sleep", "3600"]
-        cs.pods.create(pod)
-        created[pod.metadata.name] = time.perf_counter()
+        # wait for all nodes Ready with chips advertised
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            nodes, _ = cs.nodes.list()
+            ready = [n for n in nodes
+                     if n.status.extended_resources.get("google.com/tpu")]
+            if len(ready) == NODES:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("nodes never became ready")
 
-    running_at = {}
-    sched_at = {}
-    deadline = time.time() + 300
-    while len(running_at) < PODS and time.time() < deadline:
-        for p in cs.pods.list(namespace="default")[0]:
-            nm = p.metadata.name
-            if nm not in created:
-                continue
-            now = time.perf_counter()
-            if nm not in sched_at and p.spec.node_name:
-                sched_at[nm] = now
-            if nm not in running_at and p.status.phase == t.POD_RUNNING:
-                running_at[nm] = now
-        time.sleep(0.05)
+        created = {}
+        t0 = time.perf_counter()
+        for i in range(PODS):
+            pod = make_tpu_pod(f"bench-{i}", tpus=1)
+            pod.spec.containers[0].command = ["sleep", "3600"]
+            cs.pods.create(pod)
+            created[pod.metadata.name] = time.perf_counter()
 
-    n_ok = len(running_at)
-    lat = sorted(running_at[nm] - created[nm] for nm in running_at)
-    total_wall = max(running_at.values()) - t0 if running_at else float("inf")
+        running_at = {}
+        sched_at = {}
+        deadline = time.time() + 300
+        while len(running_at) < PODS and time.time() < deadline:
+            for p in cs.pods.list(namespace="default")[0]:
+                nm = p.metadata.name
+                if nm not in created:
+                    continue
+                now = time.perf_counter()
+                if nm not in sched_at and p.spec.node_name:
+                    sched_at[nm] = now
+                if nm not in running_at and p.status.phase == t.POD_RUNNING:
+                    running_at[nm] = now
+            time.sleep(0.05)
 
-    p50, p90, p99 = _pct(lat, 0.50), _pct(lat, 0.90), _pct(lat, 0.99)
-    sched_lat = sorted(sched_at[nm] - created[nm] for nm in sched_at)
-    sched_p50 = _pct(sched_lat, 0.50)
+        n_ok = len(running_at)
+        lat = sorted(running_at[nm] - created[nm] for nm in running_at)
+        total_wall = max(running_at.values()) - t0 if running_at else float("inf")
 
-    # verify every running pod actually got a distinct chip assignment,
-    # and run the device double-allocation invariant over LIVE pods (the
-    # same helper the chaos node schedules sample under fault injection)
-    from kubernetes1_tpu.scheduler.devices import find_double_allocations
+        p50, p90, p99 = _pct(lat, 0.50), _pct(lat, 0.90), _pct(lat, 0.99)
+        sched_lat = sorted(sched_at[nm] - created[nm] for nm in sched_at)
+        sched_p50 = _pct(sched_lat, 0.50)
 
-    final_pods = cs.pods.list(namespace="default")[0]
-    assigned = []
-    for p in final_pods:
-        for er in p.spec.extended_resources:
-            assigned.extend(er.assigned)
-    double_allocations = len(find_double_allocations(final_pods))
-    distinct = len(set(assigned))
+        # verify every running pod actually got a distinct chip assignment,
+        # and run the device double-allocation invariant over LIVE pods (the
+        # same helper the chaos node schedules sample under fault injection)
+        from kubernetes1_tpu.scheduler.devices import find_double_allocations
 
-    # read-path economics for this phase (BENCH_r06 delta vs r05): how
-    # often the once-per-revision serialization cache served list/watch
-    # bytes, and whether any slow watcher had to be 410-evicted
-    enc_hits, enc_misses = master.scheme.serialization_cache.stats()
-    enc_total = enc_hits + enc_misses
-    watch_evictions = (master.cacher.watch_evictions
-                       + getattr(master.store, "watch_evictions", 0))
-    # write-path economics (group commit, new in r06): batch occupancy,
-    # fan-out coalescing ratio, and the scheduler's bind batch sizes
-    st = master.store
-    fan_wakeups = st.watch_wakeups + master.cacher.watch_wakeups
-    fan_events = st.watch_events + master.cacher.watch_events
-    write_path = {
-        "store_commits": st.commit_count,
-        "store_commit_batches": st.commit_batches,
-        "store_batch_occupancy": round(
-            st.commit_count / st.commit_batches, 3)
-        if st.commit_batches else None,
-        "watch_wakeups_per_event": round(fan_wakeups / fan_events, 4)
-        if fan_events else None,
-        "bind_batch_p50": sched.bind_batch_size.quantile(0.5),
-        "bind_batch_p99": sched.bind_batch_size.quantile(0.99),
-        "bind_batches": sched.bind_batch_size.count,
-    }
-    # robustness surface (new in r06): retries every client loop took, by
-    # reason; apiserver overload shedding; WAL torn-tail repairs.  A clean
-    # unfaulted density run should show ~zero everywhere — nonzero numbers
-    # here mean the box (or a regression) injected real partial failures
-    # into the benchmark.  The chaos tier (scripts/chaos.py) exercises the
-    # same counters under seeded fault schedules, incl. standby resyncs
-    # (this single-store topology has no standby).
-    gang_now = job_ctrl.gang_recovery_snapshot()
-    robustness = {
-        "client_retries": client_retry.retries_delta(retries_before),
-        "apiserver_shed_total": master.inflight.shed_total,
-        "apiserver_peak_inflight_mutating": master.inflight.peak_mutating,
-        "wal_torn_tail_repairs": getattr(
-            master.store, "wal_torn_tail_repairs", 0),
-        # gang failure-domain surface (BENCH_r07+): counts are THIS phase's
-        # delta (the counters are process-cumulative, same contract as
-        # client_retries) — a clean density run shows zero recoveries/
-        # attempts and zero double-allocations; nonzero means real member
-        # deaths happened mid-bench.  MTTR quantiles are reported only when
-        # this phase recovered something (a cumulative quantile would leak
-        # other phases' distributions).  The chaos node schedules
-        # (scripts/chaos.py --schedule node-all) exercise the same counters
-        # under seeded node-kill / kubelet-restart / chip-death failures.
-        "gang_recovery": {
-            "recoveries": gang_now["recoveries"] - gang_before["recoveries"],
-            "mttr_p50_s": job_ctrl.gang_recovery_seconds.quantile(0.5)
-            if gang_now["recoveries"] > gang_before["recoveries"] else None,
-            "mttr_p99_s": job_ctrl.gang_recovery_seconds.quantile(0.99)
-            if gang_now["recoveries"] > gang_before["recoveries"] else None,
-            "attempts": gang_now["attempts"] - gang_before["attempts"],
-            "double_allocations": double_allocations,
-        },
-    }
+        final_pods = cs.pods.list(namespace="default")[0]
+        assigned = []
+        for p in final_pods:
+            for er in p.spec.extended_resources:
+                assigned.extend(er.assigned)
+        double_allocations = len(find_double_allocations(final_pods))
+        distinct = len(set(assigned))
+
+        # read-path economics for this phase (BENCH_r06 delta vs r05): how
+        # often the once-per-revision serialization cache served list/watch
+        # bytes, and whether any slow watcher had to be 410-evicted
+        enc_hits, enc_misses = master.scheme.serialization_cache.stats()
+        enc_total = enc_hits + enc_misses
+        watch_evictions = (master.cacher.watch_evictions
+                           + getattr(master.store, "watch_evictions", 0))
+        # write-path economics (group commit, new in r06): batch occupancy,
+        # fan-out coalescing ratio, and the scheduler's bind batch sizes
+        st = master.store
+        fan_wakeups = st.watch_wakeups + master.cacher.watch_wakeups
+        fan_events = st.watch_events + master.cacher.watch_events
+        write_path = {
+            "store_commits": st.commit_count,
+            "store_commit_batches": st.commit_batches,
+            "store_batch_occupancy": round(
+                st.commit_count / st.commit_batches, 3)
+            if st.commit_batches else None,
+            "watch_wakeups_per_event": round(fan_wakeups / fan_events, 4)
+            if fan_events else None,
+            "bind_batch_p50": sched.bind_batch_size.quantile(0.5),
+            "bind_batch_p99": sched.bind_batch_size.quantile(0.99),
+            "bind_batches": sched.bind_batch_size.count,
+        }
+        # robustness surface (new in r06): retries every client loop took, by
+        # reason; apiserver overload shedding; WAL torn-tail repairs.  A clean
+        # unfaulted density run should show ~zero everywhere — nonzero numbers
+        # here mean the box (or a regression) injected real partial failures
+        # into the benchmark.  The chaos tier (scripts/chaos.py) exercises the
+        # same counters under seeded fault schedules, incl. standby resyncs
+        # (this single-store topology has no standby).
+        gang_now = job_ctrl.gang_recovery_snapshot()
+        robustness = {
+            "client_retries": client_retry.retries_delta(retries_before),
+            "apiserver_shed_total": master.inflight.shed_total,
+            "apiserver_peak_inflight_mutating": master.inflight.peak_mutating,
+            "wal_torn_tail_repairs": getattr(
+                master.store, "wal_torn_tail_repairs", 0),
+            # gang failure-domain surface (BENCH_r07+): counts are THIS phase's
+            # delta (the counters are process-cumulative, same contract as
+            # client_retries) — a clean density run shows zero recoveries/
+            # attempts and zero double-allocations; nonzero means real member
+            # deaths happened mid-bench.  MTTR quantiles are reported only when
+            # this phase recovered something (a cumulative quantile would leak
+            # other phases' distributions).  The chaos node schedules
+            # (scripts/chaos.py --schedule node-all) exercise the same counters
+            # under seeded node-kill / kubelet-restart / chip-death failures.
+            "gang_recovery": {
+                "recoveries": gang_now["recoveries"] - gang_before["recoveries"],
+                "mttr_p50_s": job_ctrl.gang_recovery_seconds.quantile(0.5)
+                if gang_now["recoveries"] > gang_before["recoveries"] else None,
+                "mttr_p99_s": job_ctrl.gang_recovery_seconds.quantile(0.99)
+                if gang_now["recoveries"] > gang_before["recoveries"] else None,
+                "attempts": gang_now["attempts"] - gang_before["attempts"],
+                "double_allocations": double_allocations,
+            },
+        }
+
+        # observability block (one pass over the collector's fleet /metrics)
+        # + the collector's own overhead relative to this phase's wall time
+        # (the same-box A/B acceptance: scrape time <1% of the bind phase)
+        from scripts.sched_perf import observability_block
+
+        observability = observability_block(obs)
+        phase_wall = time.perf_counter() - bench_t0
+        if observability is not None and phase_wall > 0:
+            observability["collector_overhead_fraction"] = round(
+                obs.scrape_seconds_total / phase_wall, 5)
+    finally:
+        obs.stop()
 
     sli_phases = sli.report()
     sli.stop()
@@ -339,6 +372,7 @@ def bench_density():
         "watch_evictions": watch_evictions,
         "write_path": write_path,
         "robustness": robustness,
+        "observability": observability,
     }
 
 

@@ -76,6 +76,13 @@ STATIC_POD_ANNOTATION = "kubelet.ktpu.io/static"
 # pods so scheduler/kubelet spans correlate across the watch path
 # (utils/spans; the k8s Audit-ID analog made durable on the object).
 TRACE_ID_ANNOTATION = "trace.ktpu.io/trace-id"
+
+# Watch-lag SLI (obs plane): lag-stamp BOOKMARK frames carry the
+# monotonic commit timestamp(s) of the just-delivered batch under this
+# annotation, as space-separated "<shard>:<ts>" tokens — one per shard
+# the batch advanced.  Opt-in per watch (?lagStamps=1); informers parse
+# it into ktpu_informer_lag_seconds{shard=...}.
+COMMITTED_AT_ANNOTATION = "obs.ktpu.io/committed-at"
 # Pod-startup SLI phase stamps (utils/slo): wall-clock seconds as "%.6f"
 # strings, written by the component that owns each transition —
 #   created-at    apiserver, at pod admission into the registry

@@ -15,7 +15,7 @@ import string
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils import locksan
+from ..utils import flightrec, locksan
 
 from ..api import types as t
 from ..machinery import (
@@ -877,6 +877,10 @@ class Registry:
                 if self._claim_is_live(k, holder_key, holder_uid, pend):
                     with self._claims_lock:
                         self.device_claim_conflicts += 1
+                    flightrec.note(
+                        "apiserver", flightrec.DEVICE_CLAIM_CONFLICT,
+                        node=k[0], chip=k[2], loser=pod_key,
+                        holder=holder_key)
                     raise Conflict(
                         f"{t.DEVICE_CLAIM_CONFLICT}: {k[1]} chip {k[2]} "
                         f"on node {k[0]} is held by pod {holder_key}")

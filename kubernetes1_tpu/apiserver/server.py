@@ -23,7 +23,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from ..utils import fasthttp, locksan, spans as spanlib
+from ..utils import fasthttp, flightrec, locksan, spans as spanlib
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
@@ -566,6 +566,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "apiserver overloaded: too many in-flight mutating "
                 "requests; retry after the indicated backoff")
             err.retry_after = limiter.retry_after()
+            flightrec.note("apiserver", flightrec.SHED_429,
+                           method=method, path=self.path,
+                           retry_after=round(err.retry_after, 3))
             try:
                 # _send_error drains the unread request body before
                 # answering — shedding happens before any read, and the
@@ -650,6 +653,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._authz(user, "get", "debug", "", "", "")
                 if parts == ["debug", "traces"]:
                     body = self.master.spans.to_json(q.get("trace", ""))
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parts == ["debug", "flightrecorder"]:
+                    body = flightrec.to_json(q.get("component", ""))
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -992,11 +1003,52 @@ class _Handler(BaseHTTPRequestHandler):
         # resume position do (the Kubernetes watch-bookmark analog).
         # Plain streams never emit them: byte-identical wire at shards=1.
         bookmarks = getattr(w, "emit_bookmarks", False)
+        # watch-lag SLI opt-in (?lagStamps=1, informers set it): after
+        # every delivered batch, a BOOKMARK frame carries the monotonic
+        # commit stamp of the batch's newest revision PER SHARD
+        # (obs.ktpu.io/committed-at, "<shard>:<ts>" tokens) so the
+        # client can export delivered-at minus committed-at without any
+        # cross-shard clock math.  Streams that didn't ask stay
+        # byte-identical — stamps never ride the cached event frames.
+        lag_stamps = q.get("lagStamps") in ("1", "true")
+        n_shards = max(1, self.master.store_shards)
 
         def bookmark_frame() -> bytes:
             return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
                     b'"apiVersion":"v1","metadata":{"resourceVersion":"'
                     + w.bookmark_rv().encode() + b'"}}}\n')
+
+        def lag_frame(evs) -> Optional[bytes]:
+            """Lag-stamp bookmark for one delivered batch (None when no
+            stamp is available and the stream has no bookmark position
+            to refresh either)."""
+            per_shard: Dict[int, int] = {}
+            for ev in evs:
+                try:
+                    rev = int((ev.object.get("metadata") or {})
+                              .get("resourceVersion") or 0)
+                except (TypeError, ValueError, AttributeError):
+                    continue
+                if rev > per_shard.get(rev % n_shards, 0):
+                    per_shard[rev % n_shards] = rev
+            toks = []
+            for sh in sorted(per_shard):
+                ts = self.master.store.commit_ts_of(per_shard[sh])
+                if ts is not None:
+                    toks.append(f"{sh}:{ts:.6f}")
+            if not toks and not bookmarks:
+                return None
+            rv = (w.bookmark_rv() if bookmarks
+                  else str(max(per_shard.values(), default=0)))
+            meta: Dict[str, Any] = {"resourceVersion": rv}
+            if toks:
+                meta["annotations"] = {
+                    t.COMMITTED_AT_ANNOTATION: " ".join(toks)}
+            return json.dumps(
+                {"type": "BOOKMARK",
+                 "object": {"kind": "Bookmark", "apiVersion": "v1",
+                            "metadata": meta}},
+                separators=(",", ":")).encode() + b"\n"
 
         try:
             while True:
@@ -1043,7 +1095,7 @@ class _Handler(BaseHTTPRequestHandler):
                 frames = [self.master.scheme.watch_frame_bytes(
                               ev.type, ev.object, ver)
                           for ev in evs if w.event_matches(ev.object)]
-                if bookmarks:
+                if bookmarks or lag_stamps:
                     # after every delivered batch: the bookmark rides the
                     # same buffered write, so a cut can strand at most
                     # one batch's worth of single-int rv — and the
@@ -1051,7 +1103,13 @@ class _Handler(BaseHTTPRequestHandler):
                     # (duplicates are idempotent; gaps would be lost
                     # state).  Selector-filtered batches still bookmark:
                     # the position advanced even if no frame matched.
-                    frames.append(bookmark_frame())
+                    # With lagStamps the commit stamp rides the same
+                    # bookmark frame; without it the handcrafted bytes
+                    # stay exactly what PR 10 shipped.
+                    fr = (lag_frame(evs) if lag_stamps
+                          else bookmark_frame())
+                    if fr is not None:
+                        frames.append(fr)
                 self._write_chunks(frames)
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
@@ -1117,25 +1175,56 @@ class _Handler(BaseHTTPRequestHandler):
             f"ktpu_bind_device_conflicts_total "
             f"{master.registry.device_claim_conflicts}",
         ]
-        from ..client import retry as _client_retry
+        # cacher freshness-wait lag (obs plane): how long LIST/GET reads
+        # blocked for watch-cache freshness.  Sharded cachers render a
+        # per-shard p99 gauge (one hot shard must not hide in a merge);
+        # the single cacher renders its full histogram.
+        shard_cachers = getattr(master.cacher, "shard_cachers", None)
+        if shard_cachers is not None:
+            extra.append(
+                "# TYPE ktpu_cacher_freshness_wait_p99_seconds gauge")
+            for i, c in enumerate(shard_cachers):
+                p99 = c.freshness_wait_seconds.quantile(0.99)
+                extra.append(
+                    f'ktpu_cacher_freshness_wait_p99_seconds'
+                    f'{{shard="{i}"}} {p99 or 0.0}')
+        else:
+            extra.append(master.cacher.freshness_wait_seconds
+                         .render().rstrip("\n"))
+        if master.render_client_metrics:
+            from ..client import informer as _informer
+            from ..client import retry as _client_retry
 
-        # every in-process client loop (informers, controllers, kubelets
-        # in a LocalCluster) shares this counter; remote components export
-        # it from their own /metrics
-        extra.append(_client_retry.retries_total.render().rstrip("\n"))
-        # gang failure-domain surface (module-level in controllers/job.py,
-        # same aggregation contract as the retry counter): member-death ->
-        # all-members-Running MTTR + whole-gang recreate attempts
-        from ..controllers import job as _job_ctrl
+            # every in-process client loop (informers, controllers,
+            # kubelets in a LocalCluster) shares these module-level
+            # metrics; remote components export them from their own
+            # /metrics.  Exactly one Master per process renders them
+            # (render_client_metrics) so a fleet merge over co-located
+            # apiservers never double-counts.
+            extra.append(_client_retry.retries_total.render().rstrip("\n"))
+            extra.append(
+                _informer.informer_relists_total.render().rstrip("\n"))
+            extra.append(
+                _informer.informer_reconnects_total.render().rstrip("\n"))
+            extra.append(
+                _informer.informer_lag_seconds.render().rstrip("\n"))
+            # gang failure-domain surface (module-level in
+            # controllers/job.py, same aggregation contract as the retry
+            # counter): member-death -> all-members-Running MTTR +
+            # whole-gang recreate attempts
+            from ..controllers import job as _job_ctrl
 
-        extra.append(_job_ctrl.gang_recovery_seconds.render().rstrip("\n"))
-        extra.append(_job_ctrl.gang_attempts_total.render().rstrip("\n"))
+            extra.append(
+                _job_ctrl.gang_recovery_seconds.render().rstrip("\n"))
+            extra.append(
+                _job_ctrl.gang_attempts_total.render().rstrip("\n"))
         # write-path economics (in-process store only; a remote store
         # exports these from its own process): group-commit occupancy and
         # the fan-out coalescing ratio — wakeups-per-event < 1.0 means
         # watcher/replica/cacher wakeups are being amortized across
         # batched commits (the BENCH_r06 acceptance metric)
-        commits = getattr(master.store, "commit_count", None)
+        commits = (getattr(master.store, "commit_count", None)
+                   if master.render_store_metrics else None)
         if commits is not None:
             batches = master.store.commit_batches
             # client watchers hang off the CACHER in-process (the store's
@@ -1474,13 +1563,41 @@ class Master:
                                                # get 429 + Retry-After
                                                # (0 disables; reads are
                                                # never shed)
+        store=None,                            # pre-built store OBJECT
+                                               # shared by several in-
+                                               # process Masters (the
+                                               # LocalCluster apiservers=N
+                                               # shape); the caller owns
+                                               # its lifecycle — stop()
+                                               # won't close it
+        render_client_metrics: bool = True,    # render process-global
+                                               # client metrics (retries,
+                                               # informer family, gang
+                                               # counters) on /metrics —
+                                               # exactly ONE Master per
+                                               # process should, or a
+                                               # fleet merge double-counts
+        render_store_metrics: Optional[bool] = None,  # render the store's
+                                               # commit/WAL block — None =
+                                               # only when this Master
+                                               # owns the store (a shared
+                                               # store's numbers must
+                                               # appear on ONE /metrics)
     ):
         fasthttp.install()  # idempotent (see class docstring)
         # own copy: CRD registrations must not leak into the process-global
         # scheme shared by every other Master/client in this process
         self.scheme = scheme or global_scheme.copy()
-        self.store_is_remote = bool(store_address)
-        if store_address:
+        self.store_is_remote = bool(store_address) and store is None
+        self._owns_store = store is None
+        self.render_client_metrics = render_client_metrics
+        if store is not None:
+            # shared in-process store (LocalCluster multi-apiserver):
+            # this Master layers its own cacher/registry over it; the
+            # sharded facade reports its arity via .shards
+            self.store = store
+            self.store_shards = getattr(store, "shards", 1)
+        elif store_address:
             from ..storage.remote import RemoteStore
 
             # ';'-separated shard groups; within each group, comma-
@@ -1512,6 +1629,9 @@ class Master:
             self.store = Store(self.scheme, wal_path=wal_path,
                                wal_sync=wal_sync)
             self.store_shards = 1
+        self.render_store_metrics = (self._owns_store
+                                     if render_store_metrics is None
+                                     else render_store_metrics)
         self.write_coalescer = _WriteCoalescer(write_coalesce_window)
         self.inflight = _InflightLimiter(max_inflight_mutating)
         self.registry = Registry(self.store, self.scheme)
@@ -1847,4 +1967,8 @@ class Master:
         # still audit, and the final flush must include them
         if self._audit_webhook is not None:
             self._audit_webhook.stop()
-        self.store.close()
+        if self._owns_store:
+            # a shared store (Master(store=...)) outlives this apiserver:
+            # its owner — the LocalCluster — closes it once, after every
+            # Master over it has stopped
+            self.store.close()

@@ -115,6 +115,7 @@ class ResourceClient:
         label_selector: str = "",
         field_selector: str = "",
         timeout_seconds: float = 0,
+        lag_stamps: bool = False,
     ) -> WatchStream:
         params = {"resourceVersion": resource_version}
         if label_selector:
@@ -123,6 +124,12 @@ class ResourceClient:
             params["fieldSelector"] = field_selector
         if timeout_seconds:
             params["timeoutSeconds"] = str(timeout_seconds)
+        if lag_stamps:
+            # watch-lag SLI opt-in: the apiserver appends lag-stamp
+            # BOOKMARK frames (committed-at annotations) after every
+            # delivered batch; old servers ignore the param, so plain
+            # streams stay byte-identical for everyone who didn't ask
+            params["lagStamps"] = "1"
         return self.api.watch(self._path(namespace), params)
 
 

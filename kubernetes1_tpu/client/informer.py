@@ -14,13 +14,45 @@ Semantics preserved:
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
+from ..api import types as t
 from ..machinery import ApiError, TooOldResourceVersion
-from ..utils import locksan, mutsan
+from ..utils import flightrec, locksan, mutsan
+from ..utils.metrics import Counter, Histogram
 from . import retry as _retry
 from .clientset import Clientset, ResourceClient
+
+# Fleet-visible informer counters (module-level, the retries_total
+# pattern): every informer in the process bumps the labeled family, the
+# apiserver renders it on /metrics for in-process components
+# (LocalCluster) and remote component processes register it into their
+# own /metrics registry (scheduler/controllers __main__) — the
+# ObsCollector then sees relists/reconnects with zero bespoke plumbing.
+# Each SharedInformer ALSO keeps its own private counter so the
+# `relists`/`reconnects` attributes stay per-instance (tests wait on
+# THIS informer's recovery, not the process's).
+informer_relists_total = Counter(
+    "ktpu_informer_relists_total",
+    "informer full-LIST fallbacks (initial sync, stream end, 410)")
+informer_reconnects_total = Counter(
+    "ktpu_informer_reconnects_total",
+    "informer mid-stream watch re-dials (resumed from last rv)")
+
+# Watch-lag SLI: delivered-at minus committed-at per group-commit batch,
+# labeled by the OWNING SHARD (rev % stride — composite-rv-aware).  The
+# stamp rides watch-lag bookmark frames the informer opts into
+# (lagStamps); both clocks are CLOCK_MONOTONIC, comparable across
+# processes on one host.  Lag is PER-SHARD by construction: a stamp
+# names the shard whose commit it times, so no cross-shard clock math
+# ever happens.
+informer_lag_seconds = Histogram(
+    "ktpu_informer_lag_seconds",
+    "watch delivery lag (delivered-at minus committed-at) per shard",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
 
 
 class SharedInformer:
@@ -42,9 +74,12 @@ class SharedInformer:
         # observability: how often this informer had to fall back to a
         # full LIST (initial sync, watch stream end, 410-eviction
         # recovery), and how often it re-dialed a watch stream without
-        # relisting (mid-stream disconnect resumed from the last rv)
-        self.relists = 0
-        self.reconnects = 0
+        # relisting (mid-stream disconnect resumed from the last rv).
+        # utils/metrics Counters (migrated from plain ints) so the
+        # module-level family and these per-instance views share one
+        # implementation; `relists`/`reconnects` stay readable as ints.
+        self._relists_ctr = Counter("ktpu_informer_relists_total")
+        self._reconnects_ctr = Counter("ktpu_informer_reconnects_total")
         # unified retry policy: capped full-jitter backoff between relist
         # attempts, reset whenever a relist succeeds (client/retry.py)
         self._backoff = _retry.Backoff(base=0.2, factor=2.0, cap=2.0)
@@ -75,6 +110,16 @@ class SharedInformer:
         ws = self._watch_stream
         if ws is not None:
             ws.close()
+
+    @property
+    def relists(self) -> int:
+        """This informer's full-LIST count (int view of the counter —
+        kept as an attribute for every existing consumer)."""
+        return int(self._relists_ctr.value)
+
+    @property
+    def reconnects(self) -> int:
+        return int(self._reconnects_ctr.value)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
@@ -122,6 +167,26 @@ class SharedInformer:
 
     # ---------------------------------------------------------------- loops
 
+    @staticmethod
+    def _observe_lag(bookmark_meta: Dict[str, Any]):
+        """Watch-lag SLI: a lag-stamp bookmark's annotations carry
+        ``"<shard>:<monotonic commit ts>"`` tokens for every shard the
+        just-delivered batch advanced; lag = now minus that shard's
+        stamp.  Per-shard by construction — each token times ONE shard's
+        own commit clock, so composite streams never mix shard clocks."""
+        stamp = ((bookmark_meta.get("annotations") or {})
+                 .get(t.COMMITTED_AT_ANNOTATION))
+        if not stamp:
+            return
+        now = time.monotonic()
+        for tok in stamp.split():
+            shard, _, ts_s = tok.partition(":")
+            try:
+                lag = now - float(ts_s)
+            except ValueError:
+                continue
+            informer_lag_seconds.labels(shard=shard).observe(max(0.0, lag))
+
     def _dispatch(self, kind: str, *args):
         for h in self._handlers:
             fn = h.get(kind)
@@ -142,7 +207,10 @@ class SharedInformer:
         with self._lock:
             old = self._cache
             self._cache = fresh
-            self.relists += 1
+        self._relists_ctr.inc()
+        informer_relists_total.labels(resource=self.client.resource).inc()
+        flightrec.note("informer", flightrec.INFORMER_RELIST,
+                       resource=self.client.resource)
         for key, obj in fresh.items():
             if key in old:
                 self._dispatch("update", old[key], obj)
@@ -192,6 +260,7 @@ class SharedInformer:
                     resource_version=rv,
                     label_selector=self.label_selector,
                     field_selector=self.field_selector,
+                    lag_stamps=True,
                 )
             except TooOldResourceVersion:
                 return  # relist
@@ -211,7 +280,11 @@ class SharedInformer:
             if not first_stream:
                 # a re-dial after a mid-stream disconnect, resumed from
                 # the last delivered rv — no relist needed, no event lost
-                self.reconnects += 1
+                self._reconnects_ctr.inc()
+                informer_reconnects_total.labels(
+                    resource=self.client.resource).inc()
+                flightrec.note("informer", flightrec.WATCH_RECONNECT,
+                               resource=self.client.resource)
                 _retry.note_retry("watch_reconnect")
             first_stream = False
             self._watch_stream = stream
@@ -234,8 +307,9 @@ class SharedInformer:
                     if self._stop.is_set():
                         return
                     if ev_type == "BOOKMARK":
-                        rv = ((obj_dict.get("metadata") or {})
-                              .get("resourceVersion")) or rv
+                        meta = obj_dict.get("metadata") or {}
+                        rv = meta.get("resourceVersion") or rv
+                        self._observe_lag(meta)
                         continue
                     obj = self._shared(self.client.scheme.decode(obj_dict))
                     if "." not in str(rv):

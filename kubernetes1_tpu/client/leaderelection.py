@@ -27,6 +27,7 @@ from typing import Callable, Dict, FrozenSet, Optional
 from ..api import types as t
 from ..machinery.errors import AlreadyExists, ApiError, Conflict, NotFound
 from ..machinery.meta import now_iso_micro, parse_iso
+from ..utils import flightrec
 from .clientset import Clientset
 
 
@@ -370,16 +371,26 @@ class LeaseSet:
                     # shed: the rendezvous winner is a live peer — hand
                     # the shard over so a joining instance gets its share
                     self._release_shard(lease)
+                    flightrec.note("scheduler", flightrec.LEASE_SHED,
+                                   shard=shard, identity=self.identity,
+                                   to=winner)
                     continue
                 if self._renew(lease):
                     next_owned.add(shard)
                 continue
             if not expired:
                 continue  # live peer holds it: hot-standby
+            stolen_from = (lease.holder_identity
+                           if lease is not None else "")
             if winner == self.identity:
                 if self._try_take(shard, lease):
                     next_owned.add(shard)
                     self._unheld_since.pop(shard, None)
+                    if stolen_from and stolen_from != self.identity:
+                        flightrec.note(
+                            "scheduler", flightrec.LEASE_STEAL,
+                            shard=shard, identity=self.identity,
+                            from_=stolen_from)
             elif now - self._unheld_since.get(shard, now) \
                     > self.lease_duration:
                 # availability net: the designated winner never claimed
@@ -388,6 +399,10 @@ class LeaseSet:
                 if self._try_take(shard, lease):
                     next_owned.add(shard)
                     self._unheld_since.pop(shard, None)
+                    flightrec.note(
+                        "scheduler", flightrec.LEASE_STEAL,
+                        shard=shard, identity=self.identity,
+                        from_=stolen_from or "(orphan)")
         self._apply_ownership(frozenset(next_owned))
 
     def _apply_ownership(self, next_owned: FrozenSet[int]):

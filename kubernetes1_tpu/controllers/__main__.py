@@ -61,6 +61,17 @@ def main():
         reg.register(cm.node_lifecycle.evictions_total)
         reg.register(cm.node_lifecycle.errors_total)
         reg.register(cm.node_lifecycle.not_ready_total)
+        # process-entrypoint registration (see scheduler/__main__): a
+        # controller-manager PROCESS exports the informer/retry families
+        # its control loops bump; in-process deployments leave this to
+        # the apiserver's render
+        from ..client import informer as _informer
+        from ..client import retry as _retry
+
+        reg.register(_retry.retries_total)
+        reg.register(_informer.informer_relists_total)
+        reg.register(_informer.informer_reconnects_total)
+        reg.register(_informer.informer_lag_seconds)
         try:
             metrics_server = MetricsServer(reg, port=args.metrics_port).start()
             print(f"controller manager metrics on {metrics_server.url}",

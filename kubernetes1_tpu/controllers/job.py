@@ -53,6 +53,7 @@ from ..deviceplugin.tpu_plugin import (
 from ..machinery import AlreadyExists, ApiError, NotFound, now_iso
 from ..machinery.labels import label_selector_matches
 from ..machinery.scheme import from_dict, to_dict
+from ..utils import flightrec
 from ..utils.metrics import Counter, Histogram
 from .base import Controller, write_status_if_changed
 
@@ -484,6 +485,9 @@ class JobController(Controller):
             self.enqueue_after(key, 0.5)
             return
         gang_attempts_total.inc()
+        flightrec.note("job-controller", flightrec.GANG_ATTEMPT,
+                       job=job.metadata.name, attempt=nxt, why=why,
+                       backoff_s=round(delay, 2))
         self.recorder.event(
             job, "Normal", "GangRecreate",
             f"recreating gang as attempt {nxt} after {delay:.1f}s backoff")
@@ -495,6 +499,9 @@ class JobController(Controller):
         """Grace-0 delete through the shared retry policy: gang teardown
         must finalize members on DEAD nodes too — no kubelet will ever
         acknowledge a graceful delete there."""
+        flightrec.note("job-controller", flightrec.GANG_TEARDOWN,
+                       pod=pod.metadata.name,
+                       gang=pod.spec.scheduling_gang or "")
         try:
             _retry.call_with_retries(
                 lambda: self.cs.pods.delete(

@@ -135,7 +135,7 @@ class DeviceManager:
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self.allocation_latency = Histogram(
-            "device_plugin_allocation_seconds",
+            "ktpu_device_plugin_allocation_seconds",
             "AdmitPod RPC latency (the fork's DevicePluginAllocationLatency)",
         )
         self.on_capacity_change = None  # callback for node-status push

@@ -212,6 +212,11 @@ class _KubeletHandler(BaseHTTPRequestHandler):
             elif parts == ["debug", "traces"]:
                 self._send(200, kl.spans.to_json(q.get("trace", "")),
                            content_type="application/json")
+            elif parts == ["debug", "flightrecorder"]:
+                from ..utils import flightrec
+
+                self._send(200, flightrec.to_json(q.get("component", "")),
+                           content_type="application/json")
             elif parts == ["pods"]:
                 self._send(200, {"pods": sorted(p.key() for p in kl.pods.list())})
             elif parts and parts[0] == "containerLogs" and len(parts) >= 3:

@@ -6,13 +6,29 @@ e2e tests, and bench.py: an HTTP apiserver over the MVCC store, the
 device-aware scheduler, the controller manager, and N kubelets — hollow
 (FakeRuntime) for scale, or one real ProcessRuntime node that actually
 execs container commands as host processes with the TPU env injected.
+
+Horizontal shape (PRs 9/10): ``store_shards=N`` partitions /registry/
+across N in-process shard stores (stride revisions, composite rvs);
+``apiservers=M`` runs M Masters over ONE shared store object (each with
+its own cacher/registry — the stateless-apiserver shape without socket
+plumbing); ``sched_shards=K`` runs K scheduler instances with static
+shard ownership.  Exactly one Master renders the shared store's metrics
+and the process-global client metrics, so a fleet merge over the
+cluster's endpoints never double-counts.
+
+Observability (this PR): every component endpoint is registered with an
+``ObsCollector`` (``cluster.obs``) that scrapes them on an interval and
+serves the fleet-level /metrics, /debug/traces, /debug/topology and
+/debug/flightrecorder — the first layer that sees the sharded control
+plane as one system.  ``obs=False`` opts out (micro-benchmarks that
+cannot afford the scrape threads).
 """
 
 from __future__ import annotations
 
 import os
 import tempfile
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 from .apiserver import Master
@@ -21,6 +37,7 @@ from .controllers import ControllerManager
 from .deviceplugin.api import PluginServer, plugin_socket_path
 from .deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices, discover_tpu_devices
 from .kubelet import FakeRuntime, Kubelet, ProcessRuntime
+from .obs import ObsCollector
 from .proxy import Proxier
 from .scheduler import Scheduler
 from .utils.slo import StartupSLITracker
@@ -31,6 +48,14 @@ class NodeHandle:
     kubelet: Kubelet
     plugin: Optional[PluginServer]
     clientset: Clientset
+
+
+def rotated(urls: List[str], k: int) -> str:
+    """Comma server-list starting at k%len: every client keeps the full
+    failover set, load spreads across apiserver peers (sched_perf's
+    idiom, shared here for the in-process multi-apiserver shape)."""
+    i = k % len(urls)
+    return ",".join(urls[i:] + urls[:i])
 
 
 class LocalCluster:
@@ -47,6 +72,11 @@ class LocalCluster:
         root_dir: str = "",
         heartbeat_interval: float = 2.0,
         sync_interval: float = 0.25,
+        store_shards: int = 1,
+        apiservers: int = 1,
+        sched_shards: int = 1,
+        obs: bool = True,
+        obs_interval: float = 1.0,
     ):
         self.n_nodes = nodes
         self.tpus_per_node = tpus_per_node
@@ -57,36 +87,106 @@ class LocalCluster:
         self.root_dir = root_dir or tempfile.mkdtemp(prefix="ktpu-cluster-")
         self.heartbeat_interval = heartbeat_interval
         self.sync_interval = sync_interval
+        self.store_shards = max(1, store_shards)
+        self.apiservers = max(1, apiservers)
+        self.sched_shards = max(1, sched_shards)
+        self.obs_enabled = obs
+        self.obs_interval = obs_interval
 
         self.master: Optional[Master] = None
+        self.masters: List[Master] = []
+        self._shared_store = None
         self.cs: Optional[Clientset] = None
         self.scheduler: Optional[Scheduler] = None
+        self.schedulers: List[Scheduler] = []
         self.kcm: Optional[ControllerManager] = None
         self.proxier: Optional[Proxier] = None
         self.sli: Optional[StartupSLITracker] = None
+        self.obs: Optional[ObsCollector] = None
         self.nodes: List[NodeHandle] = []
 
     @property
     def url(self) -> str:
         return self.master.url
 
+    @property
+    def urls(self) -> List[str]:
+        return [m.url for m in self.masters]
+
     def start(self) -> "LocalCluster":
-        self.master = Master(port=self.port).start()
-        self.cs = Clientset(self.master.url)
-        # ephemeral /metrics + /debug/traces endpoint: the observability
-        # surface is part of the cluster, not an opt-in extra
-        self.scheduler = Scheduler(Clientset(self.master.url), metrics_port=0)
-        self.scheduler.start()
-        self.kcm = ControllerManager(Clientset(self.master.url))
+        if self.apiservers > 1:
+            # M stateless Masters over ONE shared in-process store: each
+            # layers its own cacher/registry; only master 0 renders the
+            # store block and the process-global client metrics (see
+            # Master render gates) so fleet merges stay truthful
+            from .machinery.scheme import global_scheme
+            from .storage import Store
+            from .storage.shardmap import build_sharded_store
+
+            scheme = global_scheme.copy()
+            if self.store_shards > 1:
+                self._shared_store = build_sharded_store(
+                    scheme.copy, self.store_shards)
+            else:
+                self._shared_store = Store(scheme.copy())
+            for i in range(self.apiservers):
+                self.masters.append(Master(
+                    port=self.port if i == 0 else 0,
+                    store=self._shared_store,
+                    render_client_metrics=(i == 0),
+                    render_store_metrics=(i == 0),
+                ).start())
+            self.master = self.masters[0]
+        else:
+            self.master = Master(port=self.port,
+                                 store_shards=self.store_shards).start()
+            self.masters = [self.master]
+        urls = self.urls
+        self.cs = Clientset(rotated(urls, 0))
+        # ephemeral /metrics + /debug/traces endpoint per scheduler: the
+        # observability surface is part of the cluster, not an opt-in
+        # extra.  sched_shards>1 = static in-process shard ownership
+        # (sched_perf's shape): instance k owns shard k.
+        for k in range(self.sched_shards):
+            kwargs = {}
+            if self.sched_shards > 1:
+                kwargs = {"shards": self.sched_shards, "owned_shards": {k}}
+            self.schedulers.append(Scheduler(
+                Clientset(rotated(urls, k)), metrics_port=0,
+                identity=f"sched-{k}", **kwargs))
+            self.schedulers[-1].start()
+        self.scheduler = self.schedulers[0]
+        self.kcm = ControllerManager(Clientset(rotated(urls, 1)))
         self.kcm.start()
-        self._proxier_cs = Clientset(self.master.url)
+        self._proxier_cs = Clientset(rotated(urls, 2))
         self.proxier = Proxier(self._proxier_cs).start()
         # pod-startup SLIs (utils/slo): per-phase histograms on /metrics
-        self._sli_cs = Clientset(self.master.url)
+        self._sli_cs = Clientset(rotated(urls, 3))
         self.sli = StartupSLITracker(self._sli_cs, metrics_port=0).start()
         for i in range(self.n_nodes):
             self._add_node(i)
+        if self.obs_enabled:
+            self._start_obs()
         return self
+
+    def _start_obs(self):
+        self.obs = ObsCollector(interval=self.obs_interval)
+        for i, m in enumerate(self.masters):
+            self.obs.register("apiserver", m.url, instance=f"apiserver-{i}")
+        for k, s in enumerate(self.schedulers):
+            if s.metrics_server is not None:
+                self.obs.register("scheduler", s.metrics_server.url,
+                                  instance=f"sched-{k}",
+                                  shard=k if self.sched_shards > 1 else None)
+        if self.sli is not None and self.sli.metrics_server is not None:
+            self.obs.register("sli", self.sli.metrics_server.url,
+                              instance="sli-0")
+        for h in self.nodes:
+            srv = getattr(h.kubelet, "server", None)
+            if srv is not None:
+                self.obs.register("kubelet", srv.url,
+                                  instance=h.kubelet.node_name)
+        self.obs.start()
 
     def _add_node(self, i: int):
         name = f"node-{i}"
@@ -105,7 +205,7 @@ class LocalCluster:
             runtime = FakeRuntime()
         else:
             runtime = ProcessRuntime(root_dir=os.path.join(self.root_dir, name, "run"))
-        kcs = Clientset(self.master.url)
+        kcs = Clientset(rotated(self.urls, i))
         kubelet = Kubelet(
             kcs,
             node_name=name,
@@ -134,6 +234,8 @@ class LocalCluster:
         return self
 
     def stop(self):
+        if self.obs:
+            self.obs.stop()
         for h in self.nodes:
             h.kubelet.stop()
             if h.plugin:
@@ -147,9 +249,13 @@ class LocalCluster:
             self._proxier_cs.close()
         if self.kcm:
             self.kcm.stop()
-        if self.scheduler:
-            self.scheduler.stop()
+        for s in self.schedulers:
+            s.stop()
         if self.cs:
             self.cs.close()
-        if self.master:
-            self.master.stop()
+        for m in self.masters:
+            m.stop()
+        if self._shared_store is not None:
+            # shared across Masters (none of them owns it): close once,
+            # after every apiserver over it has stopped
+            self._shared_store.close()

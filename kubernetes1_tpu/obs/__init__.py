@@ -1,0 +1,21 @@
+"""Fleet observability plane: cross-shard aggregation + the collector.
+
+`obs.aggregate` is the one metrics-merge implementation in the tree
+(counters sum; histograms merge BUCKET-WISE from the cumulative `_bucket`
+lines every component renders; quantile-max only as the documented
+fallback for reservoir-only metrics).  `obs.collector` is the
+ObsCollector: it scrapes every registered component endpoint on an
+interval and serves the fleet-level `/metrics`, `/debug/traces`,
+`/debug/topology`, and `/debug/flightrecorder` views.
+"""
+
+from .aggregate import (  # noqa: F401
+    ParsedMetrics,
+    bucket_quantile,
+    merge_metrics,
+    merge_parsed,
+    parse_metrics_text,
+    render_metrics,
+    select,
+)
+from .collector import ObsCollector  # noqa: F401

@@ -77,6 +77,18 @@ def main():
         shard_lease=args.shards > 1 and owned is None,
         identity=args.identity,
     )
+    # a scheduler PROCESS exports its own informer/retry telemetry from
+    # its /metrics (in a LocalCluster the one in-process apiserver
+    # renders these module-level metrics instead — registering here too
+    # would double-count a same-process fleet merge, which is why this
+    # lives in the process entrypoint, not Scheduler.__init__)
+    from ..client import informer as _informer
+    from ..client import retry as _retry
+
+    sched.metrics.register(_retry.retries_total)
+    sched.metrics.register(_informer.informer_relists_total)
+    sched.metrics.register(_informer.informer_reconnects_total)
+    sched.metrics.register(_informer.informer_lag_seconds)
     stop = threading.Event()
 
     if args.leader_elect:

@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..machinery import DELETED, TooOldResourceVersion, WatchEvent
 from ..utils import locksan, mutsan
+from ..utils.metrics import Histogram
 from .store import (
     DEFAULT_WATCH_QUEUE_LIMIT,
     Watcher,
@@ -128,6 +129,16 @@ class Cacher:
         # eviction can fire from a replay thread that holds no cache lock
         self._evict_lock = locksan.make_lock("storage.Cacher._evict_lock")
         self._thread: Optional[threading.Thread] = None
+        # freshness-wait lag (obs plane, rendered on the apiserver's
+        # /metrics): how long reads block in wait_fresh for the cache to
+        # catch the store.  Sync-fed caches are fresh by construction and
+        # never observe (zero-cost on the hot read path); only pump-mode
+        # waits land here.
+        self.freshness_wait_seconds = Histogram(
+            "ktpu_cacher_freshness_wait_seconds",
+            "time LIST/GET reads waited for watch-cache freshness",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -337,6 +348,7 @@ class Cacher:
             raise CacheNotReady("watch cache not seeded yet")
         if self._sync:
             return
+        t0 = time.monotonic()
         seen = getattr(self._store, "last_seen_revision", None)
         if self._stream_progress and seen is not None:
             # RPC-free freshness (the etcd progress-notify analog): the
@@ -351,7 +363,12 @@ class Cacher:
             # current_revision round-trip per read (cheap for an
             # in-process store in forced-pump mode, the only such feed)
             target = self._store.current_revision()
-        self._wait_rev_locked_entry(target, timeout)
+        try:
+            self._wait_rev_locked_entry(target, timeout)
+        finally:
+            # observe on the CacheNotReady path too: the timeout-length
+            # stalls are exactly the tail this SLI exists to surface
+            self.freshness_wait_seconds.observe(time.monotonic() - t0)
 
     def _wait_rev_locked_entry(self, target: int, timeout: float):
         """Block until the cache has applied revision `target`."""

@@ -69,10 +69,14 @@ class RemoteWatcher:
     advance freshness without waiting out its poll timeout."""
 
     def __init__(self, conn, f, framer=None, scheme: Optional[Scheme] = None,
-                 fault_site: str = "store.watch"):
+                 fault_site: str = "store.watch", ts_sink=None):
         self._conn = conn
         self._f = f
         self._fault_site = fault_site
+        # watch-lag SLI: event frames may carry the commit stamp of their
+        # newest revision ("ts"/"ts_rev"); the sink (RemoteStore._note_
+        # commit_ts) records it so this client can answer commit_ts_of
+        self._ts_sink = ts_sink
         # binary fast path: a negotiated BinFramer replaces line reads;
         # event objects may arrive as codec bytes ("objraw") decoded
         # through the scheme's codec axis
@@ -93,6 +97,17 @@ class RemoteWatcher:
         t.start()
 
     _PROGRESS = ["progress"]  # shared sentinel; identity-compared
+
+    def _note_frame_ts(self, frame: dict) -> None:
+        if self._ts_sink is None:
+            return
+        ts, ts_rev = frame.get("ts"), frame.get("ts_rev")
+        if ts is None or not ts_rev:
+            return
+        try:
+            self._ts_sink(int(ts_rev), float(ts))
+        except (TypeError, ValueError):
+            pass  # malformed stamp: lag is best-effort, never fatal
 
     def _event(self, e: dict) -> WatchEvent:
         raw = e.get("objraw")
@@ -129,10 +144,12 @@ class RemoteWatcher:
                     continue  # legacy heartbeat
                 ev = frame.get("event")
                 if ev is not None:
+                    self._note_frame_ts(frame)
                     self._q.put([self._event(ev)])
                     continue
                 evs = frame.get("events")
                 if evs is not None:
+                    self._note_frame_ts(frame)
                     self._q.put([self._event(e) for e in evs])
                     continue
                 prog = frame.get("progress")
@@ -265,6 +282,11 @@ class RemoteStore:
         # client: the remote cacher's RPC-free freshness target (a write
         # through this client is read-your-writes; see Cacher.wait_fresh)
         self._seen_rev = 0
+        # watch-lag SLI: commit stamps carried on watch frames (one per
+        # frame, keyed by the frame's newest revision) — bounded; the
+        # serving layer only ever asks about just-delivered revisions
+        self._commit_ts: Dict[int, float] = {}
+        self._commit_ts_order: deque = deque()
 
     def _note_rev(self, rev) -> None:
         try:
@@ -284,6 +306,21 @@ class RemoteStore:
     def last_seen_revision(self) -> int:
         with self._lock:
             return self._seen_rev
+
+    def _note_commit_ts(self, rev: int, ts: float) -> None:
+        with self._lock:
+            self._commit_ts[rev] = ts
+            self._commit_ts_order.append(rev)
+            while len(self._commit_ts_order) > 2048:
+                self._commit_ts.pop(self._commit_ts_order.popleft(), None)
+
+    def commit_ts_of(self, rev: int) -> Optional[float]:
+        """Monotonic commit stamp for a revision this client saw a watch
+        frame for (None otherwise — frame-granular, unlike the in-process
+        store's per-revision ring).  Comparable across processes on one
+        host: CLOCK_MONOTONIC is system-wide on Linux."""
+        with self._lock:
+            return self._commit_ts.get(rev)
 
     @property
     def address(self):
@@ -621,7 +658,8 @@ class RemoteStore:
                 framer.site = self._site_watch  # stream faults tear frames
             return RemoteWatcher(conn, f, framer=framer,
                                  scheme=self._scheme,
-                                 fault_site=self._site_watch)
+                                 fault_site=self._site_watch,
+                                 ts_sink=self._note_commit_ts)
         raise last_exc if last_exc else ConnectionError(
             f"store watch failed on every address: {self._addrs}")
 

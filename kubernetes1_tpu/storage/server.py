@@ -757,6 +757,31 @@ class StoreServer:
             except OSError:
                 pass
 
+    def _stamp_commit_ts(self, env: dict, evs) -> None:
+        """Watch-lag SLI: attach the monotonic commit stamp of the
+        frame's NEWEST revision ("ts" + "ts_rev") so the apiserver's
+        cacher — fed by this stream — can answer commit_ts_of for the
+        revisions it serves.  One stamp per frame: the frame is the
+        delivery unit whose lag is measurable.  Old clients ignore the
+        extra keys; a stamp aged out of the ring is simply omitted."""
+        fn = getattr(self.store, "commit_ts_of", None)
+        if fn is None:
+            return
+        max_rev = 0
+        for ev in evs:
+            try:
+                rev = int((ev.object.get("metadata") or {})
+                          .get("resourceVersion") or 0)
+            except (TypeError, ValueError, AttributeError):
+                continue
+            if rev > max_rev:
+                max_rev = rev
+        if max_rev:
+            ts = fn(max_rev)
+            if ts is not None:
+                env["ts"] = round(ts, 6)
+                env["ts_rev"] = max_rev
+
     def _serve_watch(self, conn, f, rid, params, framer=None):
         """framer=None is the legacy newline-JSON stream; a BinFramer
         switches frames to length-prefixed codec payloads whose event
@@ -810,27 +835,31 @@ class StoreServer:
                     if framer.codec_id == "json":
                         # length-prefixed JSON: no bytes values allowed in
                         # the envelope, ship plain object dicts
-                        framer.send({"events": [
+                        env = {"events": [
                             {"type": ev.type, "object": ev.object}
-                            for ev in evs]})
+                            for ev in evs]}
+                        self._stamp_commit_ts(env, evs)
+                        framer.send(env)
                     else:
-                        framer.send({"events": [
+                        env = {"events": [
                             {"type": ev.type,
                              "objraw": scheme.encode_bytes(
                                  ev.object, codec=framer.codec_id)}
-                            for ev in evs]})
-                elif len(evs) == 1:
-                    # store watch events already carry the encoded dict form
-                    f.write(json.dumps(
-                        {"event": {"type": evs[0].type,
-                                   "object": evs[0].object}})
-                        .encode() + b"\n")
+                            for ev in evs]}
+                        self._stamp_commit_ts(env, evs)
+                        framer.send(env)
                 else:
                     # one frame, one flush, one client-side wakeup per
-                    # group commit
-                    f.write(json.dumps(
-                        {"events": [{"type": ev.type, "object": ev.object}
-                                    for ev in evs]}).encode() + b"\n")
+                    # group commit (singletons ride the legacy "event" key)
+                    if len(evs) == 1:
+                        env = {"event": {"type": evs[0].type,
+                                         "object": evs[0].object}}
+                    else:
+                        env = {"events": [
+                            {"type": ev.type, "object": ev.object}
+                            for ev in evs]}
+                    self._stamp_commit_ts(env, evs)
+                    f.write(json.dumps(env).encode() + b"\n")
                 if framer is None:
                     f.flush()
         except (BrokenPipeError, ConnectionResetError, OSError, ValueError):

@@ -280,6 +280,14 @@ class ShardedStore:
         number; freshness logic is per-shard (see ShardedCacher)."""
         return max(s.current_revision() for s in self._stores)
 
+    def commit_ts_of(self, rev: int):
+        """Monotonic commit stamp of a revision, routed by the stride
+        contract: rev % N names the owning shard (watch-lag SLI — lag is
+        PER-SHARD, never cross-shard clock math)."""
+        st = self._stores[rev % self.shards]
+        fn = getattr(st, "commit_ts_of", None)
+        return fn(rev) if fn is not None else None
+
     def shard_revisions(self) -> List[int]:
         return [s.current_revision() for s in self._stores]
 

@@ -27,7 +27,7 @@ import time
 from typing import Optional, Tuple, Union
 
 from ..machinery.scheme import Scheme, global_scheme
-from ..utils import faultline
+from ..utils import faultline, flightrec
 from .server import StoreServer
 from .store import Store
 
@@ -127,6 +127,9 @@ class StandbyServer:
         if not self.promoted.is_set():
             self.promoted.set()
             self.server.promote()
+            flightrec.note("store-standby", flightrec.STANDBY_PROMOTION,
+                           rev=self.store.current_revision(),
+                           resyncs=self.resyncs)
             print(f"ktpu-store standby PROMOTED at rev "
                   f"{self.store.current_revision()}", flush=True)
 

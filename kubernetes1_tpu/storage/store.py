@@ -65,7 +65,7 @@ from ..machinery import (
     now_iso,
 )
 from ..machinery.scheme import Scheme
-from ..utils import faultline, locksan
+from ..utils import faultline, flightrec, locksan
 from ..utils.metrics import Histogram
 
 # Keep this many events for watch resume before compaction kicks in.
@@ -78,6 +78,11 @@ DEFAULT_WATCH_QUEUE_LIMIT = 4096
 # Replication feeds ride out longer bursts (an evicted standby pays a full
 # snapshot resync), but a wedged standby must not pin the commit history.
 DEFAULT_REPLICA_QUEUE_LIMIT = 65536
+# Commit-timestamp ring bound (watch-lag SLI): revision -> monotonic
+# commit stamp for the newest commits.  8192 revisions outlives any
+# in-flight watch batch; the informer only ever asks about revs it JUST
+# received.
+DEFAULT_COMMIT_TS_LIMIT = 8192
 
 
 class StopUpdate(Exception):
@@ -450,6 +455,15 @@ class Store:
         self.commit_batches = 0
         self.watch_wakeups = 0
         self.watch_events = 0
+        # Watch-lag SLI (obs plane): every group commit stamps ONE
+        # monotonic timestamp shared by its records; the serving layer
+        # ships it on watch-lag bookmark frames so informers can export
+        # delivered-at minus committed-at.  CLOCK_MONOTONIC is system-
+        # wide on Linux, so the stamp is comparable across processes on
+        # one host — the single-box deployment every bench and chaos
+        # schedule runs; cross-host lag would need a synced wall clock.
+        self._commit_ts: Dict[int, float] = {}
+        self._commit_ts_order: deque = deque()
         self.wal_fsync_seconds = Histogram(
             "ktpu_store_wal_fsync_seconds",
             "WAL fsync latency per group commit",
@@ -575,6 +589,8 @@ class Store:
             with open(path, "r+b") as f:
                 f.truncate(bad_start)
             self.wal_torn_tail_repairs += 1
+            flightrec.note("store", flightrec.WAL_REPAIR, op="torn_tail",
+                           path=path, bytes=size - bad_start)
             print(f"store: WAL torn tail repaired — truncated "
                   f"{size - bad_start} byte(s) at offset {bad_start} of "
                   f"{path} (replayed to rev {self._rev}; a standby resync "
@@ -654,6 +670,7 @@ class Store:
                     # WAS mutated above, and watchers/the sync-fed cache
                     # must stay coherent with it — a skipped fan-out would
                     # serve stale reads at the wrong revision forever
+                    self._stamp_commit_ts_locked(records)
                     self._fanout_batch_locked(records)
                     self.commit_count += len(records)
                     self.commit_batches += 1
@@ -693,6 +710,24 @@ class Store:
             del self._history[:drop]
         self._batch_records.append((rev, typ, key, obj))
         return rev, obj
+
+    def _stamp_commit_ts_locked(self, records: List[tuple]):
+        """Must hold lock: one monotonic stamp per group commit, shared
+        by every record in the batch (the batch IS one commit event —
+        per-record clock reads would just measure the loop)."""
+        ts = time.monotonic()
+        for rev, _typ, _key, _obj in records:
+            self._commit_ts[rev] = ts
+            self._commit_ts_order.append(rev)
+        while len(self._commit_ts_order) > DEFAULT_COMMIT_TS_LIMIT:
+            self._commit_ts.pop(self._commit_ts_order.popleft(), None)
+
+    def commit_ts_of(self, rev: int) -> Optional[float]:
+        """Monotonic commit stamp for a recent revision (None once it has
+        aged out of the ring or for pre-restart revisions).  Lock-free
+        read: dict lookups are atomic under the GIL and a raced insert
+        only means a one-call-late answer."""
+        return self._commit_ts.get(rev)
 
     def _wal_emit(self, data: bytes):
         """Write framed WAL bytes, subject to fault injection: an injected
@@ -737,6 +772,8 @@ class Store:
             os.ftruncate(self._wal.fileno(), pre)
             self._wal.seek(pre)
             self.wal_write_rollbacks += 1
+            flightrec.note("store", flightrec.WAL_REPAIR, op="rollback",
+                           offset=pre)
         except OSError as e:
             print(f"store: WAL rollback after failed write ALSO failed "
                   f"({e}) — open-time replay will skip or truncate the "
@@ -1139,6 +1176,7 @@ class Store:
             # fan out even on WAL failure (same rule as _drain_commits):
             # the in-memory state WAS mutated above and local views must
             # stay coherent with it
+            self._stamp_commit_ts_locked(records)
             self._fanout_batch_locked(records)
             self.commit_count += 1
             self.commit_batches += 1

@@ -76,6 +76,17 @@ Wired sites:
                                                  health pass flips a chip
                                                  unhealthy — seeded chip
                                                  death through ListAndWatch)
+  obs.scrape                                    (obs/collector.py: every
+                                                 ObsCollector fetch —
+                                                 /metrics scrapes and the
+                                                 /debug fan-outs.  Standing
+                                                 invariant: a dead or slow
+                                                 scrape target may only
+                                                 stall its own per-target
+                                                 thread, never the
+                                                 collector's serving path —
+                                                 scripts/chaos.py
+                                                 --schedule obs proves it)
 
 With no injector active every hook is identity — one module-global ``is
 None`` test on the hot path; no locks, no RNG, no allocation.

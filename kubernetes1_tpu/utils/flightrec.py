@@ -1,0 +1,129 @@
+"""Flight recorder: bounded per-component rings of structured events.
+
+When a chaos seed fails, the verdict JSON says WHAT broke (an invariant)
+but nothing in the system can say what each component WAS DOING — the
+counters the components keep (lease steals, sheds, gang attempts, WAL
+repairs) are totals, not timelines.  The flight recorder is the timeline:
+every existing counter site additionally drops one structured event into
+a bounded in-process ring, and the ring is
+
+- dumped at ``/debug/flightrecorder`` on every component HTTP surface
+  (utils/metrics.MetricsServer, the apiserver, the kubelet server) and
+  unioned fleet-wide by the ObsCollector;
+- written into the per-seed chaos artifact whenever a verdict fails, so
+  a red seed ships its own black box.
+
+Event kinds are a CLOSED ENUM (the module constants below): call sites
+pass ``flightrec.note(component, flightrec.LEASE_STEAL, shard=3)`` —
+never an ad-hoc string.  ktpulint KTPU011 enforces this statically (a
+string literal in the kind position is a finding), and ``note`` enforces
+it at runtime, so grepping one constant finds every producer AND every
+consumer of that event kind.
+
+Rings are process-global, keyed by component name: in a LocalCluster one
+process hosts every component and one dump shows the whole cluster's
+interleaved story; in a multi-process deployment each process dumps its
+own components and the collector merges by component name.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# ----------------------------------------------------------- event kinds
+#
+# The declared enum (KTPU011): one constant per recorded event kind.
+# Adding a kind = adding a constant here; call sites must reference it.
+
+LEASE_STEAL = "lease_steal"            # LeaseSet took a peer's expired shard
+LEASE_SHED = "lease_shed"              # LeaseSet handed a shard to its winner
+STANDBY_PROMOTION = "standby_promotion"  # standby store promoted to primary
+SHED_429 = "shed_429"                  # apiserver refused a mutating request
+GANG_ATTEMPT = "gang_attempt"          # whole-gang recreate attempt bumped
+GANG_TEARDOWN = "gang_teardown"        # gang member force-finalized
+DEVICE_CLAIM_CONFLICT = "device_claim_conflict"  # optimistic bind lost a chip
+WAL_REPAIR = "wal_repair"              # torn-tail truncation / write rollback
+INFORMER_RELIST = "informer_relist"    # informer fell back to a full LIST
+WATCH_RECONNECT = "watch_reconnect"    # informer re-dialed mid-stream
+
+KINDS = frozenset({
+    LEASE_STEAL, LEASE_SHED, STANDBY_PROMOTION, SHED_429, GANG_ATTEMPT,
+    GANG_TEARDOWN, DEVICE_CLAIM_CONFLICT, WAL_REPAIR, INFORMER_RELIST,
+    WATCH_RECONNECT,
+})
+
+# Per-component ring bound: forensics wants the recent tail.  512 events
+# x ~10 components x ~200 bytes is ~1MB worst case — flat, never grows.
+RING_CAPACITY = 512
+
+_rings: Dict[str, deque] = {}
+_lock = threading.Lock()  # ktpulint: ignore[KTPU007] hot leaf lock around one deque append per noted event
+
+
+def note(component: str, kind: str, **fields) -> None:
+    """Record one event on ``component``'s ring.  ``kind`` must be one of
+    the declared constants (programmer error otherwise — the enum is the
+    contract the dump consumers grep against)."""
+    if kind not in KINDS:
+        raise ValueError(f"flightrec kind {kind!r} is not in the declared "
+                         f"enum (utils/flightrec.py KINDS)")
+    ev = {
+        "t_mono": round(time.monotonic(), 6),
+        # wall time is for the human reading a dump next to logs; every
+        # ordering/lag computation uses the monotonic stamp
+        "wall": round(time.time(), 3),  # ktpulint: ignore[KTPU005] user-visible timestamp in the dump, not a deadline
+        "kind": kind,
+    }
+    for k, v in fields.items():
+        ev[k] = v if isinstance(v, (int, float, bool, type(None))) else str(v)
+    with _lock:
+        ring = _rings.get(component)
+        if ring is None:
+            ring = _rings[component] = deque(maxlen=RING_CAPACITY)
+        ring.append(ev)
+
+
+def dump(component: str = "") -> dict:
+    """{"components": {name: [events oldest->newest]}} — one component's
+    ring, or every ring."""
+    with _lock:
+        if component:
+            ring = _rings.get(component)
+            comps = {component: list(ring)} if ring is not None else {}
+        else:
+            comps = {name: list(ring) for name, ring in _rings.items()}
+    return {"components": comps}
+
+
+def to_json(component: str = "") -> bytes:
+    return json.dumps(dump(component), separators=(",", ":")).encode()
+
+
+def components() -> List[str]:
+    with _lock:
+        return sorted(_rings)
+
+
+def event_count(component: str = "") -> int:
+    with _lock:
+        if component:
+            ring = _rings.get(component)
+            return len(ring) if ring is not None else 0
+        return sum(len(r) for r in _rings.values())
+
+
+def last_event(component: str) -> Optional[dict]:
+    with _lock:
+        ring = _rings.get(component)
+        return ring[-1] if ring else None
+
+
+def reset() -> None:
+    """Clear every ring (chaos seeds and tests: each run's dump must be
+    ITS timeline, not the process's history)."""
+    with _lock:
+        _rings.clear()

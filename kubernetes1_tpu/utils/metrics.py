@@ -290,6 +290,18 @@ class MetricsServer:
                 from urllib.parse import parse_qs, urlsplit
 
                 parts = urlsplit(self.path)
+                if parts.path == "/debug/flightrecorder" and debug_enabled:
+                    from . import flightrec
+
+                    q = parse_qs(parts.query)
+                    body = flightrec.to_json(
+                        (q.get("component") or [""])[0])
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if parts.path == "/debug/traces" and debug_enabled \
                         and spans_ref is not None:
                     q = parse_qs(parts.query)

@@ -91,6 +91,31 @@ def _stop_quietly_mod(fn):
         traceback.print_exc()
 
 
+def _begin_seed_run():
+    """Each seed's flight-recorder dump must be ITS timeline, not the
+    sweep's history: clear every component ring before the topology
+    boots (rings are process-global and a sweep runs in one process)."""
+    from kubernetes1_tpu.utils import flightrec
+
+    flightrec.reset()
+
+
+def _finalize_verdict(verdict: dict) -> dict:
+    """Black-box rule: a FAILED verdict ships the per-component
+    flight-recorder timelines recorded during the seed (a red seed must
+    carry its own story, not just the broken invariant).  The
+    KTPU_CHAOS_FORCE_FAIL=1 hook flips the verdict red so the artifact
+    path itself is testable end-to-end."""
+    from kubernetes1_tpu.utils import flightrec
+
+    if os.environ.get("KTPU_CHAOS_FORCE_FAIL") == "1":
+        verdict["ok"] = False
+        verdict["forced_fail"] = True
+    if not verdict.get("ok"):
+        verdict["flightrecorder"] = flightrec.dump()["components"]
+    return verdict
+
+
 # Sharded-scheduler schedule: control-plane client faults only (the
 # scheduler's informer, bind POSTs, and shard-lease renew traffic all
 # ride client.*), low enough that both instances keep making progress —
@@ -150,6 +175,7 @@ def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
     order_stop = threading.Event()
     stop = threading.Event()
     threads: list = []
+    _begin_seed_run()
     verdict = {"seed": seed, "spec": spec, "killed_primary": False}
     try:
         # durable ack policy: a replication-gate timeout FAILS the write (503,
@@ -376,7 +402,7 @@ def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
     verdict["wal_torn_tail_repairs"] = wal_repairs
     if own_tmp:
         shutil.rmtree(tmpdir, ignore_errors=True)
-    return verdict
+    return _finalize_verdict(verdict)
 
 
 def run_node_schedule(seed: int, mode: str = "node-kill", duration: float = 6.0,
@@ -435,6 +461,7 @@ def run_node_schedule(seed: int, mode: str = "node-kill", duration: float = 6.0,
     # wants a hair-trigger eviction clock
     grace, evict_after = (2.5, 1.0) if mode == "node-kill" else (8.0, 4.0)
 
+    _begin_seed_run()
     verdict = {"seed": seed, "mode": mode, "spec": spec}
     retries_before = client_retry.retries_snapshot()
     gang_before = job_ctrl.gang_recovery_snapshot()
@@ -783,7 +810,7 @@ def run_node_schedule(seed: int, mode: str = "node-kill", duration: float = 6.0,
             _stop_quietly(master.stop)
         if own_tmp:
             shutil.rmtree(tmpdir, ignore_errors=True)
-    return verdict
+    return _finalize_verdict(verdict)
 
 
 def run_sched_shard_schedule(seed: int, duration: float = 6.0,
@@ -812,6 +839,7 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
     spec = SCHED_SPEC if spec is None else spec
     SHARDS, NODES, CHIPS, PODS = 4, 6, 8, 36
     master = cs = s_a = s_b = None
+    _begin_seed_run()
     verdict = {"mode": "sched-shard", "seed": seed, "spec": spec,
                "ok": False, "acked": 0, "recovery_s": None}
     try:
@@ -885,7 +913,7 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
             _stop_quietly_mod(cs.close)
         if master is not None:
             _stop_quietly_mod(master.stop)
-    return verdict
+    return _finalize_verdict(verdict)
 
 
 def run_store_shard_schedule(seed: int, duration: float = 6.0,
@@ -926,6 +954,7 @@ def run_store_shard_schedule(seed: int, duration: float = 6.0,
     if own_tmp:
         tmpdir = tempfile.mkdtemp(prefix=f"ktpu-chaos-shard-{seed}-")
     retries_before = client_retry.retries_snapshot()
+    _begin_seed_run()
     verdict = {"mode": "store-shard", "seed": seed, "spec": spec,
                "shards": shards, "killed_shard": None}
     stores, primaries, standbys, ledgers = [], [], [], []
@@ -1164,7 +1193,111 @@ def run_store_shard_schedule(seed: int, duration: float = 6.0,
     verdict["wal_torn_tail_repairs"] = wal_repairs
     if own_tmp:
         shutil.rmtree(tmpdir, ignore_errors=True)
-    return verdict
+    return _finalize_verdict(verdict)
+
+
+# Observability schedule: faults at the collector's ONE outbound site
+# (obs.scrape — standing invariant: every new socket boundary gets a
+# faultline site and chaos coverage).  Aggressive on purpose: the
+# collector's contract is that a dead or slow target degrades only its
+# own freshness, never the serving path.
+OBS_SPEC = "obs.scrape=drop@0.15|delay:300ms@0.15"
+
+
+def run_obs_schedule(seed: int, duration: float = 6.0,
+                     spec: str = None) -> dict:
+    """Collector-under-fire: a LocalCluster with its ObsCollector
+    scraping every component at a tight interval, PLUS one registered
+    target that never existed (connection refused) — then obs.scrape
+    faults (drops + 300ms delays) and a mid-run KILL of a live target's
+    metrics endpoint.
+
+    Verdict invariants:
+      - the fleet /metrics endpoint answers EVERY probe quickly for the
+        whole run (a wedged scrape target must never block serving —
+        last-good snapshots, per-target threads);
+      - dead targets are marked down (scrape_up 0) instead of wedging;
+      - live targets' staleness is bounded once the faults lift;
+      - faults were actually injected at obs.scrape.
+    """
+    import urllib.request
+
+    from kubernetes1_tpu.localcluster import LocalCluster
+    from kubernetes1_tpu.obs import aggregate
+    from kubernetes1_tpu.utils import faultline
+
+    spec = OBS_SPEC if spec is None else spec
+    _begin_seed_run()
+    verdict = {"mode": "obs", "seed": seed, "spec": spec, "ok": False}
+    cluster = None
+    try:
+        cluster = LocalCluster(nodes=1, obs=True, obs_interval=0.2).start()
+        cluster.wait_ready(40)
+        obs = cluster.obs
+        # a target that never existed: connection refused on every scrape
+        obs.register("ghost", "http://127.0.0.1:1", instance="ghost-0")
+        faultline.activate(seed, spec)
+        probes, slow, failed = 0, 0, 0
+        max_latency = 0.0
+        killed_live_target = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < duration:
+            if not killed_live_target and time.monotonic() - t0 > duration / 2:
+                # mid-run: a live, previously-healthy target dies (its
+                # server stops); its thread must keep failing QUIETLY
+                # while everyone else's freshness is untouched
+                srv = cluster.sli.metrics_server
+                if srv is not None:
+                    srv.stop()
+                    cluster.sli.metrics_server = None  # no double-stop
+                killed_live_target = True
+            p0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(
+                        obs.url + "/metrics", timeout=2.0) as r:
+                    r.read()
+            except OSError:
+                failed += 1
+            lat = time.monotonic() - p0
+            max_latency = max(max_latency, lat)
+            if lat > 1.0:
+                slow += 1
+            probes += 1
+            time.sleep(0.25)
+        verdict["injected"] = faultline.stats()
+        faultline.deactivate()
+        time.sleep(1.0)  # faults lifted: live targets re-scrape
+        with urllib.request.urlopen(obs.url + "/metrics", timeout=5) as r:
+            parsed = aggregate.parse_metrics_text(r.read().decode())
+        up = aggregate.select(parsed, "ktpu_obs_scrape_up")
+        stale = aggregate.select(parsed,
+                                 "ktpu_obs_scrape_staleness_seconds")
+        ghost_down = up.get(
+            'ktpu_obs_scrape_up{instance="ghost-0"}') == 0
+        sli_down = up.get('ktpu_obs_scrape_up{instance="sli-0"}') == 0
+        live_fresh = all(
+            0 <= v < 3.0 for k, v in stale.items()
+            if 'ghost-0' not in k and 'sli-0' not in k)
+        verdict.update({
+            "probes": probes, "probe_failures": failed,
+            "slow_probes": slow,
+            "probe_latency_max_s": round(max_latency, 3),
+            "ghost_marked_down": ghost_down,
+            "killed_target_marked_down": sli_down,
+            "live_targets_fresh": live_fresh,
+            "scrape_errors": obs.scrape_errors_total,
+            "scrapes": obs.scrapes_total,
+        })
+        verdict["ok"] = (probes > 0 and failed == 0 and max_latency < 2.0
+                         and ghost_down and sli_down and live_fresh
+                         and bool(verdict["injected"].get("obs.scrape")))
+    finally:
+        faultline.deactivate()
+        if cluster is not None:
+            _stop_quietly_mod(cluster.stop)
+    verdict["acked"] = verdict.get("scrapes", 0)  # summary-shape compat
+    verdict["recovery_s"] = 0.0
+    return _finalize_verdict(verdict)
 
 
 def main() -> int:
@@ -1181,12 +1314,15 @@ def main() -> int:
                     help="skip the mid-run primary-store kill (wire schedule)")
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
-                    + ("sched-shard", "store-shard", "node-all", "all"),
+                    + ("sched-shard", "store-shard", "obs", "node-all",
+                       "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
                          "steal), store-shard (sharded store, one shard "
                          "primary killed mid-storm -> standby failover), "
+                         "obs (collector under obs.scrape faults + dead "
+                         "targets — serving must never wedge), "
                          "node-all (all three node modes), or all")
     ap.add_argument("--store-shards", type=int, default=2,
                     help="store-shard schedule: shard count")
@@ -1201,7 +1337,7 @@ def main() -> int:
         schedules = list(NODE_MODES)
     elif args.schedule == "all":
         schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
-                                                   "store-shard"]
+                                                   "store-shard", "obs"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -1222,6 +1358,9 @@ def main() -> int:
                 v = run_store_shard_schedule(
                     seed, duration=args.duration, spec=args.spec,
                     writers=args.writers, shards=args.store_shards)
+            elif schedule == "obs":
+                v = run_obs_schedule(seed, duration=args.duration,
+                                     spec=args.spec)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

@@ -17,6 +17,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -28,12 +29,11 @@ from kubernetes1_tpu.utils.benchstamp import contention_stamp  # noqa: E402
 from tests.helpers import make_node, make_tpu_pod  # noqa: E402
 
 
-def rotated(urls, k):
-    """Comma server-list starting at k%len — every client keeps the full
-    failover set, but the load spreads across apiserver peers instead of
-    piling every connection on peer 0."""
-    i = k % len(urls)
-    return ",".join(urls[i:] + urls[:i])
+# Comma server-list starting at k%len — every client keeps the full
+# failover set, but the load spreads across apiserver peers instead of
+# piling every connection on peer 0.  ONE implementation, shared with
+# the in-process multi-apiserver LocalCluster.
+from kubernetes1_tpu.localcluster import rotated  # noqa: E402
 
 
 def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
@@ -186,6 +186,24 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                 scheds.append(Scheduler(
                     Clientset(url), shards=sched_shards, owned_shards={k},
                     identity=f"sched-{k}"))
+    obs = None
+    if multiproc:
+        # the fleet observability plane over the process topology: every
+        # endpoint the run boots is REGISTERED (apiservers, scheduler
+        # shards, per-shard store processes), and the result JSON's
+        # observability block comes off the collector's merged /metrics
+        # in one pass instead of N bespoke scrapes
+        from kubernetes1_tpu.obs import ObsCollector
+
+        obs = ObsCollector(interval=1.0)
+        for i, u in enumerate(api_urls):
+            obs.register("apiserver", u, instance=f"apiserver-{i}")
+        for k, u in enumerate(metrics_urls):
+            obs.register("scheduler", u, instance=f"sched-{k}",
+                         shard=k if sched_shards > 1 else None)
+        for i, u in enumerate(store_metrics_urls):
+            obs.register("store", u, instance=f"store-shard-{i}", shard=i)
+        obs.start()
     try:
         return _drive(nodes, pods, tpus_per_node, creators, multiproc,
                       url, cs, master if not multiproc else None, scheds,
@@ -193,8 +211,11 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                       api_urls=api_urls,
                       store_metrics_urls=store_metrics_urls,
                       store_shards=store_shards, apiservers=apiservers,
-                      bind_codec=bind_codec, store_wal=store_wal)
+                      bind_codec=bind_codec, store_wal=store_wal,
+                      obs=obs)
     finally:
+        if obs is not None:
+            obs.stop()
         # child processes must never outlive the run (a leaked apiserver/
         # scheduler would skew every later bench phase)
         for p in procs:
@@ -226,28 +247,76 @@ def scrape_metrics(metrics_url: str) -> dict:
     return out
 
 
-def merge_metrics(dicts):
-    """Merge N schedulers' scraped /metrics: counters sum, everything
-    else (gauges, quantiles) takes the max — the conservative read for
-    latency percentiles across parallel instances."""
-    out = {}
-    for mx in dicts:
-        for k, v in mx.items():
-            if k not in out:
-                out[k] = v
-            elif k.rpartition("{")[0].endswith(("_total", "_count", "_sum")) \
-                    or k.endswith(("_total", "_count", "_sum")):
-                out[k] += v
-            else:
-                out[k] = max(out[k], v)
-    return out
+# The one fleet merge rule (obs/aggregate.py): counters sum, histogram
+# quantiles recompute from the summed cumulative _bucket lines, max only
+# as the reservoir-only fallback.  The private quantile-max copy that
+# used to live here systematically over-reported merged percentiles on
+# skewed shard splits.
+from kubernetes1_tpu.obs.aggregate import merge_metrics  # noqa: E402
+
+
+def observability_block(obs) -> Optional[dict]:
+    """One pass over the collector's fleet /metrics: informer lag,
+    relists, scrape staleness, and the collector's own overhead — the
+    bench-facing summary of the obs plane (shared by sched_perf and
+    bench.py density)."""
+    if obs is None:
+        return None
+    import urllib.request
+
+    from kubernetes1_tpu.obs import aggregate
+
+    # one forced final scrape round: a short run can end inside the
+    # scrape interval, and the block must summarize the run's END state,
+    # not the last periodic snapshot.  Fanned out like every collector
+    # path — a serial walk would stall the result ~2s per already-dead
+    # target (retries x fetch timeout)
+    import threading as _threading
+
+    round_threads = [
+        _threading.Thread(target=obs.scrape_once, args=(tgt,), daemon=True)
+        for tgt in obs.targets()]
+    for th in round_threads:
+        th.start()
+    for th in round_threads:
+        th.join(timeout=5.0)
+    try:
+        with urllib.request.urlopen(f"{obs.url}/metrics", timeout=5) as r:
+            parsed = aggregate.parse_metrics_text(r.read().decode())
+    except OSError:
+        return None
+
+    def worst(name, **labels):
+        vals = list(aggregate.select(parsed, name, **labels).values())
+        return round(max(vals), 4) if vals else None
+
+    def total(name):
+        vals = aggregate.select(parsed, name).values()
+        return round(sum(vals), 4) if vals else None
+
+    return {
+        # worst shard's merged quantiles (per-shard series, max = the
+        # shard a user could be stuck behind)
+        "informer_lag_p50_s": worst("ktpu_informer_lag_seconds",
+                                    quantile="0.5"),
+        "informer_lag_p99_s": worst("ktpu_informer_lag_seconds",
+                                    quantile="0.99"),
+        "informer_relists": total("ktpu_informer_relists_total"),
+        "informer_reconnects": total("ktpu_informer_reconnects_total"),
+        "scrape_staleness_max_s": worst("ktpu_obs_scrape_staleness_seconds"),
+        "scrapes": obs.scrapes_total,
+        "scrape_errors": obs.scrape_errors_total,
+        # overhead numerator for the same-box A/B: total wall-time the
+        # collector spent scraping (the denominator is the phase wall)
+        "collector_scrape_seconds": round(obs.scrape_seconds_total, 3),
+    }
 
 
 def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
            scheds, metrics_urls=None, stamp=None, sched_shards=1,
            wire_codec="json", api_urls=None, store_metrics_urls=None,
            store_shards=1, apiservers=1, bind_codec="json",
-           store_wal=False) -> dict:
+           store_wal=False, obs=None) -> dict:
     api_urls = api_urls or [url]
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
@@ -447,9 +516,9 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "write_coalesce_waits": amx.get("ktpu_write_coalesce_waits_total"),
     } if (amx or mx) else None
     def q(attr, quantile):
-        """Max across in-process scheduler instances' own histograms
-        (counters sum elsewhere; the max is the conservative percentile
-        merge, same rule merge_metrics applies to scraped quantiles)."""
+        """Max across in-process scheduler instances' own histograms —
+        the reservoir-only fallback rule (obs/aggregate): these are read
+        directly off the objects, no bucket lines to merge."""
         vals = [getattr(s, attr).quantile(quantile) for s in scheds]
         vals = [round(v, 4) for v in vals if v is not None]
         return max(vals) if vals else None
@@ -517,6 +586,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "bind_device_conflicts": bind_conflicts,
         "read_path": read_path,
         "write_path": write_path,
+        "observability": observability_block(obs),
         "steady_state": steady,
         # per-attempt algorithm latency from the schedulers' own
         # histograms — in-process via the objects, multiproc via the

@@ -645,3 +645,82 @@ def test_multiple_pragmas_on_one_line_each_parse():
     assert bare_pragmas([both], "x.py") == []
     bare_second = "x = 1  # ktpulint: ignore[KTPU001] why  # ktpulint: ignore[KTPU002]"
     assert [f.pass_id for f in bare_pragmas([bare_second], "x.py")] == ["KTPU010"]
+
+
+# ------------------------------------------------- KTPU011 (obs naming)
+
+def test_ktpu011_fires_on_unprefixed_metric_constructor():
+    src = """
+        from kubernetes1_tpu.utils.metrics import Counter
+
+        requests = Counter("requests_total", "oops, no namespace")
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1
+    assert "requests_total" in findings[0].message
+
+
+def test_ktpu011_fires_on_unprefixed_registry_method():
+    src = """
+        def setup(reg):
+            return reg.histogram("latency_seconds")
+    """
+    assert [f.pass_id for f in _lint(src)] == ["KTPU011"]
+
+
+def test_ktpu011_quiet_on_prefixed_names_and_foreign_counters():
+    src = """
+        from collections import Counter
+        from kubernetes1_tpu.utils.metrics import Histogram
+
+        chars = Counter("abcabc")  # collections.Counter: out of scope
+        h = Histogram("ktpu_lag_seconds")
+
+        def setup(reg):
+            reg.counter("scheduler_schedule_attempts_total")
+            reg.gauge("ktpu_queue_depth")
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu011_fires_on_ad_hoc_flightrec_kind():
+    src = """
+        from kubernetes1_tpu.utils import flightrec
+
+        def f():
+            flightrec.note("scheduler", "my_random_kind", shard=3)
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1
+    assert "my_random_kind" in findings[0].message
+
+
+def test_ktpu011_quiet_on_enum_flightrec_kind():
+    src = """
+        from kubernetes1_tpu.utils import flightrec
+
+        def f():
+            flightrec.note("scheduler", flightrec.LEASE_STEAL, shard=3)
+    """
+    assert _ids(src) == []
+
+
+def test_ktpu011_fires_on_keyword_name_arg():
+    src = """
+        from kubernetes1_tpu.utils.metrics import Histogram
+
+        h = Histogram(name="latency_seconds", help_="no prefix, keyword")
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1 and "latency_seconds" in findings[0].message
+
+
+def test_ktpu011_fires_on_keyword_flightrec_kind():
+    src = """
+        from kubernetes1_tpu.utils import flightrec
+
+        def f():
+            flightrec.note("scheduler", kind="sneaky_kind", shard=1)
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1 and "sneaky_kind" in findings[0].message

@@ -1,0 +1,460 @@
+"""Fleet observability plane (kubernetes1_tpu/obs/ + utils/flightrec).
+
+Covers the PR's acceptance surface:
+- the bucket-wise histogram merge golden (merged p99 correct where the
+  old quantile-max rule is wrong by orders of magnitude);
+- the ObsCollector over a sharded LocalCluster (store_shards=2,
+  apiservers=2, sched shards=2): per-shard informer lag on the fleet
+  /metrics, merged store-shard commits equal to the per-shard sum,
+  fleet counters equal to the sum of per-instance scrapes, one-trace-id
+  union across components;
+- the watch-lag SLI under a paused-then-resumed watch (resume from a
+  pre-pause revision replays events whose commit stamps are the pause
+  old — the informer's mid-stream-reconnect shape);
+- flight-recorder ring bounds, the kind enum, and dump-on-failed-
+  chaos-verdict;
+- a dead scrape target never wedges the collector's serving path.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, SharedInformer
+from kubernetes1_tpu.client import informer as informer_mod
+from kubernetes1_tpu.client.rest import ApiClient
+from kubernetes1_tpu.localcluster import LocalCluster
+from kubernetes1_tpu.obs import ObsCollector, aggregate
+from kubernetes1_tpu.utils import flightrec
+from kubernetes1_tpu.utils.metrics import Counter, Histogram, MetricsServer, Registry
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+from tests.helpers import make_tpu_pod
+
+
+def fetch(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+# ------------------------------------------------------- aggregate golden
+
+
+class TestBucketWiseMerge:
+    def test_merged_p99_exact_where_max_rule_is_wrong(self):
+        """The golden: a skewed split (one instance holds ALL the slow
+        samples, but they are <1% of the fleet).  Bucket-wise merge
+        lands the fleet p99 in the fast bucket; quantile-max reports
+        the slow instance's p99 as the fleet's — off by ~1000x."""
+        a = Histogram("ktpu_g_seconds")
+        b = Histogram("ktpu_g_seconds")
+        for _ in range(9950):
+            a.observe(0.009)
+        for _ in range(50):
+            b.observe(9.0)
+        pa = aggregate.parse_metrics_text(a.render())
+        pb = aggregate.parse_metrics_text(b.render())
+        merged = aggregate.merge_parsed([pa, pb])
+        p99 = list(aggregate.select(
+            merged, "ktpu_g_seconds", quantile="0.99").values())[0]
+        # pooled truth: rank 9900 of 10000 lands among the 0.009s —
+        # the right answer is in the (0.005, 0.01] bucket
+        pooled = sorted([0.009] * 9950 + [9.0] * 50)
+        truth = pooled[int(0.99 * len(pooled))]
+        assert truth == 0.009
+        assert 0.005 <= p99 <= 0.025, p99  # correct bucket (interpolated)
+        # the old rule: max of per-instance reservoir p99s
+        max_rule = max(a.quantile(0.99), b.quantile(0.99))
+        assert max_rule >= 9.0  # wrong by ~1000x
+        # counts and sums merged cumulatively
+        assert list(aggregate.select(
+            merged, "ktpu_g_seconds_count").values())[0] == 10000
+
+    def test_counters_sum_gauges_max_and_flat_dict_compat(self):
+        t1 = "# TYPE ktpu_x_total counter\nktpu_x_total 3\n" \
+             "# TYPE ktpu_depth gauge\nktpu_depth 7\n"
+        t2 = "# TYPE ktpu_x_total counter\nktpu_x_total 4\n" \
+             "# TYPE ktpu_depth gauge\nktpu_depth 5\n"
+        merged = aggregate.merge_parsed(
+            [aggregate.parse_metrics_text(x) for x in (t1, t2)])
+        assert merged.samples["ktpu_x_total"] == 7
+        assert merged.samples["ktpu_depth"] == 7  # gauge: max
+        # flat-dict compat (the sched_perf scrape shape): same rules
+        flat = aggregate.merge_metrics([
+            {"ktpu_x_total": 3.0, "ktpu_depth": 7.0},
+            {"ktpu_x_total": 4.0, "ktpu_depth": 5.0}])
+        assert flat == {"ktpu_x_total": 7.0, "ktpu_depth": 7.0}
+
+    def test_quantile_max_fallback_for_reservoir_only_metrics(self):
+        """No _bucket lines rendered -> the documented fallback: max."""
+        t1 = 'ktpu_r_seconds{quantile="0.99"} 0.5\n'
+        t2 = 'ktpu_r_seconds{quantile="0.99"} 2.0\n'
+        merged = aggregate.merge_parsed(
+            [aggregate.parse_metrics_text(x) for x in (t1, t2)])
+        assert merged.samples['ktpu_r_seconds{quantile="0.99"}'] == 2.0
+
+    def test_render_roundtrip(self):
+        text = ("# TYPE ktpu_y_total counter\n"
+                "ktpu_y_total 5\n"
+                '# TYPE ktpu_z gauge\nktpu_z{shard="0"} 1\n')
+        parsed = aggregate.parse_metrics_text(text)
+        again = aggregate.parse_metrics_text(
+            aggregate.render_metrics(parsed))
+        assert again.samples == parsed.samples
+        assert again.types == parsed.types
+
+    def test_render_groups_interleaved_families_contiguously(self):
+        """Merging two scrapes whose label sets differ interleaves a
+        family's series in insertion order; the render must still emit
+        ONE contiguous block per family (the exposition grouping rule a
+        real Prometheus enforces) and keep non-finite values parseable."""
+        t1 = ('# TYPE ktpu_r_total counter\n'
+              'ktpu_r_total{reason="a"} 1\n'
+              "# TYPE ktpu_other gauge\nktpu_other 2\n")
+        t2 = ('# TYPE ktpu_r_total counter\n'
+              'ktpu_r_total{reason="b"} 3\n'
+              '# TYPE ktpu_q gauge\nktpu_q{quantile="0.5"} +Inf\n')
+        merged = aggregate.merge_parsed(
+            [aggregate.parse_metrics_text(x) for x in (t1, t2)])
+        out = aggregate.render_metrics(merged)
+        fams = [ln.split()[2] for ln in out.splitlines()
+                if ln.startswith("# TYPE")]
+        assert len(fams) == len(set(fams))  # one header per family
+        r_lines = [i for i, ln in enumerate(out.splitlines())
+                   if ln.startswith("ktpu_r_total")]
+        assert r_lines == list(range(r_lines[0], r_lines[0] + 2))
+        assert 'ktpu_q{quantile="0.5"} +Inf' in out
+
+
+# ----------------------------------------------- collector over a fleet
+
+
+@pytest.fixture(scope="class")
+def sharded_cluster():
+    c = LocalCluster(nodes=2, store_shards=2, apiservers=2, sched_shards=2,
+                     obs_interval=0.25).start()
+    try:
+        c.wait_ready(60)
+        yield c
+    finally:
+        c.stop()
+
+
+class TestCollectorOverShardedCluster:
+    def _bind_pods(self, c, n=3, prefix="obsp"):
+        for i in range(n):
+            p = make_tpu_pod(f"{prefix}-{i}", tpus=1)
+            p.spec.containers[0].command = ["serve"]
+            c.cs.pods.create(p)
+        must_poll_until(
+            lambda: all(c.cs.pods.get(f"{prefix}-{i}", "default")
+                        .spec.node_name for i in range(n)),
+            timeout=30.0, desc="pods bound")
+
+    def test_fleet_metrics_lag_per_shard_and_shard_commit_sum(
+            self, sharded_cluster):
+        c = sharded_cluster
+        self._bind_pods(c)
+        time.sleep(0.8)  # >= 2 scrape intervals: snapshots fresh
+
+        # per-shard informer lag on the fleet endpoint
+        parsed = aggregate.parse_metrics_text(fetch(c.obs.url + "/metrics"))
+        for shard in ("0", "1"):
+            lag = aggregate.select(parsed, "ktpu_informer_lag_seconds",
+                                   shard=shard, quantile="0.99")
+            assert lag, f"no lag series for shard {shard}"
+            assert all(0 <= v < 30 for v in lag.values()), lag
+
+        # merged ktpu_store_shard_commits == the per-shard sum: bracket
+        # the scrape between two direct reads of the shard stores (the
+        # counters keep moving with heartbeats)
+        shards = c._shared_store.shard_stores
+        before = [s.commit_count for s in shards]
+        for tgt in c.obs.targets():
+            if tgt.instance == "apiserver-0":
+                assert c.obs.scrape_once(tgt)
+        parsed = aggregate.parse_metrics_text(fetch(c.obs.url + "/metrics"))
+        after = [s.commit_count for s in shards]
+        total_fleet = 0.0
+        for i in range(len(shards)):
+            series = aggregate.select(
+                parsed, "ktpu_store_shard_commits_total", shard=str(i))
+            assert len(series) == 1, series
+            val = list(series.values())[0]
+            assert before[i] <= val <= after[i], (i, before[i], val, after[i])
+            total_fleet += val
+        assert sum(before) <= total_fleet <= sum(after)
+
+    def test_fleet_counters_equal_sum_of_per_instance_scrapes(
+            self, sharded_cluster):
+        c = sharded_cluster
+        # merge the SNAPSHOTS the fleet view is built from: the sum rule
+        # must hold exactly over real multi-instance scrapes
+        snaps = [tgt.parsed for tgt in c.obs.targets()
+                 if tgt.parsed is not None]
+        assert len(snaps) >= 5  # 2 apiservers + 2 scheds + sli (+nodes)
+        merged = aggregate.merge_parsed(snaps)
+        name = "scheduler_schedule_attempts_total"
+        per_instance = [s.samples[name] for s in snaps if name in s.samples]
+        assert len(per_instance) == 2  # one per scheduler shard
+        assert merged.samples[name] == sum(per_instance)
+
+    def test_one_trace_id_union_across_components(self, sharded_cluster):
+        c = sharded_cluster
+        self._bind_pods(c, n=1, prefix="obstr")
+        pod = c.cs.pods.get("obstr-0", "default")
+        trace_id = pod.metadata.annotations.get(t.TRACE_ID_ANNOTATION)
+        assert trace_id
+
+        def union_components():
+            spans = c.obs.traces(trace_id)["spans"]
+            return {s["component"] for s in spans}
+
+        must_poll_until(lambda: len(union_components()) >= 2,
+                        timeout=15.0, desc="trace union >= 2 components")
+        comps = union_components()
+        assert "apiserver" in comps
+        assert comps & {"scheduler", "kubelet"}, comps
+
+    def test_topology_lists_every_instance_with_shards(self, sharded_cluster):
+        c = sharded_cluster
+        topo = c.obs.topology()
+        instances = {i["instance"]: i for i in topo["instances"]}
+        assert {"apiserver-0", "apiserver-1", "sched-0",
+                "sched-1"} <= set(instances)
+        assert instances["sched-1"]["shard"] == 1
+        assert all(i["up"] for i in topo["instances"])
+
+
+# ------------------------------------------------------- watch-lag SLI
+
+
+class TestWatchLagSLI:
+    def test_paused_then_resumed_watch_reports_the_pause(self):
+        """Resume a lagStamps watch from a PRE-pause revision: the
+        replayed events' commit stamps are the pause old, and the lag
+        bookmark proves it — the exact shape of an informer resuming
+        after a stall.  Fresh events then stamp near-zero lag."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            _, rv0 = cs.configmaps.list(namespace="default")
+            for i in range(3):
+                cm = t.ConfigMap()
+                cm.metadata.name = f"lag-{i}"
+                cm.data = {"k": str(i)}
+                cs.configmaps.create(cm, "default")
+            pause = 1.0
+            time.sleep(pause)
+            api = ApiClient(master.url)
+            stamps = []
+            with api.watch("/api/v1/namespaces/default/configmaps",
+                           {"resourceVersion": str(rv0),
+                            "lagStamps": "1"}) as stream:
+                got = 0
+                for etype, obj in stream:
+                    if etype == "BOOKMARK":
+                        ann = ((obj.get("metadata") or {})
+                               .get("annotations") or {})
+                        stamp = ann.get(t.COMMITTED_AT_ANNOTATION)
+                        if stamp:
+                            now = time.monotonic()
+                            for tok in stamp.split():
+                                shard, _, ts = tok.partition(":")
+                                stamps.append((shard, now - float(ts)))
+                        if got >= 3:
+                            break
+                        continue
+                    got += 1
+            api.close()
+            assert stamps, "no lag stamps on the resumed stream"
+            # the replayed batch was committed before the pause
+            assert max(lag for _sh, lag in stamps) >= pause * 0.9
+            assert all(sh == "0" for sh, _lag in stamps)  # unsharded
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_live_informer_exports_sane_lag(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.configmaps, namespace="default")
+        try:
+            inf.start()
+            assert inf.wait_for_sync(10)
+            child = informer_mod.informer_lag_seconds.labels(shard="0")
+            before = child.count
+            cm = t.ConfigMap()
+            cm.metadata.name = "lag-live"
+            cm.data = {"k": "v"}
+            cs.configmaps.create(cm, "default")
+            must_poll_until(lambda: child.count > before,
+                            timeout=10.0, desc="lag observation")
+            # fresh event on an idle in-process cluster: small, >= 0
+            assert 0 <= child.quantile(0.99) < 5.0
+            # migrated counters keep their per-instance int views
+            assert isinstance(inf.relists, int) and inf.relists >= 1
+            assert inf.reconnects == 0
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()
+
+    def test_plain_watch_without_opt_in_has_no_bookmarks(self):
+        """Streams that didn't ask stay byte-compatible: no BOOKMARK
+        frames on an unsharded watch without lagStamps."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            _, rv0 = cs.configmaps.list(namespace="default")
+            cm = t.ConfigMap()
+            cm.metadata.name = "plain-0"
+            cs.configmaps.create(cm, "default")
+            api = ApiClient(master.url)
+            types = []
+            with api.watch("/api/v1/namespaces/default/configmaps",
+                           {"resourceVersion": str(rv0)}) as stream:
+                for etype, _obj in stream:
+                    types.append(etype)
+                    break
+            api.close()
+            assert types == ["ADDED"]
+        finally:
+            cs.close()
+            master.stop()
+
+
+# ------------------------------------------------------ flight recorder
+
+
+class TestFlightRecorder:
+    def setup_method(self):
+        flightrec.reset()
+
+    def teardown_method(self):
+        flightrec.reset()
+
+    def test_ring_is_bounded_and_keeps_the_tail(self):
+        for i in range(flightrec.RING_CAPACITY + 100):
+            flightrec.note("apiserver", flightrec.SHED_429, seq=i)
+        events = flightrec.dump("apiserver")["components"]["apiserver"]
+        assert len(events) == flightrec.RING_CAPACITY
+        assert events[-1]["seq"] == flightrec.RING_CAPACITY + 99
+        assert events[0]["seq"] == 100  # oldest aged out
+
+    def test_kinds_are_a_closed_enum(self):
+        with pytest.raises(ValueError):
+            flightrec.note("apiserver", "made_up_kind")
+        assert flightrec.SHED_429 in flightrec.KINDS
+
+    def test_failed_chaos_verdict_ships_timelines(self, monkeypatch):
+        from scripts.chaos import _finalize_verdict
+
+        flightrec.note("informer", flightrec.INFORMER_RELIST, resource="p")
+        flightrec.note("store", flightrec.WAL_REPAIR, op="torn_tail")
+        flightrec.note("store-standby", flightrec.STANDBY_PROMOTION, rev=9)
+        red = _finalize_verdict({"seed": 1, "ok": False})
+        assert set(red["flightrecorder"]) == {
+            "informer", "store", "store-standby"}
+        # a green verdict ships no black box...
+        green = _finalize_verdict({"seed": 1, "ok": True})
+        assert "flightrecorder" not in green
+        # ...unless the forced-fail hook flips it red (the acceptance
+        # path: a forced failing verdict writes >=3 components)
+        monkeypatch.setenv("KTPU_CHAOS_FORCE_FAIL", "1")
+        forced = _finalize_verdict({"seed": 1, "ok": True})
+        assert forced["forced_fail"] and not forced["ok"]
+        assert len(forced["flightrecorder"]) >= 3
+
+    def test_collector_union_dedups_same_process_rings(self):
+        """Two targets in ONE process serve identical rings: the fleet
+        union keeps one copy of each event (and would CONCATENATE
+        distinct processes' events, never drop a ring)."""
+        flightrec.note("scheduler", flightrec.LEASE_SHED, shard=0)
+        flightrec.note("scheduler", flightrec.LEASE_STEAL, shard=1)
+        a = MetricsServer(Registry(), port=0).start()
+        b = MetricsServer(Registry(), port=0).start()
+        obs = ObsCollector(interval=5.0)
+        try:
+            obs.register("x", a.url, instance="x-0")
+            obs.register("x", b.url, instance="x-1")
+            obs.start()
+            events = obs.flightrecorder()["components"]["scheduler"]
+            assert [e["kind"] for e in events] == [
+                flightrec.LEASE_SHED, flightrec.LEASE_STEAL]  # deduped, ordered
+        finally:
+            obs.stop()
+            a.stop()
+            b.stop()
+
+    def test_metrics_server_serves_the_dump(self):
+        flightrec.note("scheduler", flightrec.LEASE_STEAL, shard=1)
+        srv = MetricsServer(Registry(), port=0).start()
+        try:
+            import json
+
+            data = json.loads(fetch(srv.url + "/debug/flightrecorder"))
+            assert data["components"]["scheduler"][0]["kind"] == \
+                flightrec.LEASE_STEAL
+        finally:
+            srv.stop()
+
+
+# -------------------------------------------- collector failure domain
+
+
+class TestCollectorRobustness:
+    def test_dead_target_marked_down_never_wedges_serving(self):
+        reg = Registry()
+        reg.counter("ktpu_live_total").inc(5)
+        srv = MetricsServer(reg, port=0).start()
+        obs = ObsCollector(interval=0.2, fetch_timeout=0.5)
+        try:
+            obs.register("live", srv.url, instance="live-0")
+            obs.register("ghost", "http://127.0.0.1:1", instance="ghost-0")
+            obs.start()
+            must_poll_until(lambda: obs.scrapes_total >= 2
+                            and obs.scrape_errors_total >= 1,
+                            timeout=10.0, desc="scrapes + errors")
+            t0 = time.monotonic()
+            parsed = aggregate.parse_metrics_text(
+                fetch(obs.url + "/metrics", timeout=2.0))
+            assert time.monotonic() - t0 < 2.0  # serving never blocks
+            up = aggregate.select(parsed, "ktpu_obs_scrape_up")
+            assert up['ktpu_obs_scrape_up{instance="live-0"}'] == 1
+            assert up['ktpu_obs_scrape_up{instance="ghost-0"}'] == 0
+            assert parsed.samples["ktpu_live_total"] == 5
+        finally:
+            obs.stop()
+            srv.stop()
+
+    def test_reregister_moves_url_and_unregister_stops(self):
+        obs = ObsCollector(interval=0.2)
+        name = obs.register("c", "http://127.0.0.1:1")
+        assert name == "c-0"
+        assert obs.register("c", "http://127.0.0.1:2", instance="c-0") == "c-0"
+        assert len(obs.targets()) == 1
+        # a MOVED endpoint drops the old process's last-good snapshot —
+        # the fleet view must not keep merging a dead process's counters
+        tgt = obs.targets()[0]
+        tgt.parsed = aggregate.parse_metrics_text("ktpu_x_total 1\n")
+        tgt.up = True
+        obs.register("c", "http://127.0.0.1:3", instance="c-0")
+        assert tgt.parsed is None and not tgt.up
+        obs.unregister("c-0")
+        assert obs.targets() == []
+
+    def test_generated_names_never_hijack_a_live_target(self):
+        """Regression: count-based naming after an unregister collided
+        with a live instance and silently rewrote its URL."""
+        obs = ObsCollector(interval=0.2)
+        obs.register("k", "http://127.0.0.1:1")    # k-0
+        obs.register("k", "http://127.0.0.1:2")    # k-1
+        obs.unregister("k-0")
+        assert obs.register("k", "http://127.0.0.1:3") == "k-0"
+        urls = {t.instance: t.url for t in obs.targets()}
+        assert urls == {"k-1": "http://127.0.0.1:2",
+                        "k-0": "http://127.0.0.1:3"}

@@ -237,7 +237,7 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
 
     p = argparse.ArgumentParser(
         prog="ktpulint",
-        description="project-specific static analysis (KTPU001-KTPU010)")
+        description="project-specific static analysis (KTPU001-KTPU011)")
     p.add_argument("paths", nargs="*",
                    help="files/directories (default: kubernetes1_tpu/ and tools/)")
     p.add_argument("--output", choices=("text", "json"), default="text",
@@ -256,6 +256,7 @@ from . import exceptions_pass  # noqa: E402,F401
 from . import lockfactory_pass  # noqa: E402,F401
 from . import locks_pass  # noqa: E402,F401
 from . import mutation_pass  # noqa: E402,F401
+from . import obs_pass  # noqa: E402,F401
 from . import schema_pass  # noqa: E402,F401
 from . import threads_pass  # noqa: E402,F401
 from . import wallclock_pass  # noqa: E402,F401

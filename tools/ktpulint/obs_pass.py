@@ -1,0 +1,100 @@
+"""KTPU011 — observability naming discipline.
+
+Two premises the fleet observability plane (kubernetes1_tpu/obs/)
+depends on:
+
+1. **Metric names are namespaced.**  The collector merges every
+   component's /metrics into one fleet view; an unprefixed name
+   (``requests_total``) collides silently with any other component's (or
+   a future dependency's) series and the merge sums unrelated numbers.
+   Every metric constructed in this tree must carry the ``ktpu_`` or
+   ``scheduler_`` prefix (``scheduler_`` mirrors the reference's
+   scheduler metric names verbatim — the bench's comparison axis).
+   Checked at construction sites: ``Counter("name")`` / ``Gauge`` /
+   ``Histogram`` (when imported from utils.metrics) and
+   ``<registry>.counter("name")`` / ``.gauge`` / ``.histogram``.
+
+2. **Flight-recorder kinds come from the declared enum.**
+   ``flightrec.note(component, kind, ...)`` call sites must reference a
+   ``flightrec.X`` constant (or an imported UPPER_CASE name), never an
+   ad-hoc string literal: the enum is what makes a kind greppable from
+   producer to dump consumer, and ``note()`` raises on strings that
+   aren't in it — this pass moves that failure from runtime to lint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .engine import FileContext, Finding, register
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_ALLOWED_PREFIXES = ("ktpu_", "scheduler_")
+
+
+def _metric_imports(tree: ast.Module) -> Set[str]:
+    """Metric class names this module imports FROM a metrics module —
+    the gate that keeps collections.Counter et al. out of scope."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.rsplit(".", 1)[-1] == "metrics":
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _literal_str_arg(call: ast.Call, idx: int, keyword: str = ""):
+    """Literal-str value of positional arg `idx` or keyword `keyword`
+    (a name passed as name=... must not bypass the gate)."""
+    arg = None
+    if len(call.args) > idx:
+        arg = call.args[idx]
+    elif keyword:
+        for kw in call.keywords:
+            if kw.arg == keyword:
+                arg = kw.value
+                break
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+@register("KTPU011")
+def obs_pass(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    metric_names = _metric_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # -- metric name prefix ------------------------------------------
+        name_literal = None
+        if isinstance(func, ast.Name) and func.id in metric_names:
+            name_literal = _literal_str_arg(node, 0, keyword="name")
+        elif isinstance(func, ast.Attribute) \
+                and func.attr in _METRIC_METHODS:
+            name_literal = _literal_str_arg(node, 0, keyword="name")
+        if name_literal is not None \
+                and not name_literal.startswith(_ALLOWED_PREFIXES):
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU011",
+                f"metric name {name_literal!r} lacks the ktpu_/scheduler_ "
+                f"prefix — the fleet merge (obs/aggregate) namespaces "
+                f"series by prefix; unprefixed names collide silently"))
+        # -- flightrec kind enum -----------------------------------------
+        if isinstance(func, ast.Attribute) and func.attr == "note" \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "flightrec":
+            kind = _literal_str_arg(node, 1, keyword="kind")
+            if kind is not None:
+                findings.append(Finding(
+                    ctx.path, node.lineno, "KTPU011",
+                    f"flightrec.note kind {kind!r} is an ad-hoc string — "
+                    f"use the declared enum constant "
+                    f"(utils/flightrec.py, e.g. flightrec.LEASE_STEAL) "
+                    f"so every producer/consumer of the kind is greppable"))
+    return findings
