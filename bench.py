@@ -30,8 +30,13 @@ sys.path.insert(0, REPO_ROOT)
 
 NODES = int(os.environ.get("BENCH_NODES", "20"))
 CHIPS_PER_NODE = 4
+# Envelope knobs (BENCH_r07+, the 5000-node scale envelope): pods per
+# node sets density directly (BENCH_PODS still wins when set explicitly)
+PODS_PER_NODE = int(os.environ.get("BENCH_PODS_PER_NODE", "0"))
 # default exactly at chip capacity so every pod can run
-PODS = int(os.environ.get("BENCH_PODS", str(NODES * CHIPS_PER_NODE)))
+PODS = int(os.environ.get(
+    "BENCH_PODS",
+    str(NODES * (PODS_PER_NODE or CHIPS_PER_NODE))))
 WORKLOAD_BATCH = int(os.environ.get("BENCH_WORKLOAD_BATCH", "256"))
 WORKLOAD_STEPS = int(os.environ.get("BENCH_WORKLOAD_STEPS", "20"))
 LLAMA_PRESET = os.environ.get("BENCH_LLAMA_PRESET", "1b-tpu")
@@ -55,6 +60,9 @@ STORE_SHARDS = int(os.environ.get("BENCH_STORE_SHARDS", "1"))
 APISERVERS = int(os.environ.get("BENCH_APISERVERS", "1"))
 BIND_CODEC = os.environ.get("BENCH_BIND_CODEC", "json")
 STORE_WAL = os.environ.get("BENCH_STORE_WAL", "") == "1"
+# zero-copy bind leg (BENCH_r07+): schedulers ship bulk binds over the
+# persistent length-prefixed bind stream instead of full HTTP per round
+BIND_STREAM = os.environ.get("BENCH_BIND_STREAM", "") == "1"
 
 
 def _pct(xs, q):
@@ -370,6 +378,20 @@ def bench_density():
         "encode_cache_hits": enc_hits,
         "encode_cache_misses": enc_misses,
         "watch_evictions": watch_evictions,
+        # per-op read-path envelope (BENCH_r07+): selector-LIST index
+        # economics and continue-token pagination off the registry the
+        # kubelets' spec.nodeName informers actually hit
+        "read_path": {
+            "list_index_hits": master.registry.list_index_hits,
+            "list_index_misses": master.registry.list_index_misses,
+            "list_index_hit_ratio": round(
+                master.registry.list_index_hits
+                / (master.registry.list_index_hits
+                   + master.registry.list_index_misses), 4)
+            if (master.registry.list_index_hits
+                + master.registry.list_index_misses) else None,
+            "list_continue_rounds": master.registry.list_continue_rounds,
+        },
         "write_path": write_path,
         "robustness": robustness,
         "observability": observability,
@@ -631,7 +653,8 @@ def main():
                 100, 3000, multiproc=True,
                 sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC,
                 store_shards=STORE_SHARDS, apiservers=APISERVERS,
-                bind_codec=BIND_CODEC, store_wal=STORE_WAL)
+                bind_codec=BIND_CODEC, store_wal=STORE_WAL,
+                bind_stream=BIND_STREAM)
         except Exception as e:  # noqa: BLE001
             extras["sched_perf_100"] = {"error": f"{type(e).__name__}: {e}"}
         if os.environ.get("BENCH_SKIP_SCHED1K", "") != "1":
@@ -641,6 +664,7 @@ def main():
                     sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC,
                     store_shards=STORE_SHARDS, apiservers=APISERVERS,
                     bind_codec=BIND_CODEC, store_wal=STORE_WAL,
+                    bind_stream=BIND_STREAM,
                 )
             except Exception as e:  # noqa: BLE001
                 extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}

@@ -343,6 +343,14 @@ class Registry:
         self._device_claims: Dict[tuple, tuple] = {}
         self._claims_seeded = False
         self.device_claim_conflicts = 0  # served as a /metrics counter
+        # selector-LIST index economics (/metrics): a hit served the LIST
+        # from a watch-cache secondary index in O(matches); a miss is a
+        # field-selector LIST that scanned the full collection (unindexed
+        # field, inequality-only selector, or the authoritative fallback)
+        self._idx_stats_lock = locksan.make_lock("Registry._idx_stats_lock")
+        self.list_index_hits = 0
+        self.list_index_misses = 0
+        self.list_continue_rounds = 0  # continue-token chunks served
 
     # ------------------------------------------------------------------ keys
 
@@ -713,20 +721,93 @@ class Registry:
         filtered with the SAME selector semantics as list/watch — the
         matching rules live here so the cached and authoritative paths
         cannot drift apart."""
-        entries, rev = via.list_raw(self.prefix(resource, namespace))
-        dicts = [obj for _key, _rev, obj in entries]
-        if label_selector:
-            reqs = labelutil.parse_selector(label_selector)
-            dicts = [
-                d for d in dicts
-                if labelutil.selector_matches(
-                    reqs, (d.get("metadata") or {}).get("labels") or {})
-            ]
-        if field_selector:
-            freqs = parse_field_selector(field_selector)
-            dicts = [d for d in dicts
-                     if field_selector_matches(freqs, d, resource)]
-        return dicts, rev
+        entries, rev = self.list_entries(via, resource, namespace,
+                                         label_selector=label_selector,
+                                         field_selector=field_selector)
+        return [obj for _key, _rev, obj in entries], rev
+
+    def select_entries(
+        self,
+        via,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ):
+        """(entries, rev, match): key-sorted candidate (key, rev, obj)
+        entries — the FULL collection, or the index-narrowed subset —
+        plus a predicate applying every selector requirement (None when
+        unfiltered).  The paginated LIST path consumes this lazily:
+        bisect to the continue cursor, then filter forward only until
+        the chunk fills, so a continue chunk never selector-filters the
+        whole collection again.
+
+        A field selector with an equality requirement on a DECLARED index
+        (storage/cacher.register_selector_index; pods/spec.nodeName by
+        construction) is answered from the watch cache's secondary index
+        in O(matches) instead of O(collection).  The index only narrows
+        candidates: EVERY requirement is re-checked by `match`, so the
+        result is the full-scan result by construction, never by
+        extractor parity.  Unindexed selectors (and any `via` without
+        indexes — the authoritative store fallback) keep the scan path."""
+        lreqs = (labelutil.parse_selector(label_selector)
+                 if label_selector else None)
+        freqs = parse_field_selector(field_selector) if field_selector \
+            else None
+        prefix = self.prefix(resource, namespace)
+        entries = None
+        rev = None
+        if freqs:
+            lookup = getattr(via, "list_raw_indexed", None)
+            if lookup is not None:
+                for path, op, val in freqs:
+                    if op != "=":
+                        continue  # indexes answer equality only
+                    got = lookup(prefix, path, val)
+                    if got is not None:
+                        entries, rev = got
+                        break
+            with self._idx_stats_lock:
+                if entries is None:
+                    self.list_index_misses += 1
+                else:
+                    self.list_index_hits += 1
+        if entries is None:
+            entries, rev = via.list_raw(prefix)
+        if lreqs is None and freqs is None:
+            return entries, rev, None
+
+        def match(d) -> bool:
+            if lreqs is not None and not labelutil.selector_matches(
+                    lreqs, (d.get("metadata") or {}).get("labels") or {}):
+                return False
+            if freqs is not None and not field_selector_matches(
+                    freqs, d, resource):
+                return False
+            return True
+
+        return entries, rev, match
+
+    def list_entries(
+        self,
+        via,
+        resource: str,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+    ):
+        """Selector-filtered (key, rev, obj) entries + the source's
+        revision (select_entries, fully filtered)."""
+        entries, rev, match = self.select_entries(
+            via, resource, namespace, label_selector=label_selector,
+            field_selector=field_selector)
+        if match is not None:
+            entries = [e for e in entries if match(e[2])]
+        return entries, rev
+
+    def note_list_continue(self):
+        with self._idx_stats_lock:
+            self.list_continue_rounds += 1
 
     def watch(
         self,

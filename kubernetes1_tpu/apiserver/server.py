@@ -15,6 +15,7 @@ cluster boot embed a full apiserver over the MVCC store with zero setup.
 
 from __future__ import annotations
 
+import bisect
 import json
 import socket
 import threading
@@ -94,6 +95,36 @@ from .auth import (
 from .registry import Registry
 
 WATCH_HEARTBEAT_SECONDS = 5.0
+
+
+def _ratio(hits: int, misses: int) -> float:
+    total = hits + misses
+    return (hits / total) if total else 0.0
+
+
+def encode_continue(rv: str, last_key: str) -> str:
+    """Opaque LIST continue token: the FIRST chunk's resourceVersion (the
+    client's watch-resume anchor, carried through every later token
+    unchanged) + the last storage key served.  Base64url JSON — opaque to
+    clients, versionable by the server."""
+    import base64
+
+    return base64.urlsafe_b64encode(json.dumps(
+        {"rv": str(rv), "k": last_key},
+        separators=(",", ":")).encode()).decode()
+
+
+def decode_continue(token: str):
+    """-> (rv, last_key).  Raises BadRequest on garbage (a corrupt token
+    is a client bug; a STALE token is 410, judged elsewhere)."""
+    import base64
+    import binascii
+
+    try:
+        d = json.loads(base64.urlsafe_b64decode(token.encode()))
+        return str(d["rv"]), str(d["k"])
+    except (ValueError, KeyError, TypeError, binascii.Error) as e:
+        raise BadRequest(f"invalid continue token: {e}") from None
 
 
 class _AdmissionTTLCache:
@@ -677,6 +708,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if parts == ["api", "v1", "bindstream"] and method == "GET":
+                # persistent zero-copy bind leg (client/bindstream.py):
+                # the upgrade rides a GET so it is never shed at accept
+                # (reads aren't), but every ROUND inside the stream
+                # acquires a mutating inflight slot and authorizes as
+                # create pods/binding — stream framing must not become a
+                # side door around overload control or the subresource
+                # permission model
+                self._serve_bindstream(q)
+                return
             resource, ns, name, sub = self._parse_resource_path(parts)
             if resource not in self.master.scheme.by_resource:
                 raise NotFound(f"resource {resource!r} not registered")
@@ -801,7 +842,17 @@ class _Handler(BaseHTTPRequestHandler):
         """LIST from the watch cache: selector predicates run on the raw
         wire dicts and the response body is assembled from per-object
         cached bytes — one serialization per (object, revision) across
-        every list, get, and watch frame that touches it."""
+        every list, get, and watch frame that touches it.
+
+        Pagination (`limit=`/`continue=`): chunks cursor over the sorted
+        storage keys; the opaque token carries the FIRST chunk's
+        resourceVersion (the client's watch-resume anchor — resuming the
+        watch there replays every event the later chunks raced, and the
+        client upserts the re-deliveries idempotently) plus the last key
+        served.  A token whose anchor revision fell below the watch
+        cache's history floor can no longer promise a gap-free relist:
+        410 Expired, clean client restart.  No limit and no token keeps
+        the exact single-body path — byte-identical wire at shards=1."""
         master = self.master
         scheme = master.scheme
         reg = master.registry
@@ -810,34 +861,95 @@ class _Handler(BaseHTTPRequestHandler):
         kind = scheme.by_resource[resource].KIND + "List"
         ver = getattr(self, "_req_version", "")
         try:
-            dicts, rev = reg.list_raw(master.cacher, resource, ns,
-                                      label_selector=label_selector,
-                                      field_selector=field_selector)
+            limit = int(q.get("limit") or 0)
+        except ValueError:
+            raise BadRequest(f"invalid limit {q.get('limit')!r}") from None
+        if limit < 0:
+            raise BadRequest(f"limit must be >= 0, got {limit}")
+        token = q.get("continue", "")
+        anchor_rv, start_key = ("", "")
+        if token:
+            anchor_rv, start_key = decode_continue(token)
+            self._check_continue_fresh(anchor_rv)
+        try:
+            entries, rev, match = reg.select_entries(
+                master.cacher, resource, ns,
+                label_selector=label_selector,
+                field_selector=field_selector)
         except CacheNotReady:
-            # authoritative fallback: decoded store list + per-item encode
-            items, rev = reg.list(resource, ns,
-                                  label_selector=label_selector,
-                                  field_selector=field_selector)
-            encoded = [self._enc(o) for o in items]
-            list_version = (encoded[0]["apiVersion"] if encoded
-                            else ver or "v1")
-            self._send_json(200, {
-                "kind": kind,
-                "apiVersion": list_version,
-                "metadata": {"resourceVersion": str(rev)},
-                "items": encoded,
-            })
-            return
+            # authoritative fallback: raw store entries through the same
+            # selector+pagination path (the store has no selector indexes
+            # — unindexed scan — but the wire contract stays whole)
+            entries, rev, match = reg.select_entries(
+                master.store, resource, ns,
+                label_selector=label_selector,
+                field_selector=field_selector)
+        next_token = ""
+        if start_key:
+            # entries are key-sorted (store and cache both list sorted):
+            # bisect to strictly after the last served key — a continue
+            # chunk must not re-walk the already-served head
+            entries = entries[bisect.bisect_right(
+                [e[0] for e in entries], start_key):]
+            master.registry.note_list_continue()
+        if limit:
+            # lazy filtering: stop at limit+1 survivors — a chunk costs
+            # O(entries scanned to fill it), never a full-collection
+            # selector pass per continue round
+            page, more = [], False
+            for e in entries:
+                if match is not None and not match(e[2]):
+                    continue
+                if len(page) == limit:
+                    more = True
+                    break
+                page.append(e)
+            entries = page
+            if more:
+                # the anchor rv is minted by the FIRST chunk and carried
+                # through unchanged — it is the rv the informer will
+                # resume its watch from, so it must predate everything
+                # pagination might miss
+                next_token = encode_continue(anchor_rv or str(rev),
+                                             entries[-1][0])
+        elif match is not None:
+            entries = [e for e in entries if match(e[2])]
+        dicts = [d for _k, _r, d in entries]
         # the List envelope carries the version the items are encoded in —
         # envelope/items disagreement breaks version-trusting decoders
         list_version = (scheme.converted_api_version(dicts[0], ver)
                         if dicts else ver or "v1")
+        meta = '"resourceVersion":"%s"' % rev
+        if next_token:
+            meta += ',"continue":"%s"' % next_token
         head = ('{"kind":"%s","apiVersion":"%s",'
-                '"metadata":{"resourceVersion":"%s"},"items":['
-                % (kind, list_version, rev)).encode()
+                '"metadata":{%s},"items":['
+                % (kind, list_version, meta)).encode()
         body = head + b",".join(
             scheme.encode_bytes(d, ver) for d in dicts) + b"]}"
         self._send_raw_json(200, body)
+
+    def _check_continue_fresh(self, anchor_rv: str):
+        """410 a continue token whose watch-resume anchor can no longer
+        be served gap-free.  Parts below the shard count are empty-shard
+        floor sentinels (the plan_resume rule) — nothing to check."""
+        try:
+            parsed = parse_rv(anchor_rv)
+        except ValueError:
+            raise BadRequest(
+                f"invalid continue token revision {anchor_rv!r}") from None
+        floors = self.master.cacher.compacted_revisions()
+        parts = parsed if isinstance(parsed, tuple) else (parsed,)
+        if len(parts) != len(floors):
+            raise TooOldResourceVersion(
+                f"continue token arity {len(parts)} does not match shard "
+                f"count {len(floors)}; restart the list")
+        n = len(floors)
+        for p, floor in zip(parts, floors):
+            if p >= n and p < floor:
+                raise TooOldResourceVersion(
+                    f"continue token revision {p} compacted "
+                    f"(floor {floor}); restart the list")
 
     # --------------------------------------- kubelet proxy (exec/logs/etc.)
 
@@ -947,6 +1059,113 @@ class _Handler(BaseHTTPRequestHandler):
             streams.splice(client_sock, upstream)
         finally:
             upstream.close()
+
+    def _serve_bindstream(self, q):
+        """Persistent bulk-bind stream (the scheduler's zero-copy bind
+        leg): after the ktpu-bind Upgrade handshake, the connection
+        speaks length-prefixed codec frames both ways (storage/wire.
+        BinFramer — the store wire's framing).  One request frame = one
+        bindings:batch round through the SAME registry path as the HTTP
+        endpoint; per-round outcomes ship back as one response frame.
+
+        Failure semantics: a frame dispatches only when complete, so a
+        client dying mid-send can never half-bind; a torn/overlong frame
+        or clean close ends the stream (the client falls back to the
+        per-request HTTP path).  Per-round errors — authz, shed (429 +
+        retryAfterSeconds), malformed envelope — answer an {"error"}
+        frame on a healthy stream."""
+        from ..machinery.codec import CodecError, known_codecs
+        from ..storage.wire import BinFramer
+        from ..utils import streams as _streams
+
+        codec_id = q.get("codec", "json")
+        if codec_id not in known_codecs():
+            raise BadRequest(f"unsupported bind stream codec {codec_id!r}")
+        sock = _streams.accept_upgrade(self, proto="ktpu-bind")
+        if sock is None:
+            raise BadRequest(
+                "expected Connection: Upgrade, Upgrade: ktpu-bind")
+        master = self.master
+        f = sock.makefile("rwb")
+        framer = BinFramer(f, codec_id, site="apiserver.bindstream")
+        try:
+            while not master.stopping.is_set():
+                try:
+                    req = framer.recv()
+                except (ConnectionError, CodecError, OSError, ValueError):
+                    break  # client gone, or a torn/corrupt frame
+                try:
+                    resp = self._bindstream_round(req)
+                except ApiError as e:
+                    resp = {"error": e.to_status()}
+                except Exception as e:  # noqa: BLE001 — keep the stream up
+                    traceback.print_exc()
+                    resp = {"error": ApiError(str(e)).to_status()}
+                try:
+                    framer.send(resp)
+                except (ConnectionError, OSError):
+                    break
+        finally:
+            try:
+                f.close()
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _bindstream_round(self, req) -> Dict[str, Any]:
+        """One bulk-bind round: authorize (create pods/binding in the
+        envelope's namespace — the bindings:batch rule), shed past the
+        mutating inflight bound, commit through Registry.bind_batch."""
+        master = self.master
+        ns = str(req.get("namespace") or "")
+        items = req.get("items")
+        if not isinstance(items, list) or not items:
+            raise BadRequest("bind stream round requires items")
+        self._authz(self._user, "create", "pods", ns, "", "binding")
+        limiter = master.inflight
+        if not limiter.acquire("POST"):
+            err = TooManyRequests(
+                "apiserver overloaded: too many in-flight mutating "
+                "requests; retry after the indicated backoff")
+            retry_after = limiter.retry_after()
+            flightrec.note("apiserver", flightrec.SHED_429,
+                           method="BINDSTREAM", path="/api/v1/bindstream",
+                           retry_after=round(retry_after, 3))
+            status = err.to_status()
+            status["retryAfterSeconds"] = round(retry_after, 3)
+            return {"error": status}
+        try:
+            bindings = []
+            for d in items:
+                obj = master.scheme.decode(d)
+                if getattr(obj, "KIND", "") != "Binding":
+                    raise BadRequest(
+                        f"bind stream items must be Binding, got "
+                        f"{d.get('kind') if isinstance(d, dict) else d!r}")
+                # the round was authorized against the ENVELOPE namespace;
+                # an item naming another namespace would commit where the
+                # authz check never looked (bind_batch falls back to the
+                # item's own metadata.namespace)
+                # (an EMPTY envelope namespace authorized cluster-wide,
+                # where cross-namespace items are the legitimate shape)
+                item_ns = obj.metadata.namespace
+                if ns and item_ns and item_ns != ns:
+                    raise Forbidden(
+                        f"binding {obj.metadata.name!r} names namespace "
+                        f"{item_ns!r}; the round authorized {ns!r}")
+                bindings.append(obj)
+            outcomes = master.registry.bind_batch(ns, bindings)
+        finally:
+            limiter.release("POST")
+        master.audit("bind", "pods", ns, f"bindstream[{len(bindings)}]",
+                     self._user.name)
+        return {"results": [
+            {"kind": "Status", "apiVersion": "v1", "status": "Success"}
+            if e is None else e.to_status() for e in outcomes
+        ]}
 
     def _serve_watch(self, resource, ns, q):
         try:
@@ -1174,6 +1393,20 @@ class _Handler(BaseHTTPRequestHandler):
             "# TYPE ktpu_bind_device_conflicts_total counter",
             f"ktpu_bind_device_conflicts_total "
             f"{master.registry.device_claim_conflicts}",
+            # selector-LIST index + pagination economics (the 5000-node
+            # read-path envelope): hits served in O(matches) off the
+            # watch-cache secondary index, misses scanned the collection
+            "# TYPE ktpu_list_index_hits_total counter",
+            f"ktpu_list_index_hits_total {master.registry.list_index_hits}",
+            "# TYPE ktpu_list_index_misses_total counter",
+            f"ktpu_list_index_misses_total "
+            f"{master.registry.list_index_misses}",
+            "# TYPE ktpu_list_index_hit_ratio gauge",
+            f"ktpu_list_index_hit_ratio "
+            f"{_ratio(master.registry.list_index_hits, master.registry.list_index_misses):.6f}",
+            "# TYPE ktpu_list_continue_total counter",
+            f"ktpu_list_continue_total "
+            f"{master.registry.list_continue_rounds}",
         ]
         # cacher freshness-wait lag (obs plane): how long LIST/GET reads
         # blocked for watch-cache freshness.  Sharded cachers render a
@@ -1201,7 +1434,15 @@ class _Handler(BaseHTTPRequestHandler):
             # /metrics.  Exactly one Master per process renders them
             # (render_client_metrics) so a fleet merge over co-located
             # apiservers never double-counts.
+            from ..client import bindstream as _bindstream
+
             extra.append(_client_retry.retries_total.render().rstrip("\n"))
+            extra.append(
+                _bindstream.bindstream_frames_total.render().rstrip("\n"))
+            extra.append(
+                _bindstream.bindstream_bytes_total.render().rstrip("\n"))
+            extra.append(
+                _bindstream.bindstream_fallbacks_total.render().rstrip("\n"))
             extra.append(
                 _informer.informer_relists_total.render().rstrip("\n"))
             extra.append(
@@ -1301,6 +1542,17 @@ class _Handler(BaseHTTPRequestHandler):
                     raise BadRequest(
                         f"bindings:batch items must be Binding, got "
                         f"{d.get('kind')!r}")
+                # authorized against the URL namespace only: an item
+                # naming another namespace would commit where the authz
+                # check never looked (the scheduler groups bulk binds by
+                # namespace, so legitimate traffic never trips this)
+                # (the no-namespace URL form authorized cluster-wide,
+                # where cross-namespace items are the legitimate shape)
+                item_ns = obj.metadata.namespace
+                if ns and item_ns and item_ns != ns:
+                    raise Forbidden(
+                        f"binding {obj.metadata.name!r} names namespace "
+                        f"{item_ns!r}; the request authorized {ns!r}")
                 bindings.append(obj)
             if not bindings:
                 raise BadRequest("bindings:batch requires items")

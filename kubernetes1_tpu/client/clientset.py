@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..api import types as t
+from ..machinery import TooOldResourceVersion
 from ..machinery.scheme import Scheme, global_scheme
 from .rest import ApiClient, WatchStream
 
@@ -70,16 +71,76 @@ class ResourceClient:
         namespace: str = "",
         label_selector: str = "",
         field_selector: str = "",
+        limit: int = 0,
     ) -> Tuple[List[Any], str]:
+        """limit=0 (default): one request, the whole collection — the
+        wire today.  limit>0: paginated — continue tokens are followed
+        until the collection is exhausted and the returned rv is the
+        FIRST chunk's: a watch resumed there replays every event the
+        later chunks raced, so list+watch stays lossless (re-deliveries
+        upsert idempotently).  A stale token (410 — the anchor revision
+        aged out of the server's watch window mid-pagination) restarts
+        the pagination from scratch; if tokens keep going stale the last
+        resort is one unpaginated request, which cannot go stale."""
+        if not limit:
+            items, rv, _cont = self.list_page(
+                namespace, label_selector=label_selector,
+                field_selector=field_selector)
+            return items, rv
+        for _attempt in range(3):
+            try:
+                return self._list_paged(namespace, label_selector,
+                                        field_selector, limit)
+            except TooOldResourceVersion:
+                continue  # stale continue token: clean restart
+        items, rv, _cont = self.list_page(
+            namespace, label_selector=label_selector,
+            field_selector=field_selector)
+        return items, rv
+
+    def _list_paged(self, namespace, label_selector, field_selector,
+                    limit) -> Tuple[List[Any], str]:
+        items: List[Any] = []
+        first_rv = ""
+        cont = ""
+        while True:
+            page, rv, cont = self.list_page(
+                namespace, label_selector=label_selector,
+                field_selector=field_selector, limit=limit,
+                continue_token=cont)
+            items.extend(page)
+            if not first_rv:
+                first_rv = rv
+            if not cont:
+                return items, first_rv
+
+    def list_page(
+        self,
+        namespace: str = "",
+        label_selector: str = "",
+        field_selector: str = "",
+        limit: int = 0,
+        continue_token: str = "",
+    ) -> Tuple[List[Any], str, str]:
+        """One LIST chunk: (items, rv, continue_token) — empty token
+        means the collection is exhausted.  Raises TooOldResourceVersion
+        (410) when a presented token went stale; servers without
+        pagination ignore the params and answer everything with no
+        token, so a paginating client degrades to one big chunk."""
         params = {}
         if label_selector:
             params["labelSelector"] = label_selector
         if field_selector:
             params["fieldSelector"] = field_selector
+        if limit:
+            params["limit"] = str(int(limit))
+        if continue_token:
+            params["continue"] = continue_token
         data = self.api.request("GET", self._path(namespace), params=params)
         items = [self.scheme.decode(d) for d in data.get("items", [])]
-        rv = (data.get("metadata") or {}).get("resourceVersion", "0")
-        return items, rv
+        meta = data.get("metadata") or {}
+        return (items, meta.get("resourceVersion", "0"),
+                meta.get("continue", ""))  # ktpulint: ignore[KTPU009] ListMeta wire shape — list envelopes carry continue/resourceVersion, no registered dataclass models them
 
     def update(self, obj):
         ns = obj.metadata.namespace
@@ -136,7 +197,8 @@ class ResourceClient:
 class Clientset:
     def __init__(self, url: str, token: str = "", scheme: Optional[Scheme] = None,
                  ca_file: str = "", cert_file: str = "", key_file: str = "",
-                 insecure: bool = False, bind_codec: str = "json"):
+                 insecure: bool = False, bind_codec: str = "json",
+                 bind_stream: bool = False):
         self.api = ApiClient(url, token=token, ca_file=ca_file,
                              cert_file=cert_file, key_file=key_file,
                              insecure=insecure)
@@ -155,6 +217,32 @@ class Clientset:
             get_codec(bind_codec)  # typo'd codec fails at construction
         self.bind_codec = bind_codec
         self._bind_codec_ok = True
+        # persistent zero-copy bind leg (--bind-stream): bulk binds ride
+        # length-prefixed frames over one upgraded connection per bind
+        # worker instead of full HTTP per round; ANY stream failure falls
+        # back to the per-request path below for that batch
+        # (client/bindstream.py owns the contract)
+        self._bind_stream = None
+        if bind_stream:
+            self.enable_bind_stream()
+
+    def enable_bind_stream(self):
+        """Turn on the persistent bind-stream fast path (idempotent;
+        uses the clientset's bind_codec for the frame payloads)."""
+        if self._bind_stream is None:
+            from .bindstream import BindStream
+
+            self._bind_stream = BindStream(self.api, codec_id=self.bind_codec)
+        return self._bind_stream
+
+    def prefers_bulk_bind(self) -> bool:
+        """True when even a SINGLE bind is cheaper through bind_batch —
+        i.e. the persistent bind stream is live (one frame beats one
+        HTTP round-trip; the scheduler's bind loop asks this so the
+        steady-state trickle rides the zero-copy leg too, not just
+        bursts)."""
+        return self._bind_stream is not None \
+            and not self._bind_stream.unsupported
 
     @classmethod
     def from_config(cls, path: str, scheme: Optional[Scheme] = None) -> "Clientset":
@@ -336,6 +424,30 @@ class Clientset:
 
         path = f"/api/v1/namespaces/{namespace}/pods/bindings:batch"
         items = [self.scheme.encode(b) for b in bindings]
+        stream = self._bind_stream
+        if stream is not None and not stream.unsupported:
+            # zero-copy leg: one length-prefixed frame each way over the
+            # persistent per-thread connection.  ANY failure — transport,
+            # torn frame, a whole-round server error — takes the HTTP
+            # path below for THIS batch (counted loud: a fleet silently
+            # off its fast path is an unexplained throughput loss).
+            try:
+                results = stream.bind_batch(namespace, items)
+                return [None if r.get("status") == "Success"
+                        else ApiError.from_status(r) for r in results]
+            except (ApiError, ConnectionError, OSError) as e:
+                from .bindstream import bindstream_fallbacks_total
+
+                bindstream_fallbacks_total.inc()
+                # an in-band shed carries the server's backoff hint:
+                # honor it BEFORE the HTTP fallback, or every shed round
+                # becomes two back-to-back submissions against an
+                # apiserver that just said it is overloaded
+                retry_after = getattr(e, "retry_after", None)
+                if retry_after:
+                    import time as _time
+
+                    _time.sleep(min(float(retry_after), 2.0))
         data = None
         if self.bind_codec != "json" and self._bind_codec_ok:
             from ..machinery.codec import get_codec
@@ -381,4 +493,6 @@ class Clientset:
         return self.scheme.decode(data)
 
     def close(self):
+        if self._bind_stream is not None:
+            self._bind_stream.close()
         self.api.close()

@@ -41,6 +41,13 @@ informer_reconnects_total = Counter(
     "ktpu_informer_reconnects_total",
     "informer mid-stream watch re-dials (resumed from last rv)")
 
+# Default relist chunk size (client-go's reflector pages at 500 too): a
+# 150k-pod relist arrives as bounded chunks instead of one giant body —
+# the LIST rv stays the FIRST chunk's, so the watch that follows replays
+# anything the later chunks raced (idempotent upserts).  0 disables
+# pagination (one request, today's wire).
+DEFAULT_RELIST_LIMIT = 500
+
 # Watch-lag SLI: delivered-at minus committed-at per group-commit batch,
 # labeled by the OWNING SHARD (rev % stride — composite-rv-aware).  The
 # stamp rides watch-lag bookmark frames the informer opts into
@@ -63,12 +70,14 @@ class SharedInformer:
         label_selector: str = "",
         field_selector: str = "",
         resync_period: float = 0.0,
+        relist_limit: int = DEFAULT_RELIST_LIMIT,
     ):
         self.client = client
         self.namespace = namespace
         self.label_selector = label_selector
         self.field_selector = field_selector
         self.resync_period = resync_period
+        self.relist_limit = max(0, int(relist_limit))
         self._cache: Dict[str, Any] = {}
         self._lock = locksan.make_rlock("SharedInformer._lock")
         # observability: how often this informer had to fall back to a
@@ -202,6 +211,7 @@ class SharedInformer:
             namespace=self.namespace,
             label_selector=self.label_selector,
             field_selector=self.field_selector,
+            limit=self.relist_limit,
         )
         fresh = {self._key(o): self._shared(o) for o in items}
         with self._lock:

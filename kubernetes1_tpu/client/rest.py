@@ -315,6 +315,37 @@ class ApiClient:
             raise err
         return data
 
+    def upgrade(self, path: str, proto: str,
+                timeout: float = 30.0) -> socket.socket:
+        """Perform an HTTP Upgrade handshake against the active server and
+        return the raw socket (the persistent bind-stream leg rides this).
+        Connection-level failures rotate through the HA server list like
+        request(); an UpgradeRefused (the server is alive but answered a
+        real status — an older apiserver's 404) surfaces to the caller
+        undisturbed so it can stick to its fallback path."""
+        from ..utils import streams as _streams
+
+        headers = {k: v for k, v in self._headers().items()
+                   if k not in ("Content-Type", "Accept")}
+        backoff = _retry.Backoff(base=0.02, cap=0.5)
+        attempts = max(1, len(self._servers))
+        for attempt in range(attempts):
+            idx = self._active
+            host, port = self._servers[idx]
+            try:
+                return _streams.upgrade_request(
+                    host, port, path, headers, timeout=timeout,
+                    ssl_context=self.ssl_context, proto=proto)
+            except _streams.UpgradeRefused:
+                raise  # a live server's real answer: no failover
+            except (ConnectionError, OSError):
+                self._rotate(idx)
+                if attempt == attempts - 1:
+                    raise
+                _retry.note_retry("transport")
+                backoff.sleep()
+        raise ConnectionError(f"upgrade {path}: no server reachable")
+
     def watch(
         self, path: str, params: Optional[Dict[str, str]] = None
     ) -> WatchStream:

@@ -32,6 +32,12 @@ def main():
     ap.add_argument("--owned-shards", default="",
                     help="comma list of shard indices to own STATICALLY "
                          "instead of via shard leases (manual partition)")
+    ap.add_argument("--bind-stream", action="store_true",
+                    help="ship bulk binds as length-prefixed frames over "
+                         "one persistent upgraded connection per bind "
+                         "worker (the zero-copy bind leg) instead of "
+                         "full HTTP per round; any stream failure falls "
+                         "back to the per-request path")
     ap.add_argument("--bind-codec", default="json",
                     help="bindings:batch body codec (json | pybin1): "
                          "pybin1 ships the bulk-bind envelope as one "
@@ -63,6 +69,8 @@ def main():
 
         get_codec(args.bind_codec)  # typo'd codec fails at startup
         cs.bind_codec = args.bind_codec
+    if args.bind_stream:
+        cs.enable_bind_stream()
     owned = None
     if args.owned_shards:
         owned = [int(s) for s in args.owned_shards.split(",") if s.strip()]
@@ -85,10 +93,15 @@ def main():
     from ..client import informer as _informer
     from ..client import retry as _retry
 
+    from ..client import bindstream as _bindstream
+
     sched.metrics.register(_retry.retries_total)
     sched.metrics.register(_informer.informer_relists_total)
     sched.metrics.register(_informer.informer_reconnects_total)
     sched.metrics.register(_informer.informer_lag_seconds)
+    sched.metrics.register(_bindstream.bindstream_frames_total)
+    sched.metrics.register(_bindstream.bindstream_bytes_total)
+    sched.metrics.register(_bindstream.bindstream_fallbacks_total)
     stop = threading.Event()
 
     if args.leader_elect:

@@ -192,13 +192,22 @@ class NodeInfo:
                 )
 
     def remove_pod(self, pod: t.Pod):
-        key = pod.key()
-        if key not in self.pods:
+        # Release what add_pod ACCOUNTED — the STORED object, never the
+        # caller's.  The two can differ whenever a delete races a bind:
+        # the cache holds the scheduler's assumed pod (chips assigned)
+        # while the watch's DELETED event carries the unbound version
+        # (no assignment).  Releasing the event object's empty chip list
+        # leaked the assumed refcounts permanently — forget_pod can't
+        # release them either once _pod_node was popped here — and a
+        # whole slice's chips could wedge "in use" with no holder
+        # (observed as the gang-recovery chip-death flake: every
+        # replacement attempt found zero free chips forever).
+        stored = self.pods.pop(pod.key(), None)
+        if stored is None:
             return
-        del self.pods[key]
-        self.requested_milli_cpu -= pod_request_milli_cpu(pod)
-        self.requested_memory -= pod_request_memory(pod)
-        for per in pod.spec.extended_resources:
+        self.requested_milli_cpu -= pod_request_milli_cpu(stored)
+        self.requested_memory -= pod_request_memory(stored)
+        for per in stored.spec.extended_resources:
             if per.assigned and per.resource in self.extended:
                 self.extended[per.resource].release(per.assigned)
 

@@ -863,14 +863,18 @@ class Scheduler:
                 # are bulk-envelope fallbacks that must not re-batch
                 for it in singles:
                     self._bind_one(it)
-                if len(bulk) == 1:
+                if len(bulk) == 1 and not self.cs.prefers_bulk_bind():
+                    # one singleton POST beats a one-item bulk envelope —
+                    # unless the clientset has a live bind stream, where
+                    # a single frame beats the HTTP round-trip and the
+                    # steady-state trickle rides the zero-copy leg too
                     self._bind_one(bulk[0])
                 elif bulk:
                     by_ns: Dict[str, List[_BindItem]] = defaultdict(list)
                     for it in bulk:
                         by_ns[it.pod.metadata.namespace].append(it)
                     for ns, group in by_ns.items():
-                        if len(group) == 1:
+                        if len(group) == 1 and not self.cs.prefers_bulk_bind():
                             self._bind_one(group[0])
                         else:
                             self._bind_many(ns, group)

@@ -64,6 +64,57 @@ from .store import (
 # buys little here.
 DEFAULT_CACHER_HISTORY_LIMIT = 16384
 
+# ------------------------------------------------------------ selector indexes
+#
+# Declared field-selector indexes (ref: cacher.go's storage.IndexerFuncs —
+# upstream indexes pods by spec.nodeName so a kubelet's LIST is O(its
+# pods), not O(all pods)).  Registration is MODULE-LEVEL and happens at
+# import: every cacher in the process (and every apiserver over the same
+# store) maintains the identical index set, so routing a LIST through any
+# peer gives the same complexity.  The invariant the design rests on:
+# indexed collections update their index in the SAME critical section as
+# the cache apply (_apply_batch_locked under _cond), so an index lookup
+# can never observe a key the data map doesn't (or vice versa).
+#
+# The index is a pure CANDIDATE NARROWING: readers re-check the full
+# selector on the bucket's entries, so a registered extractor that ever
+# disagreed with the registry's field matcher could cost false positives
+# (filtered out) but correctness never depends on parity — only the
+# no-false-NEGATIVES property, which holds because both sides read the
+# same dotted wire path with the same default.
+_SELECTOR_INDEXES: Dict[str, Dict[str, str]] = {}
+
+
+def register_selector_index(resource: str, field: str, default: str = ""):
+    """Declare `field` (dotted wire path, e.g. "spec.nodeName") indexed
+    for `resource`.  `default` is the bucket value for objects missing
+    the field — it must match the registry's field-selector default for
+    the same (resource, field) or indexed lookups under-report."""
+    _SELECTOR_INDEXES.setdefault(resource, {})[field] = default
+
+
+def selector_indexes(resource: str) -> Dict[str, str]:
+    """field -> missing-value default for the resource ({} = unindexed)."""
+    return _SELECTOR_INDEXES.get(resource, {})
+
+
+def index_value(d: Dict[str, Any], field: str, default: str = "") -> str:
+    """Extract the indexed field's bucket value from an encoded wire dict
+    (dotted camelCase path; missing -> default).  Mirrors the registry's
+    field_get walk for plain (non-defaulted) fields."""
+    cur: Any = d
+    for part in field.split("."):
+        if not isinstance(cur, dict):
+            cur = None
+            break
+        cur = cur.get(part)
+    return default if cur is None else str(cur)
+
+
+# the mandatory index: at 150k pods a kubelet's spec.nodeName LIST must
+# be O(its pods) — the k8s cacher precedent this module cites above
+register_selector_index("pods", "spec.nodeName")
+
 
 class CacheNotReady(Exception):
     """The cache cannot answer a fresh read right now (still seeding, or
@@ -104,6 +155,10 @@ class Cacher:
         self._cond = locksan.make_condition(name="storage.Cacher._cond")
         self._data: Dict[str, Tuple[int, Dict[str, Any]]] = {}
         self._by_collection: Dict[str, set] = {}
+        # secondary selector indexes (guarded by _cond, updated in the
+        # same critical section as the data map — see module docstring):
+        # collection -> field -> value -> set(keys)
+        self._indexes: Dict[str, Dict[str, Dict[str, set]]] = {}
         self._history: List[Tuple[int, str, str, Dict[str, Any]]] = []
         self._rev = 0
         self._compacted_rev = 0
@@ -191,9 +246,11 @@ class Cacher:
                 self.reseeds += 1
             self._data = {key: (r, obj) for key, r, obj in entries}
             self._by_collection = {}
-            for key in self._data:
-                self._by_collection.setdefault(
-                    _collection_of(key), set()).add(key)
+            self._indexes = {}
+            for key, (_r, obj) in self._data.items():
+                coll = _collection_of(key)
+                self._by_collection.setdefault(coll, set()).add(key)
+                self._index_add_locked(coll, key, obj)
             self._history = []
             self._rev = rev
             self._compacted_rev = rev
@@ -225,15 +282,20 @@ class Cacher:
         Callers notify _cond once per batch."""
         events = []
         for rev, typ, key, obj in records:
+            coll = _collection_of(key)
             if typ == DELETED:
-                self._data.pop(key, None)
-                coll = self._by_collection.get(_collection_of(key))
-                if coll is not None:
-                    coll.discard(key)
+                old = self._data.pop(key, None)
+                keys = self._by_collection.get(coll)
+                if keys is not None:
+                    keys.discard(key)
+                if old is not None:
+                    self._index_remove_locked(coll, key, old[1])
             else:
+                old = self._data.get(key)
                 self._data[key] = (rev, obj)
-                self._by_collection.setdefault(
-                    _collection_of(key), set()).add(key)
+                self._by_collection.setdefault(coll, set()).add(key)
+                self._index_update_locked(
+                    coll, key, None if old is None else old[1], obj)
             self._history.append((rev, typ, key, obj))
             if rev > self._rev:
                 self._rev = rev
@@ -252,6 +314,56 @@ class Cacher:
             evicted = evicted or w.evicted
         if evicted:
             self._watchers = [w for w in self._watchers if not w.evicted]
+
+    # ------------------------------------------------------------- indexes
+
+    def _index_add_locked(self, coll: str, key: str, obj: Dict[str, Any]):
+        specs = _SELECTOR_INDEXES.get(coll)
+        if not specs:
+            return
+        fields = self._indexes.setdefault(coll, {})
+        for field, default in specs.items():
+            fields.setdefault(field, {}).setdefault(
+                index_value(obj, field, default), set()).add(key)
+
+    def _index_remove_locked(self, coll: str, key: str, obj: Dict[str, Any]):
+        specs = _SELECTOR_INDEXES.get(coll)
+        if not specs:
+            return
+        fields = self._indexes.get(coll)
+        if fields is None:
+            return
+        for field, default in specs.items():
+            buckets = fields.get(field)
+            if buckets is None:
+                continue
+            val = index_value(obj, field, default)
+            bucket = buckets.get(val)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del buckets[val]
+
+    def _index_update_locked(self, coll: str, key: str,
+                             old: Optional[Dict[str, Any]],
+                             new: Dict[str, Any]):
+        specs = _SELECTOR_INDEXES.get(coll)
+        if not specs:
+            return
+        fields = self._indexes.setdefault(coll, {})
+        for field, default in specs.items():
+            newv = index_value(new, field, default)
+            buckets = fields.setdefault(field, {})
+            if old is not None:
+                oldv = index_value(old, field, default)
+                if oldv == newv:
+                    continue  # unchanged: the common status-update case
+                bucket = buckets.get(oldv)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del buckets[oldv]
+            buckets.setdefault(newv, set()).add(key)
 
     # ------------------------------------------------- pump (remote store)
 
@@ -410,6 +522,34 @@ class Cacher:
                 entries.append((key, ent[0], obj))
             return entries, self._rev
 
+    def list_raw_indexed(self, prefix: str, field: str, value: str):
+        """Fresh (key, rev, obj) entries under prefix whose indexed
+        `field` extracts to `value`, plus the cache revision — the
+        O(its pods) answer to a kubelet's spec.nodeName LIST.  Returns
+        None when no such index is declared for the collection (callers
+        fall back to the full scan), so an unindexed selector keeps
+        today's path untouched."""
+        coll = _collection_of(prefix)
+        if field not in _SELECTOR_INDEXES.get(coll, {}):
+            return None
+        self.wait_fresh()
+        frozen = mutsan.enabled()
+        with self._cond:
+            bucket = self._indexes.get(coll, {}).get(field, {}).get(value)
+            if not bucket:
+                return [], self._rev
+            entries = []
+            for key in sorted(bucket):
+                if not key.startswith(prefix):
+                    continue  # namespace-scoped LIST over a collection index
+                ent = self._data.get(key)
+                if ent is None:
+                    continue
+                obj = mutsan.freeze(ent[1], "Cacher.list_raw_indexed") \
+                    if frozen else ent[1]
+                entries.append((key, ent[0], obj))
+            return entries, self._rev
+
     def get_raw(self, key: str) -> Optional[Dict[str, Any]]:
         """Fresh encoded wire dict for one key; None when absent."""
         self.wait_fresh()
@@ -418,6 +558,14 @@ class Cacher:
             # frozen: shared with the cache and the serialized-bytes cache
             return None if ent is None else mutsan.freeze(
                 ent[1], "Cacher.get_raw")
+
+    def compacted_revisions(self) -> List[int]:
+        """Per-shard history floors (one element here; ShardedCacher
+        returns N).  A continue token whose resume revision fell below
+        the floor can no longer anchor a gap-free relist+watch: the
+        server answers 410 and the client restarts cleanly."""
+        with self._cond:
+            return [self._compacted_rev]
 
     # ---------------------------------------------------------------- watch
 

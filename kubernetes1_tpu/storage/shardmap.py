@@ -586,6 +586,29 @@ class ShardedCacher:
         entries.sort(key=lambda kro: kro[0])
         return entries, format_rv(revs)
 
+    def list_raw_indexed(self, prefix: str, field: str, value: str):
+        """Merged indexed LIST: each shard cacher answers from its own
+        secondary index (None from any shard = the index isn't declared —
+        registration is module-level, so it's all-or-none across shards)
+        and the merge is the list_raw merge over the narrowed sets."""
+        outs = self._store._fan_out([
+            (lambda c=c: c.list_raw_indexed(prefix, field, value))
+            for c in self._shards])
+        if any(o is None for o in outs):
+            return None
+        entries: List[Tuple[str, int, Dict[str, Any]]] = []
+        revs: List[int] = []
+        for e, rev in outs:
+            entries.extend(e)
+            revs.append(rev)
+        entries.sort(key=lambda kro: kro[0])
+        return entries, format_rv(revs)
+
+    def compacted_revisions(self) -> List[int]:
+        """Per-shard history floors, shard order (continue-token
+        staleness: each composite part checks against its own shard)."""
+        return [c.compacted_revisions()[0] for c in self._shards]
+
     # --------------------------------------------------------------- watch
 
     def watch(self, prefix: str, since_rev=0,

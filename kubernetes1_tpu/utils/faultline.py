@@ -47,6 +47,14 @@ Wired sites:
                                                  PUTs, heartbeats, and the
                                                  scheduler's shard-lease
                                                  renew/steal traffic)
+  client.bindstream                             (client/bindstream.py — the
+                                                 persistent zero-copy bind
+                                                 leg: dial, round start, and
+                                                 outbound frame bytes via the
+                                                 BinFramer filter; sever/
+                                                 truncate tear the stream and
+                                                 the batch falls back cleanly
+                                                 to the per-request HTTP path)
   store.rpc / store.watch                       (storage/remote.py op checks
                                                  AND storage/wire.py framer
                                                  sends: on a negotiated

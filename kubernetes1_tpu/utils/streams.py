@@ -92,27 +92,44 @@ def quiet_connection_errors(httpd):
 quiet_tls_errors = quiet_connection_errors
 
 
+class UpgradeRefused(ConnectionError):
+    """The server answered the Upgrade handshake with a real HTTP status
+    instead of 101 — it is alive but does not serve this stream (an older
+    apiserver's 404, an authz 403).  `status` carries the code (0 when
+    the head was unparseable) so callers can distinguish does-not-speak
+    (stick to the fallback path) from transient transport failure."""
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
 def upgrade_request(host: str, port: int, path: str, headers: dict,
-                    timeout: float = 30.0, ssl_context=None) -> socket.socket:
+                    timeout: float = 30.0, ssl_context=None,
+                    proto: str = UPGRADE_PROTO) -> socket.socket:
     """Open a socket (TLS when ssl_context is given), perform the Upgrade
-    handshake, return the socket ready for frames.  Raises ConnectionError
-    on a non-101 response."""
+    handshake, return the socket ready for frames.  Raises UpgradeRefused
+    (a ConnectionError) on a non-101 response."""
     sock = socket.create_connection((host, port), timeout=timeout)
     if ssl_context is not None:
         sock = ssl_context.wrap_socket(sock, server_hostname=host)
     try:
         lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
-                 "Connection: Upgrade", f"Upgrade: {UPGRADE_PROTO}"]
+                 "Connection: Upgrade", f"Upgrade: {proto}"]
         for k, v in headers.items():
             lines.append(f"{k}: {v}")
         sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode())
         status = _read_http_head(sock)
         if " 101 " not in status.split("\r\n", 1)[0] + " ":
             body = status.split("\r\n\r\n", 1)[-1][:300]
-            raise ConnectionError(
-                f"upgrade refused: {status.splitlines()[0] if status else 'EOF'}"
-                + (f" — {body}" if body else "")
-            )
+            first = status.splitlines()[0] if status else "EOF"
+            try:
+                code = int(first.split(" ", 2)[1])
+            except (IndexError, ValueError):
+                code = 0
+            raise UpgradeRefused(
+                f"upgrade refused: {first}" + (f" — {body}" if body else ""),
+                status=code)
         sock.settimeout(None)
         return sock
     except BaseException:
@@ -134,13 +151,13 @@ def _read_http_head(sock: socket.socket) -> str:
     return data.decode(errors="replace")
 
 
-def accept_upgrade(handler) -> Optional[socket.socket]:
+def accept_upgrade(handler, proto: str = UPGRADE_PROTO) -> Optional[socket.socket]:
     """Server side: validate the Upgrade header on a BaseHTTPRequestHandler,
     send 101, and return the hijacked socket (caller owns it afterwards)."""
-    if handler.headers.get("Upgrade", "").lower() != UPGRADE_PROTO:
+    if handler.headers.get("Upgrade", "").lower() != proto:
         return None
     handler.send_response(101, "Switching Protocols")
-    handler.send_header("Upgrade", UPGRADE_PROTO)
+    handler.send_header("Upgrade", proto)
     handler.send_header("Connection", "Upgrade")
     handler.end_headers()
     handler.wfile.flush()

@@ -119,11 +119,16 @@ def _finalize_verdict(verdict: dict) -> dict:
 # Sharded-scheduler schedule: control-plane client faults only (the
 # scheduler's informer, bind POSTs, and shard-lease renew traffic all
 # ride client.*), low enough that both instances keep making progress —
-# the seeded failure is the mid-run scheduler KILL, not the wire.
+# the seeded failure is the mid-run scheduler KILL, not the wire.  The
+# schedulers run with the persistent bind stream ON and its
+# client.bindstream site under fire: a severed/truncated stream must
+# fall back to the per-request HTTP path with zero lost binds (the
+# standing faultline invariant for the new socket boundary).
 SCHED_SPEC = (
     "client.dial=drop@0.03;"
     "client.request=drop@0.03|delay:5ms@0.05;"
-    "client.watch=drop@0.05"
+    "client.watch=drop@0.05;"
+    "client.bindstream=sever@0.08|drop@0.05"
 )
 
 # Sharded-STORE schedule: the apiserver dials each store shard on its own
@@ -830,7 +835,9 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
       - the run actually injected faults (schedule exercised).
     """
     from kubernetes1_tpu.apiserver import Master
-    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.client import Clientset, SharedInformer
+    from kubernetes1_tpu.client import bindstream as _bindstream
+    from kubernetes1_tpu.machinery import AlreadyExists
     from kubernetes1_tpu.scheduler import Scheduler
     from kubernetes1_tpu.scheduler.devices import find_double_allocations
     from kubernetes1_tpu.utils import faultline
@@ -838,10 +845,12 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
 
     spec = SCHED_SPEC if spec is None else spec
     SHARDS, NODES, CHIPS, PODS = 4, 6, 8, 36
-    master = cs = s_a = s_b = None
+    master = cs = s_a = s_b = page_inf = None
     _begin_seed_run()
     verdict = {"mode": "sched-shard", "seed": seed, "spec": spec,
                "ok": False, "acked": 0, "recovery_s": None}
+    bs_frames0 = _bindstream.bindstream_frames_total.value
+    bs_falls0 = _bindstream.bindstream_fallbacks_total.value
     try:
         master = Master().start()
         cs = Clientset(master.url)
@@ -851,10 +860,20 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
                 slice_id=f"cs{i}", host_index=0))
         kw = dict(shards=SHARDS, shard_lease=True,
                   shard_lease_duration=1.5, shard_retry_period=0.3)
-        s_a = Scheduler(Clientset(master.url), identity="chaos-a", **kw)
-        s_b = Scheduler(Clientset(master.url), identity="chaos-b", **kw)
+        # bind_stream=True: the zero-copy leg under seeded sever/drop —
+        # its fallback contract is part of this schedule's verdict
+        s_a = Scheduler(Clientset(master.url, bind_stream=True),
+                        identity="chaos-a", **kw)
+        s_b = Scheduler(Clientset(master.url, bind_stream=True),
+                        identity="chaos-b", **kw)
         s_a.start()
         s_b.start()
+        # a deliberately tiny-chunk paginated informer rides the same
+        # chaos: every relist is a continue-token walk under injected
+        # drops, and the verdict requires its cache to converge LOSSLESS
+        # to the authoritative pod set (the 410/continue restart path)
+        page_inf = SharedInformer(cs.pods, namespace="default",
+                                  relist_limit=4).start()
         # both instances must actually own shards before the storm — the
         # kill is only a steal test if ownership was split to begin with
         deadline = time.monotonic() + 15
@@ -865,7 +884,18 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
                                     sorted(s_b.owned_shards())]
         faultline.activate(seed, spec)
         for i in range(PODS):
-            cs.pods.create(make_tpu_pod(f"cp-{i}", tpus=1))
+            # the storm rides the faulted wire too: a create whose every
+            # dial/redial draw lands on an injected drop must retry, not
+            # kill the schedule (AlreadyExists = an earlier "failed"
+            # attempt actually landed)
+            for _attempt in range(20):
+                try:
+                    cs.pods.create(make_tpu_pod(f"cp-{i}", tpus=1))
+                    break
+                except AlreadyExists:
+                    break  # an earlier "failed" attempt actually landed
+                except Exception:  # noqa: BLE001 — injected blip
+                    time.sleep(0.05)
 
         def bound_count():
             pods, _ = cs.pods.list(namespace="default")
@@ -893,22 +923,47 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
         pods, _ = cs.pods.list(namespace="default")
         bound = [p for p in pods if p.spec.node_name]
         doubles = find_double_allocations(pods)
+        # paginated-informer lossless convergence: with the faults off,
+        # its chunked relists + watch must reach the authoritative state
+        want = {p.metadata.name: p.spec.node_name for p in pods}
+        conv_deadline = time.monotonic() + 10
+        page_converged = False
+        while time.monotonic() < conv_deadline and not page_converged:
+            got = {p.metadata.name: p.spec.node_name
+                   for p in page_inf.list()}
+            page_converged = got == want
+            if not page_converged:
+                time.sleep(0.2)
+        bs_frames = _bindstream.bindstream_frames_total.value - bs_frames0
+        bs_falls = (_bindstream.bindstream_fallbacks_total.value
+                    - bs_falls0)
         verdict.update({
             "acked": len(bound),
             "recovery_s": round(time.monotonic() - kill_t, 2),
             "survivor_shards": sorted(s_b.owned_shards()),
             "double_allocations": len(doubles),
             "bind_conflicts": master.registry.device_claim_conflicts,
+            "bindstream_frames": int(bs_frames),
+            "bindstream_fallbacks": int(bs_falls),
+            "paginated_informer_converged": page_converged,
+            "paginated_relists": page_inf.relists,
             "faults": fault_stats,
             "ok": (len(bound) >= PODS
                    and len(s_b.owned_shards()) == SHARDS
-                   and not doubles),
+                   and not doubles
+                   # the bind leg was actually exercised: rounds rode the
+                   # stream and/or fell back — silence means misconfig
+                   and (bs_frames + bs_falls) > 0
+                   and page_converged),
         })
     finally:
         faultline.deactivate()
+        if page_inf is not None:
+            _stop_quietly_mod(page_inf.stop)
         for comp in (s_b, s_a):
             if comp is not None:
                 _stop_quietly_mod(comp.stop)
+                _stop_quietly_mod(comp.cs.close)
         if cs is not None:
             _stop_quietly_mod(cs.close)
         if master is not None:

@@ -41,7 +41,8 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                    sched_shards: int = 1, wire_codec: str = "json",
                    store_proc: bool = False, store_shards: int = 1,
                    apiservers: int = 1, bind_codec: str = "json",
-                   store_wal: bool = False) -> dict:
+                   store_wal: bool = False,
+                   bind_stream: bool = False) -> dict:
     """multiproc=True runs apiserver and scheduler as separate OS processes
     (the deployment shape) so they get real parallelism; in-process mode
     shares one GIL across every component, which caps the measurable
@@ -170,6 +171,8 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                 sched_args += ["--shards", str(sched_shards)]
             if bind_codec != "json":
                 sched_args += ["--bind-codec", bind_codec]
+            if bind_stream:
+                sched_args += ["--bind-stream"]
             procs.append(subprocess.Popen(
                 sched_args, cwd=repo, env=env,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
@@ -184,7 +187,8 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             # a parallelism win)
             for k in range(sched_shards):
                 scheds.append(Scheduler(
-                    Clientset(url), shards=sched_shards, owned_shards={k},
+                    Clientset(url, bind_stream=bind_stream),
+                    shards=sched_shards, owned_shards={k},
                     identity=f"sched-{k}"))
     obs = None
     if multiproc:
@@ -212,7 +216,7 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                       store_metrics_urls=store_metrics_urls,
                       store_shards=store_shards, apiservers=apiservers,
                       bind_codec=bind_codec, store_wal=store_wal,
-                      obs=obs)
+                      bind_stream=bind_stream, obs=obs)
     finally:
         if obs is not None:
             obs.stop()
@@ -312,11 +316,56 @@ def observability_block(obs) -> Optional[dict]:
     }
 
 
+def _pct(xs, q):
+    """Sorted-index percentile over a sample list (None when empty) —
+    THE shared helper; per-phase closures with bespoke copies drift."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(q * len(xs)))], 6)
+
+
+def _selector_list_probe(api_url: str, nodes: int, samples: int = 24) -> dict:
+    """Same-box selector-LIST latency A/B against the LIVE cluster: the
+    indexed shape is the kubelet's spec.nodeName equality (watch-cache
+    secondary index, O(its pods)); the unindexed shape is an inequality
+    selector on the same field, which the index cannot answer and which
+    therefore walks the full collection — the pre-index cost model.
+    Results are wall p50/p99 per shape plus the server's index counters
+    baked into the read_path block by the caller."""
+    import urllib.request
+
+    def run(selector):
+        lat = []
+        for i in range(samples):
+            target = f"perf-{(i * 7) % max(1, nodes)}"
+            url = (f"{api_url}/api/v1/namespaces/default/pods?"
+                   f"fieldSelector={selector.replace('<node>', target)}")
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    r.read()
+            except OSError:
+                continue
+            lat.append(time.perf_counter() - t0)
+        return lat
+
+    indexed = run("spec.nodeName%3D<node>")
+    unindexed = run("spec.nodeName!%3D__probe_none__")
+    return {
+        "indexed_p50_s": _pct(indexed, 0.5),
+        "indexed_p99_s": _pct(indexed, 0.99),
+        "unindexed_p50_s": _pct(unindexed, 0.5),
+        "unindexed_p99_s": _pct(unindexed, 0.99),
+        "samples": len(indexed),
+    }
+
+
 def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
            scheds, metrics_urls=None, stamp=None, sched_shards=1,
            wire_codec="json", api_urls=None, store_metrics_urls=None,
            store_shards=1, apiservers=1, bind_codec="json",
-           store_wal=False, obs=None) -> dict:
+           store_wal=False, bind_stream=False, obs=None) -> dict:
     api_urls = api_urls or [url]
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
@@ -326,6 +375,8 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         cs.nodes.create(node)
 
     if not multiproc and not scheds:
+        if bind_stream:
+            cs.enable_bind_stream()
         scheds = [Scheduler(cs)]
     for s in scheds:
         s.start()
@@ -483,13 +534,40 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
     # way the schedulers' are (counters sum, gauges/quantiles max): with
     # apiservers > 1 a single-URL scrape silently reported peer 0 only —
     # the same bug the per-shard store counters had before the merge
+    # probe BEFORE the apiserver scrape so its indexed LISTs land in
+    # the scraped hit/miss counters
+    selector_list = _selector_list_probe(api_urls[0], nodes)
     amx = merge_metrics([scrape_metrics(u) for u in api_urls])
+    # per-op read-path economics (the 5000-node envelope, BENCH_r07+):
+    # selector-LIST latency by indexed/unindexed shape measured against
+    # the live cluster, index hit ratio and continue-token rounds off
+    # the merged apiserver /metrics, bind-leg bytes/frames off the
+    # schedulers' (the zero-copy leg's wire cost per bulk request)
+    idx_hits = amx.get("ktpu_list_index_hits_total") or 0
+    idx_misses = amx.get("ktpu_list_index_misses_total") or 0
+    bs_frames = (mx.get("ktpu_bindstream_frames_total")
+                 or amx.get("ktpu_bindstream_frames_total") or 0)
+    bs_bytes = (mx.get("ktpu_bindstream_bytes_total")
+                or amx.get("ktpu_bindstream_bytes_total") or 0)
     read_path = {
         "encode_cache_hit_ratio": amx.get("ktpu_encode_cache_hit_ratio"),
         "encode_cache_hits": amx.get("ktpu_encode_cache_hits_total"),
         "encode_cache_misses": amx.get("ktpu_encode_cache_misses_total"),
         "watch_evictions": amx.get(
             "ktpu_watch_slow_consumer_evictions_total"),
+        "selector_list": selector_list,
+        "list_index_hits": idx_hits,
+        "list_index_misses": idx_misses,
+        "list_index_hit_ratio": (
+            round(idx_hits / (idx_hits + idx_misses), 4)
+            if (idx_hits + idx_misses) else None),
+        "list_continue_rounds": amx.get("ktpu_list_continue_total"),
+        "bindstream_frames": bs_frames,
+        "bindstream_bytes_per_frame": (
+            round(bs_bytes / bs_frames, 1) if bs_frames else None),
+        "bindstream_fallbacks": (
+            mx.get("ktpu_bindstream_fallbacks_total")
+            or amx.get("ktpu_bindstream_fallbacks_total") or 0),
     } if amx else None
 
     # write-path economics (group commit, BENCH_r06 delta vs r05): bind
@@ -581,6 +659,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "sched_shards": sched_shards,
         "wire_codec": wire_codec,
         "bind_codec": bind_codec,
+        "bind_stream": bind_stream,
         "apiservers": apiservers,
         "store_shards": store_shards_block or {"shards": store_shards},
         "bind_device_conflicts": bind_conflicts,
@@ -702,6 +781,10 @@ def main():
     ap.add_argument("--bind-codec", default="json",
                     help="bindings:batch body codec for the schedulers "
                          "(json | pybin1)")
+    ap.add_argument("--bind-stream", action="store_true",
+                    help="schedulers ship bulk binds over the persistent "
+                         "length-prefixed bind stream (the zero-copy "
+                         "bind leg) instead of full HTTP per round")
     ap.add_argument("--store-wal", action="store_true",
                     help="give each store (shard) process a WAL — the "
                          "deployment's durable shape; each shard then "
@@ -715,7 +798,8 @@ def main():
                                     store_shards=args.store_shards,
                                     apiservers=args.apiservers,
                                     bind_codec=args.bind_codec,
-                                    store_wal=args.store_wal)))
+                                    store_wal=args.store_wal,
+                                    bind_stream=args.bind_stream)))
 
 
 if __name__ == "__main__":

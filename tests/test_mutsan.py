@@ -192,7 +192,8 @@ class _FakeResourceClient:
     def __init__(self, items):
         self.items = items
 
-    def list(self, namespace="", label_selector="", field_selector=""):
+    def list(self, namespace="", label_selector="", field_selector="",
+             limit=0):
         return list(self.items), "5"
 
 

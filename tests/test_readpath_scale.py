@@ -1,0 +1,545 @@
+"""5000-node read/write envelope — tier-1 wire-compat coverage.
+
+Three contracts this PR's hot paths must keep as the tree grows:
+
+1. SELECTOR INDEXES narrow, never change: an index-backed
+   spec.nodeName LIST returns exactly the full-scan result — alone,
+   combined with other selector requirements, under concurrent writes,
+   and across the sharded merge.  (The ≥10x speed claim lives in the
+   slow tier; tier-1 asserts equality, which timing noise can't flake.)
+2. PAGINATION is wire-compatible and lossless: shards=1 with no limit=
+   stays byte-identical to the unpaginated response (golden bytes);
+   chunked LISTs union to the unpaginated result; a stale continue
+   token 410s and the client restarts cleanly — an informer relisting
+   in tiny chunks under churn still converges to the authoritative
+   state (the first-chunk-rv watch-resume rule).
+3. The BIND STREAM is an optimization, never a semantic: outcomes match
+   the per-request path, any stream failure (seeded sever included)
+   falls back cleanly with zero lost binds, and a server that refused
+   the upgrade is never probed again.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, SharedInformer
+from kubernetes1_tpu.client import bindstream as bindstream_mod
+from kubernetes1_tpu.machinery import Conflict, TooOldResourceVersion
+from kubernetes1_tpu.utils import faultline
+from kubernetes1_tpu.utils.streams import UpgradeRefused
+
+from tests.helpers import make_node, make_tpu_pod
+from tests.test_machinery import make_pod
+
+
+def _binding(pod_name, node, chips=None, ns="default"):
+    b = t.Binding(target_node=node,
+                  extended_resource_assignments=(
+                      {f"{pod_name}-tpu": chips} if chips else {}))
+    b.metadata.name = pod_name
+    b.metadata.namespace = ns
+    return b
+
+
+def _names(pods):
+    return sorted(p.metadata.name for p in pods)
+
+
+class TestSelectorIndex:
+    def test_indexed_equals_scan(self):
+        """The kubelet-shaped LIST (spec.nodeName=) through the index
+        equals the full scan — alone and combined with label + extra
+        field requirements (the index narrows; every requirement still
+        filters)."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(3):
+                cs.nodes.create(make_node(f"n{i}", tpus=8))
+            for i in range(12):
+                p = make_tpu_pod(f"p{i:02d}", tpus=1)
+                p.metadata.labels = {"par": str(i % 2)}
+                cs.pods.create(p)
+            for i in range(8):  # bind 8 of 12 across 2 nodes
+                cs.bind(
+                    "default", f"p{i:02d}",
+                    _binding(f"p{i:02d}", f"n{i % 2}", [f"n{i % 2}-c{i}"]))
+            reg = master.registry
+            for sel in ("spec.nodeName=n0", "spec.nodeName=n1",
+                        "spec.nodeName=", "spec.nodeName=ghost"):
+                idx, _ = reg.list_raw(master.cacher, "pods", "default",
+                                      field_selector=sel)
+                scan, _ = reg.list_raw(master.store, "pods", "default",
+                                       field_selector=sel)
+                assert idx == scan, sel
+            # combined requirements: index narrows on the equality, the
+            # label + inequality requirements still filter the subset
+            hits0 = reg.list_index_hits
+            idx, _ = reg.list_raw(
+                master.cacher, "pods", "default",
+                label_selector="par=0",
+                field_selector="spec.nodeName=n0,status.phase!=Failed")
+            scan, _ = reg.list_raw(
+                master.store, "pods", "default",
+                label_selector="par=0",
+                field_selector="spec.nodeName=n0,status.phase!=Failed")
+            assert idx == scan and idx
+            assert reg.list_index_hits == hits0 + 1
+            # the HTTP path agrees with the registry
+            pods, _ = cs.pods.list(namespace="default",
+                                   field_selector="spec.nodeName=n0")
+            assert {p.spec.node_name for p in pods} == {"n0"}
+            assert len(pods) == 4  # p00..p07 bound alternating n0/n1
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_indexed_equals_scan_under_concurrent_writes(self):
+        """Churn (create/bind/delete) while reading through the index:
+        every indexed snapshot satisfies the selector, and once writers
+        stop the indexed result is exactly the scan result."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        stop = threading.Event()
+        errors = []
+
+        def writer(wid):
+            try:
+                k = 0
+                while not stop.is_set():
+                    name = f"w{wid}-{k}"
+                    cs.pods.create(make_pod(name))
+                    cs.bind("default", name, _binding(name, f"n{k % 3}"))
+                    if k % 3 == 0:
+                        cs.pods.delete(name)
+                    k += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            for i in range(3):
+                cs.nodes.create(make_node(f"n{i}", tpus=8))
+            threads = [threading.Thread(target=writer, args=(w,),
+                                        daemon=True) for w in range(3)]
+            for th in threads:
+                th.start()
+            reg = master.registry
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                entries, _ = reg.list_entries(
+                    master.cacher, "pods", "default",
+                    field_selector="spec.nodeName=n1")
+                for _k, _r, d in entries:
+                    assert (d.get("spec") or {}).get("nodeName") == "n1"
+            stop.set()
+            for th in threads:
+                th.join(timeout=10)
+            assert not errors, errors
+            for sel in ("spec.nodeName=n0", "spec.nodeName=n1",
+                        "spec.nodeName=n2", "spec.nodeName="):
+                idx, _ = reg.list_raw(master.cacher, "pods", "default",
+                                      field_selector=sel)
+                scan, _ = reg.list_raw(master.store, "pods", "default",
+                                       field_selector=sel)
+                assert idx == scan, sel
+        finally:
+            stop.set()
+            cs.close()
+            master.stop()
+
+    def test_indexed_sharded_merge(self):
+        """Per-shard indexes merge to the same result the sharded scan
+        gives, with a composite rv."""
+        master = Master(store_shards=2).start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(2):
+                cs.nodes.create(make_node(f"n{i}", tpus=8))
+            for i in range(10):
+                cs.pods.create(make_tpu_pod(f"s{i:02d}", tpus=1))
+                cs.bind("default", f"s{i:02d}",
+                        _binding(f"s{i:02d}", f"n{i % 2}",
+                                 [f"n{i % 2}-c{i}"]))
+            reg = master.registry
+            idx, rv_idx = reg.list_raw(master.cacher, "pods", "default",
+                                       field_selector="spec.nodeName=n1")
+            scan, _ = reg.list_raw(master.store, "pods", "default",
+                                   field_selector="spec.nodeName=n1")
+            assert idx == scan and len(idx) == 5
+            assert "." in str(rv_idx)  # composite: one part per shard
+        finally:
+            cs.close()
+            master.stop()
+
+    @pytest.mark.slow
+    def test_index_microbench_10x(self):
+        """The acceptance number: at ≥30k pods the indexed spec.nodeName
+        LIST is ≥10x faster than the full-scan path, identical results.
+        (Measured ~2500x on the dev box; 10x leaves room for load.)"""
+        from kubernetes1_tpu.apiserver.registry import Registry
+        from kubernetes1_tpu.machinery.scheme import global_scheme
+        from kubernetes1_tpu.storage import Cacher, Store
+
+        scheme = global_scheme.copy()
+        store = Store(scheme)
+        reg = Registry(store, scheme)
+        nodes, pods = 600, 30000
+        ops = []
+        for i in range(pods):
+            p = t.Pod()
+            p.metadata.name = f"p{i:05d}"
+            p.metadata.namespace = "default"
+            p.spec.containers = [t.Container(name="c", image="x")]
+            p.spec.node_name = f"node-{i % nodes}"
+            ops.append({"op": "create",
+                        "key": f"/registry/pods/default/p{i:05d}",
+                        "obj": scheme.encode(p)})
+        for i in range(0, pods, 500):
+            store.commit_batch(ops[i:i + 500])
+        cacher = Cacher(store, scheme).start()
+        try:
+            sel = "spec.nodeName=node-7"
+            idx, _ = reg.list_entries(cacher, "pods", "default",
+                                      field_selector=sel)
+            # scan forced through the cacher (inequality can't use the
+            # index): the exact pre-index cost model on the same data
+            scan_sel = "spec.nodeName!=__nobody__"
+
+            def timed(fn, n):
+                best = None
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    fn()
+                    dt = time.perf_counter() - t0
+                    best = dt if best is None else min(best, dt)
+                return best
+
+            t_idx = timed(lambda: reg.list_entries(
+                cacher, "pods", "default", field_selector=sel), 10)
+            t_scan = timed(lambda: reg.list_entries(
+                cacher, "pods", "default", field_selector=scan_sel), 3)
+            assert len(idx) == pods // nodes
+            assert t_scan / t_idx >= 10, \
+                f"indexed {t_idx*1e3:.2f}ms vs scan {t_scan*1e3:.2f}ms"
+        finally:
+            cacher.stop()
+            store.close()
+
+
+class TestPaginatedList:
+    def test_golden_bytes_no_limit(self):
+        """shards=1 + no limit= must stay BYTE-identical to the
+        historical response: head built from the literal format, items
+        spliced from the per-revision serialization cache."""
+        import http.client
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(7):
+                cs.pods.create(make_pod(f"g{i}"))
+            conn = http.client.HTTPConnection(master.host, master.port)
+            conn.request("GET", "/api/v1/namespaces/default/pods")
+            body = conn.getresponse().read()
+            conn.close()
+            entries, rev = master.cacher.list_raw("/registry/pods/default/")
+            assert isinstance(rev, int)  # plain rv — no composite leak
+            head = ('{"kind":"PodList","apiVersion":"v1",'
+                    '"metadata":{"resourceVersion":"%s"},"items":['
+                    % rev).encode()
+            expected = head + b",".join(
+                master.scheme.encode_bytes(d, "v1")
+                for _k, _r, d in entries) + b"]}"
+            assert body == expected
+            # and no continue key anywhere near the plain wire
+            assert b'"continue"' not in body
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_pages_union_to_unpaginated(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(11):
+                cs.pods.create(make_pod(f"u{i:02d}"))
+            whole, rv_whole = cs.pods.list(namespace="default")
+            paged, rv_paged = cs.pods.list(namespace="default", limit=4)
+            assert _names(paged) == _names(whole)
+            # the paginated rv is the FIRST chunk's — presenting it to a
+            # watch replays anything later chunks raced, so it must be a
+            # real revision the server can serve
+            w = cs.pods.watch(namespace="default",
+                              resource_version=rv_paged)
+            w.close()
+            # chunk walk: 11 items at limit 4 = 3 pages, 2 continues
+            rounds0 = master.registry.list_continue_rounds
+            page, rv1, cont = cs.pods.list_page(namespace="default",
+                                                limit=4)
+            seen = list(page)
+            while cont:
+                page, _rv, cont = cs.pods.list_page(
+                    namespace="default", limit=4, continue_token=cont)
+                seen.extend(page)
+            assert _names(seen) == _names(whole)
+            assert master.registry.list_continue_rounds == rounds0 + 2
+            # selector + pagination compose (index-narrowed chunk walk)
+            sel_whole, _ = cs.pods.list(namespace="default",
+                                        field_selector="spec.nodeName=")
+            sel_paged, _ = cs.pods.list(namespace="default",
+                                        field_selector="spec.nodeName=",
+                                        limit=3)
+            assert _names(sel_paged) == _names(sel_whole)
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_limit_must_be_non_negative(self):
+        """A negative limit is a client bug: 400, not a truncated page
+        with a bogus continue token (or a 500 on an empty collection)."""
+        from kubernetes1_tpu.machinery import ApiError
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            with pytest.raises(ApiError) as ei:
+                cs.api.request("GET", "/api/v1/namespaces/default/pods",
+                               params={"limit": "-1"})
+            assert ei.value.code == 400
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_stale_continue_token_410_clean_restart(self):
+        """A token whose anchor revision fell below the watch-cache
+        floor answers 410; the paginating client restarts and still
+        returns the complete, current collection."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(9):
+                cs.pods.create(make_pod(f"s{i:02d}"))
+            _page, _rv, cont = cs.pods.list_page(namespace="default",
+                                                 limit=3)
+            assert cont
+            # age the anchor out of the cache window: shrink the history
+            # ring and churn past it
+            master.cacher._history_limit = 8
+            for i in range(9, 29):
+                cs.pods.create(make_pod(f"s{i:02d}"))
+            with pytest.raises(TooOldResourceVersion):
+                cs.pods.list_page(namespace="default", limit=3,
+                                  continue_token=cont)
+            # the auto-paginating list() restarts and converges: every
+            # pod, exactly once
+            items, _rv = cs.pods.list(namespace="default", limit=3)
+            assert _names(items) == sorted(f"s{i:02d}" for i in range(29))
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_informer_chunked_relist_lossless_under_churn(self):
+        """An informer relisting in tiny chunks while the collection
+        churns converges to the authoritative state: the watch resumes
+        from the FIRST chunk's rv, so deletes/updates that raced later
+        chunks replay instead of ghosting."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        inf = None
+        stop = threading.Event()
+
+        def churner():
+            k = 0
+            while not stop.is_set():
+                name = f"c{k % 17:02d}"
+                try:
+                    if k % 3 == 2:
+                        cs.pods.delete(name)
+                    else:
+                        cs.pods.create(make_pod(name))
+                except Exception:  # noqa: BLE001 — create/delete races itself
+                    pass
+                k += 1
+
+        try:
+            for i in range(8):
+                cs.pods.create(make_pod(f"c{i:02d}"))
+            th = threading.Thread(target=churner, daemon=True)
+            th.start()
+            inf = SharedInformer(cs.pods, namespace="default",
+                                 relist_limit=3).start()
+            assert inf.wait_for_sync(10)
+            time.sleep(1.0)  # churn across several chunked relists
+            stop.set()
+            th.join(timeout=10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                want = {p.metadata.name
+                        for p in cs.pods.list(namespace="default")[0]}
+                got = {p.metadata.name for p in inf.list()}
+                if want == got:
+                    break
+                time.sleep(0.1)
+            assert want == got
+        finally:
+            stop.set()
+            if inf is not None:
+                inf.stop()
+            cs.close()
+            master.stop()
+
+
+class TestBindStream:
+    def _cluster(self, **cs_kw):
+        master = Master().start()
+        cs = Clientset(master.url, **cs_kw)
+        cs.nodes.create(make_node("bn0", tpus=32))
+        cs.nodes.create(make_node("bn1", tpus=32))
+        return master, cs
+
+    def _make_pods(self, cs, lo, hi):
+        for i in range(lo, hi):
+            cs.pods.create(make_tpu_pod(f"bs{i}", tpus=1))
+
+    def _bindings(self, lo, hi, node="bn0"):
+        return [_binding(f"bs{i}", node, [f"{node}-c{i}"])
+                for i in range(lo, hi)]
+
+    def test_outcomes_match_http_path(self):
+        """Stream outcomes are the HTTP outcomes: successes bind, a
+        real conflict (already bound elsewhere) surfaces per item, the
+        stream stays up for the next round."""
+        master, cs = self._cluster(bind_stream=True)
+        try:
+            self._make_pods(cs, 0, 4)
+            f0 = bindstream_mod.bindstream_frames_total.value
+            outcomes = cs.bind_batch("default", self._bindings(0, 4))
+            assert outcomes == [None] * 4
+            assert bindstream_mod.bindstream_frames_total.value == f0 + 1
+            pod = cs.pods.get("bs0")
+            assert pod.spec.node_name == "bn0"
+            assert pod.spec.extended_resources[0].assigned == ["bn0-c0"]
+            # second round on the SAME stream: rebinding bs0 to another
+            # node is a per-item Conflict, neighbors still succeed
+            self._make_pods(cs, 4, 6)
+            mixed = ([_binding("bs0", "bn1", ["bn1-c0"])]
+                     + self._bindings(4, 6))
+            outcomes = cs.bind_batch("default", mixed)
+            assert isinstance(outcomes[0], Conflict)
+            assert outcomes[1:] == [None, None]
+            assert bindstream_mod.bindstream_frames_total.value == f0 + 2
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_fault_fallback_and_recovery(self):
+        """Seeded sever on client.bindstream: the batch falls back to
+        the per-request HTTP path (zero lost binds, fallback counted);
+        after the redial floor the stream comes back."""
+        master, cs = self._cluster(bind_stream=True)
+        try:
+            self._make_pods(cs, 0, 6)
+            assert cs.bind_batch("default", self._bindings(0, 2)) \
+                == [None, None]
+            falls0 = bindstream_mod.bindstream_fallbacks_total.value
+            faultline.activate(99, "client.bindstream=sever@1.0")
+            try:
+                outcomes = cs.bind_batch("default", self._bindings(2, 4))
+            finally:
+                faultline.deactivate()
+            assert outcomes == [None, None]  # fell back, still bound
+            assert bindstream_mod.bindstream_fallbacks_total.value \
+                == falls0 + 1
+            assert cs.pods.get("bs2").spec.node_name == "bn0"
+            time.sleep(bindstream_mod.REDIAL_FLOOR_SECONDS + 0.1)
+            f0 = bindstream_mod.bindstream_frames_total.value
+            assert cs.bind_batch("default", self._bindings(4, 6)) \
+                == [None, None]
+            assert bindstream_mod.bindstream_frames_total.value == f0 + 1
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_unsupported_server_sticky_fallback(self):
+        """A server that answers the upgrade with a real status (an
+        older apiserver's 404) is never probed again: the first batch
+        falls back and later batches go straight to HTTP."""
+        master, cs = self._cluster(bind_stream=True)
+        try:
+            calls = []
+
+            def refusing_upgrade(path, proto, timeout=30.0):
+                calls.append(path)
+                raise UpgradeRefused("upgrade refused: HTTP/1.1 404", 404)
+
+            cs._bind_stream.api = type(
+                "_Api", (), {"upgrade": staticmethod(refusing_upgrade)})()
+            self._make_pods(cs, 0, 4)
+            assert cs.bind_batch("default", self._bindings(0, 2)) \
+                == [None, None]
+            assert cs._bind_stream.unsupported
+            assert len(calls) == 1
+            assert cs.bind_batch("default", self._bindings(2, 4)) \
+                == [None, None]
+            assert len(calls) == 1  # sticky: no second probe
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_cross_namespace_binding_forbidden(self):
+        """A bulk bind authorized against one namespace must not commit
+        an item naming another (the authz check never looked there) —
+        enforced identically on the stream round and the HTTP batch."""
+        from kubernetes1_tpu.machinery import Forbidden
+
+        master, cs = self._cluster(bind_stream=True)
+        try:
+            self._make_pods(cs, 0, 2)
+            evil = _binding("bs0", "bn0", ["bn0-c0"], ns="other-ns")
+            # stream path: the round errors, the fallback HTTP path gets
+            # the same Forbidden — either way the caller sees the denial
+            with pytest.raises(Forbidden):
+                cs.bind_batch("default", [evil])
+            # plain HTTP path (no stream) agrees
+            cs2 = Clientset(master.url)
+            try:
+                with pytest.raises(Forbidden):
+                    cs2.bind_batch("default", [evil])
+            finally:
+                cs2.close()
+            assert not cs.pods.get("bs0").spec.node_name  # nothing landed
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_stream_request_is_one_frame(self):
+        """The wire shape: a json-codec round splices the caller's item
+        bytes into ONE length-prefixed frame whose payload parses back
+        to the envelope (no HTTP, no chunking, no re-walk drift)."""
+        captured = []
+
+        class _F:
+            def send_payloads(self, payloads):
+                captured.extend(payloads)
+                raise ConnectionError("capture only")
+
+        bs = bindstream_mod.BindStream.__new__(bindstream_mod.BindStream)
+        bs.codec_id = "json"
+        bs._local = threading.local()
+        bs._local.framer = _F()
+        bs._socks = []
+        import kubernetes1_tpu.utils.locksan as locksan
+
+        bs._socks_lock = locksan.make_lock("test.bindstream")
+        items = [{"kind": "Binding", "apiVersion": "v1",
+                  "metadata": {"name": f"x{i}"}} for i in range(3)]
+        with pytest.raises(ConnectionError):
+            bs.bind_batch("default", items)
+        assert len(captured) == 1
+        env = json.loads(captured[0])
+        assert env == {"namespace": "default", "items": items}

@@ -162,6 +162,31 @@ class TestCacheAccounting:
         cache.forget_pod(pod)
         assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 4
 
+    def test_delete_of_unbound_version_releases_assumed_chips(self):
+        """REGRESSION (gang-recovery chip-death wedge): a DELETED event
+        racing an in-flight bind carries the UNBOUND pod version (no
+        assigned chips) while the cache holds the scheduler's assumed
+        version (chips refcounted).  remove_pod must release what was
+        ACCOUNTED — the stored object — or the chips leak with no holder
+        and no expiry path (forget_pod finds _pod_node already popped;
+        cleanup_expired_assumes finds nothing), wedging every later
+        placement on that slice."""
+        cache = SchedulerCache()
+        cache.update_node(make_node("n1", tpus=4))
+        assumed = make_tpu_pod("p", tpus=2)
+        assumed.spec.extended_resources[0].assigned = [
+            "slice-0-h0-tpu0", "slice-0-h0-tpu1"]
+        assumed.spec.node_name = "n1"
+        cache.assume_pod(assumed, "n1")
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 2
+        # the watch's DELETED object: same key, NEVER bound
+        deleted_version = make_tpu_pod("p", tpus=2)
+        cache.remove_pod(deleted_version)
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 4
+        # the late forget (bind answered NotFound) stays a clean no-op
+        cache.forget_pod(assumed)
+        assert len(cache.get_node("n1").available_devices("google.com/tpu")) == 4
+
     def test_expired_assume_cleanup(self):
         cache = SchedulerCache()
         cache.ASSUME_EXPIRY_SECONDS = 0.0
