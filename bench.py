@@ -17,6 +17,33 @@ Three phases, one JSON line on stdout:
    every requested chip exactly once (BASELINE target >= 90%).
 
 Disable a phase with BENCH_SKIP_WORKLOAD=1 / BENCH_SKIP_GANG=1.
+
+Environment-variable table (the driver's knobs; defaults in parens):
+
+  BENCH_NODES (20)            hollow nodes for density; ALSO the node
+                              count of the sched_perf_envelope phase
+  BENCH_PODS_PER_NODE (0)     pods per node (0 = chip capacity, 4/node);
+                              the 5000-node envelope runs 30
+  BENCH_PODS (derived)        explicit pod count override
+  BENCH_SCHED_SHARDS (1)      scheduler shard processes (PR 9)
+  BENCH_WIRE_CODEC (json)     store-wire codec json|pybin1 (PR 9)
+  BENCH_STORE_SHARDS (1)      store shard processes (PR 10)
+  BENCH_APISERVERS (1)        stateless apiserver processes (PR 10)
+  BENCH_BIND_CODEC (json)     bindings:batch body codec (PR 10)
+  BENCH_STORE_WAL (0)         1 = per-shard WALs (durable shape)
+  BENCH_BIND_STREAM (0)       1 = persistent zero-copy bind leg (PR 12)
+  BENCH_HOLLOW_WATCHERS (0)   N informer-only kubelet stand-ins (the
+                              kubemark watch swarm, PR 13); > 0 adds the
+                              sched_perf_envelope phase at BENCH_NODES x
+                              BENCH_PODS_PER_NODE with the swarm attached
+                              — the 5000-node run is BENCH_NODES=5000
+                              BENCH_PODS_PER_NODE=30
+                              BENCH_HOLLOW_WATCHERS=5000
+  BENCH_SKIP_{GANG,SCHED,SCHED1K,KUBEMARK,WORKLOAD} (unset)
+                              1 = skip that phase
+  BENCH_KUBEMARK_NODES (200)  hollow-KUBELET count (full node loops;
+                              distinct from the watcher swarm)
+  BENCH_NO_REAP (unset)       1 = refuse a dirty box instead of reaping
 """
 
 import json
@@ -63,6 +90,11 @@ STORE_WAL = os.environ.get("BENCH_STORE_WAL", "") == "1"
 # zero-copy bind leg (BENCH_r07+): schedulers ship bulk binds over the
 # persistent length-prefixed bind stream instead of full HTTP per round
 BIND_STREAM = os.environ.get("BENCH_BIND_STREAM", "") == "1"
+# kubemark hollow-watcher swarm (the 5000-node envelope's watch half):
+# > 0 adds the sched_perf_envelope phase — BENCH_NODES nodes, informer-
+# only kubelet stand-ins watching pods by spec.nodeName, flat-RSS and
+# zero-steady-state-relist verdicts in its hollow_watchers block
+HOLLOW_WATCHERS = int(os.environ.get("BENCH_HOLLOW_WATCHERS", "0"))
 
 
 def _pct(xs, q):
@@ -93,7 +125,8 @@ def preflight_reap() -> dict:
     patterns = ("-m kubernetes1_tpu", "bin/ktpu-", "workloads/resnet_bench",
                 "workloads/llama_bench",
                 # the orchestrators whose leaked drivers respawn the load
-                "bench.py", "scripts/kubemark_bench", "scripts/sched_perf")
+                "bench.py", "scripts/kubemark_bench", "scripts/sched_perf",
+                "scripts/hollow_swarm")
     stragglers = {}
     for pid in os.listdir("/proc"):
         if not pid.isdigit() or int(pid) in skip:
@@ -391,6 +424,15 @@ def bench_density():
             if (master.registry.list_index_hits
                 + master.registry.list_index_misses) else None,
             "list_continue_rounds": master.registry.list_continue_rounds,
+            # watch-dispatch economics (PR 13): fan-out work actually
+            # done (indexed_hits + scans) vs what a full scan would have
+            # cost; the kubelets' spec.nodeName watchers ride the bucket
+            # path, so scans should be a small share at high node counts
+            "watch_dispatch_indexed_hits": getattr(
+                master.cacher, "dispatch_indexed_hits", 0),
+            "watch_dispatch_scans": getattr(
+                master.cacher, "dispatch_scans", 0),
+            "watch_bookmarks": master.watch_bookmarks,
         },
         "write_path": write_path,
         "robustness": robustness,
@@ -668,6 +710,27 @@ def main():
                 )
             except Exception as e:  # noqa: BLE001
                 extras["sched_perf_1000"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if HOLLOW_WATCHERS > 0:
+        # the kubemark ENVELOPE run (BENCH_r08+ / the 5000-node item):
+        # BENCH_NODES nodes, BENCH_PODS_PER_NODE density, and the
+        # hollow-watcher swarm attached — its result carries the
+        # hollow_watchers block (sync wall, steady-state relists,
+        # relist bytes) and apiserver_rss_mb (flatness verdict) next
+        # to the usual bind-rate/p99/steady-state numbers.  Its OWN
+        # knob, deliberately outside BENCH_SKIP_SCHED: a driver skipping
+        # the fixed-size sched_perf phases still gets the envelope.
+        try:
+            extras["sched_perf_envelope"] = _sched_perf_with_retry(
+                NODES, PODS, creators=8, multiproc=True,
+                sched_shards=SCHED_SHARDS, wire_codec=WIRE_CODEC,
+                store_shards=STORE_SHARDS, apiservers=APISERVERS,
+                bind_codec=BIND_CODEC, store_wal=STORE_WAL,
+                bind_stream=BIND_STREAM,
+                hollow_watchers=HOLLOW_WATCHERS)
+        except Exception as e:  # noqa: BLE001
+            extras["sched_perf_envelope"] = {
+                "error": f"{type(e).__name__}: {e}"}
 
     # kubemark: 200 hollow nodes (real kubelet loops) vs one apiserver
     # process, with an enforced apiserver CPU/RSS budget (VERDICT r4 #6)

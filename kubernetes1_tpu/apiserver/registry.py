@@ -826,9 +826,24 @@ class Registry:
         slow-consumer eviction."""
         source = via if via is not None else self.store
         kw = {} if queue_limit is None else {"queue_limit": queue_limit}
-        w = source.watch(self.prefix(resource, namespace), since_rev, **kw)
         lreqs = labelutil.parse_selector(label_selector) if label_selector else None
         freqs = parse_field_selector(field_selector) if field_selector else None
+        if freqs and getattr(source, "dispatch_index_capable", False):
+            # selector-indexed DISPATCH (the LIST index's write-side twin):
+            # an `=` requirement on a declared index buckets this watcher
+            # so the commit fan-out touches it only for events whose old
+            # or new indexed value matches — O(interested watchers) per
+            # event instead of O(watchers).  Narrowing only: the serving
+            # loop still re-checks event_matches on every delivered
+            # event, so indexed == scan frames by construction.
+            from ..storage.cacher import selector_indexes
+
+            declared = selector_indexes(resource)
+            for path, op, val in freqs:
+                if op == "=" and path in declared:
+                    kw["index_hint"] = (path, val)
+                    break
+        w = source.watch(self.prefix(resource, namespace), since_rev, **kw)
 
         def event_matches(obj_dict) -> bool:
             if lreqs is not None and not labelutil.selector_matches(

@@ -1230,12 +1230,34 @@ class _Handler(BaseHTTPRequestHandler):
         # cross-shard clock math.  Streams that didn't ask stay
         # byte-identical — stamps never ride the cached event frames.
         lag_stamps = q.get("lagStamps") in ("1", "true")
+        # progress-bookmark opt-in (?progressBookmarks=1, informers set
+        # it): PLAIN streams (shards=1, no composite bookmarks) get a
+        # BOOKMARK frame on idle heartbeats carrying a SAFE resume
+        # revision (Watcher.progress_rv — the cache head, but only when
+        # nothing is queued undelivered), so an informer idle for minutes
+        # resumes above the compaction floor instead of 410-full-
+        # relisting the collection.  Streams that didn't ask stay
+        # byte-identical; merged streams already bookmark every
+        # heartbeat.
+        progress = (not bookmarks
+                    and q.get("progressBookmarks") in ("1", "true"))
         n_shards = max(1, self.master.store_shards)
 
         def bookmark_frame() -> bytes:
+            self.master.note_watch_bookmark()
             return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
                     b'"apiVersion":"v1","metadata":{"resourceVersion":"'
                     + w.bookmark_rv().encode() + b'"}}}\n')
+
+        def progress_frame() -> Optional[bytes]:
+            fn = getattr(w, "progress_rv", None)
+            rv = fn() if fn is not None else None
+            if not rv:
+                return None  # unsafe this tick (events in flight): skip
+            self.master.note_watch_bookmark()
+            return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
+                    b'"apiVersion":"v1","metadata":{"resourceVersion":"'
+                    + str(rv).encode() + b'"}}}\n')
 
         def lag_frame(evs) -> Optional[bytes]:
             """Lag-stamp bookmark for one delivered batch (None when no
@@ -1263,6 +1285,7 @@ class _Handler(BaseHTTPRequestHandler):
             if toks:
                 meta["annotations"] = {
                     t.COMMITTED_AT_ANNOTATION: " ".join(toks)}
+            self.master.note_watch_bookmark()
             return json.dumps(
                 {"type": "BOOKMARK",
                  "object": {"kind": "Bookmark", "apiVersion": "v1",
@@ -1298,9 +1321,12 @@ class _Handler(BaseHTTPRequestHandler):
                     # heartbeat chunk keeps half-open connections
                     # detectable; merged streams heartbeat with a
                     # bookmark so even an idle informer always holds a
-                    # fresh composite resume position
-                    self._write_chunk(bookmark_frame() if bookmarks
-                                      else b"")
+                    # fresh composite resume position — and plain
+                    # streams that opted in get the progress analog
+                    # (None = no safe rv this tick; plain heartbeat)
+                    fr = (bookmark_frame() if bookmarks
+                          else progress_frame() if progress else None)
+                    self._write_chunk(fr if fr else b"")
                     continue
                 # watch frames honor the requested version like every verb.
                 # WatchEvents are SHARED by every watcher of the resource
@@ -1407,6 +1433,24 @@ class _Handler(BaseHTTPRequestHandler):
             "# TYPE ktpu_list_continue_total counter",
             f"ktpu_list_continue_total "
             f"{master.registry.list_continue_rounds}",
+            # watch-dispatch economics (the fan-out half of the 5000-node
+            # envelope): indexed_hits = deliveries routed through a
+            # selector bucket; scans = (event x watcher) pairs walked on
+            # the legacy scan leg.  hits + scans IS the per-commit
+            # dispatch work — at 5000 single-node watchers it should sit
+            # ~3 orders of magnitude under watchers x events.
+            "# TYPE ktpu_watch_dispatch_indexed_hits_total counter",
+            f"ktpu_watch_dispatch_indexed_hits_total "
+            f"{getattr(master.cacher, 'dispatch_indexed_hits', 0)}",
+            "# TYPE ktpu_watch_dispatch_scans_total counter",
+            f"ktpu_watch_dispatch_scans_total "
+            f"{getattr(master.cacher, 'dispatch_scans', 0)}",
+            # bookmark frames emitted (composite + lag-stamp + progress):
+            # the idle-watcher freshness surface — zero here while idle
+            # informers later 410-relist means the opt-in never reached
+            # the wire
+            "# TYPE ktpu_watch_bookmarks_total counter",
+            f"ktpu_watch_bookmarks_total {master.watch_bookmarks}",
         ]
         # cacher freshness-wait lag (obs plane): how long LIST/GET reads
         # blocked for watch-cache freshness.  Sharded cachers render a
@@ -1447,6 +1491,8 @@ class _Handler(BaseHTTPRequestHandler):
                 _informer.informer_relists_total.render().rstrip("\n"))
             extra.append(
                 _informer.informer_reconnects_total.render().rstrip("\n"))
+            extra.append(
+                _informer.informer_relist_bytes_total.render().rstrip("\n"))
             extra.append(
                 _informer.informer_lag_seconds.render().rstrip("\n"))
             # gang failure-domain surface (module-level in
@@ -1805,6 +1851,20 @@ class Master:
         watch_queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,  # per-watcher
                                                # event bound before slow-
                                                # consumer eviction (410)
+        cacher_history_limit: Optional[int] = None,  # watch-cache resume
+                                               # window (events); None =
+                                               # storage/cacher default.
+                                               # Tests/chaos shrink it to
+                                               # force compaction quickly
+                                               # (the idle-informer
+                                               # bookmark regression)
+        store_history_limit: Optional[int] = None,  # in-process store
+                                               # resume ring (events);
+                                               # shrink ALONGSIDE the
+                                               # cacher window or the
+                                               # store-fallback watch
+                                               # path absorbs the
+                                               # compaction being tested
         write_coalesce_window: float = 0.0,    # seconds; opt-in singleton
                                                # write coalescing under
                                                # burst (see _WriteCoalescer)
@@ -1842,6 +1902,16 @@ class Master:
         self.scheme = scheme or global_scheme.copy()
         self.store_is_remote = bool(store_address) and store is None
         self._owns_store = store is None
+        if store_history_limit is not None and (
+                store is not None or store_address or store_shards > 1):
+            # the knob exists to force REAL compaction in tests/chaos;
+            # silently ignoring it for sharded/remote/injected stores
+            # would let the idle-informer bookmark regression pass
+            # against an uncompacted store-fallback watch path
+            raise ValueError(
+                "store_history_limit applies only to the plain in-process "
+                "store (not store=, store_address, or store_shards>1); "
+                "shrink those stores' rings at construction instead")
         self.render_client_metrics = render_client_metrics
         if store is not None:
             # shared in-process store (LocalCluster multi-apiserver):
@@ -1878,8 +1948,11 @@ class Master:
                 wal_path=wal_path, wal_sync=wal_sync)
             self.store_shards = store_shards
         else:
+            store_kw = {}
+            if store_history_limit is not None:
+                store_kw["history_limit"] = store_history_limit
             self.store = Store(self.scheme, wal_path=wal_path,
-                               wal_sync=wal_sync)
+                               wal_sync=wal_sync, **store_kw)
             self.store_shards = 1
         self.render_store_metrics = (self._owns_store
                                      if render_store_metrics is None
@@ -1893,14 +1966,23 @@ class Master:
         # with scheme.serialization_cache, encode work per event is O(1)
         # in watcher count.
         self.watch_queue_limit = watch_queue_limit
+        cacher_kw = {}
+        if cacher_history_limit is not None:
+            cacher_kw["history_limit"] = cacher_history_limit
         if isinstance(self.store, ShardedStore):
             # per-shard caches: each shard's view is fed (and kept fresh)
             # independently; reads merge, watches fan into one queue
             self.cacher = ShardedCacher(self.store, self.scheme,
-                                        queue_limit=watch_queue_limit).start()
+                                        queue_limit=watch_queue_limit,
+                                        **cacher_kw).start()
         else:
             self.cacher = Cacher(self.store, self.scheme,
-                                 queue_limit=watch_queue_limit).start()
+                                 queue_limit=watch_queue_limit,
+                                 **cacher_kw).start()
+        # progress/composite/lag BOOKMARK frames emitted by this
+        # apiserver's watch streams (the idle-informer freshness surface)
+        self._watch_bookmarks = 0
+        self._bookmark_lock = locksan.make_lock("Master._bookmark_lock")
         self.token = token
         self.metrics = Metrics()
         # request spans land here, served at /debug/traces (utils/spans).
@@ -2026,6 +2108,17 @@ class Master:
         else:
             self.url = f"http://{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    def note_watch_bookmark(self):
+        """Count one emitted BOOKMARK frame (composite, lag-stamp, or
+        progress) — ktpu_watch_bookmarks_total on /metrics."""
+        with self._bookmark_lock:
+            self._watch_bookmarks += 1
+
+    @property
+    def watch_bookmarks(self) -> int:
+        with self._bookmark_lock:
+            return self._watch_bookmarks
 
     def _get_priority_class(self, name: str):
         return self.store.get_or_none(self.registry.key("priorityclasses", "", name))

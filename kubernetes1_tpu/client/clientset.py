@@ -177,6 +177,7 @@ class ResourceClient:
         field_selector: str = "",
         timeout_seconds: float = 0,
         lag_stamps: bool = False,
+        progress_bookmarks: bool = False,
     ) -> WatchStream:
         params = {"resourceVersion": resource_version}
         if label_selector:
@@ -191,6 +192,13 @@ class ResourceClient:
             # delivered batch; old servers ignore the param, so plain
             # streams stay byte-identical for everyone who didn't ask
             params["lagStamps"] = "1"
+        if progress_bookmarks:
+            # idle-freshness opt-in (informers set it): plain streams get
+            # a progress BOOKMARK on heartbeats so an idle watcher's
+            # resume rv rides the cache head instead of aging below the
+            # compaction floor into a 410 full relist.  Old servers
+            # ignore the param; non-opt-in streams stay byte-identical.
+            params["progressBookmarks"] = "1"
         return self.api.watch(self._path(namespace), params)
 
 

@@ -40,6 +40,11 @@ informer_relists_total = Counter(
 informer_reconnects_total = Counter(
     "ktpu_informer_reconnects_total",
     "informer mid-stream watch re-dials (resumed from last rv)")
+informer_relist_bytes_total = Counter(
+    "ktpu_informer_relist_bytes_total",
+    "response-body bytes informers paid for full relists — the cost "
+    "progress bookmarks exist to amortize away (an idle informer that "
+    "keeps relisting shows up here as periodic collection-sized spikes)")
 
 # Default relist chunk size (client-go's reflector pages at 500 too): a
 # 150k-pod relist arrives as bounded chunks instead of one giant body —
@@ -71,13 +76,25 @@ class SharedInformer:
         field_selector: str = "",
         resync_period: float = 0.0,
         relist_limit: int = DEFAULT_RELIST_LIMIT,
+        progress_bookmarks: bool = True,
     ):
         self.client = client
         self.namespace = namespace
         self.label_selector = label_selector
         self.field_selector = field_selector
+        # resync_period > 0: every period, redeliver every cached object
+        # to the update handlers LOCALLY (client-go's DeltaFIFO Resync —
+        # no API traffic, no relist).  Level-triggered controllers use it
+        # as a backstop: a sync whose effect was lost (crashed worker,
+        # external drift the watch can't see) gets recomputed within one
+        # period.  0 disables (the default — most controllers are fully
+        # event-driven).
         self.resync_period = resync_period
         self.relist_limit = max(0, int(relist_limit))
+        # progress bookmarks keep an IDLE informer's resume rv at the
+        # server's cache head (no 410 relist after quiet minutes);
+        # disable only to A/B the pre-bookmark behavior in tests
+        self.progress_bookmarks = progress_bookmarks
         self._cache: Dict[str, Any] = {}
         self._lock = locksan.make_rlock("SharedInformer._lock")
         # observability: how often this informer had to fall back to a
@@ -89,6 +106,7 @@ class SharedInformer:
         # implementation; `relists`/`reconnects` stay readable as ints.
         self._relists_ctr = Counter("ktpu_informer_relists_total")
         self._reconnects_ctr = Counter("ktpu_informer_reconnects_total")
+        self._relist_bytes_ctr = Counter("ktpu_informer_relist_bytes_total")
         # unified retry policy: capped full-jitter backoff between relist
         # attempts, reset whenever a relist succeeds (client/retry.py)
         self._backoff = _retry.Backoff(base=0.2, factor=2.0, cap=2.0)
@@ -96,6 +114,12 @@ class SharedInformer:
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._resync_thread: Optional[threading.Thread] = None
+        # handlers are serialized: the watch loop and the resync loop are
+        # different threads, so the single-dispatch-thread ordering
+        # guarantee becomes mutual exclusion + per-source order (resyncs
+        # interleave BETWEEN events, never inside a handler)
+        self._dispatch_lock = locksan.make_lock("SharedInformer._dispatch")
         self._watch_stream = None
 
     # ----------------------------------------------------------------- api
@@ -112,6 +136,11 @@ class SharedInformer:
         if self._thread is None:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
+        if self.resync_period > 0 and self._resync_thread is None:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop, daemon=True,
+                name=f"informer-resync-{self.client.resource}")
+            self._resync_thread.start()
         return self
 
     def stop(self):
@@ -129,6 +158,11 @@ class SharedInformer:
     @property
     def reconnects(self) -> int:
         return int(self._reconnects_ctr.value)
+
+    @property
+    def relist_bytes(self) -> int:
+        """Response-body bytes this informer's full relists cost."""
+        return int(self._relist_bytes_ctr.value)
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
@@ -197,16 +231,37 @@ class SharedInformer:
             informer_lag_seconds.labels(shard=shard).observe(max(0.0, lag))
 
     def _dispatch(self, kind: str, *args):
-        for h in self._handlers:
-            fn = h.get(kind)
-            if fn is None:
-                continue
-            try:
-                fn(*args)
-            except Exception:  # noqa: BLE001 — handler bugs must not kill the informer
-                traceback.print_exc()
+        with self._dispatch_lock:
+            for h in self._handlers:
+                fn = h.get(kind)
+                if fn is None:
+                    continue
+                try:
+                    fn(*args)
+                except Exception:  # noqa: BLE001 — handler bugs must not kill the informer
+                    traceback.print_exc()
+
+    def _resync_loop(self):
+        """DeltaFIFO-Resync analog: every resync_period, redeliver every
+        cached object to the UPDATE handlers from the local cache — zero
+        API traffic (this is NOT a relist; the `relists` counter proves
+        it).  old is new on a resync delivery, the client-go convention
+        level-triggered handlers rely on to tell a backstop tick from a
+        real change without a field diff."""
+        while not self._stop.wait(self.resync_period):
+            if not self._synced.is_set():
+                continue  # nothing cached to redeliver yet
+            for obj in self.list():
+                if self._stop.is_set():
+                    return
+                self._dispatch("update", obj, obj)
 
     def _relist(self) -> str:
+        # price the relist in wire bytes: every LIST chunk below runs on
+        # THIS thread, so the client's per-thread rx meter deltas cleanly
+        # (duck-typed fake clients without a real ApiClient skip the meter)
+        api = getattr(self.client, "api", None)
+        rx0 = api.rx_bytes() if api is not None else 0
         items, rv = self.client.list(
             namespace=self.namespace,
             label_selector=self.label_selector,
@@ -219,6 +274,11 @@ class SharedInformer:
             self._cache = fresh
         self._relists_ctr.inc()
         informer_relists_total.labels(resource=self.client.resource).inc()
+        rx = (api.rx_bytes() - rx0) if api is not None else 0
+        if rx > 0:
+            self._relist_bytes_ctr.inc(rx)
+            informer_relist_bytes_total.labels(
+                resource=self.client.resource).inc(rx)
         flightrec.note("informer", flightrec.INFORMER_RELIST,
                        resource=self.client.resource)
         for key, obj in fresh.items():
@@ -271,6 +331,7 @@ class SharedInformer:
                     label_selector=self.label_selector,
                     field_selector=self.field_selector,
                     lag_stamps=True,
+                    progress_bookmarks=self.progress_bookmarks,
                 )
             except TooOldResourceVersion:
                 return  # relist
@@ -375,17 +436,37 @@ class InformerFactory:
         namespace: str = "",
         label_selector: str = "",
         field_selector: str = "",
+        resync_period: float = 0.0,
     ) -> SharedInformer:
+        """resync_period > 0 asks the SHARED informer for a periodic
+        local resync (SharedInformer.resync_period).  Consumers of one
+        shared informer may ask for different periods: the shortest
+        non-zero ask wins (client-go's AddEventHandlerWithResyncPeriod
+        rule) — a faster backstop satisfies every slower one."""
         key = (resource, namespace, label_selector, field_selector)
         with self._lock:
-            if key not in self._informers:
-                self._informers[key] = SharedInformer(
+            inf = self._informers.get(key)
+            if inf is None:
+                inf = self._informers[key] = SharedInformer(
                     self.clientset.resource(resource),
                     namespace=namespace,
                     label_selector=label_selector,
                     field_selector=field_selector,
+                    resync_period=resync_period,
                 )
-            return self._informers[key]
+            elif resync_period > 0 and (inf.resync_period == 0
+                                        or resync_period < inf.resync_period):
+                if inf._thread is not None:
+                    # started informers can't honor a new ask: a 0-period
+                    # informer never spawned a resync thread, so silently
+                    # recording the period would promise a backstop that
+                    # never fires
+                    raise ValueError(
+                        f"informer {key} already started with "
+                        f"resync_period={inf.resync_period}; ask before "
+                        f"start_all() so the shortest period can win")
+                inf.resync_period = resync_period
+            return inf
 
     def start_all(self):
         with self._lock:

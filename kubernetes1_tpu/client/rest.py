@@ -281,6 +281,12 @@ class ApiClient:
                     sent = True
                     resp = conn.getresponse()
                     raw_body = resp.read()
+                    # per-thread response-byte meter (relist-bytes SLI:
+                    # the informer deltas this around a LIST to price a
+                    # full relist in wire bytes).  Thread-local — zero
+                    # contention on the request hot path.
+                    self._local.rx_bytes = (
+                        getattr(self._local, "rx_bytes", 0) + len(raw_body))
                     break
                 except (http.client.HTTPException, ConnectionError, OSError):
                     self._reset_conn()
@@ -387,6 +393,12 @@ class ApiClient:
             err.code = resp.status
             raise err
         return WatchStream(conn, resp)
+
+    def rx_bytes(self) -> int:
+        """Cumulative response-body bytes received on THIS thread (watch
+        streams excluded — they bypass request()).  Callers meter a
+        specific operation by deltaing around it on its own thread."""
+        return getattr(self._local, "rx_bytes", 0)
 
     def close(self):
         self._reset_conn()

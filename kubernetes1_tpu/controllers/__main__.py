@@ -71,6 +71,7 @@ def main():
         reg.register(_retry.retries_total)
         reg.register(_informer.informer_relists_total)
         reg.register(_informer.informer_reconnects_total)
+        reg.register(_informer.informer_relist_bytes_total)
         reg.register(_informer.informer_lag_seconds)
         try:
             metrics_server = MetricsServer(reg, port=args.metrics_port).start()

@@ -19,10 +19,20 @@ def _fmt(v: float) -> str:
 class ResourceQuotaController(Controller):
     name = "resourcequota-controller"
 
+    # Quota usage is LEVEL-recomputed: sync() re-derives status.used from
+    # authoritative LISTs, so any missed edge (a resource kind this
+    # controller has no informer for — services, configmaps, PVCs all
+    # count against quota) self-heals on the next delivery.  The resync
+    # period is that backstop's cadence: the shared quota informer
+    # redelivers every cached quota locally (SharedInformer.resync_period
+    # — no API traffic, NOT a relist), and each redelivery enqueues a
+    # recompute.  Event-driven requeues (pod churn below) stay the fast
+    # path; this bounds staleness for everything they can't see.
     resync_period = 10.0
 
     def setup(self):
-        self.quotas = self.factory.informer("resourcequotas")
+        self.quotas = self.factory.informer(
+            "resourcequotas", resync_period=self.resync_period)
         self.pods = self.factory.informer("pods")
         self.quotas.add_handler(
             on_add=self.enqueue, on_update=lambda _o, n: self.enqueue(n)

@@ -98,6 +98,7 @@ def main():
     sched.metrics.register(_retry.retries_total)
     sched.metrics.register(_informer.informer_relists_total)
     sched.metrics.register(_informer.informer_reconnects_total)
+    sched.metrics.register(_informer.informer_relist_bytes_total)
     sched.metrics.register(_informer.informer_lag_seconds)
     sched.metrics.register(_bindstream.bindstream_frames_total)
     sched.metrics.register(_bindstream.bindstream_bytes_total)

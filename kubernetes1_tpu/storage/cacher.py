@@ -139,6 +139,11 @@ def key_for_dict(scheme, d: Dict[str, Any]) -> Optional[str]:
 class Cacher:
     """In-memory, revision-ordered view of one store."""
 
+    # Registry.watch probes this before passing an index_hint: only the
+    # watch-cache layers (Cacher/ShardedCacher) bucket watchers; the
+    # authoritative store keeps the scan fan-out.
+    dispatch_index_capable = True
+
     def __init__(self, store, scheme, prefix: str = "/registry/",
                  history_limit: int = DEFAULT_CACHER_HISTORY_LIMIT,
                  queue_limit: int = DEFAULT_WATCH_QUEUE_LIMIT,
@@ -163,6 +168,26 @@ class Cacher:
         self._rev = 0
         self._compacted_rev = 0
         self._watchers: List[Watcher] = []
+        # WATCH DISPATCH INDEX (guarded by _cond, maintained in the same
+        # critical section as registration/removal): watchers that
+        # presented an `=` requirement on a DECLARED selector index are
+        # bucketed by (collection, field) -> value; everyone else is on
+        # the scan list.  The commit fan-out walks only the buckets named
+        # by each event's old+new indexed values plus the scan list, so
+        # delivery work is O(interested watchers), not O(watchers) —
+        # 5000 kubelet watchers cost ~1 bucket lookup per pod event
+        # instead of 5000 selector tests.  The index only NARROWS: the
+        # serving layer still re-checks event_matches on every delivered
+        # event, so an indexed stream's frames equal the scan stream's
+        # by construction (the PR 12 list-index invariant, applied to
+        # dispatch).
+        self._watch_index: Dict[Tuple[str, str], Dict[str, List[Watcher]]] = {}
+        self._scan_watchers: List[Watcher] = []
+        # dispatch economics (under _cond): indexed_hits = deliveries
+        # routed through a bucket; scans = (event x scan-watcher) pairs
+        # walked on the legacy leg.  hits + scans IS the fan-out work.
+        self.dispatch_indexed_hits = 0
+        self.dispatch_scans = 0
         # sync mode: commits that fired between hook registration and the
         # seed list buffer here (None once seeded)
         self._pending_records: Optional[List[tuple]] = []
@@ -220,6 +245,8 @@ class Cacher:
             feed.stop()
         with self._cond:
             watchers, self._watchers = self._watchers, []
+            self._watch_index = {}
+            self._scan_watchers = []
             self._cond.notify_all()
         for w in watchers:
             w.stop()
@@ -232,16 +259,47 @@ class Cacher:
 
     def _remove_watcher(self, w: Watcher):
         with self._cond:
+            self._unregister_watcher_locked(w)
+
+    def _unregister_watcher_locked(self, w: Watcher):
+        """Must hold _cond: drop the watcher from the master list AND its
+        dispatch route (bucket or scan list) — a bucket entry that
+        outlived its watcher would keep paying a (dead) delivery per
+        matching event forever."""
+        try:
+            self._watchers.remove(w)
+        except ValueError:
+            return  # already unregistered (reseed swept it, racing stop)
+        hint = getattr(w, "dispatch_hint", None)
+        if hint is None:
             try:
-                self._watchers.remove(w)
+                self._scan_watchers.remove(w)
             except ValueError:
                 pass
+            return
+        coll, field, value = hint
+        buckets = self._watch_index.get((coll, field))
+        if buckets is None:
+            return
+        bucket = buckets.get(value)
+        if bucket is None:
+            return
+        try:
+            bucket.remove(w)
+        except ValueError:
+            return
+        if not bucket:
+            del buckets[value]
+        if not buckets:
+            del self._watch_index[(coll, field)]
 
     # ------------------------------------------------------------- feeding
 
     def _seed(self, entries, rev: int) -> List[Watcher]:
         with self._cond:
             stale, self._watchers = self._watchers, []
+            self._watch_index = {}
+            self._scan_watchers = []
             if self._ready.is_set():
                 self.reseeds += 1
             self._data = {key: (r, obj) for key, r, obj in entries}
@@ -278,42 +336,72 @@ class Cacher:
 
     def _apply_batch_locked(self, records: List[tuple]):
         """Must hold _cond: fold one batch into the view and fan out with
-        ONE push per matching watcher (events shared across watchers).
-        Callers notify _cond once per batch."""
-        events = []
+        ONE push per interested watcher (events shared across watchers).
+        Callers notify _cond once per batch.
+
+        Dispatch is INDEX-ROUTED: each event walks only the buckets named
+        by its old and new indexed values (BOTH — an update that moves
+        the value is a transition both sides' streams must see, so their
+        frames stay equal to a scan stream's after the serving layer's
+        event_matches re-check) plus the scan list.  Bucket updates for
+        the DATA index and deliveries through the WATCH index happen in
+        this same critical section, so a registered watcher can never
+        miss an event between its registration and the next apply."""
+        deliveries: Dict[Watcher, List[WatchEvent]] = {}
+        scan = self._scan_watchers
         for rev, typ, key, obj in records:
             coll = _collection_of(key)
+            old_obj: Optional[Dict[str, Any]] = None
             if typ == DELETED:
                 old = self._data.pop(key, None)
                 keys = self._by_collection.get(coll)
                 if keys is not None:
                     keys.discard(key)
                 if old is not None:
-                    self._index_remove_locked(coll, key, old[1])
+                    old_obj = old[1]
+                    self._index_remove_locked(coll, key, old_obj)
             else:
                 old = self._data.get(key)
+                old_obj = None if old is None else old[1]
                 self._data[key] = (rev, obj)
                 self._by_collection.setdefault(coll, set()).add(key)
-                self._index_update_locked(
-                    coll, key, None if old is None else old[1], obj)
+                self._index_update_locked(coll, key, old_obj, obj)
             self._history.append((rev, typ, key, obj))
             if rev > self._rev:
                 self._rev = rev
-            events.append((key, WatchEvent(typ, obj)))
+            ev = WatchEvent(typ, obj)
+            if scan:
+                self.dispatch_scans += len(scan)
+                for w in scan:
+                    if key.startswith(w.prefix):
+                        deliveries.setdefault(w, []).append(ev)
+            specs = _SELECTOR_INDEXES.get(coll)
+            if specs:
+                for field, default in specs.items():
+                    buckets = self._watch_index.get((coll, field))
+                    if not buckets:
+                        continue
+                    vals = {index_value(obj, field, default)}
+                    if old_obj is not None:
+                        vals.add(index_value(old_obj, field, default))
+                    for v in vals:
+                        for w in buckets.get(v, ()):
+                            if key.startswith(w.prefix):
+                                self.dispatch_indexed_hits += 1
+                                deliveries.setdefault(w, []).append(ev)
         if len(self._history) > self._history_limit:
             drop = len(self._history) - self._history_limit
             self._compacted_rev = self._history[drop - 1][0]
             del self._history[:drop]
         evicted = False
-        for w in self._watchers:
-            evs = [ev for key, ev in events if key.startswith(w.prefix)]
-            if evs:
-                w._push_batch(evs)
-                self.watch_wakeups += 1
-                self.watch_events += len(evs)
+        for w, evs in deliveries.items():
+            w._push_batch(evs)
+            self.watch_wakeups += 1
+            self.watch_events += len(evs)
             evicted = evicted or w.evicted
         if evicted:
-            self._watchers = [w for w in self._watchers if not w.evicted]
+            for w in [x for x in self._watchers if x.evicted]:
+                self._unregister_watcher_locked(w)
 
     # ------------------------------------------------------------- indexes
 
@@ -570,12 +658,22 @@ class Cacher:
     # ---------------------------------------------------------------- watch
 
     def watch(self, prefix: str, since_rev: int = 0,
-              queue_limit: Optional[int] = None) -> Watcher:
+              queue_limit: Optional[int] = None,
+              index_hint: Optional[Tuple[str, str]] = None) -> Watcher:
         """Watch prefix from the cache's history window.  Resuming returns
         EXACTLY the events with rev > since_rev (waiting for the cache to
         catch up to the store first, so a resume at a store-fresh revision
         never sees duplicates); resuming below the window floor raises
-        TooOldResourceVersion and the client relists."""
+        TooOldResourceVersion and the client relists.
+
+        index_hint=(field, value) — the watcher's selector carries an
+        equality requirement on `field`: if the prefix's collection
+        declares that field indexed, the watcher is bucketed so the
+        commit fan-out routes it only events whose old or new `field`
+        extracts to `value` (a strict superset of what event_matches
+        passes, so the serving layer's re-check keeps frames identical
+        to a scan stream's).  Undeclared fields fall back to the scan
+        list — the hint can only narrow, never lose."""
         limit = self._queue_limit if queue_limit is None else queue_limit
         self.wait_fresh()
         if since_rev:
@@ -587,12 +685,13 @@ class Cacher:
             self._wait_rev_locked_entry(since_rev, self._fresh_timeout)
         w = Watcher(self, prefix, queue_limit=limit,
                     buffering=bool(since_rev))
-        replay = self.attach_watcher(w, since_rev)
+        replay = self.attach_watcher(w, since_rev, index_hint=index_hint)
         if since_rev:
             w._replay_and_go_live(replay)
         return w
 
-    def attach_watcher(self, w: Watcher, since_rev: int = 0):
+    def attach_watcher(self, w: Watcher, since_rev: int = 0,
+                       index_hint: Optional[Tuple[str, str]] = None):
         """Register an externally-built Watcher against this cache's view
         (the sharded fan-in path — one Watcher shared across N per-shard
         cachers) and return the history slice the caller must replay
@@ -607,7 +706,28 @@ class Cacher:
             replay = (self._history[history_index(self._history, since_rev):]
                       if since_rev else [])
             self._watchers.append(w)
+            self._register_dispatch_locked(w, index_hint)
         return replay
+
+    def _register_dispatch_locked(self, w: Watcher,
+                                  index_hint: Optional[Tuple[str, str]]):
+        """Must hold _cond: route the watcher into its dispatch bucket
+        (declared index + equality hint) or onto the scan list.  The
+        route is stamped on the watcher (dispatch_hint) so removal can
+        undo exactly this registration; a FanInWatcher attached to N
+        shard cachers gets the same stamp from each — same (coll, field,
+        value) triple, per-cacher bucket membership."""
+        coll = _collection_of(w.prefix)
+        if index_hint:
+            field, value = index_hint
+            if field in _SELECTOR_INDEXES.get(coll, {}):
+                value = str(value)
+                w.dispatch_hint = (coll, field, value)
+                self._watch_index.setdefault(
+                    (coll, field), {}).setdefault(value, []).append(w)
+                return
+        w.dispatch_hint = None
+        self._scan_watchers.append(w)
 
     def current_cached_revision(self) -> int:
         """The cache's applied revision right now (the fan-in facade

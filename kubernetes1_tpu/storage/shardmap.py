@@ -160,6 +160,15 @@ class FanInWatcher(Watcher):
     def bookmark_rv(self) -> str:
         return format_rv(self._positions)
 
+    def progress_rv(self):
+        """Progress bookmarks carry a PLAIN int rv — meaningful only for
+        the 1-shard facade (where it equals that shard's revision).
+        Merged streams already keep idle clients fresh with composite
+        bookmark_rv() heartbeats; a single int would gap their resume."""
+        if self._nshards > 1:
+            return None
+        return super().progress_rv()
+
     # -------------------------------------------------------- remote shards
 
     def add_remote(self, sub):
@@ -565,6 +574,14 @@ class ShardedCacher:
     def watch_events(self):
         return sum(c.watch_events for c in self._shards)
 
+    @property
+    def dispatch_indexed_hits(self):
+        return sum(c.dispatch_indexed_hits for c in self._shards)
+
+    @property
+    def dispatch_scans(self):
+        return sum(c.dispatch_scans for c in self._shards)
+
     # --------------------------------------------------------------- reads
 
     def get_raw(self, key: str):
@@ -609,10 +626,19 @@ class ShardedCacher:
         staleness: each composite part checks against its own shard)."""
         return [c.compacted_revisions()[0] for c in self._shards]
 
+    def current_cached_revision(self) -> int:
+        """Highest applied revision across the shard caches (the 1-shard
+        facade's progress-bookmark source; multi-shard streams never ask
+        — their position is the composite bookmark_rv)."""
+        return max(c.current_cached_revision() for c in self._shards)
+
     # --------------------------------------------------------------- watch
 
+    dispatch_index_capable = True
+
     def watch(self, prefix: str, since_rev=0,
-              queue_limit: Optional[int] = None) -> FanInWatcher:
+              queue_limit: Optional[int] = None,
+              index_hint=None) -> FanInWatcher:
         limit = self._queue_limit if queue_limit is None else queue_limit
         since, seeds = self._store.plan_resume(
             since_rev, lambda i: self._shards[i].current_cached_revision())
@@ -632,7 +658,8 @@ class ShardedCacher:
         replays: List[list] = []
         try:
             for c, sr in zip(self._shards, since):
-                replays.append(c.attach_watcher(w, sr))
+                replays.append(c.attach_watcher(w, sr,
+                                                index_hint=index_hint))
                 attached.append(c)
         except Exception:
             for c in attached:

@@ -284,6 +284,41 @@ class Watcher:
         self._buf.clear()
         return out
 
+    def progress_rv(self) -> Optional[int]:
+        """Consumer-thread only: a resume revision SAFE to hand the client
+        as a progress bookmark, or None when no safe answer exists right
+        now (events queued but undelivered — a bookmark would leap past
+        them, and a cut before their delivery would silently gap the
+        resumed stream).
+
+        Safety argument, order-sensitive: the owner's revision is read
+        FIRST through its own lock (Cacher._cond / Store._lock) — every
+        event <= that revision was pushed inside the same critical
+        section that published it.  The queue-empty check runs AFTER: if
+        nothing is queued now, everything pushed before the revision read
+        has already been handed to this consumer, so every event <= rev
+        destined for this stream is on the wire.  Events landing between
+        the two reads have rev > the answer and simply make it
+        conservative.  This is what lets an IDLE informer's resume point
+        ride the cache head (above the compaction floor) instead of
+        aging into a 410 full relist."""
+        owner = self._owner
+        fn = (getattr(owner, "current_cached_revision", None)
+              or getattr(owner, "current_revision", None))
+        if fn is None:
+            return None
+        rev = fn()
+        if not rev:
+            return None
+        with self._plock:
+            if self._qlen or self._pending is not None:
+                return None
+        if self._buf or not self._q.empty():
+            # _buf: consumer-side remainder; _q non-empty: a batch (or the
+            # end sentinel) raced in after the qlen check — skip this tick
+            return None
+        return rev
+
 
 class ReplicaFeed:
     """A standby's subscription to the primary's commit stream: a queue of

@@ -834,7 +834,9 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
         optimistic-binding guard held while BOTH instances raced);
       - the run actually injected faults (schedule exercised).
     """
+    from kubernetes1_tpu.api import types as t
     from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.apiserver import server as apiserver_server
     from kubernetes1_tpu.client import Clientset, SharedInformer
     from kubernetes1_tpu.client import bindstream as _bindstream
     from kubernetes1_tpu.machinery import AlreadyExists
@@ -845,14 +847,21 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
 
     spec = SCHED_SPEC if spec is None else spec
     SHARDS, NODES, CHIPS, PODS = 4, 6, 8, 36
-    master = cs = s_a = s_b = page_inf = None
+    # idle-watcher compaction phase: a tiny watch-cache window so the
+    # post-kill churn rolls the history past any idle watcher's last
+    # event, and fast heartbeats so its progress bookmark lands quickly
+    CACHER_WINDOW = 512
+    master = cs = s_a = s_b = page_inf = idle_inf = None
     _begin_seed_run()
     verdict = {"mode": "sched-shard", "seed": seed, "spec": spec,
                "ok": False, "acked": 0, "recovery_s": None}
     bs_frames0 = _bindstream.bindstream_frames_total.value
     bs_falls0 = _bindstream.bindstream_fallbacks_total.value
+    old_heartbeat = apiserver_server.WATCH_HEARTBEAT_SECONDS
     try:
-        master = Master().start()
+        apiserver_server.WATCH_HEARTBEAT_SECONDS = 0.5
+        master = Master(cacher_history_limit=CACHER_WINDOW,
+                        store_history_limit=CACHER_WINDOW).start()
         cs = Clientset(master.url)
         for i in range(NODES):
             cs.nodes.create(make_node(
@@ -934,6 +943,120 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
             page_converged = got == want
             if not page_converged:
                 time.sleep(0.2)
+        # ---- idle-watcher + history-compaction churn phase (faults off:
+        # the storm above already proved the fault contract; this phase
+        # proves the PR 13 watch economics on the same live topology) ----
+        #
+        # (a) dispatch equivalence: one INDEXED stream (spec.nodeName=
+        # <target node>, bucket-routed fan-out) and one SCAN stream (no
+        # selector, full fan-out) collect the same churn; after client-
+        # side filtering, their (type, name, rv) multisets must be equal
+        # — the indexed-==-scan invariant on a live cluster.
+        # (b) idle-informer freshness: an informer on a GHOST node (no
+        # events, ever) idles while the churn rolls the cacher history
+        # ring (> CACHER_WINDOW events), then has its stream cut.  With
+        # progress bookmarks its resume rv rode the cache head, so the
+        # reconnect replays cleanly: ZERO extra relists, and a pod later
+        # landing on the ghost node still arrives (lossless).
+        from kubernetes1_tpu.client.rest import ApiClient
+
+        target_node = bound[0].spec.node_name if bound else "cn0"
+        fin_marker = f"chaos-fin-{seed}"
+        _, rv0 = cs.pods.list(namespace="default")
+        idle_inf = SharedInformer(
+            cs.pods, namespace="default",
+            field_selector="spec.nodeName=ghost-node").start()
+        idle_inf.wait_for_sync(10.0)
+        idle_relists0 = idle_inf.relists
+
+        indexed_evs, scan_evs = [], []
+        fin_seen = [threading.Event(), threading.Event()]
+
+        def _collect(params, sink, fin_ev):
+            api = ApiClient(master.url)
+            try:
+                with api.watch("/api/v1/namespaces/default/pods",
+                               params) as stream:
+                    for etype, obj in stream:
+                        if etype == "BOOKMARK":
+                            continue
+                        meta = obj.get("metadata") or {}
+                        sink.append((etype, meta.get("name"),
+                                     meta.get("resourceVersion"),
+                                     (obj.get("spec") or {})
+                                     .get("nodeName")))
+                        ann = meta.get("annotations") or {}
+                        if ann.get("chaos.ktpu.io/fin") == fin_marker:
+                            fin_ev.set()
+                            return
+            finally:
+                api.close()
+
+        collectors = [
+            threading.Thread(
+                target=_collect,
+                args=({"resourceVersion": str(rv0),
+                       "fieldSelector": f"spec.nodeName={target_node}"},
+                      indexed_evs, fin_seen[0]),
+                daemon=True),
+            threading.Thread(
+                target=_collect,
+                args=({"resourceVersion": str(rv0)}, scan_evs,
+                      fin_seen[1]),
+                daemon=True),
+        ]
+        for th in collectors:
+            th.start()
+        # churn WELL past the cacher window (configmaps — they share the
+        # watch cache's history ring with pods), with target-node pod
+        # mutations mixed in so the indexed stream has real deliveries,
+        # including a DELETED-while-matching
+        target_pods = [p for p in bound
+                       if p.spec.node_name == target_node]
+        for i in range(CACHER_WINDOW + 60):
+            cm = t.ConfigMap(data={"i": str(i)})
+            cm.metadata.name = f"churn-{seed}-{i}"
+            cs.configmaps.create(cm, namespace="default")
+            if i % 100 == 50 and target_pods:
+                cs.pods.patch(target_pods[0].metadata.name,
+                              {"metadata": {"annotations": {
+                                  "chaos.ktpu.io/churn": str(i)}}})
+        if len(target_pods) > 1:
+            cs.pods.delete(target_pods[-1].metadata.name, "default")
+        if target_pods:
+            cs.pods.patch(target_pods[0].metadata.name,
+                          {"metadata": {"annotations": {
+                              "chaos.ktpu.io/fin": fin_marker}}})
+        for ev in fin_seen:
+            ev.wait(15.0)
+        dispatch_equal = (target_pods == [] or (
+            fin_seen[0].is_set() and fin_seen[1].is_set()
+            and sorted(e for e in indexed_evs if e[3] == target_node)
+            == sorted(e for e in scan_evs if e[3] == target_node)))
+        # idle informer: let a heartbeat carry the post-churn progress
+        # bookmark, then cut the stream mid-idle and require a CLEAN
+        # reconnect (no 410 relist) plus lossless delivery of a pod that
+        # lands on the ghost node afterwards
+        time.sleep(apiserver_server.WATCH_HEARTBEAT_SECONDS * 3)
+        ws = idle_inf._watch_stream
+        if ws is not None:
+            ws.close()
+        ghost_pod = make_tpu_pod(f"ghost-{seed}", tpus=1)
+        ghost_pod.spec.node_name = "ghost-node"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and idle_inf.reconnects < 1 \
+                and idle_inf.relists == idle_relists0:
+            time.sleep(0.1)
+        cs.pods.create(ghost_pod)
+        deadline = time.monotonic() + 10
+        idle_converged = False
+        while time.monotonic() < deadline and not idle_converged:
+            idle_converged = idle_inf.get(
+                f"default/{ghost_pod.metadata.name}") is not None
+            if not idle_converged:
+                time.sleep(0.1)
+        idle_relists = idle_inf.relists - idle_relists0
+
         bs_frames = _bindstream.bindstream_frames_total.value - bs_frames0
         bs_falls = (_bindstream.bindstream_fallbacks_total.value
                     - bs_falls0)
@@ -947,6 +1070,16 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
             "bindstream_fallbacks": int(bs_falls),
             "paginated_informer_converged": page_converged,
             "paginated_relists": page_inf.relists,
+            # PR 13 phase verdicts: dispatch-index equivalence on a live
+            # stream pair, and the idle informer surviving a compacted
+            # window with ZERO 410 relists (bookmark-kept-fresh)
+            "dispatch_equal": dispatch_equal,
+            "dispatch_indexed_hits": getattr(
+                master.cacher, "dispatch_indexed_hits", 0),
+            "watch_bookmarks": master.watch_bookmarks,
+            "idle_informer_relists_after_compaction": idle_relists,
+            "idle_informer_reconnects": idle_inf.reconnects,
+            "idle_informer_converged": idle_converged,
             "faults": fault_stats,
             "ok": (len(bound) >= PODS
                    and len(s_b.owned_shards()) == SHARDS
@@ -954,10 +1087,17 @@ def run_sched_shard_schedule(seed: int, duration: float = 6.0,
                    # the bind leg was actually exercised: rounds rode the
                    # stream and/or fell back — silence means misconfig
                    and (bs_frames + bs_falls) > 0
-                   and page_converged),
+                   and page_converged
+                   and dispatch_equal
+                   and idle_relists == 0
+                   and idle_converged
+                   and master.watch_bookmarks > 0),
         })
     finally:
         faultline.deactivate()
+        apiserver_server.WATCH_HEARTBEAT_SECONDS = old_heartbeat
+        if idle_inf is not None:
+            _stop_quietly_mod(idle_inf.stop)
         if page_inf is not None:
             _stop_quietly_mod(page_inf.stop)
         for comp in (s_b, s_a):

@@ -1,0 +1,126 @@
+"""Hollow-watcher swarm worker: the kubemark hollow-node analog for the
+WATCH path.  One process hosts N informer-only kubelet stand-ins — each a
+SharedInformer on pods filtered by `spec.nodeName=<node-i>`, exactly the
+list+watch a real kubelet runs — so thousands of per-node watch streams
+hit the apiserver from a handful of OS processes (pkg/kubemark multiplexes
+hollow kubelets the same way).
+
+Driven by scripts/sched_perf.py --hollow-watchers (which spawns one worker
+per ~500 watchers); standalone use:
+
+    python scripts/hollow_swarm.py --server http://127.0.0.1:8080 \
+        --nodes 1000 --count 500 --offset 0 --stats-out /tmp/hollow.json
+
+The worker writes a stats JSON (atomically, every --stats-interval and on
+SIGTERM): watcher count, how many informers have synced, relists /
+reconnects / relist-bytes totals.  A healthy bookmark-kept-fresh swarm
+shows relists == watchers (the initial LIST each) and zero growth after —
+every further relist is exactly the 410 cost the progress bookmarks and
+the dispatch index exist to eliminate.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes1_tpu.client import Clientset  # noqa: E402
+from kubernetes1_tpu.client.informer import SharedInformer  # noqa: E402
+
+
+def _write_stats(path: str, informers, t0: float, synced_at):
+    stats = {
+        "watchers": len(informers),
+        "synced": sum(1 for inf in informers if inf.has_synced()),
+        "relists": sum(inf.relists for inf in informers),
+        "reconnects": sum(inf.reconnects for inf in informers),
+        "relist_bytes": sum(inf.relist_bytes for inf in informers),
+        "cached_objects": sum(len(inf.keys()) for inf in informers),
+        "sync_wall_s": (round(synced_at - t0, 2)
+                        if synced_at is not None else None),
+        "uptime_s": round(time.monotonic() - t0, 2),
+        "pid": os.getpid(),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(stats, f)
+    os.replace(tmp, path)  # atomic: the driver never reads a torn file
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", required=True,
+                    help="comma-separated apiserver URL list (failover set)")
+    ap.add_argument("--nodes", type=int, required=True,
+                    help="cluster node-name space (watcher i follows node "
+                         "i %% nodes)")
+    ap.add_argument("--count", type=int, required=True,
+                    help="informers hosted by THIS worker")
+    ap.add_argument("--offset", type=int, default=0,
+                    help="first watcher index (workers partition the range)")
+    ap.add_argument("--node-prefix", default="perf-",
+                    help="node-name prefix (sched_perf creates perf-<i>)")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--stats-out", default="",
+                    help="stats JSON path (written periodically + on "
+                         "SIGTERM); empty = stdout once at exit")
+    ap.add_argument("--stats-interval", type=float, default=2.0)
+    ap.add_argument("--no-progress-bookmarks", action="store_true",
+                    help="A/B control: pre-bookmark behavior (idle "
+                         "watchers age below the compaction floor and "
+                         "pay 410 full relists)")
+    args = ap.parse_args()
+
+    # ONE clientset for the whole swarm: each informer's watch opens its
+    # own dedicated connection anyway, and relist requests ride per-thread
+    # pooled keep-alive conns — sharing the client costs nothing and keeps
+    # object count linear in watchers, not watchers x clients
+    cs = Clientset(args.server)
+    informers = [
+        SharedInformer(
+            cs.pods,
+            namespace=args.namespace,
+            field_selector=(f"spec.nodeName="
+                            f"{args.node_prefix}{(args.offset + i) % args.nodes}"),
+            progress_bookmarks=not args.no_progress_bookmarks,
+        )
+        for i in range(args.count)
+    ]
+    t0 = time.monotonic()
+    for inf in informers:
+        inf.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    synced_at = None
+    while not stop.wait(args.stats_interval):
+        if synced_at is None and all(inf.has_synced() for inf in informers):
+            synced_at = time.monotonic()
+        if args.stats_out:
+            _write_stats(args.stats_out, informers, t0, synced_at)
+    if synced_at is None and all(inf.has_synced() for inf in informers):
+        synced_at = time.monotonic()
+    if args.stats_out:
+        _write_stats(args.stats_out, informers, t0, synced_at)
+    else:
+        print(json.dumps({
+            "watchers": len(informers),
+            "synced": sum(1 for inf in informers if inf.has_synced()),
+            "relists": sum(inf.relists for inf in informers),
+            "reconnects": sum(inf.reconnects for inf in informers),
+            "relist_bytes": sum(inf.relist_bytes for inf in informers),
+        }), flush=True)
+    # no per-informer stop(): the process is exiting — tearing down
+    # thousands of daemon watch threads one by one just delays SIGTERM
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

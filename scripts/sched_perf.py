@@ -42,7 +42,8 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                    store_proc: bool = False, store_shards: int = 1,
                    apiservers: int = 1, bind_codec: str = "json",
                    store_wal: bool = False,
-                   bind_stream: bool = False) -> dict:
+                   bind_stream: bool = False,
+                   hollow_watchers: int = 0) -> dict:
     """multiproc=True runs apiserver and scheduler as separate OS processes
     (the deployment shape) so they get real parallelism; in-process mode
     shares one GIL across every component, which caps the measurable
@@ -75,6 +76,23 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
         raise ValueError(
             "--store-shards/--apiservers require --multiproc (shard and "
             "apiserver processes are the deployment shape being measured)")
+    if hollow_watchers < 0:
+        raise ValueError(f"--hollow-watchers must be >= 0, "
+                         f"got {hollow_watchers}")
+    if hollow_watchers and not multiproc:
+        # the swarm's entire point is thousands of REAL watch streams
+        # against apiserver processes; in-process mode would put every
+        # informer thread on the measured GIL and the "envelope" would
+        # measure the harness (the --wire-codec guard's rule)
+        raise ValueError(
+            "--hollow-watchers requires --multiproc (the swarm must load "
+            "apiserver processes over real sockets, not share the "
+            "benchmark's GIL)")
+    if hollow_watchers and hollow_watchers < nodes:
+        print(f"sched_perf: note — {hollow_watchers} hollow watchers over "
+              f"{nodes} nodes leaves {nodes - hollow_watchers} nodes "
+              f"unwatched (kubemark parity wants one per node)",
+              file=sys.stderr, flush=True)
     # contention stamp BEFORE the run: the bench itself saturates the box
     # by design, so an end-of-run loadavg would flag every run as dirty.
     # Numbers from an already-loaded box are noise (22x p99 swing observed
@@ -91,10 +109,12 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             return s.getsockname()[1]
 
     procs = []
+    api_procs = []
     scheds = []
     metrics_urls = []
     store_metrics_urls = []
     api_urls = []
+    hollow_stats_files = []
     sched_shards = max(1, int(sched_shards))
     store_shards = max(1, int(store_shards))
     apiservers = max(1, int(apiservers))
@@ -144,9 +164,11 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             if store_addr:
                 api_args += ["--store-address", store_addr,
                              "--wire-codec", wire_codec]
-            procs.append(subprocess.Popen(
+            ap = subprocess.Popen(
                 api_args, cwd=repo, env=env,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            procs.append(ap)
+            api_procs.append(ap)
             api_urls.append(f"http://127.0.0.1:{port}")
         url = ",".join(api_urls)
         for a, u in enumerate(api_urls):
@@ -176,6 +198,29 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             procs.append(subprocess.Popen(
                 sched_args, cwd=repo, env=env,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        if hollow_watchers:
+            # the kubemark hollow-watcher swarm: informer-only kubelet
+            # stand-ins (pods filtered by spec.nodeName, the real kubelet
+            # list+watch shape) multiplexed ~500 per worker process so a
+            # 5000-watcher envelope costs ~10 processes, not 5000.  Each
+            # worker drops periodic stats JSON the result block reads.
+            hollow_tmp = tempfile.mkdtemp(prefix="ktpu-hollow-")
+            per_worker = 500
+            off = widx = 0
+            while off < hollow_watchers:
+                cnt = min(per_worker, hollow_watchers - off)
+                sf = os.path.join(hollow_tmp, f"hollow-{widx}.json")
+                hollow_stats_files.append(sf)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "scripts/hollow_swarm.py",
+                     "--server", rotated(api_urls, widx),
+                     "--nodes", str(nodes),
+                     "--count", str(cnt), "--offset", str(off),
+                     "--stats-out", sf],
+                    cwd=repo, env=env,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+                off += cnt
+                widx += 1
     else:
         master = Master().start()
         url = master.url
@@ -209,14 +254,43 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
             obs.register("store", u, instance=f"store-shard-{i}", shard=i)
         obs.start()
     try:
-        return _drive(nodes, pods, tpus_per_node, creators, multiproc,
-                      url, cs, master if not multiproc else None, scheds,
-                      metrics_urls, stamp, sched_shards, wire_codec,
-                      api_urls=api_urls,
-                      store_metrics_urls=store_metrics_urls,
-                      store_shards=store_shards, apiservers=apiservers,
-                      bind_codec=bind_codec, store_wal=store_wal,
-                      bind_stream=bind_stream, obs=obs)
+        if hollow_stats_files:
+            # the swarm must be SYNCED (initial LIST each) before the
+            # create storm, or its relist counters would mix startup cost
+            # into the steady-state claim the envelope makes
+            _wait_hollow_sync(hollow_stats_files, hollow_watchers,
+                              timeout=60.0 + hollow_watchers / 20.0)
+        rss_sampler = None
+        if multiproc and api_procs:
+            # per-apiserver RSS over the measured run: the envelope's
+            # flat-memory claim needs evidence, not a final snapshot
+            rss_sampler = _RssSampler([p.pid for p in api_procs])
+            rss_sampler.start()
+        result = _drive(nodes, pods, tpus_per_node, creators, multiproc,
+                        url, cs, master if not multiproc else None, scheds,
+                        metrics_urls, stamp, sched_shards, wire_codec,
+                        api_urls=api_urls,
+                        store_metrics_urls=store_metrics_urls,
+                        store_shards=store_shards, apiservers=apiservers,
+                        bind_codec=bind_codec, store_wal=store_wal,
+                        bind_stream=bind_stream, obs=obs)
+        if rss_sampler is not None:
+            result["apiserver_rss_mb"] = rss_sampler.stop_and_report()
+        if hollow_stats_files:
+            # workers rewrite stats every ~2s: wait one interval out so
+            # the block reflects the run's END state, not mid-storm
+            time.sleep(2.5)
+            hb = _read_hollow_stats(hollow_stats_files)
+            hb["requested"] = hollow_watchers
+            hb["worker_procs"] = len(hollow_stats_files)
+            # steady-state relist verdict: after sync, a bookmark-fresh
+            # swarm performs ZERO further full relists — each watcher's
+            # one initial LIST is the whole budget
+            hb["steady_state_relists"] = (
+                hb["relists"] - hb["synced"]
+                if hb.get("relists") is not None else None)
+            result["hollow_watchers"] = hb
+        return result
     finally:
         if obs is not None:
             obs.stop()
@@ -229,6 +303,114 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                 p.wait(timeout=5)
             except Exception:  # noqa: BLE001
                 p.kill()
+
+
+def _read_hollow_stats(stats_files) -> dict:
+    """Merge the swarm workers' stats JSONs (sums; sync_wall = slowest
+    worker).  A worker that never wrote its file reports as absent —
+    `workers_reporting` keeps a silent crash from reading as a healthy
+    zero-relist swarm."""
+    out = {"watchers": 0, "synced": 0, "relists": 0, "reconnects": 0,
+           "relist_bytes": 0, "cached_objects": 0, "workers_reporting": 0,
+           "sync_wall_s": None}
+    for sf in stats_files:
+        try:
+            with open(sf) as f:
+                s = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out["workers_reporting"] += 1
+        for k in ("watchers", "synced", "relists", "reconnects",
+                  "relist_bytes", "cached_objects"):
+            out[k] += int(s.get(k) or 0)
+        sw = s.get("sync_wall_s")
+        if sw is not None:
+            out["sync_wall_s"] = max(out["sync_wall_s"] or 0.0, sw)
+    return out
+
+
+def _wait_hollow_sync(stats_files, total: int, timeout: float):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _read_hollow_stats(stats_files)["synced"] >= total:
+            return
+        time.sleep(1.0)
+    got = _read_hollow_stats(stats_files)
+    raise RuntimeError(
+        f"hollow-watcher swarm never synced: {got['synced']}/{total} "
+        f"after {timeout:.0f}s ({got['workers_reporting']}/"
+        f"{len(stats_files)} workers reporting)")
+
+
+class _RssSampler:
+    """Samples /proc/<pid> VmRSS for the apiserver processes once a second
+    (daemon thread); stop_and_report() summarizes per-process start/max/
+    end and a flatness verdict — the envelope's memory claim."""
+
+    def __init__(self, pids, interval: float = 1.0):
+        self._pids = list(pids)
+        self._interval = interval
+        self._samples = {pid: [] for pid in self._pids}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="apiserver-rss-sampler")
+
+    @staticmethod
+    def _rss_mb(pid):
+        try:
+            with open(f"/proc/{pid}/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) / 1024.0
+        except (OSError, ValueError, IndexError):
+            return None
+        return None
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            for pid in self._pids:
+                v = self._rss_mb(pid)
+                if v is not None:
+                    self._samples[pid].append(v)
+
+    def _sample_all(self):
+        for pid in self._pids:
+            v = self._rss_mb(pid)
+            if v is not None:
+                self._samples[pid].append(v)
+
+    def start(self):
+        self._sample_all()  # immediate baseline: short runs still report
+        self._thread.start()
+        return self
+
+    def stop_and_report(self) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._sample_all()  # final point: growth covers the whole run
+        per = []
+        for pid in self._pids:
+            xs = self._samples[pid]
+            if not xs:
+                per.append({"pid": pid, "samples": 0})
+                continue
+            growth = xs[-1] - xs[0]
+            per.append({
+                "pid": pid, "samples": len(xs),
+                "start": round(xs[0], 1), "max": round(max(xs), 1),
+                "end": round(xs[-1], 1), "growth": round(growth, 1),
+            })
+        growths = [p["growth"] for p in per if "growth" in p]
+        starts = [p["start"] for p in per if "start" in p]
+        # "flat": no apiserver grew by more than max(100MB, 25% of its
+        # starting RSS) across the run — growth proportional to pod count
+        # (leaked watch buffers, unbounded history) fails this loudly.
+        # None (not false) when nothing was sampled: absence of evidence
+        # must not read as a failed memory claim.
+        flat = (None if not growths else all(
+            g <= max(100.0, 0.25 * s) for g, s in zip(growths, starts)))
+        return {"per_apiserver": per, "flat": flat,
+                "max_growth_mb": round(max(growths), 1) if growths else None}
 
 
 def scrape_metrics(metrics_url: str) -> dict:
@@ -307,6 +489,7 @@ def observability_block(obs) -> Optional[dict]:
                                     quantile="0.99"),
         "informer_relists": total("ktpu_informer_relists_total"),
         "informer_reconnects": total("ktpu_informer_reconnects_total"),
+        "informer_relist_bytes": total("ktpu_informer_relist_bytes_total"),
         "scrape_staleness_max_s": worst("ktpu_obs_scrape_staleness_seconds"),
         "scrapes": obs.scrapes_total,
         "scrape_errors": obs.scrape_errors_total,
@@ -562,6 +745,18 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             round(idx_hits / (idx_hits + idx_misses), 4)
             if (idx_hits + idx_misses) else None),
         "list_continue_rounds": amx.get("ktpu_list_continue_total"),
+        # watch fan-out economics (the dispatch index): per-event work =
+        # indexed_hits + scans; the scan-equivalent cost would have been
+        # watchers x events.  bookmarks = frames keeping idle watchers'
+        # resume rvs fresh; relist_bytes = what informers paid for full
+        # relists (bookmark-fresh swarms pay the initial LIST only)
+        "watch_dispatch_indexed_hits": amx.get(
+            "ktpu_watch_dispatch_indexed_hits_total"),
+        "watch_dispatch_scans": amx.get("ktpu_watch_dispatch_scans_total"),
+        "watch_bookmarks": amx.get("ktpu_watch_bookmarks_total"),
+        "informer_relist_bytes": (
+            mx.get("ktpu_informer_relist_bytes_total")
+            or amx.get("ktpu_informer_relist_bytes_total") or 0),
         "bindstream_frames": bs_frames,
         "bindstream_bytes_per_frame": (
             round(bs_bytes / bs_frames, 1) if bs_frames else None),
@@ -789,6 +984,14 @@ def main():
                     help="give each store (shard) process a WAL — the "
                          "deployment's durable shape; each shard then "
                          "pays (and parallelizes) its own fsync stream")
+    ap.add_argument("--hollow-watchers", type=int, default=0,
+                    help="N informer-only kubelet stand-ins (pods watched "
+                         "by spec.nodeName — the kubemark hollow-node "
+                         "watch shape), multiplexed ~500 per worker "
+                         "process; multiproc only.  The result grows a "
+                         "hollow_watchers block (sync wall, steady-state "
+                         "relists, relist bytes) and apiserver_rss_mb "
+                         "(per-apiserver flatness verdict)")
     args = ap.parse_args()
     print(json.dumps(run_sched_perf(args.nodes, args.pods, args.tpus_per_node,
                                     args.creators, args.multiproc,
@@ -799,7 +1002,8 @@ def main():
                                     apiservers=args.apiservers,
                                     bind_codec=args.bind_codec,
                                     store_wal=args.store_wal,
-                                    bind_stream=args.bind_stream)))
+                                    bind_stream=args.bind_stream,
+                                    hollow_watchers=args.hollow_watchers)))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,512 @@
+"""Selector-indexed watch dispatch + progress bookmarks (the 5000-node
+fan-out levers).
+
+Invariants under test:
+- indexed dispatch == scan dispatch, frame for frame: buckets only NARROW
+  the candidate set and the serving layer re-checks every selector, so an
+  indexed stream's event multiset equals a scan stream's client-side
+  filter — including the update-that-moves-the-indexed-value (delivered
+  to BOTH buckets) and DELETED-while-matching;
+- idle watchers are FREE and STAY FRESH: a bucket watcher whose value
+  never fires costs zero dispatch work, and progress bookmarks keep its
+  resume rv above the compaction floor so a reconnect after a churned-out
+  window performs ZERO full relists (the A/B control without bookmarks
+  proves the 410 path this replaces);
+- the work bound: at 1000 single-node watchers, per-event dispatch work
+  is >= 10x below the per-watcher scan (slow tier);
+- streams that didn't opt in stay byte-identical (golden).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.apiserver import server as apiserver_server
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.client.informer import SharedInformer
+from kubernetes1_tpu.client.rest import ApiClient
+from kubernetes1_tpu.machinery import ADDED, DELETED, MODIFIED
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+from kubernetes1_tpu.storage.cacher import Cacher
+
+from tests.test_machinery import make_pod
+
+
+def key(pod):
+    return f"/registry/pods/{pod.metadata.namespace}/{pod.metadata.name}"
+
+
+def pod_on(name, node):
+    p = make_pod(name)
+    p.spec.node_name = node
+    return p
+
+
+@pytest.fixture
+def store():
+    s = Store(global_scheme)
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def cacher(store):
+    c = Cacher(store, global_scheme).start()
+    yield c
+    c.stop()
+
+
+def drain(w, timeout=2.0):
+    """Every event currently deliverable on a watcher (non-blocking-ish)."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        batch = w.next_batch_timeout(0.05)
+        if batch:
+            out.extend(batch)
+        elif out:
+            break
+    return out
+
+
+class TestDispatchIndex:
+    """Cacher-layer bucket routing."""
+
+    def test_bucketed_watcher_gets_only_its_value(self, store, cacher):
+        wa = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-a"))
+        wb = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-b"))
+        store.create(key(pod_on("pa", "node-a")), pod_on("pa", "node-a"))
+        store.create(key(pod_on("pb", "node-b")), pod_on("pb", "node-b"))
+        evs_a = drain(wa)
+        evs_b = drain(wb)
+        assert [(e.type, e.object["metadata"]["name"]) for e in evs_a] == \
+            [(ADDED, "pa")]
+        assert [(e.type, e.object["metadata"]["name"]) for e in evs_b] == \
+            [(ADDED, "pb")]
+        # routing went through the index, not the scan leg
+        assert cacher.dispatch_indexed_hits == 2
+        assert cacher.dispatch_scans == 0
+        wa.stop()
+        wb.stop()
+
+    def test_update_moving_value_delivers_to_both_buckets(self, store,
+                                                          cacher):
+        """The both-buckets rule: a nodeName move is a transition BOTH
+        sides must see (old side drops it at event_matches, exactly like
+        a scan stream would — but the delivery must reach the bucket)."""
+        created = store.create(key(pod_on("p1", "node-a")),
+                               pod_on("p1", "node-a"))
+        wa = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-a"))
+        wb = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-b"))
+        moved = pod_on("p1", "node-b")
+        moved.metadata.resource_version = created.metadata.resource_version
+        moved.metadata.uid = created.metadata.uid
+        store.update_cas(key(moved), moved)
+        evs_a = drain(wa)
+        evs_b = drain(wb)
+        # old bucket: sees the MODIFIED (object now names node-b — the
+        # serving layer's event_matches would drop the frame, same as a
+        # scan stream's filter; the cacher's job is only delivery)
+        assert [(e.type, e.object["spec"]["nodeName"]) for e in evs_a] == \
+            [(MODIFIED, "node-b")]
+        # new bucket: sees the same MODIFIED (its filter passes it)
+        assert [(e.type, e.object["spec"]["nodeName"]) for e in evs_b] == \
+            [(MODIFIED, "node-b")]
+        wa.stop()
+        wb.stop()
+
+    def test_deleted_while_matching_delivered(self, store, cacher):
+        store.create(key(pod_on("p1", "node-a")), pod_on("p1", "node-a"))
+        wa = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-a"))
+        store.delete(key(pod_on("p1", "node-a")))
+        evs = drain(wa)
+        assert [(e.type, e.object["metadata"]["name"]) for e in evs] == \
+            [(DELETED, "p1")]
+        wa.stop()
+
+    def test_undeclared_field_hint_falls_back_to_scan(self, store, cacher):
+        w = cacher.watch("/registry/pods/",
+                         index_hint=("status.phase", "Running"))
+        assert w.dispatch_hint is None  # not a declared index: scan leg
+        store.create(key(make_pod("p1")), make_pod("p1"))
+        assert [e.type for e in drain(w)] == [ADDED]
+        assert cacher.dispatch_scans >= 1
+        assert cacher.dispatch_indexed_hits == 0
+        w.stop()
+
+    def test_idle_bucket_watcher_costs_zero_dispatch_work(self, store,
+                                                          cacher):
+        w = cacher.watch("/registry/pods/",
+                         index_hint=("spec.nodeName", "ghost"))
+        for i in range(20):
+            store.create(key(pod_on(f"p{i}", "node-a")),
+                         pod_on(f"p{i}", "node-a"))
+        # unbound-value buckets were never walked for this watcher: the
+        # whole point — an idle watcher is invisible to the fan-out
+        assert cacher.dispatch_indexed_hits == 0
+        assert cacher.dispatch_scans == 0
+        assert w.next_batch_timeout(0.05) is None
+        w.stop()
+
+    def test_stop_cleans_bucket_and_scan_registrations(self, store, cacher):
+        wa = cacher.watch("/registry/pods/",
+                          index_hint=("spec.nodeName", "node-a"))
+        ws = cacher.watch("/registry/pods/")
+        wa.stop()
+        ws.stop()
+        with cacher._cond:
+            assert cacher._watchers == []
+            assert cacher._scan_watchers == []
+            assert cacher._watch_index == {}
+
+    def test_progress_rv_safe_only_when_drained(self, store, cacher):
+        store.create(key(pod_on("p0", "node-a")), pod_on("p0", "node-a"))
+        w = cacher.watch("/registry/pods/",
+                         index_hint=("spec.nodeName", "node-a"))
+        assert w.progress_rv() == store.current_revision()
+        store.create(key(pod_on("p1", "node-a")), pod_on("p1", "node-a"))
+        # an undelivered event is queued: no safe progress answer
+        assert w.progress_rv() is None
+        drain(w)
+        assert w.progress_rv() == store.current_revision()
+        w.stop()
+
+
+class TestIndexedScanEquivalence:
+    """HTTP-layer golden: an indexed stream's frames == a scan stream's
+    frames client-side-filtered, under concurrent writes that create,
+    annotate, move, and delete pods across nodes."""
+
+    def test_equivalence_under_concurrent_writes(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            _, rv0 = cs.pods.list(namespace="default")
+            fin = "equiv-fin"
+            indexed, scanned = [], []
+            fin_seen = [threading.Event(), threading.Event()]
+
+            def collect(params, sink, ev):
+                api = ApiClient(master.url)
+                try:
+                    with api.watch("/api/v1/namespaces/default/pods",
+                                   params) as stream:
+                        for etype, obj in stream:
+                            if etype == "BOOKMARK":
+                                continue
+                            m = obj.get("metadata") or {}
+                            sink.append(
+                                (etype, m.get("name"),
+                                 m.get("resourceVersion"),
+                                 (obj.get("spec") or {}).get("nodeName")))
+                            ann = m.get("annotations") or {}
+                            if ann.get("fin") == fin:
+                                ev.set()
+                                return
+                finally:
+                    api.close()
+
+            threads = [
+                threading.Thread(target=collect, args=(
+                    {"resourceVersion": str(rv0),
+                     "fieldSelector": "spec.nodeName=n1"},
+                    indexed, fin_seen[0]), daemon=True),
+                threading.Thread(target=collect, args=(
+                    {"resourceVersion": str(rv0)},
+                    scanned, fin_seen[1]), daemon=True),
+            ]
+            for th in threads:
+                th.start()
+
+            def writer(widx):
+                wcs = Clientset(master.url)
+                try:
+                    for i in range(8):
+                        name = f"eq-{widx}-{i}"
+                        cs_node = ("n1", "n2", "")[i % 3]
+                        p = make_pod(name)
+                        p.spec.node_name = cs_node
+                        wcs.pods.create(p)
+                        wcs.pods.patch(name, {"metadata": {"annotations": {
+                            "w": str(i)}}})
+                        if i % 3 == 2:
+                            # the MOVE the API allows: "" -> n1 (the bind
+                            # transition) — the default-value bucket to
+                            # the n1 bucket, both must see it
+                            wcs.pods.patch(
+                                name, {"spec": {"nodeName": "n1"}})
+                        if i % 4 == 1:
+                            wcs.pods.delete(name, "default")
+                finally:
+                    wcs.close()
+
+            writers = [threading.Thread(target=writer, args=(k,),
+                                        daemon=True) for k in range(4)]
+            for th in writers:
+                th.start()
+            for th in writers:
+                th.join()
+            marker = make_pod("eq-fin")
+            marker.spec.node_name = "n1"
+            marker.metadata.annotations = {"fin": fin}
+            cs.pods.create(marker)
+            for ev in fin_seen:
+                assert ev.wait(10.0), "stream never saw the fin marker"
+            want = sorted(e for e in scanned if e[3] == "n1")
+            got = sorted(e for e in indexed if e[3] == "n1")
+            assert got == want
+            # the indexed stream is pure: nothing with another node's
+            # value survives the server-side re-check
+            assert all(e[3] == "n1" for e in indexed)
+            assert master.cacher.dispatch_indexed_hits > 0
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestProgressBookmarks:
+    """Idle-informer freshness across a compacted window."""
+
+    WINDOW = 64
+
+    def _churn_master(self, monkeypatch):
+        monkeypatch.setattr(apiserver_server, "WATCH_HEARTBEAT_SECONDS",
+                            0.2)
+        return Master(cacher_history_limit=self.WINDOW,
+                      store_history_limit=self.WINDOW).start()
+
+    def _churn(self, cs, n):
+        for i in range(n):
+            cm = t.ConfigMap(data={"i": str(i)})
+            cm.metadata.name = f"churn-{i}"
+            cs.configmaps.create(cm, namespace="default")
+
+    def _cut_and_wait_reconnect(self, inf, relists0, timeout=10.0):
+        ws = inf._watch_stream
+        assert ws is not None
+        ws.close()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if inf.reconnects >= 1 or inf.relists > relists0:
+                return
+            time.sleep(0.05)
+        raise AssertionError("informer never re-established its watch")
+
+    def test_idle_informer_survives_compaction_with_bookmarks(
+            self, monkeypatch):
+        master = self._churn_master(monkeypatch)
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.pods, namespace="default",
+                             field_selector="spec.nodeName=ghost").start()
+        try:
+            assert inf.wait_for_sync(10.0)
+            relists0 = inf.relists
+            self._churn(cs, self.WINDOW + 20)  # roll BOTH history rings
+            time.sleep(1.0)  # >= several heartbeats: bookmark lands
+            self._cut_and_wait_reconnect(inf, relists0)
+            # THE claim: reconnect across the compacted window without a
+            # single 410 full relist — the bookmark kept the rv fresh
+            assert inf.relists == relists0
+            assert inf.reconnects >= 1
+            # and the resumed stream is live + lossless: a pod landing on
+            # the ghost node arrives through the bucket path
+            p = make_pod("ghost-pod")
+            p.spec.node_name = "ghost"
+            cs.pods.create(p)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    inf.get("default/ghost-pod") is None:
+                time.sleep(0.05)
+            assert inf.get("default/ghost-pod") is not None
+            assert master.watch_bookmarks > 0
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()
+
+    def test_without_bookmarks_compaction_forces_relist(self, monkeypatch):
+        """A/B control: the exact same scenario minus the opt-in pays the
+        410 full relist the bookmarks eliminate — proves the mechanism,
+        not just the absence of a symptom."""
+        master = self._churn_master(monkeypatch)
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.pods, namespace="default",
+                             field_selector="spec.nodeName=ghost",
+                             progress_bookmarks=False).start()
+        try:
+            assert inf.wait_for_sync(10.0)
+            relists0 = inf.relists
+            self._churn(cs, self.WINDOW + 20)
+            time.sleep(1.0)
+            self._cut_and_wait_reconnect(inf, relists0)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and inf.relists == relists0:
+                time.sleep(0.05)
+            assert inf.relists > relists0
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()
+
+    def test_non_opt_in_stream_stays_byte_identical(self, monkeypatch):
+        """Golden: a stream with NO opt-in params carries exactly the
+        per-event frames (the scheme's cached bytes) and newline
+        heartbeats — no BOOKMARK ever, byte-for-byte the PR 12 wire."""
+        monkeypatch.setattr(apiserver_server, "WATCH_HEARTBEAT_SECONDS",
+                            0.2)
+        master = Master().start()
+        cs = Clientset(master.url)
+        import http.client
+        from urllib.parse import urlparse
+
+        u = urlparse(master.url)
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+        try:
+            _, rv0 = cs.pods.list(namespace="default")
+            conn.request(
+                "GET",
+                f"/api/v1/namespaces/default/pods?watch=true"
+                f"&resourceVersion={rv0}")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            for i in range(3):
+                cs.pods.create(make_pod(f"g{i}"))
+            time.sleep(0.8)  # several heartbeat periods
+            # the server's own committed wire dicts: what _serve_watch
+            # ships, frame for frame (cached bytes may order keys
+            # differently than a fresh dumps, so compare canonically)
+            entries, _rev = master.store.list_raw(
+                "/registry/pods/default/")
+            expected = [
+                {"type": ADDED, "object": obj}
+                for _k, _r, obj in sorted(entries, key=lambda e: e[1])]
+            got_frames = []
+            deadline = time.monotonic() + 5
+            while len(got_frames) < 3 and time.monotonic() < deadline:
+                line = resp.readline()
+                if not line or line.strip() == b"":
+                    continue  # heartbeat newline: the only non-event byte
+                assert b"BOOKMARK" not in line
+                got_frames.append(json.loads(line))
+            assert got_frames == expected[:3]
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            cs.close()
+            master.stop()
+
+
+@pytest.mark.slow
+class TestDispatchWorkBound:
+    def test_1000_single_node_watchers_10x_under_scan(self, store, cacher):
+        """The acceptance bound: per-event fan-out work at 1000
+        single-node watchers is >= 10x below the per-watcher scan."""
+        WATCHERS, EVENTS = 1000, 200
+        ws = [cacher.watch("/registry/pods/",
+                           index_hint=("spec.nodeName", f"node-{i}"))
+              for i in range(WATCHERS)]
+        try:
+            base_hits = cacher.dispatch_indexed_hits
+            base_scans = cacher.dispatch_scans
+            for i in range(EVENTS):
+                node = f"node-{i % WATCHERS}"
+                store.create(key(pod_on(f"wp{i}", node)),
+                             pod_on(f"wp{i}", node))
+            work = (cacher.dispatch_indexed_hits - base_hits
+                    + cacher.dispatch_scans - base_scans)
+            scan_equivalent = WATCHERS * EVENTS
+            assert work * 10 <= scan_equivalent, (
+                f"dispatch work {work} not >=10x under the "
+                f"{scan_equivalent} scan equivalent")
+            # and delivery is still correct: each event reached exactly
+            # its node's watcher
+            assert cacher.dispatch_indexed_hits - base_hits == EVENTS
+        finally:
+            for w in ws:
+                w.stop()
+
+
+class TestResyncWiring:
+    def test_resync_period_redelivers_locally(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        inf = SharedInformer(cs.pods, namespace="default",
+                             resync_period=0.1)
+        updates = []
+        inf.add_handler(on_update=lambda old, new: updates.append(
+            (old.metadata.name, old is new)))
+        inf.start()
+        try:
+            cs.pods.create(make_pod("rs-1"))
+            assert inf.wait_for_sync(10.0)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and len(updates) < 3:
+                time.sleep(0.05)
+            assert len(updates) >= 3  # periodic backstop fired
+            # resync convention: old IS new (a backstop tick, not a diff)
+            assert all(same for _name, same in updates)
+            # and it is LOCAL redelivery, not API polling: one initial
+            # LIST is the informer's entire request budget
+            assert inf.relists == 1
+        finally:
+            inf.stop()
+            cs.close()
+            master.stop()
+
+    def test_factory_shortest_resync_wins(self):
+        from kubernetes1_tpu.client.informer import InformerFactory
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            factory = InformerFactory(cs)
+            a = factory.informer("pods", resync_period=10.0)
+            b = factory.informer("pods", resync_period=2.0)
+            assert a is b
+            assert a.resync_period == 2.0
+            c = factory.informer("pods")  # no ask: keeps the 2.0
+            assert c.resync_period == 2.0
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestHarnessGuards:
+    def test_hollow_watchers_require_multiproc(self):
+        from scripts.sched_perf import run_sched_perf
+
+        with pytest.raises(ValueError, match="hollow-watchers"):
+            run_sched_perf(10, 20, multiproc=False, hollow_watchers=100)
+
+    def test_negative_hollow_watchers_refused(self):
+        from scripts.sched_perf import run_sched_perf
+
+        with pytest.raises(ValueError, match="hollow-watchers"):
+            run_sched_perf(10, 20, multiproc=True, hollow_watchers=-1)
+
+    def test_dispatch_metrics_rendered(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(make_pod("m1"))
+            body = cs.api.request("GET", "/metrics", raw=True).decode()
+            for name in ("ktpu_watch_dispatch_indexed_hits_total",
+                         "ktpu_watch_dispatch_scans_total",
+                         "ktpu_watch_bookmarks_total",
+                         "ktpu_informer_relist_bytes_total"):
+                assert name in body
+        finally:
+            cs.close()
+            master.stop()
