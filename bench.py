@@ -39,7 +39,19 @@ Environment-variable table (the driver's knobs; defaults in parens):
                               — the 5000-node run is BENCH_NODES=5000
                               BENCH_PODS_PER_NODE=30
                               BENCH_HOLLOW_WATCHERS=5000
-  BENCH_SKIP_{GANG,SCHED,SCHED1K,KUBEMARK,WORKLOAD} (unset)
+  BENCH_CHURN_RATE (60)       churn phase: target creates+deletes/s the
+                              actor fleet recycles at
+  BENCH_CHURN_ACTORS (32)     churn phase: actor fleet size
+  BENCH_CHURN_SECONDS (20)    churn phase: measured churn duration
+  BENCH_CHURN_NODES (4)       churn phase: hollow nodes
+  BENCH_CHURN_COALESCE_MS (50)  endpoints coalesce window (ms)
+  BENCH_CHURN_SINGLETON (0)   1 = A/B control: per-pod DELETEs +
+                              coalesce window 0 (the pre-batch wire)
+  BENCH_CHURN_WAIT_READY (1)  0 = open-loop capacity probe (recycle on
+                              replacement CREATED, not Running)
+  BENCH_CHURN_WORKERS (1)     concurrent recycle threads (slot space
+                              partitioned across them)
+  BENCH_SKIP_{GANG,CHURN,SCHED,SCHED1K,KUBEMARK,WORKLOAD} (unset)
                               1 = skip that phase
   BENCH_KUBEMARK_NODES (200)  hollow-KUBELET count (full node loops;
                               distinct from the watcher swarm)
@@ -95,6 +107,22 @@ BIND_STREAM = os.environ.get("BENCH_BIND_STREAM", "") == "1"
 # only kubelet stand-ins watching pods by spec.nodeName, flat-RSS and
 # zero-steady-state-relist verdicts in its hollow_watchers block
 HOLLOW_WATCHERS = int(os.environ.get("BENCH_HOLLOW_WATCHERS", "0"))
+# RL actor-swarm churn phase (the Podracer shape, BENCH_r08+): a learner
+# gang Job + an actor fleet recycled at BENCH_CHURN_RATE creates+deletes/s
+# through pods/delete:batch, with the endpoints controller coalescing the
+# fleet Service's fan-out (BENCH_CHURN_COALESCE_MS window).
+# BENCH_CHURN_SINGLETON=1 is the A/B control: per-pod DELETEs + window 0.
+CHURN_RATE = float(os.environ.get("BENCH_CHURN_RATE", "60"))
+CHURN_ACTORS = int(os.environ.get("BENCH_CHURN_ACTORS", "32"))
+CHURN_SECONDS = float(os.environ.get("BENCH_CHURN_SECONDS", "20"))
+CHURN_NODES = int(os.environ.get("BENCH_CHURN_NODES", "4"))
+CHURN_COALESCE_MS = float(os.environ.get("BENCH_CHURN_COALESCE_MS", "50"))
+CHURN_SINGLETON = os.environ.get("BENCH_CHURN_SINGLETON", "") == "1"
+# 0 = open-loop capacity probe: a slot recycles as soon as its
+# replacement is CREATED, so the measured ops/s is the control plane's
+# create+delete capacity, not the kubelet restart pipeline's
+CHURN_WAIT_READY = os.environ.get("BENCH_CHURN_WAIT_READY", "1") == "1"
+CHURN_WORKERS = int(os.environ.get("BENCH_CHURN_WORKERS", "1"))
 
 
 def _pct(xs, q):
@@ -662,6 +690,170 @@ def bench_gang():
     }
 
 
+def bench_churn() -> dict:
+    """RL actor-swarm churn (the Podracer workload shape): a LocalCluster
+    with hollow kubelets + the full controller manager runs a LEARNER
+    gang Job (long-lived, chips) next to an ACTOR fleet (CPU-packable,
+    sub-minute lifetimes) fronted by a Service, and a churn driver
+    recycles the fleet at BENCH_CHURN_RATE creates+deletes/s through
+    pods/delete:batch — the first phase exercising the DELETION half of
+    the control plane at rate: batched group-commit deletes, scheduler
+    queue purges, endpoints fan-out coalescing, kubelet finalize churn.
+
+    Reports: sustained ops/s, actor-restart latency p50/p99 (delete
+    issued -> replacement Running), endpoints propagation lag p50/p99 +
+    writes-per-churn-event (< 0.5 is the coalescing claim), learner-gang
+    goodput while actors cycle, delete-batch occupancy, and leak checks.
+    BENCH_CHURN_SINGLETON=1 = the A/B control (per-pod DELETEs,
+    coalesce window 0)."""
+    import threading
+
+    from kubernetes1_tpu.controllers import endpoints as eps_ctrl
+    from kubernetes1_tpu.localcluster import LocalCluster
+    from kubernetes1_tpu.utils.features import gates
+    from kubernetes1_tpu.workloads.rl_actor import (
+        ACTOR_APP_LABEL, ChurnDriver, LEARNER_APP_LABEL, fleet_service,
+        learner_job)
+    from scripts.sched_perf import observability_block
+
+    singleton = CHURN_SINGLETON
+    window = 0.0 if singleton else CHURN_COALESCE_MS / 1000.0
+    writes0 = eps_ctrl.endpoints_writes_total.value
+    coal0 = eps_ctrl.endpoints_coalesced_total.value
+    # propagation-lag QUANTILES come from the process-cumulative module
+    # histogram: run A/B legs in separate processes (one bench.py
+    # invocation each — main() calls this phase once); the sample-count
+    # delta below says how many of the samples are this phase's
+    lag_count0 = eps_ctrl.endpoints_propagation_seconds.count
+    learner_workers = 2
+    cluster = LocalCluster(
+        nodes=CHURN_NODES, hollow=True, heartbeat_interval=2.0,
+        sync_interval=0.1, endpoints_coalesce_window=window,
+        obs=True, obs_interval=1.0).start()
+    stop = threading.Event()
+    goodput_samples = []
+    driver = None
+    try:
+        cluster.wait_ready(60)
+        cs = cluster.cs
+        gang = gates.enabled("GangScheduling")
+        cs.jobs.create(learner_job(workers=learner_workers,
+                                   tpus_per_worker=1, gang=gang))
+        cs.services.create(fleet_service("rl-learner-svc",
+                                         app=LEARNER_APP_LABEL))
+        cs.services.create(fleet_service("rl-actors"))
+
+        def learner_pods():
+            pods, _ = cs.pods.list(
+                namespace="default",
+                label_selector=f"app={LEARNER_APP_LABEL}")
+            return pods
+
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            up = [p for p in learner_pods() if p.status.phase == "Running"]
+            if len(up) >= learner_workers:
+                break
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("learner gang never reached Running")
+
+        def goodput_sampler():
+            # learner-gang goodput while actors cycle: fraction of
+            # samples with EVERY learner member Running (chip-time
+            # productive) — the Podracer claim is that actor churn never
+            # disturbs the learner slice
+            while not stop.is_set():
+                try:
+                    up = [p for p in learner_pods()
+                          if p.status.phase == "Running"
+                          and not p.metadata.deletion_timestamp]
+                    goodput_samples.append(len(up) >= learner_workers)
+                except Exception:  # noqa: BLE001 — sampling must not die
+                    pass
+                stop.wait(0.25)
+
+        th = threading.Thread(target=goodput_sampler, daemon=True)
+        th.start()
+
+        driver = ChurnDriver(
+            cs, actors=CHURN_ACTORS, rate=CHURN_RATE,
+            use_batch=not singleton, grace_seconds=0,
+            wait_ready=CHURN_WAIT_READY)
+        driver.start(ready_timeout=90.0)
+        churn = driver.run(duration=CHURN_SECONDS, workers=CHURN_WORKERS)
+
+        # endpoints convergence: the actors Service must settle to
+        # exactly the live ready fleet once churn stops (shared helpers
+        # so the chaos verdict and this check can't drift)
+        from kubernetes1_tpu.workloads.rl_actor import (
+            ready_fleet_ips, service_endpoint_ips)
+
+        conv_t0 = time.perf_counter()
+        converged = False
+        while time.perf_counter() - conv_t0 < 30.0:
+            live = ready_fleet_ips(cs)
+            if live is not None and \
+                    service_endpoint_ips(cs, "rl-actors") == live:
+                converged = True
+                break
+            time.sleep(0.2)
+        converge_s = round(time.perf_counter() - conv_t0, 2)
+
+        stop.set()
+        drained = driver.drain()
+        leaked, _ = cs.pods.list(namespace="default",
+                                 label_selector=f"app={ACTOR_APP_LABEL}")
+
+        writes = eps_ctrl.endpoints_writes_total.value - writes0
+        coalesced = eps_ctrl.endpoints_coalesced_total.value - coal0
+        hist = eps_ctrl.endpoints_propagation_seconds
+        ops = churn.get("ops") or 0
+        store = cluster.master.store
+        churn.update({
+            "wait_ready": CHURN_WAIT_READY,
+            "coalesce_window_ms": round(window * 1000.0, 1),
+            "endpoints_writes": writes,
+            "endpoints_coalesced": coalesced,
+            "endpoints_writes_per_churn_event": (
+                round(writes / ops, 4) if ops else None),
+            "endpoints_propagation_p50_s": (
+                round(hist.quantile(0.5), 4)
+                if hist.quantile(0.5) is not None else None),
+            "endpoints_propagation_p99_s": (
+                round(hist.quantile(0.99), 4)
+                if hist.quantile(0.99) is not None else None),
+            "endpoints_propagation_samples": hist.count - lag_count0,
+            "endpoints_converged": converged,
+            "endpoints_converge_s": converge_s,
+            "learner_goodput": (
+                round(sum(goodput_samples) / len(goodput_samples), 4)
+                if goodput_samples else None),
+            "learner_gang_scheduled": gang,
+            "delete_batch_ops": store.delete_batch_ops,
+            "delete_batches": store.delete_batches,
+            "delete_batch_occupancy": (
+                round(store.delete_batch_ops / store.delete_batches, 3)
+                if store.delete_batches else None),
+            "queue_churn_purges": sum(
+                s.queue_churn_purges for s in cluster.schedulers),
+            "drained": drained,
+            "leaked_actor_pods": len(leaked),
+            "observability": observability_block(cluster.obs),
+        })
+        return churn
+    finally:
+        stop.set()
+        if driver is not None:
+            # a raising start()/run() must not leak the driver's informer
+            # thread into the bench phases that run after this one
+            try:
+                driver.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        cluster.stop()
+
+
 def main():
     from kubernetes1_tpu.utils.benchstamp import contention_stamp
 
@@ -686,6 +878,15 @@ def main():
             extras["gang"] = bench_gang()
         except Exception as e:  # noqa: BLE001
             extras["gang"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # RL actor-swarm churn (the deletion half of the control plane):
+    # batched delete pipeline + coalesced endpoints fan-out under a
+    # recycled actor fleet, learner gang goodput sampled throughout
+    if os.environ.get("BENCH_SKIP_CHURN", "") != "1":
+        try:
+            extras["churn"] = bench_churn()
+        except Exception as e:  # noqa: BLE001
+            extras["churn"] = {"error": f"{type(e).__name__}: {e}"}
 
     # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes);
     # contaminated runs are retried after a quiesce, not just stamped

@@ -608,7 +608,17 @@ class Registry:
             grace_seconds = pod.spec.termination_grace_period_seconds
         finished = pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
         if grace_seconds == 0 or not pod.spec.node_name or finished:
-            return self.store.delete(key)
+            deleted = self.store.delete(key)
+            # device-claim hygiene: the pod is GONE from the store, so its
+            # chips must stop blocking replacements NOW — the lazy
+            # validate-on-collision path still covers crashes, but under
+            # churn it costs every re-placement a store round-trip.
+            # Release from the COMMITTED object, not the pre-read one: a
+            # bind landing between our read and the delete put chips on
+            # the pod the read-time copy never saw.
+            self._release_claims(self._chips_of(deleted),
+                                 deleted.metadata.uid)
+            return deleted
 
         def mark(cur):
             if cur.metadata.deletion_timestamp:
@@ -620,6 +630,120 @@ class Registry:
             return self.store.guaranteed_update(key, mark)
         except StopUpdate:
             return pod
+
+    def delete_batch(self, resource: str, namespace: str,
+                     items: List[Dict[str, Any]]) -> List[Optional[Exception]]:
+        """Batched delete: N deletions land through ONE store group commit
+        per round — one lock acquisition, one WAL fsync, one fan-out
+        wakeup for the whole set (the deletion half of bind_batch's
+        contract).  Like every caller batch this is amortization, NOT a
+        transaction: items fail independently and successful neighbors
+        commit.
+
+        Each item is {"name": str, "namespace": str (optional; defaults
+        to the request namespace), "grace_seconds": int|None,
+        "resource_version": str (optional delete-if-unchanged
+        precondition — when set, a revision mismatch is a TERMINAL
+        Conflict for that item)}.
+
+        Pod grace/finalize semantics are preserved per item, exactly the
+        singleton rules: grace 0 / unscheduled / finished pods commit as
+        DELETED; bound running pods get deletionTimestamp stamped (the
+        kubelet finalizes with grace 0 later); an already-terminating pod
+        is a success no-op.  CAS races with concurrent status writers
+        retry with a fresh read, like guaranteed_update.
+
+        Returns one outcome per item, same order: None on success or the
+        ApiError that sank it."""
+        if resource == "namespaces":
+            raise BadRequest(
+                "namespaces cannot be batch-deleted (Terminating flow)")
+        results: List[Optional[Exception]] = [None] * len(items)
+        keys: Dict[int, str] = {}
+        done: set = set()
+        for i, it in enumerate(items):
+            name = (it.get("name") or "").strip()
+            ns = it.get("namespace") or namespace or "default"
+            if not name:
+                results[i] = BadRequest("delete item requires a name")
+                done.add(i)
+                continue
+            try:
+                keys[i] = self.key(resource, ns, name)
+            except BadRequest as e:
+                results[i] = e
+                done.add(i)
+        pending = [i for i in keys if i not in done]
+        while pending:
+            raws = self.store.get_raw_many([keys[i] for i in pending])
+            ops, op_idx = [], []
+            pod_deletes: set = set()  # op indices needing claim release
+            for i, raw in zip(pending, raws):
+                if raw is None:
+                    results[i] = NotFound(
+                        f'{resource} "{items[i].get("name")}" not found')
+                    continue
+                expect = items[i].get("resource_version") or ""
+                rv = (raw.get("metadata") or {}).get("resourceVersion", "")
+                if expect and expect != rv:
+                    # explicit precondition: terminal, never retried
+                    results[i] = Conflict(
+                        f'{items[i].get("name")}: resourceVersion mismatch '
+                        f'(have {rv}, want {expect})')
+                    continue
+                if resource != "pods":
+                    ops.append({"op": "delete", "key": keys[i],
+                                "expect_rv": expect})
+                    op_idx.append(i)
+                    continue
+                pod = self.scheme.decode(raw)
+                grace = items[i].get("grace_seconds")
+                if grace is None:
+                    grace = pod.spec.termination_grace_period_seconds
+                finished = pod.status.phase in (t.POD_SUCCEEDED, t.POD_FAILED)
+                if grace == 0 or not pod.spec.node_name or finished:
+                    # expect_rv only when the caller asked: the singleton
+                    # path deletes whatever is current, and a spurious CAS
+                    # retry per concurrent status write would defeat the
+                    # amortization
+                    ops.append({"op": "delete", "key": keys[i],
+                                "expect_rv": expect})
+                    op_idx.append(i)
+                    pod_deletes.add(i)
+                    continue
+                if pod.metadata.deletion_timestamp:
+                    results[i] = None  # already terminating: success no-op
+                    continue
+                pod.metadata.deletion_timestamp = now_iso()
+                ops.append({"op": "update_cas", "key": keys[i],
+                            "obj": self.scheme.encode(pod),
+                            "expect_rv": rv})
+                op_idx.append(i)
+            if not ops:
+                break
+            outs = self.store.commit_batch(ops)
+            retry = []
+            for i, op, out in zip(op_idx, ops, outs):
+                err = out.get("error")
+                if err is None:
+                    results[i] = None
+                    if i in pod_deletes:
+                        # committed DELETED: release the chips eagerly,
+                        # same hygiene as the singleton path — from the
+                        # COMMITTED dict, not the pre-read pod (a bind
+                        # may have landed chips between read and commit)
+                        committed = out.get("obj") or {}
+                        self._release_claims(
+                            self._chips_of_raw(committed),
+                            (committed.get("metadata") or {}).get("uid",
+                                                                  ""))
+                elif (isinstance(err, Conflict)
+                      and not items[i].get("resource_version")):
+                    retry.append(i)  # CAS race on a graceful mark: re-read
+                else:
+                    results[i] = err
+            pending = retry
+        return results
 
     # PDB CAS retries against the disruption controller (ref eviction.go:57
     # retries EvictionsRetry times on resourceVersion races)
@@ -900,6 +1024,18 @@ class Registry:
         return [(node, per.resource or per.name, cid)
                 for per in pod.spec.extended_resources
                 for cid in (per.assigned or [])]
+
+    @staticmethod
+    def _chips_of_raw(d: Dict[str, Any]) -> List[tuple]:
+        """_chips_of over an ENCODED wire dict (the committed form the
+        batch path holds — no decode on the delete hot path)."""
+        spec = d.get("spec") or {}
+        node = spec.get("nodeName")
+        if not node:
+            return []
+        return [(node, per.get("resource") or per.get("name") or "", cid)
+                for per in spec.get("extendedResources") or []
+                for cid in per.get("assigned") or []]
 
     def _seed_claims_locked(self):
         """First claim after startup: rebuild the index from every bound

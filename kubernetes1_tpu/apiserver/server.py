@@ -731,6 +731,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # escalation the subresource naming exists to prevent),
                 # and a scheduler granted only pods/binding would 403
                 self._authz(user, "create", resource, ns, "", "binding")
+            elif (method == "POST" and resource == "pods"
+                    and name == "delete:batch" and not sub):
+                # a batch delete is N DELETEs: gate it with the same
+                # `delete pods` permission as the singleton verb — the
+                # POST transport must not let a create-only principal
+                # delete pods (the bindings:batch rule, delete flavor)
+                self._authz(user, "delete", resource, ns, "", "")
             else:
                 self._authz(user, verb, resource, ns, name, sub)
             handler = getattr(self, f"_do_{method.lower()}")
@@ -1505,6 +1512,19 @@ class _Handler(BaseHTTPRequestHandler):
                 _job_ctrl.gang_recovery_seconds.render().rstrip("\n"))
             extra.append(
                 _job_ctrl.gang_attempts_total.render().rstrip("\n"))
+            # endpoints fan-out economics (module-level in
+            # controllers/endpoints.py, same contract): writes vs pod
+            # churn events absorbed by coalescing, and the oldest-event
+            # -> Endpoints-write propagation-lag SLI
+            from ..controllers import endpoints as _eps_ctrl
+
+            extra.append(
+                _eps_ctrl.endpoints_writes_total.render().rstrip("\n"))
+            extra.append(
+                _eps_ctrl.endpoints_coalesced_total.render().rstrip("\n"))
+            extra.append(
+                _eps_ctrl.endpoints_propagation_seconds
+                .render().rstrip("\n"))
         # write-path economics (in-process store only; a remote store
         # exports these from its own process): group-commit occupancy and
         # the fan-out coalescing ratio — wakeups-per-event < 1.0 means
@@ -1529,6 +1549,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "# TYPE ktpu_store_batch_occupancy gauge",
                 f"ktpu_store_batch_occupancy "
                 f"{(commits / batches) if batches else 0.0:.6f}",
+                # deletion-path economics (the churn envelope): delete ops
+                # per delete-carrying caller batch — ~1.0 means the hot
+                # delete callers are still issuing singletons
+                "# TYPE ktpu_store_delete_batch_ops_total counter",
+                f"ktpu_store_delete_batch_ops_total "
+                f"{getattr(master.store, 'delete_batch_ops', 0)}",
+                "# TYPE ktpu_store_delete_batches_total counter",
+                f"ktpu_store_delete_batches_total "
+                f"{getattr(master.store, 'delete_batches', 0)}",
+                "# TYPE ktpu_store_delete_batch_occupancy gauge",
+                f"ktpu_store_delete_batch_occupancy "
+                f"{(getattr(master.store, 'delete_batch_ops', 0) / getattr(master.store, 'delete_batches', 1)) if getattr(master.store, 'delete_batches', 0) else 0.0:.6f}",
                 "# TYPE ktpu_store_watch_wakeups_total counter",
                 f"ktpu_store_watch_wakeups_total {wakeups}",
                 "# TYPE ktpu_store_watch_events_total counter",
@@ -1608,6 +1640,46 @@ class _Handler(BaseHTTPRequestHandler):
                               self._user.name)
             self._send_json(200, {
                 "kind": "BindingBatchResult", "apiVersion": "v1",
+                "results": [
+                    {"kind": "Status", "apiVersion": "v1",
+                     "status": "Success"} if e is None else e.to_status()
+                    for e in outcomes
+                ],
+            })
+            return
+        if resource == "pods" and name == "delete:batch" and not sub:
+            # batched deletion: the deletion half of the group-commit
+            # write path — N pod deletes/finalize-marks land through one
+            # store group commit, per-item Status outcomes, HTTP 200 for
+            # the envelope (amortization, not a transaction)
+            items = []
+            for d in body.get("items") or []:
+                item_ns = d.get("namespace") or ""
+                if ns and item_ns and item_ns != ns:
+                    # same rule as bindings:batch: an item naming another
+                    # namespace would delete where the authz never looked
+                    raise Forbidden(
+                        f"delete item {d.get('name')!r} names namespace "
+                        f"{item_ns!r}; the request authorized {ns!r}")
+                grace = d.get("gracePeriodSeconds")
+                items.append({
+                    "name": d.get("name") or "",
+                    "namespace": item_ns or ns,
+                    "grace_seconds": None if grace is None else int(grace),
+                    "resource_version": d.get("resourceVersion") or "",
+                })
+            if not items:
+                raise BadRequest("delete:batch requires items")
+            outcomes = reg.delete_batch("pods", ns, items)
+            flightrec.note(
+                "apiserver", flightrec.DELETE_BATCH, ns=ns,
+                items=len(items),
+                errors=sum(1 for e in outcomes if e is not None))
+            self.master.audit("delete", resource, ns,
+                              f"delete:batch[{len(items)}]",
+                              self._user.name)
+            self._send_json(200, {
+                "kind": "DeleteBatchResult", "apiVersion": "v1",
                 "results": [
                     {"kind": "Status", "apiVersion": "v1",
                      "status": "Success"} if e is None else e.to_status()

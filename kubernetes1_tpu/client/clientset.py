@@ -486,6 +486,35 @@ class Clientset:
                        else ApiError.from_status(r))
         return out
 
+    def delete_batch(self, namespace: str, items,
+                     grace_seconds: Optional[int] = None):
+        """DELETE N pods as ONE bulk request (pods/delete:batch): the
+        apiserver commits the whole set through one store group commit —
+        the hot-path for gang teardown, podgc sweeps, replicaset
+        scale-down, and eviction storms.  Returns one outcome per item,
+        same order: None on success or the ApiError that sank that member
+        (members fail independently — amortization, not a transaction).
+
+        `items` mixes plain pod names and dicts ({"name", "namespace"?,
+        "gracePeriodSeconds"?, "resourceVersion"?}); `grace_seconds`
+        applies to every item that doesn't carry its own."""
+        from ..machinery import ApiError
+
+        body_items = []
+        for it in items:
+            d = {"name": it} if isinstance(it, str) else dict(it)
+            if grace_seconds is not None and "gracePeriodSeconds" not in d:
+                d["gracePeriodSeconds"] = grace_seconds
+            body_items.append(d)
+        data = self.api.request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/delete:batch",
+            body={"kind": "DeleteBatch", "apiVersion": "v1",
+                  "items": body_items})
+        return [None if r.get("status") == "Success"
+                else ApiError.from_status(r)
+                for r in data.get("results", [])]
+
     def evict(self, namespace: str, pod_name: str,
               grace_seconds: "Optional[int]" = None):
         """Eviction subresource: voluntary, PDB-respecting pod removal.

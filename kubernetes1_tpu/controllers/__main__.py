@@ -26,6 +26,11 @@ def main():
                          "apiserver's /metrics instead")
     ap.add_argument("--node-monitor-grace", type=float, default=40.0)
     ap.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    ap.add_argument("--endpoints-coalesce-ms", type=float, default=0.0,
+                    help="endpoints fan-out coalesce window in ms (0 = one "
+                         "Endpoints write per pod event — today's wire); "
+                         ">0 batches a service's churn into one write per "
+                         "window")
     ap.add_argument("--ca-key-file", default="", help="CSR signing key")
     ap.add_argument("--ca-cert-file", default="",
                     help="cluster CA cert (enables x509 CSR signing)")
@@ -48,6 +53,7 @@ def main():
         ca_key=read_key(args.ca_key_file, "ktpu-ca-key"),
         ca_cert_pem=read_key(args.ca_cert_file, ""),
         sa_signing_key=read_key(args.sa_key_file, "ktpu-sa-key"),
+        endpoints_coalesce_window=args.endpoints_coalesce_ms / 1000.0,
     )
     cm.start()
     metrics_server = None
@@ -58,6 +64,11 @@ def main():
         reg = Registry()
         reg.register(_job.gang_recovery_seconds)
         reg.register(_job.gang_attempts_total)
+        from . import endpoints as _eps
+
+        reg.register(_eps.endpoints_writes_total)
+        reg.register(_eps.endpoints_coalesced_total)
+        reg.register(_eps.endpoints_propagation_seconds)
         reg.register(cm.node_lifecycle.evictions_total)
         reg.register(cm.node_lifecycle.errors_total)
         reg.register(cm.node_lifecycle.not_ready_total)

@@ -17,6 +17,56 @@ from ..machinery.scheme import to_dict
 from ..utils.workqueue import RateLimitingQueue
 
 
+def delete_pods_batch(cs: Clientset, pods, grace_seconds=None,
+                      reason: str = "pod_delete_batch"):
+    """Delete N pods through ONE pods/delete:batch request per namespace
+    (the deletion half of the group-commit write path) — the shared leg
+    for every hot delete caller (gang teardown, replicaset scale-down,
+    podgc sweeps, node-lifecycle eviction).
+
+    Per-pod outcomes come back aligned with `pods`: None on success or
+    the ApiError that sank that member (NotFound comes back as the error
+    so exactly-once accounting callers can tell "I deleted it" from
+    "already gone").  An ENVELOPE-level failure (transport fault, an apiserver
+    without the batch leg) falls back to singleton deletes through the
+    shared retry policy, so a controller on a degraded wire degrades to
+    exactly the pre-batch behavior instead of dropping the pass."""
+    from ..client import retry as _retry
+    from ..machinery import ApiError
+
+    if not pods:
+        return []
+    outcomes = [None] * len(pods)
+    by_ns = {}
+    for i, p in enumerate(pods):
+        by_ns.setdefault(p.metadata.namespace, []).append(i)
+    for ns, idxs in by_ns.items():
+        items = [{"name": pods[i].metadata.name} for i in idxs]
+        try:
+            results = cs.delete_batch(ns, items, grace_seconds=grace_seconds)
+            if len(results) != len(idxs):
+                raise ApiError(
+                    f"malformed delete:batch response: {len(results)} "
+                    f"results for {len(items)} items")
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            # envelope failed: per-pod fallback (idempotent — a delete
+            # that DID land answers NotFound, which is success here)
+            for i in idxs:
+                p = pods[i]
+                try:
+                    _retry.call_with_retries(
+                        lambda p=p: cs.pods.delete(
+                            p.metadata.name, p.metadata.namespace,
+                            grace_seconds=grace_seconds),
+                        steps=3, reason=reason)
+                except (ApiError, ConnectionError, TimeoutError, OSError) as e:
+                    outcomes[i] = e  # NotFound included: caller decides
+            continue
+        for i, err in zip(idxs, results):
+            outcomes[i] = err
+    return outcomes
+
+
 def write_status_if_changed(client, obj, mutate) -> bool:
     """Apply mutate(obj.status) and PUT the status subresource only when it
     actually changed. A no-op status write still bumps resourceVersion and

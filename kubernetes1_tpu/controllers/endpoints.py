@@ -1,17 +1,71 @@
 """Endpoints controller (ref: pkg/controller/endpoint/): services select
 ready pods into Endpoints objects — the discovery substrate kube-proxy and
-the TPU coordinator bootstrap resolve against."""
+the TPU coordinator bootstrap resolve against.
+
+Churn fan-out (the endpointslice-batching analog): by default every pod
+event touching a service's selector triggers one full Endpoints rewrite —
+under actor-swarm churn that is one write per service per pod event, and
+the writes (each a full-object PUT bumping resourceVersion) become the
+dominant control-plane load.  With ``coalesce_window > 0`` the controller
+keeps a per-service DIRTY set instead: the first event arms one delayed
+flush, every further event inside the window is absorbed
+(``ktpu_endpoints_coalesced_total``), and the flush recomputes the object
+from the informers — level-triggered, so the final object always equals
+what the uncoalesced controller would have written.  ``coalesce_window=0``
+(the default) keeps today's immediate enqueue byte-for-byte.
+
+The propagation-lag SLI (``ktpu_endpoints_propagation_seconds``) measures
+the OLDEST unserved pod event to the Endpoints write that folds it in —
+the staleness a consumer resolving the service can actually observe; it
+is measured at window 0 too, so a coalescing A/B compares like for like.
+"""
 
 from __future__ import annotations
+
+import time
+from typing import Dict
 
 from ..api import types as t
 from ..machinery import AlreadyExists, ApiError, NotFound
 from ..machinery.labels import match_labels
+from ..utils import locksan
+from ..utils.metrics import Counter, Histogram
 from .base import Controller
+
+# Module-level (the client/retry retries_total pattern): one process-wide
+# surface regardless of controller instances; the co-located apiserver
+# renders them (render_client_metrics) and a standalone controller
+# manager exports them from its own /metrics.
+endpoints_writes_total = Counter(
+    "ktpu_endpoints_writes_total",
+    "Endpoints object writes (update/create) committed")
+endpoints_coalesced_total = Counter(
+    "ktpu_endpoints_coalesced_total",
+    "pod churn events absorbed by an already-armed coalesced flush")
+endpoints_propagation_seconds = Histogram(
+    "ktpu_endpoints_propagation_seconds",
+    "oldest unserved pod event to the Endpoints write folding it in",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0))
 
 
 class EndpointsController(Controller):
     name = "endpoints-controller"
+
+    def __init__(self, clientset, factory, workers: int = 2,
+                 coalesce_window: float = 0.0):
+        super().__init__(clientset, factory, workers)
+        # seconds one service's flush waits to absorb more churn; 0 =
+        # immediate enqueue (today's wire, byte-identical)
+        self.coalesce_window = max(0.0, float(coalesce_window))
+        self._dirty_lock = locksan.make_lock(
+            "EndpointsController._dirty_lock")
+        # svc key -> monotonic time of the OLDEST event not yet folded
+        # into a committed write (the propagation-lag numerator)
+        self._dirty_since: Dict[str, float] = {}
+        # svc keys with a delayed flush armed (window > 0 only): events
+        # landing while armed are the coalesced ones
+        self._armed: set = set()
 
     def setup(self):
         self.services = self.factory.informer("services")
@@ -28,6 +82,10 @@ class EndpointsController(Controller):
         )
 
     def _service_deleted(self, svc: t.Service):
+        key = svc.key()
+        with self._dirty_lock:
+            self._armed.discard(key)
+            self._dirty_since.pop(key, None)
         try:
             self.cs.endpoints.delete(svc.metadata.name, svc.metadata.namespace)
         except ApiError:
@@ -38,9 +96,28 @@ class EndpointsController(Controller):
             if svc.metadata.namespace == pod.metadata.namespace and match_labels(
                 svc.spec.selector, pod.metadata.labels
             ):
-                self.enqueue(svc)
+                self._mark_dirty(svc)
+
+    def _mark_dirty(self, svc: t.Service):
+        key = svc.key()
+        with self._dirty_lock:
+            self._dirty_since.setdefault(key, time.monotonic())
+            if self.coalesce_window > 0:
+                if key in self._armed:
+                    # a flush is already armed for this window: this
+                    # event rides it — one write absorbs N churn events
+                    endpoints_coalesced_total.inc()
+                    return
+                self._armed.add(key)
+        if self.coalesce_window > 0:
+            self.enqueue_after(key, self.coalesce_window)
+        else:
+            self.queue.add(key)
 
     def sync(self, key: str):
+        with self._dirty_lock:
+            self._armed.discard(key)
+            dirty_t0 = self._dirty_since.pop(key, None)
         svc = self.services.get(key)
         if svc is None:
             return
@@ -72,14 +149,41 @@ class EndpointsController(Controller):
         eps = t.Endpoints(subsets=[subset] if subset.addresses else [])
         eps.metadata.name = svc.metadata.name
         eps.metadata.namespace = svc.metadata.namespace
+        wrote = True
         try:
-            existing = self.cs.endpoints.get(svc.metadata.name, svc.metadata.namespace)
-            eps.metadata.resource_version = existing.metadata.resource_version
-            eps.metadata.uid = existing.metadata.uid
-            eps.metadata.creation_timestamp = existing.metadata.creation_timestamp
-            self.cs.endpoints.update(eps)
-        except NotFound:
             try:
-                self.cs.endpoints.create(eps, svc.metadata.namespace)
-            except AlreadyExists:
-                pass
+                existing = self.cs.endpoints.get(svc.metadata.name, svc.metadata.namespace)
+                eps.metadata.resource_version = existing.metadata.resource_version
+                eps.metadata.uid = existing.metadata.uid
+                eps.metadata.creation_timestamp = existing.metadata.creation_timestamp
+                self.cs.endpoints.update(eps)
+            except NotFound:
+                try:
+                    self.cs.endpoints.create(eps, svc.metadata.namespace)
+                except AlreadyExists:
+                    # a PEER's create landed, not ours: no write to
+                    # count, and its content may predate our dirty
+                    # event — re-sync to fold it in
+                    wrote = False
+        except Exception:
+            # failed write: the informer state is still dirty — restore
+            # the stamp so the retry's eventual write reports the true
+            # (longer) propagation lag instead of dropping the sample
+            if dirty_t0 is not None:
+                with self._dirty_lock:
+                    cur = self._dirty_since.get(key)
+                    self._dirty_since[key] = (
+                        dirty_t0 if cur is None else min(cur, dirty_t0))
+            raise
+        if not wrote:
+            if dirty_t0 is not None:
+                with self._dirty_lock:
+                    cur = self._dirty_since.get(key)
+                    self._dirty_since[key] = (
+                        dirty_t0 if cur is None else min(cur, dirty_t0))
+            self.queue.add(key)
+            return
+        endpoints_writes_total.inc()
+        if dirty_t0 is not None:
+            endpoints_propagation_seconds.observe(
+                time.monotonic() - dirty_t0)

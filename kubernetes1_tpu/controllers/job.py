@@ -55,7 +55,7 @@ from ..machinery.labels import label_selector_matches
 from ..machinery.scheme import from_dict, to_dict
 from ..utils import flightrec
 from ..utils.metrics import Counter, Histogram
-from .base import Controller, write_status_if_changed
+from .base import Controller, delete_pods_batch, write_status_if_changed
 
 COORDINATOR_PORT = 8476
 
@@ -328,8 +328,7 @@ class JobController(Controller):
                  if self._attempt_of(p.metadata.labels) != attempt]
         # previous attempts tear down unconditionally — a broken gang's
         # survivors hold the chips the replacement needs
-        for p in stale:
-            self._force_delete(p)
+        self._force_delete_many(stale)
 
         succeeded = [p for p in cur if p.status.phase == t.POD_SUCCEEDED]
         failed = [p for p in cur if p.status.phase == t.POD_FAILED]
@@ -449,9 +448,8 @@ class JobController(Controller):
         if attempt + 1 > job.spec.backoff_limit:
             # exhausted: kill the remains (a broken slice's survivors hold
             # chips) but keep finished pod records for debugging
-            for p in cur:
-                if not self._pod_finished(p):
-                    self._force_delete(p)
+            self._force_delete_many(
+                [p for p in cur if not self._pod_finished(p)])
             active = [p for p in cur if not self._pod_finished(p)
                       and not p.metadata.deletion_timestamp]
             succeeded = [p for p in cur if p.status.phase == t.POD_SUCCEEDED]
@@ -512,6 +510,24 @@ class JobController(Controller):
             pass
         except (ApiError, ConnectionError, TimeoutError, OSError):
             pass  # level-triggered: the next sync retries the survivors
+
+    def _force_delete_many(self, pods: List[t.Pod]):
+        """Whole-gang teardown as ONE pods/delete:batch request: a gang's
+        members die together by policy, so their deletes should commit as
+        one store group commit, not N round-trips.  Same semantics as
+        _force_delete per member — grace 0, errors left to the next
+        level-triggered sync."""
+        if not pods:
+            return
+        if len(pods) == 1:
+            self._force_delete(pods[0])
+            return
+        for p in pods:
+            flightrec.note("job-controller", flightrec.GANG_TEARDOWN,
+                           pod=p.metadata.name,
+                           gang=p.spec.scheduling_gang or "")
+        delete_pods_batch(self.cs, pods, grace_seconds=0,
+                          reason="gang_teardown")
 
     # --------------------------------------------------------------- status
 

@@ -40,6 +40,7 @@ class ControllerManager:
         ca_cert_pem: str = "",
         sa_signing_key: str = "ktpu-sa-key",
         pv_base_dir: str = "/var/lib/ktpu/pv",
+        endpoints_coalesce_window: float = 0.0,  # s; 0 = write per event
     ):
         self.cs = clientset
         self.factory = InformerFactory(clientset)
@@ -52,7 +53,8 @@ class ControllerManager:
             CronJobController(clientset, self.factory),
             NamespaceController(clientset, self.factory),
             GarbageCollector(clientset, self.factory),
-            EndpointsController(clientset, self.factory),
+            EndpointsController(clientset, self.factory,
+                                coalesce_window=endpoints_coalesce_window),
             ResourceQuotaController(clientset, self.factory),
             ServiceAccountController(clientset, self.factory,
                                      signing_key=sa_signing_key),

@@ -115,6 +115,33 @@ class NodeLifecycleController:
 
         return bool(self._mutate(op))
 
+    def _delete_pods_batched(self, pods, grace_seconds=None,
+                             reason="nodelifecycle"):
+        """Batch leg of _delete_pod: one pods/delete:batch per pass
+        instead of a round-trip per evicted pod (a dead 110-pod node's
+        eviction storm is the hot case).  Returns one bool per pod —
+        True only when THIS pass's delete landed (NotFound = already
+        gone = False, same as the singleton path's exactly-once
+        accounting); transient per-item failures are counted and left to
+        the next monitor pass."""
+        from ..machinery import NotFound as _NotFound
+        from .base import delete_pods_batch
+
+        if not pods:
+            return []
+        out = []
+        for err in delete_pods_batch(self.cs, pods,
+                                     grace_seconds=grace_seconds,
+                                     reason=reason):
+            if err is None:
+                out.append(True)
+            elif isinstance(err, (_NotFound, Conflict)):
+                out.append(False)  # already gone / write race: next pass
+            else:
+                self.errors_total.inc()
+                out.append(False)
+        return out
+
     def _ready_condition(self, node: t.Node):
         for cond in node.status.conditions:
             if cond.type == t.NODE_READY:
@@ -201,6 +228,7 @@ class NodeLifecycleController:
         taint = t.Taint(key=self.NOT_READY_TAINT, effect="NoExecute")
         from ..scheduler.predicates import _tolerates
 
+        finalize, fresh = [], []
         for pod in self.pods.list():
             if pod.spec.node_name != node.metadata.name:
                 continue
@@ -217,11 +245,16 @@ class NodeLifecycleController:
             if pod.metadata.deletion_timestamp:
                 # kubelet is gone; force-finalize so it reschedules — the
                 # eviction was already counted when the first delete landed
-                self._delete_pod(pod, grace_seconds=0)
+                finalize.append(pod)
                 continue
             if pod.metadata.uid in self._evicted_uids:
                 continue  # counted; waiting on the watch to show the delete
-            if self._delete_pod(pod):
+            fresh.append(pod)
+        self._delete_pods_batched(finalize, grace_seconds=0,
+                                  reason="nodelifecycle_finalize")
+        for pod, landed in zip(fresh, self._delete_pods_batched(
+                fresh, reason="nodelifecycle_taint_evict")):
+            if landed:
                 # the delete stamps deletion_timestamp, so later passes take
                 # the force-finalize branch above: exactly one count + Event
                 # per evicted pod
@@ -260,6 +293,7 @@ class NodeLifecycleController:
             )
 
     def _evict_pods(self, node: t.Node):
+        finalize, fresh = [], []
         for pod in self.pods.list():
             if pod.spec.node_name != node.metadata.name:
                 continue
@@ -269,11 +303,18 @@ class NodeLifecycleController:
                 # kubelet is gone and can't finalize: force delete so the
                 # controller can replace the pod (not a new eviction — it
                 # was counted when the graceful delete landed)
-                self._delete_pod(pod, grace_seconds=0)
+                finalize.append(pod)
                 continue
             if pod.metadata.uid in self._evicted_uids:
                 continue  # counted; waiting on the watch to show the delete
-            if self._delete_pod(pod):
+            fresh.append(pod)
+        # a dead node's whole pod set evicts/finalizes as TWO batch
+        # requests (graceful + grace-0) instead of a round-trip per pod
+        self._delete_pods_batched(finalize, grace_seconds=0,
+                                  reason="nodelifecycle_finalize")
+        for pod, landed in zip(fresh, self._delete_pods_batched(
+                fresh, reason="nodelifecycle_evict")):
+            if landed:
                 self._evicted_uids.add(pod.metadata.uid)
                 self.evictions_total.inc()
                 self.recorder.event(

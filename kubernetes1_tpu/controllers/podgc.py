@@ -6,8 +6,7 @@ terminated pods kept around for inspection."""
 from __future__ import annotations
 
 from ..api import types as t
-from ..machinery import ApiError
-from .base import Controller
+from .base import Controller, delete_pods_batch
 
 RESYNC = 5.0  # the reference's gcCheckPeriod is 20s
 
@@ -54,6 +53,7 @@ class PodGCController(Controller):
         now = time.monotonic()
         for known in [n for n in self._missing_since if n in node_names]:
             del self._missing_since[known]
+        doomed = []
         for p in self.pods.list():
             node = p.spec.node_name
             if not node or node in node_names or p.metadata.deletion_timestamp:
@@ -61,16 +61,17 @@ class PodGCController(Controller):
             first = self._missing_since.setdefault(node, now)
             if now - first < self.quarantine:
                 continue
-            try:
-                self.cs.pods.delete(
-                    p.metadata.name, p.metadata.namespace, grace_seconds=0
-                )
+            doomed.append(p)
+        # the whole orphan sweep finalizes through ONE delete:batch group
+        # commit (a replaced TPU host orphans its pods all at once)
+        for p, err in zip(doomed, delete_pods_batch(
+                self.cs, doomed, grace_seconds=0, reason="podgc_orphaned")):
+            if err is None:
                 self.recorder.event(
                     p, "Normal", "PodGC",
-                    f"deleted orphaned pod bound to missing node {node}",
+                    f"deleted orphaned pod bound to missing node "
+                    f"{p.spec.node_name}",
                 )
-            except ApiError:
-                pass
 
     def _gc_terminated(self):
         terminated = [
@@ -83,8 +84,7 @@ class PodGCController(Controller):
         if excess <= 0:
             return
         terminated.sort(key=lambda p: p.metadata.creation_timestamp)
-        for p in terminated[:excess]:
-            try:
-                self.cs.pods.delete(p.metadata.name, p.metadata.namespace, grace_seconds=0)
-            except ApiError:
-                pass
+        # one batch for the whole cap sweep (outcomes ignored: the next
+        # resync re-lists and retries anything that didn't land)
+        delete_pods_batch(self.cs, terminated[:excess], grace_seconds=0,
+                          reason="podgc_terminated")

@@ -9,7 +9,7 @@ from ..api import types as t
 from ..machinery import ApiError, NotFound
 from ..machinery.labels import label_selector_matches
 from ..machinery.scheme import from_dict, to_dict
-from .base import Controller, write_status_if_changed
+from .base import Controller, delete_pods_batch, write_status_if_changed
 
 
 def owned_by(pod: t.Pod, kind: str, uid: str) -> bool:
@@ -81,16 +81,14 @@ class ReplicaSetController(Controller):
                 except ApiError:
                     break
         elif diff < 0:
-            # prefer deleting unscheduled, then newest
+            # prefer deleting unscheduled, then newest; the whole
+            # scale-down ships as ONE pods/delete:batch group commit
+            # (outcomes ignored — level-triggered, the next sync retries)
             doomed = sorted(
                 alive,
                 key=lambda p: (bool(p.spec.node_name), p.metadata.creation_timestamp),
             )[: -diff]
-            for pod in doomed:
-                try:
-                    self.cs.pods.delete(pod.metadata.name, pod.metadata.namespace)
-                except ApiError:
-                    pass
+            delete_pods_batch(self.cs, doomed, reason="replicaset_scale_down")
         self._update_status(rs, alive)
 
     def _update_status(self, rs: t.ReplicaSet, alive: List[t.Pod]):

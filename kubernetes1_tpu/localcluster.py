@@ -77,6 +77,7 @@ class LocalCluster:
         sched_shards: int = 1,
         obs: bool = True,
         obs_interval: float = 1.0,
+        endpoints_coalesce_window: float = 0.0,
     ):
         self.n_nodes = nodes
         self.tpus_per_node = tpus_per_node
@@ -92,6 +93,7 @@ class LocalCluster:
         self.sched_shards = max(1, sched_shards)
         self.obs_enabled = obs
         self.obs_interval = obs_interval
+        self.endpoints_coalesce_window = endpoints_coalesce_window
 
         self.master: Optional[Master] = None
         self.masters: List[Master] = []
@@ -156,7 +158,9 @@ class LocalCluster:
                 identity=f"sched-{k}", **kwargs))
             self.schedulers[-1].start()
         self.scheduler = self.schedulers[0]
-        self.kcm = ControllerManager(Clientset(rotated(urls, 1)))
+        self.kcm = ControllerManager(
+            Clientset(rotated(urls, 1)),
+            endpoints_coalesce_window=self.endpoints_coalesce_window)
         self.kcm.start()
         self._proxier_cs = Clientset(rotated(urls, 2))
         self.proxier = Proxier(self._proxier_cs).start()

@@ -84,32 +84,60 @@ class SchedulingQueue:
         with self._cond:
             self._attempts.pop(key, None)
 
+    def purge(self, key: str) -> bool:
+        """Remove every trace of a pod from the queue NOW — the churn
+        fix: a pod deleted while Pending must not cost a schedule
+        attempt, a bind, or a live backoff timer.  Clears the active
+        entry (its heap slot is skipped lazily at pop — `_entries` is
+        the liveness set), cancels any backoff timer, and drops the
+        attempt counter.  Returns True when something was actually
+        purged (the scheduler's churn-purge counter reads this).
+
+        Best-effort against a concurrently FIRING timer: its re-add can
+        land after the purge, and the scheduler's pop-side informer
+        re-check absorbs the dead key (level-triggered)."""
+        with self._cond:
+            purged = key in self._entries
+            self._entries.discard(key)
+            timer = self._timers.pop(key, None)
+            if timer is not None:
+                timer.cancel()
+                purged = True
+            self._attempts.pop(key, None)
+            return purged
+
     def pop(self, timeout: Optional[float] = None) -> Optional[str]:
         with self._cond:
             deadline = time.monotonic() + timeout if timeout is not None else None
-            while not self._heap and not self._shutdown:
+            while True:
+                # skip heap slots whose entry was purged (deleted while
+                # Pending): _entries is the liveness set
+                while self._heap:
+                    _, _, key = heapq.heappop(self._heap)
+                    if key in self._entries:
+                        self._entries.discard(key)
+                        return key
+                if self._shutdown:
+                    return None
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None
                 self._cond.wait(remaining)
-            if self._shutdown and not self._heap:
-                return None
-            _, _, key = heapq.heappop(self._heap)
-            self._entries.discard(key)
-            return key
 
     def __len__(self):
         with self._cond:
-            return len(self._heap)
+            return len(self._entries)
 
     def depth(self) -> int:
-        """Pending entries — active heap PLUS pods in backoff (the gauge
+        """Pending entries — active set PLUS pods in backoff (the gauge
         must not read ~0 exactly when everything is unschedulable and
-        backing off; the reference counts active+backoff+unschedulable)."""
+        backing off; the reference counts active+backoff+unschedulable).
+        Counts `_entries`, not the heap: purged pods leave dead heap
+        slots behind until a pop sweeps them."""
         with self._cond:
-            return len(self._heap) + len(self._timers)
+            return len(self._entries) + len(self._timers)
 
     def shut_down(self):
         with self._cond:

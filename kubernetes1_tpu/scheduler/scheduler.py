@@ -213,6 +213,11 @@ class Scheduler:
             "scheduler_schedule_failures_total")
         self._preemptions_ctr = self.metrics.counter(
             "scheduler_preemption_victims_total")
+        # churn hygiene: pods deleted while Pending that were purged from
+        # the scheduling queue / backoff timers before costing a schedule
+        # attempt or a bind (actor-swarm workloads live and die here)
+        self._queue_churn_purges_ctr = self.metrics.counter(
+            "scheduler_queue_churn_purges_total")
         self.metrics_server: Optional[MetricsServer] = None
         self._metrics_port = metrics_port
         # per-attempt spans under the pod's trace id (utils/spans), served
@@ -242,6 +247,10 @@ class Scheduler:
     @property
     def schedule_attempts(self) -> int:
         return int(self._attempts_ctr.value)
+
+    @property
+    def queue_churn_purges(self) -> int:
+        return int(self._queue_churn_purges_ctr.value)
 
     @property
     def schedule_failures(self) -> int:
@@ -406,6 +415,11 @@ class Scheduler:
     def _on_pod_delete(self, pod: t.Pod):
         self._anti_affinity_uids.discard(pod.metadata.uid)
         self._bind_fail_counts.pop(pod.key(), None)
+        # a pod deleted while Pending must not cost a schedule attempt,
+        # a bind round-trip, or a live backoff timer — under actor-swarm
+        # churn the queue would otherwise be full of dead keys
+        if self.queue.purge(pod.key()):
+            self._queue_churn_purges_ctr.inc()
         self.cache.remove_pod(pod)
         # freed resources may unblock backing-off pods
         self.queue.flush_backoffs()

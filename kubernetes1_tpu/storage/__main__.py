@@ -150,6 +150,14 @@ def main():
         "ktpu_store_batch_occupancy":
             lambda: (store.commit_count / store.commit_batches
                      if store.commit_batches else 0.0),
+        # deletion-path economics (apiservers over a REMOTE store can't
+        # render these — the counters live here, in the store process)
+        "ktpu_store_delete_batch_ops_total":
+            lambda: store.delete_batch_ops,
+        "ktpu_store_delete_batches_total": lambda: store.delete_batches,
+        "ktpu_store_delete_batch_occupancy":
+            lambda: (store.delete_batch_ops / store.delete_batches
+                     if store.delete_batches else 0.0),
         "ktpu_store_wal_fsync_p99_seconds":
             lambda: store.wal_fsync_seconds.quantile(0.99) or 0.0,
         "ktpu_store_shard_index": lambda: store.rev_offset,

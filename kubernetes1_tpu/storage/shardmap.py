@@ -228,6 +228,11 @@ class ShardedStore:
         self.shards = len(self._stores)
         self._stats_lock = locksan.make_lock("storage.ShardedStore._stats_lock")
         self._fanin_evictions = 0
+        # caller-level delete batches: one delete:batch scattered over N
+        # shards is ONE caller batch, not N — summing the shards' own
+        # per-sub-batch counts would under-report the amortization the
+        # occupancy gauge exists to show
+        self._delete_batches = 0
         # concurrent fan-out pays only when sub-calls leave the GIL (a
         # remote shard's socket round-trip + its WAL fsync); in-process
         # shards are pure lock+memory work where extra threads just add
@@ -256,6 +261,15 @@ class ShardedStore:
     @property
     def commit_batches(self):
         return self._sum_attr("commit_batches")
+
+    @property
+    def delete_batch_ops(self):
+        return self._sum_attr("delete_batch_ops") or 0
+
+    @property
+    def delete_batches(self):
+        with self._stats_lock:
+            return self._delete_batches
 
     @property
     def watch_wakeups(self):
@@ -407,6 +421,9 @@ class ShardedStore:
             [None] * len(keys))
 
     def commit_batch(self, ops: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        if any(op.get("op") == "delete" for op in ops):
+            with self._stats_lock:
+                self._delete_batches += 1
         by_shard: Dict[int, List[int]] = {}
         for pos, op in enumerate(ops):
             by_shard.setdefault(

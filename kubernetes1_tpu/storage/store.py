@@ -490,6 +490,12 @@ class Store:
         self.commit_batches = 0
         self.watch_wakeups = 0
         self.watch_events = 0
+        # deletion-path economics (ktpu_store_delete_batch_occupancy):
+        # delete ops shipped through caller batches (commit_batch) vs the
+        # batches that carried them — occupancy ~1.0 means the hot delete
+        # callers (gang teardown, podgc, eviction) are NOT batching
+        self.delete_batch_ops = 0
+        self.delete_batches = 0
         # Watch-lag SLI (obs plane): every group commit stamps ONE
         # monotonic timestamp shared by its records; the serving layer
         # ships it on watch-lag bookmark frames so informers can export
@@ -1039,11 +1045,20 @@ class Store:
         into the same drain."""
         def commit():
             out: List[Dict[str, Any]] = []
+            ndel = 0
             for op in ops:
+                if op.get("op") == "delete":
+                    ndel += 1
                 try:
                     out.append({"obj": self._apply_op_locked(op)})
                 except ApiError as e:
                     out.append({"error": e})
+            if ndel:
+                # caller-batch deletion occupancy (under _lock, inside the
+                # drain): ops per delete-carrying batch — the deletion
+                # half's analog of commit_count/commit_batches
+                self.delete_batch_ops += ndel
+                self.delete_batches += 1
             return out
 
         return self._run_commit(commit)

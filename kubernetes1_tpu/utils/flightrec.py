@@ -49,11 +49,12 @@ DEVICE_CLAIM_CONFLICT = "device_claim_conflict"  # optimistic bind lost a chip
 WAL_REPAIR = "wal_repair"              # torn-tail truncation / write rollback
 INFORMER_RELIST = "informer_relist"    # informer fell back to a full LIST
 WATCH_RECONNECT = "watch_reconnect"    # informer re-dialed mid-stream
+DELETE_BATCH = "delete_batch"          # pods/delete:batch group deletion
 
 KINDS = frozenset({
     LEASE_STEAL, LEASE_SHED, STANDBY_PROMOTION, SHED_429, GANG_ATTEMPT,
     GANG_TEARDOWN, DEVICE_CLAIM_CONFLICT, WAL_REPAIR, INFORMER_RELIST,
-    WATCH_RECONNECT,
+    WATCH_RECONNECT, DELETE_BATCH,
 })
 
 # Per-component ring bound: forensics wants the recent tail.  512 events

@@ -22,7 +22,8 @@ running only mnist doesn't pay for llama/resnet at startup.
 
 import importlib
 
-_SUBMODULES = ("mnist", "llama", "bert", "resnet", "ringattention", "sharding")
+_SUBMODULES = ("mnist", "llama", "bert", "resnet", "ringattention",
+               "sharding", "rl_actor")
 
 
 def __getattr__(name):

@@ -1,0 +1,735 @@
+"""Podracer-style RL actor/learner pairing + the actor-swarm churn driver.
+
+The Podracer architectures (PAPERS.md) run RL at scale as thousands of
+SHORT-LIVED actor pods — sub-minute lifetimes, continuous create/delete
+churn — streaming experience to a long-lived, gang-scheduled learner
+slice.  This module is that workload shape for the framework:
+
+- ``rollout`` / ``Learner`` / ``run_actor``: a real (tiny) RL loop —
+  numpy-only REINFORCE on a multi-armed bandit.  Actors run rollouts with
+  their current policy weights and POST experience batches over HTTP (the
+  Service-fronted learner address in ``KTPU_LEARNER_ADDR``); the learner
+  folds batches into a policy update and serves /stats.  Deliberately
+  CPU-cheap: the point is the CONTROL-PLANE shape (pod churn, endpoints
+  fan-out, gang placement), not the math — actors pack on non-TPU
+  capacity while learners gang on slices.
+
+- spec builders (``actor_pod``, ``learner_job``, ``fleet_service``): the
+  typed objects a driver/bench/chaos schedule creates.
+
+- ``ChurnDriver``: recycles an actor fleet at a target churn rate
+  (creates+deletes per second) against a live cluster — delete via ONE
+  pods/delete:batch per wave (or singleton DELETEs for the A/B control)
+  and immediate replacement creates under fresh generation-suffixed
+  names.  Measures achieved ops/s and per-slot actor-restart latency
+  (delete issued -> replacement Ready), the churn bench's two core
+  numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import types as t
+from ..utils import locksan
+
+ACTOR_APP_LABEL = "rl-actor"
+LEARNER_APP_LABEL = "rl-learner"
+LEARNER_PORT = 8476
+
+
+# ------------------------------------------------------------ the RL math
+#
+# A K-armed bandit with a softmax policy: rollouts sample arms, rewards
+# are arm-dependent Bernoulli draws, and the learner applies REINFORCE
+# (reward-weighted log-prob gradients).  Numpy only — runs anywhere the
+# test tier runs.
+
+def _softmax(w):
+    import numpy as np
+
+    z = np.exp(w - w.max())
+    return z / z.sum()
+
+
+def rollout(weights, steps: int = 64, seed: int = 0) -> Dict[str, list]:
+    """One experience batch: sampled arms + observed rewards under the
+    current policy.  JSON-shaped (lists), ready to POST."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    w = np.asarray(weights, dtype=np.float64)
+    # fixed latent arm qualities: arm i pays with prob (i+1)/(K+1)
+    k = len(w)
+    probs = _softmax(w)
+    arms = rng.choice(k, size=steps, p=probs)
+    pay = (arms + 1) / (k + 1)
+    rewards = (rng.random(steps) < pay).astype(np.float64)
+    return {"arms": arms.tolist(), "rewards": rewards.tolist()}
+
+
+def reinforce_update(weights, batch: Dict[str, list], lr: float = 0.05):
+    """One REINFORCE step over an experience batch; returns new weights
+    and the batch's mean reward."""
+    import numpy as np
+
+    w = np.asarray(weights, dtype=np.float64).copy()
+    arms = np.asarray(batch.get("arms") or [], dtype=np.int64)
+    rewards = np.asarray(batch.get("rewards") or [], dtype=np.float64)
+    if arms.size == 0:
+        return w, 0.0
+    baseline = rewards.mean()
+    probs = _softmax(w)
+    for a, r in zip(arms, rewards):
+        grad = -probs
+        grad[a] += 1.0
+        w += lr * (r - baseline) * grad
+    return w, float(baseline)
+
+
+class Learner:
+    """The long-lived half: accumulates experience over HTTP, applies
+    policy updates, serves weights + stats.  One instance per learner
+    pod; the ThreadingHTTPServer shape matches the repo's other tiny
+    control servers."""
+
+    def __init__(self, arms: int = 8, port: int = 0, lr: float = 0.05):
+        import numpy as np
+
+        self.weights = np.zeros(arms, dtype=np.float64)
+        self.lr = lr
+        self.batches = 0
+        self.frames = 0
+        self.updates = 0
+        self.mean_reward = 0.0
+        self._lock = locksan.make_lock("rl_actor.Learner._lock")
+        self._srv = None
+        self._port = port
+
+    def ingest(self, batch: Dict[str, list]):
+        with self._lock:
+            self.weights, mean_r = reinforce_update(
+                self.weights, batch, lr=self.lr)
+            self.batches += 1
+            self.frames += len(batch.get("arms") or [])
+            self.updates += 1
+            self.mean_reward = mean_r
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"batches": self.batches, "frames": self.frames,
+                    "updates": self.updates,
+                    "mean_reward": round(self.mean_reward, 4),
+                    "weights": [round(float(x), 4) for x in self.weights]}
+
+    # ------------------------------------------------------------- server
+
+    def start(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        learner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/stats"):
+                    self._json(200, learner.stats())
+                elif self.path.startswith("/weights"):
+                    self._json(200, {"weights": list(learner.weights)})
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if not self.path.startswith("/experience"):
+                    self._json(404, {"error": "unknown path"})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    batch = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "bad json"})
+                    return
+                learner.ingest(batch)
+                self._json(200, {"ok": True})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._srv.daemon_threads = True
+        th = threading.Thread(target=self._srv.serve_forever, daemon=True,
+                              name="rl-learner")
+        th.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+
+def run_actor(learner_url: str, lifetime_s: float = 30.0,
+              steps_per_batch: int = 64, seed: int = 0,
+              interval_s: float = 0.05) -> dict:
+    """The short-lived half: pull weights, rollout, POST experience,
+    repeat until the lifetime expires, then EXIT — recycling (the churn)
+    is the fleet controller's job, not the actor's.  Transport errors are
+    absorbed: an actor outliving its learner for a beat must not crash
+    the fleet."""
+    import urllib.request
+
+    import numpy as np
+
+    w = None
+    sent = frames = errors = 0
+    deadline = time.monotonic() + lifetime_s
+    i = 0
+    while time.monotonic() < deadline:
+        if w is None:
+            try:
+                with urllib.request.urlopen(
+                        learner_url + "/weights", timeout=2.0) as r:
+                    w = np.asarray(
+                        json.loads(r.read()).get("weights") or [0.0] * 8)
+            except OSError:
+                w = np.zeros(8)
+        batch = rollout(w, steps=steps_per_batch, seed=seed * 100003 + i)
+        i += 1
+        data = json.dumps(batch).encode()
+        req = urllib.request.Request(
+            learner_url + "/experience", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=2.0):
+                pass
+            sent += 1
+            frames += len(batch["arms"])
+            w = None  # refresh policy next round
+        except OSError:
+            errors += 1
+        if interval_s:
+            time.sleep(interval_s)
+    return {"batches_sent": sent, "frames": frames, "errors": errors}
+
+
+# -------------------------------------------------------- spec builders
+
+def actor_pod(slot: int, gen: int = 0, ns: str = "default",
+              app: str = ACTOR_APP_LABEL, name_prefix: str = "actor",
+              tpus: int = 0, lifetime_s: float = 30.0,
+              learner_addr: str = "", cpu: str = "10m") -> t.Pod:
+    """One actor: generation-suffixed name (slot recycling never reuses a
+    live name), fleet label for the Service selector, CPU-packable by
+    default (tpus=0) — Podracer actors share hosts; learners own slices."""
+    pod = t.Pod()
+    pod.metadata.name = f"{name_prefix}-{slot}-g{gen}"
+    pod.metadata.namespace = ns
+    pod.metadata.labels = {"app": app, "rl.ktpu.io/slot": str(slot)}
+    c = t.Container(
+        name="actor", image="ktpu/rl-actor",
+        command=["python", "-m", "kubernetes1_tpu.workloads.rl_actor",
+                 "--actor", "--lifetime", str(lifetime_s)])
+    c.resources.requests = {"cpu": cpu}
+    if learner_addr:
+        c.env = [t.EnvVar(name="KTPU_LEARNER_ADDR", value=learner_addr)]
+    pod.spec.containers = [c]
+    pod.spec.restart_policy = "Never"
+    if tpus:
+        per = t.PodExtendedResource(
+            name=f"{pod.metadata.name}-tpu", resource="google.com/tpu",
+            quantity=tpus)
+        pod.spec.extended_resources = [per]
+        c.extended_resource_requests = [per.name]
+    return pod
+
+
+def learner_job(name: str = "rl-learner", ns: str = "default",
+                workers: int = 2, tpus_per_worker: int = 1,
+                gang: bool = True) -> t.Job:
+    """The learner slice: an Indexed Job, gang-scheduled when the gate is
+    on, each worker holding TPU chips — the long-lived half actors stream
+    into."""
+    job = t.Job()
+    job.metadata.name = name
+    job.metadata.namespace = ns
+    job.spec.completions = workers
+    job.spec.parallelism = workers
+    job.spec.completion_mode = "Indexed"
+    job.spec.gang_scheduling = gang
+    job.spec.backoff_limit = 20
+    c = t.Container(
+        name="learner", image="ktpu/rl-learner",
+        command=["python", "-m", "kubernetes1_tpu.workloads.rl_actor",
+                 "--learner"])
+    if tpus_per_worker:
+        c.resources.limits = {"google.com/tpu": tpus_per_worker}
+    job.spec.template.metadata.labels = {"app": LEARNER_APP_LABEL}
+    job.spec.template.spec.containers = [c]
+    return job
+
+
+def fleet_service(name: str, ns: str = "default",
+                  app: str = ACTOR_APP_LABEL,
+                  port: int = LEARNER_PORT) -> t.Service:
+    """Service fronting a fleet by its app label — the discovery surface
+    whose Endpoints object churns with the fleet."""
+    svc = t.Service()
+    svc.metadata.name = name
+    svc.metadata.namespace = ns
+    svc.spec.selector = {"app": app}
+    svc.spec.ports = [t.ServicePort(name="rl", port=port, target_port=port)]
+    return svc
+
+
+def ready_fleet_ips(cs, namespace: str = "default",
+                    app: str = ACTOR_APP_LABEL):
+    """IPs of Running+Ready, non-terminating fleet pods — THE definition
+    the bench convergence check and the chaos verdict both compare a
+    fleet Service's Endpoints against (one copy, or the two drift).
+    None when the control plane couldn't answer."""
+    from ..machinery import ApiError
+
+    try:
+        pods, _ = cs.pods.list(namespace=namespace,
+                               label_selector=f"app={app}")
+    except (ApiError, ConnectionError, TimeoutError, OSError):
+        return None
+    return {p.status.pod_ip or p.status.host_ip for p in pods
+            if p.status.phase == t.POD_RUNNING
+            and not p.metadata.deletion_timestamp
+            and any(c.type == "Ready" and c.status == "True"
+                    for c in p.status.conditions)}
+
+
+def service_endpoint_ips(cs, name: str, namespace: str = "default"):
+    """Address set of a Service's Endpoints object; None when it hasn't
+    been written (or the control plane couldn't answer)."""
+    from ..machinery import ApiError
+
+    try:
+        ep = cs.endpoints.get(name, namespace)
+    except (ApiError, ConnectionError, TimeoutError, OSError):
+        return None
+    return {a.ip for s in ep.subsets for a in s.addresses}
+
+
+# -------------------------------------------------------- churn driver
+
+class ChurnDriver:
+    """Recycle an actor fleet at a target churn rate against a live
+    cluster.
+
+    One recycle = delete the slot's current pod + create its replacement
+    under the next generation name = 2 ops toward the rate.  Deletes ship
+    as ONE ``pods/delete:batch`` per wave (``use_batch=False`` = singleton
+    DELETEs, the A/B control).  Replacement readiness is watched through
+    a label-selected informer; per-slot restart latency is delete-issued
+    -> replacement Ready (``ready_mode="running"``: phase Running;
+    ``"bound"``: spec.nodeName set — the no-kubelet sched_perf topology).
+
+    With ``wait_ready=True`` (default) only READY slots recycle: the
+    driver measures the churn the WHOLE pipeline (schedule + kubelet
+    restart) sustains, and never open-loop piles work onto a wedged
+    control plane (starved ticks are counted instead).
+    ``wait_ready=False`` is the capacity probe: a slot recycles as soon
+    as its replacement is CREATED — the cycle rate is then bounded by
+    the control plane's create+delete path itself (pods die Pending
+    too, which is exactly the scheduler-queue-purge stress)."""
+
+    def __init__(self, cs, namespace: str = "default", actors: int = 16,
+                 rate: float = 50.0, use_batch: bool = True,
+                 grace_seconds: int = 0, tpus_per_actor: int = 0,
+                 ready_mode: str = "running", recycle_chunk: int = 16,
+                 name_prefix: str = "actor", app: str = ACTOR_APP_LABEL,
+                 lifetime_s: float = 30.0, learner_addr: str = "",
+                 wait_ready: bool = True):
+        from ..client.informer import SharedInformer
+
+        if ready_mode not in ("running", "bound"):
+            raise ValueError(f"ready_mode must be running|bound, "
+                             f"got {ready_mode!r}")
+        self.cs = cs
+        self.namespace = namespace
+        self.actors = int(actors)
+        self.rate = float(rate)
+        self.use_batch = bool(use_batch)
+        self.grace_seconds = grace_seconds
+        self.tpus_per_actor = int(tpus_per_actor)
+        self.ready_mode = ready_mode
+        self.recycle_chunk = max(1, int(recycle_chunk))
+        self.name_prefix = name_prefix
+        self.app = app
+        self.lifetime_s = lifetime_s
+        self.learner_addr = learner_addr
+        self.wait_ready = bool(wait_ready)
+        self._slots: List[dict] = [
+            {"slot": i, "gen": 0, "name": "", "state": "new",
+             "t_issue": 0.0, "created": False}
+            for i in range(self.actors)]
+        self._ready_names: set = set()
+        self._ready_lock = locksan.make_lock("rl_actor.ChurnDriver._ready_lock")
+        # measurement counters are bumped from N recycle workers
+        self._stat_lock = locksan.make_lock("rl_actor.ChurnDriver._stat_lock")
+        self._informer = SharedInformer(
+            cs.pods, namespace=namespace, label_selector=f"app={app}")
+        self._informer.add_handler(on_add=self._observe,
+                                   on_update=lambda _o, n: self._observe(n))
+        # old-generation names whose delete FAILED (or may not have
+        # landed): retried on every settle pass so a fault window never
+        # leaks a pod past the run (the chaos schedule's leak verdict).
+        # Guarded by _stat_lock: N recycle workers add while a sweep
+        # snapshots (an unguarded sorted() over a mutating set raises
+        # and would silently kill the worker thread).
+        self._garbage: set = set()
+        self._garbage_retry_at = 0.0
+        # measurements
+        self.creates = 0
+        self.deletes = 0
+        self.create_errors = 0
+        self.delete_errors = 0
+        self.starved_ticks = 0
+        self.restart_latencies: List[float] = []
+        self._wall = 0.0
+
+    # ------------------------------------------------------------ plumbing
+
+    def _is_ready(self, pod: t.Pod) -> bool:
+        if pod.metadata.deletion_timestamp:
+            return False
+        if self.ready_mode == "bound":
+            return bool(pod.spec.node_name)
+        return pod.status.phase == t.POD_RUNNING
+
+    def _observe(self, pod: t.Pod):
+        if self._is_ready(pod):
+            with self._ready_lock:
+                self._ready_names.add(pod.metadata.name)
+
+    def _pod_for(self, slot: dict) -> t.Pod:
+        return actor_pod(slot["slot"], gen=slot["gen"], ns=self.namespace,
+                         app=self.app, name_prefix=self.name_prefix,
+                         tpus=self.tpus_per_actor,
+                         lifetime_s=self.lifetime_s,
+                         learner_addr=self.learner_addr)
+
+    def _create(self, slot: dict) -> bool:
+        from ..machinery import AlreadyExists, ApiError
+
+        try:
+            self.cs.pods.create(self._pod_for(slot))
+        except AlreadyExists:
+            pass  # a prior attempt's create DID land
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            with self._stat_lock:
+                self.create_errors += 1
+            return False
+        slot["name"] = f"{self.name_prefix}-{slot['slot']}-g{slot['gen']}"
+        slot["created"] = True
+        with self._stat_lock:
+            self.creates += 1
+        return True
+
+    # ------------------------------------------------------------- control
+
+    def start(self, ready_timeout: float = 60.0):
+        """Create the initial fleet and wait until every slot is Ready."""
+        self._informer.start()
+        self._informer.wait_for_sync(15.0)
+        for slot in self._slots:
+            slot["state"] = "recycling"
+            # t_issue 0.0 = fleet bring-up, not a recycle: cold-start
+            # readiness must not pollute the actor-RESTART latency
+            # distribution (_settle skips the sample)
+            slot["t_issue"] = 0.0
+            self._create(slot)
+        deadline = time.monotonic() + ready_timeout
+        while time.monotonic() < deadline:
+            self._settle()
+            if all(s["state"] == "ready" for s in self._slots):
+                return self
+            time.sleep(0.1)
+        ready = sum(1 for s in self._slots if s["state"] == "ready")
+        raise RuntimeError(
+            f"churn fleet never became ready: {ready}/{self.actors}")
+
+    def _settle(self, slots=None):
+        """Fold informer observations into slot state (a worker settles
+        only ITS partition — slots never cross workers); restart latency
+        closes when a recycling slot's replacement turns Ready.  Also
+        retries garbage (old generations whose delete failed) so faults
+        can't leak pods past the run."""
+        with self._ready_lock:
+            ready = set(self._ready_names)
+        for slot in (self._slots if slots is None else slots):
+            if slot["state"] == "recycling":
+                if not slot["created"]:
+                    self._create(slot)  # earlier create failed: retry
+                elif slot["name"] in ready:
+                    slot["state"] = "ready"
+                    if slot["t_issue"]:
+                        with self._stat_lock:
+                            self.restart_latencies.append(
+                                time.monotonic() - slot["t_issue"])
+        with self._stat_lock:
+            sweep_due = (self._garbage
+                         and time.monotonic() >= self._garbage_retry_at)
+            if sweep_due:
+                self._garbage_retry_at = time.monotonic() + 0.5
+        if sweep_due:
+            self._sweep_garbage()
+
+    def _sweep_garbage(self):
+        from ..machinery import ApiError, NotFound
+
+        with self._stat_lock:
+            names = sorted(self._garbage)
+        if not names:
+            return
+        try:
+            outs = self.cs.delete_batch(
+                self.namespace, [{"name": n} for n in names],
+                grace_seconds=0)
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            return  # still faulted: next settle retries
+        with self._stat_lock:
+            for n, err in zip(names, outs):
+                if err is None or isinstance(err, NotFound):
+                    self._garbage.discard(n)
+
+    def _recycle(self, slots: List[dict]):
+        from ..machinery import ApiError, NotFound
+
+        if not slots:
+            return
+        now = time.monotonic()
+        doomed = []
+        for slot in slots:
+            doomed.append({"name": slot["name"]})
+            slot["state"] = "recycling"
+            slot["t_issue"] = now
+            slot["gen"] += 1
+            slot["created"] = False
+        with self._ready_lock:
+            # prune dead generations: the set must track ~live names,
+            # not every name a long run ever minted
+            for d in doomed:
+                self._ready_names.discard(d["name"])
+        if self.use_batch:
+            try:
+                outs = self.cs.delete_batch(
+                    self.namespace, doomed, grace_seconds=self.grace_seconds)
+                # count LANDED deletes only (success or already-gone),
+                # exactly like the singleton leg — an A/B must not let
+                # the batched side book failed items as ops
+                with self._stat_lock:
+                    for d, e in zip(doomed, outs):
+                        if e is None or isinstance(e, NotFound):
+                            self.deletes += 1
+                        else:
+                            self.delete_errors += 1
+                            self._garbage.add(d["name"])
+            except (ApiError, ConnectionError, TimeoutError, OSError):
+                # the envelope MAY have landed server-side: sweep the
+                # names until the API proves them gone (idempotent)
+                with self._stat_lock:
+                    self.delete_errors += len(doomed)
+                    self._garbage.update(d["name"] for d in doomed)
+        else:
+            for d in doomed:
+                try:
+                    self.cs.pods.delete(d["name"], self.namespace,
+                                        grace_seconds=self.grace_seconds)
+                    with self._stat_lock:
+                        self.deletes += 1
+                except NotFound:
+                    with self._stat_lock:
+                        self.deletes += 1
+                except (ApiError, ConnectionError, TimeoutError, OSError):
+                    with self._stat_lock:
+                        self.delete_errors += 1
+                        self._garbage.add(d["name"])
+        for slot in slots:
+            self._create(slot)
+
+    def run(self, duration: float = 20.0, tick: float = 0.05,
+            workers: int = 1) -> dict:
+        """Drive churn for `duration` seconds at the target rate; returns
+        the result block.  `workers` recycle threads partition the slot
+        space (slot % workers) and split the rate — a capacity probe
+        needs concurrent requests in flight to saturate a multi-process
+        control plane (ApiClient keeps one connection per thread)."""
+        workers = max(1, int(workers))
+        t0 = time.monotonic()
+        if workers == 1:
+            self._run_worker(self._slots, self.rate, duration, tick, t0)
+        else:
+            parts = [[s for s in self._slots if s["slot"] % workers == w]
+                     for w in range(workers)]
+            threads = [threading.Thread(
+                target=self._run_worker,
+                args=(parts[w], self.rate / workers, duration, tick, t0),
+                daemon=True, name=f"churn-worker-{w}")
+                for w in range(workers)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=duration + 60.0)
+        self._wall = time.monotonic() - t0
+        self._settle()
+        return self.result()
+
+    def _run_worker(self, slots: List[dict], rate: float, duration: float,
+                    tick: float, t0: float):
+        issued = 0
+        next_slot = 0
+        while True:
+            elapsed = time.monotonic() - t0
+            if elapsed >= duration:
+                break
+            self._settle(slots)
+            want = int((rate * elapsed) // 2) - issued
+            # issue whole waves until the tick's deficit is paid or no
+            # slot is eligible — each wave's synchronous deletes+creates
+            # self-pace the loop, so a capacity probe (huge rate) runs
+            # the control plane flat out instead of one wave per tick
+            while want > 0:
+                if self.wait_ready:
+                    eligible = [s for s in slots if s["state"] == "ready"]
+                else:
+                    # capacity probe: a CREATED replacement is enough —
+                    # the current name exists to delete
+                    eligible = [s for s in slots if s["created"]]
+                if not eligible:
+                    with self._stat_lock:
+                        self.starved_ticks += 1
+                    break
+                # round-robin over slots so every actor churns
+                eligible.sort(
+                    key=lambda s: (s["slot"] - next_slot) % self.actors)
+                chunk = eligible[:min(want, self.recycle_chunk)]
+                next_slot = (chunk[-1]["slot"] + 1) % self.actors
+                self._recycle(chunk)
+                issued += len(chunk)
+                want -= len(chunk)
+                if time.monotonic() - t0 >= duration:
+                    break
+            time.sleep(tick)
+
+    def drain(self, timeout: float = 30.0):
+        """Delete the whole fleet — slots, garbage, and anything else
+        wearing the fleet label (list-driven, so fault-window strays
+        can't survive) — and wait for the API to show zero actors (the
+        leak check's clean baseline)."""
+        deadline = time.monotonic() + timeout
+        names = {s["name"] for s in self._slots if s["created"]}
+        names |= self._garbage
+        while time.monotonic() < deadline:
+            try:
+                pods, _ = self.cs.pods.list(
+                    namespace=self.namespace,
+                    label_selector=f"app={self.app}")
+            except Exception:  # noqa: BLE001 — settling control plane
+                time.sleep(0.2)
+                continue
+            names |= {p.metadata.name for p in pods}
+            if not pods and not names:
+                return True
+            if names:
+                from ..machinery import ApiError
+
+                try:
+                    self.cs.delete_batch(
+                        self.namespace, [{"name": n} for n in sorted(names)],
+                        grace_seconds=0)
+                    names.clear()
+                except (ApiError, ConnectionError, TimeoutError, OSError):
+                    pass  # settling/faulted control plane: retried next loop
+            elif not pods:
+                return True
+            time.sleep(0.2)
+        return False
+
+    def stop(self):
+        self._informer.stop()
+
+    def live_names(self) -> set:
+        """The names the driver believes exist (the API-vs-driver leak
+        check's expected set)."""
+        return {s["name"] for s in self._slots if s["created"]}
+
+    def result(self) -> dict:
+        lats = sorted(self.restart_latencies)
+
+        def pct(q):
+            return round(lats[min(len(lats) - 1, int(q * len(lats)))], 4) \
+                if lats else None
+
+        ops = self.creates + self.deletes
+        return {
+            "actors": self.actors,
+            "target_rate_ops_s": self.rate,
+            "ops": ops,
+            "creates": self.creates,
+            "deletes": self.deletes,
+            "wall_s": round(self._wall, 2),
+            "ops_per_s": round(ops / self._wall, 1) if self._wall else None,
+            "recycles_completed": len(lats),
+            "actor_restart_p50_s": pct(0.50),
+            "actor_restart_p99_s": pct(0.99),
+            "create_errors": self.create_errors,
+            "delete_errors": self.delete_errors,
+            "starved_ticks": self.starved_ticks,
+            "mode": "batched" if self.use_batch else "singleton",
+            "grace_seconds": self.grace_seconds,
+        }
+
+
+# ------------------------------------------------------------------ main
+
+def main():
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description="Podracer-style RL actor/learner")
+    ap.add_argument("--actor", action="store_true")
+    ap.add_argument("--learner", action="store_true")
+    ap.add_argument("--lifetime", type=float, default=30.0)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--port", type=int, default=LEARNER_PORT)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.learner:
+        learner = Learner(port=args.port).start()
+        print(f"learner serving on {learner.url}", flush=True)
+        try:
+            while True:
+                time.sleep(5)
+                print(json.dumps(learner.stats()), flush=True)
+        except KeyboardInterrupt:
+            learner.stop()
+        return
+    addr = os.environ.get("KTPU_LEARNER_ADDR", f"http://127.0.0.1:{args.port}")
+    if not addr.startswith("http"):
+        addr = f"http://{addr}"
+    out = run_actor(addr, lifetime_s=args.lifetime,
+                    steps_per_batch=args.steps, seed=args.seed)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,338 @@ STORE_SHARD_SPEC = (
 )
 
 
+# Churn schedule: the RL actor-swarm shape under wire faults — client.*
+# faults at every component's apiserver client plus store-RPC/replication
+# faults, with a mid-storm primary-store KILL (standby promotes) while a
+# fleet of chip-holding actors is being recycled through pods/delete:batch.
+# Probabilities stay low enough that churn keeps making progress.
+CHURN_SPEC = (
+    "client.dial=drop@0.03;"
+    "client.request=drop@0.03|delay:5ms@0.05;"
+    "client.watch=drop@0.05;"
+    "store.rpc=drop@0.03|delay:5ms@0.05;"
+    "store.watch=drop@0.05;"
+    "repl.link=sever@0.08|drop@0.05"
+)
+
+
+def run_churn_schedule(seed: int, duration: float = 8.0,
+                       spec: str = None, tmpdir: str = "") -> dict:
+    """One seeded churn schedule: durable primary+standby stores, a
+    Master over the pair, scheduler, endpoints controller (coalescing),
+    2 hollow TPU kubelets, and a ChurnDriver recycling a chip-holding
+    actor fleet through pods/delete:batch — all under wire faults, with
+    the primary store KILLED mid-storm (the standby promotes under
+    deletion load).
+
+    Verdict invariants (faults off, after settle):
+      - zero leaked pods: every READY runtime sandbox maps to a live API
+        pod and the API fleet equals the driver's expected set; after
+        drain, zero actor pods remain anywhere;
+      - zero leaked device claims: the apiserver's device-claim index
+        equals exactly the chips of live bound pods (batch deletes must
+        release eagerly);
+      - endpoints converge to the live ready set;
+      - strict revision order per cacher watch stream across the
+        failover;
+      - batch deletes actually engaged (DELETE_BATCH flight-recorder
+        events) and churn made progress under the faults."""
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset, InformerFactory
+    from kubernetes1_tpu.client import retry as client_retry
+    from kubernetes1_tpu.controllers import EndpointsController
+    from kubernetes1_tpu.deviceplugin.api import PluginServer, plugin_socket_path
+    from kubernetes1_tpu.deviceplugin.tpu_plugin import TPUDevicePlugin, _fake_devices
+    from kubernetes1_tpu.kubelet import FakeRuntime, Kubelet
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.scheduler import Scheduler
+    from kubernetes1_tpu.storage import Store
+    from kubernetes1_tpu.storage.server import StoreServer
+    from kubernetes1_tpu.storage.standby import StandbyServer
+    from kubernetes1_tpu.utils import faultline, flightrec
+    from kubernetes1_tpu.workloads.rl_actor import (
+        ACTOR_APP_LABEL, ChurnDriver, fleet_service, ready_fleet_ips,
+        service_endpoint_ips)
+
+    spec = CHURN_SPEC if spec is None else spec
+    own_tmp = not tmpdir
+    if own_tmp:
+        tmpdir = tempfile.mkdtemp(prefix=f"ktpu-chaos-churn-{seed}-")
+    n_nodes, chips, actors = 2, 8, 6
+    _begin_seed_run()
+    retries_before = client_retry.retries_snapshot()
+    verdict = {"mode": "churn", "seed": seed, "spec": spec,
+               "killed_primary": False, "ok": False}
+    psock = os.path.join(tmpdir, "p.sock")
+    ssock = os.path.join(tmpdir, "s.sock")
+    store = Store(global_scheme.copy(),
+                  wal_path=os.path.join(tmpdir, "p.wal"))
+    primary = standby = master = cs = sched = epc = factory = None
+    sched_cs = ctrl_cs = None
+    nodes = []
+    driver = None
+    order_stop = threading.Event()
+    order_thread = None
+    order_ok = [True]
+    try:
+        primary = StoreServer(store, psock, repl_ack_policy="durable").start()
+        standby = StandbyServer(psock, ssock,
+                                wal_path=os.path.join(tmpdir, "s.wal"),
+                                failover_grace=0.5,
+                                repl_ack_policy="durable").start()
+        master = Master(store_address=f"{psock},{ssock}").start()
+        cs = Clientset(master.url)
+        sched_cs = Clientset(master.url)
+        sched = Scheduler(sched_cs)
+        sched.start()
+        ctrl_cs = Clientset(master.url)
+        factory = InformerFactory(ctrl_cs)
+        epc = EndpointsController(ctrl_cs, factory, coalesce_window=0.05)
+        epc.setup()
+        factory.start_all()
+        factory.wait_for_sync()
+        epc.start_workers()
+
+        def cacher_order_check():
+            # per-STREAM strict revision order at the cacher (across a
+            # failover a promoted standby may reuse revs the dead
+            # primary burned — streams resynchronize at evict/relist)
+            while not order_stop.is_set():
+                try:
+                    w = master.cacher.watch("/registry/", since_rev=0)
+                except Exception:  # noqa: BLE001 — reseeding mid-failover
+                    if order_stop.wait(0.2):
+                        return
+                    continue
+                last = 0
+                try:
+                    while not order_stop.is_set():
+                        ev = w.next_timeout(0.5)
+                        if ev is None:
+                            if w.evicted or w._stopped.is_set():
+                                break
+                            continue
+                        try:
+                            rv = int((ev.object.get("metadata") or {})
+                                     .get("resourceVersion") or 0)
+                        except (TypeError, ValueError):
+                            order_ok[0] = False
+                            continue
+                        if rv <= last:
+                            order_ok[0] = False
+                        last = rv
+                finally:
+                    w.stop()
+
+        order_thread = threading.Thread(target=cacher_order_check,
+                                        daemon=True, name="churn-order")
+        order_thread.start()
+
+        for i in range(n_nodes):
+            name = f"churn-node-{i}"
+            plugin_dir = os.path.join(tmpdir, name)
+            impl = TPUDevicePlugin(devices=_fake_devices(f"v5e:{chips}:s{i}:0"))
+            plugin = PluginServer(
+                impl, plugin_socket_path(plugin_dir, "google.com/tpu"))
+            plugin.start()
+            kcs = Clientset(master.url)
+            runtime = FakeRuntime()
+            kl = Kubelet(kcs, node_name=name, runtime=runtime,
+                         plugin_dir=plugin_dir, heartbeat_interval=0.5,
+                         sync_interval=0.2, pleg_interval=0.2)
+            kl.start()
+            nodes.append({"name": name, "kubelet": kl, "plugin": plugin,
+                          "runtime": runtime, "cs": kcs})
+
+        def chip_nodes():
+            try:
+                listed, _ = cs.nodes.list()
+            except Exception:  # noqa: BLE001
+                return 0
+            return len([n for n in listed
+                        if n.status.extended_resources.get("google.com/tpu")])
+
+        deadline = time.monotonic() + 30.0
+        while chip_nodes() < n_nodes and time.monotonic() < deadline:
+            time.sleep(0.2)
+        cs.services.create(fleet_service("rl-actors"), "default")
+
+        # actors hold chips: every recycle is a full
+        # create→bind(claim)→delete(release) cycle on a small chip pool —
+        # a leaked claim wedges the fleet within a few generations
+        driver = ChurnDriver(cs, actors=actors, rate=20.0, use_batch=True,
+                             grace_seconds=0, tpus_per_actor=1,
+                             ready_mode="running")
+        driver.start(ready_timeout=60.0)
+        if spec:
+            faultline.activate(seed, spec)
+        run_out = {}
+
+        def drive():
+            run_out.update(driver.run(duration=duration))
+
+        drv_thread = threading.Thread(target=drive, daemon=True,
+                                      name="churn-driver")
+        drv_thread.start()
+        t0 = time.monotonic()
+        while drv_thread.is_alive():
+            if (not verdict["killed_primary"]
+                    and time.monotonic() - t0 > duration / 2):
+                primary.stop()  # SIGKILL analog; the standby promotes
+                verdict["killed_primary"] = True
+            time.sleep(0.05)
+        drv_thread.join(timeout=15.0)
+        verdict["injected"] = faultline.stats()
+        faultline.deactivate()
+        verdict["churn"] = run_out
+
+        # ---- settle + invariants (faults OFF now)
+        recover_t0 = time.monotonic()
+
+        def live_actors():
+            try:
+                pods, _ = cs.pods.list(
+                    namespace="default",
+                    label_selector=f"app={ACTOR_APP_LABEL}")
+                return pods
+            except Exception:  # noqa: BLE001 — failover settling
+                return None
+
+        # fleet settles: every slot's pod exists and is Running
+        expected = driver.live_names()
+        fleet_ok = False
+        while time.monotonic() - recover_t0 < CONVERGE_TIMEOUT:
+            driver._settle()
+            expected = driver.live_names()
+            pods = live_actors()
+            if pods is not None:
+                names = {p.metadata.name for p in pods
+                         if not p.metadata.deletion_timestamp}
+                if names == expected and all(
+                        p.status.phase == t.POD_RUNNING for p in pods
+                        if p.metadata.name in expected):
+                    fleet_ok = True
+                    break
+            time.sleep(0.25)
+        verdict["fleet_converged"] = fleet_ok
+        verdict["recovery_s"] = round(time.monotonic() - recover_t0, 2)
+
+        # endpoints converge to the live ready set (shared helpers: the
+        # bench convergence check uses the same definitions)
+        eps_ok = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            live = ready_fleet_ips(cs)
+            if live is not None and \
+                    service_endpoint_ips(cs, "rl-actors") == live:
+                eps_ok = True
+                break
+            time.sleep(0.25)
+        verdict["endpoints_converged"] = eps_ok
+
+        # zero leaked device claims: the claim index must equal exactly
+        # the chips of live bound pods (batch deletes release eagerly)
+        def api_chips():
+            pods, _ = cs.pods.list(namespace="default")
+            return {(p.spec.node_name, per.resource or per.name, cid)
+                    for p in pods if p.spec.node_name
+                    and not p.metadata.deletion_timestamp
+                    for per in p.spec.extended_resources
+                    for cid in (per.assigned or [])}
+
+        claims_ok = False
+        claims_now = set()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            with master.registry._claims_lock:
+                claims_now = set(master.registry._device_claims)
+            if claims_now == api_chips():
+                claims_ok = True
+                break
+            time.sleep(0.25)
+        verdict["device_claims_leaked"] = sorted(
+            str(c) for c in (claims_now - api_chips())) if not claims_ok \
+            else []
+        verdict["device_claims_ok"] = claims_ok
+
+        # zero leaked pods, API vs runtime: every READY sandbox maps to
+        # a live API pod uid (kubelets finalize deleted actors)
+        def runtime_leaks():
+            try:
+                pods, _ = cs.pods.list(namespace="default")
+            except Exception:  # noqa: BLE001
+                return None
+            live_uids = {p.metadata.uid for p in pods}
+            leaks = []
+            for n in nodes:
+                for sb in n["runtime"].list_pod_sandboxes():
+                    if sb.state == "SANDBOX_READY" \
+                            and sb.pod_uid not in live_uids:
+                        leaks.append(f"{n['name']}/{sb.pod_name}")
+            return leaks
+
+        # None = the pod LIST itself failed (check never ran) — keep
+        # retrying; a verdict must never go green on an unexecuted check
+        leaks = runtime_leaks()
+        deadline = time.monotonic() + 20.0
+        while (leaks is None or leaks) and time.monotonic() < deadline:
+            time.sleep(0.25)
+            leaks = runtime_leaks()
+        verdict["runtime_leaked_sandboxes"] = leaks
+
+        # drain: the fleet deletes cleanly to zero
+        verdict["drained"] = driver.drain(timeout=30.0)
+
+        batch_events = sum(
+            1 for ev in flightrec.dump()["components"]
+            .get("apiserver", [])
+            if ev.get("kind") == flightrec.DELETE_BATCH)
+        verdict["delete_batch_events"] = batch_events
+        verdict["revision_order_ok"] = order_ok[0]
+        verdict["standby_promoted"] = standby.promoted.is_set()
+        verdict["client_retries"] = client_retry.retries_delta(
+            retries_before)
+        ops = run_out.get("ops") or 0
+        verdict["acked"] = ops
+        verdict["ok"] = (
+            fleet_ok and eps_ok and claims_ok and leaks == []
+            and verdict["drained"] and order_ok[0]
+            and batch_events > 0 and ops > 20
+            and (verdict["standby_promoted"]
+                 or not verdict["killed_primary"]))
+    finally:
+        order_stop.set()
+        faultline.deactivate()
+        if order_thread is not None:
+            order_thread.join(timeout=5.0)
+        if driver is not None:
+            _stop_quietly_mod(driver.stop)
+        for n in nodes:
+            _stop_quietly_mod(n["kubelet"].stop)
+            _stop_quietly_mod(n["plugin"].stop)
+            _stop_quietly_mod(n["cs"].close)
+        if epc is not None:
+            _stop_quietly_mod(epc.stop)
+        if factory is not None:
+            _stop_quietly_mod(factory.stop_all)
+        if sched is not None:
+            _stop_quietly_mod(sched.stop)
+        for handle in (ctrl_cs, sched_cs, cs):
+            if handle is not None:
+                _stop_quietly_mod(handle.close)
+        if master is not None:
+            _stop_quietly_mod(master.stop)
+        if standby is not None:
+            _stop_quietly_mod(standby.stop)
+        if primary is not None and not verdict["killed_primary"]:
+            _stop_quietly_mod(primary.stop)
+        _stop_quietly_mod(store.close)
+        if own_tmp:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return _finalize_verdict(verdict)
+
+
 def run_schedule(seed: int, duration: float = 6.0, kill_primary: bool = True,
                  spec: str = DEFAULT_SPEC, writers: int = 3,
                  tmpdir: str = "") -> dict:
@@ -1509,8 +1841,8 @@ def main() -> int:
                     help="skip the mid-run primary-store kill (wire schedule)")
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
-                    + ("sched-shard", "store-shard", "obs", "node-all",
-                       "all"),
+                    + ("sched-shard", "store-shard", "obs", "churn",
+                       "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
@@ -1518,6 +1850,9 @@ def main() -> int:
                          "primary killed mid-storm -> standby failover), "
                          "obs (collector under obs.scrape faults + dead "
                          "targets — serving must never wedge), "
+                         "churn (actor-fleet recycling through "
+                         "pods/delete:batch under wire faults + mid-storm "
+                         "store failover; leak/convergence verdicts), "
                          "node-all (all three node modes), or all")
     ap.add_argument("--store-shards", type=int, default=2,
                     help="store-shard schedule: shard count")
@@ -1532,7 +1867,8 @@ def main() -> int:
         schedules = list(NODE_MODES)
     elif args.schedule == "all":
         schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
-                                                   "store-shard", "obs"]
+                                                   "store-shard", "obs",
+                                                   "churn"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -1556,6 +1892,9 @@ def main() -> int:
             elif schedule == "obs":
                 v = run_obs_schedule(seed, duration=args.duration,
                                      spec=args.spec)
+            elif schedule == "churn":
+                v = run_churn_schedule(seed, duration=args.duration,
+                                       spec=args.spec)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

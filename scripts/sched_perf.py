@@ -43,7 +43,12 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                    apiservers: int = 1, bind_codec: str = "json",
                    store_wal: bool = False,
                    bind_stream: bool = False,
-                   hollow_watchers: int = 0) -> dict:
+                   hollow_watchers: int = 0,
+                   churn_rate: float = 0.0, churn_actors: int = 200,
+                   churn_seconds: float = 15.0,
+                   churn_singleton: bool = False,
+                   churn_tpus: int = 0, churn_workers: int = 4,
+                   churn_wait_ready: bool = True) -> dict:
     """multiproc=True runs apiserver and scheduler as separate OS processes
     (the deployment shape) so they get real parallelism; in-process mode
     shares one GIL across every component, which caps the measurable
@@ -273,7 +278,12 @@ def run_sched_perf(nodes: int, pods: int = 0, tpus_per_node: int = 32,
                         store_metrics_urls=store_metrics_urls,
                         store_shards=store_shards, apiservers=apiservers,
                         bind_codec=bind_codec, store_wal=store_wal,
-                        bind_stream=bind_stream, obs=obs)
+                        bind_stream=bind_stream, obs=obs,
+                        churn_rate=churn_rate, churn_actors=churn_actors,
+                        churn_seconds=churn_seconds,
+                        churn_singleton=churn_singleton,
+                        churn_tpus=churn_tpus, churn_workers=churn_workers,
+                        churn_wait_ready=churn_wait_ready)
         if rss_sampler is not None:
             result["apiserver_rss_mb"] = rss_sampler.stop_and_report()
         if hollow_stats_files:
@@ -496,6 +506,18 @@ def observability_block(obs) -> Optional[dict]:
         # overhead numerator for the same-box A/B: total wall-time the
         # collector spent scraping (the denominator is the phase wall)
         "collector_scrape_seconds": round(obs.scrape_seconds_total, 3),
+        # churn surface (the deletion half + endpoints fan-out): delete
+        # ops per caller batch, coalesced endpoints events, and the
+        # oldest-event -> Endpoints-write propagation-lag SLI — None
+        # until a churn workload actually exercises them
+        "store_delete_batch_occupancy": worst(
+            "ktpu_store_delete_batch_occupancy"),
+        "endpoints_writes": total("ktpu_endpoints_writes_total"),
+        "endpoints_coalesced": total("ktpu_endpoints_coalesced_total"),
+        "endpoints_propagation_p99_s": worst(
+            "ktpu_endpoints_propagation_seconds", quantile="0.99"),
+        "scheduler_queue_churn_purges": total(
+            "scheduler_queue_churn_purges_total"),
     }
 
 
@@ -548,7 +570,10 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
            scheds, metrics_urls=None, stamp=None, sched_shards=1,
            wire_codec="json", api_urls=None, store_metrics_urls=None,
            store_shards=1, apiservers=1, bind_codec="json",
-           store_wal=False, bind_stream=False, obs=None) -> dict:
+           store_wal=False, bind_stream=False, obs=None,
+           churn_rate=0.0, churn_actors=200, churn_seconds=15.0,
+           churn_singleton=False, churn_tpus=0, churn_workers=4,
+           churn_wait_ready=True) -> dict:
     api_urls = api_urls or [url]
     for i in range(nodes):
         # 8 hosts per ICI slice, v5e-32-ish geometry
@@ -706,6 +731,43 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             url, rate=min(80.0, max(5.0, throughput * 0.4)), duration=20.0,
             max_pods=free_chips)
 
+    # ---- churn phase (--churn, the RL actor-swarm shape): recycle a
+    # CPU-packable actor fleet at a target creates+deletes/s against the
+    # loaded cluster — the first phase to exercise the DELETION half
+    # (pods/delete:batch group commits, scheduler queue purges) at rate.
+    # ready_mode="bound": this topology has no kubelets, so a recycled
+    # actor is "restarted" when its replacement binds.  Runs BEFORE the
+    # metrics scrapes so the delete-batch counters land in the block.
+    churn = None
+    if churn_rate > 0:
+        from kubernetes1_tpu.workloads.rl_actor import ChurnDriver
+
+        drv = ChurnDriver(cs, actors=churn_actors, rate=churn_rate,
+                          use_batch=not churn_singleton, grace_seconds=0,
+                          tpus_per_actor=churn_tpus, ready_mode="bound",
+                          name_prefix="churn",
+                          wait_ready=churn_wait_ready)
+        # a failing churn phase must not discard the burst/steady
+        # results already measured (the bench.py rule): record the
+        # error in the block instead of aborting the run
+        try:
+            try:
+                drv.start(ready_timeout=60.0 + churn_actors / 10.0)
+                churn = drv.run(duration=churn_seconds,
+                                workers=max(1, int(churn_workers)))
+                churn["drained"] = drv.drain()
+            finally:
+                drv.stop()
+            # deletion-throughput probe (the A/B core): the same N pods
+            # deleted through the singleton verb vs pods/delete:batch —
+            # isolates the deletion path the tentpole amortizes (the
+            # full-pipeline ops/s above is create-dominated by
+            # construction)
+            churn["delete_throughput"] = _delete_throughput_probe(cs)
+        except Exception as e:  # noqa: BLE001 — phase error, not run error
+            churn = dict(churn or {},
+                         error=f"{type(e).__name__}: {e}")
+
     mx = merge_metrics([scrape_metrics(u) for u in metrics_urls]) \
         if metrics_urls else {}
 
@@ -838,6 +900,27 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
             "per_shard": per_shard,
         }
 
+    if churn is not None:
+        # deletion-path economics for the phase: delete ops per caller
+        # batch (the amortization claim) and the queue-churn purge count
+        # (dead Pending pods that never cost a schedule attempt).  With
+        # a REMOTE (shard) store the counters live in the store
+        # processes, not the apiservers — fall back to their /metrics.
+        d_ops = amx.get("ktpu_store_delete_batch_ops_total")
+        d_batches = amx.get("ktpu_store_delete_batches_total")
+        if not d_batches and store_metrics_urls:
+            smx = merge_metrics(
+                [scrape_metrics(u) for u in store_metrics_urls])
+            d_ops = smx.get("ktpu_store_delete_batch_ops_total")
+            d_batches = smx.get("ktpu_store_delete_batches_total")
+        churn["delete_batch_ops"] = d_ops
+        churn["delete_batches"] = d_batches
+        churn["delete_batch_occupancy"] = (
+            round(d_ops / d_batches, 3) if d_ops and d_batches else None)
+        churn["queue_churn_purges"] = (
+            sum(s.queue_churn_purges for s in scheds) if scheds
+            else mx.get("scheduler_queue_churn_purges_total"))
+
     result = {
         "nodes": nodes,
         "pods_requested": pods,
@@ -862,6 +945,7 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "write_path": write_path,
         "observability": observability_block(obs),
         "steady_state": steady,
+        "churn": churn,
         # per-attempt algorithm latency from the schedulers' own
         # histograms — in-process via the objects, multiproc via the
         # merged /metrics endpoints (counters sum, quantiles max)
@@ -884,6 +968,41 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
     if master:
         master.stop()
     return result
+
+
+def _delete_throughput_probe(cs, n: int = 600, batch: int = 100) -> dict:
+    """Same-box deletion A/B, both legs against the SAME live cluster:
+    create n pods, delete them one-by-one (the pre-batch cost model: one
+    HTTP round-trip + one store commit each), recreate, delete through
+    pods/delete:batch in `batch`-sized requests (one round-trip + one
+    group commit per chunk).  The ratio is the deletion path's
+    amortization factor."""
+    import time as _time
+
+    def mint(tag):
+        for i in range(n):
+            pod = t.Pod()
+            pod.metadata.name = f"delprobe-{tag}-{i}"
+            pod.spec.containers = [t.Container(name="c", image="probe")]
+            cs.pods.create(pod, "default")
+
+    out = {"pods": n, "batch": batch}
+    mint("s")
+    t0 = _time.perf_counter()
+    for i in range(n):
+        cs.pods.delete(f"delprobe-s-{i}", "default", grace_seconds=0)
+    wall = _time.perf_counter() - t0
+    out["singleton_deletes_per_s"] = round(n / wall, 1)
+    mint("b")
+    names = [f"delprobe-b-{i}" for i in range(n)]
+    t0 = _time.perf_counter()
+    for off in range(0, n, batch):
+        cs.delete_batch("default", names[off:off + batch], grace_seconds=0)
+    wall = _time.perf_counter() - t0
+    out["batched_deletes_per_s"] = round(n / wall, 1)
+    out["speedup"] = round(
+        out["batched_deletes_per_s"] / out["singleton_deletes_per_s"], 2)
+    return out
 
 
 def _steady_state(url: str, rate: float, duration: float,
@@ -992,6 +1111,35 @@ def main():
                          "hollow_watchers block (sync wall, steady-state "
                          "relists, relist bytes) and apiserver_rss_mb "
                          "(per-apiserver flatness verdict)")
+    ap.add_argument("--churn", action="store_true",
+                    help="run the RL actor-swarm churn phase after the "
+                         "burst/steady phases: recycle a CPU-packable "
+                         "actor fleet at --churn-rate creates+deletes/s "
+                         "through pods/delete:batch (the deletion half of "
+                         "the control plane, under load)")
+    ap.add_argument("--churn-rate", type=float, default=200.0,
+                    help="target churn in ops/s (1 recycle = 1 delete + "
+                         "1 create = 2 ops)")
+    ap.add_argument("--churn-actors", type=int, default=200,
+                    help="actor fleet size being recycled")
+    ap.add_argument("--churn-seconds", type=float, default=15.0)
+    ap.add_argument("--churn-singleton", action="store_true",
+                    help="A/B control: per-pod DELETE requests instead of "
+                         "pods/delete:batch")
+    ap.add_argument("--churn-tpus", type=int, default=0,
+                    help="chips per actor (0 = CPU-packable actors, the "
+                         "Podracer default; >0 stresses the device-claim "
+                         "release cycle)")
+    ap.add_argument("--churn-open-loop", action="store_true",
+                    help="capacity probe: recycle a slot as soon as its "
+                         "replacement is CREATED (not bound) — measures "
+                         "the create+delete path itself; pods deleted "
+                         "while Pending exercise the queue-purge leg")
+    ap.add_argument("--churn-workers", type=int, default=4,
+                    help="concurrent recycle threads (slot space "
+                         "partitioned; each keeps its own apiserver "
+                         "connection — a capacity probe needs requests "
+                         "in flight)")
     args = ap.parse_args()
     print(json.dumps(run_sched_perf(args.nodes, args.pods, args.tpus_per_node,
                                     args.creators, args.multiproc,
@@ -1003,7 +1151,16 @@ def main():
                                     bind_codec=args.bind_codec,
                                     store_wal=args.store_wal,
                                     bind_stream=args.bind_stream,
-                                    hollow_watchers=args.hollow_watchers)))
+                                    hollow_watchers=args.hollow_watchers,
+                                    churn_rate=(args.churn_rate
+                                                if args.churn else 0.0),
+                                    churn_actors=args.churn_actors,
+                                    churn_seconds=args.churn_seconds,
+                                    churn_singleton=args.churn_singleton,
+                                    churn_tpus=args.churn_tpus,
+                                    churn_workers=args.churn_workers,
+                                    churn_wait_ready=(
+                                        not args.churn_open_loop))))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,604 @@
+"""High-churn control plane: the batched deletion pipeline, coalesced
+endpoints fan-out, scheduler queue churn hygiene, device-claim release
+under mass deletes, and the RL actor-swarm workload.
+
+Contracts under test (the PR 5 group-commit rules, deletion flavor):
+
+1. pods/delete:batch lands N deletions through one store group commit
+   with PER-ITEM outcomes — NotFound/Conflict mixed with success, grace/
+   finalize semantics preserved per item (amortization, not a
+   transaction);
+2. batched and singleton deletion produce BYTE-IDENTICAL watch frames
+   (separate schemes so the serialization cache cannot mask a
+   divergence), and the singleton DELETE wire is unchanged;
+3. the endpoints controller with a coalesce window emits ≤ 1 write per
+   service per window while the FINAL object equals the uncoalesced
+   result; window 0 keeps today's immediate write;
+4. a pod deleted while Pending is purged from the scheduling queue and
+   the bind-failure counters promptly (counted in
+   scheduler_queue_churn_purges_total);
+5. device claims and scheduler-cache chip refcounts release promptly
+   across a full create→bind→delete→recreate cycle on the SAME chips.
+"""
+
+import time
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.apiserver.registry import Registry
+from kubernetes1_tpu.client import Clientset
+from kubernetes1_tpu.machinery import Conflict, NotFound
+from kubernetes1_tpu.machinery.scheme import global_scheme
+from kubernetes1_tpu.storage import Store
+
+from tests.helpers import make_node, make_tpu_pod
+
+
+def _mk_pod(name, ns="default", uid="", node="", phase=""):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    pod.metadata.uid = uid or f"uid-{name}"
+    pod.metadata.creation_timestamp = "2026-01-01T00:00:00Z"
+    pod.spec.containers = [t.Container(name="c", image="img")]
+    pod.spec.node_name = node
+    if phase:
+        pod.status.phase = phase
+    return pod
+
+
+class TestDeleteBatchEndpoint:
+    def test_per_item_outcomes_mixed(self):
+        """One delete:batch request: successes, a NotFound, a stale
+        resourceVersion precondition Conflict — each item fails alone."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(3):
+                p = t.Pod()
+                p.metadata.name = f"db-{i}"
+                p.spec.containers = [t.Container(name="c", image="i")]
+                cs.pods.create(p, "default")
+            out = cs.delete_batch("default", [
+                "db-0",
+                "ghost",
+                {"name": "db-1", "resourceVersion": "999999"},
+                {"name": "db-2", "gracePeriodSeconds": 0},
+            ])
+            assert out[0] is None
+            assert isinstance(out[1], NotFound)
+            assert isinstance(out[2], Conflict)
+            assert out[3] is None
+            left = {p.metadata.name
+                    for p in cs.pods.list(namespace="default")[0]}
+            assert left == {"db-1"}  # the Conflict item survived
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_grace_semantics_per_item(self):
+        """Bound running pods get deletionTimestamp (the kubelet
+        finalizes later); unbound/finished/grace-0 pods go immediately;
+        an already-terminating pod is a success no-op."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            reg = master.registry
+            for name, node, phase in (
+                    ("g-bound", "n1", t.POD_RUNNING),
+                    ("g-unbound", "", ""),
+                    ("g-done", "n1", t.POD_SUCCEEDED)):
+                reg.create("pods", "default",
+                           _mk_pod(name, node=node, phase=phase))
+            out = cs.delete_batch("default",
+                                  ["g-bound", "g-unbound", "g-done"])
+            assert out == [None, None, None]
+            pods = {p.metadata.name: p
+                    for p in cs.pods.list(namespace="default")[0]}
+            # only the bound running pod survives, marked terminating
+            assert set(pods) == {"g-bound"}
+            assert pods["g-bound"].metadata.deletion_timestamp
+            # second delete of a terminating pod: success no-op
+            out = cs.delete_batch("default", ["g-bound"])
+            assert out == [None]
+            # grace 0 finalizes it
+            out = cs.delete_batch("default", ["g-bound"],
+                                  grace_seconds=0)
+            assert out == [None]
+            assert cs.pods.list(namespace="default")[0] == []
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_cross_namespace_item_forbidden(self):
+        """An item naming another namespace is refused — the envelope
+        authorized only the URL namespace (the bindings:batch rule)."""
+        from kubernetes1_tpu.machinery import ApiError
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            try:
+                cs.delete_batch("default",
+                                [{"name": "x", "namespace": "other"}])
+                raise AssertionError("cross-namespace item accepted")
+            except ApiError as e:
+                assert getattr(e, "code", None) == 403
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_one_group_commit_per_batch(self):
+        """N immediate deletes in one request ride ONE store group
+        commit (delete-batch occupancy == N)."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            for i in range(6):
+                p = t.Pod()
+                p.metadata.name = f"oc-{i}"
+                p.spec.containers = [t.Container(name="c", image="i")]
+                cs.pods.create(p, "default")
+            before = master.store.delete_batches
+            out = cs.delete_batch("default",
+                                  [f"oc-{i}" for i in range(6)])
+            assert out == [None] * 6
+            assert master.store.delete_batches == before + 1
+            assert master.store.delete_batch_ops >= 6
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestDeletionWireEquivalence:
+    def test_batched_vs_singleton_frames_byte_identical(self, monkeypatch):
+        """The same deletion sequence via Registry.delete (singleton) and
+        Registry.delete_batch must produce byte-identical watch frames —
+        separate stores and schemes so the serialization cache cannot
+        mask a divergence.  Covers BOTH legs: immediate delete (DELETED
+        frame) and graceful mark (MODIFIED frame with deletionTimestamp,
+        pinned via now_iso so a second boundary can't skew the bytes)."""
+        from kubernetes1_tpu.apiserver import registry as reg_mod
+
+        monkeypatch.setattr(reg_mod, "now_iso",
+                            lambda: "2026-02-02T00:00:00Z")
+        stores = [Store(global_scheme.copy()), Store(global_scheme.copy())]
+        regs = [Registry(s, s._scheme) for s in stores]
+        watchers = [s.watch("/registry/pods/", queue_limit=0)
+                    for s in stores]
+        try:
+            for reg in regs:
+                reg.create("pods", "default", _mk_pod("imm"))
+                reg.create("pods", "default",
+                           _mk_pod("grace", node="n1",
+                                   phase=t.POD_RUNNING))
+            # singleton leg
+            regs[0].delete("pods", "default", "imm")
+            regs[0].delete("pods", "default", "grace")
+            # batched leg
+            out = regs[1].delete_batch("pods", "default", [
+                {"name": "imm"}, {"name": "grace"}])
+            assert out == [None, None]
+            frames = [[], []]
+            for i, w in enumerate(watchers):
+                while True:
+                    ev = w.next_timeout(2)
+                    if ev is None:
+                        break
+                    frames[i].append(
+                        stores[i]._scheme.watch_frame_bytes(
+                            ev.type, ev.object))
+            # 2 creates + 1 DELETED + 1 MODIFIED each, byte-identical
+            assert len(frames[0]) == 4
+            assert frames[0] == frames[1]
+        finally:
+            for w in watchers:
+                w.stop()
+            for s in stores:
+                s.close()
+
+    def test_singleton_delete_wire_unchanged(self):
+        """The singleton DELETE response body equals the watch DELETED
+        frame's object — the default wire carries no new fields."""
+        import json as _json
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            p = t.Pod()
+            p.metadata.name = "wire-0"
+            p.spec.containers = [t.Container(name="c", image="i")]
+            created = cs.pods.create(p, "default")
+            _, rv = cs.pods.list(namespace="default")
+            stream = cs.api.watch(
+                "/api/v1/namespaces/default/pods",
+                {"resourceVersion": str(rv)})
+            deleted = cs.pods.delete("wire-0", "default")
+            etype, obj = next(iter(stream))
+            stream.close()
+            assert etype == "DELETED"
+            assert _json.dumps(cs.scheme.encode(deleted), sort_keys=True) \
+                == _json.dumps(obj, sort_keys=True)
+            # the deleted object is the created one at a bumped rv
+            assert deleted.metadata.uid == created.metadata.uid
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestEndpointsCoalescing:
+    def _boot(self, window):
+        from kubernetes1_tpu.client import InformerFactory
+        from kubernetes1_tpu.controllers import EndpointsController
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        factory = InformerFactory(cs)
+        epc = EndpointsController(cs, factory, coalesce_window=window)
+        epc.setup()
+        factory.start_all()
+        factory.wait_for_sync()
+        epc.start_workers()
+        return master, cs, factory, epc
+
+    @staticmethod
+    def _mk_ready_pod(cs, name, ip):
+        pod = _mk_pod(name, node="n1", phase=t.POD_RUNNING)
+        pod.metadata.uid = ""
+        pod.metadata.labels = {"app": "churny"}
+        created = cs.pods.create(pod, "default")
+        created.status.phase = t.POD_RUNNING
+        created.status.pod_ip = ip
+        created.status.conditions = [
+            t.PodCondition(type="Ready", status="True")]
+        cs.pods.update_status(created)
+
+    def _svc(self):
+        svc = t.Service()
+        svc.metadata.name = "churny"
+        svc.metadata.namespace = "default"
+        svc.spec.selector = {"app": "churny"}
+        svc.spec.ports = [t.ServicePort(name="p", port=80)]
+        return svc
+
+    def test_coalesced_one_write_per_window_and_final_equals_uncoalesced(self):
+        """N pod churn events inside one window produce ≤ 1 Endpoints
+        write per service per window, the coalesced counter grows, and
+        the FINAL object equals what a window-0 (uncoalesced) controller
+        computes from the same state."""
+        from kubernetes1_tpu.controllers import endpoints as eps_mod
+
+        n = 8
+        window = 0.25
+        master, cs, factory, epc = self._boot(window)
+        try:
+            cs.services.create(self._svc(), "default")
+            time.sleep(0.1)
+            coalesced0 = eps_mod.endpoints_coalesced_total.value
+            # count endpoints writes as watch events on the object
+            _, rv = cs.resource("endpoints").list(namespace="default")
+            stream = cs.api.watch(
+                "/api/v1/namespaces/default/endpoints",
+                {"resourceVersion": str(rv)})
+            t0 = time.monotonic()
+            for i in range(n):
+                self._mk_ready_pod(cs, f"co-{i}", f"10.0.0.{i + 1}")
+            churn_wall = time.monotonic() - t0
+            deadline = time.monotonic() + 5 * window + 2.0
+            writes = []
+            import threading
+
+            def count():
+                for etype, _obj in stream:
+                    writes.append(etype)
+
+            th = threading.Thread(target=count, daemon=True)
+            th.start()
+            while time.monotonic() < deadline:
+                ep = None
+                try:
+                    ep = cs.resource("endpoints").get("churny", "default")
+                except NotFound:
+                    pass
+                if ep is not None and sum(
+                        len(s.addresses) for s in ep.subsets) == n:
+                    break
+                time.sleep(0.05)
+            time.sleep(2 * window)  # let the last armed flush land
+            stream.close()
+            ep = cs.resource("endpoints").get("churny", "default")
+            ips = sorted(a.ip for s in ep.subsets for a in s.addresses)
+            assert ips == sorted(f"10.0.0.{i + 1}" for i in range(n))
+            # ≤ 1 write per service per elapsed window (+1 for the
+            # window in flight when churn stopped)
+            budget = int((churn_wall + 5 * window + 2.0) / window) + 1
+            assert 1 <= len(writes) <= budget, (len(writes), budget)
+            # the 2n churn events (create + status) minus the armed
+            # flushes were absorbed
+            assert eps_mod.endpoints_coalesced_total.value > coalesced0
+            assert len(writes) < 2 * n
+        finally:
+            epc.stop()
+            factory.stop_all()
+            cs.close()
+            master.stop()
+
+    def test_window_zero_writes_immediately(self):
+        """coalesce_window=0 keeps today's behavior: a pod event flushes
+        without waiting a window (and never bumps the coalesced
+        counter)."""
+        from kubernetes1_tpu.controllers import endpoints as eps_mod
+
+        master, cs, factory, epc = self._boot(0.0)
+        try:
+            coalesced0 = eps_mod.endpoints_coalesced_total.value
+            cs.services.create(self._svc(), "default")
+            self._mk_ready_pod(cs, "z-0", "10.0.1.1")
+            deadline = time.monotonic() + 5.0
+            ep = None
+            while time.monotonic() < deadline:
+                try:
+                    ep = cs.resource("endpoints").get("churny", "default")
+                    if any(a.ip == "10.0.1.1"
+                           for s in ep.subsets for a in s.addresses):
+                        break
+                except NotFound:
+                    pass
+                time.sleep(0.02)
+            assert ep is not None
+            assert [a.ip for s in ep.subsets for a in s.addresses] \
+                == ["10.0.1.1"]
+            assert eps_mod.endpoints_coalesced_total.value == coalesced0
+        finally:
+            epc.stop()
+            factory.stop_all()
+            cs.close()
+            master.stop()
+
+    def test_propagation_lag_observed(self):
+        """Every committed write closes the oldest-unserved-event lag
+        sample — the propagation SLI the churn bench reports."""
+        from kubernetes1_tpu.controllers import endpoints as eps_mod
+
+        master, cs, factory, epc = self._boot(0.05)
+        try:
+            count0 = eps_mod.endpoints_propagation_seconds.count
+            cs.services.create(self._svc(), "default")
+            self._mk_ready_pod(cs, "lag-0", "10.0.2.1")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if eps_mod.endpoints_propagation_seconds.count > count0:
+                    break
+                time.sleep(0.02)
+            assert eps_mod.endpoints_propagation_seconds.count > count0
+        finally:
+            epc.stop()
+            factory.stop_all()
+            cs.close()
+            master.stop()
+
+
+class TestSchedulerQueueChurn:
+    def test_queue_purge_active_entry(self):
+        from kubernetes1_tpu.scheduler.queue import SchedulingQueue
+
+        q = SchedulingQueue()
+        q.add("ns/dead")
+        q.add("ns/alive")
+        assert q.purge("ns/dead") is True
+        assert q.purge("ns/dead") is False  # already gone
+        assert q.pop(timeout=0.1) == "ns/alive"
+        assert q.pop(timeout=0.05) is None  # purged slot never pops
+        assert len(q) == 0
+        q.shut_down()
+
+    def test_queue_purge_cancels_backoff_timer(self):
+        from kubernetes1_tpu.scheduler.queue import SchedulingQueue
+
+        q = SchedulingQueue(base_backoff=0.05, max_backoff=0.05)
+        q.add_backoff("ns/backing-off")
+        assert q.depth() == 1
+        assert q.purge("ns/backing-off") is True
+        time.sleep(0.15)  # past the timer: the re-add must not happen
+        assert q.pop(timeout=0.05) is None
+        assert q.depth() == 0
+        q.shut_down()
+
+    def test_scheduler_purges_deleted_pending_pod(self):
+        """A pod deleted while Pending leaves the queue, the backoff
+        counters, and the bind-fail counters — counted once in
+        scheduler_queue_churn_purges_total."""
+        from kubernetes1_tpu.scheduler import Scheduler
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        sched = Scheduler(cs)  # NOT started: handlers driven directly
+        try:
+            pod = make_tpu_pod("churn-pending", tpus=1)
+            pod.metadata.uid = "uid-churn-pending"
+            sched._on_pod_add(pod)
+            sched._bind_fail_counts[pod.key()] = 3
+            assert len(sched.queue) == 1
+            sched._on_pod_delete(pod)
+            assert sched.queue_churn_purges == 1
+            assert len(sched.queue) == 0
+            assert pod.key() not in sched._bind_fail_counts
+            # idempotent: a duplicate DELETED event purges nothing new
+            sched._on_pod_delete(pod)
+            assert sched.queue_churn_purges == 1
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestDeviceClaimChurnHygiene:
+    def test_claims_release_across_batch_delete_recreate_cycle(self):
+        """create→bind→delete:batch→recreate on the SAME chips: the
+        claim index must release each generation promptly (exact-equality
+        against bound pods, no lazy-staleness round-trips needed) and the
+        next generation's bind on the same chips must succeed."""
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.nodes.create(make_node("claim-n1", tpus=4))
+            reg = master.registry
+            for gen in range(3):
+                name = f"claim-pod-g{gen}"
+                cs.pods.create(make_tpu_pod(name, tpus=2))
+                binding = t.Binding(
+                    target_node="claim-n1",
+                    extended_resource_assignments={
+                        f"{name}-tpu": ["slice-0-h0-tpu0",
+                                        "slice-0-h0-tpu1"]})
+                binding.metadata.name = name
+                binding.metadata.namespace = "default"
+                # same two chips every generation: a leaked claim from
+                # the previous generation would Conflict here
+                cs.bind("default", name, binding)
+                with reg._claims_lock:
+                    held = set(reg._device_claims)
+                assert held == {("claim-n1", "google.com/tpu",
+                                 "slice-0-h0-tpu0"),
+                                ("claim-n1", "google.com/tpu",
+                                 "slice-0-h0-tpu1")}
+                out = cs.delete_batch("default", [name], grace_seconds=0)
+                assert out == [None]
+                with reg._claims_lock:
+                    assert not reg._device_claims, \
+                        f"claims leaked after gen {gen} batch delete"
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_singleton_delete_releases_claims_eagerly(self):
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.nodes.create(make_node("claim-n2", tpus=2))
+            cs.pods.create(make_tpu_pod("claim-s", tpus=1))
+            binding = t.Binding(
+                target_node="claim-n2",
+                extended_resource_assignments={
+                    "claim-s-tpu": ["slice-0-h0-tpu0"]})
+            binding.metadata.name = "claim-s"
+            binding.metadata.namespace = "default"
+            cs.bind("default", "claim-s", binding)
+            reg = master.registry
+            with reg._claims_lock:
+                assert reg._device_claims
+            cs.pods.delete("claim-s", "default", grace_seconds=0)
+            with reg._claims_lock:
+                assert not reg._device_claims
+        finally:
+            cs.close()
+            master.stop()
+
+    def test_cache_refcounts_release_across_cycles(self):
+        """Scheduler-cache chip refcounts across repeated
+        add→assume→delete cycles on the same chips: availability must
+        return to full every generation (the PR 9 refcount + PR 12
+        stored-pod-release rules under churn)."""
+        from kubernetes1_tpu.scheduler.cache import SchedulerCache
+
+        cache = SchedulerCache()
+        cache.update_node(make_node("cy-n1", tpus=2))
+        for gen in range(3):
+            pod = make_tpu_pod(f"cy-{gen}", tpus=2)
+            pod.metadata.uid = f"uid-cy-{gen}"
+            assumed = pod.clone()
+            assumed.spec.node_name = "cy-n1"
+            assumed.spec.extended_resources[0].assigned = [
+                "slice-0-h0-tpu0", "slice-0-h0-tpu1"]
+            cache.assume_pod(assumed, "cy-n1")
+            ni = cache.snapshot()["cy-n1"]
+            assert ni.extended[
+                "google.com/tpu"].available_count() == 0
+            # DELETED arrives (bound version): everything releases
+            cache.remove_pod(assumed)
+            ni = cache.snapshot()["cy-n1"]
+            assert ni.extended[
+                "google.com/tpu"].available_count() == 2, \
+                f"chips leaked in cache after gen {gen}"
+
+
+class TestRLActorWorkload:
+    def test_rollout_and_learner_loop(self):
+        """The actor/learner pairing end to end over HTTP: rollouts
+        stream, the learner folds them into policy updates."""
+        from kubernetes1_tpu.workloads.rl_actor import Learner, run_actor
+
+        learner = Learner(port=0).start()
+        try:
+            out = run_actor(learner.url, lifetime_s=0.4,
+                            steps_per_batch=32, interval_s=0.01)
+            assert out["batches_sent"] > 0
+            assert out["errors"] == 0
+            stats = learner.stats()
+            assert stats["batches"] == out["batches_sent"]
+            assert stats["frames"] == out["frames"]
+            assert stats["updates"] > 0
+        finally:
+            learner.stop()
+
+    def test_reinforce_moves_toward_better_arms(self):
+        """Sanity on the math: after enough batches the policy weights
+        must rank the best arm above the worst (rewards are monotone in
+        arm index by construction)."""
+        import numpy as np
+
+        from kubernetes1_tpu.workloads.rl_actor import (
+            reinforce_update, rollout)
+
+        w = np.zeros(8)
+        for i in range(60):
+            batch = rollout(w, steps=64, seed=i)
+            w, _ = reinforce_update(w, batch)
+        assert w[7] > w[0]
+
+    def test_spec_builders_validate(self):
+        """The builder objects pass the apiserver's strategies."""
+        from kubernetes1_tpu.workloads.rl_actor import (
+            actor_pod, fleet_service, learner_job)
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            cs.pods.create(actor_pod(0, tpus=1, learner_addr="http://x:1"))
+            cs.jobs.create(learner_job(workers=2))
+            cs.services.create(fleet_service("rl-actors"), "default")
+            assert cs.pods.get("actor-0-g0", "default") is not None
+        finally:
+            cs.close()
+            master.stop()
+
+
+class TestChurnMetricsSurface:
+    def test_delete_and_endpoints_metrics_rendered(self):
+        import urllib.request
+
+        master = Master().start()
+        try:
+            with urllib.request.urlopen(master.url + "/metrics",
+                                        timeout=5) as r:
+                body = r.read().decode()
+            for name in ("ktpu_store_delete_batch_occupancy",
+                         "ktpu_store_delete_batch_ops_total",
+                         "ktpu_endpoints_writes_total",
+                         "ktpu_endpoints_coalesced_total",
+                         "ktpu_endpoints_propagation_seconds"):
+                assert name in body, f"{name} missing from /metrics"
+        finally:
+            master.stop()
+
+    def test_scheduler_purge_counter_registered(self):
+        from kubernetes1_tpu.scheduler import Scheduler
+
+        master = Master().start()
+        cs = Clientset(master.url)
+        try:
+            sched = Scheduler(cs)
+            assert "scheduler_queue_churn_purges_total" \
+                in sched.metrics.render()
+        finally:
+            cs.close()
+            master.stop()
