@@ -40,6 +40,7 @@ _REGISTRY = [
     (t.APIService, "apiservices", False),
     (t.PodMetrics, "podmetrics", True),
     (t.NodeMetrics, "nodemetrics", False),
+    (t.PodCustomMetrics, "podcustommetrics", True),
     (t.PodSecurityPolicy, "podsecuritypolicies", False),
     (t.Role, "roles", True),
     (t.ClusterRole, "clusterroles", False),

@@ -1029,6 +1029,35 @@ class CrossVersionObjectReference:
 
 
 @dataclass
+class ResourceMetricSource:
+    """autoscaling/v2 Resource metric: utilization of a container-requested
+    resource (PodMetrics ÷ requests), percent."""
+
+    name: str = "cpu"
+    target_average_utilization: Optional[int] = None
+
+
+@dataclass
+class PodsMetricSource:
+    """autoscaling/v2 Pods metric: a named sample scraped off each pod's
+    /metrics endpoint (PodCustomMetrics), averaged across the target's
+    pods and compared against `target_average_value`."""
+
+    metric_name: str = ""
+    target_average_value: float = 0.0
+
+
+@dataclass
+class MetricSpec:
+    """One scaling signal (ref: autoscaling/v2 MetricSpec).  The HPA
+    computes a desired replica count per entry and takes the MAX."""
+
+    type: str = ""  # Resource | Pods
+    resource: Optional[ResourceMetricSource] = None
+    pods: Optional[PodsMetricSource] = None
+
+
+@dataclass
 class HorizontalPodAutoscalerSpec:
     scale_target_ref: CrossVersionObjectReference = field(
         default_factory=CrossVersionObjectReference
@@ -1036,6 +1065,16 @@ class HorizontalPodAutoscalerSpec:
     min_replicas: int = 1
     max_replicas: int = 1
     target_cpu_utilization_percentage: Optional[int] = None
+    # v2-style metric specs; when non-empty they are the scaling signals
+    # (target_cpu_utilization_percentage above is the v1 shorthand and
+    # keeps working unchanged when `metrics` is empty)
+    metrics: List[MetricSpec] = field(default_factory=list)
+    # behavior stabilization windows (ref: autoscaling/v2
+    # HPAScalingRules.stabilizationWindowSeconds): a scale-up takes the
+    # MIN recommendation of the up-window, a scale-down the MAX of the
+    # down-window — 0 (default) reacts instantly, exactly the v1 behavior
+    scale_up_stabilization_seconds: float = 0.0
+    scale_down_stabilization_seconds: float = 0.0
 
 
 @dataclass
@@ -1045,6 +1084,9 @@ class HorizontalPodAutoscalerStatus:
     current_replicas: int = 0
     desired_replicas: int = 0
     current_cpu_utilization_percentage: Optional[int] = None
+    # observed per-metric averages last cycle (metric name -> value);
+    # free-form map — metric names are workload-defined
+    current_metric_values: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -1372,6 +1414,35 @@ class NodeMetrics(KObject):
     API_VERSION = "metrics.k8s.io/v1"
     timestamp: str = ""
     usage: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class MetricSample:
+    """One named sample scraped off a pod /metrics endpoint.  `labels`
+    carries the sample's own label set (a labeled child series); HPA
+    Pods-metric matching is by bare `name`."""
+
+    name: str = ""
+    value: float = 0.0
+    type: str = ""  # counter | gauge | rate (scrape-derived counter rate)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PodCustomMetrics(KObject):
+    """Workload SLIs scraped off an annotated pod's /metrics endpoint by
+    its node's kubelet (the custom.metrics.k8s.io pipeline collapsed into
+    one hop, exactly like PodMetrics above).  `stale=True` means the last
+    scrape failed and `samples` is the LAST-GOOD snapshot — consumers
+    (the HPA) must treat stale samples as missing, never as fresh truth.
+    The kubelet copies the pod's labels onto this object so selector
+    reads work on the metrics collection directly."""
+
+    KIND = "PodCustomMetrics"
+    API_VERSION = "custom.metrics.k8s.io/v1"
+    timestamp: str = ""
+    stale: bool = False
+    samples: List[MetricSample] = field(default_factory=list)
 
 
 @dataclass

@@ -516,11 +516,19 @@ class NodeAuthorizer:
             return verb in ("get", "create", "update", "patch")
         if resource == "certificatesigningrequests":
             return verb in ("get", "create")
-        if resource in ("pods", "podmetrics"):
+        if resource in ("pods", "podmetrics", "podcustommetrics"):
             if verb not in ("update", "patch", "create", "delete"):
                 return False
-            if verb == "create" and resource == "podmetrics":
+            if verb == "create" and resource in ("podmetrics",
+                                                 "podcustommetrics"):
                 return True
+            if resource == "podcustommetrics":
+                # the scrape agent updates/GCs metrics objects NAMED
+                # after its own pods (publish rides create/update, a
+                # vanished pod's object is deleted) — ownership follows
+                # the pod of the same name on this node
+                pod = self._get_pod(namespace, name)
+                return pod is None or pod.spec.node_name == node_name
             pod = self._get_pod(namespace, name)
             # mirror pods (static manifests) are created by the node itself
             if pod is None:

@@ -28,6 +28,7 @@ from ..utils import fasthttp, flightrec, locksan, spans as spanlib
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
+from ..obs import appmetrics
 from ..machinery import (
     ApiError,
     BadRequest,
@@ -807,6 +808,17 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------------ GET
 
     def _do_get(self, resource, ns, name, sub, q):
+        if (resource == "pods" and sub
+                and getattr(self, "_req_version", "")
+                == t.PodCustomMetrics.API_VERSION):
+            # aggregated custom-metrics read path (the custom.metrics.
+            # k8s.io GET shape): /apis/custom.metrics.k8s.io/v1/
+            # namespaces/<ns>/pods/<name-or-*>/<metric> answers a
+            # MetricValueList off the PodCustomMetrics collection the
+            # kubelets publish.  Authorized upstream as `get pods`
+            # subresource <metric> — the generic path already ran it.
+            self._serve_custom_metrics(ns, name, sub, q)
+            return
         if name and not sub:
             self._get_object(resource, ns, name)
             return
@@ -957,6 +969,68 @@ class _Handler(BaseHTTPRequestHandler):
                 raise TooOldResourceVersion(
                     f"continue token revision {p} compacted "
                     f"(floor {floor}); restart the list")
+
+    # ------------------------------------------ custom-metrics read path
+
+    def _serve_custom_metrics(self, ns, name, metric, q):
+        """GET /apis/custom.metrics.k8s.io/v1/namespaces/<ns>/pods/
+        <name-or-*>/<metric> — the aggregated custom-metrics API shape:
+        one MetricValueList row per pod whose PodCustomMetrics carries
+        the named sample.  ``labelSelector`` selects over the metrics
+        objects' labels (the kubelet copies the pod's labels onto them,
+        so selecting the metrics collection IS selecting the pods).
+        Stale rows (the owning kubelet's last scrape failed) are
+        FORWARDED with ``stale: true``, never silently dropped —
+        holding-vs-discarding a stale signal is the consumer's policy
+        decision (the HPA holds)."""
+        master = self.master
+        reg = master.registry
+        scheme = master.scheme
+        if not ns:
+            raise BadRequest("custom metrics are namespaced: "
+                             "/namespaces/<ns>/pods/<name>/<metric>")
+        label_selector = q.get("labelSelector", "")
+        try:
+            entries, rev, match = reg.select_entries(
+                master.cacher, "podcustommetrics", ns,
+                label_selector=label_selector)
+        except CacheNotReady:
+            entries, rev, match = reg.select_entries(
+                master.store, "podcustommetrics", ns,
+                label_selector=label_selector)
+        items = []
+        for _k, _r, d in entries:
+            if match is not None and not match(d):
+                continue
+            if name and name != "*" \
+                    and d.get("metadata", {}).get("name") != name:
+                continue  # filter on the raw dict — don't decode 5000
+                # namespace objects to answer a single-pod query
+            pcm = scheme.decode(d)
+            value = appmetrics.sample_value(pcm, metric)
+            if value is None:
+                continue
+            items.append({
+                "describedObject": {
+                    "kind": "Pod",
+                    "namespace": pcm.metadata.namespace,
+                    "name": pcm.metadata.name,
+                },
+                "metricName": metric,
+                "value": value,
+                "timestamp": pcm.timestamp,
+                "stale": pcm.stale,
+            })
+        if name and name != "*" and not items:
+            raise NotFound(
+                f'no sample {metric!r} for pod "{ns}/{name}" '
+                f"(not scraped, or the metric is not exported)")
+        self._send_json(200, {
+            "kind": "MetricValueList",
+            "apiVersion": t.PodCustomMetrics.API_VERSION,
+            "metadata": {"resourceVersion": str(rev)},
+            "items": items,
+        })
 
     # --------------------------------------- kubelet proxy (exec/logs/etc.)
 
@@ -1525,6 +1599,25 @@ class _Handler(BaseHTTPRequestHandler):
             extra.append(
                 _eps_ctrl.endpoints_propagation_seconds
                 .render().rstrip("\n"))
+            # autoscaling loop surface (module-level in controllers/
+            # podautoscaler.py, same contract): observed metric values,
+            # desired vs current replicas, rescales, and the
+            # out-of-band -> rescale-landed reaction-time SLI
+            from ..controllers import podautoscaler as _hpa_ctrl
+
+            extra.append(
+                _hpa_ctrl.hpa_observed_value.render().rstrip("\n"))
+            extra.append(
+                _hpa_ctrl.hpa_desired_replicas.render().rstrip("\n"))
+            extra.append(
+                _hpa_ctrl.hpa_current_replicas.render().rstrip("\n"))
+            extra.append(
+                _hpa_ctrl.hpa_rescales_total.render().rstrip("\n"))
+            extra.append(
+                _hpa_ctrl.hpa_missing_metric_cycles_total
+                .render().rstrip("\n"))
+            extra.append(
+                _hpa_ctrl.hpa_reaction_seconds.render().rstrip("\n"))
         # write-path economics (in-process store only; a remote store
         # exports these from its own process): group-commit occupancy and
         # the fan-out coalescing ratio — wakeups-per-event < 1.0 means

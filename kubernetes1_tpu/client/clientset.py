@@ -29,6 +29,7 @@ _GROUP_PATH = {
     "apiservices": "/apis/apiregistration/v1",
     "podmetrics": "/apis/metrics.k8s.io/v1",
     "nodemetrics": "/apis/metrics.k8s.io/v1",
+    "podcustommetrics": "/apis/custom.metrics.k8s.io/v1",
     "roles": "/apis/rbac/v1",
     "clusterroles": "/apis/rbac/v1",
     "rolebindings": "/apis/rbac/v1",
@@ -402,6 +403,10 @@ class Clientset:
     @property
     def nodemetrics(self) -> ResourceClient:
         return self.resource("nodemetrics")
+
+    @property
+    def podcustommetrics(self) -> ResourceClient:
+        return self.resource("podcustommetrics")
 
     def bind(self, namespace: str, pod_name: str, binding: t.Binding):
         """POST the binding subresource.  Returns the server's Status dict

@@ -72,6 +72,14 @@ def main():
         reg.register(cm.node_lifecycle.evictions_total)
         reg.register(cm.node_lifecycle.errors_total)
         reg.register(cm.node_lifecycle.not_ready_total)
+        from . import podautoscaler as _hpa
+
+        reg.register(_hpa.hpa_observed_value)
+        reg.register(_hpa.hpa_desired_replicas)
+        reg.register(_hpa.hpa_current_replicas)
+        reg.register(_hpa.hpa_rescales_total)
+        reg.register(_hpa.hpa_missing_metric_cycles_total)
+        reg.register(_hpa.hpa_reaction_seconds)
         # process-entrypoint registration (see scheduler/__main__): a
         # controller-manager PROCESS exports the informer/retry families
         # its control loops bump; in-process deployments leave this to

@@ -1,24 +1,80 @@
 """Horizontal pod autoscaler (ref: pkg/controller/podautoscaler/
-horizontal.go): periodically compares observed CPU utilization (PodMetrics ÷
-container requests) against the HPA target and rescales the target workload.
+horizontal.go): periodically compares observed metrics against the HPA's
+targets and rescales the target workload.
 
-desiredReplicas = ceil(currentReplicas * currentUtilization / targetUtilization)
-with a tolerance band (±10%) to prevent thrashing, clamped to
-[minReplicas, maxReplicas] (the reference's computeReplicasForCPUUtilization)."""
+Per metric spec:   desired_m = ceil(current * observed / target)
+                   (inside a ±10% tolerance band: desired_m = current)
+Across metrics:    desired = max(desired_m)    (autoscaling/v2 rule — any
+                   one saturated signal is enough to need the replicas)
+then clamped to [minReplicas, maxReplicas] and run through the behavior
+stabilization windows (scale-up takes the MIN recommendation of its
+window, scale-down the MAX of its — v2 HPAScalingRules shape; window 0 =
+instant, the v1 behavior).
+
+Metric sources:
+
+- Resource/cpu (and the v1 ``targetCPUUtilizationPercentage`` shorthand):
+  PodMetrics ÷ container requests, percent — consumed from an INFORMER
+  snapshot, never one live GET per pod per 2s cycle;
+- Pods: a named sample scraped off each pod's /metrics endpoint
+  (PodCustomMetrics, the kubelet scrape pipeline), averaged across the
+  target's pods against ``targetAverageValue``.  Samples marked STALE
+  (the owning kubelet's scrape is failing) count as missing.
+
+Missing metrics skip the cycle (the reference's rule): with no usable
+signal the HPA HOLDS the current scale — a scrape outage must read as
+"no new information", never as "load went to zero".
+"""
 
 from __future__ import annotations
 
 import math
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client.retry import retry_on_conflict
 from ..machinery import ApiError, NotFound, now_iso
 from ..machinery.labels import label_selector_matches
+from ..obs.appmetrics import sample_value
+from ..utils import flightrec, locksan
+from ..utils.logutil import RateLimitedReporter
+from ..utils.metrics import Counter, Gauge, Histogram
 from ..utils.quantity import parse_quantity
 from .base import Controller
 
 TOLERANCE = 0.1
 SYNC_PERIOD = 2.0  # the reference uses 30s; scaled for in-process clusters
+
+# Module-level metric families (the retries_total contract): every HPA
+# instance in a process shares them; rendered by the apiserver's
+# render_client_metrics gate and the controllers __main__ registry, so a
+# fleet merge sees the whole scaling loop exactly once per process.
+hpa_observed_value = Gauge(
+    "ktpu_hpa_observed_value",
+    "last observed average per (hpa, metric) — cpu in percent, Pods "
+    "metrics in the sample's own unit")
+hpa_desired_replicas = Gauge(
+    "ktpu_hpa_desired_replicas", "last desired replica count per hpa")
+hpa_current_replicas = Gauge(
+    "ktpu_hpa_current_replicas", "target's current replica count per hpa")
+hpa_rescales_total = Counter(
+    "ktpu_hpa_rescales_total", "rescales issued, by direction")
+hpa_missing_metric_cycles_total = Counter(
+    "ktpu_hpa_missing_metric_cycles_total",
+    "cycles skipped because no metric produced a usable value")
+hpa_reaction_seconds = Histogram(
+    "ktpu_hpa_reaction_seconds",
+    "first out-of-tolerance observation -> rescale write landed")
+
+
+def rescales_snapshot() -> float:
+    """Total rescales across directions (bench/chaos delta helper — the
+    family's value lives on labeled children)."""
+    return sum(c.value for c in
+               hpa_rescales_total._children_snapshot()) \
+        + hpa_rescales_total.value
 
 
 class HorizontalPodAutoscalerController(Controller):
@@ -27,12 +83,54 @@ class HorizontalPodAutoscalerController(Controller):
     def setup(self):
         self.hpas = self.factory.informer("horizontalpodautoscalers")
         self.pods = self.factory.informer("pods")
+        # metric pipelines ride informers too: one watch each, zero API
+        # round-trips per sync cycle (the old shape issued one live
+        # podmetrics GET per pod per 2s cycle — N×RTT of pure overhead).
+        # LAZY: the PodMetrics collection churns with every kubelet
+        # heartbeat (one object per pod), so a controller manager with
+        # ZERO HPAs must not subscribe to that fan-out — the informers
+        # spin up on the first reconcile that needs them.
+        self._podmetrics = None
+        self._podcustommetrics = None
+        self._metric_inf_lock = locksan.make_lock(
+            "podautoscaler.metric_informers")
         self.hpas.add_handler(
             on_add=self._schedule, on_update=lambda _o, n: self._schedule(n)
         )
+        # per-HPA recommendation history (behavior stabilization windows)
+        # and the first-out-of-band stamp feeding the reaction-time SLI
+        self._recommendations: Dict[str, Deque[Tuple[float, int]]] = {}
+        self._out_of_band_since: Dict[str, float] = {}
+        self._status_err_reporter = RateLimitedReporter(
+            self.name, window=30.0)
 
     def _schedule(self, hpa):
         self.enqueue(hpa)
+
+    def _lazy_informer(self, attr: str, resource: str):
+        inf = getattr(self, attr)
+        if inf is not None:
+            inf.wait_for_sync(10.0)  # instant once synced
+            return inf
+        with self._metric_inf_lock:
+            inf = getattr(self, attr)
+            if inf is None:
+                inf = self.factory.informer(resource)
+                # created after the factory's start_all (first HPA seen
+                # mid-run): start it here — SharedInformer.start is
+                # guarded, and this lock serializes racing workers
+                inf.start()
+                setattr(self, attr, inf)
+        inf.wait_for_sync(10.0)
+        return inf
+
+    @property
+    def podmetrics(self):
+        return self._lazy_informer("_podmetrics", "podmetrics")
+
+    @property
+    def podcustommetrics(self):
+        return self._lazy_informer("_podcustommetrics", "podcustommetrics")
 
     def _target_client(self, kind: str):
         return {
@@ -44,6 +142,13 @@ class HorizontalPodAutoscalerController(Controller):
     def sync(self, key: str):
         hpa = self.hpas.get(key)
         if hpa is None:
+            self._recommendations.pop(key, None)
+            self._out_of_band_since.pop(key, None)
+            # the deleted HPA's labeled gauge children must not render
+            # (or feed the fleet scaling view) forever
+            for fam in (hpa_observed_value, hpa_desired_replicas,
+                        hpa_current_replicas):
+                fam.remove_labels(hpa=key)
             return
         try:
             self._reconcile(hpa)
@@ -51,11 +156,48 @@ class HorizontalPodAutoscalerController(Controller):
             # periodic resync regardless of outcome (metrics move on their own)
             self.enqueue_after(key, SYNC_PERIOD)
 
+    # ----------------------------------------------------------- evaluation
+
+    def _metric_specs(self, hpa: t.HorizontalPodAutoscaler,
+                      ) -> List[t.MetricSpec]:
+        """spec.metrics, or the v1 CPU shorthand lifted into one Resource
+        entry — one evaluation path for both API shapes."""
+        if hpa.spec.metrics:
+            return hpa.spec.metrics
+        if hpa.spec.target_cpu_utilization_percentage:
+            return [t.MetricSpec(type="Resource", resource=t.ResourceMetricSource(
+                name="cpu",
+                target_average_utilization=hpa.spec.target_cpu_utilization_percentage,
+            ))]
+        return []
+
+    def _evaluate(self, hpa, pods) -> List[Tuple[str, float, float]]:
+        """[(metric name, observed average, observed/target ratio)] —
+        one entry per metric spec that produced a value this cycle."""
+        out = []
+        for ms in self._metric_specs(hpa):
+            if ms.type == "Resource" and ms.resource is not None \
+                    and ms.resource.name == "cpu" \
+                    and ms.resource.target_average_utilization:
+                util = self._cpu_utilization(pods)
+                if util is not None:
+                    out.append(("cpu", util, util / float(
+                        ms.resource.target_average_utilization)))
+            elif ms.type == "Pods" and ms.pods is not None \
+                    and ms.pods.metric_name \
+                    and ms.pods.target_average_value > 0:
+                avg = self._pods_metric(pods, ms.pods.metric_name)
+                if avg is not None:
+                    out.append((ms.pods.metric_name, avg,
+                                avg / ms.pods.target_average_value))
+        return out
+
     def _reconcile(self, hpa: t.HorizontalPodAutoscaler):
         client = self._target_client(hpa.spec.scale_target_ref.kind)
         if client is None:
             return
         ns = hpa.metadata.namespace
+        key = hpa.key()
         try:
             target = client.get(hpa.spec.scale_target_ref.name, ns)
         except NotFound:
@@ -72,14 +214,60 @@ class HorizontalPodAutoscalerController(Controller):
             and selector is not None
             and label_selector_matches(selector, p.metadata.labels)
         ]
-        utilization = self._cpu_utilization(pods)
+        specs = self._metric_specs(hpa)
+        evaluations = self._evaluate(hpa, pods)
+        some_missing = bool(specs) and len(evaluations) < len(specs)
+        held_for_missing = False
+        if specs and not evaluations:
+            # missing-metrics-skips-cycle: no usable signal this round —
+            # hold the current scale (a scraping outage is not zero
+            # load).  The hold still runs the [min,max] clamp and the
+            # status write (the seed's v1 behavior, byte-identical) but
+            # skips the stabilization/reaction bookkeeping below: a
+            # blip's `current` sample in the up-window would suppress a
+            # pending scale-up for the whole window, and popping the
+            # reaction stamp would make the SLI measure from the last
+            # blip instead of the first out-of-tolerance observation.
+            hpa_missing_metric_cycles_total.inc()
+            held_for_missing = True
         desired = current
-        tgt = hpa.spec.target_cpu_utilization_percentage
-        if tgt and utilization is not None:
-            ratio = utilization / float(tgt)
-            if abs(ratio - 1.0) > TOLERANCE:
-                desired = int(math.ceil(current * ratio))
-        desired = max(hpa.spec.min_replicas or 1, min(hpa.spec.max_replicas, desired))
+        if evaluations:
+            # max-of-metrics, tolerance applied per metric (v2 rule)
+            per_metric = []
+            for _name, _avg, ratio in evaluations:
+                if abs(ratio - 1.0) > TOLERANCE:
+                    per_metric.append(int(math.ceil(current * ratio)))
+                else:
+                    per_metric.append(current)
+            desired = max(per_metric)
+            if some_missing and desired < current:
+                # a PARTIAL outage blocks scale-down (the reference's
+                # rule): the missing metric might be the saturated one —
+                # max-of-metrics means its vote can only RAISE desired,
+                # so acting on the readable subset is safe upward but a
+                # drain on stale information downward
+                hpa_missing_metric_cycles_total.inc()
+                desired = current
+                held_for_missing = True
+        desired = max(hpa.spec.min_replicas or 1,
+                      min(hpa.spec.max_replicas, desired))
+        if not held_for_missing:
+            # arm the reaction stamp on the PRE-stabilization want: the
+            # SLI is "first out-of-tolerance observation -> rescale
+            # landed", and a stabilization window holding the
+            # recommendation is exactly the reaction time the histogram
+            # must capture, not elide.  A missing-metric hold skips the
+            # bookkeeping like the total-outage skip above.
+            self._note_reaction_window(key, desired, current)
+            desired = self._stabilize(hpa, key, desired, current)
+
+        utilization = None
+        for name, avg, _ratio in evaluations:
+            hpa_observed_value.labels(hpa=key, metric=name).set(avg)
+            if name == "cpu":
+                utilization = avg
+        hpa_current_replicas.labels(hpa=key).set(current)
+        hpa_desired_replicas.labels(hpa=key).set(desired)
 
         if desired != current:
             def rescale():
@@ -89,20 +277,69 @@ class HorizontalPodAutoscalerController(Controller):
 
             try:
                 retry_on_conflict(rescale)
-                self.recorder.event(
-                    hpa, "Normal", "SuccessfulRescale",
-                    f"scaled {hpa.spec.scale_target_ref.kind.lower()}"
-                    f"/{hpa.spec.scale_target_ref.name} from {current} to {desired}",
-                )
             except ApiError:
                 return
-        self._update_status(hpa, current, desired, utilization)
+            direction = "up" if desired > current else "down"
+            hpa_rescales_total.labels(direction=direction).inc()
+            flightrec.note("hpa", flightrec.HPA_RESCALE, hpa=key,
+                           target=f"{hpa.spec.scale_target_ref.kind}"
+                                  f"/{hpa.spec.scale_target_ref.name}",
+                           from_replicas=current, to_replicas=desired,
+                           direction=direction)
+            since = self._out_of_band_since.pop(key, None)
+            if since is not None:
+                hpa_reaction_seconds.observe(time.monotonic() - since)
+            self.recorder.event(
+                hpa, "Normal", "SuccessfulRescale",
+                f"scaled {hpa.spec.scale_target_ref.kind.lower()}"
+                f"/{hpa.spec.scale_target_ref.name} from {current} to {desired}",
+            )
+        self._update_status(hpa, current, desired, utilization, evaluations)
+
+    # --------------------------------------------------------- stabilization
+
+    def _stabilize(self, hpa, key: str, recommendation: int,
+                   current: int) -> int:
+        """Behavior stabilization (ref: v2 stabilizationWindowSeconds):
+        a scale-up acts on the MIN recommendation of the up-window (one
+        spike must not add replicas), a scale-down on the MAX of the
+        down-window (replicas drain only after the need has been gone
+        for the whole window).  Windows of 0 pass through untouched."""
+        up_w = hpa.spec.scale_up_stabilization_seconds or 0.0
+        down_w = hpa.spec.scale_down_stabilization_seconds or 0.0
+        now = time.monotonic()
+        dq = self._recommendations.setdefault(key, deque())
+        dq.append((now, recommendation))
+        horizon = now - max(up_w, down_w, SYNC_PERIOD)
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+        if recommendation > current and up_w > 0:
+            floor = now - up_w
+            stabilized = min(r for ts, r in dq if ts >= floor)
+            return max(stabilized, current)
+        if recommendation < current and down_w > 0:
+            floor = now - down_w
+            stabilized = max(r for ts, r in dq if ts >= floor)
+            return min(stabilized, current)
+        return recommendation
+
+    def _note_reaction_window(self, key: str, desired: int, current: int):
+        """Arm the reaction-time stamp the first cycle a rescale becomes
+        wanted; disarm when the want goes away without a rescale."""
+        if desired != current:
+            self._out_of_band_since.setdefault(key, time.monotonic())
+        else:
+            self._out_of_band_since.pop(key, None)
+
+    # --------------------------------------------------------- metric reads
 
     def _cpu_utilization(self, pods):
-        """Mean of (usage / request) across pods, percent; None if no pod has
-        both a request and a metrics sample (the reference treats missing
-        metrics as 'skip this cycle')."""
+        """Mean of (usage / request) across pods, percent; None if no pod
+        has both a request and a metrics sample (the reference treats
+        missing metrics as 'skip this cycle').  PodMetrics come from the
+        informer snapshot — zero API round-trips per cycle."""
         ratios = []
+        inf = self.podmetrics  # one sync wait per cycle, not per pod
         for p in pods:
             requests = {
                 c.name: parse_quantity(c.resources.requests.get("cpu"))
@@ -110,9 +347,8 @@ class HorizontalPodAutoscalerController(Controller):
             }
             if not any(requests.values()):
                 continue
-            try:
-                pm = self.cs.podmetrics.get(p.metadata.name, p.metadata.namespace)
-            except ApiError:
+            pm = inf.get(p.key())
+            if pm is None:
                 continue
             usage = sum(parse_quantity(c.usage.get("cpu")) for c in pm.containers)
             request = sum(requests.values())
@@ -122,29 +358,66 @@ class HorizontalPodAutoscalerController(Controller):
             return None
         return sum(ratios) / len(ratios)
 
-    def _update_status(self, hpa, current, desired, utilization):
-        try:
-            fresh = self.cs.horizontalpodautoscalers.get(
-                hpa.metadata.name, hpa.metadata.namespace
-            )
-        except NotFound:
-            return
-        st = fresh.status
-        util = int(round(utilization)) if utilization is not None else st.current_cpu_utilization_percentage
-        if (
-            st.current_replicas == current
-            and st.desired_replicas == desired
-            and st.current_cpu_utilization_percentage == util
-            and st.observed_generation == fresh.metadata.generation
-        ):
-            return  # unchanged — writing anyway would re-trigger our own informer
-        st.current_replicas = current
-        st.desired_replicas = desired
-        st.current_cpu_utilization_percentage = util
-        if desired != current:
-            st.last_scale_time = now_iso()
-        st.observed_generation = fresh.metadata.generation
-        try:
+    def _pods_metric(self, pods, metric_name: str) -> Optional[float]:
+        """Average of a scraped sample across the target's pods; stale
+        PodCustomMetrics (owning kubelet's scrape failing) and pods
+        without the sample are missing, not zero.  None when NO pod has
+        a fresh sample — the skip-cycle signal."""
+        values = []
+        inf = self.podcustommetrics  # one sync wait per cycle, not per pod
+        for p in pods:
+            pcm = inf.get(p.key())
+            if pcm is None or pcm.stale:
+                continue
+            v = sample_value(pcm, metric_name)
+            if v is not None:
+                values.append(v)
+        if not values:
+            return None
+        return sum(values) / len(values)
+
+    # --------------------------------------------------------------- status
+
+    def _update_status(self, hpa, current, desired, utilization,
+                       evaluations):
+        def attempt():
+            try:
+                fresh = self.cs.horizontalpodautoscalers.get(
+                    hpa.metadata.name, hpa.metadata.namespace
+                )
+            except NotFound:
+                return
+            st = fresh.status
+            util = int(round(utilization)) if utilization is not None \
+                else st.current_cpu_utilization_percentage
+            metric_values = {name: round(avg, 4)
+                             for name, avg, _r in evaluations
+                             if name != "cpu"}
+            if (
+                st.current_replicas == current
+                and st.desired_replicas == desired
+                and st.current_cpu_utilization_percentage == util
+                and st.current_metric_values == metric_values
+                and st.observed_generation == fresh.metadata.generation
+            ):
+                return  # unchanged — writing anyway would re-trigger our own informer
+            st.current_replicas = current
+            st.desired_replicas = desired
+            st.current_cpu_utilization_percentage = util
+            st.current_metric_values = metric_values
+            if desired != current:
+                st.last_scale_time = now_iso()
+            st.observed_generation = fresh.metadata.generation
             self.cs.horizontalpodautoscalers.update_status(fresh)
-        except ApiError:
-            pass
+
+        try:
+            # Conflict = a concurrent writer bumped the rv between our
+            # get and update: re-read and retry through the shared
+            # policy.  Anything else is logged, never swallowed — a
+            # permanently failing status write must be visible.
+            retry_on_conflict(attempt)
+        except NotFound:
+            return  # HPA deleted mid-write: nothing to record
+        except ApiError as e:
+            self._status_err_reporter.report(
+                f"status update {hpa.key()}: {e}")

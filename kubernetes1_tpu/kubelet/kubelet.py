@@ -66,6 +66,7 @@ class Kubelet:
         eviction_interval: float = 10.0,
         eviction_thresholds: Optional[Dict[str, float]] = None,
         eviction_signals_fn=None,
+        podscrape_interval: float = 1.0,
         server_port: Optional[int] = 0,  # 0 = ephemeral; None = no server
         server_token: str = "",
         server_tls_cert_file: str = "",  # CSR-issued serving cert (:10250 TLS)
@@ -165,6 +166,13 @@ class Kubelet:
         # per-pod spans under the creating request's trace id (utils/spans),
         # served at the kubelet server's /debug/traces
         self.spans = SpanCollector(f"kubelet/{node_name}")
+        # pod /metrics scrape agent (custom-metrics pipeline): reconciled
+        # from the stats loop, scraping happens on per-pod threads — a
+        # dead pod endpoint can never stall the kubelet's own loops
+        from .podscrape import PodScraper
+
+        self.pod_scraper = PodScraper(
+            clientset, node_name, interval=podscrape_interval)
 
         self.server = None
         self.server_token = server_token
@@ -297,6 +305,7 @@ class Kubelet:
         self._stop.set()
         self._queue.shut_down()
         self.pods.stop()
+        self.pod_scraper.stop()
         self.device_manager.stop()
         self.prober.stop()
         self.container_manager.cleanup()
@@ -732,9 +741,13 @@ class Kubelet:
         (server/stats/summary.go) → metrics-server → metrics.k8s.io)."""
         now = now_iso()
         node_cpu, node_mem = 0.0, 0.0
-        for pod in self.pods.list():
-            if pod.spec.node_name != self.node_name:
-                continue
+        my_pods = [p for p in self.pods.list()
+                   if p.spec.node_name == self.node_name]
+        # the custom-metrics hop rides the same cadence: diff the
+        # annotated-pod set against the running scrape threads (no I/O
+        # here — the scrapes themselves live on per-pod threads)
+        self.pod_scraper.reconcile(my_pods)
+        for pod in my_pods:
             with self._lock:
                 cids = {
                     name: cid

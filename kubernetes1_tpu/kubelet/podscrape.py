@@ -1,0 +1,383 @@
+"""Pod /metrics scrape agent: the kubelet half of the custom-metrics plane.
+
+The kubelet already publishes CPU/memory PodMetrics (`_publish_metrics`,
+the resource-metrics hop).  Workload SLIs — QPS, in-flight requests,
+latency histograms — live on the POD's own /metrics endpoint
+(obs/appmetrics), declared via the ``obs.ktpu.io/scrape-port``/
+``scrape-path`` annotations.  The PodScraper lifts them into
+PodCustomMetrics objects, the ``custom.metrics.k8s.io`` pipeline's
+storage, which the HPA's Pods-type metric specs consume.
+
+Contract (the PR 11 collector rule, node-local edition):
+
+- ``reconcile(pods)`` is called from the kubelet's existing stats loop
+  and only DIFFS the annotated-pod set against the running scrape
+  threads — O(annotated pods), no I/O, so 30k hollow pods without
+  annotations cost the sync loop nothing;
+- each annotated pod gets its OWN daemon scrape thread behind the
+  ``obs.pod_scrape`` faultline site; a dead or slow pod endpoint stalls
+  only its own thread, never the kubelet sync loop or a sibling's
+  scrapes;
+- a failing scrape keeps the LAST-GOOD samples and republishes them with
+  ``stale=True`` (consumers must treat stale as missing — the HPA holds
+  its last decision instead of flapping to zero);
+- counter samples additionally publish a scrape-derived ``<name>:rate``
+  (events/second between the last two good scrapes) so autoscalers can
+  target request RATES without every workload exporting its own gauge
+  (the prometheus-adapter ``rate()`` analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..client import retry as _retry
+from ..machinery import ApiError, NotFound, now_iso
+from ..obs import aggregate
+from ..obs.appmetrics import sample_value, scrape_target  # noqa: F401 — sample_value re-exported: the value-of-metric-on-pod definition lives with the scrape contract
+from ..utils import faultline, locksan
+from ..utils.logutil import RateLimitedReporter
+
+# Sample-count cap per pod: a misbehaving workload dumping thousands of
+# series must not turn every scrape into a megabyte PodCustomMetrics
+# write.  64 named series is far past any sane SLI surface.
+MAX_SAMPLES = 64
+
+
+class _Target:
+    """One annotated pod's scrape state.  Mutated by its own thread;
+    read by reconcile/render under the scraper lock."""
+
+    def __init__(self, key: str, uid: str, url: str, pod: t.Pod):
+        self.key = key
+        self.uid = uid
+        self.url = url
+        self.namespace = pod.metadata.namespace
+        self.pod_name = pod.metadata.name
+        self.labels = dict(pod.metadata.labels or {})
+        self.stop = threading.Event()
+        self.gone = False  # pod vanished (vs replaced): object is garbage
+        self.adopt_checked = False  # pre-restart object looked for once
+        self.thread: Optional[threading.Thread] = None
+        # scrape state (last-good snapshot semantics)
+        self.samples: List[t.MetricSample] = []
+        self.stale = False
+        self.published_stale = True  # nothing published yet
+        self.last_ok_mono: Optional[float] = None
+        self.last_counters: Dict[str, float] = {}
+        self.scrapes = 0
+        self.errors = 0
+        self.last_duration_s = 0.0
+        self.rv: Optional[str] = None  # published object's rv cache
+
+
+def _extract_samples(parsed: aggregate.ParsedMetrics,
+                     prev_counters: Dict[str, float],
+                     dt: Optional[float],
+                     ) -> Tuple[List[t.MetricSample], Dict[str, float]]:
+    """ParsedMetrics -> (MetricSample list, counter snapshot for the next
+    rate derivation).  Histogram internals (``_bucket`` series, quantile
+    children) are skipped — the HPA consumes scalars; the full histogram
+    stays on the pod endpoint for humans and the fleet merge."""
+    samples: List[t.MetricSample] = []
+    counters: Dict[str, float] = {}
+    for key, value in parsed.samples.items():
+        try:
+            name, labels = aggregate.parse_series_key(key)
+        except ValueError:
+            continue
+        if "quantile" in labels or "le" in labels \
+                or name.endswith("_bucket"):
+            continue
+        fam_type = parsed.types.get(name, "")
+        if not fam_type:
+            for suffix in ("_sum", "_count"):
+                if name.endswith(suffix) \
+                        and name[: -len(suffix)] in parsed.types:
+                    fam_type = "counter"  # histogram internals: cumulative
+        is_counter = fam_type == "counter" or name.endswith("_total")
+        if len(samples) < MAX_SAMPLES:
+            samples.append(t.MetricSample(
+                name=name, value=value,
+                type="counter" if is_counter else (fam_type or "gauge"),
+                labels=labels))
+        if is_counter:
+            counters[key] = value
+            if dt and dt > 0 and key in prev_counters \
+                    and len(samples) < MAX_SAMPLES:
+                delta = value - prev_counters[key]
+                if delta >= 0:  # a restarted workload resets its counters
+                    samples.append(t.MetricSample(
+                        name=f"{name}:rate", value=delta / dt,
+                        type="rate", labels=labels))
+    return samples, counters
+
+
+class PodScraper:
+    """See module docstring.  Owned by a Kubelet; `reconcile` is wired
+    into the kubelet's stats loop, `render_metrics` into the kubelet
+    server's /metrics."""
+
+    def __init__(self, clientset, node_name: str, interval: float = 1.0,
+                 fetch_timeout: float = 1.0):
+        self.cs = clientset
+        self.node_name = node_name
+        self.interval = interval
+        self.fetch_timeout = fetch_timeout
+        self._targets: Dict[str, _Target] = {}
+        self._lock = locksan.make_lock("podscrape.PodScraper._lock")
+        self._stopping = threading.Event()
+        self._err_reporter = RateLimitedReporter(
+            f"podscrape/{node_name}", window=30.0)
+        self.scrapes_total = 0
+        self.errors_total = 0
+        self.publish_errors_total = 0
+
+    # ----------------------------------------------------------- reconcile
+
+    def reconcile(self, pods: List[t.Pod]):
+        """Diff the annotated-pod set against running scrape threads.
+        Called from the kubelet stats loop — never blocks on a scrape."""
+        want: Dict[str, Tuple[str, str, t.Pod]] = {}
+        for pod in pods:
+            if pod.metadata.deletion_timestamp:
+                continue
+            url = scrape_target(pod)
+            if url is not None:
+                want[pod.key()] = (pod.metadata.uid, url, pod)
+        to_start: List[_Target] = []
+        to_gc: List[_Target] = []
+        with self._lock:
+            for key, tgt in list(self._targets.items()):
+                cur = want.get(key)
+                if cur is None or cur[0] != tgt.uid or cur[1] != tgt.url:
+                    # gone, replaced (new uid = new pod instance), or
+                    # re-annotated: the old thread dies, state resets
+                    del self._targets[key]
+                    if cur is None:
+                        tgt.gone = True  # before stop.set: see _publish
+                        to_gc.append(tgt)
+                    tgt.stop.set()
+                elif dict(cur[2].metadata.labels or {}) != tgt.labels:
+                    # relabeled in place: the published object's labels
+                    # must follow (labelSelector reads select over them)
+                    tgt.labels = dict(cur[2].metadata.labels or {})
+            for key, (uid, url, pod) in want.items():
+                if key not in self._targets:
+                    tgt = self._targets[key] = _Target(key, uid, url, pod)
+                    to_start.append(tgt)
+        for tgt in to_start:
+            tgt.thread = threading.Thread(
+                target=self._scrape_loop, args=(tgt,), daemon=True,
+                name=f"podscrape-{tgt.pod_name}")
+            tgt.thread.start()
+        for tgt in to_gc:
+            self._gc_object(tgt)
+
+    def _gc_object(self, tgt: _Target):
+        """Best-effort delete of a vanished pod's PodCustomMetrics — a
+        stale object for a dead pod would read as a live (stale) signal."""
+        try:
+            self.cs.podcustommetrics.delete(tgt.pod_name, tgt.namespace)
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            pass  # object may never have been published; next pod wins it
+
+    # ------------------------------------------------------------- scraping
+
+    def _fetch(self, url: str) -> str:
+        """One GET behind the obs.pod_scrape faultline site (an injected
+        drop/delay/error lands HERE, inside the pod's own thread)."""
+        faultline.check("obs.pod_scrape")
+        with urllib.request.urlopen(url, timeout=self.fetch_timeout) as r:
+            return r.read().decode()
+
+    def scrape_once(self, tgt: _Target) -> bool:
+        t0 = time.monotonic()
+        try:
+            text = _retry.call_with_retries(
+                lambda: self._fetch(tgt.url), steps=2,
+                reason="pod_scrape",
+                backoff=_retry.Backoff(base=0.02, cap=0.1))
+        except Exception as e:  # noqa: BLE001 — a dead pod endpoint is a data point
+            with self._lock:
+                tgt.errors += 1
+                self.errors_total += 1
+                tgt.stale = True
+            self._err_reporter.report(f"scrape {tgt.key}: {e}")
+            if tgt.last_ok_mono is not None:
+                # fresh -> stale transition: republish the last-good
+                # samples MARKED stale — consumers hold, not flap.
+                # _publish dedups on published_stale, so the mark lands
+                # exactly once per transition but a FAILED mark write is
+                # retried on every later failing scrape until it sticks
+                # (else consumers read stale data as fresh all outage).
+                self._publish(tgt)
+            elif not tgt.adopt_checked:
+                # never scraped OK in THIS process but a pre-restart
+                # kubelet may have published a fresh-looking object for
+                # this pod — find it and stale-mark it, or consumers
+                # treat a dead endpoint's last samples as live truth
+                # for the whole outage
+                self._adopt_stale(tgt)
+            return False
+        parsed = aggregate.parse_metrics_text(text)
+        now = time.monotonic()
+        dt = (now - tgt.last_ok_mono) if tgt.last_ok_mono is not None \
+            else None
+        samples, counters = _extract_samples(
+            parsed, tgt.last_counters, dt)
+        with self._lock:
+            tgt.samples = samples
+            tgt.last_counters = counters
+            tgt.last_ok_mono = now
+            tgt.stale = False
+            tgt.last_duration_s = now - t0
+            tgt.scrapes += 1
+            self.scrapes_total += 1
+        self._publish(tgt)
+        return True
+
+    def _adopt_stale(self, tgt: _Target):
+        """First-failure path of a target that has never scraped OK in
+        this process (kubelet restart mid-outage): adopt any published
+        PodCustomMetrics for the pod as last-good and stale-mark it.
+        Transport errors retry on the next failing scrape; NotFound
+        settles the question for good."""
+        try:
+            cur = self.cs.podcustommetrics.get(tgt.pod_name, tgt.namespace)
+        except NotFound:
+            with self._lock:
+                tgt.adopt_checked = True  # nothing published: new pod
+            return
+        except (ApiError, ConnectionError, TimeoutError, OSError):
+            return  # can't tell yet — re-check on the next failure
+        with self._lock:
+            tgt.adopt_checked = True
+            tgt.rv = cur.metadata.resource_version
+            tgt.samples = list(cur.samples)  # the outage's last-good
+            if cur.stale:
+                tgt.published_stale = True  # already marked: done
+                return
+            tgt.published_stale = False
+        self._publish(tgt)  # tgt.stale is set by our caller
+
+    def _scrape_loop(self, tgt: _Target):
+        while not tgt.stop.is_set() and not self._stopping.is_set():
+            self.scrape_once(tgt)
+            tgt.stop.wait(self.interval)
+
+    # ------------------------------------------------------------ publishing
+
+    def _publish(self, tgt: _Target):
+        """Upsert the PodCustomMetrics object (steady state is update —
+        the `_upsert_metrics` shape, but per-target rv state so N pod
+        threads never share a cache slot)."""
+        if tgt.stop.is_set():
+            return  # target retired mid-scrape: don't resurrect a GC'd object
+        with self._lock:
+            obj = t.PodCustomMetrics(
+                timestamp=now_iso(), stale=tgt.stale,
+                samples=list(tgt.samples))
+            obj.metadata.name = tgt.pod_name
+            obj.metadata.namespace = tgt.namespace
+            obj.metadata.labels = dict(tgt.labels)
+            rv = tgt.rv
+            already_published_stale = tgt.published_stale and tgt.stale
+        if already_published_stale:
+            return  # stale republish happens once per transition
+        client = self.cs.podcustommetrics
+        try:
+            if rv is not None:
+                obj.metadata.resource_version = rv
+                try:
+                    updated = client.update(obj)
+                except NotFound:
+                    obj.metadata.resource_version = ""
+                    updated = client.create(obj, tgt.namespace)
+            else:
+                try:
+                    updated = client.create(obj, tgt.namespace)
+                except ApiError:
+                    # AlreadyExists (a restarted kubelet, or the prior
+                    # pod of a reused name): adopt the live object's rv
+                    cur = client.get(tgt.pod_name, tgt.namespace)
+                    obj.metadata.resource_version = \
+                        cur.metadata.resource_version
+                    updated = client.update(obj)
+        except ApiError:  # Conflict: refresh the rv, next cycle wins
+            with self._lock:
+                tgt.rv = None
+                self.publish_errors_total += 1
+            return
+        except (ConnectionError, TimeoutError, OSError) as e:
+            with self._lock:
+                self.publish_errors_total += 1
+            self._err_reporter.report(f"publish {tgt.key}: {e}")
+            return
+        with self._lock:
+            tgt.rv = updated.metadata.resource_version
+            tgt.published_stale = obj.stale
+        if tgt.stop.is_set() and tgt.gone:
+            # pod vanished while the write was in flight: reconcile's GC
+            # delete may have run BEFORE our update/create landed (the
+            # NotFound->create fallback resurrects it), and no later
+            # pass would ever clean the orphan.  reconcile sets gone,
+            # then stop, then deletes; we re-check after writing — one
+            # of the two deletes always sees the object last.  A
+            # replaced (uid/url change) target keeps the object: its
+            # successor thread owns it now.
+            self._gc_object(tgt)
+
+    # ------------------------------------------------------------ reporting
+
+    def render_metrics(self) -> str:
+        """Scrape-health lines for the kubelet's /metrics — the per-node
+        half the ObsCollector federates into the fleet scaling view."""
+        now = time.monotonic()
+        with self._lock:
+            tgts = sorted(self._targets.values(), key=lambda x: x.key)
+            lines = [
+                "# TYPE ktpu_podscrape_targets gauge",
+                f"ktpu_podscrape_targets {len(tgts)}",
+                "# TYPE ktpu_podscrape_scrapes_total counter",
+                f"ktpu_podscrape_scrapes_total {self.scrapes_total}",
+                "# TYPE ktpu_podscrape_errors_total counter",
+                f"ktpu_podscrape_errors_total {self.errors_total}",
+                "# TYPE ktpu_podscrape_publish_errors_total counter",
+                f"ktpu_podscrape_publish_errors_total "
+                f"{self.publish_errors_total}",
+            ]
+            if tgts:
+                lines.append("# TYPE ktpu_podscrape_up gauge")
+                for tg in tgts:
+                    lines.append(
+                        f'ktpu_podscrape_up{{pod="{tg.key}"}} '
+                        f"{0 if tg.stale or tg.last_ok_mono is None else 1}")
+                lines.append(
+                    "# TYPE ktpu_podscrape_staleness_seconds gauge")
+                for tg in tgts:
+                    stale_s = (now - tg.last_ok_mono
+                               if tg.last_ok_mono is not None else -1.0)
+                    lines.append(
+                        f'ktpu_podscrape_staleness_seconds'
+                        f'{{pod="{tg.key}"}} {stale_s:.3f}')
+        return "\n".join(lines) + "\n"
+
+    def targets(self) -> List[_Target]:
+        with self._lock:
+            return list(self._targets.values())
+
+    def stop(self):
+        self._stopping.set()
+        with self._lock:
+            tgts = list(self._targets.values())
+            self._targets.clear()
+        for tgt in tgts:
+            tgt.stop.set()
+        for tgt in tgts:
+            if tgt.thread is not None:
+                tgt.thread.join(timeout=2.0)

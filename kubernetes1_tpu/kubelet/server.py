@@ -244,6 +244,10 @@ class _KubeletHandler(BaseHTTPRequestHandler):
                     f"# TYPE kubelet_running_containers gauge\n"
                     f"kubelet_running_containers {running}\n"
                     + kl.device_manager.allocation_latency.render()
+                    # pod /metrics scrape health (custom-metrics plane):
+                    # per-annotated-pod up/staleness — the node-local
+                    # half the ObsCollector's scaling view federates
+                    + kl.pod_scraper.render_metrics()
                 )
                 self._send(200, body, content_type="text/plain; version=0.0.4")
             else:

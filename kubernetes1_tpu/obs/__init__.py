@@ -6,7 +6,11 @@ lines every component renders; quantile-max only as the documented
 fallback for reservoir-only metrics).  `obs.collector` is the
 ObsCollector: it scrapes every registered component endpoint on an
 interval and serves the fleet-level `/metrics`, `/debug/traces`,
-`/debug/topology`, and `/debug/flightrecorder` views.
+`/debug/topology`, and `/debug/flightrecorder` views.  `obs.appmetrics`
+is the WORKLOAD half: the registry pods embed to export QPS/in-flight/
+latency SLIs on a pod-local /metrics endpoint, plus the
+`obs.ktpu.io/scrape-*` annotation contract the kubelet's pod scrape
+agent (kubelet/podscrape.py) lifts into PodCustomMetrics for the HPA.
 """
 
 from .aggregate import (  # noqa: F401
@@ -17,5 +21,11 @@ from .aggregate import (  # noqa: F401
     parse_metrics_text,
     render_metrics,
     select,
+)
+from .appmetrics import (  # noqa: F401
+    AppMetrics,
+    sample_value,
+    scrape_annotations,
+    scrape_target,
 )
 from .collector import ObsCollector  # noqa: F401

@@ -1,0 +1,212 @@
+"""AppMetrics: the registry a WORKLOAD embeds to export pod-level SLIs.
+
+The control plane's components render `utils/metrics` registries on their
+own ports; workloads (the llama decode server, the RL learner) need the
+same text format on a pod-local /metrics endpoint so the kubelet's pod
+scrape agent (kubelet/podscrape.py) can lift their QPS / in-flight /
+latency series into PodCustomMetrics objects — the numbers the HPA's
+Pods-type metric specs scale on.
+
+AppMetrics is deliberately thin: a `utils.metrics.Registry` plus an
+optional HTTP surface.  Metric names follow the tree-wide naming
+discipline (ktpulint KTPU011): every `.counter/.gauge/.histogram`
+construction site must use a ``ktpu_``-prefixed name, or the fleet merge
+(obs/aggregate) would sum a workload's series into an unrelated one.
+
+The scrape contract is carried on the POD, as annotations:
+
+    obs.ktpu.io/scrape-port   the port serving /metrics (required)
+    obs.ktpu.io/scrape-path   endpoint path (default /metrics)
+    obs.ktpu.io/scrape-host   host override — in-process clusters run
+                              workload servers on loopback while pod IPs
+                              are synthetic, so e2e/bench pods point the
+                              kubelet at 127.0.0.1 explicitly (a real
+                              deployment omits it: default is the pod IP)
+
+`scrape_annotations()` builds the dict; `scrape_target()` resolves a
+pod's annotations to the URL the kubelet fetches (None = not annotated =
+the pod opted out, which is the overwhelmingly common case and must cost
+the kubelet nothing).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ..utils import locksan
+from ..utils.metrics import Counter, Gauge, Histogram, MetricsServer, Registry
+
+SCRAPE_PORT_ANNOTATION = "obs.ktpu.io/scrape-port"
+SCRAPE_PATH_ANNOTATION = "obs.ktpu.io/scrape-path"
+SCRAPE_HOST_ANNOTATION = "obs.ktpu.io/scrape-host"
+DEFAULT_SCRAPE_PATH = "/metrics"
+
+
+def scrape_annotations(port: int, path: str = DEFAULT_SCRAPE_PATH,
+                       host: str = "") -> Dict[str, str]:
+    """The annotation dict a pod spec builder merges into its metadata
+    to opt in to kubelet scraping."""
+    out = {SCRAPE_PORT_ANNOTATION: str(int(port))}
+    if path and path != DEFAULT_SCRAPE_PATH:
+        out[SCRAPE_PATH_ANNOTATION] = path
+    if host:
+        out[SCRAPE_HOST_ANNOTATION] = host
+    return out
+
+
+def scrape_target(pod) -> Optional[str]:
+    """Resolve a pod's scrape annotations to the /metrics URL, or None
+    when the pod isn't annotated (or the annotation is malformed — a
+    workload typo must not crash the kubelet's stats loop)."""
+    ann = pod.metadata.annotations or {}
+    port = ann.get(SCRAPE_PORT_ANNOTATION)
+    if not port:
+        return None
+    try:
+        port_n = int(port)
+    except ValueError:
+        return None
+    if not 0 < port_n < 65536:
+        return None
+    host = ann.get(SCRAPE_HOST_ANNOTATION) or pod.status.pod_ip \
+        or pod.status.host_ip
+    if not host:
+        return None
+    path = ann.get(SCRAPE_PATH_ANNOTATION) or DEFAULT_SCRAPE_PATH
+    if not path.startswith("/"):
+        path = "/" + path
+    return f"http://{host}:{port_n}{path}"
+
+
+def sample_value(pcm, metric_name: str) -> Optional[float]:
+    """A PodCustomMetrics object's scalar for `metric_name`: the
+    unlabeled sample wins; labeled children SUM (the one defensible
+    cross-label fold for counters/rates, and the documented contract for
+    gauges).  None when the metric isn't present.  Shared by every
+    consumer of the scrape pipeline (the apiserver's custom-metrics GET,
+    the HPA's Pods-metric evaluation) so 'the value of metric X on pod
+    P' has exactly one definition."""
+    labeled_sum = None
+    for s in pcm.samples:
+        if s.name != metric_name:
+            continue
+        if not s.labels:
+            return s.value
+        labeled_sum = (labeled_sum or 0.0) + s.value
+    return labeled_sum
+
+
+class AppMetrics:
+    """One workload process's metric registry + /metrics endpoint.
+
+    ``counter/gauge/histogram`` mint (or return) named metrics exactly
+    like a component Registry; ``serve()`` exposes them over HTTP on an
+    ephemeral (or fixed) port — the port the pod then advertises via
+    ``scrape_annotations``.  ``window_rate()`` is the QPS helper: the
+    observed event rate over a sliding window, published as a gauge so
+    scrape consumers don't each have to differentiate counters.
+    """
+
+    def __init__(self, rate_window_s: float = 5.0):
+        self.registry = Registry()
+        self.rate_window_s = rate_window_s
+        self._events: Dict[str, deque] = {}
+        self._lock = locksan.make_lock("appmetrics.AppMetrics._lock")
+        self._server: Optional[MetricsServer] = None
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self.registry.counter(name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self.registry.gauge(name, help_)
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        return self.registry.histogram(name, help_)
+
+    # ------------------------------------------------------------- QPS
+
+    def mark(self, name: str, n: int = 1):
+        """Record `n` events toward `name`'s sliding-window rate."""
+        now = time.monotonic()
+        floor = now - self.rate_window_s
+        with self._lock:
+            dq = self._events.get(name)
+            if dq is None:
+                dq = self._events[name] = deque()
+            dq.append((now, n))
+            # prune here too, not only in window_rate(): a pod nothing
+            # ever scrapes must not grow the deque without bound
+            while dq and dq[0][0] < floor:
+                dq.popleft()
+
+    def window_rate(self, name: str) -> float:
+        """Events/second over the trailing window (0.0 before any mark)."""
+        now = time.monotonic()
+        floor = now - self.rate_window_s
+        with self._lock:
+            dq = self._events.get(name)
+            if not dq:
+                return 0.0
+            while dq and dq[0][0] < floor:
+                dq.popleft()
+            total = sum(n for _t, n in dq)
+        return total / self.rate_window_s
+
+    def set_rate_gauges(self):
+        """Publish every marked rate as its gauge (called before each
+        render so the scraped value is current, not last-marked)."""
+        with self._lock:
+            names = list(self._events)
+        for name in names:
+            self.registry.gauge(name).set(self.window_rate(name))
+
+    # ----------------------------------------------------------- serving
+
+    def render(self) -> str:
+        self.set_rate_gauges()
+        return self.registry.render()
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> "AppMetrics":
+        """Start the /metrics endpoint (idempotent)."""
+        if self._server is None:
+            self._server = _AppMetricsServer(self, host=host, port=port)
+            self._server.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("AppMetrics.serve() not called")
+        return self._server.port
+
+    @property
+    def url(self) -> str:
+        if self._server is None:
+            raise RuntimeError("AppMetrics.serve() not called")
+        return self._server.url
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+class _AppMetricsServer(MetricsServer):
+    """MetricsServer whose /metrics refreshes the rate gauges first —
+    the registry object alone can't know a render is imminent."""
+
+    def __init__(self, app: AppMetrics, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(_RenderProxy(app), host=host, port=port)
+
+
+class _RenderProxy:
+    """Registry stand-in handing MetricsServer the refreshed render."""
+
+    def __init__(self, app: AppMetrics):
+        self._app = app
+
+    def render(self) -> str:
+        return self._app.render()

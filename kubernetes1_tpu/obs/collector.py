@@ -310,7 +310,53 @@ class ObsCollector:
                                     if t.last_scrape_mono is not None
                                     else None),
                 } for t in tgts],
+                "scaling": self._scaling_view_locked(tgts),
             }
+
+    def _scaling_view_locked(self, tgts: List[_Target]) -> dict:
+        """The custom-metrics scaling loop, federated from last-good
+        snapshots: per-kubelet pod-scrape health (how fresh the workload
+        SLIs feeding the HPAs are) and every HPA's current decision —
+        one place that answers 'why is this Deployment at N replicas'."""
+        pod_scrape: Dict[str, dict] = {}
+        hpas: Dict[str, dict] = {}
+        for t in tgts:
+            parsed = t.parsed
+            if parsed is None:
+                continue
+            targets_n = up_n = 0
+            stale_max = None
+            for key, value in parsed.samples.items():
+                if key.startswith("ktpu_podscrape_up{"):
+                    targets_n += 1
+                    up_n += 1 if value else 0
+                elif key.startswith("ktpu_podscrape_staleness_seconds{"):
+                    if stale_max is None or value > stale_max:
+                        stale_max = value
+                elif key.startswith("ktpu_hpa_"):
+                    try:
+                        name, labels = aggregate.parse_series_key(key)
+                    except ValueError:
+                        continue
+                    hpa = labels.get("hpa")
+                    if not hpa:
+                        continue
+                    entry = hpas.setdefault(hpa, {})
+                    if name == "ktpu_hpa_desired_replicas":
+                        entry["desired"] = value
+                    elif name == "ktpu_hpa_current_replicas":
+                        entry["current"] = value
+                    elif name == "ktpu_hpa_observed_value":
+                        entry.setdefault("observed", {})[
+                            labels.get("metric", "")] = value
+            if targets_n:
+                pod_scrape[t.instance] = {
+                    "targets": targets_n,
+                    "up": up_n,
+                    "staleness_max_s": (round(stale_max, 3)
+                                        if stale_max is not None else None),
+                }
+        return {"pod_scrape": pod_scrape, "hpas": hpas}
 
     # ------------------------------------------------------------- fan-outs
 

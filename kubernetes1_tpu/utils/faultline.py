@@ -95,6 +95,14 @@ Wired sites:
                                                  collector's serving path —
                                                  scripts/chaos.py
                                                  --schedule obs proves it)
+  obs.pod_scrape                                (kubelet/podscrape.py: the
+                                                 kubelet's pod /metrics
+                                                 fetches — same invariant,
+                                                 node-local: a wedged pod
+                                                 endpoint stalls only its
+                                                 own per-pod thread, never
+                                                 the kubelet sync loop;
+                                                 --schedule obs covers it)
 
 With no injector active every hook is identity — one module-global ``is
 None`` test on the hot path; no locks, no RNG, no allocation.

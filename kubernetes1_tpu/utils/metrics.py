@@ -60,6 +60,15 @@ class Counter:
         with self._lock:
             return [self._children[k] for k in sorted(self._children)]
 
+    def remove_labels(self, **kv: object):
+        """Drop every labeled child whose label set contains all the given
+        pairs — a deleted owner's series must not render forever."""
+        match = {(k, str(v)) for k, v in kv.items()}
+        with self._lock:
+            for key in [k for k in self._children
+                        if match.issubset(set(k))]:
+                del self._children[key]
+
     def inc(self, amount: float = 1.0):
         with self._lock:
             self._v += amount
@@ -138,6 +147,14 @@ class Histogram:
     def _children_snapshot(self) -> List["Histogram"]:
         with self._lock:
             return [self._children[k] for k in sorted(self._children)]
+
+    def remove_labels(self, **kv: object):
+        """See Counter.remove_labels."""
+        match = {(k, str(v)) for k, v in kv.items()}
+        with self._lock:
+            for key in [k for k in self._children
+                        if match.issubset(set(k))]:
+                del self._children[key]
 
     def observe(self, v: float):
         with self._lock:

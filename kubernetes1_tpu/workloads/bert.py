@@ -213,7 +213,7 @@ def train_demo(cfg: Optional[BertConfig] = None, mesh: Optional[Mesh] = None,
 
     cfg = cfg or tiny()
     mesh = mesh or sh.auto_mesh()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params, opt_state, tx = make_train_state(cfg, mesh, lr=lr)
         step = make_train_step(cfg, mesh, tx)
         tokens, mask = synthetic_batch(cfg, batch, seq)

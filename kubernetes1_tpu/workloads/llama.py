@@ -242,7 +242,7 @@ def train_demo(cfg: Optional[LlamaConfig] = None, mesh: Optional[Mesh] = None,
 
     cfg = cfg or tiny()
     mesh = mesh or sh.auto_mesh()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params, opt_state, tx = make_train_state(cfg, mesh, lr=lr)
         step = make_train_step(cfg, mesh, tx)
         rng = np.random.default_rng(0)
@@ -254,5 +254,196 @@ def train_demo(cfg: Optional[LlamaConfig] = None, mesh: Optional[Mesh] = None,
         return float(loss)
 
 
+# ------------------------------------------------------------ decode serving
+
+def greedy_decode(cfg: LlamaConfig, params: Dict[str, Any], step_fn,
+                  tokens, max_new: int = 8) -> list:
+    """Greedy continuation of a prompt: full re-forward per step (the
+    tiny-config serving path — a KV cache is a perf lever, not a
+    correctness one, and the serving bench's subject is the CONTROL
+    plane: scrape -> custom metrics -> HPA).  `step_fn` is the jitted
+    forward; returns the new token ids only."""
+    toks = [int(x) % cfg.vocab for x in tokens] or [1]
+    out = []
+    for _ in range(max_new):
+        window = toks[-cfg.max_seq:]
+        arr = jnp.asarray([window], jnp.int32)
+        logits = step_fn(params, arr)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out
+
+
+class DecodeServer:
+    """The llama serving half: an HTTP decode endpoint plus the pod
+    /metrics surface the kubelet's scrape agent lifts into
+    PodCustomMetrics (obs/appmetrics contract) — QPS, in-flight
+    requests, and request-latency histograms, the workload SLIs the
+    HPA's Pods-type metric specs scale a serving Deployment on.
+
+        POST /generate  {"tokens": [...], "max_new": N} -> {"tokens": [...]}
+        GET  /metrics   prometheus text (appmetrics registry)
+        GET  /healthz
+    """
+
+    def __init__(self, cfg: Optional[LlamaConfig] = None, port: int = 0,
+                 seed: int = 0):
+        from . import sharding as sh
+        from ..obs.appmetrics import AppMetrics
+
+        self.cfg = cfg or tiny()
+        self.mesh = sh.auto_mesh()
+        with sh.use_mesh(self.mesh):
+            self.params = jax.jit(partial(init_params, self.cfg))(
+                jax.random.key(seed))
+        self._step = jax.jit(partial(forward, self.cfg))
+        self.metrics = AppMetrics()
+        self.requests_total = self.metrics.counter(
+            "ktpu_llama_requests_total", "decode requests served")
+        self.errors_total = self.metrics.counter(
+            "ktpu_llama_request_errors_total", "malformed decode requests")
+        self.inflight = self.metrics.gauge(
+            "ktpu_llama_inflight", "decode requests currently in flight")
+        self.latency = self.metrics.histogram(
+            "ktpu_llama_request_latency_seconds", "decode request latency")
+        self._port = port
+        self._srv = None
+
+    def generate(self, tokens, max_new: int = 8) -> list:
+        import time as _time
+
+        from . import sharding as sh
+
+        t0 = _time.monotonic()
+        self.inflight.inc()
+        try:
+            with sh.use_mesh(self.mesh):
+                return greedy_decode(self.cfg, self.params, self._step,
+                                     tokens, max_new=max_new)
+        finally:
+            self.inflight.inc(-1)
+            self.requests_total.inc()
+            self.metrics.mark("ktpu_llama_qps")
+            self.latency.observe(_time.monotonic() - t0)
+
+    # ------------------------------------------------------------- server
+
+    def start(self) -> "DecodeServer":
+        import json as _json
+        import threading as _threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    self._send(200, server.metrics.render().encode(),
+                               ctype="text/plain; version=0.0.4")
+                elif self.path.startswith("/healthz"):
+                    self._send(200, b'{"status":"ok"}')
+                else:
+                    self._send(404, b'{"error":"unknown path"}')
+
+            def do_POST(self):
+                if not self.path.startswith("/generate"):
+                    self._send(404, b'{"error":"unknown path"}')
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    req = _json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        raise TypeError("body must be a JSON object")
+                    toks = [int(x) for x in (req.get("tokens") or [])]
+                    max_new = min(64, int(req.get("max_new") or 8))
+                except (ValueError, TypeError):
+                    server.errors_total.inc()
+                    self._send(400, b'{"error":"bad request"}')
+                    return
+                out = server.generate(toks, max_new=max_new)
+                self._send(200, _json.dumps({"tokens": out}).encode())
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._srv.daemon_threads = True
+        th = _threading.Thread(target=self._srv.serve_forever, daemon=True,
+                               name="llama-decode")
+        th.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+        self.metrics.stop()
+
+
+def serving_deployment(name: str = "llama-serve", ns: str = "default",
+                       replicas: int = 1, scrape_port: int = 0,
+                       scrape_host: str = "", cpu: str = "100m"):
+    """A Deployment of decode-server pods, template annotated with the
+    obs.ktpu.io scrape contract so each replica's kubelet lifts its
+    /metrics into PodCustomMetrics (in-process clusters pass the
+    loopback host:port of a live DecodeServer — pod IPs are synthetic
+    there; a real deployment omits scrape_host)."""
+    from ..api import types as t
+    from ..obs.appmetrics import scrape_annotations
+
+    dep = t.Deployment()
+    dep.metadata.name = name
+    dep.metadata.namespace = ns
+    dep.spec.replicas = replicas
+    dep.spec.selector = t.LabelSelector(match_labels={"app": name})
+    dep.spec.template.metadata.labels = {"app": name}
+    if scrape_port:
+        dep.spec.template.metadata.annotations = scrape_annotations(
+            scrape_port, host=scrape_host)
+    c = t.Container(
+        name="decode", image="ktpu/llama-decode",
+        command=["python", "-m", "kubernetes1_tpu.workloads.llama",
+                 "--serve"])
+    c.resources.requests = {"cpu": cpu}
+    dep.spec.template.spec.containers = [c]
+    return dep
+
+
+def _serve_main():
+    import time as _time
+
+    srv = DecodeServer().start()
+    print(f"decode server on {srv.url}", flush=True)
+    try:
+        while True:
+            _time.sleep(5)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
 if __name__ == "__main__":
-    print("final loss:", train_demo())
+    import sys
+
+    if "--serve" in sys.argv[1:]:
+        _serve_main()
+    else:
+        print("final loss:", train_demo())

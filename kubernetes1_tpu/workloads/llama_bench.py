@@ -99,7 +99,7 @@ def run(preset: str, batch: int, seq: int, steps: int, optimizer: str,
 
     import optax
 
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params = jax.jit(partial(init_params, cfg))(jax.random.key(0))
         opt_state = jax.jit(tx.init)(params)
 

@@ -138,7 +138,7 @@ def train_demo(cfg: ResNetConfig = None, mesh: Mesh = None, steps: int = 3,
 
     cfg = cfg or tiny()
     mesh = mesh or sh.auto_mesh()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params = init_params(cfg, jax.random.key(0))
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)
@@ -161,7 +161,7 @@ def bench_imgs_per_sec(batch: int = 64, size: int = 224, steps: int = 10) -> flo
 
     cfg = ResNetConfig()
     mesh = sh.auto_mesh()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params = init_params(cfg, jax.random.key(0))
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)

@@ -46,7 +46,7 @@ def run(batch: int, steps: int, size: int, warmup: int = 2,
     n_dev = len(devices)
     cfg = ResNetConfig()
     mesh = sh.auto_mesh()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params = init_params(cfg, jax.random.key(0))
         tx = optax.sgd(0.1, momentum=0.9)
         opt_state = jax.jit(tx.init)(params)

@@ -68,16 +68,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
 
+    # device-varying marker (shard_map VMA rules) landed with the
+    # top-level shard_map; identity on older jax, which has no VMA types
+    pvary = getattr(jax.lax, "pvary", lambda x, _axis: x)
+
     def local(q, k, v):
-        n = jax.lax.axis_size(axis)
+        # ring size is static mesh shape (axis_size is newer-jax only)
+        n = mesh.shape[axis]
         idx = jax.lax.axis_index(axis)
         sq = q.shape[1]
         q_off = idx * sq
         # mark accumulators as device-varying over the ring axis so the
         # fori carry types match the body outputs (shard_map VMA rules)
-        o0 = jax.lax.pvary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), axis)
-        m0 = jax.lax.pvary(jnp.full((q.shape[0], q.shape[2], sq), NEG_INF, jnp.float32), axis)
-        l0 = jax.lax.pvary(jnp.zeros((q.shape[0], q.shape[2], sq), jnp.float32), axis)
+        o0 = pvary(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), axis)
+        m0 = pvary(jnp.full((q.shape[0], q.shape[2], sq), NEG_INF, jnp.float32), axis)
+        l0 = pvary(jnp.zeros((q.shape[0], q.shape[2], sq), jnp.float32), axis)
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def body(step, carry):
@@ -96,7 +101,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         return (o / l.swapaxes(1, 2)[..., None]).astype(q.dtype)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    # jax.shard_map landed as a top-level name after 0.4.x; older
+    # installs ship it under jax.experimental (same semantics)
+    smap = getattr(jax, "shard_map", None)
+    if smap is None:
+        from jax.experimental.shard_map import shard_map as smap
+    fn = smap(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -99,6 +99,8 @@ class Learner:
     def __init__(self, arms: int = 8, port: int = 0, lr: float = 0.05):
         import numpy as np
 
+        from ..obs.appmetrics import AppMetrics
+
         self.weights = np.zeros(arms, dtype=np.float64)
         self.lr = lr
         self.batches = 0
@@ -108,15 +110,41 @@ class Learner:
         self._lock = locksan.make_lock("rl_actor.Learner._lock")
         self._srv = None
         self._port = port
+        # workload SLIs on the same HTTP surface (/metrics), the
+        # obs.ktpu.io scrape contract: the learner's ingest QPS is the
+        # signal an HPA scales an actor fleet's learner tier on
+        self.metrics = AppMetrics()
+        self.ingest_total = self.metrics.counter(
+            "ktpu_rl_ingest_total", "experience batches ingested")
+        self.ingest_inflight = self.metrics.gauge(
+            "ktpu_rl_ingest_inflight", "ingest requests in flight")
+        self.ingest_latency = self.metrics.histogram(
+            "ktpu_rl_ingest_latency_seconds", "ingest handling latency")
+        self.ingest_errors_total = self.metrics.counter(
+            "ktpu_rl_ingest_errors_total", "rejected experience batches")
 
     def ingest(self, batch: Dict[str, list]):
-        with self._lock:
-            self.weights, mean_r = reinforce_update(
-                self.weights, batch, lr=self.lr)
-            self.batches += 1
-            self.frames += len(batch.get("arms") or [])
-            self.updates += 1
-            self.mean_reward = mean_r
+        t0 = time.monotonic()
+        self.ingest_inflight.inc()
+        try:
+            with self._lock:
+                self.weights, mean_r = reinforce_update(
+                    self.weights, batch, lr=self.lr)
+                self.batches += 1
+                self.frames += len(batch.get("arms") or [])
+                self.updates += 1
+                self.mean_reward = mean_r
+        except Exception:
+            # a rejected batch must NOT count toward the ingest SLIs —
+            # an HPA scaling on ktpu_rl_ingest_qps would read a stream
+            # of garbage requests as phantom load
+            self.ingest_errors_total.inc()
+            raise
+        finally:
+            self.ingest_inflight.inc(-1)
+        self.ingest_total.inc()
+        self.metrics.mark("ktpu_rl_ingest_qps")
+        self.ingest_latency.observe(time.monotonic() - t0)
 
     def stats(self) -> dict:
         with self._lock:
@@ -149,6 +177,14 @@ class Learner:
                     self._json(200, learner.stats())
                 elif self.path.startswith("/weights"):
                     self._json(200, {"weights": list(learner.weights)})
+                elif self.path.startswith("/metrics"):
+                    body = learner.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -162,7 +198,11 @@ class Learner:
                 except ValueError:
                     self._json(400, {"error": "bad json"})
                     return
-                learner.ingest(batch)
+                try:
+                    learner.ingest(batch)
+                except (ValueError, TypeError, AttributeError):
+                    self._json(400, {"error": "bad batch"})
+                    return
                 self._json(200, {"ok": True})
 
         self._srv = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
@@ -260,10 +300,16 @@ def actor_pod(slot: int, gen: int = 0, ns: str = "default",
 
 def learner_job(name: str = "rl-learner", ns: str = "default",
                 workers: int = 2, tpus_per_worker: int = 1,
-                gang: bool = True) -> t.Job:
+                gang: bool = True, scrape_port: int = 0,
+                scrape_host: str = "") -> t.Job:
     """The learner slice: an Indexed Job, gang-scheduled when the gate is
     on, each worker holding TPU chips — the long-lived half actors stream
-    into."""
+    into.  `scrape_port` opts the workers into kubelet /metrics scraping
+    (the learner serves ingest SLIs at /metrics; in-process clusters
+    also pass the loopback `scrape_host` of the live Learner, since pod
+    IPs are synthetic there)."""
+    from ..obs.appmetrics import scrape_annotations
+
     job = t.Job()
     job.metadata.name = name
     job.metadata.namespace = ns
@@ -279,6 +325,9 @@ def learner_job(name: str = "rl-learner", ns: str = "default",
     if tpus_per_worker:
         c.resources.limits = {"google.com/tpu": tpus_per_worker}
     job.spec.template.metadata.labels = {"app": LEARNER_APP_LABEL}
+    if scrape_port:
+        job.spec.template.metadata.annotations = scrape_annotations(
+            scrape_port, host=scrape_host)
     job.spec.template.spec.containers = [c]
     return job
 

@@ -47,10 +47,35 @@ def auto_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return make_mesh(dp=dp, fsdp=fsdp, tp=1, devices=devices[: dp * fsdp])
 
 
+def use_mesh(mesh: Mesh):
+    """Context manager activating `mesh` for sharding annotations —
+    ``jax.set_mesh`` where it exists (newer jax), else the physical-mesh
+    context (``with mesh:``, the pre-set_mesh idiom) so the workloads run
+    on older jax installs too."""
+    setter = getattr(jax, "set_mesh", None)
+    return setter(mesh) if setter is not None else mesh
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The mesh governing sharding annotations right now, or None.
+    Newer jax tracks it as the abstract mesh (jax.set_mesh); older jax
+    as the thread-resources physical mesh (`with mesh:`)."""
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is not None:
+        return getter()
+    try:
+        from jax.interpreters import pxla
+
+        m = pxla.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        return None
+    return None if m.empty else m
+
+
 def constrain(x, spec: P):
     """with_sharding_constraint that no-ops when no mesh is active (so the
     same model code jits single-chip without a mesh context)."""
-    m = jax.sharding.get_abstract_mesh()
+    m = active_mesh()
     if m is None or not m.axis_names:
         return x
     # drop axes the active mesh doesn't have (e.g. a pure-dp mesh)

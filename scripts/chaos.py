@@ -82,6 +82,12 @@ CHIP_DEATH_SPEC = NODE_SPEC + ";device.health=error@0.04"
 NODE_MODES = ("node-kill", "kubelet-restart", "chip-death")
 
 
+def _hpa_rescales_now() -> float:
+    from kubernetes1_tpu.controllers.podautoscaler import rescales_snapshot
+
+    return rescales_snapshot()
+
+
 def _stop_quietly_mod(fn):
     """Guarded teardown (module-level twin of run_schedule's local): one
     component's failing stop() must not leak the rest of a topology."""
@@ -1728,7 +1734,8 @@ def run_store_shard_schedule(seed: int, duration: float = 6.0,
 # faultline site and chaos coverage).  Aggressive on purpose: the
 # collector's contract is that a dead or slow target degrades only its
 # own freshness, never the serving path.
-OBS_SPEC = "obs.scrape=drop@0.15|delay:300ms@0.15"
+OBS_SPEC = ("obs.scrape=drop@0.15|delay:300ms@0.15;"
+            "obs.pod_scrape=drop@0.20|delay:300ms@0.20")
 
 
 def run_obs_schedule(seed: int, duration: float = 6.0,
@@ -1745,28 +1752,101 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
         last-good snapshots, per-target threads);
       - dead targets are marked down (scrape_up 0) instead of wedging;
       - live targets' staleness is bounded once the faults lift;
-      - faults were actually injected at obs.scrape.
+      - faults were actually injected at obs.scrape AND obs.pod_scrape.
+
+    Custom-metrics phase (same run): an annotated 2-replica Deployment
+    scaled by a Pods-metric HPA, its /metrics endpoint under
+    obs.pod_scrape drops/delays and then KILLED mid-run:
+      - the kubelet sync loop is unaffected — a pod created while every
+        scrape is faulted still goes Running within the bound;
+      - after the endpoint dies, PodCustomMetrics are republished as the
+        last-good samples marked STALE (never silently fresh);
+      - the HPA HOLDS its last decision (replicas unchanged, zero
+        rescales) instead of flapping on a dead scrape pipeline.
     """
     import urllib.request
 
+    from kubernetes1_tpu.api import types as t
     from kubernetes1_tpu.localcluster import LocalCluster
     from kubernetes1_tpu.obs import aggregate
+    from kubernetes1_tpu.obs.appmetrics import AppMetrics, scrape_annotations
     from kubernetes1_tpu.utils import faultline
 
     spec = OBS_SPEC if spec is None else spec
     _begin_seed_run()
     verdict = {"mode": "obs", "seed": seed, "spec": spec, "ok": False}
     cluster = None
+    app = None
     try:
         cluster = LocalCluster(nodes=1, obs=True, obs_interval=0.2).start()
         cluster.wait_ready(40)
         obs = cluster.obs
+        cs = cluster.cs
         # a target that never existed: connection refused on every scrape
         obs.register("ghost", "http://127.0.0.1:1", instance="ghost-0")
+        # annotated serving fleet + Pods-metric HPA, settled BEFORE the
+        # faults: qps exactly on target ⇒ steady desired == 2 replicas
+        app = AppMetrics()
+        app.gauge("ktpu_chaos_qps").set(10.0)
+        app.serve()
+        dep = t.Deployment()
+        dep.metadata.name = "obs-serve"
+        dep.spec.replicas = 2
+        dep.spec.selector = t.LabelSelector(match_labels={"app": "obs-serve"})
+        dep.spec.template.metadata.labels = {"app": "obs-serve"}
+        dep.spec.template.metadata.annotations = scrape_annotations(
+            app.port, host="127.0.0.1")
+        c = t.Container(name="c", image="busybox", command=["serve"])
+        c.resources.requests = {"cpu": "10m"}
+        dep.spec.template.spec.containers = [c]
+        cs.deployments.create(dep)
+        hpa = t.HorizontalPodAutoscaler()
+        hpa.metadata.name = "obs-serve-hpa"
+        hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
+            kind="Deployment", name="obs-serve")
+        hpa.spec.min_replicas = 1
+        hpa.spec.max_replicas = 4
+        hpa.spec.metrics = [t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+            metric_name="ktpu_chaos_qps", target_average_value=10.0))]
+        cs.horizontalpodautoscalers.create(hpa)
+
+        def fleet_running():
+            pods, _ = cs.pods.list(namespace="default",
+                                   label_selector="app=obs-serve")
+            return [p for p in pods
+                    if p.status.phase == t.POD_RUNNING
+                    and not p.metadata.deletion_timestamp]
+
+        t_settle = time.monotonic()
+        while len(fleet_running()) < 2 \
+                and time.monotonic() - t_settle < 30.0:
+            time.sleep(0.2)
+        fleet = fleet_running()
+        # fresh (non-stale) PodCustomMetrics for the whole fleet first —
+        # the stale verdict below must measure the TRANSITION
+        def all_published_fresh():
+            for p in fleet_running():
+                try:
+                    pcm = cs.podcustommetrics.get(
+                        p.metadata.name, "default")
+                except Exception:  # noqa: BLE001 — not published yet
+                    return False
+                if pcm.stale:
+                    return False
+            return True
+
+        while not all_published_fresh() \
+                and time.monotonic() - t_settle < 40.0:
+            time.sleep(0.2)
+        pre_rescales = _hpa_rescales_now()
+
         faultline.activate(seed, spec)
         probes, slow, failed = 0, 0, 0
         max_latency = 0.0
         killed_live_target = False
+        killed_app = False
+        midfault_pod_running = False
+        midfault_pod_created_at = None
         t0 = time.monotonic()
         while time.monotonic() - t0 < duration:
             if not killed_live_target and time.monotonic() - t0 > duration / 2:
@@ -1778,6 +1858,33 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
                     srv.stop()
                     cluster.sli.metrics_server = None  # no double-stop
                 killed_live_target = True
+            if not killed_app and time.monotonic() - t0 > duration / 2:
+                # mid-run: the WORKLOAD endpoint dies — every pod's
+                # scrape starts failing; stale marking + HPA hold are
+                # verdicted after the faults lift
+                app.stop()
+                killed_app = True
+            if midfault_pod_created_at is None \
+                    and time.monotonic() - t0 > 0.5:
+                # kubelet-sync-cadence probe: a plain pod created while
+                # every scrape is faulted must still go Running quickly
+                probe_pod = t.Pod()
+                probe_pod.metadata.name = "obs-sync-probe"
+                probe_pod.spec.containers = [
+                    t.Container(name="c", image="busybox", command=["x"])]
+                try:
+                    cs.pods.create(probe_pod)
+                    midfault_pod_created_at = time.monotonic()
+                except Exception:  # noqa: BLE001 — client faults: retry next tick
+                    pass
+            if midfault_pod_created_at is not None \
+                    and not midfault_pod_running:
+                try:
+                    p = cs.pods.get("obs-sync-probe", "default")
+                    midfault_pod_running = \
+                        p.status.phase == t.POD_RUNNING
+                except Exception:  # noqa: BLE001 — client faults
+                    pass
             p0 = time.monotonic()
             try:
                 with urllib.request.urlopen(
@@ -1794,6 +1901,44 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
         verdict["injected"] = faultline.stats()
         faultline.deactivate()
         time.sleep(1.0)  # faults lifted: live targets re-scrape
+        # sync-cadence probe may turn Running just after the window
+        t_probe = time.monotonic()
+        while not midfault_pod_running \
+                and time.monotonic() - t_probe < 10.0:
+            try:
+                p = cs.pods.get("obs-sync-probe", "default")
+                midfault_pod_running = p.status.phase == t.POD_RUNNING
+            except Exception:  # noqa: BLE001 — settling
+                pass
+            time.sleep(0.2)
+        # stale marking: every fleet pod's PodCustomMetrics republished
+        # stale with the last-good sample intact
+        stale_marked = True
+        last_good_held = True
+        t_stale = time.monotonic()
+        while time.monotonic() - t_stale < 10.0:
+            stale_marked = True
+            last_good_held = True
+            for p in fleet:
+                try:
+                    pcm = cs.podcustommetrics.get(
+                        p.metadata.name, "default")
+                except Exception:  # noqa: BLE001 — deleted/settling
+                    stale_marked = False
+                    continue
+                if not pcm.stale:
+                    stale_marked = False
+                vals = [s.value for s in pcm.samples
+                        if s.name == "ktpu_chaos_qps"]
+                if vals != [10.0]:
+                    last_good_held = False
+            if stale_marked:
+                break
+            time.sleep(0.3)
+        # HPA holds: replicas unchanged, zero rescales across the run
+        replicas_now = cs.deployments.get("obs-serve").spec.replicas
+        hpa_held = (replicas_now == 2
+                    and _hpa_rescales_now() == pre_rescales)
         with urllib.request.urlopen(obs.url + "/metrics", timeout=5) as r:
             parsed = aggregate.parse_metrics_text(r.read().decode())
         up = aggregate.select(parsed, "ktpu_obs_scrape_up")
@@ -1814,12 +1959,26 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
             "live_targets_fresh": live_fresh,
             "scrape_errors": obs.scrape_errors_total,
             "scrapes": obs.scrapes_total,
+            "midfault_pod_running": midfault_pod_running,
+            "stale_samples_marked": stale_marked,
+            "stale_last_good_held": last_good_held,
+            "hpa_held_replicas": hpa_held,
+            "fleet_size": len(fleet),
         })
+        # len(fleet) == 2 guards against a vacuous verdict: with an
+        # empty fleet the stale/last-good loops never run and hpa_held
+        # trivially holds — the phase must have actually come up
         verdict["ok"] = (probes > 0 and failed == 0 and max_latency < 2.0
                          and ghost_down and sli_down and live_fresh
-                         and bool(verdict["injected"].get("obs.scrape")))
+                         and midfault_pod_running and len(fleet) == 2
+                         and stale_marked
+                         and last_good_held and hpa_held
+                         and bool(verdict["injected"].get("obs.scrape"))
+                         and bool(verdict["injected"].get("obs.pod_scrape")))
     finally:
         faultline.deactivate()
+        if app is not None:
+            _stop_quietly_mod(app.stop)
         if cluster is not None:
             _stop_quietly_mod(cluster.stop)
     verdict["acked"] = verdict.get("scrapes", 0)  # summary-shape compat

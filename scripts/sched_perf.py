@@ -518,6 +518,18 @@ def observability_block(obs) -> Optional[dict]:
             "ktpu_endpoints_propagation_seconds", quantile="0.99"),
         "scheduler_queue_churn_purges": total(
             "scheduler_queue_churn_purges_total"),
+        # custom-metrics plane (pod /metrics -> PodCustomMetrics -> HPA):
+        # per-pod scrape freshness and the autoscaling loop's outcomes —
+        # None until a workload opts into scraping / an HPA exists
+        "podscrape_staleness_max_s": worst(
+            "ktpu_podscrape_staleness_seconds"),
+        "podscrape_scrapes": total("ktpu_podscrape_scrapes_total"),
+        "podscrape_errors": total("ktpu_podscrape_errors_total"),
+        "hpa_rescales": total("ktpu_hpa_rescales_total"),
+        "hpa_missing_metric_cycles": total(
+            "ktpu_hpa_missing_metric_cycles_total"),
+        "hpa_reaction_p99_s": worst("ktpu_hpa_reaction_seconds",
+                                    quantile="0.99"),
     }
 
 

@@ -1,0 +1,708 @@
+"""Custom-metrics plane: pod /metrics scraping, the custom-metrics API,
+and metric-driven autoscaling.
+
+Covers the PR's acceptance surface:
+- obs/appmetrics: the workload registry (text format, sliding-window
+  rate gauges) and the scrape annotation contract;
+- kubelet/podscrape: annotated pods scraped on per-pod threads —
+  publishes PodCustomMetrics with the pod's labels + scrape-derived
+  counter rates, marks LAST-GOOD samples stale on endpoint death
+  (never silently fresh), a wedged pod endpoint stalls only its own
+  thread, vanished pods' objects are GC'd;
+- the apiserver's aggregated custom-metrics read path (the
+  custom.metrics.k8s.io GET shape): star/single-pod queries, label
+  selection, stale forwarding;
+- the HPA's v2 evaluation: tolerance band, min/max clamping, Pods-type
+  target-average-value metrics, max-of-metrics, stabilization windows,
+  missing/stale-metrics-skips-cycle — and the v1 CPU shorthand
+  consuming PodMetrics from an informer snapshot (no live GET per pod
+  per cycle);
+- the LocalCluster e2e: an HPA scales a Deployment out AND back driven
+  ONLY by a custom QPS metric scraped from pod /metrics, reaction time
+  reported.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.apiserver import Master
+from kubernetes1_tpu.client import Clientset, InformerFactory
+from kubernetes1_tpu.controllers import podautoscaler as hpa_mod
+from kubernetes1_tpu.controllers.podautoscaler import (
+    HorizontalPodAutoscalerController,
+)
+from kubernetes1_tpu.kubelet.podscrape import PodScraper
+from kubernetes1_tpu.localcluster import LocalCluster
+from kubernetes1_tpu.obs.appmetrics import (
+    AppMetrics,
+    sample_value,
+    scrape_annotations,
+    scrape_target,
+)
+from kubernetes1_tpu.utils.waitutil import must_poll_until
+
+
+def fetch(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def simple_pod(name, node="n1", labels=None, annotations=None,
+               ns="default"):
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    pod.metadata.labels = labels or {}
+    if annotations:
+        pod.metadata.annotations = annotations
+    pod.spec.containers = [t.Container(name="c", image="busybox")]
+    pod.spec.node_name = node
+    return pod
+
+
+# ----------------------------------------------------------- appmetrics
+
+
+class TestAppMetrics:
+    def test_text_format_and_rate_gauge(self):
+        am = AppMetrics(rate_window_s=2.0)
+        am.counter("ktpu_x_requests_total").inc(3)
+        am.gauge("ktpu_x_inflight").set(2)
+        am.histogram("ktpu_x_latency_seconds").observe(0.01)
+        am.mark("ktpu_x_qps", 4)
+        text = am.render()
+        assert "# TYPE ktpu_x_requests_total counter" in text
+        assert "ktpu_x_requests_total 3.0" in text
+        assert "ktpu_x_latency_seconds_bucket" in text
+        # 4 events over a 2s window = 2/s
+        assert "ktpu_x_qps 2.0" in text
+
+    def test_served_endpoint(self):
+        am = AppMetrics().serve()
+        try:
+            am.gauge("ktpu_x_g").set(7.5)
+            assert "ktpu_x_g 7.5" in fetch(am.url + "/metrics")
+        finally:
+            am.stop()
+
+    def test_scrape_annotation_contract(self):
+        pod = simple_pod("p", annotations=scrape_annotations(
+            8080, path="/m", host="127.0.0.1"))
+        assert scrape_target(pod) == "http://127.0.0.1:8080/m"
+        # default host falls back to the pod IP
+        pod2 = simple_pod("p2", annotations=scrape_annotations(8080))
+        pod2.status.pod_ip = "10.0.0.9"
+        assert scrape_target(pod2) == "http://10.0.0.9:8080/metrics"
+        # not annotated / malformed = opted out, never a crash
+        assert scrape_target(simple_pod("p3")) is None
+        bad = simple_pod("p4", annotations={
+            "obs.ktpu.io/scrape-port": "not-a-port"})
+        assert scrape_target(bad) is None
+
+    def test_sample_value_fold(self):
+        pcm = t.PodCustomMetrics(samples=[
+            t.MetricSample(name="ktpu_q", value=5.0),
+            t.MetricSample(name="ktpu_l", value=1.0, labels={"a": "x"}),
+            t.MetricSample(name="ktpu_l", value=2.0, labels={"a": "y"}),
+        ])
+        assert sample_value(pcm, "ktpu_q") == 5.0
+        assert sample_value(pcm, "ktpu_l") == 3.0  # labeled children sum
+        assert sample_value(pcm, "ktpu_missing") is None
+
+
+# ---------------------------------------------------------- pod scraper
+
+
+@pytest.fixture()
+def master():
+    m = Master(port=0).start()
+    cs = Clientset(m.url)
+    yield m, cs
+    cs.close()
+    m.stop()
+
+
+class TestPodScraper:
+    def _scraped_pod(self, cs, am, name="p1", labels=None):
+        pod = simple_pod(name, labels=labels or {"app": "x"},
+                         annotations=scrape_annotations(
+                             am.port, host="127.0.0.1"))
+        cs.pods.create(pod)
+        pods, _ = cs.pods.list()
+        return pods
+
+    def test_publishes_samples_labels_and_rates(self, master):
+        _m, cs = master
+        am = AppMetrics().serve()
+        am.gauge("ktpu_t_qps").set(42.0)
+        am.counter("ktpu_t_requests_total").inc(10)
+        ps = PodScraper(cs, "n1", interval=0.1)
+        try:
+            ps.reconcile(self._scraped_pod(cs, am))
+            must_poll_until(
+                lambda: _pcm_or_none(cs, "p1") is not None,
+                timeout=10.0, desc="PodCustomMetrics published")
+            pcm = cs.podcustommetrics.get("p1", "default")
+            assert pcm.stale is False
+            assert pcm.metadata.labels == {"app": "x"}  # pod labels copied
+            assert sample_value(pcm, "ktpu_t_qps") == 42.0
+            assert sample_value(pcm, "ktpu_t_requests_total") == 10.0
+            # counter rate derived between scrapes: bump and watch
+            am.counter("ktpu_t_requests_total").inc(100)
+
+            def rate_seen():
+                pcm = _pcm_or_none(cs, "p1")
+                v = pcm and sample_value(
+                    pcm, "ktpu_t_requests_total:rate")
+                return v is not None and v > 0
+            must_poll_until(rate_seen, timeout=10.0, desc="derived rate")
+        finally:
+            ps.stop()
+            am.stop()
+
+    def test_endpoint_death_marks_stale_keeps_last_good(self, master):
+        _m, cs = master
+        am = AppMetrics().serve()
+        am.gauge("ktpu_t_qps").set(9.0)
+        ps = PodScraper(cs, "n1", interval=0.1)
+        try:
+            ps.reconcile(self._scraped_pod(cs, am))
+            must_poll_until(
+                lambda: (_pcm_or_none(cs, "p1") or t.PodCustomMetrics(
+                    stale=True)).stale is False,
+                timeout=10.0, desc="fresh publish")
+            am.stop()  # the workload dies
+            must_poll_until(
+                lambda: (_pcm_or_none(cs, "p1")
+                         or t.PodCustomMetrics()).stale,
+                timeout=10.0, desc="stale marked")
+            pcm = cs.podcustommetrics.get("p1", "default")
+            # last-good samples survive the death, marked stale
+            assert sample_value(pcm, "ktpu_t_qps") == 9.0
+            text = ps.render_metrics()
+            assert 'ktpu_podscrape_up{pod="default/p1"} 0' in text
+        finally:
+            ps.stop()
+
+    def test_restart_adopts_and_stale_marks_preexisting_object(
+            self, master):
+        """Kubelet restart mid-outage: a NEW scraper (no in-memory
+        last-good) must find the pre-restart PodCustomMetrics still
+        claiming stale=False and mark it stale with its samples held —
+        else consumers read a dead endpoint's last samples as live
+        truth for the whole outage."""
+        _m, cs = master
+        am = AppMetrics().serve()
+        am.gauge("ktpu_t_qps").set(7.0)
+        ps = PodScraper(cs, "n1", interval=0.1)
+        try:
+            pods = self._scraped_pod(cs, am)
+            ps.reconcile(pods)
+            must_poll_until(
+                lambda: (_pcm_or_none(cs, "p1") or t.PodCustomMetrics(
+                    stale=True)).stale is False,
+                timeout=10.0, desc="fresh publish")
+            ps.stop()   # the kubelet dies...
+            am.stop()   # ...and so does the workload endpoint
+            assert cs.podcustommetrics.get("p1", "default").stale is False
+            ps2 = PodScraper(cs, "n1", interval=0.1)  # restarted kubelet
+            try:
+                ps2.reconcile(pods)
+                must_poll_until(
+                    lambda: (_pcm_or_none(cs, "p1")
+                             or t.PodCustomMetrics()).stale,
+                    timeout=10.0, desc="adopted object stale-marked")
+                # the pre-restart last-good samples survive the adoption
+                pcm = cs.podcustommetrics.get("p1", "default")
+                assert sample_value(pcm, "ktpu_t_qps") == 7.0
+            finally:
+                ps2.stop()
+        finally:
+            ps.stop()
+            am.stop()
+
+    def test_dead_endpoint_stalls_only_its_own_thread(self, master):
+        """The faultline-invariant shape, node-local: pod A's endpoint
+        is a black hole (accepts, never answers); pod B's samples keep
+        flowing and reconcile never blocks."""
+        _m, cs = master
+        # black hole server: accepts connections, never responds
+        import socket as _socket
+
+        hole = _socket.socket()
+        hole.bind(("127.0.0.1", 0))
+        hole.listen(8)
+        hole_port = hole.getsockname()[1]
+        am = AppMetrics().serve()
+        am.gauge("ktpu_t_qps").set(5.0)
+        pod_a = simple_pod("hole", annotations={
+            "obs.ktpu.io/scrape-port": str(hole_port),
+            "obs.ktpu.io/scrape-host": "127.0.0.1"})
+        pod_b = simple_pod("live", annotations=scrape_annotations(
+            am.port, host="127.0.0.1"))
+        cs.pods.create(pod_a)
+        cs.pods.create(pod_b)
+        pods, _ = cs.pods.list()
+        ps = PodScraper(cs, "n1", interval=0.1, fetch_timeout=1.0)
+        try:
+            t0 = time.monotonic()
+            ps.reconcile(pods)
+            assert time.monotonic() - t0 < 0.5  # reconcile never scrapes
+            must_poll_until(
+                lambda: _pcm_or_none(cs, "live") is not None,
+                timeout=10.0, desc="live pod published")
+            # the live pod's samples keep updating while the hole wedges
+            am.gauge("ktpu_t_qps").set(6.0)
+            must_poll_until(
+                lambda: sample_value(_pcm_or_none(cs, "live"),
+                                     "ktpu_t_qps") == 6.0,
+                timeout=10.0, desc="live pod stays fresh")
+            assert _pcm_or_none(cs, "hole") is None  # never answered
+        finally:
+            ps.stop()
+            am.stop()
+            hole.close()
+
+    def test_vanished_pod_object_gcd(self, master):
+        _m, cs = master
+        am = AppMetrics().serve()
+        am.gauge("ktpu_t_qps").set(1.0)
+        ps = PodScraper(cs, "n1", interval=0.1)
+        try:
+            ps.reconcile(self._scraped_pod(cs, am))
+            must_poll_until(
+                lambda: _pcm_or_none(cs, "p1") is not None,
+                timeout=10.0, desc="published")
+            ps.reconcile([])  # pod gone
+            must_poll_until(
+                lambda: _pcm_or_none(cs, "p1") is None,
+                timeout=10.0, desc="object GC'd")
+        finally:
+            ps.stop()
+            am.stop()
+
+    def test_unannotated_pods_cost_nothing(self, master):
+        _m, cs = master
+        ps = PodScraper(cs, "n1", interval=0.1)
+        try:
+            cs.pods.create(simple_pod("plain"))
+            pods, _ = cs.pods.list()
+            ps.reconcile(pods)
+            assert ps.targets() == []
+        finally:
+            ps.stop()
+
+
+def _pcm_or_none(cs, name, ns="default"):
+    try:
+        return cs.podcustommetrics.get(name, ns)
+    except Exception:  # noqa: BLE001 — NotFound/settling
+        return None
+
+
+# ------------------------------------------------- custom-metrics API
+
+
+class TestCustomMetricsAPI:
+    def _seed(self, cs):
+        for i, (app, stale) in enumerate(
+                [("a", False), ("a", False), ("b", True)]):
+            pcm = t.PodCustomMetrics(
+                timestamp="ts", stale=stale,
+                samples=[t.MetricSample(name="ktpu_q", value=float(i + 1))])
+            pcm.metadata.name = f"p{i}"
+            pcm.metadata.labels = {"app": app}
+            cs.podcustommetrics.create(pcm, "default")
+
+    def test_star_query_and_label_selection(self, master):
+        m, cs = master
+        self._seed(cs)
+        base = (m.url + "/apis/custom.metrics.k8s.io/v1"
+                "/namespaces/default/pods")
+        data = json.loads(fetch(f"{base}/*/ktpu_q"))
+        assert data["kind"] == "MetricValueList"
+        rows = {(i["describedObject"]["name"], i["value"], i["stale"])
+                for i in data["items"]}
+        assert rows == {("p0", 1.0, False), ("p1", 2.0, False),
+                        ("p2", 3.0, True)}  # stale forwarded, not dropped
+        sel = json.loads(fetch(f"{base}/*/ktpu_q?labelSelector=app%3Da"))
+        assert {i["describedObject"]["name"] for i in sel["items"]} \
+            == {"p0", "p1"}
+
+    def test_single_pod_and_missing_404(self, master):
+        m, cs = master
+        self._seed(cs)
+        base = (m.url + "/apis/custom.metrics.k8s.io/v1"
+                "/namespaces/default/pods")
+        one = json.loads(fetch(f"{base}/p1/ktpu_q"))
+        assert [i["value"] for i in one["items"]] == [2.0]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            fetch(f"{base}/p1/ktpu_nope")
+        assert ei.value.code == 404
+
+
+# ------------------------------------------------------------ HPA units
+
+
+@pytest.fixture()
+def hpa_rig():
+    """Master + a synchronously-driven HPA controller: informers run,
+    workers don't — tests call _reconcile directly for deterministic
+    cycles."""
+    m = Master(port=0).start()
+    cs = Clientset(m.url)
+    factory = InformerFactory(cs)
+    ctrl = HorizontalPodAutoscalerController(cs, factory)
+    ctrl.setup()
+    factory.start_all()
+    factory.wait_for_sync()
+    yield m, cs, ctrl
+    factory.stop_all()
+    cs.close()
+    m.stop()
+
+
+def make_rs(cs, name="workers", replicas=2, app="w"):
+    rs = t.ReplicaSet()
+    rs.metadata.name = name
+    rs.spec.replicas = replicas
+    rs.spec.selector = t.LabelSelector(match_labels={"app": app})
+    rs.spec.template.metadata.labels = {"app": app}
+    rs.spec.template.spec.containers = [
+        t.Container(name="c", image="busybox",
+                    resources=t.ResourceRequirements(
+                        requests={"cpu": "100m"}))]
+    return cs.replicasets.create(rs)
+
+
+def make_running_pod(cs, name, app="w", cpu="100m"):
+    pod = simple_pod(name, labels={"app": app})
+    pod.spec.containers[0].resources = t.ResourceRequirements(
+        requests={"cpu": cpu})
+    created = cs.pods.create(pod)
+    created.status.phase = t.POD_RUNNING
+    return cs.pods.update_status(created)
+
+
+def put_pcm(cs, pod_name, qps, stale=False, metric="ktpu_q"):
+    cur = _pcm_or_none(cs, pod_name)
+    pcm = t.PodCustomMetrics(
+        timestamp="ts", stale=stale,
+        samples=[t.MetricSample(name=metric, value=float(qps))])
+    pcm.metadata.name = pod_name
+    pcm.metadata.namespace = "default"
+    if cur is not None:
+        pcm.metadata.resource_version = cur.metadata.resource_version
+        return cs.podcustommetrics.update(pcm)
+    return cs.podcustommetrics.create(pcm, "default")
+
+
+def pods_hpa(name="workers-hpa", target=10.0, min_r=1, max_r=5,
+             metric="ktpu_q", kind="ReplicaSet", tname="workers"):
+    hpa = t.HorizontalPodAutoscaler()
+    hpa.metadata.name = name
+    hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
+        kind=kind, name=tname)
+    hpa.spec.min_replicas = min_r
+    hpa.spec.max_replicas = max_r
+    hpa.spec.metrics = [t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+        metric_name=metric, target_average_value=target))]
+    return hpa
+
+
+def _wait_informers(ctrl, cs, pods=(), pcms=(), hpas=()):
+    must_poll_until(
+        lambda: all(ctrl.pods.get(f"default/{p}") is not None
+                    for p in pods)
+        and all((ctrl.podcustommetrics.get(f"default/{p}") or
+                 t.PodCustomMetrics()).metadata.name == p for p in pcms)
+        and all(ctrl.hpas.get(f"default/{h}") is not None for h in hpas),
+        timeout=10.0, desc="informers caught up")
+
+
+class TestHPAEvaluation:
+    def _prep(self, cs, ctrl, replicas=2, qps=(), hpa=None):
+        make_rs(cs, replicas=replicas)
+        for i, q in enumerate(qps):
+            make_running_pod(cs, f"w{i}")
+            put_pcm(cs, f"w{i}", q)
+        hpa = hpa or pods_hpa()
+        created = cs.horizontalpodautoscalers.create(hpa)
+        _wait_informers(
+            ctrl, cs, pods=[f"w{i}" for i in range(len(qps))],
+            pcms=[f"w{i}" for i in range(len(qps))],
+            hpas=[hpa.metadata.name])
+        return created
+
+    def _sync_pcm(self, ctrl, name, stale=None, value=None,
+                  metric="ktpu_q"):
+        def caught_up():
+            pcm = ctrl.podcustommetrics.get(f"default/{name}")
+            if pcm is None:
+                return False
+            if stale is not None and pcm.stale != stale:
+                return False
+            if value is not None \
+                    and sample_value(pcm, metric) != value:
+                return False
+            return True
+        must_poll_until(caught_up, timeout=10.0, desc="pcm informer")
+
+    def test_tolerance_band_holds(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=2, qps=(10.5, 10.5))
+        ctrl._reconcile(hpa)
+        assert cs.replicasets.get("workers").spec.replicas == 2  # ±10%
+
+    def test_scale_out_and_clamp_to_max(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=2, qps=(100.0, 100.0))
+        ctrl._reconcile(hpa)
+        # ceil(2 * 100/10) = 20, clamped to max 5
+        assert cs.replicasets.get("workers").spec.replicas == 5
+
+    def test_scale_down_and_clamp_to_min(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=2, qps=(0.1, 0.1),
+                         hpa=pods_hpa(min_r=2))
+        ctrl._reconcile(hpa)
+        assert cs.replicasets.get("workers").spec.replicas == 2  # min clamp
+
+    def test_missing_metrics_skip_cycle(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=3, qps=())
+        make_running_pod(cs, "w0")  # a pod with NO PodCustomMetrics
+        _wait_informers(ctrl, cs, pods=["w0"])
+        before = hpa_mod.hpa_missing_metric_cycles_total.value
+        ctrl._reconcile(hpa)
+        assert cs.replicasets.get("workers").spec.replicas == 3  # held
+        assert hpa_mod.hpa_missing_metric_cycles_total.value == before + 1
+
+    def test_stale_metrics_count_as_missing(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=3, qps=(100.0,))
+        put_pcm(cs, "w0", 100.0, stale=True)
+        self._sync_pcm(ctrl, "w0", stale=True)
+        ctrl._reconcile(hpa)
+        # the only sample is stale -> no usable signal -> hold
+        assert cs.replicasets.get("workers").spec.replicas == 3
+
+    def test_partial_outage_blocks_scale_down(self, hpa_rig):
+        """One metric readable and idle, the other missing: scale-UP on
+        the readable subset is safe (max-of-metrics — a missing vote
+        could only raise desired), but a scale-DOWN must hold — the
+        missing metric might be the saturated one."""
+        _m, cs, ctrl = hpa_rig
+        make_rs(cs, replicas=4)
+        make_running_pod(cs, "w0")
+        pcm = t.PodCustomMetrics(timestamp="ts", samples=[
+            t.MetricSample(name="ktpu_a", value=0.5)])  # idle
+        pcm.metadata.name = "w0"
+        cs.podcustommetrics.create(pcm, "default")
+        hpa = pods_hpa(max_r=10)
+        hpa.spec.metrics = [
+            t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+                metric_name="ktpu_a", target_average_value=10.0)),
+            t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+                metric_name="ktpu_missing", target_average_value=10.0)),
+        ]
+        created = cs.horizontalpodautoscalers.create(hpa)
+        _wait_informers(ctrl, cs, pods=["w0"], pcms=["w0"],
+                        hpas=["workers-hpa"])
+        before = hpa_mod.hpa_missing_metric_cycles_total.value
+        ctrl._reconcile(created)
+        # ktpu_a alone says drain to min — held instead, and counted
+        assert cs.replicasets.get("workers").spec.replicas == 4
+        assert hpa_mod.hpa_missing_metric_cycles_total.value == before + 1
+
+    def test_multi_metric_max_wins(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        make_rs(cs, replicas=2)
+        make_running_pod(cs, "w0")
+        # two Pods metrics: one on target (no change), one 3x over
+        pcm = t.PodCustomMetrics(timestamp="ts", samples=[
+            t.MetricSample(name="ktpu_a", value=10.0),
+            t.MetricSample(name="ktpu_b", value=30.0)])
+        pcm.metadata.name = "w0"
+        cs.podcustommetrics.create(pcm, "default")
+        hpa = pods_hpa(max_r=10)
+        hpa.spec.metrics = [
+            t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+                metric_name="ktpu_a", target_average_value=10.0)),
+            t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+                metric_name="ktpu_b", target_average_value=10.0)),
+        ]
+        created = cs.horizontalpodautoscalers.create(hpa)
+        _wait_informers(ctrl, cs, pods=["w0"], pcms=["w0"],
+                        hpas=["workers-hpa"])
+        ctrl._reconcile(created)
+        # ktpu_a says stay at 2, ktpu_b says ceil(2*3)=6 -> max wins
+        assert cs.replicasets.get("workers").spec.replicas == 6
+
+    def test_cpu_shorthand_uses_informer_snapshot(self, hpa_rig):
+        """The v1 CPU path consumes PodMetrics via the informer — and
+        still scales exactly as before."""
+        _m, cs, ctrl = hpa_rig
+        make_rs(cs, replicas=1)
+        make_running_pod(cs, "w0", cpu="100m")
+        pm = t.PodMetrics(timestamp="ts", containers=[
+            t.ContainerMetrics(name="c", usage={"cpu": "400m"})])
+        pm.metadata.name = "w0"
+        cs.podmetrics.create(pm, "default")
+        hpa = t.HorizontalPodAutoscaler()
+        hpa.metadata.name = "cpu-hpa"
+        hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
+            kind="ReplicaSet", name="workers")
+        hpa.spec.min_replicas = 1
+        hpa.spec.max_replicas = 4
+        hpa.spec.target_cpu_utilization_percentage = 100
+        created = cs.horizontalpodautoscalers.create(hpa)
+        _wait_informers(ctrl, cs, pods=["w0"], hpas=["cpu-hpa"])
+        must_poll_until(
+            lambda: ctrl.podmetrics.get("default/w0") is not None,
+            timeout=10.0, desc="podmetrics informer")
+        ctrl._reconcile(created)
+        # 400% of request vs 100% target -> ceil(1*4) = 4
+        assert cs.replicasets.get("workers").spec.replicas == 4
+        st = cs.horizontalpodautoscalers.get("cpu-hpa").status
+        assert st.current_cpu_utilization_percentage == 400
+        assert st.current_metric_values == {}  # v1 status shape untouched
+
+    def test_scale_down_stabilization_window(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = pods_hpa()
+        hpa.spec.scale_down_stabilization_seconds = 1.0
+        # per-pod average exactly on target: the window seeds with a
+        # stay-at-4 recommendation
+        hpa = self._prep(cs, ctrl, replicas=4, qps=(10.0,), hpa=hpa)
+        ctrl._reconcile(hpa)  # recommendation: stay at 4
+        assert cs.replicasets.get("workers").spec.replicas == 4
+        put_pcm(cs, "w0", 1.0)
+        self._sync_pcm(ctrl, "w0", value=1.0)
+        ctrl._reconcile(hpa)  # low, but the 4-rec is inside the window
+        assert cs.replicasets.get("workers").spec.replicas == 4
+        time.sleep(1.1)  # window passes
+        ctrl._reconcile(hpa)
+        assert cs.replicasets.get("workers").spec.replicas == 1
+
+    def test_scale_up_stabilization_window(self, hpa_rig):
+        _m, cs, ctrl = hpa_rig
+        hpa = pods_hpa()
+        hpa.spec.scale_up_stabilization_seconds = 1.0
+        hpa = self._prep(cs, ctrl, replicas=1, qps=(10.0,), hpa=hpa)
+        ctrl._reconcile(hpa)  # on target: window seeded with rec=1
+        assert cs.replicasets.get("workers").spec.replicas == 1
+        put_pcm(cs, "w0", 50.0)
+        self._sync_pcm(ctrl, "w0", value=50.0)
+        ctrl._reconcile(hpa)  # spike, but min-of-window is still 1
+        assert cs.replicasets.get("workers").spec.replicas == 1
+        time.sleep(1.1)
+        ctrl._reconcile(hpa)  # the spike survived the window
+        assert cs.replicasets.get("workers").spec.replicas == 5
+
+    def test_rescale_emits_metrics_and_flightrec(self, hpa_rig):
+        from kubernetes1_tpu.utils import flightrec
+
+        _m, cs, ctrl = hpa_rig
+        flightrec.reset()
+        before = hpa_mod.rescales_snapshot()
+        hpa = self._prep(cs, ctrl, replicas=1, qps=(100.0,))
+        ctrl._reconcile(hpa)
+        assert cs.replicasets.get("workers").spec.replicas == 5
+        assert hpa_mod.rescales_snapshot() == before + 1
+        ev = flightrec.last_event("hpa")
+        assert ev is not None and ev["kind"] == flightrec.HPA_RESCALE
+        assert ev["from_replicas"] == 1 and ev["to_replicas"] == 5
+        assert hpa_mod.hpa_reaction_seconds.count >= 1
+
+    def test_status_conflict_absorbed(self, hpa_rig):
+        """The satellite: a conflicting concurrent status writer must
+        not kill the cycle — the retry re-reads and lands the write."""
+        _m, cs, ctrl = hpa_rig
+        hpa = self._prep(cs, ctrl, replicas=2, qps=(10.0, 10.0))
+        # racing writer: bump the HPA between the controller's get and
+        # update by pre-bumping generation via a metadata update
+        fresh = cs.horizontalpodautoscalers.get("workers-hpa")
+        fresh.metadata.labels = {"race": "1"}
+        cs.horizontalpodautoscalers.update(fresh)
+        ctrl._reconcile(hpa)  # stale hpa object in hand: must still land
+        st = cs.horizontalpodautoscalers.get("workers-hpa").status
+        assert st.current_replicas == 2
+
+
+# --------------------------------------------------------------- e2e
+
+
+class TestAutoscaleE2E:
+    def test_qps_scrape_drives_scale_out_and_back(self):
+        """THE acceptance e2e: a Deployment scaled out AND back by an
+        HPA whose only signal is a custom QPS metric scraped off pod
+        /metrics, with the reaction time reported."""
+        cluster = LocalCluster(nodes=1).start()
+        am = AppMetrics()
+        try:
+            cluster.wait_ready(40)
+            cs = cluster.cs
+            qps = am.gauge("ktpu_e2e_qps")
+            qps.set(10.0)
+            am.serve()
+            dep = t.Deployment()
+            dep.metadata.name = "serve"
+            dep.spec.replicas = 1
+            dep.spec.selector = t.LabelSelector(
+                match_labels={"app": "serve"})
+            dep.spec.template.metadata.labels = {"app": "serve"}
+            dep.spec.template.metadata.annotations = scrape_annotations(
+                am.port, host="127.0.0.1")
+            c = t.Container(name="c", image="busybox", command=["serve"])
+            c.resources.requests = {"cpu": "10m"}
+            dep.spec.template.spec.containers = [c]
+            cs.deployments.create(dep)
+            hpa = pods_hpa(name="serve-hpa", target=10.0, min_r=1,
+                           max_r=3, metric="ktpu_e2e_qps",
+                           kind="Deployment", tname="serve")
+            cs.horizontalpodautoscalers.create(hpa)
+
+            def replicas():
+                return cs.deployments.get("serve").spec.replicas or 0
+
+            must_poll_until(lambda: replicas() == 1, timeout=30.0,
+                            desc="steady at 1 (qps on target)")
+            reaction_count_before = hpa_mod.hpa_reaction_seconds.count
+            qps.set(50.0)
+            t0 = time.monotonic()
+            must_poll_until(lambda: replicas() == 3, timeout=40.0,
+                            desc="scale-out to max on 5x qps")
+            out_reaction = time.monotonic() - t0
+            qps.set(1.0)
+            t1 = time.monotonic()
+            must_poll_until(lambda: replicas() == 1, timeout=40.0,
+                            desc="scale-back on idle qps")
+            back_reaction = time.monotonic() - t1
+            # reaction time reported: the SLI histogram observed the
+            # out-of-band -> rescale-landed windows
+            assert hpa_mod.hpa_reaction_seconds.count \
+                > reaction_count_before
+            print(f"\nscale-out reaction {out_reaction:.2f}s, "
+                  f"scale-back {back_reaction:.2f}s, hpa-observed p99 "
+                  f"{hpa_mod.hpa_reaction_seconds.quantile(0.99)}")
+            # status carries the observed custom metric
+            st = cs.horizontalpodautoscalers.get("serve-hpa").status
+            assert "ktpu_e2e_qps" in st.current_metric_values
+            # the fleet view shows the whole loop
+            topo = json.loads(fetch(cluster.obs.url + "/debug/topology"))
+            scaling = topo["scaling"]
+            assert scaling["pod_scrape"]  # kubelet scrape health present
+            assert "default/serve-hpa" in scaling["hpas"]
+            fleet = fetch(cluster.obs.url + "/metrics")
+            assert "ktpu_hpa_desired_replicas" in fleet
+            assert "ktpu_podscrape_scrapes_total" in fleet
+        finally:
+            am.stop()
+            cluster.stop()

@@ -724,3 +724,42 @@ def test_ktpu011_fires_on_keyword_flightrec_kind():
     """
     findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
     assert len(findings) == 1 and "sneaky_kind" in findings[0].message
+
+
+def test_ktpu011_covers_appmetrics_construction_sites():
+    """Workload AppMetrics series ride the kubelet scrape pipeline into
+    the fleet merge — an unprefixed workload metric collides exactly
+    like an unprefixed component one, at BOTH construction shapes."""
+    src = """
+        from kubernetes1_tpu.obs.appmetrics import AppMetrics
+
+        am = AppMetrics()
+        am.counter("workload_requests_total")  # attr form
+    """
+    findings = [f for f in _lint(src) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1
+    assert "workload_requests_total" in findings[0].message
+    # classes re-exported from an appmetrics module gate like
+    # utils.metrics imports
+    src2 = """
+        from kubernetes1_tpu.obs.appmetrics import Counter
+
+        c = Counter("bare_name_total")
+    """
+    findings2 = [f for f in _lint(src2) if f.pass_id == "KTPU011"]
+    assert len(findings2) == 1 and "bare_name_total" in findings2[0].message
+
+
+def test_ktpu011_quiet_on_prefixed_appmetrics_and_hpa_rescale_kind():
+    src = """
+        from kubernetes1_tpu.obs.appmetrics import AppMetrics
+        from kubernetes1_tpu.utils import flightrec
+
+        am = AppMetrics()
+        am.gauge("ktpu_llama_qps")
+        am.histogram("ktpu_llama_request_latency_seconds")
+
+        def f():
+            flightrec.note("hpa", flightrec.HPA_RESCALE, to_replicas=3)
+    """
+    assert _ids(src) == []

@@ -59,7 +59,7 @@ def test_llama_3d_sharded_train_step():
 def test_llama_param_shardings_applied():
     mesh = sh.make_mesh(dp=1, fsdp=2, tp=2, devices=jax.devices()[:4])
     cfg = llama.tiny()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params, _, _ = llama.make_train_state(cfg, mesh)
     wq = params["layers"]["wq"]
     # (L, d, heads*hd) sharded (None, fsdp, tp) -> each shard d/2 x cols/2
@@ -75,7 +75,7 @@ def test_llama_loss_matches_unsharded():
     mesh8 = sh.make_mesh(dp=2, fsdp=2, tp=2)
     losses = []
     for mesh in (mesh1, mesh8):
-        with jax.set_mesh(mesh):
+        with sh.use_mesh(mesh):
             params, _, _ = llama.make_train_state(cfg, mesh)
             losses.append(float(jax.jit(lambda p, t: llama.loss_fn(cfg, p, t))(params, tokens)))
     assert abs(losses[0] - losses[1]) < 5e-2  # bf16 tolerance
@@ -122,7 +122,7 @@ def test_bert_mlm_sharded_train_step():
 def test_bert_param_shardings_applied():
     mesh = sh.make_mesh(dp=1, fsdp=2, tp=2, devices=jax.devices()[:4])
     cfg = bert.tiny()
-    with jax.set_mesh(mesh):
+    with sh.use_mesh(mesh):
         params, _, _ = bert.make_train_state(cfg, mesh)
     w_in = params["layers"]["w_in"]
     shard_shape = w_in.sharding.shard_shape(w_in.shape)
@@ -137,7 +137,7 @@ def test_bert_loss_matches_unsharded():
     mesh8 = sh.make_mesh(dp=2, fsdp=2, tp=2)
     losses = []
     for mesh in (mesh1, mesh8):
-        with jax.set_mesh(mesh):
+        with sh.use_mesh(mesh):
             params, _, _ = bert.make_train_state(cfg, mesh, seed=0)
             losses.append(float(bert.mlm_loss_fn(cfg, params, tokens, mask)))
     np.testing.assert_allclose(losses[0], losses[1], rtol=2e-2)
@@ -148,7 +148,7 @@ def test_bert_masked_positions_drive_loss():
     cfg = bert.tiny()
     tokens, _ = bert.synthetic_batch(cfg, 2, 8)
     mesh1 = sh.make_mesh(dp=1, fsdp=1, tp=1, devices=jax.devices()[:1])
-    with jax.set_mesh(mesh1):
+    with sh.use_mesh(mesh1):
         params, _, _ = bert.make_train_state(cfg, mesh1)
         full = jnp.ones_like(tokens)
         one = jnp.zeros_like(tokens).at[0, 0].set(1)

@@ -11,8 +11,13 @@ depends on:
    ``scheduler_`` prefix (``scheduler_`` mirrors the reference's
    scheduler metric names verbatim — the bench's comparison axis).
    Checked at construction sites: ``Counter("name")`` / ``Gauge`` /
-   ``Histogram`` (when imported from utils.metrics) and
-   ``<registry>.counter("name")`` / ``.gauge`` / ``.histogram``.
+   ``Histogram`` (when imported from a ``metrics`` or ``appmetrics``
+   module) and ``<registry>.counter("name")`` / ``.gauge`` /
+   ``.histogram`` — the attribute form covers component registries AND
+   workload ``AppMetrics`` instances (obs/appmetrics.py), whose series
+   the kubelet scrape agent lifts into PodCustomMetrics and the fleet
+   merge then folds in: an unprefixed workload metric collides exactly
+   like an unprefixed component one.
 
 2. **Flight-recorder kinds come from the declared enum.**
    ``flightrec.note(component, kind, ...)`` call sites must reference a
@@ -35,12 +40,14 @@ _ALLOWED_PREFIXES = ("ktpu_", "scheduler_")
 
 
 def _metric_imports(tree: ast.Module) -> Set[str]:
-    """Metric class names this module imports FROM a metrics module —
-    the gate that keeps collections.Counter et al. out of scope."""
+    """Metric class names this module imports FROM a metrics module
+    (utils.metrics or obs.appmetrics) — the gate that keeps
+    collections.Counter et al. out of scope."""
     out: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom) and node.module \
-                and node.module.rsplit(".", 1)[-1] == "metrics":
+                and node.module.rsplit(".", 1)[-1] in (
+                    "metrics", "appmetrics"):
             for alias in node.names:
                 if alias.name in _METRIC_CLASSES:
                     out.add(alias.asname or alias.name)
