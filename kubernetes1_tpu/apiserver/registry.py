@@ -15,7 +15,7 @@ import string
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ..utils import flightrec, locksan
+from ..utils import flightrec, invariants, locksan
 
 from ..api import types as t
 from ..machinery import (
@@ -1052,7 +1052,13 @@ class Registry:
                 res = per.get("resource") or per.get("name") or ""
                 for cid in per.get("assigned") or []:
                     # committed state: no pending window, the store is
-                    # already the proof
+                    # already the proof.  Probe: two bound pods holding
+                    # one chip IN THE STORE is corruption upstream of
+                    # this index — surface it at seed time
+                    cur = self._device_claims.get((node, res, cid))
+                    invariants.no_double_alloc(
+                        "registry.claims.seed", (node, res, cid), uid,
+                        cur[1] if cur is not None else None)
                     self._device_claims[(node, res, cid)] = (key, uid, 0.0)
         self._claims_seeded = True
 
@@ -1102,6 +1108,13 @@ class Registry:
                     deadline = (time.monotonic()
                                 + self.CLAIM_PENDING_GRACE_SECONDS)
                     for k in wanted:
+                        # probe: the conflicts scan above and this insert
+                        # must stay in ONE critical section — a refactor
+                        # that separates them double-allocates chips
+                        cur = self._device_claims.get(k)
+                        invariants.no_double_alloc(
+                            "registry.claims", k, uid,
+                            cur[1] if cur is not None else None)
                         self._device_claims[k] = (pod_key, uid, deadline)
                     return wanted
             # verify the colliding claims OUTSIDE the lock (store reads)

@@ -302,7 +302,7 @@ class CLI:
             pending = still
             if not pending or time.monotonic() >= deadline:
                 break
-            time.sleep(1.0)
+            time.sleep(1.0)  # ktpulint: ignore[KTPU013] drain re-attempt pacing for PDB-blocked evictions — a fixed operator-visible cadence, bounded by --timeout
         if pending:
             # every leftover is reported, and the node is NOT declared
             # drained — scripted maintenance must see the failure
@@ -982,7 +982,7 @@ class CLI:
                 if cond == "delete":
                     print(f"{plural}/{name} condition met", file=self.out)
                     return
-                time.sleep(0.3)
+                time.sleep(0.3)  # ktpulint: ignore[KTPU013] `ktpu wait` condition poll — fixed operator-facing cadence, bounded by --timeout
                 continue
             ok = False
             if cond == "ready" and obj.KIND == "Pod":
@@ -996,7 +996,7 @@ class CLI:
             if ok:
                 print(f"{plural}/{name} condition met", file=self.out)
                 return
-            time.sleep(0.3)
+            time.sleep(0.3)  # ktpulint: ignore[KTPU013] `ktpu wait` condition poll — fixed operator-facing cadence, bounded by --timeout
         raise SystemExit(f"error: timed out waiting for {args.condition} on {plural}/{name}")
 
     # ------------------------------------------------------------- misc

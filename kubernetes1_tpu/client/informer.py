@@ -20,7 +20,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..api import types as t
 from ..machinery import ApiError, TooOldResourceVersion
-from ..utils import flightrec, locksan, mutsan
+from ..utils import flightrec, invariants, locksan, mutsan
 from ..utils.metrics import Counter, Histogram
 from . import retry as _retry
 from .clientset import Clientset, ResourceClient
@@ -383,8 +383,15 @@ class SharedInformer:
                         self._observe_lag(meta)
                         continue
                     obj = self._shared(self.client.scheme.decode(obj_dict))
+                    prev_rv = rv
                     if "." not in str(rv):
                         rv = obj.metadata.resource_version or rv
+                    # probe: the composite-sticky rule — a sharded
+                    # ("shard.counter") resume point must never regress
+                    # to a bare per-object revision (resuming there
+                    # replays or skips whole shards)
+                    invariants.composite_sticky("informer.resume",
+                                                prev_rv, rv)
                     key = self._key(obj)
                     if ev_type == "DELETED":
                         with self._lock:

@@ -27,6 +27,7 @@ import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
+from ..client.retry import Backoff
 from ..utils import faultline, locksan
 
 DEFAULT_PLUGIN_DIR = "/var/lib/ktpu/device-plugins"
@@ -230,6 +231,7 @@ class PluginClient:
         # a beat before listen() — the plugin watcher (and tests) race
         # that gap and must not fail a plugin that is 10ms from ready
         deadline = time.monotonic() + retry_window
+        backoff = Backoff(base=0.02, factor=2.0, cap=0.1)
         while True:
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(self.timeout)
@@ -240,7 +242,7 @@ class PluginClient:
                 conn.close()
                 if time.monotonic() >= deadline:
                     raise
-                time.sleep(0.05)
+                backoff.sleep()
 
     def _ensure(self):
         if self._conn is None:

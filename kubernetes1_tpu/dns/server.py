@@ -24,6 +24,7 @@ import struct
 import threading
 from typing import List, Optional, Tuple
 
+from ..utils import faultline
 from ..utils.logutil import RateLimitedReporter
 
 DEFAULT_DNS_IP = "127.0.51.1"   # loopback alias, systemd-resolved style
@@ -284,6 +285,9 @@ class ClusterDNS:
         if not self._upstream:
             return _build_response(qid, question, _RCODE_SERVFAIL, [])
         try:
+            # dns.upstream: a dead/slow resolver must degrade to SERVFAIL
+            # (FaultInjected is an OSError — the handler below absorbs it)
+            faultline.check("dns.upstream")
             fwd = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             fwd.settimeout(2.0)
             fwd.sendto(query, (self._upstream, 53))

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api import types as t
 from ..utils.quantity import parse_quantity
 from .eviction import QOS_BESTEFFORT, QOS_BURSTABLE, QOS_GUARANTEED, qos_class
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 CPU_PERIOD_US = 100_000
 
@@ -247,6 +247,10 @@ class _V1Backend(_Backend):
 
 def _write(path: str, value: str):
     try:
+        # kubelet.statefile: an injected error exercises the same
+        # best-effort path a missing kernel knob does (FaultInjected is
+        # an OSError)
+        faultline.check("kubelet.statefile")
         with open(path, "w") as f:
             f.write(value)
     except OSError:

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ..api import types as t
-from ..utils import locksan
+from ..utils import faultline, locksan
 from ..utils.quantity import parse_quantity
 from .eviction import QOS_GUARANTEED, qos_class
 
@@ -175,6 +175,7 @@ class CPUManagerState:
     def save(self):
         if not self.path:
             return
+        faultline.check("kubelet.statefile")  # checkpoint write boundary
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({

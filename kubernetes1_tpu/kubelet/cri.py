@@ -38,7 +38,8 @@ import time
 import traceback
 from typing import Dict, List, Optional
 
-from ..utils import locksan
+from ..client.retry import Backoff
+from ..utils import faultline, locksan
 from .runtime import (
     ContainerConfig,
     ContainerRecord,
@@ -278,7 +279,9 @@ class RemoteRuntime(RuntimeService):
         # every PLEG relist into a 5s blocking loop.
         deadline = time.monotonic() + (
             retry_window if not self._ever_connected else 0.0)
+        backoff = Backoff(base=0.02, factor=2.0, cap=0.2)
         while True:
+            faultline.check("cri.dial")  # before the fd exists — a drop must not leak a socket
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(self.timeout)
             try:
@@ -289,7 +292,7 @@ class RemoteRuntime(RuntimeService):
                 conn.close()
                 if time.monotonic() >= deadline:
                     raise
-                time.sleep(0.1)
+                backoff.sleep()
 
     def _call(self, method: str, params: Optional[dict] = None):
         with self._lock:

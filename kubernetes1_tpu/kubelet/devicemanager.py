@@ -86,7 +86,7 @@ class Endpoint:
                 failures += 1
                 if failures == 2:
                     self.manager.store_mark_unhealthy(self.resource)
-            time.sleep(0.5)
+            time.sleep(0.5)  # ktpulint: ignore[KTPU013] plugin health-monitor sampling period — the two-strike unhealthy marking above counts consecutive probes at this fixed cadence; jitter would skew time-to-detection
 
     def admit_pod(self, pod: t.Pod, assignments: Dict[str, List[str]]) -> dict:
         return self.client.call(

@@ -31,7 +31,7 @@ from ..client import Clientset, EventRecorder, SharedInformer
 from ..client import retry as _retry
 from ..machinery import ApiError, Conflict, NotFound, now_iso
 from ..machinery.scheme import global_scheme
-from ..utils import locksan
+from ..utils import faultline, locksan
 from ..utils.spans import SpanCollector
 from ..utils.workqueue import WorkQueue
 from ..deviceplugin.api import DEFAULT_PLUGIN_DIR
@@ -216,6 +216,7 @@ class Kubelet:
         RemoteRuntime deliberately doesn't cache failed capability reads."""
         deadline = time.monotonic() + wait
         probe = getattr(runtime, "version", None)
+        backoff = _retry.Backoff(base=0.1, factor=2.0, cap=0.4)
         while callable(probe):
             try:
                 probe()
@@ -223,7 +224,7 @@ class Kubelet:
             except (ConnectionError, OSError):
                 if time.monotonic() >= deadline:
                     break
-                time.sleep(0.2)
+                backoff.sleep()
             except RuntimeError:
                 # the endpoint answered (an error response still needed a
                 # full round-trip; in-process stubs may not implement
@@ -1115,6 +1116,7 @@ class Kubelet:
                     return path
         except OSError:
             pass
+        faultline.check("kubelet.statefile")  # node-local state write
         with open(path, "w") as f:
             f.write(content)
         return path

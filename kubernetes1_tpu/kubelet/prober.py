@@ -16,7 +16,7 @@ import urllib.request
 from typing import Callable, Dict, Optional, Tuple
 
 from ..api import types as t
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 SUCCESS = "success"
 FAILURE = "failure"
@@ -26,6 +26,13 @@ UNKNOWN = "unknown"
 def run_probe(probe: t.Probe, target_host: str, exec_fn=None) -> bool:
     """Execute one probe attempt. exec_fn(command) -> exit code (for exec
     probes; the runtime provides the in-container execution)."""
+    try:
+        # seeded chaos can fail any probe attempt (kubelet.probe site):
+        # restart/readiness churn from flaky probes is a failure mode the
+        # eviction and endpoints paths must absorb
+        faultline.check("kubelet.probe")
+    except faultline.FaultInjected:
+        return False
     if probe.exec_action is not None:
         if exec_fn is None:
             return False

@@ -564,7 +564,7 @@ class ProcessRuntime(RuntimeService):
             # CPU-manager pinning: affinity set before exec is inherited by
             # the whole future process tree (sched_setaffinity semantics)
             cmd = _wrap_with_cpuset(cmd, config.cpuset)
-        logf = open(c.log_path, "ab")
+        logf = open(c.log_path, "ab")  # ktpulint: ignore[KTPU012] container stdout/stderr capture — workload output, not control-plane state; a torn log line loses no orchestration decision
         proc = subprocess.Popen(
             cmd,
             env=env,

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from ..api import types as t
 from ..machinery import NotFound
+from ..utils import faultline
 from ..utils import locksan
 
 SA_TOKEN_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -393,6 +394,7 @@ def _write_dir(path: str, data: Dict[str, str], secret: bool = False):
         target = os.path.join(path, safe)
         os.makedirs(os.path.dirname(target), exist_ok=True)
         tmp = target + ".ktpu-tmp"
+        faultline.check("kubelet.statefile")  # volume materialization write
         with open(tmp, "w") as f:
             f.write(str(content))
         if secret:

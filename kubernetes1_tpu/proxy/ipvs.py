@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import types as t
 from ..client import Clientset, InformerFactory
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 SCHEDULERS = ("rr", "wrr", "lc", "sh")
 
@@ -139,6 +139,9 @@ class VirtualServer:
             client.close()
             return
         try:
+            # same site as the userspace proxier: one spec faults BOTH
+            # proxy modes' upstream legs
+            faultline.check("proxy.upstream")
             upstream = socket.create_connection(backend.addr, timeout=10)
         except OSError:
             client.close()

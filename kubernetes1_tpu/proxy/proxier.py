@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api import types as t
 from ..client import Clientset, InformerFactory
 from ..utils.workqueue import RateLimitingQueue
-from ..utils import locksan
+from ..utils import faultline, locksan
 
 
 class _PortProxy:
@@ -83,6 +83,9 @@ class _PortProxy:
             client.close()
             return
         try:
+            # proxy.upstream: seeded chaos severs/delays the proxy->backend
+            # leg — the client-facing error path must stay clean
+            faultline.check("proxy.upstream")
             upstream = socket.create_connection(be, timeout=10)
         except OSError:
             self.errors += 1
@@ -258,6 +261,7 @@ class Proxier:
         target = self.resolve(cluster_ip, port)
         if target is None:
             raise ConnectionRefusedError(f"no proxy for {cluster_ip}:{port}")
+        faultline.check("proxy.upstream")
         return socket.create_connection(target, timeout=timeout)
 
     def node_port_for(self, ns: str, name: str, port_name: str = "") -> Optional[int]:

@@ -50,7 +50,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..machinery import DELETED, TooOldResourceVersion, WatchEvent
-from ..utils import locksan, mutsan
+from ..utils import invariants, locksan, mutsan, schedsan
 from ..utils.metrics import Histogram
 from .store import (
     DEFAULT_WATCH_QUEUE_LIMIT,
@@ -327,6 +327,9 @@ class Cacher:
         records = [r for r in records if r[2].startswith(self._prefix)]
         if not records:
             return
+        # the commit->apply window: a registered watcher must never miss
+        # an event that lands here while its registration is in flight
+        schedsan.preempt("cacher.apply")
         with self._cond:
             if self._pending_records is not None:  # hook beat the seed
                 self._pending_records.extend(records)
@@ -349,6 +352,11 @@ class Cacher:
         miss an event between its registration and the next apply."""
         deliveries: Dict[Watcher, List[WatchEvent]] = {}
         scan = self._scan_watchers
+        # sanitizer-build probe: capture per-event index transitions so
+        # the both-buckets rule can be re-checked independently below
+        # (against each watcher's stamped dispatch_hint, NOT the bucket
+        # maps the dispatch loop consults)
+        probe_evs = [] if invariants.armed() else None
         for rev, typ, key, obj in records:
             coll = _collection_of(key)
             old_obj: Optional[Dict[str, Any]] = None
@@ -370,6 +378,15 @@ class Cacher:
             if rev > self._rev:
                 self._rev = rev
             ev = WatchEvent(typ, obj)
+            if probe_evs is not None:
+                specs = _SELECTOR_INDEXES.get(coll) or {}
+                field_vals = {}
+                for field, default in specs.items():
+                    vals = {index_value(obj, field, default)}
+                    if old_obj is not None:
+                        vals.add(index_value(old_obj, field, default))
+                    field_vals[field] = vals
+                probe_evs.append((key, coll, ev, field_vals))
             if scan:
                 self.dispatch_scans += len(scan)
                 for w in scan:
@@ -393,6 +410,28 @@ class Cacher:
             drop = len(self._history) - self._history_limit
             self._compacted_rev = self._history[drop - 1][0]
             del self._history[:drop]
+        if probe_evs is not None:
+            invariants.rev_monotonic("cacher.apply",
+                                     invariants.stream_of(self, "cacher"),
+                                     records[0][0])
+            for key, coll, ev, field_vals in probe_evs:
+                expected = []
+                for w in self._watchers:
+                    if not key.startswith(w.prefix):
+                        continue
+                    hint = getattr(w, "dispatch_hint", None)
+                    if hint is None:
+                        must = w in scan
+                    else:
+                        hcoll, hfield, hval = hint
+                        must = (hcoll == coll
+                                and hval in field_vals.get(hfield, ()))
+                    if must:
+                        expected.append(w)
+                delivered = [w for w, evs in deliveries.items()
+                             if any(x is ev for x in evs)]
+                invariants.dispatch_superset(
+                    "cacher.dispatch", expected, delivered)
         evicted = False
         for w, evs in deliveries.items():
             w._push_batch(evs)
@@ -529,6 +568,7 @@ class Cacher:
                 return False
             records.append((rev, ev.type, key, d))
         if records:
+            schedsan.preempt("cacher.apply")
             with self._cond:
                 self._apply_batch_locked(records)
                 self._cond.notify_all()

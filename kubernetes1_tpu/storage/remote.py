@@ -28,6 +28,7 @@ from ..machinery.codec import CodecError, get_codec
 from ..machinery.scheme import Scheme
 from . import wire
 from .server import NotPrimary, error_from_wire
+from ..client.retry import Backoff
 from ..utils import faultline, locksan
 
 
@@ -419,9 +420,12 @@ class RemoteStore:
         # to absorb.  Single-server: failover is impossible, so keep the
         # old fast-fail (one pooled try + one fresh redial, no sleeps).
         attempts = 2 if len(self._addrs) == 1 else 2 + 6 * len(self._addrs)
+        # floor keeps the per-attempt pause from jittering below what the
+        # grace-window ride-out needs; the cap bounds tail latency
+        backoff = Backoff(base=0.25, factor=1.5, cap=0.4)
         for attempt in range(attempts):
             if attempt > len(self._addrs):
-                time.sleep(0.2)
+                backoff.sleep(floor=0.1)
             with self._lock:
                 # retries dial FRESH: after a store restart the whole pool
                 # is stale, and popping another dead pair would burn the
@@ -609,9 +613,10 @@ class RemoteStore:
         the bound meant for slow CLIENTS; None = the server default)."""
         last_exc: Optional[Exception] = None
         attempts = 2 if len(self._addrs) == 1 else 2 + 6 * len(self._addrs)
+        backoff = Backoff(base=0.25, factor=1.5, cap=0.4)
         for attempt in range(attempts):
             if attempt > len(self._addrs):
-                time.sleep(0.2)  # ride out a failover grace window
+                backoff.sleep(floor=0.1)  # ride out a failover grace window
             addr = self._addrs[self._active]
             try:
                 faultline.check(self._site_watch)  # injected dial refusal

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Optional, Tuple, Union
 
+from ..client.retry import Backoff
 from ..machinery.scheme import Scheme, global_scheme
 from ..utils import faultline, flightrec
 from .server import StoreServer
@@ -155,6 +156,10 @@ class StandbyServer:
         return conn
 
     def _run(self):
+        # floor/cap keep the resync cadence near the old fixed 0.1s — the
+        # failover grace accounting in _primary_dead samples in wall time
+        # and must keep being fed fresh probe results at roughly that rate
+        backoff = Backoff(base=0.1, factor=1.5, cap=0.15)
         while not self._stop.is_set() and not self.promoted.is_set():
             try:
                 self._stream_once()
@@ -165,7 +170,7 @@ class StandbyServer:
             if self._primary_dead():
                 self.promote()
                 return
-            time.sleep(0.1)  # primary alive: transient drop — resync
+            backoff.sleep(floor=0.05)  # primary alive: transient drop — resync
 
     def _stream_once(self):
         """One replication session: handshake, then apply records until the
@@ -281,5 +286,5 @@ class StandbyServer:
                 refused_since = None
             if now - failing_since >= hard:
                 return True  # not one successful connect all window: dead
-            time.sleep(0.1)
+            time.sleep(0.1)  # ktpulint: ignore[KTPU013] fixed sampling cadence — the refused-streak/hard-window accounting above measures wall-clock windows at this probe rate; jittered backoff would thin the samples the verdict is computed from
         return False

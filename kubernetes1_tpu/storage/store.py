@@ -65,7 +65,7 @@ from ..machinery import (
     now_iso,
 )
 from ..machinery.scheme import Scheme
-from ..utils import faultline, flightrec, locksan
+from ..utils import faultline, flightrec, invariants, locksan, schedsan
 from ..utils.metrics import Histogram
 
 # Keep this many events for watch resume before compaction kicks in.
@@ -672,6 +672,10 @@ class Store:
         # per event 1.0 -> 0.15).  sleep(0) is a bare yield — microseconds
         # for a solo writer, dwarfed by the JSON encode it just did.
         time.sleep(0)
+        # the enqueue->election window above is the group-commit race the
+        # interleaving sanitizer exists to stress: a preemption here must
+        # only grow batches, never lose a writer's commit
+        schedsan.preempt("store.commit.leader")
         with self._commit_mu:
             # a prior leader may have already committed us while we were
             # blocked on the mutex; only drain if there's still work
@@ -848,12 +852,21 @@ class Store:
         as lists, so N watchers x M commits cost N pushes, not N*M (used by
         local commits AND replicated applies — the delivery rules must not
         drift between them)."""
+        # probe: batches must reach the fan-out in commit order — two
+        # leaders draining concurrently or a reordered replicated apply
+        # would move this store's revision stream backwards
+        invariants.rev_monotonic("store.fanout",
+                                 invariants.stream_of(self, "store"),
+                                 records[0][0])
         events = [(key, WatchEvent(typ, obj))
                   for _rev, typ, key, obj in records]
         evicted = False
         for w in self._watchers:
             evs = [ev for key, ev in events if key.startswith(w.prefix)]
             if evs:
+                invariants.rev_monotonic(
+                    "store.watch", invariants.stream_of(w, "watcher"),
+                    records[0][0])
                 w._push_batch(evs)
                 self.watch_wakeups += 1
                 self.watch_events += len(evs)

@@ -103,9 +103,46 @@ Wired sites:
                                                  own per-pod thread, never
                                                  the kubelet sync loop;
                                                  --schedule obs covers it)
+  cri.dial                                      (kubelet/cri.py: the CRI
+                                                 socket dial — checked
+                                                 BEFORE the fd exists so an
+                                                 injected drop cannot leak
+                                                 a socket)
+  kubelet.probe                                 (kubelet/prober.py: one
+                                                 exec/http/tcp probe
+                                                 attempt — a drop is a
+                                                 probe failure, feeding the
+                                                 restart/readiness logic)
+  kubelet.statefile                             (kubelet.py resolv.conf,
+                                                 containermanager.py,
+                                                 cpumanager.py,
+                                                 volumemanager.py: node-
+                                                 local state writes — a
+                                                 drop exercises each
+                                                 manager's torn/absent-
+                                                 state recovery)
+  proxy.upstream                                (proxy/proxier.py + ipvs.py:
+                                                 the backend dial behind a
+                                                 Service VIP — a drop is a
+                                                 dead endpoint the proxier
+                                                 must route around)
+  dns.upstream                                  (dns/server.py _forward: the
+                                                 recursive upstream hop —
+                                                 FaultInjected ⊂ OSError ⇒
+                                                 SERVFAIL, never a hang)
+  stream.upgrade                                (utils/streams.py
+                                                 upgrade_request: the exec/
+                                                 attach/port-forward dial
+                                                 leg, client->apiserver and
+                                                 apiserver->kubelet both)
 
 With no injector active every hook is identity — one module-global ``is
 None`` test on the hot path; no locks, no RNG, no allocation.
+
+Every site hook doubles as a `utils/schedsan.py` preemption point: the
+same site names that inject faults also widen interleaving windows when
+``KTPU_SCHEDSAN=<seed>`` is set, so the I/O boundary map is ONE list
+serving both sanitizers (ktpulint KTPU012 keeps it complete).
 """
 
 from __future__ import annotations
@@ -116,6 +153,8 @@ import threading
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
+
+from . import schedsan
 
 ENV_VAR = "KTPU_FAULTS"
 
@@ -310,6 +349,7 @@ def check(site: str) -> None:
     """Gate a non-stream operation (a dial, an RPC, a frame read): no-op
     when inactive; may sleep (delay) or raise FaultInjected (drop/error —
     sever/truncate degrade to drop here, there are no bytes to cut)."""
+    schedsan.preempt(site)  # every I/O boundary is an interleaving point
     inj = _injector
     if inj is None:
         return
@@ -329,6 +369,7 @@ def filter_bytes(site: str, data: bytes) -> Tuple[bytes, Optional[Exception]]:
     ordering is what puts a torn frame on the wire / a torn record on
     disk before the failure surfaces (the partial-failure shape whole-
     process kills can never produce)."""
+    schedsan.preempt(site)  # every I/O boundary is an interleaving point
     inj = _injector
     if inj is None:
         return data, None

@@ -51,11 +51,12 @@ INFORMER_RELIST = "informer_relist"    # informer fell back to a full LIST
 WATCH_RECONNECT = "watch_reconnect"    # informer re-dialed mid-stream
 DELETE_BATCH = "delete_batch"          # pods/delete:batch group deletion
 HPA_RESCALE = "hpa_rescale"            # autoscaler changed a target's replicas
+INVARIANT_VIOLATION = "invariant_violation"  # utils/invariants probe tripped
 
 KINDS = frozenset({
     LEASE_STEAL, LEASE_SHED, STANDBY_PROMOTION, SHED_429, GANG_ATTEMPT,
     GANG_TEARDOWN, DEVICE_CLAIM_CONFLICT, WAL_REPAIR, INFORMER_RELIST,
-    WATCH_RECONNECT, DELETE_BATCH, HPA_RESCALE,
+    WATCH_RECONNECT, DELETE_BATCH, HPA_RESCALE, INVARIANT_VIOLATION,
 })
 
 # Per-component ring bound: forensics wants the recent tail.  512 events

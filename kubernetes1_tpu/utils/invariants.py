@@ -1,0 +1,205 @@
+"""invariants: cheap runtime probes for the standing invariants.
+
+The ROADMAP's "Standing invariants" section is the repo's real spec, and
+until now every dynamic clause in it was enforced by reviewer memory.
+This module makes the four that guard the thread-and-lock code
+MECHANICAL — each probe is a one-call assert a hot path can afford:
+
+  ``rev_monotonic(site, shard, rev)``
+      per-shard revision monotonicity at every fan-out layer (store
+      watcher delivery, cacher apply, informer resume): a revision that
+      moves backwards within one (site, shard) stream is a lost-update
+      or replay bug, full stop.
+  ``no_double_alloc(site, key, holder, prior)``
+      the device-claim ledger never holds one chip for two live pods —
+      the registry calls it at every claim insert/confirm.
+  ``dispatch_superset(site, expected, delivered)``
+      indexed dispatch ⊇ the brute-force re-check (the both-buckets
+      rule from PR 13): a watcher the full scan says should see an
+      event must be in the index's delivery set.
+  ``composite_sticky(site, old_rv, new_rv)``
+      composite (``"shard.counter"``) resume points are never
+      overwritten by a bare single-int revision (PR 11's rule).
+
+Arming: probes are identity no-ops (one module-global check) unless
+  - a schedsan schedule is active (``KTPU_SCHEDSAN=<seed>``), or
+  - a faultline injector is active (chaos runs), or
+  - ``KTPU_INVARIANTS=1`` (opt-in for sanitizer A/B runs), or
+  - ``arm()`` was called programmatically (racesweep does, scoped to
+    the scenario: ``arm()`` returns the prior state to restore).
+
+Stream keys: long-lived fan-out objects (stores, cachers, watchers) get
+their ledger stream from :func:`stream_of`, never ``id()`` — CPython
+recycles addresses, so an id-keyed stream would hand a dead cacher's
+revision history to whatever instance is allocated on top of it and
+false-trip on the newcomer's first (smaller) revision.
+
+A violation raises :class:`InvariantViolation` carrying the flight
+recorder's per-component timelines (``.flightrecorder``) and, in the
+message, the reproducing ``schedsan`` / ``faultline`` seeds — a red run
+ships its own black box AND the schedule that produced it.
+
+State (the monotonicity ledger, the claim mirror) accrues only while
+armed; ``reset()`` clears it between seeds so one scenario's revision
+history can't poison the next's.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+from . import faultline, flightrec, schedsan
+
+ENV_VAR = "KTPU_INVARIANTS"
+
+_forced = os.environ.get(ENV_VAR, "") not in ("", "0")
+
+# leaf lock: guards the probe ledgers (touched from every fan-out
+# thread while armed; never held across user code)
+_lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf lock around probe ledger dict ops; only taken while probes are armed
+_last_rev: Dict[Tuple[str, object], object] = {}
+
+
+class InvariantViolation(AssertionError):
+    """A machine-checked standing invariant failed.  Carries the flight
+    recorder dump (``.flightrecorder``) and stamps the active schedsan /
+    faultline seeds into the message so the failing schedule is
+    reproducible from the artifact alone."""
+
+    def __init__(self, site: str, detail: str):
+        self.site = site
+        self.schedsan_seed = schedsan.seed()
+        inj = faultline._injector
+        self.faults_seed = inj.seed if inj is not None else None
+        self.flightrecorder = flightrec.dump()["components"]
+        super().__init__(
+            f"invariant[{site}]: {detail} "
+            f"(schedsan_seed={self.schedsan_seed}, "
+            f"faults_seed={self.faults_seed}; replay with "
+            f"KTPU_SCHEDSAN={self.schedsan_seed})")
+
+
+def armed() -> bool:
+    """Fast path for callers whose EXPECTED-value computation is itself
+    expensive (the cacher's brute-force dispatch re-check): skip the
+    work entirely when no probe would look at it."""
+    return (_forced or schedsan.active() or faultline.active())
+
+
+def arm(on: bool = True) -> bool:
+    """Programmatic arming (racesweep; tests).  Does not clear state —
+    call :func:`reset` when starting a fresh scenario.  Returns the
+    PRIOR state so a scoped caller can restore it on the way out
+    (leaving probes force-armed after a sweep would hand every later
+    test an accruing ledger it never asked for)."""
+    global _forced
+    prior = _forced
+    _forced = bool(on)
+    return prior
+
+
+def reset() -> None:
+    """Drop accrued probe state (the per-(site, shard) revision ledger).
+    Each racesweep seed and each chaos schedule starts from a clean
+    ledger — revisions restart when a scenario rebuilds its store."""
+    with _lock:
+        _last_rev.clear()
+
+
+_stream_seq = itertools.count()
+
+
+def stream_of(obj: object, label: str) -> str:
+    """Stable per-instance stream key for the monotonicity ledger.
+    ``id()`` is NOT usable here: CPython recycles addresses, so a dead
+    instance's ledger entry would be inherited by whatever object is
+    allocated on top of it — a false "moved backwards" the first time
+    the newcomer stamps its (smaller) revision.  Minted once, memoized
+    on the instance (``_ktpu_``-prefixed: writes through mutsan's
+    frozen proxies like other blessed derived slots).  Two threads
+    racing the first mint may split one instance across two streams for
+    a single call — harmless: monotonicity within each stream still
+    holds."""
+    tok = getattr(obj, "_ktpu_invariant_stream", None)
+    if tok is None:
+        tok = f"{label}#{next(_stream_seq)}"
+        try:
+            obj._ktpu_invariant_stream = tok
+        except AttributeError:  # __slots__ instance: no memo slot
+            return f"{label}@{id(obj)}"
+    return tok
+
+
+def _violate(site: str, detail: str) -> None:
+    flightrec.note("invariants", flightrec.INVARIANT_VIOLATION,
+                   site=site, detail=detail)
+    raise InvariantViolation(site, detail)
+
+
+def rev_monotonic(site: str, shard: object, rev: object) -> None:
+    """Assert ``rev`` does not move backwards within the (site, shard)
+    stream.  Equal revisions are allowed (idempotent redelivery after a
+    resume is legal); a strictly smaller one is a lost update."""
+    if not (_forced or schedsan.active() or faultline.active()):
+        return
+    key = (site, shard)
+    with _lock:
+        last = _last_rev.get(key)
+        _last_rev[key] = rev
+    # raise OUTSIDE the ledger lock: InvariantViolation construction
+    # dumps the flight recorder, and no probe lock may be held across
+    # another subsystem's code
+    if last is not None and _lt(rev, last):
+        _violate(site, f"revision moved backwards on shard "
+                       f"{shard!r}: {last!r} -> {rev!r}")
+
+
+def _lt(a: object, b: object) -> bool:
+    """``a < b`` across the repo's two revision spellings (bare ints and
+    ``"shard.counter"`` composites) without raising on a mix — a mixed
+    comparison is itself suspicious but belongs to composite_sticky."""
+    try:
+        return a < b  # type: ignore[operator]
+    except TypeError:
+        return False
+
+
+def no_double_alloc(site: str, key: object, holder: object,
+                    prior: object) -> None:
+    """Assert a device-claim ledger slot is free or already ours:
+    ``prior`` is the live holder currently in the ledger (None when the
+    slot is free or the old claim expired)."""
+    if not (_forced or schedsan.active() or faultline.active()):
+        return
+    if prior is not None and prior != holder:
+        _violate(site, f"double allocation of {key!r}: held by {prior!r}, "
+                       f"claimed by {holder!r}")
+
+
+def dispatch_superset(site: str, expected: Iterable[object],
+                      delivered: Iterable[object]) -> None:
+    """Assert indexed dispatch delivered to AT LEAST the watchers the
+    brute-force re-check says must see the event (missing one is a lost
+    event; extras are legal — dispatch may over-approximate)."""
+    if not (_forced or schedsan.active() or faultline.active()):
+        return
+    missing = set(expected) - set(delivered)
+    if missing:
+        _violate(site, f"indexed dispatch missed {len(missing)} "
+                       f"watcher(s) the re-check requires: "
+                       f"{sorted(map(repr, missing))[:4]}")
+
+
+def composite_sticky(site: str, old_rv: object, new_rv: object) -> None:
+    """Assert a composite (``"shard.counter"``) resume point was not
+    overwritten by a bare single-int revision — the informer's resume
+    guard must have held."""
+    if not (_forced or schedsan.active() or faultline.active()):
+        return
+    if "." in str(old_rv) and new_rv is not None \
+            and "." not in str(new_rv):
+        _violate(site, f"composite resume point {old_rv!r} overwritten "
+                       f"by single-int revision {new_rv!r}")

@@ -24,6 +24,14 @@ behavior change.  With `KTPU_LOCKSAN=1` (the test suite turns it on in
 `threading.Condition.wait()` cooperates for free: waiting releases the
 underlying (wrapped) lock through the factory lock's own release/acquire
 path, so blocked-in-wait time is never charged as hold time.
+
+The factories are also where `utils/schedsan.py` plants its lock-edge
+preemption points: with `KTPU_SCHEDSAN=<seed>` active the wrappers are
+installed even when locksan itself is off, and every acquire (before
+the inner acquire — widening the contention window) and every release
+(after the inner release — widening the handoff window) draws from the
+lock class's seeded stream.  Schedules created AFTER activation get
+points; racesweep activates schedsan before building a topology.
 """
 
 from __future__ import annotations
@@ -32,6 +40,8 @@ import os
 import threading
 import time
 from typing import Dict, List, Optional, Set
+
+from . import schedsan
 
 
 class LockSanError(RuntimeError):
@@ -127,6 +137,10 @@ class _SanBase:
         self._inner = inner
         self.name = name
         self._budget = budget
+        # schedsan site names precomputed: the acquire hook runs on every
+        # lock operation under the sanitizer and must not allocate there
+        self._ss_acq = "lock.acquire:" + name
+        self._ss_rel = "lock.release:" + name
         # live acquisitions of THIS instance as (holder_stack, entry) pairs,
         # so a release from a different thread (legal Lock handoff pattern)
         # can still find and retire the acquirer's stack entry instead of
@@ -220,6 +234,7 @@ class _SanBase:
         # non-blocking acquire cannot deadlock its caller, and recording
         # its edges would poison the graph against the deadlock-AVOIDANCE
         # pattern trylock exists for.
+        schedsan.preempt(self._ss_acq)
         if blocking:
             self._before_acquire(blocking)
         got = self._inner.acquire(blocking, timeout)
@@ -230,6 +245,7 @@ class _SanBase:
     def release(self):
         entry = self._retire_mine()
         self._inner.release()  # raises on erroneous release, as the inner does
+        schedsan.preempt(self._ss_rel)
         if entry is None:
             entry = self._retire_oldest()  # legal cross-thread handoff
         self._check_budget(entry)
@@ -241,6 +257,7 @@ class _SanBase:
     def __exit__(self, exc_type, exc, tb):
         entry = self._retire_mine()
         self._inner.release()
+        schedsan.preempt(self._ss_rel)
         if entry is None:
             entry = self._retire_oldest()
         # When the critical section is already unwinding an exception, a
@@ -282,6 +299,9 @@ class SanRLock(_SanBase):
         return (self._inner._release_save(), levels)
 
     def _acquire_restore(self, state):
+        # Condition-wait wakeup re-acquire: the window between notify and
+        # the waiter retaking the lock is a classic lost-wakeup race site
+        schedsan.preempt(self._ss_acq)
         inner_state, levels = state
         self._before_acquire()
         self._inner._acquire_restore(inner_state)
@@ -293,14 +313,16 @@ class SanRLock(_SanBase):
 
 
 def make_lock(name: str, hold_budget: Optional[float] = None):
-    """A named Lock: plain threading.Lock when the sanitizer is off."""
-    if not enabled():
+    """A named Lock: plain threading.Lock when both sanitizers are off.
+    An active schedsan schedule forces the wrapper too — its preemption
+    points live on the wrapper's acquire/release path."""
+    if not (enabled() or schedsan.active()):
         return threading.Lock()
     return SanLock(threading.Lock(), name, hold_budget)
 
 
 def make_rlock(name: str, hold_budget: Optional[float] = None):
-    if not enabled():
+    if not (enabled() or schedsan.active()):
         return threading.RLock()
     return SanRLock(threading.RLock(), name, hold_budget)
 
@@ -309,7 +331,7 @@ def make_condition(lock=None, name: str = "", hold_budget: Optional[float] = Non
     """A Condition whose underlying lock goes through the sanitizer.
     Waiting releases the wrapped lock via its own release path, so time
     blocked in wait() is not charged against the hold budget."""
-    if not enabled():
+    if not (enabled() or schedsan.active()):
         return threading.Condition(lock)
     if lock is None:
         lock = make_rlock(name or "condition", hold_budget)

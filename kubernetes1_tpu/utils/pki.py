@@ -239,7 +239,7 @@ def write_pki(dir_path: str, name: str, cert_pem: str,
     """Write <name>.crt (+ <name>.key, 0600). Returns their paths."""
     os.makedirs(dir_path, exist_ok=True)
     cert_path = os.path.join(dir_path, f"{name}.crt")
-    with open(cert_path, "w") as f:
+    with open(cert_path, "w") as f:  # ktpulint: ignore[KTPU012] bootstrap-time cert material for the operator — written once before any component serves; a failure here aborts startup loudly, there is no recovery path to chaos-test
         f.write(cert_pem)
     key_path = ""
     if key_pem is not None:

@@ -1,0 +1,202 @@
+"""schedsan: seeded, deterministic thread-interleaving sanitizer.
+
+Chaos for the GIL.  CPython's scheduler hides most interleaving bugs:
+the interpreter switches threads every few milliseconds, so the narrow
+windows — between a lock release and the next acquire, between an
+enqueue and the leader-election test that decides who drains it —
+almost never see a context switch under test.  They see one in
+production, at 3am, once.
+
+This module plants *preemption points* at every concurrency-sensitive
+site the framework owns (locksan factory acquire/release, every
+faultline site check, the store's group-commit leader election, the
+cacher's ``_cond`` apply, workqueue get/put).  When activated, each
+point draws from a seeded per-site RNG stream and decides to either
+proceed, yield the GIL (``time.sleep(0)``), or take a jittered
+micro-sleep — widening exactly the windows real schedulers hit, in a
+schedule that is REPLAYABLE by seed.
+
+Activation (either):
+  - environment: ``KTPU_SCHEDSAN=<seed>`` (parsed at import, so spawned
+    server subprocesses inherit the schedule with zero plumbing);
+  - programmatic: ``schedsan.activate(seed)`` / ``deactivate()`` (what
+    scripts/racesweep.py uses in-process).
+
+Determinism contract (tests/test_schedsan.py pins it):
+  - same seed ⇒ same per-site decision sequence — each site's stream is
+    ``random.Random((seed << 32) ^ crc32(site))`` (the faultline trick),
+    so one site's schedule never shifts another's;
+  - per-site independence: interleaving calls at site B does not change
+    the decisions site A sees;
+  - identity when inactive: one module-global ``is None`` test on the
+    hot path — no locks, no RNG, no allocation (faultline's shape).
+
+Tuning: ``activate(seed, yield_prob=, sleep_prob=, max_sleep_s=)``.
+Defaults (10% yield, 2% micro-sleep ≤ 2ms) keep a racesweep scenario
+inside tens of milliseconds of added wall time while still forcing
+thousands of adversarial switch points per run.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "KTPU_SCHEDSAN"
+
+# actions a preemption point can take (recorded in the trace)
+PROCEED = "proceed"
+YIELD = "yield"
+SLEEP = "sleep"
+
+_TRACE_CAP = 8192  # bounded: a sweep must never OOM on its own telemetry
+
+
+class _Site:
+    """One named preemption point: its own seeded RNG stream (decision
+    sequences are a pure function of (seed, site)) and action counters."""
+
+    __slots__ = ("name", "rng", "counts")
+
+    def __init__(self, name: str, seed: int):
+        self.name = name
+        self.rng = random.Random((seed << 32) ^ zlib.crc32(name.encode()))
+        self.counts = {PROCEED: 0, YIELD: 0, SLEEP: 0}
+
+
+class Sampler:
+    """The active schedule: per-site streams + a bounded decision trace."""
+
+    def __init__(self, seed: int, yield_prob: float = 0.10,
+                 sleep_prob: float = 0.02, max_sleep_s: float = 0.002):
+        self.seed = int(seed)
+        self.yield_prob = float(yield_prob)
+        self.sleep_prob = float(sleep_prob)
+        self.max_sleep_s = float(max_sleep_s)
+        self._sites: Dict[str, _Site] = {}
+        # leaf lock: serializes RNG draws + trace appends (Random is not
+        # thread-safe for seeded use); held for nanoseconds, never while
+        # sleeping — the sleep happens AFTER release so a preemption at
+        # one site cannot serialize every other site behind it
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf lock inside the sanitizer itself; taken only when schedsan is ACTIVE
+        self._trace: List[Tuple[str, str]] = []
+        self._dropped = 0
+
+    def decide(self, site_name: str) -> Tuple[str, float]:
+        """(action, sleep_seconds) for the next decision at this site.
+        Pure function of (seed, site, decision index) — the draw order
+        within a site is the site's own; other sites never perturb it."""
+        with self._lock:
+            site = self._sites.get(site_name)
+            if site is None:
+                site = self._sites[site_name] = _Site(site_name, self.seed)
+            r = site.rng.random()
+            if r < self.yield_prob:
+                action, dur = YIELD, 0.0
+            elif r < self.yield_prob + self.sleep_prob:
+                # jitter drawn under the SAME per-site stream: the sleep
+                # duration is part of the replayable schedule
+                action = SLEEP
+                dur = site.rng.uniform(self.max_sleep_s / 40.0,
+                                       self.max_sleep_s)
+            else:
+                action, dur = PROCEED, 0.0
+            site.counts[action] += 1
+            if len(self._trace) < _TRACE_CAP:
+                self._trace.append((site_name, action))
+            else:
+                self._dropped += 1
+            return action, dur
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {name: dict(s.counts) for name, s in self._sites.items()}
+
+    def trace(self, site: Optional[str] = None) -> List[Tuple[str, str]]:
+        with self._lock:
+            if site is None:
+                return list(self._trace)
+            return [t for t in self._trace if t[0] == site]
+
+
+_sampler: Optional[Sampler] = None
+
+
+def active() -> bool:
+    return _sampler is not None
+
+
+# locksan spells the same question enabled(); keep both names working so
+# each caller reads naturally next to its sibling sanitizer's check
+enabled = active
+
+
+def current() -> Optional[Sampler]:
+    return _sampler
+
+
+def seed() -> Optional[int]:
+    """The active schedule's seed (None when inactive) — invariant
+    violations stamp it into their report so the schedule that produced
+    a race is reproducible from the failure artifact alone."""
+    s = _sampler
+    return s.seed if s is not None else None
+
+
+def activate(seed: int, yield_prob: float = 0.10, sleep_prob: float = 0.02,
+             max_sleep_s: float = 0.002) -> Sampler:
+    """Install a schedule process-wide (replacing any active one)."""
+    global _sampler
+    s = Sampler(int(seed), yield_prob=yield_prob, sleep_prob=sleep_prob,
+                max_sleep_s=max_sleep_s)
+    _sampler = s
+    return s
+
+
+def deactivate() -> None:
+    global _sampler
+    _sampler = None
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    """Per-site action counts (empty when inactive) — racesweep's proof
+    that a scenario actually crossed its preemption points."""
+    s = _sampler
+    return s.stats() if s is not None else {}
+
+
+def trace(site: Optional[str] = None) -> List[Tuple[str, str]]:
+    """The bounded (site, action) decision trace — what the determinism
+    regression tests compare across replays of one seed."""
+    s = _sampler
+    return s.trace(site) if s is not None else []
+
+
+def preempt(site: str) -> None:
+    """The preemption point.  No-op when inactive (one ``is None`` test);
+    when a schedule is active, draws the site's next decision and yields
+    or micro-sleeps accordingly.  The sleep happens OUTSIDE the
+    sampler's internal lock so one site's preemption never serializes
+    the rest of the process behind it."""
+    s = _sampler
+    if s is None:
+        return
+    action, dur = s.decide(site)
+    if action is PROCEED:
+        return
+    time.sleep(dur if action is SLEEP else 0.0)
+
+
+_env = os.environ.get(ENV_VAR, "")
+if _env:
+    try:
+        _seed = int(_env)
+    except ValueError as e:
+        raise ValueError(
+            f"{ENV_VAR} must be an integer seed, got {_env!r}") from e
+    activate(_seed)
+    del _seed

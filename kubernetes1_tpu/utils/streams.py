@@ -24,6 +24,8 @@ import socket
 import struct
 from typing import Optional, Tuple
 
+from . import faultline
+
 UPGRADE_PROTO = "ktpu-stream"
 
 STDIN, STDOUT, STDERR, ERROR, RESIZE = 0, 1, 2, 3, 4
@@ -110,6 +112,10 @@ def upgrade_request(host: str, port: int, path: str, headers: dict,
     """Open a socket (TLS when ssl_context is given), perform the Upgrade
     handshake, return the socket ready for frames.  Raises UpgradeRefused
     (a ConnectionError) on a non-101 response."""
+    # stream.upgrade: the exec/attach/port-forward dial leg (client->
+    # apiserver and apiserver->kubelet both ride this helper); FaultInjected
+    # is a ConnectionError, which every caller already classifies
+    faultline.check("stream.upgrade")
     sock = socket.create_connection((host, port), timeout=timeout)
     if ssl_context is not None:
         sock = ssl_context.wrap_socket(sock, server_hostname=host)

@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Any, Hashable, Optional
 
-from . import locksan
+from . import locksan, schedsan
 
 
 class WorkQueue:
@@ -27,6 +27,9 @@ class WorkQueue:
         self._shutdown = False
 
     def add(self, item: Hashable):
+        # dedup races (add-while-queued vs add-while-processing) live in
+        # the window before the condition lock — widen it under schedsan
+        schedsan.preempt("workqueue.add")
         with self._cond:
             if self._shutdown or item in self._dirty:
                 return
@@ -38,6 +41,7 @@ class WorkQueue:
 
     def get(self, timeout: Optional[float] = None):
         """Blocks; returns None on shutdown or timeout."""
+        schedsan.preempt("workqueue.get")
         with self._cond:
             deadline = time.monotonic() + timeout if timeout is not None else None
             while not self._queue and not self._shutdown:

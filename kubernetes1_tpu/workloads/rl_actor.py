@@ -263,7 +263,7 @@ def run_actor(learner_url: str, lifetime_s: float = 30.0,
         except OSError:
             errors += 1
         if interval_s:
-            time.sleep(interval_s)
+            time.sleep(interval_s)  # ktpulint: ignore[KTPU013] rollout production cadence — the workload's configured send interval, not a retry delay
     return {"batches_sent": sent, "frames": frames, "errors": errors}
 
 
@@ -693,7 +693,7 @@ class ChurnDriver:
                     namespace=self.namespace,
                     label_selector=f"app={self.app}")
             except Exception:  # noqa: BLE001 — settling control plane
-                time.sleep(0.2)
+                time.sleep(0.2)  # ktpulint: ignore[KTPU013] bench teardown drain poll against a deliberately-settling control plane — fixed cadence, deadline-bounded, not a production path
                 continue
             names |= {p.metadata.name for p in pods}
             if not pods and not names:
@@ -710,7 +710,7 @@ class ChurnDriver:
                     pass  # settling/faulted control plane: retried next loop
             elif not pods:
                 return True
-            time.sleep(0.2)
+            time.sleep(0.2)  # ktpulint: ignore[KTPU013] bench teardown drain poll — fixed cadence, deadline-bounded, not a production path
         return False
 
     def stop(self):

@@ -111,9 +111,16 @@ def _finalize_verdict(verdict: dict) -> dict:
     flight-recorder timelines recorded during the seed (a red seed must
     carry its own story, not just the broken invariant).  The
     KTPU_CHAOS_FORCE_FAIL=1 hook flips the verdict red so the artifact
-    path itself is testable end-to-end."""
-    from kubernetes1_tpu.utils import flightrec
+    path itself is testable end-to-end.
 
+    The schedsan seed rides every verdict (null when the sanitizer is
+    off): a chaos run under KTPU_SCHEDSAN=<seed> perturbs thread
+    interleavings too, and a red verdict must carry BOTH knobs needed to
+    replay it — the faults seed it already records and the schedule
+    seed."""
+    from kubernetes1_tpu.utils import flightrec, schedsan
+
+    verdict.setdefault("schedsan_seed", schedsan.seed())
     if os.environ.get("KTPU_CHAOS_FORCE_FAIL") == "1":
         verdict["ok"] = False
         verdict["forced_fail"] = True
@@ -2001,7 +2008,7 @@ def main() -> int:
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
                     + ("sched-shard", "store-shard", "obs", "churn",
-                       "node-all", "all"),
+                       "race", "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
@@ -2012,7 +2019,11 @@ def main() -> int:
                          "churn (actor-fleet recycling through "
                          "pods/delete:batch under wire faults + mid-storm "
                          "store failover; leak/convergence verdicts), "
-                         "node-all (all three node modes), or all")
+                         "race (the seeded thread-interleaving race "
+                         "scenarios from scripts/racesweep.py under the "
+                         "schedsan sanitizer — seeds drive the SCHEDULE, "
+                         "not faultline), node-all (all three node "
+                         "modes), or all")
     ap.add_argument("--store-shards", type=int, default=2,
                     help="store-shard schedule: shard count")
     ap.add_argument("--recovery-bound", type=float, default=60.0,
@@ -2027,7 +2038,7 @@ def main() -> int:
     elif args.schedule == "all":
         schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
                                                    "store-shard", "obs",
-                                                   "churn"]
+                                                   "churn", "race"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -2054,6 +2065,10 @@ def main() -> int:
             elif schedule == "churn":
                 v = run_churn_schedule(seed, duration=args.duration,
                                        spec=args.spec)
+            elif schedule == "race":
+                from scripts.racesweep import run_race_schedule
+
+                v = run_race_schedule(seed)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

@@ -6,7 +6,11 @@ non-zero when any exist.  `tests/test_lint_clean.py` runs the same check
 in tier-1, so the tree stays at zero findings.
 
 Usage: python scripts/lint.py [paths...] [--output json] [--baseline FILE]
-                              [--changed-only]
+                              [--changed-only] [--jobs N] [--list-rules]
+
+Full-tree runs default to a process-pool worker per core (--jobs to
+override, --jobs 1 to force serial); findings come out in stable file
+order either way.  --list-rules prints the KTPU rule catalog and exits.
 
 --changed-only is the fast local/pre-commit mode: lint only the .py files
 changed vs the merge-base with main (plus uncommitted changes).  The FULL
@@ -79,7 +83,7 @@ if __name__ == "__main__":
         for a in argv:
             if skip_next:
                 skip_next = False
-            elif a in ("--output", "--baseline"):
+            elif a in ("--output", "--baseline", "--jobs"):
                 skip_next = True
             elif not a.startswith("-"):
                 positional.append(a)
@@ -95,4 +99,8 @@ if __name__ == "__main__":
             sys.exit(0)
         else:
             argv = changed + argv
+    if "--jobs" not in argv and "--list-rules" not in argv:
+        # CI-gate default: a worker per core.  engine.main keeps jobs=1 as
+        # ITS default so library callers (tests) stay in-process.
+        argv += ["--jobs", str(os.cpu_count() or 1)]
     sys.exit(main(argv, rel_root=REPO))

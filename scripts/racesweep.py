@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""racesweep: seeded-interleaving sweep over the standing control-plane races.
+
+Each scenario rebuilds a small live topology and drives the exact thread
+collision its invariant guards, under the schedsan interleaving sanitizer
+(utils/schedsan.py) with the runtime invariant probes armed
+(utils/invariants.py).  schedsan perturbs thread schedules
+DETERMINISTICALLY per seed — a red seed is an artifact you can replay:
+
+    KTPU_SCHEDSAN=<seed> python scripts/racesweep.py --seeds <seed> \\
+                                                     --scenarios <name>
+
+(the env var is equivalent to --seeds for a single run; the flag form
+drives activate/deactivate per seed so one process sweeps many).
+
+Scenarios — one per standing race class the repo has shipped a fix for:
+
+  bind    sharded bind race: N scheduler shards race one chip set through
+          Registry.bind; exactly one may win (device-claim index), the
+          losers must see the DEVICE_CLAIM_CONFLICT Conflict.  Probe:
+          registry.claims no-double-alloc.
+  gang    gang teardown vs recreate: batched delete_batch of a gang racing
+          a recreator of the same names; every name must land existing
+          exactly once or not at all, never torn.  Probes: store/cacher
+          revision monotonicity via the watch fan-out.
+  watch   slow-watcher eviction vs commit fan-out: an undrained
+          queue_limit=2 watcher must be evicted without wedging or
+          starving a healthy watcher on the same cacher.  Probes:
+          cacher.apply monotonicity + dispatch superset.
+  scrape  metrics scrape vs pod delete (the PR 15 custom-metrics plane):
+          PodScraper reconcile/scrape loops racing create/delete churn of
+          the scraped pod; the scraper must converge to zero targets and
+          the apiserver must keep serving.
+
+Verdict JSON per (scenario, seed) on stdout, then a summary line; exit 1
+if any seed went red.  A red verdict carries the reproducing schedsan
+seed and the flight-recorder timelines.
+
+chaos.py's `--schedule race` delegates here (run_race_schedule) so race
+sweeps ride the same CLI and verdict plumbing as the fault schedules.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_SEEDS = "1,7,42,1729,9000"
+_JOIN_S = 30.0  # per-scenario thread-join bound: a hang is a red verdict
+
+
+def _join_all(threads, what: str):
+    deadline = time.monotonic() + _JOIN_S
+    for th in threads:
+        th.join(max(0.0, deadline - time.monotonic()))
+    stuck = [th.name for th in threads if th.is_alive()]
+    if stuck:
+        raise AssertionError(f"{what}: threads wedged: {stuck}")
+
+
+# --------------------------------------------------------------- scenarios
+
+
+def _make_pod(name: str, tpus: int = 0, annotations=None):
+    from kubernetes1_tpu.api import types as t
+
+    pod = t.Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = "default"
+    if annotations:
+        pod.metadata.annotations = dict(annotations)
+    c = t.Container(name="main", image="jax-workload")
+    c.resources.requests = {"cpu": "10m"}
+    pod.spec.containers = [c]
+    if tpus:
+        per = t.PodExtendedResource(
+            name="tpu", resource="google.com/tpu", quantity=tpus)
+        pod.spec.extended_resources = [per]
+        c.extended_resource_requests = [per.name]
+    return pod
+
+
+def scenario_bind(seed: int) -> dict:
+    """N scheduler shards race one chip set through Registry.bind."""
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.apiserver.registry import Registry
+    from kubernetes1_tpu.machinery import Conflict
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.storage import Store
+
+    shards = 4
+    chips = ["chip-0", "chip-1"]
+    store = Store(global_scheme)
+    try:
+        reg = Registry(store, global_scheme)
+        reg.ensure_namespace("default")
+        for i in range(shards):
+            reg.create("pods", "default", _make_pod(f"bind-{i}",
+                                                    tpus=len(chips)))
+        wins, conflicts, errors = [], [], []
+
+        def shard(i: int):
+            b = t.Binding()
+            b.metadata.name = f"bind-{i}"
+            b.metadata.namespace = "default"
+            b.target_node = "n0"
+            b.extended_resource_assignments = {"tpu": list(chips)}
+            try:
+                reg.bind("default", f"bind-{i}", b)
+                wins.append(i)
+            except Conflict:
+                conflicts.append(i)
+            except Exception:  # noqa: BLE001 — recorded, fails the verdict
+                errors.append(f"shard {i}: {traceback.format_exc()}")
+
+        threads = [threading.Thread(target=shard, args=(i,), daemon=True,
+                                    name=f"bind-shard-{i}")
+                   for i in range(shards)]
+        for th in threads:
+            th.start()
+        _join_all(threads, "bind")
+        if errors:
+            raise AssertionError("bind: unexpected errors: "
+                                 + " | ".join(errors))
+        if len(wins) != 1:
+            raise AssertionError(
+                f"bind: one chip set won by {len(wins)} shards "
+                f"(winners={sorted(wins)})")
+        return {"acked": shards, "winners": len(wins),
+                "claim_conflicts": len(conflicts)}
+    finally:
+        store.close()
+
+
+def scenario_gang(seed: int) -> dict:
+    """Batched gang teardown racing a recreator of the same pod names."""
+    from kubernetes1_tpu.apiserver.registry import Registry
+    from kubernetes1_tpu.machinery import ApiError
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.storage import Store
+    from kubernetes1_tpu.storage.cacher import Cacher
+
+    names = [f"g-{i}" for i in range(4)]
+    rounds = 3
+    store = Store(global_scheme)
+    cacher = Cacher(store, global_scheme).start()
+    try:
+        reg = Registry(store, global_scheme)
+        reg.ensure_namespace("default")
+        for n in names:
+            reg.create("pods", "default", _make_pod(n))
+        # a live watcher keeps the commit fan-out (and its monotonicity
+        # probes) in the race, exactly like a kubelet informer would
+        watcher = cacher.watch("/registry/pods/")
+        seen = []
+        stop = threading.Event()
+
+        def drain():
+            while True:
+                ev = watcher.next_timeout(0.2)
+                if ev is not None:
+                    seen.append(ev)
+                elif stop.is_set():
+                    return
+
+        counters = {"deleted": 0, "recreated": 0}
+        errors: list = []
+
+        def teardown():
+            try:
+                for _ in range(rounds):
+                    outcomes = reg.delete_batch(
+                        "pods", "default",
+                        [{"name": n, "grace_seconds": 0} for n in names])
+                    counters["deleted"] += sum(
+                        1 for o in outcomes if o is None)
+            except Exception:  # noqa: BLE001
+                errors.append(f"teardown: {traceback.format_exc()}")
+
+        def recreate():
+            try:
+                for _ in range(rounds):
+                    for n in names:
+                        try:
+                            reg.create("pods", "default", _make_pod(n))
+                            counters["recreated"] += 1
+                        except ApiError:
+                            pass  # lost the race this round — expected
+            except Exception:  # noqa: BLE001
+                errors.append(f"recreate: {traceback.format_exc()}")
+
+        drainer = threading.Thread(target=drain, daemon=True,
+                                   name="gang-drain")
+        racers = [threading.Thread(target=teardown, daemon=True,
+                                   name="gang-teardown"),
+                  threading.Thread(target=recreate, daemon=True,
+                                   name="gang-recreate")]
+        drainer.start()
+        for th in racers:
+            th.start()
+        _join_all(racers, "gang")
+        stop.set()
+        _join_all([drainer], "gang drain")
+        if errors:
+            raise AssertionError("gang: unexpected errors: "
+                                 + " | ".join(errors))
+        # no torn state: every name either exists whole or not at all
+        for n in names:
+            obj = store.get_or_none(f"/registry/pods/default/{n}")
+            if obj is not None and obj.metadata.name != n:
+                raise AssertionError(f"gang: torn object under {n}: "
+                                     f"{obj.metadata.name!r}")
+        return {"acked": counters["deleted"] + counters["recreated"],
+                "deleted": counters["deleted"],
+                "recreated": counters["recreated"],
+                "events_seen": len(seen)}
+    finally:
+        cacher.stop()
+        store.close()
+
+
+def scenario_watch(seed: int) -> dict:
+    """Slow-watcher eviction racing the commit fan-out."""
+    from kubernetes1_tpu.machinery.scheme import global_scheme
+    from kubernetes1_tpu.storage import Store
+    from kubernetes1_tpu.storage.cacher import Cacher
+
+    writers, per_writer = 2, 6
+    store = Store(global_scheme)
+    cacher = Cacher(store, global_scheme).start()
+    try:
+        slow = cacher.watch("/registry/pods/", queue_limit=2)  # never drained
+        fast = cacher.watch("/registry/pods/")
+        got = []
+        stop = threading.Event()
+
+        def drain():
+            while True:
+                ev = fast.next_timeout(0.2)
+                if ev is not None:
+                    got.append(ev)
+                elif stop.is_set():
+                    return
+
+        errors: list = []
+
+        def write(w: int):
+            try:
+                for i in range(per_writer):
+                    key = f"/registry/pods/default/w{w}-{i}"
+                    store.create(key, _make_pod(f"w{w}-{i}"))
+
+                    def bump(cur):
+                        cur.metadata.labels = {"round": "1"}
+                        return cur
+
+                    store.guaranteed_update(key, bump)
+                    store.delete(key)
+            except Exception:  # noqa: BLE001
+                errors.append(f"writer {w}: {traceback.format_exc()}")
+
+        drainer = threading.Thread(target=drain, daemon=True,
+                                   name="watch-drain")
+        ws = [threading.Thread(target=write, args=(w,), daemon=True,
+                               name=f"watch-writer-{w}")
+              for w in range(writers)]
+        drainer.start()
+        for th in ws:
+            th.start()
+        _join_all(ws, "watch")
+        stop.set()
+        _join_all([drainer], "watch drain")
+        if errors:
+            raise AssertionError("watch: unexpected errors: "
+                                 + " | ".join(errors))
+        deadline = time.monotonic() + 10.0
+        while not slow.evicted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if not slow.evicted:
+            raise AssertionError("watch: slow watcher never evicted")
+        total = writers * per_writer * 3  # create+update+delete per pod
+        if len(got) != total:
+            raise AssertionError(
+                f"watch: healthy watcher starved — saw {len(got)} of "
+                f"{total} events past an eviction")
+        # the cacher itself must keep serving reads
+        cacher.list_raw("/registry/pods/default/")
+        return {"acked": total, "events_delivered": len(got),
+                "evictions": cacher.watch_evictions}
+    finally:
+        cacher.stop()
+        store.close()
+
+
+def scenario_scrape(seed: int) -> dict:
+    """PodScraper scrape/reconcile loops racing pod create/delete churn."""
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.kubelet.podscrape import PodScraper
+    from kubernetes1_tpu.obs.appmetrics import AppMetrics, scrape_annotations
+
+    rounds = 6
+    m = Master(port=0).start()
+    cs = Clientset(m.url)
+    am = AppMetrics().serve()
+    ps = PodScraper(cs, "n0", interval=0.05)
+    try:
+        am.gauge("ktpu_race_qps").set(1.0)
+        ann = scrape_annotations(am.port, host="127.0.0.1")
+        errors: list = []
+        counters = {"churned": 0, "reconciles": 0}
+
+        def churn():
+            try:
+                for _ in range(rounds):
+                    cs.pods.create(_make_pod("scrape-0", annotations=ann))
+                    time.sleep(0.03)
+                    cs.pods.delete("scrape-0", grace_seconds=0)
+                    counters["churned"] += 1
+            except Exception:  # noqa: BLE001
+                errors.append(f"churn: {traceback.format_exc()}")
+
+        def reconcile():
+            try:
+                for _ in range(rounds * 6):
+                    pods, _ = cs.pods.list()
+                    ps.reconcile(pods)
+                    counters["reconciles"] += 1
+                    time.sleep(0.02)
+            except Exception:  # noqa: BLE001
+                errors.append(f"reconcile: {traceback.format_exc()}")
+
+        threads = [threading.Thread(target=churn, daemon=True,
+                                    name="scrape-churn"),
+                   threading.Thread(target=reconcile, daemon=True,
+                                    name="scrape-reconcile")]
+        for th in threads:
+            th.start()
+        _join_all(threads, "scrape")
+        if errors:
+            raise AssertionError("scrape: unexpected errors: "
+                                 + " | ".join(errors))
+        ps.reconcile([])  # the scraper must converge to zero targets
+        if ps._targets:
+            raise AssertionError(
+                f"scrape: targets leaked past reconcile([]): "
+                f"{sorted(ps._targets)}")
+        cs.pods.list()  # the apiserver must still serve
+        return {"acked": counters["churned"] + counters["reconciles"],
+                "churned": counters["churned"],
+                "reconciles": counters["reconciles"],
+                "scrapes_total": ps.scrapes_total}
+    finally:
+        ps.stop()
+        am.stop()
+        cs.close()
+        m.stop()
+
+
+SCENARIOS = {
+    "bind": scenario_bind,
+    "gang": scenario_gang,
+    "watch": scenario_watch,
+    "scrape": scenario_scrape,
+}
+
+
+# ----------------------------------------------------------------- harness
+
+
+def run_scenario(name: str, seed: int) -> dict:
+    """One (scenario, seed) run under schedsan + armed invariants.
+    Returns a chaos-style verdict dict; never raises."""
+    from kubernetes1_tpu.utils import flightrec, invariants, schedsan
+
+    verdict = {"mode": f"race-{name}", "seed": seed, "schedsan_seed": seed,
+               "ok": True, "acked": 0}
+    flightrec.reset()  # this seed's timeline, not the sweep's history
+    schedsan.activate(seed)
+    prior_armed = invariants.arm()
+    start = time.monotonic()
+    try:
+        verdict.update(SCENARIOS[name](seed))
+    except invariants.InvariantViolation as e:
+        verdict["ok"] = False
+        verdict["error"] = str(e)
+        verdict["invariant"] = True
+        verdict["flightrecorder"] = e.flightrecorder
+    except Exception as e:  # noqa: BLE001 — a red verdict, not a crash
+        verdict["ok"] = False
+        verdict["error"] = f"{type(e).__name__}: {e}"
+        verdict["flightrecorder"] = flightrec.dump()["components"]
+    finally:
+        invariants.reset()
+        invariants.arm(prior_armed)  # scoped: don't leak armed probes
+        schedsan.deactivate()
+    verdict["recovery_s"] = round(time.monotonic() - start, 3)
+    if not verdict["ok"]:
+        verdict["replay"] = (f"KTPU_SCHEDSAN={seed} python "
+                             f"scripts/racesweep.py --seeds {seed} "
+                             f"--scenarios {name}")
+    return verdict
+
+
+def run_race_schedule(seed: int, scenarios=None) -> dict:
+    """chaos.py entry point (`--schedule race`): every scenario under one
+    seed, folded into a single chaos-style verdict."""
+    runs = [run_scenario(n, seed) for n in (scenarios or SCENARIOS)]
+    verdict = {
+        "mode": "race", "seed": seed, "schedsan_seed": seed,
+        "ok": all(r["ok"] for r in runs),
+        "acked": sum(r.get("acked", 0) for r in runs),
+        "recovery_s": round(sum(r.get("recovery_s", 0.0) for r in runs), 3),
+        "scenarios": {r["mode"][len("race-"):]: r for r in runs},
+    }
+    failed = [r for r in runs if not r["ok"]]
+    if failed:
+        verdict["error"] = "; ".join(
+            f"{r['mode']}: {r.get('error', '?')}" for r in failed)
+        verdict["replay"] = failed[0].get("replay", "")
+    return verdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded thread-interleaving race sweep")
+    ap.add_argument("--seeds", default=DEFAULT_SEEDS,
+                    help="comma-separated schedsan seed sweep")
+    ap.add_argument("--scenarios", default=",".join(SCENARIOS),
+                    help=f"comma-separated subset of {list(SCENARIOS)}")
+    args = ap.parse_args()
+    seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+    names = [n.strip() for n in args.scenarios.split(",") if n.strip()]
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"racesweep: unknown scenarios {unknown} "
+              f"(have {list(SCENARIOS)})", file=sys.stderr)
+        return 2
+    verdicts = []
+    for seed in seeds:
+        for name in names:
+            v = run_scenario(name, seed)
+            print(json.dumps(v), flush=True)
+            verdicts.append(v)
+    ok = all(v["ok"] for v in verdicts)
+    print(json.dumps({
+        "summary": "racesweep", "seeds": seeds, "scenarios": names,
+        "passed": sum(1 for v in verdicts if v["ok"]),
+        "failed": [(v["mode"], v["seed"]) for v in verdicts if not v["ok"]],
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,10 @@ def pytest_configure(config):
         "markers",
         "slow: long-running schedule (multi-seed chaos sweeps, minutes of "
         "fault injection) — excluded from tier-1 (`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers",
+        "fd_leak_ok: test intentionally leaves sockets/pipes open "
+        "(opts out of the per-test fd-leak guard)")
 
 
 @pytest.fixture(autouse=True)
@@ -122,6 +126,79 @@ def _thread_leak_guard(request):
         f"non-daemon thread(s) leaked by this test: "
         f"{[th.name for th in leaked]} — join them or mark the test "
         f"thread_leak_ok")
+
+
+def _socketish_fds() -> dict:
+    """fd -> link target for this process's open socket/pipe fds (files
+    are exempt: the interesting leak class is connections — a forgotten
+    `conn.close()` on an error path holds a peer's accept slot and, at
+    scale, exhausts the fd table)."""
+    out = {}
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:  # non-Linux fallback: guard degrades to a no-op
+        return out
+    for fd in fds:
+        try:
+            target = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue  # raced a close
+        if target.startswith(("socket:", "pipe:")):
+            out[int(fd)] = target
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _fd_leak_guard(request):
+    """Socket/pipe twin of the thread-leak guard: after each test, no NEW
+    socket or pipe fd may survive.  The KTPU012 lint pass keeps I/O behind
+    faultline sites so chaos can sever it; this guard keeps the cleanup
+    half honest — an error path that drops a connection object without
+    close() passes the test that exercised it and poisons the suite's fd
+    table instead.  Grace + gc.collect() because CPython closes
+    refcount-dropped sockets immediately but cycle-held ones only at
+    collection, and server worker threads may hold a peer fd for a beat
+    while winding down.  Opt out with @pytest.mark.fd_leak_ok (and
+    thread_leak_ok tests skip too: a deliberately-leaked thread owns its
+    connections).  A surviving fd is only blamed on the test when NONE of
+    the test's new threads are still alive: the suite tolerates daemon
+    stragglers (watch handlers blocked until their next heartbeat), and
+    a straggler owns its connection — the leak class this guard exists
+    for is the ORPHANED socket, held by nothing but a dropped reference
+    or leaked global state."""
+    import gc
+    import threading
+    import time
+
+    if (request.node.get_closest_marker("fd_leak_ok")
+            or request.node.get_closest_marker("thread_leak_ok")):
+        yield
+        return
+    before_threads = set(threading.enumerate())
+    before = _socketish_fds()
+    yield
+    # fd numbers are recycled, so compare (fd, inode-target) pairs: a new
+    # socket on a reused fd number must not hide behind the old snapshot
+    def new_fds():
+        return {fd: tgt for fd, tgt in _socketish_fds().items()
+                if before.get(fd) != tgt}
+    def threads_winding_down():
+        return any(th.is_alive() for th in threading.enumerate()
+                   if th not in before_threads)
+    leaked = new_fds()
+    if leaked and threads_winding_down():
+        return  # a live thread owns it; the thread-leak guard arbitrates
+    deadline = time.monotonic() + 2.0
+    while leaked and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.05)
+        leaked = new_fds()
+        if leaked and threads_winding_down():
+            return
+    assert not leaked, (
+        f"socket/pipe fd(s) leaked by this test: "
+        f"{sorted(leaked.items())} — close them or mark the test "
+        f"fd_leak_ok")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -763,3 +763,249 @@ def test_ktpu011_quiet_on_prefixed_appmetrics_and_hpa_rescale_kind():
             flightrec.note("hpa", flightrec.HPA_RESCALE, to_replicas=3)
     """
     assert _ids(src) == []
+
+
+# ------------------------------------------------------ KTPU012 (io boundary)
+
+
+def _lint_at(path, src):
+    return lint_file(path, textwrap.dedent(src))
+
+
+def test_ktpu012_fires_on_raw_dial_without_faultline():
+    src = """
+        import socket
+
+        def dial(addr):
+            return socket.create_connection(addr, timeout=1.0)
+    """
+    findings = _lint_at("kubernetes1_tpu/kubelet/x.py", src)
+    assert [f.pass_id for f in findings] == ["KTPU012"]
+    assert "create_connection" in findings[0].message
+
+
+def test_ktpu012_fires_on_write_open_and_makefile():
+    src = """
+        def save(path, data, conn):
+            f = conn.makefile("rwb")
+            with open(path, "w") as out:
+                out.write(data)
+    """
+    ids = [f.pass_id for f in _lint_at("kubernetes1_tpu/kubelet/x.py", src)]
+    assert ids == ["KTPU012", "KTPU012"]
+
+
+def test_ktpu012_quiet_when_module_references_faultline():
+    src = """
+        import socket
+        from ..utils import faultline
+
+        def dial(addr):
+            faultline.check("x.dial")
+            return socket.create_connection(addr, timeout=1.0)
+    """
+    assert _lint_at("kubernetes1_tpu/kubelet/x.py", src) == []
+
+
+def test_ktpu012_quiet_on_read_open_and_exempt_trees():
+    read_only = """
+        def load(path):
+            with open(path) as f:
+                return f.read()
+    """
+    assert _lint_at("kubernetes1_tpu/kubelet/x.py", read_only) == []
+    dial = """
+        import socket
+
+        def dial(addr):
+            return socket.create_connection(addr)
+    """
+    # operator/user-side trees are outside the fault envelope
+    assert _lint_at("kubernetes1_tpu/cli/x.py", dial) == []
+    assert _lint_at("kubernetes1_tpu/workloads/x.py", dial) == []
+    # and so is anything not under the package at all
+    assert _lint_at("scripts/x.py", dial) == []
+
+
+def test_ktpu012_pragma_with_justification():
+    src = """
+        def save(path, data):
+            with open(path, "w") as f:  # ktpulint: ignore[KTPU012] bootstrap-only
+                f.write(data)
+    """
+    assert _lint_at("kubernetes1_tpu/kubelet/x.py", src) == []
+
+
+# ------------------------------------------------------ KTPU013 (sleep retry)
+
+
+def test_ktpu013_fires_on_sleep_in_retry_loop():
+    src = """
+        import time
+
+        def call(fn):
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(0.2)
+    """
+    findings = _lint(src)
+    assert [f.pass_id for f in findings] == ["KTPU013"]
+    assert "Backoff" in findings[0].message
+
+
+def test_ktpu013_fires_on_for_loop_retry():
+    src = """
+        import time
+
+        def call(fn):
+            for _ in range(5):
+                try:
+                    return fn()
+                except OSError:
+                    pass
+                time.sleep(0.1)
+    """
+    assert [f.pass_id for f in _lint(src)] == ["KTPU013"]
+
+
+def test_ktpu013_quiet_on_nonretry_loop_and_sleep_zero():
+    no_retry = """
+        import time
+
+        def tick():
+            while True:
+                time.sleep(0.5)
+    """
+    assert _lint(no_retry) == []
+    yield_only = """
+        import time
+
+        def spin(fn):
+            while True:
+                try:
+                    return fn()
+                except OSError:
+                    time.sleep(0)
+    """
+    assert _lint(yield_only) == []
+
+
+def test_ktpu013_retry_module_itself_exempt():
+    src = """
+        import time
+
+        def call(fn):
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(0.2)
+    """
+    assert _lint_at("kubernetes1_tpu/client/retry.py", src) == []
+
+
+def test_ktpu013_pragma_with_justification():
+    src = """
+        import time
+
+        def poll(fn):
+            while True:
+                try:
+                    fn()
+                except OSError:
+                    pass
+                time.sleep(0.5)  # ktpulint: ignore[KTPU013] fixed sampling cadence
+    """
+    assert _lint(src) == []
+
+
+# ------------------------------------------------------- KTPU014 (lock scope)
+
+
+COND_GUARDED = """
+    from kubernetes1_tpu.utils import locksan
+
+    class Cache:
+        def __init__(self):
+            self._cond = locksan.make_condition(name="Cache._cond")
+            self._data = {{}}
+            self._index = {{}}
+
+        {method}
+"""
+
+
+def _lint_cond(method: str):
+    return _lint(COND_GUARDED.format(method=textwrap.dedent(method).strip()
+                                     .replace("\n", "\n        ")))
+
+
+def test_ktpu014_fires_on_unguarded_write_to_guarded_structure():
+    findings = _lint_cond("""
+        def put(self, k, v):
+            with self._cond:
+                self._data[k] = v
+
+        def evict(self, k):
+            self._data.pop(k, None)
+    """)
+    # KTPU001 fires on the same write (a condition IS the class's lock);
+    # this pass adds the scope story — which critical section was skipped
+    got = [f for f in findings if f.pass_id == "KTPU014"]
+    assert len(got) == 1
+    assert "_data" in got[0].message
+
+
+def test_ktpu014_quiet_when_all_writes_guarded():
+    assert _lint_cond("""
+        def put(self, k, v):
+            with self._cond:
+                self._data[k] = v
+                self._index[k] = v
+
+        def drop(self, k):
+            with self._cond:
+                self._data.pop(k, None)
+    """) == []
+
+
+def test_ktpu014_locked_suffix_method_trusted():
+    # *_locked methods are called WITH the cond held by convention — the
+    # same contract KTPU001 honors for lock-guarded attributes
+    assert _lint_cond("""
+        def put(self, k, v):
+            with self._cond:
+                self._data[k] = v
+
+        def _evict_locked(self, k):
+            self._data.pop(k, None)
+    """) == []
+
+
+def test_ktpu014_nested_function_does_not_inherit_guard():
+    # a callback defined INSIDE the critical section runs later, on
+    # another thread, without the cond — its writes must still be flagged
+    findings = _lint_cond("""
+        def put(self, k, v):
+            with self._cond:
+                self._data[k] = v
+
+                def later():
+                    self._data.pop(k, None)
+                return later
+    """)
+    assert "KTPU014" in [f.pass_id for f in findings]
+
+
+def test_ktpu014_quiet_without_condition_attr():
+    src = """
+        class Plain:
+            def __init__(self):
+                self._data = {}
+
+            def put(self, k, v):
+                self._data[k] = v
+    """
+    assert _lint(src) == []

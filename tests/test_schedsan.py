@@ -1,0 +1,144 @@
+"""schedsan determinism contract: same seed ⇒ same schedule, per-site
+stream independence, identity when inactive — plus the wiring into
+locksan and the invariant-probe arming that racesweep relies on."""
+
+import threading
+
+import pytest
+
+from kubernetes1_tpu.utils import invariants, locksan, schedsan
+
+
+@pytest.fixture(autouse=True)
+def _clean_sampler():
+    """Every test starts and ends with no active schedule (env-activated
+    sessions excepted — then this suite would be testing a live schedule,
+    so bail loudly instead of silently flaking)."""
+    assert not schedsan.active(), \
+        "KTPU_SCHEDSAN is set for this pytest run; schedsan unit tests " \
+        "need to own activation"
+    yield
+    schedsan.deactivate()
+
+
+def _drive(sites, rounds=400):
+    for _ in range(rounds):
+        for s in sites:
+            schedsan.preempt(s)
+
+
+class TestDeterminism:
+    def test_same_seed_same_trace(self):
+        schedsan.activate(42, max_sleep_s=0.0001)
+        _drive(["a", "b"])
+        first = schedsan.trace()
+        stats_first = schedsan.stats()
+
+        schedsan.activate(42, max_sleep_s=0.0001)
+        _drive(["a", "b"])
+        assert schedsan.trace() == first
+        assert schedsan.stats() == stats_first
+        # and the schedule actually did something: both non-PROCEED
+        # actions appear at the default probabilities over 400 rounds
+        actions = {a for _, a in first}
+        assert schedsan.YIELD in actions
+        assert schedsan.SLEEP in actions
+
+    def test_different_seed_different_trace(self):
+        schedsan.activate(1, max_sleep_s=0.0001)
+        _drive(["a"])
+        one = schedsan.trace()
+        schedsan.activate(2, max_sleep_s=0.0001)
+        _drive(["a"])
+        assert schedsan.trace() != one
+
+    def test_per_site_stream_independence(self):
+        """Interleaving calls at other sites must not shift the decision
+        sequence one site sees — each site draws from its own stream."""
+        schedsan.activate(7, max_sleep_s=0.0001)
+        _drive(["a"])
+        alone = [t for t in schedsan.trace() if t[0] == "a"]
+
+        schedsan.activate(7, max_sleep_s=0.0001)
+        _drive(["b", "a", "c"])  # same "a" call count, noisy neighbors
+        interleaved = schedsan.trace(site="a")
+        assert interleaved == alone
+
+    def test_seed_exposed_for_replay(self):
+        assert schedsan.seed() is None
+        schedsan.activate(1729)
+        assert schedsan.seed() == 1729
+        schedsan.deactivate()
+        assert schedsan.seed() is None
+
+
+class TestIdentityWhenInactive:
+    def test_preempt_is_noop(self):
+        assert not schedsan.active()
+        schedsan.preempt("anything")  # must not raise, allocate a site...
+        assert schedsan.stats() == {}  # ...or record anything
+        assert schedsan.trace() == []
+
+    def test_locksan_factories_plain_when_both_sanitizers_off(self):
+        """schedsan alone must be enough to get sanitized (preempting)
+        locks out of the locksan factories, and neither active must mean
+        plain primitives — the zero-overhead contract."""
+        if locksan.enabled():
+            pytest.skip("KTPU_LOCKSAN active: factories always wrap")
+        lk = locksan.make_lock("schedsan-test-plain")
+        assert isinstance(lk, type(threading.Lock()))
+        schedsan.activate(3)
+        try:
+            wrapped = locksan.make_lock("schedsan-test-wrapped")
+            assert not isinstance(wrapped, type(threading.Lock()))
+        finally:
+            schedsan.deactivate()
+
+
+class TestPreemptionWiring:
+    def test_lock_acquire_release_are_preemption_points(self):
+        schedsan.activate(5, max_sleep_s=0.0001)
+        lk = locksan.make_lock("schedsan-test-wiring")
+        for _ in range(50):
+            with lk:
+                pass
+        sites = set(schedsan.stats())
+        assert "lock.acquire:schedsan-test-wiring" in sites
+        assert "lock.release:schedsan-test-wiring" in sites
+
+    def test_faultline_check_is_a_preemption_point(self):
+        from kubernetes1_tpu.utils import faultline
+
+        schedsan.activate(5, max_sleep_s=0.0001)
+        for _ in range(10):
+            faultline.check("schedsan.test.site")
+        assert "schedsan.test.site" in schedsan.stats()
+
+    def test_trace_is_bounded(self):
+        schedsan.activate(5, max_sleep_s=0.0)
+        _drive(["x"], rounds=schedsan._TRACE_CAP + 100)
+        assert len(schedsan.trace()) == schedsan._TRACE_CAP
+
+
+class TestInvariantArming:
+    def test_armed_by_schedsan(self):
+        was = invariants.armed()
+        schedsan.activate(11)
+        try:
+            assert invariants.armed()
+        finally:
+            schedsan.deactivate()
+        assert invariants.armed() == was
+
+    def test_violation_carries_schedsan_seed(self):
+        schedsan.activate(99)
+        invariants.reset()
+        try:
+            invariants.rev_monotonic("test.site", "shard0", 10)
+            with pytest.raises(invariants.InvariantViolation) as ei:
+                invariants.rev_monotonic("test.site", "shard0", 9)
+            assert "99" in str(ei.value)  # the reproducing seed, in-band
+            assert isinstance(ei.value.flightrecorder, dict)
+        finally:
+            invariants.reset()
+            schedsan.deactivate()

@@ -24,7 +24,7 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 # justification (group 2) is bounded at the next '#', so several pragmas
 # on one line each parse — and a bare second pragma can't hide inside the
@@ -66,6 +66,29 @@ class FileContext:
 Pass = Callable[[FileContext], List[Finding]]
 
 _REGISTRY: Dict[str, Pass] = {}
+
+# The rule catalog (--list-rules): one line per FINDING id.  Kept here
+# rather than derived from the registry because one registered pass may
+# emit several ids (the lock pass emits KTPU001/002/006) and KTPU000/010
+# come from the engine itself.
+RULES: Dict[str, str] = {
+    "KTPU000": "file does not parse — syntax error",
+    "KTPU001": "shared mutable attribute written without the class's lock",
+    "KTPU002": "blocking call (sleep/join/wait/network) under a held lock",
+    "KTPU003": "exception swallowed silently in control-plane code",
+    "KTPU004": "thread created non-daemon or without a name",
+    "KTPU005": "time.time() where elapsed time is meant — use monotonic",
+    "KTPU006": "iteration over shared state without a snapshot",
+    "KTPU007": "direct threading.Lock/RLock/Condition — use locksan factories",
+    "KTPU008": "mutation of an object handed out as a shared snapshot",
+    "KTPU009": "raw-dict wire key not in the schema registry (typo guard)",
+    "KTPU010": "suppression pragma without a justification (unsuppressible)",
+    "KTPU011": "flight-recorder event kind not from the closed enum",
+    "KTPU012": "raw socket/open I/O in a module with no faultline site",
+    "KTPU013": "bespoke time.sleep retry loop outside client/retry.py policy",
+    "KTPU014": "write to a condition-guarded structure outside its critical "
+               "section",
+}
 
 
 def register(pass_id: str):
@@ -141,12 +164,13 @@ def lint_file(path: str, source: str = None,
     return kept
 
 
-def lint_paths(paths: Sequence[str], only: Sequence[str] = ()) -> List[Finding]:
-    """Lint every .py file under the given files/directories."""
-    findings: List[Finding] = []
+def walk_py_files(paths: Sequence[str]) -> List[str]:
+    """Every .py file under the given files/directories, in a stable
+    (sorted-walk) order — the unit of work the parallel gate shards."""
+    files: List[str] = []
     for root in paths:
         if os.path.isfile(root):
-            findings.extend(lint_file(root, only=only))
+            files.append(root)
             continue
         for dirpath, dirnames, filenames in os.walk(root):
             dirnames[:] = sorted(
@@ -155,8 +179,34 @@ def lint_paths(paths: Sequence[str], only: Sequence[str] = ()) -> List[Finding]:
             )
             for name in sorted(filenames):
                 if name.endswith(".py"):
-                    findings.extend(
-                        lint_file(os.path.join(dirpath, name), only=only))
+                    files.append(os.path.join(dirpath, name))
+    return files
+
+
+def _lint_one(args: Tuple[str, Sequence[str]]) -> List[Finding]:
+    """Module-level worker (picklable) for the process pool."""
+    path, only = args
+    return lint_file(path, only=only)
+
+
+def lint_paths(paths: Sequence[str], only: Sequence[str] = (),
+               jobs: int = 1) -> List[Finding]:
+    """Lint every .py file under the given files/directories.  With
+    jobs > 1, files fan out over a process pool; results are stitched
+    back in file order, so output is byte-identical to a serial run
+    (the gate's wall time is the point, not its ordering)."""
+    files = walk_py_files(paths)
+    findings: List[Finding] = []
+    if jobs > 1 and len(files) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(max_workers=min(jobs, len(files))) as pool:
+            for result in pool.map(_lint_one, [(p, tuple(only))
+                                               for p in files]):
+                findings.extend(result)
+        return findings
+    for path in files:
+        findings.extend(lint_file(path, only=only))
     return findings
 
 
@@ -205,14 +255,15 @@ def diff_against_baseline(
 
 
 def run_gate(paths: Sequence[str] = (), rel_root: str = "",
-             output: str = "text", baseline: Optional[str] = None) -> int:
+             output: str = "text", baseline: Optional[str] = None,
+             jobs: int = 1) -> int:
     """Shared CLI body for scripts/lint.py and `python -m tools.ktpulint`:
     print findings (`file:line: PASS-ID message`, or a stable JSON list
     with --output json), optionally diffing against a baseline file so CI
     can fail only on NEW findings.  Returns the exit code."""
     import sys as _sys
 
-    findings = lint_paths(list(paths) or default_gate_paths())
+    findings = lint_paths(list(paths) or default_gate_paths(), jobs=jobs)
     if baseline is not None:
         findings = diff_against_baseline(
             findings, load_baseline(baseline), rel_root)
@@ -237,7 +288,7 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
 
     p = argparse.ArgumentParser(
         prog="ktpulint",
-        description="project-specific static analysis (KTPU001-KTPU011)")
+        description="project-specific static analysis (KTPU001-KTPU014)")
     p.add_argument("paths", nargs="*",
                    help="files/directories (default: kubernetes1_tpu/ and tools/)")
     p.add_argument("--output", choices=("text", "json"), default="text",
@@ -246,17 +297,29 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
     p.add_argument("--baseline", metavar="FILE", default=None,
                    help="fail only on findings NOT in this baseline file "
                         "(a previous `--output json` capture; lines ignored)")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="lint files across N worker processes "
+                        "(output order is identical to a serial run)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog (id: description) and exit")
     args = p.parse_args(list(argv))
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            print(f"{rule_id}: {RULES[rule_id]}")
+        return 0
     return run_gate(args.paths, rel_root=rel_root, output=args.output,
-                    baseline=args.baseline)
+                    baseline=args.baseline, jobs=max(args.jobs, 1))
 
 
 # importing the pass modules populates the registry
 from . import exceptions_pass  # noqa: E402,F401
+from . import io_boundary_pass  # noqa: E402,F401
 from . import lockfactory_pass  # noqa: E402,F401
 from . import locks_pass  # noqa: E402,F401
+from . import lockscope_pass  # noqa: E402,F401
 from . import mutation_pass  # noqa: E402,F401
 from . import obs_pass  # noqa: E402,F401
 from . import schema_pass  # noqa: E402,F401
+from . import sleepretry_pass  # noqa: E402,F401
 from . import threads_pass  # noqa: E402,F401
 from . import wallclock_pass  # noqa: E402,F401

@@ -1,0 +1,90 @@
+"""KTPU012 — raw I/O boundary in a module with no faultline site.
+
+The chaos suite's reach is exactly the set of `utils/faultline.py` sites:
+a socket dialed or a state file written in a module that never consults
+faultline is an I/O boundary NO seeded schedule can sever, delay, or
+tear — its failure modes ship untested.  The standing invariant
+(ROADMAP "Standing invariants") says every control-plane I/O boundary
+carries a named site; this pass makes the coverage mechanical.
+
+Granularity is the MODULE: a file that references faultline anywhere is
+assumed to route its boundaries through its sites (the runtime chaos
+suite, not static analysis, proves the routing is right); a file with
+raw outbound I/O and no faultline reference at all is a coverage hole.
+Flagged constructs: ``socket.create_connection``/``socket.socket``
+dials, ``sock.connect``, ``sock.makefile`` stream adoption, and
+write/append-mode ``open()`` (control-plane state mutation on disk).
+
+Exempt trees: ``workloads/`` and ``cli/`` (operator- and user-side code
+— their I/O talks to surfaces OUTSIDE the control plane's fault
+envelope), and ``tests``/``tools``.  The rare in-scope exception (a
+shared dial helper whose CALLERS own the named sites; bootstrap cert
+material) carries ``# ktpulint: ignore[KTPU012] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .engine import FileContext, Finding, register
+
+_EXEMPT_PARTS = ("workloads", "cli", "tests", "tools")
+
+_SOCKET_CALLS = {"create_connection", "socket"}
+_STREAM_ATTRS = {"connect", "makefile"}
+
+
+def _write_mode(node: ast.Call) -> Optional[str]:
+    """The mode string of an open() call when it writes, else None."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        if any(c in mode.value for c in "wa+x"):
+            return mode.value
+    return None
+
+
+@register("KTPU012")
+def io_boundary(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if "kubernetes1_tpu/" not in path:
+        return []
+    rel = path.split("kubernetes1_tpu/", 1)[1]
+    parts = rel.split("/")
+    if any(p in _EXEMPT_PARTS for p in parts[:-1]):
+        return []
+    if "faultline" in ctx.source:
+        # the module participates in fault injection; whether every one
+        # of ITS boundaries routes through a site is the chaos suite's
+        # job (static matching of call->site would be guesswork)
+        return []
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str):
+        findings.append(Finding(
+            ctx.path, node.lineno, "KTPU012",
+            f"{what} in a module with no faultline site — this I/O "
+            f"boundary is invisible to every seeded chaos schedule; "
+            f"add a faultline.check()/filter_bytes() site (see "
+            f"utils/faultline.py docstring) or pragma with why this "
+            f"boundary is outside the fault envelope"))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "socket" and f.attr in _SOCKET_CALLS):
+            flag(node, f"socket.{f.attr}()")
+        elif isinstance(f, ast.Attribute) and f.attr in _STREAM_ATTRS:
+            flag(node, f".{f.attr}()")
+        elif isinstance(f, ast.Name) and f.id == "open":
+            mode = _write_mode(node)
+            if mode is not None:
+                flag(node, f"open(..., {mode!r})")
+    return findings

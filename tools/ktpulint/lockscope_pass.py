@@ -1,0 +1,172 @@
+"""KTPU014 — guarded-structure write outside its condition's critical
+section.
+
+The cacher's standing invariant (PR 12/13, ROADMAP "Standing
+invariants"): the selector-index buckets, the watcher-dispatch buckets,
+and the data view are updated inside the SAME ``_cond`` critical
+section as the apply that fans events out — a write that slips outside
+the lock is a watcher that misses an event between registration and the
+next apply, or a bucket that dangles a dead watcher forever.
+
+The pass infers lock scope per class, from the file alone (the engine's
+conservatism rule — no annotations):
+
+1. a class's *condition attributes* are the ``self.X`` assigned from
+   ``locksan.make_condition(...)``;
+2. an attribute is *guarded* when some method mutates it inside a
+   ``with self.X:`` block (X a condition attribute) or inside a method
+   whose name ends in ``_locked`` (the repo's must-hold-the-lock naming
+   convention);
+3. every OTHER mutation of a guarded attribute — outside any ``with
+   self.X:``, in a method not named ``*_locked`` and not ``__init__``
+   (construction precedes sharing) — is a finding.
+
+Mutations counted: attribute/subscript assignment and augmented
+assignment, ``del``, and calls of known mutator methods (``append``,
+``update``, ``pop``, ...).  A mutation the author knows is safe
+(single-threaded setup path, a handoff protocol the lock doesn't cover)
+carries ``# ktpulint: ignore[KTPU014] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .engine import FileContext, Finding, register
+
+_MUTATORS = {
+    "append", "appendleft", "add", "remove", "discard", "pop", "popleft",
+    "clear", "extend", "extendleft", "update", "setdefault", "insert",
+    "sort", "reverse",
+}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """The X of a ``self.X``-rooted expression (peeling subscripts), or
+    '' when the expression is not rooted at self."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _cond_attrs(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        f = v.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name != "make_condition":
+            continue
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr:
+                out.add(attr)
+    return out
+
+
+class _MutationCollector(ast.NodeVisitor):
+    """Walk one method, tracking whether the current statement is inside
+    a ``with self.<cond>:`` block; record (attr, lineno, guarded)."""
+
+    def __init__(self, conds: Set[str]):
+        self.conds = conds
+        self.depth = 0
+        self.out: List[Tuple[str, int, bool]] = []
+
+    def _rec(self, target: ast.AST, lineno: int):
+        attr = _self_attr(target)
+        if attr and attr not in self.conds:
+            self.out.append((attr, lineno, self.depth > 0))
+
+    def visit_With(self, node: ast.With):
+        guards = any(_self_attr(item.context_expr) in self.conds
+                     for item in node.items)
+        if guards:
+            self.depth += 1
+        self.generic_visit(node)
+        if guards:
+            self.depth -= 1
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._rec(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._rec(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._rec(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            self._rec(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            self._rec(f.value, node.lineno)
+        self.generic_visit(node)
+
+    # nested defs capture self but run on their own schedule (threads,
+    # callbacks) — their guard state is NOT the enclosing with-block's
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        inner = _MutationCollector(self.conds)
+        for stmt in node.body:
+            inner.visit(stmt)
+        self.out.extend(inner.out)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@register("KTPU014")
+def lock_scope(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        conds = _cond_attrs(cls)
+        if not conds:
+            continue
+        # (attr, lineno, guarded, method) across the class's methods
+        muts: List[Tuple[str, int, bool, str]] = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            col = _MutationCollector(conds)
+            for stmt in meth.body:
+                col.visit(stmt)
+            muts.extend((a, ln, g, meth.name) for a, ln, g in col.out)
+        guarded: Set[str] = set()
+        for attr, _ln, g, meth_name in muts:
+            if g or meth_name.endswith("_locked"):
+                guarded.add(attr)
+        cond_names = "/".join(sorted(conds))
+        for attr, lineno, g, meth_name in muts:
+            if attr not in guarded or g:
+                continue
+            if meth_name.endswith("_locked") or meth_name == "__init__":
+                continue
+            findings.append(Finding(
+                ctx.path, lineno, "KTPU014",
+                f"write to {cls.name}.{attr} outside the {cond_names} "
+                f"critical section that guards it elsewhere — index/"
+                f"bucket updates and their fan-out must share one "
+                f"critical section (ROADMAP standing invariant); hold "
+                f"the condition, rename the method *_locked if callers "
+                f"already hold it, or pragma with why this write is "
+                f"safe unlocked"))
+    return findings

@@ -1,0 +1,68 @@
+"""KTPU013 — bespoke sleep-in-a-retry-loop outside client/retry.py.
+
+Every retry loop that sleeps a hand-picked constant re-derives backoff
+policy, badly: no exponential growth (hammering the exact server that
+is struggling), no jitter (synchronized thundering herds after a shared
+failure), and no seeding (a chaos schedule cannot replay the sleep
+sequence).  `client/retry.py`'s Backoff is the one shared policy —
+capped exponential with full jitter, drawing from the faultline seed
+under an active schedule — and the standing invariant says retry delays
+go through it.
+
+Detection: a nonzero ``time.sleep()`` lexically inside a ``while``/
+``for`` loop whose body also handles exceptions (the retry shape).
+``time.sleep(0)`` is exempt — that's a GIL yield, not a delay policy.
+`client/retry.py` itself is exempt: it IS the policy.
+
+Fixed-cadence poll loops (a health monitor ticking every N ms, a drain
+loop sampling a window) are the legitimate exception: their sleep is a
+sampling period, not a retry delay, and jitter would distort what they
+measure.  Those carry ``# ktpulint: ignore[KTPU013] <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import FileContext, Finding, register
+
+
+def _is_nonzero_sleep(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"):
+        return False
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value == 0:
+        return False  # bare GIL yield, not a delay
+    return True
+
+
+@register("KTPU013")
+def sleep_retry(ctx: FileContext) -> List[Finding]:
+    path = ctx.path.replace("\\", "/")
+    if path.endswith("client/retry.py"):
+        return []  # the shared policy implementation
+    flagged = set()
+    findings: List[Finding] = []
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        handles = any(isinstance(n, ast.ExceptHandler)
+                      for n in ast.walk(loop))
+        if not handles:
+            continue
+        for node in ast.walk(loop):
+            if _is_nonzero_sleep(node) and node.lineno not in flagged:
+                flagged.add(node.lineno)
+                findings.append(Finding(
+                    ctx.path, node.lineno, "KTPU013",
+                    "time.sleep() in a retry loop — use client/retry.py "
+                    "Backoff (capped exponential, full jitter, seeded "
+                    "under chaos schedules); if this sleep is a "
+                    "fixed-cadence sampling period rather than a retry "
+                    "delay, say so with a pragma"))
+    return findings
