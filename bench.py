@@ -51,8 +51,9 @@ Environment-variable table (the driver's knobs; defaults in parens):
                               replacement CREATED, not Running)
   BENCH_CHURN_WORKERS (1)     concurrent recycle threads (slot space
                               partitioned across them)
-  BENCH_SKIP_{GANG,CHURN,SCHED,SCHED1K,KUBEMARK,WORKLOAD} (unset)
+  BENCH_SKIP_{GANG,CHURN,SCHED,SCHED1K,KUBEMARK,WORKLOAD,SCORECARD} (unset)
                               1 = skip that phase
+  BENCH_SCORECARD_SEED (42)   cluster-life mixer seed (faults + placement)
   BENCH_KUBEMARK_NODES (200)  hollow-KUBELET count (full node loops;
                               distinct from the watcher swarm)
   BENCH_NO_REAP (unset)       1 = refuse a dirty box instead of reaping
@@ -854,6 +855,36 @@ def bench_churn() -> dict:
         cluster.stop()
 
 
+def bench_scorecard() -> dict:
+    """Cluster-life scorecard (PR 17): the everything-at-once mixer —
+    serving under open-loop load + indexed training gang + actor-churn
+    swarm + conducted seeded chaos windows (node kill included) on the
+    sharded topology, judged by the declarative SLO scorecard
+    (obs/scorecard.py).  The full scorecard JSON (SLO verdicts, burn
+    windows, interference deltas vs the solo baselines, chaos event log)
+    is written to SCORECARD_r0x.json — next free index, beside the
+    BENCH_r0x series — and the bench result carries the summary."""
+    from scripts.cluster_life import LifeConfig, run_cluster_life
+
+    result = run_cluster_life(LifeConfig(
+        seed=int(os.environ.get("BENCH_SCORECARD_SEED", "42"))))
+    root = os.path.dirname(os.path.abspath(__file__))
+    i = 1
+    while os.path.exists(os.path.join(root, f"SCORECARD_r{i:02d}.json")):
+        i += 1
+    path = os.path.join(root, f"SCORECARD_r{i:02d}.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(result, fh, indent=1, sort_keys=True)
+    return {
+        "ok": result["ok"],
+        "artifact": os.path.basename(path),
+        "slos_met": {n: v["met"] for n, v in result["slos"].items()},
+        "breached": result["breached_slos"],
+        "interference": result["interference"],
+        "node_killed": result["node_killed"],
+    }
+
+
 def main():
     from kubernetes1_tpu.utils.benchstamp import contention_stamp
 
@@ -887,6 +918,15 @@ def main():
             extras["churn"] = bench_churn()
         except Exception as e:  # noqa: BLE001
             extras["churn"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # cluster-life scorecard (PR 17): every scenario at once under
+    # conducted chaos, scored against declarative SLOs — the one phase
+    # that judges the control plane as a system, not per-subsystem
+    if os.environ.get("BENCH_SKIP_SCORECARD", "") != "1":
+        try:
+            extras["scorecard"] = bench_scorecard()
+        except Exception as e:  # noqa: BLE001
+            extras["scorecard"] = {"error": f"{type(e).__name__}: {e}"}
 
     # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes);
     # contaminated runs are retried after a quiesce, not just stamped

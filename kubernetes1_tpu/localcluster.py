@@ -78,6 +78,8 @@ class LocalCluster:
         obs: bool = True,
         obs_interval: float = 1.0,
         endpoints_coalesce_window: float = 0.0,
+        monitor_grace: float = 40.0,
+        eviction_timeout: float = 300.0,
     ):
         self.n_nodes = nodes
         self.tpus_per_node = tpus_per_node
@@ -94,6 +96,10 @@ class LocalCluster:
         self.obs_enabled = obs
         self.obs_interval = obs_interval
         self.endpoints_coalesce_window = endpoints_coalesce_window
+        # node-lifecycle clocks: chaos/mixer runs shrink these so a
+        # killed node's eviction + gang re-place fits a seconds-scale run
+        self.monitor_grace = monitor_grace
+        self.eviction_timeout = eviction_timeout
 
         self.master: Optional[Master] = None
         self.masters: List[Master] = []
@@ -160,7 +166,9 @@ class LocalCluster:
         self.scheduler = self.schedulers[0]
         self.kcm = ControllerManager(
             Clientset(rotated(urls, 1)),
-            endpoints_coalesce_window=self.endpoints_coalesce_window)
+            endpoints_coalesce_window=self.endpoints_coalesce_window,
+            monitor_grace=self.monitor_grace,
+            eviction_timeout=self.eviction_timeout)
         self.kcm.start()
         self._proxier_cs = Clientset(rotated(urls, 2))
         self.proxier = Proxier(self._proxier_cs).start()
@@ -174,6 +182,14 @@ class LocalCluster:
         return self
 
     def _start_obs(self):
+        # Registration audit (breach timelines are built from REGISTERED
+        # endpoints): every component with an HTTP surface is listed
+        # here — apiservers, schedulers, the SLI tracker, kubelets.  The
+        # kcm and proxier expose no endpoint of their own; their flight-
+        # recorder events live in the process-global rings every listed
+        # endpoint serves, so their timelines still reach breach dumps.
+        # Anything booted BESIDE the cluster (workload servers, the
+        # scorecard) must register itself on cluster.obs the same way.
         self.obs = ObsCollector(interval=self.obs_interval)
         for i, m in enumerate(self.masters):
             self.obs.register("apiserver", m.url, instance=f"apiserver-{i}")

@@ -11,6 +11,11 @@ is the WORKLOAD half: the registry pods embed to export QPS/in-flight/
 latency SLIs on a pod-local /metrics endpoint, plus the
 `obs.ktpu.io/scrape-*` annotation contract the kubelet's pod scrape
 agent (kubelet/podscrape.py) lifts into PodCustomMetrics for the HPA.
+`obs.scorecard` is the judgment layer: declarative SLOs with
+multi-window multi-burn evaluation over the collector's scrapes
+(stale = missing), exporting `ktpu_slo_*`.  `obs.timeline` merges every
+endpoint's /debug/flightrecorder + /debug/traces into one time-ordered
+cross-component timeline on breach.
 """
 
 from .aggregate import (  # noqa: F401
@@ -29,3 +34,5 @@ from .appmetrics import (  # noqa: F401
     scrape_target,
 )
 from .collector import ObsCollector  # noqa: F401
+from .scorecard import SLO, Scorecard  # noqa: F401
+from .timeline import capture as capture_timeline  # noqa: F401

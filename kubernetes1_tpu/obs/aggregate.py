@@ -186,11 +186,50 @@ def _parse_cached(key: str) -> Tuple[str, Dict[str, str]]:
     return hit
 
 
+def _boundaries_of(samples: Dict[str, float]) -> Dict[str, frozenset]:
+    """One scrape's histogram bucket boundaries: {series-identity (family
+    ``_bucket`` name + non-``le`` labels): frozenset of ``le`` bounds}."""
+    bounds: Dict[str, set] = {}
+    for key in samples:
+        name, labels = _parse_cached(key)
+        le_s = labels.get("le")
+        if le_s is None or not name.endswith("_bucket"):
+            continue
+        ident = format_series_key(
+            name, {k: v for k, v in labels.items() if k != "le"})
+        le = float("inf") if le_s in ("+Inf", "inf") else float(le_s)
+        bounds.setdefault(ident, set()).add(le)
+    return {k: frozenset(v) for k, v in bounds.items()}
+
+
+def _check_boundaries(canon: Dict[str, frozenset],
+                      bounds: Dict[str, frozenset]) -> None:
+    """Instances contributing buckets for the same series identity must
+    agree on the ``le`` set EXACTLY.  Summing cumulative counts across
+    mismatched boundaries silently invents a distribution neither
+    instance observed (the count in ``le=0.5`` means different things),
+    so a mismatch RAISES — never re-buckets."""
+    for ident, les in bounds.items():
+        prev = canon.get(ident)
+        if prev is None:
+            canon[ident] = les
+        elif prev != les:
+            raise ValueError(
+                f"mismatched histogram bucket boundaries for {ident}: "
+                f"{sorted(prev)} vs {sorted(les)} — bucket-wise merge is "
+                f"only sound over identical boundaries; refusing to "
+                f"re-bucket")
+
+
 def merge_parsed(scrapes: Iterable[ParsedMetrics]) -> ParsedMetrics:
-    """Merge N instances' parsed scrapes under the module's rule set."""
+    """Merge N instances' parsed scrapes under the module's rule set.
+    Raises ValueError when two instances disagree on a histogram's
+    bucket boundaries (see ``_check_boundaries``)."""
     merged = ParsedMetrics()
     quantile_inputs: Dict[str, List[float]] = {}
+    canon_bounds: Dict[str, frozenset] = {}
     for sc in scrapes:
+        _check_boundaries(canon_bounds, _boundaries_of(sc.samples))
         for fam, typ in sc.types.items():
             merged.types.setdefault(fam, typ)
         for key, val in sc.samples.items():
@@ -279,10 +318,13 @@ def merge_metrics(dicts: Iterable[Dict[str, float]]) -> Dict[str, float]:
     shape scripts/sched_perf.py has always consumed ({series: value},
     no TYPE headers).  Counters/buckets sum; quantile series recompute
     from the summed buckets when the family rendered them; gauges and
-    reservoir-only quantiles take the max (fallback)."""
+    reservoir-only quantiles take the max (fallback).  Mismatched bucket
+    boundaries across inputs raise (see ``_check_boundaries``)."""
     out: Dict[str, float] = {}
     quantile_inputs: Dict[str, List[float]] = {}
+    canon_bounds: Dict[str, frozenset] = {}
     for mx in dicts:
+        _check_boundaries(canon_bounds, _boundaries_of(mx))
         for key, val in mx.items():
             name, labels = _parse_cached(key)
             if "quantile" in labels:

@@ -52,11 +52,14 @@ WATCH_RECONNECT = "watch_reconnect"    # informer re-dialed mid-stream
 DELETE_BATCH = "delete_batch"          # pods/delete:batch group deletion
 HPA_RESCALE = "hpa_rescale"            # autoscaler changed a target's replicas
 INVARIANT_VIOLATION = "invariant_violation"  # utils/invariants probe tripped
+SLO_BREACH = "slo_breach"              # scorecard burn-rate alert fired
+SCORECARD_PHASE = "scorecard_phase"    # cluster-life mixer phase transition
 
 KINDS = frozenset({
     LEASE_STEAL, LEASE_SHED, STANDBY_PROMOTION, SHED_429, GANG_ATTEMPT,
     GANG_TEARDOWN, DEVICE_CLAIM_CONFLICT, WAL_REPAIR, INFORMER_RELIST,
     WATCH_RECONNECT, DELETE_BATCH, HPA_RESCALE, INVARIANT_VIOLATION,
+    SLO_BREACH, SCORECARD_PHASE,
 })
 
 # Per-component ring bound: forensics wants the recent tail.  512 events

@@ -327,6 +327,19 @@ class DecodeServer:
             self.metrics.mark("ktpu_llama_qps")
             self.latency.observe(_time.monotonic() - t0)
 
+    def warmup(self, tokens=(1, 2, 3), max_new: int = 4):
+        """Pay the XLA compile for the given request shape OUTSIDE the
+        SLI histograms: the first decode of each context length traces
+        and compiles (seconds on CPU), and the latency histogram is
+        cumulative — an un-warmed first request would sit in the p99
+        for the process's whole life and fail any serving SLO judged
+        against it."""
+        from . import sharding as sh
+
+        with sh.use_mesh(self.mesh):
+            greedy_decode(self.cfg, self.params, self._step, list(tokens),
+                          max_new=max_new)
+
     # ------------------------------------------------------------- server
 
     def start(self) -> "DecodeServer":

@@ -1792,7 +1792,14 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
         # a target that never existed: connection refused on every scrape
         obs.register("ghost", "http://127.0.0.1:1", instance="ghost-0")
         # annotated serving fleet + Pods-metric HPA, settled BEFORE the
-        # faults: qps exactly on target ⇒ steady desired == 2 replicas
+        # faults: qps exactly on target ⇒ steady desired == 2 replicas.
+        # Registration audit (PR 17): this endpoint is deliberately NOT
+        # on cluster.obs — it is the pod-scrape pipeline's target (the
+        # kubelet lifts it into PodCustomMetrics, the axis this schedule
+        # faults and kills), and registering it as a component target
+        # too would double-count the endpoint the schedule murders.
+        # Workload servers that want breach-timeline presence register
+        # like cluster_life's llama app does.
         app = AppMetrics()
         app.gauge("ktpu_chaos_qps").set(10.0)
         app.serve()
@@ -1993,6 +2000,48 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
     return _finalize_verdict(verdict)
 
 
+def run_life_schedule(seed: int, duration: float = 6.0,
+                      spec: str = None) -> dict:
+    """The everything-at-once mixer as a seeded chaos schedule: one
+    scripts/cluster_life.py run (serving + gang + churn + conducted
+    fault windows + the node kill) on the sharded topology, judged by
+    its own scorecard.  The seed drives BOTH the pod/fault placement
+    and every conducted fault window (cluster_life derives per-window
+    seeds from it), so a red scorecard replays like any other schedule.
+    ``duration`` maps to the mix window; the solo baselines stay short
+    (they calibrate the interference deltas, not the verdict).
+
+    Verdict: ok == the scorecard's own ok (every MEASURED SLO met its
+    objective); acked = total serving+churn ops; recovery_s = the gang
+    MTTR the node kill produced (0 when the kill was skipped)."""
+    from scripts.cluster_life import LifeConfig, run_cluster_life
+
+    _begin_seed_run()
+    verdict = {"mode": "life", "seed": seed,
+               "spec": spec or "(conducted: cluster_life windows)",
+               "ok": False}
+    result = run_cluster_life(LifeConfig(
+        nodes=3, sched_shards=2, store_shards=2, seed=seed,
+        solo_seconds=2.0, mix_seconds=max(8.0, duration),
+        serve_impl="synthetic", serve_rate=4.0, actors=4,
+        churn_rate=2.0))
+    verdict["ok"] = bool(result["ok"])
+    verdict["slos"] = {n: {k: v[k] for k in
+                           ("good", "bad", "missing", "met")}
+                       for n, v in result["slos"].items()}
+    verdict["breached"] = result["breached_slos"]
+    verdict["interference"] = result["interference"]
+    verdict["node_killed"] = result["node_killed"]
+    serving = result["scenarios"]["serving"]
+    churn = result["scenarios"]["churn"]["driver"]
+    verdict["acked"] = (int(serving.get("issued", 0))
+                        + int(churn.get("creates", 0))
+                        + int(churn.get("deletes", 0)))
+    mttr = result["slos"]["gang_recovery_mttr"].get("last_value")
+    verdict["recovery_s"] = float(mttr) if mttr is not None else 0.0
+    return _finalize_verdict(verdict)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description="ktpu seeded chaos runner")
     ap.add_argument("--seeds", default="1,7,42,1729,9000",
@@ -2008,7 +2057,7 @@ def main() -> int:
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
                     + ("sched-shard", "store-shard", "obs", "churn",
-                       "race", "node-all", "all"),
+                       "race", "life", "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
@@ -2022,8 +2071,11 @@ def main() -> int:
                          "race (the seeded thread-interleaving race "
                          "scenarios from scripts/racesweep.py under the "
                          "schedsan sanitizer — seeds drive the SCHEDULE, "
-                         "not faultline), node-all (all three node "
-                         "modes), or all")
+                         "not faultline), life (the everything-at-once "
+                         "scripts/cluster_life.py mixer — serving + gang "
+                         "+ churn + conducted fault windows + node kill, "
+                         "judged by its own SLO scorecard), node-all "
+                         "(all three node modes), or all")
     ap.add_argument("--store-shards", type=int, default=2,
                     help="store-shard schedule: shard count")
     ap.add_argument("--recovery-bound", type=float, default=60.0,
@@ -2038,7 +2090,7 @@ def main() -> int:
     elif args.schedule == "all":
         schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
                                                    "store-shard", "obs",
-                                                   "churn", "race"]
+                                                   "churn", "race", "life"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -2069,6 +2121,8 @@ def main() -> int:
                 from scripts.racesweep import run_race_schedule
 
                 v = run_race_schedule(seed)
+            elif schedule == "life":
+                v = run_life_schedule(seed, duration=args.duration)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

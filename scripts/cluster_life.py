@@ -1,0 +1,859 @@
+#!/usr/bin/env python
+"""Cluster-life mixer: every scenario at once, one scored verdict.
+
+Each bench phase in this tree exercises ONE axis — serving latency
+(GenAI-inference p99), gang scheduling (training), actor churn (RL),
+watch fan-out — and each has always run ALONE.  A real TPU cluster runs
+them together, and the interesting failures are the cross-scenario
+ones: a churn storm inflating the serving fleet's watch lag, a node
+kill's eviction burst delaying an HPA reaction.  This script runs the
+mix on the sharded topology and judges it with the obs plane's
+scorecard (obs/scorecard.py):
+
+  serving    an annotated Deployment fronted by a llama DecodeServer
+             (or a synthetic stand-in) under OPEN-LOOP load + a
+             Pods-metric HPA on ktpu_llama_qps;
+  training   an Indexed gang-scheduled Job holding TPU chips;
+  churn      the RL actor swarm recycling pods at a target rate;
+  chaos      periodic seeded fault windows (wire faults, store-rpc
+             storms, chip deaths via the device.health site) plus at
+             most one node KILL — the existing faultline schedules,
+             conducted on a timer.
+
+Before the mix, each measurable scenario runs a short SOLO phase; the
+scorecard JSON reports mixed-vs-solo interference deltas beside the SLO
+verdicts.  Any SLO breach during the mix captures a merged
+cross-component timeline (obs/timeline.py) from every registered
+endpoint — the breach ships its own story.
+
+Usage:
+    python scripts/cluster_life.py                      # default mix
+    python scripts/cluster_life.py --mix 30 --solo 6 \
+        --seed 7 --induce-breach --out SCORECARD.json
+
+Prints the scorecard JSON on stdout; --out also writes it to a file.
+Exit code 0 iff every SLO with measured ticks met its objective.
+tests/test_cluster_life.py drives run_cluster_life() directly with a
+seconds-scale config; scripts/chaos.py --schedule life wraps it in a
+seeded chaos verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# ------------------------------------------------------- chaos windows
+#
+# Short seeded fault windows the conductor opens and closes during the
+# mix.  Probabilities keep the cluster making progress (partial failure,
+# not a dead cluster) — except the "induce" window, which is
+# deliberately heavy enough to burn the watch-lag SLO.
+WIRE_WINDOW_SPEC = (
+    "client.request=drop@0.06|delay:10ms@0.06;"
+    "client.watch=drop@0.10;"
+    "store.rpc=drop@0.06|delay:5ms@0.06;"
+    "store.watch=drop@0.10"
+)
+STORE_WINDOW_SPEC = (
+    "store.rpc=drop@0.35|delay:20ms@0.25;"
+    "store.watch=drop@0.35"
+)
+CHIP_WINDOW_SPEC = "device.health=error@0.30"
+INDUCE_WINDOW_SPEC = (
+    "client.watch=drop@0.55;"
+    "client.request=drop@0.20|delay:30ms@0.30;"
+    "store.watch=drop@0.45"
+)
+
+SERVE_APP = "llama-serve"
+
+
+@dataclass
+class LifeConfig:
+    """One mixer run, declaratively.  Defaults are the CLI's defaults;
+    the tier-1 smoke shrinks every duration."""
+
+    nodes: int = 4
+    tpus_per_node: int = 4
+    sched_shards: int = 2
+    store_shards: int = 2
+    apiservers: int = 1
+    seed: int = 42
+    solo_seconds: float = 5.0
+    mix_seconds: float = 20.0
+    # serving
+    serve_impl: str = "decode"          # decode | synthetic
+    serve_rate: float = 6.0             # open-loop requests/s
+    serve_replicas: int = 2
+    hpa_max_replicas: int = 5
+    hpa_target_qps: float = 3.0
+    # training gang
+    gang_workers: int = 2
+    tpus_per_worker: int = 2
+    # churn swarm
+    actors: int = 6
+    churn_rate: float = 3.0
+    # chaos conduction
+    chaos: bool = True
+    chaos_period_s: float = 5.0
+    chaos_window_s: float = 1.5
+    node_kill: bool = True
+    induce_breach: bool = False
+    # SLO thresholds
+    serving_p99_s: float = 2.0
+    watch_lag_p99_s: float = 2.0
+    hpa_reaction_p99_s: float = 15.0
+    gang_mttr_p99_s: float = 30.0
+    churn_ops_floor: float = 0.2
+    qps_floor: float = 0.2
+    # evaluator cadence
+    scorecard_interval: float = 0.25
+    obs_interval: float = 0.25
+    stale_after_s: float = 5.0
+    out: str = ""
+
+
+def build_slos(cfg: LifeConfig) -> list:
+    """The declarative scorecard for a mixer run: one SLO per scenario
+    axis (≥5 verdicts).  The induce-breach variant tightens watch lag so
+    the conductor's heavy window reliably burns it — the breach-timeline
+    path must be demonstrable on demand."""
+    from kubernetes1_tpu.obs.scorecard import DEFAULT_BURN_ALERTS, SLO
+
+    watch_lag = 0.35 if cfg.induce_breach else cfg.watch_lag_p99_s
+    # the default burn pairs are minutes-scale; an induced breach must
+    # fire within one conductor window, so the tightened SLO also gets a
+    # seconds-scale alert pair (burn 3x over an 8s long / 2s short
+    # window — reachable, since objective 0.9 caps burn at 10x)
+    watch_burn = (((8.0, 2.0, 3.0),) if cfg.induce_breach
+                  else DEFAULT_BURN_ALERTS)
+    return [
+        SLO(name="serving_p99", scenario="serving", source="fleet",
+            metric="ktpu_llama_request_latency_seconds",
+            labels={"quantile": "0.99"}, op="<=",
+            threshold=cfg.serving_p99_s, objective=0.9, reduce="max"),
+        SLO(name="serving_qps", scenario="serving", source="pods",
+            metric="ktpu_llama_qps", selector=f"app={SERVE_APP}",
+            op=">=", threshold=cfg.qps_floor, objective=0.8,
+            reduce="avg"),
+        SLO(name="gang_recovery_mttr", scenario="training",
+            source="fleet", metric="ktpu_gang_recovery_seconds",
+            labels={"quantile": "0.99"}, op="<=",
+            threshold=cfg.gang_mttr_p99_s, objective=0.6, reduce="max"),
+        SLO(name="churn_ops", scenario="churn", source="fed", op=">=",
+            threshold=cfg.churn_ops_floor, objective=0.8),
+        SLO(name="watch_lag", scenario="control-plane", source="fleet",
+            metric="ktpu_informer_lag_seconds",
+            labels={"quantile": "0.99"}, op="<=", threshold=watch_lag,
+            objective=0.9, reduce="max", burn_alerts=watch_burn),
+        SLO(name="hpa_reaction", scenario="autoscaling", source="fleet",
+            metric="ktpu_hpa_reaction_seconds",
+            labels={"quantile": "0.99"}, op="<=",
+            threshold=cfg.hpa_reaction_p99_s, objective=0.9,
+            reduce="max"),
+    ]
+
+
+# ---------------------------------------------------------- serving app
+
+
+class SyntheticServe:
+    """Stand-in for the DecodeServer with the SAME metric names (the SLO
+    selectors must not care which implementation serves) and a direct
+    handle() instead of an HTTP inference hop — the tier-1 smoke's
+    seconds-scale budget has no room for a jit compile."""
+
+    def __init__(self, base_ms: float = 5.0, jitter_ms: float = 5.0,
+                 seed: int = 0):
+        from kubernetes1_tpu.obs.appmetrics import AppMetrics
+
+        self.metrics = AppMetrics()
+        self.latency = self.metrics.histogram(
+            "ktpu_llama_request_latency_seconds",
+            "synthetic serving latency")
+        self.requests = self.metrics.counter(
+            "ktpu_llama_requests_total", "synthetic requests served")
+        self.qps = self.metrics.gauge("ktpu_llama_qps",
+                                      "synthetic served qps")
+        self._rnd = random.Random(seed)
+        self.base_ms = base_ms
+        self.jitter_ms = jitter_ms
+
+    def start(self):
+        self.metrics.serve()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.metrics.port
+
+    @property
+    def base_url(self) -> str:
+        return self.metrics.url
+
+    @property
+    def metrics_url(self) -> str:
+        return self.metrics.url + "/metrics"
+
+    def request(self):
+        t0 = time.monotonic()
+        time.sleep((self.base_ms
+                    + self._rnd.random() * self.jitter_ms) / 1000.0)
+        self.requests.inc()
+        self.metrics.mark("ktpu_llama_qps")
+        self.latency.observe(time.monotonic() - t0)
+
+    def warmup(self):
+        pass  # no jit: nothing to pay outside the histograms
+
+    def stop(self):
+        self.metrics.stop()
+
+
+class DecodeServe:
+    """The real llama DecodeServer (tiny config) behind the same shape:
+    request() is one open-loop POST /generate."""
+
+    def __init__(self, seed: int = 0):
+        from kubernetes1_tpu.workloads.llama import DecodeServer
+
+        self.server = DecodeServer(seed=seed)
+
+    def start(self):
+        self.server.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def base_url(self) -> str:
+        return self.server.url
+
+    @property
+    def metrics_url(self) -> str:
+        return self.server.url + "/metrics"
+
+    def request(self):
+        import urllib.request
+
+        body = json.dumps({"tokens": [1, 2, 3], "max_new": 4}).encode()
+        req = urllib.request.Request(
+            self.server.url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            r.read()
+
+    def warmup(self):
+        # the load's one request shape, compiled outside the histogram
+        self.server.warmup(tokens=(1, 2, 3), max_new=4)
+
+    def stop(self):
+        self.server.stop()
+
+
+class OpenLoopLoad:
+    """Open-loop request generator: requests fire on the clock schedule
+    regardless of completions (each in its own thread), the load model
+    under which tail latency means anything.  In-flight is capped so a
+    wedged server degrades to counted sheds, not a thread explosion."""
+
+    MAX_INFLIGHT = 32
+
+    def __init__(self, fn, rate: float):
+        self.fn = fn
+        self.rate = rate
+        self.issued = 0
+        self.errors = 0
+        self.shed = 0
+        self._inflight = 0
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf counter lock in a bench harness
+        self._stopev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        name="life-load", daemon=True)
+        self._thread.start()
+        return self
+
+    def _one(self):
+        try:
+            self.fn()
+        except Exception:  # noqa: BLE001 — counted: open-loop errors are data
+            with self._lock:
+                self.errors += 1
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _loop(self):
+        period = 1.0 / max(self.rate, 0.1)
+        next_t = time.monotonic()
+        while not self._stopev.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                self._stopev.wait(min(next_t - now, 0.05))
+                continue
+            next_t += period
+            with self._lock:
+                if self._inflight >= self.MAX_INFLIGHT:
+                    self.shed += 1
+                    continue
+                self._inflight += 1
+                self.issued += 1
+            threading.Thread(target=self._one, name="life-load-req",
+                             daemon=True).start()
+
+    def stop(self):
+        self._stopev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# ------------------------------------------------------------- plumbing
+
+
+def _phase(name: str):
+    from kubernetes1_tpu.utils import flightrec
+
+    flightrec.note("cluster-life", flightrec.SCORECARD_PHASE, phase=name)
+
+
+def _create_serving(cs, port: int, cfg: LifeConfig):
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.obs.appmetrics import scrape_annotations
+
+    dep = t.Deployment()
+    dep.metadata.name = SERVE_APP
+    dep.spec.replicas = cfg.serve_replicas
+    dep.spec.selector = t.LabelSelector(match_labels={"app": SERVE_APP})
+    dep.spec.template.metadata.labels = {"app": SERVE_APP}
+    dep.spec.template.metadata.annotations = scrape_annotations(
+        port, host="127.0.0.1")
+    c = t.Container(name="serve", image="llama-serve", command=["serve"])
+    c.resources.requests = {"cpu": "10m"}
+    dep.spec.template.spec.containers = [c]
+    cs.deployments.create(dep)
+    hpa = t.HorizontalPodAutoscaler()
+    hpa.metadata.name = f"{SERVE_APP}-hpa"
+    hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
+        kind="Deployment", name=SERVE_APP)
+    hpa.spec.min_replicas = 1
+    hpa.spec.max_replicas = cfg.hpa_max_replicas
+    hpa.spec.metrics = [t.MetricSpec(type="Pods", pods=t.PodsMetricSource(
+        metric_name="ktpu_llama_qps",
+        target_average_value=cfg.hpa_target_qps))]
+    cs.horizontalpodautoscalers.create(hpa)
+
+
+def _serving_running(cs, want: int, timeout: float = 30.0) -> int:
+    from kubernetes1_tpu.api import types as t
+
+    deadline = time.monotonic() + timeout
+    n = 0
+    while time.monotonic() < deadline:
+        pods, _ = cs.pods.list(namespace="default",
+                               label_selector=f"app={SERVE_APP}")
+        n = len([p for p in pods if p.status.phase == t.POD_RUNNING
+                 and not p.metadata.deletion_timestamp])
+        if n >= want:
+            return n
+        time.sleep(0.2)
+    return n
+
+
+def _create_gang(cs, cfg: LifeConfig) -> str:
+    from kubernetes1_tpu.api import types as t
+
+    job = t.Job()
+    job.metadata.name = "life-gang"
+    job.spec.completions = cfg.gang_workers
+    job.spec.parallelism = cfg.gang_workers
+    job.spec.completion_mode = "Indexed"
+    job.spec.gang_scheduling = True
+    job.spec.backoff_limit = 50
+    c = t.Container(name="worker", image="jax-train", command=["serve"])
+    c.resources.limits = {"google.com/tpu": cfg.tpus_per_worker}
+    job.spec.template.spec.containers = [c]
+    cs.jobs.create(job)
+    return job.metadata.name
+
+
+def _gang_pods(cs, name: str) -> list:
+    from kubernetes1_tpu.api import types as t
+
+    pods, _ = cs.pods.list(namespace="default",
+                           label_selector=f"{t.JOB_NAME_LABEL}={name}")
+    return [p for p in pods
+            if p.status.phase not in (t.POD_SUCCEEDED, t.POD_FAILED)
+            and not p.metadata.deletion_timestamp]
+
+
+def _gang_running(cs, name: str, want: int, timeout: float) -> bool:
+    from kubernetes1_tpu.api import types as t
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods = _gang_pods(cs, name)
+        if len(pods) == want and all(
+                p.status.phase == t.POD_RUNNING for p in pods):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def _fleet_parsed(cluster):
+    from kubernetes1_tpu.obs import aggregate
+
+    return aggregate.parse_metrics_text(cluster.obs.render_fleet_metrics())
+
+
+def _fetch_parsed(url: str):
+    import urllib.request
+
+    from kubernetes1_tpu.obs import aggregate
+
+    with urllib.request.urlopen(url, timeout=5.0) as r:
+        return aggregate.parse_metrics_text(r.read().decode())
+
+
+def _delta_quantile(before, after, name: str, q: float) -> Optional[float]:
+    """Quantile of the observations made BETWEEN two scrapes of a
+    cumulative histogram: per-``le`` bucket deltas (summed across label
+    sets — cumulative counts add) fed to the shared interpolation."""
+    from kubernetes1_tpu.obs import aggregate
+
+    def per_le(parsed) -> Dict[float, float]:
+        out: Dict[float, float] = {}
+        if parsed is None:
+            return out
+        for key, val in aggregate.select(parsed, name + "_bucket").items():
+            _n, labels = aggregate.parse_series_key(key)
+            le_s = labels.get("le")
+            if le_s is None:
+                continue
+            le = float("inf") if le_s in ("+Inf", "inf") else float(le_s)
+            out[le] = out.get(le, 0.0) + val
+        return out
+
+    b0, b1 = per_le(before), per_le(after)
+    if not b1:
+        return None
+    buckets = [(le, c - b0.get(le, 0.0)) for le, c in b1.items()]
+    total = buckets and max(c for _le, c in buckets)
+    if not total or total <= 0:
+        return None
+    return aggregate.bucket_quantile(sorted(buckets), q)
+
+
+class ChaosConductor:
+    """Opens one seeded fault window per period during the mix: wire
+    faults, a store-rpc storm, chip deaths — and (once) a node KILL of a
+    gang member's host.  Every window is activate/deactivate of an
+    existing faultline spec; the seed makes the whole conduction
+    replayable."""
+
+    def __init__(self, cluster, cs, gang_name: str, cfg: LifeConfig):
+        self.cluster = cluster
+        self.cs = cs
+        self.gang_name = gang_name
+        self.cfg = cfg
+        self.rnd = random.Random(cfg.seed)
+        self.events: List[dict] = []
+        self.node_killed = ""
+        self._stopev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    def start(self):
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="life-chaos", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        from kubernetes1_tpu.utils import faultline
+
+        self._stopev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.chaos_window_s + 3.0)
+        faultline.deactivate()
+
+    def _loop(self):
+        from kubernetes1_tpu.utils import faultline
+
+        kinds = ["wire", "store-fault", "chip-death"]
+        if self.cfg.induce_breach:
+            kinds = ["induce"] + kinds
+        i = 0
+        while not self._stopev.wait(self.cfg.chaos_period_s):
+            kind = kinds[i % len(kinds)]
+            i += 1
+            # the (single) node kill replaces one EARLY window: eviction
+            # + gang re-place needs the rest of the mix to close the
+            # MTTR histogram before the scorecard stops ticking.  Under
+            # --induce-breach the induce window keeps the first slot.
+            kill_at = 2 if self.cfg.induce_breach else 1
+            if (self.cfg.node_kill and not self.node_killed
+                    and i == kill_at):
+                self._kill_gang_node()
+                continue
+            spec = {"wire": WIRE_WINDOW_SPEC,
+                    "store-fault": STORE_WINDOW_SPEC,
+                    "chip-death": CHIP_WINDOW_SPEC,
+                    "induce": INDUCE_WINDOW_SPEC}[kind]
+            window = self.cfg.chaos_window_s * (
+                3.0 if kind == "induce" else 1.0)
+            seed_i = self.cfg.seed * 1000 + i
+            faultline.activate(seed_i, spec)
+            self._stopev.wait(window)
+            injected = faultline.stats()
+            faultline.deactivate()
+            self.events.append({
+                "t_s": round(time.monotonic() - self._t0, 2),
+                "kind": kind, "spec": spec, "seed": seed_i,
+                "window_s": window, "injected": injected})
+
+    def _kill_gang_node(self):
+        """Stop the kubelet + plugin hosting a gang member: the node
+        goes NotReady, its pods evict, and the gang policy re-places the
+        whole gang — the MTTR the training SLO judges."""
+        victims = {p.spec.node_name
+                   for p in _gang_pods(self.cs, self.gang_name)
+                   if p.spec.node_name}
+        handle = None
+        for h in self.cluster.nodes:
+            if h.kubelet.node_name in victims:
+                handle = h
+                break
+        if handle is None and len(self.cluster.nodes) > 1:
+            handle = self.cluster.nodes[-1]
+        if handle is None:
+            return
+        handle.kubelet.stop()
+        if handle.plugin:
+            handle.plugin.stop()
+        self.node_killed = handle.kubelet.node_name
+        self.events.append({
+            "t_s": round(time.monotonic() - self._t0, 2),
+            "kind": "node-kill", "node": self.node_killed})
+
+
+# ------------------------------------------------------------- the run
+
+
+def run_cluster_life(cfg: LifeConfig) -> dict:
+    """Boot the sharded topology, run solo baselines then the full mix
+    under conducted chaos, and return the scorecard JSON."""
+    from kubernetes1_tpu.controllers import JobController
+    from kubernetes1_tpu.localcluster import LocalCluster
+    from kubernetes1_tpu.obs import timeline as timeline_mod
+    from kubernetes1_tpu.obs.scorecard import Scorecard
+    from kubernetes1_tpu.utils import flightrec, schedsan
+    from kubernetes1_tpu.workloads.rl_actor import ChurnDriver
+
+    flightrec.reset()
+    t_start_wall = time.time()  # ktpulint: ignore[KTPU005] timeline capture cutoff is a wall stamp by contract
+    cluster = None
+    app = None
+    load = None
+    driver = None
+    conductor = None
+    scorecard = None
+    feeder_stop = threading.Event()
+    breach_timelines: List[dict] = []
+    phases: List[str] = []
+    result: dict = {
+        "config": asdict(cfg), "seed": cfg.seed,
+        "schedsan_seed": schedsan.seed(),
+    }
+    try:
+        # ---- boot -----------------------------------------------------
+        _phase("boot")
+        phases.append("boot")
+        cluster = LocalCluster(
+            nodes=cfg.nodes, tpus_per_node=cfg.tpus_per_node,
+            sched_shards=cfg.sched_shards,
+            store_shards=cfg.store_shards,
+            apiservers=cfg.apiservers, obs=True,
+            obs_interval=cfg.obs_interval,
+            heartbeat_interval=0.5, sync_interval=0.2,
+            monitor_grace=2.5, eviction_timeout=1.0,
+        ).start()
+        cluster.wait_ready(60)
+        cs = cluster.cs
+        # gang recreate backoff at chaos cadence, not production cadence
+        for c in cluster.kcm.controllers:
+            if isinstance(c, JobController):
+                c.gang_backoff_base = 0.2
+                c.gang_backoff_cap = 2.0
+        # serving app (out-of-band inference server the pods front)
+        app = (DecodeServe(seed=cfg.seed) if cfg.serve_impl == "decode"
+               else SyntheticServe(seed=cfg.seed)).start()
+        app.warmup()  # jit compile paid before any measured window
+        # endpoint registration (the PR 17 audit): the workload server
+        # and the scorecard are components too — unregistered endpoints
+        # are silently absent from breach timelines
+        cluster.obs.register("llama", app.base_url, instance="llama-0")
+        scorecard = Scorecard(collector=cluster.obs, clientset=cs,
+                              interval=cfg.scorecard_interval,
+                              stale_after_s=cfg.stale_after_s)
+        scorecard.extend(build_slos(cfg))
+        cluster.obs.register("scorecard", scorecard.serve(),
+                             instance="scorecard-0")
+
+        def on_breach(slo, ev):
+            if len(breach_timelines) < 3:
+                tl = timeline_mod.capture(cluster.obs,
+                                          since_wall=t_start_wall)
+                tl["slo"] = slo.name
+                tl["breach"] = ev
+                breach_timelines.append(tl)
+
+        scorecard.on_breach(on_breach)
+        _create_serving(cs, app.port, cfg)
+        _serving_running(cs, cfg.serve_replicas)
+
+        # ---- solo: serving -------------------------------------------
+        _phase("solo:serving")
+        phases.append("solo:serving")
+        app_before = _fetch_parsed(app.metrics_url)
+        fleet_before = _fleet_parsed(cluster)
+        load = OpenLoopLoad(app.request, cfg.serve_rate).start()
+        time.sleep(cfg.solo_seconds)
+        load.stop()
+        load = None
+        serving_solo = _delta_quantile(
+            app_before, _fetch_parsed(app.metrics_url),
+            "ktpu_llama_request_latency_seconds", 0.99)
+        watch_solo = _delta_quantile(
+            fleet_before, _fleet_parsed(cluster),
+            "ktpu_informer_lag_seconds", 0.99)
+
+        # ---- solo: churn ---------------------------------------------
+        _phase("solo:churn")
+        phases.append("solo:churn")
+        # recycle_chunk=1: the default chunking batches recycles into
+        # bursts (fine for a capacity probe, poison for a rate SLO — a
+        # seconds-scale window between bursts reads as zero ops/s)
+        driver = ChurnDriver(cs, actors=cfg.actors, rate=cfg.churn_rate,
+                             use_batch=True, grace_seconds=0,
+                             recycle_chunk=1, wait_ready=True)
+        driver.start(ready_timeout=30.0)
+        ops0 = driver.creates + driver.deletes
+        t0 = time.monotonic()
+        # workers=1 for the baseline: the worker pacing issues its first
+        # recycle at 2/rate_per_worker seconds, so splitting the rate
+        # across workers doubles the ramp — a short solo window would
+        # read 0 ops/s and poison the interference delta
+        driver.run(duration=cfg.solo_seconds, workers=1)
+        solo_wall = max(time.monotonic() - t0, 1e-6)
+        churn_solo = (driver.creates + driver.deletes - ops0) / solo_wall
+
+        # ---- the mix --------------------------------------------------
+        _phase("mix")
+        phases.append("mix")
+        gang_name = _create_gang(cs, cfg)
+        gang_up = _gang_running(cs, gang_name, cfg.gang_workers,
+                                timeout=30.0)
+        app_mix0 = _fetch_parsed(app.metrics_url)
+        fleet_mix0 = _fleet_parsed(cluster)
+        ops_mix0 = driver.creates + driver.deletes
+        scorecard.start()
+        load = OpenLoopLoad(app.request, cfg.serve_rate).start()
+
+        churn_thread = threading.Thread(
+            target=lambda: driver.run(duration=cfg.mix_seconds, workers=2),
+            name="life-churn", daemon=True)
+        churn_thread.start()
+
+        def feed_churn():
+            # trailing ~3s window: per-second instantaneous rates are
+            # quantized by the driver's tick and would flap the SLO.
+            # Nothing is fed until the FIRST mix recycle lands — the
+            # worker pacing ramps for 2/rate_per_worker seconds, and
+            # feeding the ramp's 0.0 would book honest "not measured
+            # yet" ticks as bad; withheld feeds read as missing instead
+            # (the PR 15 staleness invariant, applied to fed SLOs).
+            samples = [(time.monotonic(),
+                        driver.creates + driver.deletes)]
+            while not feeder_stop.wait(1.0):
+                samples.append((time.monotonic(),
+                                driver.creates + driver.deletes))
+                if len(samples) > 4:
+                    samples.pop(0)
+                (t_a, ops_a), (t_b, ops_b) = samples[0], samples[-1]
+                if ops_b == ops_mix0:
+                    continue  # still ramping: no recycle since mix start
+                scorecard.feed("churn_ops",
+                               (ops_b - ops_a) / max(t_b - t_a, 1e-6))
+
+        feeder = threading.Thread(target=feed_churn, name="life-churn-feed",
+                                  daemon=True)
+        feeder.start()
+        if cfg.chaos:
+            conductor = ChaosConductor(cluster, cs, gang_name, cfg).start()
+        t_mix0 = time.monotonic()
+        time.sleep(cfg.mix_seconds)
+        mix_wall = time.monotonic() - t_mix0
+
+        # ---- wind down ------------------------------------------------
+        if conductor is not None:
+            conductor.stop()
+        feeder_stop.set()
+        feeder.join(timeout=3.0)
+        load.stop()
+        load_stats = {"issued": load.issued, "errors": load.errors,
+                      "shed": load.shed}
+        load = None
+        churn_thread.join(timeout=10.0)
+        # gang-recovery grace: the kill->evict->re-place->Running arc may
+        # close just after the mix window; hold the scorecard open until
+        # the MTTR observation has propagated scrape->tick (bounded)
+        if conductor is not None and conductor.node_killed:
+            grace_deadline = time.monotonic() + 12.0
+            while time.monotonic() < grace_deadline:
+                v = scorecard.verdict().get("gang_recovery_mttr", {})
+                if (v.get("good", 0) + v.get("bad", 0)) > 0:
+                    break
+                time.sleep(0.25)
+        scorecard.stop()
+
+        app_mix1 = _fetch_parsed(app.metrics_url)
+        fleet_mix1 = _fleet_parsed(cluster)
+        serving_mixed = _delta_quantile(
+            app_mix0, app_mix1, "ktpu_llama_request_latency_seconds", 0.99)
+        watch_mixed = _delta_quantile(
+            fleet_mix0, fleet_mix1, "ktpu_informer_lag_seconds", 0.99)
+        churn_mixed = ((driver.creates + driver.deletes - ops_mix0)
+                       / max(mix_wall, 1e-6))
+
+        def block(solo: Optional[float],
+                  mixed: Optional[float]) -> dict:
+            delta = (round(mixed - solo, 4)
+                     if solo is not None and mixed is not None else None)
+            return {"solo": _r(solo), "mixed": _r(mixed), "delta": delta}
+
+        result.update({
+            "phases": phases,
+            "slos": scorecard.verdict(),
+            "breached_slos": scorecard.breached_slos(),
+            "breach_timelines": breach_timelines,
+            "interference": {
+                "serving_p99_s": block(serving_solo, serving_mixed),
+                "watch_lag_p99_s": block(watch_solo, watch_mixed),
+                "churn_ops_per_s": block(round(churn_solo, 2),
+                                         round(churn_mixed, 2)),
+            },
+            "scenarios": {
+                "serving": {"impl": cfg.serve_impl,
+                            "rate_rps": cfg.serve_rate,
+                            "replicas": cfg.serve_replicas,
+                            **load_stats},
+                "training": {"gang_workers": cfg.gang_workers,
+                             "gang_reached_running": gang_up},
+                "churn": {"actors": cfg.actors,
+                          "target_rate_ops_s": cfg.churn_rate,
+                          "driver": driver.result()},
+            },
+            "chaos_events": conductor.events if conductor else [],
+            "node_killed": conductor.node_killed if conductor else "",
+            "topology": {"nodes": cfg.nodes,
+                         "sched_shards": cfg.sched_shards,
+                         "store_shards": cfg.store_shards,
+                         "apiservers": cfg.apiservers},
+        })
+        measured = [v for v in result["slos"].values()
+                    if v["met"] is not None]
+        result["slos_measured"] = len(measured)
+        result["ok"] = bool(measured) and all(v["met"] for v in measured)
+        return result
+    finally:
+        _phase("teardown")
+        feeder_stop.set()
+        if conductor is not None:
+            _quiet(conductor.stop)
+        if load is not None:
+            _quiet(load.stop)
+        if driver is not None:
+            _quiet(driver.stop)
+        if scorecard is not None:
+            _quiet(scorecard.stop)
+        if app is not None:
+            _quiet(app.stop)
+        if cluster is not None:
+            _quiet(cluster.stop)
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return round(v, 4) if isinstance(v, float) else v
+
+
+def _quiet(fn):
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 — teardown best-effort; the verdict already shipped
+        return
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="everything-at-once cluster-life mixer")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--sched-shards", type=int, default=2)
+    ap.add_argument("--store-shards", type=int, default=2)
+    ap.add_argument("--apiservers", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--solo", type=float, default=5.0,
+                    help="seconds per solo-baseline phase")
+    ap.add_argument("--mix", type=float, default=20.0,
+                    help="seconds of the mixed phase")
+    ap.add_argument("--serve-impl", default="decode",
+                    choices=("decode", "synthetic"))
+    ap.add_argument("--serve-rate", type=float, default=6.0)
+    ap.add_argument("--actors", type=int, default=6)
+    ap.add_argument("--churn-rate", type=float, default=3.0)
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--no-node-kill", action="store_true")
+    ap.add_argument("--induce-breach", action="store_true",
+                    help="tighten watch-lag + run a heavy fault window "
+                         "so a breach (and its timeline) is guaranteed")
+    ap.add_argument("--out", default="", help="also write the scorecard "
+                                              "JSON to this path")
+    args = ap.parse_args()
+    cfg = LifeConfig(
+        nodes=args.nodes, sched_shards=args.sched_shards,
+        store_shards=args.store_shards, apiservers=args.apiservers,
+        seed=args.seed, solo_seconds=args.solo, mix_seconds=args.mix,
+        serve_impl=args.serve_impl, serve_rate=args.serve_rate,
+        actors=args.actors, churn_rate=args.churn_rate,
+        chaos=not args.no_chaos, node_kill=not args.no_node_kill,
+        induce_breach=args.induce_breach, out=args.out,
+    )
+    result = run_cluster_life(cfg)
+    blob = json.dumps(result, indent=2, default=str)
+    print(blob, flush=True)
+    if cfg.out:
+        with open(cfg.out, "w", encoding="utf-8") as fh:
+            fh.write(blob + "\n")
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

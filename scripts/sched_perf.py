@@ -788,7 +788,8 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         return round(v, 4) if v is not None else None
 
     # read-path economics off EVERY apiserver's /metrics, merged the same
-    # way the schedulers' are (counters sum, gauges/quantiles max): with
+    # way the schedulers' are (counters sum, gauges max, histogram
+    # quantiles recomputed from summed cumulative buckets): with
     # apiservers > 1 a single-URL scrape silently reported peer 0 only —
     # the same bug the per-shard store counters had before the merge
     # probe BEFORE the apiserver scrape so its indexed LISTs land in
@@ -960,7 +961,8 @@ def _drive(nodes, pods, tpus_per_node, creators, multiproc, url, cs, master,
         "churn": churn,
         # per-attempt algorithm latency from the schedulers' own
         # histograms — in-process via the objects, multiproc via the
-        # merged /metrics endpoints (counters sum, quantiles max)
+        # merged /metrics endpoints (counters sum, histogram quantiles
+        # recomputed from the summed cumulative _bucket lines)
         "schedule_attempts": (
             sum(s.schedule_attempts for s in scheds) if scheds
             else from_metrics("scheduler_schedule_attempts_total")),

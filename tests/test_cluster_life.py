@@ -1,0 +1,270 @@
+"""Cluster-life scorecard: scorecard-evaluator unit tests + the tier-1
+mixer smoke.
+
+The unit half drives obs/scorecard.py and obs/timeline.py with stub
+collectors/clientsets (deterministic clocks, no HTTP) and pins the two
+staleness invariants from PR 15:
+
+  - a stale PodCustomMetrics collection is MISSING for SLO counting,
+    never good or bad;
+  - a collector target whose last scrape is older than ``stale_after_s``
+    is omitted from the fleet view entirely.
+
+The smoke half is one seconds-scale scripts/cluster_life.py mixer run —
+serving + gang + churn + conducted chaos windows on a 2-node
+sharded-scheduler topology — asserting the scorecard JSON envelope the
+bench and chaos drivers consume.  The full-duration run (node kill, gang
+MTTR, induced breach) lives in the slow tier (`chaos.py --schedule
+life`).
+"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes1_tpu.api import types as t
+from kubernetes1_tpu.obs import aggregate
+from kubernetes1_tpu.obs import timeline as timeline_mod
+from kubernetes1_tpu.obs.scorecard import SLO, Scorecard
+from kubernetes1_tpu.utils import flightrec
+
+
+# ------------------------------------------------------------ unit stubs
+
+
+class _StubTargets:
+    """ObsCollector stand-in: targets() only (fleet-view tests)."""
+
+    def __init__(self, targets):
+        self._targets = targets
+
+    def targets(self):
+        return self._targets
+
+
+class _StubPCM:
+    """clientset.podcustommetrics stand-in (pods-source tests)."""
+
+    def __init__(self, cols):
+        self.cols = cols
+
+    def list(self, namespace=None, label_selector=None):
+        return self.cols, "1"
+
+
+def _pcm(value: float, stale: bool = False) -> t.PodCustomMetrics:
+    pcm = t.PodCustomMetrics()
+    pcm.stale = stale
+    pcm.samples = [t.MetricSample(name="ktpu_llama_qps", value=value)]
+    return pcm
+
+
+# ------------------------------------------------------- scorecard units
+
+
+class TestScorecardStaleness:
+    def test_stale_pod_collections_read_missing_not_bad(self):
+        """A stale PodCustomMetrics is last-good data wearing a warning
+        label; the SLO must not count it as fresh truth in EITHER
+        direction.  With one fresh + one stale pod only the fresh value
+        is folded; with every pod stale the tick is missing."""
+        cs = SimpleNamespace(podcustommetrics=_StubPCM(
+            [_pcm(5.0), _pcm(50.0, stale=True)]))
+        sc = Scorecard(collector=None, clientset=cs)
+        sc.add(SLO(name="qps", source="pods", metric="ktpu_llama_qps",
+                   op=">=", threshold=1.0, reduce="max", objective=0.5))
+        out = sc.tick(now=100.0)
+        assert out["qps"] == 5.0  # the stale 50.0 never enters the fold
+        cs.podcustommetrics.cols = [_pcm(5.0, stale=True),
+                                    _pcm(50.0, stale=True)]
+        out = sc.tick(now=100.5)
+        assert out["qps"] is None
+        v = sc.verdict()["qps"]
+        assert (v["good"], v["bad"], v["missing"]) == (1, 0, 1)
+
+    def test_stale_fleet_targets_omitted_from_view(self):
+        """A target the collector has not scraped within stale_after_s
+        is dropped from the fleet merge — its series go missing rather
+        than freezing at the last scrape's values."""
+        parsed = aggregate.parse_metrics_text(
+            "# TYPE ktpu_probe gauge\nktpu_probe 1.5\n")
+        tgt = SimpleNamespace(parsed=parsed, up=True,
+                              last_scrape_mono=99.0)
+        sc = Scorecard(collector=_StubTargets([tgt]), clientset=None,
+                       stale_after_s=10.0)
+        sc.add(SLO(name="probe", source="fleet", metric="ktpu_probe",
+                   op="<=", threshold=2.0, objective=0.5))
+        assert sc.tick(now=100.0)["probe"] == 1.5  # 1s old: fresh
+        assert sc.tick(now=120.0)["probe"] is None  # 21s old: stale
+        tgt.up = False
+        tgt.last_scrape_mono = 120.0
+        assert sc.tick(now=121.0)["probe"] is None  # down: never merged
+        v = sc.verdict()["probe"]
+        assert (v["good"], v["bad"], v["missing"]) == (1, 0, 2)
+
+
+class TestScorecardBurnAndBreach:
+    def test_fed_breach_fires_hooks_notes_flightrec_and_rearms(self):
+        flightrec.reset()
+        sc = Scorecard(collector=None, clientset=None)
+        sc.add(SLO(name="ops", source="fed", op=">=", threshold=1.0,
+                   objective=0.5, scenario="churn",
+                   burn_alerts=((1.0, 0.5, 2.0),)))
+        hooks = []
+        sc.on_breach(lambda slo, ev: hooks.append((slo.name, ev)))
+        now = 1000.0
+        for i in range(4):  # sustained hard failure: burn = 1/0.5 = 2x
+            sc.feed("ops", 0.0)
+            sc.tick(now=now + 0.25 * i)
+        v = sc.verdict()["ops"]
+        assert v["breaches"], "burn 2x over both windows must breach"
+        assert hooks and hooks[0][0] == "ops"
+        assert hooks[0][1]["burn_rate"] == pytest.approx(2.0)
+        kinds = [ev["kind"] for comp in
+                 flightrec.dump()["components"].values() for ev in comp]
+        assert flightrec.SLO_BREACH in kinds
+        # recovery re-arms: good ticks drain the windows, then a second
+        # sustained burn is a SECOND breach event, not a suppressed one
+        for i in range(8):
+            sc.feed("ops", 5.0)
+            sc.tick(now=now + 2.0 + 0.25 * i)
+        for i in range(4):
+            sc.feed("ops", 0.0)
+            sc.tick(now=now + 6.0 + 0.25 * i)
+        assert len(sc.verdict()["ops"]["breaches"]) == 2
+        assert len(hooks) == 2
+
+    def test_burn_rate_exported_under_slo_prefix(self):
+        sc = Scorecard(collector=None, clientset=None)
+        sc.add(SLO(name="ops", source="fed", op=">=", threshold=1.0,
+                   objective=0.5, burn_alerts=((1.0, 0.5, 2.0),)))
+        sc.feed("ops", 0.0)
+        sc.tick(now=1.0)
+        text = sc.render()
+        assert "ktpu_slo_burn_rate" in text
+        assert 'slo="ops"' in text
+        assert "ktpu_slo_bad_total" in text
+
+
+# -------------------------------------------------------- timeline units
+
+
+class _StubCollector:
+    def __init__(self, flight, spans):
+        self._flight = flight
+        self._spans = spans
+
+    def flightrecorder(self):
+        return {"components": self._flight}
+
+    def traces(self, trace_id=""):
+        spans = self._spans
+        if trace_id:
+            spans = [s for s in spans if s.get("traceId") == trace_id]
+        return {"spans": spans}
+
+
+class TestTimelineMerge:
+    def test_events_and_spans_interleave_by_wall_time(self):
+        col = _StubCollector(
+            flight={
+                "scheduler": [{"wall": 10.0, "kind": "gang_attempt",
+                               "rv": "41"}],
+                "apiserver": [{"wall": 12.0, "kind": "watch_resync",
+                               "rv": "41"}],
+                "kcm": [{"wall": 11.0, "kind": "node_notready",
+                         "node": "node-1"}],
+            },
+            spans=[{"traceId": "abc", "spanId": "1", "start": 10.5,
+                    "durationMs": 30.0, "name": "bind",
+                    "component": "scheduler"}])
+        tl = timeline_mod.capture(col)
+        assert [e["t_wall"] for e in tl["entries"]] == [10.0, 10.5,
+                                                        11.0, 12.0]
+        assert tl["components"] == ["apiserver", "kcm", "scheduler"]
+        assert tl["counts"] == {"events": 3, "spans": 1}
+        # correlation keys: the rv links scheduler+apiserver entries,
+        # the trace id tags the span
+        assert tl["keys"]["rv:41"] == 2
+        assert tl["keys"]["trace:abc"] == 1
+        # the kcm event's payload survives as detail
+        kcm = [e for e in tl["entries"] if e["component"] == "kcm"][0]
+        assert kcm["detail"]["node"] == "node-1"
+
+    def test_since_wall_and_max_entries_bound_the_artifact(self):
+        col = _StubCollector(
+            flight={"c": [{"wall": float(i), "kind": "lease_steal"}
+                          for i in range(10)]},
+            spans=[])
+        tl = timeline_mod.capture(col, since_wall=5.0, max_entries=3)
+        assert [e["t_wall"] for e in tl["entries"]] == [7.0, 8.0, 9.0]
+
+
+# ------------------------------------------------------ the tier-1 smoke
+
+
+class TestClusterLifeSmoke:
+    def test_mini_mix_emits_scorecard_envelope(self):
+        """One seconds-scale mixer run: 2 nodes, 2 scheduler shards,
+        serving + gang + churn + two conducted fault windows.  Pins the
+        scorecard JSON envelope (the contract bench.py, chaos.py and the
+        README document) and that every scenario axis got judged."""
+        from scripts.cluster_life import LifeConfig, run_cluster_life
+
+        result = run_cluster_life(LifeConfig(
+            nodes=2, sched_shards=2, store_shards=1, seed=11,
+            solo_seconds=1.0, mix_seconds=5.0,
+            serve_impl="synthetic", serve_rate=3.0, serve_replicas=2,
+            hpa_max_replicas=3, gang_workers=2, tpus_per_worker=1,
+            actors=3, churn_rate=2.0,
+            chaos=True, chaos_period_s=2.0, chaos_window_s=0.8,
+            node_kill=False))
+        # envelope: every consumer-facing key present
+        for key in ("config", "seed", "schedsan_seed", "phases", "slos",
+                    "breached_slos", "breach_timelines", "interference",
+                    "scenarios", "chaos_events", "topology",
+                    "slos_measured", "ok"):
+            assert key in result, key
+        assert result["phases"] == ["boot", "solo:serving", "solo:churn",
+                                    "mix"]
+        # >=5 SLO verdicts, one per scenario axis
+        assert set(result["slos"]) == {
+            "serving_p99", "serving_qps", "gang_recovery_mttr",
+            "churn_ops", "watch_lag", "hpa_reaction"}
+        for v in result["slos"].values():
+            assert {"good", "bad", "missing", "met", "objective",
+                    "breaches"} <= set(v)
+        # the mix actually measured the live axes (gang MTTR stays
+        # missing without a node kill — met None, not a lie)
+        measured = [n for n, v in result["slos"].items()
+                    if v["good"] + v["bad"] > 0]
+        assert len(measured) >= 4, result["slos"]
+        assert result["slos"]["gang_recovery_mttr"]["met"] is None
+        # interference deltas vs the solo baselines, all three axes
+        assert set(result["interference"]) == {
+            "serving_p99_s", "watch_lag_p99_s", "churn_ops_per_s"}
+        for block in result["interference"].values():
+            assert {"solo", "mixed", "delta"} == set(block)
+        # chaos windows were conducted and recorded
+        assert result["chaos_events"], "no fault window fired"
+        assert result["scenarios"]["training"]["gang_reached_running"]
+        # a quiet 5s mix with generous thresholds must score green
+        assert result["ok"] is True, result["slos"]
+
+
+@pytest.mark.slow
+class TestLifeScheduleSlow:
+    def test_chaos_life_schedule_verdict(self):
+        """The full mixer as a chaos schedule: node kill + gang MTTR +
+        the verdict keys the sweep summary folds."""
+        from scripts.chaos import run_life_schedule
+
+        v = run_life_schedule(7, duration=10.0)
+        for key in ("ok", "mode", "seed", "acked", "recovery_s",
+                    "schedsan_seed", "slos", "interference"):
+            assert key in v, key
+        assert v["mode"] == "life"
+        assert v["ok"] is True, v["slos"]
+        assert v["node_killed"]
+        assert v["recovery_s"] > 0.0

@@ -750,6 +750,49 @@ def test_ktpu011_covers_appmetrics_construction_sites():
     assert len(findings2) == 1 and "bare_name_total" in findings2[0].message
 
 
+def test_ktpu011_scorecard_requires_ktpu_slo_prefix():
+    """obs/scorecard.py is the one producer of SLO verdict series: a
+    plain ktpu_ prefix (fine anywhere else) is a finding THERE, so the
+    scorecard's output can never shadow the series it judges."""
+    src = """
+        def build(reg):
+            reg.counter("ktpu_good_total")  # ktpu_ but not ktpu_slo_
+            reg.gauge("ktpu_slo_burn_rate")  # correct family
+    """
+    findings = lint_file("kubernetes1_tpu/obs/scorecard.py",
+                         textwrap.dedent(src))
+    findings = [f for f in findings if f.pass_id == "KTPU011"]
+    assert len(findings) == 1
+    assert "ktpu_slo_" in findings[0].message
+    assert "ktpu_good_total" in findings[0].message
+    # the same source anywhere else is clean
+    assert [f.pass_id for f in lint_file(
+        "kubernetes1_tpu/obs/collector.py", textwrap.dedent(src))] == []
+
+
+def test_ktpu011_flightrec_attribute_kind_checked_against_enum():
+    """A flightrec.X attribute kind must exist in the declared enum
+    (utils/flightrec.py, parsed statically): a typo'd kind is a lint
+    finding, not a runtime AttributeError in a breach path."""
+    bad = """
+        from kubernetes1_tpu.utils import flightrec
+
+        def f():
+            flightrec.note("scorecard", flightrec.SLO_BREACHED, slo="x")
+    """
+    findings = [f for f in _lint(bad) if f.pass_id == "KTPU011"]
+    assert len(findings) == 1
+    assert "SLO_BREACHED" in findings[0].message
+    good = """
+        from kubernetes1_tpu.utils import flightrec
+
+        def f():
+            flightrec.note("scorecard", flightrec.SLO_BREACH, slo="x")
+            flightrec.note("mixer", flightrec.SCORECARD_PHASE, phase="mix")
+    """
+    assert _ids(good) == []
+
+
 def test_ktpu011_quiet_on_prefixed_appmetrics_and_hpa_rescale_kind():
     src = """
         from kubernetes1_tpu.obs.appmetrics import AppMetrics

@@ -88,6 +88,45 @@ class TestBucketWiseMerge:
             {"ktpu_x_total": 4.0, "ktpu_depth": 5.0}])
         assert flat == {"ktpu_x_total": 7.0, "ktpu_depth": 7.0}
 
+    def test_mismatched_bucket_boundaries_raise(self):
+        """Summing cumulative _bucket lines is only sound when every
+        input bucketed the SAME way; a silent merge across different
+        `le` sets invents a distribution neither instance measured.
+        The merge must refuse, loudly."""
+        t1 = ('ktpu_m_seconds_bucket{le="0.1"} 3\n'
+              'ktpu_m_seconds_bucket{le="+Inf"} 3\n'
+              "ktpu_m_seconds_count 3\nktpu_m_seconds_sum 0.2\n")
+        t2 = ('ktpu_m_seconds_bucket{le="0.25"} 5\n'
+              'ktpu_m_seconds_bucket{le="+Inf"} 5\n'
+              "ktpu_m_seconds_count 5\nktpu_m_seconds_sum 0.9\n")
+        with pytest.raises(ValueError, match="mismatched histogram"):
+            aggregate.merge_parsed(
+                [aggregate.parse_metrics_text(x) for x in (t1, t2)])
+        # flat-dict leg enforces the same contract
+        with pytest.raises(ValueError, match="mismatched histogram"):
+            aggregate.merge_metrics([
+                {'ktpu_m_seconds_bucket{le="0.1"}': 3.0},
+                {'ktpu_m_seconds_bucket{le="0.25"}': 5.0}])
+
+    def test_empty_histogram_merge_is_identity(self):
+        """An instance that has observed NOTHING renders zero-filled
+        buckets (no quantile lines); merging it in must not move the
+        populated instance's buckets, count, sum, or quantiles."""
+        a = Histogram("ktpu_e_seconds")
+        b = Histogram("ktpu_e_seconds")  # never observed
+        for _ in range(100):
+            a.observe(0.02)
+        pa = aggregate.parse_metrics_text(a.render())
+        pb = aggregate.parse_metrics_text(b.render())
+        alone = aggregate.merge_parsed([pa])
+        merged = aggregate.merge_parsed([pa, pb])
+        assert merged.samples == alone.samples
+        p99_alone = list(aggregate.select(
+            alone, "ktpu_e_seconds", quantile="0.99").values())[0]
+        p99_merged = list(aggregate.select(
+            merged, "ktpu_e_seconds", quantile="0.99").values())[0]
+        assert p99_merged == p99_alone
+
     def test_quantile_max_fallback_for_reservoir_only_metrics(self):
         """No _bucket lines rendered -> the documented fallback: max."""
         t1 = 'ktpu_r_seconds{quantile="0.99"} 0.5\n'

@@ -25,18 +25,73 @@ depends on:
    ad-hoc string literal: the enum is what makes a kind greppable from
    producer to dump consumer, and ``note()`` raises on strings that
    aren't in it — this pass moves that failure from runtime to lint.
+   ``flightrec.X`` attribute kinds are additionally resolved against the
+   constants DECLARED in utils/flightrec.py (parsed statically), so a
+   typo'd or not-yet-added kind (``flightrec.SLO_BREACHED``) is a lint
+   finding, not a runtime AttributeError in a breach path.
+
+3. **Scorecard series live under ``ktpu_slo_``.**  obs/scorecard.py is
+   the one producer of SLO verdict series; every metric it constructs
+   must carry the ``ktpu_slo_`` prefix so the scorecard's own output is
+   selectable as a family (dashboards, the mixer's JSON) and can never
+   shadow the component series it judges.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List, Set
+import os
+from typing import Dict, FrozenSet, List, Optional, Set
 
 from .engine import FileContext, Finding, register
 
 _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 _METRIC_METHODS = {"counter", "gauge", "histogram"}
 _ALLOWED_PREFIXES = ("ktpu_", "scheduler_")
+# obs/scorecard.py constructs SLO verdict series: stricter prefix
+_SCORECARD_TAIL = os.path.join("obs", "scorecard.py")
+_SCORECARD_PREFIX = "ktpu_slo_"
+
+_FLIGHTREC_TAIL = os.path.join("utils", "flightrec.py")
+_enum_cache: Dict[str, Optional[FrozenSet[str]]] = {}
+
+
+def _declared_kinds(ctx_path: str) -> Optional[FrozenSet[str]]:
+    """Constant names declared in utils/flightrec.py, located by walking
+    up from the linted file (the lint runs from arbitrary cwds).  None
+    when the enum source can't be found — the check degrades to the
+    literal-only rule rather than inventing findings."""
+    d = os.path.dirname(os.path.abspath(ctx_path))
+    for _ in range(12):
+        candidate = os.path.join(d, "kubernetes1_tpu", _FLIGHTREC_TAIL)
+        hit = _enum_cache.get(candidate)
+        if hit is None and candidate not in _enum_cache:
+            hit = _parse_enum(candidate)
+            _enum_cache[candidate] = hit
+        if hit:
+            return hit
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def _parse_enum(path: str) -> Optional[FrozenSet[str]]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return None
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                    names.add(tgt.id)
+    return frozenset(names) or None
 
 
 def _metric_imports(tree: ast.Module) -> Set[str]:
@@ -70,10 +125,22 @@ def _literal_str_arg(call: ast.Call, idx: int, keyword: str = ""):
     return None
 
 
+def _kind_arg(call: ast.Call) -> Optional[ast.expr]:
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "kind":
+            return kw.value
+    return None
+
+
 @register("KTPU011")
 def obs_pass(ctx: FileContext) -> List[Finding]:
     findings: List[Finding] = []
     metric_names = _metric_imports(ctx.tree)
+    in_scorecard = os.path.abspath(ctx.path).endswith(_SCORECARD_TAIL)
+    declared = None
+    declared_resolved = False
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -85,13 +152,22 @@ def obs_pass(ctx: FileContext) -> List[Finding]:
         elif isinstance(func, ast.Attribute) \
                 and func.attr in _METRIC_METHODS:
             name_literal = _literal_str_arg(node, 0, keyword="name")
-        if name_literal is not None \
-                and not name_literal.startswith(_ALLOWED_PREFIXES):
-            findings.append(Finding(
-                ctx.path, node.lineno, "KTPU011",
-                f"metric name {name_literal!r} lacks the ktpu_/scheduler_ "
-                f"prefix — the fleet merge (obs/aggregate) namespaces "
-                f"series by prefix; unprefixed names collide silently"))
+        if name_literal is not None:
+            if in_scorecard \
+                    and not name_literal.startswith(_SCORECARD_PREFIX):
+                findings.append(Finding(
+                    ctx.path, node.lineno, "KTPU011",
+                    f"scorecard metric name {name_literal!r} lacks the "
+                    f"{_SCORECARD_PREFIX!r} prefix — SLO verdict series "
+                    f"must be selectable as one family and must never "
+                    f"shadow the component series the scorecard judges"))
+            elif not name_literal.startswith(_ALLOWED_PREFIXES):
+                findings.append(Finding(
+                    ctx.path, node.lineno, "KTPU011",
+                    f"metric name {name_literal!r} lacks the "
+                    f"ktpu_/scheduler_ prefix — the fleet merge "
+                    f"(obs/aggregate) namespaces series by prefix; "
+                    f"unprefixed names collide silently"))
         # -- flightrec kind enum -----------------------------------------
         if isinstance(func, ast.Attribute) and func.attr == "note" \
                 and isinstance(func.value, ast.Name) \
@@ -104,4 +180,20 @@ def obs_pass(ctx: FileContext) -> List[Finding]:
                     f"use the declared enum constant "
                     f"(utils/flightrec.py, e.g. flightrec.LEASE_STEAL) "
                     f"so every producer/consumer of the kind is greppable"))
+            else:
+                kind_node = _kind_arg(node)
+                if isinstance(kind_node, ast.Attribute) \
+                        and isinstance(kind_node.value, ast.Name) \
+                        and kind_node.value.id == "flightrec":
+                    if not declared_resolved:
+                        declared = _declared_kinds(ctx.path)
+                        declared_resolved = True
+                    if declared is not None \
+                            and kind_node.attr not in declared:
+                        findings.append(Finding(
+                            ctx.path, node.lineno, "KTPU011",
+                            f"flightrec.{kind_node.attr} is not declared "
+                            f"in the utils/flightrec.py enum — add the "
+                            f"constant (and KINDS entry) before noting "
+                            f"it, or fix the typo"))
     return findings
