@@ -32,6 +32,9 @@ Environment-variable table (the driver's knobs; defaults in parens):
   BENCH_BIND_CODEC (json)     bindings:batch body codec (PR 10)
   BENCH_STORE_WAL (0)         1 = per-shard WALs (durable shape)
   BENCH_BIND_STREAM (0)       1 = persistent zero-copy bind leg (PR 12)
+  BENCH_EVENTLOOP (1)         0 = thread-per-connection watch serving
+                              (the pre-PR18 A/B baseline); plumbed to
+                              every apiserver via KTPU_EVENTLOOP
   BENCH_HOLLOW_WATCHERS (0)   N informer-only kubelet stand-ins (the
                               kubemark watch swarm, PR 13); > 0 adds the
                               sched_perf_envelope phase at BENCH_NODES x
@@ -103,6 +106,12 @@ STORE_WAL = os.environ.get("BENCH_STORE_WAL", "") == "1"
 # zero-copy bind leg (BENCH_r07+): schedulers ship bulk binds over the
 # persistent length-prefixed bind stream instead of full HTTP per round
 BIND_STREAM = os.environ.get("BENCH_BIND_STREAM", "") == "1"
+# Event-loop watch serving A/B (PR 18): BENCH_EVENTLOOP=0 reverts every
+# apiserver (in-process and spawned — both read KTPU_EVENTLOOP) to the
+# thread-per-connection baseline so a density/envelope run can price the
+# dispatcher against parked handler threads on identical load.
+EVENTLOOP = os.environ.get("BENCH_EVENTLOOP", "1") not in ("0", "false")
+os.environ["KTPU_EVENTLOOP"] = "1" if EVENTLOOP else "0"
 # kubemark hollow-watcher swarm (the 5000-node envelope's watch half):
 # > 0 adds the sched_perf_envelope phase — BENCH_NODES nodes, informer-
 # only kubelet stand-ins watching pods by spec.nodeName, flat-RSS and
@@ -889,7 +898,10 @@ def main():
     from kubernetes1_tpu.utils.benchstamp import contention_stamp
 
     extras = {"baseline": "reference pod-startup SLO p99<=5s (metrics_util.go:46); "
-                          "north-star imgs/sec/chip + MFU (BASELINE.md)"}
+                          "north-star imgs/sec/chip + MFU (BASELINE.md)",
+              # which watch-serving substrate this round ran on — rounds
+              # are only comparable within one value of this knob
+              "eventloop": EVENTLOOP}
     # a poisoned box poisons every number: reap stragglers FIRST
     try:
         extras["preflight"] = preflight_reap()

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import os
 import socket
 import threading
 import time
@@ -24,7 +25,15 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from ..utils import fasthttp, flightrec, locksan, spans as spanlib
+from ..utils import (
+    eventloop as _eventloop,
+    fasthttp,
+    faultline,
+    flightrec,
+    locksan,
+    schedsan,
+    spans as spanlib,
+)
 from urllib.parse import parse_qs, urlparse
 
 from ..api import types as t
@@ -96,6 +105,436 @@ from .auth import (
 from .registry import Registry
 
 WATCH_HEARTBEAT_SECONDS = 5.0
+
+
+def _encode_chunks(frames) -> bytes:
+    """Frame N watch payloads as chunked-transfer bytes — ONE buffer, so
+    a batch costs one syscall and one client recv wakeup.  The chunked-
+    encoding wire format lives only here, shared by the threaded serving
+    loop and the event-loop dispatcher: two serving modes, one set of
+    wire bytes (the golden parity test pins this)."""
+    buf = bytearray()
+    for data in frames:
+        if not data:
+            # zero-length would terminate chunked encoding; a newline
+            # keeps the stream alive (heartbeats ride this)
+            data = b"\n"
+        buf += b"%x\r\n" % len(data) + data + b"\r\n"
+    return bytes(buf)
+
+
+class _WatchStream:
+    """Per-watch frame factory: everything about one watch stream's wire
+    frames (event frames, composite/progress/lag BOOKMARK frames, the
+    410-eviction frame) with no I/O.  Both serving modes — the threaded
+    loop parked in ``_serve_watch`` and the event-loop ``_WatchConn``
+    state machine — build their bytes HERE, so the wire cannot drift
+    between them."""
+
+    def __init__(self, master: "Master", w, q: Dict[str, str], ver: str):
+        self.master = master
+        self.w = w
+        self.ver = ver
+        # merged multi-shard streams interleave shards (cross-shard order
+        # is per-shard only), so a single per-object rv cannot encode the
+        # stream's position — BOOKMARK frames carrying the composite
+        # resume position do (the Kubernetes watch-bookmark analog).
+        # Plain streams never emit them: byte-identical wire at shards=1.
+        self.bookmarks = getattr(w, "emit_bookmarks", False)
+        # watch-lag SLI opt-in (?lagStamps=1, informers set it): after
+        # every delivered batch, a BOOKMARK frame carries the monotonic
+        # commit stamp of the batch's newest revision PER SHARD
+        # (obs.ktpu.io/committed-at, "<shard>:<ts>" tokens) so the
+        # client can export delivered-at minus committed-at without any
+        # cross-shard clock math.  Streams that didn't ask stay
+        # byte-identical — stamps never ride the cached event frames.
+        self.lag_stamps = q.get("lagStamps") in ("1", "true")
+        # progress-bookmark opt-in (?progressBookmarks=1, informers set
+        # it): PLAIN streams (shards=1, no composite bookmarks) get a
+        # BOOKMARK frame on idle heartbeats carrying a SAFE resume
+        # revision (Watcher.progress_rv — the cache head, but only when
+        # nothing is queued undelivered), so an informer idle for minutes
+        # resumes above the compaction floor instead of 410-full-
+        # relisting the collection.  Streams that didn't ask stay
+        # byte-identical; merged streams already bookmark every
+        # heartbeat.
+        self.progress = (not self.bookmarks
+                         and q.get("progressBookmarks") in ("1", "true"))
+        self.n_shards = max(1, master.store_shards)
+
+    def bookmark_frame(self) -> bytes:
+        self.master.note_watch_bookmark()
+        return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
+                b'"apiVersion":"v1","metadata":{"resourceVersion":"'
+                + self.w.bookmark_rv().encode() + b'"}}}\n')
+
+    def progress_frame(self) -> Optional[bytes]:
+        fn = getattr(self.w, "progress_rv", None)
+        rv = fn() if fn is not None else None
+        if not rv:
+            return None  # unsafe this tick (events in flight): skip
+        self.master.note_watch_bookmark()
+        return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
+                b'"apiVersion":"v1","metadata":{"resourceVersion":"'
+                + str(rv).encode() + b'"}}}\n')
+
+    def lag_frame(self, evs) -> Optional[bytes]:
+        """Lag-stamp bookmark for one delivered batch (None when no
+        stamp is available and the stream has no bookmark position
+        to refresh either)."""
+        per_shard: Dict[int, int] = {}
+        for ev in evs:
+            try:
+                rev = int((ev.object.get("metadata") or {})
+                          .get("resourceVersion") or 0)
+            except (TypeError, ValueError, AttributeError):
+                continue
+            if rev > per_shard.get(rev % self.n_shards, 0):
+                per_shard[rev % self.n_shards] = rev
+        toks = []
+        for sh in sorted(per_shard):
+            ts = self.master.store.commit_ts_of(per_shard[sh])
+            if ts is not None:
+                toks.append(f"{sh}:{ts:.6f}")
+        if not toks and not self.bookmarks:
+            return None
+        rv = (self.w.bookmark_rv() if self.bookmarks
+              else str(max(per_shard.values(), default=0)))
+        meta: Dict[str, Any] = {"resourceVersion": rv}
+        if toks:
+            meta["annotations"] = {
+                t.COMMITTED_AT_ANNOTATION: " ".join(toks)}
+        self.master.note_watch_bookmark()
+        return json.dumps(
+            {"type": "BOOKMARK",
+             "object": {"kind": "Bookmark", "apiVersion": "v1",
+                        "metadata": meta}},
+            separators=(",", ":")).encode() + b"\n"
+
+    def heartbeat_frame(self) -> bytes:
+        """The idle-tick frame: a composite bookmark on merged streams, a
+        progress bookmark when opted in and safe, else the empty payload
+        (an encoder-level ``\\n`` keep-alive chunk)."""
+        fr = (self.bookmark_frame() if self.bookmarks
+              else self.progress_frame() if self.progress else None)
+        return fr if fr else b""
+
+    def batch_frames(self, evs) -> List[bytes]:
+        """One delivered batch -> its wire frames.  WatchEvents are
+        SHARED by every watcher of the resource (one fan-out wakeup per
+        group commit) and the payload bytes come from the scheme's
+        once-per-revision serialization cache — N watchers plus every
+        list/get of the same revision cost ONE encode (the reference's
+        cacher economics, storage/cacher.go)."""
+        frames = [self.master.scheme.watch_frame_bytes(
+                      ev.type, ev.object, self.ver)
+                  for ev in evs if self.w.event_matches(ev.object)]
+        if self.bookmarks or self.lag_stamps:
+            # after every delivered batch: the bookmark rides the
+            # same buffered write, so a cut can strand at most
+            # one batch's worth of single-int rv — and the
+            # informer resumes from the last composite it holds
+            # (duplicates are idempotent; gaps would be lost
+            # state).  Selector-filtered batches still bookmark:
+            # the position advanced even if no frame matched.
+            # With lagStamps the commit stamp rides the same
+            # bookmark frame; without it the handcrafted bytes
+            # stay exactly what PR 10 shipped.
+            fr = (self.lag_frame(evs) if self.lag_stamps
+                  else self.bookmark_frame())
+            if fr is not None:
+                frames.append(fr)
+        return frames
+
+    def eviction_frame(self) -> bytes:
+        """The 410 ERROR frame a slow/stale consumer's stream ends with
+        (the reference cacher's eviction contract, storage/cacher.go)."""
+        status = TooOldResourceVersion(
+            "watch evicted; relist required").to_status()
+        return json.dumps({"type": ERROR, "object": status},
+                          separators=(",", ":")).encode() + b"\n"
+
+
+# selectors event masks, local names for the conn state machine
+_EV_READ = 1   # selectors.EVENT_READ
+_EV_WRITE = 2  # selectors.EVENT_WRITE
+
+
+class _WatchConn:
+    """One handed-off watch connection's state machine on the shared
+    dispatcher: the event-loop replacement for a ThreadingHTTPServer
+    thread parked in ``_serve_watch``'s blocking loop.
+
+    State: the socket (detached from the HTTP server after the chunked
+    headers went out), the per-connection cacher batch cursor (the
+    Watcher, drained with ``next_batch_nowait`` on its notify hook), a
+    bounded outbuf of pending wire bytes, and heartbeat/deadline timers
+    on the loop.
+
+    Semantics carried over from the threaded loop unchanged:
+
+    - BACKPRESSURE: the watcher is drained ONLY while the outbuf is
+      empty.  A client that stops reading leaves bytes in the outbuf, the
+      drain stops, the watcher's bounded queue fills, and the existing
+      slow-consumer eviction fires — exactly what a blocked sendall
+      produced, with per-connection memory bounded by one batch's frames
+      instead of a whole thread stack.
+    - HEARTBEATS: a per-connection loop timer re-armed on every delivered
+      batch emits the same idle-tick frame (composite/progress bookmark
+      or keep-alive chunk) at the same cadence.
+    - 410 EVICTION: stream end with ``evicted`` set writes the ERROR
+      frame, then the terminal chunk — byte-identical to the threaded
+      path.
+    - TEARDOWN: peer hangup (zero-byte read) or a write error stops the
+      watcher and closes; server stop ends every stream with a terminal
+      chunk, like the threaded loop's ``stopping`` check.
+
+    All methods run on the loop thread; the watcher notify hook crosses
+    threads via ``call_soon``.  The flush point is a faultline site
+    (``watch.flush``) — chaos severs frames mid-write and schedsan gets
+    a preemption point — and the handoff is a schedsan site
+    (``apiserver.watch.handoff``)."""
+
+    def __init__(self, master: "Master", stream: _WatchStream, sock,
+                 deadline: Optional[float]):
+        self.master = master
+        self.stream = stream
+        self.w = stream.w
+        self.sock = sock
+        self.deadline = deadline
+        self.loop = master.dispatcher()
+        self.outbuf = bytearray()
+        self.closed = False
+        self.finishing = False  # terminal chunk queued; close after flush
+        self._events = _EV_READ  # current selector interest
+        self._pump_pending = False
+        self._registered = False
+        self._hb_timer = None
+        self._deadline_timer = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self):
+        """Loop thread: register the socket, arm timers, drain anything
+        the watcher queued between handoff and registration."""
+        try:
+            self.sock.setblocking(False)
+            self.loop.register(self.sock, _EV_READ, self._on_io)
+        except (OSError, ValueError):
+            self._teardown()
+            return
+        self.loop.add_connection()
+        self._registered = True
+        if self.deadline is not None:
+            self._deadline_timer = self.loop.call_later(
+                max(0.0, self.deadline - time.monotonic()), self._on_deadline)
+        self._reset_heartbeat()
+        # notify crosses threads through the loop's self-pipe; installing
+        # it fires once, covering events queued before the handoff
+        self.w.set_notify(self._notify)
+
+    def _notify(self):
+        # any thread, possibly under the cacher's commit lock: must not
+        # block.  The pending flag dedups a burst of notifies into one
+        # scheduled pump (a stale-flag race costs one no-op pump).
+        if self._pump_pending:
+            return
+        self._pump_pending = True
+        self.loop.call_soon(self._pump)
+
+    # --------------------------------------------------------------- I/O
+
+    def _on_io(self, mask: int):
+        if self.closed:
+            return
+        if mask & _EV_READ:
+            # a watch client never sends frames; readable means hangup
+            # (zero-byte read) or stray bytes we ignore — the threaded
+            # handler never read mid-watch either
+            try:
+                data = self.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                data = b"ignored"
+            except OSError:
+                self._teardown()
+                return
+            if not data:
+                self._teardown()  # peer closed: same as BrokenPipeError
+                return
+        if mask & _EV_WRITE:
+            self._try_flush()
+
+    def _set_events(self, events: int):
+        if events == self._events or self.closed:
+            return
+        try:
+            self.loop.modify(self.sock, events, self._on_io)
+            self._events = events
+        except (OSError, ValueError, KeyError):
+            self._teardown()
+
+    def _send_frames(self, frames: List[bytes]):
+        """Chunk-encode and ship through the watch.flush faultline site:
+        an injected sever puts the torn prefix on the wire, then the
+        connection dies exactly as if the peer cut it mid-frame."""
+        data, exc = faultline.filter_bytes("watch.flush",
+                                           _encode_chunks(frames))
+        self.outbuf += data
+        self._try_flush()
+        if exc is not None:
+            self._teardown()
+
+    def _try_flush(self):
+        """Write-ready-driven flushing (replaces blocking sendall): send
+        what the socket accepts, keep the rest buffered with write
+        interest armed."""
+        if self.closed:
+            return
+        schedsan.preempt("watch.flush")
+        while self.outbuf:
+            try:
+                n = self.sock.send(bytes(self.outbuf))
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._teardown()
+                return
+            if n <= 0:
+                break
+            del self.outbuf[:n]
+        if self.outbuf:
+            self._set_events(_EV_READ | _EV_WRITE)
+            return
+        self._set_events(_EV_READ)
+        if self.finishing:
+            self._teardown()
+            return
+        # the wire is clear again: schedule a pump for whatever backed up
+        # while the outbuf held bytes (scheduled, not inline — an inline
+        # call would recurse pump->send->flush->pump through a deep
+        # backlog)
+        self._notify()
+
+    # -------------------------------------------------------------- pump
+
+    def _pump(self):
+        self._pump_pending = False
+        if self.closed or self.finishing:
+            return
+        if self.master.stopping.is_set():
+            self._end_stream()
+            return
+        # drain-until-dry, but ONLY while the wire is clear: the first
+        # batch that leaves bytes in the outbuf stops the drain, and the
+        # watcher's bounded queue takes the backpressure from there
+        while not self.outbuf and not self.closed and not self.finishing:
+            evs = self.w.next_batch_nowait()
+            if evs is None:
+                self._end_stream()
+                return
+            if not evs:
+                return
+            frames = self.stream.batch_frames(evs)
+            self._reset_heartbeat()
+            if frames:
+                self._send_frames(frames)
+
+    # ------------------------------------------------------------- timers
+
+    def _reset_heartbeat(self):
+        if self._hb_timer is not None:
+            self._hb_timer.cancel()
+        self._hb_timer = self.loop.call_later(
+            WATCH_HEARTBEAT_SECONDS, self._on_heartbeat)
+
+    def _on_heartbeat(self):
+        if self.closed or self.finishing:
+            return
+        if self.master.stopping.is_set():
+            self._end_stream()
+            return
+        if getattr(self.w, "closed", False) or self.w._stopped.is_set():
+            # upstream stream died or the watcher was stopped server-side
+            # — _end_stream answers 410 if evicted, else ends cleanly
+            self._end_stream()
+            return
+        self._send_frames([self.stream.heartbeat_frame()])
+        if not self.closed:
+            self._reset_heartbeat()
+
+    def _on_deadline(self):
+        # timeoutSeconds elapsed: end like the threaded loop's deadline
+        # break — terminal chunk, no ERROR frame
+        if not self.closed and not self.finishing:
+            self.finishing = True
+            self.w.stop()
+            self.outbuf += b"0\r\n\r\n"
+            self._try_flush()
+
+    # ----------------------------------------------------------- shutdown
+
+    def _end_stream(self):
+        """Orderly stream end (threaded loop's break + finally): the 410
+        ERROR frame when evicted, then the terminal chunk, then close
+        once the bytes drain."""
+        if self.closed or self.finishing:
+            return
+        frames = []
+        if getattr(self.w, "evicted", False):
+            # slow consumer (or cache reseed): this stream can no longer
+            # be gap-free.  Answer 410 Expired so the reflector relists.
+            frames.append(self.stream.eviction_frame())
+        self.finishing = True
+        self.w.stop()
+        self.outbuf += (_encode_chunks(frames) if frames else b"") \
+            + b"0\r\n\r\n"
+        self._try_flush()
+
+    def shutdown(self):
+        """Master.stop(): end the stream now (loop thread)."""
+        self._end_stream()
+
+    def _teardown(self):
+        if self.closed:
+            return
+        self.closed = True
+        if self._hb_timer is not None:
+            self._hb_timer.cancel()
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        self.w.set_notify(None)
+        self.w.stop()
+        if getattr(self, "_registered", False):
+            self.loop.unregister(self.sock)
+            self.loop.remove_connection()
+        try:
+            self.sock.close()
+        except OSError:
+            pass  # peer already tore the connection down
+        self.master._drop_watch_conn(self)
+
+
+class _ApiHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with request-socket handoff: a request marked
+    detached skips shutdown_request (the dispatcher owns the socket's
+    lifecycle from the handoff on; socketserver would otherwise SHUT_WR
+    and close it the moment the handler thread returns)."""
+
+    def __init__(self, addr, handler_cls):
+        super().__init__(addr, handler_cls)
+        self._detached = set()
+        self._detach_lock = locksan.make_lock("apiserver._detach_lock")
+
+    def detach_request(self, request):
+        with self._detach_lock:
+            self._detached.add(request)
+
+    def shutdown_request(self, request):
+        with self._detach_lock:
+            if request in self._detached:
+                self._detached.discard(request)
+                return
+        super().shutdown_request(request)
 
 
 def _ratio(hits: int, misses: int) -> float:
@@ -1296,83 +1735,41 @@ class _Handler(BaseHTTPRequestHandler):
         # in getresponse() until the headers actually hit the wire
         self.wfile.flush()
         deadline = time.monotonic() + timeout if timeout else None
-        ver = getattr(self, "_req_version", "")
-        # merged multi-shard streams interleave shards (cross-shard order
-        # is per-shard only), so a single per-object rv cannot encode the
-        # stream's position — BOOKMARK frames carrying the composite
-        # resume position do (the Kubernetes watch-bookmark analog).
-        # Plain streams never emit them: byte-identical wire at shards=1.
-        bookmarks = getattr(w, "emit_bookmarks", False)
-        # watch-lag SLI opt-in (?lagStamps=1, informers set it): after
-        # every delivered batch, a BOOKMARK frame carries the monotonic
-        # commit stamp of the batch's newest revision PER SHARD
-        # (obs.ktpu.io/committed-at, "<shard>:<ts>" tokens) so the
-        # client can export delivered-at minus committed-at without any
-        # cross-shard clock math.  Streams that didn't ask stay
-        # byte-identical — stamps never ride the cached event frames.
-        lag_stamps = q.get("lagStamps") in ("1", "true")
-        # progress-bookmark opt-in (?progressBookmarks=1, informers set
-        # it): PLAIN streams (shards=1, no composite bookmarks) get a
-        # BOOKMARK frame on idle heartbeats carrying a SAFE resume
-        # revision (Watcher.progress_rv — the cache head, but only when
-        # nothing is queued undelivered), so an informer idle for minutes
-        # resumes above the compaction floor instead of 410-full-
-        # relisting the collection.  Streams that didn't ask stay
-        # byte-identical; merged streams already bookmark every
-        # heartbeat.
-        progress = (not bookmarks
-                    and q.get("progressBookmarks") in ("1", "true"))
-        n_shards = max(1, self.master.store_shards)
+        stream = _WatchStream(self.master, w, q,
+                              ver=getattr(self, "_req_version", ""))
+        if self.master.event_loop_serving:
+            # event-loop serving: the headers are on the wire; hand the
+            # socket off to the shared dispatcher and return this handler
+            # thread to the pool.  From here the _WatchConn state machine
+            # owns the stream.
+            self._handoff_watch(stream, deadline)
+            return
+        self._serve_watch_threaded(stream, deadline)
 
-        def bookmark_frame() -> bytes:
-            self.master.note_watch_bookmark()
-            return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
-                    b'"apiVersion":"v1","metadata":{"resourceVersion":"'
-                    + w.bookmark_rv().encode() + b'"}}}\n')
+    def _handoff_watch(self, stream: _WatchStream,
+                       deadline: Optional[float]):
+        """Detach the request socket from the HTTP server and adopt it
+        onto the dispatcher.  The handler thread returns immediately;
+        socketserver's shutdown_request is told to leave the socket
+        alone (``_ApiHTTPServer.detach_request``) and the handler's
+        ``finish()`` closing its makefile wrappers only drops dup'd
+        references — the underlying fd survives."""
+        # everything buffered so far (the chunked headers) must be on the
+        # wire before the dispatcher takes over the fd
+        self.wfile.flush()
+        schedsan.preempt("apiserver.watch.handoff")
+        self.server.detach_request(self.connection)
+        self.close_connection = True
+        conn = _WatchConn(self.master, stream, self.connection, deadline)
+        self.master.adopt_watch_conn(conn)
 
-        def progress_frame() -> Optional[bytes]:
-            fn = getattr(w, "progress_rv", None)
-            rv = fn() if fn is not None else None
-            if not rv:
-                return None  # unsafe this tick (events in flight): skip
-            self.master.note_watch_bookmark()
-            return (b'{"type":"BOOKMARK","object":{"kind":"Bookmark",'
-                    b'"apiVersion":"v1","metadata":{"resourceVersion":"'
-                    + str(rv).encode() + b'"}}}\n')
-
-        def lag_frame(evs) -> Optional[bytes]:
-            """Lag-stamp bookmark for one delivered batch (None when no
-            stamp is available and the stream has no bookmark position
-            to refresh either)."""
-            per_shard: Dict[int, int] = {}
-            for ev in evs:
-                try:
-                    rev = int((ev.object.get("metadata") or {})
-                              .get("resourceVersion") or 0)
-                except (TypeError, ValueError, AttributeError):
-                    continue
-                if rev > per_shard.get(rev % n_shards, 0):
-                    per_shard[rev % n_shards] = rev
-            toks = []
-            for sh in sorted(per_shard):
-                ts = self.master.store.commit_ts_of(per_shard[sh])
-                if ts is not None:
-                    toks.append(f"{sh}:{ts:.6f}")
-            if not toks and not bookmarks:
-                return None
-            rv = (w.bookmark_rv() if bookmarks
-                  else str(max(per_shard.values(), default=0)))
-            meta: Dict[str, Any] = {"resourceVersion": rv}
-            if toks:
-                meta["annotations"] = {
-                    t.COMMITTED_AT_ANNOTATION: " ".join(toks)}
-            self.master.note_watch_bookmark()
-            return json.dumps(
-                {"type": "BOOKMARK",
-                 "object": {"kind": "Bookmark", "apiVersion": "v1",
-                            "metadata": meta}},
-                separators=(",", ":")).encode() + b"\n"
-
+    def _serve_watch_threaded(self, stream: _WatchStream,
+                              deadline: Optional[float]):
+        """The pre-event-loop serving leg: this handler thread parks in
+        the blocking batch loop until the stream ends.  Kept as the A/B
+        baseline (KTPU_EVENTLOOP=0) and as the golden-parity reference —
+        the wire bytes here define what the dispatcher must emit."""
+        w = stream.w
         try:
             while True:
                 if deadline and time.monotonic() >= deadline:
@@ -1386,11 +1783,7 @@ class _Handler(BaseHTTPRequestHandler):
                         # no longer be gap-free.  Answer 410 Expired so
                         # the reflector relists — the reference cacher's
                         # eviction contract (storage/cacher.go).
-                        status = TooOldResourceVersion(
-                            "watch evicted; relist required").to_status()
-                        self._write_chunk(json.dumps(
-                            {"type": ERROR, "object": status},
-                            separators=(",", ":")).encode() + b"\n")
+                        self._write_chunk(stream.eviction_frame())
                         break
                     if getattr(w, "closed", False) or w._stopped.is_set():
                         # upstream (external store) stream died or the
@@ -1405,38 +1798,12 @@ class _Handler(BaseHTTPRequestHandler):
                     # fresh composite resume position — and plain
                     # streams that opted in get the progress analog
                     # (None = no safe rv this tick; plain heartbeat)
-                    fr = (bookmark_frame() if bookmarks
-                          else progress_frame() if progress else None)
-                    self._write_chunk(fr if fr else b"")
+                    self._write_chunk(stream.heartbeat_frame())
                     continue
-                # watch frames honor the requested version like every verb.
-                # WatchEvents are SHARED by every watcher of the resource
-                # (one fan-out wakeup per group commit) and the payload
-                # bytes come from the scheme's once-per-revision
-                # serialization cache — N watchers plus every list/get of
-                # the same revision cost ONE encode (the reference's
-                # cacher economics, storage/cacher.go).  A batch's frames
-                # go out as ONE buffered write + flush: the syscall and
-                # the client's recv wakeup amortize across the batch too.
-                frames = [self.master.scheme.watch_frame_bytes(
-                              ev.type, ev.object, ver)
-                          for ev in evs if w.event_matches(ev.object)]
-                if bookmarks or lag_stamps:
-                    # after every delivered batch: the bookmark rides the
-                    # same buffered write, so a cut can strand at most
-                    # one batch's worth of single-int rv — and the
-                    # informer resumes from the last composite it holds
-                    # (duplicates are idempotent; gaps would be lost
-                    # state).  Selector-filtered batches still bookmark:
-                    # the position advanced even if no frame matched.
-                    # With lagStamps the commit stamp rides the same
-                    # bookmark frame; without it the handcrafted bytes
-                    # stay exactly what PR 10 shipped.
-                    fr = (lag_frame(evs) if lag_stamps
-                          else bookmark_frame())
-                    if fr is not None:
-                        frames.append(fr)
-                self._write_chunks(frames)
+                # A batch's frames go out as ONE buffered write + flush:
+                # the syscall and the client's recv wakeup amortize
+                # across the batch (frame construction: _WatchStream).
+                self._write_chunks(stream.batch_frames(evs))
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
             pass
         finally:
@@ -1453,14 +1820,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _write_chunks(self, frames):
         """Frame N chunks and ship them as ONE buffered write + flush (a
         batch's worth of watch frames costs one syscall and one client
-        recv wakeup).  The chunked-encoding wire format lives only here."""
-        buf = bytearray()
-        for data in frames:
-            if not data:
-                # zero-length would terminate chunked encoding; a newline
-                # keeps the stream alive (heartbeats ride this)
-                data = b"\n"
-            buf += b"%x\r\n" % len(data) + data + b"\r\n"
+        recv wakeup; encoding: module-level ``_encode_chunks``)."""
+        buf = _encode_chunks(frames)
         if buf:
             self.wfile.write(buf)
             self.wfile.flush()
@@ -1532,6 +1893,18 @@ class _Handler(BaseHTTPRequestHandler):
             # the wire
             "# TYPE ktpu_watch_bookmarks_total counter",
             f"ktpu_watch_bookmarks_total {master.watch_bookmarks}",
+            # event-loop serving surface: the thread-count win and the
+            # dispatcher's health.  threads is the WHOLE process (handler
+            # pool + pumps + worker pool) — at 10k hollow watchers it
+            # stays bounded instead of ~10k; connections counts every
+            # long-lived stream multiplexed on the shared dispatcher.
+            "# TYPE ktpu_apiserver_threads gauge",
+            f"ktpu_apiserver_threads {threading.active_count()}",
+            "# TYPE ktpu_eventloop_connections gauge",
+            f"ktpu_eventloop_connections {_eventloop.connection_count()}",
+            # timer fire lag: a saturated dispatcher shows up HERE (late
+            # heartbeats, stale scrapes) before clients notice
+            _eventloop.loop_lag_seconds.render().rstrip("\n"),
         ]
         # cacher freshness-wait lag (obs plane): how long LIST/GET reads
         # blocked for watch-cache freshness.  Sharded cachers render a
@@ -2060,6 +2433,14 @@ class Master:
                                                # owns the store (a shared
                                                # store's numbers must
                                                # appear on ONE /metrics)
+        event_loop_serving: Optional[bool] = None,  # watch streams on the
+                                               # shared dispatcher (one
+                                               # thread for all of them)
+                                               # vs a parked handler
+                                               # thread each; None = env
+                                               # KTPU_EVENTLOOP (default
+                                               # on, "0"/"false" off —
+                                               # the A/B knob)
     ):
         fasthttp.install()  # idempotent (see class docstring)
         # own copy: CRD registrations must not leak into the process-global
@@ -2157,6 +2538,16 @@ class Master:
         self.spans = spanlib.SpanCollector("apiserver", capacity=4096)
         self.quota_lock = locksan.make_lock("Master.quota_lock")
         self.stopping = threading.Event()
+        if event_loop_serving is None:
+            event_loop_serving = os.environ.get(
+                "KTPU_EVENTLOOP", "1").lower() not in ("0", "false")
+        self.event_loop_serving = event_loop_serving
+        # handed-off watch connections owned by the dispatcher (so stop()
+        # can end every stream); the dispatcher itself is lazy — a master
+        # that never serves a watch never starts it
+        self._watch_conns: set = set()
+        self._watch_conns_lock = locksan.make_lock(
+            "Master._watch_conns_lock")
         self._audit_log = audit_log
         self._audit_path = audit_path
         self._audit_lock = locksan.make_lock("Master._audit_lock")
@@ -2243,7 +2634,7 @@ class Master:
             else:
                 raise ValueError(f"unknown admission plugin {name!r}")
         self.admission = AdmissionChain(plugins)
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _ApiHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.master = self  # type: ignore[attr-defined]
         from ..utils.streams import quiet_connection_errors
@@ -2273,6 +2664,22 @@ class Master:
         else:
             self.url = f"http://{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+
+    def dispatcher(self) -> _eventloop.EventLoop:
+        """The shared event loop watch connections are handed off to
+        (started on first use — see utils/eventloop.shared_loop)."""
+        return _eventloop.shared_loop()
+
+    def adopt_watch_conn(self, conn: "_WatchConn"):
+        """Take ownership of a handed-off watch connection: track it for
+        stop() and schedule its registration on the loop thread."""
+        with self._watch_conns_lock:
+            self._watch_conns.add(conn)
+        self.dispatcher().call_soon(conn.start)
+
+    def _drop_watch_conn(self, conn: "_WatchConn"):
+        with self._watch_conns_lock:
+            self._watch_conns.discard(conn)
 
     def note_watch_bookmark(self):
         """Count one emitted BOOKMARK frame (composite, lag-stamp, or
@@ -2460,7 +2867,7 @@ class Master:
         self.registry.ensure_namespace("default")
         self.registry.ensure_namespace("kube-system")
         self._restore_crds()
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # ktpulint: ignore[KTPU015] the single serve_forever acceptor thread — handler threads return after handoff, it is not per-connection
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
         )
         self._thread.start()
@@ -2471,6 +2878,15 @@ class Master:
         # cacher first: its pump is a store watcher, and open client
         # watches must see their streams end before the store closes
         self.cacher.stop()
+        # handed-off streams: end each on the loop thread (terminal chunk
+        # + close once the bytes drain) — the dispatcher itself is shared
+        # and stays up
+        with self._watch_conns_lock:
+            conns = list(self._watch_conns)
+        if conns:
+            loop = self.dispatcher()
+            for conn in conns:
+                loop.call_soon(conn.shutdown)
         self._httpd.shutdown()
         self._httpd.server_close()
         # audit sink last: in-flight requests finishing during shutdown

@@ -12,12 +12,15 @@ Contract (the PR 11 collector rule, node-local edition):
 
 - ``reconcile(pods)`` is called from the kubelet's existing stats loop
   and only DIFFS the annotated-pod set against the running scrape
-  threads — O(annotated pods), no I/O, so 30k hollow pods without
+  targets — O(annotated pods), no I/O, so 30k hollow pods without
   annotations cost the sync loop nothing;
-- each annotated pod gets its OWN daemon scrape thread behind the
-  ``obs.pod_scrape`` faultline site; a dead or slow pod endpoint stalls
-  only its own thread, never the kubelet sync loop or a sibling's
-  scrapes;
+- each annotated pod is a TIMER on the shared event loop
+  (utils/eventloop) whose tick submits the blocking fetch to the
+  bounded shared worker pool, re-arming only after it completes —
+  same per-target isolation as the old thread-per-pod model (the
+  ``obs.pod_scrape`` faultline site still wraps the fetch; a dead or
+  slow pod endpoint wedges one pool slot, never the kubelet sync loop
+  or a sibling's scrape) at a bounded thread count;
 - a failing scrape keeps the LAST-GOOD samples and republishes them with
   ``stale=True`` (consumers must treat stale as missing — the HPA holds
   its last decision instead of flapping to zero);
@@ -39,7 +42,7 @@ from ..client import retry as _retry
 from ..machinery import ApiError, NotFound, now_iso
 from ..obs import aggregate
 from ..obs.appmetrics import sample_value, scrape_target  # noqa: F401 — sample_value re-exported: the value-of-metric-on-pod definition lives with the scrape contract
-from ..utils import faultline, locksan
+from ..utils import eventloop, faultline, locksan
 from ..utils.logutil import RateLimitedReporter
 
 # Sample-count cap per pod: a misbehaving workload dumping thousands of
@@ -49,8 +52,9 @@ MAX_SAMPLES = 64
 
 
 class _Target:
-    """One annotated pod's scrape state.  Mutated by its own thread;
-    read by reconcile/render under the scraper lock."""
+    """One annotated pod's scrape state.  Mutated by its scrape jobs
+    (shared worker pool); read by reconcile/render under the scraper
+    lock."""
 
     def __init__(self, key: str, uid: str, url: str, pod: t.Pod):
         self.key = key
@@ -62,7 +66,7 @@ class _Target:
         self.stop = threading.Event()
         self.gone = False  # pod vanished (vs replaced): object is garbage
         self.adopt_checked = False  # pre-restart object looked for once
-        self.thread: Optional[threading.Thread] = None
+        self.timer: Optional[eventloop.Timer] = None  # next interval tick
         # scrape state (last-good snapshot semantics)
         self.samples: List[t.MetricSample] = []
         self.stale = False
@@ -130,6 +134,8 @@ class PodScraper:
         self.fetch_timeout = fetch_timeout
         self._targets: Dict[str, _Target] = {}
         self._lock = locksan.make_lock("podscrape.PodScraper._lock")
+        self._loop = eventloop.shared_loop()
+        self._pool = eventloop.shared_pool()
         self._stopping = threading.Event()
         self._err_reporter = RateLimitedReporter(
             f"podscrape/{node_name}", window=30.0)
@@ -140,7 +146,7 @@ class PodScraper:
     # ----------------------------------------------------------- reconcile
 
     def reconcile(self, pods: List[t.Pod]):
-        """Diff the annotated-pod set against running scrape threads.
+        """Diff the annotated-pod set against running scrape targets.
         Called from the kubelet stats loop — never blocks on a scrape."""
         want: Dict[str, Tuple[str, str, t.Pod]] = {}
         for pod in pods:
@@ -156,12 +162,14 @@ class PodScraper:
                 cur = want.get(key)
                 if cur is None or cur[0] != tgt.uid or cur[1] != tgt.url:
                     # gone, replaced (new uid = new pod instance), or
-                    # re-annotated: the old thread dies, state resets
+                    # re-annotated: the old target dies, state resets
                     del self._targets[key]
                     if cur is None:
                         tgt.gone = True  # before stop.set: see _publish
                         to_gc.append(tgt)
                     tgt.stop.set()
+                    if tgt.timer is not None:
+                        tgt.timer.cancel()
                 elif dict(cur[2].metadata.labels or {}) != tgt.labels:
                     # relabeled in place: the published object's labels
                     # must follow (labelSelector reads select over them)
@@ -171,12 +179,25 @@ class PodScraper:
                     tgt = self._targets[key] = _Target(key, uid, url, pod)
                     to_start.append(tgt)
         for tgt in to_start:
-            tgt.thread = threading.Thread(
-                target=self._scrape_loop, args=(tgt,), daemon=True,
-                name=f"podscrape-{tgt.pod_name}")
-            tgt.thread.start()
+            self._schedule_scrape(tgt)
         for tgt in to_gc:
             self._gc_object(tgt)
+
+    def _schedule_scrape(self, tgt: _Target):
+        """Submit one scrape of ``tgt`` to the shared pool; the job
+        re-arms the target's interval timer AFTER it completes — at most
+        one scrape per target queued or running, the old per-pod
+        thread's ``scrape_once(); wait(interval)`` pacing."""
+        def job():
+            if tgt.stop.is_set() or self._stopping.is_set():
+                return
+            self.scrape_once(tgt)
+            if tgt.stop.is_set() or self._stopping.is_set():
+                return
+            tgt.timer = self._loop.call_later(
+                self.interval, lambda: self._pool.submit(job))
+
+        self._pool.submit(job)
 
     def _gc_object(self, tgt: _Target):
         """Best-effort delete of a vanished pod's PodCustomMetrics — a
@@ -264,11 +285,6 @@ class PodScraper:
                 return
             tgt.published_stale = False
         self._publish(tgt)  # tgt.stale is set by our caller
-
-    def _scrape_loop(self, tgt: _Target):
-        while not tgt.stop.is_set() and not self._stopping.is_set():
-            self.scrape_once(tgt)
-            tgt.stop.wait(self.interval)
 
     # ------------------------------------------------------------ publishing
 
@@ -378,6 +394,7 @@ class PodScraper:
             self._targets.clear()
         for tgt in tgts:
             tgt.stop.set()
-        for tgt in tgts:
-            if tgt.thread is not None:
-                tgt.thread.join(timeout=2.0)
+            if tgt.timer is not None:
+                # in-flight pool jobs check the stop flags before they
+                # scrape and never re-arm past them — nothing to join
+                tgt.timer.cancel()

@@ -388,9 +388,9 @@ def _follow_log(sock, runtime, cid, log_path):
     """attach: stream log growth until the container exits or the client
     hangs up (a zero-byte read on the socket detects hangup)."""
     import os as _os
-    import select
     import time as _time
 
+    from ..utils import eventloop
     from ..utils.streams import STDOUT, send_status, write_frame
 
     from .runtime import CONTAINER_RUNNING
@@ -416,9 +416,9 @@ def _follow_log(sock, runtime, cid, log_path):
                 send_status(sock, record.exit_code if record else -1)
                 return
             # hangup detection: the client never sends frames on attach,
-            # so any readable-EOF means it is gone
-            r, _, _ = select.select([sock], [], [], 0.25)
-            if r:
+            # so any readable-EOF means it is gone (shared readiness
+            # helper — utils/eventloop.wait_readable)
+            if eventloop.wait_readable(sock, 0.25):
                 probe = sock.recv(1)
                 if not probe:
                     return

@@ -8,12 +8,16 @@ that sees the sharded control plane as ONE system:
 - every component endpoint is REGISTERED (LocalCluster, sched_perf, and
   the chaos runner register what they boot: apiservers, schedulers,
   kubelets, per-shard store processes, SLI trackers);
-- one daemon thread PER TARGET scrapes ``/metrics`` on an interval
-  through the shared retry policy (client/retry.py — transient
-  classification, capped full jitter) behind the ``obs.scrape``
-  faultline site, so a dead or slow target delays only its own thread,
-  NEVER the collector's serving path or its siblings' scrapes (the
-  standing-invariant chaos schedule proves exactly this);
+- each target is a TIMER on the shared event loop (utils/eventloop), not
+  a dedicated thread: the interval tick submits the blocking fetch to
+  the bounded shared worker pool and re-arms only after it completes
+  (at most one in-flight scrape per target, same pacing as the old
+  ``scrape_once(); wait(interval)`` loop at a fraction of the stacks).
+  The fetch runs through the shared retry policy (client/retry.py —
+  transient classification, capped full jitter) behind the
+  ``obs.scrape`` faultline site, so a dead or slow target wedges only
+  one pool slot, NEVER the collector's serving path or its siblings'
+  scrapes (the standing-invariant chaos schedule proves exactly this);
 - the collector serves, from last-good snapshots (serving never blocks
   on a scrape):
 
@@ -45,7 +49,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from ..client import retry as _retry
-from ..utils import faultline, locksan
+from ..utils import eventloop, faultline, locksan
 from ..utils.logutil import RateLimitedReporter
 from . import aggregate
 
@@ -58,9 +62,10 @@ DEFAULT_INTERVAL = 1.0
 
 class _Target:
     """One registered component endpoint + its scrape state.  Scrape
-    state fields are written by the target's own scrape thread and read
-    by the serving path under the collector lock — last-good snapshot
-    semantics (a failing scrape keeps the previous parse, marked stale).
+    state fields are written by the target's scrape jobs (shared worker
+    pool) and read by the serving path under the collector lock —
+    last-good snapshot semantics (a failing scrape keeps the previous
+    parse, marked stale).
     """
 
     def __init__(self, component: str, instance: str, url: str,
@@ -76,20 +81,22 @@ class _Target:
         self.up = False
         self.scrapes = 0
         self.errors = 0
-        self.thread: Optional[threading.Thread] = None
+        self.timer: Optional[eventloop.Timer] = None  # next interval tick
         self.stop = threading.Event()
 
 
 class ObsCollector:
     """See module docstring.  start() boots the HTTP surface and one
-    scrape loop per registered target; register() after start() spawns
-    the new target's loop immediately."""
+    scrape timer per registered target; register() after start() kicks
+    the new target's first scrape immediately."""
 
     def __init__(self, interval: float = DEFAULT_INTERVAL,
                  host: str = "127.0.0.1", port: int = 0,
                  fetch_timeout: float = DEFAULT_FETCH_TIMEOUT):
         self.interval = interval
         self.fetch_timeout = fetch_timeout
+        self._loop = eventloop.shared_loop()
+        self._pool = eventloop.shared_pool()
         self._targets: Dict[str, _Target] = {}
         self._lock = locksan.make_lock("obs.ObsCollector._lock")
         self._started = False
@@ -152,7 +159,7 @@ class ObsCollector:
             self._targets[instance] = tgt
             started = self._started
         if started:
-            self._spawn_scraper(tgt)
+            self._schedule_scrape(tgt)
         return instance
 
     def unregister(self, instance: str):
@@ -160,6 +167,8 @@ class ObsCollector:
             tgt = self._targets.pop(instance, None)
         if tgt is not None:
             tgt.stop.set()
+            if tgt.timer is not None:
+                tgt.timer.cancel()
 
     def targets(self) -> List[_Target]:
         with self._lock:
@@ -173,7 +182,7 @@ class ObsCollector:
             self._started = True
             tgts = list(self._targets.values())
         for t in tgts:
-            self._spawn_scraper(t)
+            self._schedule_scrape(t)
         return self
 
     def stop(self):
@@ -183,9 +192,10 @@ class ObsCollector:
             self._started = False
         for t in tgts:
             t.stop.set()
-        for t in tgts:
-            if t.thread is not None:
-                t.thread.join(timeout=3.0)
+            if t.timer is not None:
+                # an in-flight pool job checks the stop flags before it
+                # scrapes and never re-arms past them — nothing to join
+                t.timer.cancel()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -194,11 +204,21 @@ class ObsCollector:
 
     # --------------------------------------------------------------- scraping
 
-    def _spawn_scraper(self, tgt: _Target):
-        tgt.thread = threading.Thread(
-            target=self._scrape_loop, args=(tgt,), daemon=True,
-            name=f"obs-scrape-{tgt.instance}")
-        tgt.thread.start()
+    def _schedule_scrape(self, tgt: _Target):
+        """Submit one scrape of ``tgt`` to the shared pool; the job
+        re-arms the target's interval timer AFTER it completes, so at
+        most one scrape per target is ever queued or running (the old
+        per-target thread's ``scrape_once(); wait(interval)`` pacing)."""
+        def job():
+            if tgt.stop.is_set() or self._stopping.is_set():
+                return
+            self.scrape_once(tgt)
+            if tgt.stop.is_set() or self._stopping.is_set():
+                return
+            tgt.timer = self._loop.call_later(
+                self.interval, lambda: self._pool.submit(job))
+
+        self._pool.submit(job)
 
     def _fetch(self, url: str) -> str:
         """One HTTP GET behind the obs.scrape faultline site (an injected
@@ -241,11 +261,6 @@ class ObsCollector:
             self.scrapes_total += 1
             self.scrape_seconds_total += dur
         return True
-
-    def _scrape_loop(self, tgt: _Target):
-        while not tgt.stop.is_set() and not self._stopping.is_set():
-            self.scrape_once(tgt)
-            tgt.stop.wait(self.interval)
 
     # -------------------------------------------------------------- rendering
 
@@ -377,8 +392,9 @@ class ObsCollector:
             with res_lock:
                 results[t.instance] = data
 
-        threads = [threading.Thread(target=fetch_one, args=(t,), daemon=True,
-                                    name="obs-fanout")
+        threads = [threading.Thread(  # ktpulint: ignore[KTPU015] joined one-round-trip fan-out, bounded by the target count and the fetch timeout — not a per-connection resident thread
+                       target=fetch_one, args=(t,), daemon=True,
+                       name="obs-fanout")
                    for t in tgts]
         for th in threads:
             th.start()
@@ -495,7 +511,7 @@ class ObsCollector:
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address[:2]
         self.url = f"http://{self.host}:{self.port}"
-        self._http_thread = threading.Thread(
+        self._http_thread = threading.Thread(  # ktpulint: ignore[KTPU015] the single serve_forever acceptor thread, not a per-connection thread
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
             daemon=True, name="obs-collector-http")
         self._http_thread.start()

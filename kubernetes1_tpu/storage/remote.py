@@ -93,6 +93,13 @@ class RemoteWatcher:
         # consumers must distinguish this from a heartbeat timeout or a
         # store restart would leave every watch silently stalled forever
         self.closed = False
+        # push-mode delivery hook (set_notify, same contract as
+        # storage.store.Watcher): fired after every queue transition so
+        # the event-loop dispatcher can drain instead of parking a
+        # thread.  Plain attribute, no lock: assignment is atomic, and
+        # set_notify's immediate fire covers anything the pump put
+        # before the hook landed.
+        self._notify_fn: Optional[Callable[[], None]] = None
         t = threading.Thread(target=self._pump, daemon=True,
                              name="remote-store-watch")
         t.start()
@@ -133,6 +140,11 @@ class RemoteWatcher:
             return None  # legacy heartbeat
         return json.loads(line)
 
+    def _wake(self):
+        fn = self._notify_fn
+        if fn is not None:
+            fn()  # non-blocking by contract (see set_notify)
+
     def _pump(self):
         try:
             while True:
@@ -147,11 +159,13 @@ class RemoteWatcher:
                 if ev is not None:
                     self._note_frame_ts(frame)
                     self._q.put([self._event(ev)])
+                    self._wake()
                     continue
                 evs = frame.get("events")
                 if evs is not None:
                     self._note_frame_ts(frame)
                     self._q.put([self._event(e) for e in evs])
+                    self._wake()
                     continue
                 prog = frame.get("progress")
                 if prog is not None:
@@ -159,11 +173,13 @@ class RemoteWatcher:
                     if rev > self.progress_rev:
                         self.progress_rev = rev
                     self._q.put(self._PROGRESS)
+                    self._wake()
         except (OSError, ValueError):
             pass
         finally:
             self.closed = True
             self._q.put(None)  # EOF sentinel: the stream is dead
+            self._wake()
 
     def stop(self):
         self._stopped.set()
@@ -177,6 +193,7 @@ class RemoteWatcher:
         except OSError:
             pass
         self._q.put(None)
+        self._wake()
 
     def __iter__(self):
         return self
@@ -233,6 +250,47 @@ class RemoteWatcher:
                 break
             if nxt is None:
                 self._q.put(None)
+                break
+            if nxt is self._PROGRESS:
+                continue
+            self._buf.extend(nxt)
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+    def set_notify(self, fn: Optional[Callable[[], None]]):
+        """Install a delivery hook for PUSH-mode consumers (the
+        event-loop watch dispatcher) — same contract as
+        storage.store.Watcher.set_notify: called after every queue
+        transition, must never block, fires once on install so anything
+        already queued is observed."""
+        self._notify_fn = fn
+        if fn is not None:
+            fn()
+
+    def next_batch_nowait(self) -> Optional[list]:
+        """Non-blocking twin of next_batch_timeout (the cacher
+        batch-cursor contract the dispatcher drains on notify):
+        everything deliverable right now as one list, ``[]`` when
+        nothing is queued or the wakeup was progress-only, ``None`` on
+        stream end.  Consumer-thread only, like the blocking variant."""
+        if not self._buf:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return []
+            if item is None:
+                self._stopped.set()
+                return None
+            if item is not self._PROGRESS:
+                self._buf.extend(item)
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._q.put(None)  # keep the EOF sentinel for next call
                 break
             if nxt is self._PROGRESS:
                 continue

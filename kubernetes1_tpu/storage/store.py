@@ -145,6 +145,10 @@ class Watcher:
         self.evicted = False
         self._pending: Optional[List[WatchEvent]] = [] if buffering else None
         self._plock = locksan.make_lock("storage.Watcher._plock")
+        # push-mode delivery hook (set_notify): fired after every queue
+        # transition so an event-loop consumer can wake its dispatcher
+        # instead of parking a thread in next_batch_timeout
+        self._notify: Optional[Callable[[], None]] = None
 
     def _push(self, ev: WatchEvent):
         """Owner-side: enqueue a single live event (buffered during
@@ -171,6 +175,8 @@ class Watcher:
             return
         self._qlen += len(evs)
         self._q.put(evs)
+        if self._notify is not None:
+            self._notify()  # non-blocking by contract (see set_notify)
 
     def _evict_locked(self, note: bool = True):
         """Must hold _plock: end this stream as a slow/stale consumer.
@@ -183,6 +189,8 @@ class Watcher:
         self.evicted = True
         self._stopped.set()
         self._q.put(None)
+        if self._notify is not None:
+            self._notify()
         if note:
             self._owner._note_watch_eviction()
 
@@ -222,6 +230,8 @@ class Watcher:
         if not self._stopped.is_set():
             self._stopped.set()
             self._q.put(None)
+            if self._notify is not None:
+                self._notify()
             self._owner._remove_watcher(self)
 
     def __iter__(self):
@@ -271,6 +281,47 @@ class Watcher:
         # opportunistically drain whatever else is already queued — without
         # blocking, and preserving the end-of-stream sentinel for the next
         # call (None is always the queue's final item)
+        while True:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._q.put(None)
+                break
+            self._take_batch(nxt)
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+    def set_notify(self, fn: Optional[Callable[[], None]]):
+        """Install a delivery hook for PUSH-mode consumers (the event-loop
+        watch dispatcher): called after every queue transition — batch
+        delivered, eviction, stop — possibly from the owner's commit path
+        UNDER its lock, so ``fn`` must never block (the dispatcher's hook
+        is a deque append + non-blocking self-pipe write).  Installing a
+        hook fires it once immediately so anything already queued is
+        observed; pull consumers (next_batch_timeout) never set one."""
+        with self._plock:
+            self._notify = fn
+        if fn is not None:
+            fn()
+
+    def next_batch_nowait(self) -> Optional[List[WatchEvent]]:
+        """Non-blocking twin of next_batch_timeout — the cacher batch
+        cursor an event-loop connection state machine drains on notify:
+        everything deliverable right now as one list, ``[]`` when nothing
+        is queued, ``None`` on stream end (eviction or stop).  Same
+        consumer-thread contract and the same end-of-stream sentinel
+        preservation as the blocking variant."""
+        if not self._buf:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return []
+            if item is None:
+                return None
+            self._take_batch(item)
         while True:
             try:
                 nxt = self._q.get_nowait()

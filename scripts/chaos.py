@@ -57,7 +57,11 @@ DEFAULT_SPEC = (
     "store.rpc=drop@0.05|delay:5ms@0.05;"
     "store.watch=drop@0.10;"
     "repl.link=sever@0.08|drop@0.05;"
-    "wal.write=truncate@0.03"
+    "wal.write=truncate@0.03;"
+    # the event-loop dispatcher's write path (PR 18): sever a watch
+    # frame mid-flush on the server side — clients must treat the torn
+    # chunk as a dead stream and relist/reconnect cleanly
+    "watch.flush=sever@0.05"
 )
 
 CONVERGE_TIMEOUT = 60.0

@@ -750,8 +750,31 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
                      if solo is not None and mixed is not None else None)
             return {"solo": _r(solo), "mixed": _r(mixed), "delta": delta}
 
+        # event-loop serving health (PR 18): dispatcher timer lag over
+        # the mix window plus the end-of-mix connection/thread gauges,
+        # off the same fleet scrape the interference blocks ride.  A
+        # dispatcher that saturates under the everything-at-once mix
+        # shows up here as lag_p99 long before watch streams stall.
+        from kubernetes1_tpu.obs import aggregate as _agg
+
+        def _gauge(parsed, name, fold):
+            vals = list(_agg.select(parsed, name).values()) \
+                if parsed is not None else []
+            return fold(vals) if vals else None
+
+        eventloop_block = {
+            "lag_p99_s": _r(_delta_quantile(
+                fleet_mix0, fleet_mix1,
+                "ktpu_eventloop_lag_seconds", 0.99)),
+            "connections": _gauge(fleet_mix1,
+                                  "ktpu_eventloop_connections", sum),
+            "apiserver_threads_max": _gauge(fleet_mix1,
+                                            "ktpu_apiserver_threads", max),
+        }
+
         result.update({
             "phases": phases,
+            "eventloop": eventloop_block,
             "slos": scorecard.verdict(),
             "breached_slos": scorecard.breached_slos(),
             "breach_timelines": breach_timelines,

@@ -31,6 +31,10 @@ Scenarios — one per standing race class the repo has shipped a fix for:
           PodScraper reconcile/scrape loops racing create/delete churn of
           the scraped pod; the scraper must converge to zero targets and
           the apiserver must keep serving.
+  dispatch  dispatcher flush vs client reconnect (the PR 18 event-loop
+          plane): seeded watch.flush severs tear frames mid-write on the
+          non-blocking flush path; the informer must converge through
+          clean relist/reconnect cycles.
 
 Verdict JSON per (scenario, seed) on stdout, then a summary line; exit 1
 if any seed went red.  A red verdict carries the reproducing schedsan
@@ -365,11 +369,76 @@ def scenario_scrape(seed: int) -> dict:
         m.stop()
 
 
+def scenario_dispatch(seed: int) -> dict:
+    """Dispatcher flush vs client reconnect (the PR 18 event-loop leg):
+    a seeded faultline sever at ``watch.flush`` tears watch frames
+    mid-write on the dispatcher's non-blocking flush path while a writer
+    churns pods; the informer must treat each torn stream as dead and
+    converge through clean relist/reconnect cycles.  Probes: cacher
+    monotonicity via the fan-out plus the informer's own cache-vs-server
+    convergence check below."""
+    from kubernetes1_tpu.apiserver import Master
+    from kubernetes1_tpu.client import Clientset
+    from kubernetes1_tpu.client.informer import SharedInformer
+    from kubernetes1_tpu.utils import faultline
+
+    pods = 12
+    m = Master(port=0, event_loop_serving=True).start()
+    cs = Clientset(m.url)
+    inf = None
+    # sever ~1 in 3 flushes: high enough that streams die mid-run,
+    # low enough that reconnect cycles still make forward progress
+    faultline.activate(seed, "watch.flush=sever@0.3")
+    try:
+        inf = SharedInformer(cs.pods)
+        inf.start()
+        if not inf.wait_for_sync(10.0):
+            raise AssertionError("dispatch: informer never synced")
+        errors: list = []
+
+        def churn():
+            try:
+                for i in range(pods):
+                    cs.pods.create(_make_pod(f"dp-{i}"))
+                    time.sleep(0.01)
+            except Exception:  # noqa: BLE001
+                errors.append(f"churn: {traceback.format_exc()}")
+
+        th = threading.Thread(target=churn, daemon=True,
+                              name="dispatch-churn")
+        th.start()
+        _join_all([th], "dispatch")
+        if errors:
+            raise AssertionError("dispatch: unexpected errors: "
+                                 + " | ".join(errors))
+        # convergence DESPITE severed flushes: each kill forces a clean
+        # reconnect (LIST rides the unfaulted request path), so the
+        # cache must reach every created pod
+        deadline = time.monotonic() + 20.0
+        while len(inf.list()) < pods and time.monotonic() < deadline:
+            time.sleep(0.05)
+        seen = len(inf.list())
+        if seen < pods:
+            raise AssertionError(
+                f"dispatch: informer never converged past the severed "
+                f"flushes — {seen} of {pods} pods after reconnects="
+                f"{inf.reconnects} relists={inf.relists}")
+        return {"acked": pods, "events_seen": seen,
+                "reconnects": inf.reconnects, "relists": inf.relists}
+    finally:
+        faultline.deactivate()
+        if inf is not None:
+            inf.stop()
+        cs.close()
+        m.stop()
+
+
 SCENARIOS = {
     "bind": scenario_bind,
     "gang": scenario_gang,
     "watch": scenario_watch,
     "scrape": scenario_scrape,
+    "dispatch": scenario_dispatch,
 }
 
 

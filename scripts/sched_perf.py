@@ -353,39 +353,49 @@ def _wait_hollow_sync(stats_files, total: int, timeout: float):
 
 
 class _RssSampler:
-    """Samples /proc/<pid> VmRSS for the apiserver processes once a second
-    (daemon thread); stop_and_report() summarizes per-process start/max/
-    end and a flatness verdict — the envelope's memory claim."""
+    """Samples /proc/<pid> VmRSS AND Threads for the apiserver processes
+    once a second (daemon thread); stop_and_report() summarizes
+    per-process start/max/end and a flatness verdict — the envelope's
+    memory claim — plus the thread-count trajectory, the event-loop
+    refactor's headline: watcher count must no longer show up as OS
+    threads (one parked stack per stream was the pre-PR18 wall)."""
 
     def __init__(self, pids, interval: float = 1.0):
         self._pids = list(pids)
         self._interval = interval
-        self._samples = {pid: [] for pid in self._pids}
+        self._samples = {pid: [] for pid in self._pids}  # (rss_mb, threads)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="apiserver-rss-sampler")
 
     @staticmethod
-    def _rss_mb(pid):
+    def _status(pid):
+        """(rss_mb, thread_count) from one /proc/<pid>/status pass, or
+        None when the process is gone/unreadable."""
+        rss = threads = None
         try:
             with open(f"/proc/{pid}/status") as f:
                 for line in f:
                     if line.startswith("VmRSS:"):
-                        return int(line.split()[1]) / 1024.0
+                        rss = int(line.split()[1]) / 1024.0
+                    elif line.startswith("Threads:"):
+                        threads = int(line.split()[1])
+                    if rss is not None and threads is not None:
+                        break
         except (OSError, ValueError, IndexError):
             return None
-        return None
+        return None if rss is None else (rss, threads)
 
     def _run(self):
         while not self._stop.wait(self._interval):
             for pid in self._pids:
-                v = self._rss_mb(pid)
+                v = self._status(pid)
                 if v is not None:
                     self._samples[pid].append(v)
 
     def _sample_all(self):
         for pid in self._pids:
-            v = self._rss_mb(pid)
+            v = self._status(pid)
             if v is not None:
                 self._samples[pid].append(v)
 
@@ -400,16 +410,22 @@ class _RssSampler:
         self._sample_all()  # final point: growth covers the whole run
         per = []
         for pid in self._pids:
-            xs = self._samples[pid]
-            if not xs:
+            pairs = self._samples[pid]
+            if not pairs:
                 per.append({"pid": pid, "samples": 0})
                 continue
+            xs = [p[0] for p in pairs]
+            ths = [p[1] for p in pairs if p[1] is not None]
             growth = xs[-1] - xs[0]
-            per.append({
+            rec = {
                 "pid": pid, "samples": len(xs),
                 "start": round(xs[0], 1), "max": round(max(xs), 1),
                 "end": round(xs[-1], 1), "growth": round(growth, 1),
-            })
+            }
+            if ths:
+                rec["threads"] = {"start": ths[0], "max": max(ths),
+                                  "end": ths[-1]}
+            per.append(rec)
         growths = [p["growth"] for p in per if "growth" in p]
         starts = [p["start"] for p in per if "start" in p]
         # "flat": no apiserver grew by more than max(100MB, 25% of its
@@ -419,8 +435,13 @@ class _RssSampler:
         # must not read as a failed memory claim.
         flat = (None if not growths else all(
             g <= max(100.0, 0.25 * s) for g, s in zip(growths, starts)))
+        thread_maxes = [p["threads"]["max"] for p in per if "threads" in p]
         return {"per_apiserver": per, "flat": flat,
-                "max_growth_mb": round(max(growths), 1) if growths else None}
+                "max_growth_mb": round(max(growths), 1) if growths else None,
+                # bounded-threads verdict: with event-loop serving the
+                # watcher swarm rides ONE dispatcher, so no apiserver's
+                # OS-thread count may scale with the watcher count
+                "max_threads": max(thread_maxes) if thread_maxes else None}
 
 
 def scrape_metrics(metrics_url: str) -> dict:

@@ -1,0 +1,336 @@
+"""Event-loop serving: the shared dispatcher (utils/eventloop) and the
+golden parity contract — threaded and event-loop watch serving produce
+byte-identical wire frames (PR 18's tentpole acceptance).
+
+Parity method: TWO Masters over ONE shared store (identical objects,
+revisions, creationTimestamps), one per serving mode, each watched over
+a raw socket.  Frames must match byte-for-byte; only pure keep-alive
+heartbeat chunks (``\\n``) may differ in count/placement — the threaded
+loop's deadline is heartbeat-quantized while the dispatcher's deadline
+timer fires on time ("heartbeat cadence within tolerance").
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes1_tpu.apiserver import server as apiserver
+from kubernetes1_tpu.apiserver.server import Master
+from kubernetes1_tpu.client.clientset import Clientset
+from kubernetes1_tpu.machinery import global_scheme
+from kubernetes1_tpu.storage.store import Store
+from kubernetes1_tpu.utils import eventloop
+
+from .helpers import make_tpu_pod
+
+
+# ------------------------------------------------------------- loop unit
+
+
+class TestEventLoop:
+    def test_call_soon_runs_on_loop_thread(self):
+        loop = eventloop.EventLoop(name="t-soon").start()
+        try:
+            done = threading.Event()
+            seen = {}
+
+            def cb():
+                seen["in_loop"] = loop.in_loop()
+                done.set()
+
+            loop.call_soon(cb)
+            assert done.wait(2)
+            assert seen["in_loop"] is True
+        finally:
+            loop.stop()
+
+    def test_call_later_orders_and_cancels(self):
+        loop = eventloop.EventLoop(name="t-later").start()
+        try:
+            order = []
+            done = threading.Event()
+            loop.call_later(0.05, lambda: (order.append("b"), done.set()))
+            loop.call_later(0.01, lambda: order.append("a"))
+            cancelled = loop.call_later(0.02, lambda: order.append("x"))
+            cancelled.cancel()
+            assert done.wait(2)
+            assert order == ["a", "b"]
+        finally:
+            loop.stop()
+
+    def test_timer_lag_lands_in_histogram(self):
+        loop = eventloop.EventLoop(name="t-lag").start()
+        try:
+            before = eventloop.loop_lag_seconds.render()
+            done = threading.Event()
+            loop.call_later(0.01, done.set)
+            assert done.wait(2)
+            after = eventloop.loop_lag_seconds.render()
+            assert "ktpu_eventloop_lag_seconds" in after
+            assert after != before  # one more observation
+        finally:
+            loop.stop()
+
+    def test_wait_readable(self):
+        a, b = socket.socketpair()
+        try:
+            assert eventloop.wait_readable(a, 0.05) is False
+            b.sendall(b"x")
+            assert eventloop.wait_readable(a, 1.0) is True
+        finally:
+            a.close()
+            b.close()
+
+    def test_shared_loop_restarts_after_death(self):
+        loop = eventloop.shared_loop()
+        assert loop.is_alive()
+        assert eventloop.shared_loop() is loop  # singleton while alive
+
+
+# --------------------------------------------------------- wire helpers
+
+
+def _raw_watch(master, path, timeout=8.0, rcvbuf=None):
+    """Open a raw-socket watch; return (sock, header_bytes).  A tiny
+    ``rcvbuf`` (set before connect so the window scales to it) makes a
+    deliberately-unread socket back up after a few KB instead of after
+    the kernel's default ~hundreds of KB."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if rcvbuf is not None:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    s.settimeout(timeout)
+    s.connect((master.host, master.port))
+    s.sendall(b"GET " + path.encode() + b" HTTP/1.1\r\nHost: t\r\n\r\n")
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        d = s.recv(65536)
+        assert d, "connection closed before headers"
+        buf += d
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    assert b"200" in head.split(b"\r\n")[0]
+    assert b"Transfer-Encoding: chunked" in head
+    return s, rest
+
+
+def _read_until_terminal(s, leftover=b"", deadline_s=10.0):
+    buf = leftover
+    end = time.monotonic() + deadline_s
+    while not buf.endswith(b"0\r\n\r\n") and time.monotonic() < end:
+        s.settimeout(max(0.05, end - time.monotonic()))
+        try:
+            d = s.recv(65536)
+        except socket.timeout:
+            break
+        if not d:
+            break
+        buf += d
+    return buf
+
+
+def _decode_chunks(body):
+    """Chunked-transfer body -> list of chunk payloads (terminal chunk
+    dropped; asserts the framing is well-formed)."""
+    frames = []
+    i = 0
+    while i < len(body):
+        j = body.index(b"\r\n", i)
+        size = int(body[i:j], 16)
+        if size == 0:
+            break
+        payload = body[j + 2:j + 2 + size]
+        assert len(payload) == size, "torn chunk"
+        assert body[j + 2 + size:j + 4 + size] == b"\r\n"
+        frames.append(payload)
+        i = j + 4 + size
+    return frames
+
+
+def _substantive(frames):
+    """Drop pure keep-alive heartbeats (cadence may differ between
+    serving modes); every other frame must match byte-for-byte."""
+    return [f for f in frames if f != b"\n"]
+
+
+# ------------------------------------------------------------ golden A/B
+
+
+@pytest.fixture
+def shared_pair():
+    """Two watched Masters over ONE store (identical revisions, uids and
+    timestamps), one per serving mode, plus a THIRD writer Master the
+    creates go through.  The writer matters: the master that serves a
+    write memoizes the response's serialization (canonical typed-object
+    key order) under the object's (uid, resourceVersion), while a master
+    with a cold cache serializes the committed dict as stored — so
+    routing writes through either watched master would make the two
+    streams differ in JSON key order for reasons that have nothing to do
+    with the serving mode under test."""
+    store = Store(global_scheme.copy())
+    m_loop = Master(store=store, event_loop_serving=True).start()
+    m_thr = Master(store=store, event_loop_serving=False).start()
+    m_writer = Master(store=store, event_loop_serving=True).start()
+    yield m_loop, m_thr, Clientset(m_writer.url)
+    m_loop.stop()
+    m_thr.stop()
+    m_writer.stop()
+    store.close()
+
+
+class TestGoldenParity:
+    def test_watch_frames_byte_identical(self, shared_pair):
+        m_loop, m_thr, cs = shared_pair
+        path = "/api/v1/namespaces/default/pods?watch=1&timeoutSeconds=2"
+        s1, r1 = _raw_watch(m_loop, path)
+        s2, r2 = _raw_watch(m_thr, path)
+        for i in range(5):
+            cs.pods.create(make_tpu_pod(f"gp-{i}", tpus=0))
+        b1 = _read_until_terminal(s1, r1)
+        b2 = _read_until_terminal(s2, r2)
+        s1.close()
+        s2.close()
+        f1 = _substantive(_decode_chunks(b1))
+        f2 = _substantive(_decode_chunks(b2))
+        assert len(f1) == 5, f1
+        assert f1 == f2  # byte-identical event frames
+        assert b1.endswith(b"0\r\n\r\n") and b2.endswith(b"0\r\n\r\n")
+
+    def test_progress_bookmarks_byte_identical(self, shared_pair, monkeypatch):
+        # shrink the heartbeat so both modes emit progress bookmarks
+        # inside the window; bookmark FRAMES must match byte-for-byte
+        # even if their cadence/count differs slightly
+        monkeypatch.setattr(apiserver, "WATCH_HEARTBEAT_SECONDS", 0.2)
+        m_loop, m_thr, cs = shared_pair
+        cs.pods.create(make_tpu_pod("bm-seed", tpus=0))
+        path = ("/api/v1/namespaces/default/pods?watch=1&timeoutSeconds=1"
+                "&progressBookmarks=1")
+        s1, r1 = _raw_watch(m_loop, path)
+        s2, r2 = _raw_watch(m_thr, path)
+        b1 = _read_until_terminal(s1, r1)
+        b2 = _read_until_terminal(s2, r2)
+        s1.close()
+        s2.close()
+        bm1 = [f for f in _substantive(_decode_chunks(b1))
+               if b'"BOOKMARK"' in f]
+        bm2 = [f for f in _substantive(_decode_chunks(b2))
+               if b'"BOOKMARK"' in f]
+        assert bm1 and bm2
+        # identical resume position -> identical bookmark bytes
+        assert set(bm1) == set(bm2)
+
+    def test_eviction_410_byte_identical(self, shared_pair):
+        m_loop, m_thr, _cs = shared_pair
+        path = "/api/v1/namespaces/default/pods?watch=1&timeoutSeconds=5"
+        s1, r1 = _raw_watch(m_loop, path)
+        s2, r2 = _raw_watch(m_thr, path)
+        # deterministic eviction: evict every server-side watcher the way
+        # the slow-consumer path would (queue overflow calls exactly this)
+        deadline = time.monotonic() + 5
+        evicted = 0
+        while evicted < 2 and time.monotonic() < deadline:
+            evicted = 0
+            for m in (m_loop, m_thr):
+                for w in list(m.cacher._watchers):
+                    w._evict()
+                    evicted += 1
+            time.sleep(0.05)
+        assert evicted >= 2, "watchers never registered"
+        b1 = _read_until_terminal(s1, r1)
+        b2 = _read_until_terminal(s2, r2)
+        s1.close()
+        s2.close()
+        f1 = _substantive(_decode_chunks(b1))
+        f2 = _substantive(_decode_chunks(b2))
+        assert f1 == f2
+        assert len(f1) == 1 and b'"type":"ERROR"' in f1[0]
+        assert b"410" in f1[0] or b"Expired" in f1[0]
+        assert b1.endswith(b"0\r\n\r\n") and b2.endswith(b"0\r\n\r\n")
+
+
+# ------------------------------------------------------- dispatcher e2e
+
+
+class TestDispatcherBehavior:
+    def test_backpressure_evicts_slow_consumer(self):
+        """A client that never reads backs bytes up into the kernel and
+        the outbuf; the watcher's bounded queue fills; the existing
+        slow-consumer eviction fires; the client then reads its queued
+        frames, the 410, and the terminal chunk."""
+        m = Master(event_loop_serving=True, watch_queue_limit=16).start()
+        try:
+            # accepted sockets inherit the listener's SO_SNDBUF (and a
+            # pre-set buffer opts out of TCP auto-tuning, which would
+            # otherwise grow the kernel's send buffer to megabytes and
+            # absorb the whole flood without ever blocking a send)
+            m._httpd.socket.setsockopt(
+                socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+            cs = Clientset(m.url)
+            s, rest = _raw_watch(
+                m, "/api/v1/namespaces/default/pods?watch=1", rcvbuf=4096)
+            # never recv while flooding: the dispatcher drains the
+            # watcher queue into the outbuf only while the outbuf is
+            # empty, so eviction needs the socket to actually block —
+            # the tiny client rcvbuf plus fat payloads fill the kernel's
+            # send buffer within a handful of frames
+            bulk = "x" * 8192
+            for i in range(120):
+                p = make_tpu_pod(f"bp-{i}", tpus=0)
+                p.metadata.annotations["bulk"] = bulk
+                cs.pods.create(p)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                evs = (m.cacher.watch_evictions
+                       + getattr(m.store, "watch_evictions", 0))
+                if evs:
+                    break
+                time.sleep(0.05)
+            assert evs >= 1, "slow consumer never evicted"
+            body = _read_until_terminal(s, rest, deadline_s=15.0)
+            s.close()
+            frames = _substantive(_decode_chunks(body))
+            assert any(b'"type":"ERROR"' in f for f in frames[-1:]), \
+                "stream must end with the 410 ERROR frame"
+            assert body.endswith(b"0\r\n\r\n")
+        finally:
+            m.stop()
+
+    def test_client_hangup_tears_down_connection(self):
+        m = Master(event_loop_serving=True).start()
+        try:
+            base = eventloop.connection_count()
+            s, _ = _raw_watch(m, "/api/v1/namespaces/default/pods?watch=1")
+            deadline = time.monotonic() + 5
+            while eventloop.connection_count() <= base \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert eventloop.connection_count() > base
+            s.close()  # zero-byte read on the dispatcher side
+            deadline = time.monotonic() + 5
+            while eventloop.connection_count() > base \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert eventloop.connection_count() <= base
+        finally:
+            m.stop()
+
+    def test_master_stop_ends_streams_with_terminal_chunk(self):
+        m = Master(event_loop_serving=True).start()
+        s, rest = _raw_watch(m, "/api/v1/namespaces/default/pods?watch=1")
+        m.stop()
+        body = _read_until_terminal(s, rest, deadline_s=5.0)
+        s.close()
+        assert body.endswith(b"0\r\n\r\n")
+
+    def test_metrics_export_eventloop_gauges(self):
+        m = Master(event_loop_serving=True).start()
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(m.url + "/metrics", timeout=5) as r:
+                text = r.read().decode()
+            assert "ktpu_apiserver_threads " in text
+            assert "ktpu_eventloop_connections " in text
+            assert "ktpu_eventloop_lag_seconds" in text
+        finally:
+            m.stop()

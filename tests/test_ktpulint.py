@@ -1052,3 +1052,65 @@ def test_ktpu014_quiet_without_condition_attr():
                 self._data[k] = v
     """
     assert _lint(src) == []
+
+
+# -------------------------------------------------- KTPU015 (event loop)
+
+THREAD_IN_SERVING_MODULE = """
+    import threading
+
+    def serve_watch(conn):
+        th = threading.Thread(target=pump, args=(conn,), daemon=True)
+        th.start()
+"""
+
+
+def _lint_at(path: str, src: str):
+    return lint_file(path, textwrap.dedent(src))
+
+
+def test_ktpu015_fires_in_covered_serving_modules():
+    for mod in ("apiserver/server.py", "obs/collector.py",
+                "kubelet/podscrape.py", "utils/eventloop.py"):
+        findings = _lint_at(f"/repo/kubernetes1_tpu/{mod}",
+                            THREAD_IN_SERVING_MODULE)
+        got = [f for f in findings if f.pass_id == "KTPU015"]
+        assert len(got) == 1, mod
+        assert "dispatcher" in got[0].message
+
+
+def test_ktpu015_fires_on_timer_and_bare_thread_names():
+    src = """
+        from threading import Thread
+        import threading
+
+        def scrape(tgt):
+            Thread(target=tgt.run, daemon=True).start()
+            threading.Timer(1.0, tgt.rearm).start()
+    """
+    findings = _lint_at("/repo/kubernetes1_tpu/obs/collector.py", src)
+    assert [f.pass_id for f in findings
+            if f.pass_id == "KTPU015"] == ["KTPU015"] * 2
+
+
+def test_ktpu015_quiet_outside_covered_modules():
+    # the invariant is scoped to the refactored serving/scrape modules;
+    # controllers and the kubelet's per-request stream pumps keep their
+    # own threading idioms (KTPU004 still applies everywhere)
+    for path in ("/repo/kubernetes1_tpu/controllers/job.py",
+                 "/repo/kubernetes1_tpu/kubelet/server.py", "<mem>"):
+        findings = _lint_at(path, THREAD_IN_SERVING_MODULE)
+        assert [f.pass_id for f in findings if f.pass_id == "KTPU015"] == []
+
+
+def test_ktpu015_justified_pragma_suppresses():
+    src = """
+        import threading
+
+        def start_pool():
+            th = threading.Thread(  # ktpulint: ignore[KTPU015] bounded worker pool slot, not per-connection
+                target=work, daemon=True)
+            th.start()
+    """
+    findings = _lint_at("/repo/kubernetes1_tpu/obs/collector.py", src)
+    assert [f.pass_id for f in findings if f.pass_id == "KTPU015"] == []

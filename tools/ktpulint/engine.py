@@ -88,6 +88,8 @@ RULES: Dict[str, str] = {
     "KTPU013": "bespoke time.sleep retry loop outside client/retry.py policy",
     "KTPU014": "write to a condition-guarded structure outside its critical "
                "section",
+    "KTPU015": "thread construction in an event-loop-served module — "
+               "register with the shared dispatcher instead",
 }
 
 
@@ -288,7 +290,7 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
 
     p = argparse.ArgumentParser(
         prog="ktpulint",
-        description="project-specific static analysis (KTPU001-KTPU014)")
+        description="project-specific static analysis (KTPU001-KTPU015)")
     p.add_argument("paths", nargs="*",
                    help="files/directories (default: kubernetes1_tpu/ and tools/)")
     p.add_argument("--output", choices=("text", "json"), default="text",
@@ -312,6 +314,7 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
 
 
 # importing the pass modules populates the registry
+from . import eventloop_pass  # noqa: E402,F401
 from . import exceptions_pass  # noqa: E402,F401
 from . import io_boundary_pass  # noqa: E402,F401
 from . import lockfactory_pass  # noqa: E402,F401

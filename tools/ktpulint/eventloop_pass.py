@@ -1,0 +1,64 @@
+"""KTPU015 — long-lived connections register with the dispatcher, never
+a dedicated thread.
+
+The PR 18 event-loop refactor moved watch serving and both scrape planes
+off the thread-per-connection model (one parked ThreadingHTTPServer
+thread per watch stream, one daemon thread per scrape target) onto the
+shared selectors dispatcher (utils/eventloop).  This pass is the
+regression guard that keeps the refactor from silently un-happening:
+inside the serving/scrape modules it covers, ANY `threading.Thread` /
+`threading.Timer` construction is flagged — a new per-connection or
+per-target thread is exactly the pattern the refactor retired.
+
+The sanctioned exceptions carry justified pragmas at the call site:
+- the singleton dispatcher thread itself (utils/eventloop.EventLoop);
+- the bounded WorkerPool slots for blocking I/O (utils/eventloop);
+- single acceptor/serve_forever threads (one per listener, not per
+  connection);
+- joined, request-scoped fan-outs bounded by a timeout.
+
+Scope is deliberately the modules the refactor touched — not the whole
+tree (controllers, kubelet sync loops, and test harnesses have their own
+threading idioms policed by KTPU004/KTPU007).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import ast
+
+from .engine import FileContext, Finding, register
+from .threads_pass import _ctor_name
+
+# Modules under the standing invariant (paths relative to the package
+# root).  kubelet/server.py is NOT listed: its exec/attach pumps are
+# bounded per-request stream bridges, out of this invariant's scope.
+_COVERED = (
+    "apiserver/server.py",
+    "obs/collector.py",
+    "kubelet/podscrape.py",
+    "utils/eventloop.py",
+)
+
+
+def _covered(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith("kubernetes1_tpu/" + m) for m in _COVERED)
+
+
+@register("KTPU015")
+def per_connection_threads(ctx: FileContext) -> List[Finding]:
+    if not _covered(ctx.path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _ctor_name(node) is not None:
+            findings.append(Finding(
+                ctx.path, node.lineno, "KTPU015",
+                "thread construction in an event-loop-served module — "
+                "long-lived connections and scrape targets register with "
+                "the shared dispatcher (utils/eventloop), never a "
+                "dedicated thread; if this is a sanctioned bounded "
+                "worker/acceptor, justify it with a pragma"))
+    return findings
