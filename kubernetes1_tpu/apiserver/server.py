@@ -352,7 +352,7 @@ class _WatchConn:
             # (zero-byte read) or stray bytes we ignore — the threaded
             # handler never read mid-watch either
             try:
-                data = self.sock.recv(65536)
+                data = self.sock.recv(65536)  # ktpulint: ignore[KTPU016] socket is setblocking(False); recv returns or raises BlockingIOError, never stalls the loop
             except (BlockingIOError, InterruptedError):
                 data = b"ignored"
             except OSError:
@@ -393,7 +393,7 @@ class _WatchConn:
         schedsan.preempt("watch.flush")
         while self.outbuf:
             try:
-                n = self.sock.send(bytes(self.outbuf))
+                n = self.sock.send(bytes(self.outbuf))  # ktpulint: ignore[KTPU016] socket is setblocking(False); a full kernel buffer raises BlockingIOError and we re-arm on writability
             except (BlockingIOError, InterruptedError):
                 break
             except OSError:

@@ -255,7 +255,7 @@ class PluginClient:
             # InitContainer, GetPluginInfo.  An injected drop surfaces as
             # the ConnectionError the admit path classifies RETRIABLE.
             faultline.check("plugin.rpc")
-            self._ensure()
+            self._ensure()  # ktpulint: ignore[KTPU017] the lock exists to serialize request/response framing on the one plugin socket; holding it across connect+RPC IS the contract, and no loop callback ever takes it
             self._next_id += 1
             rid = self._next_id
             frame = json.dumps({"id": rid, "method": method, "params": params or {}})

@@ -465,7 +465,14 @@ class Kubelet:
     def _register_node(self):
         node = self._node_object()
         try:
-            self.cs.nodes.create(node)
+            # Registration must survive a transport-level reset: the REST
+            # layer refuses to re-send a mutation whose response was lost
+            # (may-have-been-applied), but node create is safe to retry —
+            # an applied first attempt surfaces as ApiError(exists) on the
+            # next one, which the handler below already expects.  Without
+            # this, a reset during boot kills the whole kubelet.
+            _retry.call_with_retries(
+                lambda: self.cs.nodes.create(node), reason="node_register")
         except ApiError:
             # exists: heartbeat will refresh status, but the server endpoint
             # lives in metadata (a restart may listen on a new port)

@@ -731,7 +731,7 @@ class Store:
             # a prior leader may have already committed us while we were
             # blocked on the mutex; only drain if there's still work
             if not p.event.is_set():
-                self._drain_commits()
+                self._drain_commits()  # ktpulint: ignore[KTPU017] group commit: the leader holds _commit_mu across the batched WAL fsync BY DESIGN — followers queueing behind exactly this flush is what amortizes it
         if p.exc is not None:
             raise p.exc
         return p.result
@@ -759,7 +759,7 @@ class Store:
                     self._batch_records = None
                 if records:
                     try:
-                        self._write_wal_locked(records)
+                        self._write_wal_locked(records)  # ktpulint: ignore[KTPU017] WAL-before-visibility: the durability write MUST complete under the MVCC lock or a reader could see a revision the log never recorded
                     except OSError as e:  # ENOSPC/EIO: durability lost
                         wal_exc = e
                     # fan out even on WAL failure: the in-memory MVCC state
@@ -1284,7 +1284,7 @@ class Store:
             records = [(rev, typ, key, obj)]
             wal_exc: Optional[BaseException] = None
             try:
-                self._write_wal_locked(records)
+                self._write_wal_locked(records)  # ktpulint: ignore[KTPU017] WAL-before-visibility on the replication apply path: same rule as _drain_commits
             except OSError as e:  # injected tear / ENOSPC
                 wal_exc = e
             # fan out even on WAL failure (same rule as _drain_commits):

@@ -51,6 +51,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
+from . import loopsan
 from .logutil import RateLimitedReporter
 from .metrics import Histogram
 
@@ -146,6 +147,8 @@ class EventLoop:
         """Run ``fn`` on the loop thread ASAP.  Thread-safe and
         non-blocking (the Watcher notify hook calls this under the
         cacher's commit lock)."""
+        if loopsan.active():
+            fn = loopsan.wrap_callback(fn, "call_soon")
         self._soon.append(fn)
         self._wakeup()
 
@@ -153,6 +156,8 @@ class EventLoop:
         """Run ``fn`` on the loop thread after ``delay`` seconds.
         Thread-safe: off-loop callers route the heap push through
         call_soon; the returned handle's cancel() works either way."""
+        if loopsan.active():
+            fn = loopsan.wrap_callback(fn, "call_later")
         tm = Timer(time.monotonic() + max(0.0, delay), next(self._seq), fn)  # ktpulint: ignore[KTPU004,KTPU015] this module's own heap-entry Timer handle (class above), not threading.Timer
         if self.in_loop():
             heapq.heappush(self._timers, tm)
@@ -165,9 +170,13 @@ class EventLoop:
     # selector's internal state is not shared-access safe.
 
     def register(self, fileobj, events: int, callback):
+        if loopsan.active():
+            callback = loopsan.wrap_io_callback(callback, "register")
         self._sel.register(fileobj, events, callback)
 
     def modify(self, fileobj, events: int, callback):
+        if loopsan.active():
+            callback = loopsan.wrap_io_callback(callback, "modify")
         self._sel.modify(fileobj, events, callback)
 
     def unregister(self, fileobj):
@@ -206,6 +215,9 @@ class EventLoop:
             self._err.report(f"callback {getattr(fn, '__name__', fn)!r}: {e}")
 
     def _run(self):
+        # unconditional (one set-add per loop lifetime): loopsan armed
+        # mid-run must still know which thread is the dispatcher
+        loopsan.mark_dispatcher()
         while not self._stopping.is_set():
             timeout = None
             if self._timers:
@@ -229,8 +241,12 @@ class EventLoop:
                 tm = heapq.heappop(self._timers)
                 if tm.cancelled:
                     continue
-                loop_lag_seconds.observe(now - tm.when)
+                lag = now - tm.when
+                loop_lag_seconds.observe(lag)
+                if loopsan.active():
+                    loopsan.note_lag(lag)
                 self._guard(tm.fn)
+        loopsan.unmark_dispatcher()
         try:
             self._sel.close()
             os.close(self._wake_r)

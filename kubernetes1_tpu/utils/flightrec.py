@@ -54,12 +54,13 @@ HPA_RESCALE = "hpa_rescale"            # autoscaler changed a target's replicas
 INVARIANT_VIOLATION = "invariant_violation"  # utils/invariants probe tripped
 SLO_BREACH = "slo_breach"              # scorecard burn-rate alert fired
 SCORECARD_PHASE = "scorecard_phase"    # cluster-life mixer phase transition
+DISPATCHER_STALL = "dispatcher_stall"  # loopsan: dispatcher lag over threshold
 
 KINDS = frozenset({
     LEASE_STEAL, LEASE_SHED, STANDBY_PROMOTION, SHED_429, GANG_ATTEMPT,
     GANG_TEARDOWN, DEVICE_CLAIM_CONFLICT, WAL_REPAIR, INFORMER_RELIST,
     WATCH_RECONNECT, DELETE_BATCH, HPA_RESCALE, INVARIANT_VIOLATION,
-    SLO_BREACH, SCORECARD_PHASE,
+    SLO_BREACH, SCORECARD_PHASE, DISPATCHER_STALL,
 })
 
 # Per-component ring bound: forensics wants the recent tail.  512 events

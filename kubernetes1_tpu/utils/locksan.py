@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from . import loopsan
 from . import schedsan
 
 
@@ -237,7 +238,15 @@ class _SanBase:
         schedsan.preempt(self._ss_acq)
         if blocking:
             self._before_acquire(blocking)
-        got = self._inner.acquire(blocking, timeout)
+        if blocking and loopsan.active() and loopsan.on_dispatcher():
+            # dispatcher-side waits are loopsan's business: a bounded
+            # leaf lock is legal, but the measured wait feeds the stall
+            # telemetry (and the flight recorder past the threshold)
+            t0 = time.monotonic()
+            got = self._inner.acquire(blocking, timeout)
+            loopsan.note_lock_wait(self.name, time.monotonic() - t0)
+        else:
+            got = self._inner.acquire(blocking, timeout)
         if got:
             self._after_acquire()
         return got
@@ -316,13 +325,13 @@ def make_lock(name: str, hold_budget: Optional[float] = None):
     """A named Lock: plain threading.Lock when both sanitizers are off.
     An active schedsan schedule forces the wrapper too — its preemption
     points live on the wrapper's acquire/release path."""
-    if not (enabled() or schedsan.active()):
+    if not (enabled() or schedsan.active() or loopsan.active()):
         return threading.Lock()
     return SanLock(threading.Lock(), name, hold_budget)
 
 
 def make_rlock(name: str, hold_budget: Optional[float] = None):
-    if not (enabled() or schedsan.active()):
+    if not (enabled() or schedsan.active() or loopsan.active()):
         return threading.RLock()
     return SanRLock(threading.RLock(), name, hold_budget)
 
@@ -331,7 +340,7 @@ def make_condition(lock=None, name: str = "", hold_budget: Optional[float] = Non
     """A Condition whose underlying lock goes through the sanitizer.
     Waiting releases the wrapped lock via its own release path, so time
     blocked in wait() is not charged against the hold budget."""
-    if not (enabled() or schedsan.active()):
+    if not (enabled() or schedsan.active() or loopsan.active()):
         return threading.Condition(lock)
     if lock is None:
         lock = make_rlock(name or "condition", hold_budget)

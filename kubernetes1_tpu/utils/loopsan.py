@@ -1,0 +1,381 @@
+"""loopsan: dispatcher-blocking sanitizer — the runtime twin of KTPU016.
+
+The static pass (tools/ktpulint/callgraph.py) proves "no blocking
+primitive is REACHABLE from dispatcher-run code" over the call graph it
+can resolve.  What it cannot see — callbacks built at runtime, dynamic
+dispatch it declined to guess, lag from plain CPU hogging — this module
+catches live: the dispatcher thread is marked, the blocking primitives
+the classifier knows (``time.sleep``, blocking socket I/O,
+``queue.Queue.get``, ``Future.result``) are patched to RAISE
+``BlockingOnDispatcherError`` when invoked on that thread, and the
+error carries the callback's REGISTRATION SITE (who scheduled this
+callback, from where) plus the live call stack — turning "the loop got
+slow" into a one-line attribution.
+
+Two hazards are measured rather than raised:
+
+- lock waits: a dispatcher callback acquiring a transiently contended
+  leaf lock is legal (the static pass sanctions bounded leaf locks);
+  locksan's acquire path reports the measured wait here, and waits over
+  the stall threshold land in the flight recorder;
+- dispatcher lag: the event loop reports timer fire lag here, and lag
+  over the threshold (``KTPU_LOOPSAN_STALL_S``, default 0.25s) notes a
+  ``DISPATCHER_STALL`` flight-recorder event (rate-limited) — the black
+  box shows WHEN the loop fell behind even if no primitive raised.
+
+Family contract (schedsan/mutsan shape):
+  - ``KTPU_LOOPSAN=1`` in the environment arms at import (how tier-1
+    arms it via conftest, subprocesses inherit with zero plumbing);
+  - ``activate()`` / ``deactivate()`` arm programmatically (racesweep,
+    chaos schedules, cluster_life);
+  - identity when inactive: the loop marks its thread unconditionally
+    (one set-add per loop LIFETIME), everything else is behind one
+    ``active()`` test and the primitives are only patched while armed.
+
+Deliberate perturbation is exempt: sleeps issued from schedsan (seeded
+preemption), faultline (injected delay), and locksan's own machinery are
+the sanitizers talking, not product blocking — same frames the static
+pass exempts.  Non-blocking sockets (``gettimeout() == 0``) never stall
+by construction and pass through, which is exactly why _WatchConn's
+recv/send are statically pragma'd AND runtime-clean.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as _socket_mod
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from . import flightrec
+
+ENV_VAR = "KTPU_LOOPSAN"
+STALL_ENV_VAR = "KTPU_LOOPSAN_STALL_S"
+DEFAULT_STALL_S = 0.25
+
+_VIOLATION_CAP = 256  # bounded: the sanitizer must never OOM on telemetry
+
+# frames whose sleeps are the sanitizers' own perturbation, not product
+# blocking (mirrors callgraph._EXEMPT_MODULE_SUFFIXES)
+_EXEMPT_FILES = (f"{os.sep}schedsan.py", f"{os.sep}faultline.py",
+                 f"{os.sep}locksan.py")
+
+
+class BlockingOnDispatcherError(RuntimeError):
+    """A blocking primitive ran on the marked dispatcher thread.
+
+    Attributes carry the attribution the error message renders:
+    ``primitive`` (what blocked), ``registration_site`` (file:line that
+    scheduled the callback being run, '' when the callback predates
+    arming), ``callback`` (its name), ``stack`` (formatted call stack at
+    the blocking call)."""
+
+    def __init__(self, primitive: str, registration_site: str,
+                 callback: str, stack: str):
+        self.primitive = primitive
+        self.registration_site = registration_site
+        self.callback = callback
+        self.stack = stack
+        where = (f"callback {callback!r} registered at {registration_site}"
+                 if registration_site else
+                 "a callback registered before loopsan was armed")
+        super().__init__(
+            f"{primitive} on the shared dispatcher thread ({where}) — "
+            f"blocking work goes through eventloop.shared_pool(); the "
+            f"dispatcher runs non-blocking state machines only.\n"
+            f"stack at the blocking call:\n{stack}")
+
+
+# Dispatcher idents are tracked UNCONDITIONALLY (set-add once per loop
+# lifetime): arming mid-run — racesweep activates after the shared loop
+# already started — must still know which thread is the dispatcher.
+_dispatcher_idents: set = set()
+
+# registration attribution for the callback currently running on each
+# thread (set by the wrapper wrap_callback installs)
+_tls = threading.local()
+
+
+def mark_dispatcher() -> None:
+    """Called by EventLoop._run on entry, on the loop thread."""
+    _dispatcher_idents.add(threading.get_ident())
+
+
+def unmark_dispatcher() -> None:
+    _dispatcher_idents.discard(threading.get_ident())
+
+
+def on_dispatcher() -> bool:
+    return threading.get_ident() in _dispatcher_idents
+
+
+class _State:
+    """One armed session: violation ring + stall telemetry + the saved
+    originals of every patched primitive."""
+
+    def __init__(self, stall_threshold_s: float):
+        self.stall_threshold_s = stall_threshold_s
+        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf lock inside the sanitizer itself; locksan's factory routes back here when loopsan arms it
+        self.violation_ring: "deque[Dict[str, str]]" = deque(
+            maxlen=_VIOLATION_CAP)
+        self.violation_count = 0
+        self.max_stall_s = 0.0
+        self.stall_count = 0
+        self._last_note = 0.0
+        self.originals: Dict[str, Callable] = {}
+
+    def record_violation(self, err: BlockingOnDispatcherError) -> None:
+        with self._lock:
+            self.violation_count += 1
+            self.violation_ring.append({
+                "primitive": err.primitive,
+                "registration_site": err.registration_site,
+                "callback": err.callback,
+                "stack": err.stack,
+            })
+
+    def record_stall(self, source: str, seconds: float) -> None:
+        note = False
+        with self._lock:
+            if seconds > self.max_stall_s:
+                self.max_stall_s = seconds
+            if seconds >= self.stall_threshold_s:
+                self.stall_count += 1
+                now = time.monotonic()
+                if now - self._last_note >= 1.0:  # rate-limit the ring
+                    self._last_note = now
+                    note = True
+        if note:
+            flightrec.note("eventloop", flightrec.DISPATCHER_STALL,
+                           source=source, stall_s=round(seconds, 4))
+
+
+_state: Optional[_State] = None
+
+
+def active() -> bool:
+    return _state is not None
+
+
+enabled = active  # locksan spells the question enabled(); keep both
+
+
+def stats() -> Dict[str, object]:
+    """The scorecard-facing summary: zeroes when inactive (the
+    cluster_life ``loopsan`` block's keys never disappear)."""
+    s = _state
+    if s is None:
+        return {"violations": 0, "max_stall_s": 0.0, "stalls": 0}
+    with s._lock:
+        return {"violations": s.violation_count,
+                "max_stall_s": round(s.max_stall_s, 4),
+                "stalls": s.stall_count}
+
+
+def violations() -> List[Dict[str, str]]:
+    """Recorded violation details (newest-bounded ring) — what the
+    injected-blocking regression asserts registration sites against."""
+    s = _state
+    if s is None:
+        return []
+    with s._lock:
+        return list(s.violation_ring)
+
+
+# ------------------------------------------------------------ attribution
+
+
+def wrap_callback(fn: Callable, kind: str) -> Callable:
+    """Wrap a callback at REGISTRATION time (EventLoop does this while
+    loopsan is active): capture the registering frame now, and publish it
+    in thread-local state while the callback runs, so a primitive that
+    raises mid-callback can name who scheduled it."""
+    site = _registration_site()
+    name = getattr(fn, "__name__", repr(fn))
+
+    def _loopsan_wrapped():
+        prev = getattr(_tls, "reg", None)
+        _tls.reg = (site, f"{kind}:{name}")
+        try:
+            fn()
+        finally:
+            _tls.reg = prev
+
+    _loopsan_wrapped.__name__ = name  # keep _guard's error reports readable
+    return _loopsan_wrapped
+
+
+def wrap_io_callback(fn: Callable, kind: str) -> Callable:
+    """Same as wrap_callback for selector callbacks (they take the ready
+    mask as an argument)."""
+    site = _registration_site()
+    name = getattr(fn, "__name__", repr(fn))
+
+    def _loopsan_wrapped(mask):
+        prev = getattr(_tls, "reg", None)
+        _tls.reg = (site, f"{kind}:{name}")
+        try:
+            fn(mask)
+        finally:
+            _tls.reg = prev
+
+    _loopsan_wrapped.__name__ = name
+    return _loopsan_wrapped
+
+
+def _registration_site() -> str:
+    """file:line of the first stack frame outside the loop machinery —
+    the code that asked for this callback to run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    skip = (os.path.join(here, "loopsan.py"),
+            os.path.join(here, "eventloop.py"))
+    for frame in traceback.extract_stack()[::-1]:
+        if frame.filename not in skip:
+            return f"{os.path.basename(frame.filename)}:{frame.lineno}"
+    return ""
+
+
+def _current_registration() -> tuple:
+    reg = getattr(_tls, "reg", None)
+    return reg if reg is not None else ("", "")
+
+
+# ------------------------------------------------------------- enforcement
+
+
+def _violate(primitive: str) -> None:
+    site, cb = _current_registration()
+    stack = "".join(traceback.format_stack()[-8:-1])
+    err = BlockingOnDispatcherError(primitive, site, cb, stack)
+    s = _state
+    if s is not None:
+        s.record_violation(err)
+    raise err
+
+
+def _caller_exempt() -> bool:
+    """True when the blocking call was issued by sanitizer machinery
+    (schedsan preemption sleeps, faultline injected delays)."""
+    for frame in traceback.extract_stack()[-4:-1]:
+        if frame.filename.endswith(_EXEMPT_FILES):
+            return True
+    return False
+
+
+def note_lag(lag_s: float) -> None:
+    """EventLoop reports each timer's fire lag here (one call per timer
+    fire, behind the caller's active() test)."""
+    s = _state
+    if s is not None:
+        s.record_stall("timer_lag", lag_s)
+
+
+def note_lock_wait(lock_name: str, waited_s: float) -> None:
+    """locksan reports a measured dispatcher-side lock wait.  Contended
+    leaf locks are LEGAL (briefly) — this records the stall instead of
+    raising, and the flight recorder catches the pathological ones."""
+    s = _state
+    if s is not None and waited_s > 0.0:
+        s.record_stall(f"lock_wait:{lock_name}", waited_s)
+
+
+# Patched primitives.  Each guard answers three questions in order: is
+# this the dispatcher thread?  would this call actually block?  is the
+# caller exempt machinery?  Only then it raises.
+
+
+def _patched_sleep(orig):
+    def sleep(seconds):
+        if on_dispatcher() and seconds and not _caller_exempt():
+            _violate(f"time.sleep({seconds!r})")
+        return orig(seconds)
+
+    return sleep
+
+
+def _patched_queue_get(orig):
+    def get(self, block=True, timeout=None):
+        if on_dispatcher() and block and timeout != 0:
+            _violate("queue.Queue.get(block=True)")
+        return orig(self, block, timeout)
+
+    return get
+
+
+def _patched_future_result(orig):
+    def result(self, timeout=None):
+        if on_dispatcher() and timeout != 0 and not self.done():
+            _violate("Future.result() on an unfinished future")
+        return orig(self, timeout)
+
+    return result
+
+
+def _patched_sock(orig, label):
+    def method(self, *args, **kwargs):
+        if on_dispatcher() and self.gettimeout() != 0:
+            # a non-blocking socket (timeout 0) returns or raises
+            # BlockingIOError — it cannot stall the loop
+            _violate(f"blocking socket.{label}")
+        return orig(self, *args, **kwargs)
+
+    return method
+
+
+_SOCKET_PATCHES = ("send", "sendall", "recv", "recv_into", "accept",
+                   "connect")
+
+
+def activate(stall_threshold_s: Optional[float] = None) -> None:
+    """Arm process-wide: patch the blocking primitives and start
+    recording.  Idempotent (re-arming keeps the existing session)."""
+    global _state
+    if _state is not None:
+        return
+    if stall_threshold_s is None:
+        stall_threshold_s = float(
+            os.environ.get(STALL_ENV_VAR, "") or DEFAULT_STALL_S)
+    s = _State(stall_threshold_s)
+    s.originals["time.sleep"] = time.sleep
+    time.sleep = _patched_sleep(time.sleep)
+    import queue as _queue
+
+    s.originals["queue.Queue.get"] = _queue.Queue.get
+    _queue.Queue.get = _patched_queue_get(_queue.Queue.get)
+    from concurrent.futures import Future as _Future
+
+    s.originals["Future.result"] = _Future.result
+    _Future.result = _patched_future_result(_Future.result)
+    for name in _SOCKET_PATCHES:
+        orig = getattr(_socket_mod.socket, name)
+        s.originals[f"socket.{name}"] = orig
+        # socket.socket is the Python subclass of the C _socket.socket:
+        # setting the attribute installs a Python-level override without
+        # touching the C type
+        setattr(_socket_mod.socket, name, _patched_sock(orig, name))
+    _state = s
+
+
+def deactivate() -> None:
+    """Disarm and restore every patched primitive."""
+    global _state
+    s = _state
+    if s is None:
+        return
+    _state = None
+    time.sleep = s.originals["time.sleep"]
+    import queue as _queue
+
+    _queue.Queue.get = s.originals["queue.Queue.get"]
+    from concurrent.futures import Future as _Future
+
+    _Future.result = s.originals["Future.result"]
+    for name in _SOCKET_PATCHES:
+        setattr(_socket_mod.socket, name, s.originals[f"socket.{name}"])
+
+
+if os.environ.get(ENV_VAR, "") not in ("", "0"):
+    activate()

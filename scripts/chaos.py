@@ -104,10 +104,14 @@ def _stop_quietly_mod(fn):
 def _begin_seed_run():
     """Each seed's flight-recorder dump must be ITS timeline, not the
     sweep's history: clear every component ring before the topology
-    boots (rings are process-global and a sweep runs in one process)."""
-    from kubernetes1_tpu.utils import flightrec
+    boots (rings are process-global and a sweep runs in one process).
+    Also arms loopsan (idempotent) so every schedule — wire, life, the
+    all-mixer — runs with the dispatcher-blocking sanitizer watching;
+    DISPATCHER_STALL events land in the same per-seed timeline."""
+    from kubernetes1_tpu.utils import flightrec, loopsan
 
     flightrec.reset()
+    loopsan.activate()
 
 
 def _finalize_verdict(verdict: dict) -> dict:

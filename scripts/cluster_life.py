@@ -565,10 +565,15 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
     from kubernetes1_tpu.localcluster import LocalCluster
     from kubernetes1_tpu.obs import timeline as timeline_mod
     from kubernetes1_tpu.obs.scorecard import Scorecard
-    from kubernetes1_tpu.utils import flightrec, schedsan
+    from kubernetes1_tpu.utils import flightrec, loopsan, schedsan
     from kubernetes1_tpu.workloads.rl_actor import ChurnDriver
 
     flightrec.reset()
+    # Arm the dispatcher-blocking sanitizer for the life run (idempotent —
+    # the chaos life schedule arms it earlier via _begin_seed_run): the
+    # scorecard's loopsan block is only meaningful if the primitives were
+    # actually instrumented while the mix ran.
+    loopsan.activate()
     t_start_wall = time.time()  # ktpulint: ignore[KTPU005] timeline capture cutoff is a wall stamp by contract
     cluster = None
     app = None
@@ -775,6 +780,10 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
         result.update({
             "phases": phases,
             "eventloop": eventloop_block,
+            # runtime twin of the KTPU016 static gate: blocking-primitive
+            # calls caught ON the dispatcher during the mix, plus the worst
+            # measured dispatcher stall (lock waits + timer lag)
+            "loopsan": loopsan.stats(),
             "slos": scorecard.verdict(),
             "breached_slos": scorecard.breached_slos(),
             "breach_timelines": breach_timelines,
@@ -806,6 +815,11 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
                     if v["met"] is not None]
         result["slos_measured"] = len(measured)
         result["ok"] = bool(measured) and all(v["met"] for v in measured)
+        if result["loopsan"]["violations"] and result["ok"]:
+            # a blocking call on the dispatcher is a correctness defect in
+            # the substrate the SLOs ride on — it fails the run even when
+            # every latency number happened to squeak under budget
+            result["ok"] = False
         return result
     finally:
         _phase("teardown")

@@ -446,14 +446,19 @@ SCENARIOS = {
 
 
 def run_scenario(name: str, seed: int) -> dict:
-    """One (scenario, seed) run under schedsan + armed invariants.
-    Returns a chaos-style verdict dict; never raises."""
-    from kubernetes1_tpu.utils import flightrec, invariants, schedsan
+    """One (scenario, seed) run under schedsan + armed invariants, with
+    loopsan watching the dispatcher.  Returns a chaos-style verdict dict;
+    never raises."""
+    from kubernetes1_tpu.utils import flightrec, invariants, loopsan, schedsan
 
     verdict = {"mode": f"race-{name}", "seed": seed, "schedsan_seed": seed,
                "ok": True, "acked": 0}
     flightrec.reset()  # this seed's timeline, not the sweep's history
     schedsan.activate(seed)
+    # dispatcher-blocking sanitizer rides along: schedsan's perturbation
+    # widens exactly the windows where an accidental blocking call on the
+    # loop thread would hide, and its own injected sleeps are exempt
+    loopsan.activate()
     prior_armed = invariants.arm()
     start = time.monotonic()
     try:
@@ -471,6 +476,13 @@ def run_scenario(name: str, seed: int) -> dict:
         invariants.reset()
         invariants.arm(prior_armed)  # scoped: don't leak armed probes
         schedsan.deactivate()
+        verdict["loopsan"] = loopsan.stats()
+        loopsan.deactivate()
+    if verdict["loopsan"]["violations"] and verdict["ok"]:
+        verdict["ok"] = False
+        verdict["error"] = (
+            f"loopsan: {verdict['loopsan']['violations']} blocking "
+            f"call(s) on the dispatcher thread")
     verdict["recovery_s"] = round(time.monotonic() - start, 3)
     if not verdict["ok"]:
         verdict["replay"] = (f"KTPU_SCHEDSAN={seed} python "

@@ -28,6 +28,14 @@ os.environ.setdefault("KTPU_LOCKSAN", "1")
 # failure, exactly like KTPU_LOCKSAN above.
 os.environ.setdefault("KTPU_MUTSAN", "1")
 
+# Dispatcher-blocking sanitizer (utils/loopsan): the shared event loop's
+# thread is marked, and the classic blocking primitives (time.sleep,
+# blocking socket I/O, queue.get, Future.result) raise
+# BlockingOnDispatcherError with the callback's registration site when
+# they run on it — the runtime twin of the KTPU016 static pass.  Same
+# A/B switch shape as its siblings: `KTPU_LOOPSAN=0 pytest ...`.
+os.environ.setdefault("KTPU_LOOPSAN", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

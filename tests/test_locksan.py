@@ -220,8 +220,23 @@ def test_env_budget_default(monkeypatch):
 
 # ---------------------------------------------------------------- off switch
 
-def test_disabled_returns_plain_primitives(monkeypatch):
+@pytest.fixture
+def _all_sanitizers_off(monkeypatch):
+    """The factories return plain primitives only when EVERY sanitizer
+    that rides the wrappers is off: locksan itself, schedsan (preemption
+    points live on the wrapper), and loopsan (dispatcher lock-wait
+    measurement does too — the tier-1 conftest arms it)."""
+    from kubernetes1_tpu.utils import loopsan
+
     monkeypatch.setenv("KTPU_LOCKSAN", "0")
+    was = loopsan.active()
+    loopsan.deactivate()
+    yield
+    if was:
+        loopsan.activate()
+
+
+def test_disabled_returns_plain_primitives(monkeypatch, _all_sanitizers_off):
     lock = locksan.make_lock("t.off")
     rlock = locksan.make_rlock("t.off")
     cond = locksan.make_condition(name="t.off")
@@ -233,8 +248,7 @@ def test_disabled_returns_plain_primitives(monkeypatch):
     assert type(locksan.make_lock("t.off2")) is type(threading.Lock())
 
 
-def test_disabled_no_tracking_no_raises(monkeypatch):
-    monkeypatch.setenv("KTPU_LOCKSAN", "0")
+def test_disabled_no_tracking_no_raises(monkeypatch, _all_sanitizers_off):
     a = locksan.make_lock("t.offA")
     b = locksan.make_lock("t.offB")
     with a:

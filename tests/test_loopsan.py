@@ -1,0 +1,184 @@
+"""Runtime dispatcher-blocking sanitizer (utils/loopsan): the dynamic
+twin of the KTPU016 static pass.  The load-bearing contracts:
+
+- armed + blocking primitive ON the dispatcher -> BlockingOnDispatcherError
+  carrying the callback's REGISTRATION site (where the fix goes), not just
+  the blocking frame;
+- the sanctioned patterns stay legal: zero-timeout I/O, shared_pool
+  offload, off-dispatcher threads;
+- inactive mode is identity: primitives restored, zeroed stats (so the
+  cluster_life ``loopsan`` scorecard block renders zeros, not missing
+  keys);
+- measured stalls (lock waits, timer lag) are telemetry, never raises.
+"""
+
+import inspect
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from kubernetes1_tpu.utils import eventloop, loopsan
+
+
+@pytest.fixture
+def armed():
+    """Ensure loopsan is armed for the test and restore the prior state
+    (conftest arms it via KTPU_LOOPSAN=1, but A/B runs may not)."""
+    was = loopsan.active()
+    loopsan.activate()
+    yield
+    if not was:
+        loopsan.deactivate()
+
+
+@pytest.fixture
+def dispatcher_self(armed):
+    """Mark the test's own thread as the dispatcher: primitive guards
+    check the ident set, so violations can be asserted synchronously
+    without standing up a loop."""
+    loopsan.mark_dispatcher()
+    yield
+    loopsan.unmark_dispatcher()
+
+
+def _wait_until(pred, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------- raising
+
+
+def test_sleep_on_dispatcher_raises_and_records(dispatcher_self):
+    before = loopsan.stats()["violations"]
+    with pytest.raises(loopsan.BlockingOnDispatcherError) as ei:
+        time.sleep(0.05)
+    assert "time.sleep" in ei.value.primitive
+    s = loopsan.stats()
+    assert s["violations"] == before + 1
+    assert loopsan.violations()[-1]["primitive"] == ei.value.primitive
+
+
+def test_zero_sleep_and_off_dispatcher_sleep_legal(dispatcher_self):
+    time.sleep(0)  # scheduler hint, cannot stall the loop
+    loopsan.unmark_dispatcher()
+    try:
+        time.sleep(0.001)  # not the dispatcher: no opinion
+    finally:
+        loopsan.mark_dispatcher()  # fixture's unmark stays balanced
+
+
+def test_queue_get_and_future_result_guards(dispatcher_self):
+    q = queue.Queue()
+    with pytest.raises(loopsan.BlockingOnDispatcherError):
+        q.get()
+    with pytest.raises(queue.Empty):
+        q.get(block=True, timeout=0)  # zero-timeout poll is legal
+    fut = Future()
+    with pytest.raises(loopsan.BlockingOnDispatcherError):
+        fut.result()
+    fut.set_result(7)
+    assert fut.result() == 7  # done future returns without waiting
+
+
+# ------------------------------------------------------------ attribution
+
+
+def test_injected_blocking_callback_names_registration_site(armed):
+    """THE regression the ISSUE seeds: a time.sleep smuggled into a
+    call_soon callback must fail loudly and name the line that REGISTERED
+    the callback — the blocking frame alone points at the symptom, the
+    registration site points at the owner."""
+    loop = eventloop.EventLoop(name="loopsan-test").start()
+    try:
+        before = loopsan.stats()["violations"]
+        ran = threading.Event()
+
+        def smuggled():
+            try:
+                time.sleep(0.05)
+            finally:
+                ran.set()
+
+        reg_line = inspect.currentframe().f_lineno + 1
+        loop.call_soon(smuggled)
+        assert ran.wait(3.0)
+        assert _wait_until(
+            lambda: loopsan.stats()["violations"] > before)
+        v = loopsan.violations()[-1]
+        assert v["registration_site"] == f"test_loopsan.py:{reg_line}"
+        assert v["callback"] == "call_soon:smuggled"
+        assert "time.sleep" in v["primitive"]
+        # the raise is swallowed by the loop's _guard: the dispatcher
+        # survives and still runs later callbacks
+        again = threading.Event()
+        loop.call_soon(again.set)
+        assert again.wait(3.0)
+    finally:
+        loop.stop()
+
+
+def test_pool_offload_is_legal(armed):
+    """The sanctioned shape: the dispatcher callback only SUBMITS; the
+    blocking body runs on a pool slot loopsan has no opinion about."""
+    loop = eventloop.EventLoop(name="loopsan-pool-test").start()
+    pool = eventloop.WorkerPool(size=1, name="loopsan-pool")
+    try:
+        before = loopsan.stats()["violations"]
+        done = threading.Event()
+
+        def blocking_body():
+            time.sleep(0.02)
+            done.set()
+
+        loop.call_soon(lambda: pool.submit(blocking_body))
+        assert done.wait(3.0)
+        assert loopsan.stats()["violations"] == before
+    finally:
+        loop.stop()
+        pool._q.put(None)  # retire the worker so no thread outlives the test
+
+
+# -------------------------------------------------------- stall telemetry
+
+
+def test_lock_wait_is_measured_not_raised(dispatcher_self):
+    s0 = loopsan.stats()
+    loopsan.note_lock_wait("TestLock._mu", 0.5)  # past the 0.25s threshold
+    s1 = loopsan.stats()
+    assert s1["stalls"] == s0["stalls"] + 1
+    assert s1["max_stall_s"] >= 0.5
+    assert s1["violations"] == s0["violations"]  # measured, never raised
+
+
+# ------------------------------------------------------------ identity off
+
+
+def test_inactive_mode_is_identity():
+    was = loopsan.active()
+    loopsan.deactivate()
+    try:
+        assert not loopsan.active()
+        orig_sleep = time.sleep
+        loopsan.mark_dispatcher()
+        try:
+            time.sleep(0.001)  # no raise: the primitive is the original
+        finally:
+            loopsan.unmark_dispatcher()
+        assert loopsan.stats() == {
+            "violations": 0, "max_stall_s": 0.0, "stalls": 0}
+        assert loopsan.violations() == []
+        loopsan.activate()
+        assert time.sleep is not orig_sleep  # arming patches...
+        loopsan.deactivate()
+        assert time.sleep is orig_sleep  # ...and disarming restores
+    finally:
+        if was and not loopsan.active():
+            loopsan.activate()

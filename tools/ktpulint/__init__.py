@@ -11,13 +11,26 @@ Passes (see each module's docstring for the rationale):
 - KTPU008 mutating a shared cache snapshot without clone() (dataflow)
 - KTPU009 unknown wire-field key on an API-shaped raw dict (schema-aware)
 - KTPU010 suppression pragma without a justification
+- KTPU011 flight-recorder event kind not from the closed enum
+- KTPU012 raw socket/open I/O in a module with no faultline site
+- KTPU013 bespoke time.sleep retry loop outside client/retry.py policy
+- KTPU014 write to a condition-guarded structure outside its critical section
+- KTPU015 thread construction in an event-loop-served module
+- KTPU016 blocking primitive transitively reachable from dispatcher-run code
+  (interprocedural, over the project call graph — see callgraph.py)
+- KTPU017 lock held across a call chain that reaches a blocking primitive
+  (the interprocedural closure of KTPU002)
 
 Run the gate: `python scripts/lint.py` (exits non-zero on any finding;
 `--changed-only` for the fast pre-commit mode, `--output json` for the
 stable finding schema, `--baseline FILE` to fail only on new findings);
 suppress a deliberate exception to a rule with
 `# ktpulint: ignore[KTPU00X] <justification>` on the offending line —
-the justification is mandatory (KTPU010).
+the justification is mandatory (KTPU010).  The call-graph passes memoize
+per-file summaries under `.ktpulint_cache/` (content-hash keyed;
+`--no-cache` forces a cold build), and `python -m tools.ktpulint
+--unused-pragmas` sweeps for suppression pragmas whose finding no longer
+fires.
 """
 
 from .engine import Finding, lint_file, lint_paths, registered_passes

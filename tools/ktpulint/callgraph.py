@@ -1,0 +1,1477 @@
+"""Project-wide call-graph engine + the interprocedural passes KTPU016/017.
+
+Every pass before this one was intraprocedural: it judged a file from the
+file alone.  That was enough while the hazards were local (a sleep under
+a lock is visible in the method that sleeps).  PR 18 changed the failure
+geometry: one shared dispatcher thread now serves every watch connection
+and every scrape timer in the process, so a blocking call smuggled
+ANYWHERE into a loop callback's call chain — three frames down, in
+another module — stalls 10k watchers at once.  Kubernetes guards the
+analogous hazards with whole-program vet passes (logcheck, contextcheck);
+this module is ours.
+
+The engine (``CallGraph``) builds a best-effort, conservative call graph
+over the ``kubernetes1_tpu/`` + ``tools/`` + ``scripts/`` tree:
+
+- import/alias resolution (``import x as y``, ``from a import b``,
+  relative imports) maps dotted calls to project functions;
+- class-method resolution follows ``self.meth()`` and inherited methods
+  through project base classes;
+- self-attr type inference from ctor assigns (``self.loop =
+  master.dispatcher()`` resolves through param annotations and return
+  annotations/``return self``/``return Cls()`` inference) lets
+  ``self.attr.meth()`` find its target;
+- an attribute call neither typing nor imports can place falls back to
+  unique-method-name devirtualization (resolve iff exactly one project
+  class defines the name) and otherwise contributes NO edge — unresolved
+  means unproven, and these passes only report what a chain proves.
+
+On top of the graph sit a blocking-primitive classifier (socket
+send/recv/accept/connect, ``time.sleep``, locksan acquire without zero
+timeout, ``Future.result``, blocking ``queue.get``, fsync, subprocess /
+urlopen, the ``client/retry`` entry points) and two passes:
+
+KTPU016 — a blocking primitive transitively reachable from code the
+dispatcher runs.  Roots are the callbacks handed to
+``call_soon``/``call_later``/loop ``register``/``modify``, the notify
+hooks installed via ``set_notify``, and every implementation of the
+non-blocking cursor contract (``next_batch_nowait``/``set_notify``).
+``shared_pool().submit(...)`` is the sanctioned sink: the edge into the
+submitted job is CUT (that is exactly what the pool is for), as are
+re-registrations (``call_soon``/``call_later`` schedule, they don't run
+inline) and thread construction.  A locksan acquire on a dispatcher path
+is flagged only when some critical section of that LOCK CLASS (by
+factory name, the lockdep model) itself reaches a non-lock blocking
+primitive — a bounded leaf lock is sanctioned statically, and the
+runtime twin (``utils/loopsan``) polices actual contention.
+
+KTPU017 — KTPU002 made interprocedural: a locksan-factory lock held
+across a call chain that reaches a blocking primitive.  The direct case
+(sleep in the same ``with`` block) stays KTPU002's; this pass fires when
+the blocking step hides one or more call edges away, and the finding
+prints the per-edge chain so the fix (release first, or move the call
+out of the critical section) is mechanical.
+
+Findings are reported at the blocking call's own line (KTPU016) or at
+the call site inside the critical section (KTPU017), so the standard
+pragma idiom applies at the line a human would audit.
+
+Extraction is cached per file keyed on content hash (persisted under
+``.ktpulint_cache/``, gitignored) so the full-tree gate pays the parse
+cost once per file EDIT, not once per run; ``--no-cache`` bypasses it.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, suppressed_ids, walk_py_files
+
+# Bump when the summary shape or classifier changes: a stale cache must
+# miss on version, never deserialize into wrong facts.
+SUMMARY_VERSION = 3
+
+CACHE_DIR = ".ktpulint_cache"
+CACHE_FILE = "callgraph.json"
+
+# Bounded closure: the graph walk gives up past this many edges deep.
+# Real chains in this tree are <10; the bound exists so mutual recursion
+# and pathological fan-out can never hang the gate.
+MAX_DEPTH = 40
+
+_LOCK_FACTORIES = {"make_lock": "@lock", "make_rlock": "@lock",
+                   "make_condition": "@cond"}
+
+# dotted-suffix ctor -> type tag (builtin receivers the classifier knows)
+_CTOR_TYPES = {
+    ("threading", "Event"): "@event",
+    ("threading", "Condition"): "@cond",
+    ("threading", "Lock"): "@lock",
+    ("threading", "RLock"): "@lock",
+    ("threading", "Thread"): "@thread",
+    ("queue", "Queue"): "@queue",
+    ("queue", "SimpleQueue"): "@queue",
+    ("queue", "LifoQueue"): "@queue",
+    ("queue", "PriorityQueue"): "@queue",
+    ("socket", "socket"): "@socket",
+    ("socket", "create_connection"): "@socket",
+}
+
+_SOCKET_METHODS = {"send", "sendall", "recv", "recv_into", "recvfrom",
+                   "sendto", "accept", "connect", "makefile"}
+
+# dotted call suffixes that block wherever they run
+_BLOCKING_DOTTED = {
+    ("time", "sleep"): ("sleep", "time.sleep"),
+    ("socket", "create_connection"): ("io", "socket.create_connection"),
+    ("urllib", "request", "urlopen"): ("io", "urllib.request.urlopen"),
+    ("subprocess", "run"): ("io", "subprocess.run"),
+    ("subprocess", "call"): ("io", "subprocess.call"),
+    ("subprocess", "check_call"): ("io", "subprocess.check_call"),
+    ("subprocess", "check_output"): ("io", "subprocess.check_output"),
+    ("subprocess", "Popen"): ("io", "subprocess.Popen"),
+    ("os", "system"): ("io", "os.system"),
+    ("os", "fsync"): ("io", "os.fsync"),
+}
+
+# client/retry entry points: each one sleeps between attempts by design
+_RETRY_MODULE = "kubernetes1_tpu.client.retry"
+_RETRY_ENTRIES = {"call_with_retries", "retry_on_conflict"}
+
+# Sanitizer / fault-injection machinery: these modules PERTURB on purpose
+# (schedsan preempts with a sleep, faultline injects delays and tears) and
+# are identity when unarmed, so their injected blocking is not product
+# blocking.  Edges into them are cut and their bodies are never scanned —
+# the runtime twin (loopsan) exempts the same frames.
+_EXEMPT_MODULE_SUFFIXES = ("utils.schedsan", "utils.faultline",
+                           "utils.loopsan")
+
+
+def _exempt_module(mod: str) -> bool:
+    return mod.endswith(_EXEMPT_MODULE_SUFFIXES)
+
+# registrar method name -> index of the callback argument.  register and
+# modify additionally require a loop-shaped receiver (the names are too
+# generic to trust bare); the others are distinctive on their own.
+_REGISTRARS = {"call_soon": 0, "call_later": 1, "set_notify": 0,
+               "register": 2, "modify": 2}
+_LOOPISH_ONLY = {"register", "modify"}
+
+# method names whose implementations are dispatcher roots BY CONTRACT:
+# any watcher type served by the loop must keep these non-blocking.
+_CONTRACT_ROOTS = {"next_batch_nowait", "set_notify"}
+
+
+# ---------------------------------------------------------------- descriptors
+#
+# Extraction records symbolic, JSON-ready descriptors; resolution against
+# the full project happens at link time so per-file summaries stay
+# cacheable.
+
+
+def _dotted(node: ast.AST) -> Tuple[str, ...]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _ann_str(node: Optional[ast.AST]) -> str:
+    """An annotation as a dotted string ('Optional[EventLoop]' peels to
+    'EventLoop'; quoted forward refs unquote); '' when unrepresentable."""
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip().strip("'\"")
+    if isinstance(node, ast.Subscript):
+        head = _dotted(node.value)
+        if head and head[-1] in ("Optional", "Final"):
+            return _ann_str(node.slice)
+        return ""
+    d = _dotted(node)
+    return ".".join(d) if d else ""
+
+
+def _value_desc(node: ast.AST) -> Optional[dict]:
+    """Descriptor for an expression used as a VALUE (ctor assign RHS,
+    callback argument, with-context): what would this evaluate to?"""
+    if isinstance(node, ast.Call):
+        tgt = _call_desc(node.func)
+        return {"k": "call", "f": tgt} if tgt else None
+    if isinstance(node, ast.Lambda):
+        return None  # callers register lambdas as pseudo-functions
+    if isinstance(node, ast.Name):
+        return {"k": "name", "n": node.id}
+    if isinstance(node, ast.Attribute):
+        d = _dotted(node)
+        if not d:
+            return None
+        if d[0] == "self" and len(d) == 2:
+            return {"k": "selfattr", "a": d[1]}
+        return {"k": "dotted", "p": list(d)}
+    return None
+
+
+def _call_desc(func: ast.AST) -> Optional[dict]:
+    """Descriptor for a call TARGET expression."""
+    d = _dotted(func)
+    if not d:
+        return None
+    if d[0] == "self":
+        if len(d) == 2:
+            return {"k": "selfmeth", "m": d[1]}
+        if len(d) == 3:
+            return {"k": "selfattrmeth", "a": d[1], "m": d[2]}
+        return {"k": "deepattr", "m": d[-1]}
+    if len(d) == 1:
+        return {"k": "name", "n": d[0]}
+    return {"k": "dotted", "p": list(d)}
+
+
+# ------------------------------------------------------------------ extraction
+
+
+class _FuncExtractor(ast.NodeVisitor):
+    """Walk one function body collecting call records.  Nested defs and
+    lambdas become their own summaries (they run on their own schedule);
+    the enclosing function records them in ``defines`` for local name
+    resolution."""
+
+    def __init__(self, summary: "_FileSummary", func_id: str,
+                 cls: Optional[str]):
+        self.s = summary
+        self.func_id = func_id
+        self.cls = cls
+        self.lock_stack: List[dict] = []  # with-context descriptors
+        self.info = {"calls": [], "returns": [], "defines": {},
+                     "line": 0}
+
+    # --------------------------------------------------------------- helpers
+
+    def _add_call(self, node: ast.Call):
+        tgt = _call_desc(node.func)
+        if tgt is None:
+            return
+        rec = {"t": tgt, "ln": node.lineno}
+        if self.lock_stack:
+            rec["locks"] = [dict(d) for d in self.lock_stack]
+        kwargs = {kw.arg for kw in node.keywords if kw.arg}
+        if kwargs:
+            rec["kw"] = sorted(kwargs)
+        # literal facts the classifier needs: sleep(0) is a GIL yield,
+        # acquire(False)/acquire(timeout=0) is a trylock
+        lits = []
+        for a in node.args:
+            if isinstance(a, ast.Constant) and isinstance(
+                    a.value, (int, float, bool)):
+                lits.append(a.value)
+            else:
+                lits.append(None)
+        zero_kw = any(
+            kw.arg in ("timeout", "blocking")
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value in (0, 0.0, False)
+            for kw in node.keywords)
+        if (lits and lits[0] in (0, 0.0, False)) or zero_kw:
+            rec["zero"] = True
+        rec["nargs"] = len(node.args)
+        # callable-looking arguments: references a higher-order callee
+        # might invoke (lambdas get pseudo-ids; named refs stay symbolic)
+        fnargs = []
+        for idx, a in enumerate(node.args):
+            if isinstance(a, ast.Lambda):
+                fnargs.append({"k": "name", "n": self._lambda(a), "i": idx})
+            elif isinstance(a, (ast.Name, ast.Attribute)):
+                d = _value_desc(a)
+                if d is not None:
+                    d = dict(d)
+                    d["i"] = idx
+                    fnargs.append(d)
+        if fnargs:
+            rec["args"] = fnargs
+        self.info["calls"].append(rec)
+
+    def _lambda(self, node: ast.Lambda) -> str:
+        """Register a lambda as a pseudo-function; returns its local name."""
+        name = f"<lambda:{node.lineno}>"
+        sub = _FuncExtractor(self.s, f"{self.func_id}.{name}", self.cls)
+        sub.info["line"] = node.lineno
+        sub.visit(node.body)
+        self.s.funcs[sub.func_id] = sub.info
+        self.info["defines"][name] = sub.func_id
+        return name
+
+    # ------------------------------------------------------------- traversal
+
+    def visit_Call(self, node: ast.Call):
+        self._add_call(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._lambda(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        sub_id = f"{self.func_id}.{node.name}"
+        sub = _FuncExtractor(self.s, sub_id, self.cls)
+        sub.info["line"] = node.lineno
+        for stmt in node.body:
+            sub.visit(stmt)
+        sub.info["defines"].setdefault("__parent__", self.func_id)
+        self.s.funcs[sub_id] = sub.info
+        self.info["defines"][node.name] = sub_id
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        return  # a nested class is out of closure scope
+
+    def visit_With(self, node: ast.With):
+        entered = 0
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self._add_call(item.context_expr)
+                continue
+            d = _value_desc(item.context_expr)
+            if d is not None:
+                d = dict(d)
+                d["ln"] = item.context_expr.lineno
+                self.lock_stack.append(d)
+                entered += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Return(self, node: ast.Return):
+        v = node.value
+        if isinstance(v, ast.Name) and v.id == "self":
+            self.info["returns"].append({"k": "self"})
+        elif v is not None:
+            d = _value_desc(v)
+            if d is not None:
+                self.info["returns"].append(d)
+        self.generic_visit(node)
+
+
+class _FileSummary:
+    """JSON-ready facts about one source file."""
+
+    def __init__(self, path: str, module: str):
+        self.path = path
+        self.module = module
+        self.imports: Dict[str, str] = {}     # alias -> dotted module
+        self.from_imports: Dict[str, str] = {}  # name -> "module:attr"
+        self.funcs: Dict[str, dict] = {}      # func_id tail -> info
+        self.classes: Dict[str, dict] = {}    # ClassName -> info
+        self.globals: Dict[str, dict] = {}    # module var -> type desc
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "module": self.module,
+                "imports": self.imports, "from_imports": self.from_imports,
+                "funcs": self.funcs, "classes": self.classes,
+                "globals": self.globals}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "_FileSummary":
+        s = cls(d["path"], d["module"])
+        s.imports = d["imports"]
+        s.from_imports = d["from_imports"]
+        s.funcs = d["funcs"]
+        s.classes = d["classes"]
+        s.globals = d["globals"]
+        return s
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root) if root else os.path.basename(path)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.replace("\\", "/").split("/") if p not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or os.path.basename(path)
+
+
+def _resolve_relative(module: str, level: int, target: str) -> str:
+    base = module.split(".")
+    # `from . import x` inside pkg/mod.py: level 1 strips the module leaf
+    base = base[:len(base) - level]
+    return ".".join(base + ([target] if target else []))
+
+
+def extract_file(path: str, source: str, root: str = "") -> dict:
+    """One file's summary (JSON-ready); a syntax error yields an empty
+    summary — KTPU000 already reports it."""
+    module = _module_name(path, root)
+    s = _FileSummary(path, module)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return s.to_json()
+
+    def do_func(node, cls: Optional[str], prefix: str):
+        fid = f"{prefix}{node.name}"
+        ex = _FuncExtractor(s, fid, cls)
+        ex.info["line"] = node.lineno
+        ex.info["params"] = {
+            a.arg: _ann_str(a.annotation)
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs)
+            if a.arg != "self"}
+        ex.info["rann"] = _ann_str(node.returns)
+        for stmt in node.body:
+            ex.visit(stmt)
+        s.funcs[fid] = ex.info
+        return fid
+
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                s.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:
+                mod = _resolve_relative(module, node.level, mod)
+            for alias in node.names:
+                s.from_imports[alias.asname or alias.name] = \
+                    f"{mod}:{alias.name}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            do_func(node, None, "")
+        elif isinstance(node, ast.ClassDef):
+            cinfo = {"bases": [".".join(_dotted(b)) for b in node.bases
+                               if _dotted(b)],
+                     "methods": {}, "attrs": {}, "line": node.lineno}
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fid = do_func(sub, node.name, f"{node.name}.")
+                    cinfo["methods"][sub.name] = fid
+                    if sub.name in ("__init__", "__post_init__"):
+                        _ctor_attrs(sub, cinfo["attrs"])
+                elif isinstance(sub, ast.AnnAssign) and isinstance(
+                        sub.target, ast.Name):
+                    ann = _ann_str(sub.annotation)
+                    if ann:
+                        cinfo["attrs"].setdefault(
+                            sub.target.id, {"k": "ann", "t": ann})
+            s.classes[node.name] = cinfo
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            desc = None
+            if isinstance(node, ast.AnnAssign):
+                ann = _ann_str(node.annotation)
+                if ann:
+                    desc = {"k": "ann", "t": ann}
+            if desc is None and node.value is not None:
+                v = _value_desc(node.value)
+                if v is not None and v["k"] == "call":
+                    desc = v
+                    if isinstance(node.value, ast.Call):
+                        desc = dict(v)
+                        nm = _first_str_arg(node.value)
+                        if nm:
+                            desc["nm"] = nm
+            if desc is not None:
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        s.globals[tgt.id] = desc
+    return s.to_json()
+
+
+def _first_str_arg(call: ast.Call) -> str:
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return ""
+
+
+def _ctor_attrs(fn: ast.AST, out: Dict[str, dict]):
+    """self.X = <expr> assigns in a ctor: the self-attr type facts."""
+    for node in ast.walk(fn):
+        targets = []
+        ann = ""
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+            ann = _ann_str(node.annotation)
+        else:
+            continue
+        for tgt in targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if ann:
+                out.setdefault(tgt.attr, {"k": "ann", "t": ann})
+                continue
+            if value is None:
+                continue
+            desc = _value_desc(value)
+            if desc is None:
+                continue
+            if desc["k"] == "call" and isinstance(value, ast.Call):
+                desc = dict(desc)
+                nm = _first_str_arg(value)
+                if nm:
+                    desc["nm"] = nm
+            out.setdefault(tgt.attr, desc)
+
+
+# ----------------------------------------------------------------- the graph
+
+
+class CallGraph:
+    """Link-time resolution over a set of file summaries."""
+
+    def __init__(self, summaries: Dict[str, dict]):
+        # path -> summary dict
+        self.files = summaries
+        self.modules: Dict[str, dict] = {}
+        self.sources: Dict[str, List[str]] = {}
+        # "module:Class" -> class info;  func id "module:qual" -> info
+        self.classes: Dict[str, dict] = {}
+        self.funcs: Dict[str, dict] = {}
+        self.func_path: Dict[str, str] = {}
+        self.method_index: Dict[str, List[str]] = {}
+        for path, s in summaries.items():
+            mod = s["module"]
+            self.modules[mod] = s
+            for cname, cinfo in s["classes"].items():
+                self.classes[f"{mod}:{cname}"] = cinfo
+                for mname in cinfo["methods"]:
+                    self.method_index.setdefault(mname, []).append(
+                        f"{mod}:{cname}")
+            for fid, finfo in s["funcs"].items():
+                self.funcs[f"{mod}:{fid}"] = finfo
+                self.func_path[f"{mod}:{fid}"] = path
+        self._rt_memo: Dict[str, Optional[str]] = {}
+        self._attr_memo: Dict[Tuple[str, str], Optional[dict]] = {}
+        self._edges_memo: Dict[str, list] = {}
+        self._lock_blocks_memo: Dict[str, bool] = {}
+
+    # ------------------------------------------------------------ name lookup
+
+    def _module_symbol(self, mod: str, name: str,
+                       depth: int = 0) -> Optional[str]:
+        """Resolve a bare name in a module to 'module:func',
+        'class:module:Cls', 'mod:module', or None."""
+        if depth > 8:
+            return None
+        s = self.modules.get(mod)
+        if s is None:
+            return None
+        if name in s["funcs"] and "." not in name:
+            return f"{mod}:{name}"
+        if name in s["classes"]:
+            return f"class:{mod}:{name}"
+        if name in s["from_imports"]:
+            src, attr = s["from_imports"][name].split(":", 1)
+            if src in self.modules:
+                got = self._module_symbol(src, attr, depth + 1)
+                if got:
+                    return got
+            # `from a import b` where a.b is itself a module
+            if f"{src}.{attr}" in self.modules:
+                return f"mod:{src}.{attr}"
+            return None
+        if name in s["imports"]:
+            target = s["imports"][name]
+            return f"mod:{target}" if target in self.modules else None
+        return None
+
+    def _resolve_dotted(self, mod: str, parts: Sequence[str]) -> Optional[str]:
+        """['eventloop','shared_loop'] in some module -> symbol id."""
+        if not parts:
+            return None
+        head = self._module_symbol(mod, parts[0])
+        rest = list(parts[1:])
+        while head and rest:
+            if head.startswith("mod:"):
+                head = self._module_symbol(head[4:], rest.pop(0))
+            elif head.startswith("class:"):
+                cid = head[6:]
+                m = self._class_method(cid, rest.pop(0))
+                head = m
+            else:
+                return None
+        return head
+
+    def _class_method(self, class_id: str, name: str) -> Optional[str]:
+        """Method lookup with project-resolved inheritance."""
+        seen: Set[str] = set()
+        stack = [class_id]
+        while stack:
+            cid = stack.pop(0)
+            if cid in seen:
+                continue
+            seen.add(cid)
+            cinfo = self.classes.get(cid)
+            if cinfo is None:
+                continue
+            mod = cid.split(":", 1)[0]
+            if name in cinfo["methods"]:
+                return f"{mod}:{cinfo['methods'][name]}"
+            for base in cinfo["bases"]:
+                b = self._resolve_dotted(mod, base.split("."))
+                if b and b.startswith("class:"):
+                    stack.append(b[6:])
+        return None
+
+    # ------------------------------------------------------------------ types
+
+    def _type_from_ann(self, mod: str, ann: str) -> Optional[str]:
+        if not ann:
+            return None
+        sym = self._resolve_dotted(mod, ann.split("."))
+        if sym and sym.startswith("class:"):
+            return sym[6:]
+        tag = _CTOR_TYPES.get(tuple(ann.split(".")[-2:]))
+        return tag
+
+    def return_type(self, func_id: str, depth: int = 0) -> Optional[str]:
+        """'module:Class' / '@tag' a call of func_id evaluates to."""
+        if depth > 8 or func_id not in self.funcs:
+            return None
+        if func_id in self._rt_memo:
+            return self._rt_memo[func_id]
+        self._rt_memo[func_id] = None  # cycle guard
+        info = self.funcs[func_id]
+        mod, qual = func_id.split(":", 1)
+        out: Optional[str] = None
+        ann = info.get("rann", "")
+        if ann and ann not in ("None",):
+            out = self._type_from_ann(mod, ann)
+        if out is None:
+            for r in info.get("returns", []):
+                if r["k"] == "self" and "." in qual:
+                    out = f"{mod}:{qual.split('.')[0]}"
+                elif r["k"] == "call":
+                    got = self._resolve_value(mod, qual, None, r)
+                    if got:
+                        out = got
+                elif r["k"] == "name":
+                    g = self.modules[mod]["globals"].get(r["n"]) \
+                        if mod in self.modules else None
+                    if g:
+                        out = self._global_type(mod, g)
+                if out:
+                    break
+        self._rt_memo[func_id] = out
+        return out
+
+    def _global_type(self, mod: str, desc: dict) -> Optional[str]:
+        if desc["k"] == "ann":
+            return self._type_from_ann(mod, desc["t"])
+        if desc["k"] == "call":
+            return self._call_value_type(mod, "", None, desc["f"], desc)
+        return None
+
+    def attr_type(self, class_id: str, attr: str,
+                  depth: int = 0) -> Optional[dict]:
+        """{'t': 'module:Class'|'@tag', 'nm': lock-class-name?} for
+        self.<attr> of class_id, walking bases; None when unknown."""
+        key = (class_id, attr)
+        if key in self._attr_memo:
+            return self._attr_memo[key]
+        self._attr_memo[key] = None  # cycle guard
+        out = self._attr_type_uncached(class_id, attr, depth)
+        self._attr_memo[key] = out
+        return out
+
+    def _attr_type_uncached(self, class_id: str, attr: str,
+                            depth: int) -> Optional[dict]:
+        if depth > 8:
+            return None
+        seen: Set[str] = set()
+        stack = [class_id]
+        while stack:
+            cid = stack.pop(0)
+            if cid in seen:
+                continue
+            seen.add(cid)
+            cinfo = self.classes.get(cid)
+            if cinfo is None:
+                continue
+            mod = cid.split(":", 1)[0]
+            desc = cinfo["attrs"].get(attr)
+            if desc is not None:
+                return self._attr_desc_type(mod, cid, desc, depth)
+            for base in cinfo["bases"]:
+                b = self._resolve_dotted(mod, base.split("."))
+                if b and b.startswith("class:"):
+                    stack.append(b[6:])
+        return None
+
+    def _attr_desc_type(self, mod: str, class_id: str, desc: dict,
+                        depth: int) -> Optional[dict]:
+        k = desc["k"]
+        if k == "ann":
+            t = self._type_from_ann(mod, desc["t"])
+            return {"t": t} if t else None
+        if k == "call":
+            ctor = desc["f"]
+            t = self._call_value_type(mod, class_id.split(":", 1)[1] + ".__init__",
+                                      class_id, ctor, desc, depth)
+            if t:
+                out = {"t": t}
+                if desc.get("nm"):
+                    out["nm"] = desc["nm"]
+                return out
+            return None
+        if k == "name":
+            # self.X = param  -> the ctor param's annotation
+            init = self._class_method(class_id, "__init__")
+            if init:
+                ann = self.funcs[init].get("params", {}).get(desc["n"], "")
+                t = self._type_from_ann(mod, ann)
+                if t:
+                    return {"t": t}
+            g = self.modules[mod]["globals"].get(desc["n"]) \
+                if mod in self.modules else None
+            if g:
+                t = self._global_type(mod, g)
+                if t:
+                    return {"t": t}
+            return None
+        if k == "dotted":
+            # self.X = param.attr  -> attr type of the param's class
+            p = desc["p"]
+            init = self._class_method(class_id, "__init__")
+            if init and len(p) == 2:
+                ann = self.funcs[init].get("params", {}).get(p[0], "")
+                t = self._type_from_ann(mod, ann)
+                if t and not t.startswith("@"):
+                    return self.attr_type(t, p[1], depth + 1)
+            return None
+        if k == "selfattr":
+            return self.attr_type(class_id, desc["a"], depth + 1)
+        return None
+
+    def _call_value_type(self, mod: str, scope_qual: str,
+                         class_id: Optional[str], tgt: dict,
+                         full_desc: Optional[dict] = None,
+                         depth: int = 0) -> Optional[str]:
+        """Type a call expression evaluates to (ctor or factory)."""
+        if depth > 8:
+            return None
+        k = tgt["k"]
+        if k == "name":
+            name = tgt["n"]
+            if name in _LOCK_FACTORIES:
+                return _LOCK_FACTORIES[name]
+            sym = self._module_symbol(mod, name)
+            if sym is None:
+                return None
+            if sym.startswith("class:"):
+                return sym[6:]
+            if not sym.startswith("mod:"):
+                return self.return_type(sym, depth + 1)
+            return None
+        if k == "dotted":
+            p = tgt["p"]
+            if p[-1] in _LOCK_FACTORIES:
+                return _LOCK_FACTORIES[p[-1]]
+            tag = _CTOR_TYPES.get(tuple(p[-2:]))
+            if tag:
+                return tag
+            sym = self._resolve_dotted(mod, p)
+            if sym is None:
+                return None
+            if sym.startswith("class:"):
+                return sym[6:]
+            if not sym.startswith("mod:"):
+                return self.return_type(sym, depth + 1)
+            return None
+        if k in ("selfmeth", "selfattrmeth") and class_id:
+            if k == "selfmeth":
+                m = self._class_method(class_id, tgt["m"])
+                return self.return_type(m, depth + 1) if m else None
+            at = self.attr_type(class_id, tgt["a"], depth + 1)
+            if at and not at["t"].startswith("@"):
+                m = self._class_method(at["t"], tgt["m"])
+                return self.return_type(m, depth + 1) if m else None
+            return None
+        if k == "varattr":
+            return None
+        return None
+
+    # -------------------------------------------------------- call resolution
+
+    def _owner_class(self, func_id: str) -> Optional[str]:
+        mod, qual = func_id.split(":", 1)
+        head = qual.split(".")[0]
+        if f"{mod}:{head}" in self.classes:
+            return f"{mod}:{head}"
+        return None
+
+    def _local_define(self, func_id: str, name: str) -> Optional[str]:
+        """A nested def / lambda visible from func_id (own or parent's)."""
+        mod = func_id.split(":", 1)[0]
+        cur: Optional[str] = func_id
+        for _ in range(6):
+            if cur is None or cur not in self.funcs:
+                return None
+            defines = self.funcs[cur].get("defines", {})
+            if name in defines:
+                return f"{mod}:{defines[name]}"
+            parent = defines.get("__parent__")
+            cur = f"{mod}:{parent}" if parent else None
+        return None
+
+    def _receiver_type(self, func_id: str, call: dict) -> Optional[dict]:
+        """Type facts for the receiver of an attribute call."""
+        tgt = call["t"]
+        cls = self._owner_class(func_id)
+        mod = func_id.split(":", 1)[0]
+        if tgt["k"] == "selfattrmeth" and cls:
+            return self.attr_type(cls, tgt["a"])
+        if tgt["k"] == "dotted" and len(tgt["p"]) == 2:
+            base = tgt["p"][0]
+            # a param with an annotation, in this or an enclosing scope
+            cur: Optional[str] = func_id
+            for _ in range(6):
+                if cur is None or cur not in self.funcs:
+                    break
+                ann = self.funcs[cur].get("params", {}).get(base, "")
+                if ann:
+                    t = self._type_from_ann(mod, ann)
+                    if t:
+                        return {"t": t}
+                    break
+                parent = self.funcs[cur].get("defines", {}).get("__parent__")
+                cur = f"{mod}:{parent}" if parent else None
+            g = self.modules[mod]["globals"].get(base) \
+                if mod in self.modules else None
+            if g:
+                t = self._global_type(mod, g)
+                if t:
+                    return {"t": t}
+        return None
+
+    def resolve_call(self, func_id: str, call: dict) -> Optional[str]:
+        """The project function a call record targets, or None."""
+        tgt = call["t"]
+        k = tgt["k"]
+        mod = func_id.split(":", 1)[0]
+        cls = self._owner_class(func_id)
+        if k == "name":
+            local = self._local_define(func_id, tgt["n"])
+            if local:
+                return local
+            sym = self._module_symbol(mod, tgt["n"])
+            if sym is None:
+                return None
+            if sym.startswith("class:"):
+                return self._class_method(sym[6:], "__init__")
+            if sym.startswith("mod:"):
+                return None
+            return sym
+        if k == "selfmeth" and cls:
+            return self._class_method(cls, tgt["m"])
+        if k in ("selfattrmeth", "dotted"):
+            meth = tgt.get("m") or tgt["p"][-1]
+            rt = self._receiver_type(func_id, call)
+            if rt and not rt["t"].startswith("@"):
+                return self._class_method(rt["t"], meth)
+            if rt:  # builtin-tagged receiver: no project callee
+                return None
+            if k == "dotted":
+                sym = self._resolve_dotted(mod, tgt["p"])
+                if sym:
+                    if sym.startswith("class:"):
+                        return self._class_method(sym[6:], "__init__")
+                    if sym.startswith("mod:"):
+                        return None
+                    return sym
+            # unique-method-name devirtualization: resolve iff exactly one
+            # project class defines the name (conservative power for the
+            # dynamic-dispatch calls typing can't place)
+            meth = tgt.get("m") or (tgt["p"][-1] if k == "dotted" else "")
+            owners = self.method_index.get(meth, [])
+            if len(owners) == 1:
+                return self._class_method(owners[0], meth)
+            return None
+        if k == "deepattr":
+            owners = self.method_index.get(tgt["m"], [])
+            if len(owners) == 1:
+                return self._class_method(owners[0], tgt["m"])
+        return None
+
+    # --------------------------------------------------------- classification
+
+    def classify_blocking(self, func_id: str,
+                          call: dict) -> Optional[Tuple[str, str, dict]]:
+        """(kind, label, extra) when this call is a blocking primitive."""
+        tgt = call["t"]
+        k = tgt["k"]
+        mod = func_id.split(":", 1)[0]
+        if k == "dotted":
+            p = tuple(tgt["p"])
+            hit = _BLOCKING_DOTTED.get(p) or _BLOCKING_DOTTED.get(p[-2:]) \
+                or _BLOCKING_DOTTED.get(p[-3:])
+            if hit:
+                kind, label = hit
+                if kind == "sleep" and call.get("zero"):
+                    return None  # sleep(0) is a GIL yield, not a stall
+                # `import time as t` style aliases resolve the same way;
+                # a LOCAL symbol shadowing the stdlib name does not
+                if self._module_symbol(mod, p[0]) is None:
+                    return kind, label, {}
+        meth = tgt.get("m") or (tgt["p"][-1] if k == "dotted" and
+                                len(tgt["p"]) > 1 else "")
+        rt = self._receiver_type(func_id, call)
+        rtag = rt["t"] if rt else ""
+        if meth in _SOCKET_METHODS:
+            base = tgt.get("a") or (tgt["p"][0] if k == "dotted" else "")
+            if rtag == "@socket" or "sock" in base.lower():
+                return "socket", f"{base or 'socket'}.{meth}", {}
+        if meth == "get" and rtag == "@queue" and not call.get("zero"):
+            return "queue", "queue.get", {}
+        if meth == "wait" and rtag in ("@event", "@cond"):
+            recv = tgt.get("a") or ".".join(tgt.get("p", [])[:-1])
+            return "wait", f"{recv}.wait", {"recv": recv}
+        if meth == "result" and call.get("nargs", 0) == 0 \
+                and "timeout" not in call.get("kw", []):
+            base = tgt.get("a") or (tgt["p"][0] if k == "dotted" else "")
+            if rtag == "@future" or "future" in base.lower() \
+                    or "fut" == base.lower():
+                return "future", f"{base}.result", {}
+        if meth == "join" and (rtag == "@thread" or any(
+                t in (tgt.get("a") or "").lower()
+                for t in ("thread", "worker", "proc"))):
+            return "wait", f"{tgt.get('a', '')}.join", {}
+        if meth == "acquire" and rtag in ("@lock", "@cond") \
+                and not call.get("zero"):
+            return "lock", f"{tgt.get('a', meth)}.acquire", \
+                {"lock": (rt or {}).get("nm", "")}
+        if meth == "fsync":
+            return "io", "fsync", {}
+        # client/retry entry points sleep between attempts by design
+        callee = self.resolve_call(func_id, call)
+        if callee and callee.startswith(f"{_RETRY_MODULE}:"):
+            if callee.split(":", 1)[1] in _RETRY_ENTRIES:
+                return "retry", callee.split(":", 1)[1], {}
+        return None
+
+    def lock_context(self, func_id: str, call: dict) -> List[dict]:
+        """The locksan locks held at this call site (resolved from the
+        recorded with-context stack): [{'nm': class-name, 'desc':...}]."""
+        out = []
+        cls = self._owner_class(func_id)
+        for d in call.get("locks", []):
+            t = None
+            if d["k"] == "selfattr" and cls:
+                t = self.attr_type(cls, d["a"])
+            elif d["k"] == "name":
+                mod = func_id.split(":", 1)[0]
+                g = self.modules[mod]["globals"].get(d["n"]) \
+                    if mod in self.modules else None
+                if g is not None:
+                    tt = self._global_type(mod, g)
+                    if tt:
+                        t = {"t": tt, "nm": g.get("nm", "")}
+            if t and t["t"] in ("@lock", "@cond"):
+                # locks outside the locksan factories carry no name: give
+                # them a stable synthetic identity (owner.attr) so region
+                # analysis still groups their critical sections
+                nm = t.get("nm", "")
+                if not nm:
+                    if d["k"] == "selfattr" and cls:
+                        mod_cls = cls.replace(":", ".").split(".")
+                        nm = f"{mod_cls[-2]}.{mod_cls[-1]}.{d['a']}"
+                    elif d["k"] == "name":
+                        mod = func_id.split(":", 1)[0]
+                        nm = f"{mod.split('.')[-1]}.{d['n']}"
+                out.append({"nm": nm, "desc": d, "kind": t["t"]})
+        return out
+
+    def with_lock_acquires(self, func_id: str) -> List[dict]:
+        """Lock acquisitions implied by with-blocks in this function:
+        one record per (lock, first line it appears on)."""
+        seen: Set[Tuple[str, int]] = set()
+        out = []
+        for call in self.funcs.get(func_id, {}).get("calls", []):
+            for lk in self.lock_context(func_id, call):
+                key = (lk["desc"].get("ln", call["ln"]),
+                       json.dumps(lk["desc"], sort_keys=True))
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append({"ln": lk["desc"].get("ln", call["ln"]),
+                            "nm": lk["nm"]})
+        return out
+
+    # ------------------------------------------------------------------ edges
+
+    def _is_sink(self, func_id: str, call: dict) -> bool:
+        """True when the call hands work elsewhere: its callable args are
+        NOT invoked on this thread (shared_pool submission, loop
+        scheduling, thread construction)."""
+        tgt = call["t"]
+        meth = tgt.get("m") or (tgt["p"][-1] if tgt["k"] == "dotted"
+                                and len(tgt["p"]) > 1 else "")
+        if meth in _REGISTRARS and self._registrar_ok(func_id, call, meth):
+            return True
+        if meth == "submit":
+            rt = self._receiver_type(func_id, call)
+            base = tgt.get("a") or (tgt["p"][0] if tgt["k"] == "dotted"
+                                    else "")
+            if (rt and rt["t"].endswith(":WorkerPool")) \
+                    or "pool" in base.lower():
+                return True
+        if tgt["k"] == "dotted" and tuple(tgt["p"][-2:]) == \
+                ("threading", "Thread"):
+            return True
+        if tgt["k"] == "name" and tgt["n"] == "Thread":
+            return True
+        return False
+
+    def _registrar_ok(self, func_id: str, call: dict, meth: str) -> bool:
+        """register/modify are only loop registrars on loop-shaped
+        receivers; the distinctive names qualify on any receiver."""
+        if meth not in _LOOPISH_ONLY:
+            return True
+        tgt = call["t"]
+        base = (tgt.get("a")
+                or (tgt["p"][-2] if tgt["k"] == "dotted"
+                    and len(tgt["p"]) > 1 else ""))
+        rt = self._receiver_type(func_id, call)
+        if rt and rt["t"].endswith(":EventLoop"):
+            return True
+        return "loop" in (base or "").lower()
+
+    def edges(self, func_id: str) -> List[Tuple[str, int, str]]:
+        """(callee_id, line, label) edges out of func_id: resolved call
+        targets plus callable ARGUMENTS of non-sink calls (a higher-order
+        callee may invoke them on this thread)."""
+        if func_id in self._edges_memo:
+            return self._edges_memo[func_id]
+        out: List[Tuple[str, int, str]] = []
+        info = self.funcs.get(func_id, {})
+        for call in info.get("calls", []):
+            sink = self._is_sink(func_id, call)
+            if not sink:
+                callee = self.resolve_call(func_id, call)
+                if callee and callee != func_id \
+                        and not _exempt_module(callee.split(":", 1)[0]):
+                    out.append((callee, call["ln"], _label(call)))
+                for arg in call.get("args", []):
+                    ref = self._ref_function(func_id, arg)
+                    if ref and ref != func_id \
+                            and not _exempt_module(ref.split(":", 1)[0]):
+                        out.append((ref, call["ln"], _label(call) + "(arg)"))
+        self._edges_memo[func_id] = out
+        return out
+
+    def _ref_function(self, func_id: str, desc: dict) -> Optional[str]:
+        """A function REFERENCE descriptor (callback arg) -> func id."""
+        k = desc["k"]
+        cls = self._owner_class(func_id)
+        if k == "name":
+            local = self._local_define(func_id, desc["n"])
+            if local:
+                return local
+            sym = self._module_symbol(func_id.split(":", 1)[0], desc["n"])
+            if sym and not sym.startswith(("mod:", "class:")):
+                return sym
+            return None
+        if k == "selfattr" and cls:
+            return self._class_method(cls, desc["a"])
+        if k == "dotted" and len(desc["p"]) == 2:
+            fake_call = {"t": {"k": "dotted", "p": desc["p"]}, "ln": 0}
+            rt = self._receiver_type(func_id, fake_call)
+            if rt and not rt["t"].startswith("@"):
+                return self._class_method(rt["t"], desc["p"][1])
+        return None
+
+    # ------------------------------------------------------- dispatcher roots
+
+    def dispatcher_roots(self) -> List[Tuple[str, str]]:
+        """[(func_id, registration description)] — the code the
+        dispatcher (or a notify hook under an owner's lock) runs."""
+        roots: List[Tuple[str, str]] = []
+        seen: Set[str] = set()
+
+        def add(fid: Optional[str], why: str):
+            if fid and fid in self.funcs and fid not in seen:
+                seen.add(fid)
+                roots.append((fid, why))
+
+        for fid, info in self.funcs.items():
+            path = self.func_path.get(fid, "")
+            for call in info.get("calls", []):
+                tgt = call["t"]
+                meth = tgt.get("m") or (
+                    tgt["p"][-1] if tgt["k"] == "dotted"
+                    and len(tgt["p"]) > 1 else
+                    (tgt.get("n", "") if tgt["k"] == "name" else ""))
+                if meth not in _REGISTRARS:
+                    continue
+                if not self._registrar_ok(fid, call, meth):
+                    continue
+                want = _REGISTRARS[meth]
+                for arg in call.get("args", []):
+                    if arg.get("i") != want:
+                        continue
+                    add(self._ref_function(fid, arg),
+                        f"{meth}() at {os.path.basename(path)}:{call['ln']}")
+        # the non-blocking cursor contract: every implementation runs
+        # either on the dispatcher (drain) or under an owner's commit
+        # lock (notify install/fire)
+        for mname in _CONTRACT_ROOTS:
+            for cid in self.method_index.get(mname, []):
+                m = self._class_method(cid, mname)
+                add(m, f"non-blocking cursor contract ({mname})")
+        return roots
+
+    # ------------------------------------------------------- lock-class facts
+
+    def lock_class_blocks(self, lock_nm: str) -> bool:
+        """Does ANY critical section of this lock class (by locksan
+        factory name, anywhere in the tree) reach a non-lock blocking
+        primitive?  If not, the lock is a bounded leaf — acquiring it on
+        the dispatcher is sanctioned (loopsan polices contention at
+        runtime)."""
+        if not lock_nm:
+            return True  # unresolvable lock class: stay conservative
+        if lock_nm in self._lock_blocks_memo:
+            return self._lock_blocks_memo[lock_nm]
+        self._lock_blocks_memo[lock_nm] = False  # cycle guard
+        blocks = False
+        for fid, info in self.funcs.items():
+            for call in info.get("calls", []):
+                if not any(lk["nm"] == lock_nm
+                           for lk in self.lock_context(fid, call)):
+                    continue
+                if self.classify_blocking(fid, call) is not None \
+                        and self.classify_blocking(fid, call)[0] != "lock":
+                    blocks = True
+                    break
+                if self._is_sink(fid, call):
+                    continue
+                callee = self.resolve_call(fid, call)
+                if callee and not _exempt_module(callee.split(":", 1)[0]) \
+                        and self._reaches_blocking(callee) is not None:
+                    blocks = True
+                    break
+            if blocks:
+                break
+        self._lock_blocks_memo[lock_nm] = blocks
+        return blocks
+
+    # ------------------------------------------------------------ reachability
+
+    def _local_blocking(self, func_id: str,
+                        skip_lock: bool = True) -> List[Tuple[int, str, str]]:
+        if _exempt_module(func_id.split(":", 1)[0]):
+            return []
+        out = []
+        info = self.funcs.get(func_id, {})
+        for call in info.get("calls", []):
+            hit = self.classify_blocking(func_id, call)
+            if hit is None:
+                continue
+            kind, label, extra = hit
+            if kind == "lock" and skip_lock:
+                continue
+            if kind == "wait" and extra.get("recv"):
+                # cond.wait on a HELD condition releases it while waiting
+                if any(lk["desc"].get("a") == extra["recv"]
+                       or lk["desc"].get("n") == extra["recv"]
+                       for lk in self.lock_context(func_id, call)):
+                    continue
+            out.append((call["ln"], kind, label))
+        return out
+
+    def _reaches_blocking(self, start: str,
+                          max_depth: int = MAX_DEPTH) -> Optional[list]:
+        """Shortest chain [(func_id, line, kind, label)] from ``start``
+        to a non-lock blocking primitive, or None.  Memo-free BFS —
+        callers that sweep many starts share work via _edges_memo."""
+        seen = {start}
+        q: List[Tuple[str, list]] = [(start, [])]
+        while q:
+            fid, chain = q.pop(0)
+            if len(chain) > max_depth:
+                continue
+            local = self._local_blocking(fid)
+            if local:
+                ln, kind, label = local[0]
+                return chain + [(fid, ln, kind, label)]
+            for callee, ln, label in self.edges(fid):
+                if callee not in seen:
+                    seen.add(callee)
+                    q.append((callee, chain + [(fid, ln, label)]))
+        return None
+
+    # ---------------------------------------------------------------- passes
+
+    def ktpu016(self) -> List[Finding]:
+        """Blocking primitives reachable from dispatcher-run code."""
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, int, str]] = set()
+        # one shared BFS over all roots: parent pointers give the chain
+        parent: Dict[str, Tuple[Optional[str], str]] = {}
+        q: List[Tuple[str, int]] = []
+        for fid, why in self.dispatcher_roots():
+            if fid not in parent:
+                parent[fid] = (None, why)
+                q.append((fid, 0))
+        while q:
+            fid, depth = q.pop(0)
+            if depth > MAX_DEPTH:
+                continue
+            path = self.func_path.get(fid, "")
+            chain = self._chain_str(fid, parent)
+            root_why = self._root_why(fid, parent)
+            for ln, kind, label in self._local_blocking(fid,
+                                                        skip_lock=False):
+                if kind == "lock":
+                    continue  # with-block acquires handled below
+                key = (path, ln, kind)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    path, ln, "KTPU016",
+                    f"blocking {kind} call ({label}) on the shared "
+                    f"dispatcher: reachable via {chain} (root registered "
+                    f"by {root_why}) — blocking work goes through "
+                    f"eventloop.shared_pool(); schedule a non-blocking "
+                    f"continuation with call_soon instead"))
+            for acq in self.with_lock_acquires(fid):
+                if not self.lock_class_blocks(acq["nm"]):
+                    continue
+                key = (path, acq["ln"], "lock")
+                if key in reported:
+                    continue
+                reported.add(key)
+                nm = acq["nm"] or "<unnamed lock>"
+                findings.append(Finding(
+                    path, acq["ln"], "KTPU016",
+                    f"dispatcher-reachable acquire of lock class {nm!r} "
+                    f"whose critical sections can block (via {chain}; "
+                    f"root registered by {root_why}) — a blocked holder "
+                    f"stalls every connection on the loop; shrink that "
+                    f"lock's critical sections or hand this step to "
+                    f"shared_pool()"))
+            for callee, ln, label in self.edges(fid):
+                if callee not in parent:
+                    parent[callee] = (fid, label)
+                    q.append((callee, depth + 1))
+        return findings
+
+    def _chain_str(self, fid: str,
+                   parent: Dict[str, Tuple[Optional[str], str]]) -> str:
+        names = []
+        cur: Optional[str] = fid
+        for _ in range(MAX_DEPTH + 2):
+            if cur is None:
+                break
+            names.append(_short(cur))
+            cur = parent.get(cur, (None, ""))[0]
+        return " <- ".join(names)
+
+    def _root_why(self, fid: str,
+                  parent: Dict[str, Tuple[Optional[str], str]]) -> str:
+        cur = fid
+        for _ in range(MAX_DEPTH + 2):
+            up, why = parent.get(cur, (None, "?"))
+            if up is None:
+                return why
+            cur = up
+        return "?"
+
+    def ktpu017(self) -> List[Finding]:
+        """Locks held across call chains that reach blocking primitives
+        (the interprocedural upgrade of KTPU002 — the direct same-block
+        case stays KTPU002's)."""
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, int]] = set()
+        for fid, info in self.funcs.items():
+            path = self.func_path.get(fid, "")
+            for call in info.get("calls", []):
+                locks = self.lock_context(fid, call)
+                if not locks:
+                    continue
+                if self._is_sink(fid, call):
+                    continue
+                if self.classify_blocking(fid, call) is not None:
+                    continue  # the direct case: KTPU002's finding
+                callee = self.resolve_call(fid, call)
+                if callee is None or _exempt_module(callee.split(":", 1)[0]):
+                    continue
+                chain = self._reaches_blocking(callee)
+                if chain is None:
+                    continue
+                key = (path, call["ln"])
+                if key in reported:
+                    continue
+                reported.add(key)
+                held = ", ".join(sorted(lk["nm"] or "<unnamed>"
+                                        for lk in locks))
+                last = chain[-1]
+                hops = " -> ".join([_short(fid)]
+                                   + [_short(c[0]) for c in chain])
+                findings.append(Finding(
+                    path, call["ln"], "KTPU017",
+                    f"lock {held} held across a call chain that blocks: "
+                    f"{hops} reaches {last[3]} ({last[2]}, "
+                    f"{_short(last[0])}:{last[1]}) — every thread needing "
+                    f"the lock convoys behind this call; release first, "
+                    f"or move the blocking step outside the critical "
+                    f"section"))
+        return findings
+
+
+def _short(func_id: str) -> str:
+    mod, qual = func_id.split(":", 1)
+    return f"{mod.split('.')[-1]}.{qual}"
+
+
+def _label(call: dict) -> str:
+    tgt = call["t"]
+    k = tgt["k"]
+    if k == "name":
+        return tgt["n"]
+    if k == "dotted":
+        return ".".join(tgt["p"])
+    if k == "selfmeth":
+        return f"self.{tgt['m']}"
+    if k == "selfattrmeth":
+        return f"self.{tgt['a']}.{tgt['m']}"
+    return tgt.get("m", "?")
+
+
+# -------------------------------------------------------------------- caching
+
+
+def _cache_path(repo_root: str) -> str:
+    return os.path.join(repo_root, CACHE_DIR, CACHE_FILE)
+
+
+def _load_cache(repo_root: str) -> dict:
+    try:
+        with open(_cache_path(repo_root), encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != SUMMARY_VERSION:
+            return {}
+        return data.get("files", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_cache(repo_root: str, files: dict):
+    path = _cache_path(repo_root)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": SUMMARY_VERSION, "files": files}, f)
+        os.replace(tmp, path)  # atomic: concurrent gates never read torn JSON
+    except OSError:
+        return  # cache is an optimization; a read-only checkout still lints
+
+
+def _sha(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+
+def build_summaries(paths: Sequence[str], repo_root: str,
+                    use_cache: bool = True) -> Dict[str, dict]:
+    """path -> summary for every file, via the content-hash cache."""
+    cached = _load_cache(repo_root) if use_cache else {}
+    out: Dict[str, dict] = {}
+    fresh: Dict[str, dict] = {}
+    dirty = False
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        h = _sha(source)
+        rel = os.path.relpath(path, repo_root)
+        ent = cached.get(rel)
+        if ent is not None and ent.get("hash") == h:
+            out[path] = ent["summary"]
+            out[path]["path"] = path  # checkout may have moved
+        else:
+            summary = extract_file(path, source, repo_root)
+            out[path] = summary
+            dirty = True
+        fresh[rel] = {"hash": h, "summary": out[path]}
+    if use_cache and (dirty or set(fresh) != set(cached)):
+        _save_cache(repo_root, fresh)
+    return out
+
+
+# ----------------------------------------------------------------- entrypoints
+
+
+def graph_roots(repo_root: str) -> List[str]:
+    """The closure tree: the package, the linter, and the scripts (the
+    scripts define dispatcher callbacks too, and resolution must see
+    every edge even though findings stay scoped to the gate paths)."""
+    return [p for p in (os.path.join(repo_root, "kubernetes1_tpu"),
+                        os.path.join(repo_root, "tools"),
+                        os.path.join(repo_root, "scripts"))
+            if os.path.isdir(p)]
+
+
+def _filter_pragmas(findings: List[Finding],
+                    lines_of: Dict[str, List[str]]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        lines = lines_of.get(f.path, [])
+        idx = f.line - 1
+        text = lines[idx] if 0 <= idx < len(lines) else ""
+        ids = suppressed_ids(text)
+        if f.pass_id in ids or "*" in ids:
+            continue
+        kept.append(f)
+    return kept
+
+
+def analyze_summaries(summaries: Dict[str, dict],
+                      scope: Optional[Set[str]] = None,
+                      raw: bool = False) -> List[Finding]:
+    graph = CallGraph(summaries)
+    findings = graph.ktpu016() + graph.ktpu017()
+    if scope is not None:
+        findings = [f for f in findings if f.path in scope]
+    if not raw:
+        lines_of: Dict[str, List[str]] = {}
+        for f in findings:
+            if f.path not in lines_of:
+                try:
+                    with open(f.path, encoding="utf-8") as fh:
+                        lines_of[f.path] = fh.read().splitlines()
+                except OSError:
+                    lines_of[f.path] = []
+        findings = _filter_pragmas(findings, lines_of)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings
+
+
+def analyze_paths(scope_paths: Sequence[str], repo_root: str,
+                  use_cache: bool = True, raw: bool = False) -> List[Finding]:
+    """KTPU016/017 over the project: graph built from the full closure
+    tree (plus any scope files outside it), findings scoped to
+    ``scope_paths``."""
+    scope_files = set(walk_py_files(list(scope_paths)))
+    graph_files = walk_py_files(graph_roots(repo_root))
+    all_files = list(dict.fromkeys(graph_files + sorted(scope_files)))
+    summaries = build_summaries(all_files, repo_root, use_cache=use_cache)
+    return analyze_summaries(summaries, scope=scope_files, raw=raw)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    raw: bool = False) -> List[Finding]:
+    """Single-file / in-memory entry point (unit tests, lint_file): the
+    graph is exactly the given sources — interprocedural within them."""
+    summaries = {path: extract_file(path, src, "")
+                 for path, src in sources.items()}
+    findings = analyze_summaries(summaries, scope=set(sources), raw=True)
+    if not raw:
+        findings = _filter_pragmas(
+            findings, {p: s.splitlines() for p, s in sources.items()})
+    return findings

@@ -90,6 +90,10 @@ RULES: Dict[str, str] = {
                "section",
     "KTPU015": "thread construction in an event-loop-served module — "
                "register with the shared dispatcher instead",
+    "KTPU016": "blocking primitive transitively reachable from code the "
+               "shared dispatcher runs (call-graph pass)",
+    "KTPU017": "lock held across a call chain that reaches a blocking "
+               "primitive — KTPU002, interprocedural (call-graph pass)",
 }
 
 
@@ -134,8 +138,12 @@ def bare_pragmas(lines: Sequence[str], path: str) -> List[Finding]:
     return out
 
 
-def lint_file(path: str, source: str = None,
-              only: Sequence[str] = ()) -> List[Finding]:
+def lint_file(path: str, source: str = None, only: Sequence[str] = (),
+              callgraph: bool = True) -> List[Finding]:
+    """Lint one file.  The interprocedural passes (KTPU016/017) see only
+    this file's code when invoked here — lint_paths runs them over the
+    whole closure tree instead and passes callgraph=False to its per-file
+    workers so findings never double-report."""
     if source is None:
         with open(path, encoding="utf-8") as f:
             source = f.read()
@@ -150,8 +158,6 @@ def lint_file(path: str, source: str = None,
         findings.extend(fn(ctx))
     # filter on the FINDING id, not the registry key: one registered pass
     # may emit several ids (the lock pass emits KTPU001/002/006)
-    if only:
-        findings = [f for f in findings if f.pass_id in only]
     kept = []
     for f in findings:
         idx = f.line - 1
@@ -160,6 +166,12 @@ def lint_file(path: str, source: str = None,
         if f.pass_id in ids or "*" in ids:
             continue
         kept.append(f)
+    if callgraph:
+        from . import callgraph as _cg  # deferred: callgraph imports engine
+
+        kept.extend(_cg.analyze_sources({path: source}))
+    if only:
+        kept = [f for f in kept if f.pass_id in only]
     if not only or "KTPU010" in only:
         kept.extend(bare_pragmas(ctx.lines, path))
     kept.sort(key=lambda f: (f.path, f.line, f.pass_id))
@@ -186,17 +198,27 @@ def walk_py_files(paths: Sequence[str]) -> List[str]:
 
 
 def _lint_one(args: Tuple[str, Sequence[str]]) -> List[Finding]:
-    """Module-level worker (picklable) for the process pool."""
+    """Module-level worker (picklable) for the process pool.  Call-graph
+    passes are disabled per worker: the parent runs them once over the
+    whole tree (a per-file run would see a file's graph in isolation)."""
     path, only = args
-    return lint_file(path, only=only)
+    return lint_file(path, only=only, callgraph=False)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 
 def lint_paths(paths: Sequence[str], only: Sequence[str] = (),
-               jobs: int = 1) -> List[Finding]:
+               jobs: int = 1, use_cache: bool = True) -> List[Finding]:
     """Lint every .py file under the given files/directories.  With
     jobs > 1, files fan out over a process pool; results are stitched
     back in file order, so output is byte-identical to a serial run
-    (the gate's wall time is the point, not its ordering)."""
+    (the gate's wall time is the point, not its ordering).  The
+    interprocedural passes run ONCE in the parent over the full closure
+    tree (content-hash cached; use_cache=False bypasses), findings
+    scoped to the requested paths and merged in file order."""
     files = walk_py_files(paths)
     findings: List[Finding] = []
     if jobs > 1 and len(files) > 1:
@@ -206,9 +228,19 @@ def lint_paths(paths: Sequence[str], only: Sequence[str] = (),
             for result in pool.map(_lint_one, [(p, tuple(only))
                                                for p in files]):
                 findings.extend(result)
-        return findings
-    for path in files:
-        findings.extend(lint_file(path, only=only))
+    else:
+        for path in files:
+            findings.extend(lint_file(path, only=only, callgraph=False))
+    if not only or any(r in only for r in ("KTPU016", "KTPU017")):
+        from . import callgraph as _cg  # deferred: callgraph imports engine
+
+        cg = _cg.analyze_paths(paths, _repo_root(), use_cache=use_cache)
+        if only:
+            cg = [f for f in cg if f.pass_id in only]
+        findings.extend(cg)
+        order = {p: i for i, p in enumerate(files)}
+        findings.sort(key=lambda f: (order.get(f.path, len(order)),
+                                     f.line, f.pass_id))
     return findings
 
 
@@ -219,6 +251,94 @@ def default_gate_paths() -> List[str]:
         os.path.abspath(__file__))))
     return [os.path.join(repo, "kubernetes1_tpu"),
             os.path.join(repo, "tools")]
+
+
+def _pragma_sites(source: str) -> List[Tuple[int, Set[str]]]:
+    """(line number, suppressed ids) for every pragma in REAL comments.
+    Tokenizing (rather than regex over raw lines) keeps pragma syntax
+    quoted in docstrings and test fixture strings out of the results —
+    only a COMMENT token can suppress anything."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, Set[str]]] = []
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        for m in _PRAGMA_RE.finditer(tok.string):
+            ids: Set[str] = set()
+            for part in m.group(1).split(","):
+                part = part.strip().split()[0] if part.strip() else ""
+                if part:
+                    ids.add(part)
+            out.append((tok.start[0], ids))
+    return out
+
+
+def find_unused_pragmas(paths: Sequence[str],
+                        use_cache: bool = True) -> List[Finding]:
+    """Pragmas that no longer suppress any finding.  A pragma is a claim
+    ("this rule's premise doesn't hold here"); once the code moves on, a
+    stale pragma is a booby trap — it silently swallows the NEXT real
+    finding on that line.  Detection re-lints each file with pragma text
+    stripped from the line table (so passes that honor def-line pragmas
+    at generation time still produce their findings) and keeps a pragma
+    only if a matching raw finding lands on its line — or, for a def-line
+    pragma, anywhere in that def's span."""
+    files = walk_py_files(paths)
+    from . import callgraph as _cg  # deferred: callgraph imports engine
+
+    cg_by_file: Dict[str, List[Finding]] = {}
+    for f in _cg.analyze_paths(paths, _repo_root(), use_cache=use_cache,
+                               raw=True):
+        cg_by_file.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        lines = source.splitlines()
+        sites = _pragma_sites(source)
+        if not sites:
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # KTPU000 territory: pragma relevance is unknowable
+        stripped = [_PRAGMA_RE.sub("", t) for t in lines]
+        ctx = FileContext(path=path, source=source, tree=tree,
+                          lines=stripped)
+        raw: List[Finding] = []
+        for fn in _REGISTRY.values():
+            raw.extend(fn(ctx))
+        raw.extend(cg_by_file.get(path, []))
+        spans = {
+            node.lineno: getattr(node, "end_lineno", node.lineno)
+            for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for line_no, ids in sites:
+            end = spans.get(line_no, line_no)
+            hits = {f.pass_id for f in raw if line_no <= f.line <= end}
+            if "*" in ids:
+                if not hits:
+                    out.append(Finding(
+                        path, line_no, "UNUSED",
+                        "pragma ignore[*] suppresses nothing — delete it"))
+                continue
+            for pid in sorted(ids - hits):
+                out.append(Finding(
+                    path, line_no, "UNUSED",
+                    f"pragma id {pid} suppresses nothing here — delete it "
+                    f"(a stale pragma silently swallows the next real "
+                    f"finding on this line)"))
+    out.sort(key=lambda f: (f.path, f.line, f.message))
+    return out
 
 
 def load_baseline(path: str) -> List[Dict[str, object]]:
@@ -258,14 +378,15 @@ def diff_against_baseline(
 
 def run_gate(paths: Sequence[str] = (), rel_root: str = "",
              output: str = "text", baseline: Optional[str] = None,
-             jobs: int = 1) -> int:
+             jobs: int = 1, use_cache: bool = True) -> int:
     """Shared CLI body for scripts/lint.py and `python -m tools.ktpulint`:
     print findings (`file:line: PASS-ID message`, or a stable JSON list
     with --output json), optionally diffing against a baseline file so CI
     can fail only on NEW findings.  Returns the exit code."""
     import sys as _sys
 
-    findings = lint_paths(list(paths) or default_gate_paths(), jobs=jobs)
+    findings = lint_paths(list(paths) or default_gate_paths(), jobs=jobs,
+                          use_cache=use_cache)
     if baseline is not None:
         findings = diff_against_baseline(
             findings, load_baseline(baseline), rel_root)
@@ -290,9 +411,16 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
 
     p = argparse.ArgumentParser(
         prog="ktpulint",
-        description="project-specific static analysis (KTPU001-KTPU015)")
+        description="project-specific static analysis (KTPU001-KTPU017)")
     p.add_argument("paths", nargs="*",
                    help="files/directories (default: kubernetes1_tpu/ and tools/)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the call-graph summary cache "
+                        "(.ktpulint_cache/) and re-extract every file")
+    p.add_argument("--unused-pragmas", action="store_true",
+                   help="instead of linting, report ktpulint pragmas that "
+                        "no longer suppress any finding (default scope "
+                        "adds tests/ and scripts/, where pragmas also live)")
     p.add_argument("--output", choices=("text", "json"), default="text",
                    help="finding format; json is the stable CI/baseline schema "
                         "(rule, path, line, message)")
@@ -309,8 +437,25 @@ def main(argv: Sequence[str], rel_root: str = "") -> int:
         for rule_id in sorted(RULES):
             print(f"{rule_id}: {RULES[rule_id]}")
         return 0
+    if args.unused_pragmas:
+        import sys as _sys
+
+        scan = list(args.paths) or default_gate_paths() + [
+            os.path.join(_repo_root(), "tests"),
+            os.path.join(_repo_root(), "scripts")]
+        stale = find_unused_pragmas(scan, use_cache=not args.no_cache)
+        for f in stale:
+            path = os.path.relpath(f.path, rel_root) if rel_root else f.path
+            print(f"{path}:{f.line}: {f.message}")
+        if stale:
+            print(f"lint: {len(stale)} unused pragma id(s)",
+                  file=_sys.stderr)
+            return 1
+        print("lint: no unused pragmas", file=_sys.stderr)
+        return 0
     return run_gate(args.paths, rel_root=rel_root, output=args.output,
-                    baseline=args.baseline, jobs=max(args.jobs, 1))
+                    baseline=args.baseline, jobs=max(args.jobs, 1),
+                    use_cache=not args.no_cache)
 
 
 # importing the pass modules populates the registry
