@@ -54,8 +54,14 @@ Environment-variable table (the driver's knobs; defaults in parens):
                               replacement CREATED, not Running)
   BENCH_CHURN_WORKERS (1)     concurrent recycle threads (slot space
                               partitioned across them)
-  BENCH_SKIP_{GANG,CHURN,SCHED,SCHED1K,KUBEMARK,WORKLOAD,SCORECARD} (unset)
-                              1 = skip that phase
+  BENCH_SERVE_QPS (30)        serving phase: open-loop offered rate the
+                              generator holds through the L7 balancer
+  BENCH_SERVE_SECONDS (8)     serving phase: measured traffic duration
+  BENCH_SERVE_REPLICAS (3)    serving phase: Deployment replica count
+  BENCH_SERVE_ROLLOUT (1)     0 = skip the mid-traffic RollingUpdate
+                              (steady-state serving only)
+  BENCH_SKIP_{GANG,CHURN,SCHED,SCHED1K,KUBEMARK,WORKLOAD,SCORECARD,SERVE}
+                              (unset) 1 = skip that phase
   BENCH_SCORECARD_SEED (42)   cluster-life mixer seed (faults + placement)
   BENCH_KUBEMARK_NODES (200)  hollow-KUBELET count (full node loops;
                               distinct from the watcher swarm)
@@ -133,6 +139,13 @@ CHURN_SINGLETON = os.environ.get("BENCH_CHURN_SINGLETON", "") == "1"
 # create+delete capacity, not the kubelet restart pipeline's
 CHURN_WAIT_READY = os.environ.get("BENCH_CHURN_WAIT_READY", "1") == "1"
 CHURN_WORKERS = int(os.environ.get("BENCH_CHURN_WORKERS", "1"))
+# Serving data plane (PR 20): open-loop offered rate through the
+# least-inflight L7 balancer, replica count, and whether a RollingUpdate
+# is driven through the middle of the measured window.
+SERVE_QPS = float(os.environ.get("BENCH_SERVE_QPS", "30"))
+SERVE_SECONDS = float(os.environ.get("BENCH_SERVE_SECONDS", "8"))
+SERVE_REPLICAS = int(os.environ.get("BENCH_SERVE_REPLICAS", "3"))
+SERVE_ROLLOUT = os.environ.get("BENCH_SERVE_ROLLOUT", "1") == "1"
 
 
 def _pct(xs, q):
@@ -894,6 +907,193 @@ def bench_scorecard() -> dict:
     }
 
 
+def bench_serving() -> dict:
+    """Serving data plane (PR 20), three verdicts in one block:
+
+    - batching A/B: the same prompt set decoded sequentially (one request
+      at a time, the pre-PR20 server) vs through the continuous-batching
+      engine (concurrent submits folded into one forward per step) on the
+      tiny config — the claim is >= 2x tokens/s with batch occupancy > 1;
+    - routing A/B: least-inflight vs round-robin vs random against a
+      replica set with one deliberately slow member — least-inflight must
+      carry the best request p99 because it starves the slow replica;
+    - rollout e2e: BENCH_SERVE_REPLICAS synthetic backends behind the L7
+      balancer fed by Endpoints, BENCH_SERVE_QPS open-loop for
+      BENCH_SERVE_SECONDS, with (BENCH_SERVE_ROLLOUT=1) a RollingUpdate
+      driven mid-window — zero failed requests and the PDB Ready floor
+      held is the zero-downtime number the README quotes."""
+    import threading
+
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.client import InformerFactory
+    from kubernetes1_tpu.localcluster import LocalCluster
+    from kubernetes1_tpu.proxy import EndpointsBalancerSync, LeastInflightBalancer
+    from kubernetes1_tpu.workloads import llama
+    from kubernetes1_tpu.workloads.loadgen import LoadGen
+    from kubernetes1_tpu.workloads.servefleet import (
+        ServeFleet, SyntheticBackend, rolling_update, synthetic_factory)
+
+    out = {"qps": SERVE_QPS, "seconds": SERVE_SECONDS,
+           "replicas": SERVE_REPLICAS, "rollout_enabled": SERVE_ROLLOUT}
+
+    # ---- batching A/B (real jax decode, tiny config) ----
+    cfg = llama.tiny()
+    prompts = [[(i % 7) + 1, (i % 5) + 2] for i in range(16)]
+    max_new = 8
+    seq_srv = llama.DecodeServer(cfg=cfg, seed=3, batching=False)
+    bat_srv = llama.DecodeServer(cfg=cfg, seed=3, batching=True, slots=8)
+    try:
+        # warm every bucket the measured run will hit so neither leg
+        # pays XLA compiles inside its timing window
+        for srv in (seq_srv, bat_srv):
+            srv.warmup()
+            srv.generate(list(prompts[0]), max_new=max_new)
+        t0 = time.perf_counter()
+        for p in prompts:
+            seq_srv.generate(list(p), max_new=max_new)
+        seq_wall = time.perf_counter() - t0
+        eng = bat_srv.engine
+        steps0, toks0 = eng.steps, eng.tokens_out
+        threads = [threading.Thread(
+            target=bat_srv.generate, args=(list(p),),
+            kwargs={"max_new": max_new}) for p in prompts]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        bat_wall = time.perf_counter() - t0
+        total = len(prompts) * max_new
+        occupancy = ((eng.tokens_out - toks0) / (eng.steps - steps0)
+                     if eng.steps > steps0 else None)
+        tok_p99 = eng.token_latency.quantile(0.99)
+        out["batching_ab"] = {
+            "prompts": len(prompts), "max_new": max_new,
+            "slots": eng.slots,
+            "sequential_tokens_per_s": round(total / seq_wall, 1),
+            "batched_tokens_per_s": round(total / bat_wall, 1),
+            "speedup": round(seq_wall / bat_wall, 2),
+            "batch_occupancy": round(occupancy, 2)
+            if occupancy is not None else None,
+            "token_p99_s": round(tok_p99, 5)
+            if tok_p99 is not None else None,
+        }
+    finally:
+        seq_srv.stop()
+        bat_srv.stop()
+
+    # ---- routing A/B: one degraded replica, three policies ----
+    # the degraded member is slow AND capacity-limited (0.05s/token, 4
+    # slots ≈ 13 req/s) so a policy that keeps feeding it at qps/3
+    # builds a real queue there; least-inflight sees the queue as
+    # in-flight count and routes around it.  Fresh fleet per leg so one
+    # policy's backlog can't bleed into the next measurement.
+    routing = {}
+    for policy in ("least_inflight", "round_robin", "random"):
+        backends = [SyntheticBackend(token_delay_s=d, slots=sl).start()
+                    for d, sl in ((0.001, 8), (0.001, 8), (0.050, 4))]
+        bal = LeastInflightBalancer(seed=7, policy=policy)
+        try:
+            bal.set_backends([("127.0.0.1", b.port) for b in backends])
+            lg = LoadGen(bal.url, qps=80, arrival="poisson", seed=7,
+                         max_new=6, stream=True, max_inflight=64).start()
+            time.sleep(2.0)
+            lg.stop(drain_s=8.0)
+            s = lg.summary()
+            slow_share = (bal.stats()["backends"]
+                          [f"127.0.0.1:{backends[2].port}"]["requests"])
+            routing[policy] = {
+                "request_p99_s": s["request_p99_s"],
+                "acked": s["acked"], "failed": s["failed"],
+                "slow_replica_requests": slow_share,
+            }
+        finally:
+            bal.stop()
+            for b in backends:
+                b.stop()
+    out["routing_ab"] = routing
+
+    # ---- rollout e2e: open-loop traffic through the full path, on
+    # the sharded topology (the serving plane as a consumer of the
+    # horizontal control plane, not a single-shard special case) ----
+    app = "bench-serve"
+    cluster = LocalCluster(nodes=2, tpus_per_node=4, sched_shards=2,
+                           store_shards=2, apiservers=2).start()
+    cs = cluster.cs
+    factory = InformerFactory(cs)
+    fleet = bal = lg = None
+    try:
+        dep = t.Deployment()
+        dep.metadata.name = app
+        dep.spec.replicas = SERVE_REPLICAS
+        dep.spec.selector = t.LabelSelector(match_labels={"app": app})
+        dep.spec.template.metadata.labels = {"app": app}
+        c = t.Container(name="serve", image="llama-serve",
+                        command=["serve"])
+        c.resources.requests = {"cpu": "10m"}
+        dep.spec.template.spec.containers = [c]
+        cs.deployments.create(dep)
+        svc = t.Service()
+        svc.metadata.name = app
+        svc.spec.selector = {"app": app}
+        svc.spec.ports = [t.ServicePort(port=80)]
+        cs.services.create(svc, "default")
+        pdb = t.PodDisruptionBudget()
+        pdb.metadata.name = f"{app}-pdb"
+        pdb.spec.selector = t.LabelSelector(match_labels={"app": app})
+        pdb.spec.min_available = max(1, SERVE_REPLICAS - 1)
+        cs.poddisruptionbudgets.create(pdb, "default")
+
+        fleet = ServeFleet(cs, factory, app,
+                           backend_factory=synthetic_factory(
+                               token_delay_s=0.002, slots=8))
+        bal = LeastInflightBalancer(seed=0)
+        EndpointsBalancerSync(bal, factory, "default", app,
+                              resolver=fleet.resolver)
+        factory.start_all()
+        factory.wait_for_sync()
+        fleet.wait_backends(SERVE_REPLICAS, timeout=60)
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and len(bal.stats()["backends"]) < SERVE_REPLICAS):
+            time.sleep(0.05)
+
+        lg = LoadGen(bal.url, qps=SERVE_QPS, arrival="poisson", seed=1,
+                     stream=True).start()
+        ru = None
+        if SERVE_ROLLOUT:
+            time.sleep(max(1.0, SERVE_SECONDS / 3.0))
+            ru = rolling_update(cs, app, timeout=max(60.0, SERVE_SECONDS))
+            remain = SERVE_SECONDS - max(1.0, SERVE_SECONDS / 3.0) \
+                - ru["duration_s"]
+            if remain > 0:
+                time.sleep(remain)
+        else:
+            time.sleep(SERVE_SECONDS)
+        lg.stop(drain_s=10.0)
+        s = lg.summary()
+        out["traffic"] = {k: s[k] for k in (
+            "offered", "issued", "acked", "failed", "shed",
+            "offered_qps", "achieved_qps", "ttft_p50_s", "ttft_p99_s",
+            "token_p50_s", "token_p99_s", "request_p50_s",
+            "request_p99_s") if k in s}
+        out["balancer"] = {k: bal.stats()[k]
+                           for k in ("policy", "requests", "retries",
+                                     "errors")}
+        if ru is not None:
+            out["rollout"] = dict(ru)
+            out["rollout"]["failed_during_run"] = s["failed"]
+    finally:
+        if lg is not None:
+            lg.stop(drain_s=0.5)
+        if bal is not None:
+            bal.stop()
+        if fleet is not None:
+            fleet.stop()
+        cluster.stop()
+    return out
+
+
 def main():
     from kubernetes1_tpu.utils.benchstamp import contention_stamp
 
@@ -939,6 +1139,14 @@ def main():
             extras["scorecard"] = bench_scorecard()
         except Exception as e:  # noqa: BLE001
             extras["scorecard"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # serving data plane (PR 20): batching A/B, routing-policy A/B, and
+    # the mid-traffic RollingUpdate's zero-downtime verdict
+    if os.environ.get("BENCH_SKIP_SERVE", "") != "1":
+        try:
+            extras["serving"] = bench_serving()
+        except Exception as e:  # noqa: BLE001
+            extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
 
     # scheduler_perf analog (ref: 3k pods/100 nodes, 30k/1000 nodes);
     # contaminated runs are retried after a quiesce, not just stamped

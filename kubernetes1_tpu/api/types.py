@@ -912,6 +912,11 @@ class Service(KObject):
 class EndpointAddress:
     ip: str = ""
     node_name: str = ""
+    # the pod this address IS (real k8s: a full ObjectReference; the
+    # name suffices here).  In-process clusters assign every pod the
+    # loopback ip, so pod IDENTITY — not ip — is what an L7 resolver
+    # keys its backend registry on.
+    target_ref: str = ""
 
 
 @dataclass
@@ -924,6 +929,11 @@ class EndpointPort:
 @dataclass
 class EndpointSubset:
     addresses: List[EndpointAddress] = field(default_factory=list)
+    # matching pods that must NOT receive new traffic but may still be
+    # finishing in-flight work: terminating (deletion_timestamp set) or
+    # Running-but-not-Ready.  The explicit drain signal: an L7 balancer
+    # keeps their open responses alive while picking only `addresses`.
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
     ports: List[EndpointPort] = field(default_factory=list)
 
 

@@ -125,28 +125,46 @@ class EndpointsController(Controller):
             # selector-less service: endpoints are managed manually
             # (ref: endpoints_controller.go skips services w/o selector)
             return
-        ready_pods = [
+        selected = [
             p
             for p in self.pods.list()
             if p.metadata.namespace == svc.metadata.namespace
-            and not p.metadata.deletion_timestamp
             and match_labels(svc.spec.selector, p.metadata.labels)
             and p.status.phase == t.POD_RUNNING
+        ]
+        ready_pods = [
+            p
+            for p in selected
+            if not p.metadata.deletion_timestamp
             and any(
                 c.type == "Ready" and c.status == "True" for c in p.status.conditions
             )
         ]
+        # the DRAIN signal, made explicit: terminating or not-Ready pods
+        # leave `addresses` (no new traffic) but stay visible in
+        # `not_ready_addresses` so an L7 balancer can tell "draining"
+        # from "gone" and keep in-flight responses alive
+        ready_names = {p.metadata.name for p in ready_pods}
+        draining_pods = [p for p in selected
+                         if p.metadata.name not in ready_names]
         subset = t.EndpointSubset(
             addresses=[
-                t.EndpointAddress(ip=p.status.pod_ip or p.status.host_ip, node_name=p.spec.node_name)
+                t.EndpointAddress(ip=p.status.pod_ip or p.status.host_ip, node_name=p.spec.node_name,
+                                  target_ref=p.metadata.name)
                 for p in sorted(ready_pods, key=lambda p: p.metadata.name)
+            ],
+            not_ready_addresses=[
+                t.EndpointAddress(ip=p.status.pod_ip or p.status.host_ip, node_name=p.spec.node_name,
+                                  target_ref=p.metadata.name)
+                for p in sorted(draining_pods, key=lambda p: p.metadata.name)
             ],
             ports=[
                 t.EndpointPort(name=sp.name, port=sp.target_port or sp.port, protocol=sp.protocol)
                 for sp in svc.spec.ports
             ],
         )
-        eps = t.Endpoints(subsets=[subset] if subset.addresses else [])
+        eps = t.Endpoints(subsets=[subset] if subset.addresses
+                          or subset.not_ready_addresses else [])
         eps.metadata.name = svc.metadata.name
         eps.metadata.namespace = svc.metadata.namespace
         wrote = True

@@ -1,4 +1,6 @@
+from .balancer import EndpointsBalancerSync, LeastInflightBalancer
 from .proxier import Proxier
 from .rules import RuleTableProxier
 
-__all__ = ["Proxier", "RuleTableProxier"]
+__all__ = ["EndpointsBalancerSync", "LeastInflightBalancer", "Proxier",
+           "RuleTableProxier"]

@@ -121,11 +121,27 @@ Wired sites:
                                                  drop exercises each
                                                  manager's torn/absent-
                                                  state recovery)
-  proxy.upstream                                (proxy/proxier.py + ipvs.py:
-                                                 the backend dial behind a
-                                                 Service VIP — a drop is a
-                                                 dead endpoint the proxier
-                                                 must route around)
+  proxy.upstream                                (proxy/proxier.py + ipvs.py
+                                                 + balancer.py: the backend
+                                                 dial behind a Service VIP —
+                                                 a drop is a dead endpoint
+                                                 the proxier/balancer must
+                                                 route around)
+  proxy.upstream_send                           (proxy/balancer.py: the L7
+                                                 request-forward leg to a
+                                                 picked backend — checked
+                                                 via check_deferred on the
+                                                 shared dispatcher; a drop
+                                                 before any response byte
+                                                 is acked retries on a
+                                                 surviving backend, never
+                                                 a lost request)
+  loadgen.request                               (workloads/loadgen.py: one
+                                                 open-loop client request —
+                                                 a drop is a client-side
+                                                 failure the retry policy
+                                                 (client/retry) absorbs;
+                                                 arrivals never stall)
   dns.upstream                                  (dns/server.py _forward: the
                                                  recursive upstream hop —
                                                  FaultInjected ⊂ OSError ⇒
@@ -360,6 +376,28 @@ def check(site: str) -> None:
     if action == "delay":
         time.sleep(param or 0.0)
         return
+    raise FaultInjected(f"faultline[{site}]: injected {action}")
+
+
+def check_deferred(site: str) -> Optional[float]:
+    """``check()`` for event-loop callers: NEVER sleeps.  A delay
+    decision is RETURNED (seconds) for the caller to schedule
+    (``loop.call_later`` and resume); drop/error/sever/truncate raise
+    FaultInjected exactly like ``check()`` (there are no bytes to cut
+    at a gate, so the cutting actions degrade to drop).  Returns None
+    when no fault fires.  This is the variant dispatcher-run code uses
+    — a sleeping check on the shared loop would stall every connection
+    in the process (the KTPU016 invariant)."""
+    schedsan.preempt(site)
+    inj = _injector
+    if inj is None:
+        return None
+    d = inj.decide(site)
+    if d is None:
+        return None
+    action, param = d
+    if action == "delay":
+        return param or 0.0
     raise FaultInjected(f"faultline[{site}]: injected {action}")
 
 

@@ -275,20 +275,230 @@ def greedy_decode(cfg: LlamaConfig, params: Dict[str, Any], step_fn,
     return out
 
 
+# Sequence-length buckets for the batched forward: padding every step
+# to the next bucket bounds XLA retraces at one compile per bucket (the
+# batch dimension is always padded to the full slot count, so the shape
+# space is |buckets|, not |active lengths|).
+def _seq_bucket(n: int, max_seq: int) -> int:
+    b = 8
+    while b < n and b < max_seq:
+        b *= 2
+    return min(b, max_seq)
+
+
+class SlotLease:
+    """One admitted request's handle: a per-request token stream.  The
+    engine pushes each decoded token as its step completes; ``None``
+    terminates the stream (max_new reached or engine shutdown)."""
+
+    def __init__(self, tokens, max_new: int):
+        import queue as _queue
+
+        self.prompt = list(tokens)
+        self.max_new = max_new
+        self.out: "_queue.Queue[Optional[int]]" = _queue.Queue()
+        self.produced = 0
+        self.slot: Optional[int] = None  # assigned at admission
+        self.t_submit = 0.0
+        self.t_last = 0.0
+
+    def stream(self):
+        """Yield tokens as the engine produces them (blocks between
+        steps; ends at max_new)."""
+        while True:
+            tok = self.out.get()
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: float = 60.0) -> list:
+        """Drain the stream to a list (the non-streaming callers)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        toks = []
+        for tok in self.stream():
+            toks.append(tok)
+            if _time.monotonic() > deadline:
+                break
+        return toks
+
+
+class BatchEngine:
+    """Continuous batching: ONE decode loop folds every in-flight
+    request into a single forward per step, admitting new requests at
+    step boundaries.  Capacity is the fixed slot pool (the KV-cache
+    stand-in: a slot is the per-request state the batch carries), so
+    saturation is visible as slot exhaustion — `ktpu_llama_slots_used`
+    against `ktpu_llama_slots_total` — before it is visible as latency.
+
+    Correctness: rows are RIGHT-padded (real tokens first), positions
+    are arange, and attention is causal — so row i's logits at index
+    len_i-1 are bit-identical to an unpadded single-row forward, and
+    batched greedy decode equals sequential greedy decode token for
+    token (tests/test_serving.py proves it against greedy_decode)."""
+
+    def __init__(self, cfg: LlamaConfig, params, mesh, step_fn,
+                 slots: int = 8, metrics=None):
+        import threading as _threading
+
+        from ..utils import locksan
+
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self._step = step_fn
+        self.slots = slots
+        self._pending: list = []
+        self._active: Dict[int, SlotLease] = {}
+        self._cond = locksan.make_condition(name="BatchEngine._cond")
+        self._stopping = False
+        self.steps = 0
+        self.tokens_out = 0
+        self.metrics = metrics
+        if metrics is not None:
+            self.slots_total = metrics.gauge(
+                "ktpu_llama_slots_total", "decode batch slot pool size")
+            self.slots_used = metrics.gauge(
+                "ktpu_llama_slots_used", "decode batch slots leased")
+            self.occupancy = metrics.histogram(
+                "ktpu_llama_batch_occupancy",
+                "active requests per decode step")
+            self.token_latency = metrics.histogram(
+                "ktpu_llama_token_latency_seconds",
+                "per-token latency (inter-token gap; first = from admit)")
+            self.slots_total.set(float(slots))
+            self.slots_used.set(0.0)
+        # one engine thread per server, not per connection/request: the
+        # whole point is that N requests share this single decode loop
+        self._thread = _threading.Thread(
+            target=self._run, daemon=True, name="llama-batch-engine")
+        self._thread.start()
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, tokens, max_new: int = 8) -> SlotLease:
+        import time as _time
+
+        lease = SlotLease([int(x) % self.cfg.vocab for x in tokens] or [1],
+                          max_new)
+        lease.t_submit = lease.t_last = _time.monotonic()
+        with self._cond:
+            self._pending.append(lease)
+            self._cond.notify()
+        return lease
+
+    def stop(self):
+        with self._cond:
+            self._stopping = True
+            self._cond.notify()
+        self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ loop
+
+    def _admit_locked(self):
+        """Step-boundary admission: lease free slots to waiting
+        requests, FIFO."""
+        free = [s for s in range(self.slots) if s not in self._active]
+        while free and self._pending:
+            lease = self._pending.pop(0)
+            lease.slot = free.pop(0)
+            self._active[lease.slot] = lease
+
+    def _run(self):
+        import time as _time
+
+        import numpy as _np
+
+        from . import sharding as sh
+
+        while True:
+            with self._cond:
+                self._admit_locked()
+                while not self._active and not self._stopping:
+                    self._cond.wait(timeout=0.5)
+                    self._admit_locked()
+                if self._stopping:
+                    for lease in list(self._active.values()):
+                        lease.out.put(None)
+                    for lease in self._pending:
+                        lease.out.put(None)
+                    self._active.clear()
+                    self._pending.clear()
+                    return
+                batch = dict(self._active)
+            if self.metrics is not None:
+                self.slots_used.set(float(len(batch)))
+                self.occupancy.observe(float(len(batch)))
+            # one forward per step over every active row, right-padded
+            rows = {}
+            maxlen = 1
+            for slot, lease in batch.items():
+                toks = lease.prompt[-self.cfg.max_seq:]
+                rows[slot] = toks
+                maxlen = max(maxlen, len(toks))
+            bucket = _seq_bucket(maxlen, self.cfg.max_seq)
+            arr = _np.zeros((self.slots, bucket), _np.int32)
+            for slot, toks in rows.items():
+                arr[slot, :len(toks)] = toks
+            with sh.use_mesh(self.mesh):
+                logits = self._step(self.params, jnp.asarray(arr))
+                picks = jnp.argmax(
+                    logits[jnp.arange(self.slots),
+                           jnp.asarray([len(rows.get(s, [1])) - 1
+                                        for s in range(self.slots)])],
+                    axis=-1)
+            picks = _np.asarray(picks)
+            now = _time.monotonic()
+            self.steps += 1
+            done = []
+            for slot, lease in batch.items():
+                nxt = int(picks[slot])
+                lease.prompt.append(nxt)
+                lease.produced += 1
+                self.tokens_out += 1
+                if self.metrics is not None:
+                    self.token_latency.observe(now - lease.t_last)
+                    self.metrics.mark("ktpu_llama_tokens_per_s")
+                lease.t_last = now
+                lease.out.put(nxt)
+                if lease.produced >= lease.max_new:
+                    lease.out.put(None)
+                    done.append(slot)
+            if done:
+                with self._cond:
+                    for slot in done:
+                        self._active.pop(slot, None)
+                    self._cond.notify()
+
+
 class DecodeServer:
     """The llama serving half: an HTTP decode endpoint plus the pod
     /metrics surface the kubelet's scrape agent lifts into
     PodCustomMetrics (obs/appmetrics contract) — QPS, in-flight
-    requests, and request-latency histograms, the workload SLIs the
-    HPA's Pods-type metric specs scale a serving Deployment on.
+    requests, request-latency histograms, and (with batching) the
+    slot-pool saturation gauges, the workload SLIs the HPA's Pods-type
+    metric specs scale a serving Deployment on.
 
         POST /generate  {"tokens": [...], "max_new": N} -> {"tokens": [...]}
+                        {"stream": true} streams ndjson token lines
+                        ({"token": t} per decode step) over chunked
+                        transfer encoding instead
         GET  /metrics   prometheus text (appmetrics registry)
         GET  /healthz
+
+    ``batching=True`` (default; env KTPU_LLAMA_BATCHING=0 disables)
+    routes requests through the continuous-batching engine — N
+    concurrent requests share one forward per step.  ``batching=False``
+    keeps the sequential one-request-per-forward baseline, the A/B arm
+    the bench's tokens/s comparison runs against.
     """
 
     def __init__(self, cfg: Optional[LlamaConfig] = None, port: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, batching: Optional[bool] = None,
+                 slots: int = 8):
+        import os as _os
+
         from . import sharding as sh
         from ..obs.appmetrics import AppMetrics
 
@@ -307,6 +517,14 @@ class DecodeServer:
             "ktpu_llama_inflight", "decode requests currently in flight")
         self.latency = self.metrics.histogram(
             "ktpu_llama_request_latency_seconds", "decode request latency")
+        if batching is None:
+            batching = _os.environ.get("KTPU_LLAMA_BATCHING", "1") != "0"
+        self.batching = batching
+        self.engine: Optional[BatchEngine] = None
+        if batching:
+            self.engine = BatchEngine(self.cfg, self.params, self.mesh,
+                                      self._step, slots=slots,
+                                      metrics=self.metrics)
         self._port = port
         self._srv = None
 
@@ -318,6 +536,8 @@ class DecodeServer:
         t0 = _time.monotonic()
         self.inflight.inc()
         try:
+            if self.engine is not None:
+                return self.engine.submit(tokens, max_new).result()
             with sh.use_mesh(self.mesh):
                 return greedy_decode(self.cfg, self.params, self._step,
                                      tokens, max_new=max_new)
@@ -327,15 +547,27 @@ class DecodeServer:
             self.metrics.mark("ktpu_llama_qps")
             self.latency.observe(_time.monotonic() - t0)
 
+    def generate_stream(self, tokens, max_new: int = 8) -> SlotLease:
+        """Streaming entry: returns the lease whose .stream() yields
+        tokens at step cadence (batching only — the sequential arm has
+        no step boundary to stream at)."""
+        if self.engine is None:
+            raise RuntimeError("streaming requires batching=True")
+        return self.engine.submit(tokens, max_new)
+
     def warmup(self, tokens=(1, 2, 3), max_new: int = 4):
         """Pay the XLA compile for the given request shape OUTSIDE the
         SLI histograms: the first decode of each context length traces
         and compiles (seconds on CPU), and the latency histogram is
         cumulative — an un-warmed first request would sit in the p99
         for the process's whole life and fail any serving SLO judged
-        against it."""
+        against it.  With batching on, this pays the (slots, bucket)
+        batch shapes the engine will step through."""
         from . import sharding as sh
 
+        if self.engine is not None:
+            self.engine.submit(list(tokens), max_new).result()
+            return
         with sh.use_mesh(self.mesh):
             greedy_decode(self.cfg, self.params, self._step, list(tokens),
                           max_new=max_new)
@@ -382,12 +614,46 @@ class DecodeServer:
                         raise TypeError("body must be a JSON object")
                     toks = [int(x) for x in (req.get("tokens") or [])]
                     max_new = min(64, int(req.get("max_new") or 8))
+                    stream = bool(req.get("stream"))
                 except (ValueError, TypeError):
                     server.errors_total.inc()
                     self._send(400, b'{"error":"bad request"}')
                     return
+                if stream and server.engine is not None:
+                    self._stream(toks, max_new)
+                    return
                 out = server.generate(toks, max_new=max_new)
                 self._send(200, _json.dumps({"tokens": out}).encode())
+
+            def _stream(self, toks, max_new: int):
+                """Per-token streaming: one ndjson line per decode step
+                over chunked transfer encoding (self-delimiting, so the
+                byte-splicing proxy legs pass it through untouched)."""
+                import time as _time
+
+                t0 = _time.monotonic()
+                server.inflight.inc()
+                try:
+                    lease = server.generate_stream(toks, max_new=max_new)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def chunk(payload: bytes):
+                        self.wfile.write(b"%x\r\n%s\r\n"
+                                         % (len(payload), payload))
+
+                    for tok in lease.stream():
+                        chunk(b'{"token":%d}\n' % tok)
+                    chunk(b'{"done":true}\n')
+                    self.wfile.write(b"0\r\n\r\n")
+                finally:
+                    server.inflight.inc(-1)
+                    server.requests_total.inc()
+                    server.metrics.mark("ktpu_llama_qps")
+                    server.latency.observe(_time.monotonic() - t0)
 
         self._srv = ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
         self._srv.daemon_threads = True
@@ -409,6 +675,8 @@ class DecodeServer:
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()
+        if self.engine is not None:
+            self.engine.stop()
         self.metrics.stop()
 
 

@@ -1,0 +1,431 @@
+"""Serving backend fleet: the pod <-> live-backend bridge for the L7
+data plane.
+
+An in-process cluster's pod IPs are synthetic — no kernel, no netns, no
+process per pod — so "a Deployment of decode servers" needs a harness
+that makes each serving pod REAL on loopback: ``ServeFleet`` watches
+the app's pods and keeps exactly one live HTTP backend per Running pod,
+publishing the pod-NAME -> (host, port) registry the balancer's
+endpoints sync resolves through (the Endpoints addresses carry
+``targetRef`` = pod name precisely because every in-process pod shares
+the loopback pod IP; see `proxy.balancer.EndpointsBalancerSync`).
+
+Lifecycle mirrors the drain contract end to end:
+- pod reaches Running  -> backend starts, pod is annotated with the
+  obs.ktpu.io scrape contract at ITS OWN port (per-pod slot/QPS metrics
+  for the HPA, not one shared surface);
+- pod starts terminating -> nothing here: the endpoints controller
+  moves it to notReadyAddresses, the balancer stops picking it, and its
+  open responses keep streaming from the still-live backend;
+- pod object deleted -> the backend lingers ``linger_s`` (the tail of
+  any in-flight response), then stops.
+
+``SyntheticBackend`` is the tests/chaos stand-in: the DecodeServer's
+HTTP + streaming + metrics contract with a configurable per-token delay
+instead of a forward pass.  ``rolling_update`` drives a mid-traffic
+RollingUpdate of the serving Deployment and measures what the rollout
+did to the fleet (duration, peak unavailability) — the loadgen's
+failed-request count judged against it is the zero-downtime verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import types as t
+from ..client import retry as _retry
+from ..utils import locksan
+
+Addr = Tuple[str, int]
+
+
+class SyntheticBackend:
+    """DecodeServer's serving contract without the model: POST
+    /generate (buffered or ndjson streaming), GET /metrics with the
+    same ktpu_llama_* names (slot gauges included), GET /healthz.
+    ``token_delay_s`` shapes per-token pacing — a skewed replica in a
+    bench is just a backend with a bigger delay.  ``slots`` is real
+    capacity, same semantics as the BatchEngine pool: at most ``slots``
+    requests decode concurrently and the rest QUEUE, so an overloaded
+    replica shows up as growing latency (what least-inflight routes
+    around) instead of unbounded concurrency hiding the saturation."""
+
+    def __init__(self, token_delay_s: float = 0.002, slots: int = 8,
+                 seed: int = 0):
+        from ..obs.appmetrics import AppMetrics
+
+        self.token_delay_s = token_delay_s
+        self.slots = slots
+        self._stopping = False
+        self.metrics = AppMetrics()
+        self.requests_total = self.metrics.counter(
+            "ktpu_llama_requests_total", "requests served")
+        self.errors_total = self.metrics.counter(
+            "ktpu_llama_request_errors_total", "malformed requests")
+        self.inflight = self.metrics.gauge(
+            "ktpu_llama_inflight", "requests in flight")
+        self.latency = self.metrics.histogram(
+            "ktpu_llama_request_latency_seconds", "request latency")
+        self.slots_total = self.metrics.gauge(
+            "ktpu_llama_slots_total", "slot pool size")
+        self.slots_used = self.metrics.gauge(
+            "ktpu_llama_slots_used", "slots leased")
+        self.slots_total.set(float(slots))
+        self._active = 0
+        self._cond = locksan.make_condition(name="SyntheticBackend._cond")
+        self._srv = None
+
+    def start(self) -> "SyntheticBackend":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/metrics"):
+                    body = backend.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path.startswith("/healthz"):
+                    self._send(200, b'{"status":"ok"}')
+                else:
+                    self._send(404, b'{"error":"unknown path"}')
+
+            def do_POST(self):
+                if not self.path.startswith("/generate"):
+                    self._send(404, b'{"error":"unknown path"}')
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    toks = [int(x) for x in (req.get("tokens") or [1])]
+                    max_new = min(64, int(req.get("max_new") or 8))
+                    stream = bool(req.get("stream"))
+                except (ValueError, TypeError):
+                    backend.errors_total.inc()
+                    self._send(400, b'{"error":"bad request"}')
+                    return
+                t0 = time.monotonic()
+                backend.inflight.inc()
+                # slot admission: block (queue) until a slot frees — the
+                # per-handler thread is the queue entry, like a request
+                # parked at the engine's _pending list
+                with backend._cond:
+                    while (backend._active >= backend.slots
+                           and not backend._stopping):
+                        backend._cond.wait(timeout=0.5)
+                    if backend._stopping:
+                        backend.inflight.inc(-1)
+                        backend.errors_total.inc()
+                        self._send(503, b'{"error":"shutting down"}')
+                        return
+                    backend._active += 1
+                    backend.slots_used.set(float(backend._active))
+                try:
+                    out = [(sum(toks) + i) % 256 for i in range(max_new)]
+                    if stream:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+
+                        def chunk(payload: bytes):
+                            self.wfile.write(b"%x\r\n%s\r\n"
+                                             % (len(payload), payload))
+
+                        for tok in out:
+                            time.sleep(backend.token_delay_s)
+                            chunk(b'{"token":%d}\n' % tok)
+                        chunk(b'{"done":true}\n')
+                        self.wfile.write(b"0\r\n\r\n")
+                    else:
+                        time.sleep(backend.token_delay_s * max_new)
+                        self._send(200, json.dumps({"tokens": out}).encode())
+                finally:
+                    backend.inflight.inc(-1)
+                    with backend._cond:
+                        backend._active -= 1
+                        backend.slots_used.set(float(backend._active))
+                        backend._cond.notify()
+                    backend.requests_total.inc()
+                    backend.metrics.mark("ktpu_llama_qps")
+                    backend.metrics.mark("ktpu_llama_tokens_per_s", max_new)
+                    backend.latency.observe(time.monotonic() - t0)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        threading.Thread(target=self._srv.serve_forever, daemon=True,
+                         name="synthetic-backend").start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+        self.metrics.stop()
+
+
+def synthetic_factory(token_delay_s: float = 0.002, slots: int = 8):
+    """A ServeFleet backend factory of SyntheticBackends."""
+
+    def make(pod: t.Pod):
+        return SyntheticBackend(token_delay_s=token_delay_s,
+                                slots=slots).start()
+
+    return make
+
+
+class ServeFleet:
+    """One live backend per Running pod of ``app`` (see module
+    docstring).  ``backend_factory(pod)`` returns a started object with
+    ``.port`` and ``.stop()``; the default is a SyntheticBackend."""
+
+    def __init__(self, clientset, factory, app: str,
+                 backend_factory: Optional[Callable] = None,
+                 namespace: str = "default", linger_s: float = 0.5,
+                 annotate: bool = True):
+        self.cs = clientset
+        self.app = app
+        self.namespace = namespace
+        self.backend_factory = backend_factory or synthetic_factory()
+        self.linger_s = linger_s
+        self.annotate = annotate
+        self._lock = locksan.make_lock("ServeFleet._lock")
+        self._by_uid: Dict[str, object] = {}      # pod uid -> backend
+        # pod NAME -> (host, port): pod identity, not pod_ip — every
+        # in-process pod shares the loopback ip (see EndpointAddress
+        # .target_ref, which is what the balancer sync resolves with)
+        self._by_name: Dict[str, Addr] = {}
+        self._uid_name: Dict[str, str] = {}
+        self.started = 0
+        self.stopped = 0
+        # best-effort paths count their failures instead of hiding them
+        self.annotate_errors = 0
+        self.teardown_errors = 0
+        self._informer = factory.informer("pods")
+        self._informer.add_handler(
+            on_add=self._pod_event,
+            on_update=lambda _o, n: self._pod_event(n),
+            on_delete=self._pod_deleted,
+        )
+
+    # ----------------------------------------------------------- events
+
+    def _mine(self, pod: t.Pod) -> bool:
+        return (pod.metadata.namespace == self.namespace
+                and pod.metadata.labels.get("app") == self.app)
+
+    def _pod_event(self, pod: t.Pod):
+        if not self._mine(pod) or pod.status.phase != t.POD_RUNNING:
+            return
+        uid = pod.metadata.uid
+        with self._lock:
+            if uid in self._by_uid:
+                return
+            # reserve the slot under the lock; build outside it
+            self._by_uid[uid] = None
+        backend = self.backend_factory(pod)
+        name = pod.metadata.name
+        with self._lock:
+            self._by_uid[uid] = backend
+            self._by_name[name] = ("127.0.0.1", backend.port)
+            self._uid_name[uid] = name
+            self.started += 1
+        if self.annotate:
+            self._annotate_pod(pod, backend.port)
+
+    def _annotate_pod(self, pod: t.Pod, port: int):
+        """Point the kubelet's pod-scrape at THIS pod's own backend
+        metrics (per-pod slot saturation for the HPA)."""
+        from ..obs.appmetrics import scrape_annotations
+
+        def patch():
+            cur = self.cs.pods.get(pod.metadata.name, self.namespace)
+            cur.metadata.annotations = dict(cur.metadata.annotations or {})
+            cur.metadata.annotations.update(
+                scrape_annotations(port, host="127.0.0.1"))
+            self.cs.pods.update(cur)
+
+        try:
+            _retry.retry_on_conflict(patch)
+        except Exception:  # noqa: BLE001 — scrape annotation is best-effort; serving works without it
+            with self._lock:
+                self.annotate_errors += 1
+
+    def _pod_deleted(self, pod: t.Pod):
+        if not self._mine(pod):
+            return
+        uid = pod.metadata.uid
+        with self._lock:
+            backend = self._by_uid.pop(uid, None)
+            name = self._uid_name.pop(uid, None)
+            if name is not None:
+                self._by_name.pop(name, None)
+        if backend is None:
+            return
+
+        def stop_later():
+            # the drain tail: the balancer stopped picking this backend
+            # when it left Endpoints; give the last in-flight response
+            # its tail before tearing the socket down
+            time.sleep(self.linger_s)
+            try:
+                backend.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                with self._lock:
+                    self.teardown_errors += 1
+            with self._lock:
+                self.stopped += 1
+
+        threading.Thread(target=stop_later, name="servefleet-drain",
+                         daemon=True).start()
+
+    # ------------------------------------------------------------ lookup
+
+    def resolver(self, key: str, port: int) -> Optional[Addr]:
+        """EndpointsBalancerSync resolver: endpoint identity (the
+        address's targetRef, i.e. the pod NAME — falling back to the ip
+        when targetRef is empty) -> live loopback backend address
+        (None while the backend is still starting)."""
+        with self._lock:
+            return self._by_name.get(key)
+
+    def backends(self) -> List[Addr]:
+        with self._lock:
+            return list(self._by_name.values())
+
+    def wait_backends(self, want: int, timeout: float = 30.0) -> int:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                n = len(self._by_name)
+            if n >= want:
+                return n
+            time.sleep(0.05)
+        with self._lock:
+            return len(self._by_name)
+
+    def stop(self):
+        with self._lock:
+            backends = [b for b in self._by_uid.values() if b is not None]
+            self._by_uid.clear()
+            self._by_name.clear()
+            self._uid_name.clear()
+        for b in backends:
+            try:
+                b.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                with self._lock:
+                    self.teardown_errors += 1
+
+
+# ------------------------------------------------------------- rollout
+
+
+def rolling_update(cs, name: str, namespace: str = "default",
+                   mutate: Optional[Callable[[t.Deployment], None]] = None,
+                   timeout: float = 60.0, poll_s: float = 0.05) -> dict:
+    """Trigger a RollingUpdate of ``name`` (template bump; ``mutate``
+    customizes it) and watch it through: returns duration, the minimum
+    simultaneously-Ready count observed (the maxUnavailable floor the
+    PDB + rolling logic must hold), and the final replica state."""
+
+    def bump():
+        dep = cs.deployments.get(name, namespace)
+        ann = dict(dep.spec.template.metadata.annotations or {})
+        ann["ktpu.io/restartedAt"] = str(time.time())  # ktpulint: ignore[KTPU005] the annotation VALUE just needs to differ per rollout; wall time is the kubectl idiom
+        dep.spec.template.metadata.annotations = ann
+        if mutate is not None:
+            mutate(dep)
+        cs.deployments.update(dep)
+        return dep
+
+    old_pods, _ = cs.pods.list(namespace=namespace,
+                               label_selector=f"app={name}")
+    old_names = {p.metadata.name for p in old_pods}
+    # conflicts AND transient wire faults both retry: a rollout driven
+    # mid-chaos (cluster_life's conducted windows hit client.*) must not
+    # abort on one injected drop
+    dep = _retry.call_with_retries(
+        lambda: _retry.retry_on_conflict(bump), steps=5,
+        backoff=_retry.Backoff(base=0.05, cap=0.5),
+        reason="servefleet.rollout", classify=_retry.is_transient)
+    want = dep.spec.replicas
+    t0 = time.monotonic()
+    min_ready = want
+    done = False
+    poll_errors = 0
+    while time.monotonic() - t0 < timeout:
+        try:
+            pods, _ = cs.pods.list(namespace=namespace,
+                                   label_selector=f"app={name}")
+        except Exception:  # noqa: BLE001 — transient client fault: counted, next poll retries
+            poll_errors += 1
+            time.sleep(poll_s)  # ktpulint: ignore[KTPU013] fixed rollout poll cadence — the error is counted, the next deadline-bounded poll is the retry; backoff would skew min_ready sampling
+            continue
+        ready = [
+            p for p in pods
+            if p.status.phase == t.POD_RUNNING
+            and not p.metadata.deletion_timestamp
+            and any(c.type == "Ready" and c.status == "True"
+                    for c in p.status.conditions)
+        ]
+        min_ready = min(min_ready, len(ready))
+        # done = every Ready pod is a NEW pod and we have a full set —
+        # pod identity, not status counters: right after the bump the
+        # stale DeploymentStatus still reports updated==ready==want, so
+        # counter polling declares victory before the roll even starts
+        new_ready = [p for p in ready if p.metadata.name not in old_names]
+        if len(new_ready) >= want and len(ready) == len(new_ready):
+            try:
+                cur = cs.deployments.get(name, namespace)
+            except Exception:  # noqa: BLE001 — transient client fault: counted, next poll retries
+                poll_errors += 1
+                time.sleep(poll_s)  # ktpulint: ignore[KTPU013] fixed rollout poll cadence — counted error, next poll retries
+                continue
+            st = cur.status
+            if (st.updated_replicas >= want and st.ready_replicas >= want
+                    and st.replicas == want):
+                done = True
+                break
+        time.sleep(poll_s)  # ktpulint: ignore[KTPU013] fixed sampling cadence — min_ready_observed (the PDB-floor verdict) is sampled at this rate; jitter would thin the samples
+
+    return {
+        "completed": done,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "min_ready_observed": min_ready,
+        "replicas": want,
+        "poll_errors": poll_errors,
+    }

@@ -2008,6 +2008,177 @@ def run_obs_schedule(seed: int, duration: float = 6.0,
     return _finalize_verdict(verdict)
 
 
+# Serving data-plane schedule: faults on the proxy<->backend leg (both
+# the dial and the post-connect send gate) plus the loadgen's own
+# client-side site — low enough that the balancer's un-acked retry and
+# the loadgen's call_with_retries keep every request deliverable.  The
+# seeded failure is the mid-traffic backend KILL (crash + pod delete),
+# not the wire.
+SERVE_SPEC = (
+    "proxy.upstream=drop@0.04;"
+    "proxy.upstream_send=drop@0.04|delay:20ms@0.06;"
+    "loadgen.request=drop@0.03"
+)
+
+
+def run_serve_schedule(seed: int, duration: float = 6.0,
+                       spec: str = None) -> dict:
+    """Serving data plane under fire: a 3-replica serving Deployment
+    behind the least-inflight L7 balancer, open-loop load at 30 QPS
+    streaming per-token, faults on the proxy<->backend leg and the
+    client, and a mid-traffic backend KILL (the backend process crashes
+    while its pod is still in Endpoints, then the pod is deleted so the
+    ReplicaSet replaces it).
+
+    Verdict invariants:
+      - ZERO lost acked requests: the loadgen only acks a stream whose
+        terminal frame arrived, and the server-side ledger must have
+        served at least that many (the balancer never splices a second
+        backend onto a half-delivered response — an acked failure kills
+        the client connection so the client's retry is a FRESH request);
+      - zero client-visible failures: un-acked balancer retries plus
+        loadgen retries absorb both the wire faults and the kill;
+      - bounded tail: request p99 stays under 5s (well under the
+        loadgen's timeout — faults degrade latency, never wedge it);
+      - the balancer re-balances to survivors: acks keep flowing after
+        the kill, and the replacement pod's backend joins the set.
+    """
+    from kubernetes1_tpu.api import types as t
+    from kubernetes1_tpu.client import InformerFactory
+    from kubernetes1_tpu.localcluster import LocalCluster
+    from kubernetes1_tpu.proxy import (EndpointsBalancerSync,
+                                       LeastInflightBalancer)
+    from kubernetes1_tpu.utils import faultline
+    from kubernetes1_tpu.workloads.loadgen import LoadGen
+    from kubernetes1_tpu.workloads.servefleet import (ServeFleet,
+                                                      synthetic_factory)
+
+    spec = SERVE_SPEC if spec is None else spec
+    _begin_seed_run()
+    verdict = {"mode": "serve", "seed": seed, "spec": spec, "ok": False}
+    cluster = None
+    fleet = bal = lg = None
+    app = "chaos-serve"
+    try:
+        cluster = LocalCluster(nodes=2, tpus_per_node=4).start()
+        cs = cluster.cs
+        factory = InformerFactory(cs)
+        dep = t.Deployment()
+        dep.metadata.name = app
+        dep.spec.replicas = 3
+        dep.spec.selector = t.LabelSelector(match_labels={"app": app})
+        dep.spec.template.metadata.labels = {"app": app}
+        c = t.Container(name="serve", image="llama-serve",
+                        command=["serve"])
+        c.resources.requests = {"cpu": "10m"}
+        dep.spec.template.spec.containers = [c]
+        cs.deployments.create(dep)
+        svc = t.Service()
+        svc.metadata.name = app
+        svc.spec.selector = {"app": app}
+        svc.spec.ports = [t.ServicePort(port=80)]
+        cs.services.create(svc, "default")
+        fleet = ServeFleet(cs, factory, app,
+                           backend_factory=synthetic_factory(
+                               token_delay_s=0.002, slots=8))
+        bal = LeastInflightBalancer(seed=seed)
+        EndpointsBalancerSync(bal, factory, "default", app,
+                              resolver=fleet.resolver)
+        factory.start_all()
+        factory.wait_for_sync()
+        if fleet.wait_backends(3, timeout=30) < 3:
+            raise RuntimeError("serve chaos boot: fleet never came up")
+        t_bal = time.monotonic()
+        while len(bal.stats()["backends"]) < 3 \
+                and time.monotonic() - t_bal < 15.0:
+            time.sleep(0.05)
+        faultline.activate(seed, spec)
+        lg = LoadGen(bal.url, qps=30, stream=True, seed=seed,
+                     timeout=10.0).start()
+        killed = None
+        killed_at = None
+        killed_served = 0.0
+        first_ack_after_kill = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < max(duration, 4.0):
+            if killed is None and time.monotonic() - t0 > duration / 2:
+                # the seeded failure: one backend CRASHES mid-stream
+                # (pod still in Endpoints — the balancer must retry the
+                # refused dials onto survivors), then its pod is
+                # deleted so the ReplicaSet rolls a replacement in
+                pods, _ = cs.pods.list(namespace="default",
+                                       label_selector=f"app={app}")
+                running = sorted(
+                    (p for p in pods
+                     if p.status.phase == t.POD_RUNNING
+                     and not p.metadata.deletion_timestamp),
+                    key=lambda p: p.metadata.name)
+                victim = running[seed % len(running)]
+                backend = fleet._by_uid.get(victim.metadata.uid)
+                if backend is not None:
+                    backend.stop()
+                    # final ledger for the victim, captured before the
+                    # pod delete evicts it from the fleet registry
+                    killed_served = backend.requests_total.value
+                killed = victim.metadata.name
+                killed_at = time.monotonic()
+                pre_kill_acked = lg.acked
+                cs.pods.delete(killed, "default")
+            if killed is not None and first_ack_after_kill is None \
+                    and lg.acked > pre_kill_acked:
+                first_ack_after_kill = time.monotonic()
+            time.sleep(0.05)
+        verdict["injected"] = faultline.stats()
+        faultline.deactivate()
+        # faults lifted: let the replacement pod's backend join and the
+        # in-flight tail drain before judging
+        fleet.wait_backends(3, timeout=20)
+        lg.stop(drain_s=8.0)
+        s = lg.summary()
+        served = killed_served + sum(
+            b.requests_total.value
+            for b in fleet._by_uid.values() if b is not None)
+        # server-side ledger >= client acks (retries may duplicate
+        # server-side work; an acked-but-never-served request cannot)
+        lost_acked = max(0, s["acked"] - served) if served else 0
+        stats = bal.stats()
+        survivors_serving = len(stats["backends"]) >= 2
+        verdict.update({
+            "load": s,
+            "balancer": {k: stats[k] for k in
+                         ("requests", "retries", "errors")},
+            "killed_pod": killed,
+            "served_ledger": served,
+            "lost_acked": lost_acked,
+            "acked_after_kill": first_ack_after_kill is not None,
+            "backends_final": len(stats["backends"]),
+        })
+        verdict["acked"] = int(s["acked"])
+        verdict["recovery_s"] = round(
+            (first_ack_after_kill - killed_at), 3) \
+            if first_ack_after_kill is not None else 0.0
+        p99 = s["request_p99_s"] or 0.0
+        verdict["ok"] = (
+            s["acked"] > 30 and s["failed"] == 0 and lost_acked == 0
+            and killed is not None and first_ack_after_kill is not None
+            and survivors_serving and p99 < 5.0
+            and bool(verdict["injected"].get("proxy.upstream_send"))
+            and bool(verdict["injected"].get("loadgen.request")))
+    finally:
+        faultline.deactivate()
+        if lg is not None:
+            _stop_quietly_mod(lambda: lg.stop(drain_s=0.5))
+        if bal is not None:
+            _stop_quietly_mod(bal.stop)
+        if fleet is not None:
+            _stop_quietly_mod(fleet.stop)
+        if cluster is not None:
+            _stop_quietly_mod(cluster.stop)
+    verdict.setdefault("acked", 0)
+    verdict.setdefault("recovery_s", 0.0)
+    return _finalize_verdict(verdict)
+
+
 def run_life_schedule(seed: int, duration: float = 6.0,
                       spec: str = None) -> dict:
     """The everything-at-once mixer as a seeded chaos schedule: one
@@ -2065,7 +2236,7 @@ def main() -> int:
     ap.add_argument("--schedule", default="wire",
                     choices=("wire",) + NODE_MODES
                     + ("sched-shard", "store-shard", "obs", "churn",
-                       "race", "life", "node-all", "all"),
+                       "race", "life", "serve", "node-all", "all"),
                     help="which schedule to sweep: the control plane's wire "
                          "schedule (default), one node/slice failure mode, "
                          "sched-shard (mid-run scheduler kill + lease "
@@ -2082,7 +2253,11 @@ def main() -> int:
                          "not faultline), life (the everything-at-once "
                          "scripts/cluster_life.py mixer — serving + gang "
                          "+ churn + conducted fault windows + node kill, "
-                         "judged by its own SLO scorecard), node-all "
+                         "judged by its own SLO scorecard), serve (the "
+                         "L7 serving data plane — least-inflight "
+                         "balancer + open-loop load under proxy-leg "
+                         "faults + a mid-traffic backend kill; zero "
+                         "lost acked requests), node-all "
                          "(all three node modes), or all")
     ap.add_argument("--store-shards", type=int, default=2,
                     help="store-shard schedule: shard count")
@@ -2098,7 +2273,8 @@ def main() -> int:
     elif args.schedule == "all":
         schedules = ["wire"] + list(NODE_MODES) + ["sched-shard",
                                                    "store-shard", "obs",
-                                                   "churn", "race", "life"]
+                                                   "churn", "race", "life",
+                                                   "serve"]
     else:
         schedules = [args.schedule]
     verdicts = []
@@ -2131,6 +2307,9 @@ def main() -> int:
                 v = run_race_schedule(seed)
             elif schedule == "life":
                 v = run_life_schedule(seed, duration=args.duration)
+            elif schedule == "serve":
+                v = run_serve_schedule(seed, duration=args.duration,
+                                       spec=args.spec)
             else:
                 v = run_node_schedule(seed, mode=schedule,
                                       duration=args.duration, spec=args.spec,

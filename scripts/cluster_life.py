@@ -98,6 +98,7 @@ class LifeConfig:
     serve_impl: str = "decode"          # decode | synthetic
     serve_rate: float = 6.0             # open-loop requests/s
     serve_replicas: int = 2
+    serve_rollout: bool = True          # mid-mix RollingUpdate of the app
     hpa_max_replicas: int = 5
     hpa_target_qps: float = 3.0
     # training gang
@@ -119,6 +120,7 @@ class LifeConfig:
     gang_mttr_p99_s: float = 30.0
     churn_ops_floor: float = 0.2
     qps_floor: float = 0.2
+    rollout_errors_max: float = 0.0     # failed requests during rollout
     # evaluator cadence
     scorecard_interval: float = 0.25
     obs_interval: float = 0.25
@@ -155,6 +157,13 @@ def build_slos(cfg: LifeConfig) -> list:
             threshold=cfg.gang_mttr_p99_s, objective=0.6, reduce="max"),
         SLO(name="churn_ops", scenario="churn", source="fed", op=">=",
             threshold=cfg.churn_ops_floor, objective=0.8),
+        # fed by the mid-mix RollingUpdate driver: the loadgen's failed
+        # count across the rollout window.  Zero-downtime is the
+        # objective — unfed (rollout disabled or never completed) reads
+        # MISSING, never a free pass
+        SLO(name="serving_rollout_errors", scenario="serving",
+            source="fed", op="<=", threshold=cfg.rollout_errors_max,
+            objective=0.8),
         SLO(name="watch_lag", scenario="control-plane", source="fleet",
             metric="ktpu_informer_lag_seconds",
             labels={"quantile": "0.99"}, op="<=", threshold=watch_lag,
@@ -172,55 +181,53 @@ def build_slos(cfg: LifeConfig) -> list:
 
 class SyntheticServe:
     """Stand-in for the DecodeServer with the SAME metric names (the SLO
-    selectors must not care which implementation serves) and a direct
-    handle() instead of an HTTP inference hop — the tier-1 smoke's
-    seconds-scale budget has no room for a jit compile."""
+    selectors must not care which implementation serves) — the tier-1
+    smoke's seconds-scale budget has no room for a jit compile.  Wraps
+    `workloads.servefleet.SyntheticBackend`, which speaks the full
+    DecodeServer HTTP contract (POST /generate buffered + streaming,
+    GET /metrics), so the L7 balancer + loadgen serving path drives
+    either implementation identically."""
 
     def __init__(self, base_ms: float = 5.0, jitter_ms: float = 5.0,
                  seed: int = 0):
-        from kubernetes1_tpu.obs.appmetrics import AppMetrics
+        from kubernetes1_tpu.workloads.servefleet import SyntheticBackend
 
-        self.metrics = AppMetrics()
-        self.latency = self.metrics.histogram(
-            "ktpu_llama_request_latency_seconds",
-            "synthetic serving latency")
-        self.requests = self.metrics.counter(
-            "ktpu_llama_requests_total", "synthetic requests served")
-        self.qps = self.metrics.gauge("ktpu_llama_qps",
-                                      "synthetic served qps")
-        self._rnd = random.Random(seed)
-        self.base_ms = base_ms
-        self.jitter_ms = jitter_ms
+        # the loadgen posts max_new=4: per-token delay recovers roughly
+        # base_ms per request (jitter_ms kept for signature compat)
+        self.backend = SyntheticBackend(
+            token_delay_s=base_ms / 4.0 / 1000.0, seed=seed)
 
     def start(self):
-        self.metrics.serve()
+        self.backend.start()
         return self
 
     @property
     def port(self) -> int:
-        return self.metrics.port
+        return self.backend.port
 
     @property
     def base_url(self) -> str:
-        return self.metrics.url
+        return self.backend.url
 
     @property
     def metrics_url(self) -> str:
-        return self.metrics.url + "/metrics"
+        return self.backend.url + "/metrics"
 
     def request(self):
-        t0 = time.monotonic()
-        time.sleep((self.base_ms
-                    + self._rnd.random() * self.jitter_ms) / 1000.0)
-        self.requests.inc()
-        self.metrics.mark("ktpu_llama_qps")
-        self.latency.observe(time.monotonic() - t0)
+        import urllib.request
+
+        body = json.dumps({"tokens": [1, 2, 3], "max_new": 4}).encode()
+        req = urllib.request.Request(
+            self.backend.url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            r.read()
 
     def warmup(self):
         pass  # no jit: nothing to pay outside the histograms
 
     def stop(self):
-        self.metrics.stop()
+        self.backend.stop()
 
 
 class DecodeServe:
@@ -266,65 +273,6 @@ class DecodeServe:
         self.server.stop()
 
 
-class OpenLoopLoad:
-    """Open-loop request generator: requests fire on the clock schedule
-    regardless of completions (each in its own thread), the load model
-    under which tail latency means anything.  In-flight is capped so a
-    wedged server degrades to counted sheds, not a thread explosion."""
-
-    MAX_INFLIGHT = 32
-
-    def __init__(self, fn, rate: float):
-        self.fn = fn
-        self.rate = rate
-        self.issued = 0
-        self.errors = 0
-        self.shed = 0
-        self._inflight = 0
-        self._lock = threading.Lock()  # ktpulint: ignore[KTPU007] leaf counter lock in a bench harness
-        self._stopev = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self):
-        self._thread = threading.Thread(target=self._loop,
-                                        name="life-load", daemon=True)
-        self._thread.start()
-        return self
-
-    def _one(self):
-        try:
-            self.fn()
-        except Exception:  # noqa: BLE001 — counted: open-loop errors are data
-            with self._lock:
-                self.errors += 1
-        finally:
-            with self._lock:
-                self._inflight -= 1
-
-    def _loop(self):
-        period = 1.0 / max(self.rate, 0.1)
-        next_t = time.monotonic()
-        while not self._stopev.is_set():
-            now = time.monotonic()
-            if now < next_t:
-                self._stopev.wait(min(next_t - now, 0.05))
-                continue
-            next_t += period
-            with self._lock:
-                if self._inflight >= self.MAX_INFLIGHT:
-                    self.shed += 1
-                    continue
-                self._inflight += 1
-                self.issued += 1
-            threading.Thread(target=self._one, name="life-load-req",
-                             daemon=True).start()
-
-    def stop(self):
-        self._stopev.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-
-
 # ------------------------------------------------------------- plumbing
 
 
@@ -349,6 +297,11 @@ def _create_serving(cs, port: int, cfg: LifeConfig):
     c.resources.requests = {"cpu": "10m"}
     dep.spec.template.spec.containers = [c]
     cs.deployments.create(dep)
+    svc = t.Service()
+    svc.metadata.name = SERVE_APP
+    svc.spec.selector = {"app": SERVE_APP}
+    svc.spec.ports = [t.ServicePort(port=80)]
+    cs.services.create(svc, "default")
     hpa = t.HorizontalPodAutoscaler()
     hpa.metadata.name = f"{SERVE_APP}-hpa"
     hpa.spec.scale_target_ref = t.CrossVersionObjectReference(
@@ -581,6 +534,7 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
     driver = None
     conductor = None
     scorecard = None
+    balancer = None
     feeder_stop = threading.Event()
     breach_timelines: List[dict] = []
     phases: List[str] = []
@@ -635,12 +589,38 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
         _create_serving(cs, app.port, cfg)
         _serving_running(cs, cfg.serve_replicas)
 
+        # the REAL serving data plane (PR 20): load rides the L7
+        # least-inflight balancer, whose backend set tracks the serving
+        # Service's Endpoints (ready in, draining out).  Every pod
+        # resolves to the shared out-of-band app server — the pods are
+        # hollow, the app is the compute — so the path exercised is
+        # Service -> Endpoints -> balancer -> backend, drain semantics
+        # included, without one jax model per pod.
+        from kubernetes1_tpu.client import InformerFactory
+        from kubernetes1_tpu.proxy import (EndpointsBalancerSync,
+                                           LeastInflightBalancer)
+        from kubernetes1_tpu.workloads.loadgen import LoadGen
+        from kubernetes1_tpu.workloads.servefleet import rolling_update
+
+        bal_factory = InformerFactory(cs)
+        balancer = LeastInflightBalancer(seed=cfg.seed)
+        EndpointsBalancerSync(
+            balancer, bal_factory, "default", SERVE_APP,
+            resolver=lambda key, port: ("127.0.0.1", app.port))
+        bal_factory.start_all()
+        bal_factory.wait_for_sync()
+        t_bal = time.monotonic()
+        while not balancer.stats()["backends"] \
+                and time.monotonic() - t_bal < 15.0:
+            time.sleep(0.05)
+
         # ---- solo: serving -------------------------------------------
         _phase("solo:serving")
         phases.append("solo:serving")
         app_before = _fetch_parsed(app.metrics_url)
         fleet_before = _fleet_parsed(cluster)
-        load = OpenLoopLoad(app.request, cfg.serve_rate).start()
+        load = LoadGen(balancer.url, qps=cfg.serve_rate, stream=False,
+                       seed=cfg.seed, max_new=4).start()
         time.sleep(cfg.solo_seconds)
         load.stop()
         load = None
@@ -681,7 +661,42 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
         fleet_mix0 = _fleet_parsed(cluster)
         ops_mix0 = driver.creates + driver.deletes
         scorecard.start()
-        load = OpenLoopLoad(app.request, cfg.serve_rate).start()
+        load = LoadGen(balancer.url, qps=cfg.serve_rate, stream=False,
+                       seed=cfg.seed, max_new=4).start()
+
+        # mid-mix zero-downtime rollout: RollingUpdate the serving
+        # Deployment while the loadgen fires, feed the scorecard the
+        # failed-request count across the window (the
+        # serving_rollout_errors SLO) — fed from the rollout trigger
+        # until wind-down so the verdict has ticks even when the HPA's
+        # concurrent rescales keep the completion watch polling
+        rollout_result: dict = {}
+        rollout_thread = None
+        if cfg.serve_rollout:
+            mix_load = load
+
+            def run_rollout():
+                time.sleep(max(1.0, cfg.mix_seconds / 3.0))
+                failed0 = mix_load.failed
+
+                def drive():
+                    try:
+                        rollout_result.update(rolling_update(
+                            cs, SERVE_APP,
+                            timeout=max(10.0, cfg.mix_seconds)))
+                    except Exception as e:  # noqa: BLE001 — recorded: a failed rollout is a red SLO, not a crash
+                        rollout_result["completed"] = False
+                        rollout_result["error"] = str(e)
+
+                threading.Thread(target=drive, name="life-rollout-drive",
+                                 daemon=True).start()
+                while not feeder_stop.wait(0.5):
+                    scorecard.feed("serving_rollout_errors",
+                                   float(mix_load.failed - failed0))
+
+            rollout_thread = threading.Thread(
+                target=run_rollout, name="life-rollout", daemon=True)
+            rollout_thread.start()
 
         churn_thread = threading.Thread(
             target=lambda: driver.run(duration=cfg.mix_seconds, workers=2),
@@ -724,9 +739,13 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
         feeder_stop.set()
         feeder.join(timeout=3.0)
         load.stop()
-        load_stats = {"issued": load.issued, "errors": load.errors,
-                      "shed": load.shed}
+        load_stats = {"issued": load.issued, "errors": load.failed,
+                      "shed": load.shed, "acked": load.acked,
+                      **{k: v for k, v in load.summary().items()
+                         if k.endswith("_s") or k.endswith("_qps")}}
         load = None
+        if rollout_thread is not None:
+            rollout_thread.join(timeout=2.0)
         churn_thread.join(timeout=10.0)
         # gang-recovery grace: the kill->evict->re-place->Running arc may
         # close just after the mix window; hold the scorecard open until
@@ -797,6 +816,10 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
                 "serving": {"impl": cfg.serve_impl,
                             "rate_rps": cfg.serve_rate,
                             "replicas": cfg.serve_replicas,
+                            "balancer": {
+                                k: balancer.stats()[k]
+                                for k in ("requests", "retries", "errors")},
+                            "rollout": rollout_result,
                             **load_stats},
                 "training": {"gang_workers": cfg.gang_workers,
                              "gang_reached_running": gang_up},
@@ -832,6 +855,8 @@ def run_cluster_life(cfg: LifeConfig) -> dict:
             _quiet(driver.stop)
         if scorecard is not None:
             _quiet(scorecard.stop)
+        if balancer is not None:
+            _quiet(balancer.stop)
         if app is not None:
             _quiet(app.stop)
         if cluster is not None:

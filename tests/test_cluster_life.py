@@ -231,7 +231,8 @@ class TestClusterLifeSmoke:
         # >=5 SLO verdicts, one per scenario axis
         assert set(result["slos"]) == {
             "serving_p99", "serving_qps", "gang_recovery_mttr",
-            "churn_ops", "watch_lag", "hpa_reaction"}
+            "churn_ops", "watch_lag", "hpa_reaction",
+            "serving_rollout_errors"}
         for v in result["slos"].values():
             assert {"good", "bad", "missing", "met", "objective",
                     "breaches"} <= set(v)
@@ -249,6 +250,12 @@ class TestClusterLifeSmoke:
         # chaos windows were conducted and recorded
         assert result["chaos_events"], "no fault window fired"
         assert result["scenarios"]["training"]["gang_reached_running"]
+        # the serving phase rode the real L7 path: balancer counters
+        # moved, and the mid-mix rollout fed its zero-downtime SLO
+        serving = result["scenarios"]["serving"]
+        assert serving["balancer"]["requests"] > 0, serving
+        rollout_v = result["slos"]["serving_rollout_errors"]
+        assert rollout_v["good"] + rollout_v["bad"] > 0, rollout_v
         # a quiet 5s mix with generous thresholds must score green
         assert result["ok"] is True, result["slos"]
 

@@ -39,6 +39,7 @@ _COVERED = (
     "obs/collector.py",
     "kubelet/podscrape.py",
     "utils/eventloop.py",
+    "proxy/balancer.py",
 )
 
 
